@@ -1,0 +1,14 @@
+from kubernetes_tpu.api.quantity import parse_quantity  # noqa: F401
+from kubernetes_tpu.api.objects import (  # noqa: F401
+    Binding,
+    Container,
+    ContainerPort,
+    Node,
+    NodeCondition,
+    ObjectMeta,
+    Pod,
+    PodSpec,
+    PodStatus,
+    Taint,
+    Toleration,
+)

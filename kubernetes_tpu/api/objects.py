@@ -1,0 +1,419 @@
+"""Typed API objects (v1 subset) with Kubernetes-JSON round-tripping.
+
+The analog of the reference's versioned API types
+(staging/src/k8s.io/api/core/v1/types.go) plus their codec: each type parses
+from / serializes to the same JSON wire shape the reference speaks, so the
+extender endpoint (reference plugin/pkg/scheduler/core/extender.go:100) can
+accept `ExtenderArgs` from an unmodified Go control plane, and fixtures can be
+written as plain dicts.
+
+Only the fields the scheduling/controller planes consume are modeled; unknown
+fields are preserved in `extra` so round-trips are lossless enough for tests.
+"""
+
+from __future__ import annotations
+
+import copy
+import itertools
+from dataclasses import dataclass, field
+from typing import Any
+
+_uid_counter = itertools.count(1)
+
+
+def _new_uid() -> str:
+    return f"uid-{next(_uid_counter)}"
+
+
+@dataclass
+class ObjectMeta:
+    name: str = ""
+    namespace: str = "default"
+    uid: str = field(default_factory=_new_uid)
+    labels: dict[str, str] = field(default_factory=dict)
+    annotations: dict[str, str] = field(default_factory=dict)
+    resource_version: str = ""
+    owner_references: list[dict[str, Any]] = field(default_factory=list)
+    creation_timestamp: float = 0.0
+    deletion_timestamp: float | None = None
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]) -> "ObjectMeta":
+        return cls(
+            name=d.get("name", ""),
+            namespace=d.get("namespace", "default"),
+            uid=d.get("uid") or _new_uid(),
+            labels=dict(d.get("labels") or {}),
+            annotations=dict(d.get("annotations") or {}),
+            resource_version=str(d.get("resourceVersion", "")),
+            owner_references=list(d.get("ownerReferences") or []),
+        )
+
+    def to_dict(self) -> dict[str, Any]:
+        out: dict[str, Any] = {"name": self.name, "namespace": self.namespace, "uid": self.uid}
+        if self.labels:
+            out["labels"] = dict(self.labels)
+        if self.annotations:
+            out["annotations"] = dict(self.annotations)
+        if self.resource_version:
+            out["resourceVersion"] = self.resource_version
+        if self.owner_references:
+            out["ownerReferences"] = list(self.owner_references)
+        return out
+
+
+@dataclass
+class ContainerPort:
+    container_port: int = 0
+    host_port: int = 0
+    protocol: str = "TCP"
+    host_ip: str = ""
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]) -> "ContainerPort":
+        return cls(
+            container_port=int(d.get("containerPort", 0)),
+            host_port=int(d.get("hostPort", 0)),
+            protocol=d.get("protocol", "TCP") or "TCP",
+            host_ip=d.get("hostIP", "") or "",
+        )
+
+    def to_dict(self) -> dict[str, Any]:
+        out: dict[str, Any] = {"containerPort": self.container_port}
+        if self.host_port:
+            out["hostPort"] = self.host_port
+        if self.protocol != "TCP":
+            out["protocol"] = self.protocol
+        if self.host_ip:
+            out["hostIP"] = self.host_ip
+        return out
+
+
+@dataclass
+class Container:
+    name: str = ""
+    image: str = ""
+    requests: dict[str, str] = field(default_factory=dict)
+    limits: dict[str, str] = field(default_factory=dict)
+    ports: list[ContainerPort] = field(default_factory=list)
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]) -> "Container":
+        res = d.get("resources") or {}
+        return cls(
+            name=d.get("name", ""),
+            image=d.get("image", ""),
+            requests={k: str(v) for k, v in (res.get("requests") or {}).items()},
+            limits={k: str(v) for k, v in (res.get("limits") or {}).items()},
+            ports=[ContainerPort.from_dict(p) for p in d.get("ports") or []],
+        )
+
+    def to_dict(self) -> dict[str, Any]:
+        out: dict[str, Any] = {"name": self.name}
+        if self.image:
+            out["image"] = self.image
+        res: dict[str, Any] = {}
+        if self.requests:
+            res["requests"] = dict(self.requests)
+        if self.limits:
+            res["limits"] = dict(self.limits)
+        if res:
+            out["resources"] = res
+        if self.ports:
+            out["ports"] = [p.to_dict() for p in self.ports]
+        return out
+
+
+@dataclass
+class Toleration:
+    key: str = ""
+    operator: str = "Equal"  # Equal | Exists
+    value: str = ""
+    effect: str = ""  # "" tolerates all effects
+    toleration_seconds: int | None = None
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]) -> "Toleration":
+        return cls(
+            key=d.get("key", "") or "",
+            operator=d.get("operator", "Equal") or "Equal",
+            value=d.get("value", "") or "",
+            effect=d.get("effect", "") or "",
+            toleration_seconds=d.get("tolerationSeconds"),
+        )
+
+    def to_dict(self) -> dict[str, Any]:
+        out: dict[str, Any] = {}
+        if self.key:
+            out["key"] = self.key
+        if self.operator != "Equal":
+            out["operator"] = self.operator
+        if self.value:
+            out["value"] = self.value
+        if self.effect:
+            out["effect"] = self.effect
+        if self.toleration_seconds is not None:
+            out["tolerationSeconds"] = self.toleration_seconds
+        return out
+
+    def tolerates(self, taint: "Taint") -> bool:
+        """v1 helper semantics (reference
+        staging/src/k8s.io/api/core/v1 ToleratesTaint): empty effect matches
+        all effects; empty key with Exists matches all taints; Exists ignores
+        value."""
+        if self.effect and self.effect != taint.effect:
+            return False
+        if self.key and self.key != taint.key:
+            return False
+        if self.operator == "Exists":
+            return True
+        return self.value == taint.value
+
+
+@dataclass
+class Taint:
+    key: str = ""
+    value: str = ""
+    effect: str = "NoSchedule"  # NoSchedule | PreferNoSchedule | NoExecute
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]) -> "Taint":
+        return cls(key=d.get("key", ""), value=d.get("value", "") or "",
+                   effect=d.get("effect", "NoSchedule"))
+
+    def to_dict(self) -> dict[str, Any]:
+        out: dict[str, Any] = {"key": self.key, "effect": self.effect}
+        if self.value:
+            out["value"] = self.value
+        return out
+
+
+@dataclass
+class PodSpec:
+    node_name: str = ""
+    node_selector: dict[str, str] = field(default_factory=dict)
+    containers: list[Container] = field(default_factory=list)
+    tolerations: list[Toleration] = field(default_factory=list)
+    affinity: dict[str, Any] = field(default_factory=dict)  # raw v1 Affinity
+    scheduler_name: str = "default-scheduler"
+    restart_policy: str = "Always"
+    priority: int = 0
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]) -> "PodSpec":
+        return cls(
+            node_name=d.get("nodeName", "") or "",
+            node_selector=dict(d.get("nodeSelector") or {}),
+            containers=[Container.from_dict(c) for c in d.get("containers") or []],
+            tolerations=[Toleration.from_dict(t) for t in d.get("tolerations") or []],
+            affinity=copy.deepcopy(d.get("affinity") or {}),
+            scheduler_name=d.get("schedulerName", "default-scheduler") or "default-scheduler",
+            restart_policy=d.get("restartPolicy", "Always") or "Always",
+            priority=int(d.get("priority", 0) or 0),
+        )
+
+    def to_dict(self) -> dict[str, Any]:
+        out: dict[str, Any] = {}
+        if self.node_name:
+            out["nodeName"] = self.node_name
+        if self.node_selector:
+            out["nodeSelector"] = dict(self.node_selector)
+        if self.containers:
+            out["containers"] = [c.to_dict() for c in self.containers]
+        if self.tolerations:
+            out["tolerations"] = [t.to_dict() for t in self.tolerations]
+        if self.affinity:
+            out["affinity"] = copy.deepcopy(self.affinity)
+        if self.scheduler_name != "default-scheduler":
+            out["schedulerName"] = self.scheduler_name
+        if self.priority:
+            out["priority"] = self.priority
+        return out
+
+
+@dataclass
+class PodStatus:
+    phase: str = "Pending"
+    conditions: list[dict[str, Any]] = field(default_factory=list)
+    host_ip: str = ""
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]) -> "PodStatus":
+        return cls(
+            phase=d.get("phase", "Pending") or "Pending",
+            conditions=list(d.get("conditions") or []),
+            host_ip=d.get("hostIP", "") or "",
+        )
+
+    def to_dict(self) -> dict[str, Any]:
+        out: dict[str, Any] = {"phase": self.phase}
+        if self.conditions:
+            out["conditions"] = list(self.conditions)
+        if self.host_ip:
+            out["hostIP"] = self.host_ip
+        return out
+
+
+@dataclass
+class Pod:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: PodSpec = field(default_factory=PodSpec)
+    status: PodStatus = field(default_factory=PodStatus)
+
+    kind = "Pod"
+
+    @property
+    def key(self) -> str:
+        return f"{self.metadata.namespace}/{self.metadata.name}"
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]) -> "Pod":
+        return cls(
+            metadata=ObjectMeta.from_dict(d.get("metadata") or {}),
+            spec=PodSpec.from_dict(d.get("spec") or {}),
+            status=PodStatus.from_dict(d.get("status") or {}),
+        )
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "apiVersion": "v1",
+            "kind": "Pod",
+            "metadata": self.metadata.to_dict(),
+            "spec": self.spec.to_dict(),
+            "status": self.status.to_dict(),
+        }
+
+    def is_best_effort(self) -> bool:
+        """BestEffort QoS: no container has any request or limit (reference
+        pkg/api/v1/helper/qos/qos.go GetPodQOS)."""
+        for c in self.spec.containers:
+            if c.requests or c.limits:
+                return False
+        return True
+
+
+@dataclass
+class NodeCondition:
+    type: str = ""
+    status: str = "Unknown"  # True | False | Unknown
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]) -> "NodeCondition":
+        return cls(type=d.get("type", ""), status=d.get("status", "Unknown"))
+
+    def to_dict(self) -> dict[str, Any]:
+        return {"type": self.type, "status": self.status}
+
+
+@dataclass
+class NodeSpec:
+    unschedulable: bool = False
+    taints: list[Taint] = field(default_factory=list)
+    provider_id: str = ""
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]) -> "NodeSpec":
+        return cls(
+            unschedulable=bool(d.get("unschedulable", False)),
+            taints=[Taint.from_dict(t) for t in d.get("taints") or []],
+            provider_id=d.get("providerID", "") or "",
+        )
+
+    def to_dict(self) -> dict[str, Any]:
+        out: dict[str, Any] = {}
+        if self.unschedulable:
+            out["unschedulable"] = True
+        if self.taints:
+            out["taints"] = [t.to_dict() for t in self.taints]
+        if self.provider_id:
+            out["providerID"] = self.provider_id
+        return out
+
+
+@dataclass
+class NodeStatus:
+    capacity: dict[str, str] = field(default_factory=dict)
+    allocatable: dict[str, str] = field(default_factory=dict)
+    conditions: list[NodeCondition] = field(default_factory=list)
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]) -> "NodeStatus":
+        return cls(
+            capacity={k: str(v) for k, v in (d.get("capacity") or {}).items()},
+            allocatable={k: str(v) for k, v in (d.get("allocatable") or {}).items()},
+            conditions=[NodeCondition.from_dict(c) for c in d.get("conditions") or []],
+        )
+
+    def to_dict(self) -> dict[str, Any]:
+        out: dict[str, Any] = {}
+        if self.capacity:
+            out["capacity"] = dict(self.capacity)
+        if self.allocatable:
+            out["allocatable"] = dict(self.allocatable)
+        if self.conditions:
+            out["conditions"] = [c.to_dict() for c in self.conditions]
+        return out
+
+    def effective_allocatable(self) -> dict[str, str]:
+        """allocatable falls back to capacity when unset (reference defaulting
+        behavior in pkg/api/v1/defaults)."""
+        return self.allocatable or self.capacity
+
+
+@dataclass
+class Node:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: NodeSpec = field(default_factory=NodeSpec)
+    status: NodeStatus = field(default_factory=NodeStatus)
+
+    kind = "Node"
+
+    @property
+    def key(self) -> str:
+        return self.metadata.name
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]) -> "Node":
+        return cls(
+            metadata=ObjectMeta.from_dict(d.get("metadata") or {}),
+            spec=NodeSpec.from_dict(d.get("spec") or {}),
+            status=NodeStatus.from_dict(d.get("status") or {}),
+        )
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "apiVersion": "v1",
+            "kind": "Node",
+            "metadata": self.metadata.to_dict(),
+            "spec": self.spec.to_dict(),
+            "status": self.status.to_dict(),
+        }
+
+
+@dataclass
+class Binding:
+    """pods/binding subresource payload (reference pkg/registry/core/pod/rest;
+    written by the scheduler at plugin/pkg/scheduler/scheduler.go:224)."""
+
+    pod_name: str
+    namespace: str
+    target_node: str
+
+    kind = "Binding"
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]) -> "Binding":
+        meta = d.get("metadata") or {}
+        return cls(
+            pod_name=meta.get("name", ""),
+            namespace=meta.get("namespace", "default"),
+            target_node=(d.get("target") or {}).get("name", ""),
+        )
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "apiVersion": "v1",
+            "kind": "Binding",
+            "metadata": {"name": self.pod_name, "namespace": self.namespace},
+            "target": {"apiVersion": "v1", "kind": "Node", "name": self.target_node},
+        }

@@ -1,0 +1,3 @@
+from kubernetes_tpu.state.layout import Capacities, Resource  # noqa: F401
+from kubernetes_tpu.state.cluster_state import ClusterState, encode_nodes  # noqa: F401
+from kubernetes_tpu.state.pod_batch import PodBatch, encode_pods  # noqa: F401

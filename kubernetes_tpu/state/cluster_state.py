@@ -1,0 +1,285 @@
+"""Device-resident cluster state: a structure-of-arrays tensor database.
+
+This is the TPU-native re-design of the scheduler cache's per-node `NodeInfo`
+aggregate (reference plugin/pkg/scheduler/schedulercache/node_info.go:34-74:
+pods, requested/allocatable Resource, usedPorts, taints, conditions,
+generation). Instead of N Go structs behind a mutex, the whole cluster is a
+handful of padded arrays with the node axis outermost, so predicates/priorities
+evaluate as masked vector ops over every node at once and the node axis shards
+across a device mesh.
+
+Host-side bookkeeping (name->row mapping, topology-domain interning,
+generation counters for incremental scatter) lives in `NodeTable`; the arrays
+themselves are a pure pytree (`ClusterState`) safe to close over in jit.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Sequence
+
+import numpy as np
+from flax import struct
+
+from kubernetes_tpu.api.objects import Node, Pod
+from kubernetes_tpu.api.quantity import parse_quantity
+from kubernetes_tpu.state.layout import (
+    TOPOLOGY_KEYS,
+    Capacities,
+    CapacityError,
+    Condition,
+    Effect,
+    MEM_UNIT,
+    Resource,
+)
+from kubernetes_tpu.utils.hashing import hash32, hash_kv, hash_lanes
+
+
+@struct.dataclass
+class ClusterState:
+    """Pure pytree of padded device arrays; node axis is dim 0 everywhere."""
+
+    valid: np.ndarray          # bool[N] — row holds a live node
+    allocatable: np.ndarray    # f32[N, R]
+    requested: np.ndarray      # f32[N, R] — sum of requests of assigned pods
+    nonzero_requested: np.ndarray  # f32[N, 2] — (cpu, mem) with per-pod defaults
+    ports: np.ndarray          # i32[N, PORT_SLOTS], -1 = empty
+    label_key: np.ndarray      # u32[N, L] hash32(key), 0 = empty
+    label_kv_lo: np.ndarray    # u32[N, L] lane of hash(key=value)
+    label_kv_hi: np.ndarray    # u32[N, L]
+    taint_key: np.ndarray      # u32[N, T], 0 = empty
+    taint_kv_lo: np.ndarray    # u32[N, T]
+    taint_kv_hi: np.ndarray    # u32[N, T]
+    taint_effect: np.ndarray   # i32[N, T], Effect codes
+    conditions: np.ndarray     # u32[N] Condition bitmask (0 == healthy)
+    name_lo: np.ndarray        # u32[N] node-name hash lanes
+    name_hi: np.ndarray        # u32[N]
+    topology: np.ndarray       # i32[N, TK] interned domain id, -1 = unknown
+
+    @property
+    def num_nodes(self) -> int:
+        return self.valid.shape[0]
+
+
+def empty_state(caps: Capacities) -> ClusterState:
+    n = caps.num_nodes
+    r = Resource.COUNT
+    return ClusterState(
+        valid=np.zeros((n,), np.bool_),
+        allocatable=np.zeros((n, r), np.float32),
+        requested=np.zeros((n, r), np.float32),
+        nonzero_requested=np.zeros((n, 2), np.float32),
+        ports=np.full((n, caps.node_port_slots), -1, np.int32),
+        label_key=np.zeros((n, caps.label_slots), np.uint32),
+        label_kv_lo=np.zeros((n, caps.label_slots), np.uint32),
+        label_kv_hi=np.zeros((n, caps.label_slots), np.uint32),
+        taint_key=np.zeros((n, caps.taint_slots), np.uint32),
+        taint_kv_lo=np.zeros((n, caps.taint_slots), np.uint32),
+        taint_kv_hi=np.zeros((n, caps.taint_slots), np.uint32),
+        taint_effect=np.zeros((n, caps.taint_slots), np.int32),
+        conditions=np.zeros((n,), np.uint32),
+        name_lo=np.zeros((n,), np.uint32),
+        name_hi=np.zeros((n,), np.uint32),
+        topology=np.full((n, caps.topology_slots), -1, np.int32),
+    )
+
+
+def resource_rows(quantities: dict[str, str]) -> np.ndarray:
+    """v1 resource map -> f32[R] in device units."""
+    out = np.zeros((Resource.COUNT,), np.float32)
+    for name, qty in quantities.items():
+        entry = Resource.NAMES.get(name)
+        if entry is None:
+            continue  # opaque int resources: not yet modeled on device
+        row, kind = entry
+        frac = parse_quantity(qty)
+        if kind == "milli":
+            out[row] = float(frac * 1000)
+        elif kind == "mem":
+            out[row] = float(frac / MEM_UNIT)
+        else:
+            out[row] = float(frac)
+    return out
+
+
+def condition_mask(node: Node) -> int:
+    mask = 0
+    ready_seen = False
+    for cond in node.status.conditions:
+        if cond.type == "Ready":
+            ready_seen = True
+            if cond.status != "True":
+                mask |= Condition.NOT_READY
+        elif cond.type == "MemoryPressure" and cond.status == "True":
+            mask |= Condition.MEMORY_PRESSURE
+        elif cond.type == "DiskPressure" and cond.status == "True":
+            mask |= Condition.DISK_PRESSURE
+        elif cond.type == "NetworkUnavailable" and cond.status == "True":
+            mask |= Condition.NETWORK_UNAVAILABLE
+        elif cond.type == "OutOfDisk" and cond.status == "True":
+            mask |= Condition.OUT_OF_DISK
+    if not ready_seen and node.status.conditions:
+        # Conditions reported but no Ready condition: treat as not ready
+        # (reference CheckNodeCondition treats missing Ready as unknown).
+        mask |= Condition.NOT_READY
+    if node.spec.unschedulable:
+        mask |= Condition.UNSCHEDULABLE
+    return mask
+
+
+class NodeTable:
+    """Host-side index over the device state: row assignment, free-list,
+    topology-domain interning, per-row generation (the analog of
+    NodeInfo.generation, node_info.go:60) for incremental device updates."""
+
+    def __init__(self, caps: Capacities):
+        self.caps = caps
+        self.row_of: dict[str, int] = {}
+        self.name_of: list[str | None] = [None] * caps.num_nodes
+        self.free: list[int] = list(range(caps.num_nodes - 1, -1, -1))
+        self.generation: np.ndarray = np.zeros((caps.num_nodes,), np.int64)
+        self._gen_counter = 0
+        # topology interning: per topology key, domain string -> id
+        self.domains: list[dict[str, int]] = [dict() for _ in TOPOLOGY_KEYS]
+
+    def assign_row(self, name: str) -> int:
+        row = self.row_of.get(name)
+        if row is None:
+            if not self.free:
+                raise CapacityError(
+                    f"node capacity {self.caps.num_nodes} exhausted adding {name!r}")
+            row = self.free.pop()
+            self.row_of[name] = row
+            self.name_of[row] = name
+        return row
+
+    def release_row(self, name: str) -> int:
+        row = self.row_of.pop(name)
+        self.name_of[row] = None
+        self.free.append(row)
+        return row
+
+    def bump(self, row: int) -> None:
+        self._gen_counter += 1
+        self.generation[row] = self._gen_counter
+
+    def intern_domain(self, key_idx: int, value: str) -> int:
+        table = self.domains[key_idx]
+        did = table.get(value)
+        if did is None:
+            did = len(table)
+            table[value] = did
+        return did
+
+
+def _fill_node_row(state: ClusterState, table: NodeTable, row: int, node: Node) -> None:
+    caps = table.caps
+    state.valid[row] = True
+    state.allocatable[row] = resource_rows(node.status.effective_allocatable())
+    state.conditions[row] = condition_mask(node)
+    lo, hi = hash_lanes(node.metadata.name)
+    state.name_lo[row], state.name_hi[row] = lo, hi
+
+    labels = node.metadata.labels
+    if len(labels) > caps.label_slots:
+        raise CapacityError(
+            f"node {node.metadata.name!r}: {len(labels)} labels > {caps.label_slots} slots")
+    state.label_key[row] = 0
+    state.label_kv_lo[row] = 0
+    state.label_kv_hi[row] = 0
+    for i, (k, v) in enumerate(sorted(labels.items())):
+        state.label_key[row, i] = hash32(k)
+        kv_lo, kv_hi = hash_kv(k, v)
+        state.label_kv_lo[row, i] = kv_lo
+        state.label_kv_hi[row, i] = kv_hi
+
+    taints = node.spec.taints
+    if len(taints) > caps.taint_slots:
+        raise CapacityError(
+            f"node {node.metadata.name!r}: {len(taints)} taints > {caps.taint_slots} slots")
+    state.taint_key[row] = 0
+    state.taint_kv_lo[row] = 0
+    state.taint_kv_hi[row] = 0
+    state.taint_effect[row] = Effect.NONE
+    for i, t in enumerate(taints):
+        state.taint_key[row, i] = hash32(t.key)
+        kv_lo, kv_hi = hash_kv(t.key, t.value)
+        state.taint_kv_lo[row, i] = kv_lo
+        state.taint_kv_hi[row, i] = kv_hi
+        state.taint_effect[row, i] = Effect.NAMES.get(t.effect, Effect.NONE)
+
+    state.topology[row] = -1
+    for ki, key in enumerate(TOPOLOGY_KEYS):
+        val = labels.get(key)
+        if key == "kubernetes.io/hostname" and val is None:
+            val = node.metadata.name  # hostname domain defaults to node name
+        if val is not None:
+            state.topology[row, ki] = table.intern_domain(ki, val)
+
+
+def pod_requests(pod: Pod) -> np.ndarray:
+    """Sum of container requests in device units, +1 pod slot (reference
+    GetResourceRequest, predicates.go; pods row mirrors the
+    len(nodeInfo.Pods())+1 > allowedPodNumber check at predicates.go:561)."""
+    out = np.zeros((Resource.COUNT,), np.float32)
+    out[Resource.PODS] = 1.0
+    for c in pod.spec.containers:
+        out += resource_rows(c.requests)
+    out[Resource.PODS] = 1.0
+    return out
+
+
+def pod_nonzero_requests(pod: Pod) -> np.ndarray:
+    """(cpu_milli, mem_mib) with per-container defaults for scoring (reference
+    priorities/util/non_zero.go GetNonzeroRequests)."""
+    from kubernetes_tpu.state.layout import (
+        DEFAULT_NONZERO_CPU_MILLI,
+        DEFAULT_NONZERO_MEM_MIB,
+    )
+
+    cpu = 0.0
+    mem = 0.0
+    for c in pod.spec.containers:
+        c_rows = resource_rows(c.requests)
+        cpu += c_rows[Resource.CPU] if c_rows[Resource.CPU] > 0 else DEFAULT_NONZERO_CPU_MILLI
+        mem += c_rows[Resource.MEMORY] if c_rows[Resource.MEMORY] > 0 else DEFAULT_NONZERO_MEM_MIB
+    return np.array([cpu, mem], np.float32)
+
+
+def add_pod_to_state(state: ClusterState, table: NodeTable, pod: Pod, row: int) -> None:
+    """Account an assigned pod against a node row (the analog of
+    NodeInfo.addPod, node_info.go:171)."""
+    state.requested[row] += pod_requests(pod)
+    state.nonzero_requested[row] += pod_nonzero_requests(pod)
+    ports = state.ports[row]
+    for c in pod.spec.containers:
+        for p in c.ports:
+            if p.host_port:
+                empty = np.nonzero(ports == -1)[0]
+                if empty.size == 0:
+                    raise CapacityError(
+                        f"node row {row}: port slots ({table.caps.node_port_slots}) exhausted")
+                ports[empty[0]] = p.host_port
+    table.bump(row)
+
+
+def encode_nodes(
+    nodes: Iterable[Node],
+    caps: Capacities,
+    assigned_pods: Sequence[Pod] = (),
+) -> tuple[ClusterState, NodeTable]:
+    """Full (re-)encode: the List half of list+watch. Incremental updates go
+    through `statedb.StateDB` which scatters only changed rows."""
+    state = empty_state(caps)
+    table = NodeTable(caps)
+    for node in nodes:
+        row = table.assign_row(node.metadata.name)
+        _fill_node_row(state, table, row, node)
+        table.bump(row)
+    for pod in assigned_pods:
+        if not pod.spec.node_name:
+            continue
+        row = table.row_of.get(pod.spec.node_name)
+        if row is None:
+            continue  # pod bound to an unknown node: ignored, like cache misses
+        add_pod_to_state(state, table, pod, row)
+    return state, table

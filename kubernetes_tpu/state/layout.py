@@ -1,0 +1,116 @@
+"""Fixed tensor layout shared by the cluster-state database and pod batches.
+
+Everything scheduled on device has a static, padded shape: TPU/XLA compiles
+one program per shape bucket, so capacities are part of the compile key.
+`Capacities` is hashable and frozen — pass it as a static argument to jitted
+functions.
+
+Units (chosen so common values are exact in float32):
+- cpu: milli-cores (reference Resource.MilliCPU, schedulercache/node_info.go)
+- memory / storage: MiB (reference uses int64 bytes; MiB keeps terabyte-range
+  clusters inside float32's 2^24 exact-integer window)
+- gpu / pods: counts
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+MEM_UNIT = 2**20  # bytes per device-side memory unit (MiB)
+
+
+class Resource:
+    """Row indices of the resource axis (reference scheduler Resource struct:
+    plugin/pkg/scheduler/schedulercache/node_info.go:45-52)."""
+
+    PODS = 0
+    CPU = 1        # milli-cores
+    MEMORY = 2     # MiB
+    GPU = 3        # count (alpha.kubernetes.io/nvidia-gpu)
+    SCRATCH = 4    # MiB (storage.kubernetes.io/scratch)
+    OVERLAY = 5    # MiB (storage.kubernetes.io/overlay)
+    COUNT = 6
+
+    # v1 resource-name -> (row, converter kind)
+    NAMES = {
+        "pods": (PODS, "count"),
+        "cpu": (CPU, "milli"),
+        "memory": (MEMORY, "mem"),
+        "alpha.kubernetes.io/nvidia-gpu": (GPU, "count"),
+        "storage.kubernetes.io/scratch": (SCRATCH, "mem"),
+        "storage.kubernetes.io/overlay": (OVERLAY, "mem"),
+    }
+
+
+class Effect:
+    """Taint-effect codes (0 reserved for empty slot)."""
+
+    NONE = 0
+    NO_SCHEDULE = 1
+    PREFER_NO_SCHEDULE = 2
+    NO_EXECUTE = 3
+
+    NAMES = {"NoSchedule": NO_SCHEDULE, "PreferNoSchedule": PREFER_NO_SCHEDULE,
+             "NoExecute": NO_EXECUTE}
+
+
+class TolOp:
+    """Toleration operator codes (0 reserved for empty slot)."""
+
+    NONE = 0
+    EQUAL = 1
+    EXISTS = 2
+
+
+class Condition:
+    """Bits of the per-node condition mask. Bit set == the *bad* state, so an
+    all-zero mask is a healthy schedulable node (reference:
+    CheckNodeCondition predicates.go:1306, pressure checks :1274,:1296, and
+    the unschedulable filter in factory.go's node lister predicate)."""
+
+    NOT_READY = 1 << 0
+    MEMORY_PRESSURE = 1 << 1
+    DISK_PRESSURE = 1 << 2
+    NETWORK_UNAVAILABLE = 1 << 3
+    OUT_OF_DISK = 1 << 4
+    UNSCHEDULABLE = 1 << 5
+
+
+# Topology keys interned into the per-node topology table, in row order.
+TOPOLOGY_KEYS = (
+    "kubernetes.io/hostname",
+    "failure-domain.beta.kubernetes.io/zone",
+    "failure-domain.beta.kubernetes.io/region",
+)
+
+# Scoring-time defaults for pods with no requests (reference
+# plugin/pkg/scheduler/algorithm/priorities/util/non_zero.go:29-31).
+DEFAULT_NONZERO_CPU_MILLI = 100.0
+DEFAULT_NONZERO_MEM_MIB = 200.0 * 1024 * 1024 / MEM_UNIT  # 200 MB in MiB
+
+MAX_PRIORITY = 10  # schedulerapi.MaxPriority
+
+
+@dataclass(frozen=True)
+class Capacities:
+    """Static padding capacities — the compile-time shape key.
+
+    Encoders raise `CapacityError` when an object exceeds a per-slot capacity;
+    pick capacities for the workload (defaults cover scheduler_perf-style
+    fixtures and typical clusters).
+    """
+
+    num_nodes: int = 1024          # N: node axis (pad to multiple of mesh size)
+    batch_pods: int = 256          # P: pending pods per solver batch
+    label_slots: int = 24          # L: labels per node
+    taint_slots: int = 8           # T: taints per node
+    node_port_slots: int = 32      # host ports in use per node
+    pod_port_slots: int = 8        # host ports requested per pod
+    selector_slots: int = 12       # nodeSelector terms per pod
+    toleration_slots: int = 8      # tolerations per pod
+    topology_slots: int = len(TOPOLOGY_KEYS)
+    affinity_terms: int = 4        # pod (anti-)affinity terms per pod
+
+
+class CapacityError(ValueError):
+    """An object does not fit the static tensor capacities."""

@@ -1,0 +1,120 @@
+"""Pending-pod batch encoding: P pods -> padded arrays for one solver call.
+
+The reference schedules one pod at a time (scheduler.go:253 scheduleOne); here
+a whole batch of pending pods is encoded as a padded (P, ...) pytree and
+scheduled in one device program. Padding rows have valid=False and are ignored
+by the solver.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import numpy as np
+from flax import struct
+
+from kubernetes_tpu.api.objects import Pod
+from kubernetes_tpu.state.cluster_state import pod_nonzero_requests, pod_requests
+from kubernetes_tpu.state.layout import Capacities, CapacityError, Effect, Resource, TolOp
+from kubernetes_tpu.utils.hashing import hash32, hash_kv, hash_lanes
+
+
+@struct.dataclass
+class PodBatch:
+    valid: np.ndarray           # bool[P]
+    requests: np.ndarray        # f32[P, R]
+    nonzero_requests: np.ndarray  # f32[P, 2] (cpu, mem) scoring requests
+    ports: np.ndarray           # i32[P, Kp], -1 = empty
+    sel_kv_lo: np.ndarray       # u32[P, S] nodeSelector key=value hash lanes, 0 = empty
+    sel_kv_hi: np.ndarray       # u32[P, S]
+    tol_key: np.ndarray         # u32[P, T] hash32(key), 0 = empty key (Exists -> all)
+    tol_kv_lo: np.ndarray       # u32[P, T]
+    tol_kv_hi: np.ndarray       # u32[P, T]
+    tol_op: np.ndarray          # i32[P, T] TolOp codes, NONE = unused slot
+    tol_effect: np.ndarray      # i32[P, T] Effect codes, NONE = all effects
+    node_name_lo: np.ndarray    # u32[P] spec.nodeName hash lanes, 0 = unset
+    node_name_hi: np.ndarray    # u32[P]
+    best_effort: np.ndarray     # bool[P] BestEffort QoS (pressure-check exemption)
+
+    @property
+    def batch_pods(self) -> int:
+        return self.valid.shape[0]
+
+
+def empty_batch(caps: Capacities) -> PodBatch:
+    p = caps.batch_pods
+    return PodBatch(
+        valid=np.zeros((p,), np.bool_),
+        requests=np.zeros((p, Resource.COUNT), np.float32),
+        nonzero_requests=np.zeros((p, 2), np.float32),
+        ports=np.full((p, caps.pod_port_slots), -1, np.int32),
+        sel_kv_lo=np.zeros((p, caps.selector_slots), np.uint32),
+        sel_kv_hi=np.zeros((p, caps.selector_slots), np.uint32),
+        tol_key=np.zeros((p, caps.toleration_slots), np.uint32),
+        tol_kv_lo=np.zeros((p, caps.toleration_slots), np.uint32),
+        tol_kv_hi=np.zeros((p, caps.toleration_slots), np.uint32),
+        tol_op=np.zeros((p, caps.toleration_slots), np.int32),
+        tol_effect=np.zeros((p, caps.toleration_slots), np.int32),
+        node_name_lo=np.zeros((p,), np.uint32),
+        node_name_hi=np.zeros((p,), np.uint32),
+        best_effort=np.zeros((p,), np.bool_),
+    )
+
+
+def encode_pod_into(batch: PodBatch, i: int, pod: Pod, caps: Capacities) -> None:
+    batch.valid[i] = True
+    batch.requests[i] = pod_requests(pod)
+    batch.nonzero_requests[i] = pod_nonzero_requests(pod)
+
+    host_ports = [p.host_port for c in pod.spec.containers for p in c.ports if p.host_port]
+    if len(host_ports) > caps.pod_port_slots:
+        raise CapacityError(f"pod {pod.key}: {len(host_ports)} host ports > "
+                            f"{caps.pod_port_slots} slots")
+    batch.ports[i] = -1
+    batch.ports[i, : len(host_ports)] = host_ports
+
+    selector = pod.spec.node_selector
+    if len(selector) > caps.selector_slots:
+        raise CapacityError(f"pod {pod.key}: {len(selector)} selector terms > "
+                            f"{caps.selector_slots} slots")
+    batch.sel_kv_lo[i] = 0
+    batch.sel_kv_hi[i] = 0
+    for s, (k, v) in enumerate(sorted(selector.items())):
+        lo, hi = hash_kv(k, v)
+        batch.sel_kv_lo[i, s] = lo
+        batch.sel_kv_hi[i, s] = hi
+
+    tols = pod.spec.tolerations
+    if len(tols) > caps.toleration_slots:
+        raise CapacityError(f"pod {pod.key}: {len(tols)} tolerations > "
+                            f"{caps.toleration_slots} slots")
+    batch.tol_key[i] = 0
+    batch.tol_kv_lo[i] = 0
+    batch.tol_kv_hi[i] = 0
+    batch.tol_op[i] = TolOp.NONE
+    batch.tol_effect[i] = Effect.NONE
+    for t, tol in enumerate(tols):
+        batch.tol_key[i, t] = hash32(tol.key) if tol.key else 0
+        kv_lo, kv_hi = hash_kv(tol.key, tol.value)
+        batch.tol_kv_lo[i, t] = kv_lo
+        batch.tol_kv_hi[i, t] = kv_hi
+        batch.tol_op[i, t] = TolOp.EXISTS if tol.operator == "Exists" else TolOp.EQUAL
+        batch.tol_effect[i, t] = Effect.NAMES.get(tol.effect, Effect.NONE)
+
+    if pod.spec.node_name:
+        lo, hi = hash_lanes(pod.spec.node_name)
+        batch.node_name_lo[i] = lo
+        batch.node_name_hi[i] = hi
+    else:
+        batch.node_name_lo[i] = 0
+        batch.node_name_hi[i] = 0
+    batch.best_effort[i] = pod.is_best_effort()
+
+
+def encode_pods(pods: Sequence[Pod], caps: Capacities) -> PodBatch:
+    if len(pods) > caps.batch_pods:
+        raise CapacityError(f"{len(pods)} pods > batch capacity {caps.batch_pods}")
+    batch = empty_batch(caps)
+    for i, pod in enumerate(pods):
+        encode_pod_into(batch, i, pod, caps)
+    return batch

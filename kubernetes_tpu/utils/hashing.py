@@ -1,0 +1,52 @@
+"""Stable string hashing for device-side label/taint/selector matching.
+
+The reference matches labels, selectors, taints and node names as Go strings
+(e.g. plugin/pkg/scheduler/algorithm/predicates/predicates.go:686
+`PodMatchNodeSelector`). On TPU, strings become fixed-width integer hashes
+computed once on the host at encode time; all device-side comparisons are
+integer equality. We use FNV-1a 64-bit split into two uint32 lanes (TPU int64
+support is emulated, uint32 compares are native), giving an effective 64-bit
+match space: collisions require both lanes to collide simultaneously
+(~2^-64 per pair; at 15k nodes x 32 labels the birthday bound is ~1e-8).
+
+Hash value 0 is reserved as the "empty slot" sentinel; real hashes that land
+on 0 are remapped to 1.
+"""
+
+from __future__ import annotations
+
+_FNV64_OFFSET = 0xCBF29CE484222325
+_FNV64_PRIME = 0x100000001B3
+_MASK64 = 0xFFFFFFFFFFFFFFFF
+
+
+def fnv1a64(data: str | bytes) -> int:
+    """FNV-1a 64-bit hash of a string (utf-8) or bytes."""
+    if isinstance(data, str):
+        data = data.encode("utf-8")
+    h = _FNV64_OFFSET
+    for b in data:
+        h = ((h ^ b) * _FNV64_PRIME) & _MASK64
+    return h
+
+
+def hash_lanes(data: str | bytes) -> tuple[int, int]:
+    """Return (lo32, hi32) uint32 lanes of fnv1a64, each remapped 0 -> 1."""
+    h = fnv1a64(data)
+    lo = h & 0xFFFFFFFF
+    hi = (h >> 32) & 0xFFFFFFFF
+    return (lo or 1, hi or 1)
+
+
+def hash32(data: str | bytes) -> int:
+    """Single uint32 hash lane (lo lane), 0 remapped to 1.
+
+    Used where one lane suffices (small universes such as topology-domain
+    interning where exactness is enforced by a host-side intern table).
+    """
+    return hash_lanes(data)[0]
+
+
+def hash_kv(key: str, value: str) -> tuple[int, int]:
+    """Hash lanes for a key=value pair (labels, selector terms, taints)."""
+    return hash_lanes(key + "\x00" + value)

@@ -1,0 +1,121 @@
+"""Node/pod tensor encoding tests (analog of schedulercache NodeInfo tests,
+reference plugin/pkg/scheduler/schedulercache/node_info.go semantics)."""
+
+import numpy as np
+import pytest
+
+from kubernetes_tpu.api.objects import Node, Pod
+from kubernetes_tpu.state import Capacities, Resource, encode_nodes, encode_pods
+from kubernetes_tpu.state.cluster_state import pod_nonzero_requests, pod_requests
+from kubernetes_tpu.state.layout import (
+    CapacityError,
+    Condition,
+    DEFAULT_NONZERO_CPU_MILLI,
+    DEFAULT_NONZERO_MEM_MIB,
+    Effect,
+)
+
+CAPS = Capacities(num_nodes=8, batch_pods=4)
+
+
+def mk_node(name, cpu="4", mem="8Gi", pods="110", labels=None, taints=None,
+            conditions=None, unschedulable=False):
+    return Node.from_dict({
+        "metadata": {"name": name, "labels": labels or {}},
+        "spec": {"taints": taints or [], "unschedulable": unschedulable},
+        "status": {
+            "allocatable": {"cpu": cpu, "memory": mem, "pods": pods},
+            "conditions": conditions or [{"type": "Ready", "status": "True"}],
+        },
+    })
+
+
+def mk_pod(name, cpu="100m", mem="128Mi", **spec):
+    containers = [{"name": "c", "resources": {"requests": {"cpu": cpu, "memory": mem}}}]
+    if not cpu and not mem:
+        containers = [{"name": "c"}]
+    return Pod.from_dict({"metadata": {"name": name}, "spec": {"containers": containers, **spec}})
+
+
+def test_node_resources_units():
+    state, table = encode_nodes([mk_node("n0", cpu="2500m", mem="4Gi", pods="10")], CAPS)
+    row = table.row_of["n0"]
+    assert state.valid[row]
+    assert state.allocatable[row, Resource.CPU] == 2500
+    assert state.allocatable[row, Resource.MEMORY] == 4096
+    assert state.allocatable[row, Resource.PODS] == 10
+    assert not state.valid[(row + 1) % CAPS.num_nodes]
+
+
+def test_pod_requests_and_pods_row():
+    req = pod_requests(mk_pod("p", cpu="250m", mem="64Mi"))
+    assert req[Resource.PODS] == 1
+    assert req[Resource.CPU] == 250
+    assert req[Resource.MEMORY] == 64
+
+
+def test_nonzero_request_defaults():
+    nz = pod_nonzero_requests(mk_pod("p", cpu="", mem=""))
+    assert nz[0] == DEFAULT_NONZERO_CPU_MILLI
+    assert nz[1] == pytest.approx(DEFAULT_NONZERO_MEM_MIB)
+
+
+def test_assigned_pods_accumulate():
+    pod = mk_pod("p", cpu="500m", mem="256Mi")
+    pod.spec.node_name = "n0"
+    state, table = encode_nodes([mk_node("n0")], CAPS, assigned_pods=[pod, pod])
+    row = table.row_of["n0"]
+    assert state.requested[row, Resource.CPU] == 1000
+    assert state.requested[row, Resource.PODS] == 2
+
+
+def test_taints_and_conditions():
+    node = mk_node(
+        "n0",
+        taints=[{"key": "gpu", "value": "true", "effect": "NoSchedule"}],
+        conditions=[{"type": "Ready", "status": "True"},
+                    {"type": "MemoryPressure", "status": "True"}],
+        unschedulable=True,
+    )
+    state, table = encode_nodes([node], CAPS)
+    row = table.row_of["n0"]
+    assert state.taint_effect[row, 0] == Effect.NO_SCHEDULE
+    assert state.taint_key[row, 0] != 0
+    assert state.conditions[row] & Condition.MEMORY_PRESSURE
+    assert state.conditions[row] & Condition.UNSCHEDULABLE
+    assert not state.conditions[row] & Condition.NOT_READY
+
+
+def test_topology_interning():
+    nodes = [mk_node(f"n{i}", labels={"failure-domain.beta.kubernetes.io/zone":
+                                      f"zone-{i % 2}"}) for i in range(4)]
+    state, table = encode_nodes(nodes, CAPS)
+    zones = [state.topology[table.row_of[f"n{i}"], 1] for i in range(4)]
+    assert zones[0] == zones[2] and zones[1] == zones[3] and zones[0] != zones[1]
+    # hostname domain defaults to the node name -> all distinct
+    hosts = {int(state.topology[table.row_of[f"n{i}"], 0]) for i in range(4)}
+    assert len(hosts) == 4
+
+
+def test_pod_batch_selector_and_tolerations():
+    pod = mk_pod("p", nodeSelector={"disk": "ssd"},
+                 tolerations=[{"key": "gpu", "operator": "Exists", "effect": "NoSchedule"}])
+    batch = encode_pods([pod], CAPS)
+    assert batch.valid[0] and not batch.valid[1]
+    assert batch.sel_kv_lo[0, 0] != 0 and batch.sel_kv_lo[0, 1] == 0
+    assert batch.tol_op[0, 0] == 2  # Exists
+    assert batch.tol_effect[0, 0] == Effect.NO_SCHEDULE
+
+
+def test_capacity_errors():
+    with pytest.raises(CapacityError):
+        encode_nodes([mk_node(f"n{i}") for i in range(CAPS.num_nodes + 1)], CAPS)
+    with pytest.raises(CapacityError):
+        encode_pods([mk_pod(f"p{i}") for i in range(CAPS.batch_pods + 1)], CAPS)
+
+
+def test_row_reuse_after_release():
+    state, table = encode_nodes([mk_node("n0"), mk_node("n1")], CAPS)
+    row = table.row_of["n1"]
+    table.release_row("n1")
+    assert table.assign_row("n2") == row

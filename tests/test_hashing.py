@@ -1,0 +1,24 @@
+from kubernetes_tpu.utils.hashing import fnv1a64, hash32, hash_kv, hash_lanes
+
+
+def test_fnv_known_vectors():
+    # Published FNV-1a 64 test vectors.
+    assert fnv1a64(b"") == 0xCBF29CE484222325
+    assert fnv1a64(b"a") == 0xAF63DC4C8601EC8C
+    assert fnv1a64(b"foobar") == 0x85944171F73967E8
+
+
+def test_lanes_never_zero():
+    lo, hi = hash_lanes("")
+    assert lo != 0 and hi != 0
+    assert hash32("x") != 0
+
+
+def test_kv_distinct_from_concat():
+    # "ab"+"c" must not collide with "a"+"bc" (NUL separator).
+    assert hash_kv("ab", "c") != hash_kv("a", "bc")
+
+
+def test_stability():
+    assert hash_lanes("zone-a") == hash_lanes("zone-a")
+    assert hash_lanes("zone-a") != hash_lanes("zone-b")

@@ -1,0 +1,48 @@
+"""Quantity grammar parity with the reference's resource.Quantity
+(vendor/k8s.io/apimachinery/pkg/api/resource/quantity.go)."""
+
+from fractions import Fraction
+
+import pytest
+
+from kubernetes_tpu.api.quantity import parse_quantity, to_int, to_milli
+
+
+@pytest.mark.parametrize(
+    "text,expected",
+    [
+        ("100m", Fraction(1, 10)),
+        ("1", 1),
+        ("0.5", Fraction(1, 2)),
+        ("2k", 2000),
+        ("1Ki", 1024),
+        ("1Mi", 1024**2),
+        ("1Gi", 1024**3),
+        ("4Ti", 4 * 1024**4),
+        ("1G", 10**9),
+        ("1e3", 1000),
+        ("1.5E2", 150),
+        ("250u", Fraction(250, 10**6)),
+        ("3n", Fraction(3, 10**9)),
+    ],
+)
+def test_parse(text, expected):
+    assert parse_quantity(text) == Fraction(expected)
+
+
+def test_milli_rounds_up():
+    assert to_milli("100m") == 100
+    assert to_milli("1") == 1000
+    assert to_milli("1m") == 1
+    assert to_milli(Fraction(1, 3000) * 1) == pytest.approx(1)  # ceil to 1 milli
+
+
+def test_to_int_bytes():
+    assert to_int("128Mi") == 128 * 1024**2
+    assert to_int("1500m") == 2  # ceil
+
+
+@pytest.mark.parametrize("bad", ["", "abc", "1Xi", "--3"])
+def test_rejects_garbage(bad):
+    with pytest.raises(ValueError):
+        parse_quantity(bad)
