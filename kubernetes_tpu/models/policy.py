@@ -1,0 +1,95 @@
+"""Scheduler policy: which predicates/priorities run, with what weights.
+
+The analog of the reference's Policy file API
+(plugin/pkg/scheduler/api/types.go:38-50: `Policy{Predicates, Priorities,
+ExtenderConfigs}` loadable from JSON) and the default algorithm provider
+(algorithmprovider/defaults/defaults.go:73-231). The policy is frozen and
+hashable so it can be a static jit argument: changing policy recompiles the
+device program, matching the reference's construct-scheduler-from-policy flow
+(factory.go CreateFromConfig).
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+
+# Predicate names follow the reference registry (factory/plugins.go).
+# "GeneralPredicates" expands to resources+host+ports+selector
+# (predicates.go:900).
+DEFAULT_PREDICATES: tuple[str, ...] = (
+    "GeneralPredicates",
+    "PodToleratesNodeTaints",
+    "CheckNodeMemoryPressure",
+    "CheckNodeDiskPressure",
+    "CheckNodeCondition",
+)
+
+DEFAULT_PRIORITIES: tuple[tuple[str, int], ...] = (
+    ("LeastRequestedPriority", 1),
+    ("BalancedResourceAllocation", 1),
+    ("TaintTolerationPriority", 1),
+)
+
+KNOWN_PREDICATES = frozenset({
+    "GeneralPredicates", "PodFitsResources", "PodFitsHost", "PodFitsHostPorts",
+    "MatchNodeSelector", "PodToleratesNodeTaints", "CheckNodeMemoryPressure",
+    "CheckNodeDiskPressure", "CheckNodeCondition",
+})
+
+KNOWN_PRIORITIES = frozenset({
+    "LeastRequestedPriority", "MostRequestedPriority",
+    "BalancedResourceAllocation", "TaintTolerationPriority", "EqualPriority",
+})
+
+
+@dataclass(frozen=True)
+class Policy:
+    predicates: tuple[str, ...] = DEFAULT_PREDICATES
+    priorities: tuple[tuple[str, int], ...] = DEFAULT_PRIORITIES
+
+    def __post_init__(self):
+        unknown = set(self.predicates) - KNOWN_PREDICATES
+        if unknown:
+            raise ValueError(f"unknown predicates: {sorted(unknown)}")
+        unknown = {n for n, _ in self.priorities} - KNOWN_PRIORITIES
+        if unknown:
+            raise ValueError(f"unknown priorities: {sorted(unknown)}")
+        for n, w in self.priorities:
+            # the reference registry requires positive weights
+            # (factory/plugins.go validatePriorityOrDie)
+            if w <= 0:
+                raise ValueError(f"priority {n} must have a positive weight, got {w}")
+
+    # --- convenience views used by the solver ---
+    def has_predicate(self, *names: str) -> bool:
+        return any(n in self.predicates for n in names)
+
+    def weight(self, name: str) -> int:
+        for n, w in self.priorities:
+            if n == name:
+                return w
+        return 0
+
+    @classmethod
+    def from_json(cls, text: str) -> "Policy":
+        """Parse the reference's JSON policy schema
+        (plugin/pkg/scheduler/api/v1/types.go): {"predicates": [{"name": ..}],
+        "priorities": [{"name": .., "weight": ..}]}."""
+        d = json.loads(text)
+        preds = tuple(p["name"] for p in d.get("predicates") or []) or DEFAULT_PREDICATES
+        prios = tuple(
+            (p["name"], int(p.get("weight", 1))) for p in d.get("priorities") or []
+        ) or DEFAULT_PRIORITIES
+        return cls(predicates=preds, priorities=prios)
+
+    def to_json(self) -> str:
+        return json.dumps({
+            "kind": "Policy",
+            "apiVersion": "v1",
+            "predicates": [{"name": n} for n in self.predicates],
+            "priorities": [{"name": n, "weight": w} for n, w in self.priorities],
+        })
+
+
+DEFAULT_POLICY = Policy()

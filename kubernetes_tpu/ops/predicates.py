@@ -1,0 +1,205 @@
+"""Vectorized scheduling predicates: masked boolean ops over the node axis.
+
+Each kernel re-expresses one reference `FitPredicate(pod, meta, nodeInfo) ->
+bool` (signature at plugin/pkg/scheduler/algorithm/types.go:31) as a function
+of one encoded pod against *all* nodes at once, returning `bool[N]`. Batch
+evaluation over P pods is `jax.vmap` — the TPU-native replacement for the
+`workqueue.Parallelize(16, len(nodes), checkNode)` goroutine fan-out
+(reference plugin/pkg/scheduler/core/generic_scheduler.go:204).
+
+Covered predicates (reference algorithm/predicates/predicates.go):
+- PodFitsResources      (:556)  -> fits_resources
+- PodFitsHost           (:698)  -> fits_host
+- PodFitsHostPorts      (:859)  -> fits_host_ports
+- PodMatchNodeSelector  (:686)  -> match_node_selector  (plain nodeSelector;
+                                   required node-affinity terms arrive with
+                                   the affinity op set)
+- PodToleratesNodeTaints(:1241) -> tolerates_node_taints
+- CheckNodeMemoryPressure (:1274), CheckNodeDiskPressure (:1296),
+  CheckNodeCondition (:1306), unschedulable lister filter -> node_conditions_ok
+
+Volume-topology predicates (NoDiskConflict, MaxPDVolumeCount, VolumeZone)
+live in the volume op set once volume state is modeled.
+
+All kernels are pure, jit-safe, and shard over the node axis unmodified: they
+contain only elementwise ops and reductions over static slot axes.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from kubernetes_tpu.state.cluster_state import ClusterState
+from kubernetes_tpu.state.layout import Condition, Effect, Resource, TolOp
+from kubernetes_tpu.state.pod_batch import PodBatch
+
+
+def fits_resources(state: ClusterState, pod: PodBatch, requested=None) -> jnp.ndarray:
+    """PodFitsResources (predicates.go:556) against all nodes.
+
+    `requested` overrides state.requested — the solver passes the running
+    ledger that includes in-batch assumptions (the analog of scheduling
+    against assumed pods, scheduler.go:188).
+    """
+    req = state.requested if requested is None else requested
+    alloc = state.allocatable
+
+    pods_ok = req[:, Resource.PODS] + 1.0 <= alloc[:, Resource.PODS]
+
+    r = pod.requests
+    # all-zero shortcut: a pod requesting nothing only pays the pod-count
+    # check (predicates.go:576-578)
+    all_zero = (
+        (r[Resource.CPU] == 0) & (r[Resource.MEMORY] == 0) & (r[Resource.GPU] == 0)
+        & (r[Resource.SCRATCH] == 0) & (r[Resource.OVERLAY] == 0)
+    )
+
+    def fits(row):
+        return alloc[:, row] >= r[row] + req[:, row]
+
+    basic = fits(Resource.CPU) & fits(Resource.MEMORY) & fits(Resource.GPU)
+
+    # storage: when the node exposes no overlay allocatable, overlay requests
+    # fall through to scratch space (predicates.go:590-605)
+    no_overlay = alloc[:, Resource.OVERLAY] == 0
+    scratch_req_no_overlay = r[Resource.SCRATCH] + r[Resource.OVERLAY]
+    node_scratch_no_overlay = req[:, Resource.OVERLAY] + req[:, Resource.SCRATCH]
+    scratch_ok_no_overlay = (
+        alloc[:, Resource.SCRATCH] >= scratch_req_no_overlay + node_scratch_no_overlay
+    )
+    scratch_ok_overlay = (
+        alloc[:, Resource.SCRATCH] >= r[Resource.SCRATCH] + req[:, Resource.SCRATCH]
+    ) & (alloc[:, Resource.OVERLAY] >= r[Resource.OVERLAY] + req[:, Resource.OVERLAY])
+    storage = jnp.where(no_overlay, scratch_ok_no_overlay, scratch_ok_overlay)
+
+    return pods_ok & (all_zero | (basic & storage))
+
+
+def fits_host(state: ClusterState, pod: PodBatch) -> jnp.ndarray:
+    """PodFitsHost (predicates.go:698): spec.nodeName pins the node."""
+    unset = pod.node_name_lo == 0
+    match = (state.name_lo == pod.node_name_lo) & (state.name_hi == pod.node_name_hi)
+    return unset | match
+
+
+def fits_host_ports(state: ClusterState, pod: PodBatch, ports=None) -> jnp.ndarray:
+    """PodFitsHostPorts (predicates.go:859): no requested host port may be in
+    use. Port 0 / empty slots (-1) never conflict."""
+    node_ports = state.ports if ports is None else ports  # i32[N, Kn]
+    ok = jnp.ones(node_ports.shape[0], dtype=bool)
+    for kp in range(pod.ports.shape[0]):
+        want = pod.ports[kp]
+        conflict = ((node_ports == want) & (want > 0)).any(axis=-1)
+        ok &= ~conflict
+    return ok
+
+
+def match_node_selector(state: ClusterState, pod: PodBatch) -> jnp.ndarray:
+    """PodMatchNodeSelector (predicates.go:686) for map-form nodeSelector:
+    every key=value term must appear in the node's labels."""
+    ok = jnp.ones(state.label_kv_lo.shape[0], dtype=bool)
+    for s in range(pod.sel_kv_lo.shape[0]):
+        lo, hi = pod.sel_kv_lo[s], pod.sel_kv_hi[s]
+        term_empty = lo == 0
+        has = ((state.label_kv_lo == lo) & (state.label_kv_hi == hi)).any(axis=-1)
+        ok &= term_empty | has
+    return ok
+
+
+def _tolerated(state: ClusterState, pod: PodBatch, t: int) -> jnp.ndarray:
+    """bool[N]: taint slot t of every node is tolerated by some toleration
+    (v1 ToleratesTaint semantics, see api.objects.Toleration.tolerates):
+    empty toleration key matches every taint key; Equal compares values only;
+    Exists ignores values; empty toleration effect matches every effect."""
+    taint_key = state.taint_key[:, t]
+    taint_lo = state.taint_val_lo[:, t]
+    taint_hi = state.taint_val_hi[:, t]
+    taint_eff = state.taint_effect[:, t]
+    out = jnp.zeros(taint_key.shape[0], dtype=bool)
+    for j in range(pod.tol_op.shape[0]):
+        op = pod.tol_op[j]
+        used = op != TolOp.NONE
+        eff_ok = (pod.tol_effect[j] == Effect.NONE) | (pod.tol_effect[j] == taint_eff)
+        key_ok = (pod.tol_key[j] == 0) | (pod.tol_key[j] == taint_key)
+        value_ok = jnp.where(
+            op == TolOp.EXISTS,
+            True,
+            (pod.tol_val_lo[j] == taint_lo) & (pod.tol_val_hi[j] == taint_hi),
+        )
+        out |= used & eff_ok & key_ok & value_ok
+    return out
+
+
+def tolerates_node_taints(state: ClusterState, pod: PodBatch) -> jnp.ndarray:
+    """PodToleratesNodeTaints (predicates.go:1241): every NoSchedule/NoExecute
+    taint must be tolerated (PreferNoSchedule is scoring-only)."""
+    ok = jnp.ones(state.taint_key.shape[0], dtype=bool)
+    for t in range(state.taint_key.shape[1]):
+        eff = state.taint_effect[:, t]
+        hard = (eff == Effect.NO_SCHEDULE) | (eff == Effect.NO_EXECUTE)
+        ok &= ~hard | _tolerated(state, pod, t)
+    return ok
+
+
+def count_untolerated_prefer_taints(state: ClusterState, pod: PodBatch) -> jnp.ndarray:
+    """i32[N]: untolerated PreferNoSchedule taints per node — the map half of
+    the TaintToleration priority (priorities/taint_toleration.go:29)."""
+    count = jnp.zeros(state.taint_key.shape[0], dtype=jnp.int32)
+    for t in range(state.taint_key.shape[1]):
+        prefer = state.taint_effect[:, t] == Effect.PREFER_NO_SCHEDULE
+        count += (prefer & ~_tolerated(state, pod, t)).astype(jnp.int32)
+    return count
+
+
+def node_schedulable(state: ClusterState, pod: PodBatch) -> jnp.ndarray:
+    """spec.unschedulable exclusion — NOT a policy predicate: the reference
+    applies it unconditionally in the scheduler's node lister
+    (factory.go getNodeConditionPredicate), so the solver always ANDs this in."""
+    return (state.conditions & jnp.uint32(Condition.UNSCHEDULABLE)) == 0
+
+
+def check_node_condition(state: ClusterState, pod: PodBatch) -> jnp.ndarray:
+    """CheckNodeCondition (predicates.go:1306): NotReady, NetworkUnavailable
+    and OutOfDisk reject all pods."""
+    hard = Condition.NOT_READY | Condition.NETWORK_UNAVAILABLE | Condition.OUT_OF_DISK
+    return (state.conditions & jnp.uint32(hard)) == 0
+
+
+def check_memory_pressure(state: ClusterState, pod: PodBatch) -> jnp.ndarray:
+    """CheckNodeMemoryPressure (predicates.go:1274): rejects only BestEffort
+    pods."""
+    pressure = (state.conditions & jnp.uint32(Condition.MEMORY_PRESSURE)) != 0
+    return ~(pressure & pod.best_effort)
+
+
+def check_disk_pressure(state: ClusterState, pod: PodBatch) -> jnp.ndarray:
+    """CheckNodeDiskPressure (predicates.go:1296): rejects all pods."""
+    return (state.conditions & jnp.uint32(Condition.DISK_PRESSURE)) == 0
+
+
+def node_conditions_ok(state: ClusterState, pod: PodBatch) -> jnp.ndarray:
+    """All condition checks plus the unschedulable filter (convenience
+    conjunction for full-default evaluation)."""
+    return (
+        node_schedulable(state, pod)
+        & check_node_condition(state, pod)
+        & check_memory_pressure(state, pod)
+        & check_disk_pressure(state, pod)
+    )
+
+
+def static_feasibility(state: ClusterState, pod: PodBatch) -> jnp.ndarray:
+    """All assignment-independent predicates for one pod: bool[N].
+
+    Resource and port checks against the *running* ledger happen in the
+    solver; this mask covers everything that in-batch assignments cannot
+    change. Invalid (padding) node rows are always infeasible.
+    """
+    return (
+        state.valid
+        & pod.valid
+        & fits_host(state, pod)
+        & match_node_selector(state, pod)
+        & tolerates_node_taints(state, pod)
+        & node_conditions_ok(state, pod)
+    )

@@ -47,8 +47,8 @@ class ClusterState:
     label_kv_lo: np.ndarray    # u32[N, L] lane of hash(key=value)
     label_kv_hi: np.ndarray    # u32[N, L]
     taint_key: np.ndarray      # u32[N, T], 0 = empty
-    taint_kv_lo: np.ndarray    # u32[N, T]
-    taint_kv_hi: np.ndarray    # u32[N, T]
+    taint_val_lo: np.ndarray   # u32[N, T] hash lanes of the taint *value*
+    taint_val_hi: np.ndarray   # u32[N, T]
     taint_effect: np.ndarray   # i32[N, T], Effect codes
     conditions: np.ndarray     # u32[N] Condition bitmask (0 == healthy)
     name_lo: np.ndarray        # u32[N] node-name hash lanes
@@ -73,8 +73,8 @@ def empty_state(caps: Capacities) -> ClusterState:
         label_kv_lo=np.zeros((n, caps.label_slots), np.uint32),
         label_kv_hi=np.zeros((n, caps.label_slots), np.uint32),
         taint_key=np.zeros((n, caps.taint_slots), np.uint32),
-        taint_kv_lo=np.zeros((n, caps.taint_slots), np.uint32),
-        taint_kv_hi=np.zeros((n, caps.taint_slots), np.uint32),
+        taint_val_lo=np.zeros((n, caps.taint_slots), np.uint32),
+        taint_val_hi=np.zeros((n, caps.taint_slots), np.uint32),
         taint_effect=np.zeros((n, caps.taint_slots), np.int32),
         conditions=np.zeros((n,), np.uint32),
         name_lo=np.zeros((n,), np.uint32),
@@ -197,14 +197,14 @@ def _fill_node_row(state: ClusterState, table: NodeTable, row: int, node: Node) 
         raise CapacityError(
             f"node {node.metadata.name!r}: {len(taints)} taints > {caps.taint_slots} slots")
     state.taint_key[row] = 0
-    state.taint_kv_lo[row] = 0
-    state.taint_kv_hi[row] = 0
+    state.taint_val_lo[row] = 0
+    state.taint_val_hi[row] = 0
     state.taint_effect[row] = Effect.NONE
     for i, t in enumerate(taints):
         state.taint_key[row, i] = hash32(t.key)
-        kv_lo, kv_hi = hash_kv(t.key, t.value)
-        state.taint_kv_lo[row, i] = kv_lo
-        state.taint_kv_hi[row, i] = kv_hi
+        val_lo, val_hi = hash_lanes(t.value)
+        state.taint_val_lo[row, i] = val_lo
+        state.taint_val_hi[row, i] = val_hi
         state.taint_effect[row, i] = Effect.NAMES.get(t.effect, Effect.NONE)
 
     state.topology[row] = -1

@@ -27,9 +27,9 @@ class PodBatch:
     ports: np.ndarray           # i32[P, Kp], -1 = empty
     sel_kv_lo: np.ndarray       # u32[P, S] nodeSelector key=value hash lanes, 0 = empty
     sel_kv_hi: np.ndarray       # u32[P, S]
-    tol_key: np.ndarray         # u32[P, T] hash32(key), 0 = empty key (Exists -> all)
-    tol_kv_lo: np.ndarray       # u32[P, T]
-    tol_kv_hi: np.ndarray       # u32[P, T]
+    tol_key: np.ndarray         # u32[P, T] hash32(key), 0 = empty key (matches all)
+    tol_val_lo: np.ndarray      # u32[P, T] hash lanes of the toleration *value*
+    tol_val_hi: np.ndarray      # u32[P, T]
     tol_op: np.ndarray          # i32[P, T] TolOp codes, NONE = unused slot
     tol_effect: np.ndarray      # i32[P, T] Effect codes, NONE = all effects
     node_name_lo: np.ndarray    # u32[P] spec.nodeName hash lanes, 0 = unset
@@ -51,8 +51,8 @@ def empty_batch(caps: Capacities) -> PodBatch:
         sel_kv_lo=np.zeros((p, caps.selector_slots), np.uint32),
         sel_kv_hi=np.zeros((p, caps.selector_slots), np.uint32),
         tol_key=np.zeros((p, caps.toleration_slots), np.uint32),
-        tol_kv_lo=np.zeros((p, caps.toleration_slots), np.uint32),
-        tol_kv_hi=np.zeros((p, caps.toleration_slots), np.uint32),
+        tol_val_lo=np.zeros((p, caps.toleration_slots), np.uint32),
+        tol_val_hi=np.zeros((p, caps.toleration_slots), np.uint32),
         tol_op=np.zeros((p, caps.toleration_slots), np.int32),
         tol_effect=np.zeros((p, caps.toleration_slots), np.int32),
         node_name_lo=np.zeros((p,), np.uint32),
@@ -89,15 +89,15 @@ def encode_pod_into(batch: PodBatch, i: int, pod: Pod, caps: Capacities) -> None
         raise CapacityError(f"pod {pod.key}: {len(tols)} tolerations > "
                             f"{caps.toleration_slots} slots")
     batch.tol_key[i] = 0
-    batch.tol_kv_lo[i] = 0
-    batch.tol_kv_hi[i] = 0
+    batch.tol_val_lo[i] = 0
+    batch.tol_val_hi[i] = 0
     batch.tol_op[i] = TolOp.NONE
     batch.tol_effect[i] = Effect.NONE
     for t, tol in enumerate(tols):
         batch.tol_key[i, t] = hash32(tol.key) if tol.key else 0
-        kv_lo, kv_hi = hash_kv(tol.key, tol.value)
-        batch.tol_kv_lo[i, t] = kv_lo
-        batch.tol_kv_hi[i, t] = kv_hi
+        val_lo, val_hi = hash_lanes(tol.value)
+        batch.tol_val_lo[i, t] = val_lo
+        batch.tol_val_hi[i, t] = val_hi
         batch.tol_op[i, t] = TolOp.EXISTS if tol.operator == "Exists" else TolOp.EQUAL
         batch.tol_effect[i, t] = Effect.NAMES.get(tol.effect, Effect.NONE)
 

@@ -1,0 +1,244 @@
+"""Pure-Python serial scheduler: the behavioral spec for parity tests.
+
+An independent, direct implementation of the reference's one-pod-at-a-time
+semantics (scheduleOne: predicates -> int-math priorities -> round-robin
+selectHost -> assume), written over the api objects with exact integer
+arithmetic. The batched device solver must make identical decisions.
+
+One deliberate determinization: the reference's selectHost sorts the priority
+list with an *unstable* sort before round-robin among ties
+(generic_scheduler.go:149), so its tie order is unspecified; both this spec
+and the device solver fix tie order to node-list order.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from fractions import Fraction
+
+from kubernetes_tpu.api.objects import Node, Pod
+from kubernetes_tpu.api.quantity import parse_quantity
+
+DEFAULT_NONZERO_CPU = 100            # milli
+DEFAULT_NONZERO_MEM = 200 * 1024 * 1024  # bytes (non_zero.go:30)
+MAX_PRIORITY = 10
+
+
+def _milli(qty: str | None) -> int:
+    return int(parse_quantity(qty) * 1000) if qty else 0
+
+
+def _bytes(qty: str | None) -> int:
+    return int(parse_quantity(qty)) if qty else 0
+
+
+@dataclass
+class NodeState:
+    node: Node
+    alloc_cpu: int = 0
+    alloc_mem: int = 0
+    alloc_gpu: int = 0
+    alloc_pods: int = 0
+    alloc_scratch: int = 0
+    alloc_overlay: int = 0
+    req_cpu: int = 0
+    req_mem: int = 0
+    req_gpu: int = 0
+    req_scratch: int = 0
+    req_overlay: int = 0
+    num_pods: int = 0
+    nz_cpu: int = 0
+    nz_mem: int = 0
+    ports: set = field(default_factory=set)
+
+    @classmethod
+    def from_node(cls, node: Node) -> "NodeState":
+        alloc = node.status.effective_allocatable()
+        return cls(
+            node=node,
+            alloc_cpu=_milli(alloc.get("cpu")),
+            alloc_mem=_bytes(alloc.get("memory")),
+            alloc_gpu=_bytes(alloc.get("alpha.kubernetes.io/nvidia-gpu")),
+            alloc_pods=_bytes(alloc.get("pods")),
+            alloc_scratch=_bytes(alloc.get("storage.kubernetes.io/scratch")),
+            alloc_overlay=_bytes(alloc.get("storage.kubernetes.io/overlay")),
+        )
+
+    def add_pod(self, pod: Pod) -> None:
+        cpu, mem, gpu, scratch, overlay = pod_request(pod)
+        nz_cpu, nz_mem = pod_nonzero(pod)
+        self.req_cpu += cpu
+        self.req_mem += mem
+        self.req_gpu += gpu
+        self.req_scratch += scratch
+        self.req_overlay += overlay
+        self.nz_cpu += nz_cpu
+        self.nz_mem += nz_mem
+        self.num_pods += 1
+        self.ports |= pod_ports(pod)
+
+
+def pod_request(pod: Pod) -> tuple[int, int, int, int, int]:
+    cpu = mem = gpu = scratch = overlay = 0
+    for c in pod.spec.containers:
+        cpu += _milli(c.requests.get("cpu"))
+        mem += _bytes(c.requests.get("memory"))
+        gpu += _bytes(c.requests.get("alpha.kubernetes.io/nvidia-gpu"))
+        scratch += _bytes(c.requests.get("storage.kubernetes.io/scratch"))
+        overlay += _bytes(c.requests.get("storage.kubernetes.io/overlay"))
+    return cpu, mem, gpu, scratch, overlay
+
+
+def pod_nonzero(pod: Pod) -> tuple[int, int]:
+    cpu = mem = 0
+    for c in pod.spec.containers:
+        ccpu = _milli(c.requests.get("cpu"))
+        cmem = _bytes(c.requests.get("memory"))
+        cpu += ccpu if ccpu else DEFAULT_NONZERO_CPU
+        mem += cmem if cmem else DEFAULT_NONZERO_MEM
+    return cpu, mem
+
+
+def pod_ports(pod: Pod) -> set[int]:
+    return {p.host_port for c in pod.spec.containers for p in c.ports if p.host_port}
+
+
+# ---- predicates (Go semantics, predicates.go) ----
+
+def fits_resources(ns: NodeState, pod: Pod) -> bool:
+    if ns.num_pods + 1 > ns.alloc_pods:
+        return False
+    cpu, mem, gpu, scratch, overlay = pod_request(pod)
+    if cpu == 0 and mem == 0 and gpu == 0 and scratch == 0 and overlay == 0:
+        return True
+    if not (ns.alloc_cpu >= cpu + ns.req_cpu
+            and ns.alloc_mem >= mem + ns.req_mem
+            and ns.alloc_gpu >= gpu + ns.req_gpu):
+        return False
+    # scratch/overlay fallthrough (predicates.go:590-605)
+    if ns.alloc_overlay == 0:
+        if ns.alloc_scratch < (scratch + overlay) + (ns.req_overlay + ns.req_scratch):
+            return False
+    else:
+        if ns.alloc_scratch < scratch + ns.req_scratch:
+            return False
+        if ns.alloc_overlay < overlay + ns.req_overlay:
+            return False
+    return True
+
+
+def fits_host(ns: NodeState, pod: Pod) -> bool:
+    return not pod.spec.node_name or pod.spec.node_name == ns.node.metadata.name
+
+
+def fits_ports(ns: NodeState, pod: Pod) -> bool:
+    return not (pod_ports(pod) & ns.ports)
+
+
+def match_selector(ns: NodeState, pod: Pod) -> bool:
+    labels = ns.node.metadata.labels
+    return all(labels.get(k) == v for k, v in pod.spec.node_selector.items())
+
+
+def tolerates_taints(ns: NodeState, pod: Pod) -> bool:
+    for taint in ns.node.spec.taints:
+        if taint.effect not in ("NoSchedule", "NoExecute"):
+            continue
+        if not any(t.tolerates(taint) for t in pod.spec.tolerations):
+            return False
+    return True
+
+
+def conditions_ok(ns: NodeState, pod: Pod) -> bool:
+    node = ns.node
+    if node.spec.unschedulable:
+        return False
+    ready = False
+    for c in node.status.conditions:
+        if c.type == "Ready":
+            ready = c.status == "True"
+        elif c.status == "True" and c.type in ("OutOfDisk", "NetworkUnavailable",
+                                               "DiskPressure"):
+            return False
+        elif c.type == "MemoryPressure" and c.status == "True" and pod.is_best_effort():
+            return False
+    return ready or not node.status.conditions
+
+
+def feasible(ns: NodeState, pod: Pod) -> bool:
+    return (fits_resources(ns, pod) and fits_host(ns, pod) and fits_ports(ns, pod)
+            and match_selector(ns, pod) and tolerates_taints(ns, pod)
+            and conditions_ok(ns, pod))
+
+
+# ---- priorities (int64 math) ----
+
+def least_requested(ns: NodeState, pod: Pod) -> int:
+    nz_cpu, nz_mem = pod_nonzero(pod)
+
+    def unused(req, cap):
+        if cap == 0 or req > cap:
+            return 0
+        return ((cap - req) * MAX_PRIORITY) // cap
+
+    return (unused(ns.nz_cpu + nz_cpu, ns.alloc_cpu)
+            + unused(ns.nz_mem + nz_mem, ns.alloc_mem)) // 2
+
+
+def balanced_allocation(ns: NodeState, pod: Pod) -> int:
+    nz_cpu, nz_mem = pod_nonzero(pod)
+    if ns.alloc_cpu == 0 or ns.alloc_mem == 0:
+        return 0
+    cpu_frac = Fraction(ns.nz_cpu + nz_cpu, ns.alloc_cpu)
+    mem_frac = Fraction(ns.nz_mem + nz_mem, ns.alloc_mem)
+    if cpu_frac >= 1 or mem_frac >= 1:
+        return 0
+    return int((1 - abs(cpu_frac - mem_frac)) * MAX_PRIORITY)
+
+
+def untolerated_prefer_count(ns: NodeState, pod: Pod) -> int:
+    # Only tolerations applicable to PreferNoSchedule count
+    # (taint_toleration.go getAllTolerationPreferNoSchedule).
+    tols = [t for t in pod.spec.tolerations
+            if not t.effect or t.effect == "PreferNoSchedule"]
+    n = 0
+    for taint in ns.node.spec.taints:
+        if taint.effect != "PreferNoSchedule":
+            continue
+        if not any(t.tolerates(taint) for t in tols):
+            n += 1
+    return n
+
+
+class SerialScheduler:
+    """scheduleOne loop over Python objects."""
+
+    def __init__(self, nodes: list[Node], assigned_pods: list[Pod] = ()):
+        self.states = [NodeState.from_node(n) for n in nodes]
+        self.by_name = {ns.node.metadata.name: ns for ns in self.states}
+        for pod in assigned_pods:
+            ns = self.by_name.get(pod.spec.node_name)
+            if ns:
+                ns.add_pod(pod)
+        self.rr = 0
+
+    def schedule_one(self, pod: Pod) -> str | None:
+        fits = [ns for ns in self.states if feasible(ns, pod)]
+        if not fits:
+            return None
+        counts = [untolerated_prefer_count(ns, pod) for ns in fits]
+        max_count = max(counts)
+        scores = []
+        for ns, cnt in zip(fits, counts):
+            tt = MAX_PRIORITY if max_count == 0 else int(
+                (1 - Fraction(cnt, max_count)) * MAX_PRIORITY)
+            scores.append(least_requested(ns, pod) + balanced_allocation(ns, pod) + tt)
+        best = max(scores)
+        ties = [ns for ns, s in zip(fits, scores) if s == best]
+        pick = ties[self.rr % len(ties)]
+        self.rr += 1
+        pick.add_pod(pod)
+        return pick.node.metadata.name
+
+    def schedule(self, pods: list[Pod]) -> list[str | None]:
+        return [self.schedule_one(p) for p in pods]

@@ -1,0 +1,253 @@
+"""Table-driven predicate parity tests, modeled on the reference's
+predicates_test.go fixtures."""
+
+import jax
+import numpy as np
+import pytest
+
+from kubernetes_tpu.api.objects import Node, Pod
+from kubernetes_tpu.ops import predicates as preds
+from kubernetes_tpu.state import Capacities, encode_nodes, encode_pods
+
+CAPS = Capacities(num_nodes=8, batch_pods=4)
+
+
+def row(batch, i=0):
+    return jax.tree.map(lambda a: a[i], batch)
+
+
+def mk_node(name="n0", cpu="4", mem="8Gi", pods="110", **kw):
+    d = {
+        "metadata": {"name": name, "labels": kw.get("labels", {})},
+        "spec": {"taints": kw.get("taints", []),
+                 "unschedulable": kw.get("unschedulable", False)},
+        "status": {
+            "allocatable": {"cpu": cpu, "memory": mem, "pods": pods,
+                            **kw.get("alloc_extra", {})},
+            "conditions": kw.get("conditions",
+                                 [{"type": "Ready", "status": "True"}]),
+        },
+    }
+    return Node.from_dict(d)
+
+
+def mk_pod(name="p", requests=None, **spec):
+    c = {"name": "c"}
+    if requests:
+        c["resources"] = {"requests": requests}
+    return Pod.from_dict({"metadata": {"name": name},
+                          "spec": {"containers": [c], **spec}})
+
+
+def run(pred, nodes, pod, assigned=()):
+    state, table = encode_nodes(nodes, CAPS, assigned_pods=assigned)
+    batch = encode_pods([pod], CAPS)
+    out = np.asarray(pred(state, row(batch)))
+    return {n.metadata.name: bool(out[table.row_of[n.metadata.name]]) for n in nodes}
+
+
+class TestFitsResources:
+    def test_enough(self):
+        got = run(preds.fits_resources, [mk_node(cpu="1", mem="1Gi")],
+                  mk_pod(requests={"cpu": "500m", "memory": "512Mi"}))
+        assert got["n0"]
+
+    def test_insufficient_cpu(self):
+        got = run(preds.fits_resources, [mk_node(cpu="1")],
+                  mk_pod(requests={"cpu": "1500m"}))
+        assert not got["n0"]
+
+    def test_counts_existing_pods(self):
+        assigned = mk_pod("prev", requests={"cpu": "600m"})
+        assigned.spec.node_name = "n0"
+        got = run(preds.fits_resources, [mk_node(cpu="1")],
+                  mk_pod(requests={"cpu": "500m"}), assigned=[assigned])
+        assert not got["n0"]
+
+    def test_pod_count_limit(self):
+        assigned = mk_pod("prev")
+        assigned.spec.node_name = "n0"
+        got = run(preds.fits_resources, [mk_node(pods="1")], mk_pod(),
+                  assigned=[assigned])
+        assert not got["n0"]
+
+    def test_zero_request_skips_resource_checks(self):
+        # predicates.go:576: an all-zero pod passes even on a saturated node
+        assigned = mk_pod("prev", requests={"cpu": "4", "memory": "8Gi"})
+        assigned.spec.node_name = "n0"
+        got = run(preds.fits_resources, [mk_node(cpu="4", mem="8Gi")],
+                  mk_pod(), assigned=[assigned])
+        assert got["n0"]
+
+    def test_scratch_overlay_fallthrough(self):
+        # node exposes no overlay allocatable: overlay requests count against
+        # scratch (predicates.go:590-605)
+        node = mk_node(alloc_extra={"storage.kubernetes.io/scratch": "10Gi"})
+        fits = run(preds.fits_resources, [node],
+                   mk_pod(requests={"storage.kubernetes.io/overlay": "8Gi"}))
+        toobig = run(preds.fits_resources, [node],
+                     mk_pod(requests={"storage.kubernetes.io/overlay": "12Gi"}))
+        assert fits["n0"] and not toobig["n0"]
+
+    def test_overlay_tracked_separately_when_allocatable(self):
+        node = mk_node(alloc_extra={"storage.kubernetes.io/scratch": "10Gi",
+                                    "storage.kubernetes.io/overlay": "1Gi"})
+        got = run(preds.fits_resources, [node],
+                  mk_pod(requests={"storage.kubernetes.io/overlay": "8Gi"}))
+        assert not got["n0"]
+
+    def test_gpu(self):
+        got = run(preds.fits_resources,
+                  [mk_node(alloc_extra={"alpha.kubernetes.io/nvidia-gpu": "1"}),
+                   mk_node(name="n1")],
+                  mk_pod(requests={"alpha.kubernetes.io/nvidia-gpu": "1"}))
+        assert got["n0"] and not got["n1"]
+
+
+class TestFitsHost:
+    def test_unpinned_matches_all(self):
+        got = run(preds.fits_host, [mk_node("a"), mk_node("b")], mk_pod())
+        assert got == {"a": True, "b": True}
+
+    def test_pinned(self):
+        got = run(preds.fits_host, [mk_node("a"), mk_node("b")],
+                  mk_pod(nodeName="b"))
+        assert got == {"a": False, "b": True}
+
+
+class TestHostPorts:
+    def test_conflict(self):
+        prev = Pod.from_dict({"metadata": {"name": "prev"}, "spec": {"containers": [
+            {"name": "c", "ports": [{"containerPort": 80, "hostPort": 8080}]}]}})
+        prev.spec.node_name = "n0"
+        pod = Pod.from_dict({"metadata": {"name": "p"}, "spec": {"containers": [
+            {"name": "c", "ports": [{"containerPort": 80, "hostPort": 8080}]}]}})
+        got = run(preds.fits_host_ports, [mk_node(), mk_node("n1")], pod,
+                  assigned=[prev])
+        assert not got["n0"] and got["n1"]
+
+    def test_no_host_port_never_conflicts(self):
+        pod = Pod.from_dict({"metadata": {"name": "p"}, "spec": {"containers": [
+            {"name": "c", "ports": [{"containerPort": 80}]}]}})
+        got = run(preds.fits_host_ports, [mk_node()], pod)
+        assert got["n0"]
+
+
+class TestNodeSelector:
+    def test_match(self):
+        got = run(preds.match_node_selector,
+                  [mk_node(labels={"disk": "ssd", "arch": "amd64"}),
+                   mk_node("n1", labels={"disk": "hdd", "arch": "amd64"}),
+                   mk_node("n2")],
+                  mk_pod(nodeSelector={"disk": "ssd", "arch": "amd64"}))
+        assert got == {"n0": True, "n1": False, "n2": False}
+
+    def test_empty_selector_matches_all(self):
+        got = run(preds.match_node_selector, [mk_node(), mk_node("n1")], mk_pod())
+        assert got == {"n0": True, "n1": True}
+
+
+class TestTaints:
+    def test_noschedule_rejects(self):
+        got = run(preds.tolerates_node_taints,
+                  [mk_node(taints=[{"key": "k", "value": "v",
+                                    "effect": "NoSchedule"}]),
+                   mk_node("n1")],
+                  mk_pod())
+        assert got == {"n0": False, "n1": True}
+
+    def test_equal_toleration(self):
+        taints = [{"key": "k", "value": "v", "effect": "NoSchedule"}]
+        ok = run(preds.tolerates_node_taints, [mk_node(taints=taints)],
+                 mk_pod(tolerations=[{"key": "k", "operator": "Equal",
+                                      "value": "v", "effect": "NoSchedule"}]))
+        bad = run(preds.tolerates_node_taints, [mk_node(taints=taints)],
+                  mk_pod(tolerations=[{"key": "k", "operator": "Equal",
+                                       "value": "other", "effect": "NoSchedule"}]))
+        assert ok["n0"] and not bad["n0"]
+
+    def test_exists_ignores_value(self):
+        got = run(preds.tolerates_node_taints,
+                  [mk_node(taints=[{"key": "k", "value": "anything",
+                                    "effect": "NoSchedule"}])],
+                  mk_pod(tolerations=[{"key": "k", "operator": "Exists",
+                                       "effect": "NoSchedule"}]))
+        assert got["n0"]
+
+    def test_empty_key_exists_tolerates_everything(self):
+        got = run(preds.tolerates_node_taints,
+                  [mk_node(taints=[{"key": "k", "value": "v",
+                                    "effect": "NoExecute"}])],
+                  mk_pod(tolerations=[{"operator": "Exists"}]))
+        assert got["n0"]
+
+    def test_empty_effect_tolerates_all_effects(self):
+        got = run(preds.tolerates_node_taints,
+                  [mk_node(taints=[{"key": "k", "value": "v",
+                                    "effect": "NoSchedule"}])],
+                  mk_pod(tolerations=[{"key": "k", "operator": "Equal",
+                                       "value": "v"}]))
+        assert got["n0"]
+
+    def test_empty_key_equal_matches_value_only(self):
+        # empty key matches every taint key; Equal compares values only
+        got = run(preds.tolerates_node_taints,
+                  [mk_node(taints=[{"key": "k", "value": "v",
+                                    "effect": "NoSchedule"}])],
+                  mk_pod(tolerations=[{"operator": "Equal", "value": "v",
+                                       "effect": "NoSchedule"}]))
+        assert got["n0"]
+
+    def test_prefer_noschedule_does_not_reject(self):
+        got = run(preds.tolerates_node_taints,
+                  [mk_node(taints=[{"key": "k", "value": "v",
+                                    "effect": "PreferNoSchedule"}])],
+                  mk_pod())
+        assert got["n0"]
+
+    def test_effect_mismatch_does_not_tolerate(self):
+        got = run(preds.tolerates_node_taints,
+                  [mk_node(taints=[{"key": "k", "value": "v",
+                                    "effect": "NoExecute"}])],
+                  mk_pod(tolerations=[{"key": "k", "operator": "Equal",
+                                       "value": "v", "effect": "NoSchedule"}]))
+        assert not got["n0"]
+
+
+class TestConditions:
+    def test_not_ready(self):
+        got = run(preds.node_conditions_ok,
+                  [mk_node(conditions=[{"type": "Ready", "status": "False"}]),
+                   mk_node("n1")],
+                  mk_pod())
+        assert got == {"n0": False, "n1": True}
+
+    def test_memory_pressure_only_rejects_best_effort(self):
+        conds = [{"type": "Ready", "status": "True"},
+                 {"type": "MemoryPressure", "status": "True"}]
+        burstable = mk_pod(requests={"cpu": "100m"})
+        besteffort = mk_pod()
+        got_b = run(preds.node_conditions_ok, [mk_node(conditions=conds)], burstable)
+        got_be = run(preds.node_conditions_ok, [mk_node(conditions=conds)], besteffort)
+        assert got_b["n0"] and not got_be["n0"]
+
+    def test_disk_pressure_rejects_all(self):
+        conds = [{"type": "Ready", "status": "True"},
+                 {"type": "DiskPressure", "status": "True"}]
+        got = run(preds.node_conditions_ok, [mk_node(conditions=conds)],
+                  mk_pod(requests={"cpu": "100m"}))
+        assert not got["n0"]
+
+    def test_unschedulable(self):
+        got = run(preds.node_conditions_ok, [mk_node(unschedulable=True)], mk_pod())
+        assert not got["n0"]
+
+
+def test_vmap_over_batch():
+    state, table = encode_nodes([mk_node(), mk_node("n1", unschedulable=True)], CAPS)
+    batch = encode_pods([mk_pod("a"), mk_pod("b", nodeName="n1")], CAPS)
+    mask = np.asarray(jax.vmap(lambda p: preds.static_feasibility(state, p))(batch))
+    assert mask[0, table.row_of["n0"]]
+    assert not mask[0, table.row_of["n1"]]          # unschedulable
+    assert not mask[1, table.row_of["n0"]]          # pinned elsewhere
+    assert not mask[2:].any()                       # padding rows infeasible

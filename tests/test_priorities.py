@@ -1,0 +1,120 @@
+"""Priority scoring parity tests (reference
+plugin/pkg/scheduler/algorithm/priorities/*_test.go style)."""
+
+import jax
+import numpy as np
+
+from kubernetes_tpu.api.objects import Node, Pod
+from kubernetes_tpu.ops import priorities as prios
+from kubernetes_tpu.state import Capacities, encode_nodes, encode_pods
+
+CAPS = Capacities(num_nodes=8, batch_pods=4)
+
+
+def row(batch, i=0):
+    return jax.tree.map(lambda a: a[i], batch)
+
+
+def mk_node(name="n0", cpu="4", mem="8Gi", taints=None):
+    return Node.from_dict({
+        "metadata": {"name": name},
+        "spec": {"taints": taints or []},
+        "status": {"allocatable": {"cpu": cpu, "memory": mem, "pods": "110"},
+                   "conditions": [{"type": "Ready", "status": "True"}]},
+    })
+
+
+def mk_pod(name="p", cpu=None, mem=None, tolerations=None):
+    req = {}
+    if cpu:
+        req["cpu"] = cpu
+    if mem:
+        req["memory"] = mem
+    c = {"name": "c"}
+    if req:
+        c["resources"] = {"requests": req}
+    return Pod.from_dict({"metadata": {"name": name},
+                          "spec": {"containers": [c],
+                                   "tolerations": tolerations or []}})
+
+
+def scores(fn, nodes, pod, assigned=()):
+    state, table = encode_nodes(nodes, CAPS, assigned_pods=assigned)
+    out = np.asarray(fn(state, row(encode_pods([pod], CAPS))))
+    return {n.metadata.name: float(out[table.row_of[n.metadata.name]])
+            for n in nodes}
+
+
+def test_least_requested_empty_node():
+    # pod 1000m/2Gi on empty 4-core/8Gi node:
+    # cpu: ((4000-1000)*10)/4000 = 7; mem: ((8192-2048)*10)/8192 = 7 -> (7+7)/2 = 7
+    got = scores(prios.least_requested, [mk_node()], mk_pod(cpu="1", mem="2Gi"))
+    assert got["n0"] == 7
+
+
+def test_least_requested_prefers_emptier():
+    prev = mk_pod("prev", cpu="2", mem="4Gi")
+    prev.spec.node_name = "busy"
+    got = scores(prios.least_requested, [mk_node("busy"), mk_node("idle")],
+                 mk_pod(cpu="1", mem="2Gi"), assigned=[prev])
+    assert got["idle"] > got["busy"]
+
+
+def test_least_requested_overcommitted_zero():
+    got = scores(prios.least_requested, [mk_node(cpu="1", mem="1Gi")],
+                 mk_pod(cpu="2", mem="2Gi"))
+    assert got["n0"] == 0
+
+
+def test_least_requested_integer_truncation():
+    # cpu: ((3000-1000)*10)/3000 = 6 (6.66 truncated); mem ((7680-512)*10)/7680
+    # = 9 (9.33 truncated) -> (6+9)/2 = 7 (7.5 truncated)
+    got = scores(prios.least_requested, [mk_node(cpu="3", mem="7680Mi")],
+                 mk_pod(cpu="1", mem="512Mi"))
+    assert got["n0"] == 7
+
+
+def test_balanced_allocation_perfect_balance():
+    # 1 core / 2Gi on 4 core / 8Gi: both fractions 0.25 -> 10
+    got = scores(prios.balanced_allocation, [mk_node()], mk_pod(cpu="1", mem="2Gi"))
+    assert got["n0"] == 10
+
+
+def test_balanced_allocation_imbalance():
+    # cpu 0.5, mem 0.25 -> int((1-0.25)*10) = 7
+    got = scores(prios.balanced_allocation, [mk_node()], mk_pod(cpu="2", mem="2Gi"))
+    assert got["n0"] == 7
+
+
+def test_balanced_allocation_overcommit_zero():
+    got = scores(prios.balanced_allocation, [mk_node(cpu="1")],
+                 mk_pod(cpu="2", mem="1Mi"))
+    assert got["n0"] == 0
+
+
+def test_taint_toleration_normalization():
+    # n0: 2 untolerated prefer taints, n1: 1, n2: 0 -> scores 0, 5, 10
+    t = lambda k: {"key": k, "value": "v", "effect": "PreferNoSchedule"}
+    got = scores(prios.taint_toleration,
+                 [mk_node("n0", taints=[t("a"), t("b")]),
+                  mk_node("n1", taints=[t("a")]),
+                  mk_node("n2")],
+                 mk_pod())
+    assert got == {"n0": 0, "n1": 5, "n2": 10}
+
+
+def test_taint_toleration_all_tolerated():
+    t = {"key": "a", "value": "v", "effect": "PreferNoSchedule"}
+    got = scores(prios.taint_toleration,
+                 [mk_node("n0", taints=[t]), mk_node("n1")],
+                 mk_pod(tolerations=[{"key": "a", "operator": "Exists",
+                                      "effect": "PreferNoSchedule"}]))
+    assert got == {"n0": 10, "n1": 10}
+
+
+def test_taint_toleration_empty_effect_toleration_applies():
+    # Empty-effect tolerations cover PreferNoSchedule (taint_toleration.go:44)
+    t = {"key": "a", "value": "v", "effect": "PreferNoSchedule"}
+    got = scores(prios.taint_toleration, [mk_node("n0", taints=[t])],
+                 mk_pod(tolerations=[{"key": "a", "operator": "Equal", "value": "v"}]))
+    assert got["n0"] == 10

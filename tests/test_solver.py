@@ -1,0 +1,186 @@
+"""Batched solver tests: serial-equivalence against the pure-Python spec
+(tests/serial_reference.py) plus targeted invariants (no double booking,
+round-robin ties, in-batch port conflicts)."""
+
+import jax
+import numpy as np
+import pytest
+
+from kubernetes_tpu.api.objects import Node, Pod
+from kubernetes_tpu.models.policy import DEFAULT_POLICY
+from kubernetes_tpu.ops.solver import schedule_batch
+from kubernetes_tpu.state import Capacities, Resource, encode_nodes, encode_pods
+from tests.serial_reference import SerialScheduler
+
+jit_schedule = jax.jit(schedule_batch, static_argnames=("policy",))
+
+
+def mk_node(name, cpu="4", mem="8Gi", pods="110", labels=None, taints=None):
+    return Node.from_dict({
+        "metadata": {"name": name, "labels": labels or {}},
+        "spec": {"taints": taints or []},
+        "status": {"allocatable": {"cpu": cpu, "memory": mem, "pods": pods},
+                   "conditions": [{"type": "Ready", "status": "True"}]},
+    })
+
+
+def mk_pod(name, cpu=None, mem=None, **spec):
+    req = {}
+    if cpu:
+        req["cpu"] = cpu
+    if mem:
+        req["memory"] = mem
+    c = {"name": "c"}
+    if req:
+        c["resources"] = {"requests": req}
+    return Pod.from_dict({"metadata": {"name": name},
+                          "spec": {"containers": [c], **spec}})
+
+
+def solve(nodes, pods, caps=None, assigned=()):
+    caps = caps or Capacities(num_nodes=16, batch_pods=16)
+    state, table = encode_nodes(nodes, caps, assigned_pods=assigned)
+    batch = encode_pods(pods, caps)
+    result = jit_schedule(state, batch, 0, DEFAULT_POLICY)
+    names = []
+    for i in range(len(pods)):
+        idx = int(result.assignments[i])
+        names.append(table.name_of[idx] if idx >= 0 else None)
+    return names, result, table
+
+
+def test_spreads_by_least_requested():
+    nodes = [mk_node(f"n{i}") for i in range(4)]
+    pods = [mk_pod(f"p{i}", cpu="1", mem="2Gi") for i in range(4)]
+    names, _, _ = solve(nodes, pods)
+    assert sorted(names) == ["n0", "n1", "n2", "n3"]
+
+
+def test_no_double_booking():
+    # 2-core nodes, 1.5-core pods: one pod per node, third unschedulable
+    nodes = [mk_node("a", cpu="2"), mk_node("b", cpu="2")]
+    pods = [mk_pod(f"p{i}", cpu="1500m") for i in range(3)]
+    names, result, _ = solve(nodes, pods)
+    assert set(names[:2]) == {"a", "b"}
+    assert names[2] is None
+    np.testing.assert_allclose(
+        np.asarray(result.new_requested)[:2, Resource.CPU].sum(), 3000)
+
+
+def test_round_robin_ties():
+    # Identical nodes and pods with no resource requests (all-zero requests
+    # keep utilization scores constant): ties rotate round-robin.
+    nodes = [mk_node(f"n{i}") for i in range(3)]
+    pods = [mk_pod(f"p{i}") for i in range(6)]
+    names, result, _ = solve(nodes, pods)
+    assert names == ["n0", "n1", "n2", "n0", "n1", "n2"]
+    assert int(result.rr_end) == 6
+
+
+def test_in_batch_port_conflict():
+    port_pod = lambda name: Pod.from_dict({
+        "metadata": {"name": name},
+        "spec": {"containers": [{"name": "c", "ports": [
+            {"containerPort": 80, "hostPort": 8080}]}]}})
+    nodes = [mk_node("a"), mk_node("b")]
+    names, _, _ = solve(nodes, [port_pod("p0"), port_pod("p1"), port_pod("p2")])
+    assert set(names[:2]) == {"a", "b"}
+    assert names[2] is None  # both ports taken within the batch
+
+
+def test_unschedulable_pod_gets_minus_one():
+    nodes = [mk_node("a")]
+    names, result, _ = solve(nodes, [mk_pod("p", nodeSelector={"x": "y"})])
+    assert names == [None]
+    assert int(result.feasible_counts[0]) == 0
+
+
+def test_padding_rows_ignored():
+    caps = Capacities(num_nodes=16, batch_pods=8)
+    nodes = [mk_node("a")]
+    pods = [mk_pod("p", cpu="1")]
+    names, result, _ = solve(nodes, pods, caps=caps)
+    assert names == ["a"]
+    assert (np.asarray(result.assignments)[1:] == -1).all()
+
+
+def test_unschedulable_filter_is_not_policy_gated():
+    # Even a resources-only policy must never use spec.unschedulable nodes
+    # (reference node-lister filter, factory.go).
+    from kubernetes_tpu.models.policy import Policy
+    caps = Capacities(num_nodes=16, batch_pods=16)
+    cordoned = mk_node("a")
+    cordoned.spec.unschedulable = True
+    state, table = encode_nodes([cordoned, mk_node("b")], caps)
+    batch = encode_pods([mk_pod("p", cpu="1")], caps)
+    pol = Policy(predicates=("GeneralPredicates",),
+                 priorities=(("LeastRequestedPriority", 1),))
+    result = jit_schedule(state, batch, 0, pol)
+    assert table.name_of[int(result.assignments[0])] == "b"
+
+
+def test_negative_priority_weight_rejected():
+    from kubernetes_tpu.models.policy import Policy
+    with pytest.raises(ValueError, match="positive weight"):
+        Policy(priorities=(("LeastRequestedPriority", -1),))
+
+
+def test_respects_preexisting_assignments():
+    prev = mk_pod("prev", cpu="3")
+    prev.spec.node_name = "a"
+    nodes = [mk_node("a", cpu="4"), mk_node("b", cpu="4")]
+    names, _, _ = solve(nodes, [mk_pod("p", cpu="2")], assigned=[prev])
+    assert names == ["b"]
+
+
+def _random_cluster(rng, n_nodes, n_pods):
+    zones = ["z0", "z1", "z2"]
+    nodes = []
+    for i in range(n_nodes):
+        labels = {"zone": zones[rng.randint(3)]}
+        if rng.rand() < 0.3:
+            labels["disk"] = "ssd"
+        taints = []
+        if rng.rand() < 0.2:
+            taints.append({"key": "dedicated", "value": "infra",
+                           "effect": rng.choice(["NoSchedule", "PreferNoSchedule"])})
+        node = mk_node(
+            f"n{i}", cpu=f"{rng.randint(2, 9)}", mem=f"{rng.randint(4, 17)}Gi",
+            pods=str(rng.randint(3, 8)), labels=labels, taints=taints)
+        if rng.rand() < 0.5:
+            node.status.allocatable["storage.kubernetes.io/scratch"] = (
+                f"{rng.randint(2, 20)}Gi")
+            if rng.rand() < 0.3:
+                node.status.allocatable["storage.kubernetes.io/overlay"] = (
+                    f"{rng.randint(1, 8)}Gi")
+        nodes.append(node)
+    pods = []
+    for i in range(n_pods):
+        spec = {}
+        if rng.rand() < 0.25:
+            spec["nodeSelector"] = {"disk": "ssd"}
+        if rng.rand() < 0.3:
+            spec["tolerations"] = [{"key": "dedicated", "operator": "Exists"}]
+        if rng.rand() < 0.15:
+            spec["containers"] = [{"name": "c", "ports": [
+                {"containerPort": 80, "hostPort": int(8000 + rng.randint(3))}]}]
+        cpu = f"{rng.choice([250, 500, 1000, 1500])}m" if rng.rand() < 0.8 else None
+        mem = f"{rng.choice([256, 512, 1024, 2048])}Mi" if rng.rand() < 0.8 else None
+        pod = mk_pod(f"p{i}", cpu=cpu, mem=mem, **spec)
+        if rng.rand() < 0.3:
+            kind = rng.choice(["scratch", "overlay"])
+            pod.spec.containers[0].requests[
+                f"storage.kubernetes.io/{kind}"] = f"{rng.randint(1, 6)}Gi"
+        pods.append(pod)
+    return nodes, pods
+
+
+@pytest.mark.parametrize("seed", range(5))
+def test_serial_parity_random(seed):
+    """The batched device solver must make the same decision as the serial
+    Python spec for every pod, in order."""
+    rng = np.random.RandomState(seed)
+    nodes, pods = _random_cluster(rng, n_nodes=12, n_pods=20)
+    expected = SerialScheduler(nodes).schedule(pods)
+    got, _, _ = solve(nodes, pods, caps=Capacities(num_nodes=16, batch_pods=24))
+    assert got == expected
