@@ -1,0 +1,6 @@
+from kubernetes_tpu.parallel.mesh import (  # noqa: F401
+    make_mesh,
+    make_sharded_scheduler,
+    shard_batch,
+    shard_state,
+)

@@ -1,0 +1,92 @@
+"""Device-mesh sharding of the scheduler computation.
+
+The reference's only intra-scheduler parallelism is a 16-goroutine fan-out
+over nodes (`workqueue.Parallelize(16, len(nodes), checkNode)`,
+core/generic_scheduler.go:204,352). The TPU-native equivalent shards the
+**node axis** of the cluster-state tensors across a `jax.sharding.Mesh` so
+predicates/priorities evaluate on all chips at once over ICI; cross-chip
+argmax/normalization reductions (the analog of the priority Reduce goroutines,
+:353-364) become XLA collectives inserted by GSPMD.
+
+Axis mapping from the ML-parallelism vocabulary to this domain (SURVEY.md
+SS2.8/SS5.7): the node axis plays the role of sequence/tensor parallelism (the
+dimension that outgrows one chip — 15k+ nodes), and the pod-batch axis plays
+data parallelism for the embarrassingly parallel phase A. Phase B's scan is
+sequential by construction (serial-equivalence), so its per-step vector work
+shards over nodes only.
+
+Multi-host scale-out (DCN between slices) uses the same specs: `make_mesh`
+accepts any device list, and jax.distributed initialization supplies the
+global device set.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from kubernetes_tpu.models.policy import DEFAULT_POLICY, Policy
+from kubernetes_tpu.ops.solver import schedule_batch
+from kubernetes_tpu.state.cluster_state import ClusterState
+from kubernetes_tpu.state.pod_batch import PodBatch
+
+NODE_AXIS = "nodes"
+
+
+def make_mesh(devices=None) -> Mesh:
+    """1-D mesh over all (or given) devices, node axis sharded across it."""
+    devices = np.asarray(devices if devices is not None else jax.devices())
+    return Mesh(devices.reshape(-1), (NODE_AXIS,))
+
+
+def state_sharding(mesh: Mesh) -> ClusterState:
+    """Pytree of NamedShardings: every cluster-state array shards dim 0 (the
+    node axis) across the mesh."""
+    spec = NamedSharding(mesh, P(NODE_AXIS))
+    return jax.tree.map(lambda _: spec, ClusterState(
+        **{f: 0 for f in ClusterState.__dataclass_fields__}))
+
+
+def batch_sharding(mesh: Mesh) -> PodBatch:
+    """Pod batches are replicated: every chip sees the whole pending batch
+    (they are small — the node axis is the big one)."""
+    spec = NamedSharding(mesh, P())
+    return jax.tree.map(lambda _: spec, PodBatch(
+        **{f: 0 for f in PodBatch.__dataclass_fields__}))
+
+
+def shard_state(state: ClusterState, mesh: Mesh) -> ClusterState:
+    if state.num_nodes % mesh.size != 0:
+        raise ValueError(
+            f"num_nodes={state.num_nodes} not divisible by mesh size {mesh.size}; "
+            f"pick Capacities.num_nodes as a multiple of the device count")
+    return jax.device_put(state, state_sharding(mesh))
+
+
+def shard_batch(batch: PodBatch, mesh: Mesh) -> PodBatch:
+    return jax.device_put(batch, batch_sharding(mesh))
+
+
+def make_sharded_scheduler(mesh: Mesh, policy: Policy = DEFAULT_POLICY):
+    """jit schedule_batch with node-axis sharding constraints.
+
+    Returns fn(state, batch, rr) -> SolverResult whose ledger outputs stay
+    node-sharded (so batch-to-batch chaining never gathers to one chip).
+    """
+    from kubernetes_tpu.ops.solver import SolverResult
+
+    st = state_sharding(mesh)
+    bt = batch_sharding(mesh)
+    repl = NamedSharding(mesh, P())
+    nodes_spec = NamedSharding(mesh, P(NODE_AXIS))
+    out_shardings = SolverResult(
+        assignments=repl, scores=repl, feasible_counts=repl,
+        new_requested=nodes_spec, new_nonzero=nodes_spec, new_ports=nodes_spec,
+        rr_end=repl,
+    )
+    return jax.jit(
+        lambda state, batch, rr: schedule_batch(state, batch, rr, policy),
+        in_shardings=(st, bt, repl),
+        out_shardings=out_shardings,
+    )

@@ -2,15 +2,24 @@
 
 Multi-chip TPU hardware is unavailable in CI; sharding correctness is
 validated on 8 virtual CPU devices (the same mechanism the driver's
-`dryrun_multichip` uses). Must run before jax is imported anywhere.
+`dryrun_multichip` uses).
+
+Note: the session's axon sitecustomize imports jax at interpreter start and
+pins `jax_platforms="axon,cpu"` via jax.config (which outranks the
+JAX_PLATFORMS env var), so we must re-pin the config here, before any backend
+is initialized by a test.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
