@@ -291,6 +291,12 @@ class Pod:
                 return False
         return True
 
+    def host_ports(self) -> list[int]:
+        """Requested host ports (reference scheduler util GetUsedPorts,
+        plugin/pkg/scheduler/util/utils.go:25 — port 0 excluded)."""
+        return [p.host_port for c in self.spec.containers
+                for p in c.ports if p.host_port]
+
 
 @dataclass
 class NodeCondition:
@@ -387,6 +393,47 @@ class Node:
             "metadata": self.metadata.to_dict(),
             "spec": self.spec.to_dict(),
             "status": self.status.to_dict(),
+        }
+
+
+@dataclass
+class Event:
+    """Cluster event object (reference: events are first-class API objects
+    recorded via EventBroadcaster, client-go/tools/record/event.go:78)."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    involved_object: dict[str, Any] = field(default_factory=dict)
+    reason: str = ""
+    message: str = ""
+    type: str = "Normal"  # Normal | Warning
+    count: int = 1
+    source_component: str = ""
+
+    kind = "Event"
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]) -> "Event":
+        return cls(
+            metadata=ObjectMeta.from_dict(d.get("metadata") or {}),
+            involved_object=dict(d.get("involvedObject") or {}),
+            reason=d.get("reason", ""),
+            message=d.get("message", ""),
+            type=d.get("type", "Normal"),
+            count=int(d.get("count", 1)),
+            source_component=(d.get("source") or {}).get("component", ""),
+        )
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "apiVersion": "v1",
+            "kind": "Event",
+            "metadata": self.metadata.to_dict(),
+            "involvedObject": dict(self.involved_object),
+            "reason": self.reason,
+            "message": self.message,
+            "type": self.type,
+            "count": self.count,
+            "source": {"component": self.source_component},
         }
 
 

@@ -1,0 +1,8 @@
+from kubernetes_tpu.apiserver.store import (  # noqa: F401
+    Conflict,
+    NotFound,
+    AlreadyExists,
+    Expired,
+    ObjectStore,
+    WatchEvent,
+)

@@ -1,0 +1,237 @@
+"""In-memory object store with optimistic concurrency and watch streams.
+
+The host-plane analog of the reference's storage stack: etcd3 revisions + CAS
+(GuaranteedUpdate, apiserver/pkg/storage/etcd3/store.go:257), the generic
+registry's CRUD semantics (registry/generic/registry/store.go:78), the watch
+cache ring buffer fanning one stream out to N subscribers
+(storage/cacher.go:141, watch_cache.go:93), and the pods/binding subresource
+(pkg/registry/core/pod/rest). Storage is a dict of deep-copied API objects; a
+single global monotonically increasing resourceVersion orders all writes, and
+watchers can resume from any version still inside the ring buffer — older
+versions raise Expired (HTTP 410 analog) which makes clients relist, exactly
+the Reflector contract (client-go/tools/cache/reflector.go:239).
+
+Designed to run inside one asyncio loop: CRUD is synchronous (dict ops are
+atomic per loop tick), watch delivery is via asyncio.Queue.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import copy
+import fnmatch
+import time
+from collections import deque
+from dataclasses import dataclass
+from typing import Any, Callable
+
+from kubernetes_tpu.api.objects import Binding
+
+
+class NotFound(KeyError):
+    pass
+
+
+class AlreadyExists(ValueError):
+    pass
+
+
+class Conflict(ValueError):
+    """resourceVersion mismatch — the CAS failure (etcd3 txn miss)."""
+
+
+class Expired(ValueError):
+    """Watch resume point fell out of the ring buffer (HTTP 410 Gone)."""
+
+
+@dataclass
+class WatchEvent:
+    type: str          # ADDED | MODIFIED | DELETED
+    kind: str
+    obj: Any           # deep copy of the API object
+    resource_version: int
+
+
+def _key(namespace: str, name: str) -> tuple[str, str]:
+    return (namespace or "default", name)
+
+
+class ObjectStore:
+    """One store instance == one apiserver+etcd."""
+
+    def __init__(self, watch_window: int = 4096):
+        self._objects: dict[str, dict[tuple[str, str], Any]] = {}
+        self._rv = 0
+        self._history: deque[WatchEvent] = deque(maxlen=watch_window)
+        self._watchers: list[tuple[str | None, asyncio.Queue]] = []
+
+    # ---- versioning ----
+
+    @property
+    def resource_version(self) -> int:
+        return self._rv
+
+    def _next_rv(self) -> int:
+        self._rv += 1
+        return self._rv
+
+    # ---- CRUD ----
+
+    def _bucket(self, kind: str) -> dict[tuple[str, str], Any]:
+        return self._objects.setdefault(kind, {})
+
+    def create(self, obj: Any) -> Any:
+        kind = obj.kind
+        key = _key(obj.metadata.namespace, obj.metadata.name)
+        bucket = self._bucket(kind)
+        if key in bucket:
+            raise AlreadyExists(f"{kind} {key} already exists")
+        stored = copy.deepcopy(obj)
+        rv = self._next_rv()
+        stored.metadata.resource_version = str(rv)
+        stored.metadata.creation_timestamp = time.time()
+        bucket[key] = stored
+        self._publish(WatchEvent("ADDED", kind, copy.deepcopy(stored), rv))
+        return copy.deepcopy(stored)
+
+    def get(self, kind: str, name: str, namespace: str = "default") -> Any:
+        try:
+            return copy.deepcopy(self._bucket(kind)[_key(namespace, name)])
+        except KeyError:
+            raise NotFound(f"{kind} {namespace}/{name} not found") from None
+
+    def update(self, obj: Any, *, check_version: bool = True) -> Any:
+        kind = obj.kind
+        key = _key(obj.metadata.namespace, obj.metadata.name)
+        bucket = self._bucket(kind)
+        current = bucket.get(key)
+        if current is None:
+            raise NotFound(f"{kind} {key} not found")
+        if check_version and obj.metadata.resource_version and (
+                obj.metadata.resource_version != current.metadata.resource_version):
+            raise Conflict(
+                f"{kind} {key}: version {obj.metadata.resource_version} != "
+                f"{current.metadata.resource_version}")
+        stored = copy.deepcopy(obj)
+        rv = self._next_rv()
+        stored.metadata.resource_version = str(rv)
+        stored.metadata.creation_timestamp = current.metadata.creation_timestamp
+        bucket[key] = stored
+        self._publish(WatchEvent("MODIFIED", kind, copy.deepcopy(stored), rv))
+        return copy.deepcopy(stored)
+
+    def guaranteed_update(self, kind: str, name: str, namespace: str,
+                          mutate: Callable[[Any], Any], retries: int = 16) -> Any:
+        """CAS retry loop (GuaranteedUpdate, etcd3/store.go:257)."""
+        for _ in range(retries):
+            obj = self.get(kind, name, namespace)
+            mutate(obj)
+            try:
+                return self.update(obj)
+            except Conflict:
+                continue
+        raise Conflict(f"{kind} {namespace}/{name}: too many CAS retries")
+
+    def delete(self, kind: str, name: str, namespace: str = "default") -> Any:
+        bucket = self._bucket(kind)
+        key = _key(namespace, name)
+        obj = bucket.pop(key, None)
+        if obj is None:
+            raise NotFound(f"{kind} {namespace}/{name} not found")
+        rv = self._next_rv()
+        self._publish(WatchEvent("DELETED", kind, copy.deepcopy(obj), rv))
+        return obj
+
+    def list(self, kind: str, namespace: str | None = None,
+             label_selector: dict[str, str] | None = None,
+             field_glob: str | None = None) -> list[Any]:
+        out = []
+        for (ns, name), obj in self._bucket(kind).items():
+            if namespace is not None and ns != namespace:
+                continue
+            if label_selector is not None:
+                labels = obj.metadata.labels
+                if not all(labels.get(k) == v for k, v in label_selector.items()):
+                    continue
+            if field_glob is not None and not fnmatch.fnmatch(name, field_glob):
+                continue
+            out.append(copy.deepcopy(obj))
+        return out
+
+    # ---- pods/binding subresource ----
+
+    def bind(self, binding: Binding) -> Any:
+        """Set spec.nodeName exactly once (the scheduler's write; reference
+        registry rejects double binds)."""
+        pod = self.get("Pod", binding.pod_name, binding.namespace)
+        if pod.spec.node_name:
+            raise Conflict(
+                f"pod {binding.namespace}/{binding.pod_name} already bound "
+                f"to {pod.spec.node_name}")
+        pod.spec.node_name = binding.target_node
+        return self.update(pod, check_version=False)
+
+    # ---- watch ----
+
+    def _publish(self, event: WatchEvent) -> None:
+        self._history.append(event)
+        for kind, queue in self._watchers:
+            if kind is None or kind == event.kind:
+                queue.put_nowait(event)
+
+    def watch(self, kind: str | None = None,
+              since: int | None = None) -> "WatchStream":
+        """Subscribe to events after resourceVersion `since` (None = now).
+
+        Raises Expired if `since` predates the ring buffer — the caller must
+        relist, like a Reflector on 410.
+        """
+        queue: asyncio.Queue = asyncio.Queue()
+        backlog: list[WatchEvent] = []
+        if since is not None and since < self._rv:
+            oldest = self._history[0].resource_version if self._history else self._rv + 1
+            if since < oldest - 1:
+                raise Expired(f"resourceVersion {since} is too old "
+                              f"(window starts at {oldest})")
+            backlog = [e for e in self._history if e.resource_version > since]
+        entry = (kind, queue)
+        self._watchers.append(entry)
+        for e in backlog:
+            if kind is None or kind == e.kind:
+                queue.put_nowait(e)
+        return WatchStream(self, entry, queue)
+
+
+class WatchStream:
+    def __init__(self, store: ObjectStore, entry, queue: asyncio.Queue):
+        self._store = store
+        self._entry = entry
+        self._queue = queue
+        self._stopped = False
+
+    async def next(self, timeout: float | None = None) -> WatchEvent | None:
+        if self._stopped:
+            return None
+        try:
+            if timeout is None:
+                return await self._queue.get()
+            return await asyncio.wait_for(self._queue.get(), timeout)
+        except asyncio.TimeoutError:
+            return None
+
+    def stop(self) -> None:
+        if not self._stopped:
+            self._stopped = True
+            try:
+                self._store._watchers.remove(self._entry)
+            except ValueError:
+                pass
+
+    def __aiter__(self):
+        return self
+
+    async def __anext__(self) -> WatchEvent:
+        ev = await self.next()
+        if ev is None:
+            raise StopAsyncIteration
+        return ev

@@ -1,0 +1,2 @@
+from kubernetes_tpu.client.informer import Informer  # noqa: F401
+from kubernetes_tpu.client.workqueue import BackoffQueue  # noqa: F401

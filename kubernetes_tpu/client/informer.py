@@ -1,0 +1,107 @@
+"""Informer: list+watch cache with resume semantics.
+
+The asyncio re-design of client-go's Reflector/SharedIndexInformer
+(client-go/tools/cache/reflector.go:239 ListAndWatch: full List, then Watch
+from the list's resourceVersion; on an expired resume point, relist). The
+local cache is a dict the way the reference's ThreadSafeStore is; handlers
+fire in watch order on the owning asyncio loop, so no locking is needed.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Any, Callable
+
+from kubernetes_tpu.apiserver.store import Expired, ObjectStore, WatchEvent
+
+log = logging.getLogger(__name__)
+
+Handler = Callable[[WatchEvent], None]
+
+
+class Informer:
+    def __init__(self, store: ObjectStore, kind: str):
+        self.store = store
+        self.kind = kind
+        self.cache: dict[tuple[str, str], Any] = {}
+        self._handlers: list[Handler] = []
+        self._task: asyncio.Task | None = None
+        self._synced = asyncio.Event()
+
+    def add_handler(self, handler: Handler) -> None:
+        self._handlers.append(handler)
+
+    # ---- lister interface ----
+
+    def get(self, name: str, namespace: str = "default") -> Any | None:
+        return self.cache.get((namespace, name))
+
+    def items(self) -> list[Any]:
+        return list(self.cache.values())
+
+    async def wait_for_sync(self) -> None:
+        await self._synced.wait()
+
+    # ---- lifecycle ----
+
+    def start(self) -> None:
+        if self._task is None:
+            self._task = asyncio.get_running_loop().create_task(self._run())
+
+    def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            self._task = None
+
+    async def _run(self) -> None:
+        while True:
+            try:
+                await self._list_and_watch()
+            except asyncio.CancelledError:
+                return
+            except Exception:  # noqa: BLE001 — reflector loops survive anything
+                log.exception("informer %s: list/watch failed; relisting", self.kind)
+                await asyncio.sleep(0.05)
+
+    async def _list_and_watch(self) -> None:
+        rv = self.store.resource_version
+        fresh = {(o.metadata.namespace, o.metadata.name): o
+                 for o in self.store.list(self.kind)}
+        # replay the delta between cache and fresh list as synthetic events
+        for key, obj in fresh.items():
+            old = self.cache.get(key)
+            if old is None:
+                self._dispatch(WatchEvent("ADDED", self.kind, obj, rv))
+            elif old.metadata.resource_version != obj.metadata.resource_version:
+                self._dispatch(WatchEvent("MODIFIED", self.kind, obj, rv))
+        for key in list(self.cache.keys() - fresh.keys()):
+            self._dispatch(WatchEvent("DELETED", self.kind, self.cache[key], rv))
+        self.cache = dict(fresh)
+        self._synced.set()
+
+        try:
+            stream = self.store.watch(self.kind, since=rv)
+        except Expired:
+            return  # relist
+        try:
+            async for event in stream:
+                self._apply(event)
+                self._dispatch(event)
+        finally:
+            stream.stop()
+
+    def _apply(self, event: WatchEvent) -> None:
+        key = (event.obj.metadata.namespace, event.obj.metadata.name)
+        if event.type == "DELETED":
+            self.cache.pop(key, None)
+        else:
+            self.cache[key] = event.obj
+
+    def _dispatch(self, event: WatchEvent) -> None:
+        for h in self._handlers:
+            try:
+                h(event)
+            except Exception:  # noqa: BLE001
+                log.exception("informer %s: handler failed on %s",
+                              self.kind, event.type)

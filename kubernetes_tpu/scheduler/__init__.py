@@ -1,0 +1,1 @@
+from kubernetes_tpu.scheduler.driver import Scheduler  # noqa: F401

@@ -1,0 +1,270 @@
+"""Scheduler driver: informers -> batch solver -> bindings.
+
+The host loop replacing the reference's `Scheduler.Run`/`scheduleOne`
+(plugin/pkg/scheduler/scheduler.go:149,253) and its factory wiring
+(factory/factory.go:118 NewConfigFactory: informers feeding a FIFO of
+unscheduled pods, error path with exponential backoff :897). Differences are
+the point of the re-design:
+
+- pods are popped in FIFO order but scheduled as a *batch* in one device
+  program (ops/solver.py) instead of one blocking scheduleOne per pod;
+- assume + bind: each assignment is accounted optimistically in StateDB
+  (cache.AssumePod analog), then bound through the store; a failed bind
+  rolls the assumption back (ForgetPod, scheduler.go:224) and requeues with
+  backoff;
+- unschedulable pods requeue with exponential backoff and emit
+  FailedScheduling events (scheduler.go:174,248 event parity).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from collections import deque
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from kubernetes_tpu.api.objects import Binding, Pod
+from kubernetes_tpu.apiserver.store import Conflict, NotFound, ObjectStore, WatchEvent
+from kubernetes_tpu.client.informer import Informer
+from kubernetes_tpu.client.workqueue import Backoff, BackoffQueue
+from kubernetes_tpu.models.policy import DEFAULT_POLICY, Policy
+from kubernetes_tpu.ops.solver import schedule_batch
+from kubernetes_tpu.state import Capacities
+from kubernetes_tpu.state.layout import CapacityError
+from kubernetes_tpu.state.pod_batch import empty_batch, encode_pod_into
+from kubernetes_tpu.state.statedb import StateDB
+from kubernetes_tpu.utils.events import EventRecorder
+from kubernetes_tpu.utils.trace import StepTimer
+
+log = logging.getLogger(__name__)
+
+
+@dataclass
+class SchedulerMetrics:
+    """Counters/latency mirrors of the reference's Prometheus metrics
+    (plugin/pkg/scheduler/metrics/metrics.go:31-50)."""
+
+    scheduled: int = 0
+    failed: int = 0
+    binding_errors: int = 0
+    batches: int = 0
+    # bounded windows (the reference uses fixed-bucket Prometheus histograms)
+    e2e_latency: deque = field(default_factory=lambda: deque(maxlen=8192))
+    algorithm_latency: deque = field(default_factory=lambda: deque(maxlen=8192))
+
+    def snapshot(self) -> dict:
+        lat = sorted(self.e2e_latency) or [0.0]
+        return {
+            "scheduled": self.scheduled,
+            "failed": self.failed,
+            "binding_errors": self.binding_errors,
+            "batches": self.batches,
+            "e2e_p50_ms": 1e3 * lat[len(lat) // 2],
+            "e2e_p99_ms": 1e3 * lat[min(len(lat) - 1, int(len(lat) * 0.99))],
+        }
+
+
+class Scheduler:
+    def __init__(
+        self,
+        store: ObjectStore,
+        caps: Capacities | None = None,
+        policy: Policy = DEFAULT_POLICY,
+        mesh=None,
+        scheduler_name: str = "default-scheduler",
+        batch_wait: float = 0.002,
+    ):
+        import jax
+
+        self.store = store
+        self.caps = caps or Capacities()
+        self.policy = policy
+        self.scheduler_name = scheduler_name
+        self.batch_wait = batch_wait
+
+        self.statedb = StateDB(self.caps, mesh=mesh)
+        self.queue = BackoffQueue()
+        self.backoff = Backoff(initial=0.05, max_duration=5.0)
+        self.metrics = SchedulerMetrics()
+        self.events = EventRecorder(store)
+        self._assumed: set[str] = set()
+        self._enqueue_time: dict[str, float] = {}
+        self._rr = np.uint32(0)
+
+        self.node_informer = Informer(store, "Node")
+        self.pod_informer = Informer(store, "Pod")
+        self.node_informer.add_handler(self._on_node_event)
+        self.pod_informer.add_handler(self._on_pod_event)
+
+        if mesh is not None:
+            from kubernetes_tpu.parallel.mesh import make_sharded_scheduler
+            self._schedule_fn = make_sharded_scheduler(mesh, policy)
+        else:
+            self._schedule_fn = jax.jit(
+                lambda s, b, rr: schedule_batch(s, b, rr, policy))
+        self._stopped = False
+
+    # ---- informer handlers ----
+
+    def _on_node_event(self, event: WatchEvent) -> None:
+        node = event.obj
+        if event.type == "DELETED":
+            self.statedb.remove_node(node.metadata.name)
+            return
+        self.statedb.upsert_node(node)
+        # re-account bound pods the state missed: pods whose MODIFIED/ADDED
+        # event raced ahead of this node's, or whose accounting was dropped by
+        # a node delete+recreate
+        for pod in self.pod_informer.items():
+            if (pod.spec.node_name == node.metadata.name
+                    and not self.statedb.is_accounted(pod.key)
+                    and pod.key not in self._assumed):
+                self.statedb.add_pod(pod)
+
+    def _wants(self, pod: Pod) -> bool:
+        return pod.spec.scheduler_name == self.scheduler_name
+
+    def _on_pod_event(self, event: WatchEvent) -> None:
+        pod: Pod = event.obj
+        key = pod.key
+        if event.type == "DELETED":
+            self._assumed.discard(key)
+            self._enqueue_time.pop(key, None)
+            self.statedb.remove_pod(key)
+            return
+        if pod.spec.node_name:
+            self._enqueue_time.pop(key, None)
+            if key in self._assumed:
+                # our own binding confirmed by the watch
+                self._assumed.discard(key)
+            else:
+                # bound elsewhere; if the node is unknown the node-event
+                # handler re-accounts it once the node appears
+                self.statedb.add_pod(pod)
+        elif self._wants(pod):
+            self._enqueue_time.setdefault(key, time.monotonic())
+            self.queue.add(key)
+
+    # ---- lifecycle ----
+
+    async def start(self) -> None:
+        self.node_informer.start()
+        self.pod_informer.start()
+        await self.node_informer.wait_for_sync()
+        await self.pod_informer.wait_for_sync()
+
+    def stop(self) -> None:
+        self._stopped = True
+        self.queue.close()
+        self.node_informer.stop()
+        self.pod_informer.stop()
+
+    async def run(self) -> None:
+        """Schedule until stopped (wait.Until(scheduleOne) analog)."""
+        await self.start()
+        while not self._stopped:
+            await self.schedule_pending(wait=0.5)
+
+    # ---- one batch ----
+
+    async def schedule_pending(self, wait: float | None = None) -> int:
+        """Pop up to a batch of pending pods, schedule, bind. Returns the
+        number of pods scheduled."""
+        keys = await self.queue.get_batch(self.caps.batch_pods, wait=wait)
+        if not keys:
+            return 0
+
+        batch = empty_batch(self.caps)
+        pods: list[Pod] = []
+        live_keys: list[str] = []
+        for key in keys:
+            ns, name = key.split("/", 1)
+            pod = self.pod_informer.get(name, ns)
+            if pod is None or pod.spec.node_name:
+                self._enqueue_time.pop(key, None)
+                self.queue.done(key)  # deleted or already bound: drop
+                continue
+            try:
+                encode_pod_into(batch, len(pods), pod, self.caps)
+            except CapacityError as e:
+                # per-pod failure must not wedge the batch
+                # (MakeDefaultErrorFunc parity, factory.go:897)
+                self._fail(key, pod, f"pod exceeds scheduler capacities: {e}")
+                continue
+            pods.append(pod)
+            live_keys.append(key)
+        if not pods:
+            return 0
+
+        timer = StepTimer(f"scheduling batch of {len(pods)}")
+        state = self.statedb.flush()
+        timer.step("encode + flush")
+
+        t0 = time.monotonic()
+        result = self._schedule_fn(state, batch, self._rr)
+        assignments = np.asarray(result.assignments)
+        self._rr = result.rr_end
+        self.metrics.algorithm_latency.append(time.monotonic() - t0)
+        timer.step("device solve")
+
+        scheduled = 0
+        committed: list[tuple[Pod, str]] = []
+        any_rejected = False
+        for i, (key, pod) in enumerate(zip(live_keys, pods)):
+            row = int(assignments[i])
+            if row < 0:
+                self._fail(key, pod, "no nodes available to schedule pods")
+                continue
+            node_name = self.statedb.table.name_of[row]
+            if node_name is None:
+                any_rejected = True  # the vanished node left a ledger charge
+                self._fail(key, pod, "assigned node vanished")
+                continue
+            try:
+                self.store.bind(Binding(pod_name=pod.metadata.name,
+                                        namespace=pod.metadata.namespace,
+                                        target_node=node_name))
+            except (Conflict, NotFound) as e:
+                # the solver's ledger charged this pod; drop that ledger below
+                any_rejected = True
+                self.metrics.binding_errors += 1
+                self._fail(key, pod, f"binding rejected: {e}")
+                continue
+            self._assumed.add(key)
+            committed.append((pod, node_name))
+            scheduled += 1
+            self.queue.done(key)
+            self.backoff.reset(key)
+            enq = self._enqueue_time.pop(key, None)
+            if enq is not None:
+                self.metrics.e2e_latency.append(time.monotonic() - enq)
+            self.events.record(pod, "Normal", "Scheduled",
+                               f"Successfully assigned {key} to {node_name}")
+
+        if any_rejected:
+            # the solver output charges pods whose binding failed: keep the
+            # host truth (accounting only bound pods) and force a re-upload
+            # instead of adopting the device ledger (ForgetPod analog)
+            for pod, node_name in committed:
+                self.statedb.add_pod(pod, node_name)
+            self.statedb.mark_ledger_dirty()
+        else:
+            # clean batch: adopt the device ledger, no transfer either way
+            self.statedb.commit_ledger(result.new_requested, result.new_nonzero,
+                                       result.new_ports, committed)
+        self.metrics.scheduled += scheduled
+        self.metrics.batches += 1
+        if self.metrics.batches % 128 == 0:
+            self.backoff.gc()
+        timer.step("bind + commit")
+        timer.log_if_long(0.1 * len(pods))
+        return scheduled
+
+    def _fail(self, key: str, pod: Pod, message: str) -> None:
+        self.metrics.failed += 1
+        self.queue.done(key)
+        self.queue.add_after(key, self.backoff.next_delay(key))
+        self.events.record(pod, "Warning", "FailedScheduling", message)

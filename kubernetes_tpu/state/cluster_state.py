@@ -245,20 +245,28 @@ def pod_nonzero_requests(pod: Pod) -> np.ndarray:
     return np.array([cpu, mem], np.float32)
 
 
+def insert_port(port_row: np.ndarray, port: int) -> None:
+    """Fill the first empty (-1) slot of a node's port row."""
+    empty = np.nonzero(port_row == -1)[0]
+    if empty.size == 0:
+        raise CapacityError(f"port slots ({port_row.shape[0]}) exhausted")
+    port_row[empty[0]] = port
+
+
+def remove_port(port_row: np.ndarray, port: int) -> None:
+    """Clear one occurrence of `port` from a node's port row."""
+    hit = np.nonzero(port_row == port)[0]
+    if hit.size:
+        port_row[hit[0]] = -1
+
+
 def add_pod_to_state(state: ClusterState, table: NodeTable, pod: Pod, row: int) -> None:
     """Account an assigned pod against a node row (the analog of
     NodeInfo.addPod, node_info.go:171)."""
     state.requested[row] += pod_requests(pod)
     state.nonzero_requested[row] += pod_nonzero_requests(pod)
-    ports = state.ports[row]
-    for c in pod.spec.containers:
-        for p in c.ports:
-            if p.host_port:
-                empty = np.nonzero(ports == -1)[0]
-                if empty.size == 0:
-                    raise CapacityError(
-                        f"node row {row}: port slots ({table.caps.node_port_slots}) exhausted")
-                ports[empty[0]] = p.host_port
+    for port in pod.host_ports():
+        insert_port(state.ports[row], port)
     table.bump(row)
 
 

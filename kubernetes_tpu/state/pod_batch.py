@@ -66,7 +66,7 @@ def encode_pod_into(batch: PodBatch, i: int, pod: Pod, caps: Capacities) -> None
     batch.requests[i] = pod_requests(pod)
     batch.nonzero_requests[i] = pod_nonzero_requests(pod)
 
-    host_ports = [p.host_port for c in pod.spec.containers for p in c.ports if p.host_port]
+    host_ports = pod.host_ports()
     if len(host_ports) > caps.pod_port_slots:
         raise CapacityError(f"pod {pod.key}: {len(host_ports)} host ports > "
                             f"{caps.pod_port_slots} slots")

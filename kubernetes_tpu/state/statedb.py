@@ -1,0 +1,178 @@
+"""StateDB: host-canonical cluster state incrementally mirrored to device.
+
+The stateful shell around `ClusterState` playing the role of the scheduler
+cache (reference plugin/pkg/scheduler/schedulercache/cache.go): it aggregates
+node objects + accounted pods (bound and assumed) into the SoA arrays, tracks
+dirtiness at field-group granularity (the generation-counter analog,
+node_info.go:60), and hands the device a fresh view only when something
+actually changed.
+
+Two commit paths keep the hot loop off the PCIe bus:
+- `add_pod`/`remove_pod` mutate host numpy and mark the ledger dirty; the next
+  `flush()` re-uploads ledger arrays (external writes: pods bound by other
+  components, deletions, node changes).
+- `commit_ledger(result, ...)` accepts the solver's *device-resident* output
+  ledger as the new truth (batch-to-batch chaining never leaves the device)
+  while mirroring the same arithmetic into host numpy for rollback/re-encode;
+  host and device stay equal without a transfer.
+
+Assume/forget semantics (cache.go:109 AssumePod, scheduler.go:224 rollback):
+the driver accounts an assignment optimistically via either path; a failed
+bind calls `remove_pod` which both fixes host numpy and marks the ledger
+dirty, forcing re-upload of the corrected truth.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+
+from kubernetes_tpu.api.objects import Node, Pod
+from kubernetes_tpu.state.cluster_state import (
+    ClusterState,
+    NodeTable,
+    _fill_node_row,
+    empty_state,
+    insert_port,
+    pod_nonzero_requests,
+    pod_requests,
+    remove_port,
+)
+from kubernetes_tpu.state.layout import Capacities
+
+
+class StateDB:
+    def __init__(self, caps: Capacities, mesh=None):
+        self.caps = caps
+        self.mesh = mesh
+        self.host: ClusterState = empty_state(caps)
+        self.table = NodeTable(caps)
+        # pod key -> (node_name, requests, nonzero, ports) for exact removal
+        self._accounted: dict[str, tuple[str, np.ndarray, np.ndarray, list[int]]] = {}
+        self._dirty_nodes = True   # static node fields changed
+        self._dirty_ledger = True  # requested/nonzero/ports changed on host
+        self._device: ClusterState | None = None
+
+    # ---- node lifecycle ----
+
+    def upsert_node(self, node: Node) -> None:
+        row = self.table.assign_row(node.metadata.name)
+        _fill_node_row(self.host, self.table, row, node)
+        self.table.bump(row)
+        self._dirty_nodes = True
+
+    def remove_node(self, name: str) -> None:
+        if name not in self.table.row_of:
+            return
+        row = self.table.release_row(name)
+        for key in [k for k, v in self._accounted.items() if v[0] == name]:
+            del self._accounted[key]
+        for field in self.host.__dataclass_fields__:
+            arr = getattr(self.host, field)
+            arr[row] = -1 if field in ("ports", "topology") else 0
+        self._dirty_nodes = True
+        self._dirty_ledger = True
+
+    def has_node(self, name: str) -> bool:
+        return name in self.table.row_of
+
+    # ---- pod accounting (bound + assumed) ----
+
+    def _apply_pod(self, row: int, req, nz, ports: list[int], sign: int) -> None:
+        self.host.requested[row] += sign * req
+        self.host.nonzero_requested[row] += sign * nz
+        for port in ports:
+            if sign > 0:
+                insert_port(self.host.ports[row], port)
+            else:
+                remove_port(self.host.ports[row], port)
+        self.table.bump(row)
+
+    def add_pod(self, pod: Pod, node_name: str | None = None, *,
+                mirror_only: bool = False) -> bool:
+        """Account a pod against its node. Returns False if the node is
+        unknown (cache-miss pods are skipped, like the reference cache).
+
+        mirror_only: host-side bookkeeping for a change already present in
+        the device ledger (commit_ledger path) — don't mark dirty.
+        """
+        node_name = node_name or pod.spec.node_name
+        row = self.table.row_of.get(node_name)
+        if row is None:
+            return False
+        if pod.key in self._accounted:
+            return True  # already accounted (assume then confirm)
+        req = pod_requests(pod)
+        nz = pod_nonzero_requests(pod)
+        ports = pod.host_ports()
+        self._apply_pod(row, req, nz, ports, +1)
+        self._accounted[pod.key] = (node_name, req, nz, ports)
+        if not mirror_only:
+            self._dirty_ledger = True
+        return True
+
+    def remove_pod(self, pod_key: str) -> None:
+        entry = self._accounted.pop(pod_key, None)
+        if entry is None:
+            return
+        node_name, req, nz, ports = entry
+        row = self.table.row_of.get(node_name)
+        if row is None:
+            return  # node vanished; its rows were zeroed already
+        self._apply_pod(row, req, nz, ports, -1)
+        self._dirty_ledger = True
+
+    def is_accounted(self, pod_key: str) -> bool:
+        return pod_key in self._accounted
+
+    def mark_ledger_dirty(self) -> None:
+        """Force the next flush() to re-upload the host ledger — used when the
+        device-side ledger is known to carry charges the host truth does not
+        (e.g. a solver assignment whose binding was rolled back)."""
+        self._dirty_ledger = True
+
+    # ---- device mirror ----
+
+    def flush(self) -> ClusterState:
+        """Return the device view, re-uploading only what changed."""
+        if self._device is None or self._dirty_nodes:
+            dev = self._put(self.host)
+        elif self._dirty_ledger:
+            dev = self._device.replace(
+                requested=self._put_arr(self.host.requested),
+                nonzero_requested=self._put_arr(self.host.nonzero_requested),
+                ports=self._put_arr(self.host.ports),
+            )
+        else:
+            return self._device
+        self._device = dev
+        self._dirty_nodes = False
+        self._dirty_ledger = False
+        return dev
+
+    def commit_ledger(self, new_requested, new_nonzero, new_ports,
+                      assignments: list[tuple[Pod, str]]) -> None:
+        """Adopt the solver's output ledger as the device truth and mirror
+        the same assignments into host numpy (no transfer either way)."""
+        if self._device is None:
+            raise RuntimeError("commit_ledger before flush")
+        self._device = self._device.replace(
+            requested=new_requested, nonzero_requested=new_nonzero,
+            ports=new_ports)
+        for pod, node_name in assignments:
+            self.add_pod(pod, node_name, mirror_only=True)
+
+    def _put(self, state: ClusterState) -> ClusterState:
+        if self.mesh is not None:
+            from kubernetes_tpu.parallel.mesh import shard_state
+            return shard_state(state, self.mesh)
+        return jax.tree.map(lambda a: jax.device_put(np.asarray(a)), state)
+
+    def _put_arr(self, arr: np.ndarray):
+        if self.mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+            from kubernetes_tpu.parallel.mesh import NODE_AXIS
+            return jax.device_put(
+                np.asarray(arr), NamedSharding(self.mesh, PartitionSpec(NODE_AXIS)))
+        return jax.device_put(np.asarray(arr))

@@ -1,0 +1,44 @@
+"""Event recording with spam aggregation.
+
+The EventBroadcaster/EventRecorder analog (reference
+client-go/tools/record/event.go:78,114 and events_cache aggregation): repeated
+(object, reason, message) events bump a count on one stored Event instead of
+creating new objects.
+"""
+
+from __future__ import annotations
+
+import time
+
+from kubernetes_tpu.api.objects import Event, ObjectMeta
+from kubernetes_tpu.apiserver.store import NotFound, ObjectStore
+
+
+class EventRecorder:
+    def __init__(self, store: ObjectStore, component: str = "default-scheduler"):
+        self.store = store
+        self.component = component
+
+    def record(self, obj, event_type: str, reason: str, message: str) -> Event:
+        name = f"{obj.metadata.name}.{reason.lower()}"
+        namespace = obj.metadata.namespace
+        try:
+            existing = self.store.get("Event", name, namespace)
+            existing.count += 1
+            existing.message = message
+            return self.store.update(existing, check_version=False)
+        except NotFound:
+            event = Event(
+                metadata=ObjectMeta(name=name, namespace=namespace),
+                involved_object={
+                    "kind": obj.kind,
+                    "name": obj.metadata.name,
+                    "namespace": namespace,
+                    "uid": obj.metadata.uid,
+                },
+                reason=reason,
+                message=message,
+                type=event_type,
+                source_component=self.component,
+            )
+            return self.store.create(event)

@@ -1,0 +1,39 @@
+"""In-request step timing, logged only when over threshold.
+
+The util/trace.Trace analog (reference apiserver/pkg/util/trace/trace.go:28-90;
+the scheduler wraps Schedule with trace.Step(...) + LogIfLong(100ms),
+core/generic_scheduler.go:89-126).
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+
+log = logging.getLogger("kubernetes_tpu.trace")
+
+
+class StepTimer:
+    def __init__(self, name: str):
+        self.name = name
+        self.start = time.monotonic()
+        self.steps: list[tuple[str, float]] = []
+
+    def step(self, label: str) -> None:
+        self.steps.append((label, time.monotonic()))
+
+    def total(self) -> float:
+        return time.monotonic() - self.start
+
+    def log_if_long(self, threshold: float) -> bool:
+        total = self.total()
+        if total < threshold:
+            return False
+        prev = self.start
+        parts = []
+        for label, t in self.steps:
+            parts.append(f"{label}: {1e3 * (t - prev):.1f}ms")
+            prev = t
+        log.warning("trace %s (total %.1fms): %s",
+                    self.name, 1e3 * total, "; ".join(parts))
+        return True

@@ -1,0 +1,109 @@
+"""StateDB incremental mirror: accounting, dirtiness, rollback."""
+
+import numpy as np
+
+from kubernetes_tpu.api.objects import Node, Pod
+from kubernetes_tpu.state import Capacities, Resource
+from kubernetes_tpu.state.statedb import StateDB
+
+CAPS = Capacities(num_nodes=8, batch_pods=4)
+
+
+def mk_node(name, cpu="4"):
+    return Node.from_dict({
+        "metadata": {"name": name},
+        "status": {"allocatable": {"cpu": cpu, "memory": "8Gi", "pods": "110"},
+                   "conditions": [{"type": "Ready", "status": "True"}]}})
+
+
+def mk_pod(name, node=None, cpu="500m", port=None):
+    c = {"name": "c", "resources": {"requests": {"cpu": cpu}}}
+    if port:
+        c["ports"] = [{"containerPort": 80, "hostPort": port}]
+    spec = {"containers": [c]}
+    if node:
+        spec["nodeName"] = node
+    return Pod.from_dict({"metadata": {"name": name}, "spec": spec})
+
+
+def test_pod_accounting_roundtrip():
+    db = StateDB(CAPS)
+    db.upsert_node(mk_node("n0"))
+    row = db.table.row_of["n0"]
+    assert db.add_pod(mk_pod("a", node="n0", port=8080))
+    assert db.host.requested[row, Resource.CPU] == 500
+    assert 8080 in db.host.ports[row]
+    db.remove_pod("default/a")
+    assert db.host.requested[row, Resource.CPU] == 0
+    assert 8080 not in db.host.ports[row]
+
+
+def test_unknown_node_pod_skipped():
+    db = StateDB(CAPS)
+    assert not db.add_pod(mk_pod("a", node="ghost"))
+
+
+def test_double_add_is_idempotent():
+    db = StateDB(CAPS)
+    db.upsert_node(mk_node("n0"))
+    row = db.table.row_of["n0"]
+    db.add_pod(mk_pod("a", node="n0"))
+    db.add_pod(mk_pod("a", node="n0"))
+    assert db.host.requested[row, Resource.CPU] == 500
+
+
+def test_node_update_preserves_accounting():
+    db = StateDB(CAPS)
+    db.upsert_node(mk_node("n0", cpu="4"))
+    db.add_pod(mk_pod("a", node="n0"))
+    db.upsert_node(mk_node("n0", cpu="8"))
+    row = db.table.row_of["n0"]
+    assert db.host.allocatable[row, Resource.CPU] == 8000
+    assert db.host.requested[row, Resource.CPU] == 500
+
+
+def test_remove_node_zeroes_rows_and_drops_pods():
+    db = StateDB(CAPS)
+    db.upsert_node(mk_node("n0"))
+    row = db.table.row_of["n0"]
+    db.add_pod(mk_pod("a", node="n0"))
+    db.remove_node("n0")
+    assert not db.host.valid[row]
+    assert db.host.requested[row].sum() == 0
+    assert not db.is_accounted("default/a")
+    # re-adding the node reuses the row cleanly
+    db.upsert_node(mk_node("n1"))
+    assert db.table.row_of["n1"] == row
+
+
+def test_flush_caches_until_dirty():
+    db = StateDB(CAPS)
+    db.upsert_node(mk_node("n0"))
+    dev1 = db.flush()
+    dev2 = db.flush()
+    assert dev1 is dev2  # clean: same device object
+    db.add_pod(mk_pod("a", node="n0"))
+    dev3 = db.flush()
+    assert dev3 is not dev2
+    row = db.table.row_of["n0"]
+    assert float(np.asarray(dev3.requested)[row, Resource.CPU]) == 500
+    # ledger-only flush reuses static arrays
+    assert dev3.label_key is dev2.label_key
+
+
+def test_commit_ledger_keeps_host_and_device_equal():
+    db = StateDB(CAPS)
+    db.upsert_node(mk_node("n0"))
+    dev = db.flush()
+    pod = mk_pod("a")
+    new_req = np.asarray(dev.requested).copy()
+    row = db.table.row_of["n0"]
+    new_req[row, Resource.CPU] += 500
+    new_req[row, Resource.PODS] += 1
+    import jax
+    db.commit_ledger(jax.device_put(new_req), dev.nonzero_requested, dev.ports,
+                     [(pod, "n0")])
+    assert db.host.requested[row, Resource.CPU] == 500
+    dev2 = db.flush()  # must NOT re-upload: ledger is already device truth
+    np.testing.assert_allclose(np.asarray(dev2.requested), new_req)
+    assert db.is_accounted("default/a")

@@ -1,0 +1,53 @@
+"""Workqueue dedup + backoff semantics (client-go workqueue + PodBackoff)."""
+
+import asyncio
+
+from kubernetes_tpu.client.workqueue import Backoff, BackoffQueue
+
+
+def test_backoff_doubles_and_caps():
+    b = Backoff(initial=1.0, max_duration=5.0)
+    assert [b.next_delay("x") for _ in range(4)] == [1.0, 2.0, 4.0, 5.0]
+    b.reset("x")
+    assert b.next_delay("x") == 1.0
+
+
+def test_queue_dedup():
+    async def run():
+        q = BackoffQueue()
+        q.add("a")
+        q.add("a")
+        q.add("b")
+        assert await q.get_batch(10) == ["a", "b"]
+        # re-add while processing marks dirty: reappears after done()
+        q.add("a")
+        assert await q.get_batch(10, wait=0.01) == []
+        q.done("a")
+        assert await q.get_batch(10) == ["a"]
+
+    asyncio.run(run())
+
+
+def test_delayed_add():
+    async def run():
+        q = BackoffQueue()
+        q.add_after("x", 0.05)
+        assert await q.get_batch(10, wait=0.01) == []
+        got = await q.get_batch(10, wait=1.0)
+        assert got == ["x"]
+
+    asyncio.run(run())
+
+
+def test_close_unblocks():
+    async def run():
+        q = BackoffQueue()
+
+        async def closer():
+            await asyncio.sleep(0.01)
+            q.close()
+
+        asyncio.get_running_loop().create_task(closer())
+        assert await q.get_batch(10) == []
+
+    asyncio.run(run())
