@@ -1,0 +1,20 @@
+import time, sys, numpy as np, jax
+from kubernetes_tpu.models.policy import DEFAULT_POLICY
+from kubernetes_tpu.ops.solver import schedule_batch
+from kubernetes_tpu.perf.fixtures import make_nodes, make_pods
+from kubernetes_tpu.state import Capacities, encode_cluster
+
+n, p = int(sys.argv[1]), int(sys.argv[2])
+caps = Capacities(num_nodes=n, batch_pods=p)
+state, batch, _ = encode_cluster(make_nodes(n - 1, zones=3), make_pods(p), caps)
+state = jax.device_put(state); batch = jax.device_put(batch)
+fn = jax.jit(lambda s, b, rr: schedule_batch(s, b, rr, DEFAULT_POLICY))
+t0 = time.perf_counter()
+r = fn(state, batch, np.uint32(0)); r.assignments.block_until_ready()
+print(f"compile+first: {time.perf_counter()-t0:.1f}s", flush=True)
+t0 = time.perf_counter(); iters = 10
+for _ in range(iters):
+    r = fn(state, batch, np.uint32(0))
+r.assignments.block_until_ready()
+dt = (time.perf_counter() - t0) / iters
+print(f"N={n} P={p}: {dt*1e3:.2f} ms/batch = {p/dt:.0f} pods/s", flush=True)

@@ -37,6 +37,16 @@ class ObjectMeta:
     creation_timestamp: float = 0.0
     deletion_timestamp: float | None = None
 
+    def clone(self) -> "ObjectMeta":
+        return ObjectMeta(
+            name=self.name, namespace=self.namespace, uid=self.uid,
+            labels=dict(self.labels), annotations=dict(self.annotations),
+            resource_version=self.resource_version,
+            owner_references=[dict(r) for r in self.owner_references],
+            creation_timestamp=self.creation_timestamp,
+            deletion_timestamp=self.deletion_timestamp,
+        )
+
     @classmethod
     def from_dict(cls, d: dict[str, Any]) -> "ObjectMeta":
         return cls(
@@ -96,6 +106,14 @@ class Container:
     requests: dict[str, str] = field(default_factory=dict)
     limits: dict[str, str] = field(default_factory=dict)
     ports: list[ContainerPort] = field(default_factory=list)
+
+    def clone(self) -> "Container":
+        return Container(
+            name=self.name, image=self.image, requests=dict(self.requests),
+            limits=dict(self.limits),
+            ports=[ContainerPort(p.container_port, p.host_port, p.protocol,
+                                 p.host_ip) for p in self.ports],
+        )
 
     @classmethod
     def from_dict(cls, d: dict[str, Any]) -> "Container":
@@ -199,6 +217,18 @@ class PodSpec:
     restart_policy: str = "Always"
     priority: int = 0
 
+    def clone(self) -> "PodSpec":
+        return PodSpec(
+            node_name=self.node_name, node_selector=dict(self.node_selector),
+            containers=[c.clone() for c in self.containers],
+            tolerations=[Toleration(t.key, t.operator, t.value, t.effect,
+                                    t.toleration_seconds)
+                         for t in self.tolerations],
+            affinity=copy.deepcopy(self.affinity) if self.affinity else {},
+            scheduler_name=self.scheduler_name,
+            restart_policy=self.restart_policy, priority=self.priority,
+        )
+
     @classmethod
     def from_dict(cls, d: dict[str, Any]) -> "PodSpec":
         return cls(
@@ -237,6 +267,11 @@ class PodStatus:
     conditions: list[dict[str, Any]] = field(default_factory=list)
     host_ip: str = ""
 
+    def clone(self) -> "PodStatus":
+        return PodStatus(phase=self.phase,
+                         conditions=[dict(c) for c in self.conditions],
+                         host_ip=self.host_ip)
+
     @classmethod
     def from_dict(cls, d: dict[str, Any]) -> "PodStatus":
         return cls(
@@ -265,6 +300,10 @@ class Pod:
     @property
     def key(self) -> str:
         return f"{self.metadata.namespace}/{self.metadata.name}"
+
+    def clone(self) -> "Pod":
+        return Pod(metadata=self.metadata.clone(), spec=self.spec.clone(),
+                   status=self.status.clone())
 
     @classmethod
     def from_dict(cls, d: dict[str, Any]) -> "Pod":
@@ -378,6 +417,19 @@ class Node:
     def key(self) -> str:
         return self.metadata.name
 
+    def clone(self) -> "Node":
+        return Node(
+            metadata=self.metadata.clone(),
+            spec=NodeSpec(unschedulable=self.spec.unschedulable,
+                          taints=[Taint(t.key, t.value, t.effect)
+                                  for t in self.spec.taints],
+                          provider_id=self.spec.provider_id),
+            status=NodeStatus(capacity=dict(self.status.capacity),
+                              allocatable=dict(self.status.allocatable),
+                              conditions=[NodeCondition(c.type, c.status)
+                                          for c in self.status.conditions]),
+        )
+
     @classmethod
     def from_dict(cls, d: dict[str, Any]) -> "Node":
         return cls(
@@ -410,6 +462,12 @@ class Event:
     source_component: str = ""
 
     kind = "Event"
+
+    def clone(self) -> "Event":
+        return Event(metadata=self.metadata.clone(),
+                     involved_object=dict(self.involved_object),
+                     reason=self.reason, message=self.message, type=self.type,
+                     count=self.count, source_component=self.source_component)
 
     @classmethod
     def from_dict(cls, d: dict[str, Any]) -> "Event":

@@ -5,7 +5,7 @@ The host-plane analog of the reference's storage stack: etcd3 revisions + CAS
 registry's CRUD semantics (registry/generic/registry/store.go:78), the watch
 cache ring buffer fanning one stream out to N subscribers
 (storage/cacher.go:141, watch_cache.go:93), and the pods/binding subresource
-(pkg/registry/core/pod/rest). Storage is a dict of deep-copied API objects; a
+(pkg/registry/core/pod/rest). Storage is a dict of cloned API objects; a
 single global monotonically increasing resourceVersion orders all writes, and
 watchers can resume from any version still inside the ring buffer — older
 versions raise Expired (HTTP 410 analog) which makes clients relist, exactly
@@ -18,7 +18,6 @@ atomic per loop tick), watch delivery is via asyncio.Queue.
 from __future__ import annotations
 
 import asyncio
-import copy
 import fnmatch
 import time
 from collections import deque
@@ -48,7 +47,7 @@ class Expired(ValueError):
 class WatchEvent:
     type: str          # ADDED | MODIFIED | DELETED
     kind: str
-    obj: Any           # deep copy of the API object
+    obj: Any           # stored instance — consumers must not mutate
     resource_version: int
 
 
@@ -86,17 +85,19 @@ class ObjectStore:
         bucket = self._bucket(kind)
         if key in bucket:
             raise AlreadyExists(f"{kind} {key} already exists")
-        stored = copy.deepcopy(obj)
+        stored = obj.clone()
         rv = self._next_rv()
         stored.metadata.resource_version = str(rv)
         stored.metadata.creation_timestamp = time.time()
         bucket[key] = stored
-        self._publish(WatchEvent("ADDED", kind, copy.deepcopy(stored), rv))
-        return copy.deepcopy(stored)
+        # watch consumers get the stored instance itself and MUST NOT mutate
+        # it (same contract as client-go informer caches)
+        self._publish(WatchEvent("ADDED", kind, stored, rv))
+        return stored.clone()
 
     def get(self, kind: str, name: str, namespace: str = "default") -> Any:
         try:
-            return copy.deepcopy(self._bucket(kind)[_key(namespace, name)])
+            return self._bucket(kind)[_key(namespace, name)].clone()
         except KeyError:
             raise NotFound(f"{kind} {namespace}/{name} not found") from None
 
@@ -112,13 +113,13 @@ class ObjectStore:
             raise Conflict(
                 f"{kind} {key}: version {obj.metadata.resource_version} != "
                 f"{current.metadata.resource_version}")
-        stored = copy.deepcopy(obj)
+        stored = obj.clone()
         rv = self._next_rv()
         stored.metadata.resource_version = str(rv)
         stored.metadata.creation_timestamp = current.metadata.creation_timestamp
         bucket[key] = stored
-        self._publish(WatchEvent("MODIFIED", kind, copy.deepcopy(stored), rv))
-        return copy.deepcopy(stored)
+        self._publish(WatchEvent("MODIFIED", kind, stored, rv))
+        return stored.clone()
 
     def guaranteed_update(self, kind: str, name: str, namespace: str,
                           mutate: Callable[[Any], Any], retries: int = 16) -> Any:
@@ -139,12 +140,15 @@ class ObjectStore:
         if obj is None:
             raise NotFound(f"{kind} {namespace}/{name} not found")
         rv = self._next_rv()
-        self._publish(WatchEvent("DELETED", kind, copy.deepcopy(obj), rv))
-        return obj
+        self._publish(WatchEvent("DELETED", kind, obj, rv))
+        return obj.clone()
 
     def list(self, kind: str, namespace: str | None = None,
              label_selector: dict[str, str] | None = None,
-             field_glob: str | None = None) -> list[Any]:
+             field_glob: str | None = None, *,
+             copy_objects: bool = True) -> list[Any]:
+        """copy_objects=False shares stored instances (read-only contract) —
+        used by informers, matching client-go cache semantics."""
         out = []
         for (ns, name), obj in self._bucket(kind).items():
             if namespace is not None and ns != namespace:
@@ -155,7 +159,7 @@ class ObjectStore:
                     continue
             if field_glob is not None and not fnmatch.fnmatch(name, field_glob):
                 continue
-            out.append(copy.deepcopy(obj))
+            out.append(obj.clone() if copy_objects else obj)
         return out
 
     # ---- pods/binding subresource ----

@@ -67,7 +67,7 @@ class Informer:
     async def _list_and_watch(self) -> None:
         rv = self.store.resource_version
         fresh = {(o.metadata.namespace, o.metadata.name): o
-                 for o in self.store.list(self.kind)}
+                 for o in self.store.list(self.kind, copy_objects=False)}
         # replay the delta between cache and fresh list as synthetic events
         for key, obj in fresh.items():
             old = self.cache.get(key)

@@ -1,4 +1,4 @@
-"""Vectorized scheduling predicates: masked boolean ops over the node axis.
+"""Vectorized scheduling predicates: masked ops over the node axis.
 
 Each kernel re-expresses one reference `FitPredicate(pod, meta, nodeInfo) ->
 bool` (signature at plugin/pkg/scheduler/algorithm/types.go:31) as a function
@@ -7,22 +7,30 @@ evaluation over P pods is `jax.vmap` — the TPU-native replacement for the
 `workqueue.Parallelize(16, len(nodes), checkNode)` goroutine fan-out
 (reference plugin/pkg/scheduler/core/generic_scheduler.go:204).
 
+The irregular string-matching predicates ride the MXU: selector terms, taints
+and host ports are interned into small universes (state/cluster_state.py), so
+matching is `one_hot_row @ membership_matrix.T` — under vmap, one (P x U) x
+(U x N) matmul per predicate. This replaces the reference's per-node Go map
+lookups (predicates.go:686,859,1241) and is what makes 15k-node clusters a
+single small device program.
+
 Covered predicates (reference algorithm/predicates/predicates.go):
 - PodFitsResources      (:556)  -> fits_resources
 - PodFitsHost           (:698)  -> fits_host
 - PodFitsHostPorts      (:859)  -> fits_host_ports
-- PodMatchNodeSelector  (:686)  -> match_node_selector  (plain nodeSelector;
+- PodMatchNodeSelector  (:686)  -> match_node_selector  (map-form nodeSelector;
                                    required node-affinity terms arrive with
                                    the affinity op set)
 - PodToleratesNodeTaints(:1241) -> tolerates_node_taints
-- CheckNodeMemoryPressure (:1274), CheckNodeDiskPressure (:1296),
-  CheckNodeCondition (:1306), unschedulable lister filter -> node_conditions_ok
+- CheckNodeCondition    (:1306), CheckNodeMemoryPressure (:1274),
+  CheckNodeDiskPressure (:1296) -> check_node_condition / check_*_pressure
+- unschedulable lister filter   -> node_schedulable (not policy-gated)
 
 Volume-topology predicates (NoDiskConflict, MaxPDVolumeCount, VolumeZone)
 live in the volume op set once volume state is modeled.
 
-All kernels are pure, jit-safe, and shard over the node axis unmodified: they
-contain only elementwise ops and reductions over static slot axes.
+All kernels are pure, jit-safe, and shard over the node axis: elementwise ops,
+reductions over static universe axes, and node-sharded matmuls.
 """
 
 from __future__ import annotations
@@ -82,49 +90,39 @@ def fits_host(state: ClusterState, pod: PodBatch) -> jnp.ndarray:
     return unset | match
 
 
-def fits_host_ports(state: ClusterState, pod: PodBatch, ports=None) -> jnp.ndarray:
+def fits_host_ports(state: ClusterState, pod: PodBatch, port_count=None) -> jnp.ndarray:
     """PodFitsHostPorts (predicates.go:859): no requested host port may be in
-    use. Port 0 / empty slots (-1) never conflict."""
-    node_ports = state.ports if ports is None else ports  # i32[N, Kn]
-    ok = jnp.ones(node_ports.shape[0], dtype=bool)
-    for kp in range(pod.ports.shape[0]):
-        want = pod.ports[kp]
-        conflict = ((node_ports == want) & (want > 0)).any(axis=-1)
-        ok &= ~conflict
-    return ok
+    use. One matvec: conflicts = port_count[N, UP] @ pod_onehot[UP]."""
+    counts = state.port_count if port_count is None else port_count
+    conflicts = counts @ pod.port_onehot
+    return conflicts == 0.0
 
 
 def match_node_selector(state: ClusterState, pod: PodBatch) -> jnp.ndarray:
     """PodMatchNodeSelector (predicates.go:686) for map-form nodeSelector:
-    every key=value term must appear in the node's labels."""
-    ok = jnp.ones(state.label_kv_lo.shape[0], dtype=bool)
-    for s in range(pod.sel_kv_lo.shape[0]):
-        lo, hi = pod.sel_kv_lo[s], pod.sel_kv_hi[s]
-        term_empty = lo == 0
-        has = ((state.label_kv_lo == lo) & (state.label_kv_hi == hi)).any(axis=-1)
-        ok &= term_empty | has
-    return ok
+    every required term must be satisfied. Satisfied-term count comes from
+    one matvec against the membership matrix."""
+    satisfied = state.sel_member @ pod.sel_onehot
+    return satisfied >= pod.sel_count
 
 
-def _tolerated(state: ClusterState, pod: PodBatch, t: int) -> jnp.ndarray:
-    """bool[N]: taint slot t of every node is tolerated by some toleration
+def _tolerated_universe(state: ClusterState, pod: PodBatch) -> jnp.ndarray:
+    """bool[UT]: universe taint u is tolerated by some toleration of the pod
     (v1 ToleratesTaint semantics, see api.objects.Toleration.tolerates):
     empty toleration key matches every taint key; Equal compares values only;
     Exists ignores values; empty toleration effect matches every effect."""
-    taint_key = state.taint_key[:, t]
-    taint_lo = state.taint_val_lo[:, t]
-    taint_hi = state.taint_val_hi[:, t]
-    taint_eff = state.taint_effect[:, t]
-    out = jnp.zeros(taint_key.shape[0], dtype=bool)
+    out = jnp.zeros(state.taint_u_key.shape[0], dtype=bool)
     for j in range(pod.tol_op.shape[0]):
         op = pod.tol_op[j]
         used = op != TolOp.NONE
-        eff_ok = (pod.tol_effect[j] == Effect.NONE) | (pod.tol_effect[j] == taint_eff)
-        key_ok = (pod.tol_key[j] == 0) | (pod.tol_key[j] == taint_key)
+        eff_ok = (pod.tol_effect[j] == Effect.NONE) | (
+            pod.tol_effect[j] == state.taint_u_effect)
+        key_ok = (pod.tol_key[j] == 0) | (pod.tol_key[j] == state.taint_u_key)
         value_ok = jnp.where(
             op == TolOp.EXISTS,
             True,
-            (pod.tol_val_lo[j] == taint_lo) & (pod.tol_val_hi[j] == taint_hi),
+            (pod.tol_val_lo[j] == state.taint_u_val_lo)
+            & (pod.tol_val_hi[j] == state.taint_u_val_hi),
         )
         out |= used & eff_ok & key_ok & value_ok
     return out
@@ -132,23 +130,18 @@ def _tolerated(state: ClusterState, pod: PodBatch, t: int) -> jnp.ndarray:
 
 def tolerates_node_taints(state: ClusterState, pod: PodBatch) -> jnp.ndarray:
     """PodToleratesNodeTaints (predicates.go:1241): every NoSchedule/NoExecute
-    taint must be tolerated (PreferNoSchedule is scoring-only)."""
-    ok = jnp.ones(state.taint_key.shape[0], dtype=bool)
-    for t in range(state.taint_key.shape[1]):
-        eff = state.taint_effect[:, t]
-        hard = (eff == Effect.NO_SCHEDULE) | (eff == Effect.NO_EXECUTE)
-        ok &= ~hard | _tolerated(state, pod, t)
-    return ok
+    taint must be tolerated (PreferNoSchedule is scoring-only). One matvec:
+    violations = hard_member[N, UT] @ untolerated[UT]."""
+    untolerated = 1.0 - _tolerated_universe(state, pod).astype(jnp.float32)
+    violations = state.taint_hard_member @ untolerated
+    return violations == 0.0
 
 
 def count_untolerated_prefer_taints(state: ClusterState, pod: PodBatch) -> jnp.ndarray:
-    """i32[N]: untolerated PreferNoSchedule taints per node — the map half of
+    """f32[N]: untolerated PreferNoSchedule taints per node — the map half of
     the TaintToleration priority (priorities/taint_toleration.go:29)."""
-    count = jnp.zeros(state.taint_key.shape[0], dtype=jnp.int32)
-    for t in range(state.taint_key.shape[1]):
-        prefer = state.taint_effect[:, t] == Effect.PREFER_NO_SCHEDULE
-        count += (prefer & ~_tolerated(state, pod, t)).astype(jnp.int32)
-    return count
+    untolerated = 1.0 - _tolerated_universe(state, pod).astype(jnp.float32)
+    return state.taint_prefer_member @ untolerated
 
 
 def node_schedulable(state: ClusterState, pod: PodBatch) -> jnp.ndarray:

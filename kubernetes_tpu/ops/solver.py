@@ -43,7 +43,7 @@ class SolverResult:
     feasible_counts: jnp.ndarray  # i32[P] nodes that passed all predicates
     new_requested: jnp.ndarray     # f32[N, R] ledger after the batch
     new_nonzero: jnp.ndarray       # f32[N, 2]
-    new_ports: jnp.ndarray         # i32[N, Kn]
+    new_port_count: jnp.ndarray    # f32[N, UP]
     rr_end: jnp.ndarray        # u32 round-robin counter after the batch
 
 
@@ -91,21 +91,6 @@ def _select_host(masked_score: jnp.ndarray, feasible: jnp.ndarray, rr: jnp.ndarr
     return node, best, ntie
 
 
-def _insert_ports(row: jnp.ndarray, pod_ports: jnp.ndarray, on: jnp.ndarray) -> jnp.ndarray:
-    """Insert each requested host port into the first empty (-1) slot.
-
-    A full port table drops the insert (conflict tracking degrades
-    conservatively for later pods); the host-side encode path raises
-    CapacityError before this can matter for realistic capacities.
-    """
-    for kp in range(pod_ports.shape[0]):
-        port = pod_ports[kp]
-        slot = jnp.argmax(row == -1)
-        free = row[slot] == -1
-        row = jnp.where(on & free & (port > 0), row.at[slot].set(port), row)
-    return row
-
-
 def schedule_batch(
     state: ClusterState,
     batch: PodBatch,
@@ -134,14 +119,15 @@ def schedule_batch(
 
     # ---- Phase B: scan over the pod axis, vector over nodes ----
     def step(carry, xs):
-        requested, nonzero, ports, rr = carry
+        requested, nonzero, port_count, rr = carry
         pod, s_mask, s_score, p_counts = xs
 
         feasible = s_mask
         if use_resources:
             feasible = feasible & preds.fits_resources(state, pod, requested=requested)
         if use_ports:
-            feasible = feasible & preds.fits_host_ports(state, pod, ports=ports)
+            feasible = feasible & preds.fits_host_ports(state, pod,
+                                                        port_count=port_count)
 
         score = s_score
         if w_lr:
@@ -156,21 +142,20 @@ def schedule_batch(
         assigned = (ntie > 0) & pod.valid
         node_idx = jnp.where(assigned, node, -1)
 
-        on = assigned
-        add = jnp.where(on, 1.0, 0.0)
+        add = jnp.where(assigned, 1.0, 0.0)
         requested = requested.at[node].add(add * pod.requests)
         nonzero = nonzero.at[node].add(add * pod.nonzero_requests)
         if use_ports:
-            ports = ports.at[node].set(_insert_ports(ports[node], pod.ports, on))
+            port_count = port_count.at[node].add(add * pod.port_onehot)
         rr = rr + jnp.where(assigned, jnp.uint32(1), jnp.uint32(0))
 
         out = (node_idx, jnp.where(assigned, best, 0.0),
                jnp.sum(feasible.astype(jnp.int32)))
-        return (requested, nonzero, ports, rr), out
+        return (requested, nonzero, port_count, rr), out
 
-    init = (state.requested, state.nonzero_requested, state.ports,
+    init = (state.requested, state.nonzero_requested, state.port_count,
             jnp.asarray(rr_start, jnp.uint32))
-    (requested, nonzero, ports, rr), (nodes, scores, counts) = jax.lax.scan(
+    (requested, nonzero, port_count, rr), (nodes, scores, counts) = jax.lax.scan(
         step, init, (batch, static_mask, static_score, prefer_counts))
 
     return SolverResult(
@@ -179,6 +164,6 @@ def schedule_batch(
         feasible_counts=counts,
         new_requested=requested,
         new_nonzero=nonzero,
-        new_ports=ports,
+        new_port_count=port_count,
         rr_end=rr,
     )

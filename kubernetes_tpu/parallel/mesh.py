@@ -41,11 +41,15 @@ def make_mesh(devices=None) -> Mesh:
 
 
 def state_sharding(mesh: Mesh) -> ClusterState:
-    """Pytree of NamedShardings: every cluster-state array shards dim 0 (the
-    node axis) across the mesh."""
-    spec = NamedSharding(mesh, P(NODE_AXIS))
-    return jax.tree.map(lambda _: spec, ClusterState(
-        **{f: 0 for f in ClusterState.__dataclass_fields__}))
+    """Pytree of NamedShardings: node-axis arrays shard dim 0 across the
+    mesh; cluster-global arrays (taint-universe attributes) replicate."""
+    from kubernetes_tpu.state.cluster_state import NODE_AXIS_FIELDS
+
+    sharded = NamedSharding(mesh, P(NODE_AXIS))
+    repl = NamedSharding(mesh, P())
+    return ClusterState(**{
+        f: sharded if f in NODE_AXIS_FIELDS else repl
+        for f in ClusterState.__dataclass_fields__})
 
 
 def batch_sharding(mesh: Mesh) -> PodBatch:
@@ -82,8 +86,8 @@ def make_sharded_scheduler(mesh: Mesh, policy: Policy = DEFAULT_POLICY):
     nodes_spec = NamedSharding(mesh, P(NODE_AXIS))
     out_shardings = SolverResult(
         assignments=repl, scores=repl, feasible_counts=repl,
-        new_requested=nodes_spec, new_nonzero=nodes_spec, new_ports=nodes_spec,
-        rr_end=repl,
+        new_requested=nodes_spec, new_nonzero=nodes_spec,
+        new_port_count=nodes_spec, rr_end=repl,
     )
     return jax.jit(
         lambda state, batch, rr: schedule_batch(state, batch, rr, policy),

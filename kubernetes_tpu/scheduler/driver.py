@@ -33,8 +33,9 @@ from kubernetes_tpu.client.workqueue import Backoff, BackoffQueue
 from kubernetes_tpu.models.policy import DEFAULT_POLICY, Policy
 from kubernetes_tpu.ops.solver import schedule_batch
 from kubernetes_tpu.state import Capacities
+from kubernetes_tpu.state.encode_cache import EncodeCache
 from kubernetes_tpu.state.layout import CapacityError
-from kubernetes_tpu.state.pod_batch import empty_batch, encode_pod_into
+from kubernetes_tpu.state.pod_batch import empty_batch
 from kubernetes_tpu.state.statedb import StateDB
 from kubernetes_tpu.utils.events import EventRecorder
 from kubernetes_tpu.utils.trace import StepTimer
@@ -86,6 +87,7 @@ class Scheduler:
         self.batch_wait = batch_wait
 
         self.statedb = StateDB(self.caps, mesh=mesh)
+        self.encode_cache = EncodeCache(self.caps, self.statedb.table)
         self.queue = BackoffQueue()
         self.backoff = Backoff(initial=0.05, max_duration=5.0)
         self.metrics = SchedulerMetrics()
@@ -188,7 +190,7 @@ class Scheduler:
                 self.queue.done(key)  # deleted or already bound: drop
                 continue
             try:
-                encode_pod_into(batch, len(pods), pod, self.caps)
+                self.encode_cache.encode_into(batch, len(pods), pod)
             except CapacityError as e:
                 # per-pod failure must not wedge the batch
                 # (MakeDefaultErrorFunc parity, factory.go:897)
@@ -254,7 +256,7 @@ class Scheduler:
         else:
             # clean batch: adopt the device ledger, no transfer either way
             self.statedb.commit_ledger(result.new_requested, result.new_nonzero,
-                                       result.new_ports, committed)
+                                       result.new_port_count, committed)
         self.metrics.scheduled += scheduled
         self.metrics.batches += 1
         if self.metrics.batches % 128 == 0:

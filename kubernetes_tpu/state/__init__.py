@@ -1,3 +1,11 @@
 from kubernetes_tpu.state.layout import Capacities, Resource  # noqa: F401
-from kubernetes_tpu.state.cluster_state import ClusterState, encode_nodes  # noqa: F401
-from kubernetes_tpu.state.pod_batch import PodBatch, encode_pods  # noqa: F401
+from kubernetes_tpu.state.cluster_state import (  # noqa: F401
+    ClusterState,
+    NodeTable,
+    encode_nodes,
+)
+from kubernetes_tpu.state.pod_batch import (  # noqa: F401
+    PodBatch,
+    encode_cluster,
+    encode_pods,
+)

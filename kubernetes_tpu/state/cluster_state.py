@@ -4,13 +4,24 @@ This is the TPU-native re-design of the scheduler cache's per-node `NodeInfo`
 aggregate (reference plugin/pkg/scheduler/schedulercache/node_info.go:34-74:
 pods, requested/allocatable Resource, usedPorts, taints, conditions,
 generation). Instead of N Go structs behind a mutex, the whole cluster is a
-handful of padded arrays with the node axis outermost, so predicates/priorities
-evaluate as masked vector ops over every node at once and the node axis shards
-across a device mesh.
+handful of padded arrays with the node axis outermost, so predicates and
+priorities evaluate as masked vector ops over every node at once and the node
+axis shards across a device mesh.
 
-Host-side bookkeeping (name->row mapping, topology-domain interning,
-generation counters for incremental scatter) lives in `NodeTable`; the arrays
-themselves are a pure pytree (`ClusterState`) safe to close over in jit.
+**Universe interning.** The irregular, string-keyed parts of matching
+(nodeSelector terms, taints, host ports) are interned into small global
+universes on the host: each distinct selector key=value term, each distinct
+(key, value, effect) taint, and each distinct host port gets an integer id.
+The device then carries *membership matrices* — `sel_member[n, u] = 1` iff
+node n's labels satisfy term u; `taint_*_member[n, u] = 1` iff node n carries
+universe taint u; `port_count[n, u]` = occurrences of port u on node n — and
+the (pods x nodes) matching in ops/predicates.py becomes one one-hot matmul
+on the MXU per predicate, replacing the reference's per-node string-matching
+loops (predicates.go:686,859,1241).
+
+Host-side bookkeeping (name->row mapping, universe interning, label/taint
+source data, generation counters) lives in `NodeTable`; the arrays themselves
+are a pure pytree (`ClusterState`) safe to pass through jit.
 """
 
 from __future__ import annotations
@@ -31,29 +42,38 @@ from kubernetes_tpu.state.layout import (
     MEM_UNIT,
     Resource,
 )
-from kubernetes_tpu.utils.hashing import hash32, hash_kv, hash_lanes
+from kubernetes_tpu.utils.hashing import hash32, hash_lanes
+
+# ClusterState fields whose dim 0 is the node axis (shard across the mesh);
+# everything else is cluster-global and replicated.
+NODE_AXIS_FIELDS = frozenset({
+    "valid", "allocatable", "requested", "nonzero_requested", "port_count",
+    "sel_member", "taint_hard_member", "taint_prefer_member", "conditions",
+    "name_lo", "name_hi", "topology",
+})
 
 
 @struct.dataclass
 class ClusterState:
-    """Pure pytree of padded device arrays; node axis is dim 0 everywhere."""
+    """Pure pytree of padded device arrays."""
 
-    valid: np.ndarray          # bool[N] — row holds a live node
-    allocatable: np.ndarray    # f32[N, R]
-    requested: np.ndarray      # f32[N, R] — sum of requests of assigned pods
-    nonzero_requested: np.ndarray  # f32[N, 2] — (cpu, mem) with per-pod defaults
-    ports: np.ndarray          # i32[N, PORT_SLOTS], -1 = empty
-    label_key: np.ndarray      # u32[N, L] hash32(key), 0 = empty
-    label_kv_lo: np.ndarray    # u32[N, L] lane of hash(key=value)
-    label_kv_hi: np.ndarray    # u32[N, L]
-    taint_key: np.ndarray      # u32[N, T], 0 = empty
-    taint_val_lo: np.ndarray   # u32[N, T] hash lanes of the taint *value*
-    taint_val_hi: np.ndarray   # u32[N, T]
-    taint_effect: np.ndarray   # i32[N, T], Effect codes
-    conditions: np.ndarray     # u32[N] Condition bitmask (0 == healthy)
-    name_lo: np.ndarray        # u32[N] node-name hash lanes
-    name_hi: np.ndarray        # u32[N]
-    topology: np.ndarray       # i32[N, TK] interned domain id, -1 = unknown
+    valid: np.ndarray             # bool[N] — row holds a live node
+    allocatable: np.ndarray       # f32[N, R]
+    requested: np.ndarray         # f32[N, R] — sum of requests of assigned pods
+    nonzero_requested: np.ndarray  # f32[N, 2] — (cpu, mem) with scoring defaults
+    port_count: np.ndarray        # f32[N, UP] — pods using interned port u
+    sel_member: np.ndarray        # f32[N, US] — node satisfies selector term u
+    taint_hard_member: np.ndarray    # f32[N, UT] — NoSchedule/NoExecute taints
+    taint_prefer_member: np.ndarray  # f32[N, UT] — PreferNoSchedule taints
+    # taint universe attributes (dim 0 = UT, replicated across the mesh)
+    taint_u_key: np.ndarray       # u32[UT] hash32(key), 0 = empty slot
+    taint_u_val_lo: np.ndarray    # u32[UT] value hash lanes
+    taint_u_val_hi: np.ndarray    # u32[UT]
+    taint_u_effect: np.ndarray    # i32[UT] Effect codes
+    conditions: np.ndarray        # u32[N] Condition bitmask (0 == healthy)
+    name_lo: np.ndarray           # u32[N] node-name hash lanes
+    name_hi: np.ndarray           # u32[N]
+    topology: np.ndarray          # i32[N, TK] interned domain id, -1 = unknown
 
     @property
     def num_nodes(self) -> int:
@@ -62,20 +82,19 @@ class ClusterState:
 
 def empty_state(caps: Capacities) -> ClusterState:
     n = caps.num_nodes
-    r = Resource.COUNT
     return ClusterState(
         valid=np.zeros((n,), np.bool_),
-        allocatable=np.zeros((n, r), np.float32),
-        requested=np.zeros((n, r), np.float32),
+        allocatable=np.zeros((n, Resource.COUNT), np.float32),
+        requested=np.zeros((n, Resource.COUNT), np.float32),
         nonzero_requested=np.zeros((n, 2), np.float32),
-        ports=np.full((n, caps.node_port_slots), -1, np.int32),
-        label_key=np.zeros((n, caps.label_slots), np.uint32),
-        label_kv_lo=np.zeros((n, caps.label_slots), np.uint32),
-        label_kv_hi=np.zeros((n, caps.label_slots), np.uint32),
-        taint_key=np.zeros((n, caps.taint_slots), np.uint32),
-        taint_val_lo=np.zeros((n, caps.taint_slots), np.uint32),
-        taint_val_hi=np.zeros((n, caps.taint_slots), np.uint32),
-        taint_effect=np.zeros((n, caps.taint_slots), np.int32),
+        port_count=np.zeros((n, caps.port_universe), np.float32),
+        sel_member=np.zeros((n, caps.selector_universe), np.float32),
+        taint_hard_member=np.zeros((n, caps.taint_universe), np.float32),
+        taint_prefer_member=np.zeros((n, caps.taint_universe), np.float32),
+        taint_u_key=np.zeros((caps.taint_universe,), np.uint32),
+        taint_u_val_lo=np.zeros((caps.taint_universe,), np.uint32),
+        taint_u_val_hi=np.zeros((caps.taint_universe,), np.uint32),
+        taint_u_effect=np.zeros((caps.taint_universe,), np.int32),
         conditions=np.zeros((n,), np.uint32),
         name_lo=np.zeros((n,), np.uint32),
         name_hi=np.zeros((n,), np.uint32),
@@ -127,9 +146,10 @@ def condition_mask(node: Node) -> int:
 
 
 class NodeTable:
-    """Host-side index over the device state: row assignment, free-list,
-    topology-domain interning, per-row generation (the analog of
-    NodeInfo.generation, node_info.go:60) for incremental device updates."""
+    """Host-side index over the device state: row assignment + free-list,
+    universe interning (selector terms, taints, ports), per-row source data
+    for membership refills, topology-domain interning, and per-row generation
+    counters (the NodeInfo.generation analog, node_info.go:60)."""
 
     def __init__(self, caps: Capacities):
         self.caps = caps
@@ -138,8 +158,18 @@ class NodeTable:
         self.free: list[int] = list(range(caps.num_nodes - 1, -1, -1))
         self.generation: np.ndarray = np.zeros((caps.num_nodes,), np.int64)
         self._gen_counter = 0
+        # universes
+        self.sel_terms: dict[tuple[str, str], int] = {}
+        self.taints: dict[tuple[str, str, str], int] = {}
+        self.ports: dict[int, int] = {}
+        # terms interned after nodes were encoded: columns awaiting refill
+        self.pending_sel_refresh: list[tuple[int, str, str]] = []
+        # per-row source data for refills on universe growth
+        self.labels_of: list[dict[str, str] | None] = [None] * caps.num_nodes
         # topology interning: per topology key, domain string -> id
         self.domains: list[dict[str, int]] = [dict() for _ in TOPOLOGY_KEYS]
+
+    # ---- rows ----
 
     def assign_row(self, name: str) -> int:
         row = self.row_of.get(name)
@@ -155,12 +185,57 @@ class NodeTable:
     def release_row(self, name: str) -> int:
         row = self.row_of.pop(name)
         self.name_of[row] = None
+        self.labels_of[row] = None
         self.free.append(row)
         return row
 
     def bump(self, row: int) -> None:
         self._gen_counter += 1
         self.generation[row] = self._gen_counter
+
+    # ---- universes ----
+
+    def intern_sel_term(self, key: str, value: str) -> int:
+        """Intern a selector term; newly seen terms are queued in
+        `pending_sel_refresh` for a membership-column refill
+        (apply_pending_refreshes)."""
+        term = (key, value)
+        tid = self.sel_terms.get(term)
+        if tid is not None:
+            return tid
+        if len(self.sel_terms) >= self.caps.selector_universe:
+            raise CapacityError(
+                f"selector universe {self.caps.selector_universe} exhausted "
+                f"interning {term!r}")
+        tid = len(self.sel_terms)
+        self.sel_terms[term] = tid
+        self.pending_sel_refresh.append((tid, key, value))
+        return tid
+
+    def intern_taint(self, taint) -> int:
+        key = (taint.key, taint.value, taint.effect)
+        tid = self.taints.get(key)
+        if tid is not None:
+            return tid
+        if len(self.taints) >= self.caps.taint_universe:
+            raise CapacityError(
+                f"taint universe {self.caps.taint_universe} exhausted "
+                f"interning {key!r}")
+        tid = len(self.taints)
+        self.taints[key] = tid
+        return tid
+
+    def intern_port(self, port: int) -> int:
+        pid = self.ports.get(port)
+        if pid is not None:
+            return pid
+        if len(self.ports) >= self.caps.port_universe:
+            raise CapacityError(
+                f"port universe {self.caps.port_universe} exhausted "
+                f"interning {port}")
+        pid = len(self.ports)
+        self.ports[port] = pid
+        return pid
 
     def intern_domain(self, key_idx: int, value: str) -> int:
         table = self.domains[key_idx]
@@ -170,42 +245,43 @@ class NodeTable:
             table[value] = did
         return did
 
+    def port_onehot(self, ports: Iterable[int]) -> np.ndarray:
+        out = np.zeros((self.caps.port_universe,), np.float32)
+        for port in ports:
+            out[self.intern_port(port)] += 1.0
+        return out
+
 
 def _fill_node_row(state: ClusterState, table: NodeTable, row: int, node: Node) -> None:
-    caps = table.caps
     state.valid[row] = True
     state.allocatable[row] = resource_rows(node.status.effective_allocatable())
     state.conditions[row] = condition_mask(node)
     lo, hi = hash_lanes(node.metadata.name)
     state.name_lo[row], state.name_hi[row] = lo, hi
 
-    labels = node.metadata.labels
-    if len(labels) > caps.label_slots:
-        raise CapacityError(
-            f"node {node.metadata.name!r}: {len(labels)} labels > {caps.label_slots} slots")
-    state.label_key[row] = 0
-    state.label_kv_lo[row] = 0
-    state.label_kv_hi[row] = 0
-    for i, (k, v) in enumerate(sorted(labels.items())):
-        state.label_key[row, i] = hash32(k)
-        kv_lo, kv_hi = hash_kv(k, v)
-        state.label_kv_lo[row, i] = kv_lo
-        state.label_kv_hi[row, i] = kv_hi
+    labels = dict(node.metadata.labels)
+    table.labels_of[row] = labels
+    # membership against every interned selector term
+    state.sel_member[row] = 0.0
+    for (k, v), tid in table.sel_terms.items():
+        if labels.get(k) == v:
+            state.sel_member[row, tid] = 1.0
 
-    taints = node.spec.taints
-    if len(taints) > caps.taint_slots:
-        raise CapacityError(
-            f"node {node.metadata.name!r}: {len(taints)} taints > {caps.taint_slots} slots")
-    state.taint_key[row] = 0
-    state.taint_val_lo[row] = 0
-    state.taint_val_hi[row] = 0
-    state.taint_effect[row] = Effect.NONE
-    for i, t in enumerate(taints):
-        state.taint_key[row, i] = hash32(t.key)
+    # taints: intern and set membership + universe attributes
+    state.taint_hard_member[row] = 0.0
+    state.taint_prefer_member[row] = 0.0
+    for t in node.spec.taints:
+        tid = table.intern_taint(t)
+        state.taint_u_key[tid] = hash32(t.key)
         val_lo, val_hi = hash_lanes(t.value)
-        state.taint_val_lo[row, i] = val_lo
-        state.taint_val_hi[row, i] = val_hi
-        state.taint_effect[row, i] = Effect.NAMES.get(t.effect, Effect.NONE)
+        state.taint_u_val_lo[tid] = val_lo
+        state.taint_u_val_hi[tid] = val_hi
+        effect = Effect.NAMES.get(t.effect, Effect.NONE)
+        state.taint_u_effect[tid] = effect
+        if effect in (Effect.NO_SCHEDULE, Effect.NO_EXECUTE):
+            state.taint_hard_member[row, tid] = 1.0
+        elif effect == Effect.PREFER_NO_SCHEDULE:
+            state.taint_prefer_member[row, tid] = 1.0
 
     state.topology[row] = -1
     for ki, key in enumerate(TOPOLOGY_KEYS):
@@ -216,12 +292,24 @@ def _fill_node_row(state: ClusterState, table: NodeTable, row: int, node: Node) 
             state.topology[row, ki] = table.intern_domain(ki, val)
 
 
+def apply_pending_refreshes(state: ClusterState, table: NodeTable) -> bool:
+    """Fill membership columns for selector terms interned after nodes were
+    encoded. Returns True if any column changed (device re-upload needed)."""
+    if not table.pending_sel_refresh:
+        return False
+    for term_id, key, value in table.pending_sel_refresh:
+        for row, labels in enumerate(table.labels_of):
+            if labels is not None and labels.get(key) == value:
+                state.sel_member[row, term_id] = 1.0
+    table.pending_sel_refresh.clear()
+    return True
+
+
 def pod_requests(pod: Pod) -> np.ndarray:
     """Sum of container requests in device units, +1 pod slot (reference
     GetResourceRequest, predicates.go; pods row mirrors the
     len(nodeInfo.Pods())+1 > allowedPodNumber check at predicates.go:561)."""
     out = np.zeros((Resource.COUNT,), np.float32)
-    out[Resource.PODS] = 1.0
     for c in pod.spec.containers:
         out += resource_rows(c.requests)
     out[Resource.PODS] = 1.0
@@ -245,28 +333,12 @@ def pod_nonzero_requests(pod: Pod) -> np.ndarray:
     return np.array([cpu, mem], np.float32)
 
 
-def insert_port(port_row: np.ndarray, port: int) -> None:
-    """Fill the first empty (-1) slot of a node's port row."""
-    empty = np.nonzero(port_row == -1)[0]
-    if empty.size == 0:
-        raise CapacityError(f"port slots ({port_row.shape[0]}) exhausted")
-    port_row[empty[0]] = port
-
-
-def remove_port(port_row: np.ndarray, port: int) -> None:
-    """Clear one occurrence of `port` from a node's port row."""
-    hit = np.nonzero(port_row == port)[0]
-    if hit.size:
-        port_row[hit[0]] = -1
-
-
 def add_pod_to_state(state: ClusterState, table: NodeTable, pod: Pod, row: int) -> None:
     """Account an assigned pod against a node row (the analog of
     NodeInfo.addPod, node_info.go:171)."""
     state.requested[row] += pod_requests(pod)
     state.nonzero_requested[row] += pod_nonzero_requests(pod)
-    for port in pod.host_ports():
-        insert_port(state.ports[row], port)
+    state.port_count[row] += table.port_onehot(pod.host_ports())
     table.bump(row)
 
 
@@ -274,11 +346,29 @@ def encode_nodes(
     nodes: Iterable[Node],
     caps: Capacities,
     assigned_pods: Sequence[Pod] = (),
+    table: NodeTable | None = None,
 ) -> tuple[ClusterState, NodeTable]:
     """Full (re-)encode: the List half of list+watch. Incremental updates go
-    through `statedb.StateDB` which scatters only changed rows."""
+    through `statedb.StateDB` which touches only changed rows/columns.
+
+    Pass an existing `table` to keep universe ids stable across re-encodes
+    (so previously encoded pod batches stay valid)."""
     state = empty_state(caps)
-    table = NodeTable(caps)
+    if table is not None:
+        # relist semantics: rows for departed nodes are released
+        node_list = list(nodes)
+        names = {n.metadata.name for n in node_list}
+        for gone in [n for n in table.row_of if n not in names]:
+            table.release_row(gone)
+        nodes = node_list
+    table = table or NodeTable(caps)
+    # re-materialize universe taint attributes when reusing a table
+    for (key, value, effect), tid in table.taints.items():
+        state.taint_u_key[tid] = hash32(key)
+        val_lo, val_hi = hash_lanes(value)
+        state.taint_u_val_lo[tid] = val_lo
+        state.taint_u_val_hi[tid] = val_hi
+        state.taint_u_effect[tid] = Effect.NAMES.get(effect, Effect.NONE)
     for node in nodes:
         row = table.assign_row(node.metadata.name)
         _fill_node_row(state, table, row, node)

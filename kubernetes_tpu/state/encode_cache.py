@@ -1,0 +1,69 @@
+"""Pod-encoding equivalence cache.
+
+The reference dedups predicate work across pods from the same controller via
+an equivalence-class hash (core/equivalence_cache.go:55: pods with identical
+scheduling-relevant specs share cached fit results). Here the expensive
+per-pod work is *encoding* (quantity parsing + FNV hashing of
+selectors/tolerations/ports); pods with identical scheduling-relevant specs
+share one encoded row, copied into the batch by array assignment.
+
+The fingerprint covers exactly the fields the encoder reads — requests,
+host ports, nodeSelector, tolerations, nodeName, QoS class. LRU-bounded.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+
+import numpy as np
+
+from kubernetes_tpu.api.objects import Pod
+from kubernetes_tpu.state.cluster_state import NodeTable
+from kubernetes_tpu.state.layout import Capacities
+from kubernetes_tpu.state.pod_batch import PodBatch, empty_batch, encode_pod_into
+
+# PodBatch fields with a per-pod row (everything in the pytree)
+_FIELDS = tuple(PodBatch.__dataclass_fields__)
+
+
+def pod_fingerprint(pod: Pod) -> tuple:
+    """Hashable equivalence class of the scheduling-relevant spec."""
+    spec = pod.spec
+    return (
+        tuple(
+            (tuple(sorted(c.requests.items())),
+             tuple(p.host_port for p in c.ports if p.host_port),
+             bool(c.requests or c.limits))
+            for c in spec.containers
+        ),
+        tuple(sorted(spec.node_selector.items())),
+        tuple((t.key, t.operator, t.value, t.effect) for t in spec.tolerations),
+        spec.node_name,
+    )
+
+
+class EncodeCache:
+    def __init__(self, caps: Capacities, table: NodeTable, max_entries: int = 4096):
+        self.caps = caps
+        self.table = table
+        self.max_entries = max_entries
+        self._rows: OrderedDict[tuple, tuple[np.ndarray, ...]] = OrderedDict()
+        self._scratch = empty_batch(caps)
+        self.hits = 0
+        self.misses = 0
+
+    def encode_into(self, batch: PodBatch, i: int, pod: Pod) -> None:
+        fp = pod_fingerprint(pod)
+        row = self._rows.get(fp)
+        if row is None:
+            self.misses += 1
+            encode_pod_into(self._scratch, 0, pod, self.caps, self.table)
+            row = tuple(np.copy(getattr(self._scratch, f)[0]) for f in _FIELDS)
+            self._rows[fp] = row
+            if len(self._rows) > self.max_entries:
+                self._rows.popitem(last=False)
+        else:
+            self.hits += 1
+            self._rows.move_to_end(fp)
+        for f, val in zip(_FIELDS, row):
+            getattr(batch, f)[i] = val

@@ -95,18 +95,22 @@ MAX_PRIORITY = 10  # schedulerapi.MaxPriority
 class Capacities:
     """Static padding capacities — the compile-time shape key.
 
-    Encoders raise `CapacityError` when an object exceeds a per-slot capacity;
-    pick capacities for the workload (defaults cover scheduler_perf-style
-    fixtures and typical clusters).
+    Encoders raise `CapacityError` when an object exceeds a capacity; pick
+    capacities for the workload (defaults cover scheduler_perf-style fixtures
+    and typical clusters).
+
+    The `*_universe` capacities size the interned matching universes: distinct
+    nodeSelector terms, distinct node taints, and distinct host ports get
+    small global integer ids, per-node membership matrices f32[N, U], and
+    per-pod one-hot rows — so selector/taint/port matching over (P x N) is a
+    single MXU matmul instead of slot-wise compare loops.
     """
 
     num_nodes: int = 1024          # N: node axis (pad to multiple of mesh size)
     batch_pods: int = 256          # P: pending pods per solver batch
-    label_slots: int = 24          # L: labels per node
-    taint_slots: int = 8           # T: taints per node
-    node_port_slots: int = 32      # host ports in use per node
-    pod_port_slots: int = 8        # host ports requested per pod
-    selector_slots: int = 12       # nodeSelector terms per pod
+    selector_universe: int = 128   # US: distinct nodeSelector key=value terms
+    taint_universe: int = 64       # UT: distinct (key, value, effect) taints
+    port_universe: int = 64        # UP: distinct host ports in use
     toleration_slots: int = 8      # tolerations per pod
     topology_slots: int = len(TOPOLOGY_KEYS)
     affinity_terms: int = 4        # pod (anti-)affinity terms per pod

@@ -4,6 +4,13 @@ The reference schedules one pod at a time (scheduler.go:253 scheduleOne); here
 a whole batch of pending pods is encoded as a padded (P, ...) pytree and
 scheduled in one device program. Padding rows have valid=False and are ignored
 by the solver.
+
+Selector terms and host ports are interned into the cluster's universes
+(cluster_state.NodeTable), producing one-hot rows that pair with the node
+membership matrices for MXU matching. Encoding a pod can therefore grow the
+universes — callers holding a device state must apply pending membership
+refreshes (cluster_state.apply_pending_refreshes / StateDB.flush) before
+scheduling the batch.
 """
 
 from __future__ import annotations
@@ -14,9 +21,15 @@ import numpy as np
 from flax import struct
 
 from kubernetes_tpu.api.objects import Pod
-from kubernetes_tpu.state.cluster_state import pod_nonzero_requests, pod_requests
+from kubernetes_tpu.state.cluster_state import (
+    ClusterState,
+    NodeTable,
+    apply_pending_refreshes,
+    pod_nonzero_requests,
+    pod_requests,
+)
 from kubernetes_tpu.state.layout import Capacities, CapacityError, Effect, Resource, TolOp
-from kubernetes_tpu.utils.hashing import hash32, hash_kv, hash_lanes
+from kubernetes_tpu.utils.hashing import hash32, hash_lanes
 
 
 @struct.dataclass
@@ -24,9 +37,9 @@ class PodBatch:
     valid: np.ndarray           # bool[P]
     requests: np.ndarray        # f32[P, R]
     nonzero_requests: np.ndarray  # f32[P, 2] (cpu, mem) scoring requests
-    ports: np.ndarray           # i32[P, Kp], -1 = empty
-    sel_kv_lo: np.ndarray       # u32[P, S] nodeSelector key=value hash lanes, 0 = empty
-    sel_kv_hi: np.ndarray       # u32[P, S]
+    port_onehot: np.ndarray     # f32[P, UP] — interned host-port counts
+    sel_onehot: np.ndarray      # f32[P, US] — required selector terms
+    sel_count: np.ndarray       # f32[P] — number of required terms
     tol_key: np.ndarray         # u32[P, T] hash32(key), 0 = empty key (matches all)
     tol_val_lo: np.ndarray      # u32[P, T] hash lanes of the toleration *value*
     tol_val_hi: np.ndarray      # u32[P, T]
@@ -47,9 +60,9 @@ def empty_batch(caps: Capacities) -> PodBatch:
         valid=np.zeros((p,), np.bool_),
         requests=np.zeros((p, Resource.COUNT), np.float32),
         nonzero_requests=np.zeros((p, 2), np.float32),
-        ports=np.full((p, caps.pod_port_slots), -1, np.int32),
-        sel_kv_lo=np.zeros((p, caps.selector_slots), np.uint32),
-        sel_kv_hi=np.zeros((p, caps.selector_slots), np.uint32),
+        port_onehot=np.zeros((p, caps.port_universe), np.float32),
+        sel_onehot=np.zeros((p, caps.selector_universe), np.float32),
+        sel_count=np.zeros((p,), np.float32),
         tol_key=np.zeros((p, caps.toleration_slots), np.uint32),
         tol_val_lo=np.zeros((p, caps.toleration_slots), np.uint32),
         tol_val_hi=np.zeros((p, caps.toleration_slots), np.uint32),
@@ -61,28 +74,18 @@ def empty_batch(caps: Capacities) -> PodBatch:
     )
 
 
-def encode_pod_into(batch: PodBatch, i: int, pod: Pod, caps: Capacities) -> None:
+def encode_pod_into(batch: PodBatch, i: int, pod: Pod, caps: Capacities,
+                    table: NodeTable) -> None:
     batch.valid[i] = True
     batch.requests[i] = pod_requests(pod)
     batch.nonzero_requests[i] = pod_nonzero_requests(pod)
+    batch.port_onehot[i] = table.port_onehot(pod.host_ports())
 
-    host_ports = pod.host_ports()
-    if len(host_ports) > caps.pod_port_slots:
-        raise CapacityError(f"pod {pod.key}: {len(host_ports)} host ports > "
-                            f"{caps.pod_port_slots} slots")
-    batch.ports[i] = -1
-    batch.ports[i, : len(host_ports)] = host_ports
-
+    batch.sel_onehot[i] = 0.0
     selector = pod.spec.node_selector
-    if len(selector) > caps.selector_slots:
-        raise CapacityError(f"pod {pod.key}: {len(selector)} selector terms > "
-                            f"{caps.selector_slots} slots")
-    batch.sel_kv_lo[i] = 0
-    batch.sel_kv_hi[i] = 0
-    for s, (k, v) in enumerate(sorted(selector.items())):
-        lo, hi = hash_kv(k, v)
-        batch.sel_kv_lo[i, s] = lo
-        batch.sel_kv_hi[i, s] = hi
+    for k, v in selector.items():
+        batch.sel_onehot[i, table.intern_sel_term(k, v)] = 1.0
+    batch.sel_count[i] = float(len(selector))
 
     tols = pod.spec.tolerations
     if len(tols) > caps.toleration_slots:
@@ -111,10 +114,27 @@ def encode_pod_into(batch: PodBatch, i: int, pod: Pod, caps: Capacities) -> None
     batch.best_effort[i] = pod.is_best_effort()
 
 
-def encode_pods(pods: Sequence[Pod], caps: Capacities) -> PodBatch:
+def encode_pods(pods: Sequence[Pod], caps: Capacities, table: NodeTable,
+                state: ClusterState | None = None) -> PodBatch:
+    """Encode a batch against the cluster's universes. When `state` is given,
+    membership columns for newly interned terms are refilled in place."""
     if len(pods) > caps.batch_pods:
         raise CapacityError(f"{len(pods)} pods > batch capacity {caps.batch_pods}")
     batch = empty_batch(caps)
     for i, pod in enumerate(pods):
-        encode_pod_into(batch, i, pod, caps)
+        encode_pod_into(batch, i, pod, caps, table)
+    if state is not None:
+        apply_pending_refreshes(state, table)
     return batch
+
+
+def encode_cluster(nodes, pods, caps: Capacities):
+    """One-shot fixture encoding: nodes + pending pods with a shared
+    universe, membership fully consistent. Returns (state, batch, table)."""
+    from kubernetes_tpu.state.cluster_state import encode_nodes
+
+    table = NodeTable(caps)
+    batch = encode_pods(pods, caps, table)
+    state, _ = encode_nodes(nodes, caps, table=table)
+    apply_pending_refreshes(state, table)
+    return state, batch, table

@@ -33,11 +33,10 @@ from kubernetes_tpu.state.cluster_state import (
     ClusterState,
     NodeTable,
     _fill_node_row,
+    apply_pending_refreshes,
     empty_state,
-    insert_port,
     pod_nonzero_requests,
     pod_requests,
-    remove_port,
 )
 from kubernetes_tpu.state.layout import Capacities
 
@@ -48,8 +47,8 @@ class StateDB:
         self.mesh = mesh
         self.host: ClusterState = empty_state(caps)
         self.table = NodeTable(caps)
-        # pod key -> (node_name, requests, nonzero, ports) for exact removal
-        self._accounted: dict[str, tuple[str, np.ndarray, np.ndarray, list[int]]] = {}
+        # pod key -> (node_name, requests, nonzero, port_onehot) for removal
+        self._accounted: dict[str, tuple[str, np.ndarray, np.ndarray, np.ndarray]] = {}
         self._dirty_nodes = True   # static node fields changed
         self._dirty_ledger = True  # requested/nonzero/ports changed on host
         self._device: ClusterState | None = None
@@ -68,9 +67,10 @@ class StateDB:
         row = self.table.release_row(name)
         for key in [k for k, v in self._accounted.items() if v[0] == name]:
             del self._accounted[key]
-        for field in self.host.__dataclass_fields__:
+        from kubernetes_tpu.state.cluster_state import NODE_AXIS_FIELDS
+        for field in NODE_AXIS_FIELDS:
             arr = getattr(self.host, field)
-            arr[row] = -1 if field in ("ports", "topology") else 0
+            arr[row] = -1 if field == "topology" else 0
         self._dirty_nodes = True
         self._dirty_ledger = True
 
@@ -79,14 +79,10 @@ class StateDB:
 
     # ---- pod accounting (bound + assumed) ----
 
-    def _apply_pod(self, row: int, req, nz, ports: list[int], sign: int) -> None:
+    def _apply_pod(self, row: int, req, nz, port_onehot: np.ndarray, sign: int) -> None:
         self.host.requested[row] += sign * req
         self.host.nonzero_requested[row] += sign * nz
-        for port in ports:
-            if sign > 0:
-                insert_port(self.host.ports[row], port)
-            else:
-                remove_port(self.host.ports[row], port)
+        self.host.port_count[row] += sign * port_onehot
         self.table.bump(row)
 
     def add_pod(self, pod: Pod, node_name: str | None = None, *,
@@ -105,9 +101,9 @@ class StateDB:
             return True  # already accounted (assume then confirm)
         req = pod_requests(pod)
         nz = pod_nonzero_requests(pod)
-        ports = pod.host_ports()
-        self._apply_pod(row, req, nz, ports, +1)
-        self._accounted[pod.key] = (node_name, req, nz, ports)
+        onehot = self.table.port_onehot(pod.host_ports())
+        self._apply_pod(row, req, nz, onehot, +1)
+        self._accounted[pod.key] = (node_name, req, nz, onehot)
         if not mirror_only:
             self._dirty_ledger = True
         return True
@@ -116,11 +112,11 @@ class StateDB:
         entry = self._accounted.pop(pod_key, None)
         if entry is None:
             return
-        node_name, req, nz, ports = entry
+        node_name, req, nz, onehot = entry
         row = self.table.row_of.get(node_name)
         if row is None:
             return  # node vanished; its rows were zeroed already
-        self._apply_pod(row, req, nz, ports, -1)
+        self._apply_pod(row, req, nz, onehot, -1)
         self._dirty_ledger = True
 
     def is_accounted(self, pod_key: str) -> bool:
@@ -135,15 +131,22 @@ class StateDB:
     # ---- device mirror ----
 
     def flush(self) -> ClusterState:
-        """Return the device view, re-uploading only what changed."""
+        """Return the device view, re-uploading only what changed. Newly
+        interned selector terms (from pod encoding) refill their membership
+        columns first."""
+        dirty_sel = apply_pending_refreshes(self.host, self.table)
         if self._device is None or self._dirty_nodes:
             dev = self._put(self.host)
-        elif self._dirty_ledger:
-            dev = self._device.replace(
-                requested=self._put_arr(self.host.requested),
-                nonzero_requested=self._put_arr(self.host.nonzero_requested),
-                ports=self._put_arr(self.host.ports),
-            )
+        elif self._dirty_ledger or dirty_sel:
+            dev = self._device
+            if self._dirty_ledger:
+                dev = dev.replace(
+                    requested=self._put_arr(self.host.requested),
+                    nonzero_requested=self._put_arr(self.host.nonzero_requested),
+                    port_count=self._put_arr(self.host.port_count),
+                )
+            if dirty_sel:
+                dev = dev.replace(sel_member=self._put_arr(self.host.sel_member))
         else:
             return self._device
         self._device = dev
@@ -151,7 +154,7 @@ class StateDB:
         self._dirty_ledger = False
         return dev
 
-    def commit_ledger(self, new_requested, new_nonzero, new_ports,
+    def commit_ledger(self, new_requested, new_nonzero, new_port_count,
                       assignments: list[tuple[Pod, str]]) -> None:
         """Adopt the solver's output ledger as the device truth and mirror
         the same assignments into host numpy (no transfer either way)."""
@@ -159,7 +162,7 @@ class StateDB:
             raise RuntimeError("commit_ledger before flush")
         self._device = self._device.replace(
             requested=new_requested, nonzero_requested=new_nonzero,
-            ports=new_ports)
+            port_count=new_port_count)
         for pod, node_name in assignments:
             self.add_pod(pod, node_name, mirror_only=True)
 

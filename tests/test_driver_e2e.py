@@ -142,9 +142,10 @@ def test_oversized_pod_fails_without_wedging_batch():
         await sched.start()
         monster = Pod.from_dict({
             "metadata": {"name": "monster"},
-            "spec": {"containers": [{"name": "c", "ports": [
-                {"containerPort": 80 + i, "hostPort": 8000 + i}
-                for i in range(CAPS.pod_port_slots + 1)]}]}})
+            "spec": {"containers": [{"name": "c"}],
+                     "tolerations": [
+                         {"key": f"k{i}", "operator": "Exists"}
+                         for i in range(CAPS.toleration_slots + 1)]}})
         store.create(monster)
         store.create(make_pods(1)[0])
         await asyncio.sleep(0)

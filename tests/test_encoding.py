@@ -1,11 +1,19 @@
 """Node/pod tensor encoding tests (analog of schedulercache NodeInfo tests,
-reference plugin/pkg/scheduler/schedulercache/node_info.go semantics)."""
+reference plugin/pkg/scheduler/schedulercache/node_info.go semantics) under
+the universe-interned membership layout."""
 
 import numpy as np
 import pytest
 
 from kubernetes_tpu.api.objects import Node, Pod
-from kubernetes_tpu.state import Capacities, Resource, encode_nodes, encode_pods
+from kubernetes_tpu.state import (
+    Capacities,
+    NodeTable,
+    Resource,
+    encode_cluster,
+    encode_nodes,
+    encode_pods,
+)
 from kubernetes_tpu.state.cluster_state import pod_nonzero_requests, pod_requests
 from kubernetes_tpu.state.layout import (
     CapacityError,
@@ -69,18 +77,32 @@ def test_assigned_pods_accumulate():
     assert state.requested[row, Resource.PODS] == 2
 
 
-def test_taints_and_conditions():
+def test_taint_universe_and_membership():
     node = mk_node(
         "n0",
-        taints=[{"key": "gpu", "value": "true", "effect": "NoSchedule"}],
+        taints=[{"key": "gpu", "value": "true", "effect": "NoSchedule"},
+                {"key": "soft", "value": "x", "effect": "PreferNoSchedule"}],
+    )
+    state, table = encode_nodes([node, mk_node("n1")], CAPS)
+    row = table.row_of["n0"]
+    hard_id = table.taints[("gpu", "true", "NoSchedule")]
+    prefer_id = table.taints[("soft", "x", "PreferNoSchedule")]
+    assert state.taint_hard_member[row, hard_id] == 1.0
+    assert state.taint_prefer_member[row, prefer_id] == 1.0
+    assert state.taint_hard_member[table.row_of["n1"]].sum() == 0
+    assert state.taint_u_effect[hard_id] == Effect.NO_SCHEDULE
+    assert state.taint_u_key[hard_id] != 0
+
+
+def test_conditions_bits():
+    node = mk_node(
+        "n0",
         conditions=[{"type": "Ready", "status": "True"},
                     {"type": "MemoryPressure", "status": "True"}],
         unschedulable=True,
     )
     state, table = encode_nodes([node], CAPS)
     row = table.row_of["n0"]
-    assert state.taint_effect[row, 0] == Effect.NO_SCHEDULE
-    assert state.taint_key[row, 0] != 0
     assert state.conditions[row] & Condition.MEMORY_PRESSURE
     assert state.conditions[row] & Condition.UNSCHEDULABLE
     assert not state.conditions[row] & Condition.NOT_READY
@@ -97,12 +119,42 @@ def test_topology_interning():
     assert len(hosts) == 4
 
 
-def test_pod_batch_selector_and_tolerations():
-    pod = mk_pod("p", nodeSelector={"disk": "ssd"},
-                 tolerations=[{"key": "gpu", "operator": "Exists", "effect": "NoSchedule"}])
-    batch = encode_pods([pod], CAPS)
+def test_selector_membership_consistency_any_order():
+    # pods encoded before nodes (encode_cluster) and after nodes (pending
+    # refresh) must both yield correct membership
+    node = mk_node("n0", labels={"disk": "ssd"})
+    pod = mk_pod("p", nodeSelector={"disk": "ssd"})
+
+    state, batch, table = encode_cluster([node], [pod], CAPS)
+    tid = table.sel_terms[("disk", "ssd")]
+    assert state.sel_member[table.row_of["n0"], tid] == 1.0
+    assert batch.sel_onehot[0, tid] == 1.0
+    assert batch.sel_count[0] == 1.0
+
+    # reverse order: nodes first, then pods + explicit refresh via state arg
+    table2 = NodeTable(CAPS)
+    state2, _ = encode_nodes([node], CAPS, table=table2)
+    batch2 = encode_pods([pod], CAPS, table2, state=state2)
+    tid2 = table2.sel_terms[("disk", "ssd")]
+    assert state2.sel_member[table2.row_of["n0"], tid2] == 1.0
+    assert batch2.sel_onehot[0, tid2] == 1.0
+
+
+def test_port_universe():
+    pod = Pod.from_dict({"metadata": {"name": "p"}, "spec": {"containers": [
+        {"name": "c", "ports": [{"containerPort": 80, "hostPort": 8080},
+                                {"containerPort": 81, "hostPort": 9090}]}]}})
+    state, batch, table = encode_cluster([mk_node("n0")], [pod], CAPS)
+    assert batch.port_onehot[0, table.ports[8080]] == 1.0
+    assert batch.port_onehot[0, table.ports[9090]] == 1.0
+    assert batch.port_onehot[0].sum() == 2.0
+
+
+def test_toleration_encoding():
+    pod = mk_pod("p", tolerations=[{"key": "gpu", "operator": "Exists",
+                                    "effect": "NoSchedule"}])
+    _, batch, _ = encode_cluster([mk_node("n0")], [pod], CAPS)
     assert batch.valid[0] and not batch.valid[1]
-    assert batch.sel_kv_lo[0, 0] != 0 and batch.sel_kv_lo[0, 1] == 0
     assert batch.tol_op[0, 0] == 2  # Exists
     assert batch.tol_effect[0, 0] == Effect.NO_SCHEDULE
 
@@ -110,8 +162,15 @@ def test_pod_batch_selector_and_tolerations():
 def test_capacity_errors():
     with pytest.raises(CapacityError):
         encode_nodes([mk_node(f"n{i}") for i in range(CAPS.num_nodes + 1)], CAPS)
+    table = NodeTable(CAPS)
     with pytest.raises(CapacityError):
-        encode_pods([mk_pod(f"p{i}") for i in range(CAPS.batch_pods + 1)], CAPS)
+        encode_pods([mk_pod(f"p{i}") for i in range(CAPS.batch_pods + 1)], CAPS, table)
+    with pytest.raises(CapacityError):
+        # selector universe exhaustion
+        encode_pods(
+            [mk_pod("p", nodeSelector={f"k{i}": "v"
+                                       for i in range(CAPS.selector_universe + 1)})],
+            CAPS, table)
 
 
 def test_row_reuse_after_release():
@@ -119,3 +178,13 @@ def test_row_reuse_after_release():
     row = table.row_of["n1"]
     table.release_row("n1")
     assert table.assign_row("n2") == row
+
+
+def test_encode_nodes_with_reused_table_keeps_taint_universe():
+    node = mk_node("n0", taints=[{"key": "k", "value": "v", "effect": "NoSchedule"}])
+    state, table = encode_nodes([node], CAPS)
+    tid = table.taints[("k", "v", "NoSchedule")]
+    # re-encode with the same table (e.g. relist): universe ids stable
+    state2, _ = encode_nodes([node], CAPS, table=table)
+    assert state2.taint_u_key[tid] == state.taint_u_key[tid]
+    assert state2.taint_hard_member[table.row_of["n0"], tid] == 1.0

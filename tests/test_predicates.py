@@ -7,7 +7,7 @@ import pytest
 
 from kubernetes_tpu.api.objects import Node, Pod
 from kubernetes_tpu.ops import predicates as preds
-from kubernetes_tpu.state import Capacities, encode_nodes, encode_pods
+from kubernetes_tpu.state import Capacities, encode_cluster
 
 CAPS = Capacities(num_nodes=8, batch_pods=4)
 
@@ -40,8 +40,12 @@ def mk_pod(name="p", requests=None, **spec):
 
 
 def run(pred, nodes, pod, assigned=()):
-    state, table = encode_nodes(nodes, CAPS, assigned_pods=assigned)
-    batch = encode_pods([pod], CAPS)
+    from kubernetes_tpu.state.cluster_state import add_pod_to_state
+    state, batch, table = encode_cluster(nodes, [pod], CAPS)
+    for ap in assigned:
+        arow = table.row_of.get(ap.spec.node_name)
+        if arow is not None:
+            add_pod_to_state(state, table, ap, arow)
     out = np.asarray(pred(state, row(batch)))
     return {n.metadata.name: bool(out[table.row_of[n.metadata.name]]) for n in nodes}
 
@@ -244,8 +248,9 @@ class TestConditions:
 
 
 def test_vmap_over_batch():
-    state, table = encode_nodes([mk_node(), mk_node("n1", unschedulable=True)], CAPS)
-    batch = encode_pods([mk_pod("a"), mk_pod("b", nodeName="n1")], CAPS)
+    state, batch, table = encode_cluster(
+        [mk_node(), mk_node("n1", unschedulable=True)],
+        [mk_pod("a"), mk_pod("b", nodeName="n1")], CAPS)
     mask = np.asarray(jax.vmap(lambda p: preds.static_feasibility(state, p))(batch))
     assert mask[0, table.row_of["n0"]]
     assert not mask[0, table.row_of["n1"]]          # unschedulable

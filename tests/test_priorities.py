@@ -6,7 +6,7 @@ import numpy as np
 
 from kubernetes_tpu.api.objects import Node, Pod
 from kubernetes_tpu.ops import priorities as prios
-from kubernetes_tpu.state import Capacities, encode_nodes, encode_pods
+from kubernetes_tpu.state import Capacities, encode_cluster
 
 CAPS = Capacities(num_nodes=8, batch_pods=4)
 
@@ -39,8 +39,13 @@ def mk_pod(name="p", cpu=None, mem=None, tolerations=None):
 
 
 def scores(fn, nodes, pod, assigned=()):
-    state, table = encode_nodes(nodes, CAPS, assigned_pods=assigned)
-    out = np.asarray(fn(state, row(encode_pods([pod], CAPS))))
+    from kubernetes_tpu.state.cluster_state import add_pod_to_state
+    state, batch, table = encode_cluster(nodes, [pod], CAPS)
+    for ap in assigned:
+        arow = table.row_of.get(ap.spec.node_name)
+        if arow is not None:
+            add_pod_to_state(state, table, ap, arow)
+    out = np.asarray(fn(state, row(batch)))
     return {n.metadata.name: float(out[table.row_of[n.metadata.name]])
             for n in nodes}
 

@@ -15,7 +15,7 @@ from kubernetes_tpu.parallel import (
     shard_state,
 )
 from kubernetes_tpu.perf.fixtures import make_nodes, make_pods
-from kubernetes_tpu.state import Capacities, encode_nodes, encode_pods
+from kubernetes_tpu.state import Capacities, encode_cluster, encode_nodes
 
 CAPS = Capacities(num_nodes=64, batch_pods=32)
 
@@ -23,9 +23,7 @@ CAPS = Capacities(num_nodes=64, batch_pods=32)
 def fixtures():
     nodes = make_nodes(50, zones=3, labels_per_node=2, taint_every=10)
     pods = make_pods(30, selector_every=5, tolerate=False)
-    state, table = encode_nodes(nodes, CAPS)
-    batch = encode_pods(pods, CAPS)
-    return state, batch, table
+    return encode_cluster(nodes, pods, CAPS)
 
 
 def test_mesh_uses_all_devices():
@@ -61,7 +59,6 @@ def test_ledger_stays_sharded():
 
 
 def test_indivisible_node_count_rejected():
-    state, _, _ = fixtures()
     bad = Capacities(num_nodes=60, batch_pods=32)
     s, _ = encode_nodes(make_nodes(10), bad)
     with pytest.raises(ValueError, match="divisible"):
@@ -75,7 +72,7 @@ def test_chained_batches_on_mesh():
     r1 = fn(shard_state(state, mesh), shard_batch(batch, mesh), np.uint32(0))
     state2 = state.replace(requested=r1.new_requested,
                            nonzero_requested=r1.new_nonzero,
-                           ports=r1.new_ports)
+                           port_count=r1.new_port_count)
     # state2 mixes host arrays and sharded outputs; device_put re-lays it out
     r2 = fn(shard_state(state2, mesh), shard_batch(batch, mesh), r1.rr_end)
     a1 = np.asarray(r1.assignments)[:30]

@@ -9,7 +9,7 @@ import pytest
 from kubernetes_tpu.api.objects import Node, Pod
 from kubernetes_tpu.models.policy import DEFAULT_POLICY
 from kubernetes_tpu.ops.solver import schedule_batch
-from kubernetes_tpu.state import Capacities, Resource, encode_nodes, encode_pods
+from kubernetes_tpu.state import Capacities, Resource, encode_cluster
 from tests.serial_reference import SerialScheduler
 
 jit_schedule = jax.jit(schedule_batch, static_argnames=("policy",))
@@ -38,9 +38,13 @@ def mk_pod(name, cpu=None, mem=None, **spec):
 
 
 def solve(nodes, pods, caps=None, assigned=()):
+    from kubernetes_tpu.state.cluster_state import add_pod_to_state
     caps = caps or Capacities(num_nodes=16, batch_pods=16)
-    state, table = encode_nodes(nodes, caps, assigned_pods=assigned)
-    batch = encode_pods(pods, caps)
+    state, batch, table = encode_cluster(nodes, pods, caps)
+    for ap in assigned:
+        arow = table.row_of.get(ap.spec.node_name)
+        if arow is not None:
+            add_pod_to_state(state, table, ap, arow)
     result = jit_schedule(state, batch, 0, DEFAULT_POLICY)
     names = []
     for i in range(len(pods)):
@@ -111,8 +115,8 @@ def test_unschedulable_filter_is_not_policy_gated():
     caps = Capacities(num_nodes=16, batch_pods=16)
     cordoned = mk_node("a")
     cordoned.spec.unschedulable = True
-    state, table = encode_nodes([cordoned, mk_node("b")], caps)
-    batch = encode_pods([mk_pod("p", cpu="1")], caps)
+    state, batch, table = encode_cluster([cordoned, mk_node("b")],
+                                         [mk_pod("p", cpu="1")], caps)
     pol = Policy(predicates=("GeneralPredicates",),
                  priorities=(("LeastRequestedPriority", 1),))
     result = jit_schedule(state, batch, 0, pol)

@@ -32,10 +32,10 @@ def test_pod_accounting_roundtrip():
     row = db.table.row_of["n0"]
     assert db.add_pod(mk_pod("a", node="n0", port=8080))
     assert db.host.requested[row, Resource.CPU] == 500
-    assert 8080 in db.host.ports[row]
+    assert db.host.port_count[row, db.table.ports[8080]] == 1.0
     db.remove_pod("default/a")
     assert db.host.requested[row, Resource.CPU] == 0
-    assert 8080 not in db.host.ports[row]
+    assert db.host.port_count[row].sum() == 0
 
 
 def test_unknown_node_pod_skipped():
@@ -88,7 +88,7 @@ def test_flush_caches_until_dirty():
     row = db.table.row_of["n0"]
     assert float(np.asarray(dev3.requested)[row, Resource.CPU]) == 500
     # ledger-only flush reuses static arrays
-    assert dev3.label_key is dev2.label_key
+    assert dev3.sel_member is dev2.sel_member
 
 
 def test_commit_ledger_keeps_host_and_device_equal():
@@ -101,8 +101,8 @@ def test_commit_ledger_keeps_host_and_device_equal():
     new_req[row, Resource.CPU] += 500
     new_req[row, Resource.PODS] += 1
     import jax
-    db.commit_ledger(jax.device_put(new_req), dev.nonzero_requested, dev.ports,
-                     [(pod, "n0")])
+    db.commit_ledger(jax.device_put(new_req), dev.nonzero_requested,
+                     dev.port_count, [(pod, "n0")])
     assert db.host.requested[row, Resource.CPU] == 500
     dev2 = db.flush()  # must NOT re-upload: ledger is already device truth
     np.testing.assert_allclose(np.asarray(dev2.requested), new_req)
