@@ -1,0 +1,65 @@
+#!/usr/bin/env python
+"""Headline benchmark: sustained pods scheduled/sec at 5k nodes.
+
+Config mirrors BASELINE.json's "NodeResourcesFit LeastAllocated scoring,
+5k nodes / 10k pending pods" scheduler_perf config, run end-to-end through
+the full framework (in-memory apiserver -> informers -> encode -> batched
+device solve -> bind -> watch confirmation).
+
+Baseline: the reference kube-scheduler's enforced scheduler_perf threshold is
+30 pods/s at >=1000 fake nodes (hard test failure below it;
+test/integration/scheduler_perf/scheduler_test.go:35-38 and BASELINE.md).
+vs_baseline = value / 30.
+
+Prints exactly ONE JSON line on stdout. Diagnostics go to stderr.
+Env overrides: BENCH_NODES, BENCH_PODS, BENCH_TIMEOUT_S.
+"""
+
+import faulthandler
+import json
+import os
+import signal
+import sys
+
+
+def _die_with_timeout(signum, frame):
+    faulthandler.dump_traceback(file=sys.stderr)
+    print(json.dumps({
+        "metric": "pods_scheduled_per_sec_5k_nodes",
+        "value": 0.0,
+        "unit": "pods/s",
+        "vs_baseline": 0.0,
+        "error": "benchmark timed out (device unavailable?)",
+    }), flush=True)
+    os._exit(2)
+
+
+def main() -> None:
+    timeout = int(os.environ.get("BENCH_TIMEOUT_S", "1800"))
+    signal.signal(signal.SIGALRM, _die_with_timeout)
+    signal.alarm(timeout)
+
+    n_nodes = int(os.environ.get("BENCH_NODES", "5000"))
+    n_pods = int(os.environ.get("BENCH_PODS", "10000"))
+
+    import jax
+
+    from kubernetes_tpu.perf.harness import run_throughput
+
+    print(f"bench: devices={jax.devices()} nodes={n_nodes} pods={n_pods}",
+          file=sys.stderr, flush=True)
+
+    result = run_throughput(n_nodes, n_pods, node_kwargs={"zones": 3})
+    print(f"bench: {result} | {result.metrics}", file=sys.stderr, flush=True)
+
+    baseline = 30.0  # reference hard-fail floor at >=1000-node configs
+    print(json.dumps({
+        "metric": "pods_scheduled_per_sec_5k_nodes",
+        "value": round(result.pods_per_sec, 1),
+        "unit": "pods/s",
+        "vs_baseline": round(result.pods_per_sec / baseline, 2),
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

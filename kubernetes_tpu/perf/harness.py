@@ -1,0 +1,105 @@
+"""Throughput harness — the scheduler_perf equivalent.
+
+Mirrors the reference's integration benchmark
+(test/integration/scheduler_perf/scheduler_test.go:71-100
+schedulePods: spin up an in-process control plane, pre-create fake nodes,
+pump templated pods in, and measure sustained pods scheduled/sec; hard-fail
+thresholds at :35-38). Here the control plane is the in-memory store +
+informers and the scheduler is the batched device solver; the measured
+number is end-to-end (encode + device solve + bind + watch confirmation).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from dataclasses import dataclass
+
+from kubernetes_tpu.apiserver import ObjectStore
+from kubernetes_tpu.models.policy import DEFAULT_POLICY, Policy
+from kubernetes_tpu.perf.fixtures import make_nodes, make_pods
+from kubernetes_tpu.scheduler import Scheduler
+from kubernetes_tpu.state import Capacities
+
+
+@dataclass
+class ThroughputResult:
+    scheduled: int
+    seconds: float
+    pods_per_sec: float
+    batches: int
+    metrics: dict
+
+    def __str__(self) -> str:
+        return (f"{self.scheduled} pods in {self.seconds:.2f}s = "
+                f"{self.pods_per_sec:.0f} pods/s over {self.batches} batches")
+
+
+async def _run(n_nodes: int, n_pods: int, caps: Capacities, policy: Policy,
+               warmup_pods: int, node_kwargs: dict, pod_kwargs: dict,
+               mesh=None) -> ThroughputResult:
+    store = ObjectStore(watch_window=max(1 << 18, 4 * (n_pods + n_nodes)))
+    for node in make_nodes(n_nodes, **node_kwargs):
+        store.create(node)
+    sched = Scheduler(store, caps=caps, policy=policy, mesh=mesh)
+    await sched.start()
+
+    async def drain(expect: int) -> int:
+        done = 0
+        idle = 0
+        while done < expect and idle < 3:
+            got = await sched.schedule_pending(wait=0.5)
+            done += got
+            idle = idle + 1 if got == 0 else 0
+        return done
+
+    if warmup_pods:
+        for pod in make_pods(warmup_pods, name_prefix="warm", **pod_kwargs):
+            store.create(pod)
+        await asyncio.sleep(0)
+        await drain(warmup_pods)
+        # reclaim warmup capacity so the timed wave sees a clean cluster
+        for pod in store.list("Pod", copy_objects=False):
+            store.delete("Pod", pod.metadata.name, pod.metadata.namespace)
+        await asyncio.sleep(0)
+        while await sched.schedule_pending(wait=0.05):
+            pass
+
+    for pod in make_pods(n_pods, **pod_kwargs):
+        store.create(pod)
+    await asyncio.sleep(0)
+
+    batches_before = sched.metrics.batches
+    t0 = time.perf_counter()
+    done = await drain(n_pods)
+    dt = time.perf_counter() - t0
+    result = ThroughputResult(
+        scheduled=done,
+        seconds=dt,
+        pods_per_sec=done / dt if dt > 0 else 0.0,
+        batches=sched.metrics.batches - batches_before,
+        metrics=sched.metrics.snapshot(),
+    )
+    sched.stop()
+    return result
+
+
+def run_throughput(
+    n_nodes: int,
+    n_pods: int,
+    caps: Capacities | None = None,
+    policy: Policy = DEFAULT_POLICY,
+    warmup_pods: int | None = None,
+    node_kwargs: dict | None = None,
+    pod_kwargs: dict | None = None,
+    mesh=None,
+) -> ThroughputResult:
+    """Blocking entry point: returns sustained scheduling throughput."""
+    if caps is None:
+        num_nodes = 1 << max(6, (n_nodes - 1).bit_length())
+        caps = Capacities(num_nodes=num_nodes,
+                          batch_pods=min(512, max(64, n_pods // 8)))
+    if warmup_pods is None:
+        warmup_pods = min(2 * caps.batch_pods, n_pods)
+    return asyncio.run(_run(n_nodes, n_pods, caps, policy, warmup_pods,
+                            node_kwargs or {}, pod_kwargs or {}, mesh))

@@ -1,0 +1,28 @@
+"""Throughput CI gates — the reference's enforced scheduler_perf thresholds
+(test/integration/scheduler_perf/scheduler_test.go:35-38: fail < 30 pods/s,
+warn < 100 pods/s) applied to the small SchedulingBasic-style config. Runs on
+the CPU test backend, which sustains orders of magnitude more."""
+
+from kubernetes_tpu.perf.harness import run_throughput
+from kubernetes_tpu.state import Capacities
+
+
+def test_scheduling_basic_throughput_floor():
+    # SchedulingBasic: 100 nodes / 300 pods (scaled-down density config)
+    result = run_throughput(
+        100, 300, caps=Capacities(num_nodes=128, batch_pods=128))
+    assert result.scheduled == 300
+    assert result.pods_per_sec >= 100, f"below warn threshold: {result}"
+
+
+def test_throughput_with_feature_mix():
+    result = run_throughput(
+        60, 200,
+        caps=Capacities(num_nodes=64, batch_pods=64),
+        node_kwargs={"zones": 3, "labels_per_node": 2, "taint_every": 10},
+        pod_kwargs={"selector_every": 7, "tolerate": True},
+    )
+    # tainted nodes exist and some pods carry selectors; everything that fits
+    # must still schedule at full speed
+    assert result.scheduled == 200
+    assert result.pods_per_sec >= 100, f"below warn threshold: {result}"
