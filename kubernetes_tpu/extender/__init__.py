@@ -1,0 +1,1 @@
+from kubernetes_tpu.extender.server import ExtenderServer  # noqa: F401

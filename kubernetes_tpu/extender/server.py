@@ -1,0 +1,253 @@
+"""Scheduler-extender HTTP endpoint: the stock-control-plane integration seam.
+
+Speaks the reference's extender wire protocol so an *unmodified* Go
+kube-scheduler can delegate filtering/prioritization to the TPU:
+`HTTPExtender` POSTs JSON `ExtenderArgs{pod, nodes|nodenames}` to
+URLPrefix+"/"+verb and expects `ExtenderFilterResult` / `HostPriorityList`
+back (reference plugin/pkg/scheduler/core/extender.go:100 Filter, :143
+Prioritize, :227-243 POST mechanics; wire types
+plugin/pkg/scheduler/api/v1/types.go:148-204). The optional bind verb
+(`ExtenderBindingArgs`) binds through this framework's store in standalone
+deployments.
+
+Two node-delivery modes, matching ExtenderConfig.NodeCacheCapable:
+- node-cache-capable (names only): candidates resolve against the maintained
+  StateDB — the intended production mode, where the extender watches the
+  cluster itself and the Go scheduler ships only names.
+- full objects: nodes in the request body are encoded on the fly into a
+  scratch state (universe ids shared with the persistent table).
+
+The HTTP layer is a minimal asyncio HTTP/1.1 server — requests are small
+JSON POSTs on a trusted network, exactly how the reference treats extenders
+(5s default timeout, extender.go:36).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+from typing import Any
+
+import jax
+import numpy as np
+
+from kubernetes_tpu.api.objects import Node, Pod
+from kubernetes_tpu.models.policy import DEFAULT_POLICY, Policy
+from kubernetes_tpu.ops import predicates as preds
+from kubernetes_tpu.ops import priorities as prios
+from kubernetes_tpu.state import Capacities, encode_cluster
+from kubernetes_tpu.state.layout import CapacityError
+from kubernetes_tpu.state.pod_batch import empty_batch, encode_pod_into
+from kubernetes_tpu.state.statedb import StateDB
+
+log = logging.getLogger(__name__)
+
+
+def _row(batch, i=0):
+    return jax.tree.map(lambda a: a[i], batch)
+
+
+class ExtenderService:
+    """Protocol logic, HTTP-free (reused by tests and the HTTP server)."""
+
+    def __init__(self, caps: Capacities | None = None,
+                 policy: Policy = DEFAULT_POLICY, statedb: StateDB | None = None,
+                 store=None):
+        self.caps = caps or Capacities()
+        self.policy = policy
+        self.statedb = statedb
+        self.store = store
+
+        def _eval(state, pod_row):
+            feasible = (preds.static_feasibility(state, pod_row)
+                        & preds.fits_resources(state, pod_row)
+                        & preds.fits_host_ports(state, pod_row))
+            score = (prios.least_requested(state, pod_row)
+                     + prios.balanced_allocation(state, pod_row)
+                     + prios.taint_toleration(state, pod_row, feasible=feasible))
+            return feasible, score
+
+        self._eval = jax.jit(_eval)
+
+    # ---- state resolution ----
+
+    def _cached_state(self):
+        if self.statedb is None:
+            return None, None
+        return self.statedb.flush(), self.statedb.table
+
+    def _evaluate(self, pod: Pod, nodes: list[Node] | None,
+                  node_names: list[str] | None):
+        """Returns (names, feasible bool[N], scores f32[N], row_of)."""
+        if nodes is not None:
+            state, batch, table = encode_cluster(nodes, [pod], self.caps)
+            names = [n.metadata.name for n in nodes]
+        else:
+            state, table = self._cached_state()
+            if state is None:
+                raise ValueError("nodenames given but no statedb maintained")
+            batch = empty_batch(self.caps)
+            encode_pod_into(batch, 0, pod, self.caps, table)
+            from kubernetes_tpu.state.cluster_state import apply_pending_refreshes
+            if apply_pending_refreshes(self.statedb.host, table):
+                self.statedb.mark_ledger_dirty()  # sel_member changed
+                state = self.statedb.flush()
+            names = node_names or []
+        feasible, score = self._eval(state, _row(batch))
+        return names, np.asarray(feasible), np.asarray(score), table.row_of
+
+    # ---- verbs ----
+
+    def filter(self, args: dict[str, Any]) -> dict[str, Any]:
+        """ExtenderFilterResult for ExtenderArgs (extender.go:100)."""
+        try:
+            pod = Pod.from_dict(args.get("pod") or {})
+            nodes, node_names = _parse_candidates(args)
+            names, feasible, _, row_of = self._evaluate(pod, nodes, node_names)
+            passed, failed = [], {}
+            for name in names:
+                row = row_of.get(name)
+                if row is not None and feasible[row]:
+                    passed.append(name)
+                else:
+                    failed[name] = "node(s) didn't satisfy TPU predicates"
+            if nodes is not None:
+                by_name = {n.metadata.name: n for n in nodes}
+                result: dict[str, Any] = {"nodes": {
+                    "apiVersion": "v1", "kind": "NodeList",
+                    "items": [by_name[n].to_dict() for n in passed]}}
+            else:
+                result = {"nodenames": passed}
+            if failed:
+                result["failedNodes"] = failed
+            return result
+        except (ValueError, CapacityError, KeyError) as e:  # malformed args
+            return {"error": f"{type(e).__name__}: {e}"}
+
+    def prioritize(self, args: dict[str, Any]) -> list[dict[str, Any]]:
+        """HostPriorityList for ExtenderArgs (extender.go:143). Scores are the
+        default-policy weighted sum truncated to int (the Go scheduler
+        multiplies by the configured extender weight)."""
+        pod = Pod.from_dict(args.get("pod") or {})
+        nodes, node_names = _parse_candidates(args)
+        names, _, score, row_of = self._evaluate(pod, nodes, node_names)
+        out = []
+        for name in names:
+            row = row_of.get(name)
+            out.append({"host": name,
+                        "score": int(score[row]) if row is not None else 0})
+        return out
+
+    def bind(self, args: dict[str, Any]) -> dict[str, Any]:
+        """ExtenderBindingResult for ExtenderBindingArgs — standalone mode
+        binds through this framework's store."""
+        if self.store is None:
+            return {"Error": "bind not supported: no store configured"}
+        from kubernetes_tpu.api.objects import Binding
+        from kubernetes_tpu.apiserver.store import Conflict, NotFound
+        try:
+            self.store.bind(Binding(pod_name=args.get("PodName", ""),
+                                    namespace=args.get("PodNamespace", "default"),
+                                    target_node=args.get("Node", "")))
+            return {"Error": ""}
+        except (Conflict, NotFound) as e:
+            return {"Error": str(e)}
+
+
+def _parse_candidates(args: dict[str, Any]):
+    if args.get("nodes") is not None:
+        return [Node.from_dict(d) for d in args["nodes"].get("items") or []], None
+    names = args.get("nodenames")
+    return None, list(names or [])
+
+
+class ExtenderServer:
+    """Minimal asyncio HTTP/1.1 wrapper around ExtenderService."""
+
+    def __init__(self, service: ExtenderService, host: str = "127.0.0.1",
+                 port: int = 0):
+        self.service = service
+        self.host = host
+        self.port = port
+        self._server: asyncio.AbstractServer | None = None
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_server(self._handle, self.host, self.port)
+        self.port = self._server.sockets[0].getsockname()[1]
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    async def _handle(self, reader: asyncio.StreamReader,
+                      writer: asyncio.StreamWriter) -> None:
+        try:
+            while True:
+                request_line = await reader.readline()
+                if not request_line:
+                    return
+                try:
+                    method, path, _ = request_line.decode().split(None, 2)
+                except ValueError:
+                    await self._respond(writer, 400, {"error": "bad request line"})
+                    return
+                headers: dict[str, str] = {}
+                while True:
+                    line = await reader.readline()
+                    if line in (b"\r\n", b"\n", b""):
+                        break
+                    name, _, value = line.decode().partition(":")
+                    headers[name.strip().lower()] = value.strip()
+                length = int(headers.get("content-length", 0))
+                body = await reader.readexactly(length) if length else b""
+
+                status, payload = self._route(method, path, body)
+                keep = headers.get("connection", "keep-alive").lower() != "close"
+                await self._respond(writer, status, payload, keep_alive=keep)
+                if not keep:
+                    return
+        except (asyncio.IncompleteReadError, ConnectionError):
+            pass
+        finally:
+            writer.close()
+
+    def _route(self, method: str, path: str, body: bytes):
+        path = path.rstrip("/")
+        if method == "GET" and path in ("", "/healthz"):
+            return 200, {"ok": True}
+        if method != "POST":
+            return 405, {"error": f"method {method} not allowed"}
+        try:
+            args = json.loads(body or b"{}")
+        except json.JSONDecodeError as e:
+            return 400, {"error": f"bad JSON: {e}"}
+        if not isinstance(args, dict):
+            return 400, {"error": "request body must be a JSON object"}
+        verb = path.rsplit("/", 1)[-1]
+        if verb == "filter":
+            return 200, self.service.filter(args)
+        if verb == "prioritize":
+            return 200, self.service.prioritize(args)
+        if verb == "bind":
+            return 200, self.service.bind(args)
+        return 404, {"error": f"unknown verb {verb!r}"}
+
+    async def _respond(self, writer: asyncio.StreamWriter, status: int,
+                       payload, keep_alive: bool = False) -> None:
+        body = json.dumps(payload).encode()
+        reason = {200: "OK", 400: "Bad Request", 404: "Not Found",
+                  405: "Method Not Allowed"}.get(status, "Error")
+        conn = "keep-alive" if keep_alive else "close"
+        writer.write(
+            f"HTTP/1.1 {status} {reason}\r\n"
+            f"Content-Type: application/json\r\n"
+            f"Content-Length: {len(body)}\r\n"
+            f"Connection: {conn}\r\n\r\n".encode() + body)
+        await writer.drain()

@@ -1,0 +1,160 @@
+"""Extender endpoint tests: wire-format parity with the reference's
+HTTPExtender client (core/extender.go:100,143,227-243) over real HTTP."""
+
+import asyncio
+import json
+import urllib.request
+
+import pytest
+
+from kubernetes_tpu.api.objects import Node
+from kubernetes_tpu.apiserver import ObjectStore
+from kubernetes_tpu.extender import ExtenderServer
+from kubernetes_tpu.extender.server import ExtenderService
+from kubernetes_tpu.perf.fixtures import make_nodes, make_pods
+from kubernetes_tpu.state import Capacities
+from kubernetes_tpu.state.statedb import StateDB
+
+CAPS = Capacities(num_nodes=16, batch_pods=4)
+
+
+def pod_json(cpu="500m", selector=None):
+    spec = {"containers": [{"name": "c", "resources": {
+        "requests": {"cpu": cpu, "memory": "256Mi"}}}]}
+    if selector:
+        spec["nodeSelector"] = selector
+    return {"apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": "p", "namespace": "default"}, "spec": spec}
+
+
+def node_list(nodes):
+    return {"apiVersion": "v1", "kind": "NodeList",
+            "items": [n.to_dict() for n in nodes]}
+
+
+async def _post(url, payload):
+    def do():
+        req = urllib.request.Request(
+            url, data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=5) as resp:
+            return json.loads(resp.read())
+    return await asyncio.get_running_loop().run_in_executor(None, do)
+
+
+def test_filter_full_node_objects():
+    async def run():
+        service = ExtenderService(caps=CAPS)
+        server = ExtenderServer(service)
+        await server.start()
+        nodes = make_nodes(3, cpu="1")
+        nodes[1] = Node.from_dict({
+            "metadata": {"name": "node-1"},
+            "spec": {"taints": [{"key": "k", "value": "v",
+                                 "effect": "NoSchedule"}]},
+            "status": {"allocatable": {"cpu": "1", "memory": "8Gi",
+                                       "pods": "110"},
+                       "conditions": [{"type": "Ready", "status": "True"}]}})
+        result = await _post(server.url + "/filter",
+                             {"pod": pod_json(), "nodes": node_list(nodes)})
+        names = [n["metadata"]["name"] for n in result["nodes"]["items"]]
+        assert names == ["node-0", "node-2"]
+        assert "node-1" in result["failedNodes"]
+        await server.stop()
+
+    asyncio.run(run())
+
+
+def test_filter_rejects_oversized_pod_gracefully():
+    async def run():
+        service = ExtenderService(caps=CAPS)
+        server = ExtenderServer(service)
+        await server.start()
+        bad_pod = pod_json()
+        bad_pod["spec"]["tolerations"] = [
+            {"key": f"k{i}", "operator": "Exists"}
+            for i in range(CAPS.toleration_slots + 1)]
+        result = await _post(server.url + "/filter",
+                             {"pod": bad_pod,
+                              "nodes": node_list(make_nodes(2))})
+        assert "error" in result
+        await server.stop()
+
+    asyncio.run(run())
+
+
+def test_prioritize_scores():
+    async def run():
+        service = ExtenderService(caps=CAPS)
+        server = ExtenderServer(service)
+        await server.start()
+        nodes = make_nodes(2)
+        result = await _post(server.url + "/prioritize",
+                             {"pod": pod_json(), "nodes": node_list(nodes)})
+        assert {r["host"] for r in result} == {"node-0", "node-1"}
+        assert all(isinstance(r["score"], int) for r in result)
+        await server.stop()
+
+    asyncio.run(run())
+
+
+def test_node_cache_capable_mode_with_statedb():
+    async def run():
+        db = StateDB(CAPS)
+        for node in make_nodes(4, cpu="2"):
+            db.upsert_node(node)
+        pod = make_pods(1, cpu="1500m")[0]
+        pod.spec.node_name = "node-0"
+        db.add_pod(pod)
+        service = ExtenderService(caps=CAPS, statedb=db)
+        server = ExtenderServer(service)
+        await server.start()
+        result = await _post(
+            server.url + "/filter",
+            {"pod": pod_json(cpu="1"),
+             "nodenames": ["node-0", "node-1", "node-2"]})
+        # node-0 is full (1.5 of 2 cores used)
+        assert result["nodenames"] == ["node-1", "node-2"]
+        assert "node-0" in result["failedNodes"]
+        await server.stop()
+
+    asyncio.run(run())
+
+
+def test_bind_verb_standalone():
+    async def run():
+        store = ObjectStore()
+        store.create(make_pods(1)[0])
+        service = ExtenderService(caps=CAPS, store=store)
+        server = ExtenderServer(service)
+        await server.start()
+        result = await _post(server.url + "/bind",
+                             {"PodName": "pod-0", "PodNamespace": "default",
+                              "Node": "node-7"})
+        assert result["Error"] == ""
+        assert store.get("Pod", "pod-0").spec.node_name == "node-7"
+        # double bind fails
+        result = await _post(server.url + "/bind",
+                             {"PodName": "pod-0", "PodNamespace": "default",
+                              "Node": "node-8"})
+        assert "already bound" in result["Error"]
+        await server.stop()
+
+    asyncio.run(run())
+
+
+def test_healthz_and_unknown_verb():
+    async def run():
+        server = ExtenderServer(ExtenderService(caps=CAPS))
+        await server.start()
+
+        def get():
+            with urllib.request.urlopen(server.url + "/healthz", timeout=5) as r:
+                return json.loads(r.read())
+        ok = await asyncio.get_running_loop().run_in_executor(None, get)
+        assert ok == {"ok": True}
+        with pytest.raises(urllib.error.HTTPError):
+            await _post(server.url + "/nope", {})
+        await server.stop()
+
+    asyncio.run(run())
