@@ -337,6 +337,27 @@ class Pod:
                 for p in c.ports if p.host_port]
 
 
+def parse_node_affinity(affinity: dict) -> tuple[list | None, list]:
+    """Split a raw v1 Affinity dict into node-affinity parts.
+
+    Returns `(required_terms, preferred)`: `required_terms` is None when no
+    requiredDuringSchedulingIgnoredDuringExecution NodeSelector is present
+    (matches all nodes, reference predicates.go:662), else the list of
+    nodeSelectorTerms (each a list of matchExpressions dicts — an empty list
+    matches no nodes, predicates.go:645 via NodeSelectorRequirementsAsSelector
+    returning labels.Nothing for len==0). `preferred` is a list of
+    `(weight, matchExpressions)` tuples."""
+    na = (affinity or {}).get("nodeAffinity") or {}
+    required = na.get("requiredDuringSchedulingIgnoredDuringExecution")
+    req_terms = None
+    if required is not None:
+        req_terms = [t.get("matchExpressions") or []
+                     for t in required.get("nodeSelectorTerms") or []]
+    preferred = [(int(p.get("weight", 0)), (p.get("preference") or {}).get("matchExpressions") or [])
+                 for p in na.get("preferredDuringSchedulingIgnoredDuringExecution") or []]
+    return req_terms, preferred
+
+
 @dataclass
 class NodeCondition:
     type: str = ""

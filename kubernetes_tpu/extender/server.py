@@ -89,9 +89,9 @@ class ExtenderService:
                 raise ValueError("nodenames given but no statedb maintained")
             batch = empty_batch(self.caps)
             encode_pod_into(batch, 0, pod, self.caps, table)
-            from kubernetes_tpu.state.cluster_state import apply_pending_refreshes
-            if apply_pending_refreshes(self.statedb.host, table):
-                self.statedb.mark_ledger_dirty()  # sel_member changed
+            if table.pending_sel_refresh or table.pending_req_refresh:
+                # flush() refills the new membership columns and re-uploads
+                # sel_member/req_member to the device
                 state = self.statedb.flush()
             names = node_names or []
         feasible, score = self._eval(state, _row(batch))

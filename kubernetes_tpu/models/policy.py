@@ -40,6 +40,7 @@ KNOWN_PREDICATES = frozenset({
 KNOWN_PRIORITIES = frozenset({
     "LeastRequestedPriority", "MostRequestedPriority",
     "BalancedResourceAllocation", "TaintTolerationPriority", "EqualPriority",
+    "NodeAffinityPriority",
 })
 
 

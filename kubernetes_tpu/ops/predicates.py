@@ -99,11 +99,22 @@ def fits_host_ports(state: ClusterState, pod: PodBatch, port_count=None) -> jnp.
 
 
 def match_node_selector(state: ClusterState, pod: PodBatch) -> jnp.ndarray:
-    """PodMatchNodeSelector (predicates.go:686) for map-form nodeSelector:
-    every required term must be satisfied. Satisfied-term count comes from
-    one matvec against the membership matrix."""
+    """PodMatchNodeSelector (predicates.go:686 podMatchesNodeLabels): the
+    map-form nodeSelector AND any required node affinity must both hold.
+
+    nodeSelector: satisfied-term count from one matvec against the selector
+    membership matrix. Node affinity: OR over terms, each term an AND over
+    interned requirements — `naff_onehot[T, UR] @ req_member[N, UR].T` gives
+    per-term satisfied-requirement counts, a term holds when every
+    requirement matched (count equality), and dead terms (empty/unparseable,
+    predicates.go:628-645) never hold."""
     satisfied = state.sel_member @ pod.sel_onehot
-    return satisfied >= pod.sel_count
+    sel_ok = satisfied >= pod.sel_count
+
+    term_sat = pod.naff_onehot @ state.req_member.T          # f32[T, N]
+    term_ok = (term_sat >= pod.naff_count[:, None]) & pod.naff_ok[:, None]
+    aff_ok = (~pod.naff_has) | jnp.any(term_ok, axis=0)
+    return sel_ok & aff_ok
 
 
 def _tolerated_universe(state: ClusterState, pod: PodBatch) -> jnp.ndarray:

@@ -98,6 +98,37 @@ def taint_toleration(state: ClusterState, pod: PodBatch, feasible=None) -> jnp.n
         counts, state.valid if feasible is None else feasible)
 
 
+def node_affinity_counts(state: ClusterState, pod: PodBatch) -> jnp.ndarray:
+    """The map half of NodeAffinityPriority (node_affinity.go
+    CalculateNodeAffinityPriorityMap): per node, the total weight of preferred
+    scheduling terms whose selector matches the node's labels. One matmul per
+    pod: `pref_onehot[TP, UR] @ req_member[N, UR].T`, a term matches when all
+    its requirements do."""
+    term_sat = pod.pref_onehot @ state.req_member.T            # f32[TP, N]
+    matches = (term_sat >= pod.pref_count[:, None]) & (pod.pref_weight[:, None] > 0)
+    return jnp.sum(jnp.where(matches, pod.pref_weight[:, None], 0.0), axis=0)
+
+
+def normalized_from_counts(counts: jnp.ndarray, feasible: jnp.ndarray) -> jnp.ndarray:
+    """NormalizeReduce-style reduce (node_affinity.go
+    CalculateNodeAffinityPriorityReduce): score = int(MaxPriority * count /
+    maxCount) over the filtered node list; all zero when maxCount == 0."""
+    counts = jnp.where(feasible, counts.astype(jnp.float32), 0.0)
+    max_count = jnp.max(counts)
+    return jnp.where(
+        max_count > 0,
+        jnp.trunc(counts * MAX_PRIORITY / jnp.maximum(max_count, 1.0) + FLOOR_EPS),
+        0.0,
+    )
+
+
+def node_affinity(state: ClusterState, pod: PodBatch, feasible=None) -> jnp.ndarray:
+    """NodeAffinityPriority map+reduce."""
+    counts = node_affinity_counts(state, pod)
+    return normalized_from_counts(
+        counts, state.valid if feasible is None else feasible)
+
+
 def equal(state: ClusterState, pod: PodBatch) -> jnp.ndarray:
     """EqualPriority (generic_scheduler.go:416): weight-1 constant score."""
     return jnp.ones(state.valid.shape[0], dtype=jnp.float32)

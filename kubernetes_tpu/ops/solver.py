@@ -107,6 +107,7 @@ def schedule_batch(
     w_lr = policy.weight("LeastRequestedPriority")
     w_ba = policy.weight("BalancedResourceAllocation")
     w_tt = policy.weight("TaintTolerationPriority")
+    w_na = policy.weight("NodeAffinityPriority")
 
     # ---- Phase A: batched over (P, N) ----
     static_mask = jax.vmap(lambda p: _static_mask(state, p, policy))(batch)
@@ -116,11 +117,16 @@ def schedule_batch(
             lambda p: preds.count_untolerated_prefer_taints(state, p))(batch)
     else:
         prefer_counts = jnp.zeros(static_mask.shape, jnp.int32)
+    if w_na:
+        na_counts = jax.vmap(
+            lambda p: prios.node_affinity_counts(state, p))(batch)
+    else:
+        na_counts = jnp.zeros(static_mask.shape, jnp.float32)
 
     # ---- Phase B: scan over the pod axis, vector over nodes ----
     def step(carry, xs):
         requested, nonzero, port_count, rr = carry
-        pod, s_mask, s_score, p_counts = xs
+        pod, s_mask, s_score, p_counts, na_count = xs
 
         feasible = s_mask
         if use_resources:
@@ -136,6 +142,8 @@ def schedule_batch(
             score = score + w_ba * prios.balanced_allocation(state, pod, nonzero_requested=nonzero)
         if w_tt:
             score = score + w_tt * prios.taint_toleration_from_counts(p_counts, feasible)
+        if w_na:
+            score = score + w_na * prios.normalized_from_counts(na_count, feasible)
 
         masked = jnp.where(feasible, score, -jnp.inf)
         node, best, ntie = _select_host(masked, feasible, rr)
@@ -156,7 +164,7 @@ def schedule_batch(
     init = (state.requested, state.nonzero_requested, state.port_count,
             jnp.asarray(rr_start, jnp.uint32))
     (requested, nonzero, port_count, rr), (nodes, scores, counts) = jax.lax.scan(
-        step, init, (batch, static_mask, static_score, prefer_counts))
+        step, init, (batch, static_mask, static_score, prefer_counts, na_counts))
 
     return SolverResult(
         assignments=nodes,

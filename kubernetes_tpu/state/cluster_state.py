@@ -40,6 +40,7 @@ from kubernetes_tpu.state.layout import (
     Condition,
     Effect,
     MEM_UNIT,
+    ReqOp,
     Resource,
 )
 from kubernetes_tpu.utils.hashing import hash32, hash_lanes
@@ -48,8 +49,8 @@ from kubernetes_tpu.utils.hashing import hash32, hash_lanes
 # everything else is cluster-global and replicated.
 NODE_AXIS_FIELDS = frozenset({
     "valid", "allocatable", "requested", "nonzero_requested", "port_count",
-    "sel_member", "taint_hard_member", "taint_prefer_member", "conditions",
-    "name_lo", "name_hi", "topology",
+    "sel_member", "req_member", "taint_hard_member", "taint_prefer_member",
+    "conditions", "name_lo", "name_hi", "topology",
 })
 
 
@@ -63,6 +64,7 @@ class ClusterState:
     nonzero_requested: np.ndarray  # f32[N, 2] — (cpu, mem) with scoring defaults
     port_count: np.ndarray        # f32[N, UP] — pods using interned port u
     sel_member: np.ndarray        # f32[N, US] — node satisfies selector term u
+    req_member: np.ndarray        # f32[N, UR] — node satisfies requirement u
     taint_hard_member: np.ndarray    # f32[N, UT] — NoSchedule/NoExecute taints
     taint_prefer_member: np.ndarray  # f32[N, UT] — PreferNoSchedule taints
     # taint universe attributes (dim 0 = UT, replicated across the mesh)
@@ -89,6 +91,7 @@ def empty_state(caps: Capacities) -> ClusterState:
         nonzero_requested=np.zeros((n, 2), np.float32),
         port_count=np.zeros((n, caps.port_universe), np.float32),
         sel_member=np.zeros((n, caps.selector_universe), np.float32),
+        req_member=np.zeros((n, caps.req_universe), np.float32),
         taint_hard_member=np.zeros((n, caps.taint_universe), np.float32),
         taint_prefer_member=np.zeros((n, caps.taint_universe), np.float32),
         taint_u_key=np.zeros((caps.taint_universe,), np.uint32),
@@ -145,6 +148,49 @@ def condition_mask(node: Node) -> int:
     return mask
 
 
+_INT64_MAX = 2**63 - 1
+_INT64_MIN = -(2**63)
+
+
+def parse_int64(s: str) -> int | None:
+    """Go strconv.ParseInt(s, 10, 64) semantics: optional sign + ASCII digits
+    only (no whitespace, underscores, or other bases), int64 range. Returns
+    None on failure (Gt/Lt requirements fail closed, selector.go)."""
+    body = s[1:] if s[:1] in "+-" else s
+    if not body or not body.isascii() or not body.isdigit():
+        return None
+    v = int(s)
+    if not (_INT64_MIN <= v <= _INT64_MAX):
+        return None
+    return v
+
+
+def match_requirement(labels: dict[str, str], key: str, op: str,
+                      values: tuple[str, ...]) -> bool:
+    """Evaluate one NodeSelectorRequirement against a label set, with the
+    reference's labels.Requirement.Matches semantics
+    (apimachinery/pkg/labels/selector.go: NotIn/DoesNotExist are satisfied by
+    a missing key; Gt/Lt parse both sides as int64 and fail closed)."""
+    has = key in labels
+    if op == ReqOp.IN:
+        return has and labels[key] in values
+    if op == ReqOp.NOT_IN:
+        return not has or labels[key] not in values
+    if op == ReqOp.EXISTS:
+        return has
+    if op == ReqOp.DOES_NOT_EXIST:
+        return not has
+    if op in (ReqOp.GT, ReqOp.LT):
+        if not has or len(values) != 1:
+            return False
+        lhs = parse_int64(labels[key])
+        rhs = parse_int64(values[0])
+        if lhs is None or rhs is None:
+            return False
+        return lhs > rhs if op == ReqOp.GT else lhs < rhs
+    return False
+
+
 class NodeTable:
     """Host-side index over the device state: row assignment + free-list,
     universe interning (selector terms, taints, ports), per-row source data
@@ -162,8 +208,10 @@ class NodeTable:
         self.sel_terms: dict[tuple[str, str], int] = {}
         self.taints: dict[tuple[str, str, str], int] = {}
         self.ports: dict[int, int] = {}
+        self.reqs: dict[tuple[str, str, tuple[str, ...]], int] = {}
         # terms interned after nodes were encoded: columns awaiting refill
         self.pending_sel_refresh: list[tuple[int, str, str]] = []
+        self.pending_req_refresh: list[tuple[int, str, str, tuple[str, ...]]] = []
         # per-row source data for refills on universe growth
         self.labels_of: list[dict[str, str] | None] = [None] * caps.num_nodes
         # topology interning: per topology key, domain string -> id
@@ -211,6 +259,23 @@ class NodeTable:
         self.sel_terms[term] = tid
         self.pending_sel_refresh.append((tid, key, value))
         return tid
+
+    def intern_requirement(self, key: str, op: str, values) -> int:
+        """Intern a NodeSelectorRequirement (values canonicalized by sorting —
+        In/NotIn set semantics). Newly seen requirements queue a membership
+        refill in `pending_req_refresh`."""
+        req = (key, op, tuple(sorted(values)))
+        rid = self.reqs.get(req)
+        if rid is not None:
+            return rid
+        if len(self.reqs) >= self.caps.req_universe:
+            raise CapacityError(
+                f"requirement universe {self.caps.req_universe} exhausted "
+                f"interning {req!r}")
+        rid = len(self.reqs)
+        self.reqs[req] = rid
+        self.pending_req_refresh.append((rid, *req))
+        return rid
 
     def intern_taint(self, taint) -> int:
         key = (taint.key, taint.value, taint.effect)
@@ -261,11 +326,15 @@ def _fill_node_row(state: ClusterState, table: NodeTable, row: int, node: Node) 
 
     labels = dict(node.metadata.labels)
     table.labels_of[row] = labels
-    # membership against every interned selector term
+    # membership against every interned selector term / requirement
     state.sel_member[row] = 0.0
     for (k, v), tid in table.sel_terms.items():
         if labels.get(k) == v:
             state.sel_member[row, tid] = 1.0
+    state.req_member[row] = 0.0
+    for (k, op, values), rid in table.reqs.items():
+        if match_requirement(labels, k, op, values):
+            state.req_member[row, rid] = 1.0
 
     # taints: intern and set membership + universe attributes
     state.taint_hard_member[row] = 0.0
@@ -293,16 +362,23 @@ def _fill_node_row(state: ClusterState, table: NodeTable, row: int, node: Node) 
 
 
 def apply_pending_refreshes(state: ClusterState, table: NodeTable) -> bool:
-    """Fill membership columns for selector terms interned after nodes were
-    encoded. Returns True if any column changed (device re-upload needed)."""
-    if not table.pending_sel_refresh:
-        return False
+    """Fill membership columns for selector terms / requirements interned
+    after nodes were encoded. Returns True if any column changed (device
+    re-upload needed)."""
+    changed = False
     for term_id, key, value in table.pending_sel_refresh:
+        changed = True
         for row, labels in enumerate(table.labels_of):
             if labels is not None and labels.get(key) == value:
                 state.sel_member[row, term_id] = 1.0
     table.pending_sel_refresh.clear()
-    return True
+    for rid, key, op, values in table.pending_req_refresh:
+        changed = True
+        for row, labels in enumerate(table.labels_of):
+            if labels is not None and match_requirement(labels, key, op, values):
+                state.req_member[row, rid] = 1.0
+    table.pending_req_refresh.clear()
+    return changed
 
 
 def pod_requests(pod: Pod) -> np.ndarray:

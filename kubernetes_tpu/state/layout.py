@@ -62,6 +62,22 @@ class TolOp:
     EXISTS = 2
 
 
+class ReqOp:
+    """NodeSelectorRequirement operators (reference v1.NodeSelectorOperator,
+    staging/src/k8s.io/api/core/v1/types.go; semantics of
+    labels.Requirement.Matches in apimachinery/pkg/labels/selector.go:
+    NotIn/DoesNotExist are satisfied by a missing key)."""
+
+    IN = "In"
+    NOT_IN = "NotIn"
+    EXISTS = "Exists"
+    DOES_NOT_EXIST = "DoesNotExist"
+    GT = "Gt"
+    LT = "Lt"
+
+    ALL = (IN, NOT_IN, EXISTS, DOES_NOT_EXIST, GT, LT)
+
+
 class Condition:
     """Bits of the per-node condition mask. Bit set == the *bad* state, so an
     all-zero mask is a healthy schedulable node (reference:
@@ -111,9 +127,11 @@ class Capacities:
     selector_universe: int = 128   # US: distinct nodeSelector key=value terms
     taint_universe: int = 64       # UT: distinct (key, value, effect) taints
     port_universe: int = 64        # UP: distinct host ports in use
+    req_universe: int = 64         # UR: distinct NodeSelectorRequirements
     toleration_slots: int = 8      # tolerations per pod
     topology_slots: int = len(TOPOLOGY_KEYS)
-    affinity_terms: int = 4        # pod (anti-)affinity terms per pod
+    affinity_terms: int = 4        # required node-affinity OR-terms per pod
+    pref_terms: int = 4            # preferred node-affinity terms per pod
 
 
 class CapacityError(ValueError):

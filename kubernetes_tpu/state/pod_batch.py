@@ -48,6 +48,16 @@ class PodBatch:
     node_name_lo: np.ndarray    # u32[P] spec.nodeName hash lanes, 0 = unset
     node_name_hi: np.ndarray    # u32[P]
     best_effort: np.ndarray     # bool[P] BestEffort QoS (pressure-check exemption)
+    # required node affinity: OR over terms, each term an AND over interned
+    # requirements (one-hot into the requirement universe UR)
+    naff_has: np.ndarray        # bool[P] — pod carries a required NodeSelector
+    naff_onehot: np.ndarray     # f32[P, AT, UR]
+    naff_count: np.ndarray      # f32[P, AT] — requirements in term t
+    naff_ok: np.ndarray         # bool[P, AT] — term is live (non-empty, parsed)
+    # preferred node affinity terms (NodeAffinityPriority)
+    pref_onehot: np.ndarray     # f32[P, TP, UR]
+    pref_count: np.ndarray      # f32[P, TP]
+    pref_weight: np.ndarray     # f32[P, TP] — 0 for unused/invalid slots
 
     @property
     def batch_pods(self) -> int:
@@ -71,6 +81,13 @@ def empty_batch(caps: Capacities) -> PodBatch:
         node_name_lo=np.zeros((p,), np.uint32),
         node_name_hi=np.zeros((p,), np.uint32),
         best_effort=np.zeros((p,), np.bool_),
+        naff_has=np.zeros((p,), np.bool_),
+        naff_onehot=np.zeros((p, caps.affinity_terms, caps.req_universe), np.float32),
+        naff_count=np.zeros((p, caps.affinity_terms), np.float32),
+        naff_ok=np.zeros((p, caps.affinity_terms), np.bool_),
+        pref_onehot=np.zeros((p, caps.pref_terms, caps.req_universe), np.float32),
+        pref_count=np.zeros((p, caps.pref_terms), np.float32),
+        pref_weight=np.zeros((p, caps.pref_terms), np.float32),
     )
 
 
@@ -112,6 +129,81 @@ def encode_pod_into(batch: PodBatch, i: int, pod: Pod, caps: Capacities,
         batch.node_name_lo[i] = 0
         batch.node_name_hi[i] = 0
     batch.best_effort[i] = pod.is_best_effort()
+    _encode_node_affinity(batch, i, pod, caps, table)
+
+
+def _valid_requirement(expr: dict) -> bool:
+    """Mirror labels.NewRequirement validation (selector.go): operator must be
+    known; In/NotIn need >=1 value; Exists/DoesNotExist need none; Gt/Lt need
+    exactly one."""
+    from kubernetes_tpu.state.layout import ReqOp
+
+    op = expr.get("operator", "")
+    values = expr.get("values") or []
+    if op in (ReqOp.IN, ReqOp.NOT_IN):
+        return len(values) >= 1
+    if op in (ReqOp.EXISTS, ReqOp.DOES_NOT_EXIST):
+        return len(values) == 0
+    if op in (ReqOp.GT, ReqOp.LT):
+        return len(values) == 1
+    return False
+
+
+def _encode_node_affinity(batch: PodBatch, i: int, pod: Pod, caps: Capacities,
+                          table: NodeTable) -> None:
+    from kubernetes_tpu.api.objects import parse_node_affinity
+
+    req_terms, preferred = parse_node_affinity(pod.spec.affinity)
+    batch.naff_onehot[i] = 0.0
+    batch.naff_count[i] = 0.0
+    batch.naff_ok[i] = False
+    batch.naff_has[i] = req_terms is not None
+    if req_terms is not None:
+        if len(req_terms) > caps.affinity_terms:
+            raise CapacityError(
+                f"pod {pod.key}: {len(req_terms)} nodeSelectorTerms > "
+                f"{caps.affinity_terms} slots")
+        # a parse error in ANY term makes the whole term list match nothing
+        # (nodeMatchesNodeSelectorTerms returns false on error,
+        # predicates.go:628-631)
+        poisoned = any(not _valid_requirement(e) for exprs in req_terms
+                       for e in exprs)
+        if not poisoned:
+            for t, exprs in enumerate(req_terms):
+                if not exprs:
+                    continue  # empty term: labels.Nothing, matches no node
+                # count distinct interned ids: duplicate expressions in a term
+                # collapse to one one-hot column
+                rids = {table.intern_requirement(
+                    e.get("key", ""), e["operator"], tuple(e.get("values") or ()))
+                    for e in exprs}
+                for rid in rids:
+                    batch.naff_onehot[i, t, rid] = 1.0
+                batch.naff_count[i, t] = float(len(rids))
+                batch.naff_ok[i, t] = True
+
+    batch.pref_onehot[i] = 0.0
+    batch.pref_count[i] = 0.0
+    batch.pref_weight[i] = 0.0
+    if preferred:
+        if len(preferred) > caps.pref_terms:
+            raise CapacityError(
+                f"pod {pod.key}: {len(preferred)} preferred terms > "
+                f"{caps.pref_terms} slots")
+        for t, (weight, exprs) in enumerate(preferred):
+            # weight<=0 skipped (node_affinity.go skips 0; API validation
+            # forbids negatives); empty/invalid expressions never match, so
+            # the slot contributes nothing
+            if weight <= 0 or not exprs or any(not _valid_requirement(e)
+                                               for e in exprs):
+                continue
+            rids = {table.intern_requirement(
+                e.get("key", ""), e["operator"], tuple(e.get("values") or ()))
+                for e in exprs}
+            for rid in rids:
+                batch.pref_onehot[i, t, rid] = 1.0
+            batch.pref_count[i, t] = float(len(rids))
+            batch.pref_weight[i, t] = float(weight)
 
 
 def encode_pods(pods: Sequence[Pod], caps: Capacities, table: NodeTable,

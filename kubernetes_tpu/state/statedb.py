@@ -132,12 +132,12 @@ class StateDB:
 
     def flush(self) -> ClusterState:
         """Return the device view, re-uploading only what changed. Newly
-        interned selector terms (from pod encoding) refill their membership
-        columns first."""
-        dirty_sel = apply_pending_refreshes(self.host, self.table)
+        interned selector terms / requirements (from pod encoding) refill
+        their membership columns first."""
+        dirty_membership = apply_pending_refreshes(self.host, self.table)
         if self._device is None or self._dirty_nodes:
             dev = self._put(self.host)
-        elif self._dirty_ledger or dirty_sel:
+        elif self._dirty_ledger or dirty_membership:
             dev = self._device
             if self._dirty_ledger:
                 dev = dev.replace(
@@ -145,8 +145,10 @@ class StateDB:
                     nonzero_requested=self._put_arr(self.host.nonzero_requested),
                     port_count=self._put_arr(self.host.port_count),
                 )
-            if dirty_sel:
-                dev = dev.replace(sel_member=self._put_arr(self.host.sel_member))
+            if dirty_membership:
+                dev = dev.replace(
+                    sel_member=self._put_arr(self.host.sel_member),
+                    req_member=self._put_arr(self.host.req_member))
         else:
             return self._device
         self._device = dev

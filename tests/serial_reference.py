@@ -135,9 +135,90 @@ def fits_ports(ns: NodeState, pod: Pod) -> bool:
     return not (pod_ports(pod) & ns.ports)
 
 
+def _match_expression(labels: dict, expr: dict) -> bool:
+    """labels.Requirement.Matches semantics (apimachinery selector.go)."""
+    key = expr.get("key", "")
+    op = expr.get("operator", "")
+    values = expr.get("values") or []
+    has = key in labels
+    if op == "In":
+        return has and labels[key] in values
+    if op == "NotIn":
+        return not has or labels[key] not in values
+    if op == "Exists":
+        return has
+    if op == "DoesNotExist":
+        return not has
+    if op in ("Gt", "Lt"):
+        if not has or len(values) != 1:
+            return False
+        # Go strconv.ParseInt: sign + digits only, fail closed
+        def go_int(s):
+            body = s[1:] if s[:1] in "+-" else s
+            if not body or not body.isascii() or not body.isdigit():
+                return None
+            v = int(s)
+            return v if -(2**63) <= v <= 2**63 - 1 else None
+        lhs, rhs = go_int(labels[key]), go_int(values[0])
+        if lhs is None or rhs is None:
+            return False
+        return lhs > rhs if op == "Gt" else lhs < rhs
+    return False
+
+
+def _expr_parses(expr: dict) -> bool:
+    op = expr.get("operator", "")
+    nvals = len(expr.get("values") or [])
+    if op in ("In", "NotIn"):
+        return nvals >= 1
+    if op in ("Exists", "DoesNotExist"):
+        return nvals == 0
+    if op in ("Gt", "Lt"):
+        return nvals == 1
+    return False
+
+
 def match_selector(ns: NodeState, pod: Pod) -> bool:
+    """podMatchesNodeLabels (predicates.go:641): map-form nodeSelector AND
+    required node affinity."""
     labels = ns.node.metadata.labels
-    return all(labels.get(k) == v for k, v in pod.spec.node_selector.items())
+    if not all(labels.get(k) == v for k, v in pod.spec.node_selector.items()):
+        return False
+    from kubernetes_tpu.api.objects import parse_node_affinity
+
+    req_terms, _ = parse_node_affinity(pod.spec.affinity)
+    if req_terms is None:
+        return True
+    # parse error in any term -> the whole list matches nothing
+    # (nodeMatchesNodeSelectorTerms, predicates.go:628-631)
+    for exprs in req_terms:
+        for e in exprs:
+            if not _expr_parses(e):
+                return False
+    for exprs in req_terms:
+        if not exprs:
+            continue  # labels.Nothing
+        if all(_match_expression(labels, e) for e in exprs):
+            return True
+    return False
+
+
+def node_affinity_count(ns: NodeState, pod: Pod) -> int:
+    """CalculateNodeAffinityPriorityMap (node_affinity.go): summed weights of
+    matching preferred terms."""
+    from kubernetes_tpu.api.objects import parse_node_affinity
+
+    _, preferred = parse_node_affinity(pod.spec.affinity)
+    labels = ns.node.metadata.labels
+    count = 0
+    for weight, exprs in preferred:
+        if weight <= 0 or not exprs:
+            continue
+        if any(not _expr_parses(e) for e in exprs):
+            continue
+        if all(_match_expression(labels, e) for e in exprs):
+            count += weight
+    return count
 
 
 def tolerates_taints(ns: NodeState, pod: Pod) -> bool:
@@ -213,7 +294,8 @@ def untolerated_prefer_count(ns: NodeState, pod: Pod) -> int:
 class SerialScheduler:
     """scheduleOne loop over Python objects."""
 
-    def __init__(self, nodes: list[Node], assigned_pods: list[Pod] = ()):
+    def __init__(self, nodes: list[Node], assigned_pods: list[Pod] = (),
+                 *, with_node_affinity: bool = False):
         self.states = [NodeState.from_node(n) for n in nodes]
         self.by_name = {ns.node.metadata.name: ns for ns in self.states}
         for pod in assigned_pods:
@@ -221,6 +303,7 @@ class SerialScheduler:
             if ns:
                 ns.add_pod(pod)
         self.rr = 0
+        self.with_node_affinity = with_node_affinity
 
     def schedule_one(self, pod: Pod) -> str | None:
         fits = [ns for ns in self.states if feasible(ns, pod)]
@@ -228,11 +311,20 @@ class SerialScheduler:
             return None
         counts = [untolerated_prefer_count(ns, pod) for ns in fits]
         max_count = max(counts)
+        na_scores = [0] * len(fits)
+        if self.with_node_affinity:
+            na_counts = [node_affinity_count(ns, pod) for ns in fits]
+            na_max = max(na_counts)
+            if na_max > 0:
+                # CalculateNodeAffinityPriorityReduce: int(10 * count / max)
+                na_scores = [int(Fraction(MAX_PRIORITY * c, na_max))
+                             for c in na_counts]
         scores = []
-        for ns, cnt in zip(fits, counts):
+        for ns, cnt, na in zip(fits, counts, na_scores):
             tt = MAX_PRIORITY if max_count == 0 else int(
                 (1 - Fraction(cnt, max_count)) * MAX_PRIORITY)
-            scores.append(least_requested(ns, pod) + balanced_allocation(ns, pod) + tt)
+            scores.append(least_requested(ns, pod) + balanced_allocation(ns, pod)
+                          + tt + na)
         best = max(scores)
         ties = [ns for ns, s in zip(fits, scores) if s == best]
         pick = ties[self.rr % len(ties)]

@@ -1,0 +1,270 @@
+"""Node-affinity parity: full NodeSelectorRequirement operator set against
+podMatchesNodeLabels semantics (reference predicates.go:641-686) and
+NodeAffinityPriority (node_affinity.go), including randomized serial parity."""
+
+import jax
+import numpy as np
+import pytest
+
+from kubernetes_tpu.api.objects import Node, Pod
+from kubernetes_tpu.models.policy import Policy
+from kubernetes_tpu.ops import predicates as preds
+from kubernetes_tpu.ops import priorities as prios
+from kubernetes_tpu.ops.solver import schedule_batch
+from kubernetes_tpu.state import Capacities, encode_cluster
+from tests.serial_reference import SerialScheduler
+
+CAPS = Capacities(num_nodes=8, batch_pods=4)
+
+jit_schedule = jax.jit(schedule_batch, static_argnames=("policy",))
+
+
+def row(batch, i=0):
+    return jax.tree.map(lambda a: a[i], batch)
+
+
+def mk_node(name, labels=None, cpu="4", mem="8Gi"):
+    return Node.from_dict({
+        "metadata": {"name": name, "labels": labels or {}},
+        "status": {"allocatable": {"cpu": cpu, "memory": mem, "pods": "110"},
+                   "conditions": [{"type": "Ready", "status": "True"}]},
+    })
+
+
+def aff_pod(name="p", required=None, preferred=None, selector=None):
+    affinity = {"nodeAffinity": {}}
+    if required is not None:
+        affinity["nodeAffinity"][
+            "requiredDuringSchedulingIgnoredDuringExecution"] = {
+            "nodeSelectorTerms": [{"matchExpressions": t} for t in required]}
+    if preferred is not None:
+        affinity["nodeAffinity"][
+            "preferredDuringSchedulingIgnoredDuringExecution"] = [
+            {"weight": w, "preference": {"matchExpressions": exprs}}
+            for w, exprs in preferred]
+    spec = {"containers": [{"name": "c"}], "affinity": affinity}
+    if selector:
+        spec["nodeSelector"] = selector
+    return Pod.from_dict({"metadata": {"name": name}, "spec": spec})
+
+
+def run_pred(nodes, pod):
+    state, batch, table = encode_cluster(nodes, [pod], CAPS)
+    out = np.asarray(preds.match_node_selector(state, row(batch)))
+    return {n.metadata.name: bool(out[table.row_of[n.metadata.name]])
+            for n in nodes}
+
+
+NODES = [
+    mk_node("a", {"zone": "z1", "disk": "ssd"}),
+    mk_node("b", {"zone": "z2"}),
+    mk_node("c", {"zone": "z1", "gen": "5"}),
+]
+
+
+class TestRequiredNodeAffinity:
+    def test_in(self):
+        got = run_pred(NODES, aff_pod(required=[
+            [{"key": "zone", "operator": "In", "values": ["z1"]}]]))
+        assert got == {"a": True, "b": False, "c": True}
+
+    def test_not_in_missing_key_satisfies(self):
+        got = run_pred(NODES, aff_pod(required=[
+            [{"key": "disk", "operator": "NotIn", "values": ["ssd"]}]]))
+        assert got == {"a": False, "b": True, "c": True}
+
+    def test_exists(self):
+        got = run_pred(NODES, aff_pod(required=[
+            [{"key": "disk", "operator": "Exists"}]]))
+        assert got == {"a": True, "b": False, "c": False}
+
+    def test_does_not_exist(self):
+        got = run_pred(NODES, aff_pod(required=[
+            [{"key": "disk", "operator": "DoesNotExist"}]]))
+        assert got == {"a": False, "b": True, "c": True}
+
+    def test_gt_lt(self):
+        got = run_pred(NODES, aff_pod(required=[
+            [{"key": "gen", "operator": "Gt", "values": ["3"]}]]))
+        assert got == {"a": False, "b": False, "c": True}
+        got = run_pred(NODES, aff_pod(required=[
+            [{"key": "gen", "operator": "Lt", "values": ["3"]}]]))
+        assert got == {"a": False, "b": False, "c": False}
+
+    def test_terms_are_ored(self):
+        got = run_pred(NODES, aff_pod(required=[
+            [{"key": "disk", "operator": "In", "values": ["ssd"]}],
+            [{"key": "zone", "operator": "In", "values": ["z2"]}]]))
+        assert got == {"a": True, "b": True, "c": False}
+
+    def test_expressions_are_anded(self):
+        got = run_pred(NODES, aff_pod(required=[
+            [{"key": "zone", "operator": "In", "values": ["z1"]},
+             {"key": "disk", "operator": "Exists"}]]))
+        assert got == {"a": True, "b": False, "c": False}
+
+    def test_empty_terms_match_nothing(self):
+        # non-nil NodeSelector with zero terms matches no nodes
+        # (predicates.go:655-659 comment cases 2-3)
+        got = run_pred(NODES, aff_pod(required=[]))
+        assert got == {"a": False, "b": False, "c": False}
+
+    def test_empty_expressions_term_matches_nothing(self):
+        # NodeSelectorRequirementsAsSelector(len==0) -> labels.Nothing
+        got = run_pred(NODES, aff_pod(required=[[]]))
+        assert got == {"a": False, "b": False, "c": False}
+
+    def test_parse_error_poisons_all_terms(self):
+        # nodeMatchesNodeSelectorTerms returns false outright on a bad term
+        got = run_pred(NODES, aff_pod(required=[
+            [{"key": "zone", "operator": "In", "values": ["z1"]}],
+            [{"key": "disk", "operator": "Bogus"}]]))
+        assert got == {"a": False, "b": False, "c": False}
+
+    def test_duplicate_expressions_collapse(self):
+        # duplicate (or sorted-equivalent) expressions in one term intern to
+        # one requirement id; the AND count must use distinct ids
+        got = run_pred(NODES, aff_pod(required=[
+            [{"key": "zone", "operator": "In", "values": ["z1", "z2"]},
+             {"key": "zone", "operator": "In", "values": ["z2", "z1"]}]]))
+        assert got == {"a": True, "b": True, "c": True}
+
+    def test_gt_rejects_non_go_integers(self):
+        # Go strconv.ParseInt fails on ' 7' and '1_0'; requirement fails closed
+        nodes = [mk_node("sp", {"gen": " 7"}), mk_node("us", {"gen": "1_0"}),
+                 mk_node("ok", {"gen": "7"})]
+        got = run_pred(nodes, aff_pod(required=[
+            [{"key": "gen", "operator": "Gt", "values": ["5"]}]]))
+        assert got == {"sp": False, "us": False, "ok": True}
+
+    def test_statedb_flush_uploads_req_member(self):
+        # a requirement first seen at pod-encode time must reach the device
+        # membership matrix on the next flush (review regression)
+        from kubernetes_tpu.state.pod_batch import empty_batch, encode_pod_into
+        from kubernetes_tpu.state.statedb import StateDB
+        db = StateDB(CAPS)
+        for n in NODES:
+            db.upsert_node(n)
+        db.flush()  # device state uploaded with no requirements interned
+        batch = empty_batch(CAPS)
+        pod = aff_pod(required=[[{"key": "zone", "operator": "In",
+                                  "values": ["z1"]}]])
+        encode_pod_into(batch, 0, pod, CAPS, db.table)
+        state = db.flush()
+        out = np.asarray(preds.match_node_selector(state, row(batch)))
+        got = {name: bool(out[db.table.row_of[name]]) for name in ("a", "b", "c")}
+        assert got == {"a": True, "b": False, "c": True}
+
+    def test_combines_with_node_selector(self):
+        got = run_pred(NODES, aff_pod(
+            selector={"zone": "z1"},
+            required=[[{"key": "disk", "operator": "Exists"}]]))
+        assert got == {"a": True, "b": False, "c": False}
+
+    def test_no_affinity_matches_all(self):
+        got = run_pred(NODES, Pod.from_dict(
+            {"metadata": {"name": "p"}, "spec": {"containers": [{"name": "c"}]}}))
+        assert got == {"a": True, "b": True, "c": True}
+
+    def test_serial_reference_agrees(self):
+        cases = [
+            aff_pod(required=[[{"key": "zone", "operator": "In", "values": ["z1"]}]]),
+            aff_pod(required=[[{"key": "disk", "operator": "NotIn", "values": ["ssd"]}]]),
+            aff_pod(required=[[{"key": "gen", "operator": "Gt", "values": ["3"]}]]),
+            aff_pod(required=[]),
+            aff_pod(required=[[]]),
+        ]
+        from tests.serial_reference import NodeState, match_selector
+        for pod in cases:
+            got = run_pred(NODES, pod)
+            want = {n.metadata.name: match_selector(NodeState.from_node(n), pod)
+                    for n in NODES}
+            assert got == want, pod.spec.affinity
+
+
+class TestNodeAffinityPriority:
+    def test_weighted_terms_normalize_to_ten(self):
+        pod = aff_pod(preferred=[
+            (80, [{"key": "zone", "operator": "In", "values": ["z1"]}]),
+            (20, [{"key": "disk", "operator": "Exists"}]),
+        ])
+        state, batch, table = encode_cluster(NODES, [pod], CAPS)
+        counts = np.asarray(prios.node_affinity_counts(state, row(batch)))
+        score = np.asarray(prios.node_affinity(state, row(batch)))
+        by = lambda arr: {n.metadata.name: float(arr[table.row_of[n.metadata.name]])
+                          for n in NODES}
+        assert by(counts) == {"a": 100.0, "b": 0.0, "c": 80.0}
+        assert by(score) == {"a": 10.0, "b": 0.0, "c": 8.0}
+
+    def test_zero_matches_all_zero(self):
+        pod = aff_pod(preferred=[(50, [{"key": "nope", "operator": "Exists"}])])
+        state, batch, _ = encode_cluster(NODES, [pod], CAPS)
+        assert (np.asarray(prios.node_affinity(state, row(batch))) == 0).all()
+
+    def test_weight_zero_term_skipped(self):
+        pod = aff_pod(preferred=[(0, [{"key": "zone", "operator": "Exists"}])])
+        state, batch, _ = encode_cluster(NODES, [pod], CAPS)
+        assert (np.asarray(prios.node_affinity_counts(state, row(batch))) == 0).all()
+
+
+AFF_POLICY = Policy(
+    predicates=("GeneralPredicates", "PodToleratesNodeTaints",
+                "CheckNodeMemoryPressure", "CheckNodeDiskPressure",
+                "CheckNodeCondition"),
+    priorities=(("LeastRequestedPriority", 1),
+                ("BalancedResourceAllocation", 1),
+                ("TaintTolerationPriority", 1),
+                ("NodeAffinityPriority", 1)),
+)
+
+
+def _random_affinity(rng):
+    ops = ["In", "NotIn", "Exists", "DoesNotExist", "Gt", "Lt"]
+    def expr():
+        op = ops[rng.randint(len(ops))]
+        key = rng.choice(["zone", "disk", "gen"])
+        if op in ("Exists", "DoesNotExist"):
+            return {"key": key, "operator": op}
+        if op in ("Gt", "Lt"):
+            return {"key": "gen", "operator": op, "values": [str(rng.randint(1, 9))]}
+        vals = list(rng.choice(["z0", "z1", "ssd", "hdd"],
+                               size=rng.randint(1, 3), replace=False))
+        return {"key": key, "operator": op, "values": vals}
+    required = None
+    if rng.rand() < 0.5:
+        required = [[expr() for _ in range(rng.randint(1, 3))]
+                    for _ in range(rng.randint(1, 3))]
+    preferred = None
+    if rng.rand() < 0.6:
+        preferred = [(int(rng.randint(1, 100)), [expr()])
+                     for _ in range(rng.randint(1, 3))]
+    return required, preferred
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_solver_serial_parity_with_affinity(seed):
+    rng = np.random.RandomState(seed + 100)
+    nodes = []
+    for i in range(10):
+        labels = {"zone": f"z{rng.randint(3)}"}
+        if rng.rand() < 0.4:
+            labels["disk"] = rng.choice(["ssd", "hdd"])
+        if rng.rand() < 0.4:
+            labels["gen"] = str(rng.randint(1, 9))
+        nodes.append(mk_node(f"n{i}", labels, cpu=f"{rng.randint(2, 9)}"))
+    pods = []
+    for i in range(16):
+        required, preferred = _random_affinity(rng)
+        pod = aff_pod(f"p{i}", required=required, preferred=preferred)
+        if rng.rand() < 0.7:
+            pod.spec.containers[0].requests = {"cpu": f"{rng.choice([250, 500, 1000])}m"}
+        pods.append(pod)
+
+    expected = SerialScheduler(nodes, with_node_affinity=True).schedule(pods)
+    caps = Capacities(num_nodes=16, batch_pods=16)
+    state, batch, table = encode_cluster(nodes, pods, caps)
+    result = jit_schedule(state, batch, 0, AFF_POLICY)
+    got = [table.name_of[int(result.assignments[i])]
+           if int(result.assignments[i]) >= 0 else None
+           for i in range(len(pods))]
+    assert got == expected
