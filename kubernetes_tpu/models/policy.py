@@ -34,13 +34,13 @@ DEFAULT_PRIORITIES: tuple[tuple[str, int], ...] = (
 KNOWN_PREDICATES = frozenset({
     "GeneralPredicates", "PodFitsResources", "PodFitsHost", "PodFitsHostPorts",
     "MatchNodeSelector", "PodToleratesNodeTaints", "CheckNodeMemoryPressure",
-    "CheckNodeDiskPressure", "CheckNodeCondition",
+    "CheckNodeDiskPressure", "CheckNodeCondition", "MatchInterPodAffinity",
 })
 
 KNOWN_PRIORITIES = frozenset({
     "LeastRequestedPriority", "MostRequestedPriority",
     "BalancedResourceAllocation", "TaintTolerationPriority", "EqualPriority",
-    "NodeAffinityPriority",
+    "NodeAffinityPriority", "InterPodAffinityPriority",
 })
 
 
@@ -48,6 +48,10 @@ KNOWN_PRIORITIES = frozenset({
 class Policy:
     predicates: tuple[str, ...] = DEFAULT_PREDICATES
     priorities: tuple[tuple[str, int], ...] = DEFAULT_PRIORITIES
+    # HardPodAffinitySymmetricWeight (api/types.go:50; default 1): the score
+    # granted per existing pod whose *required* affinity term matches the
+    # incoming pod, in InterPodAffinityPriority's symmetric pass.
+    hard_pod_affinity_weight: int = 1
 
     def __post_init__(self):
         unknown = set(self.predicates) - KNOWN_PREDICATES
@@ -82,7 +86,9 @@ class Policy:
         prios = tuple(
             (p["name"], int(p.get("weight", 1))) for p in d.get("priorities") or []
         ) or DEFAULT_PRIORITIES
-        return cls(predicates=preds, priorities=prios)
+        return cls(predicates=preds, priorities=prios,
+                   hard_pod_affinity_weight=int(
+                       d.get("hardPodAffinitySymmetricWeight", 1)))
 
     def to_json(self) -> str:
         return json.dumps({
@@ -90,6 +96,7 @@ class Policy:
             "apiVersion": "v1",
             "predicates": [{"name": n} for n in self.predicates],
             "priorities": [{"name": n, "weight": w} for n, w in self.priorities],
+            "hardPodAffinitySymmetricWeight": self.hard_pod_affinity_weight,
         })
 
 

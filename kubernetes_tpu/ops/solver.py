@@ -30,10 +30,15 @@ import jax.numpy as jnp
 from flax import struct
 
 from kubernetes_tpu.models.policy import DEFAULT_POLICY, Policy
+from kubernetes_tpu.ops import interpod
 from kubernetes_tpu.ops import predicates as preds
 from kubernetes_tpu.ops import priorities as prios
 from kubernetes_tpu.state.cluster_state import ClusterState
 from kubernetes_tpu.state.pod_batch import PodBatch
+
+# Domain-axis size for inter-pod affinity aggregates; must equal the encoding
+# Capacities.domain_universe (pass caps to schedule_batch to override).
+DEFAULT_DOMAIN_UNIVERSE = 64
 
 
 @struct.dataclass
@@ -96,11 +101,13 @@ def schedule_batch(
     batch: PodBatch,
     rr_start,
     policy: Policy = DEFAULT_POLICY,
+    caps=None,
 ) -> SolverResult:
     """Schedule a whole pending batch in one device program.
 
-    Pure function; jit with `policy` static. Returns per-pod assignments plus
-    the post-batch resource ledger for the host to commit (assume semantics).
+    Pure function; jit with `policy` (and `caps`, if given) static. Returns
+    per-pod assignments plus the post-batch resource ledger for the host to
+    commit (assume semantics).
     """
     use_resources = policy.has_predicate("GeneralPredicates", "PodFitsResources")
     use_ports = policy.has_predicate("GeneralPredicates", "PodFitsHostPorts")
@@ -108,6 +115,11 @@ def schedule_batch(
     w_ba = policy.weight("BalancedResourceAllocation")
     w_tt = policy.weight("TaintTolerationPriority")
     w_na = policy.weight("NodeAffinityPriority")
+    w_ip = policy.weight("InterPodAffinityPriority")
+    use_ipa = policy.has_predicate("MatchInterPodAffinity")
+    use_ip_ledger = use_ipa or bool(w_ip)
+    hard_w = float(policy.hard_pod_affinity_weight)
+    domain_universe = caps.domain_universe if caps else DEFAULT_DOMAIN_UNIVERSE
 
     # ---- Phase A: batched over (P, N) ----
     static_mask = jax.vmap(lambda p: _static_mask(state, p, policy))(batch)
@@ -125,7 +137,8 @@ def schedule_batch(
 
     # ---- Phase B: scan over the pod axis, vector over nodes ----
     def step(carry, xs):
-        requested, nonzero, port_count, rr = carry
+        requested, nonzero, port_count, rr = carry[:4]
+        ledger = carry[4] if use_ip_ledger else None
         pod, s_mask, s_score, p_counts, na_count = xs
 
         feasible = s_mask
@@ -134,6 +147,8 @@ def schedule_batch(
         if use_ports:
             feasible = feasible & preds.fits_host_ports(state, pod,
                                                         port_count=port_count)
+        if use_ipa:
+            feasible = feasible & interpod.interpod_feasible(state, pod, ledger)
 
         score = s_score
         if w_lr:
@@ -144,6 +159,9 @@ def schedule_batch(
             score = score + w_tt * prios.taint_toleration_from_counts(p_counts, feasible)
         if w_na:
             score = score + w_na * prios.normalized_from_counts(na_count, feasible)
+        if w_ip:
+            ip_counts = interpod.interpod_counts(state, pod, ledger, hard_w)
+            score = score + w_ip * interpod.interpod_score(ip_counts, feasible)
 
         masked = jnp.where(feasible, score, -jnp.inf)
         node, best, ntie = _select_host(masked, feasible, rr)
@@ -159,12 +177,18 @@ def schedule_batch(
 
         out = (node_idx, jnp.where(assigned, best, 0.0),
                jnp.sum(feasible.astype(jnp.int32)))
-        return (requested, nonzero, port_count, rr), out
+        new_carry = (requested, nonzero, port_count, rr)
+        if use_ip_ledger:
+            new_carry += (interpod.ledger_add(ledger, state, pod, node, add),)
+        return new_carry, out
 
     init = (state.requested, state.nonzero_requested, state.port_count,
             jnp.asarray(rr_start, jnp.uint32))
-    (requested, nonzero, port_count, rr), (nodes, scores, counts) = jax.lax.scan(
+    if use_ip_ledger:
+        init += (interpod.make_ledger(state, domain_universe),)
+    final_carry, (nodes, scores, counts) = jax.lax.scan(
         step, init, (batch, static_mask, static_score, prefer_counts, na_counts))
+    requested, nonzero, port_count, rr = final_carry[:4]
 
     return SolverResult(
         assignments=nodes,

@@ -50,7 +50,8 @@ from kubernetes_tpu.utils.hashing import hash32, hash_lanes
 NODE_AXIS_FIELDS = frozenset({
     "valid", "allocatable", "requested", "nonzero_requested", "port_count",
     "sel_member", "req_member", "taint_hard_member", "taint_prefer_member",
-    "conditions", "name_lo", "name_hi", "topology",
+    "conditions", "name_lo", "name_hi", "topology", "podsel_count",
+    "term_count",
 })
 
 
@@ -76,6 +77,16 @@ class ClusterState:
     name_lo: np.ndarray           # u32[N] node-name hash lanes
     name_hi: np.ndarray           # u32[N]
     topology: np.ndarray          # i32[N, TK] interned domain id, -1 = unknown
+    # inter-pod affinity state (see state/podaffinity.py)
+    podsel_count: np.ndarray      # f32[N, UQ] — pods on n matching selector q
+    term_count: np.ndarray        # f32[N, UE] — pods on n carrying term e
+    # carried-term attributes (dim 0 = UE, replicated across the mesh)
+    term_q: np.ndarray            # i32[UE] selector-universe id, -1 = empty slot
+    term_tkey: np.ndarray         # i32[UE] topo slot / TKEY_* sentinel
+    term_weight: np.ndarray       # f32[UE] signed preferred weight
+    term_kind: np.ndarray         # i32[UE] TermKind codes
+    term_poison: np.ndarray       # bool[UE] unparseable selector on a required
+                                  #          anti term: carriers poison scheduling
 
     @property
     def num_nodes(self) -> int:
@@ -102,6 +113,13 @@ def empty_state(caps: Capacities) -> ClusterState:
         name_lo=np.zeros((n,), np.uint32),
         name_hi=np.zeros((n,), np.uint32),
         topology=np.full((n, caps.topology_slots), -1, np.int32),
+        podsel_count=np.zeros((n, caps.podsel_universe), np.float32),
+        term_count=np.zeros((n, caps.term_universe), np.float32),
+        term_q=np.full((caps.term_universe,), -1, np.int32),
+        term_tkey=np.zeros((caps.term_universe,), np.int32),
+        term_weight=np.zeros((caps.term_universe,), np.float32),
+        term_kind=np.zeros((caps.term_universe,), np.int32),
+        term_poison=np.zeros((caps.term_universe,), np.bool_),
     )
 
 
@@ -209,13 +227,23 @@ class NodeTable:
         self.taints: dict[tuple[str, str, str], int] = {}
         self.ports: dict[int, int] = {}
         self.reqs: dict[tuple[str, str, tuple[str, ...]], int] = {}
+        # pod-selector universe: (namespaces, canonical selector) -> qid
+        self.podsels: dict[tuple, int] = {}
+        self.podsel_attrs: list[tuple] = []          # qid -> (ns_key, canon)
+        # carried-term universe: (qid, tkey_code, weight, kind, poison) -> eid
+        self.terms: dict[tuple, int] = {}
+        self.term_attrs: list[tuple] = []            # eid -> same tuple
         # terms interned after nodes were encoded: columns awaiting refill
         self.pending_sel_refresh: list[tuple[int, str, str]] = []
         self.pending_req_refresh: list[tuple[int, str, str, tuple[str, ...]]] = []
+        self.pending_podsel_refresh: list[int] = []  # qids needing pod refills
+        self.pending_topo_refresh: list[int] = []    # topo slots needing refills
+        self.dirty_term_attrs = False                # term attr arrays changed
         # per-row source data for refills on universe growth
         self.labels_of: list[dict[str, str] | None] = [None] * caps.num_nodes
-        # topology interning: per topology key, domain string -> id
-        self.domains: list[dict[str, int]] = [dict() for _ in TOPOLOGY_KEYS]
+        # topology interning: slot -> (domain value -> id); key -> slot
+        self.domains: list[dict] = [dict() for _ in range(caps.topology_slots)]
+        self.topo_key_of: dict[str, int] = {k: i for i, k in enumerate(TOPOLOGY_KEYS)}
 
     # ---- rows ----
 
@@ -302,13 +330,86 @@ class NodeTable:
         self.ports[port] = pid
         return pid
 
-    def intern_domain(self, key_idx: int, value: str) -> int:
+    def intern_domain(self, key_idx: int, value) -> int:
+        from kubernetes_tpu.state.layout import TOPO_HOSTNAME
+
         table = self.domains[key_idx]
         did = table.get(value)
         if did is None:
             did = len(table)
+            # hostname-slot domains are per-node (unbounded by design); all
+            # other slots must fit the device domain axis
+            if key_idx != TOPO_HOSTNAME and did >= self.caps.domain_universe:
+                raise CapacityError(
+                    f"domain universe {self.caps.domain_universe} exhausted "
+                    f"for topology slot {key_idx} interning {value!r}")
             table[value] = did
         return did
+
+    def intern_topo_key(self, key: str) -> int:
+        """Intern a custom topology key from an affinity term; newly seen keys
+        queue a topology-column refill."""
+        slot = self.topo_key_of.get(key)
+        if slot is not None:
+            return slot
+        from kubernetes_tpu.state.layout import FIRST_CUSTOM_TOPO
+
+        # next free slot after the defaults and the virtual composite slot
+        # (slot 3 is TOPO_ZONE_REGION, never present in topo_key_of)
+        slot = max(max(self.topo_key_of.values()) + 1, FIRST_CUSTOM_TOPO)
+        if slot >= self.caps.topology_slots:
+            raise CapacityError(
+                f"topology slots {self.caps.topology_slots} exhausted "
+                f"interning key {key!r}")
+        self.topo_key_of[key] = slot
+        self.pending_topo_refresh.append(slot)
+        return slot
+
+    def tkey_code(self, key: str, *, required: bool) -> int:
+        """Map a term's topologyKey to a device code: a topo slot, or
+        TKEY_INVALID (empty key on a required term fails everywhere,
+        predicates.go:1014,1162), or TKEY_DEFAULT_UNION (empty key on a
+        preferred term matches any default failure domain,
+        priorityutil.Topologies)."""
+        from kubernetes_tpu.state.layout import TKEY_DEFAULT_UNION, TKEY_INVALID
+
+        if not key:
+            return TKEY_INVALID if required else TKEY_DEFAULT_UNION
+        try:
+            return self.intern_topo_key(key)
+        except CapacityError:
+            if required:
+                raise
+            return TKEY_INVALID
+
+    def intern_podsel(self, ns_key: frozenset, canon) -> int:
+        entry = (ns_key, canon)
+        qid = self.podsels.get(entry)
+        if qid is not None:
+            return qid
+        if len(self.podsels) >= self.caps.podsel_universe:
+            raise CapacityError(
+                f"pod-selector universe {self.caps.podsel_universe} exhausted")
+        qid = len(self.podsels)
+        self.podsels[entry] = qid
+        self.podsel_attrs.append(entry)
+        self.pending_podsel_refresh.append(qid)
+        return qid
+
+    def intern_term(self, qid: int, tkey_code: int, weight: float, kind: int,
+                    poison: bool) -> int:
+        entry = (qid, tkey_code, float(weight), int(kind), bool(poison))
+        eid = self.terms.get(entry)
+        if eid is not None:
+            return eid
+        if len(self.terms) >= self.caps.term_universe:
+            raise CapacityError(
+                f"carried-term universe {self.caps.term_universe} exhausted")
+        eid = len(self.terms)
+        self.terms[entry] = eid
+        self.term_attrs.append(entry)
+        self.dirty_term_attrs = True
+        return eid
 
     def port_onehot(self, ports: Iterable[int]) -> np.ndarray:
         out = np.zeros((self.caps.port_universe,), np.float32)
@@ -353,12 +454,21 @@ def _fill_node_row(state: ClusterState, table: NodeTable, row: int, node: Node) 
             state.taint_prefer_member[row, tid] = 1.0
 
     state.topology[row] = -1
-    for ki, key in enumerate(TOPOLOGY_KEYS):
+    from kubernetes_tpu.state.layout import TOPO_HOSTNAME, TOPO_ZONE_REGION
+
+    for key, slot in table.topo_key_of.items():
         val = labels.get(key)
-        if key == "kubernetes.io/hostname" and val is None:
+        if slot == TOPO_HOSTNAME and val is None:
             val = node.metadata.name  # hostname domain defaults to node name
         if val is not None:
-            state.topology[row, ki] = table.intern_domain(ki, val)
+            state.topology[row, slot] = table.intern_domain(slot, val)
+    # virtual (zone, region) composite domain for default-union
+    # inclusion-exclusion (see layout.TOPO_ZONE_REGION)
+    z = labels.get(TOPOLOGY_KEYS[1])
+    r = labels.get(TOPOLOGY_KEYS[2])
+    if z is not None and r is not None:
+        state.topology[row, TOPO_ZONE_REGION] = table.intern_domain(
+            TOPO_ZONE_REGION, (z, r))
 
 
 def apply_pending_refreshes(state: ClusterState, table: NodeTable) -> bool:
@@ -378,6 +488,27 @@ def apply_pending_refreshes(state: ClusterState, table: NodeTable) -> bool:
             if labels is not None and match_requirement(labels, key, op, values):
                 state.req_member[row, rid] = 1.0
     table.pending_req_refresh.clear()
+    # topology columns for custom keys interned after nodes were encoded
+    if table.pending_topo_refresh:
+        slot_key = {s: k for k, s in table.topo_key_of.items()}
+        for slot in table.pending_topo_refresh:
+            changed = True
+            key = slot_key[slot]
+            for row, labels in enumerate(table.labels_of):
+                if labels is not None and key in labels:
+                    state.topology[row, slot] = table.intern_domain(
+                        slot, labels[key])
+        table.pending_topo_refresh.clear()
+    # carried-term attribute rows (tiny, replicated)
+    if table.dirty_term_attrs:
+        changed = True
+        for eid, (qid, tk, w, kind, poison) in enumerate(table.term_attrs):
+            state.term_q[eid] = qid
+            state.term_tkey[eid] = tk
+            state.term_weight[eid] = w
+            state.term_kind[eid] = kind
+            state.term_poison[eid] = poison
+        table.dirty_term_attrs = False
     return changed
 
 
@@ -409,12 +540,72 @@ def pod_nonzero_requests(pod: Pod) -> np.ndarray:
     return np.array([cpu, mem], np.float32)
 
 
+def intern_pod_affinity_terms(table: NodeTable, pod: Pod):
+    """Intern every pod-affinity term a pod carries into the selector and
+    carried-term universes. Returns (carried eids, parsed terms)."""
+    from kubernetes_tpu.state.layout import TermKind
+    from kubernetes_tpu.state.podaffinity import PARSE_ERROR, parse_pod_affinity
+
+    terms = parse_pod_affinity(pod.spec.affinity, pod.metadata.namespace)
+    eids: list[int] = []
+    for kind, lst, required in (
+        (TermKind.ANTI_REQ, terms.anti_req, True),
+        (TermKind.AFF_REQ, terms.aff_req, True),
+        (TermKind.AFF_PREF, terms.aff_pref, False),
+        (TermKind.ANTI_PREF, terms.anti_pref, False),
+    ):
+        for t in lst:
+            qid = table.intern_podsel(t.namespaces, t.selector)
+            tk = table.tkey_code(t.topology_key, required=required)
+            if kind == TermKind.AFF_PREF:
+                w = float(t.weight)
+            elif kind == TermKind.ANTI_PREF:
+                w = -float(t.weight)
+            else:
+                w = 0.0
+            # a required anti term whose selector cannot be parsed poisons
+            # scheduling for every incoming pod while a carrier exists
+            # (getMatchingAntiAffinityTerms error path, predicates.go:1108)
+            poison = kind == TermKind.ANTI_REQ and t.selector == PARSE_ERROR
+            eids.append(table.intern_term(qid, tk, w, kind, poison))
+    return eids, terms
+
+
+def pod_match_row(table: NodeTable, pod: Pod) -> np.ndarray:
+    """f32[UQ]: which pod-selector-universe entries this pod matches
+    (PodMatchesTermsNamespaceAndSelector against every interned entry)."""
+    from kubernetes_tpu.state.podaffinity import pod_matches_entry
+
+    out = np.zeros((table.caps.podsel_universe,), np.float32)
+    for qid, (ns_key, canon) in enumerate(table.podsel_attrs):
+        if pod_matches_entry(pod, ns_key, canon):
+            out[qid] = 1.0
+    return out
+
+
+def carried_term_row(table: NodeTable, eids) -> np.ndarray:
+    """f32[UE]: carried-term multiplicity row for one pod."""
+    out = np.zeros((table.caps.term_universe,), np.float32)
+    for e in eids:
+        out[e] += 1.0
+    return out
+
+
 def add_pod_to_state(state: ClusterState, table: NodeTable, pod: Pod, row: int) -> None:
     """Account an assigned pod against a node row (the analog of
-    NodeInfo.addPod, node_info.go:171)."""
+    NodeInfo.addPod, node_info.go:171).
+
+    NOTE on ordering: this matches the pod against the selector universe as
+    interned *now* — when batch-encoding fixtures, intern every pod's terms
+    (intern_pod_affinity_terms) before accounting any pod, or counts for
+    later-interned selectors will miss earlier pods. Incremental flows
+    (StateDB) refill via pending_podsel_refresh instead."""
     state.requested[row] += pod_requests(pod)
     state.nonzero_requested[row] += pod_nonzero_requests(pod)
     state.port_count[row] += table.port_onehot(pod.host_ports())
+    eids, _ = intern_pod_affinity_terms(table, pod)
+    state.term_count[row] += carried_term_row(table, eids)
+    state.podsel_count[row] += pod_match_row(table, pod)
     table.bump(row)
 
 
@@ -438,20 +629,25 @@ def encode_nodes(
             table.release_row(gone)
         nodes = node_list
     table = table or NodeTable(caps)
-    # re-materialize universe taint attributes when reusing a table
+    # re-materialize universe taint and term attributes when reusing a table
     for (key, value, effect), tid in table.taints.items():
         state.taint_u_key[tid] = hash32(key)
         val_lo, val_hi = hash_lanes(value)
         state.taint_u_val_lo[tid] = val_lo
         state.taint_u_val_hi[tid] = val_hi
         state.taint_u_effect[tid] = Effect.NAMES.get(effect, Effect.NONE)
+    if table.term_attrs:
+        table.dirty_term_attrs = True
     for node in nodes:
         row = table.assign_row(node.metadata.name)
         _fill_node_row(state, table, row, node)
         table.bump(row)
-    for pod in assigned_pods:
-        if not pod.spec.node_name:
-            continue
+    # intern every assigned pod's terms before accounting any, so selector
+    # counts are complete regardless of order (see add_pod_to_state)
+    bound = [p for p in assigned_pods if p.spec.node_name]
+    for pod in bound:
+        intern_pod_affinity_terms(table, pod)
+    for pod in bound:
         row = table.row_of.get(pod.spec.node_name)
         if row is None:
             continue  # pod bound to an unknown node: ignored, like cache misses

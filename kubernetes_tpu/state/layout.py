@@ -93,11 +93,37 @@ class Condition:
 
 
 # Topology keys interned into the per-node topology table, in row order.
+# Slots 0-2 are the reference's default failure domains
+# (kubeletapis.DefaultFailureDomains); slot 3 is a virtual composite
+# (zone, region) domain used for inclusion-exclusion when a preferred
+# pod-anti-affinity term has an empty topologyKey ("match any default
+# domain", priorityutil.Topologies). Custom topology keys from affinity
+# terms intern from slot FIRST_CUSTOM_TOPO up to `topology_slots`.
 TOPOLOGY_KEYS = (
     "kubernetes.io/hostname",
     "failure-domain.beta.kubernetes.io/zone",
     "failure-domain.beta.kubernetes.io/region",
 )
+TOPO_HOSTNAME = 0
+TOPO_ZONE = 1
+TOPO_REGION = 2
+TOPO_ZONE_REGION = 3   # virtual composite slot
+FIRST_CUSTOM_TOPO = 4
+
+# Sentinel topology-slot codes used in affinity-term encodings.
+TKEY_INVALID = -1       # empty/uninternable topologyKey on a required term
+TKEY_DEFAULT_UNION = -2  # empty topologyKey on a preferred term: any default domain
+
+
+class TermKind:
+    """Carried pod-affinity-term kinds (the existing-pod side of matching:
+    predicates.go getMatchingAntiAffinityTerms + interpod_affinity.go
+    symmetric weighting)."""
+
+    ANTI_REQ = 0   # required anti-affinity: predicate, hard fail
+    AFF_REQ = 1    # required affinity: priority, weight = hardPodAffinityWeight
+    AFF_PREF = 2   # preferred affinity: priority, +weight
+    ANTI_PREF = 3  # preferred anti-affinity: priority, -weight
 
 # Scoring-time defaults for pods with no requests (reference
 # plugin/pkg/scheduler/algorithm/priorities/util/non_zero.go:29-31).
@@ -128,10 +154,15 @@ class Capacities:
     taint_universe: int = 64       # UT: distinct (key, value, effect) taints
     port_universe: int = 64        # UP: distinct host ports in use
     req_universe: int = 64         # UR: distinct NodeSelectorRequirements
+    podsel_universe: int = 32      # UQ: distinct (namespaces, labelSelector)
+    term_universe: int = 32        # UE: distinct carried pod-affinity terms
+    domain_universe: int = 64      # D: domains per non-hostname topology slot
     toleration_slots: int = 8      # tolerations per pod
-    topology_slots: int = len(TOPOLOGY_KEYS)
+    topology_slots: int = 8        # 3 defaults + 1 virtual + custom keys
     affinity_terms: int = 4        # required node-affinity OR-terms per pod
     pref_terms: int = 4            # preferred node-affinity terms per pod
+    interpod_slots: int = 4        # required pod-(anti-)affinity terms per pod
+    interpod_pref_slots: int = 4   # preferred pod-(anti-)affinity terms per pod
 
 
 class CapacityError(ValueError):

@@ -25,6 +25,9 @@ from kubernetes_tpu.state.cluster_state import (
     ClusterState,
     NodeTable,
     apply_pending_refreshes,
+    carried_term_row,
+    intern_pod_affinity_terms,
+    pod_match_row,
     pod_nonzero_requests,
     pod_requests,
 )
@@ -58,6 +61,19 @@ class PodBatch:
     pref_onehot: np.ndarray     # f32[P, TP, UR]
     pref_count: np.ndarray      # f32[P, TP]
     pref_weight: np.ndarray     # f32[P, TP] — 0 for unused/invalid slots
+    # inter-pod affinity (state/podaffinity.py; ops/interpod.py)
+    pod_matches_q: np.ndarray   # f32[P, UQ] — pod matches selector entry q
+    pod_carries_e: np.ndarray   # f32[P, UE] — carried-term multiplicities
+    paff_q: np.ndarray          # i32[P, IA] required affinity: selector id, -1 unused
+    paff_tkey: np.ndarray       # i32[P, IA] topo slot (TKEY_INVALID impossible
+                                #            here — encoded via ipaff_fail)
+    panti_q: np.ndarray         # i32[P, IA] required anti-affinity
+    panti_tkey: np.ndarray      # i32[P, IA]
+    ipaff_fail: np.ndarray      # bool[P] — a required term is unschedulable
+                                #           (empty topologyKey / bad selector)
+    ppref_q: np.ndarray         # i32[P, IP] preferred terms, -1 unused
+    ppref_tkey: np.ndarray      # i32[P, IP] slot or TKEY_DEFAULT_UNION
+    ppref_w: np.ndarray         # f32[P, IP] signed weight (anti negative)
 
     @property
     def batch_pods(self) -> int:
@@ -88,6 +104,16 @@ def empty_batch(caps: Capacities) -> PodBatch:
         pref_onehot=np.zeros((p, caps.pref_terms, caps.req_universe), np.float32),
         pref_count=np.zeros((p, caps.pref_terms), np.float32),
         pref_weight=np.zeros((p, caps.pref_terms), np.float32),
+        pod_matches_q=np.zeros((p, caps.podsel_universe), np.float32),
+        pod_carries_e=np.zeros((p, caps.term_universe), np.float32),
+        paff_q=np.full((p, caps.interpod_slots), -1, np.int32),
+        paff_tkey=np.zeros((p, caps.interpod_slots), np.int32),
+        panti_q=np.full((p, caps.interpod_slots), -1, np.int32),
+        panti_tkey=np.zeros((p, caps.interpod_slots), np.int32),
+        ipaff_fail=np.zeros((p,), np.bool_),
+        ppref_q=np.full((p, caps.interpod_pref_slots), -1, np.int32),
+        ppref_tkey=np.zeros((p, caps.interpod_pref_slots), np.int32),
+        ppref_w=np.zeros((p, caps.interpod_pref_slots), np.float32),
     )
 
 
@@ -130,6 +156,69 @@ def encode_pod_into(batch: PodBatch, i: int, pod: Pod, caps: Capacities,
         batch.node_name_hi[i] = 0
     batch.best_effort[i] = pod.is_best_effort()
     _encode_node_affinity(batch, i, pod, caps, table)
+    _encode_interpod_affinity(batch, i, pod, caps, table)
+
+
+def _encode_interpod_affinity(batch: PodBatch, i: int, pod: Pod,
+                              caps: Capacities, table: NodeTable) -> None:
+    """Encode the pod's own pod-(anti-)affinity terms and (provisionally) its
+    match/carry rows. The rows depend on the *final* universe contents, so
+    batch encoders must re-run fill_batch_affinity after every pod has
+    interned its terms; the inline fill here keeps the single-pod path
+    (extender) correct without a second call."""
+    from kubernetes_tpu.state.layout import TKEY_INVALID
+    from kubernetes_tpu.state.podaffinity import PARSE_ERROR
+
+    eids, terms = intern_pod_affinity_terms(table, pod)
+
+    fail = False
+    for lst, q_arr, tk_arr in ((terms.aff_req, batch.paff_q, batch.paff_tkey),
+                               (terms.anti_req, batch.panti_q, batch.panti_tkey)):
+        if len(lst) > caps.interpod_slots:
+            raise CapacityError(
+                f"pod {pod.key}: {len(lst)} required pod-affinity terms > "
+                f"{caps.interpod_slots} slots")
+        q_arr[i] = -1
+        for t_idx, t in enumerate(lst):
+            tk = table.tkey_code(t.topology_key, required=True)
+            if tk == TKEY_INVALID or t.selector == PARSE_ERROR:
+                # empty topologyKey or unparseable selector on a required
+                # term: the pod cannot schedule anywhere
+                # (predicates.go:1014,1162,1191-1196)
+                fail = True
+                continue
+            q_arr[i, t_idx] = table.intern_podsel(t.namespaces, t.selector)
+            tk_arr[i, t_idx] = tk
+    batch.ipaff_fail[i] = fail
+
+    pref = ([(t, +1.0) for t in terms.aff_pref]
+            + [(t, -1.0) for t in terms.anti_pref])
+    pref = [(t, sign) for t, sign in pref if t.weight != 0]
+    if len(pref) > caps.interpod_pref_slots:
+        raise CapacityError(
+            f"pod {pod.key}: {len(pref)} preferred pod-affinity terms > "
+            f"{caps.interpod_pref_slots} slots")
+    batch.ppref_q[i] = -1
+    for t_idx, (t, sign) in enumerate(pref):
+        batch.ppref_q[i, t_idx] = table.intern_podsel(t.namespaces, t.selector)
+        batch.ppref_tkey[i, t_idx] = table.tkey_code(t.topology_key,
+                                                     required=False)
+        batch.ppref_w[i, t_idx] = sign * float(t.weight)
+
+    batch.pod_matches_q[i] = pod_match_row(table, pod)
+    batch.pod_carries_e[i] = carried_term_row(table, eids)
+
+
+def fill_batch_affinity(batch: PodBatch, pods: Sequence[Pod],
+                        table: NodeTable) -> None:
+    """Recompute match/carry rows once the universes are final (terms
+    interned by later pods in the batch, or by assigned pods)."""
+    if not table.podsels and not table.terms:
+        return  # no affinity anywhere: rows are already all-zero
+    for i, pod in enumerate(pods):
+        eids, _ = intern_pod_affinity_terms(table, pod)
+        batch.pod_matches_q[i] = pod_match_row(table, pod)
+        batch.pod_carries_e[i] = carried_term_row(table, eids)
 
 
 def _valid_requirement(expr: dict) -> bool:
@@ -215,18 +304,25 @@ def encode_pods(pods: Sequence[Pod], caps: Capacities, table: NodeTable,
     batch = empty_batch(caps)
     for i, pod in enumerate(pods):
         encode_pod_into(batch, i, pod, caps, table)
+    fill_batch_affinity(batch, pods, table)
     if state is not None:
         apply_pending_refreshes(state, table)
     return batch
 
 
-def encode_cluster(nodes, pods, caps: Capacities):
-    """One-shot fixture encoding: nodes + pending pods with a shared
-    universe, membership fully consistent. Returns (state, batch, table)."""
+def encode_cluster(nodes, pods, caps: Capacities, assigned_pods=()):
+    """One-shot fixture encoding: nodes (+ assigned pods) + pending pods with
+    a shared universe, membership fully consistent. Returns
+    (state, batch, table)."""
     from kubernetes_tpu.state.cluster_state import encode_nodes
 
     table = NodeTable(caps)
     batch = encode_pods(pods, caps, table)
-    state, _ = encode_nodes(nodes, caps, table=table)
+    state, _ = encode_nodes(nodes, caps, assigned_pods=assigned_pods,
+                            table=table)
+    # assigned pods may have interned new selector entries: refresh the
+    # batch's match rows against the final universes
+    fill_batch_affinity(batch, pods, table)
     apply_pending_refreshes(state, table)
+    table.pending_podsel_refresh.clear()  # counts were built post-interning
     return state, batch, table

@@ -1,0 +1,142 @@
+"""Host-side pod-affinity term parsing, canonicalization and matching.
+
+The irregular half of inter-pod (anti-)affinity (reference
+plugin/pkg/scheduler/algorithm/predicates/predicates.go:982-1240 and
+priorities/interpod_affinity.go): v1 `PodAffinityTerm`s carry a
+`metav1.LabelSelector` plus a namespace list plus a topology key. All string
+work happens here on the host — selectors are canonicalized and interned into
+the pod-selector universe (cluster_state.NodeTable), pods are matched against
+universe entries when encoded or accounted, and the device only ever sees
+integer ids, one-hot match rows and per-node/per-domain counts.
+
+Semantics mirrored:
+- `metav1.LabelSelectorAsSelector`: nil selector -> labels.Nothing (matches
+  no pods); empty selector -> labels.Everything; matchLabels entries become
+  In requirements; only In/NotIn/Exists/DoesNotExist are legal operators.
+- `priorityutil.GetNamespacesFromPodAffinityTerm`: an empty namespace list
+  means the namespace of the pod *carrying* the term.
+- `priorityutil.PodMatchesTermsNamespaceAndSelector`: namespace membership
+  AND selector match.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any
+
+from kubernetes_tpu.api.objects import Pod
+
+# Canonical selector forms:
+#   NOTHING          — nil selector, matches no pods
+#   PARSE_ERROR      — invalid selector, poisons the carrying term
+#   ()               — empty selector, matches everything
+#   ((key, op, values), ...) — conjunction of requirements
+NOTHING = "<nothing>"
+PARSE_ERROR = "<error>"
+
+_SEL_OPS = ("In", "NotIn", "Exists", "DoesNotExist")
+
+
+def canonical_selector(selector: dict | None):
+    """Canonicalize a metav1.LabelSelector dict."""
+    if selector is None:
+        return NOTHING
+    reqs = []
+    for k in sorted(selector.get("matchLabels") or {}):
+        reqs.append((k, "In", (selector["matchLabels"][k],)))
+    for e in selector.get("matchExpressions") or []:
+        op = e.get("operator", "")
+        values = tuple(sorted(e.get("values") or ()))
+        if op not in _SEL_OPS:
+            return PARSE_ERROR
+        if op in ("In", "NotIn") and not values:
+            return PARSE_ERROR
+        if op in ("Exists", "DoesNotExist") and values:
+            return PARSE_ERROR
+        reqs.append((e.get("key", ""), op, values))
+    return tuple(sorted(reqs))
+
+
+def selector_matches(canon, labels: dict[str, str]) -> bool:
+    if canon == NOTHING or canon == PARSE_ERROR:
+        return False
+    from kubernetes_tpu.state.cluster_state import match_requirement
+
+    return all(match_requirement(labels, k, op, values)
+               for k, op, values in canon)
+
+
+@dataclass(frozen=True)
+class ParsedTerm:
+    """One PodAffinityTerm with namespaces resolved against its carrier."""
+
+    selector: Any                 # canonical selector form
+    namespaces: frozenset[str]
+    topology_key: str             # "" = empty (meaning depends on term kind)
+    weight: int = 0               # preferred terms only
+
+    @property
+    def universe_key(self):
+        return (self.namespaces, self.selector)
+
+    def matches_pod(self, pod: Pod) -> bool:
+        return (pod.metadata.namespace in self.namespaces
+                and selector_matches(self.selector, pod.metadata.labels))
+
+
+def _parse_term(term: dict, carrier_namespace: str, weight: int = 0) -> ParsedTerm:
+    namespaces = frozenset(term.get("namespaces") or [carrier_namespace])
+    return ParsedTerm(
+        selector=canonical_selector(term.get("labelSelector")),
+        namespaces=namespaces,
+        topology_key=term.get("topologyKey", "") or "",
+        weight=weight,
+    )
+
+
+@dataclass
+class PodAffinityTerms:
+    """All four term lists of one pod, parsed."""
+
+    aff_req: list[ParsedTerm]
+    anti_req: list[ParsedTerm]
+    aff_pref: list[ParsedTerm]
+    anti_pref: list[ParsedTerm]
+
+    @property
+    def empty(self) -> bool:
+        return not (self.aff_req or self.anti_req or self.aff_pref
+                    or self.anti_pref)
+
+    @property
+    def has_required(self) -> bool:
+        return bool(self.aff_req or self.anti_req)
+
+
+def parse_pod_affinity(affinity: dict | None, carrier_namespace: str) -> PodAffinityTerms:
+    """Extract the four PodAffinityTerm lists from a raw v1 Affinity dict
+    (getPodAffinityTerms/getPodAntiAffinityTerms, predicates.go:1039-1063)."""
+    aff = (affinity or {}).get("podAffinity") or {}
+    anti = (affinity or {}).get("podAntiAffinity") or {}
+
+    def required(src):
+        return [_parse_term(t, carrier_namespace)
+                for t in src.get("requiredDuringSchedulingIgnoredDuringExecution") or []]
+
+    def preferred(src):
+        return [_parse_term(p.get("podAffinityTerm") or {}, carrier_namespace,
+                            weight=int(p.get("weight", 0)))
+                for p in src.get("preferredDuringSchedulingIgnoredDuringExecution") or []]
+
+    return PodAffinityTerms(
+        aff_req=required(aff),
+        anti_req=required(anti),
+        aff_pref=preferred(aff),
+        anti_pref=preferred(anti),
+    )
+
+
+def pod_matches_entry(pod: Pod, ns_key: frozenset, canon) -> bool:
+    """PodMatchesTermsNamespaceAndSelector for a universe entry."""
+    return (pod.metadata.namespace in ns_key
+            and selector_matches(canon, pod.metadata.labels))

@@ -28,17 +28,36 @@ import numpy as np
 
 import jax
 
+from dataclasses import dataclass
+
 from kubernetes_tpu.api.objects import Node, Pod
 from kubernetes_tpu.state.cluster_state import (
     ClusterState,
     NodeTable,
     _fill_node_row,
     apply_pending_refreshes,
+    carried_term_row,
     empty_state,
+    intern_pod_affinity_terms,
+    pod_match_row,
     pod_nonzero_requests,
     pod_requests,
 )
 from kubernetes_tpu.state.layout import Capacities
+
+
+@dataclass
+class AccountedPod:
+    """Removal + refill record for one accounted pod."""
+
+    node_name: str
+    requests: np.ndarray
+    nonzero: np.ndarray
+    port_onehot: np.ndarray
+    match_row: np.ndarray    # f32[UQ] at accounting time (refilled on growth)
+    carry_row: np.ndarray    # f32[UE] carried-term multiplicities
+    namespace: str
+    labels: dict
 
 
 class StateDB:
@@ -47,10 +66,10 @@ class StateDB:
         self.mesh = mesh
         self.host: ClusterState = empty_state(caps)
         self.table = NodeTable(caps)
-        # pod key -> (node_name, requests, nonzero, port_onehot) for removal
-        self._accounted: dict[str, tuple[str, np.ndarray, np.ndarray, np.ndarray]] = {}
-        self._dirty_nodes = True   # static node fields changed
-        self._dirty_ledger = True  # requested/nonzero/ports changed on host
+        self._accounted: dict[str, AccountedPod] = {}
+        self._dirty_nodes = True    # static node fields changed
+        self._dirty_ledger = True   # requested/nonzero/ports changed on host
+        self._dirty_affinity = False  # podsel/term counts changed on host only
         self._device: ClusterState | None = None
 
     # ---- node lifecycle ----
@@ -65,7 +84,8 @@ class StateDB:
         if name not in self.table.row_of:
             return
         row = self.table.release_row(name)
-        for key in [k for k, v in self._accounted.items() if v[0] == name]:
+        for key in [k for k, v in self._accounted.items()
+                    if v.node_name == name]:
             del self._accounted[key]
         from kubernetes_tpu.state.cluster_state import NODE_AXIS_FIELDS
         for field in NODE_AXIS_FIELDS:
@@ -79,10 +99,12 @@ class StateDB:
 
     # ---- pod accounting (bound + assumed) ----
 
-    def _apply_pod(self, row: int, req, nz, port_onehot: np.ndarray, sign: int) -> None:
-        self.host.requested[row] += sign * req
-        self.host.nonzero_requested[row] += sign * nz
-        self.host.port_count[row] += sign * port_onehot
+    def _apply_pod(self, row: int, acc: AccountedPod, sign: int) -> None:
+        self.host.requested[row] += sign * acc.requests
+        self.host.nonzero_requested[row] += sign * acc.nonzero
+        self.host.port_count[row] += sign * acc.port_onehot
+        self.host.podsel_count[row] += sign * acc.match_row
+        self.host.term_count[row] += sign * acc.carry_row
         self.table.bump(row)
 
     def add_pod(self, pod: Pod, node_name: str | None = None, *,
@@ -91,7 +113,10 @@ class StateDB:
         unknown (cache-miss pods are skipped, like the reference cache).
 
         mirror_only: host-side bookkeeping for a change already present in
-        the device ledger (commit_ledger path) — don't mark dirty.
+        the device ledger (commit_ledger path) — don't mark dirty. The
+        inter-pod affinity rows are NOT in the solver's output ledger, so
+        they are applied to the host and flushed on membership dirtiness
+        like other universe state.
         """
         node_name = node_name or pod.spec.node_name
         row = self.table.row_of.get(node_name)
@@ -99,24 +124,31 @@ class StateDB:
             return False
         if pod.key in self._accounted:
             return True  # already accounted (assume then confirm)
-        req = pod_requests(pod)
-        nz = pod_nonzero_requests(pod)
-        onehot = self.table.port_onehot(pod.host_ports())
-        self._apply_pod(row, req, nz, onehot, +1)
-        self._accounted[pod.key] = (node_name, req, nz, onehot)
+        eids, _ = intern_pod_affinity_terms(self.table, pod)
+        acc = AccountedPod(
+            node_name=node_name,
+            requests=pod_requests(pod),
+            nonzero=pod_nonzero_requests(pod),
+            port_onehot=self.table.port_onehot(pod.host_ports()),
+            match_row=pod_match_row(self.table, pod),
+            carry_row=carried_term_row(self.table, eids),
+            namespace=pod.metadata.namespace,
+            labels=dict(pod.metadata.labels),
+        )
+        self._apply_pod(row, acc, +1)
+        self._accounted[pod.key] = acc
         if not mirror_only:
             self._dirty_ledger = True
         return True
 
     def remove_pod(self, pod_key: str) -> None:
-        entry = self._accounted.pop(pod_key, None)
-        if entry is None:
+        acc = self._accounted.pop(pod_key, None)
+        if acc is None:
             return
-        node_name, req, nz, onehot = entry
-        row = self.table.row_of.get(node_name)
+        row = self.table.row_of.get(acc.node_name)
         if row is None:
             return  # node vanished; its rows were zeroed already
-        self._apply_pod(row, req, nz, onehot, -1)
+        self._apply_pod(row, acc, -1)
         self._dirty_ledger = True
 
     def is_accounted(self, pod_key: str) -> bool:
@@ -130,14 +162,35 @@ class StateDB:
 
     # ---- device mirror ----
 
+    def _refill_podsel(self) -> None:
+        """Fill podsel_count columns for selector entries interned after pods
+        were accounted (the accounted-pod analog of membership refills)."""
+        if not self.table.pending_podsel_refresh:
+            return
+        from kubernetes_tpu.state.podaffinity import selector_matches
+
+        for qid in self.table.pending_podsel_refresh:
+            ns_key, canon = self.table.podsel_attrs[qid]
+            for acc in self._accounted.values():
+                if acc.match_row[qid]:
+                    continue  # accounted after the intern: already counted
+                if acc.namespace in ns_key and selector_matches(canon, acc.labels):
+                    row = self.table.row_of.get(acc.node_name)
+                    if row is not None:
+                        self.host.podsel_count[row, qid] += 1.0
+                        acc.match_row[qid] = 1.0
+        self.table.pending_podsel_refresh.clear()
+        self._dirty_affinity = True
+
     def flush(self) -> ClusterState:
         """Return the device view, re-uploading only what changed. Newly
         interned selector terms / requirements (from pod encoding) refill
         their membership columns first."""
+        self._refill_podsel()
         dirty_membership = apply_pending_refreshes(self.host, self.table)
         if self._device is None or self._dirty_nodes:
             dev = self._put(self.host)
-        elif self._dirty_ledger or dirty_membership:
+        elif self._dirty_ledger or dirty_membership or self._dirty_affinity:
             dev = self._device
             if self._dirty_ledger:
                 dev = dev.replace(
@@ -145,15 +198,27 @@ class StateDB:
                     nonzero_requested=self._put_arr(self.host.nonzero_requested),
                     port_count=self._put_arr(self.host.port_count),
                 )
+            if (self._dirty_ledger or self._dirty_affinity) and self.table.podsels:
+                dev = dev.replace(
+                    podsel_count=self._put_arr(self.host.podsel_count),
+                    term_count=self._put_arr(self.host.term_count))
             if dirty_membership:
                 dev = dev.replace(
                     sel_member=self._put_arr(self.host.sel_member),
-                    req_member=self._put_arr(self.host.req_member))
+                    req_member=self._put_arr(self.host.req_member),
+                    topology=self._put_arr(self.host.topology),
+                    term_q=jax.device_put(np.asarray(self.host.term_q)),
+                    term_tkey=jax.device_put(np.asarray(self.host.term_tkey)),
+                    term_weight=jax.device_put(np.asarray(self.host.term_weight)),
+                    term_kind=jax.device_put(np.asarray(self.host.term_kind)),
+                    term_poison=jax.device_put(np.asarray(self.host.term_poison)),
+                )
         else:
             return self._device
         self._device = dev
         self._dirty_nodes = False
         self._dirty_ledger = False
+        self._dirty_affinity = False
         return dev
 
     def commit_ledger(self, new_requested, new_nonzero, new_port_count,
@@ -167,6 +232,11 @@ class StateDB:
             port_count=new_port_count)
         for pod, node_name in assignments:
             self.add_pod(pod, node_name, mirror_only=True)
+            acc = self._accounted.get(pod.key)
+            # the solver's output ledger does not include inter-pod affinity
+            # counts; if this pod affects them, the next flush re-uploads
+            if acc is not None and (acc.match_row.any() or acc.carry_row.any()):
+                self._dirty_affinity = True
 
     def _put(self, state: ClusterState) -> ClusterState:
         if self.mesh is not None:

@@ -135,47 +135,23 @@ def fits_ports(ns: NodeState, pod: Pod) -> bool:
     return not (pod_ports(pod) & ns.ports)
 
 
+# Label-requirement semantics are shared with production: match_requirement /
+# _valid_requirement are themselves pinned by table tests against the
+# documented Go behavior, and this oracle's independence lives at the
+# scheduler-decision level (predicates -> scores -> selectHost), not in
+# re-implementing apimachinery's selector grammar a third time.
 def _match_expression(labels: dict, expr: dict) -> bool:
-    """labels.Requirement.Matches semantics (apimachinery selector.go)."""
-    key = expr.get("key", "")
-    op = expr.get("operator", "")
-    values = expr.get("values") or []
-    has = key in labels
-    if op == "In":
-        return has and labels[key] in values
-    if op == "NotIn":
-        return not has or labels[key] not in values
-    if op == "Exists":
-        return has
-    if op == "DoesNotExist":
-        return not has
-    if op in ("Gt", "Lt"):
-        if not has or len(values) != 1:
-            return False
-        # Go strconv.ParseInt: sign + digits only, fail closed
-        def go_int(s):
-            body = s[1:] if s[:1] in "+-" else s
-            if not body or not body.isascii() or not body.isdigit():
-                return None
-            v = int(s)
-            return v if -(2**63) <= v <= 2**63 - 1 else None
-        lhs, rhs = go_int(labels[key]), go_int(values[0])
-        if lhs is None or rhs is None:
-            return False
-        return lhs > rhs if op == "Gt" else lhs < rhs
-    return False
+    from kubernetes_tpu.state.cluster_state import match_requirement
+
+    return match_requirement(labels, expr.get("key", ""),
+                             expr.get("operator", ""),
+                             tuple(expr.get("values") or ()))
 
 
 def _expr_parses(expr: dict) -> bool:
-    op = expr.get("operator", "")
-    nvals = len(expr.get("values") or [])
-    if op in ("In", "NotIn"):
-        return nvals >= 1
-    if op in ("Exists", "DoesNotExist"):
-        return nvals == 0
-    if op in ("Gt", "Lt"):
-        return nvals == 1
-    return False
+    from kubernetes_tpu.state.pod_batch import _valid_requirement
+
+    return _valid_requirement(expr)
 
 
 def match_selector(ns: NodeState, pod: Pod) -> bool:
@@ -277,6 +253,108 @@ def balanced_allocation(ns: NodeState, pod: Pod) -> int:
     return int((1 - abs(cpu_frac - mem_frac)) * MAX_PRIORITY)
 
 
+# ---- inter-pod affinity (Go semantics, predicates.go:982-1240,
+# interpod_affinity.go) ----
+
+DEFAULT_TOPO_KEYS = ("kubernetes.io/hostname",
+                     "failure-domain.beta.kubernetes.io/zone",
+                     "failure-domain.beta.kubernetes.io/region")
+
+
+def _topo_value(node: Node, key: str):
+    val = node.metadata.labels.get(key)
+    if key == "kubernetes.io/hostname" and val is None:
+        val = node.metadata.name  # encoder-defaulted hostname domain
+    return val
+
+
+def same_topology(a: Node, b: Node, key: str) -> bool:
+    va, vb = _topo_value(a, key), _topo_value(b, key)
+    return va is not None and va == vb
+
+
+def same_topology_or_default(a: Node, b: Node, key: str) -> bool:
+    """priorityutil.Topologies.NodesHaveSameTopologyKey: empty key means any
+    default failure domain."""
+    if not key:
+        return any(same_topology(a, b, k) for k in DEFAULT_TOPO_KEYS)
+    return same_topology(a, b, key)
+
+
+def interpod_feasible(placed, by_name, node: Node, pod: Pod) -> bool:
+    """InterPodAffinityMatches (predicates.go:982): existing pods' required
+    anti-affinity, then the pod's own required (anti-)affinity."""
+    from kubernetes_tpu.state.podaffinity import PARSE_ERROR, parse_pod_affinity
+
+    for epod, enode_name in placed:
+        eterms = parse_pod_affinity(epod.spec.affinity, epod.metadata.namespace)
+        for t in eterms.anti_req:
+            if t.selector == PARSE_ERROR:
+                return False  # error path fails every node
+            if t.matches_pod(pod):
+                if not t.topology_key:
+                    return False
+                if same_topology(node, by_name[enode_name].node, t.topology_key):
+                    return False
+
+    terms = parse_pod_affinity(pod.spec.affinity, pod.metadata.namespace)
+    for t in terms.aff_req:
+        if not t.topology_key or t.selector == PARSE_ERROR:
+            return False
+        in_domain = False
+        exists = False
+        for epod, enode_name in placed:
+            if t.matches_pod(epod):
+                exists = True
+                if same_topology(node, by_name[enode_name].node, t.topology_key):
+                    in_domain = True
+        if not in_domain:
+            if exists:
+                return False
+            if not t.matches_pod(pod):
+                return False
+    for t in terms.anti_req:
+        if not t.topology_key or t.selector == PARSE_ERROR:
+            return False
+        for epod, enode_name in placed:
+            if t.matches_pod(epod) and same_topology(
+                    node, by_name[enode_name].node, t.topology_key):
+                return False
+    return True
+
+
+def interpod_count(placed, by_name, node: Node, pod: Pod, hard_w: int) -> float:
+    """CalculateInterPodAffinityPriority's weighted count for one node."""
+    from kubernetes_tpu.state.podaffinity import parse_pod_affinity
+
+    terms = parse_pod_affinity(pod.spec.affinity, pod.metadata.namespace)
+    count = 0.0
+    for epod, enode_name in placed:
+        enode = by_name[enode_name].node
+        for t in terms.aff_pref:
+            if t.weight and t.matches_pod(epod) and same_topology_or_default(
+                    node, enode, t.topology_key):
+                count += t.weight
+        for t in terms.anti_pref:
+            if t.weight and t.matches_pod(epod) and same_topology_or_default(
+                    node, enode, t.topology_key):
+                count -= t.weight
+        eterms = parse_pod_affinity(epod.spec.affinity, epod.metadata.namespace)
+        for t in eterms.aff_req:
+            if hard_w and t.matches_pod(pod) and same_topology_or_default(
+                    node, enode, t.topology_key):
+                count += hard_w
+        for t in eterms.aff_pref:
+            if t.weight and t.matches_pod(pod) and same_topology_or_default(
+                    node, enode, t.topology_key):
+                count += t.weight
+        for t in eterms.anti_pref:
+            if t.weight and t.matches_pod(pod) and same_topology_or_default(
+                    node, enode, t.topology_key):
+                count -= t.weight
+    return count
+
+
 def untolerated_prefer_count(ns: NodeState, pod: Pod) -> int:
     # Only tolerations applicable to PreferNoSchedule count
     # (taint_toleration.go getAllTolerationPreferNoSchedule).
@@ -295,18 +373,26 @@ class SerialScheduler:
     """scheduleOne loop over Python objects."""
 
     def __init__(self, nodes: list[Node], assigned_pods: list[Pod] = (),
-                 *, with_node_affinity: bool = False):
+                 *, with_node_affinity: bool = False,
+                 with_interpod: bool = False, hard_pod_affinity_weight: int = 1):
         self.states = [NodeState.from_node(n) for n in nodes]
         self.by_name = {ns.node.metadata.name: ns for ns in self.states}
+        self.placed: list[tuple[Pod, str]] = []
         for pod in assigned_pods:
             ns = self.by_name.get(pod.spec.node_name)
             if ns:
                 ns.add_pod(pod)
+                self.placed.append((pod, pod.spec.node_name))
         self.rr = 0
         self.with_node_affinity = with_node_affinity
+        self.with_interpod = with_interpod
+        self.hard_w = hard_pod_affinity_weight
 
     def schedule_one(self, pod: Pod) -> str | None:
         fits = [ns for ns in self.states if feasible(ns, pod)]
+        if self.with_interpod:
+            fits = [ns for ns in fits
+                    if interpod_feasible(self.placed, self.by_name, ns.node, pod)]
         if not fits:
             return None
         counts = [untolerated_prefer_count(ns, pod) for ns in fits]
@@ -319,17 +405,27 @@ class SerialScheduler:
                 # CalculateNodeAffinityPriorityReduce: int(10 * count / max)
                 na_scores = [int(Fraction(MAX_PRIORITY * c, na_max))
                              for c in na_counts]
+        ip_scores = [0] * len(fits)
+        if self.with_interpod:
+            ip_counts = [interpod_count(self.placed, self.by_name, ns.node,
+                                        pod, self.hard_w) for ns in fits]
+            ip_max = max(0.0, max(ip_counts))
+            ip_min = min(0.0, min(ip_counts))
+            if ip_max - ip_min > 0:
+                ip_scores = [int(MAX_PRIORITY * (c - ip_min) / (ip_max - ip_min))
+                             for c in ip_counts]
         scores = []
-        for ns, cnt, na in zip(fits, counts, na_scores):
+        for ns, cnt, na, ip in zip(fits, counts, na_scores, ip_scores):
             tt = MAX_PRIORITY if max_count == 0 else int(
                 (1 - Fraction(cnt, max_count)) * MAX_PRIORITY)
             scores.append(least_requested(ns, pod) + balanced_allocation(ns, pod)
-                          + tt + na)
+                          + tt + na + ip)
         best = max(scores)
         ties = [ns for ns, s in zip(fits, scores) if s == best]
         pick = ties[self.rr % len(ties)]
         self.rr += 1
         pick.add_pod(pod)
+        self.placed.append((pod, pick.node.metadata.name))
         return pick.node.metadata.name
 
     def schedule(self, pods: list[Pod]) -> list[str | None]:
