@@ -213,6 +213,7 @@ class PodSpec:
     containers: list[Container] = field(default_factory=list)
     tolerations: list[Toleration] = field(default_factory=list)
     affinity: dict[str, Any] = field(default_factory=dict)  # raw v1 Affinity
+    volumes: list[dict[str, Any]] = field(default_factory=list)  # raw v1 Volume
     scheduler_name: str = "default-scheduler"
     restart_policy: str = "Always"
     priority: int = 0
@@ -225,6 +226,7 @@ class PodSpec:
                                     t.toleration_seconds)
                          for t in self.tolerations],
             affinity=copy.deepcopy(self.affinity) if self.affinity else {},
+            volumes=copy.deepcopy(self.volumes) if self.volumes else [],
             scheduler_name=self.scheduler_name,
             restart_policy=self.restart_policy, priority=self.priority,
         )
@@ -237,6 +239,7 @@ class PodSpec:
             containers=[Container.from_dict(c) for c in d.get("containers") or []],
             tolerations=[Toleration.from_dict(t) for t in d.get("tolerations") or []],
             affinity=copy.deepcopy(d.get("affinity") or {}),
+            volumes=copy.deepcopy(d.get("volumes") or []),
             scheduler_name=d.get("schedulerName", "default-scheduler") or "default-scheduler",
             restart_policy=d.get("restartPolicy", "Always") or "Always",
             priority=int(d.get("priority", 0) or 0),
@@ -254,6 +257,8 @@ class PodSpec:
             out["tolerations"] = [t.to_dict() for t in self.tolerations]
         if self.affinity:
             out["affinity"] = copy.deepcopy(self.affinity)
+        if self.volumes:
+            out["volumes"] = copy.deepcopy(self.volumes)
         if self.scheduler_name != "default-scheduler":
             out["schedulerName"] = self.scheduler_name
         if self.priority:
@@ -401,6 +406,9 @@ class NodeStatus:
     capacity: dict[str, str] = field(default_factory=dict)
     allocatable: dict[str, str] = field(default_factory=dict)
     conditions: list[NodeCondition] = field(default_factory=list)
+    # raw v1 ContainerImage dicts: {"names": [...], "sizeBytes": int}
+    # (ImageLocalityPriority reads node.Status.Images, image_locality.go:71)
+    images: list[dict[str, Any]] = field(default_factory=list)
 
     @classmethod
     def from_dict(cls, d: dict[str, Any]) -> "NodeStatus":
@@ -408,6 +416,7 @@ class NodeStatus:
             capacity={k: str(v) for k, v in (d.get("capacity") or {}).items()},
             allocatable={k: str(v) for k, v in (d.get("allocatable") or {}).items()},
             conditions=[NodeCondition.from_dict(c) for c in d.get("conditions") or []],
+            images=copy.deepcopy(d.get("images") or []),
         )
 
     def to_dict(self) -> dict[str, Any]:
@@ -418,6 +427,8 @@ class NodeStatus:
             out["allocatable"] = dict(self.allocatable)
         if self.conditions:
             out["conditions"] = [c.to_dict() for c in self.conditions]
+        if self.images:
+            out["images"] = copy.deepcopy(self.images)
         return out
 
     def effective_allocatable(self) -> dict[str, str]:
@@ -448,7 +459,8 @@ class Node:
             status=NodeStatus(capacity=dict(self.status.capacity),
                               allocatable=dict(self.status.allocatable),
                               conditions=[NodeCondition(c.type, c.status)
-                                          for c in self.status.conditions]),
+                                          for c in self.status.conditions],
+                              images=copy.deepcopy(self.status.images)),
         )
 
     @classmethod
@@ -514,6 +526,178 @@ class Event:
             "count": self.count,
             "source": {"component": self.source_component},
         }
+
+
+@dataclass
+class PersistentVolume:
+    """Cluster-scoped storage object (reference staging/src/k8s.io/api/core/v1
+    PersistentVolume; the scheduler reads its labels for NoVolumeZoneConflict,
+    predicates.go:461-470, and its node-affinity annotation for
+    NoVolumeNodeConflict, predicates.go:1345)."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: dict[str, Any] = field(default_factory=dict)  # raw PV source spec
+
+    kind = "PersistentVolume"
+
+    @property
+    def key(self) -> str:
+        return self.metadata.name
+
+    def clone(self) -> "PersistentVolume":
+        return PersistentVolume(metadata=self.metadata.clone(),
+                                spec=copy.deepcopy(self.spec))
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]) -> "PersistentVolume":
+        return cls(metadata=ObjectMeta.from_dict(d.get("metadata") or {}),
+                   spec=copy.deepcopy(d.get("spec") or {}))
+
+    def to_dict(self) -> dict[str, Any]:
+        return {"apiVersion": "v1", "kind": "PersistentVolume",
+                "metadata": self.metadata.to_dict(),
+                "spec": copy.deepcopy(self.spec)}
+
+
+@dataclass
+class PersistentVolumeClaim:
+    """Namespaced claim bound to a PV by name (spec.volumeName; the scheduler
+    resolves pod volume -> PVC -> PV, predicates.go:230-270)."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: dict[str, Any] = field(default_factory=dict)
+
+    kind = "PersistentVolumeClaim"
+
+    @property
+    def key(self) -> str:
+        return f"{self.metadata.namespace}/{self.metadata.name}"
+
+    @property
+    def volume_name(self) -> str:
+        return self.spec.get("volumeName", "") or ""
+
+    def clone(self) -> "PersistentVolumeClaim":
+        return PersistentVolumeClaim(metadata=self.metadata.clone(),
+                                     spec=copy.deepcopy(self.spec))
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]) -> "PersistentVolumeClaim":
+        return cls(metadata=ObjectMeta.from_dict(d.get("metadata") or {}),
+                   spec=copy.deepcopy(d.get("spec") or {}))
+
+    def to_dict(self) -> dict[str, Any]:
+        return {"apiVersion": "v1", "kind": "PersistentVolumeClaim",
+                "metadata": self.metadata.to_dict(),
+                "spec": copy.deepcopy(self.spec)}
+
+
+@dataclass
+class Service:
+    """Service with a map selector (reference v1.Service; the scheduler's
+    SelectorSpreadPriority and ServiceAffinity look up services matching a
+    pod, selector_spreading.go:61)."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: dict[str, Any] = field(default_factory=dict)
+
+    kind = "Service"
+
+    @property
+    def key(self) -> str:
+        return f"{self.metadata.namespace}/{self.metadata.name}"
+
+    @property
+    def selector(self) -> dict[str, str]:
+        return dict(self.spec.get("selector") or {})
+
+    def clone(self) -> "Service":
+        return Service(metadata=self.metadata.clone(),
+                       spec=copy.deepcopy(self.spec))
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]) -> "Service":
+        return cls(metadata=ObjectMeta.from_dict(d.get("metadata") or {}),
+                   spec=copy.deepcopy(d.get("spec") or {}))
+
+    def to_dict(self) -> dict[str, Any]:
+        return {"apiVersion": "v1", "kind": "Service",
+                "metadata": self.metadata.to_dict(),
+                "spec": copy.deepcopy(self.spec)}
+
+
+@dataclass
+class _Workload:
+    """Shared shape of the pod-owning workload kinds (RC/RS/StatefulSet):
+    metadata + raw spec holding replicas/selector/template."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: dict[str, Any] = field(default_factory=dict)
+    status: dict[str, Any] = field(default_factory=dict)
+
+    @property
+    def key(self) -> str:
+        return f"{self.metadata.namespace}/{self.metadata.name}"
+
+    @property
+    def replicas(self) -> int:
+        r = self.spec.get("replicas")
+        return 1 if r is None else int(r)
+
+    def clone(self):
+        return type(self)(metadata=self.metadata.clone(),
+                          spec=copy.deepcopy(self.spec),
+                          status=copy.deepcopy(self.status))
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]):
+        return cls(metadata=ObjectMeta.from_dict(d.get("metadata") or {}),
+                   spec=copy.deepcopy(d.get("spec") or {}),
+                   status=copy.deepcopy(d.get("status") or {}))
+
+    def to_dict(self) -> dict[str, Any]:
+        return {"apiVersion": self.api_version, "kind": self.kind,
+                "metadata": self.metadata.to_dict(),
+                "spec": copy.deepcopy(self.spec),
+                "status": copy.deepcopy(self.status)}
+
+
+@dataclass
+class ReplicationController(_Workload):
+    """v1 ReplicationController: map-style spec.selector
+    (selector_spreading.go:68 SelectorFromSet)."""
+
+    kind = "ReplicationController"
+    api_version = "v1"
+
+    @property
+    def selector(self) -> dict[str, str]:
+        return dict(self.spec.get("selector") or {})
+
+
+@dataclass
+class ReplicaSet(_Workload):
+    """extensions/v1beta1 ReplicaSet: LabelSelector-style spec.selector
+    (selector_spreading.go:73 LabelSelectorAsSelector)."""
+
+    kind = "ReplicaSet"
+    api_version = "extensions/v1beta1"
+
+    @property
+    def selector(self) -> dict[str, Any]:
+        return dict(self.spec.get("selector") or {})
+
+
+@dataclass
+class StatefulSet(_Workload):
+    """apps/v1beta1 StatefulSet (selector_spreading.go:80)."""
+
+    kind = "StatefulSet"
+    api_version = "apps/v1beta1"
+
+    @property
+    def selector(self) -> dict[str, Any]:
+        return dict(self.spec.get("selector") or {})
 
 
 @dataclass

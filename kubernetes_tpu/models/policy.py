@@ -14,6 +14,12 @@ from __future__ import annotations
 import json
 from dataclasses import dataclass, field
 
+from kubernetes_tpu.state.layout import (
+    DEFAULT_MAX_AZURE_DISK_VOLUMES,
+    DEFAULT_MAX_EBS_VOLUMES,
+    DEFAULT_MAX_GCE_PD_VOLUMES,
+)
+
 # Predicate names follow the reference registry (factory/plugins.go).
 # "GeneralPredicates" expands to resources+host+ports+selector
 # (predicates.go:900).
@@ -35,6 +41,11 @@ KNOWN_PREDICATES = frozenset({
     "GeneralPredicates", "PodFitsResources", "PodFitsHost", "PodFitsHostPorts",
     "MatchNodeSelector", "PodToleratesNodeTaints", "CheckNodeMemoryPressure",
     "CheckNodeDiskPressure", "CheckNodeCondition", "MatchInterPodAffinity",
+    # registry aliases (defaults.go:73-87)
+    "PodFitsPorts", "HostName",
+    # volume predicates (defaults.go:120-155, 178-184)
+    "NoDiskConflict", "MaxEBSVolumeCount", "MaxGCEPDVolumeCount",
+    "MaxAzureDiskVolumeCount", "NoVolumeZoneConflict", "NoVolumeNodeConflict",
 })
 
 KNOWN_PRIORITIES = frozenset({
@@ -52,6 +63,11 @@ class Policy:
     # granted per existing pod whose *required* affinity term matches the
     # incoming pod, in InterPodAffinityPriority's symmetric pass.
     hard_pod_affinity_weight: int = 1
+    # MaxPDVolumeCount limits (defaults.go:120-155; env KUBE_MAX_PD_VOLS
+    # override applied by `with_env_overrides` at scheduler construction)
+    max_ebs_volumes: int = DEFAULT_MAX_EBS_VOLUMES
+    max_gce_pd_volumes: int = DEFAULT_MAX_GCE_PD_VOLUMES
+    max_azure_disk_volumes: int = DEFAULT_MAX_AZURE_DISK_VOLUMES
 
     def __post_init__(self):
         unknown = set(self.predicates) - KNOWN_PREDICATES
@@ -69,6 +85,38 @@ class Policy:
     # --- convenience views used by the solver ---
     def has_predicate(self, *names: str) -> bool:
         return any(n in self.predicates for n in names)
+
+    def attach_maxes(self) -> tuple[tuple[int, int], ...]:
+        """Static ((VolType, limit), ...) for the configured MaxPDVolumeCount
+        predicates."""
+        from kubernetes_tpu.state.layout import VolType
+
+        out = []
+        if "MaxEBSVolumeCount" in self.predicates:
+            out.append((VolType.EBS, self.max_ebs_volumes))
+        if "MaxGCEPDVolumeCount" in self.predicates:
+            out.append((VolType.GCE, self.max_gce_pd_volumes))
+        if "MaxAzureDiskVolumeCount" in self.predicates:
+            out.append((VolType.AZURE, self.max_azure_disk_volumes))
+        return tuple(out)
+
+    def with_env_overrides(self) -> "Policy":
+        """Apply KUBE_MAX_PD_VOLS (defaults.go getMaxVols) to every attach
+        limit, like the reference's predicate factories."""
+        import os
+        from dataclasses import replace
+
+        raw = os.environ.get("KUBE_MAX_PD_VOLS")
+        if not raw:
+            return self
+        try:
+            limit = int(raw)
+        except ValueError:
+            return self
+        if limit <= 0:
+            return self
+        return replace(self, max_ebs_volumes=limit, max_gce_pd_volumes=limit,
+                       max_azure_disk_volumes=limit)
 
     def weight(self, name: str) -> int:
         for n, w in self.priorities:

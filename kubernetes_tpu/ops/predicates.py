@@ -26,8 +26,11 @@ Covered predicates (reference algorithm/predicates/predicates.go):
   CheckNodeDiskPressure (:1296) -> check_node_condition / check_*_pressure
 - unschedulable lister filter   -> node_schedulable (not policy-gated)
 
-Volume-topology predicates (NoDiskConflict, MaxPDVolumeCount, VolumeZone)
-live in the volume op set once volume state is modeled.
+Volume predicates (atom grammars in state/volumes.py):
+- NoDiskConflict        (:183)  -> no_disk_conflict
+- MaxPDVolumeCount      (:215)  -> max_attach_ok (EBS/GCE PD/Azure Disk)
+- NoVolumeZoneConflict  (:395)  -> volume_zone
+- NoVolumeNodeConflict  (:1345) -> volume_node
 
 All kernels are pure, jit-safe, and shard over the node axis: elementwise ops,
 reductions over static universe axes, and node-sharded matmuls.
@@ -38,7 +41,7 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 from kubernetes_tpu.state.cluster_state import ClusterState
-from kubernetes_tpu.state.layout import Condition, Effect, Resource, TolOp
+from kubernetes_tpu.state.layout import Condition, Effect, Resource, TolOp, VolType
 from kubernetes_tpu.state.pod_batch import PodBatch
 
 
@@ -179,6 +182,68 @@ def check_memory_pressure(state: ClusterState, pod: PodBatch) -> jnp.ndarray:
 def check_disk_pressure(state: ClusterState, pod: PodBatch) -> jnp.ndarray:
     """CheckNodeDiskPressure (predicates.go:1296): rejects all pods."""
     return (state.conditions & jnp.uint32(Condition.DISK_PRESSURE)) == 0
+
+
+def no_disk_conflict(state: ClusterState, pod: PodBatch,
+                     vol_any=None, vol_rw=None) -> jnp.ndarray:
+    """NoDiskConflict (predicates.go:183): a wanted read-write atom conflicts
+    with any existing user; a wanted read-only atom conflicts with a
+    read-write user. Two matvecs over the conflict-atom universe."""
+    v_any = state.vol_any if vol_any is None else vol_any
+    v_rw = state.vol_rw if vol_rw is None else vol_rw
+    conflicts = v_any @ pod.vol_want_rw + v_rw @ pod.vol_want_ro
+    return conflicts == 0.0
+
+
+def max_attach_ok(state: ClusterState, pod: PodBatch, maxes: tuple,
+                  attach_count=None) -> jnp.ndarray:
+    """MaxPDVolumeCount for the configured filters (predicates.go:281-320).
+
+    `maxes` is a static tuple of (VolType code, limit). For each filter:
+    distinct existing atoms of that type on the node, plus the pod's wanted
+    atoms not already there, must not exceed the limit. VolType.ANY atoms
+    (unresolvable claims) count toward every filter."""
+    counts = state.attach_count if attach_count is None else attach_count
+    present = (counts > 0).astype(jnp.float32)          # [N, UA]
+    absent = 1.0 - present
+    ok = jnp.ones(present.shape[0], dtype=bool)
+    for vtype, limit in maxes:
+        mask = ((state.attach_type == vtype)
+                | (state.attach_type == VolType.ANY)).astype(jnp.float32)
+        # a pod wanting no atoms of this type passes before any counting
+        # (the len(newVolumes)==0 quick return, predicates.go:296)
+        wants = pod.att_onehot @ mask > 0
+        existing = present @ mask                        # distinct, [N]
+        new = (absent * pod.att_onehot[None, :]) @ mask  # not-yet-attached
+        ok = ok & (~wants | (existing + new <= float(limit)))
+    return ok & ~pod.att_fail
+
+
+def volume_zone(state: ClusterState, pod: PodBatch) -> jnp.ndarray:
+    """NoVolumeZoneConflict (predicates.go:395): nodes carrying zone/region
+    labels must match every bound PV's zone/region label exactly; nodes with
+    no zone constraints pass unconditionally (predicates.go:421-427).
+
+    A pod whose claim chain fails to resolve errors the whole scheduling
+    attempt whenever a zoned node would have evaluated it (the predicate
+    error path aggregated by findNodesThatFit, generic_scheduler.go:182-199;
+    the reference's exact scope depends on unspecified predicate map order —
+    here it is deterministically "any valid zoned node exists")."""
+    from kubernetes_tpu.state.layout import TOPO_REGION, TOPO_ZONE
+
+    unconstrained = (state.topology[:, TOPO_ZONE] < 0) & (
+        state.topology[:, TOPO_REGION] < 0)
+    satisfied = state.sel_member @ pod.vz_onehot
+    fail_kill = pod.vz_fail & jnp.any(state.valid & ~unconstrained)
+    return (unconstrained | ((satisfied >= pod.vz_count) & ~pod.vz_fail)) \
+        & ~fail_kill
+
+
+def volume_node(state: ClusterState, pod: PodBatch) -> jnp.ndarray:
+    """NoVolumeNodeConflict (predicates.go:1345): every bound PV's
+    node-affinity selector must match the node."""
+    satisfied = state.volsel_member @ pod.vs_onehot
+    return (satisfied >= pod.vs_count) & ~pod.vs_fail
 
 
 def node_conditions_ok(state: ClusterState, pod: PodBatch) -> jnp.ndarray:

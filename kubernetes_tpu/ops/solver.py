@@ -52,6 +52,22 @@ class SolverResult:
     rr_end: jnp.ndarray        # u32 round-robin counter after the batch
 
 
+@struct.dataclass
+class Carry:
+    """Scan-carried assume ledger: every assignment-dependent count. Fields
+    gated off by the policy stay None (None is an empty pytree, so the scan
+    carry structure remains static per policy)."""
+
+    requested: jnp.ndarray
+    nonzero: jnp.ndarray
+    port_count: jnp.ndarray
+    rr: jnp.ndarray
+    ipa: object = None          # AffinityLedger | None
+    vol_any: object = None      # f32[N, UV] | None
+    vol_rw: object = None
+    attach_count: object = None  # f32[N, UA] | None
+
+
 def _static_mask(state: ClusterState, pod, policy: Policy) -> jnp.ndarray:
     """Assignment-independent predicate conjunction for one pod: bool[N].
 
@@ -60,7 +76,7 @@ def _static_mask(state: ClusterState, pod, policy: Policy) -> jnp.ndarray:
     (factory.go getNodeConditionPredicate).
     """
     ok = state.valid & preds.node_schedulable(state, pod)
-    if policy.has_predicate("GeneralPredicates", "PodFitsHost"):
+    if policy.has_predicate("GeneralPredicates", "PodFitsHost", "HostName"):
         ok = ok & preds.fits_host(state, pod)
     if policy.has_predicate("GeneralPredicates", "MatchNodeSelector"):
         ok = ok & preds.match_node_selector(state, pod)
@@ -72,6 +88,10 @@ def _static_mask(state: ClusterState, pod, policy: Policy) -> jnp.ndarray:
         ok = ok & preds.check_memory_pressure(state, pod)
     if policy.has_predicate("CheckNodeDiskPressure"):
         ok = ok & preds.check_disk_pressure(state, pod)
+    if policy.has_predicate("NoVolumeZoneConflict"):
+        ok = ok & preds.volume_zone(state, pod)
+    if policy.has_predicate("NoVolumeNodeConflict"):
+        ok = ok & preds.volume_node(state, pod)
     return ok
 
 
@@ -110,7 +130,8 @@ def schedule_batch(
     commit (assume semantics).
     """
     use_resources = policy.has_predicate("GeneralPredicates", "PodFitsResources")
-    use_ports = policy.has_predicate("GeneralPredicates", "PodFitsHostPorts")
+    use_ports = policy.has_predicate("GeneralPredicates", "PodFitsHostPorts",
+                                     "PodFitsPorts")
     w_lr = policy.weight("LeastRequestedPriority")
     w_ba = policy.weight("BalancedResourceAllocation")
     w_tt = policy.weight("TaintTolerationPriority")
@@ -118,6 +139,8 @@ def schedule_batch(
     w_ip = policy.weight("InterPodAffinityPriority")
     use_ipa = policy.has_predicate("MatchInterPodAffinity")
     use_ip_ledger = use_ipa or bool(w_ip)
+    use_nodisk = policy.has_predicate("NoDiskConflict")
+    attach_maxes = policy.attach_maxes()
     hard_w = float(policy.hard_pod_affinity_weight)
     domain_universe = caps.domain_universe if caps else DEFAULT_DOMAIN_UNIVERSE
 
@@ -136,66 +159,87 @@ def schedule_batch(
         na_counts = jnp.zeros(static_mask.shape, jnp.float32)
 
     # ---- Phase B: scan over the pod axis, vector over nodes ----
-    def step(carry, xs):
-        requested, nonzero, port_count, rr = carry[:4]
-        ledger = carry[4] if use_ip_ledger else None
+    def step(carry: Carry, xs):
         pod, s_mask, s_score, p_counts, na_count = xs
 
         feasible = s_mask
         if use_resources:
-            feasible = feasible & preds.fits_resources(state, pod, requested=requested)
+            feasible = feasible & preds.fits_resources(
+                state, pod, requested=carry.requested)
         if use_ports:
-            feasible = feasible & preds.fits_host_ports(state, pod,
-                                                        port_count=port_count)
+            feasible = feasible & preds.fits_host_ports(
+                state, pod, port_count=carry.port_count)
+        if use_nodisk:
+            feasible = feasible & preds.no_disk_conflict(
+                state, pod, vol_any=carry.vol_any, vol_rw=carry.vol_rw)
+        if attach_maxes:
+            feasible = feasible & preds.max_attach_ok(
+                state, pod, attach_maxes, attach_count=carry.attach_count)
         if use_ipa:
-            feasible = feasible & interpod.interpod_feasible(state, pod, ledger)
+            feasible = feasible & interpod.interpod_feasible(state, pod,
+                                                             carry.ipa)
 
         score = s_score
         if w_lr:
-            score = score + w_lr * prios.least_requested(state, pod, nonzero_requested=nonzero)
+            score = score + w_lr * prios.least_requested(
+                state, pod, nonzero_requested=carry.nonzero)
         if w_ba:
-            score = score + w_ba * prios.balanced_allocation(state, pod, nonzero_requested=nonzero)
+            score = score + w_ba * prios.balanced_allocation(
+                state, pod, nonzero_requested=carry.nonzero)
         if w_tt:
             score = score + w_tt * prios.taint_toleration_from_counts(p_counts, feasible)
         if w_na:
             score = score + w_na * prios.normalized_from_counts(na_count, feasible)
         if w_ip:
-            ip_counts = interpod.interpod_counts(state, pod, ledger, hard_w)
+            ip_counts = interpod.interpod_counts(state, pod, carry.ipa, hard_w)
             score = score + w_ip * interpod.interpod_score(ip_counts, feasible)
 
         masked = jnp.where(feasible, score, -jnp.inf)
-        node, best, ntie = _select_host(masked, feasible, rr)
+        node, best, ntie = _select_host(masked, feasible, carry.rr)
         assigned = (ntie > 0) & pod.valid
         node_idx = jnp.where(assigned, node, -1)
 
         add = jnp.where(assigned, 1.0, 0.0)
-        requested = requested.at[node].add(add * pod.requests)
-        nonzero = nonzero.at[node].add(add * pod.nonzero_requests)
-        if use_ports:
-            port_count = port_count.at[node].add(add * pod.port_onehot)
-        rr = rr + jnp.where(assigned, jnp.uint32(1), jnp.uint32(0))
-
+        new_carry = Carry(
+            requested=carry.requested.at[node].add(add * pod.requests),
+            nonzero=carry.nonzero.at[node].add(add * pod.nonzero_requests),
+            port_count=(carry.port_count.at[node].add(add * pod.port_onehot)
+                        if use_ports else carry.port_count),
+            rr=carry.rr + jnp.where(assigned, jnp.uint32(1), jnp.uint32(0)),
+            ipa=(interpod.ledger_add(carry.ipa, state, pod, node, add)
+                 if use_ip_ledger else None),
+            vol_any=(carry.vol_any.at[node].add(
+                add * (pod.vol_want_rw + pod.vol_want_ro))
+                if use_nodisk else None),
+            vol_rw=(carry.vol_rw.at[node].add(add * pod.vol_want_rw)
+                    if use_nodisk else None),
+            attach_count=(carry.attach_count.at[node].add(add * pod.att_onehot)
+                          if attach_maxes else None),
+        )
         out = (node_idx, jnp.where(assigned, best, 0.0),
                jnp.sum(feasible.astype(jnp.int32)))
-        new_carry = (requested, nonzero, port_count, rr)
-        if use_ip_ledger:
-            new_carry += (interpod.ledger_add(ledger, state, pod, node, add),)
         return new_carry, out
 
-    init = (state.requested, state.nonzero_requested, state.port_count,
-            jnp.asarray(rr_start, jnp.uint32))
-    if use_ip_ledger:
-        init += (interpod.make_ledger(state, domain_universe),)
-    final_carry, (nodes, scores, counts) = jax.lax.scan(
+    init = Carry(
+        requested=state.requested,
+        nonzero=state.nonzero_requested,
+        port_count=state.port_count,
+        rr=jnp.asarray(rr_start, jnp.uint32),
+        ipa=(interpod.make_ledger(state, domain_universe)
+             if use_ip_ledger else None),
+        vol_any=state.vol_any if use_nodisk else None,
+        vol_rw=state.vol_rw if use_nodisk else None,
+        attach_count=state.attach_count if attach_maxes else None,
+    )
+    final, (nodes, scores, counts) = jax.lax.scan(
         step, init, (batch, static_mask, static_score, prefer_counts, na_counts))
-    requested, nonzero, port_count, rr = final_carry[:4]
 
     return SolverResult(
         assignments=nodes,
         scores=scores,
         feasible_counts=counts,
-        new_requested=requested,
-        new_nonzero=nonzero,
-        new_port_count=port_count,
-        rr_end=rr,
+        new_requested=final.requested,
+        new_nonzero=final.nonzero,
+        new_port_count=final.port_count,
+        rr_end=final.rr,
     )

@@ -68,6 +68,28 @@ class SchedulerMetrics:
         }
 
 
+def store_volume_context(store: ObjectStore, local_volumes_enabled=False):
+    """VolumeContext backed by the object store — the PVInfo/PVCInfo listers
+    the reference's predicate factories receive (factory/plugins.go
+    PluginFactoryArgs)."""
+    from kubernetes_tpu.state.volumes import VolumeContext
+
+    def get_pvc(namespace, name):
+        try:
+            return store.get("PersistentVolumeClaim", name, namespace)
+        except NotFound:
+            return None
+
+    def get_pv(name):
+        try:
+            return store.get("PersistentVolume", name)
+        except NotFound:
+            return None
+
+    return VolumeContext(get_pvc=get_pvc, get_pv=get_pv,
+                         local_volumes_enabled=local_volumes_enabled)
+
+
 class Scheduler:
     def __init__(
         self,
@@ -82,12 +104,15 @@ class Scheduler:
 
         self.store = store
         self.caps = caps or Capacities()
+        policy = policy.with_env_overrides()  # KUBE_MAX_PD_VOLS (defaults.go)
         self.policy = policy
         self.scheduler_name = scheduler_name
         self.batch_wait = batch_wait
 
-        self.statedb = StateDB(self.caps, mesh=mesh)
-        self.encode_cache = EncodeCache(self.caps, self.statedb.table)
+        self.volume_ctx = store_volume_context(store)
+        self.statedb = StateDB(self.caps, mesh=mesh, volume_ctx=self.volume_ctx)
+        self.encode_cache = EncodeCache(self.caps, self.statedb.table,
+                                        volume_ctx=self.volume_ctx)
         self.queue = BackoffQueue()
         self.backoff = Backoff(initial=0.05, max_duration=5.0)
         self.metrics = SchedulerMetrics()

@@ -42,6 +42,7 @@ from kubernetes_tpu.state.layout import (
     MEM_UNIT,
     ReqOp,
     Resource,
+    VolType,
 )
 from kubernetes_tpu.utils.hashing import hash32, hash_lanes
 
@@ -51,7 +52,8 @@ NODE_AXIS_FIELDS = frozenset({
     "valid", "allocatable", "requested", "nonzero_requested", "port_count",
     "sel_member", "req_member", "taint_hard_member", "taint_prefer_member",
     "conditions", "name_lo", "name_hi", "topology", "podsel_count",
-    "term_count",
+    "term_count", "vol_any", "vol_rw", "attach_count", "img_size",
+    "avoid_member", "volsel_member",
 })
 
 
@@ -77,6 +79,14 @@ class ClusterState:
     name_lo: np.ndarray           # u32[N] node-name hash lanes
     name_hi: np.ndarray           # u32[N]
     topology: np.ndarray          # i32[N, TK] interned domain id, -1 = unknown
+    # volume state (see state/volumes.py)
+    vol_any: np.ndarray           # f32[N, UV] — pods on n using conflict atom u
+    vol_rw: np.ndarray            # f32[N, UV] — of those, not read-only
+    attach_count: np.ndarray      # f32[N, UA] — pods on n using attach atom u
+    attach_type: np.ndarray       # i32[UA] VolType codes, EMPTY = free slot
+    img_size: np.ndarray          # f32[N, UI] — bytes of image u if present
+    avoid_member: np.ndarray      # f32[N, UO] — node prefers to avoid sig u
+    volsel_member: np.ndarray     # f32[N, UVS] — node matches PV selector u
     # inter-pod affinity state (see state/podaffinity.py)
     podsel_count: np.ndarray      # f32[N, UQ] — pods on n matching selector q
     term_count: np.ndarray        # f32[N, UE] — pods on n carrying term e
@@ -113,6 +123,13 @@ def empty_state(caps: Capacities) -> ClusterState:
         name_lo=np.zeros((n,), np.uint32),
         name_hi=np.zeros((n,), np.uint32),
         topology=np.full((n, caps.topology_slots), -1, np.int32),
+        vol_any=np.zeros((n, caps.volume_universe), np.float32),
+        vol_rw=np.zeros((n, caps.volume_universe), np.float32),
+        attach_count=np.zeros((n, caps.attach_universe), np.float32),
+        attach_type=np.full((caps.attach_universe,), VolType.EMPTY, np.int32),
+        img_size=np.zeros((n, caps.image_universe), np.float32),
+        avoid_member=np.zeros((n, caps.avoid_universe), np.float32),
+        volsel_member=np.zeros((n, caps.volsel_universe), np.float32),
         podsel_count=np.zeros((n, caps.podsel_universe), np.float32),
         term_count=np.zeros((n, caps.term_universe), np.float32),
         term_q=np.full((caps.term_universe,), -1, np.int32),
@@ -227,6 +244,16 @@ class NodeTable:
         self.taints: dict[tuple[str, str, str], int] = {}
         self.ports: dict[int, int] = {}
         self.reqs: dict[tuple[str, str, tuple[str, ...]], int] = {}
+        # volume universes (state/volumes.py atom grammars)
+        self.vol_atoms: dict[tuple, int] = {}
+        self.attach_atoms: dict[tuple, int] = {}
+        self.attach_types: dict[int, int] = {}   # aid -> VolType
+        self.images: dict[str, int] = {}
+        self.avoids: dict[tuple[str, str], int] = {}
+        self.volsels: dict[str, int] = {}        # canon json -> vsid
+        self.volsel_attrs: list[list] = []       # vsid -> parsed terms
+        self.pending_volsel_refresh: list[int] = []
+        self.dirty_attach_attrs = False          # attach_type rows changed
         # pod-selector universe: (namespaces, canonical selector) -> qid
         self.podsels: dict[tuple, int] = {}
         self.podsel_attrs: list[tuple] = []          # qid -> (ns_key, canon)
@@ -411,6 +438,96 @@ class NodeTable:
         self.dirty_term_attrs = True
         return eid
 
+    def intern_vol_atom(self, atom: tuple) -> int:
+        vid = self.vol_atoms.get(atom)
+        if vid is not None:
+            return vid
+        if len(self.vol_atoms) >= self.caps.volume_universe:
+            raise CapacityError(
+                f"volume universe {self.caps.volume_universe} exhausted "
+                f"interning {atom!r}")
+        vid = len(self.vol_atoms)
+        self.vol_atoms[atom] = vid
+        return vid
+
+    def intern_attach_atom(self, vtype: int, atom: tuple) -> int:
+        aid = self.attach_atoms.get(atom)
+        if aid is not None:
+            return aid
+        if len(self.attach_atoms) >= self.caps.attach_universe:
+            raise CapacityError(
+                f"attach universe {self.caps.attach_universe} exhausted "
+                f"interning {atom!r}")
+        aid = len(self.attach_atoms)
+        self.attach_atoms[atom] = aid
+        self.attach_types[aid] = vtype
+        self.dirty_attach_attrs = True
+        return aid
+
+    def intern_image(self, name: str) -> int:
+        iid = self.images.get(name)
+        if iid is not None:
+            return iid
+        if len(self.images) >= self.caps.image_universe:
+            raise CapacityError(
+                f"image universe {self.caps.image_universe} exhausted "
+                f"interning {name!r}")
+        iid = len(self.images)
+        self.images[name] = iid
+        return iid
+
+    def intern_avoid(self, sig: tuple[str, str]) -> int:
+        oid = self.avoids.get(sig)
+        if oid is not None:
+            return oid
+        if len(self.avoids) >= self.caps.avoid_universe:
+            raise CapacityError(
+                f"avoid universe {self.caps.avoid_universe} exhausted "
+                f"interning {sig!r}")
+        oid = len(self.avoids)
+        self.avoids[sig] = oid
+        return oid
+
+    def intern_volsel(self, terms: list) -> int:
+        from kubernetes_tpu.state.volumes import node_selector_canon
+
+        canon = node_selector_canon(terms)
+        vsid = self.volsels.get(canon)
+        if vsid is not None:
+            return vsid
+        if len(self.volsels) >= self.caps.volsel_universe:
+            raise CapacityError(
+                f"volume-selector universe {self.caps.volsel_universe} "
+                f"exhausted")
+        vsid = len(self.volsels)
+        self.volsels[canon] = vsid
+        self.volsel_attrs.append(terms)
+        self.pending_volsel_refresh.append(vsid)
+        return vsid
+
+    def vol_rows(self, pod) -> tuple[np.ndarray, np.ndarray]:
+        """(any, rw) conflict-atom count rows for one pod's volumes."""
+        from kubernetes_tpu.state.volumes import pod_conflict_atoms
+
+        any_row = np.zeros((self.caps.volume_universe,), np.float32)
+        rw_row = np.zeros((self.caps.volume_universe,), np.float32)
+        for atom, read_only in pod_conflict_atoms(pod):
+            vid = self.intern_vol_atom(atom)
+            any_row[vid] += 1.0
+            if not read_only:
+                rw_row[vid] += 1.0
+        return any_row, rw_row
+
+    def attach_row(self, pod, ctx, permissive: bool = False) -> np.ndarray:
+        """0/1 attach-atom row for one pod (unique per pod by construction,
+        mirroring the per-pod filteredVolumes set, predicates.go:226)."""
+        from kubernetes_tpu.state.volumes import pod_attach_atoms
+
+        row = np.zeros((self.caps.attach_universe,), np.float32)
+        for vtype, atom in pod_attach_atoms(pod, ctx, permissive=permissive):
+            row[self.intern_attach_atom(vtype, atom)] = 1.0
+        return row
+
     def port_onehot(self, ports: Iterable[int]) -> np.ndarray:
         out = np.zeros((self.caps.port_universe,), np.float32)
         for port in ports:
@@ -452,6 +569,29 @@ def _fill_node_row(state: ClusterState, table: NodeTable, row: int, node: Node) 
             state.taint_hard_member[row, tid] = 1.0
         elif effect == Effect.PREFER_NO_SCHEDULE:
             state.taint_prefer_member[row, tid] = 1.0
+
+    # container images present on the node (ImageLocalityPriority source,
+    # node.Status.Images, image_locality.go:71-80)
+    state.img_size[row] = 0.0
+    for image in node.status.images:
+        size = float(image.get("sizeBytes") or 0)
+        for img_name in image.get("names") or []:
+            state.img_size[row, table.intern_image(img_name)] = size
+
+    # preferAvoidPods signatures (NodePreferAvoidPodsPriority source)
+    from kubernetes_tpu.state.volumes import parse_avoid_signatures
+
+    state.avoid_member[row] = 0.0
+    for sig in parse_avoid_signatures(node.metadata.annotations):
+        state.avoid_member[row, table.intern_avoid(sig)] = 1.0
+
+    # PV node-affinity selector membership (NoVolumeNodeConflict)
+    from kubernetes_tpu.state.volumes import node_selector_matches
+
+    state.volsel_member[row] = 0.0
+    for canon, vsid in table.volsels.items():
+        if node_selector_matches(table.volsel_attrs[vsid], labels):
+            state.volsel_member[row, vsid] = 1.0
 
     state.topology[row] = -1
     from kubernetes_tpu.state.layout import TOPO_HOSTNAME, TOPO_ZONE_REGION
@@ -499,6 +639,23 @@ def apply_pending_refreshes(state: ClusterState, table: NodeTable) -> bool:
                     state.topology[row, slot] = table.intern_domain(
                         slot, labels[key])
         table.pending_topo_refresh.clear()
+    # PV node-affinity selector columns interned after nodes were encoded
+    if table.pending_volsel_refresh:
+        from kubernetes_tpu.state.volumes import node_selector_matches
+
+        for vsid in table.pending_volsel_refresh:
+            changed = True
+            terms = table.volsel_attrs[vsid]
+            for row, labels in enumerate(table.labels_of):
+                if labels is not None and node_selector_matches(terms, labels):
+                    state.volsel_member[row, vsid] = 1.0
+        table.pending_volsel_refresh.clear()
+    # attach-atom type attributes (tiny, replicated)
+    if table.dirty_attach_attrs:
+        changed = True
+        for aid, vtype in table.attach_types.items():
+            state.attach_type[aid] = vtype
+        table.dirty_attach_attrs = False
     # carried-term attribute rows (tiny, replicated)
     if table.dirty_term_attrs:
         changed = True
@@ -591,7 +748,8 @@ def carried_term_row(table: NodeTable, eids) -> np.ndarray:
     return out
 
 
-def add_pod_to_state(state: ClusterState, table: NodeTable, pod: Pod, row: int) -> None:
+def add_pod_to_state(state: ClusterState, table: NodeTable, pod: Pod, row: int,
+                     ctx=None) -> None:
     """Account an assigned pod against a node row (the analog of
     NodeInfo.addPod, node_info.go:171).
 
@@ -600,12 +758,24 @@ def add_pod_to_state(state: ClusterState, table: NodeTable, pod: Pod, row: int) 
     (intern_pod_affinity_terms) before accounting any pod, or counts for
     later-interned selectors will miss earlier pods. Incremental flows
     (StateDB) refill via pending_podsel_refresh instead."""
+    from kubernetes_tpu.state.volumes import EMPTY_CONTEXT
+
     state.requested[row] += pod_requests(pod)
     state.nonzero_requested[row] += pod_nonzero_requests(pod)
     state.port_count[row] += table.port_onehot(pod.host_ports())
     eids, _ = intern_pod_affinity_terms(table, pod)
     state.term_count[row] += carried_term_row(table, eids)
     state.podsel_count[row] += pod_match_row(table, pod)
+    if pod.spec.volumes:
+        any_row, rw_row = table.vol_rows(pod)
+        state.vol_any[row] += any_row
+        state.vol_rw[row] += rw_row
+        # permissive: a bound pod's broken claim skips only that volume (the
+        # reference would error the whole scheduling attempt for every
+        # incoming pod, predicates.go:302 — a poisoned-node state not worth
+        # reproducing)
+        state.attach_count[row] += table.attach_row(
+            pod, ctx or EMPTY_CONTEXT, permissive=True)
     table.bump(row)
 
 
@@ -614,6 +784,7 @@ def encode_nodes(
     caps: Capacities,
     assigned_pods: Sequence[Pod] = (),
     table: NodeTable | None = None,
+    ctx=None,
 ) -> tuple[ClusterState, NodeTable]:
     """Full (re-)encode: the List half of list+watch. Incremental updates go
     through `statedb.StateDB` which touches only changed rows/columns.
@@ -638,6 +809,8 @@ def encode_nodes(
         state.taint_u_effect[tid] = Effect.NAMES.get(effect, Effect.NONE)
     if table.term_attrs:
         table.dirty_term_attrs = True
+    for aid, vtype in table.attach_types.items():
+        state.attach_type[aid] = vtype
     for node in nodes:
         row = table.assign_row(node.metadata.name)
         _fill_node_row(state, table, row, node)
@@ -651,5 +824,5 @@ def encode_nodes(
         row = table.row_of.get(pod.spec.node_name)
         if row is None:
             continue  # pod bound to an unknown node: ignored, like cache misses
-        add_pod_to_state(state, table, pod, row)
+        add_pod_to_state(state, table, pod, row, ctx=ctx)
     return state, table

@@ -8,11 +8,16 @@ selectors/tolerations/ports); pods with identical scheduling-relevant specs
 share one encoded row, copied into the batch by array assignment.
 
 The fingerprint covers exactly the fields the encoder reads — requests,
-host ports, nodeSelector, tolerations, nodeName, QoS class. LRU-bounded.
+host ports, nodeSelector, tolerations, nodeName, QoS class, namespace +
+labels (pod-affinity matching), affinity terms, and volumes. Pods with
+claim-backed volumes bypass the cache: their encoding depends on PVC/PV
+objects that can change between batches (a bind event re-resolving a claim
+must not be served a stale row). LRU-bounded.
 """
 
 from __future__ import annotations
 
+import json
 from collections import OrderedDict
 
 import numpy as np
@@ -26,8 +31,15 @@ from kubernetes_tpu.state.pod_batch import PodBatch, empty_batch, encode_pod_int
 _FIELDS = tuple(PodBatch.__dataclass_fields__)
 
 
+def cacheable(pod: Pod) -> bool:
+    """Claim-backed volumes resolve through mutable PVC/PV state — never
+    cache those rows (and synthetic missing-claim atoms are per-pod-uid,
+    so they could not be shared anyway)."""
+    return not any("persistentVolumeClaim" in v for v in pod.spec.volumes)
+
+
 def pod_fingerprint(pod: Pod) -> tuple:
-    """Hashable equivalence class of the scheduling-relevant spec."""
+    """Hashable equivalence class of everything the encoder reads."""
     spec = pod.spec
     return (
         tuple(
@@ -39,13 +51,21 @@ def pod_fingerprint(pod: Pod) -> tuple:
         tuple(sorted(spec.node_selector.items())),
         tuple((t.key, t.operator, t.value, t.effect) for t in spec.tolerations),
         spec.node_name,
+        # pod-affinity matching reads namespace + labels (pod_match_row)
+        pod.metadata.namespace,
+        tuple(sorted(pod.metadata.labels.items())),
+        # affinity + direct volumes as canonical JSON
+        json.dumps(spec.affinity, sort_keys=True) if spec.affinity else "",
+        json.dumps(spec.volumes, sort_keys=True) if spec.volumes else "",
     )
 
 
 class EncodeCache:
-    def __init__(self, caps: Capacities, table: NodeTable, max_entries: int = 4096):
+    def __init__(self, caps: Capacities, table: NodeTable, max_entries: int = 4096,
+                 volume_ctx=None):
         self.caps = caps
         self.table = table
+        self.volume_ctx = volume_ctx
         self.max_entries = max_entries
         self._rows: OrderedDict[tuple, tuple[np.ndarray, ...]] = OrderedDict()
         self._scratch = empty_batch(caps)
@@ -53,11 +73,16 @@ class EncodeCache:
         self.misses = 0
 
     def encode_into(self, batch: PodBatch, i: int, pod: Pod) -> None:
+        if not cacheable(pod):
+            encode_pod_into(batch, i, pod, self.caps, self.table,
+                            ctx=self.volume_ctx)
+            return
         fp = pod_fingerprint(pod)
         row = self._rows.get(fp)
         if row is None:
             self.misses += 1
-            encode_pod_into(self._scratch, 0, pod, self.caps, self.table)
+            encode_pod_into(self._scratch, 0, pod, self.caps, self.table,
+                            ctx=self.volume_ctx)
             row = tuple(np.copy(getattr(self._scratch, f)[0]) for f in _FIELDS)
             self._rows[fp] = row
             if len(self._rows) > self.max_entries:

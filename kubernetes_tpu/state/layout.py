@@ -125,6 +125,28 @@ class TermKind:
     AFF_PREF = 2   # preferred affinity: priority, +weight
     ANTI_PREF = 3  # preferred anti-affinity: priority, -weight
 
+class VolType:
+    """Attachable-volume type codes for MaxPDVolumeCount (reference
+    EBSVolumeFilter/GCEPDVolumeFilter/AzureDiskVolumeFilter,
+    predicates.go:323-373). ANY marks synthetic atoms for unresolvable PVCs,
+    which the reference counts toward every filter ("assuming PVC matches
+    predicate", predicates.go:240-243)."""
+
+    EBS = 0
+    GCE = 1
+    AZURE = 2
+    ANY = 3
+    EMPTY = -1
+
+    COUNT = 3  # real types (ANY matches all of them)
+
+
+# Reference attach limits (defaults.go:35-41 + aws.DefaultMaxEBSVolumes=39);
+# overridable via KUBE_MAX_PD_VOLS (defaults.go getMaxVols) at Policy build.
+DEFAULT_MAX_EBS_VOLUMES = 39
+DEFAULT_MAX_GCE_PD_VOLUMES = 16
+DEFAULT_MAX_AZURE_DISK_VOLUMES = 16
+
 # Scoring-time defaults for pods with no requests (reference
 # plugin/pkg/scheduler/algorithm/priorities/util/non_zero.go:29-31).
 DEFAULT_NONZERO_CPU_MILLI = 100.0
@@ -163,6 +185,11 @@ class Capacities:
     pref_terms: int = 4            # preferred node-affinity terms per pod
     interpod_slots: int = 4        # required pod-(anti-)affinity terms per pod
     interpod_pref_slots: int = 4   # preferred pod-(anti-)affinity terms per pod
+    volume_universe: int = 32      # UV: distinct disk-conflict atoms
+    attach_universe: int = 32      # UA: distinct attachable-volume atoms
+    image_universe: int = 64       # UI: distinct container-image names
+    avoid_universe: int = 16       # UO: distinct preferAvoidPods signatures
+    volsel_universe: int = 16      # UVS: distinct PV node-affinity selectors
 
 
 class CapacityError(ValueError):

@@ -74,6 +74,17 @@ class PodBatch:
     ppref_q: np.ndarray         # i32[P, IP] preferred terms, -1 unused
     ppref_tkey: np.ndarray      # i32[P, IP] slot or TKEY_DEFAULT_UNION
     ppref_w: np.ndarray         # f32[P, IP] signed weight (anti negative)
+    # volumes (state/volumes.py atom grammars)
+    vol_want_rw: np.ndarray     # f32[P, UV] conflict atoms wanted read-write
+    vol_want_ro: np.ndarray     # f32[P, UV] conflict atoms wanted read-only
+    att_onehot: np.ndarray      # f32[P, UA] attach atoms (0/1, unique per pod)
+    att_fail: np.ndarray        # bool[P] MaxPDVolumeCount resolution error
+    vz_onehot: np.ndarray       # f32[P, US] zone/region selector terms from PVs
+    vz_count: np.ndarray        # f32[P]
+    vz_fail: np.ndarray         # bool[P] VolumeZone resolution error
+    vs_onehot: np.ndarray       # f32[P, UVS] PV node-affinity selectors
+    vs_count: np.ndarray        # f32[P]
+    vs_fail: np.ndarray         # bool[P] VolumeNode resolution error
 
     @property
     def batch_pods(self) -> int:
@@ -114,11 +125,21 @@ def empty_batch(caps: Capacities) -> PodBatch:
         ppref_q=np.full((p, caps.interpod_pref_slots), -1, np.int32),
         ppref_tkey=np.zeros((p, caps.interpod_pref_slots), np.int32),
         ppref_w=np.zeros((p, caps.interpod_pref_slots), np.float32),
+        vol_want_rw=np.zeros((p, caps.volume_universe), np.float32),
+        vol_want_ro=np.zeros((p, caps.volume_universe), np.float32),
+        att_onehot=np.zeros((p, caps.attach_universe), np.float32),
+        att_fail=np.zeros((p,), np.bool_),
+        vz_onehot=np.zeros((p, caps.selector_universe), np.float32),
+        vz_count=np.zeros((p,), np.float32),
+        vz_fail=np.zeros((p,), np.bool_),
+        vs_onehot=np.zeros((p, caps.volsel_universe), np.float32),
+        vs_count=np.zeros((p,), np.float32),
+        vs_fail=np.zeros((p,), np.bool_),
     )
 
 
 def encode_pod_into(batch: PodBatch, i: int, pod: Pod, caps: Capacities,
-                    table: NodeTable) -> None:
+                    table: NodeTable, ctx=None) -> None:
     batch.valid[i] = True
     batch.requests[i] = pod_requests(pod)
     batch.nonzero_requests[i] = pod_nonzero_requests(pod)
@@ -157,6 +178,60 @@ def encode_pod_into(batch: PodBatch, i: int, pod: Pod, caps: Capacities,
     batch.best_effort[i] = pod.is_best_effort()
     _encode_node_affinity(batch, i, pod, caps, table)
     _encode_interpod_affinity(batch, i, pod, caps, table)
+    _encode_volumes(batch, i, pod, caps, table, ctx)
+
+
+def _encode_volumes(batch: PodBatch, i: int, pod: Pod, caps: Capacities,
+                    table: NodeTable, ctx) -> None:
+    """Conflict/attach/zone/node-affinity rows for the pod's volumes. The
+    per-predicate fail bits mirror the reference's error returns: each bit
+    only takes effect when the corresponding predicate is in the policy."""
+    from kubernetes_tpu.state.volumes import (
+        EMPTY_CONTEXT,
+        VolumeError,
+        pod_volume_node_selectors,
+        pod_zone_terms,
+    )
+
+    batch.vol_want_rw[i] = 0.0
+    batch.vol_want_ro[i] = 0.0
+    batch.att_onehot[i] = 0.0
+    batch.att_fail[i] = False
+    batch.vz_onehot[i] = 0.0
+    batch.vz_count[i] = 0.0
+    batch.vz_fail[i] = False
+    batch.vs_onehot[i] = 0.0
+    batch.vs_count[i] = 0.0
+    batch.vs_fail[i] = False
+    if not pod.spec.volumes:
+        return
+    ctx = ctx or EMPTY_CONTEXT
+
+    any_row, rw_row = table.vol_rows(pod)
+    batch.vol_want_rw[i] = rw_row
+    batch.vol_want_ro[i] = any_row - rw_row
+
+    try:
+        batch.att_onehot[i] = table.attach_row(pod, ctx)
+    except VolumeError:
+        batch.att_fail[i] = True
+
+    try:
+        terms = {term: None for term in pod_zone_terms(pod, ctx)}  # dedup
+        for key, value in terms:
+            batch.vz_onehot[i, table.intern_sel_term(key, value)] = 1.0
+        batch.vz_count[i] = float(len(terms))
+    except VolumeError:
+        batch.vz_fail[i] = True
+
+    try:
+        vsids = {table.intern_volsel(sel)
+                 for sel in pod_volume_node_selectors(pod, ctx)}
+        for vsid in vsids:
+            batch.vs_onehot[i, vsid] = 1.0
+        batch.vs_count[i] = float(len(vsids))
+    except VolumeError:
+        batch.vs_fail[i] = True
 
 
 def _encode_interpod_affinity(batch: PodBatch, i: int, pod: Pod,
@@ -296,30 +371,30 @@ def _encode_node_affinity(batch: PodBatch, i: int, pod: Pod, caps: Capacities,
 
 
 def encode_pods(pods: Sequence[Pod], caps: Capacities, table: NodeTable,
-                state: ClusterState | None = None) -> PodBatch:
+                state: ClusterState | None = None, ctx=None) -> PodBatch:
     """Encode a batch against the cluster's universes. When `state` is given,
     membership columns for newly interned terms are refilled in place."""
     if len(pods) > caps.batch_pods:
         raise CapacityError(f"{len(pods)} pods > batch capacity {caps.batch_pods}")
     batch = empty_batch(caps)
     for i, pod in enumerate(pods):
-        encode_pod_into(batch, i, pod, caps, table)
+        encode_pod_into(batch, i, pod, caps, table, ctx=ctx)
     fill_batch_affinity(batch, pods, table)
     if state is not None:
         apply_pending_refreshes(state, table)
     return batch
 
 
-def encode_cluster(nodes, pods, caps: Capacities, assigned_pods=()):
+def encode_cluster(nodes, pods, caps: Capacities, assigned_pods=(), ctx=None):
     """One-shot fixture encoding: nodes (+ assigned pods) + pending pods with
     a shared universe, membership fully consistent. Returns
     (state, batch, table)."""
     from kubernetes_tpu.state.cluster_state import encode_nodes
 
     table = NodeTable(caps)
-    batch = encode_pods(pods, caps, table)
+    batch = encode_pods(pods, caps, table, ctx=ctx)
     state, _ = encode_nodes(nodes, caps, assigned_pods=assigned_pods,
-                            table=table)
+                            table=table, ctx=ctx)
     # assigned pods may have interned new selector entries: refresh the
     # batch's match rows against the final universes
     fill_batch_affinity(batch, pods, table)

@@ -58,12 +58,16 @@ class AccountedPod:
     carry_row: np.ndarray    # f32[UE] carried-term multiplicities
     namespace: str
     labels: dict
+    vol_any_row: np.ndarray | None = None   # f32[UV] conflict-atom counts
+    vol_rw_row: np.ndarray | None = None
+    att_row: np.ndarray | None = None       # f32[UA] attach atoms
 
 
 class StateDB:
-    def __init__(self, caps: Capacities, mesh=None):
+    def __init__(self, caps: Capacities, mesh=None, volume_ctx=None):
         self.caps = caps
         self.mesh = mesh
+        self.volume_ctx = volume_ctx  # VolumeContext for claim resolution
         self.host: ClusterState = empty_state(caps)
         self.table = NodeTable(caps)
         self._accounted: dict[str, AccountedPod] = {}
@@ -105,6 +109,11 @@ class StateDB:
         self.host.port_count[row] += sign * acc.port_onehot
         self.host.podsel_count[row] += sign * acc.match_row
         self.host.term_count[row] += sign * acc.carry_row
+        if acc.vol_any_row is not None:
+            self.host.vol_any[row] += sign * acc.vol_any_row
+            self.host.vol_rw[row] += sign * acc.vol_rw_row
+        if acc.att_row is not None:
+            self.host.attach_count[row] += sign * acc.att_row
         self.table.bump(row)
 
     def add_pod(self, pod: Pod, node_name: str | None = None, *,
@@ -125,6 +134,13 @@ class StateDB:
         if pod.key in self._accounted:
             return True  # already accounted (assume then confirm)
         eids, _ = intern_pod_affinity_terms(self.table, pod)
+        vol_any_row = vol_rw_row = att_row = None
+        if pod.spec.volumes:
+            from kubernetes_tpu.state.volumes import EMPTY_CONTEXT
+
+            vol_any_row, vol_rw_row = self.table.vol_rows(pod)
+            att_row = self.table.attach_row(
+                pod, self.volume_ctx or EMPTY_CONTEXT, permissive=True)
         acc = AccountedPod(
             node_name=node_name,
             requests=pod_requests(pod),
@@ -134,6 +150,9 @@ class StateDB:
             carry_row=carried_term_row(self.table, eids),
             namespace=pod.metadata.namespace,
             labels=dict(pod.metadata.labels),
+            vol_any_row=vol_any_row,
+            vol_rw_row=vol_rw_row,
+            att_row=att_row,
         )
         self._apply_pod(row, acc, +1)
         self._accounted[pod.key] = acc
@@ -198,6 +217,13 @@ class StateDB:
                     nonzero_requested=self._put_arr(self.host.nonzero_requested),
                     port_count=self._put_arr(self.host.port_count),
                 )
+                if self.table.vol_atoms:
+                    dev = dev.replace(
+                        vol_any=self._put_arr(self.host.vol_any),
+                        vol_rw=self._put_arr(self.host.vol_rw))
+                if self.table.attach_atoms:
+                    dev = dev.replace(
+                        attach_count=self._put_arr(self.host.attach_count))
             if (self._dirty_ledger or self._dirty_affinity) and self.table.podsels:
                 dev = dev.replace(
                     podsel_count=self._put_arr(self.host.podsel_count),
@@ -207,6 +233,8 @@ class StateDB:
                     sel_member=self._put_arr(self.host.sel_member),
                     req_member=self._put_arr(self.host.req_member),
                     topology=self._put_arr(self.host.topology),
+                    volsel_member=self._put_arr(self.host.volsel_member),
+                    attach_type=jax.device_put(np.asarray(self.host.attach_type)),
                     term_q=jax.device_put(np.asarray(self.host.term_q)),
                     term_tkey=jax.device_put(np.asarray(self.host.term_tkey)),
                     term_weight=jax.device_put(np.asarray(self.host.term_weight)),
@@ -233,10 +261,16 @@ class StateDB:
         for pod, node_name in assignments:
             self.add_pod(pod, node_name, mirror_only=True)
             acc = self._accounted.get(pod.key)
+            if acc is None:
+                continue
             # the solver's output ledger does not include inter-pod affinity
             # counts; if this pod affects them, the next flush re-uploads
-            if acc is not None and (acc.match_row.any() or acc.carry_row.any()):
+            if acc.match_row.any() or acc.carry_row.any():
                 self._dirty_affinity = True
+            # nor the volume ledgers: a volume-bearing assignment forces a
+            # ledger re-upload from the (equal-by-mirroring) host truth
+            if acc.vol_any_row is not None or acc.att_row is not None:
+                self._dirty_ledger = True
 
     def _put(self, state: ClusterState) -> ClusterState:
         if self.mesh is not None:

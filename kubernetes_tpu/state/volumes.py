@@ -1,0 +1,295 @@
+"""Host-side volume analysis: pod volumes -> interned device atoms.
+
+The reference's volume predicates walk per-pod volume lists with string
+comparisons (NoDiskConflict predicates.go:100-195, MaxPDVolumeCountChecker
+:215-320, VolumeZoneChecker :395-470, VolumeNodeChecker :1345). Here every
+distinct conflict identity becomes an integer "atom" in a small universe, the
+node side carries per-atom usage counts, the pod side carries one-hot want
+rows, and the predicates in ops/predicates.py reduce to one masked matmul
+each.
+
+Atom grammars:
+- **Conflict atoms** (NoDiskConflict): GCE PD -> ("gce", pdName); AWS EBS ->
+  ("aws", volumeID); ISCSI -> ("iscsi", iqn); RBD -> one atom per monitor
+  ("rbd", monitor, pool, image) — set-overlap of monitors (haveSame,
+  predicates.go:887) is exactly one-hot overlap of per-monitor atoms. Each
+  atom carries a read-only flag; a conflict needs a read-write party except
+  for AWS EBS, which conflicts regardless (predicates.go:121-128), so EBS
+  atoms are always read-write.
+- **Attach atoms** (MaxPDVolumeCount): (VolType, cloud volume id), resolved
+  through PVC -> PV when the volume is claim-backed. Lookup misses produce a
+  deterministic synthetic atom unique per (pod, claim) with VolType.ANY
+  (the reference generates a random ID and counts it toward the limit,
+  predicates.go:240-268).
+- **Zone terms** (VolumeZone): bound PV labels for zone/region become
+  (key, value) selector-universe terms the node must carry — the reference
+  compares raw label strings (predicates.go:461-470).
+- **Volume node selectors** (VolumeNode): a bound PV's node-affinity
+  annotation becomes one interned selector whose per-node membership is
+  evaluated host-side (volume.alpha.kubernetes.io/node-affinity, mirrors
+  pkg/volume/util.go CheckNodeAffinity).
+
+Resolution errors (unnamed claim, unbound claim, missing PV for zone checks)
+mirror the reference's error returns: the predicate fails for the pod
+everywhere, surfaced as per-pod fail bits.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+from kubernetes_tpu.api.objects import PersistentVolume, PersistentVolumeClaim, Pod
+from kubernetes_tpu.state.layout import TOPOLOGY_KEYS, VolType
+
+ZONE_LABELS = (TOPOLOGY_KEYS[1], TOPOLOGY_KEYS[2])  # zone, region
+
+# PV annotation carrying alpha node affinity (reference
+# v1.AlphaStorageNodeAffinityAnnotation, pkg/api/v1/types.go).
+NODE_AFFINITY_ANNOTATION = "volume.alpha.kubernetes.io/node-affinity"
+
+
+class VolumeError(Exception):
+    """Unresolvable volume reference — the analog of a predicate returning a
+    non-nil error (fails the pod's scheduling attempt)."""
+
+
+@dataclass
+class VolumeContext:
+    """Lister access for claim resolution (reference PluginFactoryArgs
+    PVInfo/PVCInfo, factory/plugins.go). `None` lookups mean not-found."""
+
+    get_pvc: Callable[[str, str], PersistentVolumeClaim | None] = \
+        lambda ns, name: None
+    get_pv: Callable[[str], PersistentVolume | None] = lambda name: None
+    # feature gate for NoVolumeNodeConflict (PersistentLocalVolumes,
+    # pkg/features/kube_features.go — alpha, default off)
+    local_volumes_enabled: bool = False
+
+
+EMPTY_CONTEXT = VolumeContext()
+
+
+def conflict_atoms(volume: dict) -> list[tuple[tuple, bool]]:
+    """[(atom, read_only)] for one raw v1 Volume (isVolumeConflict,
+    predicates.go:100-147)."""
+    gce = volume.get("gcePersistentDisk")
+    if gce is not None:
+        return [(("gce", gce.get("pdName", "")), bool(gce.get("readOnly")))]
+    aws = volume.get("awsElasticBlockStore")
+    if aws is not None:
+        # EBS conflicts regardless of read-only (predicates.go:121-125)
+        return [(("aws", aws.get("volumeID", "")), False)]
+    iscsi = volume.get("iscsi")
+    if iscsi is not None:
+        return [(("iscsi", iscsi.get("iqn", "")), bool(iscsi.get("readOnly")))]
+    rbd = volume.get("rbd")
+    if rbd is not None:
+        pool = rbd.get("pool") or "rbd"
+        image = rbd.get("image", "")
+        ro = bool(rbd.get("readOnly"))
+        return [(("rbd", mon, pool, image), ro)
+                for mon in rbd.get("monitors") or []]
+    return []
+
+
+def pod_conflict_atoms(pod: Pod) -> list[tuple[tuple, bool]]:
+    out = []
+    for vol in pod.spec.volumes:
+        out.extend(conflict_atoms(vol))
+    return out
+
+
+_FILTERS = (
+    (VolType.EBS, "awsElasticBlockStore", "volumeID"),
+    (VolType.GCE, "gcePersistentDisk", "pdName"),
+    (VolType.AZURE, "azureDisk", "diskName"),
+)
+
+
+def _direct_attach_atom(volume: dict) -> tuple[int, tuple] | None:
+    for vtype, key, id_field in _FILTERS:
+        src = volume.get(key)
+        if src is not None:
+            return (vtype, (key, src.get(id_field, "")))
+    return None
+
+
+def _resolve_pvc(namespace: str, volume: dict, ctx: VolumeContext):
+    """Resolve a claim-backed volume to its PV. Returns (pv | None, claim).
+    Raises VolumeError for the reference's hard-error cases; returns
+    pv=None for lookup misses (the permissive paths)."""
+    claim = volume["persistentVolumeClaim"]
+    pvc_name = claim.get("claimName", "")
+    if not pvc_name:
+        raise VolumeError("PersistentVolumeClaim had no name")
+    pvc = ctx.get_pvc(namespace, pvc_name)
+    if pvc is None:
+        return None, pvc_name  # not found: permissive for attach counting
+    pv_name = pvc.volume_name
+    if not pv_name:
+        raise VolumeError(f"PersistentVolumeClaim is not bound: {pvc_name!r}")
+    return ctx.get_pv(pv_name), pvc_name
+
+
+def pod_attach_atoms(pod: Pod, ctx: VolumeContext,
+                     permissive: bool = False) -> list[tuple[int, tuple]]:
+    """Unique (VolType, atom) list for MaxPDVolumeCount. Raises VolumeError
+    on the reference's error paths (unnamed/unbound claims); with
+    `permissive`, erroring volumes are skipped instead — used when counting
+    already-bound pods, where a broken claim must not zero the node's whole
+    attach row."""
+    atoms: dict[tuple, int] = {}
+    for idx, vol in enumerate(pod.spec.volumes):
+        direct = _direct_attach_atom(vol)
+        if direct is not None:
+            vtype, atom = direct
+            atoms[atom] = vtype
+            continue
+        if "persistentVolumeClaim" not in vol:
+            continue
+        try:
+            pv, pvc_name = _resolve_pvc(pod.metadata.namespace, vol, ctx)
+        except VolumeError:
+            if permissive:
+                continue
+            raise
+        if pv is None:
+            # PVC not found: one synthetic atom per (pod, volume slot) that
+            # counts toward every filter (predicates.go:240-268 random IDs —
+            # deterministic here, same multiplicity)
+            atoms[("missing", pod.metadata.namespace, pvc_name,
+                   pod.metadata.uid, idx)] = VolType.ANY
+            continue
+        direct = _direct_attach_atom(pv.spec)
+        if direct is not None:
+            vtype, atom = direct
+            atoms[atom] = vtype
+    return [(vtype, atom) for atom, vtype in atoms.items()]
+
+
+def pod_zone_terms(pod: Pod, ctx: VolumeContext) -> list[tuple[str, str]]:
+    """(label key, value) constraints from bound PVs' zone/region labels
+    (VolumeZoneChecker predicate body, predicates.go:430-470). Raises
+    VolumeError when a claim chain cannot be resolved — VolumeZone treats
+    every miss as a hard error (predicates.go:440-458)."""
+    terms: list[tuple[str, str]] = []
+    for vol in pod.spec.volumes:
+        if "persistentVolumeClaim" not in vol:
+            continue
+        pv, pvc_name = _resolve_pvc(pod.metadata.namespace, vol, ctx)
+        if pv is None:
+            raise VolumeError(
+                f"PersistentVolumeClaim or PV not found: {pvc_name!r}")
+        for k, v in pv.metadata.labels.items():
+            if k in ZONE_LABELS:
+                terms.append((k, v))
+    return terms
+
+
+def parse_volume_node_selector(pv: PersistentVolume) -> list | None:
+    """NodeSelectorTerms from the PV's alpha node-affinity annotation, or
+    None when absent (mirrors GetStorageNodeAffinityFromAnnotation +
+    CheckNodeAffinity, pkg/volume/util.go)."""
+    import json
+
+    raw = pv.metadata.annotations.get(NODE_AFFINITY_ANNOTATION)
+    if not raw:
+        return None
+    try:
+        affinity = json.loads(raw)
+    except ValueError as exc:
+        raise VolumeError(f"bad node-affinity annotation on PV "
+                          f"{pv.metadata.name!r}: {exc}")
+    required = (affinity or {}).get(
+        "requiredDuringSchedulingIgnoredDuringExecution")
+    if not required:
+        return None
+    return [t.get("matchExpressions") or []
+            for t in required.get("nodeSelectorTerms") or []]
+
+
+def node_selector_canon(terms: list) -> str:
+    """Stable interning key for a NodeSelector (list of OR-terms)."""
+    import json
+
+    return json.dumps(terms, sort_keys=True, separators=(",", ":"))
+
+
+def node_selector_matches(terms: list, labels: dict[str, str]) -> bool:
+    """OR over terms, AND over each term's requirements; invalid
+    requirements make their term match nothing (nodeMatchesNodeSelectorTerms
+    semantics, predicates.go:625-660)."""
+    from kubernetes_tpu.state.cluster_state import match_requirement
+    from kubernetes_tpu.state.pod_batch import _valid_requirement
+
+    for exprs in terms:
+        if not exprs:
+            continue  # empty term matches nothing
+        if any(not _valid_requirement(e) for e in exprs):
+            continue
+        if all(match_requirement(labels, e.get("key", ""), e["operator"],
+                                 tuple(e.get("values") or ())) for e in exprs):
+            return True
+    return False
+
+
+def pod_volume_node_selectors(pod: Pod, ctx: VolumeContext) -> list[list]:
+    """NodeSelector term-lists the node must satisfy, one per constrained
+    bound PV (NoVolumeNodeConflict; empty when the feature gate is off,
+    predicates.go:1355-1357)."""
+    if not ctx.local_volumes_enabled:
+        return []
+    selectors: list[list] = []
+    for vol in pod.spec.volumes:
+        if "persistentVolumeClaim" not in vol:
+            continue
+        pv, pvc_name = _resolve_pvc(pod.metadata.namespace, vol, ctx)
+        if pv is None:
+            raise VolumeError(
+                f"PersistentVolumeClaim or PV not found: {pvc_name!r}")
+        terms = parse_volume_node_selector(pv)
+        if terms is not None:
+            selectors.append(terms)
+    return selectors
+
+
+# ---- preferAvoidPods (NodePreferAvoidPodsPriority, used in M1b) ----
+
+AVOID_PODS_ANNOTATION = "scheduler.alpha.kubernetes.io/preferAvoidPods"
+
+
+def parse_avoid_signatures(annotations: dict[str, str]) -> list[tuple[str, str]]:
+    """[(kind, uid)] signatures from the node's preferAvoidPods annotation
+    (GetAvoidPodsFromNodeAnnotations, pkg/api/v1/helper/helpers.go); parse
+    failures yield no signatures (the priority treats them as schedulable,
+    node_prefer_avoid_pods.go:47-50)."""
+    import json
+
+    raw = annotations.get(AVOID_PODS_ANNOTATION)
+    if not raw:
+        return []
+    try:
+        parsed = json.loads(raw)
+    except ValueError:
+        return []
+    out = []
+    for entry in (parsed or {}).get("preferAvoidPods") or []:
+        ctrl = ((entry.get("podSignature") or {}).get("podController") or {})
+        kind = ctrl.get("kind", "")
+        uid = ctrl.get("uid", "")
+        if kind and uid:
+            out.append((kind, uid))
+    return out
+
+
+def pod_controller_ref(pod: Pod) -> tuple[str, str] | None:
+    """(kind, uid) of the pod's controller owner if it is an RC or RS
+    (GetControllerRef + kind filter, node_prefer_avoid_pods.go:35-43)."""
+    for ref in pod.metadata.owner_references:
+        if ref.get("controller"):
+            kind = ref.get("kind", "")
+            if kind in ("ReplicationController", "ReplicaSet"):
+                return (kind, ref.get("uid", ""))
+            return None
+    return None

@@ -50,6 +50,7 @@ class NodeState:
     nz_cpu: int = 0
     nz_mem: int = 0
     ports: set = field(default_factory=set)
+    pods: list = field(default_factory=list)  # pods on this node (volumes)
 
     @classmethod
     def from_node(cls, node: Node) -> "NodeState":
@@ -76,6 +77,7 @@ class NodeState:
         self.nz_mem += nz_mem
         self.num_pods += 1
         self.ports |= pod_ports(pod)
+        self.pods.append(pod)
 
 
 def pod_request(pod: Pod) -> tuple[int, int, int, int, int]:
@@ -355,6 +357,136 @@ def interpod_count(placed, by_name, node: Node, pod: Pod, hard_w: int) -> float:
     return count
 
 
+# ---- volume predicates (direct Go transcriptions over raw volume dicts) ----
+
+def _volume_conflict(v: dict, other_pod: Pod) -> bool:
+    """isVolumeConflict (predicates.go:100-147)."""
+    for ev in other_pod.spec.volumes:
+        gce, egce = v.get("gcePersistentDisk"), ev.get("gcePersistentDisk")
+        if gce and egce and gce.get("pdName") == egce.get("pdName") \
+                and not (gce.get("readOnly") and egce.get("readOnly")):
+            return True
+        aws, eaws = v.get("awsElasticBlockStore"), ev.get("awsElasticBlockStore")
+        if aws and eaws and aws.get("volumeID") == eaws.get("volumeID"):
+            return True
+        i, ei = v.get("iscsi"), ev.get("iscsi")
+        if i and ei and i.get("iqn") == ei.get("iqn") \
+                and not (i.get("readOnly") and ei.get("readOnly")):
+            return True
+        r, er = v.get("rbd"), ev.get("rbd")
+        if r and er:
+            if (set(r.get("monitors") or []) & set(er.get("monitors") or [])
+                    and (r.get("pool") or "rbd") == (er.get("pool") or "rbd")
+                    and r.get("image") == er.get("image")
+                    and not (r.get("readOnly") and er.get("readOnly"))):
+                return True
+    return False
+
+
+def no_disk_conflict(ns: NodeState, pod: Pod) -> bool:
+    for v in pod.spec.volumes:
+        for ep in ns.pods:
+            if _volume_conflict(v, ep):
+                return False
+    return True
+
+
+_ATTACH_FIELDS = {
+    "ebs": ("awsElasticBlockStore", "volumeID"),
+    "gce": ("gcePersistentDisk", "pdName"),
+    "azure": ("azureDisk", "diskName"),
+}
+
+
+class VolumeFailure(Exception):
+    """Predicate hard-error path (pod scheduling attempt fails)."""
+
+
+def _filter_volumes(pod: Pod, which: str, ctx, out: set) -> None:
+    """filterVolumes (predicates.go:226-280) for one filter type."""
+    key, id_field = _ATTACH_FIELDS[which]
+    for idx, v in enumerate(pod.spec.volumes):
+        src = v.get(key)
+        if src is not None:
+            out.add((key, src.get(id_field, "")))
+            continue
+        claim = v.get("persistentVolumeClaim")
+        if claim is None:
+            continue
+        name = claim.get("claimName", "")
+        if not name:
+            raise VolumeFailure("PVC had no name")
+        pvc = ctx.get_pvc(pod.metadata.namespace, name) if ctx else None
+        if pvc is None:
+            out.add(("missing", pod.metadata.namespace, name,
+                     pod.metadata.uid, idx))
+            continue
+        if not pvc.volume_name:
+            raise VolumeFailure("PVC not bound")
+        pv = ctx.get_pv(pvc.volume_name)
+        if pv is None:
+            out.add(("missing", pod.metadata.namespace, name,
+                     pod.metadata.uid, idx))
+            continue
+        src = pv.spec.get(key)
+        if src is not None:
+            out.add((key, src.get(id_field, "")))
+
+
+def max_volume_ok(ns: NodeState, pod: Pod, which: str, limit: int, ctx) -> bool:
+    new: set = set()
+    _filter_volumes(pod, which, ctx, new)
+    if not new:
+        return True
+    existing: set = set()
+    for ep in ns.pods:
+        _filter_volumes(ep, which, ctx, existing)
+    return len(existing) + len(new - existing) <= limit
+
+
+ZONE_KEYS = ("failure-domain.beta.kubernetes.io/zone",
+             "failure-domain.beta.kubernetes.io/region")
+
+
+def volume_zone_terms(pod: Pod, ctx) -> list[tuple[str, str]]:
+    """Resolve every claim to its PV zone labels (predicates.go:430-465);
+    raises on the error paths."""
+    terms = []
+    for v in pod.spec.volumes:
+        claim = v.get("persistentVolumeClaim")
+        if claim is None:
+            continue
+        name = claim.get("claimName", "")
+        if not name:
+            raise VolumeFailure("PVC had no name")
+        pvc = ctx.get_pvc(pod.metadata.namespace, name) if ctx else None
+        if pvc is None:
+            raise VolumeFailure("PVC not found")
+        if not pvc.volume_name:
+            raise VolumeFailure("PVC not bound")
+        pv = ctx.get_pv(pvc.volume_name)
+        if pv is None:
+            raise VolumeFailure("PV not found")
+        for k, val in pv.metadata.labels.items():
+            if k in ZONE_KEYS:
+                terms.append((k, val))
+    return terms
+
+
+def node_zone_constrained(ns: NodeState) -> bool:
+    return any(k in ns.node.metadata.labels for k in ZONE_KEYS)
+
+
+def volume_zone_ok(ns: NodeState, terms: list[tuple[str, str]]) -> bool:
+    """Per-node half of VolumeZoneChecker: unconstrained nodes pass; others
+    must carry every PV zone label exactly (predicates.go:421-470)."""
+    constraints = {k: v for k, v in ns.node.metadata.labels.items()
+                   if k in ZONE_KEYS}
+    if not constraints:
+        return True
+    return all(constraints.get(k, "") == v for k, v in terms)
+
+
 def untolerated_prefer_count(ns: NodeState, pod: Pod) -> int:
     # Only tolerations applicable to PreferNoSchedule count
     # (taint_toleration.go getAllTolerationPreferNoSchedule).
@@ -374,7 +506,9 @@ class SerialScheduler:
 
     def __init__(self, nodes: list[Node], assigned_pods: list[Pod] = (),
                  *, with_node_affinity: bool = False,
-                 with_interpod: bool = False, hard_pod_affinity_weight: int = 1):
+                 with_interpod: bool = False, hard_pod_affinity_weight: int = 1,
+                 with_volumes: bool = False, volume_ctx=None,
+                 attach_limits: dict | None = None):
         self.states = [NodeState.from_node(n) for n in nodes]
         self.by_name = {ns.node.metadata.name: ns for ns in self.states}
         self.placed: list[tuple[Pod, str]] = []
@@ -387,12 +521,38 @@ class SerialScheduler:
         self.with_node_affinity = with_node_affinity
         self.with_interpod = with_interpod
         self.hard_w = hard_pod_affinity_weight
+        self.with_volumes = with_volumes
+        self.volume_ctx = volume_ctx
+        # {"ebs": limit, "gce": limit, "azure": limit}
+        self.attach_limits = attach_limits or {}
+
+    def _volume_filter(self, fits: list, pod: Pod) -> list | None:
+        """None = predicate error, the whole scheduling attempt fails."""
+        try:
+            fits = [ns for ns in fits if no_disk_conflict(ns, pod)]
+            for which, limit in self.attach_limits.items():
+                fits = [ns for ns in fits
+                        if max_volume_ok(ns, pod, which, limit, self.volume_ctx)]
+            # VolumeZone only resolves claims when a zoned node would have
+            # evaluated it (deterministic form of the reference's error
+            # aggregation; see ops/predicates.py volume_zone)
+            if pod.spec.volumes and any(node_zone_constrained(ns)
+                                        for ns in self.states):
+                terms = volume_zone_terms(pod, self.volume_ctx)
+                fits = [ns for ns in fits if volume_zone_ok(ns, terms)]
+        except VolumeFailure:
+            return None
+        return fits
 
     def schedule_one(self, pod: Pod) -> str | None:
         fits = [ns for ns in self.states if feasible(ns, pod)]
         if self.with_interpod:
             fits = [ns for ns in fits
                     if interpod_feasible(self.placed, self.by_name, ns.node, pod)]
+        if self.with_volumes:
+            fits = self._volume_filter(fits, pod)
+            if fits is None:
+                return None  # predicate error: scheduling attempt fails
         if not fits:
             return None
         counts = [untolerated_prefer_count(ns, pod) for ns in fits]

@@ -1,0 +1,308 @@
+"""Volume predicate tests: NoDiskConflict, MaxPDVolumeCount, VolumeZone,
+VolumeNode — unit tables (modeled on predicates_test.go volume cases) plus
+serial-parity of full batched scheduling with volume-bearing pods."""
+
+import numpy as np
+import pytest
+
+from kubernetes_tpu.api.objects import (
+    Node,
+    PersistentVolume,
+    PersistentVolumeClaim,
+    Pod,
+)
+from kubernetes_tpu.models.policy import Policy
+from kubernetes_tpu.ops.solver import schedule_batch
+from kubernetes_tpu.state import Capacities, encode_cluster
+from kubernetes_tpu.state.volumes import VolumeContext
+
+from tests.serial_reference import SerialScheduler
+
+CAPS = Capacities(num_nodes=8, batch_pods=8)
+
+
+def mk_node(name, labels=None, pods="110", cpu="64", mem="256Gi"):
+    return Node.from_dict({
+        "metadata": {"name": name, "labels": labels or {}},
+        "status": {"allocatable": {"cpu": cpu, "memory": mem, "pods": pods},
+                   "conditions": [{"type": "Ready", "status": "True"}]},
+    })
+
+
+def mk_pod(name, volumes=None, node_name="", namespace="default", uid=None):
+    return Pod.from_dict({
+        "metadata": {"name": name, "namespace": namespace,
+                     "uid": uid or f"uid-{name}"},
+        "spec": {"nodeName": node_name,
+                 "containers": [{"name": "c", "resources": {
+                     "requests": {"cpu": "100m"}}}],
+                 "volumes": volumes or []},
+    })
+
+
+def gce(pd, ro=False):
+    return {"name": pd, "gcePersistentDisk": {"pdName": pd, "readOnly": ro}}
+
+
+def ebs(vid):
+    return {"name": vid, "awsElasticBlockStore": {"volumeID": vid}}
+
+
+def rbd(image, monitors, ro=False):
+    return {"name": image, "rbd": {"monitors": monitors, "pool": "rbd",
+                                   "image": image, "readOnly": ro}}
+
+
+def pvc_vol(claim):
+    return {"name": claim, "persistentVolumeClaim": {"claimName": claim}}
+
+
+def mk_ctx(pvcs=(), pvs=(), local=False):
+    pvc_map = {p.key: p for p in pvcs}
+    pv_map = {p.metadata.name: p for p in pvs}
+    return VolumeContext(
+        get_pvc=lambda ns, name: pvc_map.get(f"{ns}/{name}"),
+        get_pv=lambda name: pv_map.get(name),
+        local_volumes_enabled=local,
+    )
+
+
+def solve(nodes, pending, policy, assigned=(), ctx=None, caps=CAPS):
+    state, batch, table = encode_cluster(nodes, pending, caps,
+                                         assigned_pods=assigned, ctx=ctx)
+    result = schedule_batch(state, batch, np.uint32(0), policy=policy,
+                            caps=caps)
+    rows = np.asarray(result.assignments)
+    return [table.name_of[r] if r >= 0 else None
+            for r in rows[: len(pending)]]
+
+
+DISK_POLICY = Policy(predicates=("GeneralPredicates", "NoDiskConflict"))
+
+
+class TestNoDiskConflict:
+    def test_gce_rw_conflicts(self):
+        nodes = [mk_node("n0"), mk_node("n1")]
+        assigned = [mk_pod("a", volumes=[gce("pd-1")], node_name="n0")]
+        got = solve(nodes, [mk_pod("p", volumes=[gce("pd-1")])], DISK_POLICY,
+                    assigned=assigned)
+        assert got == ["n1"]
+
+    def test_gce_both_readonly_ok(self):
+        nodes = [mk_node("n0")]
+        assigned = [mk_pod("a", volumes=[gce("pd-1", ro=True)], node_name="n0")]
+        got = solve(nodes, [mk_pod("p", volumes=[gce("pd-1", ro=True)])],
+                    DISK_POLICY, assigned=assigned)
+        assert got == ["n0"]
+
+    def test_ebs_conflicts_even_readonly(self):
+        nodes = [mk_node("n0")]
+        assigned = [mk_pod("a", volumes=[ebs("vol-1")], node_name="n0")]
+        got = solve(nodes, [mk_pod("p", volumes=[ebs("vol-1")])], DISK_POLICY,
+                    assigned=assigned)
+        assert got == [None]
+
+    def test_rbd_monitor_overlap(self):
+        nodes = [mk_node("n0"), mk_node("n1")]
+        assigned = [mk_pod("a", volumes=[rbd("img", ["m1", "m2"])],
+                           node_name="n0")]
+        # overlapping monitor + same pool/image conflicts
+        got = solve(nodes, [mk_pod("p", volumes=[rbd("img", ["m2", "m3"])])],
+                    DISK_POLICY, assigned=assigned)
+        assert got == ["n1"]
+        # disjoint monitors: no conflict
+        got = solve(nodes, [mk_pod("q", volumes=[rbd("img", ["m4"])])],
+                    DISK_POLICY, assigned=assigned)
+        assert got == ["n0"]
+
+    def test_in_batch_conflict(self):
+        # two pods in one batch wanting the same PD must not share a node
+        nodes = [mk_node("n0"), mk_node("n1")]
+        got = solve(nodes, [mk_pod("p1", volumes=[gce("pd")]),
+                            mk_pod("p2", volumes=[gce("pd")])], DISK_POLICY)
+        assert set(got) == {"n0", "n1"}
+
+
+class TestMaxPDVolumeCount:
+    POLICY = Policy(predicates=("GeneralPredicates", "MaxEBSVolumeCount"),
+                    max_ebs_volumes=2)
+
+    def test_over_limit(self):
+        nodes = [mk_node("n0")]
+        assigned = [mk_pod("a", volumes=[ebs("v1"), ebs("v2")], node_name="n0")]
+        got = solve(nodes, [mk_pod("p", volumes=[ebs("v3")])], self.POLICY,
+                    assigned=assigned)
+        assert got == [None]
+
+    def test_reusing_attached_volume_ok(self):
+        nodes = [mk_node("n0")]
+        assigned = [mk_pod("a", volumes=[ebs("v1"), ebs("v2")], node_name="n0")]
+        # v1 already attached: no new attachment needed... but EBS conflicts
+        # on NoDiskConflict, which is not in this policy
+        got = solve(nodes, [mk_pod("p", volumes=[ebs("v1")])], self.POLICY,
+                    assigned=assigned)
+        assert got == ["n0"]
+
+    def test_no_relevant_volumes_passes(self):
+        nodes = [mk_node("n0")]
+        assigned = [mk_pod("a", volumes=[ebs("v1"), ebs("v2"), ebs("v3")],
+                           node_name="n0")]
+        got = solve(nodes, [mk_pod("p", volumes=[gce("pd")])], self.POLICY,
+                    assigned=assigned)
+        assert got == ["n0"]
+
+    def test_pvc_resolution(self):
+        pv = PersistentVolume.from_dict({
+            "metadata": {"name": "pv-1"},
+            "spec": {"awsElasticBlockStore": {"volumeID": "v9"}}})
+        pvc = PersistentVolumeClaim.from_dict({
+            "metadata": {"name": "claim", "namespace": "default"},
+            "spec": {"volumeName": "pv-1"}})
+        ctx = mk_ctx(pvcs=[pvc], pvs=[pv])
+        nodes = [mk_node("n0")]
+        assigned = [mk_pod("a", volumes=[ebs("v1"), ebs("v2")], node_name="n0")]
+        got = solve(nodes, [mk_pod("p", volumes=[pvc_vol("claim")])],
+                    self.POLICY, assigned=assigned, ctx=ctx)
+        assert got == [None]  # resolved EBS volume would be the 3rd
+
+    def test_missing_pvc_counts(self):
+        nodes = [mk_node("n0")]
+        assigned = [mk_pod("a", volumes=[ebs("v1"), ebs("v2")], node_name="n0")]
+        got = solve(nodes, [mk_pod("p", volumes=[pvc_vol("ghost")])],
+                    self.POLICY, assigned=assigned, ctx=mk_ctx())
+        assert got == [None]  # synthetic atom counts toward the limit
+
+    def test_unbound_pvc_fails_pod(self):
+        pvc = PersistentVolumeClaim.from_dict({
+            "metadata": {"name": "claim", "namespace": "default"},
+            "spec": {}})
+        nodes = [mk_node("n0")]
+        got = solve(nodes, [mk_pod("p", volumes=[pvc_vol("claim")])],
+                    self.POLICY, ctx=mk_ctx(pvcs=[pvc]))
+        assert got == [None]
+
+
+ZONE = "failure-domain.beta.kubernetes.io/zone"
+
+
+class TestVolumeZone:
+    POLICY = Policy(predicates=("GeneralPredicates", "NoVolumeZoneConflict"))
+
+    def _fixture(self):
+        pv = PersistentVolume.from_dict({
+            "metadata": {"name": "pv-z", "labels": {ZONE: "us-a"}},
+            "spec": {"gcePersistentDisk": {"pdName": "pd"}}})
+        pvc = PersistentVolumeClaim.from_dict({
+            "metadata": {"name": "claim", "namespace": "default"},
+            "spec": {"volumeName": "pv-z"}})
+        return mk_ctx(pvcs=[pvc], pvs=[pv])
+
+    def test_zone_match_required(self):
+        ctx = self._fixture()
+        nodes = [mk_node("n0", labels={ZONE: "us-b"}),
+                 mk_node("n1", labels={ZONE: "us-a"})]
+        got = solve(nodes, [mk_pod("p", volumes=[pvc_vol("claim")])],
+                    self.POLICY, ctx=ctx)
+        assert got == ["n1"]
+
+    def test_unzoned_node_passes(self):
+        ctx = self._fixture()
+        nodes = [mk_node("n0", labels={ZONE: "us-b"}), mk_node("n1")]
+        got = solve(nodes, [mk_pod("p", volumes=[pvc_vol("claim")])],
+                    self.POLICY, ctx=ctx)
+        assert got == ["n1"]
+
+    def test_missing_pv_fails_on_zoned_nodes_only(self):
+        nodes = [mk_node("n0", labels={ZONE: "us-a"})]
+        got = solve(nodes, [mk_pod("p", volumes=[pvc_vol("ghost")])],
+                    self.POLICY, ctx=mk_ctx())
+        assert got == [None]
+        # a cluster with no zone labels never resolves claims at all
+        got = solve([mk_node("n1")], [mk_pod("p", volumes=[pvc_vol("ghost")])],
+                    self.POLICY, ctx=mk_ctx())
+        assert got == ["n1"]
+
+
+class TestVolumeNode:
+    POLICY = Policy(predicates=("GeneralPredicates", "NoVolumeNodeConflict"))
+
+    def _fixture(self, local=True):
+        import json
+
+        affinity = {"requiredDuringSchedulingIgnoredDuringExecution": {
+            "nodeSelectorTerms": [{"matchExpressions": [
+                {"key": "disk", "operator": "In", "values": ["local-1"]}]}]}}
+        pv = PersistentVolume.from_dict({
+            "metadata": {"name": "pv-l", "annotations": {
+                "volume.alpha.kubernetes.io/node-affinity":
+                    json.dumps(affinity)}},
+            "spec": {"local": {"path": "/mnt/disks/x"}}})
+        pvc = PersistentVolumeClaim.from_dict({
+            "metadata": {"name": "claim", "namespace": "default"},
+            "spec": {"volumeName": "pv-l"}})
+        return mk_ctx(pvcs=[pvc], pvs=[pv], local=local)
+
+    def test_affinity_pins_node(self):
+        ctx = self._fixture()
+        nodes = [mk_node("n0"), mk_node("n1", labels={"disk": "local-1"})]
+        got = solve(nodes, [mk_pod("p", volumes=[pvc_vol("claim")])],
+                    self.POLICY, ctx=ctx)
+        assert got == ["n1"]
+
+    def test_feature_gate_off_ignores(self):
+        ctx = self._fixture(local=False)
+        nodes = [mk_node("n0")]
+        got = solve(nodes, [mk_pod("p", volumes=[pvc_vol("claim")])],
+                    self.POLICY, ctx=ctx)
+        assert got == ["n0"]
+
+
+FULL_POLICY = Policy(
+    predicates=("GeneralPredicates", "NoDiskConflict", "MaxEBSVolumeCount",
+                "MaxGCEPDVolumeCount", "NoVolumeZoneConflict"),
+    max_ebs_volumes=2, max_gce_pd_volumes=2,
+)
+
+
+class TestSerialParity:
+    @pytest.mark.parametrize("seed,zoned", [(7, True), (11, True), (3, False)])
+    def test_randomized_volume_parity(self, seed, zoned):
+        rng = np.random.RandomState(seed)
+        zones = ["us-a", "us-b"]
+        # with `zoned`, node n5 stays unzoned (mixed cluster)
+        nodes = [mk_node(f"n{i}",
+                         labels={ZONE: zones[i % 2]} if zoned and i < 5 else {},
+                         pods="6")
+                 for i in range(6)]
+        pvs, pvcs = [], []
+        for i in range(4):
+            pvs.append(PersistentVolume.from_dict({
+                "metadata": {"name": f"pv{i}",
+                             "labels": {ZONE: zones[i % 2]}},
+                "spec": {"gcePersistentDisk": {"pdName": f"pvpd{i}"}}}))
+            pvcs.append(PersistentVolumeClaim.from_dict({
+                "metadata": {"name": f"c{i}", "namespace": "default"},
+                "spec": {"volumeName": f"pv{i}"}}))
+        ctx = mk_ctx(pvcs=pvcs, pvs=pvs)
+
+        def rand_volumes():
+            vols = []
+            if rng.rand() < 0.5:
+                vols.append(gce(f"pd{rng.randint(3)}", ro=rng.rand() < 0.5))
+            if rng.rand() < 0.4:
+                vols.append(ebs(f"v{rng.randint(3)}"))
+            if rng.rand() < 0.4:
+                # c4/c5 never exist: unresolvable-claim paths
+                vols.append(pvc_vol(f"c{rng.randint(6)}"))
+            return vols
+
+        assigned = [mk_pod(f"a{i}", volumes=rand_volumes(),
+                           node_name=f"n{rng.randint(6)}") for i in range(5)]
+        pending = [mk_pod(f"p{i}", volumes=rand_volumes()) for i in range(8)]
+
+        serial = SerialScheduler(
+            nodes, assigned, with_volumes=True, volume_ctx=ctx,
+            attach_limits={"ebs": 2, "gce": 2})
+        want = serial.schedule(pending)
+        got = solve(nodes, pending, FULL_POLICY, assigned=assigned, ctx=ctx)
+        assert got == want
