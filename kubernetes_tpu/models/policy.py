@@ -22,18 +22,30 @@ from kubernetes_tpu.state.layout import (
 
 # Predicate names follow the reference registry (factory/plugins.go).
 # "GeneralPredicates" expands to resources+host+ports+selector
-# (predicates.go:900).
+# (predicates.go:900). The defaults are the reference's default algorithm
+# provider sets (defaultPredicates/defaultPriorities, defaults.go:118-235).
 DEFAULT_PREDICATES: tuple[str, ...] = (
+    "NoVolumeZoneConflict",
+    "MaxEBSVolumeCount",
+    "MaxGCEPDVolumeCount",
+    "MaxAzureDiskVolumeCount",
+    "MatchInterPodAffinity",
+    "NoDiskConflict",
     "GeneralPredicates",
     "PodToleratesNodeTaints",
     "CheckNodeMemoryPressure",
     "CheckNodeDiskPressure",
     "CheckNodeCondition",
+    "NoVolumeNodeConflict",
 )
 
 DEFAULT_PRIORITIES: tuple[tuple[str, int], ...] = (
+    ("SelectorSpreadPriority", 1),
+    ("InterPodAffinityPriority", 1),
     ("LeastRequestedPriority", 1),
     ("BalancedResourceAllocation", 1),
+    ("NodePreferAvoidPodsPriority", 10000),
+    ("NodeAffinityPriority", 1),
     ("TaintTolerationPriority", 1),
 )
 
@@ -52,6 +64,8 @@ KNOWN_PRIORITIES = frozenset({
     "LeastRequestedPriority", "MostRequestedPriority",
     "BalancedResourceAllocation", "TaintTolerationPriority", "EqualPriority",
     "NodeAffinityPriority", "InterPodAffinityPriority",
+    "SelectorSpreadPriority", "ServiceSpreadingPriority",
+    "NodePreferAvoidPodsPriority", "ImageLocalityPriority",
 })
 
 
@@ -68,12 +82,27 @@ class Policy:
     max_ebs_volumes: int = DEFAULT_MAX_EBS_VOLUMES
     max_gce_pd_volumes: int = DEFAULT_MAX_GCE_PD_VOLUMES
     max_azure_disk_volumes: int = DEFAULT_MAX_AZURE_DISK_VOLUMES
+    # argument-carrying registrations (api/v1/types.go PredicateArgument /
+    # PriorityArgument): custom-named entries whose behavior comes from args.
+    # (name, (labels...), presence) — CheckNodeLabelPresence instances
+    label_presence_predicates: tuple = ()
+    # (name, (labels...)) — ServiceAffinity instances
+    service_affinity_predicates: tuple = ()
+    # (name, label, presence) — NodeLabelPriority instances (weight in
+    # `priorities` under the same name)
+    label_priorities: tuple = ()
+    # (name, label) — ServiceAntiAffinityPriority instances
+    service_anti_priorities: tuple = ()
 
     def __post_init__(self):
-        unknown = set(self.predicates) - KNOWN_PREDICATES
+        arg_preds = ({n for n, _, _ in self.label_presence_predicates}
+                     | {n for n, _ in self.service_affinity_predicates})
+        unknown = set(self.predicates) - KNOWN_PREDICATES - arg_preds
         if unknown:
             raise ValueError(f"unknown predicates: {sorted(unknown)}")
-        unknown = {n for n, _ in self.priorities} - KNOWN_PRIORITIES
+        arg_prios = ({n for n, _, _ in self.label_priorities}
+                     | {n for n, _ in self.service_anti_priorities})
+        unknown = {n for n, _ in self.priorities} - KNOWN_PRIORITIES - arg_prios
         if unknown:
             raise ValueError(f"unknown priorities: {sorted(unknown)}")
         for n, w in self.priorities:
@@ -127,25 +156,152 @@ class Policy:
     @classmethod
     def from_json(cls, text: str) -> "Policy":
         """Parse the reference's JSON policy schema
-        (plugin/pkg/scheduler/api/v1/types.go): {"predicates": [{"name": ..}],
-        "priorities": [{"name": .., "weight": ..}]}."""
+        (plugin/pkg/scheduler/api/v1/types.go): {"predicates": [{"name": ..,
+        "argument": ..}], "priorities": [{"name": .., "weight": ..,
+        "argument": ..}]} with labelsPresence / serviceAffinity /
+        labelPreference / serviceAntiAffinity arguments."""
         d = json.loads(text)
-        preds = tuple(p["name"] for p in d.get("predicates") or []) or DEFAULT_PREDICATES
-        prios = tuple(
-            (p["name"], int(p.get("weight", 1))) for p in d.get("priorities") or []
-        ) or DEFAULT_PRIORITIES
-        return cls(predicates=preds, priorities=prios,
+        preds, label_presence, svc_aff = [], [], []
+        for p in d.get("predicates") or []:
+            name = p["name"]
+            preds.append(name)
+            arg = p.get("argument") or {}
+            if "labelsPresence" in arg:
+                lp = arg["labelsPresence"] or {}
+                label_presence.append((name, tuple(lp.get("labels") or ()),
+                                       bool(lp.get("presence"))))
+            elif "serviceAffinity" in arg:
+                sa = arg["serviceAffinity"] or {}
+                svc_aff.append((name, tuple(sa.get("labels") or ())))
+        prios, label_prios, svc_anti = [], [], []
+        for p in d.get("priorities") or []:
+            name = p["name"]
+            prios.append((name, int(p.get("weight", 1))))
+            arg = p.get("argument") or {}
+            if "labelPreference" in arg:
+                lp = arg["labelPreference"] or {}
+                label_prios.append((name, lp.get("label", ""),
+                                    bool(lp.get("presence"))))
+            elif "serviceAntiAffinity" in arg:
+                sa = arg["serviceAntiAffinity"] or {}
+                svc_anti.append((name, sa.get("label", "")))
+        return cls(predicates=tuple(preds) or DEFAULT_PREDICATES,
+                   priorities=tuple(prios) or DEFAULT_PRIORITIES,
                    hard_pod_affinity_weight=int(
-                       d.get("hardPodAffinitySymmetricWeight", 1)))
+                       d.get("hardPodAffinitySymmetricWeight", 1)),
+                   label_presence_predicates=tuple(label_presence),
+                   service_affinity_predicates=tuple(svc_aff),
+                   label_priorities=tuple(label_prios),
+                   service_anti_priorities=tuple(svc_anti))
 
     def to_json(self) -> str:
+        pred_args = {n: {"labelsPresence": {"labels": list(labels),
+                                            "presence": presence}}
+                     for n, labels, presence in self.label_presence_predicates}
+        pred_args.update({n: {"serviceAffinity": {"labels": list(labels)}}
+                          for n, labels in self.service_affinity_predicates})
+        prio_args = {n: {"labelPreference": {"label": label,
+                                             "presence": presence}}
+                     for n, label, presence in self.label_priorities}
+        prio_args.update({n: {"serviceAntiAffinity": {"label": label}}
+                          for n, label in self.service_anti_priorities})
         return json.dumps({
             "kind": "Policy",
             "apiVersion": "v1",
-            "predicates": [{"name": n} for n in self.predicates],
-            "priorities": [{"name": n, "weight": w} for n, w in self.priorities],
+            "predicates": [
+                {"name": n, **({"argument": pred_args[n]} if n in pred_args else {})}
+                for n in self.predicates],
+            "priorities": [
+                {"name": n, "weight": w,
+                 **({"argument": prio_args[n]} if n in prio_args else {})}
+                for n, w in self.priorities],
             "hardPodAffinitySymmetricWeight": self.hard_pod_affinity_weight,
         })
 
+    def service_affinity_labels(self) -> tuple:
+        """Union of all configured ServiceAffinity labels (for the encode
+        context)."""
+        out: list = []
+        for name, labels in self.service_affinity_predicates:
+            if name in self.predicates:
+                out.extend(labels)
+        return tuple(dict.fromkeys(out))
+
 
 DEFAULT_POLICY = Policy()
+
+
+def active_label_priorities(policy: Policy) -> tuple:
+    """((label, presence, weight), ...) for configured NodeLabel priorities."""
+    weights = dict(policy.priorities)
+    return tuple((label, presence, weights[name])
+                 for name, label, presence in policy.label_priorities
+                 if weights.get(name))
+
+
+def active_service_anti(policy: Policy) -> tuple:
+    """((label, weight), ...) for configured ServiceAntiAffinity priorities."""
+    weights = dict(policy.priorities)
+    return tuple((label, weights[name])
+                 for name, label in policy.service_anti_priorities
+                 if weights.get(name))
+
+
+def active_label_presence(policy: Policy) -> tuple:
+    """(((labels...), presence), ...) for configured CheckNodeLabelPresence
+    instances."""
+    return tuple((labels, presence)
+                 for name, labels, presence in policy.label_presence_predicates
+                 if name in policy.predicates)
+
+
+def build_policy_rows(policy: Policy, table, caps):
+    """Device rows for the argument-carrying registrations: each configured
+    label becomes an interned Exists requirement (membership via the shared
+    requirement universe) and each ServiceAntiAffinity label a topology slot.
+    Returns None when the policy carries no arguments (the common case, and
+    a stable jit signature)."""
+    import numpy as np
+
+    from kubernetes_tpu.state.layout import ReqOp
+
+    lp = active_label_presence(policy)
+    nl = active_label_priorities(policy)
+    sa = active_service_anti(policy)
+    if not (lp or nl or sa):
+        return None
+    ur = caps.req_universe
+    pres = np.zeros((ur,), np.float32)
+    absent = np.zeros((ur,), np.float32)
+    npres = 0
+    for labels, presence in lp:
+        for label in labels:
+            rid = table.intern_requirement(label, ReqOp.EXISTS, ())
+            if presence:
+                if pres[rid] == 0:
+                    npres += 1
+                pres[rid] = 1.0
+            else:
+                absent[rid] = 1.0
+    nlp = np.zeros((len(nl), ur), np.float32)
+    for i, (label, _presence, _w) in enumerate(nl):
+        nlp[i, table.intern_requirement(label, ReqOp.EXISTS, ())] = 1.0
+    slots = np.asarray([table.intern_topo_key(label) for label, _w in sa],
+                       np.int32)
+    return PolicyRows(pres_onehot=pres, pres_count=np.float32(npres),
+                      abs_onehot=absent, nlp_onehot=nlp, svcanti_slot=slots)
+
+
+from flax import struct as _struct  # noqa: E402
+
+
+@_struct.dataclass
+class PolicyRows:
+    """Interned device rows for argument-carrying policy registrations
+    (passed to schedule_batch alongside the static Policy)."""
+
+    pres_onehot: object   # f32[UR] labels that must exist
+    pres_count: object    # f32 scalar
+    abs_onehot: object    # f32[UR] labels that must not exist
+    nlp_onehot: object    # f32[KN, UR] one Exists row per NodeLabel prio
+    svcanti_slot: object  # i32[KS] topo slot per ServiceAntiAffinity prio

@@ -246,6 +246,24 @@ def volume_node(state: ClusterState, pod: PodBatch) -> jnp.ndarray:
     return (satisfied >= pod.vs_count) & ~pod.vs_fail
 
 
+def label_presence_ok(state: ClusterState, pres_onehot, pres_count,
+                      abs_onehot) -> jnp.ndarray:
+    """CheckNodeLabelPresence (predicates.go:737): configured labels must all
+    be present (pres) / all absent (abs), value-independent. Pod-independent —
+    one mask per batch from the PolicyRows Exists-requirement rows."""
+    have = state.req_member @ pres_onehot
+    stray = state.req_member @ abs_onehot
+    return (have >= pres_count) & (stray == 0)
+
+
+def service_affinity(state: ClusterState, pod: PodBatch) -> jnp.ndarray:
+    """checkServiceAffinity (predicates.go:821): the node must carry the
+    pod's resolved affinity labels (pinned by nodeSelector or backfilled
+    from an existing service pod's node — state/spreading.py)."""
+    satisfied = state.req_member @ pod.svcaff_onehot
+    return (satisfied >= pod.svcaff_count) & ~pod.svcaff_fail
+
+
 def node_conditions_ok(state: ClusterState, pod: PodBatch) -> jnp.ndarray:
     """All condition checks plus the unschedulable filter (convenience
     conjunction for full-default evaluation)."""

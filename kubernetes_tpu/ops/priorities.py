@@ -132,3 +132,59 @@ def node_affinity(state: ClusterState, pod: PodBatch, feasible=None) -> jnp.ndar
 def equal(state: ClusterState, pod: PodBatch) -> jnp.ndarray:
     """EqualPriority (generic_scheduler.go:416): weight-1 constant score."""
     return jnp.ones(state.valid.shape[0], dtype=jnp.float32)
+
+
+def _used_score(requested: jnp.ndarray, capacity: jnp.ndarray) -> jnp.ndarray:
+    """calculateUsedScore (most_requested.go:51): (req*10)/cap truncated;
+    0 when cap == 0 or req > cap."""
+    safe_cap = jnp.where(capacity == 0, 1.0, capacity)
+    score = jnp.floor(requested * MAX_PRIORITY / safe_cap + FLOOR_EPS)
+    return jnp.where((capacity == 0) | (requested > capacity), 0.0, score)
+
+
+def most_requested(state: ClusterState, pod: PodBatch,
+                   nonzero_requested=None) -> jnp.ndarray:
+    """MostRequestedPriorityMap (most_requested.go:32): the bin-packing
+    mirror of LeastRequested — favor nodes with higher cpu+mem utilization
+    after placing the pod."""
+    nz = state.nonzero_requested if nonzero_requested is None else nonzero_requested
+    total_cpu = nz[:, 0] + pod.nonzero_requests[0]
+    total_mem = nz[:, 1] + pod.nonzero_requests[1]
+    cpu_score = _used_score(total_cpu, state.allocatable[:, Resource.CPU])
+    mem_score = _used_score(total_mem, state.allocatable[:, Resource.MEMORY])
+    return jnp.floor((cpu_score + mem_score) / 2.0 + FLOOR_EPS)
+
+
+# ImageLocality size bounds (balanced_resource_allocation.go:33-35)
+MIN_IMG_SIZE = 23.0 * 1024 * 1024
+MAX_IMG_SIZE = 1000.0 * 1024 * 1024
+
+
+def image_locality(state: ClusterState, pod: PodBatch) -> jnp.ndarray:
+    """ImageLocalityPriorityMap (image_locality.go:32): bucket the summed
+    bytes of the pod's images already present on the node into [0, 10]. One
+    matvec: sums = img_size[N, UI] @ img_onehot[UI]."""
+    sums = state.img_size @ pod.img_onehot
+    mid = jnp.floor(MAX_PRIORITY * (sums - MIN_IMG_SIZE)
+                    / (MAX_IMG_SIZE - MIN_IMG_SIZE) + FLOOR_EPS) + 1.0
+    return jnp.where(sums < MIN_IMG_SIZE, 0.0,
+                     jnp.where(sums >= MAX_IMG_SIZE, float(MAX_PRIORITY), mid))
+
+
+def node_prefer_avoid(state: ClusterState, pod: PodBatch) -> jnp.ndarray:
+    """CalculateNodePreferAvoidPodsPriorityMap (node_prefer_avoid_pods.go:29):
+    0 on nodes whose preferAvoidPods annotation names the pod's RC/RS
+    controller, MaxPriority elsewhere (registered at weight 10000 so it
+    dominates, defaults.go:225)."""
+    hit = state.avoid_member @ pod.avoid_onehot
+    return jnp.where(hit > 0, 0.0, float(MAX_PRIORITY))
+
+
+def node_label_score(state: ClusterState, onehot_row: jnp.ndarray,
+                     presence: bool) -> jnp.ndarray:
+    """CalculateNodeLabelPriorityMap (node_label.go:44): MaxPriority when the
+    label's presence matches the preference. Pod-independent — computed once
+    per batch from the PolicyRows Exists-requirement row."""
+    exists = (state.req_member @ onehot_row) > 0
+    match = exists if presence else ~exists
+    return jnp.where(match, float(MAX_PRIORITY), 0.0)

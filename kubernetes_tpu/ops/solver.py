@@ -29,10 +29,17 @@ import jax
 import jax.numpy as jnp
 from flax import struct
 
-from kubernetes_tpu.models.policy import DEFAULT_POLICY, Policy
+from kubernetes_tpu.models.policy import (
+    DEFAULT_POLICY,
+    Policy,
+    active_label_presence,
+    active_label_priorities,
+    active_service_anti,
+)
 from kubernetes_tpu.ops import interpod
 from kubernetes_tpu.ops import predicates as preds
 from kubernetes_tpu.ops import priorities as prios
+from kubernetes_tpu.ops import spread as spreadops
 from kubernetes_tpu.state.cluster_state import ClusterState
 from kubernetes_tpu.state.pod_batch import PodBatch
 
@@ -68,14 +75,21 @@ class Carry:
     attach_count: object = None  # f32[N, UA] | None
 
 
-def _static_mask(state: ClusterState, pod, policy: Policy) -> jnp.ndarray:
+def _static_mask(state: ClusterState, pod, policy: Policy,
+                 base_mask=None) -> jnp.ndarray:
     """Assignment-independent predicate conjunction for one pod: bool[N].
 
     The unschedulable filter is NOT policy-gated: the reference applies it in
     the scheduler's node lister regardless of configured predicates
-    (factory.go getNodeConditionPredicate).
+    (factory.go getNodeConditionPredicate). `base_mask` carries the
+    pod-independent policy-argument predicates (CheckNodeLabelPresence).
     """
     ok = state.valid & preds.node_schedulable(state, pod)
+    if base_mask is not None:
+        ok = ok & base_mask
+    if policy.service_affinity_predicates and policy.has_predicate(
+            *[n for n, _ in policy.service_affinity_predicates]):
+        ok = ok & preds.service_affinity(state, pod)
     if policy.has_predicate("GeneralPredicates", "PodFitsHost", "HostName"):
         ok = ok & preds.fits_host(state, pod)
     if policy.has_predicate("GeneralPredicates", "MatchNodeSelector"):
@@ -95,12 +109,22 @@ def _static_mask(state: ClusterState, pod, policy: Policy) -> jnp.ndarray:
     return ok
 
 
-def _static_score(state: ClusterState, pod, policy: Policy) -> jnp.ndarray:
-    """Assignment-independent score terms for one pod: f32[N]."""
+def _static_score(state: ClusterState, pod, policy: Policy,
+                  base_score=None) -> jnp.ndarray:
+    """Assignment-independent score terms for one pod: f32[N]. `base_score`
+    carries the pod-independent terms (NodeLabel priorities)."""
     score = jnp.zeros(state.valid.shape[0], jnp.float32)
+    if base_score is not None:
+        score = score + base_score
     w = policy.weight("EqualPriority")
     if w:
         score = score + w * prios.equal(state, pod)
+    w = policy.weight("ImageLocalityPriority")
+    if w:
+        score = score + w * prios.image_locality(state, pod)
+    w = policy.weight("NodePreferAvoidPodsPriority")
+    if w:
+        score = score + w * prios.node_prefer_avoid(state, pod)
     return score
 
 
@@ -122,31 +146,67 @@ def schedule_batch(
     rr_start,
     policy: Policy = DEFAULT_POLICY,
     caps=None,
+    prows=None,
 ) -> SolverResult:
     """Schedule a whole pending batch in one device program.
 
-    Pure function; jit with `policy` (and `caps`, if given) static. Returns
+    Pure function; jit with `policy` (and `caps`, if given) static. `prows`
+    carries the PolicyRows for argument-carrying registrations (None when
+    the policy has none — models/policy.py build_policy_rows). Returns
     per-pod assignments plus the post-batch resource ledger for the host to
     commit (assume semantics).
     """
+    # normalize to jnp arrays: un-jitted callers pass host numpy, and numpy
+    # arrays cannot be indexed by traced scalars inside the scan
+    state = jax.tree.map(jnp.asarray, state)
+    batch = jax.tree.map(jnp.asarray, batch)
+
     use_resources = policy.has_predicate("GeneralPredicates", "PodFitsResources")
     use_ports = policy.has_predicate("GeneralPredicates", "PodFitsHostPorts",
                                      "PodFitsPorts")
     w_lr = policy.weight("LeastRequestedPriority")
+    w_mr = policy.weight("MostRequestedPriority")
     w_ba = policy.weight("BalancedResourceAllocation")
     w_tt = policy.weight("TaintTolerationPriority")
     w_na = policy.weight("NodeAffinityPriority")
     w_ip = policy.weight("InterPodAffinityPriority")
+    w_ss = policy.weight("SelectorSpreadPriority")
+    w_ssp = policy.weight("ServiceSpreadingPriority")
+    svcanti = active_service_anti(policy)
+    if prows is None and (svcanti or active_label_presence(policy)
+                          or active_label_priorities(policy)):
+        raise ValueError(
+            "policy carries argument registrations (labelsPresence / "
+            "labelPreference / serviceAntiAffinity) but no PolicyRows were "
+            "given — build them with models.policy.build_policy_rows")
     use_ipa = policy.has_predicate("MatchInterPodAffinity")
-    use_ip_ledger = use_ipa or bool(w_ip)
+    use_ip_ledger = (use_ipa or bool(w_ip) or bool(w_ss) or bool(w_ssp)
+                     or bool(svcanti))
     use_nodisk = policy.has_predicate("NoDiskConflict")
     attach_maxes = policy.attach_maxes()
     hard_w = float(policy.hard_pod_affinity_weight)
     domain_universe = caps.domain_universe if caps else DEFAULT_DOMAIN_UNIVERSE
 
+    # pod-independent policy-argument rows (CheckNodeLabelPresence mask,
+    # NodeLabel priority scores) — computed once, broadcast over the batch
+    base_mask = None
+    base_score = None
+    if prows is not None:
+        if active_label_presence(policy):
+            base_mask = preds.label_presence_ok(
+                state, prows.pres_onehot, prows.pres_count, prows.abs_onehot)
+        nl = active_label_priorities(policy)
+        if nl:
+            base_score = jnp.zeros(state.valid.shape[0], jnp.float32)
+            for i, (_label, presence, weight) in enumerate(nl):
+                base_score = base_score + weight * prios.node_label_score(
+                    state, prows.nlp_onehot[i], presence)
+
     # ---- Phase A: batched over (P, N) ----
-    static_mask = jax.vmap(lambda p: _static_mask(state, p, policy))(batch)
-    static_score = jax.vmap(lambda p: _static_score(state, p, policy))(batch)
+    static_mask = jax.vmap(
+        lambda p: _static_mask(state, p, policy, base_mask))(batch)
+    static_score = jax.vmap(
+        lambda p: _static_score(state, p, policy, base_score))(batch)
     if w_tt:
         prefer_counts = jax.vmap(
             lambda p: preds.count_untolerated_prefer_taints(state, p))(batch)
@@ -183,6 +243,9 @@ def schedule_batch(
         if w_lr:
             score = score + w_lr * prios.least_requested(
                 state, pod, nonzero_requested=carry.nonzero)
+        if w_mr:
+            score = score + w_mr * prios.most_requested(
+                state, pod, nonzero_requested=carry.nonzero)
         if w_ba:
             score = score + w_ba * prios.balanced_allocation(
                 state, pod, nonzero_requested=carry.nonzero)
@@ -193,6 +256,16 @@ def schedule_batch(
         if w_ip:
             ip_counts = interpod.interpod_counts(state, pod, carry.ipa, hard_w)
             score = score + w_ip * interpod.interpod_score(ip_counts, feasible)
+        if w_ss:
+            score = score + w_ss * spreadops.selector_spread(
+                state, pod.spread_q, carry.ipa, feasible, domain_universe)
+        if w_ssp:
+            score = score + w_ssp * spreadops.selector_spread(
+                state, pod.spread_svc_q, carry.ipa, feasible, domain_universe)
+        for i, (_label, sa_weight) in enumerate(svcanti):
+            score = score + sa_weight * spreadops.service_anti_affinity(
+                state, pod.svcanti_q, pod.svcanti_total, carry.ipa, feasible,
+                prows.svcanti_slot[i], domain_universe)
 
         masked = jnp.where(feasible, score, -jnp.inf)
         node, best, ntie = _select_host(masked, feasible, carry.rr)

@@ -72,11 +72,14 @@ def shard_batch(batch: PodBatch, mesh: Mesh) -> PodBatch:
     return jax.device_put(batch, batch_sharding(mesh))
 
 
-def make_sharded_scheduler(mesh: Mesh, policy: Policy = DEFAULT_POLICY):
+def make_sharded_scheduler(mesh: Mesh, policy: Policy = DEFAULT_POLICY,
+                           caps=None, prows=None):
     """jit schedule_batch with node-axis sharding constraints.
 
     Returns fn(state, batch, rr) -> SolverResult whose ledger outputs stay
     node-sharded (so batch-to-batch chaining never gathers to one chip).
+    `prows` (PolicyRows, replicated) is closed over as a constant — it is
+    fixed for the life of the policy.
     """
     from kubernetes_tpu.ops.solver import SolverResult
 
@@ -90,7 +93,8 @@ def make_sharded_scheduler(mesh: Mesh, policy: Policy = DEFAULT_POLICY):
         new_port_count=nodes_spec, rr_end=repl,
     )
     return jax.jit(
-        lambda state, batch, rr: schedule_batch(state, batch, rr, policy),
+        lambda state, batch, rr: schedule_batch(state, batch, rr, policy,
+                                                caps=caps, prows=prows),
         in_shardings=(st, bt, repl),
         out_shardings=out_shardings,
     )

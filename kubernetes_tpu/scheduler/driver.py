@@ -68,26 +68,43 @@ class SchedulerMetrics:
         }
 
 
+def store_encode_context(store: ObjectStore, policy: Policy = DEFAULT_POLICY,
+                         local_volumes_enabled=False):
+    """EncodeContext backed by the object store — the PVInfo/PVCInfo and
+    Service/RC/RS/StatefulSet listers the reference's predicate/priority
+    factories receive (factory/plugins.go PluginFactoryArgs)."""
+    from kubernetes_tpu.state.context import EncodeContext
+
+    def getter(kind):
+        def get(name, namespace="default"):
+            try:
+                return store.get(kind, name, namespace)
+            except NotFound:
+                return None
+        return get
+
+    get_pvc_ = getter("PersistentVolumeClaim")
+    get_pv_ = getter("PersistentVolume")
+    get_node_ = getter("Node")
+    return EncodeContext(
+        get_pvc=lambda ns, name: get_pvc_(name, ns),
+        get_pv=lambda name: get_pv_(name),
+        local_volumes_enabled=local_volumes_enabled,
+        get_services=lambda ns: store.list("Service", ns),
+        get_rcs=lambda ns: store.list("ReplicationController", ns),
+        get_rss=lambda ns: store.list("ReplicaSet", ns),
+        get_sss=lambda ns: store.list("StatefulSet", ns),
+        list_pods=lambda ns: store.list("Pod", ns),
+        get_node=lambda name: get_node_(name),
+        service_affinity_labels=policy.service_affinity_labels(),
+        service_anti=bool(policy.service_anti_priorities),
+    )
+
+
+# back-compat alias (pre-spreading name)
 def store_volume_context(store: ObjectStore, local_volumes_enabled=False):
-    """VolumeContext backed by the object store — the PVInfo/PVCInfo listers
-    the reference's predicate factories receive (factory/plugins.go
-    PluginFactoryArgs)."""
-    from kubernetes_tpu.state.volumes import VolumeContext
-
-    def get_pvc(namespace, name):
-        try:
-            return store.get("PersistentVolumeClaim", name, namespace)
-        except NotFound:
-            return None
-
-    def get_pv(name):
-        try:
-            return store.get("PersistentVolume", name)
-        except NotFound:
-            return None
-
-    return VolumeContext(get_pvc=get_pvc, get_pv=get_pv,
-                         local_volumes_enabled=local_volumes_enabled)
+    return store_encode_context(store,
+                                local_volumes_enabled=local_volumes_enabled)
 
 
 class Scheduler:
@@ -109,10 +126,13 @@ class Scheduler:
         self.scheduler_name = scheduler_name
         self.batch_wait = batch_wait
 
-        self.volume_ctx = store_volume_context(store)
+        self.volume_ctx = store_encode_context(store, policy)
         self.statedb = StateDB(self.caps, mesh=mesh, volume_ctx=self.volume_ctx)
         self.encode_cache = EncodeCache(self.caps, self.statedb.table,
                                         volume_ctx=self.volume_ctx)
+        from kubernetes_tpu.models.policy import build_policy_rows
+
+        self._prows = build_policy_rows(policy, self.statedb.table, self.caps)
         self.queue = BackoffQueue()
         self.backoff = Backoff(initial=0.05, max_duration=5.0)
         self.metrics = SchedulerMetrics()
@@ -125,14 +145,30 @@ class Scheduler:
         self.pod_informer = Informer(store, "Pod")
         self.node_informer.add_handler(self._on_node_event)
         self.pod_informer.add_handler(self._on_pod_event)
+        # workload objects feed cached pod encodings (spreading entries):
+        # any change invalidates the encode cache (the reference invalidates
+        # its equivalence cache from the same informers, factory.go:160-250)
+        self.workload_informers = [
+            Informer(store, kind)
+            for kind in ("Service", "ReplicationController", "ReplicaSet",
+                         "StatefulSet")]
+        for informer in self.workload_informers:
+            informer.add_handler(self._on_workload_event)
 
+        caps = self.caps
+        prows = self._prows
         if mesh is not None:
             from kubernetes_tpu.parallel.mesh import make_sharded_scheduler
-            self._schedule_fn = make_sharded_scheduler(mesh, policy)
+            self._schedule_fn = make_sharded_scheduler(mesh, policy, caps=caps,
+                                                       prows=prows)
         else:
             self._schedule_fn = jax.jit(
-                lambda s, b, rr: schedule_batch(s, b, rr, policy))
+                lambda s, b, rr: schedule_batch(s, b, rr, policy, caps=caps,
+                                                prows=prows))
         self._stopped = False
+
+    def _on_workload_event(self, event: WatchEvent) -> None:
+        self.encode_cache.generation += 1
 
     # ---- informer handlers ----
 
@@ -180,6 +216,8 @@ class Scheduler:
     async def start(self) -> None:
         self.node_informer.start()
         self.pod_informer.start()
+        for informer in self.workload_informers:
+            informer.start()
         await self.node_informer.wait_for_sync()
         await self.pod_informer.wait_for_sync()
 
@@ -188,6 +226,8 @@ class Scheduler:
         self.queue.close()
         self.node_informer.stop()
         self.pod_informer.stop()
+        for informer in self.workload_informers:
+            informer.stop()
 
     async def run(self) -> None:
         """Schedule until stopped (wait.Until(scheduleOne) analog)."""

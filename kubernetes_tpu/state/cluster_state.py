@@ -248,6 +248,10 @@ class NodeTable:
         self.vol_atoms: dict[tuple, int] = {}
         self.attach_atoms: dict[tuple, int] = {}
         self.attach_types: dict[int, int] = {}   # aid -> VolType
+        # bumped when node-side interning can invalidate encoded pod rows
+        # (a new preferAvoidPods signature: pods encoded earlier lack the
+        # one-hot). EncodeCache folds this into its fingerprint.
+        self.pod_row_epoch = 0
         self.images: dict[str, int] = {}
         self.avoids: dict[tuple[str, str], int] = {}
         self.volsels: dict[str, int] = {}        # canon json -> vsid
@@ -421,6 +425,9 @@ class NodeTable:
         self.podsels[entry] = qid
         self.podsel_attrs.append(entry)
         self.pending_podsel_refresh.append(qid)
+        # cached pod rows carry pod_matches_q over the old universe: a new
+        # entry invalidates them (they may match it)
+        self.pod_row_epoch += 1
         return qid
 
     def intern_term(self, qid: int, tkey_code: int, weight: float, kind: int,
@@ -486,6 +493,7 @@ class NodeTable:
                 f"interning {sig!r}")
         oid = len(self.avoids)
         self.avoids[sig] = oid
+        self.pod_row_epoch += 1
         return oid
 
     def intern_volsel(self, terms: list) -> int:
@@ -609,6 +617,13 @@ def _fill_node_row(state: ClusterState, table: NodeTable, row: int, node: Node) 
     if z is not None and r is not None:
         state.topology[row, TOPO_ZONE_REGION] = table.intern_domain(
             TOPO_ZONE_REGION, (z, r))
+    # virtual GetZoneKey domain (either half present) for zone-weighted
+    # selector spreading (layout.TOPO_SPREAD_ZONE)
+    from kubernetes_tpu.state.layout import TOPO_SPREAD_ZONE
+
+    if z is not None or r is not None:
+        state.topology[row, TOPO_SPREAD_ZONE] = table.intern_domain(
+            TOPO_SPREAD_ZONE, (r or "", z or ""))
 
 
 def apply_pending_refreshes(state: ClusterState, table: NodeTable) -> bool:

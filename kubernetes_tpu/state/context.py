@@ -1,0 +1,49 @@
+"""EncodeContext: lister access the pod/node encoders need.
+
+The analog of the reference's PluginFactoryArgs (factory/plugins.go): the
+predicate/priority factories receive PVInfo/PVCInfo and the Service/RC/RS/
+StatefulSet listers; here one context object carries the same lookups into
+encoding. Every field has an empty default so fixture paths work without a
+store; the driver builds a store-backed instance.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable
+
+
+def _none(*_a, **_k):
+    return None
+
+
+def _empty(*_a, **_k):
+    return []
+
+
+@dataclass
+class EncodeContext:
+    # ---- volume resolution (PVInfo/PVCInfo) ----
+    get_pvc: Callable = _none          # (namespace, name) -> PVC | None
+    get_pv: Callable = _none           # (name) -> PV | None
+    # feature gate for NoVolumeNodeConflict (PersistentLocalVolumes,
+    # pkg/features/kube_features.go — alpha, default off)
+    local_volumes_enabled: bool = False
+
+    # ---- workload listers (SelectorSpread / ServiceAffinity) ----
+    get_services: Callable = _empty    # (namespace) -> [Service]
+    get_rcs: Callable = _empty         # (namespace) -> [ReplicationController]
+    get_rss: Callable = _empty         # (namespace) -> [ReplicaSet]
+    get_sss: Callable = _empty         # (namespace) -> [StatefulSet]
+    list_pods: Callable = _empty       # (namespace) -> [Pod]
+    get_node: Callable = _none         # (name) -> Node | None
+
+    # ServiceAffinity predicate labels from the policy (predicates.go:793);
+    # the per-pod affinity terms are only computed when this is set.
+    service_affinity_labels: tuple = ()
+    # True when a ServiceAntiAffinity priority is configured: per-pod service
+    # totals depend on the live pod list, so rows must not be cached.
+    service_anti: bool = False
+
+
+EMPTY_CONTEXT = EncodeContext()

@@ -54,10 +54,20 @@ def pod_fingerprint(pod: Pod) -> tuple:
         # pod-affinity matching reads namespace + labels (pod_match_row)
         pod.metadata.namespace,
         tuple(sorted(pod.metadata.labels.items())),
+        # image locality reads container images; prefer-avoid the controller
+        tuple(c.image for c in spec.containers),
+        _controller_ref(pod),
         # affinity + direct volumes as canonical JSON
         json.dumps(spec.affinity, sort_keys=True) if spec.affinity else "",
         json.dumps(spec.volumes, sort_keys=True) if spec.volumes else "",
     )
+
+
+def _controller_ref(pod: Pod):
+    for ref in pod.metadata.owner_references:
+        if ref.get("controller"):
+            return (ref.get("kind", ""), ref.get("uid", ""))
+    return None
 
 
 class EncodeCache:
@@ -72,12 +82,21 @@ class EncodeCache:
         self.hits = 0
         self.misses = 0
 
+    # bumped by the driver on Service/RC/RS/StatefulSet events: spreading
+    # entries in cached rows depend on the workload objects
+    generation = 0
+
     def encode_into(self, batch: PodBatch, i: int, pod: Pod) -> None:
-        if not cacheable(pod):
+        if not cacheable(pod) or (self.volume_ctx is not None
+                                  and (self.volume_ctx.service_affinity_labels
+                                       or self.volume_ctx.service_anti)):
+            # claim-backed volumes resolve through mutable PVC/PV state, and
+            # ServiceAffinity terms / ServiceAntiAffinity totals depend on
+            # other pods' placements — all must re-encode every batch
             encode_pod_into(batch, i, pod, self.caps, self.table,
                             ctx=self.volume_ctx)
             return
-        fp = pod_fingerprint(pod)
+        fp = (pod_fingerprint(pod), self.table.pod_row_epoch, self.generation)
         row = self._rows.get(fp)
         if row is None:
             self.misses += 1

@@ -107,8 +107,11 @@ TOPOLOGY_KEYS = (
 TOPO_HOSTNAME = 0
 TOPO_ZONE = 1
 TOPO_REGION = 2
-TOPO_ZONE_REGION = 3   # virtual composite slot
-FIRST_CUSTOM_TOPO = 4
+TOPO_ZONE_REGION = 3    # virtual composite slot (both present)
+TOPO_SPREAD_ZONE = 4    # virtual GetZoneKey slot: (region, zone) with either
+                        # present (pkg/util/node/node.go:115 — the zone id
+                        # SelectorSpreadPriority aggregates by)
+FIRST_CUSTOM_TOPO = 5
 
 # Sentinel topology-slot codes used in affinity-term encodings.
 TKEY_INVALID = -1       # empty/uninternable topologyKey on a required term

@@ -85,6 +85,17 @@ class PodBatch:
     vs_onehot: np.ndarray       # f32[P, UVS] PV node-affinity selectors
     vs_count: np.ndarray        # f32[P]
     vs_fail: np.ndarray         # bool[P] VolumeNode resolution error
+    # spreading / service state (state/spreading.py)
+    spread_q: np.ndarray        # i32[P] controller-selector union entry, -1 none
+    spread_svc_q: np.ndarray    # i32[P] services-only union (ServiceSpreading)
+    svcanti_q: np.ndarray       # i32[P] first-service selector entry, -1 none
+    svcanti_total: np.ndarray   # f32[P] matching same-namespace pods anywhere
+    svcaff_onehot: np.ndarray   # f32[P, UR] ServiceAffinity requirement terms
+    svcaff_count: np.ndarray    # f32[P]
+    svcaff_fail: np.ndarray     # bool[P] backfill pod unbound: hard error
+    # image locality / prefer-avoid
+    img_onehot: np.ndarray      # f32[P, UI] container-image multiplicities
+    avoid_onehot: np.ndarray    # f32[P, UO] controllerRef signature, if interned
 
     @property
     def batch_pods(self) -> int:
@@ -135,6 +146,15 @@ def empty_batch(caps: Capacities) -> PodBatch:
         vs_onehot=np.zeros((p, caps.volsel_universe), np.float32),
         vs_count=np.zeros((p,), np.float32),
         vs_fail=np.zeros((p,), np.bool_),
+        spread_q=np.full((p,), -1, np.int32),
+        spread_svc_q=np.full((p,), -1, np.int32),
+        svcanti_q=np.full((p,), -1, np.int32),
+        svcanti_total=np.zeros((p,), np.float32),
+        svcaff_onehot=np.zeros((p, caps.req_universe), np.float32),
+        svcaff_count=np.zeros((p,), np.float32),
+        svcaff_fail=np.zeros((p,), np.bool_),
+        img_onehot=np.zeros((p, caps.image_universe), np.float32),
+        avoid_onehot=np.zeros((p, caps.avoid_universe), np.float32),
     )
 
 
@@ -179,6 +199,65 @@ def encode_pod_into(batch: PodBatch, i: int, pod: Pod, caps: Capacities,
     _encode_node_affinity(batch, i, pod, caps, table)
     _encode_interpod_affinity(batch, i, pod, caps, table)
     _encode_volumes(batch, i, pod, caps, table, ctx)
+    _encode_workloads(batch, i, pod, caps, table, ctx)
+
+
+def _encode_workloads(batch: PodBatch, i: int, pod: Pod, caps: Capacities,
+                      table: NodeTable, ctx) -> None:
+    """Spreading entries, service (anti-)affinity, image locality and
+    prefer-avoid rows (state/spreading.py, state/volumes.py)."""
+    from kubernetes_tpu.state.context import EMPTY_CONTEXT
+    from kubernetes_tpu.state.layout import ReqOp
+    from kubernetes_tpu.state.spreading import (
+        first_service_entry,
+        service_affinity_terms,
+        spread_entry,
+    )
+    from kubernetes_tpu.state.volumes import pod_controller_ref
+
+    ctx = ctx or EMPTY_CONTEXT
+    batch.spread_q[i] = spread_entry(pod, ctx, table)
+    batch.spread_svc_q[i] = spread_entry(pod, ctx, table, services_only=True)
+    batch.svcanti_q[i], batch.svcanti_total[i] = \
+        first_service_entry(pod, ctx, table)
+    # these entries were interned AFTER pod_matches_q was filled; the pod
+    # matches its own union/service entries by construction (they are built
+    # from selectors that select it), so set the columns directly — the
+    # in-batch ledger needs them to count same-batch placements
+    for q in (batch.spread_q[i], batch.spread_svc_q[i], batch.svcanti_q[i]):
+        if q >= 0:
+            batch.pod_matches_q[i, q] = 1.0
+
+    batch.svcaff_onehot[i] = 0.0
+    batch.svcaff_count[i] = 0.0
+    batch.svcaff_fail[i] = False
+    if ctx.service_affinity_labels:
+        terms = service_affinity_terms(pod, ctx, ctx.service_affinity_labels)
+        if terms is None:
+            batch.svcaff_fail[i] = True
+        else:
+            rids = {table.intern_requirement(k, ReqOp.IN, (v,))
+                    for k, v in terms}
+            for rid in rids:
+                batch.svcaff_onehot[i, rid] = 1.0
+            batch.svcaff_count[i] = float(len(rids))
+
+    # image locality: interned lookups only — an image on no node scores 0
+    # everywhere, and interning it here keeps the row valid if a node
+    # reports it later (img_size columns refill at node encode)
+    batch.img_onehot[i] = 0.0
+    for c in pod.spec.containers:
+        if c.image:
+            batch.img_onehot[i, table.intern_image(c.image)] += 1.0
+
+    # prefer-avoid: lookup only — signatures are interned by node
+    # annotations; an unseen signature cannot be avoided by any node
+    batch.avoid_onehot[i] = 0.0
+    sig = pod_controller_ref(pod)
+    if sig is not None:
+        oid = table.avoids.get(sig)
+        if oid is not None:
+            batch.avoid_onehot[i, oid] = 1.0
 
 
 def _encode_volumes(batch: PodBatch, i: int, pod: Pod, caps: Capacities,
@@ -296,6 +375,24 @@ def fill_batch_affinity(batch: PodBatch, pods: Sequence[Pod],
         batch.pod_carries_e[i] = carried_term_row(table, eids)
 
 
+def fill_batch_avoid(batch: PodBatch, pods: Sequence[Pod],
+                     table: NodeTable) -> None:
+    """Recompute prefer-avoid rows once node annotations have interned their
+    signatures (avoid atoms only come from nodes; a batch encoded before its
+    nodes would miss them)."""
+    if not table.avoids:
+        return
+    from kubernetes_tpu.state.volumes import pod_controller_ref
+
+    for i, pod in enumerate(pods):
+        batch.avoid_onehot[i] = 0.0
+        sig = pod_controller_ref(pod)
+        if sig is not None:
+            oid = table.avoids.get(sig)
+            if oid is not None:
+                batch.avoid_onehot[i, oid] = 1.0
+
+
 def _valid_requirement(expr: dict) -> bool:
     """Mirror labels.NewRequirement validation (selector.go): operator must be
     known; In/NotIn need >=1 value; Exists/DoesNotExist need none; Gt/Lt need
@@ -395,9 +492,10 @@ def encode_cluster(nodes, pods, caps: Capacities, assigned_pods=(), ctx=None):
     batch = encode_pods(pods, caps, table, ctx=ctx)
     state, _ = encode_nodes(nodes, caps, assigned_pods=assigned_pods,
                             table=table, ctx=ctx)
-    # assigned pods may have interned new selector entries: refresh the
-    # batch's match rows against the final universes
+    # assigned pods may have interned new selector entries, and nodes new
+    # avoid signatures: refresh the batch rows against the final universes
     fill_batch_affinity(batch, pods, table)
+    fill_batch_avoid(batch, pods, table)
     apply_pending_refreshes(state, table)
     table.pending_podsel_refresh.clear()  # counts were built post-interning
     return state, batch, table

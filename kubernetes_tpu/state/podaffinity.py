@@ -31,8 +31,11 @@ from kubernetes_tpu.api.objects import Pod
 #   PARSE_ERROR      — invalid selector, poisons the carrying term
 #   ()               — empty selector, matches everything
 #   ((key, op, values), ...) — conjunction of requirements
+#   (UNION, (canon, ...))    — disjunction (SelectorSpread's match-any over
+#                              controller selectors, selector_spreading.go:123)
 NOTHING = "<nothing>"
 PARSE_ERROR = "<error>"
+UNION = "<union>"
 
 _SEL_OPS = ("In", "NotIn", "Exists", "DoesNotExist")
 
@@ -57,9 +60,22 @@ def canonical_selector(selector: dict | None):
     return tuple(sorted(reqs))
 
 
+def union_selector(canons) -> tuple:
+    """Canonical match-any disjunction over selector canons."""
+    return (UNION, tuple(sorted(set(canons), key=repr)))
+
+
+def map_selector(selector: dict) -> tuple:
+    """Canonicalize a map-style selector (labels.SelectorFromSet — Service
+    and RC spec.selector)."""
+    return tuple(sorted((k, "In", (v,)) for k, v in selector.items()))
+
+
 def selector_matches(canon, labels: dict[str, str]) -> bool:
     if canon == NOTHING or canon == PARSE_ERROR:
         return False
+    if len(canon) == 2 and canon[0] == UNION:
+        return any(selector_matches(c, labels) for c in canon[1])
     from kubernetes_tpu.state.cluster_state import match_requirement
 
     return all(match_requirement(labels, k, op, values)

@@ -1,0 +1,121 @@
+"""Host-side spreading/service analysis: controller selectors + service
+affinity inference.
+
+Mirrors the lister-driven halves of SelectorSpreadPriority
+(selector_spreading.go:61-89 getSelectors), ServiceAntiAffinityPriority
+(selector_spreading.go:190-250) and the ServiceAffinity predicate's
+precomputation (predicates.go:762-781). Everything resolves to interned
+integer ids: the pod's controller selectors become ONE pod-selector-universe
+entry with match-any union semantics (so per-node counts never double-count a
+pod matching two selectors, selector_spreading.go:123-131), and service
+affinity becomes requirement-universe terms.
+"""
+
+from __future__ import annotations
+
+from kubernetes_tpu.api.objects import Pod
+from kubernetes_tpu.state.context import EncodeContext
+from kubernetes_tpu.state.podaffinity import (
+    canonical_selector,
+    map_selector,
+    PARSE_ERROR,
+    selector_matches,
+    union_selector,
+)
+
+
+def pod_controller_selectors(pod: Pod, ctx: EncodeContext,
+                             services_only: bool = False) -> list:
+    """Canonical selectors of services/RCs/RSs/StatefulSets matching the pod
+    (getSelectors, selector_spreading.go:61; `services_only` is the
+    ServiceSpreadingPriority variant, defaults.go:97-104).
+
+    Lister semantics: nil/empty selectors match nothing (the listers'
+    explicit guards); the RC/RS/SS listers error out for label-less pods
+    (ignored by getSelectors), the service lister does not."""
+    ns = pod.metadata.namespace
+    labels = pod.metadata.labels
+    out = []
+    for svc in ctx.get_services(ns):
+        sel = svc.selector
+        if sel and selector_matches(map_selector(sel), labels):
+            out.append(map_selector(sel))
+    if services_only or not labels:
+        return out
+    for rc in ctx.get_rcs(ns):
+        sel = rc.selector
+        if sel and selector_matches(map_selector(sel), labels):
+            out.append(map_selector(sel))
+    for rs in ctx.get_rss(ns):
+        canon = canonical_selector(rs.selector or None)
+        if canon != PARSE_ERROR and canon != () \
+                and selector_matches(canon, labels):
+            out.append(canon)
+    for ss in ctx.get_sss(ns):
+        canon = canonical_selector(ss.selector or None)
+        if canon != PARSE_ERROR and canon != () \
+                and selector_matches(canon, labels):
+            out.append(canon)
+    return out
+
+
+def spread_entry(pod: Pod, ctx: EncodeContext, table,
+                 services_only: bool = False) -> int:
+    """Pod-selector-universe id of the pod's spreading union, or -1 when the
+    pod has no matching controllers (score degenerates to uniform
+    MaxPriority, selector_spreading.go:157-167)."""
+    canons = pod_controller_selectors(pod, ctx, services_only=services_only)
+    if not canons:
+        return -1
+    return table.intern_podsel(frozenset([pod.metadata.namespace]),
+                               union_selector(canons))
+
+
+def first_service_entry(pod: Pod, ctx: EncodeContext, table):
+    """(qid, total) for ServiceAntiAffinityPriority: the first matching
+    service's selector (selector_spreading.go:228 'just use the first
+    service') interned same-namespace, plus the total count of matching
+    same-namespace pods — bound or not (nsServicePods from the pod lister,
+    :230-240)."""
+    ns = pod.metadata.namespace
+    for svc in ctx.get_services(ns):
+        sel = svc.selector
+        if sel and selector_matches(map_selector(sel), pod.metadata.labels):
+            canon = map_selector(sel)
+            qid = table.intern_podsel(frozenset([ns]), canon)
+            total = sum(1 for p in ctx.list_pods(ns)
+                        if selector_matches(canon, p.metadata.labels))
+            return qid, float(total)
+    return -1, 0.0
+
+
+def service_affinity_terms(pod: Pod, ctx: EncodeContext,
+                           labels: tuple) -> list[tuple[str, str]] | None:
+    """The ServiceAffinity predicate's affinity-label set for one pod
+    (serviceAffinityPrecomputation + checkServiceAffinity,
+    predicates.go:762-855): pinned nodeSelector values first; unset labels
+    backfilled from the node of the first existing same-namespace pod whose
+    labels the pod's label set selects, when the pod belongs to a service.
+    Returns (key, value) terms the node must carry, or None when the
+    backfill pod is unbound (GetNodeInfo error -> attempt fails)."""
+    affinity = {k: pod.spec.node_selector[k] for k in labels
+                if k in pod.spec.node_selector}
+    if len(affinity) < len(labels):
+        ns = pod.metadata.namespace
+        services = [s for s in ctx.get_services(ns)
+                    if s.selector and selector_matches(
+                        map_selector(s.selector), pod.metadata.labels)]
+        if services:
+            own_sel = map_selector(pod.metadata.labels)
+            matching = [p for p in ctx.list_pods(ns)
+                        if selector_matches(own_sel, p.metadata.labels)]
+            if matching:
+                first = matching[0]
+                node = ctx.get_node(first.spec.node_name) \
+                    if first.spec.node_name else None
+                if node is None:
+                    return None  # unbound/unknown node: hard error path
+                for k in labels:
+                    if k not in affinity and k in node.metadata.labels:
+                        affinity[k] = node.metadata.labels[k]
+    return sorted(affinity.items())

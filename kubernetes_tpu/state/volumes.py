@@ -36,10 +36,7 @@ everywhere, surfaced as per-pod fail bits.
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
-from typing import Any, Callable
-
-from kubernetes_tpu.api.objects import PersistentVolume, PersistentVolumeClaim, Pod
+from kubernetes_tpu.api.objects import PersistentVolume, Pod
 from kubernetes_tpu.state.layout import TOPOLOGY_KEYS, VolType
 
 ZONE_LABELS = (TOPOLOGY_KEYS[1], TOPOLOGY_KEYS[2])  # zone, region
@@ -54,20 +51,10 @@ class VolumeError(Exception):
     non-nil error (fails the pod's scheduling attempt)."""
 
 
-@dataclass
-class VolumeContext:
-    """Lister access for claim resolution (reference PluginFactoryArgs
-    PVInfo/PVCInfo, factory/plugins.go). `None` lookups mean not-found."""
+# The claim-resolution half of the shared encode context (state/context.py).
+from kubernetes_tpu.state.context import EMPTY_CONTEXT, EncodeContext  # noqa: E402,F401
 
-    get_pvc: Callable[[str, str], PersistentVolumeClaim | None] = \
-        lambda ns, name: None
-    get_pv: Callable[[str], PersistentVolume | None] = lambda name: None
-    # feature gate for NoVolumeNodeConflict (PersistentLocalVolumes,
-    # pkg/features/kube_features.go — alpha, default off)
-    local_volumes_enabled: bool = False
-
-
-EMPTY_CONTEXT = VolumeContext()
+VolumeContext = EncodeContext
 
 
 def conflict_atoms(volume: dict) -> list[tuple[tuple, bool]]:

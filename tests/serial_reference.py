@@ -487,6 +487,223 @@ def volume_zone_ok(ns: NodeState, terms: list[tuple[str, str]]) -> bool:
     return all(constraints.get(k, "") == v for k, v in terms)
 
 
+# ---- spreading / service / image / avoid (direct Go transcriptions) ----
+
+def _match_map_selector(sel: dict, labels: dict) -> bool:
+    return all(labels.get(k) == v for k, v in sel.items())
+
+
+def _match_label_selector(sel: dict, labels: dict):
+    """metav1.LabelSelector match; None on parse error."""
+    for k, v in (sel.get("matchLabels") or {}).items():
+        if labels.get(k) != v:
+            return False
+    ok = True
+    for e in sel.get("matchExpressions") or []:
+        op, key = e.get("operator"), e.get("key", "")
+        values = e.get("values") or []
+        if op == "In":
+            if not values:
+                return None
+            ok = ok and labels.get(key) in values
+        elif op == "NotIn":
+            if not values:
+                return None
+            ok = ok and (key not in labels or labels[key] not in values)
+        elif op == "Exists":
+            ok = ok and key in labels
+        elif op == "DoesNotExist":
+            ok = ok and key not in labels
+        else:
+            return None
+    return ok
+
+
+def spread_selectors(pod: Pod, ctx) -> list:
+    """getSelectors (selector_spreading.go:61): matching services + RC/RS/SS
+    (the latter only for labeled pods — the listers error on label-less
+    pods). Returns matcher callables."""
+    if ctx is None:
+        return []
+    ns, labels = pod.metadata.namespace, pod.metadata.labels
+    out = []
+    for svc in ctx.get_services(ns):
+        sel = svc.selector
+        if sel and _match_map_selector(sel, labels):
+            out.append(("map", sel))
+    if labels:
+        for rc in ctx.get_rcs(ns):
+            sel = rc.selector
+            if sel and _match_map_selector(sel, labels):
+                out.append(("map", sel))
+        for rs in list(ctx.get_rss(ns)) + list(ctx.get_sss(ns)):
+            sel = rs.selector
+            if sel and _match_label_selector(sel, labels):
+                out.append(("ls", sel))
+    return out
+
+
+def _matches_any(selectors, labels: dict) -> bool:
+    for kind, sel in selectors:
+        if kind == "map":
+            if _match_map_selector(sel, labels):
+                return True
+        elif _match_label_selector(sel, labels):
+            return True
+    return False
+
+
+def zone_key(node: Node) -> str:
+    """GetZoneKey (pkg/util/node/node.go:115)."""
+    region = node.metadata.labels.get(ZONE_KEYS[1], "")
+    zone = node.metadata.labels.get(ZONE_KEYS[0], "")
+    if region == "" and zone == "":
+        return ""
+    return region + ":\x00:" + zone
+
+
+def selector_spread_scores(fits: list, pod: Pod, ctx) -> list[int]:
+    """CalculateSpreadPriority (selector_spreading.go:100-188) over the
+    filtered node list."""
+    selectors = spread_selectors(pod, ctx)
+    counts, zcounts = {}, {}
+    if selectors:
+        for ns in fits:
+            c = sum(1 for p in ns.pods
+                    if p.metadata.namespace == pod.metadata.namespace
+                    and _matches_any(selectors, p.metadata.labels))
+            counts[ns.node.metadata.name] = c
+            zid = zone_key(ns.node)
+            if zid:
+                zcounts[zid] = zcounts.get(zid, 0) + c
+    max_node = max(counts.values(), default=0)
+    max_zone = max(zcounts.values(), default=0)
+    out = []
+    for ns in fits:
+        fscore = float(MAX_PRIORITY)
+        if max_node > 0:
+            fscore = MAX_PRIORITY * (
+                (max_node - counts[ns.node.metadata.name]) / max_node)
+        if zcounts:
+            zid = zone_key(ns.node)
+            if zid:
+                # max_zone == 0 is 0/0 in the reference; deterministically
+                # MaxPriority (see ops/spread.py)
+                zscore = float(MAX_PRIORITY) if max_zone == 0 else \
+                    MAX_PRIORITY * ((max_zone - zcounts[zid]) / max_zone)
+                fscore = fscore / 3.0 + (2.0 / 3.0) * zscore
+        out.append(int(fscore))
+    return out
+
+
+def service_anti_scores(fits: list, pod: Pod, ctx, label: str) -> list[int]:
+    """CalculateAntiAffinityPriority (selector_spreading.go:210-270)."""
+    sel = None
+    if ctx is not None:
+        for svc in ctx.get_services(pod.metadata.namespace):
+            s = svc.selector
+            if s and _match_map_selector(s, pod.metadata.labels):
+                sel = s
+                break
+    service_pods = []
+    if sel is not None:
+        service_pods = [p for p in ctx.list_pods(pod.metadata.namespace)
+                        if _match_map_selector(sel, p.metadata.labels)]
+    labeled = {ns.node.metadata.name: ns.node.metadata.labels[label]
+               for ns in fits if label in ns.node.metadata.labels}
+    pod_counts: dict = {}
+    for p in service_pods:
+        value = labeled.get(p.spec.node_name)
+        if value is not None:
+            pod_counts[value] = pod_counts.get(value, 0) + 1
+    total = len(service_pods)
+    out = []
+    for ns in fits:
+        name = ns.node.metadata.name
+        if name not in labeled:
+            out.append(0)
+            continue
+        if total > 0:
+            out.append(int(MAX_PRIORITY
+                           * ((total - pod_counts.get(labeled[name], 0))
+                              / total)))
+        else:
+            out.append(MAX_PRIORITY)
+    return out
+
+
+MIN_IMG = 23 * 1024 * 1024
+MAX_IMG = 1000 * 1024 * 1024
+
+
+def image_locality_score(ns: NodeState, pod: Pod) -> int:
+    """ImageLocalityPriorityMap (image_locality.go:32-80)."""
+    total = 0
+    for c in pod.spec.containers:
+        for image in ns.node.status.images:
+            if c.image in (image.get("names") or []):
+                total += int(image.get("sizeBytes") or 0)
+                break
+    if total < MIN_IMG:
+        return 0
+    if total >= MAX_IMG:
+        return MAX_PRIORITY
+    return int(MAX_PRIORITY * (total - MIN_IMG) // (MAX_IMG - MIN_IMG)) + 1
+
+
+def prefer_avoid_score(ns: NodeState, pod: Pod) -> int:
+    """CalculateNodePreferAvoidPodsPriorityMap (node_prefer_avoid_pods.go)."""
+    import json as _json
+
+    ref = None
+    for r in pod.metadata.owner_references:
+        if r.get("controller"):
+            if r.get("kind") in ("ReplicationController", "ReplicaSet"):
+                ref = (r.get("kind"), r.get("uid"))
+            break
+    if ref is None:
+        return MAX_PRIORITY
+    raw = ns.node.metadata.annotations.get(
+        "scheduler.alpha.kubernetes.io/preferAvoidPods")
+    if not raw:
+        return MAX_PRIORITY
+    try:
+        avoids = _json.loads(raw)
+    except ValueError:
+        return MAX_PRIORITY
+    for entry in (avoids or {}).get("preferAvoidPods") or []:
+        ctrl = (entry.get("podSignature") or {}).get("podController") or {}
+        if (ctrl.get("kind"), ctrl.get("uid")) == ref:
+            return 0
+    return MAX_PRIORITY
+
+
+def most_requested(ns: NodeState, pod: Pod) -> int:
+    """MostRequestedPriorityMap (most_requested.go)."""
+    nz_cpu, nz_mem = pod_nonzero(pod)
+
+    def used(req, cap):
+        if cap == 0 or req > cap:
+            return 0
+        return (req * MAX_PRIORITY) // cap
+
+    return int((used(ns.nz_cpu + nz_cpu, ns.alloc_cpu)
+                + used(ns.nz_mem + nz_mem, ns.alloc_mem)) // 2)
+
+
+def node_label_score(ns: NodeState, label: str, presence: bool) -> int:
+    exists = label in ns.node.metadata.labels
+    return MAX_PRIORITY if exists == presence else 0
+
+
+def label_presence_ok(ns: NodeState, labels: tuple, presence: bool) -> bool:
+    """CheckNodeLabelPresence (predicates.go:737)."""
+    for label in labels:
+        if (label in ns.node.metadata.labels) != presence:
+            return False
+    return True
+
+
 def untolerated_prefer_count(ns: NodeState, pod: Pod) -> int:
     # Only tolerations applicable to PreferNoSchedule count
     # (taint_toleration.go getAllTolerationPreferNoSchedule).
@@ -508,7 +725,15 @@ class SerialScheduler:
                  *, with_node_affinity: bool = False,
                  with_interpod: bool = False, hard_pod_affinity_weight: int = 1,
                  with_volumes: bool = False, volume_ctx=None,
-                 attach_limits: dict | None = None):
+                 attach_limits: dict | None = None,
+                 extra_priorities: frozenset = frozenset(),
+                 # ((label, presence, weight), ...)
+                 label_priorities: tuple = (),
+                 # ((labels, presence), ...)
+                 label_presence: tuple = (),
+                 # ((label, weight), ...) ServiceAntiAffinity
+                 service_anti: tuple = (),
+                 service_affinity_labels: tuple = ()):
         self.states = [NodeState.from_node(n) for n in nodes]
         self.by_name = {ns.node.metadata.name: ns for ns in self.states}
         self.placed: list[tuple[Pod, str]] = []
@@ -525,6 +750,11 @@ class SerialScheduler:
         self.volume_ctx = volume_ctx
         # {"ebs": limit, "gce": limit, "azure": limit}
         self.attach_limits = attach_limits or {}
+        self.extra = extra_priorities
+        self.label_priorities = label_priorities
+        self.label_presence = label_presence
+        self.service_anti = service_anti
+        self.service_affinity_labels = service_affinity_labels
 
     def _volume_filter(self, fits: list, pod: Pod) -> list | None:
         """None = predicate error, the whole scheduling attempt fails."""
@@ -544,8 +774,45 @@ class SerialScheduler:
             return None
         return fits
 
+    def _service_affinity_ok(self, ns: NodeState, terms) -> bool:
+        return all(ns.node.metadata.labels.get(k) == v for k, v in terms)
+
+    def _service_affinity_terms(self, pod: Pod):
+        """checkServiceAffinity precomputation (predicates.go:762-855);
+        None = hard error (backfill pod unbound)."""
+        labels = self.service_affinity_labels
+        ctx = self.volume_ctx
+        affinity = {k: pod.spec.node_selector[k] for k in labels
+                    if k in pod.spec.node_selector}
+        if len(affinity) < len(labels) and ctx is not None:
+            ns_name = pod.metadata.namespace
+            services = [s for s in ctx.get_services(ns_name)
+                        if s.selector and _match_map_selector(
+                            s.selector, pod.metadata.labels)]
+            if services:
+                own = pod.metadata.labels
+                matching = [p for p in ctx.list_pods(ns_name)
+                            if _match_map_selector(own, p.metadata.labels)]
+                if matching:
+                    first = matching[0]
+                    node = ctx.get_node(first.spec.node_name) \
+                        if first.spec.node_name else None
+                    if node is None:
+                        return None
+                    for k in labels:
+                        if k not in affinity and k in node.metadata.labels:
+                            affinity[k] = node.metadata.labels[k]
+        return sorted(affinity.items())
+
     def schedule_one(self, pod: Pod) -> str | None:
         fits = [ns for ns in self.states if feasible(ns, pod)]
+        for labels, presence in self.label_presence:
+            fits = [ns for ns in fits if label_presence_ok(ns, labels, presence)]
+        if self.service_affinity_labels:
+            terms = self._service_affinity_terms(pod)
+            if terms is None:
+                return None
+            fits = [ns for ns in fits if self._service_affinity_ok(ns, terms)]
         if self.with_interpod:
             fits = [ns for ns in fits
                     if interpod_feasible(self.placed, self.by_name, ns.node, pod)]
@@ -574,12 +841,29 @@ class SerialScheduler:
             if ip_max - ip_min > 0:
                 ip_scores = [int(MAX_PRIORITY * (c - ip_min) / (ip_max - ip_min))
                              for c in ip_counts]
+        ss_scores = [0] * len(fits)
+        if "SelectorSpreadPriority" in self.extra:
+            ss_scores = selector_spread_scores(fits, pod, self.volume_ctx)
+        sa_scores = [0] * len(fits)
+        for label, weight in self.service_anti:
+            s = service_anti_scores(fits, pod, self.volume_ctx, label)
+            sa_scores = [a + weight * b for a, b in zip(sa_scores, s)]
         scores = []
-        for ns, cnt, na, ip in zip(fits, counts, na_scores, ip_scores):
+        for idx, (ns, cnt, na, ip) in enumerate(
+                zip(fits, counts, na_scores, ip_scores)):
             tt = MAX_PRIORITY if max_count == 0 else int(
                 (1 - Fraction(cnt, max_count)) * MAX_PRIORITY)
-            scores.append(least_requested(ns, pod) + balanced_allocation(ns, pod)
-                          + tt + na + ip)
+            score = (least_requested(ns, pod) + balanced_allocation(ns, pod)
+                     + tt + na + ip + ss_scores[idx] + sa_scores[idx])
+            if "MostRequestedPriority" in self.extra:
+                score += most_requested(ns, pod)
+            if "ImageLocalityPriority" in self.extra:
+                score += image_locality_score(ns, pod)
+            if "NodePreferAvoidPodsPriority" in self.extra:
+                score += 10000 * prefer_avoid_score(ns, pod)
+            for label, presence, weight in self.label_priorities:
+                score += weight * node_label_score(ns, label, presence)
+            scores.append(score)
         best = max(scores)
         ties = [ns for ns, s in zip(fits, scores) if s == best]
         pick = ties[self.rr % len(ties)]
