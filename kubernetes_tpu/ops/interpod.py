@@ -69,6 +69,16 @@ def domain_aggregates(topology: jnp.ndarray, counts: jnp.ndarray,
     return jnp.einsum("nkd,nu->kdu", onehot, counts)
 
 
+def topology_onehot(topology: jnp.ndarray, domain_universe: int) -> jnp.ndarray:
+    """f32[K, N, D]: one-hot of each node's domain id per topology slot
+    (-1 sentinel -> zero row). Pod-independent — compute once per batch and
+    thread through the per-pod kernels so domain->node broadcasts become MXU
+    matmuls instead of device gathers (dynamic gathers serialize on the TPU
+    scalar core and dominated the round-1 solve)."""
+    return jnp.transpose(jax.nn.one_hot(topology, domain_universe, axis=-1),
+                         (1, 0, 2))
+
+
 def make_ledger(state: ClusterState, domain_universe: int) -> AffinityLedger:
     return AffinityLedger(
         podsel_count=state.podsel_count,
@@ -82,19 +92,16 @@ def make_ledger(state: ClusterState, domain_universe: int) -> AffinityLedger:
     )
 
 
-def _slot_counts(topology: jnp.ndarray, node_counts: jnp.ndarray,
+def _slot_counts(topo_onehot: jnp.ndarray, node_counts: jnp.ndarray,
                  dom_counts: jnp.ndarray) -> jnp.ndarray:
     """f32[K, N, U]: for every topology slot k, the count of matches in node
-    n's k-domain. Slot 0 (hostname) reads node-level counts directly."""
-    k_slots = topology.shape[1]
-    per_slot = []
-    for k in range(k_slots):
-        if k == TOPO_HOSTNAME:
-            per_slot.append(node_counts)
-        else:
-            dom = topology[:, k]
-            gathered = dom_counts[k][jnp.clip(dom, 0)]       # [N, U]
-            per_slot.append(jnp.where((dom >= 0)[:, None], gathered, 0.0))
+    n's k-domain. Slot 0 (hostname) reads node-level counts directly; the
+    rest broadcast domain aggregates back to nodes with one [N,D]@[D,U]
+    matmul per slot (the -1 sentinel's zero one-hot row masks automatically)."""
+    k_slots = topo_onehot.shape[0]
+    per_slot = [node_counts]
+    for k in range(1, k_slots):
+        per_slot.append(topo_onehot[k] @ dom_counts[k])      # [N, U]
     return jnp.stack(per_slot)
 
 
@@ -120,24 +127,26 @@ def _counts_by_tkey(tkey: jnp.ndarray, slot_counts: jnp.ndarray,
     return out
 
 
-def _scalar_count(q, tkey, topology, node_counts, dom_counts,
+def _scalar_count(q, tkey, topo_onehot, node_counts, dom_counts,
                   union_all) -> jnp.ndarray:
     """f32[N]: count for one (q, tkey) own-term slot (q, tkey traced
     scalars; q >= 0)."""
-    k_slots = topology.shape[1]
+    k_slots = topo_onehot.shape[0]
     host = node_counts[:, q]
     out = jnp.where(tkey == TKEY_DEFAULT_UNION, union_all[:, q], 0.0)
     out = out + jnp.where(tkey == TOPO_HOSTNAME, host, 0.0)
     for k in range(1, k_slots):
-        dom = topology[:, k]
-        gathered = dom_counts[k, jnp.clip(dom, 0), q] * (dom >= 0)
-        out = out + jnp.where(tkey == k, gathered, 0.0)
+        broadcast = topo_onehot[k] @ dom_counts[k, :, q]     # [N]
+        out = out + jnp.where(tkey == k, broadcast, 0.0)
     return out
 
 
-def interpod_feasible(state: ClusterState, pod, ledger: AffinityLedger) -> jnp.ndarray:
+def interpod_feasible(state: ClusterState, pod, ledger: AffinityLedger,
+                      topo_onehot=None) -> jnp.ndarray:
     """bool[N]: InterPodAffinityMatches for one pod against every node."""
     topology = state.topology
+    if topo_onehot is None:
+        topo_onehot = topology_onehot(topology, ledger.dom_podsel.shape[1])
     n = topology.shape[0]
 
     # -- existing pods' required anti-affinity (predicates.go:1139) --
@@ -150,7 +159,7 @@ def interpod_feasible(state: ClusterState, pod, ledger: AffinityLedger) -> jnp.n
     # scheduling while any carrier exists (error path, predicates.go:1156)
     poisoned = jnp.any(anti & state.term_poison & (ledger.total_e > 0))
 
-    slot_e = _slot_counts(topology, ledger.term_count, ledger.dom_term)
+    slot_e = _slot_counts(topo_onehot, ledger.term_count, ledger.dom_term)
     union_e = _union_counts(topology, slot_e, ledger.term_count)
     cnt_e = _counts_by_tkey(state.term_tkey, slot_e, union_e)      # [N, UE]
     # empty topologyKey on a required anti term rejects every node while a
@@ -161,7 +170,7 @@ def interpod_feasible(state: ClusterState, pod, ledger: AffinityLedger) -> jnp.n
     ok = (violations == 0) & ~poisoned
 
     union_q = _union_counts(topology,
-                            _slot_counts(topology, ledger.podsel_count,
+                            _slot_counts(topo_onehot, ledger.podsel_count,
                                          ledger.dom_podsel),
                             ledger.podsel_count)
 
@@ -170,7 +179,7 @@ def interpod_feasible(state: ClusterState, pod, ledger: AffinityLedger) -> jnp.n
         q = pod.paff_q[t]
         used = q >= 0
         qc = jnp.clip(q, 0)
-        cnt = _scalar_count(qc, pod.paff_tkey[t], topology,
+        cnt = _scalar_count(qc, pod.paff_tkey[t], topo_onehot,
                             ledger.podsel_count, ledger.dom_podsel, union_q)
         exists = ledger.total_q[qc] > 0
         self_match = pod.pod_matches_q[qc] > 0
@@ -184,7 +193,7 @@ def interpod_feasible(state: ClusterState, pod, ledger: AffinityLedger) -> jnp.n
         q = pod.panti_q[t]
         used = q >= 0
         qc = jnp.clip(q, 0)
-        cnt = _scalar_count(qc, pod.panti_tkey[t], topology,
+        cnt = _scalar_count(qc, pod.panti_tkey[t], topo_onehot,
                             ledger.podsel_count, ledger.dom_podsel, union_q)
         ok = ok & (~used | (cnt == 0))
 
@@ -192,13 +201,15 @@ def interpod_feasible(state: ClusterState, pod, ledger: AffinityLedger) -> jnp.n
 
 
 def interpod_counts(state: ClusterState, pod, ledger: AffinityLedger,
-                    hard_weight: float) -> jnp.ndarray:
+                    hard_weight: float, topo_onehot=None) -> jnp.ndarray:
     """f32[N]: the weighted-count map of CalculateInterPodAffinityPriority —
     the pod's own preferred terms plus the symmetric contributions of
     existing pods' terms (hard affinity weighted by hard_weight)."""
     topology = state.topology
+    if topo_onehot is None:
+        topo_onehot = topology_onehot(topology, ledger.dom_podsel.shape[1])
 
-    slot_q = _slot_counts(topology, ledger.podsel_count, ledger.dom_podsel)
+    slot_q = _slot_counts(topo_onehot, ledger.podsel_count, ledger.dom_podsel)
     union_q = _union_counts(topology, slot_q, ledger.podsel_count)
     counts = jnp.zeros((topology.shape[0],), jnp.float32)
 
@@ -206,7 +217,7 @@ def interpod_counts(state: ClusterState, pod, ledger: AffinityLedger,
         q = pod.ppref_q[t]
         used = q >= 0
         qc = jnp.clip(q, 0)
-        cnt = _scalar_count(qc, pod.ppref_tkey[t], topology,
+        cnt = _scalar_count(qc, pod.ppref_tkey[t], topo_onehot,
                             ledger.podsel_count, ledger.dom_podsel, union_q)
         counts = counts + jnp.where(used, pod.ppref_w[t] * cnt, 0.0)
 
@@ -216,7 +227,7 @@ def interpod_counts(state: ClusterState, pod, ledger: AffinityLedger,
                         pod.pod_matches_q[jnp.clip(term_q, 0)], 0.0)
     eff_w = state.term_weight + hard_weight * (
         state.term_kind == TermKind.AFF_REQ).astype(jnp.float32)
-    slot_e = _slot_counts(topology, ledger.term_count, ledger.dom_term)
+    slot_e = _slot_counts(topo_onehot, ledger.term_count, ledger.dom_term)
     union_e = _union_counts(topology, slot_e, ledger.term_count)
     cnt_e = _counts_by_tkey(state.term_tkey, slot_e, union_e)
     counts = counts + jnp.sum(cnt_e * (match_e * eff_w)[None, :], axis=1)

@@ -218,6 +218,11 @@ def schedule_batch(
     else:
         na_counts = jnp.zeros(static_mask.shape, jnp.float32)
 
+    # domain->node broadcast matrix, shared by every interpod/spread kernel
+    # (pod-independent; hoisted so scan steps do matmuls, not gathers)
+    topo_onehot = (interpod.topology_onehot(state.topology, domain_universe)
+                   if use_ip_ledger else None)
+
     # ---- Phase B: scan over the pod axis, vector over nodes ----
     def step(carry: Carry, xs):
         pod, s_mask, s_score, p_counts, na_count = xs
@@ -236,8 +241,8 @@ def schedule_batch(
             feasible = feasible & preds.max_attach_ok(
                 state, pod, attach_maxes, attach_count=carry.attach_count)
         if use_ipa:
-            feasible = feasible & interpod.interpod_feasible(state, pod,
-                                                             carry.ipa)
+            feasible = feasible & interpod.interpod_feasible(
+                state, pod, carry.ipa, topo_onehot)
 
         score = s_score
         if w_lr:
@@ -254,18 +259,21 @@ def schedule_batch(
         if w_na:
             score = score + w_na * prios.normalized_from_counts(na_count, feasible)
         if w_ip:
-            ip_counts = interpod.interpod_counts(state, pod, carry.ipa, hard_w)
+            ip_counts = interpod.interpod_counts(state, pod, carry.ipa, hard_w,
+                                                 topo_onehot)
             score = score + w_ip * interpod.interpod_score(ip_counts, feasible)
         if w_ss:
             score = score + w_ss * spreadops.selector_spread(
-                state, pod.spread_q, carry.ipa, feasible, domain_universe)
+                state, pod.spread_q, carry.ipa, feasible, domain_universe,
+                topo_onehot)
         if w_ssp:
             score = score + w_ssp * spreadops.selector_spread(
-                state, pod.spread_svc_q, carry.ipa, feasible, domain_universe)
+                state, pod.spread_svc_q, carry.ipa, feasible, domain_universe,
+                topo_onehot)
         for i, (_label, sa_weight) in enumerate(svcanti):
             score = score + sa_weight * spreadops.service_anti_affinity(
                 state, pod.svcanti_q, pod.svcanti_total, carry.ipa, feasible,
-                prows.svcanti_slot[i], domain_universe)
+                prows.svcanti_slot[i], domain_universe, topo_onehot)
 
         masked = jnp.where(feasible, score, -jnp.inf)
         node, best, ntie = _select_host(masked, feasible, carry.rr)

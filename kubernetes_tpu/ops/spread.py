@@ -27,7 +27,8 @@ ZONE_WEIGHT = 2.0 / 3.0
 
 
 def selector_spread(state: ClusterState, spread_q, ledger: AffinityLedger,
-                    feasible, domain_universe: int) -> jnp.ndarray:
+                    feasible, domain_universe: int,
+                    topo_onehot=None) -> jnp.ndarray:
     """f32[N] SelectorSpread scores for one pod (spread_q: traced i32 scalar,
     -1 = no matching controllers -> uniform MaxPriority,
     selector_spreading.go:157 initializes every fScore to MaxPriority and
@@ -39,7 +40,8 @@ def selector_spread(state: ClusterState, spread_q, ledger: AffinityLedger,
 
     dom = state.topology[:, TOPO_SPREAD_ZONE]             # i32[N]
     has_zone = dom >= 0
-    onehot = jax.nn.one_hot(dom, domain_universe)         # [N, D], -1 -> 0row
+    onehot = (jax.nn.one_hot(dom, domain_universe)        # [N, D], -1 -> 0row
+              if topo_onehot is None else topo_onehot[TOPO_SPREAD_ZONE])
     zc = onehot.T @ masked                                # [D] per-zone counts
     zc_node = onehot @ zc                                 # [N]
     have_zones = jnp.any(feasible & has_zone)
@@ -65,7 +67,7 @@ def selector_spread(state: ClusterState, spread_q, ledger: AffinityLedger,
 
 def service_anti_affinity(state: ClusterState, svcanti_q, total,
                           ledger: AffinityLedger, feasible, slot,
-                          domain_universe: int) -> jnp.ndarray:
+                          domain_universe: int, topo_onehot=None) -> jnp.ndarray:
     """f32[N] ServiceAntiAffinity scores for one pod and one configured
     label (slot: traced i32 from PolicyRows). Labeled nodes score by how few
     same-service pods share their label value — counted over feasible
@@ -76,7 +78,8 @@ def service_anti_affinity(state: ClusterState, svcanti_q, total,
     dom = state.topology[:, slot]                         # i32[N]
     labeled = dom >= 0
     contrib = jnp.where(feasible & labeled, counts, 0.0)
-    onehot = jax.nn.one_hot(dom, domain_universe)
+    onehot = (jax.nn.one_hot(dom, domain_universe)
+              if topo_onehot is None else topo_onehot[slot])
     per_dom = onehot.T @ contrib
     dom_count = onehot @ per_dom                          # [N]
     score = jnp.where(
