@@ -58,6 +58,27 @@ def test_ledger_stays_sharded():
     assert shard_shape[0] == CAPS.num_nodes // 8
 
 
+def test_sharded_matches_single_device_big_shapes():
+    """8k-node caps over the 8-device virtual mesh (VERDICT r1 weak #7):
+    sharded and single-device decisions must match at realistic scale."""
+    caps = Capacities(num_nodes=8192, batch_pods=64)
+    nodes = make_nodes(6000, zones=3, labels_per_node=2, taint_every=16)
+    pods = make_pods(48, selector_every=7, tolerate=True)
+    state, batch, _ = encode_cluster(nodes, pods, caps)
+    ref = schedule_batch(state, batch, 0, DEFAULT_POLICY)
+
+    mesh = make_mesh()
+    fn = make_sharded_scheduler(mesh, DEFAULT_POLICY)
+    got = fn(shard_state(state, mesh), shard_batch(batch, mesh), np.uint32(0))
+
+    np.testing.assert_array_equal(np.asarray(ref.assignments),
+                                  np.asarray(got.assignments))
+    np.testing.assert_allclose(np.asarray(ref.new_requested),
+                               np.asarray(got.new_requested))
+    assert (np.asarray(got.assignments)[:48] >= 0).all()
+    assert int(ref.rr_end) == int(got.rr_end)
+
+
 def test_indivisible_node_count_rejected():
     bad = Capacities(num_nodes=60, batch_pods=32)
     s, _ = encode_nodes(make_nodes(10), bad)
