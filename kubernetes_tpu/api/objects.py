@@ -608,8 +608,12 @@ class Service:
         return f"{self.metadata.namespace}/{self.metadata.name}"
 
     @property
-    def selector(self) -> dict[str, str]:
-        return dict(self.spec.get("selector") or {})
+    def selector(self) -> dict[str, str] | None:
+        """None (absent) vs {} matters: the reference lister skips only nil
+        selectors — a non-nil empty map selects everything
+        (service_expansion.go:45-50, labels.Set{}.AsSelector())."""
+        sel = self.spec.get("selector")
+        return None if sel is None else dict(sel)
 
     def clone(self) -> "Service":
         return Service(metadata=self.metadata.clone(),

@@ -87,4 +87,8 @@ def service_anti_affinity(state: ClusterState, svcanti_q, total,
         jnp.trunc(MAX_PRIORITY * (total - dom_count)
                   / jnp.maximum(total, 1.0) + FLOOR_EPS),
         float(MAX_PRIORITY))
+    # in-batch assume increments can push dom_count past the encode-time
+    # total; the reference recomputes both from the same snapshot and can
+    # never go negative — clamp to preserve that invariant
+    score = jnp.maximum(score, 0.0)
     return jnp.where(labeled, score, 0.0)

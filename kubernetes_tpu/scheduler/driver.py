@@ -247,6 +247,7 @@ class Scheduler:
         batch = empty_batch(self.caps)
         pods: list[Pod] = []
         live_keys: list[str] = []
+        epoch_before = self.statedb.table.pod_row_epoch
         for key in keys:
             ns, name = key.split("/", 1)
             pod = self.pod_informer.get(name, ns)
@@ -265,6 +266,18 @@ class Scheduler:
             live_keys.append(key)
         if not pods:
             return 0
+        if self.statedb.table.pod_row_epoch != epoch_before:
+            # a later pod in this batch interned new podsel/term entries:
+            # earlier pods' match/carry rows (encoded, possibly cached,
+            # against the smaller universe) miss them — refresh every row
+            # against the final universes before flushing
+            from kubernetes_tpu.state.pod_batch import (
+                fill_batch_affinity,
+                fill_batch_avoid,
+            )
+
+            fill_batch_affinity(batch, pods, self.statedb.table)
+            fill_batch_avoid(batch, pods, self.statedb.table)
 
         timer = StepTimer(f"scheduling batch of {len(pods)}")
         state = self.statedb.flush()

@@ -30,15 +30,16 @@ def pod_controller_selectors(pod: Pod, ctx: EncodeContext,
     (getSelectors, selector_spreading.go:61; `services_only` is the
     ServiceSpreadingPriority variant, defaults.go:97-104).
 
-    Lister semantics: nil/empty selectors match nothing (the listers'
-    explicit guards); the RC/RS/SS listers error out for label-less pods
-    (ignored by getSelectors), the service lister does not."""
+    Lister semantics: nil selectors match nothing (the listers' explicit
+    guards; a non-nil empty map matches everything,
+    service_expansion.go:45-50); the RC/RS/SS listers error out for
+    label-less pods (ignored by getSelectors), the service lister does not."""
     ns = pod.metadata.namespace
     labels = pod.metadata.labels
     out = []
     for svc in ctx.get_services(ns):
         sel = svc.selector
-        if sel and selector_matches(map_selector(sel), labels):
+        if sel is not None and selector_matches(map_selector(sel), labels):
             out.append(map_selector(sel))
     if services_only or not labels:
         return out
@@ -75,16 +76,18 @@ def first_service_entry(pod: Pod, ctx: EncodeContext, table):
     """(qid, total) for ServiceAntiAffinityPriority: the first matching
     service's selector (selector_spreading.go:228 'just use the first
     service') interned same-namespace, plus the total count of matching
-    same-namespace pods — bound or not (nsServicePods from the pod lister,
-    :230-240)."""
+    same-namespace *assigned* pods (nsServicePods comes from the scheduler
+    cache's pod lister, factory.go:139, which holds only bound pods)."""
     ns = pod.metadata.namespace
     for svc in ctx.get_services(ns):
         sel = svc.selector
-        if sel and selector_matches(map_selector(sel), pod.metadata.labels):
+        if sel is not None and selector_matches(map_selector(sel),
+                                                pod.metadata.labels):
             canon = map_selector(sel)
             qid = table.intern_podsel(frozenset([ns]), canon)
             total = sum(1 for p in ctx.list_pods(ns)
-                        if selector_matches(canon, p.metadata.labels))
+                        if p.spec.node_name
+                        and selector_matches(canon, p.metadata.labels))
             return qid, float(total)
     return -1, 0.0
 
@@ -96,25 +99,29 @@ def service_affinity_terms(pod: Pod, ctx: EncodeContext,
     predicates.go:762-855): pinned nodeSelector values first; unset labels
     backfilled from the node of the first existing same-namespace pod whose
     labels the pod's label set selects, when the pod belongs to a service.
-    Returns (key, value) terms the node must carry, or None when the
-    backfill pod is unbound (GetNodeInfo error -> attempt fails)."""
+    The backfill candidates are *assigned* pods only — the reference's
+    podLister is the scheduler cache (factory.go:139), which holds only
+    bound pods, so a service's first pod schedules unconstrained and pins
+    the labels. Returns (key, value) terms the node must carry, or None
+    when a bound backfill pod's node lookup fails (GetNodeInfo error ->
+    attempt fails)."""
     affinity = {k: pod.spec.node_selector[k] for k in labels
                 if k in pod.spec.node_selector}
     if len(affinity) < len(labels):
         ns = pod.metadata.namespace
         services = [s for s in ctx.get_services(ns)
-                    if s.selector and selector_matches(
+                    if s.selector is not None and selector_matches(
                         map_selector(s.selector), pod.metadata.labels)]
         if services:
             own_sel = map_selector(pod.metadata.labels)
             matching = [p for p in ctx.list_pods(ns)
-                        if selector_matches(own_sel, p.metadata.labels)]
+                        if p.spec.node_name
+                        and selector_matches(own_sel, p.metadata.labels)]
             if matching:
                 first = matching[0]
-                node = ctx.get_node(first.spec.node_name) \
-                    if first.spec.node_name else None
+                node = ctx.get_node(first.spec.node_name)
                 if node is None:
-                    return None  # unbound/unknown node: hard error path
+                    return None  # bound pod, unknown node: hard error path
                 for k in labels:
                     if k not in affinity and k in node.metadata.labels:
                         affinity[k] = node.metadata.labels[k]

@@ -529,7 +529,7 @@ def spread_selectors(pod: Pod, ctx) -> list:
     out = []
     for svc in ctx.get_services(ns):
         sel = svc.selector
-        if sel and _match_map_selector(sel, labels):
+        if sel is not None and _match_map_selector(sel, labels):
             out.append(("map", sel))
     if labels:
         for rc in ctx.get_rcs(ns):
@@ -602,13 +602,15 @@ def service_anti_scores(fits: list, pod: Pod, ctx, label: str) -> list[int]:
     if ctx is not None:
         for svc in ctx.get_services(pod.metadata.namespace):
             s = svc.selector
-            if s and _match_map_selector(s, pod.metadata.labels):
+            if s is not None and _match_map_selector(s, pod.metadata.labels):
                 sel = s
                 break
     service_pods = []
     if sel is not None:
+        # the cache-backed pod lister holds only assigned pods (factory.go:139)
         service_pods = [p for p in ctx.list_pods(pod.metadata.namespace)
-                        if _match_map_selector(sel, p.metadata.labels)]
+                        if p.spec.node_name
+                        and _match_map_selector(sel, p.metadata.labels)]
     labeled = {ns.node.metadata.name: ns.node.metadata.labels[label]
                for ns in fits if label in ns.node.metadata.labels}
     pod_counts: dict = {}
@@ -787,16 +789,16 @@ class SerialScheduler:
         if len(affinity) < len(labels) and ctx is not None:
             ns_name = pod.metadata.namespace
             services = [s for s in ctx.get_services(ns_name)
-                        if s.selector and _match_map_selector(
+                        if s.selector is not None and _match_map_selector(
                             s.selector, pod.metadata.labels)]
             if services:
                 own = pod.metadata.labels
                 matching = [p for p in ctx.list_pods(ns_name)
-                            if _match_map_selector(own, p.metadata.labels)]
+                            if p.spec.node_name
+                            and _match_map_selector(own, p.metadata.labels)]
                 if matching:
                     first = matching[0]
-                    node = ctx.get_node(first.spec.node_name) \
-                        if first.spec.node_name else None
+                    node = ctx.get_node(first.spec.node_name)
                     if node is None:
                         return None
                     for k in labels:

@@ -292,6 +292,39 @@ class TestServiceAffinity:
         assert got == ["n1"]
 
 
+    def test_first_service_pod_schedules_unconstrained(self):
+        """Regression (ADVICE r1 high): the backfill lister holds only
+        assigned pods (factory.go:139); the service's first pod used to
+        backfill from itself (unbound) -> hard error -> livelock."""
+        nodes = [mk_node("n0", labels={"region": "r1"}),
+                 mk_node("n1", labels={"region": "r2"})]
+        web = {"app": "web"}
+        pending = [mk_pod("p", labels=web)]
+        ctx = mk_ctx(services=[svc()], all_pods=pending, nodes=nodes,
+                     sa_labels=("region",))
+        got = solve(nodes, pending, self.POLICY, assigned=(), ctx=ctx)
+        assert got[0] in ("n0", "n1")
+
+
+class TestServiceSelectorNilVsEmpty:
+    def test_empty_map_selector_matches_all_nil_matches_none(self):
+        """service_expansion.go:45-50: nil selectors match nothing; a
+        non-nil empty map selects everything."""
+        from kubernetes_tpu.state.spreading import pod_controller_selectors
+
+        empty = Service.from_dict({
+            "metadata": {"name": "s", "namespace": "default"},
+            "spec": {"selector": {}}})
+        absent = Service.from_dict({
+            "metadata": {"name": "t", "namespace": "default"},
+            "spec": {}})
+        assert empty.selector == {}
+        assert absent.selector is None
+        ctx = mk_ctx(services=[empty, absent])
+        sels = pod_controller_selectors(mk_pod("p"), ctx, services_only=True)
+        assert sels == [()]  # the empty canon (match-all); nil skipped
+
+
 class TestServiceAntiAffinity:
     POLICY = Policy(
         predicates=BASE_PREDS,
