@@ -13,6 +13,7 @@ from __future__ import annotations
 
 from decimal import Decimal
 from fractions import Fraction
+from functools import lru_cache
 
 _BINARY_SUFFIXES = {
     "Ki": 1024,
@@ -40,12 +41,20 @@ _DECIMAL_SUFFIXES = {
 def parse_quantity(value: str | int | float) -> Fraction:
     """Parse a Kubernetes quantity into an exact Fraction of base units.
 
-    Accepts ints/floats for convenience (treated as base units).
+    Accepts ints/floats for convenience (treated as base units). String
+    parses are memoized — clusters reuse a handful of distinct quantity
+    strings, and Fraction/Decimal construction dominates the host-side
+    accounting path otherwise.
     """
     if isinstance(value, Fraction):
         return value
     if isinstance(value, (int, float)):
         return Fraction(Decimal(str(value)))
+    return _parse_str(value)
+
+
+@lru_cache(maxsize=65536)
+def _parse_str(value: str) -> Fraction:
     s = value.strip()
     if not s:
         raise ValueError("empty quantity")
@@ -65,12 +74,15 @@ def parse_quantity(value: str | int | float) -> Fraction:
         raise ValueError(f"unparseable quantity {value!r}") from e
 
 
+@lru_cache(maxsize=65536)
 def to_milli(value: str | int | float) -> int:
     """Quantity -> integer milli-units, rounding up (reference rounds CPU
     quantities up to milli scale: resource.Quantity.MilliValue)."""
     frac = parse_quantity(value) * 1000
     return -((-frac.numerator) // frac.denominator)  # ceil
 
+
+@lru_cache(maxsize=65536)
 def to_int(value: str | int | float) -> int:
     """Quantity -> integer base units (bytes for memory), rounding up."""
     frac = parse_quantity(value)

@@ -51,14 +51,17 @@ from kubernetes_tpu.state.pod_batch import PodBatch
 
 @struct.dataclass
 class AffinityLedger:
-    """The scan-carried inter-pod affinity state."""
+    """The scan-carried inter-pod affinity state. Term-universe fields are
+    None (empty pytree) when only the podsel consumers (SelectorSpread /
+    ServiceAntiAffinity) are active — they read node-level podsel counts
+    only."""
 
     podsel_count: jnp.ndarray   # f32[N, UQ]
-    term_count: jnp.ndarray     # f32[N, UE]
-    dom_podsel: jnp.ndarray     # f32[K, D, UQ]
-    dom_term: jnp.ndarray       # f32[K, D, UE]
     total_q: jnp.ndarray        # f32[UQ]
-    total_e: jnp.ndarray        # f32[UE]
+    term_count: object = None   # f32[N, UE] | None
+    dom_podsel: object = None   # f32[K, D, UQ] | None
+    dom_term: object = None     # f32[K, D, UE] | None
+    total_e: object = None      # f32[UE] | None
 
 
 def domain_aggregates(topology: jnp.ndarray, counts: jnp.ndarray,
@@ -79,7 +82,13 @@ def topology_onehot(topology: jnp.ndarray, domain_universe: int) -> jnp.ndarray:
                          (1, 0, 2))
 
 
-def make_ledger(state: ClusterState, domain_universe: int) -> AffinityLedger:
+def make_ledger(state: ClusterState, domain_universe: int,
+                with_terms: bool = True) -> AffinityLedger:
+    if not with_terms:
+        return AffinityLedger(
+            podsel_count=state.podsel_count,
+            total_q=jnp.sum(state.podsel_count, axis=0),
+        )
     return AffinityLedger(
         podsel_count=state.podsel_count,
         term_count=state.term_count,
@@ -247,9 +256,14 @@ def interpod_score(counts: jnp.ndarray, feasible: jnp.ndarray) -> jnp.ndarray:
 
 
 def ledger_add(ledger: AffinityLedger, state: ClusterState, pod, node,
-               add: jnp.ndarray) -> AffinityLedger:
+               add: jnp.ndarray, with_terms: bool = True) -> AffinityLedger:
     """Account an assignment into the affinity ledger (add is 1.0 or 0.0)."""
     q_row = add * pod.pod_matches_q
+    if not with_terms:
+        return AffinityLedger(
+            podsel_count=ledger.podsel_count.at[node].add(q_row),
+            total_q=ledger.total_q + q_row,
+        )
     e_row = add * pod.pod_carries_e
     doms = state.topology[node]                       # i32[K]
     k_idx = jnp.arange(doms.shape[0])

@@ -25,6 +25,8 @@ serial reference in tests/serial_reference.py.
 
 from __future__ import annotations
 
+from dataclasses import dataclass
+
 import jax
 import jax.numpy as jnp
 from flax import struct
@@ -41,11 +43,60 @@ from kubernetes_tpu.ops import predicates as preds
 from kubernetes_tpu.ops import priorities as prios
 from kubernetes_tpu.ops import spread as spreadops
 from kubernetes_tpu.state.cluster_state import ClusterState
+from kubernetes_tpu.state.layout import MAX_PRIORITY
 from kubernetes_tpu.state.pod_batch import PodBatch
 
 # Domain-axis size for inter-pod affinity aggregates; must equal the encoding
 # Capacities.domain_universe (pass caps to schedule_batch to override).
 DEFAULT_DOMAIN_UNIVERSE = 64
+
+
+@dataclass(frozen=True)
+class BatchFlags:
+    """Batch-content gates: what this batch can actually exercise.
+
+    The policy decides which kernels are *configured*; these flags record
+    which of them the current batch (plus accounted state) can possibly
+    affect, so the compiled program skips provably-neutral work. Each flag
+    set False asserts a fact about the inputs under which the skipped
+    kernel's contribution is exactly neutral (constant score shifts are
+    re-added as scalars), keeping decisions bit-identical to ALL_ACTIVE.
+
+    This is the batched analog of the reference's per-predicate
+    short-circuits (e.g. the len(newVolumes)==0 quick return,
+    predicates.go:296): the reference skips per pod at run time, a compiled
+    tensor program must skip per batch at trace time. Hashable — part of
+    the jit key; the driver computes it per batch (few distinct values in
+    practice, so a handful of program variants).
+    """
+
+    ipa: bool = True      # own interpod terms in batch, or carried terms
+    spread: bool = True   # any spread_q / spread_svc_q entry
+    svcanti: bool = True  # any svcanti_q entry
+    vol: bool = True      # any disk-conflict atom wanted
+    attach: bool = True   # any attachable-volume atom (or resolve failure)
+
+
+ALL_ACTIVE = BatchFlags()
+
+
+def batch_flags(batch: PodBatch, n_pods: int, table) -> BatchFlags:
+    """Compute the gates for `n_pods` encoded rows of a host-side batch
+    against the current NodeTable (carried terms live in the state)."""
+    import numpy as np
+
+    def any_(arr):
+        return bool(np.asarray(arr[:n_pods]).any())
+
+    return BatchFlags(
+        ipa=bool(table.terms) or any_(batch.paff_q >= 0)
+        or any_(batch.panti_q >= 0) or any_(batch.ppref_q >= 0)
+        or any_(batch.ipaff_fail),
+        spread=any_(batch.spread_q >= 0) or any_(batch.spread_svc_q >= 0),
+        svcanti=any_(batch.svcanti_q >= 0),
+        vol=any_(batch.vol_want_rw) or any_(batch.vol_want_ro),
+        attach=any_(batch.att_onehot) or any_(batch.att_fail),
+    )
 
 
 @struct.dataclass
@@ -147,12 +198,13 @@ def schedule_batch(
     policy: Policy = DEFAULT_POLICY,
     caps=None,
     prows=None,
+    flags: BatchFlags = ALL_ACTIVE,
 ) -> SolverResult:
     """Schedule a whole pending batch in one device program.
 
-    Pure function; jit with `policy` (and `caps`, if given) static. `prows`
-    carries the PolicyRows for argument-carrying registrations (None when
-    the policy has none — models/policy.py build_policy_rows). Returns
+    Pure function; jit with `policy`, `flags` (and `caps`, if given) static.
+    `prows` carries the PolicyRows for argument-carrying registrations (None
+    when the policy has none — models/policy.py build_policy_rows). Returns
     per-pod assignments plus the post-batch resource ledger for the host to
     commit (assume semantics).
     """
@@ -169,7 +221,7 @@ def schedule_batch(
     w_ba = policy.weight("BalancedResourceAllocation")
     w_tt = policy.weight("TaintTolerationPriority")
     w_na = policy.weight("NodeAffinityPriority")
-    w_ip = policy.weight("InterPodAffinityPriority")
+    w_ip = policy.weight("InterPodAffinityPriority") if flags.ipa else 0
     w_ss = policy.weight("SelectorSpreadPriority")
     w_ssp = policy.weight("ServiceSpreadingPriority")
     svcanti = active_service_anti(policy)
@@ -179,11 +231,22 @@ def schedule_batch(
             "policy carries argument registrations (labelsPresence / "
             "labelPreference / serviceAntiAffinity) but no PolicyRows were "
             "given — build them with models.policy.build_policy_rows")
-    use_ipa = policy.has_predicate("MatchInterPodAffinity")
-    use_ip_ledger = (use_ipa or bool(w_ip) or bool(w_ss) or bool(w_ssp)
-                     or bool(svcanti))
-    use_nodisk = policy.has_predicate("NoDiskConflict")
-    attach_maxes = policy.attach_maxes()
+    use_ipa = policy.has_predicate("MatchInterPodAffinity") and flags.ipa
+    # flag-gated neutral terms: with every spread_q == -1, SelectorSpread
+    # scores a uniform MaxPriority (selector_spreading.go:157) — a constant
+    # shift that cannot change argmax but must stay in the reported score
+    const_score = 0.0
+    if w_ss and not flags.spread:
+        const_score += w_ss * float(MAX_PRIORITY)
+        w_ss = 0
+    if w_ssp and not flags.spread:
+        const_score += w_ssp * float(MAX_PRIORITY)
+        w_ssp = 0
+    use_svcanti = bool(svcanti) and flags.svcanti
+    use_terms = use_ipa or bool(w_ip)   # carried-term ledger structures
+    use_ip_ledger = (use_terms or bool(w_ss) or bool(w_ssp) or use_svcanti)
+    use_nodisk = policy.has_predicate("NoDiskConflict") and flags.vol
+    attach_maxes = policy.attach_maxes() if flags.attach else ()
     hard_w = float(policy.hard_pod_affinity_weight)
     domain_universe = caps.domain_universe if caps else DEFAULT_DOMAIN_UNIVERSE
 
@@ -191,16 +254,29 @@ def schedule_batch(
     # NodeLabel priority scores) — computed once, broadcast over the batch
     base_mask = None
     base_score = None
+    if const_score:
+        base_score = jnp.full(state.valid.shape[0], const_score, jnp.float32)
     if prows is not None:
         if active_label_presence(policy):
             base_mask = preds.label_presence_ok(
                 state, prows.pres_onehot, prows.pres_count, prows.abs_onehot)
         nl = active_label_priorities(policy)
         if nl:
-            base_score = jnp.zeros(state.valid.shape[0], jnp.float32)
+            if base_score is None:
+                base_score = jnp.zeros(state.valid.shape[0], jnp.float32)
             for i, (_label, presence, weight) in enumerate(nl):
                 base_score = base_score + weight * prios.node_label_score(
                     state, prows.nlp_onehot[i], presence)
+        if svcanti and not use_svcanti:
+            # every svcanti_q == -1 and svcanti_total == 0: counts are zero,
+            # so labeled nodes score MaxPriority and unlabeled 0 — a
+            # pod-independent surface, hoisted out of the scan
+            if base_score is None:
+                base_score = jnp.zeros(state.valid.shape[0], jnp.float32)
+            for i, (_label, sa_weight) in enumerate(svcanti):
+                labeled = state.topology[:, prows.svcanti_slot[i]] >= 0
+                base_score = base_score + sa_weight * jnp.where(
+                    labeled, float(MAX_PRIORITY), 0.0)
 
     # ---- Phase A: batched over (P, N) ----
     static_mask = jax.vmap(
@@ -270,10 +346,12 @@ def schedule_batch(
             score = score + w_ssp * spreadops.selector_spread(
                 state, pod.spread_svc_q, carry.ipa, feasible, domain_universe,
                 topo_onehot)
-        for i, (_label, sa_weight) in enumerate(svcanti):
-            score = score + sa_weight * spreadops.service_anti_affinity(
-                state, pod.svcanti_q, pod.svcanti_total, carry.ipa, feasible,
-                prows.svcanti_slot[i], domain_universe, topo_onehot)
+        if use_svcanti:
+            for i, (_label, sa_weight) in enumerate(svcanti):
+                score = score + sa_weight * spreadops.service_anti_affinity(
+                    state, pod.svcanti_q, pod.svcanti_total, carry.ipa,
+                    feasible, prows.svcanti_slot[i], domain_universe,
+                    topo_onehot)
 
         masked = jnp.where(feasible, score, -jnp.inf)
         node, best, ntie = _select_host(masked, feasible, carry.rr)
@@ -287,7 +365,8 @@ def schedule_batch(
             port_count=(carry.port_count.at[node].add(add * pod.port_onehot)
                         if use_ports else carry.port_count),
             rr=carry.rr + jnp.where(assigned, jnp.uint32(1), jnp.uint32(0)),
-            ipa=(interpod.ledger_add(carry.ipa, state, pod, node, add)
+            ipa=(interpod.ledger_add(carry.ipa, state, pod, node, add,
+                                     with_terms=use_terms)
                  if use_ip_ledger else None),
             vol_any=(carry.vol_any.at[node].add(
                 add * (pod.vol_want_rw + pod.vol_want_ro))
@@ -306,7 +385,7 @@ def schedule_batch(
         nonzero=state.nonzero_requested,
         port_count=state.port_count,
         rr=jnp.asarray(rr_start, jnp.uint32),
-        ipa=(interpod.make_ledger(state, domain_universe)
+        ipa=(interpod.make_ledger(state, domain_universe, with_terms=use_terms)
              if use_ip_ledger else None),
         vol_any=state.vol_any if use_nodisk else None,
         vol_rw=state.vol_rw if use_nodisk else None,

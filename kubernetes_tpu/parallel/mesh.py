@@ -73,15 +73,21 @@ def shard_batch(batch: PodBatch, mesh: Mesh) -> PodBatch:
 
 
 def make_sharded_scheduler(mesh: Mesh, policy: Policy = DEFAULT_POLICY,
-                           caps=None, prows=None):
+                           caps=None, prows=None, flags=None, packed=False):
     """jit schedule_batch with node-axis sharding constraints.
 
     Returns fn(state, batch, rr) -> SolverResult whose ledger outputs stay
     node-sharded (so batch-to-batch chaining never gathers to one chip).
     `prows` (PolicyRows, replicated) is closed over as a constant — it is
-    fixed for the life of the policy.
+    fixed for the life of the policy. `flags` (BatchFlags) gates
+    batch-content-neutral kernels out of the compiled program. With
+    `packed=True` the returned fn takes (state, fblob, iblob, rr) — the
+    two-blob transport of pod_batch.pack_batch, replicated like the batch.
     """
-    from kubernetes_tpu.ops.solver import SolverResult
+    from kubernetes_tpu.ops.solver import ALL_ACTIVE, SolverResult
+
+    if flags is None:
+        flags = ALL_ACTIVE
 
     st = state_sharding(mesh)
     bt = batch_sharding(mesh)
@@ -92,9 +98,20 @@ def make_sharded_scheduler(mesh: Mesh, policy: Policy = DEFAULT_POLICY,
         new_requested=nodes_spec, new_nonzero=nodes_spec,
         new_port_count=nodes_spec, rr_end=repl,
     )
+    if packed:
+        from kubernetes_tpu.state.pod_batch import unpack_batch
+
+        return jax.jit(
+            lambda state, fblob, iblob, rr: schedule_batch(
+                state, unpack_batch(fblob, iblob, caps), rr, policy,
+                caps=caps, prows=prows, flags=flags),
+            in_shardings=(st, repl, repl, repl),
+            out_shardings=out_shardings,
+        )
     return jax.jit(
         lambda state, batch, rr: schedule_batch(state, batch, rr, policy,
-                                                caps=caps, prows=prows),
+                                                caps=caps, prows=prows,
+                                                flags=flags),
         in_shardings=(st, bt, repl),
         out_shardings=out_shardings,
     )

@@ -98,7 +98,7 @@ def run_throughput(
     if caps is None:
         num_nodes = 1 << max(6, (n_nodes - 1).bit_length())
         caps = Capacities(num_nodes=num_nodes,
-                          batch_pods=min(512, max(64, n_pods // 8)))
+                          batch_pods=min(2048, max(64, n_pods // 8)))
     if warmup_pods is None:
         warmup_pods = min(2 * caps.batch_pods, n_pods)
     return asyncio.run(_run(n_nodes, n_pods, caps, policy, warmup_pods,

@@ -155,17 +155,41 @@ class Scheduler:
         for informer in self.workload_informers:
             informer.add_handler(self._on_workload_event)
 
-        caps = self.caps
-        prows = self._prows
-        if mesh is not None:
-            from kubernetes_tpu.parallel.mesh import make_sharded_scheduler
-            self._schedule_fn = make_sharded_scheduler(mesh, policy, caps=caps,
-                                                       prows=prows)
-        else:
-            self._schedule_fn = jax.jit(
-                lambda s, b, rr: schedule_batch(s, b, rr, policy, caps=caps,
-                                                prows=prows))
+        self.mesh = mesh
+        self._schedule_fns: dict = {}
         self._stopped = False
+        # Pipelining: dispatch batch k+1 while batch k's result is still in
+        # flight on the device, hiding dispatch/readback round-trip latency
+        # (substantial over remote-device transports). Safe only when pod
+        # encoding is placement-independent: ServiceAffinity backfills and
+        # ServiceAntiAffinity totals read current placements at encode time,
+        # so those policies force the synchronous path.
+        self._pipeline = not (policy.service_affinity_labels()
+                              or policy.service_anti_priorities)
+        self._inflight: tuple | None = None
+
+    def _get_schedule_fn(self, flags):
+        """Compiled solver variant for this batch's content gates — a
+        handful of variants in practice (jit caches per BatchFlags)."""
+        import jax
+
+        fn = self._schedule_fns.get(flags)
+        if fn is None:
+            from kubernetes_tpu.state.pod_batch import unpack_batch
+
+            caps, policy, prows = self.caps, self.policy, self._prows
+            if self.mesh is not None:
+                from kubernetes_tpu.parallel.mesh import make_sharded_scheduler
+                fn = make_sharded_scheduler(self.mesh, policy, caps=caps,
+                                            prows=prows, flags=flags,
+                                            packed=True)
+            else:
+                fn = jax.jit(
+                    lambda s, fb, ib, rr: schedule_batch(
+                        s, unpack_batch(fb, ib, caps), rr, policy,
+                        caps=caps, prows=prows, flags=flags))
+            self._schedule_fns[flags] = fn
+        return fn
 
     def _on_workload_event(self, event: WatchEvent) -> None:
         self.encode_cache.generation += 1
@@ -223,6 +247,7 @@ class Scheduler:
 
     def stop(self) -> None:
         self._stopped = True
+        self._settle_inflight()
         self.queue.close()
         self.node_informer.stop()
         self.pod_informer.stop()
@@ -239,10 +264,12 @@ class Scheduler:
 
     async def schedule_pending(self, wait: float | None = None) -> int:
         """Pop up to a batch of pending pods, schedule, bind. Returns the
-        number of pods scheduled."""
-        keys = await self.queue.get_batch(self.caps.batch_pods, wait=wait)
+        number of pods scheduled (in pipeline mode: settled this call)."""
+        effective_wait = 0 if self._inflight is not None else wait
+        keys = await self.queue.get_batch(self.caps.batch_pods,
+                                          wait=effective_wait)
         if not keys:
-            return 0
+            return self._settle_inflight()
 
         batch = empty_batch(self.caps)
         pods: list[Pod] = []
@@ -265,7 +292,7 @@ class Scheduler:
             pods.append(pod)
             live_keys.append(key)
         if not pods:
-            return 0
+            return self._settle_inflight()
         if self.statedb.table.pod_row_epoch != epoch_before:
             # a later pod in this batch interned new podsel/term entries:
             # earlier pods' match/carry rows (encoded, possibly cached,
@@ -280,14 +307,67 @@ class Scheduler:
             fill_batch_avoid(batch, pods, self.statedb.table)
 
         timer = StepTimer(f"scheduling batch of {len(pods)}")
+        from kubernetes_tpu.ops.solver import batch_flags
+        from kubernetes_tpu.state.pod_batch import pack_batch
+
+        flags = batch_flags(batch, len(pods), self.statedb.table)
+        schedule_fn = self._get_schedule_fn(flags)
+        fblob, iblob = pack_batch(batch, self.caps)
+        # only resource/port charges chain device-side through adopt_ledger;
+        # a batch touching podsel/volume/attach state must settle before its
+        # successor dispatches (those arrays reach the device via host
+        # mirror + re-upload only)
+        clean = not (flags.ipa or flags.spread or flags.svcanti or flags.vol
+                     or flags.attach)
+        settled = 0
+        if self._inflight is not None and (not self._pipeline or not clean
+                                           or self.statedb.ledger_dirty):
+            # a dirty flush would re-upload host truth that misses the
+            # in-flight batch's charges: settle it first
+            settled += self._settle_inflight()
         state = self.statedb.flush()
         timer.step("encode + flush")
 
         t0 = time.monotonic()
-        result = self._schedule_fn(state, batch, self._rr)
-        assignments = np.asarray(result.assignments)
+        result = schedule_fn(state, fblob, iblob, self._rr)
         self._rr = result.rr_end
-        self.metrics.algorithm_latency.append(time.monotonic() - t0)
+        try:
+            # start the device->host copy now; by settle time (after the
+            # next dispatch) it is usually already on the host
+            result.assignments.copy_to_host_async()
+        except AttributeError:
+            pass
+        timer.step("device dispatch")
+        # pipeline only under sustained load (more pods already queued →
+        # another call is imminent); a drained queue settles synchronously
+        # so small/interactive workloads keep request-response semantics
+        if self._pipeline and clean and len(self.queue) > 0:
+            # adopt the (lazy, device-side) output ledger now so the next
+            # batch chains on it without a synchronization; settle the
+            # previous batch while this one computes
+            self.statedb.adopt_ledger(result.new_requested, result.new_nonzero,
+                                      result.new_port_count)
+            settled += self._settle_inflight()
+            self._inflight = (result, pods, live_keys, t0, timer, True)
+            return settled
+        settled += self._settle_inflight()  # previous batch, if any
+        self._inflight = (result, pods, live_keys, t0, timer, False)
+        return settled + self._settle_inflight()
+
+    def _settle_inflight(self) -> int:
+        """Read back the in-flight solve, bind its assignments, and commit
+        the ledger (the synchronous tail of the former schedule_pending)."""
+        if self._inflight is None:
+            return 0
+        result, pods, live_keys, t0, timer, adopted = self._inflight
+        self._inflight = None
+        t_wait = time.monotonic()
+        assignments = np.asarray(result.assignments)
+        # synchronous batches observe the true dispatch-to-ready span; for a
+        # pipelined batch only the residual blocking wait is observable (the
+        # full span would count the successor's host work as algorithm time)
+        self.metrics.algorithm_latency.append(
+            time.monotonic() - (t_wait if adopted else t0))
         timer.step("device solve")
 
         scheduled = 0
@@ -333,8 +413,11 @@ class Scheduler:
             self.statedb.mark_ledger_dirty()
         else:
             # clean batch: adopt the device ledger, no transfer either way
+            # (a pipelined batch already adopted at dispatch — replacing now
+            # would regress the device ledger past its successor's chaining)
             self.statedb.commit_ledger(result.new_requested, result.new_nonzero,
-                                       result.new_port_count, committed)
+                                       result.new_port_count, committed,
+                                       replace_device=not adopted)
         self.metrics.scheduled += scheduled
         self.metrics.batches += 1
         if self.metrics.batches % 128 == 0:

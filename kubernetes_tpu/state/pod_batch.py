@@ -158,6 +158,76 @@ def empty_batch(caps: Capacities) -> PodBatch:
     )
 
 
+def _batch_layout(caps: Capacities):
+    """Column layout for blob transport: field -> (blob, offset, width,
+    trailing_shape, dtype). Uploading a batch as ~45 small arrays pays ~45
+    per-transfer latencies on remote-device transports; two contiguous blobs
+    (one f32, one i32 that also carries u32 bitcast and bools) pay two."""
+    proto = empty_batch(caps)
+    layout = {}
+    offsets = {"f": 0, "i": 0}
+    for name in PodBatch.__dataclass_fields__:
+        arr = getattr(proto, name)
+        trailing = arr.shape[1:]
+        width = int(np.prod(trailing)) if trailing else 1
+        blob = "f" if arr.dtype == np.float32 else "i"
+        layout[name] = (blob, offsets[blob], width, trailing, arr.dtype)
+        offsets[blob] += width
+    return layout, offsets["f"], offsets["i"]
+
+
+_LAYOUTS: dict = {}
+
+
+def _layout(caps: Capacities):
+    lay = _LAYOUTS.get(caps)
+    if lay is None:
+        lay = _LAYOUTS[caps] = _batch_layout(caps)
+    return lay
+
+
+def pack_batch(batch: PodBatch, caps: Capacities,
+               out: tuple[np.ndarray, np.ndarray] | None = None):
+    """Host-side: pack a numpy PodBatch into (f32[P, F], i32[P, I]) blobs.
+    Pass `out` to reuse transfer buffers across batches."""
+    layout, f_width, i_width = _layout(caps)
+    p = batch.batch_pods
+    if out is None:
+        out = (np.empty((p, f_width), np.float32),
+               np.empty((p, i_width), np.int32))
+    fblob, iblob = out
+    for name, (blob, off, width, _trailing, dtype) in layout.items():
+        arr = getattr(batch, name)
+        flat = arr.reshape(p, width)
+        if blob == "f":
+            fblob[:, off:off + width] = flat
+        elif dtype == np.uint32:
+            iblob[:, off:off + width] = flat.view(np.int32)
+        else:
+            iblob[:, off:off + width] = flat
+    return fblob, iblob
+
+
+def unpack_batch(fblob, iblob, caps: Capacities) -> PodBatch:
+    """Device-side (jit-traceable): rebuild the PodBatch pytree by slicing
+    the blobs — pure views for XLA, no data movement."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    layout, _f, _i = _layout(caps)
+    p = fblob.shape[0]
+    fields = {}
+    for name, (blob, off, width, trailing, dtype) in layout.items():
+        src = fblob if blob == "f" else iblob
+        col = src[:, off:off + width].reshape((p, *trailing))
+        if dtype == np.uint32:
+            col = lax.bitcast_convert_type(col, jnp.uint32)
+        elif dtype == np.bool_:
+            col = col != 0
+        fields[name] = col
+    return PodBatch(**fields)
+
+
 def encode_pod_into(batch: PodBatch, i: int, pod: Pod, caps: Capacities,
                     table: NodeTable, ctx=None) -> None:
     batch.valid[i] = True

@@ -173,6 +173,24 @@ class StateDB:
     def is_accounted(self, pod_key: str) -> bool:
         return pod_key in self._accounted
 
+    @property
+    def ledger_dirty(self) -> bool:
+        """True when the next flush() will re-upload ledger/affinity/node
+        arrays from host truth — a pipelined driver must settle any
+        in-flight batch first, or its device-side charges get overwritten."""
+        return (self._dirty_nodes or self._dirty_ledger or self._dirty_affinity
+                or bool(self.table.pending_podsel_refresh))
+
+    def adopt_ledger(self, new_requested, new_nonzero, new_port_count) -> None:
+        """Chain the solver's (possibly still in-flight) output ledger as
+        the device truth without synchronizing — host mirroring happens at
+        settle time via commit_ledger/add_pod."""
+        if self._device is None:
+            raise RuntimeError("adopt_ledger before flush")
+        self._device = self._device.replace(
+            requested=new_requested, nonzero_requested=new_nonzero,
+            port_count=new_port_count)
+
     def mark_ledger_dirty(self) -> None:
         """Force the next flush() to re-upload the host ledger — used when the
         device-side ledger is known to carry charges the host truth does not
@@ -250,14 +268,21 @@ class StateDB:
         return dev
 
     def commit_ledger(self, new_requested, new_nonzero, new_port_count,
-                      assignments: list[tuple[Pod, str]]) -> None:
+                      assignments: list[tuple[Pod, str]],
+                      replace_device: bool = True) -> None:
         """Adopt the solver's output ledger as the device truth and mirror
-        the same assignments into host numpy (no transfer either way)."""
+        the same assignments into host numpy (no transfer either way).
+
+        replace_device=False commits the host mirror only — the pipelined
+        driver already chained this batch's output via adopt_ledger() before
+        dispatching its successor; re-replacing here would regress the
+        device ledger to the older batch's arrays."""
         if self._device is None:
             raise RuntimeError("commit_ledger before flush")
-        self._device = self._device.replace(
-            requested=new_requested, nonzero_requested=new_nonzero,
-            port_count=new_port_count)
+        if replace_device:
+            self._device = self._device.replace(
+                requested=new_requested, nonzero_requested=new_nonzero,
+                port_count=new_port_count)
         for pod, node_name in assignments:
             self.add_pod(pod, node_name, mirror_only=True)
             acc = self._accounted.get(pod.key)

@@ -9,36 +9,58 @@ creating new objects.
 from __future__ import annotations
 
 import time
+from collections import OrderedDict
 
 from kubernetes_tpu.api.objects import Event, ObjectMeta
-from kubernetes_tpu.apiserver.store import NotFound, ObjectStore
+from kubernetes_tpu.apiserver.store import AlreadyExists, NotFound, ObjectStore
+
+_KNOWN_MAX = 65536
 
 
 class EventRecorder:
     def __init__(self, store: ObjectStore, component: str = "default-scheduler"):
         self.store = store
         self.component = component
+        # LRU aggregation index: most events are first-time names (per-pod),
+        # and raising NotFound per recorded event dominates the recorder
+        # under load; bounded so a long-lived process cannot grow it forever
+        self._known: OrderedDict[tuple[str, str], None] = OrderedDict()
 
     def record(self, obj, event_type: str, reason: str, message: str) -> Event:
         name = f"{obj.metadata.name}.{reason.lower()}"
         namespace = obj.metadata.namespace
+        key = (namespace, name)
+        if key in self._known:
+            self._known.move_to_end(key)
+            try:
+                existing = self.store.get("Event", name, namespace)
+                existing.count += 1
+                existing.message = message
+                return self.store.update(existing, check_version=False)
+            except NotFound:
+                self._known.pop(key, None)  # deleted externally: recreate
+        event = Event(
+            metadata=ObjectMeta(name=name, namespace=namespace),
+            involved_object={
+                "kind": obj.kind,
+                "name": obj.metadata.name,
+                "namespace": namespace,
+                "uid": obj.metadata.uid,
+            },
+            reason=reason,
+            message=message,
+            type=event_type,
+            source_component=self.component,
+        )
         try:
+            created = self.store.create(event)
+        except AlreadyExists:
+            # raced with an earlier instance of this event name
             existing = self.store.get("Event", name, namespace)
             existing.count += 1
             existing.message = message
-            return self.store.update(existing, check_version=False)
-        except NotFound:
-            event = Event(
-                metadata=ObjectMeta(name=name, namespace=namespace),
-                involved_object={
-                    "kind": obj.kind,
-                    "name": obj.metadata.name,
-                    "namespace": namespace,
-                    "uid": obj.metadata.uid,
-                },
-                reason=reason,
-                message=message,
-                type=event_type,
-                source_component=self.component,
-            )
-            return self.store.create(event)
+            created = self.store.update(existing, check_version=False)
+        self._known[key] = None
+        if len(self._known) > _KNOWN_MAX:
+            self._known.popitem(last=False)
+        return created

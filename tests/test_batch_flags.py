@@ -1,0 +1,150 @@
+"""BatchFlags gating parity: a program compiled with content gates computed
+from the batch must produce bit-identical results to the ALL_ACTIVE program
+(the gates only skip provably-neutral work — solver.py BatchFlags)."""
+
+import numpy as np
+import pytest
+
+from kubernetes_tpu.api.objects import Node, Pod, Service
+from kubernetes_tpu.models.policy import DEFAULT_POLICY, Policy, build_policy_rows
+from kubernetes_tpu.ops.solver import ALL_ACTIVE, batch_flags, schedule_batch
+from kubernetes_tpu.state import Capacities, encode_cluster
+from kubernetes_tpu.state.context import EncodeContext
+
+CAPS = Capacities(num_nodes=16, batch_pods=8)
+ZONE = "failure-domain.beta.kubernetes.io/zone"
+
+
+def mk_node(name, zone="a"):
+    return Node.from_dict({
+        "metadata": {"name": name, "labels": {ZONE: zone}},
+        "status": {"allocatable": {"cpu": "8", "memory": "16Gi", "pods": "110"},
+                   "conditions": [{"type": "Ready", "status": "True"}]},
+    })
+
+
+def mk_pod(name, labels=None, affinity=None, volumes=None):
+    d = {"metadata": {"name": name, "namespace": "default", "uid": f"u-{name}",
+                      "labels": labels or {}},
+         "spec": {"containers": [{"name": "c", "resources": {
+             "requests": {"cpu": "100m"}}}]}}
+    if affinity:
+        d["spec"]["affinity"] = affinity
+    if volumes:
+        d["spec"]["volumes"] = volumes
+    return Pod.from_dict(d)
+
+
+def mk_ctx(services=(), all_pods=()):
+    return EncodeContext(
+        get_services=lambda ns: [s for s in services
+                                 if s.metadata.namespace == ns],
+        get_rcs=lambda ns: [], get_rss=lambda ns: [], get_sss=lambda ns: [],
+        list_pods=lambda ns: [p for p in all_pods
+                              if p.metadata.namespace == ns],
+        get_node=lambda name: None,
+    )
+
+
+def both(nodes, pods, policy, ctx=None):
+    state, batch, table = encode_cluster(nodes, pods, CAPS, ctx=ctx)
+    prows = build_policy_rows(policy, table, CAPS)
+    flags = batch_flags(batch, len(pods), table)
+    full = schedule_batch(state, batch, 0, policy, caps=CAPS, prows=prows,
+                          flags=ALL_ACTIVE)
+    gated = schedule_batch(state, batch, 0, policy, caps=CAPS, prows=prows,
+                           flags=flags)
+    return full, gated, flags
+
+
+def assert_equal(full, gated):
+    np.testing.assert_array_equal(np.asarray(full.assignments),
+                                  np.asarray(gated.assignments))
+    np.testing.assert_array_equal(np.asarray(full.scores),
+                                  np.asarray(gated.scores))
+    np.testing.assert_array_equal(np.asarray(full.feasible_counts),
+                                  np.asarray(gated.feasible_counts))
+    np.testing.assert_array_equal(np.asarray(full.new_requested),
+                                  np.asarray(gated.new_requested))
+    assert int(full.rr_end) == int(gated.rr_end)
+
+
+def test_plain_pods_gate_everything_off():
+    nodes = [mk_node(f"n{i}") for i in range(6)]
+    pods = [mk_pod(f"p{i}") for i in range(6)]
+    full, gated, flags = both(nodes, pods, DEFAULT_POLICY)
+    assert not (flags.ipa or flags.spread or flags.svcanti or flags.vol
+                or flags.attach)
+    assert_equal(full, gated)
+    assert (np.asarray(gated.assignments)[:6] >= 0).all()
+
+
+def test_service_pods_keep_spread_on():
+    nodes = [mk_node(f"n{i}") for i in range(4)]
+    web = {"app": "web"}
+    pods = [mk_pod(f"p{i}", labels=web) for i in range(4)]
+    svc = Service.from_dict({
+        "metadata": {"name": "web", "namespace": "default"},
+        "spec": {"selector": web}})
+    ctx = mk_ctx(services=[svc], all_pods=pods)
+    full, gated, flags = both(nodes, pods, DEFAULT_POLICY, ctx=ctx)
+    assert flags.spread and not flags.ipa
+    assert_equal(full, gated)
+
+
+def test_interpod_pods_keep_ipa_on():
+    nodes = [mk_node(f"n{i}") for i in range(4)]
+    web = {"app": "web"}
+    anti = {"podAntiAffinity": {
+        "requiredDuringSchedulingIgnoredDuringExecution": [{
+            "labelSelector": {"matchLabels": web},
+            "topologyKey": "kubernetes.io/hostname"}]}}
+    pods = [mk_pod(f"p{i}", labels=web, affinity=anti) for i in range(4)]
+    full, gated, flags = both(nodes, pods, DEFAULT_POLICY)
+    assert flags.ipa
+    assert_equal(full, gated)
+    # anti-affinity on hostname: all four land on distinct nodes
+    a = np.asarray(gated.assignments)[:4]
+    assert len(set(a.tolist())) == 4
+
+
+def test_volume_pods_keep_vol_on():
+    nodes = [mk_node(f"n{i}") for i in range(3)]
+    vol = [{"name": "d", "gcePersistentDisk": {"pdName": "disk-1",
+                                               "readOnly": False}}]
+    pods = [mk_pod(f"p{i}", volumes=vol) for i in range(3)]
+    full, gated, flags = both(nodes, pods, DEFAULT_POLICY)
+    assert flags.vol and flags.attach
+    assert_equal(full, gated)
+    # NoDiskConflict: the same RW disk cannot share a node
+    a = np.asarray(gated.assignments)[:3]
+    assert len(set(a.tolist())) == 3
+
+
+def test_svcanti_policy_gated_constant_when_inactive():
+    policy = Policy(
+        predicates=("GeneralPredicates",),
+        priorities=(("LeastRequestedPriority", 1), ("RackSpread", 1)),
+        service_anti_priorities=(("RackSpread", ZONE),))
+    nodes = [mk_node(f"n{i}") for i in range(4)]
+    pods = [mk_pod(f"p{i}") for i in range(4)]  # no service: svcanti inactive
+    full, gated, flags = both(nodes, pods, policy)
+    assert not flags.svcanti
+    assert_equal(full, gated)
+
+
+@pytest.mark.parametrize("with_services", [False, True])
+def test_spread_constant_shift_preserves_scores(with_services):
+    """Gating spread off must keep reported scores identical (the uniform
+    MaxPriority surface is re-added as a constant)."""
+    nodes = [mk_node(f"n{i}") for i in range(3)]
+    pods = [mk_pod(f"p{i}", labels={"app": "x"}) for i in range(3)]
+    ctx = None
+    if with_services:
+        svc = Service.from_dict({
+            "metadata": {"name": "x", "namespace": "default"},
+            "spec": {"selector": {"app": "x"}}})
+        ctx = mk_ctx(services=[svc], all_pods=pods)
+    full, gated, flags = both(nodes, pods, DEFAULT_POLICY, ctx=ctx)
+    assert flags.spread == with_services
+    assert_equal(full, gated)

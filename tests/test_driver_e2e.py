@@ -74,6 +74,37 @@ def test_unschedulable_retries_after_node_appears():
     asyncio.run(run())
 
 
+def test_pipelined_batches_chain_full_ledger():
+    """Regression: with pipelining (batch k+1 dispatched before batch k
+    settles), every batch must still see ALL predecessors' resource
+    charges — a settle that regressed the device ledger to the previous
+    batch's output let later batches over-commit nodes."""
+    async def run():
+        store = ObjectStore()
+        for node in make_nodes(2, cpu="2"):
+            store.create(node)
+        caps = Capacities(num_nodes=4, batch_pods=2)
+        sched = Scheduler(store, caps=caps)
+        sched.backoff.initial = 30.0  # no retries inside the window
+        await sched.start()
+        for pod in make_pods(8, cpu="1"):
+            store.create(pod)
+        await asyncio.sleep(0)
+        # many small batches so the queue stays non-empty -> pipelined
+        done = 0
+        for _ in range(12):
+            done += await sched.schedule_pending(wait=0.1)
+        bound = [p for p in store.list("Pod") if p.spec.node_name]
+        counts = {}
+        for p in bound:
+            counts[p.spec.node_name] = counts.get(p.spec.node_name, 0) + 1
+        assert len(bound) == 4, f"exactly 4 one-core pods fit: {counts}"
+        assert all(c <= 2 for c in counts.values()), f"over-commit: {counts}"
+        sched.stop()
+
+    asyncio.run(run())
+
+
 def test_capacity_exhaustion_and_recovery():
     async def run():
         store = ObjectStore()
