@@ -55,11 +55,22 @@ def make_pods(
     selector_every: int = 0,
     tolerate: bool = False,
     namespace: str = "default",
+    app_groups: int = 0,
+    anti_affinity_every: int = 0,
+    pref_affinity_every: int = 0,
 ) -> list[Pod]:
     """Templated pending pods (the basic scheduler_perf pod spec: small
-    cpu/memory requests)."""
+    cpu/memory requests).
+
+    app_groups labels pods app=app-{i%g} (service/spread targets);
+    anti_affinity_every adds required hostname anti-affinity against the
+    pod's own app group; pref_affinity_every adds preferred zone affinity
+    toward it (the interpod-heavy config shape, BASELINE.md)."""
     out = []
     for i in range(n):
+        meta: dict = {"name": f"{name_prefix}-{i}", "namespace": namespace}
+        if app_groups:
+            meta["labels"] = {"app": f"app-{i % app_groups}"}
         spec: dict = {"containers": [{
             "name": "app",
             "image": "k8s.gcr.io/pause:3.0",
@@ -69,8 +80,34 @@ def make_pods(
             spec["nodeSelector"] = {"label-0": f"value-{i % 7}"}
         if tolerate:
             spec["tolerations"] = [{"key": "dedicated", "operator": "Exists"}]
-        out.append(Pod.from_dict({
-            "metadata": {"name": f"{name_prefix}-{i}", "namespace": namespace},
-            "spec": spec,
-        }))
+        affinity: dict = {}
+        sel = {"matchLabels": {"app": f"app-{i % app_groups}"}} \
+            if app_groups else None
+        if anti_affinity_every and sel and i % anti_affinity_every == 0:
+            affinity["podAntiAffinity"] = {
+                "requiredDuringSchedulingIgnoredDuringExecution": [{
+                    "labelSelector": sel,
+                    "topologyKey": "kubernetes.io/hostname"}]}
+        if pref_affinity_every and sel and i % pref_affinity_every == 0:
+            affinity["podAffinity"] = {
+                "preferredDuringSchedulingIgnoredDuringExecution": [{
+                    "weight": 10,
+                    "podAffinityTerm": {
+                        "labelSelector": sel,
+                        "topologyKey":
+                            "failure-domain.beta.kubernetes.io/zone"}}]}
+        if affinity:
+            spec["affinity"] = affinity
+        out.append(Pod.from_dict({"metadata": meta, "spec": spec}))
     return out
+
+
+def make_services(n: int, namespace: str = "default") -> list:
+    """Services selecting the app groups of make_pods(app_groups=n) — the
+    SelectorSpread / PodTopologySpread-analog config's workload objects."""
+    from kubernetes_tpu.api.objects import Service
+
+    return [Service.from_dict({
+        "metadata": {"name": f"svc-{i}", "namespace": namespace},
+        "spec": {"selector": {"app": f"app-{i}"}}})
+        for i in range(n)]

@@ -37,8 +37,12 @@ class ThroughputResult:
 
 async def _run(n_nodes: int, n_pods: int, caps: Capacities, policy: Policy,
                warmup_pods: int, node_kwargs: dict, pod_kwargs: dict,
-               mesh=None) -> ThroughputResult:
+               mesh=None, n_services: int = 0) -> ThroughputResult:
     store = ObjectStore(watch_window=max(1 << 18, 4 * (n_pods + n_nodes)))
+    if n_services:
+        from kubernetes_tpu.perf.fixtures import make_services
+        for svc in make_services(n_services):
+            store.create(svc)
     for node in make_nodes(n_nodes, **node_kwargs):
         store.create(node)
     sched = Scheduler(store, caps=caps, policy=policy, mesh=mesh)
@@ -93,6 +97,7 @@ def run_throughput(
     node_kwargs: dict | None = None,
     pod_kwargs: dict | None = None,
     mesh=None,
+    n_services: int = 0,
 ) -> ThroughputResult:
     """Blocking entry point: returns sustained scheduling throughput."""
     if caps is None:
@@ -102,4 +107,5 @@ def run_throughput(
     if warmup_pods is None:
         warmup_pods = min(2 * caps.batch_pods, n_pods)
     return asyncio.run(_run(n_nodes, n_pods, caps, policy, warmup_pods,
-                            node_kwargs or {}, pod_kwargs or {}, mesh))
+                            node_kwargs or {}, pod_kwargs or {}, mesh,
+                            n_services=n_services))

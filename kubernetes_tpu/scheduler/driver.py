@@ -117,7 +117,9 @@ class Scheduler:
         scheduler_name: str = "default-scheduler",
         batch_wait: float = 0.002,
     ):
-        import jax
+        from kubernetes_tpu.utils.compilation_cache import enable
+
+        enable()  # persistent XLA cache: cold start loads compiled variants
 
         self.store = store
         self.caps = caps or Capacities()

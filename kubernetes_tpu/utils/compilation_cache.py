@@ -1,0 +1,36 @@
+"""Persistent XLA compilation cache.
+
+The reference pays no compile cost (Go is AOT); our analog of its instant
+cold start is XLA program persistence: first-ever compile of each
+(policy, capacities, flags) solver variant lands on disk, later processes
+load it in well under a second. The scheduler enables this at construction
+(plugin/cmd/kube-scheduler self-configures its runtime the same way).
+"""
+
+from __future__ import annotations
+
+import os
+
+_DEFAULT_DIR = os.path.expanduser("~/.cache/kubernetes_tpu/xla")
+_enabled = False
+
+
+def enable(cache_dir: str | None = None) -> bool:
+    """Idempotent, best-effort: point JAX's persistent compilation cache at
+    `cache_dir` (env KUBERNETES_TPU_XLA_CACHE overrides the default).
+    Returns True when active."""
+    global _enabled
+    if _enabled:
+        return True
+    try:
+        import jax
+
+        path = (cache_dir or os.environ.get("KUBERNETES_TPU_XLA_CACHE")
+                or _DEFAULT_DIR)
+        os.makedirs(path, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", path)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        _enabled = True
+    except Exception:  # noqa: BLE001 - cache is an optimization, never fatal
+        _enabled = False
+    return _enabled
