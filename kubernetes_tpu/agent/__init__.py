@@ -1,0 +1,5 @@
+"""L6 node agent: the hollow kubelet (kubemark-style) node plane."""
+
+from kubernetes_tpu.agent.hollow import HollowCluster, HollowKubelet
+
+__all__ = ["HollowCluster", "HollowKubelet"]

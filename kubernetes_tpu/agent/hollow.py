@@ -1,0 +1,189 @@
+"""Hollow kubelet: the kubemark-style node agent.
+
+Plays the kubelet's control-plane role without a container runtime
+(pkg/kubemark/hollow_kubelet.go:44 runs the real kubelet against fake
+docker/cadvisor; here the "runtime" is a no-op that starts instantly):
+
+- registers its Node object (kubelet_node_status.go registerWithAPIServer),
+- heartbeats NodeStatus Ready on a period (:borrows tryUpdateNodeStatus,
+  10s default in the reference),
+- acks bindings: pods scheduled onto it transition Pending -> Running with
+  a Ready condition (syncPod -> status_manager PATCH,
+  pkg/kubelet/status/status_manager.go:131),
+- stops acking/heartbeating when stopped — the failure-injection lever the
+  node lifecycle controller detects.
+
+A HollowCluster shares ONE pod informer across N agents (kubemark scale
+shape: thousands of hollow nodes on one host), dispatching bound pods to
+their node's agent by spec.nodeName.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+
+from kubernetes_tpu.api.objects import Node, NodeCondition, Pod
+from kubernetes_tpu.apiserver.store import AlreadyExists, Conflict, NotFound, ObjectStore
+from kubernetes_tpu.client.informer import Informer
+
+log = logging.getLogger(__name__)
+
+DEFAULT_HEARTBEAT = 10.0  # nodeStatusUpdateFrequency (componentconfig)
+
+
+class HollowKubelet:
+    """One node's agent. Create via HollowCluster for shared informers."""
+
+    def __init__(self, store: ObjectStore, node_name: str,
+                 heartbeat_every: float = DEFAULT_HEARTBEAT,
+                 capacity: dict | None = None):
+        self.store = store
+        self.node_name = node_name
+        self.heartbeat_every = heartbeat_every
+        self.capacity = capacity or {"cpu": "4", "memory": "8Gi",
+                                     "pods": "110"}
+        self._task: asyncio.Task | None = None
+        self.running = False
+
+    # ---- registration + heartbeat ----
+
+    def register(self) -> None:
+        """Create or refresh this kubelet's Node (registerWithAPIServer)."""
+        try:
+            node = self.store.get("Node", self.node_name, "default")
+        except NotFound:
+            node = Node.from_dict({
+                "metadata": {"name": self.node_name,
+                             "labels": {"kubernetes.io/hostname":
+                                        self.node_name}},
+                "status": {"allocatable": dict(self.capacity),
+                           "capacity": dict(self.capacity)}})
+            try:
+                self.store.create(node)
+            except AlreadyExists:
+                pass
+        self._heartbeat()
+
+    def _heartbeat(self) -> None:
+        try:
+            node = self.store.get("Node", self.node_name, "default")
+        except NotFound:
+            return
+        now = time.time()
+        ready = None
+        for c in node.status.conditions:
+            if c.type == "Ready":
+                ready = c
+        if ready is None:
+            ready = NodeCondition(type="Ready")
+            node.status.conditions.append(ready)
+        if ready.status != "True":
+            ready.last_transition_time = now
+        ready.status = "True"
+        ready.reason = "KubeletReady"
+        ready.last_heartbeat_time = now
+        try:
+            self.store.update(node, check_version=False)
+        except (Conflict, NotFound):
+            pass
+
+    # ---- pod lifecycle ----
+
+    def ack_pod(self, pod: Pod) -> None:
+        """Binding observed: run the (instant) hollow runtime and report
+        Running + Ready (the syncPod -> status PATCH path)."""
+        if not self.running:
+            return
+        fresh = None
+        try:
+            fresh = self.store.get("Pod", pod.metadata.name,
+                                   pod.metadata.namespace)
+        except NotFound:
+            return
+        if fresh.spec.node_name != self.node_name \
+                or fresh.status.phase == "Running":
+            return
+        now = time.time()
+        fresh.status.phase = "Running"
+        fresh.status.conditions = [
+            {"type": "Ready", "status": "True", "lastTransitionTime": now}]
+        try:
+            self.store.update(fresh, check_version=False)
+        except (Conflict, NotFound):
+            pass
+
+    # ---- lifecycle ----
+
+    async def start(self) -> None:
+        self.register()
+        self.running = True
+        self._task = asyncio.get_running_loop().create_task(self._loop())
+
+    def stop(self) -> None:
+        """Stop heartbeating and acking — from the control plane's view the
+        node just died (the kubemark failure-injection lever)."""
+        self.running = False
+        if self._task is not None:
+            self._task.cancel()
+            self._task = None
+
+    async def _loop(self) -> None:
+        while True:
+            await asyncio.sleep(self.heartbeat_every)
+            if not self.running:
+                return
+            self._heartbeat()
+
+
+class HollowCluster:
+    """N hollow kubelets over one shared pod informer (kubemark shape)."""
+
+    def __init__(self, store: ObjectStore, n_nodes: int = 0,
+                 name_prefix: str = "hollow",
+                 heartbeat_every: float = DEFAULT_HEARTBEAT,
+                 capacity: dict | None = None):
+        self.store = store
+        self.kubelets: dict[str, HollowKubelet] = {}
+        self.pod_informer = Informer(store, "Pod")
+        self.pod_informer.add_handler(self._on_pod)
+        for i in range(n_nodes):
+            name = f"{name_prefix}-{i}"
+            self.kubelets[name] = HollowKubelet(
+                store, name, heartbeat_every=heartbeat_every,
+                capacity=capacity)
+
+    def add(self, kubelet: HollowKubelet) -> None:
+        self.kubelets[kubelet.node_name] = kubelet
+
+    def _on_pod(self, event) -> None:
+        if event.type == "DELETED":
+            return
+        pod = event.obj
+        if not pod.spec.node_name:
+            return
+        kubelet = self.kubelets.get(pod.spec.node_name)
+        if kubelet is not None and kubelet.running:
+            kubelet.ack_pod(pod)
+
+    async def start(self) -> None:
+        self.pod_informer.start()
+        for kubelet in self.kubelets.values():
+            await kubelet.start()
+        await self.pod_informer.wait_for_sync()
+        # ack pods bound before the informer synced
+        for pod in self.pod_informer.items():
+            if pod.spec.node_name:
+                kubelet = self.kubelets.get(pod.spec.node_name)
+                if kubelet is not None and kubelet.running:
+                    kubelet.ack_pod(pod)
+
+    def stop(self, node_names=None) -> None:
+        """Stop all agents (or the named subset — partial failure)."""
+        names = node_names if node_names is not None \
+            else list(self.kubelets.keys())
+        for name in names:
+            self.kubelets[name].stop()
+        if node_names is None:
+            self.pod_informer.stop()

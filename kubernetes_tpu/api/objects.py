@@ -367,13 +367,28 @@ def parse_node_affinity(affinity: dict) -> tuple[list | None, list]:
 class NodeCondition:
     type: str = ""
     status: str = "Unknown"  # True | False | Unknown
+    # epoch seconds (the reference's metav1.Time fields; the node controller
+    # reads heartbeat age to detect dead kubelets, node_controller.go:587)
+    last_heartbeat_time: float = 0.0
+    last_transition_time: float = 0.0
+    reason: str = ""
 
     @classmethod
     def from_dict(cls, d: dict[str, Any]) -> "NodeCondition":
-        return cls(type=d.get("type", ""), status=d.get("status", "Unknown"))
+        return cls(type=d.get("type", ""), status=d.get("status", "Unknown"),
+                   last_heartbeat_time=float(d.get("lastHeartbeatTime") or 0.0),
+                   last_transition_time=float(d.get("lastTransitionTime") or 0.0),
+                   reason=d.get("reason", "") or "")
 
     def to_dict(self) -> dict[str, Any]:
-        return {"type": self.type, "status": self.status}
+        out = {"type": self.type, "status": self.status}
+        if self.last_heartbeat_time:
+            out["lastHeartbeatTime"] = self.last_heartbeat_time
+        if self.last_transition_time:
+            out["lastTransitionTime"] = self.last_transition_time
+        if self.reason:
+            out["reason"] = self.reason
+        return out
 
 
 @dataclass
@@ -458,8 +473,12 @@ class Node:
                           provider_id=self.spec.provider_id),
             status=NodeStatus(capacity=dict(self.status.capacity),
                               allocatable=dict(self.status.allocatable),
-                              conditions=[NodeCondition(c.type, c.status)
-                                          for c in self.status.conditions],
+                              conditions=[
+                                  NodeCondition(c.type, c.status,
+                                                c.last_heartbeat_time,
+                                                c.last_transition_time,
+                                                c.reason)
+                                  for c in self.status.conditions],
                               images=copy.deepcopy(self.status.images)),
         )
 
@@ -702,6 +721,24 @@ class StatefulSet(_Workload):
     @property
     def selector(self) -> dict[str, Any]:
         return dict(self.spec.get("selector") or {})
+
+
+@dataclass
+class Deployment(_Workload):
+    """extensions/v1beta1 Deployment: LabelSelector spec.selector + pod
+    template + strategy (reference pkg/controller/deployment; types at
+    staging/src/k8s.io/api/extensions/v1beta1/types.go)."""
+
+    kind = "Deployment"
+    api_version = "extensions/v1beta1"
+
+    @property
+    def selector(self) -> dict[str, Any]:
+        return dict(self.spec.get("selector") or {})
+
+    @property
+    def strategy_type(self) -> str:
+        return (self.spec.get("strategy") or {}).get("type", "RollingUpdate")
 
 
 @dataclass

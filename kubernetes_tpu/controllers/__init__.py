@@ -1,0 +1,21 @@
+"""L4 controllers: the reconcile plane (SURVEY.md §2.4)."""
+
+from kubernetes_tpu.controllers.base import (
+    Expectations,
+    ReconcileController,
+    slow_start_batch,
+)
+from kubernetes_tpu.controllers.deployment import DeploymentController
+from kubernetes_tpu.controllers.gc import GarbageCollector
+from kubernetes_tpu.controllers.manager import ControllerManager
+from kubernetes_tpu.controllers.replicaset import ReplicaManager
+
+__all__ = [
+    "ControllerManager",
+    "DeploymentController",
+    "Expectations",
+    "GarbageCollector",
+    "ReconcileController",
+    "ReplicaManager",
+    "slow_start_batch",
+]

@@ -1,0 +1,136 @@
+"""Generic reconcile machinery: the one pattern every reference controller
+follows (SURVEY.md §2.4) — SharedInformer events → rate-limited workqueue of
+object keys → worker loops → sync(key) reconciling desired vs observed.
+
+Mirrors pkg/controller/controller_utils.go: ControllerExpectations (:150,
+the in-flight create/delete bookkeeping that stops a controller from acting
+twice while its own writes are still in the watch pipe) and slowStartBatch
+(:744, 1-2-4-... create bursts so a failing kubelet/quota doesn't eat the
+whole burst); worker shape per replica_set.go:405 (worker → processNextWorkItem
+→ syncHandler with rate-limited requeue on error).
+
+Host-plane only by design: controllers reconcile object counts and write
+through the store; the device never sees them (the TPU tier is the
+scheduler's filter/score program)."""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from typing import Awaitable, Callable
+
+from kubernetes_tpu.client.workqueue import Backoff, BackoffQueue
+
+log = logging.getLogger(__name__)
+
+# controller.ExpectationsTimeout (controller_utils.go:80)
+EXPECTATIONS_TTL = 5 * 60.0
+# controller.SlowStartInitialBatchSize (controller_utils.go:744 callers)
+SLOW_START_INITIAL = 1
+
+
+class Expectations:
+    """Per-key in-flight create/delete counts (ControllerExpectations,
+    controller_utils.go:150). A sync observes its own previous writes via
+    the informer before acting again; expired expectations (5min) unblock a
+    controller whose watch stalled."""
+
+    def __init__(self):
+        self._exp: dict[str, tuple[int, int, float]] = {}
+
+    def expect(self, key: str, adds: int = 0, dels: int = 0) -> None:
+        self._exp[key] = (adds, dels, time.monotonic())
+
+    def creation_observed(self, key: str) -> None:
+        adds, dels, ts = self._exp.get(key, (0, 0, 0.0))
+        if key in self._exp:
+            self._exp[key] = (adds - 1, dels, ts)
+
+    def deletion_observed(self, key: str) -> None:
+        adds, dels, ts = self._exp.get(key, (0, 0, 0.0))
+        if key in self._exp:
+            self._exp[key] = (adds, dels - 1, ts)
+
+    def satisfied(self, key: str) -> bool:
+        if key not in self._exp:
+            return True
+        adds, dels, ts = self._exp[key]
+        if adds <= 0 and dels <= 0:
+            return True
+        return time.monotonic() - ts > EXPECTATIONS_TTL  # expired
+
+    def forget(self, key: str) -> None:
+        self._exp.pop(key, None)
+
+
+async def slow_start_batch(count: int, fn: Callable[[], Awaitable[bool]],
+                           initial: int = SLOW_START_INITIAL) -> int:
+    """slowStartBatch (controller_utils.go:744): run `count` create calls in
+    doubling batches, stopping at the first batch with a failure. Returns
+    successful calls."""
+    remaining = count
+    successes = 0
+    batch = initial
+    while remaining > 0:
+        n = min(batch, remaining)
+        results = await asyncio.gather(*(fn() for _ in range(n)),
+                                       return_exceptions=True)
+        ok = sum(1 for r in results if r is True)
+        successes += ok
+        if ok < n:
+            break
+        remaining -= n
+        batch = 2 * batch
+    return successes
+
+
+class ReconcileController:
+    """Informer-fed keyed reconcile loop. Subclasses implement
+    `async sync(key)` and call `enqueue(key)` from informer handlers."""
+
+    name = "controller"
+    workers = 1
+
+    def __init__(self):
+        self.queue = BackoffQueue()
+        self.backoff = Backoff(initial=0.01, max_duration=30.0)
+        self._tasks: list[asyncio.Task] = []
+        self.expectations = Expectations()
+
+    def enqueue(self, key: str) -> None:
+        self.queue.add(key)
+
+    def enqueue_after(self, key: str, delay: float) -> None:
+        self.queue.add_after(key, delay)
+
+    async def start(self) -> None:
+        loop = asyncio.get_running_loop()
+        for _ in range(self.workers):
+            self._tasks.append(loop.create_task(self._worker()))
+
+    def stop(self) -> None:
+        self.queue.close()
+        for t in self._tasks:
+            t.cancel()
+        self._tasks.clear()
+
+    async def _worker(self) -> None:
+        while True:
+            key = await self.queue.get()
+            if key is None:
+                return
+            try:
+                await self.sync(key)
+            except asyncio.CancelledError:
+                return
+            except Exception as e:  # noqa: BLE001 — requeue w/ backoff
+                log.warning("%s: sync(%s) failed: %s", self.name, key, e)
+                self.queue.done(key)
+                self.queue.add_after(key, self.backoff.next_delay(key))
+                continue
+            self.queue.done(key)
+            self.backoff.reset(key)
+
+    async def sync(self, key: str) -> None:  # pragma: no cover - interface
+        raise NotImplementedError

@@ -1,0 +1,222 @@
+"""Deployment controller: template-hashed ReplicaSets + rollout strategies.
+
+Mirrors pkg/controller/deployment (sync at deployment_controller.go:560,
+getNewReplicaSet sync.go:196, rolling math rolling.go:22 NewRSNewReplicas /
+:57 reconcileOldReplicaSets, recreate.go): a Deployment owns one ReplicaSet
+per pod-template revision, named {deployment}-{template-hash}; RollingUpdate
+scales the new RS up within maxSurge and old RSs down within
+maxUnavailable, Recreate kills all old replicas before scaling up the new.
+
+Availability feeds from the RS controller's status (readyReplicas), which in
+turn reads pod Ready conditions reported by the node agent."""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from typing import Any
+
+from kubernetes_tpu.api.objects import ReplicaSet
+from kubernetes_tpu.apiserver.store import AlreadyExists, Conflict, NotFound, ObjectStore
+from kubernetes_tpu.client.informer import Informer
+from kubernetes_tpu.controllers.base import ReconcileController
+from kubernetes_tpu.controllers.replicaset import controller_ref, make_controller_ref
+
+HASH_LABEL = "pod-template-hash"  # extensions.DefaultDeploymentUniqueLabelKey
+
+
+def template_hash(template: dict) -> str:
+    """Pod-template revision hash (controller.ComputeHash analog): stable
+    digest of the canonicalized template, excluding the hash label itself."""
+    import copy
+
+    t = copy.deepcopy(template or {})
+    labels = (t.get("metadata") or {}).get("labels")
+    if labels:
+        labels.pop(HASH_LABEL, None)
+    blob = json.dumps(t, sort_keys=True)
+    return hashlib.sha1(blob.encode()).hexdigest()[:10]
+
+
+def parse_intstr(value: Any, total: int, default: str, round_up: bool) -> int:
+    """intstr.GetValueFromIntOrPercent: ints pass through, "25%" scales by
+    `total` (surge rounds up, unavailable rounds down)."""
+    if value is None:
+        value = default
+    if isinstance(value, int):
+        return value
+    s = str(value).strip()
+    if s.endswith("%"):
+        frac = int(s[:-1]) * total
+        return -(-frac // 100) if round_up else frac // 100
+    return int(s)
+
+
+class DeploymentController(ReconcileController):
+    workers = 2
+
+    def __init__(self, store: ObjectStore, deploy_informer: Informer,
+                 rs_informer: Informer):
+        super().__init__()
+        self.name = "deployment-controller"
+        self.store = store
+        self.deployments = deploy_informer
+        self.replicasets = rs_informer
+        deploy_informer.add_handler(self._on_deployment)
+        rs_informer.add_handler(self._on_rs)
+
+    def _on_deployment(self, event) -> None:
+        self.enqueue(event.obj.key)
+
+    def _on_rs(self, event) -> None:
+        ref = controller_ref(event.obj)
+        if ref is not None and ref.get("kind") == "Deployment":
+            self.enqueue(f"{event.obj.metadata.namespace}/{ref.get('name')}")
+
+    # ---- helpers ----
+
+    def _owned_rss(self, deploy) -> list[ReplicaSet]:
+        out = []
+        for rs in self.replicasets.items():
+            if rs.metadata.namespace != deploy.metadata.namespace:
+                continue
+            ref = controller_ref(rs)
+            if ref is not None and ref.get("uid") == deploy.metadata.uid:
+                out.append(rs)
+        return out
+
+    def _new_rs(self, deploy, rss: list[ReplicaSet]) -> ReplicaSet | None:
+        want = template_hash(deploy.spec.get("template") or {})
+        for rs in rss:
+            if rs.metadata.labels.get(HASH_LABEL) == want \
+                    or template_hash(rs.spec.get("template") or {}) == want:
+                return rs
+        return None
+
+    def _create_new_rs(self, deploy, initial_replicas: int) -> ReplicaSet:
+        """getNewReplicaSet's create path (sync.go:271): template + hash
+        label baked into selector, template labels, and RS labels."""
+        import copy
+
+        template = copy.deepcopy(deploy.spec.get("template") or {})
+        h = template_hash(template)
+        tmeta = template.setdefault("metadata", {})
+        tmeta.setdefault("labels", {})
+        tmeta["labels"][HASH_LABEL] = h
+        selector = copy.deepcopy(deploy.spec.get("selector") or {})
+        selector.setdefault("matchLabels", {})[HASH_LABEL] = h
+        rs = ReplicaSet.from_dict({
+            "metadata": {
+                "name": f"{deploy.metadata.name}-{h}",
+                "namespace": deploy.metadata.namespace,
+                "labels": dict(tmeta["labels"]),
+                "ownerReferences": [make_controller_ref(deploy)],
+            },
+            "spec": {"replicas": initial_replicas, "selector": selector,
+                     "template": template},
+        })
+        try:
+            return self.store.create(rs)
+        except AlreadyExists:
+            return self.store.get("ReplicaSet", rs.metadata.name,
+                                  rs.metadata.namespace)
+
+    def _scale_rs(self, rs: ReplicaSet, replicas: int) -> None:
+        if rs.replicas == replicas:
+            return
+        fresh = rs.clone()
+        fresh.spec["replicas"] = replicas
+        try:
+            self.store.update(fresh)
+        except (Conflict, NotFound):
+            self.enqueue_after(
+                f"{rs.metadata.namespace}/{rs.metadata.name}", 0.05)
+
+    # ---- reconcile ----
+
+    async def sync(self, key: str) -> None:
+        ns, name = key.split("/", 1)
+        deploy = self.deployments.get(name, ns)
+        if deploy is None:
+            return
+        rss = self._owned_rss(deploy)
+        new_rs = self._new_rs(deploy, rss)
+        old_rss = [rs for rs in rss if new_rs is None
+                   or rs.metadata.uid != new_rs.metadata.uid]
+        desired = deploy.replicas
+
+        if deploy.strategy_type == "Recreate":
+            # recreate.go: all old replicas down, then the new RS up
+            for rs in old_rss:
+                self._scale_rs(rs, 0)
+            old_active = sum(int((rs.status or {}).get("replicas", 0))
+                             for rs in old_rss)
+            if old_active > 0:
+                self.enqueue_after(key, 0.05)  # wait for teardown
+            else:
+                if new_rs is None:
+                    new_rs = self._create_new_rs(deploy, desired)
+                self._scale_rs(new_rs, desired)
+            self._update_status(deploy, new_rs, old_rss)
+            return
+
+        # RollingUpdate (rolling.go)
+        params = (deploy.spec.get("strategy") or {}).get("rollingUpdate") or {}
+        max_surge = parse_intstr(params.get("maxSurge"), desired, "25%", True)
+        max_unavail = parse_intstr(params.get("maxUnavailable"), desired,
+                                   "25%", False)
+        if max_surge == 0 and max_unavail == 0:
+            max_unavail = 1  # validation forbids both zero; stay live
+        if new_rs is None:
+            new_rs = self._create_new_rs(
+                deploy, desired if not old_rss else 0)
+            rss = rss + [new_rs]
+
+        # scale up new within surge (NewRSNewReplicas, rolling.go:22)
+        total = sum(rs.replicas for rs in rss)
+        headroom = desired + max_surge - total
+        if headroom > 0 and new_rs.replicas < desired:
+            self._scale_rs(new_rs, min(desired, new_rs.replicas + headroom))
+
+        # scale down old within availability budget (rolling.go:57)
+        total_available = sum(int((rs.status or {}).get("availableReplicas", 0))
+                              for rs in rss)
+        min_available = desired - max_unavail
+        budget = total_available - min_available
+        if budget > 0:
+            for rs in sorted(old_rss,
+                             key=lambda r: r.metadata.creation_timestamp):
+                if budget <= 0:
+                    break
+                down = min(rs.replicas, budget)
+                if down > 0:
+                    self._scale_rs(rs, rs.replicas - down)
+                    budget -= down
+        if any(rs.replicas > 0 for rs in old_rss) \
+                or new_rs.replicas < desired:
+            self.enqueue_after(key, 0.05)  # rollout still progressing
+        self._update_status(deploy, new_rs, old_rss)
+
+    def _update_status(self, deploy, new_rs, old_rss) -> None:
+        rss = ([new_rs] if new_rs is not None else []) + list(old_rss)
+        status = {
+            "replicas": sum(int((r.status or {}).get("replicas", 0))
+                            for r in rss),
+            "updatedReplicas": int((new_rs.status or {}).get("replicas", 0))
+            if new_rs is not None else 0,
+            "readyReplicas": sum(int((r.status or {}).get("readyReplicas", 0))
+                                 for r in rss),
+            "availableReplicas": sum(
+                int((r.status or {}).get("availableReplicas", 0))
+                for r in rss),
+        }
+        fresh = self.deployments.get(deploy.metadata.name,
+                                     deploy.metadata.namespace)
+        if fresh is None or fresh.status == status:
+            return
+        fresh = fresh.clone()
+        fresh.status = status
+        try:
+            self.store.update(fresh)
+        except (Conflict, NotFound):
+            pass

@@ -1,0 +1,71 @@
+"""Orphan garbage collection: pods whose controller owner is gone.
+
+The pod-edge subset of the reference's ownerRef garbage collector
+(pkg/controller/garbagecollector: a dependency graph over ownerReferences;
+orphaned dependents are deleted on owner deletion) — here the only
+dependents are pods and the owners are the workload kinds, so a keyed
+reconcile over pods suffices; the graph degenerates to one lookup."""
+
+from __future__ import annotations
+
+from kubernetes_tpu.apiserver.store import NotFound, ObjectStore
+from kubernetes_tpu.client.informer import Informer
+from kubernetes_tpu.controllers.base import ReconcileController
+from kubernetes_tpu.controllers.replicaset import controller_ref
+
+OWNER_KINDS = ("ReplicaSet", "ReplicationController", "StatefulSet",
+               "Deployment", "Job")
+
+
+class GarbageCollector(ReconcileController):
+    workers = 2
+
+    def __init__(self, store: ObjectStore, pod_informer: Informer,
+                 owner_informers: dict[str, Informer]):
+        super().__init__()
+        self.name = "garbage-collector"
+        self.store = store
+        self.pods = pod_informer
+        self.owners = owner_informers
+        pod_informer.add_handler(self._on_pod)
+        for informer in owner_informers.values():
+            informer.add_handler(self._on_owner)
+
+    def _on_pod(self, event) -> None:
+        if event.type == "DELETED":
+            return
+        pod = event.obj
+        if controller_ref(pod) is not None:
+            self.enqueue(pod.key)
+
+    def _on_owner(self, event) -> None:
+        # an owner deletion orphans its pods: re-check every owned pod
+        if event.type != "DELETED":
+            return
+        owner = event.obj
+        for pod in self.pods.items():
+            ref = controller_ref(pod)
+            if (ref is not None and ref.get("uid") == owner.metadata.uid
+                    and pod.metadata.namespace == owner.metadata.namespace):
+                self.enqueue(pod.key)
+
+    def _owner_exists(self, namespace: str, ref: dict) -> bool:
+        kind = ref.get("kind", "")
+        informer = self.owners.get(kind)
+        if informer is None:
+            return True  # unmanaged kind: never collect
+        owner = informer.get(ref.get("name", ""), namespace)
+        return owner is not None and owner.metadata.uid == ref.get("uid")
+
+    async def sync(self, key: str) -> None:
+        ns, name = key.split("/", 1)
+        pod = self.pods.get(name, ns)
+        if pod is None:
+            return
+        ref = controller_ref(pod)
+        if ref is None or self._owner_exists(ns, ref):
+            return
+        try:
+            self.store.delete("Pod", name, ns)
+        except NotFound:
+            pass

@@ -1,0 +1,59 @@
+"""Controller manager: shared informers + the controller set.
+
+The kube-controller-manager analog (cmd/kube-controller-manager/app/
+controllermanager.go:315-339 registers the loops against one shared
+informer factory). start() syncs informers once, then every controller's
+workers run against the shared caches."""
+
+from __future__ import annotations
+
+from kubernetes_tpu.apiserver.store import ObjectStore
+from kubernetes_tpu.client.informer import Informer
+from kubernetes_tpu.controllers.deployment import DeploymentController
+from kubernetes_tpu.controllers.gc import GarbageCollector
+from kubernetes_tpu.controllers.replicaset import ReplicaManager
+
+
+class ControllerManager:
+    def __init__(self, store: ObjectStore, enable_gc: bool = True):
+        self.store = store
+        self.informers: dict[str, Informer] = {
+            kind: Informer(store, kind)
+            for kind in ("Pod", "ReplicaSet", "ReplicationController",
+                         "StatefulSet", "Deployment")}
+        pods = self.informers["Pod"]
+        self.replicaset = ReplicaManager(
+            store, "ReplicaSet", self.informers["ReplicaSet"], pods)
+        self.replication = ReplicaManager(
+            store, "ReplicationController",
+            self.informers["ReplicationController"], pods)
+        self.deployment = DeploymentController(
+            store, self.informers["Deployment"], self.informers["ReplicaSet"])
+        self.controllers = [self.replicaset, self.replication,
+                            self.deployment]
+        if enable_gc:
+            self.gc = GarbageCollector(
+                store, pods,
+                {k: v for k, v in self.informers.items() if k != "Pod"})
+            self.controllers.append(self.gc)
+
+    async def start(self) -> None:
+        for informer in self.informers.values():
+            informer.start()
+        for informer in self.informers.values():
+            await informer.wait_for_sync()
+        for controller in self.controllers:
+            await controller.start()
+        # reconcile pre-existing objects that predate the watch
+        for obj in self.informers["ReplicaSet"].items():
+            self.replicaset.enqueue(obj.key)
+        for obj in self.informers["ReplicationController"].items():
+            self.replication.enqueue(obj.key)
+        for obj in self.informers["Deployment"].items():
+            self.deployment.enqueue(obj.key)
+
+    def stop(self) -> None:
+        for controller in self.controllers:
+            controller.stop()
+        for informer in self.informers.values():
+            informer.stop()

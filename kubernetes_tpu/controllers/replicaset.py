@@ -1,0 +1,265 @@
+"""ReplicaSet / ReplicationController reconcile loops.
+
+Mirrors pkg/controller/replicaset/replica_set.go (queue wiring :112, event
+handlers :147-266, worker :405, syncReplicaSet :543, manageReplicas :459)
+and pkg/controller/replication (same logic over v1.RC with a map selector).
+One generic manager covers both kinds — the reference keeps two copies only
+because Go lacks the generic.
+
+Semantics kept:
+- expectations gate the sync (no double-creates while writes are in the
+  watch pipe), slow-start create bursts, burstReplicas clamp (:66, 500);
+- pod adoption/release by selector + controllerRef (ClaimPods,
+  controller_utils.go:1000: adopt selector-matching orphans, release owned
+  pods that stopped matching);
+- deletion victims ranked by ActivePods order (controller_utils.go:695):
+  unassigned first, then Pending < Unknown < Running, then not-ready,
+  then youngest.
+"""
+
+from __future__ import annotations
+
+import uuid
+from typing import Any
+
+from kubernetes_tpu.api.objects import Pod
+from kubernetes_tpu.apiserver.store import Conflict, NotFound, ObjectStore
+from kubernetes_tpu.client.informer import Informer
+from kubernetes_tpu.controllers.base import ReconcileController, slow_start_batch
+from kubernetes_tpu.state.podaffinity import (
+    PARSE_ERROR,
+    canonical_selector,
+    map_selector,
+    selector_matches,
+)
+
+BURST_REPLICAS = 500  # replica_set.go:66
+_PHASE_RANK = {"Pending": 0, "Unknown": 1, "Running": 2}
+
+
+def workload_selector_canon(obj) -> Any:
+    """Canonical selector for a workload object: RC uses a map selector,
+    RS/StatefulSet/Deployment a LabelSelector."""
+    if obj.kind == "ReplicationController":
+        return map_selector(obj.selector or {})
+    return canonical_selector(obj.selector or None)
+
+
+def controller_ref(pod: Pod) -> dict | None:
+    """The pod's controller ownerRef (metav1.GetControllerOf)."""
+    for ref in pod.metadata.owner_references:
+        if ref.get("controller"):
+            return ref
+    return None
+
+
+def make_controller_ref(obj) -> dict:
+    return {"apiVersion": obj.api_version, "kind": obj.kind,
+            "name": obj.metadata.name, "uid": obj.metadata.uid,
+            "controller": True, "blockOwnerDeletion": True}
+
+
+def is_active(pod: Pod) -> bool:
+    """controller.FilterActivePods (controller_utils.go:700): terminal or
+    terminating pods don't count toward replicas."""
+    return (pod.status.phase not in ("Succeeded", "Failed")
+            and pod.metadata.deletion_timestamp is None)
+
+
+def pod_ready(pod: Pod) -> bool:
+    return any(c.get("type") == "Ready" and c.get("status") == "True"
+               for c in pod.status.conditions)
+
+
+def deletion_order_key(pod: Pod):
+    """ActivePods Less (controller_utils.go:695) — pods sorting FIRST are
+    deleted first."""
+    return (
+        0 if not pod.spec.node_name else 1,
+        _PHASE_RANK.get(pod.status.phase, 1),
+        1 if pod_ready(pod) else 0,
+        -pod.metadata.creation_timestamp,  # youngest first
+    )
+
+
+def pod_from_template(owner, template: dict) -> Pod:
+    """GetPodFromTemplate (controller_utils.go:500): template + generated
+    name + controller ownerRef."""
+    import copy
+
+    d = copy.deepcopy(template or {})
+    meta = d.setdefault("metadata", {})
+    meta["name"] = f"{owner.metadata.name}-{uuid.uuid4().hex[:5]}"
+    meta["namespace"] = owner.metadata.namespace
+    meta.pop("uid", None)
+    meta.setdefault("labels", {})
+    refs = [r for r in meta.get("ownerReferences", [])
+            if not r.get("controller")]
+    refs.append(make_controller_ref(owner))
+    meta["ownerReferences"] = refs
+    return Pod.from_dict(d)
+
+
+class ReplicaManager(ReconcileController):
+    """Shared RS/RC reconcile loop; `kind` picks the workload bucket."""
+
+    workers = 4
+
+    def __init__(self, store: ObjectStore, kind: str,
+                 workload_informer: Informer, pod_informer: Informer):
+        super().__init__()
+        self.name = f"{kind.lower()}-controller"
+        self.store = store
+        self.kind = kind
+        self.workloads = workload_informer
+        self.pods = pod_informer
+        workload_informer.add_handler(self._on_workload)
+        pod_informer.add_handler(self._on_pod)
+
+    # ---- informer handlers (replica_set.go:147-266) ----
+
+    def _on_workload(self, event) -> None:
+        obj = event.obj
+        if obj.kind != self.kind:
+            return
+        if event.type == "DELETED":
+            self.expectations.forget(obj.key)
+        self.enqueue(obj.key)
+
+    def _key_for(self, pod: Pod) -> str | None:
+        ref = controller_ref(pod)
+        if ref is not None:
+            if ref.get("kind") != self.kind:
+                return None
+            return f"{pod.metadata.namespace}/{ref.get('name')}"
+        # orphan: every selector-matching workload may want to adopt it
+        for w in self.workloads.items():
+            if w.metadata.namespace != pod.metadata.namespace:
+                continue
+            canon = workload_selector_canon(w)
+            if canon not in ((), PARSE_ERROR) \
+                    and selector_matches(canon, pod.metadata.labels):
+                return w.key
+        return None
+
+    def _on_pod(self, event) -> None:
+        pod: Pod = event.obj
+        key = self._key_for(pod)
+        if key is None:
+            return
+        if event.type == "ADDED":
+            self.expectations.creation_observed(key)
+        elif event.type == "DELETED":
+            self.expectations.deletion_observed(key)
+        self.enqueue(key)
+
+    # ---- reconcile (syncReplicaSet, replica_set.go:543) ----
+
+    def _claim_pods(self, rs) -> list[Pod]:
+        """ClaimPods (controller_utils.go:1000): owned+matching stay; owned
+        non-matching are released; matching orphans are adopted."""
+        canon = workload_selector_canon(rs)
+        if canon in ((), PARSE_ERROR):
+            return []  # invalid/empty selector matches nothing for claims
+        ns = rs.metadata.namespace
+        claimed = []
+        for pod in self.pods.items():
+            if pod.metadata.namespace != ns or not is_active(pod):
+                continue
+            ref = controller_ref(pod)
+            owned = (ref is not None and ref.get("uid") == rs.metadata.uid)
+            matches = selector_matches(canon, pod.metadata.labels)
+            if owned and matches:
+                claimed.append(pod)
+            elif owned and not matches:
+                self._release(pod)
+            elif matches and ref is None:
+                adopted = self._adopt(rs, pod)
+                if adopted is not None:
+                    claimed.append(adopted)
+        return claimed
+
+    def _adopt(self, rs, pod: Pod) -> Pod | None:
+        fresh = pod.clone()
+        fresh.metadata.owner_references.append(make_controller_ref(rs))
+        try:
+            return self.store.update(fresh)
+        except (Conflict, NotFound):
+            return None  # raced; next sync retries
+
+    def _release(self, pod: Pod) -> None:
+        fresh = pod.clone()
+        fresh.metadata.owner_references = [
+            r for r in fresh.metadata.owner_references
+            if not r.get("controller")]
+        try:
+            self.store.update(fresh)
+        except (Conflict, NotFound):
+            pass
+
+    async def sync(self, key: str) -> None:
+        ns, name = key.split("/", 1)
+        rs = self.workloads.get(name, ns)
+        if rs is None:
+            self.expectations.forget(key)
+            return
+        if not self.expectations.satisfied(key):
+            return  # our own writes are still in the watch pipe
+        pods = self._claim_pods(rs)
+        await self._manage(rs, key, pods)
+        self._update_status(rs, pods)
+
+    async def _manage(self, rs, key: str, pods: list[Pod]) -> None:
+        """manageReplicas (replica_set.go:459)."""
+        diff = len(pods) - rs.replicas
+        if diff < 0:
+            want = min(-diff, BURST_REPLICAS)
+            self.expectations.expect(key, adds=want)
+            template = rs.spec.get("template") or {}
+
+            async def create_one() -> bool:
+                pod = pod_from_template(rs, template)
+                if self.kind != "ReplicationController" and not \
+                        pod.metadata.labels:
+                    pod.metadata.labels = dict(
+                        (rs.spec.get("selector") or {}).get("matchLabels")
+                        or {})
+                try:
+                    self.store.create(pod)
+                    return True
+                except Exception:  # noqa: BLE001
+                    self.expectations.creation_observed(key)  # lower burden
+                    return False
+
+            await slow_start_batch(want, create_one)
+        elif diff > 0:
+            want = min(diff, BURST_REPLICAS)
+            victims = sorted(pods, key=deletion_order_key)[:want]
+            self.expectations.expect(key, dels=want)
+            for pod in victims:
+                try:
+                    self.store.delete("Pod", pod.metadata.name,
+                                      pod.metadata.namespace)
+                except NotFound:
+                    self.expectations.deletion_observed(key)
+
+    def _update_status(self, rs, pods: list[Pod]) -> None:
+        """calculateStatus subset (replica_set_utils.go): observed replica
+        counts on the workload object."""
+        fresh = self.workloads.get(rs.metadata.name, rs.metadata.namespace)
+        if fresh is None:
+            return
+        status = {
+            "replicas": len(pods),
+            "readyReplicas": sum(1 for p in pods if pod_ready(p)),
+            "availableReplicas": sum(1 for p in pods if pod_ready(p)),
+            "fullyLabeledReplicas": len(pods),
+        }
+        if fresh.status == status:
+            return
+        fresh = fresh.clone()
+        fresh.status = status
+        try:
+            self.store.update(fresh)
+        except (Conflict, NotFound):
+            pass

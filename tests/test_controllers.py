@@ -1,0 +1,264 @@
+"""L4 controller plane: ReplicaSet/RC reconcile, Deployment rollouts, orphan
+GC — semantics per pkg/controller/replicaset/replica_set.go:543 and
+pkg/controller/deployment, driven end-to-end through store watch events."""
+
+import asyncio
+
+import pytest
+
+from kubernetes_tpu.api.objects import Deployment, Pod, ReplicaSet
+from kubernetes_tpu.apiserver import ObjectStore
+from kubernetes_tpu.controllers import ControllerManager
+from kubernetes_tpu.controllers.deployment import HASH_LABEL
+from kubernetes_tpu.controllers.replicaset import controller_ref
+
+
+def rs_obj(name="web", replicas=3, labels=None, ns="default"):
+    labels = labels or {"app": name}
+    return ReplicaSet.from_dict({
+        "metadata": {"name": name, "namespace": ns},
+        "spec": {
+            "replicas": replicas,
+            "selector": {"matchLabels": labels},
+            "template": {
+                "metadata": {"labels": dict(labels)},
+                "spec": {"containers": [{"name": "c", "resources": {
+                    "requests": {"cpu": "100m"}}}]},
+            },
+        },
+    })
+
+
+def deploy_obj(name="site", replicas=4, image="img:v1", strategy=None):
+    d = {
+        "metadata": {"name": name, "namespace": "default"},
+        "spec": {
+            "replicas": replicas,
+            "selector": {"matchLabels": {"app": name}},
+            "template": {
+                "metadata": {"labels": {"app": name}},
+                "spec": {"containers": [{"name": "c", "image": image}]},
+            },
+        },
+    }
+    if strategy:
+        d["spec"]["strategy"] = strategy
+    return Deployment.from_dict(d)
+
+
+async def until(cond, timeout=5.0, msg="condition"):
+    async with asyncio.timeout(timeout):
+        while not cond():
+            await asyncio.sleep(0.01)
+
+
+def active_pods(store, ns="default"):
+    return [p for p in store.list("Pod", ns)
+            if p.status.phase not in ("Succeeded", "Failed")]
+
+
+def mark_ready(store, pod):
+    fresh = store.get("Pod", pod.metadata.name, pod.metadata.namespace)
+    fresh.status.phase = "Running"
+    fresh.status.conditions = [{"type": "Ready", "status": "True"}]
+    store.update(fresh, check_version=False)
+
+
+def test_replicaset_scale_up_down_and_gc():
+    async def run():
+        store = ObjectStore()
+        mgr = ControllerManager(store)
+        await mgr.start()
+
+        store.create(rs_obj("web", replicas=3))
+        await until(lambda: len(active_pods(store)) == 3)
+        pods = active_pods(store)
+        assert all(controller_ref(p) and controller_ref(p)["name"] == "web"
+                   for p in pods)
+        # steady state: no over-creation while events settle
+        await asyncio.sleep(0.3)
+        assert len(active_pods(store)) == 3
+
+        # scale up
+        rs = store.get("ReplicaSet", "web")
+        rs.spec["replicas"] = 5
+        store.update(rs, check_version=False)
+        await until(lambda: len(active_pods(store)) == 5)
+
+        # scale down: 2 victims chosen, youngest/unassigned first
+        rs = store.get("ReplicaSet", "web")
+        rs.spec["replicas"] = 2
+        store.update(rs, check_version=False)
+        await until(lambda: len(active_pods(store)) == 2)
+        await asyncio.sleep(0.2)
+        assert len(active_pods(store)) == 2
+
+        # RS status mirrors observed replicas
+        await until(lambda: (store.get("ReplicaSet", "web").status or {})
+                    .get("replicas") == 2)
+
+        # delete the RS: the GC collects its orphaned pods
+        store.delete("ReplicaSet", "web")
+        await until(lambda: len(active_pods(store)) == 0)
+        mgr.stop()
+
+    asyncio.run(run())
+
+
+def test_replicaset_adopts_matching_orphan():
+    async def run():
+        store = ObjectStore()
+        mgr = ControllerManager(store)
+        await mgr.start()
+        orphan = Pod.from_dict({
+            "metadata": {"name": "stray", "namespace": "default",
+                         "labels": {"app": "web"}},
+            "spec": {"containers": [{"name": "c"}]}})
+        store.create(orphan)
+        store.create(rs_obj("web", replicas=2))
+        await until(lambda: len(active_pods(store)) == 2)
+        stray = store.get("Pod", "stray")
+        ref = controller_ref(stray)
+        assert ref is not None and ref["name"] == "web"  # adopted + counted
+        mgr.stop()
+
+    asyncio.run(run())
+
+
+def test_replicaset_releases_relabelled_pod():
+    async def run():
+        store = ObjectStore()
+        mgr = ControllerManager(store)
+        await mgr.start()
+        store.create(rs_obj("web", replicas=1))
+        await until(lambda: len(active_pods(store)) == 1)
+        pod = active_pods(store)[0]
+        pod.metadata.labels = {"app": "other"}
+        store.update(pod, check_version=False)
+        # released (ownerRef dropped) and replaced by a matching pod
+        await until(lambda: sum(
+            1 for p in active_pods(store)
+            if p.metadata.labels.get("app") == "web") == 1)
+        released = store.get("Pod", pod.metadata.name)
+        assert controller_ref(released) is None
+        mgr.stop()
+
+    asyncio.run(run())
+
+
+def test_replication_controller_map_selector():
+    async def run():
+        from kubernetes_tpu.api.objects import ReplicationController
+
+        store = ObjectStore()
+        mgr = ControllerManager(store)
+        await mgr.start()
+        store.create(ReplicationController.from_dict({
+            "metadata": {"name": "old", "namespace": "default"},
+            "spec": {"replicas": 2, "selector": {"app": "old"},
+                     "template": {"metadata": {"labels": {"app": "old"}},
+                                  "spec": {"containers": [{"name": "c"}]}}},
+        }))
+        await until(lambda: len(active_pods(store)) == 2)
+        mgr.stop()
+
+    asyncio.run(run())
+
+
+def test_deployment_rolling_update():
+    async def run():
+        store = ObjectStore()
+        mgr = ControllerManager(store)
+        await mgr.start()
+        store.create(deploy_obj("site", replicas=4, image="img:v1"))
+        await until(lambda: len(active_pods(store)) == 4)
+        rss = store.list("ReplicaSet")
+        assert len(rss) == 1 and HASH_LABEL in rss[0].metadata.labels
+        v1_hash = rss[0].metadata.labels[HASH_LABEL]
+        for p in active_pods(store):
+            mark_ready(store, p)
+        await until(lambda: (store.get("Deployment", "site").status or {})
+                    .get("availableReplicas") == 4)
+
+        # new template -> second RS; rolling keeps availability within
+        # maxUnavailable while shifting replicas to the new revision
+        d = store.get("Deployment", "site")
+        d.spec["template"]["spec"]["containers"][0]["image"] = "img:v2"
+        store.update(d, check_version=False)
+
+        async def rollout_done():
+            while True:
+                rss = {rs.metadata.labels.get(HASH_LABEL): rs
+                       for rs in store.list("ReplicaSet")}
+                new = [rs for h, rs in rss.items() if h != v1_hash]
+                if new and new[0].replicas == 4 \
+                        and rss.get(v1_hash) is not None \
+                        and rss[v1_hash].replicas == 0:
+                    return
+                # simulate kubelet: new pods become ready
+                for p in active_pods(store):
+                    if p.status.phase != "Running":
+                        mark_ready(store, p)
+                await asyncio.sleep(0.02)
+
+        async with asyncio.timeout(10.0):
+            await rollout_done()
+        # all pods are v2 eventually
+        await until(lambda: all(
+            p.spec.containers[0].image == "img:v2"
+            for p in active_pods(store)) and len(active_pods(store)) == 4,
+            timeout=10.0)
+        mgr.stop()
+
+    asyncio.run(run())
+
+
+def test_deployment_recreate():
+    async def run():
+        store = ObjectStore()
+        mgr = ControllerManager(store)
+        await mgr.start()
+        store.create(deploy_obj("site", replicas=3, image="img:v1",
+                                strategy={"type": "Recreate"}))
+        await until(lambda: len(active_pods(store)) == 3)
+        d = store.get("Deployment", "site")
+        d.spec["template"]["spec"]["containers"][0]["image"] = "img:v2"
+        store.update(d, check_version=False)
+        # every old pod terminates before any new pod appears, then 3 x v2
+        await until(lambda: len(active_pods(store)) == 3 and all(
+            p.spec.containers[0].image == "img:v2"
+            for p in active_pods(store)), timeout=10.0)
+        mgr.stop()
+
+    asyncio.run(run())
+
+
+def test_rs_pods_flow_through_scheduler():
+    """VERDICT r1 'done' criterion: RS replicas=N -> N pods appear and get
+    scheduled; scale down -> pods deleted — all through watch events."""
+    async def run():
+        from kubernetes_tpu.perf.fixtures import make_nodes
+        from kubernetes_tpu.scheduler import Scheduler
+        from kubernetes_tpu.state import Capacities
+
+        store = ObjectStore()
+        for node in make_nodes(4):
+            store.create(node)
+        sched = Scheduler(store, caps=Capacities(num_nodes=8, batch_pods=8))
+        await sched.start()
+        mgr = ControllerManager(store)
+        await mgr.start()
+
+        store.create(rs_obj("web", replicas=6))
+        bound = lambda: [p for p in active_pods(store) if p.spec.node_name]
+        async with asyncio.timeout(30.0):
+            while len(bound()) < 6:
+                await sched.schedule_pending(wait=0.1)
+        rs = store.get("ReplicaSet", "web")
+        rs.spec["replicas"] = 2
+        store.update(rs, check_version=False)
+        await until(lambda: len(active_pods(store)) == 2, timeout=10.0)
+        mgr.stop()
+        sched.stop()
+
+    asyncio.run(run())
