@@ -4,8 +4,9 @@ run end-to-end through the full framework (in-memory apiserver -> informers
 -> encode -> batched device solve -> bind -> watch confirmation).
 
 Configs (BASELINE.json):
-- headline: NodeResourcesFit/LeastAllocated shape, 5k nodes / 10k pods
-- interpod: InterPodAffinity-heavy, 5k nodes / 2k pods (required hostname
+- headline: NodeResourcesFit/LeastAllocated shape, 15k nodes / 30k pods
+  (the north-star scale; BENCH_NODES/BENCH_PODS override)
+- interpod: InterPodAffinity-heavy, 5k nodes (required hostname
   anti-affinity + preferred zone affinity over app groups)
 - spread:   SelectorSpread (PodTopologySpread analog), 3 zones,
   15k nodes / 30k pods with services selecting the app groups
@@ -27,10 +28,10 @@ import signal
 import sys
 
 RESULT: dict = {
-    "metric": "pods_scheduled_per_sec_5k_nodes",
-    "value": 0.0,
+    "metric": "pods_scheduled_per_sec_15k_nodes",
+    "value": None,
     "unit": "pods/s",
-    "vs_baseline": 0.0,
+    "vs_baseline": None,
 }
 
 
@@ -46,8 +47,8 @@ def main() -> None:
     signal.signal(signal.SIGALRM, _die_with_timeout)
     signal.alarm(timeout)
 
-    n_nodes = int(os.environ.get("BENCH_NODES", "5000"))
-    n_pods = int(os.environ.get("BENCH_PODS", "10000"))
+    n_nodes = int(os.environ.get("BENCH_NODES", "15000"))
+    n_pods = int(os.environ.get("BENCH_PODS", "30000"))
     configs = os.environ.get("BENCH_CONFIGS", "headline,interpod,spread")
     configs = [c.strip() for c in configs.split(",") if c.strip()]
 
@@ -65,18 +66,16 @@ def main() -> None:
         r = run_throughput(n_nodes, n_pods, node_kwargs={"zones": 3})
         print(f"bench[headline]: {r} | {r.metrics}", file=sys.stderr,
               flush=True)
+        RESULT["metric"] = f"pods_scheduled_per_sec_{n_nodes // 1000}k_nodes"
         RESULT["value"] = round(r.pods_per_sec, 1)
         RESULT["vs_baseline"] = round(r.pods_per_sec / baseline, 2)
         extras["headline_e2e_p50_ms"] = round(r.metrics["e2e_p50_ms"], 1)
         extras["headline_e2e_p99_ms"] = round(r.metrics["e2e_p99_ms"], 1)
 
     if "interpod" in configs:
-        from kubernetes_tpu.state import Capacities
-
+        interpod_nodes = min(n_nodes, 5000)
         r = run_throughput(
-            n_nodes, min(n_pods, 4096),
-            caps=Capacities(num_nodes=1 << max(6, (n_nodes - 1).bit_length()),
-                            batch_pods=1024),
+            interpod_nodes, 8192,
             node_kwargs={"zones": 3},
             pod_kwargs={"app_groups": 8, "anti_affinity_every": 16,
                         "pref_affinity_every": 2})
@@ -97,6 +96,15 @@ def main() -> None:
         extras["spread_vs_baseline"] = round(r.pods_per_sec / baseline, 2)
         extras["spread_e2e_p50_ms"] = round(r.metrics["e2e_p50_ms"], 1)
 
+    if RESULT["value"] is None and extras:
+        # headline config not selected: promote the first metric actually
+        # run so a filtered invocation is distinguishable from a failed one
+        for key in ("interpod_5k_pods_per_sec", "spread_15k_pods_per_sec"):
+            if key in extras:
+                RESULT["metric"] = key
+                RESULT["value"] = extras[key]
+                RESULT["vs_baseline"] = round(extras[key] / baseline, 2)
+                break
     RESULT["extras"] = extras
     print(json.dumps(RESULT), flush=True)
 

@@ -75,9 +75,100 @@ class BatchFlags:
     svcanti: bool = True  # any svcanti_q entry
     vol: bool = True      # any disk-conflict atom wanted
     attach: bool = True   # any attachable-volume atom (or resolve failure)
+    tt: bool = True       # any PreferNoSchedule taint interned (TaintToleration
+                          # counts can be nonzero) — else uniform MaxPriority
+    na: bool = True       # any preferred node-affinity term in batch
 
 
 ALL_ACTIVE = BatchFlags()
+
+
+@dataclass(frozen=True)
+class PolicyGates:
+    """The compile-time kernel gates for one (policy, flags) pair — the
+    single derivation consumed both by `schedule_batch` (what the compiled
+    program tracks) and by `ledger_coverage` (what the driver may chain
+    device-side at commit time). Weights are post-gating: a flag-neutralized
+    kernel has weight 0 here and its constant contribution in const_score."""
+
+    use_resources: bool
+    use_ports: bool
+    w_lr: float
+    w_mr: float
+    w_ba: float
+    w_tt: float
+    w_na: float
+    w_ip: float
+    w_ss: float
+    w_ssp: float
+    svcanti: tuple
+    use_ipa: bool
+    use_svcanti: bool
+    use_terms: bool
+    use_ip_ledger: bool
+    use_nodisk: bool
+    attach_maxes: tuple
+    const_score: float
+
+
+def policy_gates(policy: Policy, flags: BatchFlags) -> PolicyGates:
+    use_ipa = policy.has_predicate("MatchInterPodAffinity") and flags.ipa
+    w_ss = policy.weight("SelectorSpreadPriority")
+    w_ssp = policy.weight("ServiceSpreadingPriority")
+    w_tt = policy.weight("TaintTolerationPriority")
+    w_na = policy.weight("NodeAffinityPriority")
+    svcanti = active_service_anti(policy)
+    # flag-gated neutral terms: with every spread_q == -1, SelectorSpread
+    # scores a uniform MaxPriority (selector_spreading.go:157) — a constant
+    # shift that cannot change argmax but must stay in the reported score
+    const_score = 0.0
+    if w_ss and not flags.spread:
+        const_score += w_ss * float(MAX_PRIORITY)
+        w_ss = 0
+    if w_ssp and not flags.spread:
+        const_score += w_ssp * float(MAX_PRIORITY)
+        w_ssp = 0
+    # no PreferNoSchedule taint interned: every count is 0, the reduce
+    # yields uniform MaxPriority (taint_toleration.go:90 maxCount==0 path)
+    if w_tt and not flags.tt:
+        const_score += w_tt * float(MAX_PRIORITY)
+        w_tt = 0
+    # no preferred node-affinity term in the batch: counts are all 0 and the
+    # NormalizeReduce maxCount==0 path scores every node 0 — drop the kernel
+    if w_na and not flags.na:
+        w_na = 0
+    w_ip = policy.weight("InterPodAffinityPriority") if flags.ipa else 0
+    use_svcanti = bool(svcanti) and flags.svcanti
+    use_terms = use_ipa or bool(w_ip)   # carried-term ledger structures
+    return PolicyGates(
+        use_resources=policy.has_predicate("GeneralPredicates",
+                                           "PodFitsResources"),
+        use_ports=policy.has_predicate("GeneralPredicates",
+                                       "PodFitsHostPorts", "PodFitsPorts"),
+        w_lr=policy.weight("LeastRequestedPriority"),
+        w_mr=policy.weight("MostRequestedPriority"),
+        w_ba=policy.weight("BalancedResourceAllocation"),
+        w_tt=w_tt, w_na=w_na, w_ip=w_ip, w_ss=w_ss, w_ssp=w_ssp,
+        svcanti=svcanti,
+        use_ipa=use_ipa,
+        use_svcanti=use_svcanti,
+        use_terms=use_terms,
+        use_ip_ledger=(use_terms or bool(w_ss) or bool(w_ssp) or use_svcanti),
+        use_nodisk=policy.has_predicate("NoDiskConflict") and flags.vol,
+        attach_maxes=policy.attach_maxes() if flags.attach else (),
+        const_score=const_score,
+    )
+
+
+def ledger_coverage(policy: Policy, flags: BatchFlags) -> tuple[bool, bool, bool]:
+    """(ipa, vol, attach): which state-ledger groups a program compiled with
+    this (policy, flags) pair actually tracks through its scan carry —
+    derived from the same PolicyGates the program itself compiles with. The
+    driver uses this at commit time: a pod whose accounting rows touch an
+    *untracked* group must dirty the host mirror so the next flush re-uploads
+    truth the device pass-through ledger does not contain."""
+    g = policy_gates(policy, flags)
+    return g.use_ip_ledger, bool(g.use_nodisk), bool(g.attach_maxes)
 
 
 def batch_flags(batch: PodBatch, n_pods: int, table) -> BatchFlags:
@@ -96,7 +187,16 @@ def batch_flags(batch: PodBatch, n_pods: int, table) -> BatchFlags:
         svcanti=any_(batch.svcanti_q >= 0),
         vol=any_(batch.vol_want_rw) or any_(batch.vol_want_ro),
         attach=any_(batch.att_onehot) or any_(batch.att_fail),
+        tt=table_has_prefer_taints(table),
+        na=any_(batch.pref_weight > 0),
     )
+
+
+def table_has_prefer_taints(table) -> bool:
+    """True when any interned taint can produce a nonzero TaintToleration
+    count (the map input is taint_prefer_member, populated only by
+    PreferNoSchedule taints)."""
+    return any(effect == "PreferNoSchedule" for _k, _v, effect in table.taints)
 
 
 @struct.dataclass
@@ -108,6 +208,14 @@ class SolverResult:
     new_nonzero: jnp.ndarray       # f32[N, 2]
     new_port_count: jnp.ndarray    # f32[N, UP]
     rr_end: jnp.ndarray        # u32 round-robin counter after the batch
+    # full post-batch state ledger: kernels the batch could not touch pass
+    # the input arrays through unchanged (an alias, no device copy), so the
+    # driver can chain EVERY batch device-to-device with no host re-upload
+    new_podsel: jnp.ndarray    # f32[N, UQ]
+    new_term: jnp.ndarray      # f32[N, UE]
+    new_vol_any: jnp.ndarray   # f32[N, UV]
+    new_vol_rw: jnp.ndarray    # f32[N, UV]
+    new_attach: jnp.ndarray    # f32[N, UA]
 
 
 @struct.dataclass
@@ -213,40 +321,19 @@ def schedule_batch(
     state = jax.tree.map(jnp.asarray, state)
     batch = jax.tree.map(jnp.asarray, batch)
 
-    use_resources = policy.has_predicate("GeneralPredicates", "PodFitsResources")
-    use_ports = policy.has_predicate("GeneralPredicates", "PodFitsHostPorts",
-                                     "PodFitsPorts")
-    w_lr = policy.weight("LeastRequestedPriority")
-    w_mr = policy.weight("MostRequestedPriority")
-    w_ba = policy.weight("BalancedResourceAllocation")
-    w_tt = policy.weight("TaintTolerationPriority")
-    w_na = policy.weight("NodeAffinityPriority")
-    w_ip = policy.weight("InterPodAffinityPriority") if flags.ipa else 0
-    w_ss = policy.weight("SelectorSpreadPriority")
-    w_ssp = policy.weight("ServiceSpreadingPriority")
-    svcanti = active_service_anti(policy)
+    g = policy_gates(policy, flags)
+    use_resources, use_ports = g.use_resources, g.use_ports
+    w_lr, w_mr, w_ba, w_tt, w_na = g.w_lr, g.w_mr, g.w_ba, g.w_tt, g.w_na
+    w_ip, w_ss, w_ssp, svcanti = g.w_ip, g.w_ss, g.w_ssp, g.svcanti
+    use_ipa, use_svcanti, use_terms = g.use_ipa, g.use_svcanti, g.use_terms
+    use_ip_ledger, use_nodisk = g.use_ip_ledger, g.use_nodisk
+    attach_maxes, const_score = g.attach_maxes, g.const_score
     if prows is None and (svcanti or active_label_presence(policy)
                           or active_label_priorities(policy)):
         raise ValueError(
             "policy carries argument registrations (labelsPresence / "
             "labelPreference / serviceAntiAffinity) but no PolicyRows were "
             "given — build them with models.policy.build_policy_rows")
-    use_ipa = policy.has_predicate("MatchInterPodAffinity") and flags.ipa
-    # flag-gated neutral terms: with every spread_q == -1, SelectorSpread
-    # scores a uniform MaxPriority (selector_spreading.go:157) — a constant
-    # shift that cannot change argmax but must stay in the reported score
-    const_score = 0.0
-    if w_ss and not flags.spread:
-        const_score += w_ss * float(MAX_PRIORITY)
-        w_ss = 0
-    if w_ssp and not flags.spread:
-        const_score += w_ssp * float(MAX_PRIORITY)
-        w_ssp = 0
-    use_svcanti = bool(svcanti) and flags.svcanti
-    use_terms = use_ipa or bool(w_ip)   # carried-term ledger structures
-    use_ip_ledger = (use_terms or bool(w_ss) or bool(w_ssp) or use_svcanti)
-    use_nodisk = policy.has_predicate("NoDiskConflict") and flags.vol
-    attach_maxes = policy.attach_maxes() if flags.attach else ()
     hard_w = float(policy.hard_pod_affinity_weight)
     domain_universe = caps.domain_universe if caps else DEFAULT_DOMAIN_UNIVERSE
 
@@ -283,16 +370,19 @@ def schedule_batch(
         lambda p: _static_mask(state, p, policy, base_mask))(batch)
     static_score = jax.vmap(
         lambda p: _static_score(state, p, policy, base_score))(batch)
+    p_pods = static_mask.shape[0]
     if w_tt:
         prefer_counts = jax.vmap(
             lambda p: preds.count_untolerated_prefer_taints(state, p))(batch)
     else:
-        prefer_counts = jnp.zeros(static_mask.shape, jnp.int32)
+        # unused by the step when the weight is zero: a (P, 1) placeholder
+        # keeps the scan xs tiny instead of carrying a dead (P, N) array
+        prefer_counts = jnp.zeros((p_pods, 1), jnp.int32)
     if w_na:
         na_counts = jax.vmap(
             lambda p: prios.node_affinity_counts(state, p))(batch)
     else:
-        na_counts = jnp.zeros(static_mask.shape, jnp.float32)
+        na_counts = jnp.zeros((p_pods, 1), jnp.float32)
 
     # domain->node broadcast matrix, shared by every interpod/spread kernel
     # (pod-independent; hoisted so scan steps do matmuls, not gathers)
@@ -402,4 +492,11 @@ def schedule_batch(
         new_nonzero=final.nonzero,
         new_port_count=final.port_count,
         rr_end=final.rr,
+        new_podsel=(final.ipa.podsel_count if use_ip_ledger
+                    else state.podsel_count),
+        new_term=(final.ipa.term_count if use_ip_ledger and use_terms
+                  else state.term_count),
+        new_vol_any=final.vol_any if use_nodisk else state.vol_any,
+        new_vol_rw=final.vol_rw if use_nodisk else state.vol_rw,
+        new_attach=final.attach_count if attach_maxes else state.attach_count,
     )

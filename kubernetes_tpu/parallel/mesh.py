@@ -97,6 +97,9 @@ def make_sharded_scheduler(mesh: Mesh, policy: Policy = DEFAULT_POLICY,
         assignments=repl, scores=repl, feasible_counts=repl,
         new_requested=nodes_spec, new_nonzero=nodes_spec,
         new_port_count=nodes_spec, rr_end=repl,
+        new_podsel=nodes_spec, new_term=nodes_spec,
+        new_vol_any=nodes_spec, new_vol_rw=nodes_spec,
+        new_attach=nodes_spec,
     )
     if packed:
         from kubernetes_tpu.state.pod_batch import unpack_batch

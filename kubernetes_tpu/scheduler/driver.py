@@ -35,7 +35,6 @@ from kubernetes_tpu.ops.solver import schedule_batch
 from kubernetes_tpu.state import Capacities
 from kubernetes_tpu.state.encode_cache import EncodeCache
 from kubernetes_tpu.state.layout import CapacityError
-from kubernetes_tpu.state.pod_batch import empty_batch
 from kubernetes_tpu.state.statedb import StateDB
 from kubernetes_tpu.utils.events import EventRecorder
 from kubernetes_tpu.utils.trace import StepTimer
@@ -86,15 +85,19 @@ def store_encode_context(store: ObjectStore, policy: Policy = DEFAULT_POLICY,
     get_pvc_ = getter("PersistentVolumeClaim")
     get_pv_ = getter("PersistentVolume")
     get_node_ = getter("Node")
+    # read-only listers: skip the defensive deep clone (the encoders never
+    # mutate — at 15k nodes / 30k pods a cloning list per encode miss was
+    # the single largest host cost after device transfers)
     return EncodeContext(
         get_pvc=lambda ns, name: get_pvc_(name, ns),
         get_pv=lambda name: get_pv_(name),
         local_volumes_enabled=local_volumes_enabled,
-        get_services=lambda ns: store.list("Service", ns),
-        get_rcs=lambda ns: store.list("ReplicationController", ns),
-        get_rss=lambda ns: store.list("ReplicaSet", ns),
-        get_sss=lambda ns: store.list("StatefulSet", ns),
-        list_pods=lambda ns: store.list("Pod", ns),
+        get_services=lambda ns: store.list("Service", ns, copy_objects=False),
+        get_rcs=lambda ns: store.list("ReplicationController", ns,
+                                      copy_objects=False),
+        get_rss=lambda ns: store.list("ReplicaSet", ns, copy_objects=False),
+        get_sss=lambda ns: store.list("StatefulSet", ns, copy_objects=False),
+        list_pods=lambda ns: store.list("Pod", ns, copy_objects=False),
         get_node=lambda name: get_node_(name),
         service_affinity_labels=policy.service_affinity_labels(),
         service_anti=bool(policy.service_anti_priorities),
@@ -142,6 +145,12 @@ class Scheduler:
         self._assumed: set[str] = set()
         self._enqueue_time: dict[str, float] = {}
         self._rr = np.uint32(0)
+        self._blob_pool: list = []
+        # node name -> keys of bound pods seen on it (indexed even before
+        # the node itself is known, so a late node event re-accounts them);
+        # replaces the O(nodes x pods) informer sweep per node event
+        self._pods_by_node: dict[str, set[str]] = {}
+        self._pod_node: dict[str, str] = {}
 
         self.node_informer = Informer(store, "Node")
         self.pod_informer = Informer(store, "Pod")
@@ -168,7 +177,11 @@ class Scheduler:
         # so those policies force the synchronous path.
         self._pipeline = not (policy.service_affinity_labels()
                               or policy.service_anti_priorities)
-        self._inflight: tuple | None = None
+        # in-flight batches, oldest first; depth >1 hides the per-batch
+        # dispatch/readback round trip (dominant on remote-device
+        # transports: ~120ms RTT vs ~10ms of device compute per batch)
+        self.pipeline_depth = 3
+        self._inflight_q: deque = deque()
 
     def _get_schedule_fn(self, flags):
         """Compiled solver variant for this batch's content gates — a
@@ -205,16 +218,33 @@ class Scheduler:
             return
         self.statedb.upsert_node(node)
         # re-account bound pods the state missed: pods whose MODIFIED/ADDED
-        # event raced ahead of this node's, or whose accounting was dropped by
-        # a node delete+recreate
-        for pod in self.pod_informer.items():
-            if (pod.spec.node_name == node.metadata.name
-                    and not self.statedb.is_accounted(pod.key)
-                    and pod.key not in self._assumed):
+        # event raced ahead of this node's, or whose accounting was dropped
+        # by a node delete+recreate — via the node->pods index, not an
+        # O(all pods) informer sweep
+        for key in self._pods_by_node.get(node.metadata.name, ()):
+            if self.statedb.is_accounted(key) or key in self._assumed:
+                continue
+            ns, name = key.split("/", 1)
+            pod = self.pod_informer.get(name, ns)
+            if pod is not None and pod.spec.node_name == node.metadata.name:
                 self.statedb.add_pod(pod)
 
     def _wants(self, pod: Pod) -> bool:
         return pod.spec.scheduler_name == self.scheduler_name
+
+    @property
+    def inflight_batches(self) -> int:
+        """Dispatched-but-unsettled batches (pipeline depth in use)."""
+        return len(self._inflight_q)
+
+    def _unindex_pod(self, key: str) -> None:
+        prev = self._pod_node.pop(key, None)
+        if prev is not None:
+            pods = self._pods_by_node.get(prev)
+            if pods is not None:
+                pods.discard(key)
+                if not pods:
+                    del self._pods_by_node[prev]
 
     def _on_pod_event(self, event: WatchEvent) -> None:
         pod: Pod = event.obj
@@ -222,9 +252,15 @@ class Scheduler:
         if event.type == "DELETED":
             self._assumed.discard(key)
             self._enqueue_time.pop(key, None)
+            self._unindex_pod(key)
             self.statedb.remove_pod(key)
             return
         if pod.spec.node_name:
+            if self._pod_node.get(key) != pod.spec.node_name:
+                self._unindex_pod(key)
+                self._pod_node[key] = pod.spec.node_name
+                self._pods_by_node.setdefault(
+                    pod.spec.node_name, set()).add(key)
             self._enqueue_time.pop(key, None)
             if key in self._assumed:
                 # our own binding confirmed by the watch
@@ -264,16 +300,33 @@ class Scheduler:
 
     # ---- one batch ----
 
+    def _next_blobs(self):
+        """Rotating packed transport blobs: in-flight batches' blobs stay
+        referenced (commit reads accounting rows from them), so depth+2
+        buffer pairs rotate."""
+        from kubernetes_tpu.state.pod_batch import _layout
+
+        if not self._blob_pool:
+            _lay, f_width, i_width = _layout(self.caps)
+            p = self.caps.batch_pods
+            self._blob_pool = [
+                (np.zeros((p, f_width), np.float32),
+                 np.zeros((p, i_width), np.int32))
+                for _ in range(self.pipeline_depth + 2)]
+        self._blob_pool.append(self._blob_pool.pop(0))
+        fblob, iblob = self._blob_pool[0]
+        return fblob, iblob
+
     async def schedule_pending(self, wait: float | None = None) -> int:
         """Pop up to a batch of pending pods, schedule, bind. Returns the
         number of pods scheduled (in pipeline mode: settled this call)."""
-        effective_wait = 0 if self._inflight is not None else wait
+        effective_wait = 0 if self._inflight_q else wait
         keys = await self.queue.get_batch(self.caps.batch_pods,
                                           wait=effective_wait)
         if not keys:
             return self._settle_inflight()
 
-        batch = empty_batch(self.caps)
+        fblob, iblob = self._next_blobs()
         pods: list[Pod] = []
         live_keys: list[str] = []
         epoch_before = self.statedb.table.pod_row_epoch
@@ -285,7 +338,8 @@ class Scheduler:
                 self.queue.done(key)  # deleted or already bound: drop
                 continue
             try:
-                self.encode_cache.encode_into(batch, len(pods), pod)
+                self.encode_cache.encode_packed_into(fblob, iblob,
+                                                     len(pods), pod)
             except CapacityError as e:
                 # per-pod failure must not wedge the batch
                 # (MakeDefaultErrorFunc parity, factory.go:897)
@@ -296,36 +350,30 @@ class Scheduler:
         if not pods:
             return self._settle_inflight()
         if self.statedb.table.pod_row_epoch != epoch_before:
-            # a later pod in this batch interned new podsel/term entries:
+            # a later pod in this batch interned new podsel/avoid entries:
             # earlier pods' match/carry rows (encoded, possibly cached,
-            # against the smaller universe) miss them — refresh every row
-            # against the final universes before flushing
-            from kubernetes_tpu.state.pod_batch import (
-                fill_batch_affinity,
-                fill_batch_avoid,
-            )
-
-            fill_batch_affinity(batch, pods, self.statedb.table)
-            fill_batch_avoid(batch, pods, self.statedb.table)
+            # against the smaller universe) miss them — re-encode every row
+            # against the final universes (epoch is in the cache key, so
+            # stale cached rows cannot be served)
+            for i, pod in enumerate(pods):
+                self.encode_cache.encode_packed_into(fblob, iblob, i, pod)
+        # unused tail rows of a reused blob must not leak the previous
+        # batch's encodings (valid flags in particular)
+        if len(pods) < self.caps.batch_pods:
+            fblob[len(pods):] = 0.0
+            iblob[len(pods):] = 0
 
         timer = StepTimer(f"scheduling batch of {len(pods)}")
-        from kubernetes_tpu.ops.solver import batch_flags
-        from kubernetes_tpu.state.pod_batch import pack_batch
+        from kubernetes_tpu.state.pod_batch import packed_batch_flags
 
-        flags = batch_flags(batch, len(pods), self.statedb.table)
+        flags = packed_batch_flags(fblob, iblob, len(pods),
+                                   self.statedb.table, self.caps)
         schedule_fn = self._get_schedule_fn(flags)
-        fblob, iblob = pack_batch(batch, self.caps)
-        # only resource/port charges chain device-side through adopt_ledger;
-        # a batch touching podsel/volume/attach state must settle before its
-        # successor dispatches (those arrays reach the device via host
-        # mirror + re-upload only)
-        clean = not (flags.ipa or flags.spread or flags.svcanti or flags.vol
-                     or flags.attach)
         settled = 0
-        if self._inflight is not None and (not self._pipeline or not clean
-                                           or self.statedb.ledger_dirty):
+        if self._inflight_q and (not self._pipeline
+                                 or self.statedb.ledger_dirty):
             # a dirty flush would re-upload host truth that misses the
-            # in-flight batch's charges: settle it first
+            # in-flight batches' charges: settle them first
             settled += self._settle_inflight()
         state = self.statedb.flush()
         timer.step("encode + flush")
@@ -343,26 +391,34 @@ class Scheduler:
         # pipeline only under sustained load (more pods already queued →
         # another call is imminent); a drained queue settles synchronously
         # so small/interactive workloads keep request-response semantics
-        if self._pipeline and clean and len(self.queue) > 0:
+        if self._pipeline and len(self.queue) > 0:
             # adopt the (lazy, device-side) output ledger now so the next
             # batch chains on it without a synchronization; settle the
-            # previous batch while this one computes
-            self.statedb.adopt_ledger(result.new_requested, result.new_nonzero,
-                                      result.new_port_count)
-            settled += self._settle_inflight()
-            self._inflight = (result, pods, live_keys, t0, timer, True)
+            # oldest batches while this one computes
+            self.statedb.adopt_result(result)
+            self._inflight_q.append((result, pods, live_keys, (fblob, iblob),
+                                     flags, t0, timer, True))
+            while len(self._inflight_q) > self.pipeline_depth:
+                settled += self._settle_one()
             return settled
-        settled += self._settle_inflight()  # previous batch, if any
-        self._inflight = (result, pods, live_keys, t0, timer, False)
+        self._inflight_q.append((result, pods, live_keys, (fblob, iblob),
+                                 flags, t0, timer, False))
         return settled + self._settle_inflight()
 
     def _settle_inflight(self) -> int:
-        """Read back the in-flight solve, bind its assignments, and commit
-        the ledger (the synchronous tail of the former schedule_pending)."""
-        if self._inflight is None:
+        """Settle every in-flight batch, oldest first."""
+        settled = 0
+        while self._inflight_q:
+            settled += self._settle_one()
+        return settled
+
+    def _settle_one(self) -> int:
+        """Read back the oldest in-flight solve, bind its assignments, and
+        commit the ledger (the synchronous tail of schedule_pending)."""
+        if not self._inflight_q:
             return 0
-        result, pods, live_keys, t0, timer, adopted = self._inflight
-        self._inflight = None
+        (result, pods, live_keys, blobs, flags, t0, timer,
+         adopted) = self._inflight_q.popleft()
         t_wait = time.monotonic()
         assignments = np.asarray(result.assignments)
         # synchronous batches observe the true dispatch-to-ready span; for a
@@ -372,8 +428,9 @@ class Scheduler:
             time.monotonic() - (t_wait if adopted else t0))
         timer.step("device solve")
 
+        fblob, iblob = blobs
         scheduled = 0
-        committed: list[tuple[Pod, str]] = []
+        committed: list[tuple[Pod, str, int]] = []
         any_rejected = False
         for i, (key, pod) in enumerate(zip(live_keys, pods)):
             row = int(assignments[i])
@@ -396,7 +453,7 @@ class Scheduler:
                 self._fail(key, pod, f"binding rejected: {e}")
                 continue
             self._assumed.add(key)
-            committed.append((pod, node_name))
+            committed.append((pod, node_name, i))
             scheduled += 1
             self.queue.done(key)
             self.backoff.reset(key)
@@ -410,16 +467,18 @@ class Scheduler:
             # the solver output charges pods whose binding failed: keep the
             # host truth (accounting only bound pods) and force a re-upload
             # instead of adopting the device ledger (ForgetPod analog)
-            for pod, node_name in committed:
-                self.statedb.add_pod(pod, node_name)
+            self.statedb.commit_batch(result, fblob, committed,
+                                      replace_device=False)
             self.statedb.mark_ledger_dirty()
         else:
-            # clean batch: adopt the device ledger, no transfer either way
-            # (a pipelined batch already adopted at dispatch — replacing now
-            # would regress the device ledger past its successor's chaining)
-            self.statedb.commit_ledger(result.new_requested, result.new_nonzero,
-                                       result.new_port_count, committed,
-                                       replace_device=not adopted)
+            # clean batch: adopt the full device ledger, no transfer either
+            # way (a pipelined batch already adopted at dispatch — replacing
+            # now would regress the device ledger past its successor)
+            from kubernetes_tpu.ops.solver import ledger_coverage
+
+            self.statedb.commit_batch(
+                result, fblob, committed, replace_device=not adopted,
+                coverage=ledger_coverage(self.policy, flags))
         self.metrics.scheduled += scheduled
         self.metrics.batches += 1
         if self.metrics.batches % 128 == 0:

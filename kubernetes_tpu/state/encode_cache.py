@@ -78,6 +78,7 @@ class EncodeCache:
         self.volume_ctx = volume_ctx
         self.max_entries = max_entries
         self._rows: OrderedDict[tuple, tuple[np.ndarray, ...]] = OrderedDict()
+        self._packed: OrderedDict[tuple, tuple[np.ndarray, np.ndarray]] = OrderedDict()
         self._scratch = empty_batch(caps)
         self.hits = 0
         self.misses = 0
@@ -86,13 +87,17 @@ class EncodeCache:
     # entries in cached rows depend on the workload objects
     generation = 0
 
+    def _must_reencode(self, pod: Pod) -> bool:
+        # claim-backed volumes resolve through mutable PVC/PV state, and
+        # ServiceAffinity terms / ServiceAntiAffinity totals depend on
+        # other pods' placements — all must re-encode every batch
+        return not cacheable(pod) or (
+            self.volume_ctx is not None
+            and bool(self.volume_ctx.service_affinity_labels
+                     or self.volume_ctx.service_anti))
+
     def encode_into(self, batch: PodBatch, i: int, pod: Pod) -> None:
-        if not cacheable(pod) or (self.volume_ctx is not None
-                                  and (self.volume_ctx.service_affinity_labels
-                                       or self.volume_ctx.service_anti)):
-            # claim-backed volumes resolve through mutable PVC/PV state, and
-            # ServiceAffinity terms / ServiceAntiAffinity totals depend on
-            # other pods' placements — all must re-encode every batch
+        if self._must_reencode(pod):
             encode_pod_into(batch, i, pod, self.caps, self.table,
                             ctx=self.volume_ctx)
             return
@@ -111,3 +116,32 @@ class EncodeCache:
             self._rows.move_to_end(fp)
         for f, val in zip(_FIELDS, row):
             getattr(batch, f)[i] = val
+
+    def encode_packed_into(self, fblob: np.ndarray, iblob: np.ndarray,
+                           i: int, pod: Pod) -> None:
+        """Encode one pod directly into packed blob row i: a cache hit is
+        two row memcpys (vs ~45 per-field assignments), which is what makes
+        host encoding ~µs/pod under sustained template load."""
+        from kubernetes_tpu.state.pod_batch import pack_row
+
+        if self._must_reencode(pod):
+            encode_pod_into(self._scratch, 0, pod, self.caps, self.table,
+                            ctx=self.volume_ctx)
+            frow, irow = pack_row(self._scratch, 0, self.caps)
+            fblob[i], iblob[i] = frow, irow
+            return
+        fp = (pod_fingerprint(pod), self.table.pod_row_epoch, self.generation)
+        packed = self._packed.get(fp)
+        if packed is None:
+            self.misses += 1
+            encode_pod_into(self._scratch, 0, pod, self.caps, self.table,
+                            ctx=self.volume_ctx)
+            packed = pack_row(self._scratch, 0, self.caps)
+            self._packed[fp] = packed
+            if len(self._packed) > self.max_entries:
+                self._packed.popitem(last=False)
+        else:
+            self.hits += 1
+            self._packed.move_to_end(fp)
+        fblob[i] = packed[0]
+        iblob[i] = packed[1]

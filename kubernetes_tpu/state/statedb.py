@@ -11,10 +11,11 @@ Two commit paths keep the hot loop off the PCIe bus:
 - `add_pod`/`remove_pod` mutate host numpy and mark the ledger dirty; the next
   `flush()` re-uploads ledger arrays (external writes: pods bound by other
   components, deletions, node changes).
-- `commit_ledger(result, ...)` accepts the solver's *device-resident* output
-  ledger as the new truth (batch-to-batch chaining never leaves the device)
-  while mirroring the same arithmetic into host numpy for rollback/re-encode;
-  host and device stay equal without a transfer.
+- `commit_result(result, ...)` accepts the solver's *device-resident* full
+  output ledger (resources, ports, inter-pod affinity counts, volume and
+  attach counts) as the new truth — batch-to-batch chaining never leaves the
+  device — while mirroring the same arithmetic into host numpy from the
+  batch's pre-encoded rows; host and device stay equal without a transfer.
 
 Assume/forget semantics (cache.go:109 AssumePod, scheduler.go:224 rollback):
 the driver accounts an assignment optimistically via either path; a failed
@@ -120,12 +121,10 @@ class StateDB:
                 mirror_only: bool = False) -> bool:
         """Account a pod against its node. Returns False if the node is
         unknown (cache-miss pods are skipped, like the reference cache).
+        Batch commits go through the vectorized `commit_batch` instead.
 
         mirror_only: host-side bookkeeping for a change already present in
-        the device ledger (commit_ledger path) — don't mark dirty. The
-        inter-pod affinity rows are NOT in the solver's output ledger, so
-        they are applied to the host and flushed on membership dirtiness
-        like other universe state.
+        the device ledger — don't mark dirty.
         """
         node_name = node_name or pod.spec.node_name
         row = self.table.row_of.get(node_name)
@@ -181,15 +180,23 @@ class StateDB:
         return (self._dirty_nodes or self._dirty_ledger or self._dirty_affinity
                 or bool(self.table.pending_podsel_refresh))
 
-    def adopt_ledger(self, new_requested, new_nonzero, new_port_count) -> None:
-        """Chain the solver's (possibly still in-flight) output ledger as
-        the device truth without synchronizing — host mirroring happens at
-        settle time via commit_ledger/add_pod."""
+    def adopt_result(self, result) -> None:
+        """Chain the solver's (possibly still in-flight) full output ledger
+        as the device truth without synchronizing — host mirroring happens
+        at settle time via commit_result. Kernels a batch could not touch
+        return the input arrays unchanged, so this is alias bookkeeping,
+        not data movement."""
         if self._device is None:
-            raise RuntimeError("adopt_ledger before flush")
+            raise RuntimeError("adopt_result before flush")
         self._device = self._device.replace(
-            requested=new_requested, nonzero_requested=new_nonzero,
-            port_count=new_port_count)
+            requested=result.new_requested,
+            nonzero_requested=result.new_nonzero,
+            port_count=result.new_port_count,
+            podsel_count=result.new_podsel,
+            term_count=result.new_term,
+            vol_any=result.new_vol_any,
+            vol_rw=result.new_vol_rw,
+            attach_count=result.new_attach)
 
     def mark_ledger_dirty(self) -> None:
         """Force the next flush() to re-upload the host ledger — used when the
@@ -267,41 +274,98 @@ class StateDB:
         self._dirty_affinity = False
         return dev
 
-    def commit_ledger(self, new_requested, new_nonzero, new_port_count,
-                      assignments: list[tuple[Pod, str]],
-                      replace_device: bool = True) -> None:
-        """Adopt the solver's output ledger as the device truth and mirror
-        the same assignments into host numpy (no transfer either way).
+    def commit_batch(self, result, fblob: np.ndarray,
+                     committed: list[tuple[Pod, str, int]],
+                     replace_device: bool = True,
+                     coverage: tuple[bool, bool, bool] = (True, True, True),
+                     ) -> None:
+        """Adopt the solver's full output ledger as the device truth and
+        mirror the same assignments into host numpy straight from the packed
+        float blob (every mirrored ledger column is f32) — one vectorized
+        scatter-add per ledger group instead of per-pod row arithmetic
+        (no transfer either way, no re-matching).
+
+        committed: (pod, node_name, batch_row_index) triples.
 
         replace_device=False commits the host mirror only — the pipelined
-        driver already chained this batch's output via adopt_ledger() before
+        driver already chained this batch's output via adopt_result() before
         dispatching its successor; re-replacing here would regress the
-        device ledger to the older batch's arrays."""
+        device ledger to the older batch's arrays.
+
+        coverage: solver.ledger_coverage(policy, flags) — rows that touch a
+        group the compiled program passed through untracked must dirty that
+        group for re-upload from host truth."""
+        from kubernetes_tpu.state.pod_batch import _layout
+
         if self._device is None:
-            raise RuntimeError("commit_ledger before flush")
+            raise RuntimeError("commit_batch before flush")
         if replace_device:
-            self._device = self._device.replace(
-                requested=new_requested, nonzero_requested=new_nonzero,
-                port_count=new_port_count)
-        for pod, node_name in assignments:
-            self.add_pod(pod, node_name, mirror_only=True)
-            acc = self._accounted.get(pod.key)
-            if acc is None:
-                continue
-            # the solver's output ledger does not include inter-pod affinity
-            # counts; if this pod affects them, the next flush re-uploads
-            if acc.match_row.any() or acc.carry_row.any():
-                self._dirty_affinity = True
-            # nor the volume ledgers: a volume-bearing assignment forces a
-            # ledger re-upload from the (equal-by-mirroring) host truth
-            if acc.vol_any_row is not None or acc.att_row is not None:
-                self._dirty_ledger = True
+            self.adopt_result(result)
+        live = [(pod, node_name, i) for pod, node_name, i in committed
+                if pod.key not in self._accounted
+                and node_name in self.table.row_of]
+        if not live:
+            return
+        idx = np.fromiter((i for _, _, i in live), np.int64, len(live))
+        rows = np.fromiter((self.table.row_of[n] for _, n, _ in live),
+                           np.int64, len(live))
+        layout, _f, _i = _layout(self.caps)
+        gathered = fblob[idx]                       # (K, F) one fancy copy
+
+        def colv(name):
+            _blob, off, width, _trailing, _dtype = layout[name]
+            return gathered[:, off:off + width]
+
+        req = colv("requests")
+        nz = colv("nonzero_requests")
+        ports = colv("port_onehot")
+        match = colv("pod_matches_q")
+        carry = colv("pod_carries_e")
+        want_rw = colv("vol_want_rw")
+        vol_any = want_rw + colv("vol_want_ro")
+        att = colv("att_onehot")
+
+        host = self.host
+        np.add.at(host.requested, rows, req)
+        np.add.at(host.nonzero_requested, rows, nz)
+        np.add.at(host.port_count, rows, ports)
+        np.add.at(host.podsel_count, rows, match)
+        np.add.at(host.term_count, rows, carry)
+        if vol_any.any():
+            np.add.at(host.vol_any, rows, vol_any)
+            np.add.at(host.vol_rw, rows, want_rw)
+        if att.any():
+            np.add.at(host.attach_count, rows, att)
+        gen0 = self.table._gen_counter
+        self.table.generation[rows] = np.arange(
+            gen0 + 1, gen0 + 1 + len(rows))
+        self.table._gen_counter = gen0 + len(rows)
+
+        for k, (pod, node_name, _i) in enumerate(live):
+            self._accounted[pod.key] = AccountedPod(
+                node_name=node_name,
+                requests=req[k], nonzero=nz[k], port_onehot=ports[k],
+                match_row=match[k], carry_row=carry[k],
+                namespace=pod.metadata.namespace,
+                labels=dict(pod.metadata.labels),
+                vol_any_row=vol_any[k], vol_rw_row=want_rw[k],
+                att_row=att[k])
+
+        ipa_cov, vol_cov, attach_cov = coverage
+        if not ipa_cov and (match.any() or carry.any()):
+            self._dirty_affinity = True
+        if not vol_cov and vol_any.any():
+            self._dirty_ledger = True
+        if not attach_cov and att.any():
+            self._dirty_ledger = True
 
     def _put(self, state: ClusterState) -> ClusterState:
         if self.mesh is not None:
             from kubernetes_tpu.parallel.mesh import shard_state
             return shard_state(state, self.mesh)
-        return jax.tree.map(lambda a: jax.device_put(np.asarray(a)), state)
+        # ONE batched transfer for the whole pytree — per-leaf puts pay a
+        # per-call round trip each on remote-device transports
+        return jax.device_put(jax.tree.map(np.asarray, state))
 
     def _put_arr(self, arr: np.ndarray):
         if self.mesh is not None:

@@ -53,7 +53,7 @@ class EventRecorder:
             source_component=self.component,
         )
         try:
-            created = self.store.create(event)
+            created = self.store.create(event, copy=False)
         except AlreadyExists:
             # raced with an earlier instance of this event name
             existing = self.store.get("Event", name, namespace)

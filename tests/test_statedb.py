@@ -91,18 +91,33 @@ def test_flush_caches_until_dirty():
     assert dev3.sel_member is dev2.sel_member
 
 
-def test_commit_ledger_keeps_host_and_device_equal():
+def test_commit_batch_keeps_host_and_device_equal():
+    from kubernetes_tpu.ops.solver import SolverResult
+    from kubernetes_tpu.state.encode_cache import EncodeCache
+    from kubernetes_tpu.state.pod_batch import _layout
+
     db = StateDB(CAPS)
     db.upsert_node(mk_node("n0"))
     dev = db.flush()
     pod = mk_pod("a")
+    # encode the pod into packed blobs, the commit transport
+    _lay, f_width, i_width = _layout(CAPS)
+    fblob = np.zeros((CAPS.batch_pods, f_width), np.float32)
+    iblob = np.zeros((CAPS.batch_pods, i_width), np.int32)
+    EncodeCache(CAPS, db.table).encode_packed_into(fblob, iblob, 0, pod)
     new_req = np.asarray(dev.requested).copy()
     row = db.table.row_of["n0"]
     new_req[row, Resource.CPU] += 500
     new_req[row, Resource.PODS] += 1
     import jax
-    db.commit_ledger(jax.device_put(new_req), dev.nonzero_requested,
-                     dev.port_count, [(pod, "n0")])
+    result = SolverResult(
+        assignments=None, scores=None, feasible_counts=None,
+        new_requested=jax.device_put(new_req),
+        new_nonzero=dev.nonzero_requested, new_port_count=dev.port_count,
+        rr_end=None, new_podsel=dev.podsel_count, new_term=dev.term_count,
+        new_vol_any=dev.vol_any, new_vol_rw=dev.vol_rw,
+        new_attach=dev.attach_count)
+    db.commit_batch(result, fblob, [(pod, "n0", 0)])
     assert db.host.requested[row, Resource.CPU] == 500
     dev2 = db.flush()  # must NOT re-upload: ledger is already device truth
     np.testing.assert_allclose(np.asarray(dev2.requested), new_req)
