@@ -49,7 +49,8 @@ def main() -> None:
 
     n_nodes = int(os.environ.get("BENCH_NODES", "15000"))
     n_pods = int(os.environ.get("BENCH_PODS", "30000"))
-    configs = os.environ.get("BENCH_CONFIGS", "headline,interpod,spread")
+    configs = os.environ.get("BENCH_CONFIGS",
+                             "headline,interpod,spread,recovery")
     configs = [c.strip() for c in configs.split(",") if c.strip()]
 
     import jax
@@ -95,6 +96,15 @@ def main() -> None:
         extras["spread_15k_pods_per_sec"] = round(r.pods_per_sec, 1)
         extras["spread_vs_baseline"] = round(r.pods_per_sec / baseline, 2)
         extras["spread_e2e_p50_ms"] = round(r.metrics["e2e_p50_ms"], 1)
+
+    if "recovery" in configs:
+        from kubernetes_tpu.perf.harness import run_recovery
+
+        r = run_recovery(200, 600, kill_frac=0.1)
+        print(f"bench[recovery]: {r}", file=sys.stderr, flush=True)
+        extras["recovery_seconds_kill10pct_200n"] = round(
+            r.seconds_to_recover, 2)
+        extras["recovery_stranded_pods"] = r.stranded
 
     if RESULT["value"] is None and extras:
         # headline config not selected: promote the first metric actually

@@ -363,6 +363,34 @@ def parse_node_affinity(affinity: dict) -> tuple[list | None, list]:
     return req_terms, preferred
 
 
+def _rfc3339(epoch: float) -> str:
+    from datetime import datetime, timezone
+
+    return datetime.fromtimestamp(epoch, timezone.utc).isoformat(
+        timespec="seconds").replace("+00:00", "Z")
+
+
+def _cond_time(value) -> float:
+    """Condition timestamps: internal producers write epoch floats; external
+    Kubernetes JSON carries RFC3339 strings. Parse both, degrade unparseable
+    values to 0.0 instead of rejecting the whole Node."""
+    if value is None:
+        return 0.0
+    if isinstance(value, (int, float)):
+        return float(value)
+    try:
+        return float(value)
+    except (TypeError, ValueError):
+        pass
+    try:
+        from datetime import datetime
+
+        return datetime.fromisoformat(str(value).replace("Z", "+00:00")
+                                      ).timestamp()
+    except (TypeError, ValueError):
+        return 0.0
+
+
 @dataclass
 class NodeCondition:
     type: str = ""
@@ -376,16 +404,18 @@ class NodeCondition:
     @classmethod
     def from_dict(cls, d: dict[str, Any]) -> "NodeCondition":
         return cls(type=d.get("type", ""), status=d.get("status", "Unknown"),
-                   last_heartbeat_time=float(d.get("lastHeartbeatTime") or 0.0),
-                   last_transition_time=float(d.get("lastTransitionTime") or 0.0),
+                   last_heartbeat_time=_cond_time(d.get("lastHeartbeatTime")),
+                   last_transition_time=_cond_time(d.get("lastTransitionTime")),
                    reason=d.get("reason", "") or "")
 
     def to_dict(self) -> dict[str, Any]:
+        # wire format is RFC3339 (metav1.Time) so a stock Go control plane
+        # can unmarshal what we emit; from_dict accepts both forms
         out = {"type": self.type, "status": self.status}
         if self.last_heartbeat_time:
-            out["lastHeartbeatTime"] = self.last_heartbeat_time
+            out["lastHeartbeatTime"] = _rfc3339(self.last_heartbeat_time)
         if self.last_transition_time:
-            out["lastTransitionTime"] = self.last_transition_time
+            out["lastTransitionTime"] = _rfc3339(self.last_transition_time)
         if self.reason:
             out["reason"] = self.reason
         return out

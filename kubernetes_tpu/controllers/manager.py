@@ -11,16 +11,20 @@ from kubernetes_tpu.apiserver.store import ObjectStore
 from kubernetes_tpu.client.informer import Informer
 from kubernetes_tpu.controllers.deployment import DeploymentController
 from kubernetes_tpu.controllers.gc import GarbageCollector
+from kubernetes_tpu.controllers.nodelifecycle import NodeLifecycleController
 from kubernetes_tpu.controllers.replicaset import ReplicaManager
 
 
 class ControllerManager:
-    def __init__(self, store: ObjectStore, enable_gc: bool = True):
+    def __init__(self, store: ObjectStore, enable_gc: bool = True,
+                 enable_node_lifecycle: bool = True,
+                 node_lifecycle_kwargs: dict | None = None):
         self.store = store
         self.informers: dict[str, Informer] = {
             kind: Informer(store, kind)
-            for kind in ("Pod", "ReplicaSet", "ReplicationController",
-                         "StatefulSet", "Deployment")}
+            for kind in ("Pod", "Node", "ReplicaSet",
+                         "ReplicationController", "StatefulSet",
+                         "Deployment")}
         pods = self.informers["Pod"]
         self.replicaset = ReplicaManager(
             store, "ReplicaSet", self.informers["ReplicaSet"], pods)
@@ -34,8 +38,14 @@ class ControllerManager:
         if enable_gc:
             self.gc = GarbageCollector(
                 store, pods,
-                {k: v for k, v in self.informers.items() if k != "Pod"})
+                {k: v for k, v in self.informers.items()
+                 if k not in ("Pod", "Node")})
             self.controllers.append(self.gc)
+        if enable_node_lifecycle:
+            self.node_lifecycle = NodeLifecycleController(
+                store, self.informers["Node"], pods,
+                **(node_lifecycle_kwargs or {}))
+            self.controllers.append(self.node_lifecycle)
 
     async def start(self) -> None:
         for informer in self.informers.values():

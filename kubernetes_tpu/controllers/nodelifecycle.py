@@ -1,0 +1,205 @@
+"""Node lifecycle controller: heartbeat monitoring + rate-limited eviction.
+
+The NodeController analog (reference pkg/controller/node/node_controller.go:185
+monitorNodeStatus, :587 heartbeat-age checks): kubelets heartbeat their Node's
+Ready condition; when a heartbeat goes stale past the grace period the
+controller marks Ready Unknown (the control plane's view of a dead kubelet),
+and once the node has been not-Ready past the pod-eviction timeout its pods
+are deleted through a rate-limited queue
+(node/scheduler/rate_limited_queue.go:1 — per-tick token pacing so a zone
+outage doesn't delete every pod at once). Deleted pods flow back through
+their ReplicaSet (recreate) and the scheduler (re-place on live nodes) —
+closing the failure-recovery loop SURVEY.md §5.3 describes.
+
+Scheduling-side containment is immediate and separate: the Ready=Unknown
+write reaches the scheduler's statedb through the node informer, where
+CheckNodeCondition rejects new placements (ops/predicates.py).
+
+Defaults mirror the reference componentconfig: 5s monitor period
+(--node-monitor-period), 40s grace (--node-monitor-grace-period), 5m pod
+eviction timeout (--pod-eviction-timeout), 0.1 evictions/s
+(--node-eviction-rate).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+
+from kubernetes_tpu.api.objects import NodeCondition
+from kubernetes_tpu.apiserver.store import Conflict, NotFound, ObjectStore
+from kubernetes_tpu.client.informer import Informer
+from kubernetes_tpu.utils.events import EventRecorder
+
+log = logging.getLogger(__name__)
+
+MONITOR_PERIOD = 5.0        # nodeMonitorPeriod
+GRACE_PERIOD = 40.0         # nodeMonitorGracePeriod
+STARTUP_GRACE_PERIOD = 60.0  # nodeStartupGracePeriod
+EVICTION_TIMEOUT = 300.0    # podEvictionTimeout
+EVICTION_RATE = 0.1         # evictionLimiterQPS
+
+
+class NodeLifecycleController:
+    """Not a keyed reconcile loop: one periodic monitor pass over every
+    node (exactly monitorNodeStatus's shape) + one paced eviction worker."""
+
+    name = "node-lifecycle"
+
+    def __init__(self, store: ObjectStore, node_informer: Informer,
+                 pod_informer: Informer, *,
+                 monitor_period: float = MONITOR_PERIOD,
+                 grace_period: float = GRACE_PERIOD,
+                 startup_grace_period: float = STARTUP_GRACE_PERIOD,
+                 eviction_timeout: float = EVICTION_TIMEOUT,
+                 eviction_rate: float = EVICTION_RATE):
+        self.store = store
+        self.nodes = node_informer
+        self.pods = pod_informer
+        self.monitor_period = monitor_period
+        self.grace_period = grace_period
+        self.startup_grace_period = startup_grace_period
+        self.eviction_timeout = eviction_timeout
+        self.eviction_rate = eviction_rate
+        self.events = EventRecorder(store, component="node-controller")
+        # node -> wall time the controller first saw it not-Ready
+        self._not_ready_since: dict[str, float] = {}
+        self._eviction_q: asyncio.Queue[str] = asyncio.Queue()
+        self._queued: set[str] = set()
+        self._tasks: list[asyncio.Task] = []
+        self.evicted_pods = 0  # observability counter
+
+    async def start(self) -> None:
+        loop = asyncio.get_running_loop()
+        self._tasks = [loop.create_task(self._monitor_loop()),
+                       loop.create_task(self._eviction_loop())]
+
+    def stop(self) -> None:
+        for t in self._tasks:
+            t.cancel()
+        self._tasks.clear()
+
+    # ---- heartbeat monitoring ----
+
+    def monitor_once(self, now: float | None = None) -> None:
+        """One monitorNodeStatus pass (exposed for tests)."""
+        now = time.time() if now is None else now
+        seen = set()
+        for node in self.nodes.items():
+            name = node.metadata.name
+            seen.add(name)
+            ready = next((c for c in node.status.conditions
+                          if c.type == "Ready"), None)
+            if ready is None:
+                # registered but never heartbeated: startup grace from the
+                # Node's creation (node_controller.go:640)
+                age = now - (node.metadata.creation_timestamp or now)
+                if age > self.startup_grace_period:
+                    self._mark_unknown(name, now)
+                    self._track_not_ready(name, now)
+                continue
+            hb = ready.last_heartbeat_time or node.metadata.creation_timestamp
+            if ready.status == "True":
+                if now - hb > self.grace_period:
+                    self._mark_unknown(name, now)
+                    self._track_not_ready(name, now)
+                else:
+                    # healthy: clear tracking, cancel any pending eviction
+                    self._not_ready_since.pop(name, None)
+                    self._queued.discard(name)
+            else:
+                since = self._track_not_ready(
+                    name, min(now, ready.last_transition_time or now))
+                if now - since > self.eviction_timeout \
+                        and name not in self._queued:
+                    self._queued.add(name)
+                    self._eviction_q.put_nowait(name)
+        # pods bound to a Node object that no longer exists are stranded the
+        # same way a dead kubelet strands them — evict (the reference's
+        # deleteNode path, node_controller.go:426). Grace-period the first
+        # sighting: a bind may race ahead of its node's ADDED event.
+        missing = {p.spec.node_name for p in self.pods.items()
+                   if p.spec.node_name and p.spec.node_name not in seen}
+        for name in missing:
+            since = self._track_not_ready(name, now)
+            if now - since > self.grace_period and name not in self._queued:
+                self._queued.add(name)
+                self._eviction_q.put_nowait(name)
+        for gone in set(self._not_ready_since) - seen - missing:
+            # keep any queued eviction: a deleted Node's pods still need
+            # deleting even though tracking ends here
+            self._not_ready_since.pop(gone, None)
+
+    def _track_not_ready(self, name: str, when: float) -> float:
+        return self._not_ready_since.setdefault(name, when)
+
+    def _mark_unknown(self, name: str, now: float) -> None:
+        """Ready -> Unknown (NodeStatusUnknown, node_controller.go:684)."""
+        def mutate(node):
+            ready = next((c for c in node.status.conditions
+                          if c.type == "Ready"), None)
+            if ready is None:
+                ready = NodeCondition(type="Ready")
+                node.status.conditions.append(ready)
+            if ready.status != "Unknown":
+                ready.status = "Unknown"
+                ready.reason = "NodeStatusUnknown"
+                ready.last_transition_time = now
+            return node
+
+        try:
+            self.store.guaranteed_update("Node", name, "default", mutate)
+        except (NotFound, Conflict):
+            return
+        log.info("node %s: heartbeat stale, Ready -> Unknown", name)
+
+    # ---- rate-limited eviction ----
+
+    def _still_dead(self, name: str) -> bool:
+        node = self.nodes.get(name)
+        if node is None:
+            return True  # node object deleted: its pods are stranded
+        ready = next((c for c in node.status.conditions
+                      if c.type == "Ready"), None)
+        return ready is None or ready.status != "True"
+
+    def evict_node_pods(self, name: str) -> int:
+        """Delete every pod bound to `name` (deletePods,
+        node_controller.go:757). Returns pods deleted."""
+        deleted = 0
+        for pod in list(self.pods.items()):
+            if pod.spec.node_name != name:
+                continue
+            try:
+                self.store.delete("Pod", pod.metadata.name,
+                                  pod.metadata.namespace)
+            except NotFound:
+                continue
+            deleted += 1
+            self.events.record(
+                pod, "Normal", "NodeControllerEviction",
+                f"Marking for deletion Pod {pod.key} from Node {name}")
+        if deleted:
+            self.evicted_pods += deleted
+            log.info("node %s: evicted %d pods", name, deleted)
+        return deleted
+
+    async def _monitor_loop(self) -> None:
+        while True:
+            await asyncio.sleep(self.monitor_period)
+            try:
+                self.monitor_once()
+            except Exception:  # noqa: BLE001 — monitoring must not die
+                log.exception("monitor pass failed")
+
+    async def _eviction_loop(self) -> None:
+        while True:
+            name = await self._eviction_q.get()
+            if name not in self._queued:
+                continue  # cancelled by a recovery before the token came up
+            self._queued.discard(name)
+            if self._still_dead(name):
+                self.evict_node_pods(name)
+            # token pacing: at most eviction_rate nodes drained per second
+            await asyncio.sleep(1.0 / max(self.eviction_rate, 1e-9))

@@ -33,9 +33,8 @@ import jax
 import numpy as np
 
 from kubernetes_tpu.api.objects import Node, Pod
-from kubernetes_tpu.models.policy import DEFAULT_POLICY, Policy
-from kubernetes_tpu.ops import predicates as preds
-from kubernetes_tpu.ops import priorities as prios
+from kubernetes_tpu.models.policy import DEFAULT_POLICY, Policy, build_policy_rows
+from kubernetes_tpu.ops.solver import evaluate_pod
 from kubernetes_tpu.state import Capacities, encode_cluster
 from kubernetes_tpu.state.layout import CapacityError
 from kubernetes_tpu.state.pod_batch import empty_batch, encode_pod_into
@@ -43,32 +42,52 @@ from kubernetes_tpu.state.statedb import StateDB
 
 log = logging.getLogger(__name__)
 
+_UNBUILT = object()
+
 
 def _row(batch, i=0):
     return jax.tree.map(lambda a: a[i], batch)
 
 
 class ExtenderService:
-    """Protocol logic, HTTP-free (reused by tests and the HTTP server)."""
+    """Protocol logic, HTTP-free (reused by tests and the HTTP server).
+
+    Both verbs run the CONFIGURED policy's complete predicate/priority set
+    via ops.solver.evaluate_pod — the same `_pod_eval` the batch solver's
+    scan step executes (one derivation, no drift): a stock Go scheduler
+    delegating here gets interpod-affinity, volume, spreading and every
+    policy-argument registration, not a hard-coded subset."""
 
     def __init__(self, caps: Capacities | None = None,
                  policy: Policy = DEFAULT_POLICY, statedb: StateDB | None = None,
                  store=None):
         self.caps = caps or Capacities()
-        self.policy = policy
+        self.policy = policy.with_env_overrides()
         self.statedb = statedb
         self.store = store
+        # prows arrays are passed as traced args so per-request tables
+        # (full-objects mode) don't recompile; policy/caps stay static
+        self._eval = jax.jit(
+            lambda state, pod_row, prows: evaluate_pod(
+                state, pod_row, self.policy, caps=self.caps, prows=prows))
+        # PolicyRows against the persistent statedb table are stable after
+        # the first build; full-objects mode rebuilds per fresh table
+        self._statedb_prows = _UNBUILT
 
-        def _eval(state, pod_row):
-            feasible = (preds.static_feasibility(state, pod_row)
-                        & preds.fits_resources(state, pod_row)
-                        & preds.fits_host_ports(state, pod_row))
-            score = (prios.least_requested(state, pod_row)
-                     + prios.balanced_allocation(state, pod_row)
-                     + prios.taint_toleration(state, pod_row, feasible=feasible))
-            return feasible, score
-
-        self._eval = jax.jit(_eval)
+    def warmup(self) -> None:
+        """Compile the evaluation program before serving (first compile can
+        exceed the reference client's 5s default timeout, extender.go:36)."""
+        try:
+            dummy = Node.from_dict({
+                "metadata": {"name": "warmup-node"},
+                "status": {"allocatable": {"cpu": "1", "memory": "1Gi",
+                                           "pods": "10"},
+                           "conditions": [{"type": "Ready",
+                                           "status": "True"}]}})
+            self._evaluate(Pod.from_dict({"metadata": {"name": "warmup"}}),
+                           [dummy], None)
+        except Exception:  # never block serving on a warmup failure
+            log.exception("extender warmup failed")
 
     # ---- state resolution ----
 
@@ -80,21 +99,32 @@ class ExtenderService:
     def _evaluate(self, pod: Pod, nodes: list[Node] | None,
                   node_names: list[str] | None):
         """Returns (names, feasible bool[N], scores f32[N], row_of)."""
+        ctx = self.statedb.volume_ctx if self.statedb is not None else None
         if nodes is not None:
-            state, batch, table = encode_cluster(nodes, [pod], self.caps)
+            state, batch, table = encode_cluster(nodes, [pod], self.caps,
+                                                 ctx=ctx)
+            # argument registrations intern Exists-requirements/topology
+            # slots into the fresh table — refill membership afterwards
+            prows = build_policy_rows(self.policy, table, self.caps)
+            from kubernetes_tpu.state.cluster_state import apply_pending_refreshes
+            apply_pending_refreshes(state, table)
             names = [n.metadata.name for n in nodes]
         else:
             state, table = self._cached_state()
             if state is None:
                 raise ValueError("nodenames given but no statedb maintained")
+            if self._statedb_prows is _UNBUILT:
+                self._statedb_prows = build_policy_rows(
+                    self.policy, table, self.caps)
+            prows = self._statedb_prows
             batch = empty_batch(self.caps)
-            encode_pod_into(batch, 0, pod, self.caps, table)
-            if table.pending_sel_refresh or table.pending_req_refresh:
-                # flush() refills the new membership columns and re-uploads
-                # sel_member/req_member to the device
-                state = self.statedb.flush()
+            encode_pod_into(batch, 0, pod, self.caps, table, ctx=ctx)
+            # encoding may have interned new membership/selector/volsel
+            # entries; flush() refills the affected columns and re-uploads
+            # them (no-op when nothing is pending)
+            state = self.statedb.flush()
             names = node_names or []
-        feasible, score = self._eval(state, _row(batch))
+        feasible, score = self._eval(state, _row(batch), prows)
         return names, np.asarray(feasible), np.asarray(score), table.row_of
 
     # ---- verbs ----
@@ -173,6 +203,8 @@ class ExtenderServer:
         self._server: asyncio.AbstractServer | None = None
 
     async def start(self) -> None:
+        await asyncio.get_running_loop().run_in_executor(
+            None, self.service.warmup)
         self._server = await asyncio.start_server(self._handle, self.host, self.port)
         self.port = self._server.sockets[0].getsockname()[1]
 

@@ -287,6 +287,117 @@ def _static_score(state: ClusterState, pod, policy: Policy,
     return score
 
 
+def _base_rows(state: ClusterState, policy: Policy, prows,
+               g: PolicyGates):
+    """Pod-independent policy-argument rows (CheckNodeLabelPresence mask,
+    NodeLabel priority scores, gated-neutral constant shifts) — computed once
+    per batch/evaluation, broadcast over pods."""
+    base_mask = None
+    base_score = None
+    if g.const_score:
+        base_score = jnp.full(state.valid.shape[0], g.const_score, jnp.float32)
+    if prows is not None:
+        if active_label_presence(policy):
+            base_mask = preds.label_presence_ok(
+                state, prows.pres_onehot, prows.pres_count, prows.abs_onehot)
+        nl = active_label_priorities(policy)
+        if nl:
+            if base_score is None:
+                base_score = jnp.zeros(state.valid.shape[0], jnp.float32)
+            for i, (_label, presence, weight) in enumerate(nl):
+                base_score = base_score + weight * prios.node_label_score(
+                    state, prows.nlp_onehot[i], presence)
+        if g.svcanti and not g.use_svcanti:
+            # every svcanti_q == -1 and svcanti_total == 0: counts are zero,
+            # so labeled nodes score MaxPriority and unlabeled 0 — a
+            # pod-independent surface, hoisted out of the scan
+            if base_score is None:
+                base_score = jnp.zeros(state.valid.shape[0], jnp.float32)
+            for i, (_label, sa_weight) in enumerate(g.svcanti):
+                labeled = state.topology[:, prows.svcanti_slot[i]] >= 0
+                base_score = base_score + sa_weight * jnp.where(
+                    labeled, float(MAX_PRIORITY), 0.0)
+    return base_mask, base_score
+
+
+def _init_carry(state: ClusterState, g: PolicyGates, rr_start,
+                domain_universe: int) -> Carry:
+    """The assume ledger as of batch start — the accounted cluster state."""
+    return Carry(
+        requested=state.requested,
+        nonzero=state.nonzero_requested,
+        port_count=state.port_count,
+        rr=jnp.asarray(rr_start, jnp.uint32),
+        ipa=(interpod.make_ledger(state, domain_universe,
+                                  with_terms=g.use_terms)
+             if g.use_ip_ledger else None),
+        vol_any=state.vol_any if g.use_nodisk else None,
+        vol_rw=state.vol_rw if g.use_nodisk else None,
+        attach_count=state.attach_count if g.attach_maxes else None,
+    )
+
+
+def _pod_eval(state: ClusterState, g: PolicyGates, carry: Carry, pod,
+              s_mask, s_score, p_counts, na_count, topo_onehot, prows,
+              hard_w: float, domain_universe: int):
+    """One pod's full-policy (feasible[N], score[N]) against an assume
+    ledger — THE evaluation semantics, shared verbatim by the solver's scan
+    step and the extender's Filter/Prioritize verbs (extender parity with
+    in-batch scheduling is by construction, not by re-implementation)."""
+    feasible = s_mask
+    if g.use_resources:
+        feasible = feasible & preds.fits_resources(
+            state, pod, requested=carry.requested)
+    if g.use_ports:
+        feasible = feasible & preds.fits_host_ports(
+            state, pod, port_count=carry.port_count)
+    if g.use_nodisk:
+        feasible = feasible & preds.no_disk_conflict(
+            state, pod, vol_any=carry.vol_any, vol_rw=carry.vol_rw)
+    if g.attach_maxes:
+        feasible = feasible & preds.max_attach_ok(
+            state, pod, g.attach_maxes, attach_count=carry.attach_count)
+    if g.use_ipa:
+        feasible = feasible & interpod.interpod_feasible(
+            state, pod, carry.ipa, topo_onehot)
+
+    score = s_score
+    if g.w_lr:
+        score = score + g.w_lr * prios.least_requested(
+            state, pod, nonzero_requested=carry.nonzero)
+    if g.w_mr:
+        score = score + g.w_mr * prios.most_requested(
+            state, pod, nonzero_requested=carry.nonzero)
+    if g.w_ba:
+        score = score + g.w_ba * prios.balanced_allocation(
+            state, pod, nonzero_requested=carry.nonzero)
+    if g.w_tt:
+        score = score + g.w_tt * prios.taint_toleration_from_counts(
+            p_counts, feasible)
+    if g.w_na:
+        score = score + g.w_na * prios.normalized_from_counts(
+            na_count, feasible)
+    if g.w_ip:
+        ip_counts = interpod.interpod_counts(state, pod, carry.ipa, hard_w,
+                                             topo_onehot)
+        score = score + g.w_ip * interpod.interpod_score(ip_counts, feasible)
+    if g.w_ss:
+        score = score + g.w_ss * spreadops.selector_spread(
+            state, pod.spread_q, carry.ipa, feasible, domain_universe,
+            topo_onehot)
+    if g.w_ssp:
+        score = score + g.w_ssp * spreadops.selector_spread(
+            state, pod.spread_svc_q, carry.ipa, feasible, domain_universe,
+            topo_onehot)
+    if g.use_svcanti:
+        for i, (_label, sa_weight) in enumerate(g.svcanti):
+            score = score + sa_weight * spreadops.service_anti_affinity(
+                state, pod.svcanti_q, pod.svcanti_total, carry.ipa,
+                feasible, prows.svcanti_slot[i], domain_universe,
+                topo_onehot)
+    return feasible, score
+
+
 def _select_host(masked_score: jnp.ndarray, feasible: jnp.ndarray, rr: jnp.ndarray):
     """selectHost parity (generic_scheduler.go:144): among max-score feasible
     nodes, pick the (rr % ties)-th in node order."""
@@ -322,12 +433,11 @@ def schedule_batch(
     batch = jax.tree.map(jnp.asarray, batch)
 
     g = policy_gates(policy, flags)
-    use_resources, use_ports = g.use_resources, g.use_ports
-    w_lr, w_mr, w_ba, w_tt, w_na = g.w_lr, g.w_mr, g.w_ba, g.w_tt, g.w_na
-    w_ip, w_ss, w_ssp, svcanti = g.w_ip, g.w_ss, g.w_ssp, g.svcanti
-    use_ipa, use_svcanti, use_terms = g.use_ipa, g.use_svcanti, g.use_terms
-    use_ip_ledger, use_nodisk = g.use_ip_ledger, g.use_nodisk
-    attach_maxes, const_score = g.attach_maxes, g.const_score
+    # only the gates the remaining inline code reads; _base_rows/_init_carry/
+    # _pod_eval consume the rest straight from g
+    w_tt, w_na, use_ports, svcanti = g.w_tt, g.w_na, g.use_ports, g.svcanti
+    use_terms, use_ip_ledger = g.use_terms, g.use_ip_ledger
+    use_nodisk, attach_maxes = g.use_nodisk, g.attach_maxes
     if prows is None and (svcanti or active_label_presence(policy)
                           or active_label_priorities(policy)):
         raise ValueError(
@@ -339,31 +449,7 @@ def schedule_batch(
 
     # pod-independent policy-argument rows (CheckNodeLabelPresence mask,
     # NodeLabel priority scores) — computed once, broadcast over the batch
-    base_mask = None
-    base_score = None
-    if const_score:
-        base_score = jnp.full(state.valid.shape[0], const_score, jnp.float32)
-    if prows is not None:
-        if active_label_presence(policy):
-            base_mask = preds.label_presence_ok(
-                state, prows.pres_onehot, prows.pres_count, prows.abs_onehot)
-        nl = active_label_priorities(policy)
-        if nl:
-            if base_score is None:
-                base_score = jnp.zeros(state.valid.shape[0], jnp.float32)
-            for i, (_label, presence, weight) in enumerate(nl):
-                base_score = base_score + weight * prios.node_label_score(
-                    state, prows.nlp_onehot[i], presence)
-        if svcanti and not use_svcanti:
-            # every svcanti_q == -1 and svcanti_total == 0: counts are zero,
-            # so labeled nodes score MaxPriority and unlabeled 0 — a
-            # pod-independent surface, hoisted out of the scan
-            if base_score is None:
-                base_score = jnp.zeros(state.valid.shape[0], jnp.float32)
-            for i, (_label, sa_weight) in enumerate(svcanti):
-                labeled = state.topology[:, prows.svcanti_slot[i]] >= 0
-                base_score = base_score + sa_weight * jnp.where(
-                    labeled, float(MAX_PRIORITY), 0.0)
+    base_mask, base_score = _base_rows(state, policy, prows, g)
 
     # ---- Phase A: batched over (P, N) ----
     static_mask = jax.vmap(
@@ -392,56 +478,9 @@ def schedule_batch(
     # ---- Phase B: scan over the pod axis, vector over nodes ----
     def step(carry: Carry, xs):
         pod, s_mask, s_score, p_counts, na_count = xs
-
-        feasible = s_mask
-        if use_resources:
-            feasible = feasible & preds.fits_resources(
-                state, pod, requested=carry.requested)
-        if use_ports:
-            feasible = feasible & preds.fits_host_ports(
-                state, pod, port_count=carry.port_count)
-        if use_nodisk:
-            feasible = feasible & preds.no_disk_conflict(
-                state, pod, vol_any=carry.vol_any, vol_rw=carry.vol_rw)
-        if attach_maxes:
-            feasible = feasible & preds.max_attach_ok(
-                state, pod, attach_maxes, attach_count=carry.attach_count)
-        if use_ipa:
-            feasible = feasible & interpod.interpod_feasible(
-                state, pod, carry.ipa, topo_onehot)
-
-        score = s_score
-        if w_lr:
-            score = score + w_lr * prios.least_requested(
-                state, pod, nonzero_requested=carry.nonzero)
-        if w_mr:
-            score = score + w_mr * prios.most_requested(
-                state, pod, nonzero_requested=carry.nonzero)
-        if w_ba:
-            score = score + w_ba * prios.balanced_allocation(
-                state, pod, nonzero_requested=carry.nonzero)
-        if w_tt:
-            score = score + w_tt * prios.taint_toleration_from_counts(p_counts, feasible)
-        if w_na:
-            score = score + w_na * prios.normalized_from_counts(na_count, feasible)
-        if w_ip:
-            ip_counts = interpod.interpod_counts(state, pod, carry.ipa, hard_w,
-                                                 topo_onehot)
-            score = score + w_ip * interpod.interpod_score(ip_counts, feasible)
-        if w_ss:
-            score = score + w_ss * spreadops.selector_spread(
-                state, pod.spread_q, carry.ipa, feasible, domain_universe,
-                topo_onehot)
-        if w_ssp:
-            score = score + w_ssp * spreadops.selector_spread(
-                state, pod.spread_svc_q, carry.ipa, feasible, domain_universe,
-                topo_onehot)
-        if use_svcanti:
-            for i, (_label, sa_weight) in enumerate(svcanti):
-                score = score + sa_weight * spreadops.service_anti_affinity(
-                    state, pod.svcanti_q, pod.svcanti_total, carry.ipa,
-                    feasible, prows.svcanti_slot[i], domain_universe,
-                    topo_onehot)
+        feasible, score = _pod_eval(
+            state, g, carry, pod, s_mask, s_score, p_counts, na_count,
+            topo_onehot, prows, hard_w, domain_universe)
 
         masked = jnp.where(feasible, score, -jnp.inf)
         node, best, ntie = _select_host(masked, feasible, carry.rr)
@@ -470,17 +509,7 @@ def schedule_batch(
                jnp.sum(feasible.astype(jnp.int32)))
         return new_carry, out
 
-    init = Carry(
-        requested=state.requested,
-        nonzero=state.nonzero_requested,
-        port_count=state.port_count,
-        rr=jnp.asarray(rr_start, jnp.uint32),
-        ipa=(interpod.make_ledger(state, domain_universe, with_terms=use_terms)
-             if use_ip_ledger else None),
-        vol_any=state.vol_any if use_nodisk else None,
-        vol_rw=state.vol_rw if use_nodisk else None,
-        attach_count=state.attach_count if attach_maxes else None,
-    )
+    init = _init_carry(state, g, rr_start, domain_universe)
     final, (nodes, scores, counts) = jax.lax.scan(
         step, init, (batch, static_mask, static_score, prefer_counts, na_counts))
 
@@ -500,3 +529,47 @@ def schedule_batch(
         new_vol_rw=final.vol_rw if use_nodisk else state.vol_rw,
         new_attach=final.attach_count if attach_maxes else state.attach_count,
     )
+
+
+def evaluate_pod(
+    state: ClusterState,
+    pod,
+    policy: Policy = DEFAULT_POLICY,
+    caps=None,
+    prows=None,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Full-policy (feasible bool[N], score f32[N]) for ONE encoded pod row
+    against the accounted cluster state — the extender's Filter/Prioritize
+    surface (core/extender.go:100,143).
+
+    Runs the exact `_pod_eval` the solver's scan step runs, with the assume
+    ledger initialized from `state` and no in-batch predecessors — i.e. the
+    verdict the solver would reach scheduling this pod next. Pure; jit with
+    `policy` (and `caps`) static. Always compiled ALL_ACTIVE: the extender
+    serves one pod per request, so batch-content gating buys nothing and
+    full faithfulness costs nothing.
+    """
+    state = jax.tree.map(jnp.asarray, state)
+    pod = jax.tree.map(jnp.asarray, pod)
+    g = policy_gates(policy, ALL_ACTIVE)
+    if prows is None and (g.svcanti or active_label_presence(policy)
+                          or active_label_priorities(policy)):
+        raise ValueError(
+            "policy carries argument registrations (labelsPresence / "
+            "labelPreference / serviceAntiAffinity) but no PolicyRows were "
+            "given — build them with models.policy.build_policy_rows")
+    hard_w = float(policy.hard_pod_affinity_weight)
+    domain_universe = caps.domain_universe if caps else DEFAULT_DOMAIN_UNIVERSE
+
+    base_mask, base_score = _base_rows(state, policy, prows, g)
+    s_mask = _static_mask(state, pod, policy, base_mask)
+    s_score = _static_score(state, pod, policy, base_score)
+    p_counts = (preds.count_untolerated_prefer_taints(state, pod)
+                if g.w_tt else jnp.zeros((1,), jnp.int32))
+    na_count = (prios.node_affinity_counts(state, pod)
+                if g.w_na else jnp.zeros((1,), jnp.float32))
+    topo_onehot = (interpod.topology_onehot(state.topology, domain_universe)
+                   if g.use_ip_ledger else None)
+    carry = _init_carry(state, g, 0, domain_universe)
+    return _pod_eval(state, g, carry, pod, s_mask, s_score, p_counts,
+                     na_count, topo_onehot, prows, hard_w, domain_universe)

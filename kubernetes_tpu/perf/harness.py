@@ -93,6 +93,91 @@ async def _run(n_nodes: int, n_pods: int, caps: Capacities, policy: Policy,
     return result
 
 
+@dataclass
+class RecoveryResult:
+    nodes: int
+    killed: int
+    pods: int
+    stranded: int
+    seconds_to_recover: float
+
+    def __str__(self) -> str:
+        return (f"killed {self.killed}/{self.nodes} nodes ({self.stranded} "
+                f"stranded pods): all {self.pods} pods Running on live "
+                f"nodes in {self.seconds_to_recover:.2f}s")
+
+
+async def _run_recovery(n_nodes: int, n_pods: int,
+                        kill_frac: float) -> RecoveryResult:
+    """Chaos mode: hollow cluster under RS load, kill a node fraction, and
+    measure wall time until every pod is Running on a live node again (the
+    kubemark-style failure drill — node lifecycle controller detects, evicts;
+    ReplicaSet recreates; scheduler re-places; hollow kubelets ack)."""
+    from kubernetes_tpu.agent.hollow import HollowCluster
+    from kubernetes_tpu.api.objects import ReplicaSet
+    from kubernetes_tpu.controllers import ControllerManager
+
+    store = ObjectStore(watch_window=max(1 << 18, 16 * (n_pods + n_nodes)))
+    cluster = HollowCluster(store, n_nodes=n_nodes, heartbeat_every=0.5,
+                            capacity={"cpu": "32", "memory": "64Gi",
+                                      "pods": "110"})
+    await cluster.start()
+    mgr = ControllerManager(store, node_lifecycle_kwargs=dict(
+        monitor_period=0.2, grace_period=1.5, eviction_timeout=0.5,
+        eviction_rate=1e9))
+    await mgr.start()
+    num = 1 << max(6, (n_nodes - 1).bit_length())
+    sched = Scheduler(store, caps=Capacities(
+        num_nodes=num, batch_pods=min(2048, max(64, n_pods // 2))))
+    await sched.start()
+    driver = asyncio.get_running_loop().create_task(sched.run())
+
+    store.create(ReplicaSet.from_dict({
+        "metadata": {"name": "load", "namespace": "default"},
+        "spec": {"replicas": n_pods,
+                 "selector": {"matchLabels": {"app": "load"}},
+                 "template": {"metadata": {"labels": {"app": "load"}},
+                              "spec": {"containers": [{"name": "c",
+                                       "resources": {"requests": {
+                                           "cpu": "100m",
+                                           "memory": "64Mi"}}}]}}}}))
+
+    def running_off(dead_nodes=frozenset()):
+        return sum(1 for p in store.list("Pod", copy_objects=False)
+                   if p.status.phase == "Running"
+                   and p.spec.node_name not in dead_nodes)
+
+    async with asyncio.timeout(120):
+        while running_off() < n_pods:
+            await asyncio.sleep(0.1)
+
+    by_node: dict[str, int] = {}
+    for p in store.list("Pod", copy_objects=False):
+        by_node[p.spec.node_name] = by_node.get(p.spec.node_name, 0) + 1
+    victims = sorted(by_node, key=by_node.get, reverse=True)[
+        :max(1, int(kill_frac * n_nodes))]
+    stranded = sum(by_node[v] for v in victims)
+    t0 = time.perf_counter()
+    cluster.stop(victims)
+    dead = frozenset(victims)
+    async with asyncio.timeout(120):
+        while running_off(dead) < n_pods:
+            await asyncio.sleep(0.1)
+    seconds = time.perf_counter() - t0
+    sched.stop()
+    driver.cancel()
+    mgr.stop()
+    cluster.stop()
+    return RecoveryResult(nodes=n_nodes, killed=len(victims), pods=n_pods,
+                          stranded=stranded, seconds_to_recover=seconds)
+
+
+def run_recovery(n_nodes: int = 200, n_pods: int = 600,
+                 kill_frac: float = 0.1) -> RecoveryResult:
+    """Blocking entry point for the chaos/recovery drill."""
+    return asyncio.run(_run_recovery(n_nodes, n_pods, kill_frac))
+
+
 def run_throughput(
     n_nodes: int,
     n_pods: int,
