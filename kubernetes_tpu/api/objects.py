@@ -49,6 +49,7 @@ class ObjectMeta:
 
     @classmethod
     def from_dict(cls, d: dict[str, Any]) -> "ObjectMeta":
+        dts = d.get("deletionTimestamp")
         return cls(
             name=d.get("name", ""),
             namespace=d.get("namespace", "default"),
@@ -57,6 +58,8 @@ class ObjectMeta:
             annotations=dict(d.get("annotations") or {}),
             resource_version=str(d.get("resourceVersion", "")),
             owner_references=list(d.get("ownerReferences") or []),
+            creation_timestamp=_cond_time(d.get("creationTimestamp")),
+            deletion_timestamp=None if dts is None else _cond_time(dts),
         )
 
     def to_dict(self) -> dict[str, Any]:
@@ -69,6 +72,12 @@ class ObjectMeta:
             out["resourceVersion"] = self.resource_version
         if self.owner_references:
             out["ownerReferences"] = list(self.owner_references)
+        # timestamps must round-trip or WAL replay/restart loses creation
+        # order (victim ranking) and node startup grace
+        if self.creation_timestamp:
+            out["creationTimestamp"] = _rfc3339(self.creation_timestamp)
+        if self.deletion_timestamp is not None:
+            out["deletionTimestamp"] = _rfc3339(self.deletion_timestamp)
         return out
 
 
@@ -376,10 +385,8 @@ def _cond_time(value) -> float:
     values to 0.0 instead of rejecting the whole Node."""
     if value is None:
         return 0.0
-    if isinstance(value, (int, float)):
-        return float(value)
     try:
-        return float(value)
+        return float(value)  # epoch numbers, possibly as strings
     except (TypeError, ValueError):
         pass
     try:
@@ -680,6 +687,39 @@ class Service:
 
 
 @dataclass
+class Endpoints:
+    """v1 Endpoints: the Service -> ready-pod address mapping maintained by
+    the endpoint controller (pkg/controller/endpoint), and the object whose
+    annotation carries the leader-election record
+    (client-go/tools/leaderelection/resourcelock/endpointslock.go)."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    subsets: list[dict[str, Any]] = field(default_factory=list)
+
+    kind = "Endpoints"
+
+    @property
+    def key(self) -> str:
+        return f"{self.metadata.namespace}/{self.metadata.name}"
+
+    def clone(self) -> "Endpoints":
+        return Endpoints(metadata=self.metadata.clone(),
+                         subsets=copy.deepcopy(self.subsets))
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]) -> "Endpoints":
+        return cls(metadata=ObjectMeta.from_dict(d.get("metadata") or {}),
+                   subsets=copy.deepcopy(d.get("subsets") or []))
+
+    def to_dict(self) -> dict[str, Any]:
+        out = {"apiVersion": "v1", "kind": "Endpoints",
+               "metadata": self.metadata.to_dict()}
+        if self.subsets:
+            out["subsets"] = copy.deepcopy(self.subsets)
+        return out
+
+
+@dataclass
 class _Workload:
     """Shared shape of the pod-owning workload kinds (RC/RS/StatefulSet):
     metadata + raw spec holding replicas/selector/template."""
@@ -769,6 +809,36 @@ class Deployment(_Workload):
     @property
     def strategy_type(self) -> str:
         return (self.spec.get("strategy") or {}).get("type", "RollingUpdate")
+
+
+@dataclass
+class Job(_Workload):
+    """batch/v1 Job: run-to-completion workload (reference
+    pkg/controller/job/jobcontroller.go; types
+    staging/src/k8s.io/api/batch/v1/types.go)."""
+
+    kind = "Job"
+    api_version = "batch/v1"
+
+    @property
+    def selector(self) -> dict[str, Any]:
+        sel = self.spec.get("selector")
+        if sel:
+            return dict(sel)
+        # the reference defaults the selector to the template labels
+        labels = ((self.spec.get("template") or {}).get("metadata") or {}
+                  ).get("labels") or {}
+        return {"matchLabels": dict(labels)} if labels else {}
+
+    @property
+    def completions(self) -> int:
+        c = self.spec.get("completions")
+        return 1 if c is None else int(c)
+
+    @property
+    def parallelism(self) -> int:
+        p = self.spec.get("parallelism")
+        return 1 if p is None else int(p)
 
 
 @dataclass

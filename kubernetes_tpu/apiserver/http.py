@@ -1,0 +1,542 @@
+"""HTTP front-end for the ObjectStore: the generic-apiserver REST surface.
+
+Serves the reference's resource route shapes (registerResourceHandlers,
+staging/src/k8s.io/apiserver/pkg/endpoints/installer.go:195) over the
+in-memory store:
+
+    GET    /api/v1/{plural}                          cluster-wide list
+    GET    /api/v1/{plural}?watch=1&resourceVersion=N  chunked watch stream
+    GET    /api/v1/namespaces/{ns}/{plural}          namespaced list
+    GET    /api/v1/namespaces/{ns}/{plural}/{name}   get
+    POST   /api/v1/namespaces/{ns}/{plural}          create
+    PUT    /api/v1/namespaces/{ns}/{plural}/{name}   update (CAS on
+                                                     resourceVersion)
+    DELETE /api/v1/namespaces/{ns}/{plural}/{name}   delete
+    POST   /api/v1/namespaces/{ns}/pods/{name}/binding  the pods/binding
+           subresource (pkg/registry/core/pod/rest)
+
+`/apis/{group}/{version}/...` routes alias the same resources (the vintage
+tree serves workloads under extensions/v1beta1 and apps/v1beta1).
+
+Watch semantics match the reference's chunked-frame protocol
+(endpoints/handlers/watch.go): each event is one JSON line
+`{"type": "ADDED", "object": {...}}`; a resume point older than the ring
+buffer answers **410 Gone**, telling the client to relist — exactly the
+Reflector contract the in-process store enforces with `Expired`.
+
+`RemoteStore` is the client half: an ObjectStore-compatible facade whose
+CRUD speaks blocking HTTP (small JSON bodies on a local/trusted network —
+the reference's client-go default QPS model) and whose `watch()` returns an
+async stream, so `Informer`, `Scheduler`, controllers, and the extender run
+over TCP unchanged.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import socket
+from typing import Any
+from urllib.parse import parse_qs, urlsplit
+
+from kubernetes_tpu.api import objects as objs
+from kubernetes_tpu.api.objects import Binding
+from kubernetes_tpu.apiserver.store import (
+    AlreadyExists,
+    Conflict,
+    Expired,
+    NotFound,
+    ObjectStore,
+    WatchEvent,
+)
+
+log = logging.getLogger(__name__)
+
+# plural REST resource <-> kind (discovery surface of the vintage tree)
+RESOURCES: dict[str, str] = {
+    "pods": "Pod",
+    "nodes": "Node",
+    "services": "Service",
+    "endpoints": "Endpoints",
+    "events": "Event",
+    "bindings": "Binding",
+    "persistentvolumes": "PersistentVolume",
+    "persistentvolumeclaims": "PersistentVolumeClaim",
+    "replicationcontrollers": "ReplicationController",
+    "replicasets": "ReplicaSet",
+    "statefulsets": "StatefulSet",
+    "deployments": "Deployment",
+    "jobs": "Job",
+}
+KIND_TO_CLS = {cls.kind: cls for cls in (
+    objs.Pod, objs.Node, objs.Service, objs.Endpoints, objs.Event,
+    objs.PersistentVolume, objs.PersistentVolumeClaim,
+    objs.ReplicationController, objs.ReplicaSet, objs.StatefulSet,
+    objs.Deployment, objs.Job)}
+PLURAL_OF = {kind: plural for plural, kind in RESOURCES.items()}
+
+
+def decode_object(kind: str, body: dict) -> Any:
+    cls = KIND_TO_CLS.get(kind)
+    if cls is None:
+        raise NotFound(f"unknown kind {kind!r}")
+    return cls.from_dict(body)
+
+
+def encode_object(obj: Any) -> dict:
+    out = obj.to_dict()
+    out.setdefault("kind", obj.kind)
+    return out
+
+
+class APIServer:
+    """Asyncio HTTP/1.1 apiserver over one ObjectStore."""
+
+    def __init__(self, store: ObjectStore, host: str = "127.0.0.1",
+                 port: int = 0):
+        self.store = store
+        self.host = host
+        self.port = port
+        self._server: asyncio.AbstractServer | None = None
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_server(
+            self._handle, self.host, self.port)
+        self.port = self._server.sockets[0].getsockname()[1]
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None
+
+    # ---- connection handling ----
+
+    async def _handle(self, reader: asyncio.StreamReader,
+                      writer: asyncio.StreamWriter) -> None:
+        try:
+            while True:
+                request_line = await reader.readline()
+                if not request_line:
+                    return
+                try:
+                    method, target, _ = request_line.decode().split(None, 2)
+                except ValueError:
+                    await _respond(writer, 400, {"message": "bad request"})
+                    return
+                headers: dict[str, str] = {}
+                while True:
+                    line = await reader.readline()
+                    if line in (b"\r\n", b"\n", b""):
+                        break
+                    name, _, value = line.decode().partition(":")
+                    headers[name.strip().lower()] = value.strip()
+                length = int(headers.get("content-length", 0))
+                body = await reader.readexactly(length) if length else b""
+
+                url = urlsplit(target)
+                query = {k: v[-1] for k, v in parse_qs(url.query).items()}
+                if query.get("watch") in ("1", "true"):
+                    await self._serve_watch(writer, url.path, query)
+                    return  # watch owns the connection until it closes
+                status, payload = self._route(method, url.path, query, body)
+                keep = headers.get("connection", "keep-alive").lower() != "close"
+                await _respond(writer, status, payload, keep_alive=keep)
+                if not keep:
+                    return
+        except (asyncio.IncompleteReadError, ConnectionError):
+            pass
+        finally:
+            writer.close()
+
+    # ---- routing ----
+
+    @staticmethod
+    def _parse_path(path: str):
+        """-> (ns | None, plural, name | None, subresource | None)."""
+        parts = [p for p in path.strip("/").split("/") if p]
+        # strip the version prefix: api/v1 or apis/{group}/{version}
+        if parts[:1] == ["api"]:
+            parts = parts[2:]
+        elif parts[:1] == ["apis"]:
+            parts = parts[3:]
+        else:
+            raise NotFound(f"unknown path {path!r}")
+        ns = None
+        if parts[:1] == ["namespaces"] and len(parts) >= 3:
+            ns = parts[1]
+            parts = parts[2:]
+        elif parts[:1] == ["namespaces"] and len(parts) == 2:
+            # namespace-scoped list via /namespaces/{ns} alone: unsupported
+            raise NotFound(f"unknown path {path!r}")
+        if not parts:
+            raise NotFound(f"unknown path {path!r}")
+        plural, name, sub = parts[0], None, None
+        if len(parts) >= 2:
+            name = parts[1]
+        if len(parts) >= 3:
+            sub = parts[2]
+        if plural not in RESOURCES:
+            raise NotFound(f"unknown resource {plural!r}")
+        return ns, plural, name, sub
+
+    def _route(self, method: str, path: str, query: dict, body: bytes):
+        try:
+            ns, plural, name, sub = self._parse_path(path)
+            kind = RESOURCES[plural]
+            if sub == "binding" and method == "POST" and kind == "Pod":
+                args = json.loads(body)
+                target = (args.get("target") or {}).get("name", "")
+                self.store.bind(Binding(pod_name=name,
+                                        namespace=ns or "default",
+                                        target_node=target))
+                return 201, {"kind": "Status", "status": "Success"}
+            if sub is not None:
+                return 404, {"message": f"unknown subresource {sub!r}"}
+            if method == "GET" and name is not None:
+                obj = self.store.get(kind, name, ns or "default")
+                return 200, encode_object(obj)
+            if method == "GET":
+                items = self.store.list(kind, namespace=ns,
+                                        copy_objects=False)
+                return 200, {
+                    "kind": f"{kind}List",
+                    "metadata": {"resourceVersion":
+                                 str(self.store.resource_version)},
+                    "items": [encode_object(o) for o in items]}
+            if method == "POST":
+                obj = decode_object(kind, json.loads(body))
+                if ns:
+                    obj.metadata.namespace = ns
+                created = self.store.create(obj)
+                return 201, encode_object(created)
+            if method == "PUT" and name is not None:
+                obj = decode_object(kind, json.loads(body))
+                if ns:
+                    obj.metadata.namespace = ns
+                updated = self.store.update(obj)
+                return 200, encode_object(updated)
+            if method == "DELETE" and name is not None:
+                deleted = self.store.delete(kind, name, ns or "default")
+                return 200, encode_object(deleted)
+            return 405, {"message": f"method {method} not allowed"}
+        except NotFound as e:
+            return 404, {"kind": "Status", "reason": "NotFound",
+                         "message": str(e)}
+        except AlreadyExists as e:
+            return 409, {"kind": "Status", "reason": "AlreadyExists",
+                         "message": str(e)}
+        except Conflict as e:
+            return 409, {"kind": "Status", "reason": "Conflict",
+                         "message": str(e)}
+        except (json.JSONDecodeError, KeyError, TypeError, ValueError) as e:
+            return 400, {"kind": "Status", "reason": "BadRequest",
+                         "message": f"{type(e).__name__}: {e}"}
+
+    # ---- watch streaming ----
+
+    async def _serve_watch(self, writer: asyncio.StreamWriter, path: str,
+                           query: dict) -> None:
+        try:
+            ns, plural, _name, _sub = self._parse_path(path)
+            kind = RESOURCES[plural]
+        except NotFound as e:
+            await _respond(writer, 404, {"message": str(e)})
+            return
+        since = query.get("resourceVersion")
+        try:
+            stream = self.store.watch(
+                kind, since=int(since) if since else None)
+        except Expired as e:
+            # 410 Gone — the Reflector relists (watch.go / cacher semantics)
+            await _respond(writer, 410, {"kind": "Status", "reason": "Gone",
+                                         "message": str(e)})
+            return
+        writer.write(b"HTTP/1.1 200 OK\r\n"
+                     b"Content-Type: application/json\r\n"
+                     b"Transfer-Encoding: identity\r\n"
+                     b"Connection: close\r\n\r\n")
+        try:
+            while True:
+                event = await stream.next(timeout=30.0)
+                if event is None:
+                    # heartbeat frame keeps half-open detection simple
+                    writer.write(b"\n")
+                    await writer.drain()
+                    continue
+                if ns and event.obj.metadata.namespace != ns:
+                    continue
+                frame = {"type": event.type,
+                         "resourceVersion": event.resource_version,
+                         "object": encode_object(event.obj)}
+                writer.write(json.dumps(frame).encode() + b"\n")
+                await writer.drain()
+        except (ConnectionError, asyncio.CancelledError):
+            pass
+        finally:
+            stream.stop()
+            writer.close()
+
+
+async def _respond(writer: asyncio.StreamWriter, status: int, payload,
+                   keep_alive: bool = False) -> None:
+    body = json.dumps(payload).encode()
+    reason = {200: "OK", 201: "Created", 400: "Bad Request", 404: "Not Found",
+              405: "Method Not Allowed", 409: "Conflict",
+              410: "Gone"}.get(status, "Error")
+    conn = "keep-alive" if keep_alive else "close"
+    writer.write(
+        f"HTTP/1.1 {status} {reason}\r\n"
+        f"Content-Type: application/json\r\n"
+        f"Content-Length: {len(body)}\r\n"
+        f"Connection: {conn}\r\n\r\n".encode() + body)
+    await writer.drain()
+
+
+# ---------------------------------------------------------------------------
+# client half
+# ---------------------------------------------------------------------------
+
+
+class RemoteWatchStream:
+    """Async line-delimited watch frames -> WatchEvent, Informer-compatible."""
+
+    def __init__(self, reader: asyncio.StreamReader,
+                 writer: asyncio.StreamWriter):
+        self._reader = reader
+        self._writer = writer
+        self._stopped = False
+
+    async def next(self, timeout: float | None = None) -> WatchEvent | None:
+        if self._stopped:
+            return None
+        try:
+            while True:
+                if timeout is None:
+                    line = await self._reader.readline()
+                else:
+                    line = await asyncio.wait_for(self._reader.readline(),
+                                                  timeout)
+                if not line:
+                    raise ConnectionError("watch stream closed")
+                line = line.strip()
+                if not line:
+                    continue  # heartbeat
+                frame = json.loads(line)
+                obj = decode_object(frame["object"].get("kind"),
+                                    frame["object"])
+                return WatchEvent(frame["type"], obj.kind, obj,
+                                  int(frame.get("resourceVersion", 0)))
+        except asyncio.TimeoutError:
+            return None
+
+    def stop(self) -> None:
+        if not self._stopped:
+            self._stopped = True
+            self._writer.close()
+
+    def __aiter__(self):
+        return self
+
+    async def __anext__(self) -> WatchEvent:
+        ev = await self.next()
+        if ev is None:
+            raise StopAsyncIteration
+        return ev
+
+
+class RemoteStore:
+    """ObjectStore-compatible client over the HTTP API: informers, the
+    scheduler driver, controllers, and the extender run over TCP unchanged."""
+
+    def __init__(self, host: str, port: int):
+        self.host = host
+        self.port = port
+
+    # ---- blocking HTTP core (CRUD: small JSON on a trusted network) ----
+
+    def _request(self, method: str, path: str, body: dict | None = None):
+        payload = json.dumps(body).encode() if body is not None else b""
+        with socket.create_connection((self.host, self.port),
+                                      timeout=30) as sock:
+            sock.sendall(
+                f"{method} {path} HTTP/1.1\r\n"
+                f"Host: {self.host}\r\n"
+                f"Content-Type: application/json\r\n"
+                f"Content-Length: {len(payload)}\r\n"
+                f"Connection: close\r\n\r\n".encode() + payload)
+            data = b""
+            while True:
+                chunk = sock.recv(65536)
+                if not chunk:
+                    break
+                data += chunk
+        head, _, resp_body = data.partition(b"\r\n\r\n")
+        status = int(head.split(None, 2)[1])
+        decoded = json.loads(resp_body) if resp_body else {}
+        if status == 404:
+            raise NotFound(decoded.get("message", "not found"))
+        if status == 409:
+            if decoded.get("reason") == "AlreadyExists":
+                raise AlreadyExists(decoded.get("message", ""))
+            raise Conflict(decoded.get("message", ""))
+        if status == 410:
+            raise Expired(decoded.get("message", ""))
+        if status >= 400:
+            raise ValueError(f"HTTP {status}: {decoded.get('message')}")
+        return decoded
+
+    @staticmethod
+    def _path(kind: str, namespace: str | None = None,
+              name: str | None = None) -> str:
+        plural = PLURAL_OF[kind]
+        path = "/api/v1"
+        if namespace is not None:
+            path += f"/namespaces/{namespace}"
+        path += f"/{plural}"
+        if name is not None:
+            path += f"/{name}"
+        return path
+
+    # ---- ObjectStore surface ----
+
+    @property
+    def resource_version(self) -> int:
+        decoded = self._request("GET", self._path("Pod"))
+        return int(decoded["metadata"]["resourceVersion"])
+
+    def get(self, kind: str, name: str, namespace: str = "default") -> Any:
+        return decode_object(kind, self._request(
+            "GET", self._path(kind, namespace, name)))
+
+    def list(self, kind: str, namespace: str | None = None,
+             label_selector: dict[str, str] | None = None, *,
+             copy_objects: bool = True) -> list[Any]:
+        decoded = self._request("GET", self._path(kind, namespace))
+        out = [decode_object(kind, d) for d in decoded["items"]]
+        if label_selector:
+            out = [o for o in out
+                   if all(o.metadata.labels.get(k) == v
+                          for k, v in label_selector.items())]
+        return out
+
+    def create(self, obj: Any, *, copy: bool = True) -> Any:
+        return decode_object(obj.kind, self._request(
+            "POST", self._path(obj.kind, obj.metadata.namespace),
+            encode_object(obj)))
+
+    def update(self, obj: Any, *, check_version: bool = True) -> Any:
+        body = encode_object(obj)
+        if not check_version:
+            body.setdefault("metadata", {}).pop("resourceVersion", None)
+        return decode_object(obj.kind, self._request(
+            "PUT", self._path(obj.kind, obj.metadata.namespace,
+                              obj.metadata.name), body))
+
+    def guaranteed_update(self, kind: str, name: str, namespace: str,
+                          mutate, retries: int = 16) -> Any:
+        for _ in range(retries):
+            obj = self.get(kind, name, namespace)
+            mutate(obj)
+            try:
+                return self.update(obj)
+            except Conflict:
+                continue
+        raise Conflict(f"{kind} {namespace}/{name}: too many CAS retries")
+
+    def delete(self, kind: str, name: str, namespace: str = "default") -> Any:
+        return decode_object(kind, self._request(
+            "DELETE", self._path(kind, namespace, name)))
+
+    def bind(self, binding: Binding) -> Any:
+        return self._request(
+            "POST",
+            self._path("Pod", binding.namespace, binding.pod_name)
+            + "/binding",
+            {"target": {"kind": "Node", "name": binding.target_node},
+             "metadata": {"name": binding.pod_name}})
+
+    def watch(self, kind: str | None = None,
+              since: int | None = None) -> RemoteWatchStream:
+        """Open the chunked watch stream. Must run inside the event loop the
+        stream will be consumed on; raises Expired on 410."""
+        plural = PLURAL_OF[kind]
+        query = "watch=1" + (f"&resourceVersion={since}"
+                             if since is not None else "")
+        loop = asyncio.get_running_loop()
+        fut = loop.create_task(self._open_watch(plural, query))
+        # Informer calls watch() synchronously from a coroutine: expose the
+        # stream as a lazily-opened wrapper
+        return _LazyWatch(fut)
+
+    async def _open_watch(self, plural: str, query: str):
+        reader, writer = await asyncio.open_connection(self.host, self.port)
+        writer.write(f"GET /api/v1/{plural}?{query} HTTP/1.1\r\n"
+                     f"Host: {self.host}\r\nConnection: keep-alive\r\n\r\n"
+                     .encode())
+        await writer.drain()
+        status_line = await reader.readline()
+        status = int(status_line.split(None, 2)[1])
+        headers = {}
+        while True:
+            line = await reader.readline()
+            if line in (b"\r\n", b"\n", b""):
+                break
+            name, _, value = line.decode().partition(":")
+            headers[name.strip().lower()] = value.strip()
+        if status == 410:
+            length = int(headers.get("content-length", 0))
+            body = await reader.readexactly(length) if length else b"{}"
+            writer.close()
+            raise Expired(json.loads(body).get("message", "410 Gone"))
+        if status != 200:
+            writer.close()
+            raise ValueError(f"watch failed: HTTP {status}")
+        return RemoteWatchStream(reader, writer)
+
+
+class _LazyWatch:
+    """Defers the async watch handshake to the first next() call while
+    keeping the Informer's synchronous `store.watch(...)` call shape. A 410
+    at handshake time surfaces as ConnectionError->relist (equivalent
+    recovery path to the in-process store's synchronous Expired)."""
+
+    def __init__(self, open_task: asyncio.Task):
+        self._open = open_task
+        self._stream: RemoteWatchStream | None = None
+        self._stopped = False
+
+    async def _ensure(self) -> RemoteWatchStream:
+        if self._stream is None:
+            self._stream = await self._open
+        return self._stream
+
+    async def next(self, timeout: float | None = None) -> WatchEvent | None:
+        stream = await self._ensure()
+        if self._stopped:
+            return None
+        return await stream.next(timeout)
+
+    def stop(self) -> None:
+        self._stopped = True
+        if self._stream is not None:
+            self._stream.stop()
+        elif self._open.done() and not self._open.cancelled() \
+                and self._open.exception() is None:
+            self._open.result().stop()
+        else:
+            self._open.cancel()
+
+    def __aiter__(self):
+        return self
+
+    async def __anext__(self) -> WatchEvent:
+        ev = await self.next()
+        if ev is None:
+            raise StopAsyncIteration
+        return ev

@@ -56,13 +56,73 @@ def _key(namespace: str, name: str) -> tuple[str, str]:
 
 
 class ObjectStore:
-    """One store instance == one apiserver+etcd."""
+    """One store instance == one apiserver+etcd.
 
-    def __init__(self, watch_window: int = 4096):
+    `persist_path` enables etcd-like durability: every mutation appends one
+    JSON line to a write-ahead log (flushed per write, so state survives a
+    SIGKILL'd process), and a fresh store replays the log on startup —
+    resourceVersions continue from where they stopped, so resumed watchers
+    and relisting Reflectors see one consistent history (the checkpoint/
+    resume model of SURVEY.md §5.4: components are crash-only, *all* state
+    lives in the store). Compaction = delete the log once the cluster is
+    drained; replay cost is linear in total writes."""
+
+    def __init__(self, watch_window: int = 4096,
+                 persist_path: str | None = None):
         self._objects: dict[str, dict[tuple[str, str], Any]] = {}
         self._rv = 0
         self._history: deque[WatchEvent] = deque(maxlen=watch_window)
         self._watchers: list[tuple[str | None, asyncio.Queue]] = []
+        self._wal = None
+        if persist_path:
+            self._replay_wal(persist_path)
+            self._wal = open(persist_path, "a", encoding="utf-8")
+
+    # ---- write-ahead log ----
+
+    def _replay_wal(self, path: str) -> None:
+        import json
+        import os
+
+        from kubernetes_tpu.apiserver.http import decode_object
+
+        if not os.path.exists(path):
+            return
+        with open(path, encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    entry = json.loads(line)
+                except ValueError:
+                    continue  # torn tail write from the crash: stop-safe
+                kind = entry["kind"]
+                rv = int(entry["rv"])
+                if entry["op"] == "DELETE":
+                    self._bucket(kind).pop(
+                        (entry["ns"], entry["name"]), None)
+                else:
+                    obj = decode_object(kind, entry["obj"])
+                    obj.metadata.resource_version = str(rv)
+                    self._bucket(kind)[(entry["ns"], entry["name"])] = obj
+                self._rv = max(self._rv, rv)
+
+    def _append_wal(self, event: WatchEvent) -> None:
+        import json
+
+        obj = event.obj
+        entry = {
+            "op": "DELETE" if event.type == "DELETED" else "PUT",
+            "rv": event.resource_version,
+            "kind": event.kind,
+            "ns": obj.metadata.namespace or "default",
+            "name": obj.metadata.name,
+        }
+        if event.type != "DELETED":
+            entry["obj"] = obj.to_dict()
+        self._wal.write(json.dumps(entry) + "\n")
+        self._wal.flush()
 
     # ---- versioning ----
 
@@ -203,6 +263,8 @@ class ObjectStore:
     # ---- watch ----
 
     def _publish(self, event: WatchEvent) -> None:
+        if self._wal is not None:
+            self._append_wal(event)
         self._history.append(event)
         for kind, queue in self._watchers:
             if kind is None or kind == event.kind:

@@ -1,0 +1,203 @@
+"""Leader election via CAS on a lock object's annotation.
+
+The client-go LeaderElector re-design (client-go/tools/leaderelection/
+leaderelection.go:138 Run, :172 acquire, :146 renew; record format
+resourcelock.LeaderElectionRecord in the
+``control-plane.alpha.kubernetes.io/leader`` annotation of an Endpoints
+object — endpointslock.go). The store's `guaranteed_update` CAS plays the
+role of the apiserver's resourceVersion-checked update.
+
+Semantics preserved from the reference:
+- a candidate acquires when the record is absent, expired
+  (renewTime + leaseDuration < now), or already its own;
+- the holder renews every retry_period and must succeed within
+  renew_deadline or it stops leading;
+- `leaderTransitions` increments only when the holder identity changes;
+- losing the lease calls on_stopped_leading — the reference process exits
+  and its replica takes over from shared state (crash-only HA).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import time
+from dataclasses import dataclass
+from typing import Awaitable, Callable
+
+from kubernetes_tpu.api.objects import Endpoints, ObjectMeta
+from kubernetes_tpu.apiserver.store import AlreadyExists, Conflict, NotFound
+
+log = logging.getLogger(__name__)
+
+LEADER_ANNOTATION = "control-plane.alpha.kubernetes.io/leader"
+
+# componentconfig defaults (leaderelection.go / options)
+LEASE_DURATION = 15.0
+RENEW_DEADLINE = 10.0
+RETRY_PERIOD = 2.0
+
+
+@dataclass
+class LeaderElectionRecord:
+    holder_identity: str
+    lease_duration_seconds: float
+    acquire_time: float
+    renew_time: float
+    leader_transitions: int = 0
+
+    def to_json(self) -> str:
+        return json.dumps({
+            "holderIdentity": self.holder_identity,
+            "leaseDurationSeconds": self.lease_duration_seconds,
+            "acquireTime": self.acquire_time,
+            "renewTime": self.renew_time,
+            "leaderTransitions": self.leader_transitions,
+        })
+
+    @classmethod
+    def from_json(cls, raw: str) -> "LeaderElectionRecord | None":
+        try:
+            d = json.loads(raw)
+            return cls(
+                holder_identity=d.get("holderIdentity", ""),
+                lease_duration_seconds=float(
+                    d.get("leaseDurationSeconds", LEASE_DURATION)),
+                acquire_time=float(d.get("acquireTime", 0.0)),
+                renew_time=float(d.get("renewTime", 0.0)),
+                leader_transitions=int(d.get("leaderTransitions", 0)),
+            )
+        except (ValueError, TypeError):
+            return None
+
+
+class LeaderElector:
+    def __init__(self, store, identity: str,
+                 lock_name: str = "kube-scheduler",
+                 lock_namespace: str = "kube-system", *,
+                 lease_duration: float = LEASE_DURATION,
+                 renew_deadline: float = RENEW_DEADLINE,
+                 retry_period: float = RETRY_PERIOD,
+                 on_started_leading: Callable[[], Awaitable] | None = None,
+                 on_stopped_leading: Callable[[], None] | None = None):
+        self.store = store
+        self.identity = identity
+        self.lock_name = lock_name
+        self.lock_namespace = lock_namespace
+        self.lease_duration = lease_duration
+        self.renew_deadline = renew_deadline
+        self.retry_period = retry_period
+        self.on_started_leading = on_started_leading
+        self.on_stopped_leading = on_stopped_leading
+        self.is_leader = False
+        self._stop = False
+
+    # ---- lock record I/O ----
+
+    def _get_record(self) -> LeaderElectionRecord | None:
+        try:
+            obj = self.store.get("Endpoints", self.lock_name,
+                                 self.lock_namespace)
+        except NotFound:
+            return None
+        raw = obj.metadata.annotations.get(LEADER_ANNOTATION)
+        return LeaderElectionRecord.from_json(raw) if raw else None
+
+    def _try_acquire_or_renew(self, now: float) -> bool:
+        """One acquire-or-renew attempt (tryAcquireOrRenew,
+        leaderelection.go:210). Returns True while holding the lease."""
+        current = self._get_record()
+        if current is None:
+            record = LeaderElectionRecord(
+                holder_identity=self.identity,
+                lease_duration_seconds=self.lease_duration,
+                acquire_time=now, renew_time=now)
+            return self._write_record(record, create_ok=True)
+        expired = current.renew_time + current.lease_duration_seconds < now
+        if current.holder_identity != self.identity and not expired:
+            return False  # someone else holds an unexpired lease
+        record = LeaderElectionRecord(
+            holder_identity=self.identity,
+            lease_duration_seconds=self.lease_duration,
+            acquire_time=(current.acquire_time
+                          if current.holder_identity == self.identity
+                          else now),
+            renew_time=now,
+            leader_transitions=(current.leader_transitions
+                                if current.holder_identity == self.identity
+                                else current.leader_transitions + 1))
+        return self._write_record(record)
+
+    def _write_record(self, record: LeaderElectionRecord,
+                      create_ok: bool = False) -> bool:
+        if create_ok:
+            obj = Endpoints(metadata=ObjectMeta(
+                name=self.lock_name, namespace=self.lock_namespace,
+                annotations={LEADER_ANNOTATION: record.to_json()}))
+            try:
+                self.store.create(obj)
+                return True
+            except AlreadyExists:
+                pass  # raced another candidate: fall through to CAS update
+
+        def mutate(obj):
+            # re-check under the CAS: a racing writer may have renewed
+            raw = obj.metadata.annotations.get(LEADER_ANNOTATION)
+            cur = LeaderElectionRecord.from_json(raw) if raw else None
+            if cur is not None and cur.holder_identity != self.identity \
+                    and cur.renew_time + cur.lease_duration_seconds \
+                    >= record.renew_time:
+                raise _Lost()
+            obj.metadata.annotations[LEADER_ANNOTATION] = record.to_json()
+            return obj
+
+        try:
+            self.store.guaranteed_update("Endpoints", self.lock_name,
+                                         self.lock_namespace, mutate)
+            return True
+        except (_Lost, Conflict, NotFound):
+            return False
+
+    # ---- run loop ----
+
+    async def run(self) -> None:
+        """Block until leadership is acquired, run on_started_leading, and
+        keep renewing; returns after the lease is lost or stop() is called
+        (the reference exits the process here)."""
+        while not self._stop:
+            if self._try_acquire_or_renew(time.time()):
+                break
+            await asyncio.sleep(self.retry_period)
+        if self._stop:
+            return
+        self.is_leader = True
+        log.info("%s: became leader of %s/%s", self.identity,
+                 self.lock_namespace, self.lock_name)
+        work = None
+        if self.on_started_leading is not None:
+            work = asyncio.get_running_loop().create_task(
+                self.on_started_leading())
+        try:
+            deadline = time.time() + self.renew_deadline
+            while not self._stop:
+                await asyncio.sleep(self.retry_period)
+                if self._try_acquire_or_renew(time.time()):
+                    deadline = time.time() + self.renew_deadline
+                elif time.time() > deadline:
+                    log.warning("%s: failed to renew lease within %.1fs",
+                                self.identity, self.renew_deadline)
+                    break
+        finally:
+            self.is_leader = False
+            if work is not None:
+                work.cancel()
+            if self.on_stopped_leading is not None:
+                self.on_stopped_leading()
+
+    def stop(self) -> None:
+        self._stop = True
+
+
+class _Lost(Exception):
+    pass

@@ -1,0 +1,127 @@
+"""The scheduler server binary: flags, healthz/metrics, leader election.
+
+The plugin/cmd/kube-scheduler analog (app/server.go:67 Run: options ->
+healthz+metrics endpoints :151 -> optional leader election :111-143 ->
+scheduler loop). Connects to an HTTP apiserver (apiserver.http.APIServer)
+and schedules against the one TPU-backed solver.
+
+    python -m kubernetes_tpu.cmd.scheduler \
+        --apiserver http://127.0.0.1:8080 \
+        --policy-config-file policy.json --leader-elect \
+        --port 10251
+
+The in-process variant (--apiserver omitted) starts its own store + HTTP
+apiserver — the hollow/integration topology.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+import os
+import socket
+import sys
+from urllib.parse import urlsplit
+
+from kubernetes_tpu.models.policy import DEFAULT_POLICY, Policy
+from kubernetes_tpu.state import Capacities
+
+log = logging.getLogger(__name__)
+
+
+def parse_args(argv=None) -> argparse.Namespace:
+    p = argparse.ArgumentParser(
+        prog="kubernetes-tpu-scheduler",
+        description="TPU-native batch scheduler (kube-scheduler analog)")
+    p.add_argument("--apiserver", default="",
+                   help="HTTP apiserver URL; empty starts an in-process "
+                        "store + apiserver on --apiserver-port")
+    p.add_argument("--apiserver-port", type=int, default=8080)
+    p.add_argument("--persist-path", default="",
+                   help="WAL file for the in-process store (etcd-like "
+                        "durability: state survives SIGKILL + restart)")
+    p.add_argument("--port", type=int, default=10251,
+                   help="healthz/metrics port (0 = ephemeral)")
+    p.add_argument("--scheduler-name", default="default-scheduler")
+    p.add_argument("--policy-config-file", default="",
+                   help="scheduler Policy JSON (api/types.go:38)")
+    p.add_argument("--leader-elect", action="store_true")
+    p.add_argument("--lock-object-name", default="kube-scheduler")
+    p.add_argument("--lock-object-namespace", default="kube-system")
+    p.add_argument("--num-nodes", type=int, default=1024,
+                   help="node-axis capacity (padded)")
+    p.add_argument("--batch-pods", type=int, default=256,
+                   help="pending pods per solver batch")
+    return p.parse_args(argv)
+
+
+def load_policy(path: str) -> Policy:
+    if not path:
+        return DEFAULT_POLICY
+    with open(path) as f:
+        return Policy.from_json(f.read())
+
+
+async def run(args: argparse.Namespace) -> None:
+    from kubernetes_tpu.scheduler import Scheduler
+    from kubernetes_tpu.scheduler.server import SchedulerServer
+
+    api_server = None
+    if args.apiserver:
+        from kubernetes_tpu.apiserver.http import RemoteStore
+
+        url = urlsplit(args.apiserver)
+        store = RemoteStore(url.hostname, url.port or 80)
+    else:
+        from kubernetes_tpu.apiserver import ObjectStore
+        from kubernetes_tpu.apiserver.http import APIServer
+
+        store = ObjectStore(persist_path=args.persist_path or None)
+        api_server = APIServer(store, port=args.apiserver_port)
+        await api_server.start()
+        log.info("in-process apiserver at %s", api_server.url)
+
+    caps = Capacities(num_nodes=args.num_nodes, batch_pods=args.batch_pods)
+    sched = Scheduler(store, caps=caps, policy=load_policy(
+        args.policy_config_file), scheduler_name=args.scheduler_name)
+    server = SchedulerServer(sched, port=args.port)
+    await server.start()
+    log.info("healthz/metrics at %s", server.url)
+
+    try:
+        if args.leader_elect:
+            from kubernetes_tpu.client.leaderelection import LeaderElector
+
+            identity = f"{socket.gethostname()}_{os.getpid()}"
+            elector = LeaderElector(
+                store, identity,
+                lock_name=args.lock_object_name,
+                lock_namespace=args.lock_object_namespace,
+                on_started_leading=sched.run)
+            # returns when the lease is lost: crash-only handoff — exit and
+            # let the supervisor restart us as a standby (server.go:140)
+            await elector.run()
+            log.warning("lost leader lease; exiting")
+        else:
+            await sched.run()
+    finally:
+        sched.stop()
+        await server.stop()
+        if api_server is not None:
+            await api_server.stop()
+
+
+def main(argv=None) -> int:
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(levelname).1s %(name)s: %(message)s")
+    try:
+        asyncio.run(run(parse_args(argv)))
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

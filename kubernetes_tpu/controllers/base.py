@@ -65,24 +65,29 @@ class Expectations:
 
 
 async def slow_start_batch(count: int, fn: Callable[[], Awaitable[bool]],
-                           initial: int = SLOW_START_INITIAL) -> int:
+                           initial: int = SLOW_START_INITIAL
+                           ) -> tuple[int, int]:
     """slowStartBatch (controller_utils.go:744): run `count` create calls in
     doubling batches, stopping at the first batch with a failure. Returns
-    successful calls."""
+    (successes, attempted) — callers must release expectations for the
+    `count - attempted` calls that were never made (the reference's
+    skippedPods loop, replica_set.go:478)."""
     remaining = count
     successes = 0
+    attempted = 0
     batch = initial
     while remaining > 0:
         n = min(batch, remaining)
         results = await asyncio.gather(*(fn() for _ in range(n)),
                                        return_exceptions=True)
+        attempted += n
         ok = sum(1 for r in results if r is True)
         successes += ok
         if ok < n:
             break
         remaining -= n
         batch = 2 * batch
-    return successes
+    return successes, attempted
 
 
 class ReconcileController:

@@ -1,0 +1,127 @@
+"""Endpoint controller: Services acquire endpoints as pods go Ready.
+
+The pkg/controller/endpoint/endpoints_controller.go analog: for every
+Service with a selector, maintain one same-named Endpoints object whose
+subsets carry the addresses of Ready bound pods matching the selector
+(addresses) and matching-but-unready pods (notReadyAddresses), with ports
+mapped from the Service spec (:syncService, :420 computeEndpoints shape).
+Services without a selector are user-managed (skipped), exactly the
+reference's headless/external case.
+"""
+
+from __future__ import annotations
+
+from kubernetes_tpu.api.objects import Endpoints, ObjectMeta, Pod
+from kubernetes_tpu.apiserver.store import Conflict, NotFound, ObjectStore
+from kubernetes_tpu.client.informer import Informer
+from kubernetes_tpu.controllers.base import ReconcileController
+from kubernetes_tpu.controllers.replicaset import pod_ready
+
+
+def _pod_address(pod: Pod) -> dict:
+    addr = {"targetRef": {"kind": "Pod", "name": pod.metadata.name,
+                          "namespace": pod.metadata.namespace,
+                          "uid": pod.metadata.uid}}
+    # hollow pods have no IPs; hostIP/nodeName identify the backend
+    if pod.status.host_ip:
+        addr["ip"] = pod.status.host_ip
+    if pod.spec.node_name:
+        addr["nodeName"] = pod.spec.node_name
+    return addr
+
+
+def _service_ports(service) -> list[dict]:
+    out = []
+    for p in service.spec.get("ports") or [{}]:
+        port = {}
+        if p.get("name"):
+            port["name"] = p["name"]
+        if p.get("targetPort") or p.get("port"):
+            port["port"] = int(p.get("targetPort") or p.get("port"))
+        port["protocol"] = p.get("protocol", "TCP")
+        out.append(port)
+    return out
+
+
+class EndpointController(ReconcileController):
+    workers = 2
+
+    def __init__(self, store: ObjectStore, service_informer: Informer,
+                 pod_informer: Informer):
+        super().__init__()
+        self.name = "endpoint-controller"
+        self.store = store
+        self.services = service_informer
+        self.pods = pod_informer
+        service_informer.add_handler(self._on_service)
+        pod_informer.add_handler(self._on_pod)
+
+    def _on_service(self, event) -> None:
+        self.enqueue(event.obj.key)
+
+    def _on_pod(self, event) -> None:
+        # enqueue every service whose selector matches the pod's labels
+        # (addPod, endpoints_controller.go:150 getPodServiceMemberships)
+        pod = event.obj
+        for svc in self.services.items():
+            if svc.metadata.namespace != pod.metadata.namespace:
+                continue
+            sel = svc.selector
+            if sel is None:
+                continue
+            if all(pod.metadata.labels.get(k) == v for k, v in sel.items()):
+                self.enqueue(svc.key)
+
+    async def sync(self, key: str) -> None:
+        ns, name = key.split("/", 1)
+        svc = self.services.get(name, ns)
+        if svc is None:
+            # service deleted: its endpoints go with it (syncService :367)
+            try:
+                self.store.delete("Endpoints", name, ns)
+            except NotFound:
+                pass
+            return
+        sel = svc.selector
+        if sel is None:
+            return  # selector-less services manage their own endpoints
+
+        ready, not_ready = [], []
+        for pod in self.pods.items():
+            if pod.metadata.namespace != ns or not pod.spec.node_name:
+                continue
+            if pod.status.phase in ("Succeeded", "Failed"):
+                continue
+            if not all(pod.metadata.labels.get(k) == v
+                       for k, v in sel.items()):
+                continue
+            (ready if pod_ready(pod) else not_ready).append(
+                _pod_address(pod))
+        subset: dict = {}
+        if ready:
+            subset["addresses"] = sorted(
+                ready, key=lambda a: a["targetRef"]["name"])
+        if not_ready:
+            subset["notReadyAddresses"] = sorted(
+                not_ready, key=lambda a: a["targetRef"]["name"])
+        if subset:
+            subset["ports"] = _service_ports(svc)
+        subsets = [subset] if subset else []
+
+        try:
+            current = self.store.get("Endpoints", name, ns)
+        except NotFound:
+            current = None
+        if current is not None and current.subsets == subsets:
+            return
+        if current is None:
+            self.store.create(Endpoints(
+                metadata=ObjectMeta(name=name, namespace=ns),
+                subsets=subsets))
+        else:
+            fresh = current.clone()
+            fresh.subsets = subsets
+            try:
+                self.store.update(fresh)
+            except Conflict:
+                self.enqueue(key)  # retry against the newer version

@@ -57,6 +57,19 @@ class GarbageCollector(ReconcileController):
         owner = informer.get(ref.get("name", ""), namespace)
         return owner is not None and owner.metadata.uid == ref.get("uid")
 
+    def _owner_live(self, namespace: str, ref: dict) -> bool:
+        """Re-check against the store itself: the pod and owner informers
+        ride independent watch streams, so a pod can be observed before its
+        just-created owner's ADDED lands — the reference GC confirms absence
+        with a live apiserver read before deleting (garbagecollector.go
+        attemptToDeleteItem; ADVICE r2 #2)."""
+        try:
+            owner = self.store.get(ref.get("kind", ""), ref.get("name", ""),
+                                   namespace)
+        except (NotFound, KeyError):
+            return False
+        return owner.metadata.uid == ref.get("uid")
+
     async def sync(self, key: str) -> None:
         ns, name = key.split("/", 1)
         pod = self.pods.get(name, ns)
@@ -65,6 +78,8 @@ class GarbageCollector(ReconcileController):
         ref = controller_ref(pod)
         if ref is None or self._owner_exists(ns, ref):
             return
+        if self._owner_live(ns, ref):
+            return  # informer lag, not a real orphan
         try:
             self.store.delete("Pod", name, ns)
         except NotFound:

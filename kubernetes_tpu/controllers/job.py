@@ -1,0 +1,148 @@
+"""Job controller: run-to-completion workloads.
+
+The pkg/controller/job/jobcontroller.go analog (syncJob :436, manageJob
+:593): keep `parallelism` active pods while fewer than `completions` have
+Succeeded; count Succeeded/Failed into status; on completion, add the
+Complete condition and delete nothing (finished pods are the record). Uses
+the shared expectations + slow-start machinery the way the reference does.
+"""
+
+from __future__ import annotations
+
+import time
+
+from kubernetes_tpu.api.objects import Pod
+from kubernetes_tpu.apiserver.store import NotFound, ObjectStore
+from kubernetes_tpu.client.informer import Informer
+from kubernetes_tpu.controllers.base import ReconcileController, slow_start_batch
+from kubernetes_tpu.controllers.replicaset import (
+    controller_ref,
+    is_active,
+    pod_from_template,
+)
+from kubernetes_tpu.state.podaffinity import (
+    PARSE_ERROR,
+    canonical_selector,
+    selector_matches,
+)
+
+
+class JobController(ReconcileController):
+    workers = 2
+
+    def __init__(self, store: ObjectStore, job_informer: Informer,
+                 pod_informer: Informer):
+        super().__init__()
+        self.name = "job-controller"
+        self.store = store
+        self.jobs = job_informer
+        self.pods = pod_informer
+        job_informer.add_handler(self._on_job)
+        pod_informer.add_handler(self._on_pod)
+
+    def _on_job(self, event) -> None:
+        if event.obj.kind == "Job":
+            if event.type == "DELETED":
+                self.expectations.forget(event.obj.key)
+            self.enqueue(event.obj.key)
+
+    def _on_pod(self, event) -> None:
+        ref = controller_ref(event.obj)
+        if ref is None or ref.get("kind") != "Job":
+            return
+        key = f"{event.obj.metadata.namespace}/{ref.get('name')}"
+        if event.type == "ADDED":
+            self.expectations.creation_observed(key)
+        elif event.type == "DELETED":
+            self.expectations.deletion_observed(key)
+        self.enqueue(key)
+
+    def _owned(self, job) -> list[Pod]:
+        canon = canonical_selector(job.selector or None)
+        out = []
+        for pod in self.pods.items():
+            if pod.metadata.namespace != job.metadata.namespace:
+                continue
+            ref = controller_ref(pod)
+            if ref is not None and ref.get("uid") == job.metadata.uid:
+                out.append(pod)
+            elif ref is None and canon not in ((), PARSE_ERROR) \
+                    and selector_matches(canon, pod.metadata.labels):
+                out.append(pod)
+        return out
+
+    async def sync(self, key: str) -> None:
+        ns, name = key.split("/", 1)
+        job = self.jobs.get(name, ns)
+        if job is None:
+            self.expectations.forget(key)
+            return
+        if not self.expectations.satisfied(key):
+            return
+        pods = self._owned(job)
+        succeeded = sum(1 for p in pods if p.status.phase == "Succeeded")
+        failed = sum(1 for p in pods if p.status.phase == "Failed")
+        active = [p for p in pods if is_active(p)]
+        complete = succeeded >= job.completions
+
+        if complete:
+            # excess active workers are no longer needed (syncJob :520)
+            for pod in active:
+                try:
+                    self.store.delete("Pod", pod.metadata.name, ns)
+                except NotFound:
+                    pass
+        else:
+            # keep `parallelism` workers, but never more than the work left
+            want = min(job.parallelism,
+                       job.completions - succeeded) - len(active)
+            if want > 0:
+                self.expectations.expect(key, adds=want)
+                template = job.spec.get("template") or {}
+
+                async def create_one() -> bool:
+                    pod = pod_from_template(job, template)
+                    if not pod.metadata.labels:
+                        pod.metadata.labels = dict(
+                            (job.selector or {}).get("matchLabels") or {})
+                    # job pods must not restart forever (validation defaults
+                    # them to OnFailure/Never)
+                    if pod.spec.restart_policy == "Always":
+                        pod.spec.restart_policy = "OnFailure"
+                    try:
+                        self.store.create(pod)
+                        return True
+                    except Exception:  # noqa: BLE001
+                        self.expectations.creation_observed(key)
+                        return False
+
+                _ok, attempted = await slow_start_batch(want, create_one)
+                for _ in range(want - attempted):
+                    self.expectations.creation_observed(key)
+
+        self._update_status(job, len(active), succeeded, failed, complete)
+
+    def _update_status(self, job, active: int, succeeded: int, failed: int,
+                       complete: bool) -> None:
+        fresh = self.jobs.get(job.metadata.name, job.metadata.namespace)
+        if fresh is None:
+            return
+        status = dict(fresh.status)
+        status.update({"active": active, "succeeded": succeeded,
+                       "failed": failed})
+        if complete and not any(
+                c.get("type") == "Complete"
+                for c in status.get("conditions", [])):
+            status.setdefault("conditions", []).append({
+                "type": "Complete", "status": "True",
+                "lastTransitionTime": time.time()})
+            status["completionTime"] = time.time()
+            status["active"] = 0
+        if status == fresh.status:
+            return
+        fresh = fresh.clone()
+        fresh.status = status
+        try:
+            self.store.update(fresh)
+        except Exception:  # noqa: BLE001 — status write is best-effort
+            pass

@@ -10,9 +10,12 @@ from __future__ import annotations
 from kubernetes_tpu.apiserver.store import ObjectStore
 from kubernetes_tpu.client.informer import Informer
 from kubernetes_tpu.controllers.deployment import DeploymentController
+from kubernetes_tpu.controllers.endpoints import EndpointController
 from kubernetes_tpu.controllers.gc import GarbageCollector
+from kubernetes_tpu.controllers.job import JobController
 from kubernetes_tpu.controllers.nodelifecycle import NodeLifecycleController
 from kubernetes_tpu.controllers.replicaset import ReplicaManager
+from kubernetes_tpu.controllers.statefulset import StatefulSetController
 
 
 class ControllerManager:
@@ -22,9 +25,9 @@ class ControllerManager:
         self.store = store
         self.informers: dict[str, Informer] = {
             kind: Informer(store, kind)
-            for kind in ("Pod", "Node", "ReplicaSet",
+            for kind in ("Pod", "Node", "Service", "ReplicaSet",
                          "ReplicationController", "StatefulSet",
-                         "Deployment")}
+                         "Deployment", "Job")}
         pods = self.informers["Pod"]
         self.replicaset = ReplicaManager(
             store, "ReplicaSet", self.informers["ReplicaSet"], pods)
@@ -33,13 +36,19 @@ class ControllerManager:
             self.informers["ReplicationController"], pods)
         self.deployment = DeploymentController(
             store, self.informers["Deployment"], self.informers["ReplicaSet"])
+        self.statefulset = StatefulSetController(
+            store, self.informers["StatefulSet"], pods)
+        self.job = JobController(store, self.informers["Job"], pods)
+        self.endpoints = EndpointController(
+            store, self.informers["Service"], pods)
         self.controllers = [self.replicaset, self.replication,
-                            self.deployment]
+                            self.deployment, self.statefulset, self.job,
+                            self.endpoints]
         if enable_gc:
             self.gc = GarbageCollector(
                 store, pods,
                 {k: v for k, v in self.informers.items()
-                 if k not in ("Pod", "Node")})
+                 if k not in ("Pod", "Node", "Service")})
             self.controllers.append(self.gc)
         if enable_node_lifecycle:
             self.node_lifecycle = NodeLifecycleController(
@@ -61,6 +70,12 @@ class ControllerManager:
             self.replication.enqueue(obj.key)
         for obj in self.informers["Deployment"].items():
             self.deployment.enqueue(obj.key)
+        for obj in self.informers["StatefulSet"].items():
+            self.statefulset.enqueue(obj.key)
+        for obj in self.informers["Job"].items():
+            self.job.enqueue(obj.key)
+        for obj in self.informers["Service"].items():
+            self.endpoints.enqueue(obj.key)
 
     def stop(self) -> None:
         for controller in self.controllers:

@@ -67,6 +67,10 @@ class NodeLifecycleController:
         self._not_ready_since: dict[str, float] = {}
         self._eviction_q: asyncio.Queue[str] = asyncio.Queue()
         self._queued: set[str] = set()
+        # drained dead nodes: not re-queued (each re-eviction would burn a
+        # rate token doing nothing) unless pods land on them again; cleared
+        # on recovery
+        self._evicted: set[str] = set()
         self._tasks: list[asyncio.Task] = []
         self.evicted_pods = 0  # observability counter
 
@@ -85,6 +89,11 @@ class NodeLifecycleController:
     def monitor_once(self, now: float | None = None) -> None:
         """One monitorNodeStatus pass (exposed for tests)."""
         now = time.time() if now is None else now
+        pods_on: dict[str, int] = {}
+        for p in self.pods.items():
+            if p.spec.node_name:
+                pods_on[p.spec.node_name] = pods_on.get(p.spec.node_name,
+                                                        0) + 1
         seen = set()
         for node in self.nodes.items():
             name = node.metadata.name
@@ -108,19 +117,21 @@ class NodeLifecycleController:
                     # healthy: clear tracking, cancel any pending eviction
                     self._not_ready_since.pop(name, None)
                     self._queued.discard(name)
+                    self._evicted.discard(name)
             else:
                 since = self._track_not_ready(
                     name, min(now, ready.last_transition_time or now))
                 if now - since > self.eviction_timeout \
-                        and name not in self._queued:
+                        and name not in self._queued \
+                        and (name not in self._evicted
+                             or pods_on.get(name)):
                     self._queued.add(name)
                     self._eviction_q.put_nowait(name)
         # pods bound to a Node object that no longer exists are stranded the
         # same way a dead kubelet strands them — evict (the reference's
         # deleteNode path, node_controller.go:426). Grace-period the first
         # sighting: a bind may race ahead of its node's ADDED event.
-        missing = {p.spec.node_name for p in self.pods.items()
-                   if p.spec.node_name and p.spec.node_name not in seen}
+        missing = set(pods_on) - seen
         for name in missing:
             since = self._track_not_ready(name, now)
             if now - since > self.grace_period and name not in self._queued:
@@ -201,5 +212,6 @@ class NodeLifecycleController:
             self._queued.discard(name)
             if self._still_dead(name):
                 self.evict_node_pods(name)
+                self._evicted.add(name)
             # token pacing: at most eviction_rate nodes drained per second
             await asyncio.sleep(1.0 / max(self.eviction_rate, 1e-9))

@@ -89,7 +89,9 @@ def pod_from_template(owner, template: dict) -> Pod:
 
     d = copy.deepcopy(template or {})
     meta = d.setdefault("metadata", {})
-    meta["name"] = f"{owner.metadata.name}-{uuid.uuid4().hex[:5]}"
+    # 10 hex chars: a 500-pod burst had ~10% AlreadyExists odds at 5 chars
+    # (ADVICE r2 #3); the reference survives collisions via apiserver retry
+    meta["name"] = f"{owner.metadata.name}-{uuid.uuid4().hex[:10]}"
     meta["namespace"] = owner.metadata.namespace
     meta.pop("uid", None)
     meta.setdefault("labels", {})
@@ -231,7 +233,12 @@ class ReplicaManager(ReconcileController):
                     self.expectations.creation_observed(key)  # lower burden
                     return False
 
-            await slow_start_batch(want, create_one)
+            _ok, attempted = await slow_start_batch(want, create_one)
+            # expectations for never-attempted creates must be released or
+            # the RS is ignored until the 5-minute TTL (skippedPods,
+            # replica_set.go:478; ADVICE r2 #1)
+            for _ in range(want - attempted):
+                self.expectations.creation_observed(key)
         elif diff > 0:
             want = min(diff, BURST_REPLICAS)
             victims = sorted(pods, key=deletion_order_key)[:want]

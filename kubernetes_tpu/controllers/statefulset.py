@@ -1,0 +1,141 @@
+"""StatefulSet controller: ordered, stably-named pods.
+
+The pkg/controller/statefulset/stateful_set.go analog (sync loop
+:syncStatefulSet -> stateful_set_control.go UpdateStatefulSet): replicas
+get ordinal identities `<name>-0 .. <name>-(N-1)`; scale-up creates the
+lowest missing ordinal only after every lower ordinal is Running and Ready
+(OrderedReady semantics, stateful_set_control.go:428); scale-down deletes
+the highest ordinal first, one at a time, and only when every remaining pod
+is healthy (:464). Identity is stable: a deleted ordinal is recreated with
+the same name.
+"""
+
+from __future__ import annotations
+
+import copy
+import re
+
+from kubernetes_tpu.api.objects import Pod
+from kubernetes_tpu.apiserver.store import AlreadyExists, NotFound, ObjectStore
+from kubernetes_tpu.client.informer import Informer
+from kubernetes_tpu.controllers.base import ReconcileController
+from kubernetes_tpu.controllers.replicaset import (
+    controller_ref,
+    is_active,
+    make_controller_ref,
+    pod_ready,
+)
+
+
+def ordinal_of(set_name: str, pod_name: str) -> int | None:
+    """getOrdinal (stateful_set_utils.go:53): <setname>-<ordinal>."""
+    m = re.fullmatch(re.escape(set_name) + r"-(\d+)", pod_name)
+    return int(m.group(1)) if m else None
+
+
+class StatefulSetController(ReconcileController):
+    workers = 2
+
+    def __init__(self, store: ObjectStore, set_informer: Informer,
+                 pod_informer: Informer):
+        super().__init__()
+        self.name = "statefulset-controller"
+        self.store = store
+        self.sets = set_informer
+        self.pods = pod_informer
+        set_informer.add_handler(self._on_set)
+        pod_informer.add_handler(self._on_pod)
+
+    def _on_set(self, event) -> None:
+        if event.obj.kind == "StatefulSet":
+            self.enqueue(event.obj.key)
+
+    def _on_pod(self, event) -> None:
+        ref = controller_ref(event.obj)
+        if ref is not None and ref.get("kind") == "StatefulSet":
+            self.enqueue(f"{event.obj.metadata.namespace}/{ref.get('name')}")
+
+    def _owned_by_ordinal(self, sts) -> dict[int, Pod]:
+        owned: dict[int, Pod] = {}
+        for pod in self.pods.items():
+            if pod.metadata.namespace != sts.metadata.namespace \
+                    or not is_active(pod):
+                continue
+            ref = controller_ref(pod)
+            if ref is None or ref.get("uid") != sts.metadata.uid:
+                continue
+            ordinal = ordinal_of(sts.metadata.name, pod.metadata.name)
+            if ordinal is not None:
+                owned[ordinal] = pod
+        return owned
+
+    def _make_pod(self, sts, ordinal: int) -> Pod:
+        d = copy.deepcopy(sts.spec.get("template") or {})
+        meta = d.setdefault("metadata", {})
+        meta["name"] = f"{sts.metadata.name}-{ordinal}"
+        meta["namespace"] = sts.metadata.namespace
+        meta.pop("uid", None)
+        labels = meta.setdefault("labels", {})
+        if not labels:
+            labels.update((sts.spec.get("selector") or {})
+                          .get("matchLabels") or {})
+        # the stable-identity labels (stateful_set_utils.go:95)
+        labels["statefulset.kubernetes.io/pod-name"] = meta["name"]
+        meta["ownerReferences"] = [make_controller_ref(sts)]
+        pod = Pod.from_dict(d)
+        # stable network identity: hostname == pod name
+        pod.spec.node_selector = dict(pod.spec.node_selector)
+        return pod
+
+    async def sync(self, key: str) -> None:
+        ns, name = key.split("/", 1)
+        sts = self.sets.get(name, ns)
+        if sts is None:
+            return
+        owned = self._owned_by_ordinal(sts)
+        want = sts.replicas
+
+        # scale up: create the LOWEST missing ordinal < want, but only once
+        # every lower ordinal is Running and Ready (OrderedReady)
+        for ordinal in range(want):
+            pod = owned.get(ordinal)
+            if pod is None:
+                if all(pod_ready(owned[i]) for i in range(ordinal)
+                       if i in owned):
+                    try:
+                        self.store.create(self._make_pod(sts, ordinal))
+                    except AlreadyExists:
+                        pass
+                # one create per sync; the pod's events re-enqueue us
+                self._update_status(sts, owned)
+                return
+            if not pod_ready(pod):
+                # wait for this ordinal before creating higher ones
+                self._update_status(sts, owned)
+                return
+
+        # scale down: delete the HIGHEST ordinal >= want, one at a time
+        extra = sorted((o for o in owned if o >= want), reverse=True)
+        if extra:
+            victim = owned[extra[0]]
+            try:
+                self.store.delete("Pod", victim.metadata.name, ns)
+            except NotFound:
+                pass
+        self._update_status(sts, owned)
+
+    def _update_status(self, sts, owned: dict[int, Pod]) -> None:
+        fresh = self.sets.get(sts.metadata.name, sts.metadata.namespace)
+        if fresh is None:
+            return
+        status = {"replicas": len(owned),
+                  "readyReplicas": sum(1 for p in owned.values()
+                                       if pod_ready(p))}
+        if fresh.status == status:
+            return
+        fresh = fresh.clone()
+        fresh.status = status
+        try:
+            self.store.update(fresh)
+        except Exception:  # noqa: BLE001 — status write is best-effort
+            pass

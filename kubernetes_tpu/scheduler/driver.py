@@ -54,6 +54,7 @@ class SchedulerMetrics:
     # bounded windows (the reference uses fixed-bucket Prometheus histograms)
     e2e_latency: deque = field(default_factory=lambda: deque(maxlen=8192))
     algorithm_latency: deque = field(default_factory=lambda: deque(maxlen=8192))
+    binding_latency: deque = field(default_factory=lambda: deque(maxlen=8192))
 
     def snapshot(self) -> dict:
         lat = sorted(self.e2e_latency) or [0.0]
@@ -432,6 +433,7 @@ class Scheduler:
         scheduled = 0
         committed: list[tuple[Pod, str, int]] = []
         any_rejected = False
+        t_bind = time.monotonic()
         for i, (key, pod) in enumerate(zip(live_keys, pods)):
             row = int(assignments[i])
             if row < 0:
@@ -479,6 +481,10 @@ class Scheduler:
             self.statedb.commit_batch(
                 result, fblob, committed, replace_device=not adopted,
                 coverage=ledger_coverage(self.policy, flags))
+        if scheduled:
+            # per-pod binding latency (the batch amortizes one write loop)
+            self.metrics.binding_latency.append(
+                (time.monotonic() - t_bind) / scheduled)
         self.metrics.scheduled += scheduled
         self.metrics.batches += 1
         if self.metrics.batches % 128 == 0:

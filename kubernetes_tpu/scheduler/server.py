@@ -1,0 +1,129 @@
+"""Scheduler observability endpoints: /healthz + Prometheus /metrics.
+
+The plugin/cmd/kube-scheduler server surface (app/server.go:151 installs
+healthz and the Prometheus handler): text exposition of the reference's
+scheduler histograms (metrics/metrics.go:31-50 —
+e2e_scheduling_latency_microseconds, scheduling_algorithm_latency_
+microseconds, binding_latency_microseconds with ExponentialBuckets(1000, 2,
+15)) plus the framework's counters. Latency windows are converted to
+cumulative histogram buckets at scrape time.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Iterable
+
+from kubernetes_tpu.scheduler.driver import Scheduler
+
+# ExponentialBuckets(1000, 2, 15) in microseconds (metrics.go:36)
+BUCKETS_US = [1000.0 * (2 ** i) for i in range(15)]
+
+
+def _histogram(name: str, help_text: str,
+               samples_seconds: Iterable[float]) -> str:
+    samples = [1e6 * s for s in samples_seconds]  # seconds -> microseconds
+    lines = [f"# HELP {name} {help_text}", f"# TYPE {name} histogram"]
+    cumulative = 0
+    remaining = sorted(samples)
+    idx = 0
+    for bound in BUCKETS_US:
+        while idx < len(remaining) and remaining[idx] <= bound:
+            idx += 1
+        cumulative = idx
+        lines.append(f'{name}_bucket{{le="{bound:g}"}} {cumulative}')
+    lines.append(f'{name}_bucket{{le="+Inf"}} {len(remaining)}')
+    lines.append(f"{name}_sum {sum(remaining):g}")
+    lines.append(f"{name}_count {len(remaining)}")
+    return "\n".join(lines)
+
+
+def render_metrics(sched: Scheduler) -> str:
+    m = sched.metrics
+    parts = [
+        "# HELP scheduler_pods_scheduled_total Pods successfully bound.",
+        "# TYPE scheduler_pods_scheduled_total counter",
+        f"scheduler_pods_scheduled_total {m.scheduled}",
+        "# HELP scheduler_pods_failed_total Scheduling attempts that failed.",
+        "# TYPE scheduler_pods_failed_total counter",
+        f"scheduler_pods_failed_total {m.failed}",
+        "# HELP scheduler_binding_errors_total Bind writes rejected.",
+        "# TYPE scheduler_binding_errors_total counter",
+        f"scheduler_binding_errors_total {m.binding_errors}",
+        "# HELP scheduler_batches_total Solver batches dispatched.",
+        "# TYPE scheduler_batches_total counter",
+        f"scheduler_batches_total {m.batches}",
+        _histogram("e2e_scheduling_latency_microseconds",
+                   "E2e scheduling latency (queue arrival to bind).",
+                   m.e2e_latency),
+        _histogram("scheduling_algorithm_latency_microseconds",
+                   "Scheduling algorithm (device solve) latency.",
+                   m.algorithm_latency),
+        _histogram("binding_latency_microseconds",
+                   "Binding latency per pod.",
+                   m.binding_latency),
+    ]
+    return "\n".join(parts) + "\n"
+
+
+class SchedulerServer:
+    """Asyncio HTTP server for /healthz and /metrics."""
+
+    def __init__(self, sched: Scheduler, host: str = "127.0.0.1",
+                 port: int = 0):
+        self.sched = sched
+        self.host = host
+        self.port = port
+        self._server: asyncio.AbstractServer | None = None
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_server(
+            self._handle, self.host, self.port)
+        self.port = self._server.sockets[0].getsockname()[1]
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None
+
+    async def _handle(self, reader: asyncio.StreamReader,
+                      writer: asyncio.StreamWriter) -> None:
+        try:
+            request_line = await reader.readline()
+            if not request_line:
+                return
+            try:
+                method, path, _ = request_line.decode().split(None, 2)
+            except ValueError:
+                return
+            while True:  # drain headers
+                line = await reader.readline()
+                if line in (b"\r\n", b"\n", b""):
+                    break
+            path = path.split("?", 1)[0].rstrip("/") or "/"
+            if method != "GET":
+                body, status, ctype = b"method not allowed", 405, "text/plain"
+            elif path in ("/", "/healthz"):
+                body, status, ctype = b"ok", 200, "text/plain"
+            elif path == "/metrics":
+                body = render_metrics(self.sched).encode()
+                status, ctype = 200, "text/plain; version=0.0.4"
+            else:
+                body, status, ctype = b"not found", 404, "text/plain"
+            reason = {200: "OK", 404: "Not Found",
+                      405: "Method Not Allowed"}.get(status, "Error")
+            writer.write(
+                f"HTTP/1.1 {status} {reason}\r\n"
+                f"Content-Type: {ctype}\r\n"
+                f"Content-Length: {len(body)}\r\n"
+                f"Connection: close\r\n\r\n".encode() + body)
+            await writer.drain()
+        except (ConnectionError, asyncio.IncompleteReadError):
+            pass
+        finally:
+            writer.close()

@@ -1,0 +1,46 @@
+"""Test helper: run an APIServer over its own event loop in a background
+thread (the deployment shape — server and clients in different processes),
+yielding a RemoteStore for the client side."""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import threading
+
+from kubernetes_tpu.apiserver import ObjectStore
+from kubernetes_tpu.apiserver.http import APIServer, RemoteStore
+
+
+@contextlib.contextmanager
+def http_store(store: ObjectStore | None = None):
+    """-> (RemoteStore client, backing ObjectStore). The backing store must
+    only be touched from the server thread after startup; tests assert on
+    final state through the client."""
+    store = store if store is not None else ObjectStore()
+    started = threading.Event()
+    holder: dict = {}
+
+    def run():
+        async def main():
+            server = APIServer(store)
+            await server.start()
+            holder["server"] = server
+            holder["loop"] = asyncio.get_running_loop()
+            holder["shutdown"] = asyncio.Event()
+            started.set()
+            await holder["shutdown"].wait()
+            await server.stop()
+
+        asyncio.run(main())
+
+    thread = threading.Thread(target=run, daemon=True)
+    thread.start()
+    if not started.wait(10):
+        raise RuntimeError("APIServer thread failed to start")
+    server = holder["server"]
+    try:
+        yield RemoteStore(server.host, server.port), store
+    finally:
+        holder["loop"].call_soon_threadsafe(holder["shutdown"].set)
+        thread.join(timeout=10)

@@ -250,3 +250,32 @@ def test_bound_pods_from_elsewhere_are_accounted():
         sched.stop()
 
     asyncio.run(run())
+
+
+def test_end_to_end_binding_over_http():
+    """The same e2e flow with the control plane behind the HTTP apiserver:
+    informers list+watch over TCP, bindings go through the pods/binding
+    subresource (VERDICT r2 #4 done-criterion)."""
+    from tests.http_util import http_store
+
+    async def run():
+        with http_store() as (client, _server_store):
+            for node in make_nodes(20):
+                client.create(node)
+            sched = Scheduler(client, caps=CAPS)
+            await sched.start()
+            for pod in make_pods(40):
+                client.create(pod)
+            got = await drain(sched, 40, timeout=30.0)
+            assert got == 40
+            bound = [p for p in client.list("Pod") if p.spec.node_name]
+            assert len(bound) == 40
+            counts = {}
+            for p in bound:
+                counts[p.spec.node_name] = counts.get(p.spec.node_name, 0) + 1
+            assert max(counts.values()) == 2
+            events = client.list("Event")
+            assert any(e.reason == "Scheduled" for e in events)
+            sched.stop()
+
+    asyncio.run(run())

@@ -1,0 +1,131 @@
+"""Leader election (client-go leaderelection.go:138-190 semantics over the
+store's CAS) and the scheduler's healthz/metrics endpoints
+(plugin/cmd/kube-scheduler/app/server.go:151)."""
+
+import asyncio
+import json
+import urllib.request
+
+from kubernetes_tpu.apiserver import ObjectStore
+from kubernetes_tpu.client.leaderelection import (
+    LEADER_ANNOTATION,
+    LeaderElectionRecord,
+    LeaderElector,
+)
+
+
+def record_of(store):
+    obj = store.get("Endpoints", "kube-scheduler", "kube-system")
+    return LeaderElectionRecord.from_json(
+        obj.metadata.annotations[LEADER_ANNOTATION])
+
+
+def test_single_candidate_acquires_and_renews():
+    async def run():
+        store = ObjectStore()
+        led = asyncio.Event()
+        elector = LeaderElector(
+            store, "a", lease_duration=0.5, renew_deadline=0.3,
+            retry_period=0.05,
+            on_started_leading=lambda: _set_and_wait(led))
+        task = asyncio.get_running_loop().create_task(elector.run())
+        await asyncio.wait_for(led.wait(), 5)
+        assert elector.is_leader
+        r1 = record_of(store)
+        assert r1.holder_identity == "a"
+        await asyncio.sleep(0.12)
+        r2 = record_of(store)
+        assert r2.renew_time > r1.renew_time  # renewing
+        assert r2.leader_transitions == 0
+        elector.stop()
+        await asyncio.wait_for(task, 5)
+
+    asyncio.run(run())
+
+
+async def _set_and_wait(event):
+    event.set()
+    await asyncio.Event().wait()  # hold leadership until cancelled
+
+
+def test_two_candidates_one_leads_failover_on_death():
+    """Two schedulers, one binds; kill it, the standby takes over within
+    the lease duration (VERDICT r2 #7 done-criterion, scaled-down times)."""
+    async def run():
+        store = ObjectStore()
+        led_a, led_b = asyncio.Event(), asyncio.Event()
+        kw = dict(lease_duration=0.6, renew_deadline=0.4, retry_period=0.05)
+        a = LeaderElector(store, "a",
+                          on_started_leading=lambda: _set_and_wait(led_a),
+                          **kw)
+        b = LeaderElector(store, "b",
+                          on_started_leading=lambda: _set_and_wait(led_b),
+                          **kw)
+        loop = asyncio.get_running_loop()
+        task_a = loop.create_task(a.run())
+        await asyncio.wait_for(led_a.wait(), 5)
+        task_b = loop.create_task(b.run())
+        await asyncio.sleep(0.2)
+        assert a.is_leader and not b.is_leader
+        assert not led_b.is_set()
+
+        # kill the leader (hard death: no clean release, lease must expire)
+        task_a.cancel()
+        t0 = loop.time()
+        await asyncio.wait_for(led_b.wait(), 5)
+        takeover = loop.time() - t0
+        assert b.is_leader
+        assert takeover <= 2 * kw["lease_duration"] + 0.5
+        r = record_of(store)
+        assert r.holder_identity == "b"
+        assert r.leader_transitions == 1
+        b.stop()
+        await asyncio.wait_for(task_b, 5)
+
+    asyncio.run(run())
+
+
+def test_healthz_and_prometheus_metrics():
+    async def run():
+        from kubernetes_tpu.perf.fixtures import make_nodes, make_pods
+        from kubernetes_tpu.scheduler import Scheduler
+        from kubernetes_tpu.scheduler.server import SchedulerServer
+        from kubernetes_tpu.state import Capacities
+
+        store = ObjectStore()
+        for n in make_nodes(4):
+            store.create(n)
+        sched = Scheduler(store, caps=Capacities(num_nodes=8, batch_pods=8))
+        await sched.start()
+        for p in make_pods(8):
+            store.create(p)
+        await asyncio.sleep(0)
+        done = 0
+        async with asyncio.timeout(10):
+            while done < 8:
+                done += await sched.schedule_pending(wait=0.2)
+
+        server = SchedulerServer(sched)
+        await server.start()
+
+        def fetch(path):
+            with urllib.request.urlopen(server.url + path, timeout=5) as r:
+                return r.status, r.read().decode()
+
+        loop = asyncio.get_running_loop()
+        status, body = await loop.run_in_executor(None, fetch, "/healthz")
+        assert (status, body) == (200, "ok")
+        status, text = await loop.run_in_executor(None, fetch, "/metrics")
+        assert status == 200
+        assert "scheduler_pods_scheduled_total 8" in text
+        # reference histogram names with cumulative buckets
+        for name in ("e2e_scheduling_latency_microseconds",
+                     "scheduling_algorithm_latency_microseconds",
+                     "binding_latency_microseconds"):
+            assert f"# TYPE {name} histogram" in text
+            assert f'{name}_bucket{{le="+Inf"}}' in text
+        assert 'e2e_scheduling_latency_microseconds_count 8' in text
+        await server.stop()
+        sched.stop()
+
+    asyncio.run(run())

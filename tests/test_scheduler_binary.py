@@ -1,0 +1,73 @@
+"""The scheduler server binary, end to end as a real subprocess: in-process
+apiserver mode, healthz live, pods bound through HTTP (plugin/cmd/
+kube-scheduler analog)."""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+import urllib.request
+
+from kubernetes_tpu.api.objects import Node, Pod
+from kubernetes_tpu.apiserver.http import RemoteStore
+
+
+def free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_binary_schedules_over_http():
+    api_port, health_port = free_port(), free_port()
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "kubernetes_tpu.cmd.scheduler",
+         "--apiserver-port", str(api_port), "--port", str(health_port),
+         "--num-nodes", "64", "--batch-pods", "16"],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    try:
+        client = RemoteStore("127.0.0.1", api_port)
+        deadline = time.time() + 60
+        while True:  # wait for the in-process apiserver
+            try:
+                client.list("Node")
+                break
+            except OSError:
+                if time.time() > deadline:
+                    raise TimeoutError("apiserver never came up")
+                time.sleep(0.2)
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{health_port}/healthz", timeout=5) as r:
+            assert r.read() == b"ok"
+
+        client.create(Node.from_dict({
+            "metadata": {"name": "n0"},
+            "status": {"allocatable": {"cpu": "4", "memory": "8Gi",
+                                       "pods": "110"},
+                       "conditions": [{"type": "Ready",
+                                       "status": "True"}]}}))
+        client.create(Pod.from_dict({
+            "metadata": {"name": "p0"},
+            "spec": {"containers": [{"name": "c", "resources": {
+                "requests": {"cpu": "100m"}}}]}}))
+        deadline = time.time() + 120  # first CPU jit compile is slow
+        while True:
+            if client.get("Pod", "p0").spec.node_name == "n0":
+                break
+            if time.time() > deadline:
+                raise TimeoutError("pod never bound")
+            time.sleep(0.3)
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{health_port}/metrics", timeout=5) as r:
+            assert b"scheduler_pods_scheduled_total 1" in r.read()
+    finally:
+        proc.terminate()
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            proc.kill()
