@@ -375,8 +375,10 @@ def parse_node_affinity(affinity: dict) -> tuple[list | None, list]:
 def _rfc3339(epoch: float) -> str:
     from datetime import datetime, timezone
 
+    # microseconds: whole-second truncation would collapse a creation burst
+    # into ties and scramble youngest-first victim ranking after WAL replay
     return datetime.fromtimestamp(epoch, timezone.utc).isoformat(
-        timespec="seconds").replace("+00:00", "Z")
+        timespec="microseconds").replace("+00:00", "Z")
 
 
 def _cond_time(value) -> float:

@@ -410,6 +410,13 @@ class RemoteStore:
         decoded = self._request("GET", self._path("Pod"))
         return int(decoded["metadata"]["resourceVersion"])
 
+    def list_with_version(self, kind: str) -> tuple[list[Any], int]:
+        """One GET: the items and the list's own metadata.resourceVersion —
+        the atomic snapshot Informer relists from."""
+        decoded = self._request("GET", self._path(kind))
+        items = [decode_object(kind, d) for d in decoded["items"]]
+        return items, int(decoded["metadata"]["resourceVersion"])
+
     def get(self, kind: str, name: str, namespace: str = "default") -> Any:
         return decode_object(kind, self._request(
             "GET", self._path(kind, namespace, name)))

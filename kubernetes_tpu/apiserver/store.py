@@ -225,6 +225,12 @@ class ObjectStore:
             out.append(obj.clone() if copy_objects else obj)
         return out
 
+    def list_with_version(self, kind: str) -> tuple[list[Any], int]:
+        """(items, resourceVersion) as ONE consistent snapshot — the List
+        half of ListAndWatch (a separate resource_version read would let
+        events slip between the two and be delivered twice on resume)."""
+        return self.list(kind, copy_objects=False), self._rv
+
     # ---- pods/binding subresource ----
 
     def bind(self, binding: Binding) -> Any:

@@ -65,9 +65,8 @@ class Informer:
                 await asyncio.sleep(0.05)
 
     async def _list_and_watch(self) -> None:
-        rv = self.store.resource_version
-        fresh = {(o.metadata.namespace, o.metadata.name): o
-                 for o in self.store.list(self.kind, copy_objects=False)}
+        items, rv = self.store.list_with_version(self.kind)
+        fresh = {(o.metadata.namespace, o.metadata.name): o for o in items}
         # replay the delta between cache and fresh list as synthetic events
         for key, obj in fresh.items():
             old = self.cache.get(key)

@@ -182,6 +182,13 @@ class LeaderElector:
             deadline = time.time() + self.renew_deadline
             while not self._stop:
                 await asyncio.sleep(self.retry_period)
+                if work is not None and work.done():
+                    # the led work died: stop renewing so a standby can take
+                    # over (the reference process would have exited)
+                    if not work.cancelled() and work.exception() is not None:
+                        log.error("%s: leading work failed: %s",
+                                  self.identity, work.exception())
+                    break
                 if self._try_acquire_or_renew(time.time()):
                     deadline = time.time() + self.renew_deadline
                 elif time.time() > deadline:

@@ -36,8 +36,19 @@ def _service_ports(service) -> list[dict]:
         port = {}
         if p.get("name"):
             port["name"] = p["name"]
-        if p.get("targetPort") or p.get("port"):
-            port["port"] = int(p.get("targetPort") or p.get("port"))
+        target = p.get("targetPort")
+        try:
+            number = int(target) if target is not None else None
+        except (TypeError, ValueError):
+            # named targetPort: the reference resolves it per pod against
+            # container ports (endpoints_controller.go:466); without a
+            # runtime there is nothing behind the name — fall back to the
+            # service port so the subset stays valid
+            number = None
+        if number is None and p.get("port") is not None:
+            number = int(p["port"])
+        if number is not None:
+            port["port"] = number
         port["protocol"] = p.get("protocol", "TCP")
         out.append(port)
     return out

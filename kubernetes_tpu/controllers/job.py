@@ -96,6 +96,20 @@ class JobController(ReconcileController):
             # keep `parallelism` workers, but never more than the work left
             want = min(job.parallelism,
                        job.completions - succeeded) - len(active)
+            if want < 0:
+                # parallelism reduced (or an over-create raced): delete the
+                # excess, worst candidates first (manageJob, :593)
+                from kubernetes_tpu.controllers.replicaset import (
+                    deletion_order_key,
+                )
+
+                victims = sorted(active, key=deletion_order_key)[:-want]
+                self.expectations.expect(key, dels=len(victims))
+                for pod in victims:
+                    try:
+                        self.store.delete("Pod", pod.metadata.name, ns)
+                    except NotFound:
+                        self.expectations.deletion_observed(key)
             if want > 0:
                 self.expectations.expect(key, adds=want)
                 template = job.spec.get("template") or {}

@@ -82,10 +82,7 @@ class StatefulSetController(ReconcileController):
         # the stable-identity labels (stateful_set_utils.go:95)
         labels["statefulset.kubernetes.io/pod-name"] = meta["name"]
         meta["ownerReferences"] = [make_controller_ref(sts)]
-        pod = Pod.from_dict(d)
-        # stable network identity: hostname == pod name
-        pod.spec.node_selector = dict(pod.spec.node_selector)
-        return pod
+        return Pod.from_dict(d)
 
     async def sync(self, key: str) -> None:
         ns, name = key.split("/", 1)

