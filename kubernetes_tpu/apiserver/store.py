@@ -74,6 +74,7 @@ class ObjectStore:
         self._history: deque[WatchEvent] = deque(maxlen=watch_window)
         self._watchers: list[tuple[str | None, asyncio.Queue]] = []
         self._wal = None
+        self._cluster_ip_counter = 0
         if persist_path:
             self._replay_wal(persist_path)
             self._wal = open(persist_path, "a", encoding="utf-8")
@@ -106,6 +107,9 @@ class ObjectStore:
                     obj = decode_object(kind, entry["obj"])
                     obj.metadata.resource_version = str(rv)
                     self._bucket(kind)[(entry["ns"], entry["name"])] = obj
+                    if kind == "Service":
+                        self._reserve_cluster_ip(
+                            obj.spec.get("clusterIP", ""))
                 self._rv = max(self._rv, rv)
 
     def _append_wal(self, event: WatchEvent) -> None:
@@ -123,6 +127,18 @@ class ObjectStore:
             entry["obj"] = obj.to_dict()
         self._wal.write(json.dumps(entry) + "\n")
         self._wal.flush()
+
+    def _reserve_cluster_ip(self, ip: str) -> None:
+        """Advance the allocator past an explicitly-given clusterIP so a
+        later auto-allocation cannot hand out a duplicate."""
+        if not ip.startswith("10.96."):
+            return
+        try:
+            _z, _z2, a, b = ip.split(".")
+            self._cluster_ip_counter = max(self._cluster_ip_counter,
+                                           int(a) * 250 + int(b) - 1)
+        except ValueError:
+            pass
 
     # ---- versioning ----
 
@@ -152,6 +168,16 @@ class ObjectStore:
         rv = self._next_rv()
         stored.metadata.resource_version = str(rv)
         stored.metadata.creation_timestamp = time.time()
+        if kind == "Service":
+            if stored.spec.get("clusterIP"):
+                self._reserve_cluster_ip(stored.spec["clusterIP"])
+            else:
+                # the service registry's ClusterIP allocation
+                # (pkg/registry/core/service/ipallocator) — sequential from
+                # the conventional service CIDR
+                self._cluster_ip_counter += 1
+                c = self._cluster_ip_counter
+                stored.spec["clusterIP"] = f"10.96.{c // 250}.{c % 250 + 1}"
         bucket[key] = stored
         # watch consumers get the stored instance itself and MUST NOT mutate
         # it (same contract as client-go informer caches)
@@ -180,6 +206,13 @@ class ObjectStore:
         rv = self._next_rv()
         stored.metadata.resource_version = str(rv)
         stored.metadata.creation_timestamp = current.metadata.creation_timestamp
+        if kind == "Service" and not stored.spec.get("clusterIP"):
+            # clusterIP is allocate-once, immutable: a spec-replacing update
+            # (kubectl apply) must not wipe it (service strategy
+            # PrepareForUpdate)
+            ip = current.spec.get("clusterIP")
+            if ip:
+                stored.spec["clusterIP"] = ip
         bucket[key] = stored
         self._publish(WatchEvent("MODIFIED", kind, stored, rv))
         return stored.clone()

@@ -1,0 +1,1 @@
+from kubernetes_tpu.cli.kubectl import main  # noqa: F401

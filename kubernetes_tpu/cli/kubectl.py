@@ -1,0 +1,298 @@
+"""kubectl-style CLI over the HTTP apiserver.
+
+The pkg/kubectl analog (reference cmd structure pkg/kubectl/cmd/cmd.go;
+resource Builder/Visitor pipeline resource/builder.go:109; printers
+pkg/printers) scoped to the verbs the framework's objects support:
+
+    get  <resource> [name] [-n ns] [-o json|wide|name] [--all-namespaces]
+    describe <resource> <name> [-n ns]
+    create -f file.json|yaml  (or - for stdin)
+    apply  -f file.json|yaml  (create-or-update by name)
+    delete <resource> <name> [-n ns]
+    scale  <workload> <name> --replicas=N
+    bind   <pod> <node>          (the pods/binding subresource)
+    logs/exec are runtime verbs: not applicable to a hollow runtime
+
+Server address from --server or $KUBECTL_SERVER (default
+http://127.0.0.1:8080). YAML input is accepted when PyYAML is available;
+JSON always works (the reference's own wire format here).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from urllib.parse import urlsplit
+
+from kubernetes_tpu.api.objects import Binding
+from kubernetes_tpu.apiserver.http import RESOURCES, RemoteStore, decode_object
+from kubernetes_tpu.apiserver.store import AlreadyExists, Conflict, NotFound
+
+# singular/short aliases -> plural resource (kubectl's RESTMapper role)
+ALIASES = {
+    "po": "pods", "pod": "pods",
+    "no": "nodes", "node": "nodes",
+    "svc": "services", "service": "services",
+    "ep": "endpoints",
+    "ev": "events", "event": "events",
+    "pv": "persistentvolumes", "persistentvolume": "persistentvolumes",
+    "pvc": "persistentvolumeclaims",
+    "persistentvolumeclaim": "persistentvolumeclaims",
+    "rc": "replicationcontrollers",
+    "replicationcontroller": "replicationcontrollers",
+    "rs": "replicasets", "replicaset": "replicasets",
+    "sts": "statefulsets", "statefulset": "statefulsets",
+    "deploy": "deployments", "deployment": "deployments",
+    "job": "jobs",
+}
+
+
+def resolve_resource(word: str) -> str:
+    plural = ALIASES.get(word.lower(), word.lower())
+    if plural not in RESOURCES:
+        raise SystemExit(f"error: unknown resource type {word!r}")
+    return plural
+
+
+def load_manifest(path: str) -> list[dict]:
+    raw = sys.stdin.read() if path == "-" else open(path).read()
+    try:
+        doc = json.loads(raw)
+        return doc if isinstance(doc, list) else [doc]
+    except json.JSONDecodeError:
+        pass
+    try:
+        import yaml  # optional; baked into most images
+    except ImportError:
+        raise SystemExit("error: manifest is not JSON and PyYAML is "
+                         "unavailable")
+    try:
+        return [d for d in yaml.safe_load_all(raw) if d]
+    except yaml.YAMLError as e:
+        raise SystemExit(f"error: cannot parse manifest: {e}")
+
+
+def _age(obj) -> str:
+    ts = obj.metadata.creation_timestamp
+    if not ts:
+        return "<unknown>"
+    secs = max(0, int(time.time() - ts))
+    if secs < 120:
+        return f"{secs}s"
+    if secs < 7200:
+        return f"{secs // 60}m"
+    return f"{secs // 3600}h"
+
+
+def _row(kind: str, obj, wide: bool) -> list[str]:
+    if kind == "Pod":
+        row = [obj.metadata.name, obj.status.phase or "Pending", _age(obj)]
+        if wide:
+            row.append(obj.spec.node_name or "<none>")
+        return row
+    if kind == "Node":
+        ready = next((c.status for c in obj.status.conditions
+                      if c.type == "Ready"), "Unknown")
+        status = {"True": "Ready", "False": "NotReady"}.get(
+            ready, "NotReady" if obj.status.conditions else "Unknown")
+        return [obj.metadata.name, status, _age(obj)]
+    if kind in ("ReplicaSet", "ReplicationController", "StatefulSet",
+                "Deployment"):
+        status = obj.status or {}
+        return [obj.metadata.name,
+                f"{status.get('replicas', 0)}/{obj.replicas}",
+                str(status.get("readyReplicas", 0)), _age(obj)]
+    if kind == "Job":
+        status = obj.status or {}
+        return [obj.metadata.name,
+                f"{status.get('succeeded', 0)}/{obj.completions}", _age(obj)]
+    if kind == "Service":
+        return [obj.metadata.name, _age(obj)]
+    if kind == "Endpoints":
+        addrs = [a.get("targetRef", {}).get("name", "?")
+                 for s in obj.subsets for a in s.get("addresses", [])]
+        return [obj.metadata.name, ",".join(addrs[:4])
+                + ("..." if len(addrs) > 4 else ""), _age(obj)]
+    if kind == "Event":
+        return [obj.metadata.name, obj.type, obj.reason,
+                str(getattr(obj, "count", 1)), obj.message[:60]]
+    return [obj.metadata.name, _age(obj)]
+
+
+HEADERS = {
+    "Pod": ["NAME", "STATUS", "AGE"],
+    "Pod-wide": ["NAME", "STATUS", "AGE", "NODE"],
+    "Node": ["NAME", "STATUS", "AGE"],
+    "ReplicaSet": ["NAME", "REPLICAS", "READY", "AGE"],
+    "ReplicationController": ["NAME", "REPLICAS", "READY", "AGE"],
+    "StatefulSet": ["NAME", "REPLICAS", "READY", "AGE"],
+    "Deployment": ["NAME", "REPLICAS", "READY", "AGE"],
+    "Job": ["NAME", "COMPLETIONS", "AGE"],
+    "Service": ["NAME", "AGE"],
+    "Endpoints": ["NAME", "ADDRESSES", "AGE"],
+    "Event": ["NAME", "TYPE", "REASON", "COUNT", "MESSAGE"],
+}
+
+
+def print_table(rows: list[list[str]], headers: list[str]) -> None:
+    widths = [max(len(h), *(len(r[i]) for r in rows)) if rows else len(h)
+              for i, h in enumerate(headers)]
+    fmt = "  ".join(f"{{:<{w}}}" for w in widths)
+    print(fmt.format(*headers))
+    for r in rows:
+        print(fmt.format(*r))
+
+
+def cmd_get(client, args) -> int:
+    plural = resolve_resource(args.resource)
+    kind = RESOURCES[plural]
+    ns = None if args.all_namespaces else args.namespace
+    if args.name:
+        objs = [client.get(kind, args.name, args.namespace)]
+    else:
+        objs = client.list(kind, namespace=ns)
+        objs.sort(key=lambda o: (o.metadata.namespace, o.metadata.name))
+    if args.output == "json":
+        docs = [o.to_dict() for o in objs]
+        print(json.dumps(docs[0] if args.name else
+                         {"kind": f"{kind}List", "items": docs}, indent=2))
+        return 0
+    if args.output == "name":
+        for o in objs:
+            print(f"{plural}/{o.metadata.name}")
+        return 0
+    wide = args.output == "wide"
+    headers = HEADERS.get(f"{kind}-wide" if wide and
+                          f"{kind}-wide" in HEADERS else kind,
+                          ["NAME", "AGE"])
+    print_table([_row(kind, o, wide) for o in objs], headers)
+    return 0
+
+
+def cmd_describe(client, args) -> int:
+    kind = RESOURCES[resolve_resource(args.resource)]
+    obj = client.get(kind, args.name, args.namespace)
+    print(json.dumps(obj.to_dict(), indent=2))
+    # related events, the describe signature feature
+    events = [e for e in client.list("Event", namespace=args.namespace)
+              if e.involved_object.get("name") == args.name]
+    if events:
+        print("\nEvents:")
+        for e in sorted(events, key=lambda e: e.metadata.creation_timestamp):
+            print(f"  {e.type}\t{e.reason}\t{e.message}")
+    return 0
+
+
+def cmd_create(client, args) -> int:
+    for doc in load_manifest(args.filename):
+        obj = decode_object(doc.get("kind", ""), doc)
+        created = client.create(obj)
+        print(f"{created.kind.lower()}/{created.metadata.name} created")
+    return 0
+
+
+def cmd_apply(client, args) -> int:
+    for doc in load_manifest(args.filename):
+        obj = decode_object(doc.get("kind", ""), doc)
+        try:
+            client.create(obj)
+            print(f"{obj.kind.lower()}/{obj.metadata.name} created")
+        except AlreadyExists:
+            client.update(obj, check_version=False)
+            print(f"{obj.kind.lower()}/{obj.metadata.name} configured")
+    return 0
+
+
+def cmd_delete(client, args) -> int:
+    kind = RESOURCES[resolve_resource(args.resource)]
+    client.delete(kind, args.name, args.namespace)
+    print(f"{kind.lower()}/{args.name} deleted")
+    return 0
+
+
+def cmd_scale(client, args) -> int:
+    kind = RESOURCES[resolve_resource(args.resource)]
+
+    def mutate(obj):
+        obj.spec["replicas"] = args.replicas
+        return obj
+
+    client.guaranteed_update(kind, args.name, args.namespace, mutate)
+    print(f"{kind.lower()}/{args.name} scaled to {args.replicas}")
+    return 0
+
+
+def cmd_bind(client, args) -> int:
+    client.bind(Binding(pod_name=args.pod, namespace=args.namespace,
+                        target_node=args.node))
+    print(f"pod/{args.pod} bound to {args.node}")
+    return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    import os
+
+    p = argparse.ArgumentParser(prog="kubectl",
+                                description="CLI over the HTTP apiserver")
+    p.add_argument("--server", "-s",
+                   default=os.environ.get("KUBECTL_SERVER",
+                                          "http://127.0.0.1:8080"))
+    sub = p.add_subparsers(dest="verb", required=True)
+
+    def common(sp, name=True):
+        sp.add_argument("resource")
+        if name:
+            sp.add_argument("name")
+        sp.add_argument("-n", "--namespace", default="default")
+
+    g = sub.add_parser("get")
+    g.add_argument("resource")
+    g.add_argument("name", nargs="?")
+    g.add_argument("-n", "--namespace", default="default")
+    g.add_argument("--all-namespaces", action="store_true")
+    g.add_argument("-o", "--output", default="",
+                   choices=["", "json", "wide", "name"])
+    g.set_defaults(fn=cmd_get)
+    d = sub.add_parser("describe")
+    common(d)
+    d.set_defaults(fn=cmd_describe)
+    for verb, fn in (("create", cmd_create), ("apply", cmd_apply)):
+        c = sub.add_parser(verb)
+        c.add_argument("-f", "--filename", required=True)
+        c.set_defaults(fn=fn)
+    de = sub.add_parser("delete")
+    common(de)
+    de.set_defaults(fn=cmd_delete)
+    sc = sub.add_parser("scale")
+    common(sc)
+    sc.add_argument("--replicas", type=int, required=True)
+    sc.set_defaults(fn=cmd_scale)
+    b = sub.add_parser("bind")
+    b.add_argument("pod")
+    b.add_argument("node")
+    b.add_argument("-n", "--namespace", default="default")
+    b.set_defaults(fn=cmd_bind)
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    url = urlsplit(args.server)
+    client = RemoteStore(url.hostname, url.port or 80)
+    try:
+        return args.fn(client, args)
+    except NotFound as e:
+        print(f"Error from server (NotFound): {e}", file=sys.stderr)
+        return 1
+    except (Conflict, AlreadyExists) as e:
+        print(f"Error from server (Conflict): {e}", file=sys.stderr)
+        return 1
+    except ConnectionError as e:
+        print(f"Unable to connect to the server: {e}", file=sys.stderr)
+        return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,82 @@
+"""The controller-manager binary: the kube-controller-manager analog
+(cmd/kube-controller-manager/app/controllermanager.go — leader-elected
+process running the reconcile loops against one apiserver).
+
+    python -m kubernetes_tpu.cmd.controller_manager \
+        --apiserver http://127.0.0.1:8080 --leader-elect
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+import os
+import socket
+import sys
+from urllib.parse import urlsplit
+
+log = logging.getLogger(__name__)
+
+
+def parse_args(argv=None) -> argparse.Namespace:
+    p = argparse.ArgumentParser(
+        prog="kubernetes-tpu-controller-manager",
+        description="reconcile-loop manager (kube-controller-manager analog)")
+    p.add_argument("--apiserver", required=True,
+                   help="HTTP apiserver URL (apiserver.http.APIServer)")
+    p.add_argument("--leader-elect", action="store_true")
+    p.add_argument("--lock-object-name", default="kube-controller-manager")
+    p.add_argument("--lock-object-namespace", default="kube-system")
+    p.add_argument("--node-monitor-grace-period", type=float, default=40.0)
+    p.add_argument("--pod-eviction-timeout", type=float, default=300.0)
+    p.add_argument("--node-eviction-rate", type=float, default=0.1)
+    return p.parse_args(argv)
+
+
+async def run(args: argparse.Namespace) -> None:
+    from kubernetes_tpu.apiserver.http import RemoteStore
+    from kubernetes_tpu.controllers import ControllerManager
+
+    url = urlsplit(args.apiserver)
+    store = RemoteStore(url.hostname, url.port or 80)
+    mgr = ControllerManager(store, node_lifecycle_kwargs=dict(
+        grace_period=args.node_monitor_grace_period,
+        eviction_timeout=args.pod_eviction_timeout,
+        eviction_rate=args.node_eviction_rate))
+
+    async def lead():
+        await mgr.start()
+        log.info("controllers running against %s", args.apiserver)
+        await asyncio.Event().wait()
+
+    try:
+        if args.leader_elect:
+            from kubernetes_tpu.client.leaderelection import LeaderElector
+
+            elector = LeaderElector(
+                store, f"{socket.gethostname()}_{os.getpid()}",
+                lock_name=args.lock_object_name,
+                lock_namespace=args.lock_object_namespace,
+                on_started_leading=lead)
+            await elector.run()
+            log.warning("lost leader lease; exiting")
+        else:
+            await lead()
+    finally:
+        mgr.stop()
+
+
+def main(argv=None) -> int:
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(levelname).1s %(name)s: %(message)s")
+    try:
+        asyncio.run(run(parse_args(argv)))
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -41,6 +41,9 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--persist-path", default="",
                    help="WAL file for the in-process store (etcd-like "
                         "durability: state survives SIGKILL + restart)")
+    p.add_argument("--controllers", action="store_true",
+                   help="also run the controller manager in-process (the "
+                        "hyperkube-style all-in-one topology)")
     p.add_argument("--port", type=int, default=10251,
                    help="healthz/metrics port (0 = ephemeral)")
     p.add_argument("--scheduler-name", default="default-scheduler")
@@ -89,6 +92,20 @@ async def run(args: argparse.Namespace) -> None:
     await server.start()
     log.info("healthz/metrics at %s", server.url)
 
+    mgr_holder: list = []
+
+    async def lead() -> None:
+        """Everything that must run on the LEADER only (controllers would
+        otherwise reconcile concurrently from every standby replica)."""
+        if args.controllers:
+            from kubernetes_tpu.controllers import ControllerManager
+
+            mgr = ControllerManager(store)
+            mgr_holder.append(mgr)
+            await mgr.start()
+            log.info("in-process controller manager running")
+        await sched.run()
+
     try:
         if args.leader_elect:
             from kubernetes_tpu.client.leaderelection import LeaderElector
@@ -98,15 +115,17 @@ async def run(args: argparse.Namespace) -> None:
                 store, identity,
                 lock_name=args.lock_object_name,
                 lock_namespace=args.lock_object_namespace,
-                on_started_leading=sched.run)
+                on_started_leading=lead)
             # returns when the lease is lost: crash-only handoff — exit and
             # let the supervisor restart us as a standby (server.go:140)
             await elector.run()
             log.warning("lost leader lease; exiting")
         else:
-            await sched.run()
+            await lead()
     finally:
         sched.stop()
+        for mgr in mgr_holder:
+            mgr.stop()
         await server.stop()
         if api_server is not None:
             await api_server.stop()

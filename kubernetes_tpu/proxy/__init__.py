@@ -1,0 +1,1 @@
+from kubernetes_tpu.proxy.proxier import FakeIptables, Proxier  # noqa: F401

@@ -1,0 +1,92 @@
+"""kubectl CLI over the HTTP apiserver: get/describe/create/apply/delete/
+scale/bind against a live server (pkg/kubectl analog, VERDICT r2 row 25)."""
+
+import io
+import json
+import sys
+
+import pytest
+
+from kubernetes_tpu.cli.kubectl import main
+
+from tests.http_util import http_store
+from tests.test_http_apiserver import mk_node, mk_pod_dict
+
+
+def run_cli(client, *argv):
+    out = io.StringIO()
+    old = sys.stdout
+    sys.stdout = out
+    try:
+        rc = main(["--server", f"http://{client.host}:{client.port}",
+                   *argv])
+    finally:
+        sys.stdout = old
+    return rc, out.getvalue()
+
+
+def test_create_get_describe_delete(tmp_path):
+    with http_store() as (client, _store):
+        manifest = tmp_path / "pod.json"
+        manifest.write_text(json.dumps(mk_pod_dict("cli-pod")))
+        rc, out = run_cli(client, "create", "-f", str(manifest))
+        assert rc == 0 and "pod/cli-pod created" in out
+
+        rc, out = run_cli(client, "get", "pods")
+        assert rc == 0
+        assert out.splitlines()[0].split() == ["NAME", "STATUS", "AGE"]
+        assert "cli-pod" in out and "Pending" in out
+
+        rc, out = run_cli(client, "get", "po", "cli-pod", "-o", "json")
+        assert rc == 0
+        assert json.loads(out)["metadata"]["name"] == "cli-pod"
+
+        rc, out = run_cli(client, "describe", "pod", "cli-pod")
+        assert rc == 0 and '"name": "cli-pod"' in out
+
+        rc, out = run_cli(client, "delete", "pod", "cli-pod")
+        assert rc == 0
+        rc, _ = run_cli(client, "get", "pods", "cli-pod")
+        assert rc == 1  # NotFound exits 1, like kubectl
+
+
+def test_apply_scale_and_wide_output(tmp_path):
+    with http_store() as (client, _store):
+        client.create(mk_node("n0"))
+        rs = {"kind": "ReplicaSet",
+              "metadata": {"name": "web", "namespace": "default"},
+              "spec": {"replicas": 2,
+                       "selector": {"matchLabels": {"app": "web"}},
+                       "template": {"metadata": {"labels": {"app": "web"}},
+                                    "spec": {"containers": [{"name": "c"}]}}}}
+        manifest = tmp_path / "rs.json"
+        manifest.write_text(json.dumps(rs))
+        rc, out = run_cli(client, "apply", "-f", str(manifest))
+        assert rc == 0 and "replicaset/web created" in out
+        rs["spec"]["replicas"] = 3
+        manifest.write_text(json.dumps(rs))
+        rc, out = run_cli(client, "apply", "-f", str(manifest))
+        assert rc == 0 and "replicaset/web configured" in out
+        assert client.get("ReplicaSet", "web").replicas == 3
+
+        rc, out = run_cli(client, "scale", "rs", "web", "--replicas=5")
+        assert rc == 0
+        assert client.get("ReplicaSet", "web").replicas == 5
+
+        # bind + wide output shows the node
+        from kubernetes_tpu.api.objects import Pod
+        client.create(Pod.from_dict(mk_pod_dict("w0")))
+        rc, out = run_cli(client, "bind", "w0", "n0")
+        assert rc == 0
+        rc, out = run_cli(client, "get", "pods", "-o", "wide")
+        assert rc == 0 and "n0" in out
+        rc, out = run_cli(client, "get", "pods", "-o", "name")
+        assert "pods/w0" in out
+
+
+def test_get_nodes_status_column():
+    with http_store() as (client, _store):
+        client.create(mk_node("ready-node"))
+        rc, out = run_cli(client, "get", "nodes")
+        assert rc == 0
+        assert "ready-node" in out and "Ready" in out
