@@ -651,19 +651,44 @@ class PersistentVolumeClaim:
 
 
 @dataclass
-class Service:
-    """Service with a map selector (reference v1.Service; the scheduler's
-    SelectorSpreadPriority and ServiceAffinity look up services matching a
-    pod, selector_spreading.go:61)."""
+class _SpecStatusObject:
+    """Generic spec/status object shape for config-ish kinds."""
 
     metadata: ObjectMeta = field(default_factory=ObjectMeta)
     spec: dict[str, Any] = field(default_factory=dict)
-
-    kind = "Service"
+    status: dict[str, Any] = field(default_factory=dict)
 
     @property
     def key(self) -> str:
         return f"{self.metadata.namespace}/{self.metadata.name}"
+
+    def clone(self):
+        return type(self)(metadata=self.metadata.clone(),
+                          spec=copy.deepcopy(self.spec),
+                          status=copy.deepcopy(self.status))
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]):
+        return cls(metadata=ObjectMeta.from_dict(d.get("metadata") or {}),
+                   spec=copy.deepcopy(d.get("spec") or {}),
+                   status=copy.deepcopy(d.get("status") or {}))
+
+    def to_dict(self) -> dict[str, Any]:
+        out = {"apiVersion": "v1", "kind": self.kind,
+               "metadata": self.metadata.to_dict(),
+               "spec": copy.deepcopy(self.spec)}
+        if self.status:
+            out["status"] = copy.deepcopy(self.status)
+        return out
+
+
+@dataclass
+class Service(_SpecStatusObject):
+    """Service with a map selector (reference v1.Service; the scheduler's
+    SelectorSpreadPriority and ServiceAffinity look up services matching a
+    pod, selector_spreading.go:61)."""
+
+    kind = "Service"
 
     @property
     def selector(self) -> dict[str, str] | None:
@@ -672,20 +697,6 @@ class Service:
         (service_expansion.go:45-50, labels.Set{}.AsSelector())."""
         sel = self.spec.get("selector")
         return None if sel is None else dict(sel)
-
-    def clone(self) -> "Service":
-        return Service(metadata=self.metadata.clone(),
-                       spec=copy.deepcopy(self.spec))
-
-    @classmethod
-    def from_dict(cls, d: dict[str, Any]) -> "Service":
-        return cls(metadata=ObjectMeta.from_dict(d.get("metadata") or {}),
-                   spec=copy.deepcopy(d.get("spec") or {}))
-
-    def to_dict(self) -> dict[str, Any]:
-        return {"apiVersion": "v1", "kind": "Service",
-                "metadata": self.metadata.to_dict(),
-                "spec": copy.deepcopy(self.spec)}
 
 
 @dataclass
@@ -811,6 +822,23 @@ class Deployment(_Workload):
     @property
     def strategy_type(self) -> str:
         return (self.spec.get("strategy") or {}).get("type", "RollingUpdate")
+
+
+@dataclass
+class LimitRange(_SpecStatusObject):
+    """v1 LimitRange: per-namespace container request/limit defaults and
+    bounds enforced by the LimitRanger admission plugin
+    (plugin/pkg/admission/limitranger)."""
+
+    kind = "LimitRange"
+
+
+@dataclass
+class ResourceQuota(_SpecStatusObject):
+    """v1 ResourceQuota: per-namespace aggregate resource caps enforced by
+    the ResourceQuota admission plugin (plugin/pkg/admission/resourcequota)."""
+
+    kind = "ResourceQuota"
 
 
 @dataclass

@@ -1,0 +1,195 @@
+"""Admission control: the mutate-then-validate plugin chain.
+
+The plugin/pkg/admission analog (chain wiring
+apiserver/pkg/admission/chain.go; the reference registers 23 plugins —
+plugin/pkg/admission/). Implemented plugins are the resource-governance
+core plus a defaulting mutator:
+
+- LimitRanger (plugin/pkg/admission/limitranger/admission.go): apply
+  per-namespace default container requests/limits from LimitRange objects
+  and reject containers exceeding max / under min.
+- ResourceQuota (plugin/pkg/admission/resourcequota/admission.go): reject
+  pod creation that would push the namespace's aggregate requests.cpu /
+  requests.memory / pods count past a ResourceQuota's hard caps; mirrors
+  usage into the quota's status.
+- DefaultTolerationSeconds
+  (plugin/pkg/admission/defaulttolerationseconds): add the 300s
+  not-ready/unreachable NoExecute tolerations to pods that don't set them.
+
+The chain hooks the ObjectStore's write path (`ObjectStore(admission=...)`)
+— the storage-front position the reference's handler chain occupies; HTTP
+maps AdmissionError to 403 Forbidden like quota rejections."""
+
+from __future__ import annotations
+
+from typing import Any
+
+from kubernetes_tpu.api.objects import Toleration
+from kubernetes_tpu.api.quantity import parse_quantity
+
+
+class AdmissionError(Exception):
+    """Request rejected by an admission plugin (HTTP 403)."""
+
+
+class AdmissionChain:
+    def __init__(self, plugins: list | None = None):
+        self.plugins = plugins if plugins is not None else []
+
+    def admit(self, store, obj: Any, operation: str) -> None:
+        """Mutating plugins first, then validating — each may mutate `obj`
+        in place or raise AdmissionError (chain.go Admit ordering)."""
+        for plugin in self.plugins:
+            plugin.admit(store, obj, operation)
+
+
+def default_chain() -> AdmissionChain:
+    return chain_for("default")
+
+
+def chain_for(names: str) -> AdmissionChain:
+    """Build a chain from a comma-separated plugin list ('default' = all);
+    unknown names are an error, like the reference's --admission-control."""
+    registry = {
+        "DefaultTolerationSeconds": DefaultTolerationSeconds,
+        "LimitRanger": LimitRanger,
+        "ResourceQuota": ResourceQuotaPlugin,
+    }
+    if names.strip().lower() == "default":
+        wanted = list(registry)
+    else:
+        wanted = [n.strip() for n in names.split(",") if n.strip()]
+        unknown = [n for n in wanted if n not in registry]
+        if unknown:
+            raise ValueError(f"unknown admission plugin(s): {unknown}; "
+                             f"available: {sorted(registry)}")
+    return AdmissionChain([registry[n]() for n in wanted])
+
+
+# ---------------------------------------------------------------------------
+
+
+NOT_READY_KEY = "node.alpha.kubernetes.io/notReady"
+UNREACHABLE_KEY = "node.alpha.kubernetes.io/unreachable"
+DEFAULT_TOLERATION_SECONDS = 300
+
+
+class DefaultTolerationSeconds:
+    def admit(self, store, obj: Any, operation: str) -> None:
+        if obj.kind != "Pod" or operation != "CREATE":
+            return
+        keys = {t.key for t in obj.spec.tolerations}
+        for key in (NOT_READY_KEY, UNREACHABLE_KEY):
+            if key not in keys:
+                obj.spec.tolerations.append(Toleration(
+                    key=key, operator="Exists", effect="NoExecute",
+                    toleration_seconds=DEFAULT_TOLERATION_SECONDS))
+
+
+class LimitRanger:
+    def admit(self, store, obj: Any, operation: str) -> None:
+        if obj.kind != "Pod" or operation != "CREATE":
+            return
+        ns = obj.metadata.namespace
+        for lr in store.list("LimitRange", namespace=ns,
+                             copy_objects=False):
+            for item in lr.spec.get("limits", []):
+                if item.get("type", "Container") != "Container":
+                    continue
+                self._apply(obj, item)
+
+    @staticmethod
+    def _apply(pod, item: dict) -> None:
+        defaults = item.get("default") or {}          # default limits
+        default_req = item.get("defaultRequest") or {}
+        maxes = item.get("max") or {}
+        mins = item.get("min") or {}
+        for c in pod.spec.containers:
+            for res, qty in default_req.items():
+                c.requests.setdefault(res, str(qty))
+            for res, qty in defaults.items():
+                c.limits.setdefault(res, str(qty))
+            for res, cap in maxes.items():
+                # both requests and limits must respect max (limitranger
+                # maxConstraint applies to each value set)
+                for used in (c.requests.get(res), c.limits.get(res)):
+                    if used and parse_quantity(used) \
+                            > parse_quantity(str(cap)):
+                        raise AdmissionError(
+                            f"maximum {res} usage per Container is {cap}, "
+                            f"but {used} is requested")
+            for res, floor in mins.items():
+                for used in (c.requests.get(res), c.limits.get(res)):
+                    if used and parse_quantity(used) \
+                            < parse_quantity(str(floor)):
+                        raise AdmissionError(
+                            f"minimum {res} usage per Container is {floor}, "
+                            f"but {used} is requested")
+
+
+class ResourceQuotaPlugin:
+    TRACKED = ("requests.cpu", "requests.memory", "pods")
+
+    def admit(self, store, obj: Any, operation: str) -> None:
+        if obj.kind != "Pod" or operation != "CREATE":
+            return
+        ns = obj.metadata.namespace
+        quotas = store.list("ResourceQuota", namespace=ns,
+                            copy_objects=False)
+        if not quotas:
+            return
+        used = self._namespace_usage(store, ns)
+        incoming = self._pod_usage(obj)
+        # validate EVERY quota before mutating anything: a later quota's
+        # rejection must not leave earlier quotas' status over-counted
+        for quota in quotas:
+            hard = quota.spec.get("hard") or {}
+            for res in self.TRACKED:
+                if res not in hard:
+                    continue
+                total = used.get(res, 0) + incoming.get(res, 0)
+                cap = parse_quantity(str(hard[res]))
+                if total > cap:
+                    raise AdmissionError(
+                        f"exceeded quota: {quota.metadata.name}, requested: "
+                        f"{res}={incoming.get(res, 0)}, used: "
+                        f"{res}={used.get(res, 0)}, limited: "
+                        f"{res}={hard[res]}")
+        for quota in quotas:
+            # mirror usage into status through the store's write path (RV
+            # bump + watch event + WAL; the reference's quota controller
+            # keeps this fresh asynchronously, admission updates eagerly)
+            hard = quota.spec.get("hard") or {}
+            status = {
+                "hard": dict(hard),
+                "used": {res: str(used.get(res, 0) + incoming.get(res, 0))
+                         for res in self.TRACKED if res in hard}}
+            if quota.status == status:
+                continue
+            fresh = quota.clone()
+            fresh.status = status
+            try:
+                store.update(fresh, check_version=False)
+            except Exception:  # noqa: BLE001 — usage mirror is best-effort
+                pass
+
+    @staticmethod
+    def _pod_usage(pod) -> dict:
+        out = {"pods": 1, "requests.cpu": 0, "requests.memory": 0}
+        for c in pod.spec.containers:
+            if "cpu" in c.requests:
+                out["requests.cpu"] += parse_quantity(c.requests["cpu"])
+            if "memory" in c.requests:
+                out["requests.memory"] += parse_quantity(
+                    c.requests["memory"])
+        return out
+
+    def _namespace_usage(self, store, ns: str) -> dict:
+        total = {"pods": 0, "requests.cpu": 0, "requests.memory": 0}
+        for pod in store.list("Pod", namespace=ns, copy_objects=False):
+            if pod.status.phase in ("Succeeded", "Failed"):
+                continue
+            usage = self._pod_usage(pod)
+            for k, v in usage.items():
+                total[k] += v
+        return total

@@ -1,0 +1,113 @@
+"""Authentication + authorization for the HTTP apiserver.
+
+The vintage reference's authn/authz surface scoped to its two simplest,
+fully-offline modes:
+
+- **Bearer-token authentication** (apiserver/pkg/authentication/token;
+  --token-auth-file: csv of token,user,uid,\"group1,group2\"): the
+  Authorization header resolves to (user, groups) or 401.
+- **ABAC authorization** (pkg/auth/authorizer/abac/abac.go; policy file of
+  JSON lines {"user"|"group", "resource", "namespace", "readonly"}): a
+  request is allowed when ANY policy line matches; "*" wildcards; readonly
+  policies allow only get/list/watch. Deny -> 403.
+
+Both are optional: an APIServer without an authenticator serves
+unauthenticated (the in-process/test topology)."""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+
+
+@dataclass
+class UserInfo:
+    name: str
+    groups: tuple[str, ...] = ()
+
+
+class TokenAuthenticator:
+    def __init__(self, tokens: dict[str, UserInfo]):
+        self.tokens = tokens
+
+    @classmethod
+    def from_csv(cls, text: str) -> "TokenAuthenticator":
+        """token,user,uid[,\"group1,group2\"] per line (tokenfile.go)."""
+        import csv
+        import io
+
+        tokens: dict[str, UserInfo] = {}
+        for lineno, row in enumerate(csv.reader(io.StringIO(text)), 1):
+            if not row or row[0].startswith("#"):
+                continue
+            if len(row) < 2:
+                raise ValueError(
+                    f"malformed token file line {lineno}: expected "
+                    f"token,user[,uid[,groups]], got {len(row)} field(s)")
+            token, user = row[0].strip(), row[1].strip()
+            groups = tuple(g.strip() for g in row[3].split(",")) \
+                if len(row) > 3 and row[3] else ()
+            tokens[token] = UserInfo(name=user, groups=groups)
+        return cls(tokens)
+
+    def authenticate(self, headers: dict[str, str]) -> UserInfo | None:
+        auth = headers.get("authorization", "")
+        if not auth.lower().startswith("bearer "):
+            return None
+        return self.tokens.get(auth[7:].strip())
+
+
+READONLY_VERBS = frozenset({"get", "list", "watch"})
+
+
+@dataclass
+class ABACPolicy:
+    user: str = ""        # "" never matches; "*" matches everyone
+    group: str = ""
+    resource: str = "*"
+    namespace: str = "*"
+    readonly: bool = False
+
+    def matches(self, user: UserInfo, verb: str, resource: str,
+                namespace: str) -> bool:
+        subject_ok = (self.user == "*" or self.user == user.name
+                      or self.group == "*" or self.group in user.groups)
+        if not subject_ok:
+            return False
+        if self.resource not in ("*", resource):
+            return False
+        # cluster-scoped requests (namespace "") only match wildcard-
+        # namespace policies: a policy sandboxing a user to one namespace
+        # must never grant Nodes/PVs (abac.go matches namespace exactly)
+        if self.namespace not in ("*", namespace) or (
+                namespace == "" and self.namespace != "*"):
+            return False
+        return not self.readonly or verb in READONLY_VERBS
+
+
+class ABACAuthorizer:
+    def __init__(self, policies: list[ABACPolicy]):
+        self.policies = policies
+
+    @classmethod
+    def from_policy_file(cls, text: str) -> "ABACAuthorizer":
+        """One JSON object per line (abac.go policy file format)."""
+        policies = []
+        for line in text.splitlines():
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            d = json.loads(line)
+            spec = d.get("spec", d)  # v1beta1 wraps in spec; v0 is flat
+            policies.append(ABACPolicy(
+                user=spec.get("user", ""),
+                group=spec.get("group", ""),
+                resource=spec.get("resource", "*") or "*",
+                namespace=spec.get("namespace", "*") or "*",
+                readonly=bool(spec.get("readonly", False))))
+        return cls(policies)
+
+    def authorize(self, user: UserInfo, verb: str, resource: str,
+                  namespace: str) -> bool:
+        return any(p.matches(user, verb, resource, namespace)
+                   for p in self.policies)

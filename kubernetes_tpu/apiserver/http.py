@@ -42,6 +42,7 @@ from urllib.parse import parse_qs, urlsplit
 
 from kubernetes_tpu.api import objects as objs
 from kubernetes_tpu.api.objects import Binding
+from kubernetes_tpu.apiserver.admission import AdmissionError
 from kubernetes_tpu.apiserver.store import (
     AlreadyExists,
     Conflict,
@@ -68,12 +69,14 @@ RESOURCES: dict[str, str] = {
     "statefulsets": "StatefulSet",
     "deployments": "Deployment",
     "jobs": "Job",
+    "limitranges": "LimitRange",
+    "resourcequotas": "ResourceQuota",
 }
 KIND_TO_CLS = {cls.kind: cls for cls in (
     objs.Pod, objs.Node, objs.Service, objs.Endpoints, objs.Event,
     objs.PersistentVolume, objs.PersistentVolumeClaim,
     objs.ReplicationController, objs.ReplicaSet, objs.StatefulSet,
-    objs.Deployment, objs.Job)}
+    objs.Deployment, objs.Job, objs.LimitRange, objs.ResourceQuota)}
 PLURAL_OF = {kind: plural for plural, kind in RESOURCES.items()}
 
 
@@ -91,14 +94,47 @@ def encode_object(obj: Any) -> dict:
 
 
 class APIServer:
-    """Asyncio HTTP/1.1 apiserver over one ObjectStore."""
+    """Asyncio HTTP/1.1 apiserver over one ObjectStore.
+
+    `authenticator`/`authorizer` (apiserver.auth) take the reference
+    handler-chain's WithAuthentication/WithAuthorization positions
+    (apiserver/pkg/server/config.go:470-478): no authenticator = open
+    server (the in-process topology); with one, requests resolve to a user
+    (else 401) and, with an authorizer, must pass ABAC (else 403)."""
 
     def __init__(self, store: ObjectStore, host: str = "127.0.0.1",
-                 port: int = 0):
+                 port: int = 0, authenticator=None, authorizer=None):
         self.store = store
         self.host = host
         self.port = port
+        self.authenticator = authenticator
+        self.authorizer = authorizer
         self._server: asyncio.AbstractServer | None = None
+
+    def _authfilter(self, method: str, path: str,
+                    headers: dict[str, str]) -> tuple[int, dict] | None:
+        """-> (status, payload) to short-circuit, or None to proceed."""
+        if self.authenticator is None:
+            return None
+        user = self.authenticator.authenticate(headers)
+        if user is None:
+            return 401, {"kind": "Status", "reason": "Unauthorized",
+                         "message": "invalid or missing bearer token"}
+        if self.authorizer is None:
+            return None
+        try:
+            ns, plural, name, _sub = self._parse_path(path)
+        except NotFound:
+            return None  # let routing produce the 404
+        verb = {"GET": "get" if name else "list", "POST": "create",
+                "PUT": "update", "DELETE": "delete"}.get(method, method)
+        # cluster-scoped (and cross-namespace) requests authorize against
+        # namespace "" — only wildcard-namespace policies may grant them
+        if self.authorizer.authorize(user, verb, plural, ns or ""):
+            return None
+        return 403, {"kind": "Status", "reason": "Forbidden",
+                     "message": f"user {user.name!r} cannot {verb} "
+                                f"{plural} in {ns or 'cluster scope'}"}
 
     @property
     def url(self) -> str:
@@ -141,6 +177,12 @@ class APIServer:
 
                 url = urlsplit(target)
                 query = {k: v[-1] for k, v in parse_qs(url.query).items()}
+                denied = self._authfilter(
+                    "GET" if query.get("watch") in ("1", "true") else method,
+                    url.path, headers)
+                if denied is not None:
+                    await _respond(writer, *denied)
+                    return
                 if query.get("watch") in ("1", "true"):
                     await self._serve_watch(writer, url.path, query)
                     return  # watch owns the connection until it closes
@@ -227,6 +269,9 @@ class APIServer:
             return 405, {"message": f"method {method} not allowed"}
         except NotFound as e:
             return 404, {"kind": "Status", "reason": "NotFound",
+                         "message": str(e)}
+        except AdmissionError as e:
+            return 403, {"kind": "Status", "reason": "Forbidden",
                          "message": str(e)}
         except AlreadyExists as e:
             return 409, {"kind": "Status", "reason": "AlreadyExists",
@@ -354,9 +399,14 @@ class RemoteStore:
     """ObjectStore-compatible client over the HTTP API: informers, the
     scheduler driver, controllers, and the extender run over TCP unchanged."""
 
-    def __init__(self, host: str, port: int):
+    def __init__(self, host: str, port: int, token: str = ""):
         self.host = host
         self.port = port
+        self.token = token
+
+    def _auth_header(self) -> str:
+        return (f"Authorization: Bearer {self.token}\r\n"
+                if self.token else "")
 
     # ---- blocking HTTP core (CRUD: small JSON on a trusted network) ----
 
@@ -367,6 +417,7 @@ class RemoteStore:
             sock.sendall(
                 f"{method} {path} HTTP/1.1\r\n"
                 f"Host: {self.host}\r\n"
+                f"{self._auth_header()}"
                 f"Content-Type: application/json\r\n"
                 f"Content-Length: {len(payload)}\r\n"
                 f"Connection: close\r\n\r\n".encode() + payload)
@@ -381,6 +432,8 @@ class RemoteStore:
         decoded = json.loads(resp_body) if resp_body else {}
         if status == 404:
             raise NotFound(decoded.get("message", "not found"))
+        if status in (401, 403):
+            raise PermissionError(decoded.get("message", f"HTTP {status}"))
         if status == 409:
             if decoded.get("reason") == "AlreadyExists":
                 raise AlreadyExists(decoded.get("message", ""))
@@ -484,7 +537,8 @@ class RemoteStore:
     async def _open_watch(self, plural: str, query: str):
         reader, writer = await asyncio.open_connection(self.host, self.port)
         writer.write(f"GET /api/v1/{plural}?{query} HTTP/1.1\r\n"
-                     f"Host: {self.host}\r\nConnection: keep-alive\r\n\r\n"
+                     f"Host: {self.host}\r\n{self._auth_header()}"
+                     f"Connection: keep-alive\r\n\r\n"
                      .encode())
         await writer.drain()
         status_line = await reader.readline()

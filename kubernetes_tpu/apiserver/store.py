@@ -68,13 +68,16 @@ class ObjectStore:
     drained; replay cost is linear in total writes."""
 
     def __init__(self, watch_window: int = 4096,
-                 persist_path: str | None = None):
+                 persist_path: str | None = None, admission=None):
         self._objects: dict[str, dict[tuple[str, str], Any]] = {}
         self._rv = 0
         self._history: deque[WatchEvent] = deque(maxlen=watch_window)
         self._watchers: list[tuple[str | None, asyncio.Queue]] = []
         self._wal = None
         self._cluster_ip_counter = 0
+        # admission chain (apiserver/admission.py) applied to create/update
+        # — the reference's handler-chain position in front of the registry
+        self.admission = admission
         if persist_path:
             self._replay_wal(persist_path)
             self._wal = open(persist_path, "a", encoding="utf-8")
@@ -165,6 +168,8 @@ class ObjectStore:
         if key in bucket:
             raise AlreadyExists(f"{kind} {key} already exists")
         stored = obj.clone() if copy else obj
+        if self.admission is not None:
+            self.admission.admit(self, stored, "CREATE")
         rv = self._next_rv()
         stored.metadata.resource_version = str(rv)
         stored.metadata.creation_timestamp = time.time()
@@ -203,6 +208,8 @@ class ObjectStore:
                 f"{kind} {key}: version {obj.metadata.resource_version} != "
                 f"{current.metadata.resource_version}")
         stored = obj.clone()
+        if self.admission is not None:
+            self.admission.admit(self, stored, "UPDATE")
         rv = self._next_rv()
         stored.metadata.resource_version = str(rv)
         stored.metadata.creation_timestamp = current.metadata.creation_timestamp

@@ -1,1 +1,2 @@
-from kubernetes_tpu.cli.kubectl import main  # noqa: F401
+# kubectl lives in kubernetes_tpu.cli.kubectl (no eager re-export: importing
+# it here would shadow `python -m kubernetes_tpu.cli.kubectl` via runpy)

@@ -239,6 +239,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--server", "-s",
                    default=os.environ.get("KUBECTL_SERVER",
                                           "http://127.0.0.1:8080"))
+    p.add_argument("--token",
+                   default=os.environ.get("KUBECTL_TOKEN", ""),
+                   help="bearer token for an authn-enabled apiserver "
+                        "(env KUBECTL_TOKEN)")
     sub = p.add_subparsers(dest="verb", required=True)
 
     def common(sp, name=True):
@@ -280,7 +284,7 @@ def build_parser() -> argparse.ArgumentParser:
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     url = urlsplit(args.server)
-    client = RemoteStore(url.hostname, url.port or 80)
+    client = RemoteStore(url.hostname, url.port or 80, token=args.token)
     try:
         return args.fn(client, args)
     except NotFound as e:
@@ -288,6 +292,9 @@ def main(argv=None) -> int:
         return 1
     except (Conflict, AlreadyExists) as e:
         print(f"Error from server (Conflict): {e}", file=sys.stderr)
+        return 1
+    except PermissionError as e:
+        print(f"Error from server (Forbidden): {e}", file=sys.stderr)
         return 1
     except ConnectionError as e:
         print(f"Unable to connect to the server: {e}", file=sys.stderr)

@@ -1,0 +1,4 @@
+from kubernetes_tpu.cloudprovider.interface import (  # noqa: F401
+    CloudProvider,
+    FakeCloud,
+)

@@ -1,0 +1,79 @@
+"""Cloud provider SPI + the fake provider.
+
+The pkg/cloudprovider analog (Interface at pkg/cloudprovider/cloud.go:
+LoadBalancer/Instances/Zones sub-interfaces; nine real providers + the fake
+at pkg/cloudprovider/providers/fake used by every controller test). The
+service controller consumes LoadBalancer; the node lifecycle consumes
+Instances (does a cloud instance still exist?); Zones labels nodes."""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass, field
+
+
+@dataclass
+class LoadBalancerStatus:
+    ingress_ip: str = ""
+
+
+class CloudProvider:
+    """The Interface subset controllers consume (cloud.go:43-118)."""
+
+    # -- LoadBalancer --
+    def get_load_balancer(self, service) -> LoadBalancerStatus | None:
+        raise NotImplementedError
+
+    def ensure_load_balancer(self, service, node_names) -> LoadBalancerStatus:
+        raise NotImplementedError
+
+    def ensure_load_balancer_deleted(self, service) -> None:
+        raise NotImplementedError
+
+    # -- Instances --
+    def instance_exists(self, node_name: str) -> bool:
+        raise NotImplementedError
+
+    # -- Zones --
+    def get_zone(self, node_name: str) -> tuple[str, str]:
+        """(failure domain, region)."""
+        raise NotImplementedError
+
+
+@dataclass
+class FakeCloud(CloudProvider):
+    """Deterministic in-memory provider (providers/fake/fake.go): records
+    every call so tests can assert the controller's cloud traffic."""
+
+    balancers: dict[str, LoadBalancerStatus] = field(default_factory=dict)
+    backends: dict[str, tuple[str, ...]] = field(default_factory=dict)
+    instances: set = field(default_factory=set)
+    zone: tuple[str, str] = ("fake-zone-a", "fake-region")
+    calls: list[str] = field(default_factory=list)
+    _ip_counter: itertools.count = field(
+        default_factory=lambda: itertools.count(1))
+
+    def get_load_balancer(self, service):
+        self.calls.append(f"get:{service.key}")
+        return self.balancers.get(service.key)
+
+    def ensure_load_balancer(self, service, node_names):
+        self.calls.append(f"ensure:{service.key}")
+        status = self.balancers.get(service.key)
+        if status is None:
+            status = LoadBalancerStatus(
+                ingress_ip=f"198.51.100.{next(self._ip_counter)}")
+            self.balancers[service.key] = status
+        self.backends[service.key] = tuple(sorted(node_names))
+        return status
+
+    def ensure_load_balancer_deleted(self, service):
+        self.calls.append(f"delete:{service.key}")
+        self.balancers.pop(service.key, None)
+        self.backends.pop(service.key, None)
+
+    def instance_exists(self, node_name: str) -> bool:
+        return node_name in self.instances
+
+    def get_zone(self, node_name: str) -> tuple[str, str]:
+        return self.zone

@@ -25,6 +25,9 @@ def parse_args(argv=None) -> argparse.Namespace:
         description="reconcile-loop manager (kube-controller-manager analog)")
     p.add_argument("--apiserver", required=True,
                    help="HTTP apiserver URL (apiserver.http.APIServer)")
+    p.add_argument("--token", default=os.environ.get("KUBE_TOKEN", ""),
+                   help="bearer token for an authn-enabled apiserver "
+                        "(env KUBE_TOKEN)")
     p.add_argument("--leader-elect", action="store_true")
     p.add_argument("--lock-object-name", default="kube-controller-manager")
     p.add_argument("--lock-object-namespace", default="kube-system")
@@ -39,7 +42,7 @@ async def run(args: argparse.Namespace) -> None:
     from kubernetes_tpu.controllers import ControllerManager
 
     url = urlsplit(args.apiserver)
-    store = RemoteStore(url.hostname, url.port or 80)
+    store = RemoteStore(url.hostname, url.port or 80, token=args.token)
     mgr = ControllerManager(store, node_lifecycle_kwargs=dict(
         grace_period=args.node_monitor_grace_period,
         eviction_timeout=args.pod_eviction_timeout,

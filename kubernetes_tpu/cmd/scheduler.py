@@ -44,6 +44,14 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--controllers", action="store_true",
                    help="also run the controller manager in-process (the "
                         "hyperkube-style all-in-one topology)")
+    p.add_argument("--admission-control", default="",
+                   help="'default' enables DefaultTolerationSeconds,"
+                        "LimitRanger,ResourceQuota on the in-process store")
+    p.add_argument("--token-auth-file", default="",
+                   help="csv of token,user,uid,groups — enables bearer-token"
+                        " authn on the in-process apiserver")
+    p.add_argument("--authorization-policy-file", default="",
+                   help="ABAC policy jsonl — enables authorization")
     p.add_argument("--port", type=int, default=10251,
                    help="healthz/metrics port (0 = ephemeral)")
     p.add_argument("--scheduler-name", default="default-scheduler")
@@ -80,8 +88,27 @@ async def run(args: argparse.Namespace) -> None:
         from kubernetes_tpu.apiserver import ObjectStore
         from kubernetes_tpu.apiserver.http import APIServer
 
-        store = ObjectStore(persist_path=args.persist_path or None)
-        api_server = APIServer(store, port=args.apiserver_port)
+        admission = None
+        if args.admission_control:
+            from kubernetes_tpu.apiserver.admission import chain_for
+
+            admission = chain_for(args.admission_control)
+        store = ObjectStore(persist_path=args.persist_path or None,
+                            admission=admission)
+        authenticator = authorizer = None
+        if args.token_auth_file:
+            from kubernetes_tpu.apiserver.auth import TokenAuthenticator
+
+            with open(args.token_auth_file) as f:
+                authenticator = TokenAuthenticator.from_csv(f.read())
+        if args.authorization_policy_file:
+            from kubernetes_tpu.apiserver.auth import ABACAuthorizer
+
+            with open(args.authorization_policy_file) as f:
+                authorizer = ABACAuthorizer.from_policy_file(f.read())
+        api_server = APIServer(store, port=args.apiserver_port,
+                               authenticator=authenticator,
+                               authorizer=authorizer)
         await api_server.start()
         log.info("in-process apiserver at %s", api_server.url)
 

@@ -21,7 +21,8 @@ from kubernetes_tpu.controllers.statefulset import StatefulSetController
 class ControllerManager:
     def __init__(self, store: ObjectStore, enable_gc: bool = True,
                  enable_node_lifecycle: bool = True,
-                 node_lifecycle_kwargs: dict | None = None):
+                 node_lifecycle_kwargs: dict | None = None,
+                 cloud=None):
         self.store = store
         self.informers: dict[str, Informer] = {
             kind: Informer(store, kind)
@@ -55,6 +56,15 @@ class ControllerManager:
                 store, self.informers["Node"], pods,
                 **(node_lifecycle_kwargs or {}))
             self.controllers.append(self.node_lifecycle)
+        if cloud is not None:
+            from kubernetes_tpu.controllers.service_lb import (
+                ServiceLBController,
+            )
+
+            self.service_lb = ServiceLBController(
+                store, cloud, self.informers["Service"],
+                self.informers["Node"])
+            self.controllers.append(self.service_lb)
 
     async def start(self) -> None:
         for informer in self.informers.values():

@@ -1,0 +1,88 @@
+"""Service (cloud load balancer) controller.
+
+The pkg/controller/service analog: Services of type LoadBalancer get a
+cloud balancer ensured across the cluster's nodes, their ingress IP written
+to status.loadBalancer; deletion (or type change) tears the balancer down
+(servicecontroller.go syncService/createLoadBalancerIfNeeded)."""
+
+from __future__ import annotations
+
+from kubernetes_tpu.apiserver.store import Conflict, NotFound, ObjectStore
+from kubernetes_tpu.client.informer import Informer
+from kubernetes_tpu.cloudprovider import CloudProvider
+from kubernetes_tpu.controllers.base import ReconcileController
+
+
+class ServiceLBController(ReconcileController):
+    workers = 1
+
+    def __init__(self, store: ObjectStore, cloud: CloudProvider,
+                 service_informer: Informer, node_informer: Informer):
+        super().__init__()
+        self.name = "service-lb-controller"
+        self.store = store
+        self.cloud = cloud
+        self.services = service_informer
+        self.nodes = node_informer
+        self._known_nodes: frozenset = frozenset()
+        service_informer.add_handler(self._on_service)
+        node_informer.add_handler(self._on_node)
+
+    def _on_service(self, event) -> None:
+        self.enqueue(event.obj.key)
+
+    def _on_node(self, event) -> None:
+        # only node-set MEMBERSHIP changes re-ensure balancers — heartbeats
+        # modify Node objects constantly (nodeSyncLoop compares host lists,
+        # servicecontroller.go:600)
+        names = frozenset(n.metadata.name for n in self.nodes.items())
+        if names == self._known_nodes:
+            return
+        self._known_nodes = names
+        for svc in self.services.items():
+            if (svc.spec.get("type") == "LoadBalancer"):
+                self.enqueue(svc.key)
+
+    async def sync(self, key: str) -> None:
+        ns, name = key.split("/", 1)
+        svc = self.services.get(name, ns)
+        if svc is None or svc.spec.get("type") != "LoadBalancer":
+            # deleted or no longer wants a balancer: tear down
+            probe = svc if svc is not None else _DeletedService(key)
+            if self.cloud.get_load_balancer(probe) is not None:
+                self.cloud.ensure_load_balancer_deleted(probe)
+                if svc is not None:
+                    self._clear_status(svc)
+            return
+        node_names = [n.metadata.name for n in self.nodes.items()]
+        status = self.cloud.ensure_load_balancer(svc, node_names)
+        want = {"ingress": [{"ip": status.ingress_ip}]}
+        if svc.status.get("loadBalancer") == want:
+            return  # no-op: a status write would re-trigger our own sync
+
+        def mutate(obj):
+            obj.status["loadBalancer"] = dict(want)
+            return obj
+
+        try:
+            self.store.guaranteed_update("Service", name, ns, mutate)
+        except (NotFound, Conflict):
+            pass
+
+    def _clear_status(self, svc) -> None:
+        def mutate(obj):
+            obj.status.pop("loadBalancer", None)
+            return obj
+
+        try:
+            self.store.guaranteed_update(
+                "Service", svc.metadata.name, svc.metadata.namespace, mutate)
+        except (NotFound, Conflict):
+            pass
+
+
+class _DeletedService:
+    """Key-only stand-in so teardown can address the cloud's records."""
+
+    def __init__(self, key: str):
+        self.key = key

@@ -1,0 +1,2 @@
+from kubernetes_tpu.testing.framework import ClusterFixture  # noqa: F401
+from kubernetes_tpu.testing.chaos import ChaosMonkey  # noqa: F401

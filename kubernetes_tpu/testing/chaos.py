@@ -1,0 +1,61 @@
+"""ChaosMonkey: orchestrated disruption around running behaviors-under-test.
+
+The test/e2e/chaosmonkey/chaosmonkey.go analog, same contract: register
+Tests that (1) set up and verify steady state, (2) wait for the disruption,
+(3) validate the post-disruption world; `Do(disruption)` runs Setup for
+every test, fires the disruption once, then runs every Test's validation
+(chaosmonkey.go:48 Register, :70 Do)."""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Awaitable, Callable
+
+Disruption = Callable[[], Awaitable[None]]
+
+
+class ChaosTest:
+    """Override setup()/test() — test() runs after the disruption fired."""
+
+    async def setup(self) -> None:  # pragma: no cover - interface
+        pass
+
+    async def test(self) -> None:  # pragma: no cover - interface
+        raise NotImplementedError
+
+
+class FuncChaosTest(ChaosTest):
+    def __init__(self, setup=None, test=None):
+        self._setup = setup
+        self._test = test
+
+    async def setup(self) -> None:
+        if self._setup is not None:
+            await self._setup()
+
+    async def test(self) -> None:
+        if self._test is not None:
+            await self._test()
+
+
+class ChaosMonkey:
+    def __init__(self, disruption: Disruption):
+        self.disruption = disruption
+        self.tests: list[ChaosTest] = []
+
+    def register(self, test: ChaosTest) -> None:
+        self.tests.append(test)
+
+    def register_func(self, setup=None, test=None) -> None:
+        self.register(FuncChaosTest(setup=setup, test=test))
+
+    async def do(self) -> None:
+        """Setup all -> disrupt -> validate all (chaosmonkey.go:70)."""
+        for test in self.tests:
+            await test.setup()
+        await self.disruption()
+        results = await asyncio.gather(
+            *(test.test() for test in self.tests), return_exceptions=True)
+        failures = [r for r in results if isinstance(r, BaseException)]
+        if failures:
+            raise failures[0]

@@ -1,0 +1,85 @@
+"""E2E test framework: a whole cluster as one async fixture.
+
+The test/e2e/framework analog (framework.go: per-test namespace, cluster
+helpers, teardown) fused with the integration ring's in-process master
+(test/integration/framework/master_utils.go:453 RunAMaster): one call
+boots store (+ optional WAL), apiserver-equivalent wiring, controller
+manager, scheduler, and a kubelet fleet with a fake runtime — the full
+control plane the e2e suites drive."""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+
+from kubernetes_tpu.agent.kubelet import KubeletCluster
+from kubernetes_tpu.apiserver import ObjectStore
+from kubernetes_tpu.controllers import ControllerManager
+from kubernetes_tpu.scheduler import Scheduler
+from kubernetes_tpu.state import Capacities
+
+_ns_counter = itertools.count(1)
+
+
+class ClusterFixture:
+    def __init__(self, n_nodes: int = 4, caps: Capacities | None = None,
+                 node_lifecycle_kwargs: dict | None = None,
+                 capacity: dict | None = None):
+        self.store = ObjectStore()
+        self.kubelets = KubeletCluster(
+            self.store, n_nodes=n_nodes, heartbeat_every=0.2,
+            capacity=capacity or {"cpu": "16", "memory": "32Gi",
+                                  "pods": "110"})
+        self.manager = ControllerManager(
+            self.store,
+            node_lifecycle_kwargs=node_lifecycle_kwargs
+            or dict(monitor_period=0.1, grace_period=0.6,
+                    eviction_timeout=0.2, eviction_rate=1000.0))
+        self.caps = caps or Capacities(
+            num_nodes=max(8, 1 << (n_nodes - 1).bit_length()),
+            batch_pods=64)
+        self.scheduler = Scheduler(self.store, caps=self.caps)
+        self._driver_task: asyncio.Task | None = None
+
+    async def start(self) -> "ClusterFixture":
+        await self.kubelets.start()
+        await self.manager.start()
+        await self.scheduler.start()
+        self._driver_task = asyncio.get_running_loop().create_task(
+            self.scheduler.run())
+        return self
+
+    def stop(self) -> None:
+        self.scheduler.stop()
+        if self._driver_task is not None:
+            self._driver_task.cancel()
+        self.manager.stop()
+        self.kubelets.stop()
+
+    async def restart_scheduler(self) -> None:
+        """Component-restart disruption: kill the scheduler mid-flight and
+        bring up a fresh instance that must rebuild all state by relisting
+        (the crash-only contract, SURVEY.md §5.4)."""
+        self.scheduler.stop()
+        if self._driver_task is not None:
+            self._driver_task.cancel()
+        self.scheduler = Scheduler(self.store, caps=self.caps)
+        await self.scheduler.start()
+        self._driver_task = asyncio.get_running_loop().create_task(
+            self.scheduler.run())
+
+    def namespace(self) -> str:
+        """A fresh per-test namespace name (framework.go CreateNamespace)."""
+        return f"e2e-{next(_ns_counter)}"
+
+    # ---- assertion helpers ----
+
+    def pods(self, namespace: str | None = None):
+        return self.store.list("Pod", namespace, copy_objects=False)
+
+    async def wait_running(self, count: int, namespace: str | None = None,
+                           timeout: float = 30.0) -> None:
+        async with asyncio.timeout(timeout):
+            while sum(1 for p in self.pods(namespace)
+                      if p.status.phase == "Running") < count:
+                await asyncio.sleep(0.05)

@@ -1,0 +1,110 @@
+"""E2E framework + chaosmonkey (test/e2e/framework + chaosmonkey analogs)
+and the cloud-provider service LB controller."""
+
+import asyncio
+
+from kubernetes_tpu.cloudprovider import FakeCloud
+from kubernetes_tpu.testing import ChaosMonkey, ClusterFixture
+
+from tests.test_controllers import rs_obj, until
+
+
+def test_chaos_scheduler_restart_under_load():
+    """Register workload behaviors, disrupt by restarting the scheduler
+    mid-flight, validate the world converges — the chaosmonkey contract
+    around the crash-only scheduler."""
+    async def run():
+        cluster = await ClusterFixture(n_nodes=4).start()
+        try:
+            async def setup():
+                cluster.store.create(rs_obj("steady", replicas=8))
+                await cluster.wait_running(8)
+
+            async def validate():
+                # post-disruption: a second workload must still schedule,
+                # and the first must still be whole
+                cluster.store.create(rs_obj("after", replicas=4,
+                                            labels={"app": "after"}))
+                await cluster.wait_running(12)
+                names = {p.metadata.name.split("-")[0]
+                         for p in cluster.pods()
+                         if p.status.phase == "Running"}
+                assert names == {"steady", "after"}
+
+            async def disruption():
+                await cluster.restart_scheduler()
+
+            monkey = ChaosMonkey(disruption)
+            monkey.register_func(setup=setup, test=validate)
+            await monkey.do()
+        finally:
+            cluster.stop()
+
+    asyncio.run(run())
+
+
+def test_chaos_node_kill_via_framework():
+    """The node-failure drill expressed through the framework + monkey."""
+    async def run():
+        cluster = await ClusterFixture(n_nodes=4).start()
+        try:
+            async def setup():
+                cluster.store.create(rs_obj("work", replicas=8))
+                await cluster.wait_running(8)
+
+            async def validate():
+                async with asyncio.timeout(20):
+                    while True:
+                        pods = cluster.pods()
+                        live = [p for p in pods
+                                if p.status.phase == "Running"
+                                and p.spec.node_name != "node-0"]
+                        if len(live) == 8:
+                            return
+                        await asyncio.sleep(0.05)
+
+            async def disruption():
+                cluster.kubelets.stop(["node-0"])
+
+            monkey = ChaosMonkey(disruption)
+            monkey.register_func(setup=setup, test=validate)
+            await monkey.do()
+        finally:
+            cluster.stop()
+
+    asyncio.run(run())
+
+
+def test_service_loadbalancer_lifecycle():
+    async def run():
+        from kubernetes_tpu.api.objects import Service
+        from kubernetes_tpu.apiserver import ObjectStore
+        from kubernetes_tpu.controllers import ControllerManager
+
+        store = ObjectStore()
+        cloud = FakeCloud()
+        mgr = ControllerManager(store, enable_node_lifecycle=False,
+                                cloud=cloud)
+        await mgr.start()
+        from kubernetes_tpu.api.objects import Node
+        store.create(Node.from_dict({"metadata": {"name": "n0"}}))
+        store.create(Service.from_dict({
+            "metadata": {"name": "lb", "namespace": "default"},
+            "spec": {"type": "LoadBalancer", "selector": {"app": "lb"},
+                     "ports": [{"port": 80}]}}))
+        await until(lambda: (store.get("Service", "lb").status
+                             .get("loadBalancer", {}).get("ingress")))
+        svc = store.get("Service", "lb")
+        ip = svc.status["loadBalancer"]["ingress"][0]["ip"]
+        assert ip.startswith("198.51.100.")
+        assert cloud.backends["default/lb"] == ("n0",)
+        # node join updates the backend pool
+        store.create(Node.from_dict({"metadata": {"name": "n1"}}))
+        await until(lambda: cloud.backends.get("default/lb")
+                    == ("n0", "n1"))
+        # deletion tears the balancer down
+        store.delete("Service", "lb")
+        await until(lambda: "default/lb" not in cloud.balancers)
+        mgr.stop()
+
+    asyncio.run(run())
