@@ -105,6 +105,9 @@ class Kubelet(HollowKubelet):
             return
         if pod.spec.node_name != self.node_name:
             return
+        if pod.status.phase in ("Succeeded", "Failed"):
+            return  # terminal: our own final status write must not
+            # resurrect a parked worker for a pod that will never run again
         queue = self._workers.get(pod.key)
         if queue is None:
             queue = asyncio.Queue()

@@ -825,6 +825,68 @@ class Deployment(_Workload):
 
 
 @dataclass
+class Namespace(_SpecStatusObject):
+    """v1 Namespace (cluster-scoped; stored under the conventional ""
+    namespace key). status.phase Active|Terminating drives the lifecycle
+    admission plugin and the namespace controller's cascade deletion
+    (pkg/controller/namespace)."""
+
+    kind = "Namespace"
+
+    @property
+    def phase(self) -> str:
+        return self.status.get("phase", "Active")
+
+
+@dataclass
+class CustomResourceDefinition(_SpecStatusObject):
+    """apiextensions CustomResourceDefinition: registers a new REST
+    resource served generically (apiextensions-apiserver analog;
+    spec: {group, version, names: {plural, kind}, scope})."""
+
+    kind = "CustomResourceDefinition"
+
+    @property
+    def plural(self) -> str:
+        return (self.spec.get("names") or {}).get("plural", "")
+
+    @property
+    def target_kind(self) -> str:
+        return (self.spec.get("names") or {}).get("kind", "")
+
+
+@dataclass
+class GenericObject:
+    """Schema-less object backing custom resources: whatever JSON arrives,
+    keyed like every other object (the apiextensions CustomResource)."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    body: dict[str, Any] = field(default_factory=dict)
+    kind: str = ""
+
+    @property
+    def key(self) -> str:
+        return f"{self.metadata.namespace}/{self.metadata.name}"
+
+    def clone(self) -> "GenericObject":
+        return GenericObject(metadata=self.metadata.clone(),
+                             body=copy.deepcopy(self.body), kind=self.kind)
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]) -> "GenericObject":
+        body = {k: copy.deepcopy(v) for k, v in d.items()
+                if k != "metadata"}
+        return cls(metadata=ObjectMeta.from_dict(d.get("metadata") or {}),
+                   body=body, kind=d.get("kind", ""))
+
+    def to_dict(self) -> dict[str, Any]:
+        out = copy.deepcopy(self.body)
+        out["kind"] = self.kind
+        out["metadata"] = self.metadata.to_dict()
+        return out
+
+
+@dataclass
 class LimitRange(_SpecStatusObject):
     """v1 LimitRange: per-namespace container request/limit defaults and
     bounds enforced by the LimitRanger admission plugin

@@ -51,6 +51,7 @@ def chain_for(names: str) -> AdmissionChain:
     """Build a chain from a comma-separated plugin list ('default' = all);
     unknown names are an error, like the reference's --admission-control."""
     registry = {
+        "NamespaceLifecycle": NamespaceLifecycle,
         "DefaultTolerationSeconds": DefaultTolerationSeconds,
         "LimitRanger": LimitRanger,
         "ResourceQuota": ResourceQuotaPlugin,
@@ -67,6 +68,31 @@ def chain_for(names: str) -> AdmissionChain:
 
 
 # ---------------------------------------------------------------------------
+
+
+class NamespaceLifecycle:
+    """Reject writes into a Terminating (or deleted-while-known) namespace
+    (plugin/pkg/admission/namespace/lifecycle). Unlike the reference this
+    store is schema-less: a namespace with no Namespace object is treated
+    as implicitly Active (auto-provisioned `default` semantics) so
+    namespace objects stay opt-in."""
+
+    SKIP_KINDS = frozenset({"Namespace", "CustomResourceDefinition",
+                            "Event"})
+
+    def admit(self, store, obj: Any, operation: str) -> None:
+        if operation != "CREATE" or obj.kind in self.SKIP_KINDS:
+            return
+        ns = obj.metadata.namespace
+        try:
+            namespace = store.get("Namespace", ns)
+        except KeyError:
+            return  # implicitly Active
+        if namespace.phase == "Terminating" \
+                or namespace.metadata.deletion_timestamp is not None:
+            raise AdmissionError(
+                f"unable to create new content in namespace {ns} because "
+                f"it is being terminated")
 
 
 NOT_READY_KEY = "node.alpha.kubernetes.io/notReady"

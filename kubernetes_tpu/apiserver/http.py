@@ -43,6 +43,7 @@ from urllib.parse import parse_qs, urlsplit
 from kubernetes_tpu.api import objects as objs
 from kubernetes_tpu.api.objects import Binding
 from kubernetes_tpu.apiserver.admission import AdmissionError
+from kubernetes_tpu.apiserver.validation import ValidationError
 from kubernetes_tpu.apiserver.store import (
     AlreadyExists,
     Conflict,
@@ -71,19 +72,27 @@ RESOURCES: dict[str, str] = {
     "jobs": "Job",
     "limitranges": "LimitRange",
     "resourcequotas": "ResourceQuota",
+    "namespaces": "Namespace",
+    "customresourcedefinitions": "CustomResourceDefinition",
 }
 KIND_TO_CLS = {cls.kind: cls for cls in (
     objs.Pod, objs.Node, objs.Service, objs.Endpoints, objs.Event,
     objs.PersistentVolume, objs.PersistentVolumeClaim,
     objs.ReplicationController, objs.ReplicaSet, objs.StatefulSet,
-    objs.Deployment, objs.Job, objs.LimitRange, objs.ResourceQuota)}
+    objs.Deployment, objs.Job, objs.LimitRange, objs.ResourceQuota,
+    objs.Namespace, objs.CustomResourceDefinition)}
 PLURAL_OF = {kind: plural for plural, kind in RESOURCES.items()}
 
 
 def decode_object(kind: str, body: dict) -> Any:
     cls = KIND_TO_CLS.get(kind)
     if cls is None:
-        raise NotFound(f"unknown kind {kind!r}")
+        # custom resources decode generically (apiextensions serving path)
+        obj = objs.GenericObject.from_dict(body)
+        obj.kind = kind or obj.kind
+        if not obj.kind:
+            raise NotFound("object has no kind")
+        return obj
     return cls.from_dict(body)
 
 
@@ -123,7 +132,7 @@ class APIServer:
         if self.authorizer is None:
             return None
         try:
-            ns, plural, name, _sub = self._parse_path(path)
+            ns, plural, _kind, name, _sub = self._parse_path(path)
         except NotFound:
             return None  # let routing produce the 404
         verb = {"GET": "get" if name else "list", "POST": "create",
@@ -198,9 +207,26 @@ class APIServer:
 
     # ---- routing ----
 
-    @staticmethod
-    def _parse_path(path: str):
-        """-> (ns | None, plural, name | None, subresource | None)."""
+    def _resolve_plural(self, plural: str) -> str:
+        """plural -> kind, consulting registered CRDs for custom resources
+        (the apiextensions serving path)."""
+        kind = RESOURCES.get(plural)
+        if kind is not None:
+            return kind
+        for crd in self.store.list("CustomResourceDefinition",
+                                   copy_objects=False):
+            if crd.plural == plural and crd.target_kind:
+                return crd.target_kind
+        raise NotFound(f"unknown resource {plural!r}")
+
+    def _parse_path(self, path: str):
+        """-> (ns | None, plural, kind, name | None, subresource | None).
+
+        `/namespaces/{x}` with nothing after it addresses the Namespace
+        RESOURCE itself (cluster-scoped); with a trailing resource segment
+        it scopes the request to namespace x (installer.go path shapes).
+        Resolves the kind exactly once per request (CRD lookups scan the
+        store)."""
         parts = [p for p in path.strip("/").split("/") if p]
         # strip the version prefix: api/v1 or apis/{group}/{version}
         if parts[:1] == ["api"]:
@@ -213,9 +239,6 @@ class APIServer:
         if parts[:1] == ["namespaces"] and len(parts) >= 3:
             ns = parts[1]
             parts = parts[2:]
-        elif parts[:1] == ["namespaces"] and len(parts) == 2:
-            # namespace-scoped list via /namespaces/{ns} alone: unsupported
-            raise NotFound(f"unknown path {path!r}")
         if not parts:
             raise NotFound(f"unknown path {path!r}")
         plural, name, sub = parts[0], None, None
@@ -223,14 +246,11 @@ class APIServer:
             name = parts[1]
         if len(parts) >= 3:
             sub = parts[2]
-        if plural not in RESOURCES:
-            raise NotFound(f"unknown resource {plural!r}")
-        return ns, plural, name, sub
+        return ns, plural, self._resolve_plural(plural), name, sub
 
     def _route(self, method: str, path: str, query: dict, body: bytes):
         try:
-            ns, plural, name, sub = self._parse_path(path)
-            kind = RESOURCES[plural]
+            ns, _plural, kind, name, sub = self._parse_path(path)
             if sub == "binding" and method == "POST" and kind == "Pod":
                 args = json.loads(body)
                 target = (args.get("target") or {}).get("name", "")
@@ -264,6 +284,21 @@ class APIServer:
                 updated = self.store.update(obj)
                 return 200, encode_object(updated)
             if method == "DELETE" and name is not None:
+                if kind == "Namespace":
+                    # first DELETE soft-deletes into Terminating (the
+                    # namespace controller cascades); a DELETE of an
+                    # already-Terminating namespace finalizes it — which is
+                    # how a controller running over RemoteStore removes the
+                    # object after the sweep (finalize semantics)
+                    from kubernetes_tpu.controllers.namespace import (
+                        request_namespace_deletion,
+                    )
+
+                    current = self.store.get("Namespace", name)
+                    if current.phase != "Terminating":
+                        request_namespace_deletion(self.store, name)
+                        return 200, encode_object(
+                            self.store.get("Namespace", name))
                 deleted = self.store.delete(kind, name, ns or "default")
                 return 200, encode_object(deleted)
             return 405, {"message": f"method {method} not allowed"}
@@ -272,6 +307,9 @@ class APIServer:
                          "message": str(e)}
         except AdmissionError as e:
             return 403, {"kind": "Status", "reason": "Forbidden",
+                         "message": str(e)}
+        except ValidationError as e:
+            return 422, {"kind": "Status", "reason": "Invalid",
                          "message": str(e)}
         except AlreadyExists as e:
             return 409, {"kind": "Status", "reason": "AlreadyExists",
@@ -288,8 +326,7 @@ class APIServer:
     async def _serve_watch(self, writer: asyncio.StreamWriter, path: str,
                            query: dict) -> None:
         try:
-            ns, plural, _name, _sub = self._parse_path(path)
-            kind = RESOURCES[plural]
+            ns, _plural, kind, _name, _sub = self._parse_path(path)
         except NotFound as e:
             await _respond(writer, 404, {"message": str(e)})
             return
@@ -434,6 +471,8 @@ class RemoteStore:
             raise NotFound(decoded.get("message", "not found"))
         if status in (401, 403):
             raise PermissionError(decoded.get("message", f"HTTP {status}"))
+        if status == 422:
+            raise ValidationError(decoded.get("message", "invalid object"))
         if status == 409:
             if decoded.get("reason") == "AlreadyExists":
                 raise AlreadyExists(decoded.get("message", ""))

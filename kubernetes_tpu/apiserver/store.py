@@ -168,6 +168,13 @@ class ObjectStore:
         if key in bucket:
             raise AlreadyExists(f"{kind} {key} already exists")
         stored = obj.clone() if copy else obj
+        # validation precedes admission so plugins with side effects (the
+        # quota usage mirror) never observe an object the write path will
+        # reject anyway; admission-added defaults come from trusted config
+        # objects that were themselves validated on THEIR write
+        from kubernetes_tpu.apiserver.validation import validate
+
+        validate(stored)
         if self.admission is not None:
             self.admission.admit(self, stored, "CREATE")
         rv = self._next_rv()
@@ -208,6 +215,9 @@ class ObjectStore:
                 f"{kind} {key}: version {obj.metadata.resource_version} != "
                 f"{current.metadata.resource_version}")
         stored = obj.clone()
+        from kubernetes_tpu.apiserver.validation import validate
+
+        validate(stored)
         if self.admission is not None:
             self.admission.admit(self, stored, "UPDATE")
         rv = self._next_rv()

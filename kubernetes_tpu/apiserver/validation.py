@@ -1,0 +1,111 @@
+"""API object validation — the pkg/api/validation analog, enforced on the
+store's write path the way the registry strategies run Validate before
+storage (apiserver/pkg/registry/generic/registry/store.go Create).
+
+Covers the invariants the control plane itself relies on: DNS-1123 names,
+non-empty unique containers, parseable resource quantities with
+requests <= limits, restart-policy enum, port ranges, workload selectors
+actually selecting their templates (the classic misconfiguration the
+reference rejects at ValidateReplicaSetSpec)."""
+
+from __future__ import annotations
+
+import re
+from typing import Any
+
+from kubernetes_tpu.api.quantity import parse_quantity
+
+# DNS-1123 subdomain (validation.IsDNS1123Subdomain)
+_NAME_RE = re.compile(
+    r"^[a-z0-9]([-a-z0-9]*[a-z0-9])?(\.[a-z0-9]([-a-z0-9]*[a-z0-9])?)*$")
+_RESTART_POLICIES = ("Always", "OnFailure", "Never")
+
+
+class ValidationError(ValueError):
+    """Invalid API object (HTTP 422 in the reference)."""
+
+
+def validate(obj: Any) -> None:
+    meta = getattr(obj, "metadata", None)
+    if meta is None:
+        return
+    if not meta.name or len(meta.name) > 253 \
+            or not _NAME_RE.match(meta.name):
+        raise ValidationError(
+            f"metadata.name: invalid value {meta.name!r}: must be a "
+            f"DNS-1123 subdomain")
+    kind = getattr(obj, "kind", "")
+    if kind == "Pod":
+        _validate_pod(obj)
+    elif kind == "Service":
+        _validate_service(obj)
+    elif kind in ("ReplicaSet", "ReplicationController", "StatefulSet",
+                  "Deployment", "Job"):
+        _validate_workload(obj)
+
+
+def _validate_quantities(where: str, quantities: dict) -> dict:
+    parsed = {}
+    for res, qty in quantities.items():
+        try:
+            parsed[res] = parse_quantity(str(qty))
+        except (ValueError, ArithmeticError):
+            raise ValidationError(
+                f"{where}[{res}]: invalid quantity {qty!r}")
+    return parsed
+
+
+def _validate_pod(pod) -> None:
+    if not pod.spec.containers:
+        raise ValidationError("spec.containers: must specify at least one")
+    seen = set()
+    for i, c in enumerate(pod.spec.containers):
+        where = f"spec.containers[{i}]"
+        if not c.name or not _NAME_RE.match(c.name):
+            raise ValidationError(f"{where}.name: invalid value {c.name!r}")
+        if c.name in seen:
+            raise ValidationError(f"{where}.name: duplicate {c.name!r}")
+        seen.add(c.name)
+        req = _validate_quantities(f"{where}.resources.requests",
+                                   c.requests)
+        lim = _validate_quantities(f"{where}.resources.limits", c.limits)
+        for res, value in req.items():
+            if res in lim and value > lim[res]:
+                raise ValidationError(
+                    f"{where}.resources.requests[{res}]: must be <= limit "
+                    f"({value} > {lim[res]})")
+        for p in c.ports:
+            for port in (p.container_port, p.host_port):
+                if port and not 0 < port <= 65535:
+                    raise ValidationError(
+                        f"{where}.ports: invalid port {port}")
+    if pod.spec.restart_policy not in _RESTART_POLICIES:
+        raise ValidationError(
+            f"spec.restartPolicy: unsupported value "
+            f"{pod.spec.restart_policy!r}")
+
+
+def _validate_service(svc) -> None:
+    for i, p in enumerate(svc.spec.get("ports") or []):
+        port = p.get("port")
+        if port is not None and not 0 < int(port) <= 65535:
+            raise ValidationError(f"spec.ports[{i}].port: invalid {port}")
+
+
+def _validate_workload(obj) -> None:
+    if obj.replicas < 0:
+        raise ValidationError("spec.replicas: must be non-negative")
+    template_labels = ((obj.spec.get("template") or {})
+                       .get("metadata") or {}).get("labels") or {}
+    selector = obj.spec.get("selector")
+    if isinstance(selector, dict) and selector:
+        match = selector.get("matchLabels") \
+            if "matchLabels" in selector or "matchExpressions" in selector \
+            else selector  # RC map selector
+        if match and template_labels:
+            mismatched = {k: v for k, v in match.items()
+                          if template_labels.get(k) != v}
+            if mismatched:
+                raise ValidationError(
+                    f"spec.template.metadata.labels: selector does not "
+                    f"match template labels (missing {mismatched})")

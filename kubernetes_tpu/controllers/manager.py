@@ -28,7 +28,7 @@ class ControllerManager:
             kind: Informer(store, kind)
             for kind in ("Pod", "Node", "Service", "ReplicaSet",
                          "ReplicationController", "StatefulSet",
-                         "Deployment", "Job")}
+                         "Deployment", "Job", "Namespace")}
         pods = self.informers["Pod"]
         self.replicaset = ReplicaManager(
             store, "ReplicaSet", self.informers["ReplicaSet"], pods)
@@ -42,9 +42,13 @@ class ControllerManager:
         self.job = JobController(store, self.informers["Job"], pods)
         self.endpoints = EndpointController(
             store, self.informers["Service"], pods)
+        from kubernetes_tpu.controllers.namespace import NamespaceController
+
+        self.namespace = NamespaceController(store,
+                                             self.informers["Namespace"])
         self.controllers = [self.replicaset, self.replication,
                             self.deployment, self.statefulset, self.job,
-                            self.endpoints]
+                            self.endpoints, self.namespace]
         if enable_gc:
             self.gc = GarbageCollector(
                 store, pods,

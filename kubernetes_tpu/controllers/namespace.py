@@ -1,0 +1,94 @@
+"""Namespace controller: cascade deletion of namespace contents.
+
+The pkg/controller/namespace analog (namespace_controller.go syncNamespace
+-> deletion.go deleteAllContent): when a Namespace enters Terminating, the
+controller deletes every namespaced object inside it across all known
+kinds, then finalizes by removing the Namespace object itself."""
+
+from __future__ import annotations
+
+import time
+
+from kubernetes_tpu.apiserver.store import Conflict, NotFound, ObjectStore
+from kubernetes_tpu.client.informer import Informer
+from kubernetes_tpu.controllers.base import ReconcileController
+
+# the namespaced kinds swept on termination (deletion.go's
+# groupVersionResources discovery, statically known here)
+NAMESPACED_KINDS = (
+    "Pod", "Service", "Endpoints", "Event", "ReplicaSet",
+    "ReplicationController", "StatefulSet", "Deployment", "Job",
+    "PersistentVolumeClaim", "LimitRange", "ResourceQuota",
+)
+
+
+class NamespaceController(ReconcileController):
+    workers = 1
+
+    def __init__(self, store: ObjectStore, ns_informer: Informer):
+        super().__init__()
+        self.name = "namespace-controller"
+        self.store = store
+        self.namespaces = ns_informer
+        ns_informer.add_handler(self._on_namespace)
+
+    def _on_namespace(self, event) -> None:
+        if event.type != "DELETED":
+            self.enqueue(event.obj.metadata.name)
+
+    async def sync(self, key: str) -> None:
+        ns_obj = self.namespaces.get(key)
+        if ns_obj is None:
+            return
+        if ns_obj.phase != "Terminating" \
+                and ns_obj.metadata.deletion_timestamp is None:
+            return
+        if ns_obj.phase != "Terminating":
+            # phase transition first, so admission rejects new content
+            # while the sweep runs (syncNamespace :154)
+            def mark(obj):
+                obj.status["phase"] = "Terminating"
+                return obj
+
+            try:
+                self.store.guaranteed_update("Namespace", key, "default",
+                                             mark)
+            except (NotFound, Conflict):
+                return
+        remaining = 0
+        kinds = list(NAMESPACED_KINDS)
+        # CRD-backed custom resources are namespaced content too
+        # (deleteAllContent discovers resources dynamically)
+        for crd in self.store.list("CustomResourceDefinition",
+                                   copy_objects=False):
+            if crd.target_kind:
+                kinds.append(crd.target_kind)
+        for kind in kinds:
+            for obj in list(self.store.list(kind, namespace=key,
+                                            copy_objects=False)):
+                try:
+                    self.store.delete(kind, obj.metadata.name, key)
+                except NotFound:
+                    continue
+                remaining += 1
+        if remaining:
+            self.enqueue_after(key, 0.05)  # re-check until empty
+            return
+        # finalize: the namespace object itself goes away (deletion.go
+        # retryOnConflictError(finalizeNamespace) then delete)
+        try:
+            self.store.delete("Namespace", key)
+        except NotFound:
+            pass
+
+
+def request_namespace_deletion(store: ObjectStore, name: str) -> None:
+    """The DELETE-namespace API semantics: set deletionTimestamp +
+    Terminating instead of removing the object, letting the controller
+    cascade (registry namespace strategy)."""
+    def mutate(obj):
+        obj.metadata.deletion_timestamp = time.time()
+        obj.status["phase"] = "Terminating"
+        return obj
+
+    store.guaranteed_update("Namespace", name, "default", mutate)

@@ -32,9 +32,12 @@ class ServiceLBController(ReconcileController):
         self.enqueue(event.obj.key)
 
     def _on_node(self, event) -> None:
-        # only node-set MEMBERSHIP changes re-ensure balancers — heartbeats
-        # modify Node objects constantly (nodeSyncLoop compares host lists,
-        # servicecontroller.go:600)
+        # only node-set MEMBERSHIP changes re-ensure balancers — heartbeat
+        # MODIFIED events (constant at scale) cannot change the set, so
+        # they don't even pay a membership recompute (nodeSyncLoop compares
+        # host lists, servicecontroller.go:600)
+        if event.type == "MODIFIED":
+            return
         names = frozenset(n.metadata.name for n in self.nodes.items())
         if names == self._known_nodes:
             return

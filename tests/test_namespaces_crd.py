@@ -1,0 +1,145 @@
+"""Namespaces (lifecycle admission + cascade deletion), API validation, and
+CustomResourceDefinitions served generically over HTTP."""
+
+import asyncio
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from kubernetes_tpu.api.objects import Namespace, Pod, ReplicaSet, Service
+from kubernetes_tpu.apiserver import ObjectStore
+from kubernetes_tpu.apiserver.admission import AdmissionError, default_chain
+from kubernetes_tpu.apiserver.validation import ValidationError
+from kubernetes_tpu.controllers import ControllerManager
+from kubernetes_tpu.controllers.namespace import request_namespace_deletion
+
+from tests.http_util import http_store
+from tests.test_controllers import until
+
+
+def mk_pod(name, ns="default"):
+    return Pod.from_dict({"metadata": {"name": name, "namespace": ns},
+                          "spec": {"containers": [{"name": "c"}]}})
+
+
+# ---- validation ----
+
+
+def test_validation_rejects_malformed_objects():
+    store = ObjectStore()
+    with pytest.raises(ValidationError, match="DNS-1123"):
+        store.create(mk_pod("Bad_Name"))
+    with pytest.raises(ValidationError, match="at least one"):
+        store.create(Pod.from_dict({"metadata": {"name": "empty"}}))
+    with pytest.raises(ValidationError, match="duplicate"):
+        store.create(Pod.from_dict({
+            "metadata": {"name": "dup"},
+            "spec": {"containers": [{"name": "c"}, {"name": "c"}]}}))
+    with pytest.raises(ValidationError, match="invalid quantity"):
+        store.create(Pod.from_dict({
+            "metadata": {"name": "badq"},
+            "spec": {"containers": [{"name": "c", "resources": {
+                "requests": {"cpu": "banana"}}}]}}))
+    with pytest.raises(ValidationError, match="must be <= limit"):
+        store.create(Pod.from_dict({
+            "metadata": {"name": "reqlim"},
+            "spec": {"containers": [{"name": "c", "resources": {
+                "requests": {"cpu": "2"}, "limits": {"cpu": "1"}}}]}}))
+    with pytest.raises(ValidationError, match="selector does not match"):
+        store.create(ReplicaSet.from_dict({
+            "metadata": {"name": "mismatch"},
+            "spec": {"replicas": 1,
+                     "selector": {"matchLabels": {"app": "a"}},
+                     "template": {"metadata": {"labels": {"app": "b"}},
+                                  "spec": {"containers": [{"name": "c"}]}}}}))
+    # valid objects still pass
+    store.create(mk_pod("ok-pod"))
+
+
+def test_validation_422_over_http():
+    with http_store() as (client, _store):
+        with pytest.raises(ValidationError, match="DNS-1123"):
+            client.create(mk_pod("Bad_Name"))
+
+
+# ---- namespace lifecycle ----
+
+
+def test_terminating_namespace_rejects_new_content():
+    store = ObjectStore(admission=default_chain())
+    store.create(Namespace.from_dict({"metadata": {"name": "team-a"}}))
+    store.create(mk_pod("p0", ns="team-a"))          # Active: allowed
+    request_namespace_deletion(store, "team-a")
+    with pytest.raises(AdmissionError, match="being terminated"):
+        store.create(mk_pod("p1", ns="team-a"))
+    store.create(mk_pod("p2"))                       # other ns unaffected
+
+
+def test_namespace_cascade_deletion():
+    async def run():
+        store = ObjectStore()
+        mgr = ControllerManager(store, enable_node_lifecycle=False)
+        await mgr.start()
+        store.create(Namespace.from_dict({"metadata": {"name": "doomed"}}))
+        store.create(mk_pod("p0", ns="doomed"))
+        store.create(Service.from_dict({
+            "metadata": {"name": "svc", "namespace": "doomed"},
+            "spec": {"selector": {"a": "b"}}}))
+        store.create(mk_pod("survivor"))
+        request_namespace_deletion(store, "doomed")
+        await until(lambda: not store.list("Pod", "doomed")
+                    and not store.list("Service", "doomed")
+                    and not store.list("Namespace",
+                                       field_glob="doomed"), timeout=10)
+        # the namespace object finalized away; other namespaces untouched
+        assert store.list("Pod", "default")
+        mgr.stop()
+
+    asyncio.run(run())
+
+
+# ---- CRDs ----
+
+
+def test_crd_registers_custom_resource_over_http():
+    with http_store() as (client, _store):
+        # register the CRD through the apiserver
+        crd = {"kind": "CustomResourceDefinition",
+               "metadata": {"name": "tpujobs.example.com"},
+               "spec": {"group": "example.com", "version": "v1",
+                        "scope": "Namespaced",
+                        "names": {"plural": "tpujobs", "kind": "TPUJob"}}}
+        url = f"http://{client.host}:{client.port}"
+        req = urllib.request.Request(
+            f"{url}/apis/apiextensions.k8s.io/v1beta1/"
+            f"customresourcedefinitions",
+            data=json.dumps(crd).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=5) as resp:
+            assert resp.status == 201
+
+        # CRUD the custom resource at its own group path
+        cr = {"kind": "TPUJob", "apiVersion": "example.com/v1",
+              "metadata": {"name": "train-1", "namespace": "default"},
+              "spec": {"slices": 4, "topology": "4x4"}}
+        base = f"{url}/apis/example.com/v1/namespaces/default/tpujobs"
+        req = urllib.request.Request(
+            base, data=json.dumps(cr).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=5) as resp:
+            assert resp.status == 201
+        with urllib.request.urlopen(f"{base}/train-1", timeout=5) as resp:
+            got = json.loads(resp.read())
+        assert got["kind"] == "TPUJob"
+        assert got["spec"] == {"slices": 4, "topology": "4x4"}
+        with urllib.request.urlopen(base, timeout=5) as resp:
+            listing = json.loads(resp.read())
+        assert listing["kind"] == "TPUJobList"
+        assert len(listing["items"]) == 1
+        # unregistered plurals still 404
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(f"{url}/apis/example.com/v1/widgets",
+                                   timeout=5)
+        assert err.value.code == 404
