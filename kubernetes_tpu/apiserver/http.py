@@ -291,6 +291,7 @@ class APIServer:
                     # how a controller running over RemoteStore removes the
                     # object after the sweep (finalize semantics)
                     from kubernetes_tpu.controllers.namespace import (
+                        namespace_is_empty,
                         request_namespace_deletion,
                     )
 
@@ -299,6 +300,13 @@ class APIServer:
                         request_namespace_deletion(self.store, name)
                         return 200, encode_object(
                             self.store.get("Namespace", name))
+                    if not namespace_is_empty(self.store, name):
+                        # finalize only once the sweep has emptied it — an
+                        # impatient repeat DELETE must not orphan contents
+                        return 409, {"kind": "Status", "reason": "Conflict",
+                                     "message": f"namespace {name} is "
+                                                f"terminating; contents are "
+                                                f"still being deleted"}
                 deleted = self.store.delete(kind, name, ns or "default")
                 return 200, encode_object(deleted)
             return 405, {"message": f"method {method} not allowed"}
@@ -436,10 +444,14 @@ class RemoteStore:
     """ObjectStore-compatible client over the HTTP API: informers, the
     scheduler driver, controllers, and the extender run over TCP unchanged."""
 
-    def __init__(self, host: str, port: int, token: str = ""):
+    def __init__(self, host: str, port: int, token: str = "",
+                 rate_limiter=None):
         self.host = host
         self.port = port
         self.token = token
+        # client-go-style token bucket (client/flowcontrol.py); None = no
+        # throttling, the in-process/test default
+        self.rate_limiter = rate_limiter
 
     def _auth_header(self) -> str:
         return (f"Authorization: Bearer {self.token}\r\n"
@@ -448,6 +460,8 @@ class RemoteStore:
     # ---- blocking HTTP core (CRUD: small JSON on a trusted network) ----
 
     def _request(self, method: str, path: str, body: dict | None = None):
+        if self.rate_limiter is not None:
+            self.rate_limiter.accept()
         payload = json.dumps(body).encode() if body is not None else b""
         with socket.create_connection((self.host, self.port),
                                       timeout=30) as sock:

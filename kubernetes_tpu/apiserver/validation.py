@@ -88,12 +88,25 @@ def _validate_pod(pod) -> None:
 def _validate_service(svc) -> None:
     for i, p in enumerate(svc.spec.get("ports") or []):
         port = p.get("port")
-        if port is not None and not 0 < int(port) <= 65535:
+        if port is None:
+            continue
+        try:
+            number = int(port)
+        except (TypeError, ValueError):
+            raise ValidationError(
+                f"spec.ports[{i}].port: invalid {port!r}")
+        if not 0 < number <= 65535:
             raise ValidationError(f"spec.ports[{i}].port: invalid {port}")
 
 
 def _validate_workload(obj) -> None:
-    if obj.replicas < 0:
+    try:
+        replicas = obj.replicas
+    except (TypeError, ValueError):
+        raise ValidationError(
+            f"spec.replicas: invalid value "
+            f"{obj.spec.get('replicas')!r}")
+    if replicas < 0:
         raise ValidationError("spec.replicas: must be non-negative")
     template_labels = ((obj.spec.get("template") or {})
                        .get("metadata") or {}).get("labels") or {}

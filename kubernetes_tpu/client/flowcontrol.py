@@ -1,0 +1,45 @@
+"""Client-side flow control: the token-bucket rate limiter.
+
+The client-go util/flowcontrol analog (throttle.go tokenBucketRateLimiter:
+qps refill, burst capacity) that caps a client's request rate against the
+apiserver — the scheduler_perf harness configures the reference's client at
+5000 QPS / 5000 burst (test/integration/scheduler_perf/util.go:46).
+`RemoteStore(rate_limiter=...)` applies it to every blocking request."""
+
+from __future__ import annotations
+
+import time
+
+
+class TokenBucketRateLimiter:
+    def __init__(self, qps: float, burst: int):
+        if qps <= 0:
+            raise ValueError("qps must be positive")
+        self.qps = qps
+        self.burst = max(1, burst)
+        self._tokens = float(self.burst)
+        self._last = time.monotonic()
+
+    def _refill(self, now: float) -> None:
+        self._tokens = min(self.burst,
+                           self._tokens + (now - self._last) * self.qps)
+        self._last = now
+
+    def try_accept(self) -> bool:
+        """Non-blocking TryAccept (throttle.go:103)."""
+        self._refill(time.monotonic())
+        if self._tokens >= 1.0:
+            self._tokens -= 1.0
+            return True
+        return False
+
+    def accept(self) -> None:
+        """Blocking Accept: sleep until a token is available
+        (throttle.go:91)."""
+        while True:
+            now = time.monotonic()
+            self._refill(now)
+            if self._tokens >= 1.0:
+                self._tokens -= 1.0
+                return
+            time.sleep(max((1.0 - self._tokens) / self.qps, 1e-4))

@@ -56,14 +56,9 @@ class NamespaceController(ReconcileController):
             except (NotFound, Conflict):
                 return
         remaining = 0
-        kinds = list(NAMESPACED_KINDS)
         # CRD-backed custom resources are namespaced content too
         # (deleteAllContent discovers resources dynamically)
-        for crd in self.store.list("CustomResourceDefinition",
-                                   copy_objects=False):
-            if crd.target_kind:
-                kinds.append(crd.target_kind)
-        for kind in kinds:
+        for kind in namespace_kinds(self.store):
             for obj in list(self.store.list(kind, namespace=key,
                                             copy_objects=False)):
                 try:
@@ -80,6 +75,20 @@ class NamespaceController(ReconcileController):
             self.store.delete("Namespace", key)
         except NotFound:
             pass
+
+
+def namespace_kinds(store: ObjectStore) -> list[str]:
+    """Every namespaced kind, including CRD-backed custom resources."""
+    kinds = list(NAMESPACED_KINDS)
+    for crd in store.list("CustomResourceDefinition", copy_objects=False):
+        if crd.target_kind:
+            kinds.append(crd.target_kind)
+    return kinds
+
+
+def namespace_is_empty(store: ObjectStore, name: str) -> bool:
+    return not any(store.list(kind, namespace=name, copy_objects=False)
+                   for kind in namespace_kinds(store))
 
 
 def request_namespace_deletion(store: ObjectStore, name: str) -> None:

@@ -1,0 +1,86 @@
+"""Native (C) host-plane kernels, built on first import.
+
+The reference's runtime is compiled Go; this package gives the framework's
+host plane the same native tier where it does byte-level work — currently
+the FNV-1a hashing kernel behind universe interning (utils/hashing.py).
+
+Build strategy: compile `fnv.c` with the system C compiler into the
+package's `_build/` directory the first time it is imported (a few ms,
+cached thereafter, keyed by source mtime) and bind it with ctypes — the
+image ships g++/cc but not pybind11. Any failure (no compiler, read-only
+filesystem) degrades silently to the pure-Python implementations; callers
+check `fnv1a64 is not None`.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import tempfile
+
+log = logging.getLogger(__name__)
+
+fnv1a64 = None          # (bytes) -> int, or None when unavailable
+lanes_batch = None      # (list[bytes]) -> (np.uint32[n], np.uint32[n])
+
+
+def _build_and_bind():
+    global fnv1a64, lanes_batch
+
+    src = os.path.join(os.path.dirname(__file__), "fnv.c")
+    build_dir = os.path.join(os.path.dirname(__file__), "_build")
+    lib_path = os.path.join(build_dir, "libfnv.so")
+    try:
+        if (not os.path.exists(lib_path)
+                or os.path.getmtime(lib_path) < os.path.getmtime(src)):
+            os.makedirs(build_dir, exist_ok=True)
+            # build via a temp file + rename: concurrent importers race
+            fd, tmp = tempfile.mkstemp(dir=build_dir, suffix=".so")
+            os.close(fd)
+            subprocess.run(
+                ["cc", "-O2", "-shared", "-fPIC", "-o", tmp, src],
+                check=True, capture_output=True, timeout=60)
+            os.replace(tmp, lib_path)
+        lib = ctypes.CDLL(lib_path)
+        # symbol binding stays inside the guard: a stale .so missing a
+        # symbol must degrade to pure Python, not crash the import
+        lib.fnv1a64.restype = ctypes.c_uint64
+        lib.fnv1a64.argtypes = [ctypes.c_char_p, ctypes.c_size_t]
+        lib.fnv1a64_lanes_batch.restype = None
+        lib.fnv1a64_lanes_batch.argtypes = [
+            ctypes.c_char_p, ctypes.POINTER(ctypes.c_size_t),
+            ctypes.c_size_t, ctypes.POINTER(ctypes.c_uint32),
+            ctypes.POINTER(ctypes.c_uint32)]
+    except (OSError, subprocess.SubprocessError, AttributeError) as e:
+        log.debug("native fnv unavailable (%s); using pure Python", e)
+        return
+
+    def _fnv1a64(data: bytes) -> int:
+        return lib.fnv1a64(data, len(data))
+
+    def _lanes_batch(items: list[bytes]):
+        import numpy as np
+
+        n = len(items)
+        blob = b"".join(items)
+        offsets = (ctypes.c_size_t * (n + 1))()
+        pos = 0
+        for i, item in enumerate(items):
+            offsets[i] = pos
+            pos += len(item)
+        offsets[n] = pos
+        lo = np.empty(n, np.uint32)
+        hi = np.empty(n, np.uint32)
+        lib.fnv1a64_lanes_batch(
+            blob, offsets, n,
+            lo.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+            hi.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)))
+        return lo, hi
+
+    fnv1a64 = _fnv1a64
+    lanes_batch = _lanes_batch
+
+
+_build_and_bind()

@@ -1,0 +1,41 @@
+/* FNV-1a 64-bit — the host-plane hashing kernel behind universe interning.
+ *
+ * Every string the device ever compares (labels, selector terms, taints,
+ * node names) is hashed exactly once on the host at encode time
+ * (kubernetes_tpu/utils/hashing.py); this is that loop in C. The reference
+ * runtime is compiled Go — its map hashing and string compares are native
+ * code — so the framework's encode path gets the same treatment rather
+ * than a Python byte loop.
+ *
+ * Exposed via ctypes (no pybind11 in the image); see native/__init__.py
+ * for the build-on-first-import harness and the pure-Python fallback.
+ */
+
+#include <stddef.h>
+#include <stdint.h>
+
+#define FNV64_OFFSET 0xCBF29CE484222325ULL
+#define FNV64_PRIME 0x100000001B3ULL
+
+uint64_t fnv1a64(const unsigned char *data, size_t len) {
+    uint64_t h = FNV64_OFFSET;
+    for (size_t i = 0; i < len; i++) {
+        h ^= (uint64_t)data[i];
+        h *= FNV64_PRIME;
+    }
+    return h;
+}
+
+/* Batch API: hash n strings packed back-to-back in `data`, with
+ * offsets[i]..offsets[i+1] delimiting string i (offsets has n+1 entries).
+ * Writes the 0->1-remapped uint32 lanes the device layout wants. */
+void fnv1a64_lanes_batch(const unsigned char *data, const size_t *offsets,
+                         size_t n, uint32_t *lo_out, uint32_t *hi_out) {
+    for (size_t i = 0; i < n; i++) {
+        uint64_t h = fnv1a64(data + offsets[i], offsets[i + 1] - offsets[i]);
+        uint32_t lo = (uint32_t)(h & 0xFFFFFFFFULL);
+        uint32_t hi = (uint32_t)(h >> 32);
+        lo_out[i] = lo ? lo : 1;
+        hi_out[i] = hi ? hi : 1;
+    }
+}

@@ -32,7 +32,7 @@ from kubernetes_tpu.state.cluster_state import (
     pod_requests,
 )
 from kubernetes_tpu.state.layout import Capacities, CapacityError, Effect, Resource, TolOp
-from kubernetes_tpu.utils.hashing import hash32, hash_lanes
+from kubernetes_tpu.utils.hashing import hash32, hash_lanes, hash_lanes_many
 
 
 @struct.dataclass
@@ -305,11 +305,11 @@ def encode_pod_into(batch: PodBatch, i: int, pod: Pod, caps: Capacities,
     batch.tol_val_hi[i] = 0
     batch.tol_op[i] = TolOp.NONE
     batch.tol_effect[i] = Effect.NONE
+    # one native batch call hashes every toleration value (hash_lanes_many)
+    value_lanes = hash_lanes_many([tol.value for tol in tols])
     for t, tol in enumerate(tols):
         batch.tol_key[i, t] = hash32(tol.key) if tol.key else 0
-        val_lo, val_hi = hash_lanes(tol.value)
-        batch.tol_val_lo[i, t] = val_lo
-        batch.tol_val_hi[i, t] = val_hi
+        batch.tol_val_lo[i, t], batch.tol_val_hi[i, t] = value_lanes[t]
         batch.tol_op[i, t] = TolOp.EXISTS if tol.operator == "Exists" else TolOp.EQUAL
         batch.tol_effect[i, t] = Effect.NAMES.get(tol.effect, Effect.NONE)
 

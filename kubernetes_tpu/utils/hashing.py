@@ -15,19 +15,29 @@ on 0 are remapped to 1.
 
 from __future__ import annotations
 
+from kubernetes_tpu import native as _native
+
 _FNV64_OFFSET = 0xCBF29CE484222325
 _FNV64_PRIME = 0x100000001B3
 _MASK64 = 0xFFFFFFFFFFFFFFFF
 
 
-def fnv1a64(data: str | bytes) -> int:
-    """FNV-1a 64-bit hash of a string (utf-8) or bytes."""
-    if isinstance(data, str):
-        data = data.encode("utf-8")
+def _fnv1a64_py(data: bytes) -> int:
     h = _FNV64_OFFSET
     for b in data:
         h = ((h ^ b) * _FNV64_PRIME) & _MASK64
     return h
+
+
+def fnv1a64(data: str | bytes) -> int:
+    """FNV-1a 64-bit hash of a string (utf-8) or bytes. Computed by the
+    native kernel (kubernetes_tpu/native/fnv.c) when the build-on-import
+    succeeded; bit-identical pure-Python fallback otherwise."""
+    if isinstance(data, str):
+        data = data.encode("utf-8")
+    if _native.fnv1a64 is not None:
+        return _native.fnv1a64(data)
+    return _fnv1a64_py(data)
 
 
 def hash_lanes(data: str | bytes) -> tuple[int, int]:
@@ -50,3 +60,15 @@ def hash32(data: str | bytes) -> int:
 def hash_kv(key: str, value: str) -> tuple[int, int]:
     """Hash lanes for a key=value pair (labels, selector terms, taints)."""
     return hash_lanes(key + "\x00" + value)
+
+
+def hash_lanes_many(items: list[str | bytes]) -> list[tuple[int, int]]:
+    """Lanes for a batch of strings in ONE native call when the kernel is
+    available (encode paths hash several strings per object); scalar
+    fallback is bit-identical."""
+    if _native.lanes_batch is not None and items:
+        encoded = [i.encode("utf-8") if isinstance(i, str) else i
+                   for i in items]
+        lo, hi = _native.lanes_batch(encoded)
+        return [(int(lo[k]), int(hi[k])) for k in range(len(items))]
+    return [hash_lanes(i) for i in items]

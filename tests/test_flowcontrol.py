@@ -1,0 +1,46 @@
+"""Token-bucket client flow control (client-go util/flowcontrol analog)."""
+
+import time
+
+import pytest
+
+from kubernetes_tpu.client.flowcontrol import TokenBucketRateLimiter
+
+
+def test_burst_then_throttle():
+    rl = TokenBucketRateLimiter(qps=100, burst=5)
+    assert all(rl.try_accept() for _ in range(5))   # burst drains freely
+    assert not rl.try_accept()                      # empty bucket
+    time.sleep(0.03)                                # ~3 tokens refill
+    got = sum(rl.try_accept() for _ in range(10))
+    assert 1 <= got <= 5
+
+
+def test_blocking_accept_paces():
+    rl = TokenBucketRateLimiter(qps=200, burst=1)
+    rl.accept()
+    t0 = time.monotonic()
+    for _ in range(4):
+        rl.accept()
+    elapsed = time.monotonic() - t0
+    assert elapsed >= 4 / 200 * 0.5   # paced near qps (slack for timers)
+
+
+def test_remote_store_applies_limiter():
+    from tests.http_util import http_store
+    from kubernetes_tpu.apiserver.http import RemoteStore
+
+    with http_store() as (client, _store):
+        limited = RemoteStore(client.host, client.port,
+                              rate_limiter=TokenBucketRateLimiter(
+                                  qps=50, burst=1))
+        limited.list("Pod")
+        t0 = time.monotonic()
+        for _ in range(3):
+            limited.list("Pod")
+        assert time.monotonic() - t0 >= 3 / 50 * 0.5
+
+
+def test_invalid_qps_rejected():
+    with pytest.raises(ValueError):
+        TokenBucketRateLimiter(qps=0, burst=1)

@@ -1,0 +1,48 @@
+"""Native FNV kernel: bit-parity with the pure-Python implementation, and
+the build/fallback contract."""
+
+import random
+import string
+
+import pytest
+
+from kubernetes_tpu import native
+from kubernetes_tpu.utils.hashing import _fnv1a64_py, fnv1a64, hash_lanes
+
+
+def test_native_kernel_built():
+    # the test image ships cc: the native tier must actually be in play
+    assert native.fnv1a64 is not None, "native build failed on an image with cc"
+
+
+def test_native_matches_python_bit_for_bit():
+    rng = random.Random(7)
+    cases = [b"", b"a", "kubernetes.io/hostname".encode(),
+             "zone=ümläut".encode()]
+    for _ in range(200):
+        n = rng.randrange(0, 64)
+        cases.append(bytes(rng.randrange(256) for _ in range(n)))
+    for data in cases:
+        assert native.fnv1a64(data) == _fnv1a64_py(data), data
+
+
+def test_batch_lanes_match_scalar():
+    items = [f"{k}={v}".encode()
+             for k in string.ascii_lowercase for v in ("a", "bb", "ccc")]
+    lo, hi = native.lanes_batch(items)
+    for i, item in enumerate(items):
+        want_lo, want_hi = hash_lanes(item)
+        assert (int(lo[i]), int(hi[i])) == (want_lo, want_hi)
+
+
+def test_zero_lane_remap_in_batch():
+    # lanes of 0 must remap to 1 (the empty-slot sentinel); empty string's
+    # offset hash has nonzero lanes, so just verify the invariant holds
+    items = [b"", b"x"]
+    lo, hi = native.lanes_batch(items)
+    assert (lo != 0).all() and (hi != 0).all()
+
+
+def test_public_fnv_uses_some_backend():
+    # whichever backend is live, the public function stays deterministic
+    assert fnv1a64("abc") == fnv1a64(b"abc") == _fnv1a64_py(b"abc")
