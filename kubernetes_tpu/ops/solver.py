@@ -268,6 +268,46 @@ def _static_mask(state: ClusterState, pod, policy: Policy,
     return ok
 
 
+def _use_fused_static(policy: Policy, state, batch) -> bool:
+    """The Pallas fused static kernel applies selector/taint/condition/
+    host checks unconditionally — sound only when the policy registers all
+    of them and adds no base-mask predicates; tile shapes must divide the
+    padded capacities. Opt-in via KTPU_PALLAS=1 (see PERF.md)."""
+    import os
+
+    if os.environ.get("KTPU_PALLAS") != "1":
+        return False
+    return (
+        state.valid.shape[0] % 128 == 0    # lane width (tiles adapt above)
+        and batch.valid.shape[0] % 8 == 0  # f32 sublane width
+        and policy.has_predicate("GeneralPredicates", "PodFitsHost",
+                                 "HostName")
+        and policy.has_predicate("GeneralPredicates", "MatchNodeSelector")
+        and policy.has_predicate("PodToleratesNodeTaints")
+        and policy.has_predicate("CheckNodeCondition")
+        and policy.has_predicate("CheckNodeMemoryPressure")
+        and policy.has_predicate("CheckNodeDiskPressure")
+        and not policy.service_affinity_predicates
+        and not active_label_presence(policy))
+
+
+def _static_rest(state: ClusterState, pod, policy: Policy,
+                 base_mask=None) -> jnp.ndarray:
+    """The static terms the fused kernel does NOT cover: required
+    node-affinity (a (T × UR × N) contraction) and the volume zone/node
+    predicates. AND-combined with the kernel output."""
+    term_sat = pod.naff_onehot @ state.req_member.T
+    term_ok = (term_sat >= pod.naff_count[:, None]) & pod.naff_ok[:, None]
+    ok = (~pod.naff_has) | jnp.any(term_ok, axis=0)
+    if base_mask is not None:
+        ok = ok & base_mask
+    if policy.has_predicate("NoVolumeZoneConflict"):
+        ok = ok & preds.volume_zone(state, pod)
+    if policy.has_predicate("NoVolumeNodeConflict"):
+        ok = ok & preds.volume_node(state, pod)
+    return ok
+
+
 def _static_score(state: ClusterState, pod, policy: Policy,
                   base_score=None) -> jnp.ndarray:
     """Assignment-independent score terms for one pod: f32[N]. `base_score`
@@ -452,8 +492,21 @@ def schedule_batch(
     base_mask, base_score = _base_rows(state, policy, prows, g)
 
     # ---- Phase A: batched over (P, N) ----
-    static_mask = jax.vmap(
-        lambda p: _static_mask(state, p, policy, base_mask))(batch)
+    if _use_fused_static(policy, state, batch):
+        from kubernetes_tpu.ops.pallas_kernels import fused_static_mask
+
+        untol = jax.vmap(
+            lambda p: 1.0 - preds._tolerated_universe(state, p)
+            .astype(jnp.float32))(batch)
+        fused = fused_static_mask(
+            state, batch.sel_onehot, batch.sel_count, untol,
+            batch.best_effort, batch.node_name_lo, batch.node_name_hi,
+            interpret=jax.default_backend() != "tpu")
+        static_mask = fused & jax.vmap(
+            lambda p: _static_rest(state, p, policy, base_mask))(batch)
+    else:
+        static_mask = jax.vmap(
+            lambda p: _static_mask(state, p, policy, base_mask))(batch)
     static_score = jax.vmap(
         lambda p: _static_score(state, p, policy, base_score))(batch)
     p_pods = static_mask.shape[0]
