@@ -27,9 +27,10 @@ from jax.experimental.pallas import tpu as pltpu
 
 from kubernetes_tpu.state.layout import Condition
 
-# tile sizes trade VMEM footprint against grid-step count; at (256, 512)
-# the per-step matmuls are MXU-sized and a 15k-node/4k-pod mask is ~512
-# grid steps (~1.1 MB of VMEM-resident operands per step)
+# tile sizes trade VMEM footprint against grid-step count; at (128, 256)
+# a 16k-node / 4k-pod mask is (4096/128)*(16384/256) = 2048 grid steps
+# with ~0.5 MB of VMEM-resident operands per step (512-wide node tiles
+# tripped the scoped-vmem limit under Mosaic's double buffering)
 NODE_TILE = 256
 POD_TILE = 128
 

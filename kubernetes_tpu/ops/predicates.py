@@ -101,23 +101,27 @@ def fits_host_ports(state: ClusterState, pod: PodBatch, port_count=None) -> jnp.
     return conflicts == 0.0
 
 
+def node_affinity_ok(state: ClusterState, pod: PodBatch) -> jnp.ndarray:
+    """The required-node-affinity half of PodMatchNodeSelector: OR over
+    terms, each term an AND over interned requirements —
+    `naff_onehot[T, UR] @ req_member[N, UR].T` gives per-term
+    satisfied-requirement counts, a term holds when every requirement
+    matched (count equality), and dead terms (empty/unparseable,
+    predicates.go:628-645) never hold. Shared by match_node_selector and
+    the Pallas fused path's XLA remainder (solver._static_rest)."""
+    term_sat = pod.naff_onehot @ state.req_member.T          # f32[T, N]
+    term_ok = (term_sat >= pod.naff_count[:, None]) & pod.naff_ok[:, None]
+    return (~pod.naff_has) | jnp.any(term_ok, axis=0)
+
+
 def match_node_selector(state: ClusterState, pod: PodBatch) -> jnp.ndarray:
     """PodMatchNodeSelector (predicates.go:686 podMatchesNodeLabels): the
     map-form nodeSelector AND any required node affinity must both hold.
-
     nodeSelector: satisfied-term count from one matvec against the selector
-    membership matrix. Node affinity: OR over terms, each term an AND over
-    interned requirements — `naff_onehot[T, UR] @ req_member[N, UR].T` gives
-    per-term satisfied-requirement counts, a term holds when every
-    requirement matched (count equality), and dead terms (empty/unparseable,
-    predicates.go:628-645) never hold."""
+    membership matrix."""
     satisfied = state.sel_member @ pod.sel_onehot
     sel_ok = satisfied >= pod.sel_count
-
-    term_sat = pod.naff_onehot @ state.req_member.T          # f32[T, N]
-    term_ok = (term_sat >= pod.naff_count[:, None]) & pod.naff_ok[:, None]
-    aff_ok = (~pod.naff_has) | jnp.any(term_ok, axis=0)
-    return sel_ok & aff_ok
+    return sel_ok & node_affinity_ok(state, pod)
 
 
 def _tolerated_universe(state: ClusterState, pod: PodBatch) -> jnp.ndarray:

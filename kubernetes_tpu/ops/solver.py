@@ -272,7 +272,9 @@ def _use_fused_static(policy: Policy, state, batch) -> bool:
     """The Pallas fused static kernel applies selector/taint/condition/
     host checks unconditionally — sound only when the policy registers all
     of them and adds no base-mask predicates; tile shapes must divide the
-    padded capacities. Opt-in via KTPU_PALLAS=1 (see PERF.md)."""
+    padded capacities. Opt-in via KTPU_PALLAS=1 (see PERF.md). The sharded
+    path passes allow_fused=False — Mosaic custom calls have no GSPMD
+    partitioning rule, so the kernel must never trace under a mesh."""
     import os
 
     if os.environ.get("KTPU_PALLAS") != "1":
@@ -296,9 +298,7 @@ def _static_rest(state: ClusterState, pod, policy: Policy,
     """The static terms the fused kernel does NOT cover: required
     node-affinity (a (T × UR × N) contraction) and the volume zone/node
     predicates. AND-combined with the kernel output."""
-    term_sat = pod.naff_onehot @ state.req_member.T
-    term_ok = (term_sat >= pod.naff_count[:, None]) & pod.naff_ok[:, None]
-    ok = (~pod.naff_has) | jnp.any(term_ok, axis=0)
+    ok = preds.node_affinity_ok(state, pod)
     if base_mask is not None:
         ok = ok & base_mask
     if policy.has_predicate("NoVolumeZoneConflict"):
@@ -458,6 +458,7 @@ def schedule_batch(
     caps=None,
     prows=None,
     flags: BatchFlags = ALL_ACTIVE,
+    allow_fused: bool = True,
 ) -> SolverResult:
     """Schedule a whole pending batch in one device program.
 
@@ -492,7 +493,7 @@ def schedule_batch(
     base_mask, base_score = _base_rows(state, policy, prows, g)
 
     # ---- Phase A: batched over (P, N) ----
-    if _use_fused_static(policy, state, batch):
+    if allow_fused and _use_fused_static(policy, state, batch):
         from kubernetes_tpu.ops.pallas_kernels import fused_static_mask
 
         untol = jax.vmap(

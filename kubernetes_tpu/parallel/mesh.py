@@ -107,14 +107,15 @@ def make_sharded_scheduler(mesh: Mesh, policy: Policy = DEFAULT_POLICY,
         return jax.jit(
             lambda state, fblob, iblob, rr: schedule_batch(
                 state, unpack_batch(fblob, iblob, caps), rr, policy,
-                caps=caps, prows=prows, flags=flags),
+                caps=caps, prows=prows, flags=flags, allow_fused=False),
             in_shardings=(st, repl, repl, repl),
             out_shardings=out_shardings,
         )
     return jax.jit(
         lambda state, batch, rr: schedule_batch(state, batch, rr, policy,
                                                 caps=caps, prows=prows,
-                                                flags=flags),
+                                                flags=flags,
+                                                allow_fused=False),
         in_shardings=(st, bt, repl),
         out_shardings=out_shardings,
     )

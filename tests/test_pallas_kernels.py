@@ -18,12 +18,34 @@ CAPS = Capacities(num_nodes=128, batch_pods=16)
 
 
 def fixture():
+    from kubernetes_tpu.api.objects import Node
+
     nodes = [mk_node(f"n{i}",
                      labels={"disk": "ssd"} if i % 3 == 0 else {},
                      taints=[{"key": "k", "value": "v",
                               "effect": "NoSchedule"}] if i % 5 == 0 else [])
              for i in range(40)]
-    nodes.append(mk_node("pressure"))
+    # condition bits must be exercised: memory pressure (rejects only
+    # BestEffort pods), disk pressure and NotReady (reject everyone)
+    nodes.append(Node.from_dict({
+        "metadata": {"name": "mempressure"},
+        "status": {"allocatable": {"cpu": "4", "memory": "8Gi",
+                                   "pods": "110"},
+                   "conditions": [{"type": "Ready", "status": "True"},
+                                  {"type": "MemoryPressure",
+                                   "status": "True"}]}}))
+    nodes.append(Node.from_dict({
+        "metadata": {"name": "diskpressure"},
+        "status": {"allocatable": {"cpu": "4", "memory": "8Gi",
+                                   "pods": "110"},
+                   "conditions": [{"type": "Ready", "status": "True"},
+                                  {"type": "DiskPressure",
+                                   "status": "True"}]}}))
+    nodes.append(Node.from_dict({
+        "metadata": {"name": "notready"},
+        "status": {"allocatable": {"cpu": "4", "memory": "8Gi",
+                                   "pods": "110"},
+                   "conditions": [{"type": "Ready", "status": "False"}]}}))
     pods = [
         mk_pod("plain", cpu="100m"),
         mk_pod("selects", nodeSelector={"disk": "ssd"}),
@@ -65,12 +87,17 @@ def test_solver_parity_with_pallas_enabled():
     """Same fixture through schedule_batch with and without the fused
     kernel: assignments and scores must be identical."""
     state, batch, _table = fixture()
-    baseline = schedule_batch(state, batch, 0, DEFAULT_POLICY, caps=CAPS)
-    os.environ["KTPU_PALLAS"] = "1"
+    saved = os.environ.pop("KTPU_PALLAS", None)  # force-plain baseline
     try:
+        baseline = schedule_batch(state, batch, 0, DEFAULT_POLICY,
+                                  caps=CAPS)
+        os.environ["KTPU_PALLAS"] = "1"
         fused = schedule_batch(state, batch, 0, DEFAULT_POLICY, caps=CAPS)
     finally:
-        del os.environ["KTPU_PALLAS"]
+        if saved is None:
+            os.environ.pop("KTPU_PALLAS", None)
+        else:
+            os.environ["KTPU_PALLAS"] = saved
     np.testing.assert_array_equal(np.asarray(baseline.assignments),
                                   np.asarray(fused.assignments))
     np.testing.assert_array_equal(np.asarray(baseline.scores),
