@@ -887,6 +887,24 @@ class GenericObject:
 
 
 @dataclass
+class Cluster(_SpecStatusObject):
+    """federation/v1beta1 Cluster: a member cluster registered with the
+    federation control plane (reference federation/apis/federation/types.go;
+    spec.serverAddress points at the member apiserver)."""
+
+    kind = "Cluster"
+
+    @property
+    def server_address(self) -> str:
+        return self.spec.get("serverAddress", "")
+
+    @property
+    def ready(self) -> bool:
+        return any(c.get("type") == "Ready" and c.get("status") == "True"
+                   for c in self.status.get("conditions", []))
+
+
+@dataclass
 class LimitRange(_SpecStatusObject):
     """v1 LimitRange: per-namespace container request/limit defaults and
     bounds enforced by the LimitRanger admission plugin

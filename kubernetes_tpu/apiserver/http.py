@@ -74,13 +74,14 @@ RESOURCES: dict[str, str] = {
     "resourcequotas": "ResourceQuota",
     "namespaces": "Namespace",
     "customresourcedefinitions": "CustomResourceDefinition",
+    "clusters": "Cluster",
 }
 KIND_TO_CLS = {cls.kind: cls for cls in (
     objs.Pod, objs.Node, objs.Service, objs.Endpoints, objs.Event,
     objs.PersistentVolume, objs.PersistentVolumeClaim,
     objs.ReplicationController, objs.ReplicaSet, objs.StatefulSet,
     objs.Deployment, objs.Job, objs.LimitRange, objs.ResourceQuota,
-    objs.Namespace, objs.CustomResourceDefinition)}
+    objs.Namespace, objs.CustomResourceDefinition, objs.Cluster)}
 PLURAL_OF = {kind: plural for plural, kind in RESOURCES.items()}
 
 

@@ -1,0 +1,5 @@
+from kubernetes_tpu.federation.sync import (  # noqa: F401
+    ClusterHealthController,
+    FederatedSyncController,
+    split_replicas,
+)

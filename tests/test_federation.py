@@ -1,0 +1,136 @@
+"""Federation: replica split planning, cluster health, and federated
+ReplicaSet propagation across member clusters (federation/pkg/
+federation-controller analogs)."""
+
+import asyncio
+import json
+
+from kubernetes_tpu.api.objects import Cluster, Node
+from kubernetes_tpu.apiserver import ObjectStore
+from kubernetes_tpu.client.informer import Informer
+from kubernetes_tpu.federation import (
+    ClusterHealthController,
+    FederatedSyncController,
+    split_replicas,
+)
+from kubernetes_tpu.federation.sync import PREFERENCES_ANNOTATION
+
+from tests.test_controllers import rs_obj, until
+
+
+def test_split_replicas_planner():
+    assert split_replicas(5, ["a", "b"]) == {"a": 3, "b": 2}
+    assert split_replicas(6, ["a", "b", "c"]) == {"a": 2, "b": 2, "c": 2}
+    assert split_replicas(5, ["a", "b"], {"a": 3, "b": 1}) \
+        == {"a": 4, "b": 1}
+    assert split_replicas(0, ["a", "b"]) == {"a": 0, "b": 0}
+    assert split_replicas(5, []) == {}
+    # zero weights degrade to an equal split instead of dividing by zero
+    assert split_replicas(4, ["a", "b"], {"a": 0, "b": 0}) \
+        == {"a": 2, "b": 2}
+
+
+class _Fed:
+    """Federation control plane + N in-process member clusters."""
+
+    def __init__(self, n_members=2):
+        self.fed = ObjectStore()
+        self.members = {f"m{i}": ObjectStore() for i in range(n_members)}
+        for name, store in self.members.items():
+            store.create(Node.from_dict({"metadata": {"name": f"{name}-n0"}}))
+            self.fed.create(Cluster.from_dict({
+                "metadata": {"name": name},
+                "spec": {"serverAddress": f"fake://{name}"}}))
+        self.cluster_informer = Informer(self.fed, "Cluster")
+        self.rs_informer = Informer(self.fed, "ReplicaSet")
+        self.health = ClusterHealthController(
+            self.fed, self.cluster_informer, self.client)
+        self.sync = FederatedSyncController(
+            self.fed, self.rs_informer, self.cluster_informer, self.client)
+
+    def client(self, cluster):
+        store = self.members.get(cluster.metadata.name)
+        if store is None:
+            raise ConnectionError(cluster.metadata.name)
+        return store
+
+    async def start(self):
+        self.cluster_informer.start()
+        self.rs_informer.start()
+        await self.cluster_informer.wait_for_sync()
+        await self.rs_informer.wait_for_sync()
+        await self.health.start()
+        await self.sync.start()
+        for c in self.cluster_informer.items():
+            self.health.enqueue(c.metadata.name)
+        # wait until every member is marked Ready
+        await until(lambda: all(
+            c.ready for c in self.fed.list("Cluster", copy_objects=False)))
+
+    def stop(self):
+        self.health.stop()
+        self.sync.stop()
+        self.cluster_informer.stop()
+        self.rs_informer.stop()
+
+
+def member_replicas(fed, name="web"):
+    out = {}
+    for cname, store in fed.members.items():
+        rss = [r for r in store.list("ReplicaSet", copy_objects=False)
+               if r.metadata.name == name]
+        out[cname] = rss[0].replicas if rss else None
+    return out
+
+
+def test_federated_replicaset_propagates_and_rescales():
+    async def run():
+        fed = _Fed(2)
+        await fed.start()
+        fed.fed.create(rs_obj("web", replicas=5))
+        await until(lambda: member_replicas(fed) == {"m0": 3, "m1": 2})
+        # rescale upstream -> members re-planned
+        rs = fed.fed.get("ReplicaSet", "web")
+        rs.spec["replicas"] = 9
+        fed.fed.update(rs, check_version=False)
+        await until(lambda: member_replicas(fed) == {"m0": 5, "m1": 4})
+        # delete upstream -> members cleaned
+        fed.fed.delete("ReplicaSet", "web")
+        await until(lambda: member_replicas(fed)
+                    == {"m0": None, "m1": None})
+        fed.stop()
+
+    asyncio.run(run())
+
+
+def test_preferences_weights_respected():
+    async def run():
+        fed = _Fed(2)
+        await fed.start()
+        rs = rs_obj("weighted", replicas=8)
+        rs.metadata.annotations[PREFERENCES_ANNOTATION] = json.dumps(
+            {"clusters": {"m0": {"weight": 3}, "m1": {"weight": 1}}})
+        fed.fed.create(rs)
+        await until(lambda: member_replicas(fed, "weighted")
+                    == {"m0": 6, "m1": 2})
+        fed.stop()
+
+    asyncio.run(run())
+
+
+def test_unhealthy_member_excluded_from_placement():
+    async def run():
+        fed = _Fed(2)
+        await fed.start()
+        # m1 becomes unreachable: health controller marks it NotReady
+        del fed.members["m1"]
+        fed.health.enqueue("m1")
+        await until(lambda: not fed.fed.get("Cluster", "m1").ready)
+        fed.fed.create(rs_obj("web", replicas=4))
+        await until(lambda: (fed.members["m0"].list(
+            "ReplicaSet", copy_objects=False) or [None])[0] is not None
+            and fed.members["m0"].list(
+                "ReplicaSet", copy_objects=False)[0].replicas == 4)
+        fed.stop()
+
+    asyncio.run(run())
