@@ -43,12 +43,14 @@ class ControllerManager:
         self.endpoints = EndpointController(
             store, self.informers["Service"], pods)
         from kubernetes_tpu.controllers.namespace import NamespaceController
+        from kubernetes_tpu.controllers.podgc import PodGCController
 
         self.namespace = NamespaceController(store,
                                              self.informers["Namespace"])
+        self.podgc = PodGCController(store, pods)
         self.controllers = [self.replicaset, self.replication,
                             self.deployment, self.statefulset, self.job,
-                            self.endpoints, self.namespace]
+                            self.endpoints, self.namespace, self.podgc]
         if enable_gc:
             self.gc = GarbageCollector(
                 store, pods,

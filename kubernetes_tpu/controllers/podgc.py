@@ -1,0 +1,72 @@
+"""Pod garbage collection: bound terminated-pod accumulation.
+
+The pkg/controller/podgc analog (gc_controller.go): when the number of
+terminated (Succeeded/Failed) pods exceeds the configured threshold, delete
+the oldest beyond it (--terminated-pod-gc-threshold, default 12500). Keeps
+the finished-pod record bounded so Jobs can run forever without the store
+growing unbounded."""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+
+from kubernetes_tpu.apiserver.store import NotFound, ObjectStore
+from kubernetes_tpu.client.informer import Informer
+
+log = logging.getLogger(__name__)
+
+TERMINATED_POD_GC_THRESHOLD = 12500  # gc_controller.go flag default
+
+
+class PodGCController:
+    """Periodic sweep (gcc.gc runs every gcCheckPeriod=20s)."""
+
+    name = "podgc-controller"
+
+    def __init__(self, store: ObjectStore, pod_informer: Informer, *,
+                 threshold: int = TERMINATED_POD_GC_THRESHOLD,
+                 check_period: float = 20.0):
+        self.store = store
+        self.pods = pod_informer
+        self.threshold = threshold
+        self.check_period = check_period
+        self._task: asyncio.Task | None = None
+
+    async def start(self) -> None:
+        self._task = asyncio.get_running_loop().create_task(self._loop())
+
+    def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            self._task = None
+
+    def gc_once(self) -> int:
+        """One sweep; returns pods deleted (gcTerminated,
+        gc_controller.go:115: sort by creation, delete oldest overflow)."""
+        terminated = [p for p in self.pods.items()
+                      if p.status.phase in ("Succeeded", "Failed")]
+        overflow = len(terminated) - self.threshold
+        if overflow <= 0:
+            return 0
+        terminated.sort(key=lambda p: p.metadata.creation_timestamp)
+        deleted = 0
+        for pod in terminated[:overflow]:
+            try:
+                self.store.delete("Pod", pod.metadata.name,
+                                  pod.metadata.namespace)
+                deleted += 1
+            except NotFound:
+                pass
+        if deleted:
+            log.info("podgc: deleted %d terminated pods over threshold %d",
+                     deleted, self.threshold)
+        return deleted
+
+    async def _loop(self) -> None:
+        while True:
+            await asyncio.sleep(self.check_period)
+            try:
+                self.gc_once()
+            except Exception:  # noqa: BLE001 — the sweep must not die
+                log.exception("podgc sweep failed")

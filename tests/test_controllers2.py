@@ -217,3 +217,34 @@ def _job_complete(store, name="work"):
     job = store.get("Job", name)
     return any(c.get("type") == "Complete"
                for c in job.status.get("conditions", []))
+
+
+def test_podgc_deletes_oldest_terminated_over_threshold():
+    """pkg/controller/podgc gcTerminated semantics: keep the newest
+    `threshold` terminated pods, delete the oldest overflow."""
+    async def run():
+        from kubernetes_tpu.controllers.podgc import PodGCController
+        from kubernetes_tpu.client.informer import Informer
+
+        store = ObjectStore()
+        for i in range(6):
+            store.create(Pod.from_dict({
+                "metadata": {"name": f"t{i}"},
+                "spec": {"containers": [{"name": "c"}]},
+                "status": {"phase": "Succeeded"}}))
+        store.create(Pod.from_dict({
+            "metadata": {"name": "live"},
+            "spec": {"containers": [{"name": "c"}]},
+            "status": {"phase": "Running"}}))
+        pods = Informer(store, "Pod")
+        pods.start()
+        await pods.wait_for_sync()
+        gc = PodGCController(store, pods, threshold=2)
+        assert gc.gc_once() == 4
+        names = {p.metadata.name for p in store.list("Pod")}
+        # oldest four terminated deleted; newest two + the live pod stay
+        assert names == {"t4", "t5", "live"}
+        assert gc.gc_once() == 0
+        pods.stop()
+
+    asyncio.run(run())
