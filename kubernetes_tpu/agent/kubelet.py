@@ -66,6 +66,10 @@ class FakeRuntime:
         """StopPodSandbox + RemovePodSandbox."""
         self._pods.pop(key, None)
 
+    def __contains__(self, key: str) -> bool:
+        """Part of the runtime interface: is this pod's sandbox present?"""
+        return key in self._pods
+
     def list_pods(self) -> dict[str, dict]:
         """The PLEG relist source: advance scripted exits, then snapshot."""
         now = time.monotonic()
@@ -83,10 +87,17 @@ class Kubelet(HollowKubelet):
 
     PLEG_PERIOD = 0.05  # reference relists at 1s; fakes are faster
 
+    MOUNT_RETRY = 0.1  # reconciler retry period over fakes
+
     def __init__(self, store: ObjectStore, node_name: str,
-                 runtime: FakeRuntime | None = None, **kw):
+                 runtime: FakeRuntime | None = None,
+                 volume_manager=None, **kw):
         super().__init__(store, node_name, **kw)
+        from kubernetes_tpu.agent.volumes import VolumeManager
+
         self.runtime = runtime if runtime is not None else FakeRuntime()
+        self.volumes = volume_manager if volume_manager is not None \
+            else VolumeManager(store, node_name)
         self._workers: dict[str, asyncio.Queue] = {}
         self._worker_tasks: dict[str, asyncio.Task] = {}
         self._pleg_task: asyncio.Task | None = None
@@ -101,6 +112,7 @@ class Kubelet(HollowKubelet):
         if event_type == "DELETED":
             self._stop_worker(pod.key)
             self.runtime.kill_pod(pod.key)
+            self.volumes.unmount_pod(pod.key)
             self._reported.pop(pod.key, None)
             return
         if pod.spec.node_name != self.node_name:
@@ -126,6 +138,8 @@ class Kubelet(HollowKubelet):
     # ---- pod workers (pod_workers.go:153) ----
 
     async def _manage_pod_loop(self, key: str, queue: asyncio.Queue) -> None:
+        from kubernetes_tpu.agent.volumes import MountError
+
         while True:
             pod = await queue.get()
             # drain to the newest update: workers serialize per pod and
@@ -134,13 +148,23 @@ class Kubelet(HollowKubelet):
                 pod = queue.get_nowait()
             try:
                 self._sync_pod(pod)
+            except MountError as e:
+                # WaitForAttachAndMount failure: the pod must not start;
+                # the reconciler retries until the volume becomes
+                # mountable (secret created, PV attached, ...)
+                log.info("syncPod(%s): waiting on volumes: %s", key, e)
+                loop = asyncio.get_running_loop()
+                loop.call_later(self.MOUNT_RETRY, queue.put_nowait, pod)
             except Exception:  # noqa: BLE001 — a worker must not die
                 log.exception("syncPod(%s) failed", key)
 
     def _sync_pod(self, pod: Pod) -> None:
-        """syncPod (kubelet.go:1390): run it, then report status."""
+        """syncPod (kubelet.go:1390): volumes first (WaitForAttachAndMount,
+        kubelet.go:1447), then the runtime, then report status."""
         if pod.status.phase in ("Succeeded", "Failed"):
             return
+        if pod.key not in self.runtime:
+            self.volumes.mount_pod(pod)
         self.runtime.sync_pod(pod)
         self._set_status(pod.key, "Running")
 
@@ -182,6 +206,7 @@ class Kubelet(HollowKubelet):
                     self._set_status(key, phase)
                     self._stop_worker(key)
                     self.runtime.kill_pod(key)
+                    self.volumes.unmount_pod(key)
 
     # ---- lifecycle ----
 
@@ -226,7 +251,7 @@ class KubeletCluster:
             # route the removal to whichever kubelet runs it
             for kubelet in self.kubelets.values():
                 if pod.key in kubelet._workers \
-                        or pod.key in kubelet.runtime._pods:
+                        or pod.key in kubelet.runtime:
                     kubelet.handle_pod("DELETED", pod)
             return
         if not pod.spec.node_name:

@@ -1,0 +1,216 @@
+"""Volume plugin SPI + kubelet volume manager.
+
+The pkg/volume analog: a `VolumePlugin` SPI (plugins.go VolumePlugin/
+Mounter) with the built-in drivers a pod spec can name — emptyDir,
+hostPath, secret, configMap, downwardAPI, and persistentVolumeClaim —
+plus the kubelet-side `VolumeManager` (volumemanager/reconciler/
+reconciler.go:165): mount every volume a pod declares before its
+containers start, unmount when the pod goes away. "Mount" here populates
+an in-memory mount table (the kubemark-fidelity stand-in for bind mounts);
+what is real is the control flow: secret/configMap content is resolved
+from the API at mount time (a missing Secret blocks pod start, exactly the
+reference's MountVolume error path), and a PVC volume requires the claim
+to be Bound and the underlying PV attached to this node
+(operation_executor WaitForAttach) before it mounts.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any
+
+from kubernetes_tpu.api.objects import Pod
+from kubernetes_tpu.apiserver.store import NotFound, ObjectStore
+
+
+class MountError(Exception):
+    """MountVolume failure — the pod must not start (reconciler retries)."""
+
+
+@dataclass
+class Mount:
+    volume_name: str
+    plugin: str
+    path: str
+    data: dict[str, Any] = field(default_factory=dict)
+
+
+class EmptyDirPlugin:
+    """pkg/volume/empty_dir: fresh scratch space per pod."""
+
+    name = "emptyDir"
+
+    def supports(self, vol: dict) -> bool:
+        return "emptyDir" in vol
+
+    def mount(self, pod: Pod, vol: dict, node_name: str) -> Mount:
+        return Mount(vol["name"], self.name,
+                     f"/var/lib/kubelet/pods/{pod.metadata.uid}/volumes/"
+                     f"emptydir/{vol['name']}")
+
+
+class HostPathPlugin:
+    """pkg/volume/host_path: the node path itself."""
+
+    name = "hostPath"
+
+    def supports(self, vol: dict) -> bool:
+        return "hostPath" in vol
+
+    def mount(self, pod: Pod, vol: dict, node_name: str) -> Mount:
+        path = (vol.get("hostPath") or {}).get("path", "")
+        if not path:
+            raise MountError(f"hostPath volume {vol['name']}: empty path")
+        return Mount(vol["name"], self.name, path)
+
+
+class SecretPlugin:
+    """pkg/volume/secret: projects Secret data; a missing Secret is a
+    mount failure, not an empty dir."""
+
+    name = "secret"
+    kind = "Secret"
+    spec_key = "secret"
+    ref_key = "secretName"
+
+    def __init__(self, store: ObjectStore):
+        self.store = store
+
+    def supports(self, vol: dict) -> bool:
+        return self.spec_key in vol
+
+    def mount(self, pod: Pod, vol: dict, node_name: str) -> Mount:
+        ref = (vol.get(self.spec_key) or {}).get(self.ref_key, "")
+        try:
+            obj = self.store.get(self.kind, ref, pod.metadata.namespace)
+        except NotFound:
+            raise MountError(
+                f"{self.kind.lower()} {ref!r} not found for volume "
+                f"{vol['name']}") from None
+        return Mount(vol["name"], self.name,
+                     f"/var/lib/kubelet/pods/{pod.metadata.uid}/volumes/"
+                     f"{self.name}/{vol['name']}",
+                     data=dict(obj.data))
+
+
+class ConfigMapPlugin(SecretPlugin):
+    """pkg/volume/configmap — same projection over ConfigMaps."""
+
+    name = "configMap"
+    kind = "ConfigMap"
+    spec_key = "configMap"
+    ref_key = "name"
+
+
+class DownwardAPIPlugin:
+    """pkg/volume/downwardapi: project pod metadata fields."""
+
+    name = "downwardAPI"
+
+    def supports(self, vol: dict) -> bool:
+        return "downwardAPI" in vol
+
+    def mount(self, pod: Pod, vol: dict, node_name: str) -> Mount:
+        data = {}
+        for item in (vol.get("downwardAPI") or {}).get("items") or []:
+            fieldpath = (item.get("fieldRef") or {}).get("fieldPath", "")
+            value = {"metadata.name": pod.metadata.name,
+                     "metadata.namespace": pod.metadata.namespace,
+                     "metadata.uid": pod.metadata.uid,
+                     "spec.nodeName": pod.spec.node_name,
+                     }.get(fieldpath)
+            if value is None:
+                raise MountError(f"downwardAPI volume {vol['name']}: "
+                                 f"unsupported fieldPath {fieldpath!r}")
+            data[item.get("path", fieldpath)] = value
+        return Mount(vol["name"], self.name,
+                     f"/var/lib/kubelet/pods/{pod.metadata.uid}/volumes/"
+                     f"downwardapi/{vol['name']}", data=data)
+
+
+class PVCPlugin:
+    """pkg/volume/persistent_claim + WaitForAttach: the claim must be
+    Bound, and the bound PV attached to this node (by the attach/detach
+    controller) before the mount proceeds."""
+
+    name = "persistentVolumeClaim"
+
+    def __init__(self, store: ObjectStore, require_attach: bool = True):
+        self.store = store
+        self.require_attach = require_attach
+
+    def supports(self, vol: dict) -> bool:
+        return "persistentVolumeClaim" in vol
+
+    def mount(self, pod: Pod, vol: dict, node_name: str) -> Mount:
+        claim = (vol.get("persistentVolumeClaim") or {}).get("claimName", "")
+        try:
+            pvc = self.store.get("PersistentVolumeClaim", claim,
+                                 pod.metadata.namespace)
+        except NotFound:
+            raise MountError(f"claim {claim!r} not found") from None
+        if not pvc.volume_name:
+            raise MountError(f"claim {claim!r} is not bound")
+        if self.require_attach:
+            from kubernetes_tpu.controllers.volume import _attached_name
+
+            try:
+                node = self.store.get("Node", node_name)
+            except NotFound:
+                raise MountError(f"node {node_name!r} not found") from None
+            want = _attached_name(pvc.volume_name)
+            if not any(a.get("name") == want
+                       for a in node.status.volumes_attached):
+                raise MountError(
+                    f"volume {pvc.volume_name!r} not yet attached to "
+                    f"{node_name}")
+        return Mount(vol["name"], self.name,
+                     f"/var/lib/kubelet/pods/{pod.metadata.uid}/volumes/"
+                     f"pv/{pvc.volume_name}",
+                     data={"pv": pvc.volume_name})
+
+
+def default_plugins(store: ObjectStore,
+                    require_attach: bool = True) -> list:
+    return [EmptyDirPlugin(), HostPathPlugin(), SecretPlugin(store),
+            ConfigMapPlugin(store), DownwardAPIPlugin(),
+            PVCPlugin(store, require_attach=require_attach)]
+
+
+class VolumeManager:
+    """Desired/actual mount worlds for one kubelet (volumemanager/
+    volume_manager.go WaitForAttachAndMount, collapsed to synchronous
+    mounts over fakes)."""
+
+    def __init__(self, store: ObjectStore, node_name: str,
+                 plugins: list | None = None, require_attach: bool = True):
+        self.node_name = node_name
+        self.plugins = plugins if plugins is not None else default_plugins(
+            store, require_attach=require_attach)
+        self._mounts: dict[str, list[Mount]] = {}  # pod key -> mounts
+
+    def _plugin_for(self, vol: dict):
+        for plugin in self.plugins:
+            if plugin.supports(vol):
+                return plugin
+        return None
+
+    def mount_pod(self, pod: Pod) -> list[Mount]:
+        """Mount every declared volume or raise MountError (all-or-nothing:
+        a pod with any unmountable volume must not start)."""
+        mounts: list[Mount] = []
+        for vol in pod.spec.volumes:
+            plugin = self._plugin_for(vol)
+            if plugin is None:
+                raise MountError(
+                    f"no plugin for volume {vol.get('name')!r} "
+                    f"(sources: {sorted(k for k in vol if k != 'name')})")
+            mounts.append(plugin.mount(pod, vol, self.node_name))
+        self._mounts[pod.key] = mounts
+        return mounts
+
+    def unmount_pod(self, pod_key: str) -> None:
+        self._mounts.pop(pod_key, None)
+
+    def mounts(self, pod_key: str) -> list[Mount]:
+        return list(self._mounts.get(pod_key, ()))

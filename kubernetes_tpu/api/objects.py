@@ -463,6 +463,10 @@ class NodeStatus:
     # raw v1 ContainerImage dicts: {"names": [...], "sizeBytes": int}
     # (ImageLocalityPriority reads node.Status.Images, image_locality.go:71)
     images: list[dict[str, Any]] = field(default_factory=list)
+    # attach/detach controller's actual world: [{"name": ..., "devicePath":
+    # ...}] + kubelet's in-use marks (v1.NodeStatus VolumesAttached/InUse)
+    volumes_attached: list[dict[str, Any]] = field(default_factory=list)
+    volumes_in_use: list[str] = field(default_factory=list)
 
     @classmethod
     def from_dict(cls, d: dict[str, Any]) -> "NodeStatus":
@@ -471,6 +475,8 @@ class NodeStatus:
             allocatable={k: str(v) for k, v in (d.get("allocatable") or {}).items()},
             conditions=[NodeCondition.from_dict(c) for c in d.get("conditions") or []],
             images=copy.deepcopy(d.get("images") or []),
+            volumes_attached=copy.deepcopy(d.get("volumesAttached") or []),
+            volumes_in_use=list(d.get("volumesInUse") or []),
         )
 
     def to_dict(self) -> dict[str, Any]:
@@ -483,6 +489,10 @@ class NodeStatus:
             out["conditions"] = [c.to_dict() for c in self.conditions]
         if self.images:
             out["images"] = copy.deepcopy(self.images)
+        if self.volumes_attached:
+            out["volumesAttached"] = copy.deepcopy(self.volumes_attached)
+        if self.volumes_in_use:
+            out["volumesInUse"] = list(self.volumes_in_use)
         return out
 
     def effective_allocatable(self) -> dict[str, str]:
@@ -518,7 +528,11 @@ class Node:
                                                 c.last_transition_time,
                                                 c.reason)
                                   for c in self.status.conditions],
-                              images=copy.deepcopy(self.status.images)),
+                              images=copy.deepcopy(self.status.images),
+                              volumes_attached=copy.deepcopy(
+                                  self.status.volumes_attached),
+                              volumes_in_use=list(
+                                  self.status.volumes_in_use)),
         )
 
     @classmethod
@@ -595,6 +609,7 @@ class PersistentVolume:
 
     metadata: ObjectMeta = field(default_factory=ObjectMeta)
     spec: dict[str, Any] = field(default_factory=dict)  # raw PV source spec
+    status: dict[str, Any] = field(default_factory=dict)
 
     kind = "PersistentVolume"
 
@@ -602,19 +617,28 @@ class PersistentVolume:
     def key(self) -> str:
         return self.metadata.name
 
+    @property
+    def phase(self) -> str:
+        return self.status.get("phase", "Pending")
+
     def clone(self) -> "PersistentVolume":
         return PersistentVolume(metadata=self.metadata.clone(),
-                                spec=copy.deepcopy(self.spec))
+                                spec=copy.deepcopy(self.spec),
+                                status=copy.deepcopy(self.status))
 
     @classmethod
     def from_dict(cls, d: dict[str, Any]) -> "PersistentVolume":
         return cls(metadata=ObjectMeta.from_dict(d.get("metadata") or {}),
-                   spec=copy.deepcopy(d.get("spec") or {}))
+                   spec=copy.deepcopy(d.get("spec") or {}),
+                   status=copy.deepcopy(d.get("status") or {}))
 
     def to_dict(self) -> dict[str, Any]:
-        return {"apiVersion": "v1", "kind": "PersistentVolume",
-                "metadata": self.metadata.to_dict(),
-                "spec": copy.deepcopy(self.spec)}
+        out = {"apiVersion": "v1", "kind": "PersistentVolume",
+               "metadata": self.metadata.to_dict(),
+               "spec": copy.deepcopy(self.spec)}
+        if self.status:
+            out["status"] = copy.deepcopy(self.status)
+        return out
 
 
 @dataclass
@@ -624,6 +648,7 @@ class PersistentVolumeClaim:
 
     metadata: ObjectMeta = field(default_factory=ObjectMeta)
     spec: dict[str, Any] = field(default_factory=dict)
+    status: dict[str, Any] = field(default_factory=dict)
 
     kind = "PersistentVolumeClaim"
 
@@ -635,19 +660,28 @@ class PersistentVolumeClaim:
     def volume_name(self) -> str:
         return self.spec.get("volumeName", "") or ""
 
+    @property
+    def phase(self) -> str:
+        return self.status.get("phase", "Pending")
+
     def clone(self) -> "PersistentVolumeClaim":
         return PersistentVolumeClaim(metadata=self.metadata.clone(),
-                                     spec=copy.deepcopy(self.spec))
+                                     spec=copy.deepcopy(self.spec),
+                                     status=copy.deepcopy(self.status))
 
     @classmethod
     def from_dict(cls, d: dict[str, Any]) -> "PersistentVolumeClaim":
         return cls(metadata=ObjectMeta.from_dict(d.get("metadata") or {}),
-                   spec=copy.deepcopy(d.get("spec") or {}))
+                   spec=copy.deepcopy(d.get("spec") or {}),
+                   status=copy.deepcopy(d.get("status") or {}))
 
     def to_dict(self) -> dict[str, Any]:
-        return {"apiVersion": "v1", "kind": "PersistentVolumeClaim",
-                "metadata": self.metadata.to_dict(),
-                "spec": copy.deepcopy(self.spec)}
+        out = {"apiVersion": "v1", "kind": "PersistentVolumeClaim",
+               "metadata": self.metadata.to_dict(),
+               "spec": copy.deepcopy(self.spec)}
+        if self.status:
+            out["status"] = copy.deepcopy(self.status)
+        return out
 
 
 @dataclass
@@ -674,7 +708,8 @@ class _SpecStatusObject:
                    status=copy.deepcopy(d.get("status") or {}))
 
     def to_dict(self) -> dict[str, Any]:
-        out = {"apiVersion": "v1", "kind": self.kind,
+        out = {"apiVersion": getattr(self, "api_version", "v1"),
+               "kind": self.kind,
                "metadata": self.metadata.to_dict(),
                "spec": copy.deepcopy(self.spec)}
         if self.status:
@@ -949,6 +984,199 @@ class Job(_Workload):
     def parallelism(self) -> int:
         p = self.spec.get("parallelism")
         return 1 if p is None else int(p)
+
+
+@dataclass
+class _DataObject:
+    """Shared shape of the data-map kinds (Secret/ConfigMap): metadata + a
+    string-keyed payload map (reference staging/src/k8s.io/api/core/v1/
+    types.go Secret/ConfigMap)."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    data: dict[str, str] = field(default_factory=dict)
+
+    @property
+    def key(self) -> str:
+        return f"{self.metadata.namespace}/{self.metadata.name}"
+
+    def clone(self):
+        return type(self)(metadata=self.metadata.clone(),
+                          data=dict(self.data))
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]):
+        return cls(metadata=ObjectMeta.from_dict(d.get("metadata") or {}),
+                   data=dict(d.get("data") or {}))
+
+    def to_dict(self) -> dict[str, Any]:
+        out = {"apiVersion": "v1", "kind": self.kind,
+               "metadata": self.metadata.to_dict()}
+        if self.data:
+            out["data"] = dict(self.data)
+        return out
+
+
+@dataclass
+class Secret(_DataObject):
+    """v1 Secret (service-account tokens, pull secrets; consumed by the
+    kubelet secret manager, pkg/kubelet/secret)."""
+
+    kind = "Secret"
+    type: str = "Opaque"
+
+    def clone(self):
+        out = super().clone()
+        out.type = self.type
+        return out
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]):
+        out = super().from_dict(d)
+        out.type = d.get("type", "Opaque")
+        return out
+
+    def to_dict(self) -> dict[str, Any]:
+        out = super().to_dict()
+        out["type"] = self.type
+        return out
+
+
+@dataclass
+class ConfigMap(_DataObject):
+    """v1 ConfigMap (pkg/kubelet/configmap consumer; also the dynamic
+    kubelet-config carrier, SURVEY.md §5.6(e))."""
+
+    kind = "ConfigMap"
+
+
+@dataclass
+class ServiceAccount:
+    """v1 ServiceAccount: identity for pods; the serviceaccounts controller
+    guarantees one named "default" per namespace and a token Secret for each
+    account (pkg/controller/serviceaccount/serviceaccounts_controller.go,
+    tokens_controller.go)."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    secrets: list[dict[str, Any]] = field(default_factory=list)
+
+    kind = "ServiceAccount"
+
+    @property
+    def key(self) -> str:
+        return f"{self.metadata.namespace}/{self.metadata.name}"
+
+    def clone(self) -> "ServiceAccount":
+        return ServiceAccount(metadata=self.metadata.clone(),
+                              secrets=copy.deepcopy(self.secrets))
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]) -> "ServiceAccount":
+        return cls(metadata=ObjectMeta.from_dict(d.get("metadata") or {}),
+                   secrets=copy.deepcopy(d.get("secrets") or []))
+
+    def to_dict(self) -> dict[str, Any]:
+        out = {"apiVersion": "v1", "kind": "ServiceAccount",
+               "metadata": self.metadata.to_dict()}
+        if self.secrets:
+            out["secrets"] = copy.deepcopy(self.secrets)
+        return out
+
+
+@dataclass
+class DaemonSet(_Workload):
+    """extensions/v1beta1 DaemonSet: one pod per eligible node, placed by
+    the daemon controller directly (bypasses the scheduler — it calls
+    GeneralPredicates itself, pkg/controller/daemon/daemon_controller.go:1327)."""
+
+    kind = "DaemonSet"
+    api_version = "extensions/v1beta1"
+
+    @property
+    def selector(self) -> dict[str, Any]:
+        sel = self.spec.get("selector")
+        if sel:
+            return dict(sel)
+        labels = ((self.spec.get("template") or {}).get("metadata") or {}
+                  ).get("labels") or {}
+        return {"matchLabels": dict(labels)} if labels else {}
+
+
+@dataclass
+class CronJob(_SpecStatusObject):
+    """batch/v2alpha1 CronJob (pkg/controller/cronjob/cronjob_controller.go):
+    spec.schedule is 5-field cron; spawns Job objects at fire times under
+    spec.concurrencyPolicy Allow|Forbid|Replace."""
+
+    kind = "CronJob"
+    api_version = "batch/v2alpha1"
+
+    @property
+    def schedule(self) -> str:
+        return self.spec.get("schedule", "")
+
+    @property
+    def concurrency_policy(self) -> str:
+        return self.spec.get("concurrencyPolicy", "Allow")
+
+    @property
+    def suspend(self) -> bool:
+        return bool(self.spec.get("suspend", False))
+
+
+@dataclass
+class HorizontalPodAutoscaler(_SpecStatusObject):
+    """autoscaling/v1 HPA (pkg/controller/podautoscaler/horizontal.go):
+    scales scaleTargetRef between minReplicas and maxReplicas to hold
+    targetCPUUtilizationPercentage."""
+
+    kind = "HorizontalPodAutoscaler"
+    api_version = "autoscaling/v1"
+
+    @property
+    def target_ref(self) -> dict[str, str]:
+        return dict(self.spec.get("scaleTargetRef") or {})
+
+    @property
+    def min_replicas(self) -> int:
+        return int(self.spec.get("minReplicas") or 1)
+
+    @property
+    def max_replicas(self) -> int:
+        return int(self.spec.get("maxReplicas") or 1)
+
+    @property
+    def target_utilization(self) -> int:
+        # reference default 80% (horizontal.go defaultTargetCPUUtilizationPercentage)
+        return int(self.spec.get("targetCPUUtilizationPercentage") or 80)
+
+
+@dataclass
+class PodDisruptionBudget(_SpecStatusObject):
+    """policy/v1beta1 PDB (pkg/controller/disruption/disruption.go): the
+    disruption controller computes currentHealthy/desiredHealthy/
+    disruptionsAllowed; eviction honors disruptionsAllowed."""
+
+    kind = "PodDisruptionBudget"
+    api_version = "policy/v1beta1"
+
+    @property
+    def selector(self) -> dict[str, Any]:
+        return dict(self.spec.get("selector") or {})
+
+
+@dataclass
+class APIService(_SpecStatusObject):
+    """apiregistration APIService (kube-aggregator,
+    staging/src/k8s.io/kube-aggregator/pkg/apis/apiregistration): routes an
+    API group/version to a delegate server; spec.service/spec.serverAddress
+    names the backend, local (no backend) groups are served by the core."""
+
+    kind = "APIService"
+    api_version = "apiregistration.k8s.io/v1beta1"
+
+    @property
+    def group_version(self) -> tuple[str, str]:
+        return (self.spec.get("group", ""), self.spec.get("version", ""))
 
 
 @dataclass

@@ -50,6 +50,7 @@ from kubernetes_tpu.apiserver.store import (
     Expired,
     NotFound,
     ObjectStore,
+    TooManyRequests,
     WatchEvent,
 )
 
@@ -75,13 +76,24 @@ RESOURCES: dict[str, str] = {
     "namespaces": "Namespace",
     "customresourcedefinitions": "CustomResourceDefinition",
     "clusters": "Cluster",
+    "secrets": "Secret",
+    "configmaps": "ConfigMap",
+    "serviceaccounts": "ServiceAccount",
+    "daemonsets": "DaemonSet",
+    "cronjobs": "CronJob",
+    "horizontalpodautoscalers": "HorizontalPodAutoscaler",
+    "poddisruptionbudgets": "PodDisruptionBudget",
+    "apiservices": "APIService",
 }
 KIND_TO_CLS = {cls.kind: cls for cls in (
     objs.Pod, objs.Node, objs.Service, objs.Endpoints, objs.Event,
     objs.PersistentVolume, objs.PersistentVolumeClaim,
     objs.ReplicationController, objs.ReplicaSet, objs.StatefulSet,
     objs.Deployment, objs.Job, objs.LimitRange, objs.ResourceQuota,
-    objs.Namespace, objs.CustomResourceDefinition, objs.Cluster)}
+    objs.Namespace, objs.CustomResourceDefinition, objs.Cluster,
+    objs.Secret, objs.ConfigMap, objs.ServiceAccount, objs.DaemonSet,
+    objs.CronJob, objs.HorizontalPodAutoscaler, objs.PodDisruptionBudget,
+    objs.APIService)}
 PLURAL_OF = {kind: plural for plural, kind in RESOURCES.items()}
 
 
@@ -258,6 +270,22 @@ class APIServer:
                 self.store.bind(Binding(pod_name=name,
                                         namespace=ns or "default",
                                         target_node=target))
+                return 201, {"kind": "Status", "status": "Success"}
+            if sub == "eviction" and method == "POST" and kind == "Pod":
+                # pods/eviction subresource (pkg/registry/core/pod/storage/
+                # eviction.go): delete gated by PodDisruptionBudgets; a
+                # denied eviction is 429 TooManyRequests, the kubectl-drain
+                # retry signal
+                from kubernetes_tpu.controllers.disruption import can_evict
+
+                pod = self.store.get("Pod", name, ns or "default")
+                if not can_evict(self.store, pod):
+                    return 429, {"kind": "Status",
+                                 "reason": "TooManyRequests",
+                                 "message": "Cannot evict pod as it would "
+                                            "violate the pod's disruption "
+                                            "budget."}
+                self.store.delete("Pod", name, ns or "default")
                 return 201, {"kind": "Status", "status": "Success"}
             if sub is not None:
                 return 404, {"message": f"unknown subresource {sub!r}"}
@@ -494,6 +522,8 @@ class RemoteStore:
             raise Conflict(decoded.get("message", ""))
         if status == 410:
             raise Expired(decoded.get("message", ""))
+        if status == 429:
+            raise TooManyRequests(decoded.get("message", ""))
         if status >= 400:
             raise ValueError(f"HTTP {status}: {decoded.get('message')}")
         return decoded
@@ -574,6 +604,18 @@ class RemoteStore:
             + "/binding",
             {"target": {"kind": "Node", "name": binding.target_node},
              "metadata": {"name": binding.pod_name}})
+
+    def evict(self, name: str, namespace: str = "default") -> bool:
+        """pods/eviction subresource. False = the pod's disruption budget
+        refused (HTTP 429) — retry later, like kubectl drain."""
+        try:
+            self._request(
+                "POST", self._path("Pod", namespace, name) + "/eviction",
+                {"apiVersion": "policy/v1beta1", "kind": "Eviction",
+                 "metadata": {"name": name, "namespace": namespace}})
+        except TooManyRequests:
+            return False
+        return True
 
     def watch(self, kind: str | None = None,
               since: int | None = None) -> RemoteWatchStream:

@@ -43,6 +43,10 @@ class Expired(ValueError):
     """Watch resume point fell out of the ring buffer (HTTP 410 Gone)."""
 
 
+class TooManyRequests(ValueError):
+    """HTTP 429 — an eviction refused by a disruption budget."""
+
+
 @dataclass
 class WatchEvent:
     type: str          # ADDED | MODIFIED | DELETED

@@ -231,6 +231,64 @@ def cmd_bind(client, args) -> int:
     return 0
 
 
+def _set_unschedulable(client, node: str, value: bool) -> None:
+    def mutate(obj):
+        obj.spec.unschedulable = value
+        return obj
+
+    client.guaranteed_update("Node", node, "default", mutate)
+
+
+def cmd_cordon(client, args) -> int:
+    _set_unschedulable(client, args.name, True)
+    print(f"node/{args.name} cordoned")
+    return 0
+
+
+def cmd_uncordon(client, args) -> int:
+    _set_unschedulable(client, args.name, False)
+    print(f"node/{args.name} uncordoned")
+    return 0
+
+
+def cmd_drain(client, args) -> int:
+    """Cordon, then evict every pod on the node through the eviction
+    subresource — PodDisruptionBudgets gate each eviction (429 retries),
+    DaemonSet pods are skipped because their controller would immediately
+    re-place them (pkg/kubectl/cmd/drain.go semantics)."""
+    import time as _time
+
+    _set_unschedulable(client, args.name, True)
+    deadline = _time.monotonic() + args.timeout
+    pending = None
+    while pending is None or pending:
+        pending = []
+        for pod in client.list("Pod"):
+            if pod.spec.node_name != args.name:
+                continue
+            owner = next((r for r in pod.metadata.owner_references
+                          if r.get("controller")), {})
+            if owner.get("kind") == "DaemonSet":
+                continue
+            try:
+                evicted = client.evict(pod.metadata.name,
+                                       pod.metadata.namespace)
+            except NotFound:
+                continue  # went away on its own mid-drain: success
+            if evicted:
+                print(f"pod/{pod.metadata.name} evicted")
+            else:
+                pending.append(pod.metadata.name)
+        if pending:
+            if _time.monotonic() > deadline:
+                print(f"error: pods not evictable within budget: "
+                      f"{', '.join(sorted(pending))}", file=sys.stderr)
+                return 1
+            _time.sleep(0.5)
+    print(f"node/{args.name} drained")
+    return 0
+
+
 def build_parser() -> argparse.ArgumentParser:
     import os
 
@@ -278,6 +336,14 @@ def build_parser() -> argparse.ArgumentParser:
     b.add_argument("node")
     b.add_argument("-n", "--namespace", default="default")
     b.set_defaults(fn=cmd_bind)
+    for verb, fn in (("cordon", cmd_cordon), ("uncordon", cmd_uncordon)):
+        c = sub.add_parser(verb)
+        c.add_argument("name")
+        c.set_defaults(fn=fn)
+    dr = sub.add_parser("drain")
+    dr.add_argument("name")
+    dr.add_argument("--timeout", type=float, default=30.0)
+    dr.set_defaults(fn=cmd_drain)
     return p
 
 

@@ -1,0 +1,152 @@
+"""CronJob controller.
+
+Analog of pkg/controller/cronjob/cronjob_controller.go: a 10s `syncAll`
+sweep (not informer-driven — the reference polls deliberately, :96) that,
+for every CronJob, computes unmet fire times since the last schedule
+(utils.go getRecentUnmetScheduleTimes), applies the concurrency policy
+(Allow | Forbid: skip while a spawned Job is still active | Replace: delete
+the active Jobs first), creates one Job per latest unmet time with the
+conventional scheduled-time-derived name (so a concurrently-running second
+controller can't double-spawn: the create collides), and records
+status.lastScheduleTime.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from typing import Callable
+
+from kubernetes_tpu.api.objects import Job
+from kubernetes_tpu.apiserver.store import (
+    AlreadyExists,
+    Conflict,
+    NotFound,
+    ObjectStore,
+)
+from kubernetes_tpu.client.informer import Informer
+from kubernetes_tpu.controllers.replicaset import make_controller_ref
+from kubernetes_tpu.utils.cron import CronError, CronSchedule
+
+log = logging.getLogger(__name__)
+
+
+class CronJobController:
+    name = "cronjob-controller"
+
+    def __init__(self, store: ObjectStore, cronjob_informer: Informer,
+                 job_informer: Informer, sync_period: float = 10.0,
+                 now: Callable[[], float] = time.time):
+        self.store = store
+        self.cronjobs = cronjob_informer
+        self.jobs = job_informer
+        self.sync_period = sync_period
+        self.now = now
+        self._task: asyncio.Task | None = None
+
+    async def start(self) -> None:
+        self._task = asyncio.get_running_loop().create_task(self._loop())
+
+    def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            self._task = None
+
+    async def _loop(self) -> None:
+        while True:
+            await asyncio.sleep(self.sync_period)
+            try:
+                self.sync_all()
+            except Exception:  # noqa: BLE001 — the sweep must not die
+                log.exception("cronjob sync failed")
+
+    def sync_all(self) -> None:
+        for cj in self.cronjobs.items():
+            try:
+                self.sync_one(cj)
+            except Exception:  # noqa: BLE001 — per-object isolation
+                log.exception("cronjob %s sync failed", cj.key)
+
+    def _owned_jobs(self, cj) -> list[Job]:
+        out = []
+        for job in self.jobs.items():
+            if job.metadata.namespace != cj.metadata.namespace:
+                continue
+            if any(r.get("uid") == cj.metadata.uid
+                   for r in job.metadata.owner_references):
+                out.append(job)
+        return out
+
+    @staticmethod
+    def _job_active(job) -> bool:
+        return not any(c.get("type") == "Complete"
+                       and c.get("status") == "True"
+                       for c in job.status.get("conditions", []))
+
+    def sync_one(self, cj) -> None:
+        if cj.suspend:
+            return
+        try:
+            schedule = CronSchedule(cj.schedule)
+        except CronError as e:
+            log.warning("cronjob %s: bad schedule: %s", cj.key, e)
+            return
+        now = self.now()
+        last = cj.status.get("lastScheduleTime")
+        # never look further back than creation; fresh objects fire from now
+        start = max(float(last) if last else cj.metadata.creation_timestamp
+                    or now, now - 24 * 3600)
+        unmet = schedule.fire_times(start, now, limit=100)
+        if not unmet:
+            return
+        fire = unmet[-1]  # only the most recent unmet time (syncOne :244)
+        owned = self._owned_jobs(cj)
+        active = [j for j in owned if self._job_active(j)]
+        policy = cj.concurrency_policy
+        if policy == "Forbid" and active:
+            # leave lastScheduleTime alone: the slot stays unmet and fires
+            # once the active Job completes (the reference returns without
+            # touching status, cronjob_controller.go syncOne :253)
+            return
+        if policy == "Replace":
+            for job in active:
+                try:
+                    self.store.delete("Job", job.metadata.name,
+                                      job.metadata.namespace)
+                except NotFound:
+                    pass
+        self._spawn(cj, fire)
+        self._record_schedule(cj, fire)
+
+    def _spawn(self, cj, fire: float) -> None:
+        import copy
+
+        template = copy.deepcopy(cj.spec.get("jobTemplate") or {})
+        spec = template.get("spec") or {}
+        meta = template.get("metadata") or {}
+        # deterministic name from the fire minute (getJobFromTemplate :58):
+        # a second controller replica creating the same slot collides
+        meta["name"] = f"{cj.metadata.name}-{int(fire) // 60}"
+        meta["namespace"] = cj.metadata.namespace
+        meta.setdefault("labels", dict(
+            ((cj.spec.get("jobTemplate") or {}).get("metadata") or {}
+             ).get("labels") or {}))
+        meta.setdefault("ownerReferences", []).append(
+            make_controller_ref(cj))
+        job = Job.from_dict({"metadata": meta, "spec": spec})
+        try:
+            self.store.create(job)
+        except AlreadyExists:
+            pass
+
+    def _record_schedule(self, cj, fire: float) -> None:
+        def mutate(obj):
+            obj.status["lastScheduleTime"] = fire
+            return obj
+
+        try:
+            self.store.guaranteed_update("CronJob", cj.metadata.name,
+                                         cj.metadata.namespace, mutate)
+        except (NotFound, Conflict):
+            pass

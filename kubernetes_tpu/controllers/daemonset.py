@@ -1,0 +1,285 @@
+"""DaemonSet controller: one pod per eligible node, scheduler bypassed.
+
+Analog of pkg/controller/daemon/daemon_controller.go: the daemon controller
+places its own pods — it evaluates fit directly (nodeShouldRunDaemonPod
+:1327 calls predicates.GeneralPredicates) and creates pods with
+spec.nodeName already set, so they never enter the scheduler queue. Fit
+here = node Ready (or pod tolerates being there), nodeSelector + required
+node-affinity match, NoSchedule/NoExecute taints tolerated, and the pod's
+resource requests fit in allocatable minus the node's active pods.
+"""
+
+from __future__ import annotations
+
+from kubernetes_tpu.api.objects import Node, Pod, parse_node_affinity
+from kubernetes_tpu.api.quantity import parse_quantity
+from kubernetes_tpu.apiserver.store import NotFound, ObjectStore
+from kubernetes_tpu.client.informer import Informer
+from kubernetes_tpu.controllers.base import ReconcileController, slow_start_batch
+from kubernetes_tpu.controllers.replicaset import (
+    controller_ref,
+    is_active,
+    make_controller_ref,
+)
+from kubernetes_tpu.state.cluster_state import match_requirement
+
+
+def _node_ready(node: Node) -> bool:
+    return any(c.type == "Ready" and c.status == "True"
+               for c in node.status.conditions)
+
+
+def _affinity_matches(pod: Pod, node: Node) -> bool:
+    labels = node.metadata.labels
+    for k, v in pod.spec.node_selector.items():
+        if labels.get(k) != v:
+            return False
+    req_terms, _ = parse_node_affinity(pod.spec.affinity)
+    if req_terms is None:
+        return True
+    for term in req_terms:
+        if all(match_requirement(labels, e.get("key", ""),
+                                 e.get("operator", "In"),
+                                 tuple(e.get("values") or ()))
+               for e in term):
+            return True
+    return False
+
+
+def _tolerates_taints(pod: Pod, node: Node) -> bool:
+    for taint in node.spec.taints:
+        if taint.effect not in ("NoSchedule", "NoExecute"):
+            continue
+        if not any(t.tolerates(taint) for t in pod.spec.tolerations):
+            return False
+    return True
+
+
+def _pod_requests(pod: Pod) -> tuple:
+    cpu = mem = 0
+    for c in pod.spec.containers:
+        if "cpu" in c.requests:
+            cpu += parse_quantity(c.requests["cpu"])
+        if "memory" in c.requests:
+            mem += parse_quantity(c.requests["memory"])
+    return cpu, mem
+
+
+def _fits_resources(pod: Pod, node: Node, node_pods: list[Pod]) -> bool:
+    alloc = node.status.effective_allocatable()
+    free_cpu = parse_quantity(alloc.get("cpu", "0"))
+    free_mem = parse_quantity(alloc.get("memory", "0"))
+    for other in node_pods:
+        cpu, mem = _pod_requests(other)
+        free_cpu -= cpu
+        free_mem -= mem
+    cpu, mem = _pod_requests(pod)
+    return cpu <= free_cpu and mem <= free_mem
+
+
+def _node_fingerprint(node: Node) -> tuple:
+    """The fields node_should_run reads — heartbeats that change only
+    condition timestamps hash equal and are ignored."""
+    return (
+        _node_ready(node),
+        tuple(sorted(node.metadata.labels.items())),
+        tuple(sorted((t.key, t.value, t.effect)
+                     for t in node.spec.taints)),
+        tuple(sorted(node.status.effective_allocatable().items())),
+    )
+
+
+def node_should_run(pod: Pod, node: Node, node_pods: list[Pod]) -> bool:
+    """nodeShouldRunDaemonPod (daemon_controller.go:1327): the host-side
+    GeneralPredicates subset that matters without the scheduler."""
+    if not _node_ready(node):
+        return False
+    if not _affinity_matches(pod, node):
+        return False
+    if not _tolerates_taints(pod, node):
+        return False
+    return _fits_resources(pod, node, node_pods)
+
+
+def _daemon_pod_name(ds_name: str, node_name: str) -> str:
+    """Deterministic per-(ds, node) pod name within the 63-char limit.
+    Over-long names keep a unique suffix hash instead of a bare prefix
+    truncation, which would collide distinct daemonsets on one node."""
+    name = f"{ds_name}-{node_name}"
+    if len(name) <= 63:
+        return name
+    import hashlib
+
+    digest = hashlib.sha1(name.encode()).hexdigest()[:10]
+    return f"{name[:52].rstrip('-.')}-{digest}"
+
+
+class DaemonSetController(ReconcileController):
+    workers = 2
+
+    def __init__(self, store: ObjectStore, ds_informer: Informer,
+                 pod_informer: Informer, node_informer: Informer):
+        super().__init__()
+        self.name = "daemonset-controller"
+        self.store = store
+        self.daemonsets = ds_informer
+        self.pods = pod_informer
+        self.nodes = node_informer
+        self._node_fp: dict[str, tuple] = {}
+        ds_informer.add_handler(self._on_ds)
+        pod_informer.add_handler(self._on_pod)
+        node_informer.add_handler(self._on_node)
+
+    def _on_ds(self, event) -> None:
+        if event.type == "DELETED":
+            self.expectations.forget(event.obj.key)
+        self.enqueue(event.obj.key)
+
+    def _on_pod(self, event) -> None:
+        ref = controller_ref(event.obj)
+        if ref is None or ref.get("kind") != "DaemonSet":
+            return
+        key = f"{event.obj.metadata.namespace}/{ref.get('name')}"
+        if event.type == "ADDED":
+            self.expectations.creation_observed(key)
+        elif event.type == "DELETED":
+            self.expectations.deletion_observed(key)
+        self.enqueue(key)
+
+    def _on_node(self, event) -> None:
+        # Node events fan out to every daemonset — but heartbeat-only
+        # MODIFIED events (the overwhelming majority at kubemark scale:
+        # every hollow node PATCHes conditions on a timer) are dropped by
+        # fingerprinting the fit-relevant fields. The reference reacts only
+        # to relevant node changes too (daemon_controller.go updateNode).
+        node = event.obj
+        name = node.metadata.name
+        if event.type == "DELETED":
+            self._node_fp.pop(name, None)
+        else:
+            fp = _node_fingerprint(node)
+            if event.type == "MODIFIED" and self._node_fp.get(name) == fp:
+                return
+            self._node_fp[name] = fp
+        for ds in self.daemonsets.items():
+            self.enqueue(ds.key)
+
+    def _template_pod(self, ds) -> Pod:
+        import copy
+
+        d = copy.deepcopy(ds.spec.get("template") or {})
+        d.setdefault("metadata", {})
+        return Pod.from_dict(d)
+
+    def _owned_by_node(self, ds) -> dict[str, list[Pod]]:
+        out: dict[str, list[Pod]] = {}
+        for pod in self.pods.items():
+            if pod.metadata.namespace != ds.metadata.namespace:
+                continue
+            ref = controller_ref(pod)
+            if ref is None or ref.get("uid") != ds.metadata.uid:
+                continue
+            out.setdefault(pod.spec.node_name or "", []).append(pod)
+        return out
+
+    async def sync(self, key: str) -> None:
+        ns, name = key.split("/", 1)
+        ds = self.daemonsets.get(name, ns)
+        if ds is None:
+            self.expectations.forget(key)
+            return
+        if not self.expectations.satisfied(key):
+            return
+        probe = self._template_pod(ds)
+        by_node = self._owned_by_node(ds)
+        pods_per_node: dict[str, list[Pod]] = {}
+        for pod in self.pods.items():
+            if pod.spec.node_name and is_active(pod):
+                pods_per_node.setdefault(pod.spec.node_name, []).append(pod)
+
+        to_create: list[str] = []
+        to_delete: list[Pod] = []
+        seen_nodes = set()
+        for node in self.nodes.items():
+            seen_nodes.add(node.metadata.name)
+            mine = [p for p in by_node.get(node.metadata.name, ())
+                    if is_active(p)]
+            others = [p for p in pods_per_node.get(node.metadata.name, ())
+                      if not any(p is m for m in mine)]
+            should = node_should_run(probe, node, others)
+            if should and not mine:
+                to_create.append(node.metadata.name)
+            elif not should and mine:
+                to_delete.extend(mine)
+            elif len(mine) > 1:
+                # duplicates: keep the oldest (manage :1030)
+                mine.sort(key=lambda p: p.metadata.creation_timestamp)
+                to_delete.extend(mine[1:])
+        # pods on nodes that no longer exist
+        for node_name, pods in by_node.items():
+            if node_name and node_name not in seen_nodes:
+                to_delete.extend(p for p in pods if is_active(p))
+
+        if to_delete:
+            self.expectations.expect(key, dels=len(to_delete))
+            for pod in to_delete:
+                try:
+                    self.store.delete("Pod", pod.metadata.name, ns)
+                except NotFound:
+                    self.expectations.deletion_observed(key)
+        if to_create:
+            self.expectations.expect(key, adds=len(to_create))
+            queue = list(to_create)
+
+            async def create_one() -> bool:
+                node_name = queue.pop()
+                pod = self._template_pod(ds)
+                pod.metadata.name = _daemon_pod_name(ds.metadata.name,
+                                                     node_name)
+                pod.metadata.namespace = ns
+                pod.metadata.owner_references = [make_controller_ref(ds)]
+                if not pod.metadata.labels:
+                    pod.metadata.labels = dict(
+                        (ds.selector.get("matchLabels")) or {})
+                pod.spec.node_name = node_name  # the scheduler bypass
+                try:
+                    self.store.create(pod)
+                    return True
+                except Exception:  # noqa: BLE001
+                    self.expectations.creation_observed(key)
+                    return False
+
+            _ok, attempted = await slow_start_batch(len(to_create), create_one)
+            for _ in range(len(to_create) - attempted):
+                self.expectations.creation_observed(key)
+
+        self._update_status(ds, by_node, seen_nodes, probe, pods_per_node)
+
+    def _update_status(self, ds, by_node, seen_nodes, probe,
+                       pods_per_node) -> None:
+        desired = current = ready = 0
+        for node in self.nodes.items():
+            others = [p for p in pods_per_node.get(node.metadata.name, ())
+                      if controller_ref(p) is None
+                      or (controller_ref(p) or {}).get("uid")
+                      != ds.metadata.uid]
+            if node_should_run(probe, node, others):
+                desired += 1
+            mine = [p for p in by_node.get(node.metadata.name, ())
+                    if is_active(p)]
+            if mine:
+                current += 1
+                if any(p.status.phase == "Running" for p in mine):
+                    ready += 1
+        status = {"desiredNumberScheduled": desired,
+                  "currentNumberScheduled": current,
+                  "numberReady": ready}
+        fresh = self.daemonsets.get(ds.metadata.name, ds.metadata.namespace)
+        if fresh is None or fresh.status == status:
+            return
+        fresh = fresh.clone()
+        fresh.status = status
+        try:
+            self.store.update(fresh, check_version=False)
+        except Exception:  # noqa: BLE001 — status write is best-effort
+            pass

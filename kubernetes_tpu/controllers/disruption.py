@@ -1,0 +1,144 @@
+"""Disruption controller: PodDisruptionBudget status + eviction gate.
+
+Analog of pkg/controller/disruption/disruption.go: for each PDB, count the
+selector's pods (expectedCount), the healthy (Ready) ones among them, derive
+desiredHealthy from spec.minAvailable (integer or "N%"), and publish
+disruptionsAllowed = currentHealthy - desiredHealthy. `can_evict` is the
+check the eviction subresource applies (pkg/registry/core/pod/storage/
+eviction.go:103 checkAndDecrement): an eviction may proceed only while
+disruptionsAllowed > 0, and decrements it synchronously so concurrent
+evictions can't both spend the same budget.
+"""
+
+from __future__ import annotations
+
+import math
+
+from kubernetes_tpu.apiserver.store import Conflict, NotFound, ObjectStore
+from kubernetes_tpu.client.informer import Informer
+from kubernetes_tpu.controllers.base import ReconcileController
+from kubernetes_tpu.controllers.replicaset import is_active, pod_ready
+from kubernetes_tpu.state.podaffinity import (
+    PARSE_ERROR,
+    canonical_selector,
+    selector_matches,
+)
+
+
+def _min_available(pdb, expected: int) -> int:
+    """spec.minAvailable: integer or percentage string (intstr semantics,
+    GetValueFromIntOrPercent with round-up for minAvailable)."""
+    v = pdb.spec.get("minAvailable", 0)
+    if isinstance(v, str) and v.endswith("%"):
+        return math.ceil(expected * int(v[:-1]) / 100.0)
+    return int(v)
+
+
+class DisruptionController(ReconcileController):
+    workers = 1
+
+    def __init__(self, store: ObjectStore, pdb_informer: Informer,
+                 pod_informer: Informer):
+        super().__init__()
+        self.name = "disruption-controller"
+        self.store = store
+        self.pdbs = pdb_informer
+        self.pods = pod_informer
+        pdb_informer.add_handler(self._on_pdb)
+        pod_informer.add_handler(self._on_pod)
+
+    def _on_pdb(self, event) -> None:
+        if event.type != "DELETED":
+            self.enqueue(event.obj.key)
+
+    def _on_pod(self, event) -> None:
+        # any pod change may affect the PDBs selecting it (getPdbForPod)
+        pod = event.obj
+        for pdb in self.pdbs.items():
+            if pdb.metadata.namespace != pod.metadata.namespace:
+                continue
+            canon = canonical_selector(pdb.selector or None)
+            if canon not in ((), PARSE_ERROR) \
+                    and selector_matches(canon, pod.metadata.labels):
+                self.enqueue(pdb.key)
+
+    def _matching(self, pdb) -> list:
+        canon = canonical_selector(pdb.selector or None)
+        if canon in ((), PARSE_ERROR):
+            return []
+        return [p for p in self.pods.items()
+                if p.metadata.namespace == pdb.metadata.namespace
+                and is_active(p)
+                and selector_matches(canon, p.metadata.labels)]
+
+    async def sync(self, key: str) -> None:
+        ns, name = key.split("/", 1)
+        pdb = self.pdbs.get(name, ns)
+        if pdb is None:
+            return
+        pods = self._matching(pdb)
+        expected = len(pods)
+        healthy = sum(1 for p in pods if pod_ready(p))
+        desired = _min_available(pdb, expected)
+        allowed = max(0, healthy - desired)
+        status = {"expectedPods": expected, "currentHealthy": healthy,
+                  "desiredHealthy": desired, "disruptionsAllowed": allowed}
+        if pdb.status == status:
+            return
+
+        def mutate(obj):
+            obj.status = status
+            return obj
+
+        try:
+            self.store.guaranteed_update("PodDisruptionBudget", name, ns,
+                                         mutate)
+        except (NotFound, Conflict):
+            pass
+
+
+def can_evict(store: ObjectStore, pod) -> bool:
+    """Eviction-subresource budget check: spend one disruption from every
+    PDB covering the pod, or refuse without spending anything. Check-all-
+    then-spend-all: the whole call runs without yielding (single-loop
+    store), so two callers can't both observe the same budget — the analog
+    of the reference's retried live decrement (eviction.go:156
+    checkAndDecrement)."""
+    ns = pod.metadata.namespace
+    covering = []
+    for pdb in store.list("PodDisruptionBudget", namespace=ns,
+                          copy_objects=False):
+        canon = canonical_selector(pdb.selector or None)
+        if canon in ((), PARSE_ERROR) \
+                or not selector_matches(canon, pod.metadata.labels):
+            continue
+        if int(pdb.status.get("disruptionsAllowed", 0)) <= 0:
+            return False
+        covering.append(pdb.metadata.name)
+
+    def spend(obj):
+        remaining = int(obj.status.get("disruptionsAllowed", 0))
+        if remaining <= 0:
+            raise Conflict("budget exhausted")
+        obj.status["disruptionsAllowed"] = remaining - 1
+        return obj
+
+    def refund(obj):
+        obj.status["disruptionsAllowed"] = \
+            int(obj.status.get("disruptionsAllowed", 0)) + 1
+        return obj
+
+    spent: list[str] = []
+    for name in covering:
+        try:
+            store.guaranteed_update("PodDisruptionBudget", name, ns, spend)
+            spent.append(name)
+        except (NotFound, Conflict):
+            for prior in spent:  # no partial spend survives a refusal
+                try:
+                    store.guaranteed_update("PodDisruptionBudget", prior,
+                                            ns, refund)
+                except (NotFound, Conflict):
+                    pass
+            return False
+    return True

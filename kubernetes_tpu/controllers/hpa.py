@@ -1,0 +1,187 @@
+"""Horizontal pod autoscaler.
+
+Analog of pkg/controller/podautoscaler/horizontal.go: every sync period,
+for each HPA read the scale target's current replicas, get per-pod CPU
+utilization from a metrics source (the reference queries heapster through
+metrics_client.go; here the source is injectable — tests provide one, the
+hollow agent reports fake usage), and set
+
+    desired = ceil(current * avgUtilization / targetUtilization)
+
+clamped to [minReplicas, maxReplicas], skipping changes inside the 10%
+tolerance band (horizontal.go:251 tolerance = 0.1). Scaling writes
+spec.replicas through the workload kinds' scale shape (the reference's
+/scale subresource).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import math
+import time
+from typing import Callable, Protocol
+
+from kubernetes_tpu.apiserver.store import Conflict, NotFound, ObjectStore
+from kubernetes_tpu.client.informer import Informer
+from kubernetes_tpu.controllers.replicaset import workload_selector_canon
+from kubernetes_tpu.state.podaffinity import PARSE_ERROR, selector_matches
+
+log = logging.getLogger(__name__)
+
+TOLERANCE = 0.1  # horizontal.go tolerance
+SCALABLE_KINDS = ("ReplicationController", "ReplicaSet", "Deployment",
+                  "StatefulSet")
+
+
+class MetricsSource(Protocol):
+    def utilization(self, namespace: str, pod_names: list[str]
+                    ) -> dict[str, float]:
+        """pod name → CPU utilization fraction of request (1.0 = 100%)."""
+
+
+class StaticMetrics:
+    """Test/hollow metrics source: explicit per-pod utilization, with an
+    optional default for unknown pods. default=None reports nothing for
+    unknown pods — the controller then skips reconciliation rather than
+    scaling on absent data (the reference aborts the sync when the metrics
+    query fails, horizontal.go:293)."""
+
+    def __init__(self, default: float | None = None):
+        self.default = default
+        self.per_pod: dict[str, float] = {}
+
+    def set(self, pod_name: str, utilization: float) -> None:
+        self.per_pod[pod_name] = utilization
+
+    def utilization(self, namespace: str, pod_names: list[str]
+                    ) -> dict[str, float]:
+        if self.default is None:
+            return {n: self.per_pod[n] for n in pod_names
+                    if n in self.per_pod}
+        return {n: self.per_pod.get(n, self.default) for n in pod_names}
+
+
+class HorizontalController:
+    name = "horizontalpodautoscaler-controller"
+
+    def __init__(self, store: ObjectStore, hpa_informer: Informer,
+                 pod_informer: Informer, metrics: MetricsSource,
+                 sync_period: float = 30.0,
+                 now: Callable[[], float] = time.time):
+        self.store = store
+        self.hpas = hpa_informer
+        self.pods = pod_informer
+        self.metrics = metrics
+        self.sync_period = sync_period
+        self.now = now
+        self._task: asyncio.Task | None = None
+
+    async def start(self) -> None:
+        self._task = asyncio.get_running_loop().create_task(self._loop())
+
+    def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            self._task = None
+
+    async def _loop(self) -> None:
+        while True:
+            await asyncio.sleep(self.sync_period)
+            try:
+                self.sync_all()
+            except Exception:  # noqa: BLE001 — the loop must not die
+                log.exception("hpa sync failed")
+
+    def sync_all(self) -> None:
+        for hpa in self.hpas.items():
+            try:
+                self.reconcile(hpa)
+            except Exception:  # noqa: BLE001 — per-HPA isolation
+                log.exception("hpa %s reconcile failed", hpa.key)
+
+    def _target(self, hpa):
+        ref = hpa.target_ref
+        kind = ref.get("kind", "")
+        if kind not in SCALABLE_KINDS:
+            return None
+        try:
+            return self.store.get(kind, ref.get("name", ""),
+                                  hpa.metadata.namespace)
+        except NotFound:
+            return None
+
+    def _target_pods(self, hpa, target) -> list:
+        canon = workload_selector_canon(target)
+        if canon in ((), PARSE_ERROR):
+            return []
+        return [p for p in self.pods.items()
+                if p.metadata.namespace == hpa.metadata.namespace
+                and p.status.phase == "Running"
+                and selector_matches(canon, p.metadata.labels)]
+
+    def reconcile(self, hpa) -> None:
+        target = self._target(hpa)
+        if target is None:
+            return
+        current = target.replicas
+        if current == 0:
+            # reference: autoscaling is disabled at 0 (horizontal.go:273) —
+            # an operator-zeroed workload must NOT be scaled back up, so the
+            # min/max clamp never applies here
+            self._write_status(hpa, current, current, None)
+            return
+        pods = self._target_pods(hpa, target)
+        if not pods:
+            # rollout in flight (pods Pending) — no data, no action; the
+            # reference aborts the sync when metrics are unavailable
+            return
+        usage = self.metrics.utilization(
+            hpa.metadata.namespace, [p.metadata.name for p in pods])
+        if len(usage) < len(pods):
+            # partial coverage must not drive fleet-wide scaling (one hot
+            # sample would double the workload); the reference aborts the
+            # sync when metrics are incomplete
+            return
+        desired = current
+        avg = sum(usage.values()) / len(usage)
+        avg_pct = 100.0 * avg
+        ratio = avg_pct / hpa.target_utilization
+        if abs(ratio - 1.0) > TOLERANCE:
+            desired = math.ceil(current * ratio)
+        desired = max(hpa.min_replicas, min(hpa.max_replicas, desired))
+        if desired != current:
+            def scale(obj):
+                obj.spec["replicas"] = desired
+                return obj
+
+            try:
+                self.store.guaranteed_update(
+                    target.kind, target.metadata.name,
+                    hpa.metadata.namespace, scale)
+            except (NotFound, Conflict):
+                return
+        self._write_status(hpa, current, desired, avg_pct)
+
+    def _write_status(self, hpa, current: int, desired: int,
+                      avg_pct: float | None) -> None:
+        status = {"currentReplicas": current, "desiredReplicas": desired}
+        if avg_pct is not None:
+            status["currentCPUUtilizationPercentage"] = int(round(avg_pct))
+        if desired != current:
+            status["lastScaleTime"] = self.now()
+        elif "lastScaleTime" in hpa.status:
+            status["lastScaleTime"] = hpa.status["lastScaleTime"]
+        if {k: v for k, v in hpa.status.items()} == status:
+            return
+
+        def mutate(obj):
+            obj.status = status
+            return obj
+
+        try:
+            self.store.guaranteed_update(
+                "HorizontalPodAutoscaler", hpa.metadata.name,
+                hpa.metadata.namespace, mutate)
+        except (NotFound, Conflict):
+            pass

@@ -22,13 +22,17 @@ class ControllerManager:
     def __init__(self, store: ObjectStore, enable_gc: bool = True,
                  enable_node_lifecycle: bool = True,
                  node_lifecycle_kwargs: dict | None = None,
-                 cloud=None):
+                 cloud=None, hpa_metrics=None):
         self.store = store
         self.informers: dict[str, Informer] = {
             kind: Informer(store, kind)
             for kind in ("Pod", "Node", "Service", "ReplicaSet",
                          "ReplicationController", "StatefulSet",
-                         "Deployment", "Job", "Namespace")}
+                         "Deployment", "Job", "Namespace",
+                         "ServiceAccount", "ResourceQuota", "CronJob",
+                         "HorizontalPodAutoscaler", "PodDisruptionBudget",
+                         "DaemonSet", "PersistentVolume",
+                         "PersistentVolumeClaim")}
         pods = self.informers["Pod"]
         self.replicaset = ReplicaManager(
             store, "ReplicaSet", self.informers["ReplicaSet"], pods)
@@ -48,12 +52,57 @@ class ControllerManager:
         self.namespace = NamespaceController(store,
                                              self.informers["Namespace"])
         self.podgc = PodGCController(store, pods)
+        from kubernetes_tpu.controllers.cronjob import CronJobController
+        from kubernetes_tpu.controllers.daemonset import DaemonSetController
+        from kubernetes_tpu.controllers.disruption import DisruptionController
+        from kubernetes_tpu.controllers.hpa import (
+            HorizontalController,
+            StaticMetrics,
+        )
+        from kubernetes_tpu.controllers.quota import ResourceQuotaController
+        from kubernetes_tpu.controllers.serviceaccount import (
+            ServiceAccountController,
+        )
+        from kubernetes_tpu.controllers.ttl import TTLController
+
+        self.serviceaccount = ServiceAccountController(
+            store, self.informers["Namespace"],
+            self.informers["ServiceAccount"])
+        self.resourcequota = ResourceQuotaController(
+            store, self.informers["ResourceQuota"], pods)
+        self.ttl = TTLController(store, self.informers["Node"])
+        self.disruption = DisruptionController(
+            store, self.informers["PodDisruptionBudget"], pods)
+        self.hpa = HorizontalController(
+            store, self.informers["HorizontalPodAutoscaler"], pods,
+            hpa_metrics if hpa_metrics is not None else StaticMetrics())
+        self.cronjob = CronJobController(
+            store, self.informers["CronJob"], self.informers["Job"])
+        self.daemonset = DaemonSetController(
+            store, self.informers["DaemonSet"], pods,
+            self.informers["Node"])
+        from kubernetes_tpu.controllers.volume import (
+            AttachDetachController,
+            PersistentVolumeBinder,
+        )
+
+        self.pv_binder = PersistentVolumeBinder(
+            store, self.informers["PersistentVolumeClaim"],
+            self.informers["PersistentVolume"])
+        self.attach_detach = AttachDetachController(
+            store, self.informers["Node"], pods,
+            self.informers["PersistentVolumeClaim"])
         self.controllers = [self.replicaset, self.replication,
                             self.deployment, self.statefulset, self.job,
-                            self.endpoints, self.namespace, self.podgc]
+                            self.endpoints, self.namespace, self.podgc,
+                            self.serviceaccount, self.resourcequota,
+                            self.ttl, self.disruption, self.hpa,
+                            self.cronjob, self.daemonset, self.pv_binder,
+                            self.attach_detach]
         if enable_gc:
             self.gc = GarbageCollector(
-                store, pods,
+                store,
+                {"Pod": pods, "Job": self.informers["Job"]},
                 {k: v for k, v in self.informers.items()
                  if k not in ("Pod", "Node", "Service")})
             self.controllers.append(self.gc)
@@ -92,6 +141,17 @@ class ControllerManager:
             self.job.enqueue(obj.key)
         for obj in self.informers["Service"].items():
             self.endpoints.enqueue(obj.key)
+        for obj in self.informers["Namespace"].items():
+            self.serviceaccount.enqueue(obj.metadata.name)
+        for obj in self.informers["PodDisruptionBudget"].items():
+            self.disruption.enqueue(obj.key)
+        for obj in self.informers["DaemonSet"].items():
+            self.daemonset.enqueue(obj.key)
+        for obj in self.informers["Node"].items():
+            self.ttl.enqueue(obj.metadata.name)
+            self.attach_detach.enqueue(obj.metadata.name)
+        for obj in self.informers["PersistentVolumeClaim"].items():
+            self.pv_binder.enqueue(obj.key)
 
     def stop(self) -> None:
         for controller in self.controllers:

@@ -19,6 +19,8 @@ NAMESPACED_KINDS = (
     "Pod", "Service", "Endpoints", "Event", "ReplicaSet",
     "ReplicationController", "StatefulSet", "Deployment", "Job",
     "PersistentVolumeClaim", "LimitRange", "ResourceQuota",
+    "Secret", "ConfigMap", "ServiceAccount", "DaemonSet", "CronJob",
+    "HorizontalPodAutoscaler", "PodDisruptionBudget",
 )
 
 

@@ -1,0 +1,92 @@
+"""ResourceQuota controller: asynchronous usage recalculation.
+
+Analog of pkg/controller/resourcequota/resource_quota_controller.go: the
+admission plugin (apiserver/admission.py ResourceQuotaPlugin) charges usage
+eagerly on CREATE, but only this controller *replenishes* — when pods are
+deleted or reach a terminal phase, it recomputes the namespace's true usage
+and rewrites quota status (replenishment_controller.go registers exactly
+those deletion/terminal triggers). A periodic full resync bounds drift.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+
+from kubernetes_tpu.apiserver.admission import ResourceQuotaPlugin
+from kubernetes_tpu.apiserver.store import Conflict, NotFound, ObjectStore
+from kubernetes_tpu.client.informer import Informer
+from kubernetes_tpu.controllers.base import ReconcileController
+
+log = logging.getLogger(__name__)
+
+
+class ResourceQuotaController(ReconcileController):
+    workers = 1
+
+    def __init__(self, store: ObjectStore, quota_informer: Informer,
+                 pod_informer: Informer, resync_period: float = 30.0):
+        super().__init__()
+        self.name = "resourcequota-controller"
+        self.store = store
+        self.quotas = quota_informer
+        self.resync_period = resync_period
+        self._usage = ResourceQuotaPlugin()
+        self._resync_task: asyncio.Task | None = None
+        quota_informer.add_handler(self._on_quota)
+        pod_informer.add_handler(self._on_pod)
+
+    def _on_quota(self, event) -> None:
+        if event.type != "DELETED":
+            self.enqueue(event.obj.key)
+
+    def _on_pod(self, event) -> None:
+        # replenishment triggers: pod deleted or turned terminal
+        terminal = event.obj.status.phase in ("Succeeded", "Failed")
+        if event.type == "DELETED" or terminal:
+            ns = event.obj.metadata.namespace
+            for quota in self.quotas.items():
+                if quota.metadata.namespace == ns:
+                    self.enqueue(quota.key)
+
+    async def start(self) -> None:
+        await super().start()
+        self._resync_task = asyncio.get_running_loop().create_task(
+            self._resync_loop())
+        for quota in self.quotas.items():
+            self.enqueue(quota.key)
+
+    def stop(self) -> None:
+        if self._resync_task is not None:
+            self._resync_task.cancel()
+            self._resync_task = None
+        super().stop()
+
+    async def _resync_loop(self) -> None:
+        while True:
+            await asyncio.sleep(self.resync_period)
+            for quota in self.quotas.items():
+                self.enqueue(quota.key)
+
+    async def sync(self, key: str) -> None:
+        ns, name = key.split("/", 1)
+        quota = self.quotas.get(name, ns)
+        if quota is None:
+            return
+        used = self._usage._namespace_usage(self.store, ns)
+        hard = quota.spec.get("hard") or {}
+        status = {"hard": dict(hard),
+                  "used": {res: str(used.get(res, 0))
+                           for res in ResourceQuotaPlugin.TRACKED
+                           if res in hard}}
+        if quota.status == status:
+            return
+
+        def mutate(obj):
+            obj.status = status
+            return obj
+
+        try:
+            self.store.guaranteed_update("ResourceQuota", name, ns, mutate)
+        except (NotFound, Conflict):
+            pass

@@ -1,0 +1,108 @@
+"""ServiceAccount + token controllers.
+
+Analog of pkg/controller/serviceaccount: ServiceAccountsController
+(serviceaccounts_controller.go:112) guarantees every Active namespace holds
+the accounts in its managed set (just "default"), recreating them on
+deletion; TokensController (tokens_controller.go:106) guarantees every
+ServiceAccount owns at least one token Secret and that the account's
+`secrets` list references it.
+"""
+
+from __future__ import annotations
+
+import secrets as _secrets
+
+from kubernetes_tpu.api.objects import Secret, ServiceAccount
+from kubernetes_tpu.apiserver.store import (
+    AlreadyExists,
+    Conflict,
+    NotFound,
+    ObjectStore,
+)
+from kubernetes_tpu.client.informer import Informer
+from kubernetes_tpu.controllers.base import ReconcileController
+
+MANAGED_ACCOUNTS = ("default",)
+TOKEN_TYPE = "kubernetes.io/service-account-token"
+
+
+class ServiceAccountController(ReconcileController):
+    """Keyed by namespace name; sync ensures the managed accounts exist
+    and each account has a token Secret."""
+
+    workers = 1
+
+    def __init__(self, store: ObjectStore, ns_informer: Informer,
+                 sa_informer: Informer):
+        super().__init__()
+        self.name = "serviceaccount-controller"
+        self.store = store
+        self.namespaces = ns_informer
+        self.accounts = sa_informer
+        ns_informer.add_handler(self._on_namespace)
+        sa_informer.add_handler(self._on_account)
+
+    def _on_namespace(self, event) -> None:
+        if event.type != "DELETED":
+            self.enqueue(event.obj.metadata.name)
+
+    def _on_account(self, event) -> None:
+        # account deleted (or token list mutated) → re-ensure its namespace
+        self.enqueue(event.obj.metadata.namespace)
+
+    async def sync(self, key: str) -> None:
+        ns = self.namespaces.get(key)
+        if ns is None or ns.phase == "Terminating":
+            return
+        for name in MANAGED_ACCOUNTS:
+            sa = self.accounts.get(name, key)
+            if sa is None:
+                try:
+                    sa = self.store.create(ServiceAccount.from_dict(
+                        {"metadata": {"name": name, "namespace": key}}))
+                except AlreadyExists:
+                    sa = self.store.get("ServiceAccount", name, key)
+            self._ensure_token(sa)
+
+    def _ensure_token(self, sa: ServiceAccount) -> None:
+        """TokensController.syncServiceAccount: a token Secret bound to the
+        account via the conventional annotations, referenced in sa.secrets."""
+        ns = sa.metadata.namespace
+        live = []
+        for ref in sa.secrets:
+            try:
+                sec = self.store.get("Secret", ref.get("name", ""), ns)
+            except NotFound:
+                continue
+            if sec.type == TOKEN_TYPE:
+                live.append(ref)
+        if live:
+            if live != sa.secrets:
+                self._set_secrets(sa, live)
+            return
+        token = Secret.from_dict({
+            "metadata": {
+                "name": f"{sa.metadata.name}-token-{_secrets.token_hex(4)}",
+                "namespace": ns,
+                "annotations": {
+                    "kubernetes.io/service-account.name": sa.metadata.name,
+                    "kubernetes.io/service-account.uid": sa.metadata.uid,
+                }},
+            "type": TOKEN_TYPE,
+            "data": {"token": _secrets.token_urlsafe(32)}})
+        try:
+            created = self.store.create(token)
+        except AlreadyExists:
+            return
+        self._set_secrets(sa, [{"name": created.metadata.name}])
+
+    def _set_secrets(self, sa: ServiceAccount, refs: list[dict]) -> None:
+        def mutate(obj):
+            obj.secrets = refs
+            return obj
+
+        try:
+            self.store.guaranteed_update("ServiceAccount", sa.metadata.name,
+                                         sa.metadata.namespace, mutate)
+        except (NotFound, Conflict):
+            pass

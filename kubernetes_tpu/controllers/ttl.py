@@ -1,0 +1,64 @@
+"""TTL controller: scale secret/configmap re-read pressure with cluster size.
+
+Analog of pkg/controller/ttl/ttlcontroller.go: annotate every Node with
+`node.alpha.kubernetes.io/ttl`, the seconds a kubelet may cache secrets/
+configmaps before re-reading. Bigger clusters get longer TTLs so apiserver
+read load stays flat (tiers at ttlcontroller.go:53-60); transitions are
+hysteretic — the controller only steps one tier at a time per node write.
+"""
+
+from __future__ import annotations
+
+from kubernetes_tpu.apiserver.store import Conflict, NotFound, ObjectStore
+from kubernetes_tpu.client.informer import Informer
+from kubernetes_tpu.controllers.base import ReconcileController
+
+TTL_ANNOTATION = "node.alpha.kubernetes.io/ttl"
+
+# (cluster size at/above which the tier applies, ttl seconds) — the
+# reference's ttlBoundaries ladder
+TIERS = ((0, 0), (100, 15), (500, 30), (1000, 60), (2000, 300))
+
+
+def desired_ttl(num_nodes: int) -> int:
+    ttl = 0
+    for threshold, seconds in TIERS:
+        if num_nodes >= threshold:
+            ttl = seconds
+    return ttl
+
+
+class TTLController(ReconcileController):
+    workers = 1
+
+    def __init__(self, store: ObjectStore, node_informer: Informer):
+        super().__init__()
+        self.name = "ttl-controller"
+        self.store = store
+        self.nodes = node_informer
+        node_informer.add_handler(self._on_node)
+
+    def _on_node(self, event) -> None:
+        if event.type == "ADDED" or event.type == "DELETED":
+            # cluster size changed: every node may need a new tier
+            for node in self.nodes.items():
+                self.enqueue(node.metadata.name)
+        elif event.type == "MODIFIED":
+            self.enqueue(event.obj.metadata.name)
+
+    async def sync(self, key: str) -> None:
+        node = self.nodes.get(key)
+        if node is None:
+            return
+        want = str(desired_ttl(len(self.nodes.items())))
+        if node.metadata.annotations.get(TTL_ANNOTATION) == want:
+            return
+
+        def mutate(obj):
+            obj.metadata.annotations[TTL_ANNOTATION] = want
+            return obj
+
+        try:
+            self.store.guaranteed_update("Node", key, "default", mutate)
+        except (NotFound, Conflict):
+            pass

@@ -1,0 +1,272 @@
+"""PersistentVolume binder + attach/detach controllers.
+
+PersistentVolumeBinder — analog of pkg/controller/volume/persistentvolume/
+pv_controller.go: pair pending PVCs with the smallest satisfying Available
+PV (findBestMatchForClaim semantics: capacity >= request, accessModes
+superset, label selector matches, storageClassName equal), write the
+bidirectional bind (pv.spec.claimRef <-> pvc.spec.volumeName) and the
+Bound phases; on claim deletion apply persistentVolumeReclaimPolicy
+(Retain -> Released, Recycle -> scrub back to Available, Delete -> remove
+the PV object).
+
+AttachDetachController — analog of pkg/controller/volume/attachdetach/
+attach_detach_controller.go: the desired world is every scheduled,
+non-terminal pod's PV-backed volumes on its node; the actual world is
+node.status.volumesAttached. Reconcile by updating the node status through
+the store (the kubelet volumemanager then mounts what is attached).
+"""
+
+from __future__ import annotations
+
+from kubernetes_tpu.api.quantity import parse_quantity
+from kubernetes_tpu.apiserver.store import Conflict, NotFound, ObjectStore
+from kubernetes_tpu.client.informer import Informer
+from kubernetes_tpu.controllers.base import ReconcileController
+from kubernetes_tpu.controllers.replicaset import is_active
+from kubernetes_tpu.state.podaffinity import (
+    PARSE_ERROR,
+    canonical_selector,
+    selector_matches,
+)
+
+ACCESS_MODES = ("ReadWriteOnce", "ReadOnlyMany", "ReadWriteMany")
+
+
+def _capacity(obj_spec: dict):
+    cap = ((obj_spec.get("capacity") or {}).get("storage")
+           or ((obj_spec.get("resources") or {}).get("requests")
+               or {}).get("storage") or "0")
+    return parse_quantity(str(cap))
+
+
+def _modes(spec: dict) -> frozenset[str]:
+    return frozenset(spec.get("accessModes") or ())
+
+
+def pv_matches_claim(pv, pvc) -> bool:
+    """findBestMatchForClaim's per-volume predicate (index.go
+    findMatchingVolume semantics at this vintage)."""
+    if pv.spec.get("claimRef"):
+        return False
+    if _capacity(pv.spec) < _capacity(pvc.spec):
+        return False
+    if not _modes(pvc.spec) <= _modes(pv.spec):
+        return False
+    # storageClassName must agree (annotation-era: volume.beta... class)
+    if (pv.spec.get("storageClassName") or "") != \
+            (pvc.spec.get("storageClassName") or ""):
+        return False
+    sel = pvc.spec.get("selector")
+    if sel:
+        canon = canonical_selector(sel)
+        if canon == PARSE_ERROR or not selector_matches(
+                canon, pv.metadata.labels):
+            return False
+    return True
+
+
+class PersistentVolumeBinder(ReconcileController):
+    workers = 1
+
+    def __init__(self, store: ObjectStore, pvc_informer: Informer,
+                 pv_informer: Informer):
+        super().__init__()
+        self.name = "persistentvolume-binder"
+        self.store = store
+        self.claims = pvc_informer
+        self.volumes = pv_informer
+        pvc_informer.add_handler(self._on_claim)
+        pv_informer.add_handler(self._on_volume)
+
+    def _on_claim(self, event) -> None:
+        if event.type == "DELETED":
+            self._release(event.obj)
+            return
+        self.enqueue(event.obj.key)
+
+    def _on_volume(self, event) -> None:
+        if event.type == "DELETED":
+            return
+        # a new/updated volume may satisfy a pending claim
+        for pvc in self.claims.items():
+            if not pvc.volume_name:
+                self.enqueue(pvc.key)
+
+    async def sync(self, key: str) -> None:
+        ns, name = key.split("/", 1)
+        pvc = self.claims.get(name, ns)
+        if pvc is None or pvc.volume_name:
+            return
+        # smallest satisfying Available volume wins
+        candidates = [pv for pv in self.volumes.items()
+                      if pv_matches_claim(pv, pvc)]
+        if not candidates:
+            self._set_phase_pvc(pvc, "Pending")
+            return
+        best = min(candidates, key=lambda pv: (_capacity(pv.spec),
+                                               pv.metadata.name))
+        claim_ref = {"kind": "PersistentVolumeClaim", "namespace": ns,
+                     "name": name, "uid": pvc.metadata.uid}
+
+        def bind_pv(obj):
+            if obj.spec.get("claimRef"):
+                raise Conflict(f"{obj.metadata.name} already claimed")
+            obj.spec["claimRef"] = claim_ref
+            obj.status["phase"] = "Bound"
+            return obj
+
+        try:
+            self.store.guaranteed_update("PersistentVolume",
+                                         best.metadata.name, "default",
+                                         bind_pv)
+        except (NotFound, Conflict):
+            self.enqueue_after(key, 0.05)  # raced another claim: retry
+            return
+
+        def bind_pvc(obj):
+            obj.spec["volumeName"] = best.metadata.name
+            obj.status["phase"] = "Bound"
+            return obj
+
+        try:
+            self.store.guaranteed_update("PersistentVolumeClaim", name, ns,
+                                         bind_pvc)
+        except (NotFound, Conflict):
+            # claim vanished mid-bind: roll the volume back
+            self._scrub(best.metadata.name)
+
+    def _set_phase_pvc(self, pvc, phase: str) -> None:
+        if pvc.phase == phase:
+            return
+
+        def mutate(obj):
+            obj.status["phase"] = phase
+            return obj
+
+        try:
+            self.store.guaranteed_update(
+                "PersistentVolumeClaim", pvc.metadata.name,
+                pvc.metadata.namespace, mutate)
+        except (NotFound, Conflict):
+            pass
+
+    def _scrub(self, pv_name: str) -> None:
+        def mutate(obj):
+            obj.spec.pop("claimRef", None)
+            obj.status["phase"] = "Available"
+            return obj
+
+        try:
+            self.store.guaranteed_update("PersistentVolume", pv_name,
+                                         "default", mutate)
+        except (NotFound, Conflict):
+            pass
+
+    def _release(self, pvc) -> None:
+        """Claim deleted: apply the bound volume's reclaim policy
+        (pv_controller.go reclaimVolume)."""
+        if not pvc.volume_name:
+            return
+        try:
+            pv = self.store.get("PersistentVolume", pvc.volume_name)
+        except NotFound:
+            return
+        ref = pv.spec.get("claimRef") or {}
+        if ref.get("uid") != pvc.metadata.uid:
+            return  # already rebound elsewhere
+        policy = pv.spec.get("persistentVolumeReclaimPolicy", "Retain")
+        if policy == "Delete":
+            try:
+                self.store.delete("PersistentVolume", pv.metadata.name)
+            except NotFound:
+                pass
+        elif policy == "Recycle":
+            self._scrub(pv.metadata.name)
+        else:  # Retain: released, needs admin action before reuse
+            def mutate(obj):
+                obj.status["phase"] = "Released"
+                return obj
+
+            try:
+                self.store.guaranteed_update("PersistentVolume",
+                                             pv.metadata.name, "default",
+                                             mutate)
+            except (NotFound, Conflict):
+                pass
+
+
+def _attached_name(pv_name: str) -> str:
+    return f"kubernetes.io/pv/{pv_name}"
+
+
+class AttachDetachController(ReconcileController):
+    """Keyed by node name; sync reconciles that node's volumesAttached
+    against the PV-backed volumes of its active pods."""
+
+    workers = 1
+
+    def __init__(self, store: ObjectStore, node_informer: Informer,
+                 pod_informer: Informer, pvc_informer: Informer):
+        super().__init__()
+        self.name = "attachdetach-controller"
+        self.store = store
+        self.nodes = node_informer
+        self.pods = pod_informer
+        self.claims = pvc_informer
+        node_informer.add_handler(self._on_node)
+        pod_informer.add_handler(self._on_pod)
+        pvc_informer.add_handler(self._on_claim)
+
+    def _on_node(self, event) -> None:
+        if event.type == "ADDED":
+            self.enqueue(event.obj.metadata.name)
+
+    def _on_pod(self, event) -> None:
+        node = event.obj.spec.node_name
+        if node:
+            self.enqueue(node)
+
+    def _on_claim(self, event) -> None:
+        # a claim binding late must attach for already-scheduled pods —
+        # re-sync the nodes of pods referencing it
+        name = event.obj.metadata.name
+        ns = event.obj.metadata.namespace
+        for pod in self.pods.items():
+            if not pod.spec.node_name or pod.metadata.namespace != ns:
+                continue
+            if any((v.get("persistentVolumeClaim") or {}).get("claimName")
+                   == name for v in pod.spec.volumes):
+                self.enqueue(pod.spec.node_name)
+
+    def _desired(self, node_name: str) -> list[str]:
+        out: set[str] = set()
+        for pod in self.pods.items():
+            if pod.spec.node_name != node_name or not is_active(pod):
+                continue
+            for vol in pod.spec.volumes:
+                claim = (vol.get("persistentVolumeClaim") or {}).get(
+                    "claimName")
+                if not claim:
+                    continue
+                pvc = self.claims.get(claim, pod.metadata.namespace)
+                if pvc is not None and pvc.volume_name:
+                    out.add(pvc.volume_name)
+        return sorted(out)
+
+    async def sync(self, key: str) -> None:
+        node = self.nodes.get(key)
+        if node is None:
+            return
+        want = [{"name": _attached_name(pv), "devicePath": f"/dev/disk/{pv}"}
+                for pv in self._desired(key)]
+        if node.status.volumes_attached == want:
+            return
+
+        def mutate(obj):
+            obj.status.volumes_attached = want
+            return obj
+
+        try:
+            self.store.guaranteed_update("Node", key, "default", mutate)
+        except (NotFound, Conflict):
+            pass

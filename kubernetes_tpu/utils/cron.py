@@ -1,0 +1,92 @@
+"""5-field cron schedule evaluation for the CronJob controller.
+
+The reference vendors robfig/cron (used by pkg/controller/cronjob/utils.go
+getRecentUnmetScheduleTimes). This is an independent minimal evaluator for
+the standard 5-field form (minute hour day-of-month month day-of-week)
+supporting '*', '*/n', 'a-b', 'a-b/n' and comma lists — the subset cluster
+operators actually write. Fire times are minute-aligned.
+"""
+
+from __future__ import annotations
+
+import time
+
+_FIELD_RANGES = ((0, 59), (0, 23), (1, 31), (1, 12), (0, 6))
+
+
+class CronError(ValueError):
+    pass
+
+
+def _parse_field(text: str, lo: int, hi: int) -> frozenset[int]:
+    out: set[int] = set()
+    for part in text.split(","):
+        step = 1
+        if "/" in part:
+            part, step_s = part.split("/", 1)
+            try:
+                step = int(step_s)
+            except ValueError:
+                raise CronError(f"bad step {step_s!r}") from None
+            if step <= 0:
+                raise CronError(f"bad step {step}")
+        if part == "*":
+            lo_p, hi_p = lo, hi
+        elif "-" in part:
+            try:
+                a, b = part.split("-", 1)
+                lo_p, hi_p = int(a), int(b)
+            except ValueError:
+                raise CronError(f"bad range {part!r}") from None
+        else:
+            try:
+                lo_p = hi_p = int(part)
+            except ValueError:
+                raise CronError(f"bad value {part!r}") from None
+        if not (lo <= lo_p <= hi and lo <= hi_p <= hi and lo_p <= hi_p):
+            raise CronError(f"{part!r} outside [{lo},{hi}]")
+        out.update(range(lo_p, hi_p + 1, step))
+    return frozenset(out)
+
+
+class CronSchedule:
+    def __init__(self, spec: str):
+        fields = spec.split()
+        if len(fields) != 5:
+            raise CronError(f"want 5 fields, got {len(fields)}: {spec!r}")
+        self.minute, self.hour, self.dom, self.month, self.dow = (
+            _parse_field(f, lo, hi)
+            for f, (lo, hi) in zip(fields, _FIELD_RANGES))
+        # standard cron: if BOTH dom and dow are restricted, either may match
+        self._dom_star = fields[2] == "*"
+        self._dow_star = fields[4] == "*"
+
+    def matches(self, epoch: float) -> bool:
+        t = time.localtime(epoch)
+        if t.tm_min not in self.minute or t.tm_hour not in self.hour \
+                or t.tm_mon not in self.month:
+            return False
+        dom_ok = t.tm_mday in self.dom
+        dow_ok = (t.tm_wday + 1) % 7 in self.dow  # cron: 0=Sunday
+        if self._dom_star or self._dow_star:
+            return dom_ok and dow_ok
+        return dom_ok or dow_ok
+
+    def fire_times(self, start: float, end: float,
+                   limit: int = 1000) -> list[float]:
+        """Minute-aligned fire times in (start, end]. Bounded by `limit`
+        (the reference errors past 100 unmet times, utils.go:94 — a
+        too-long-dead cronjob must not replay unbounded)."""
+        out: list[float] = []
+        t = (int(start) // 60 + 1) * 60
+        scanned = 0
+        while t <= end:
+            if self.matches(t):
+                out.append(float(t))
+                if len(out) >= limit:
+                    break
+            t += 60
+            scanned += 1
+            if scanned > 366 * 24 * 60:  # one year of minutes: give up
+                break
+        return out

@@ -1,0 +1,605 @@
+"""Tier-3 controllers: ServiceAccount/tokens, ResourceQuota replenishment,
+TTL annotations, PodDisruptionBudget + eviction gate, HPA, CronJob,
+DaemonSet. Reference semantics: pkg/controller/{serviceaccount,
+resourcequota,ttl,disruption,podautoscaler,cronjob,daemon}."""
+
+import asyncio
+
+import pytest
+
+from kubernetes_tpu.api.objects import (
+    CronJob,
+    DaemonSet,
+    HorizontalPodAutoscaler,
+    Namespace,
+    Node,
+    Pod,
+    PodDisruptionBudget,
+    ReplicaSet,
+    ResourceQuota,
+)
+from kubernetes_tpu.apiserver import ObjectStore
+from kubernetes_tpu.controllers import ControllerManager
+from kubernetes_tpu.utils.cron import CronError, CronSchedule
+
+from tests.test_controllers import mark_ready, until
+
+
+def ready_node(name, cpu="4", mem="8Gi", labels=None):
+    return Node.from_dict({
+        "metadata": {"name": name, "labels": labels or {}},
+        "status": {"allocatable": {"cpu": cpu, "memory": mem},
+                   "conditions": [{"type": "Ready", "status": "True"}]}})
+
+
+async def start_mgr(store, **kw):
+    kw.setdefault("enable_node_lifecycle", False)
+    mgr = ControllerManager(store, **kw)
+    await mgr.start()
+    return mgr
+
+
+# ---- cron schedule parsing ----
+
+
+def test_cron_parse_and_match():
+    s = CronSchedule("*/15 3 * * *")
+    import time as _t
+
+    # 03:30 local on any day matches; 03:31 doesn't
+    base = _t.mktime((2026, 7, 15, 3, 30, 0, 0, 0, -1))
+    assert s.matches(base)
+    assert not s.matches(base + 60)
+    fires = s.fire_times(base - 3600, base)
+    assert [(_t.localtime(f).tm_hour, _t.localtime(f).tm_min)
+            for f in fires] == [(3, 0), (3, 15), (3, 30)]
+
+
+def test_cron_rejects_garbage():
+    for bad in ("* * * *", "61 * * * *", "*/0 * * * *", "a * * * *",
+                "5-1 * * * *"):
+        with pytest.raises(CronError):
+            CronSchedule(bad)
+
+
+def test_cron_dom_dow_disjunction():
+    # both restricted: standard cron fires when EITHER matches
+    s = CronSchedule("0 0 13 * 5")  # the 13th OR any Friday
+    import time as _t
+
+    fri = _t.mktime((2026, 7, 17, 0, 0, 0, 0, 0, -1))  # Fri July 17 2026
+    thirteenth = _t.mktime((2026, 7, 13, 0, 0, 0, 0, 0, -1))  # Monday
+    other = _t.mktime((2026, 7, 14, 0, 0, 0, 0, 0, -1))
+    assert s.matches(fri) and s.matches(thirteenth)
+    assert not s.matches(other)
+
+
+# ---- serviceaccount + tokens ----
+
+
+def test_default_serviceaccount_and_token_created():
+    async def run():
+        store = ObjectStore()
+        store.create(Namespace.from_dict(
+            {"metadata": {"name": "team-a", "namespace": "default"}}))
+        await start_mgr(store)
+        await until(lambda: any(
+            sa.metadata.name == "default" and sa.secrets
+            for sa in store.list("ServiceAccount", namespace="team-a")))
+        sa = store.get("ServiceAccount", "default", "team-a")
+        token = store.get("Secret", sa.secrets[0]["name"], "team-a")
+        assert token.type == "kubernetes.io/service-account-token"
+        assert token.data["token"]
+        assert token.metadata.annotations[
+            "kubernetes.io/service-account.name"] == "default"
+        # deleting the account recreates it (and a fresh token)
+        store.delete("ServiceAccount", "default", "team-a")
+        await until(lambda: any(
+            sa.metadata.name == "default" and sa.secrets
+            for sa in store.list("ServiceAccount", namespace="team-a")))
+
+    asyncio.run(run())
+
+
+# ---- resourcequota replenishment ----
+
+
+def test_quota_replenishes_on_pod_delete():
+    async def run():
+        store = ObjectStore()
+        from kubernetes_tpu.apiserver.admission import chain_for
+
+        store.admission = chain_for("ResourceQuota")
+        store.create(ResourceQuota.from_dict({
+            "metadata": {"name": "caps", "namespace": "default"},
+            "spec": {"hard": {"pods": "2"}}}))
+        await start_mgr(store)
+        p1 = store.create(Pod.from_dict(
+            {"metadata": {"name": "a"},
+             "spec": {"containers": [{"name": "c"}]}}))
+        store.create(Pod.from_dict(
+            {"metadata": {"name": "b"},
+             "spec": {"containers": [{"name": "c"}]}}))
+        from kubernetes_tpu.apiserver.admission import AdmissionError
+
+        with pytest.raises(AdmissionError):
+            store.create(Pod.from_dict(
+                {"metadata": {"name": "c"},
+                 "spec": {"containers": [{"name": "c"}]}}))
+        # deletion replenishes: the controller recomputes used to 1
+        store.delete("Pod", p1.metadata.name)
+        await until(lambda: store.get(
+            "ResourceQuota", "caps").status.get("used", {}).get("pods")
+            == "1")
+        store.create(Pod.from_dict(
+            {"metadata": {"name": "c"},
+             "spec": {"containers": [{"name": "c"}]}}))
+
+    asyncio.run(run())
+
+
+# ---- ttl controller ----
+
+
+def test_ttl_annotation_scales_with_cluster_size():
+    async def run():
+        store = ObjectStore()
+        for i in range(3):
+            store.create(ready_node(f"n{i}"))
+        await start_mgr(store)
+        from kubernetes_tpu.controllers.ttl import TTL_ANNOTATION
+
+        await until(lambda: all(
+            n.metadata.annotations.get(TTL_ANNOTATION) == "0"
+            for n in store.list("Node")))
+
+    asyncio.run(run())
+
+
+def test_ttl_tiers():
+    from kubernetes_tpu.controllers.ttl import desired_ttl
+
+    assert desired_ttl(5) == 0
+    assert desired_ttl(100) == 15
+    assert desired_ttl(750) == 30
+    assert desired_ttl(1500) == 60
+    assert desired_ttl(9000) == 300
+
+
+# ---- disruption / pdb ----
+
+
+def pdb_obj(name="budget", min_available=2, app="web"):
+    return PodDisruptionBudget.from_dict({
+        "metadata": {"name": name, "namespace": "default"},
+        "spec": {"minAvailable": min_available,
+                 "selector": {"matchLabels": {"app": app}}}})
+
+
+def test_pdb_status_and_eviction_gate():
+    async def run():
+        store = ObjectStore()
+        await start_mgr(store)
+        store.create(pdb_obj(min_available=2))
+        pods = [Pod.from_dict({
+            "metadata": {"name": f"w{i}", "labels": {"app": "web"}},
+            "spec": {"containers": [{"name": "c"}], "nodeName": "n0"}})
+            for i in range(3)]
+        for p in pods:
+            store.create(p)
+            mark_ready(store, p)
+        await until(lambda: store.get(
+            "PodDisruptionBudget", "budget").status.get(
+                "disruptionsAllowed") == 1)
+        status = store.get("PodDisruptionBudget", "budget").status
+        assert status["currentHealthy"] == 3
+        assert status["desiredHealthy"] == 2
+        # the eviction gate spends the budget exactly once
+        from kubernetes_tpu.controllers.disruption import can_evict
+
+        assert can_evict(store, pods[0])
+        assert not can_evict(store, pods[1])
+
+    asyncio.run(run())
+
+
+def test_pdb_percentage_min_available():
+    async def run():
+        store = ObjectStore()
+        await start_mgr(store)
+        store.create(pdb_obj(min_available="50%"))
+        for i in range(4):
+            p = store.create(Pod.from_dict({
+                "metadata": {"name": f"w{i}", "labels": {"app": "web"}},
+                "spec": {"containers": [{"name": "c"}],
+                         "nodeName": "n0"}}))
+            mark_ready(store, p)
+        await until(lambda: store.get(
+            "PodDisruptionBudget", "budget").status.get(
+                "disruptionsAllowed") == 2)
+
+    asyncio.run(run())
+
+
+# ---- hpa ----
+
+
+def rs_with_pods(store, replicas=2, app="api", cpu="1"):
+    rs = store.create(ReplicaSet.from_dict({
+        "metadata": {"name": app, "namespace": "default"},
+        "spec": {"replicas": replicas,
+                 "selector": {"matchLabels": {"app": app}},
+                 "template": {"metadata": {"labels": {"app": app}},
+                              "spec": {"containers": [
+                                  {"name": "c",
+                                   "resources": {"requests": {"cpu": cpu}}}
+                              ]}}}}))
+    return rs
+
+
+def test_hpa_scales_up_and_down():
+    async def run():
+        store = ObjectStore()
+        from kubernetes_tpu.controllers.hpa import StaticMetrics
+
+        metrics = StaticMetrics(default=0.9)  # 90% of request
+        mgr = await start_mgr(store, hpa_metrics=metrics)
+        rs_with_pods(store, replicas=2)
+        store.create(HorizontalPodAutoscaler.from_dict({
+            "metadata": {"name": "api-hpa", "namespace": "default"},
+            "spec": {"scaleTargetRef": {"kind": "ReplicaSet",
+                                        "name": "api"},
+                     "minReplicas": 1, "maxReplicas": 10,
+                     "targetCPUUtilizationPercentage": 60}}))
+        # replicaset controller creates the pods; mark them Running
+        await until(lambda: len(store.list("Pod")) == 2)
+        for p in store.list("Pod"):
+            mark_ready(store, p)
+        await until(lambda: sum(
+            1 for p in mgr.informers["Pod"].items()
+            if p.status.phase == "Running") == 2)
+        mgr.hpa.sync_all()
+        # ceil(2 * 90/60) = 3
+        assert store.get("ReplicaSet", "api").replicas == 3
+        hpa = store.get("HorizontalPodAutoscaler", "api-hpa")
+        assert hpa.status["desiredReplicas"] == 3
+        assert hpa.status["currentCPUUtilizationPercentage"] == 90
+        await until(lambda: len(store.list("Pod")) == 3)
+        for p in store.list("Pod"):
+            mark_ready(store, p)
+        await until(lambda: sum(
+            1 for p in mgr.informers["Pod"].items()
+            if p.status.phase == "Running") == 3)
+        # load drops: ceil(3 * 10/60) = 1
+        metrics.default = 0.1
+        mgr.hpa.sync_all()
+        assert store.get("ReplicaSet", "api").replicas == 1
+
+    asyncio.run(run())
+
+
+def test_hpa_tolerance_band_prevents_flapping():
+    async def run():
+        store = ObjectStore()
+        from kubernetes_tpu.controllers.hpa import StaticMetrics
+
+        metrics = StaticMetrics(default=0.63)  # ratio 1.05 — inside 10%
+        mgr = await start_mgr(store, hpa_metrics=metrics)
+        rs_with_pods(store, replicas=2)
+        store.create(HorizontalPodAutoscaler.from_dict({
+            "metadata": {"name": "api-hpa", "namespace": "default"},
+            "spec": {"scaleTargetRef": {"kind": "ReplicaSet",
+                                        "name": "api"},
+                     "minReplicas": 1, "maxReplicas": 10,
+                     "targetCPUUtilizationPercentage": 60}}))
+        await until(lambda: len(store.list("Pod")) == 2)
+        for p in store.list("Pod"):
+            mark_ready(store, p)
+        await until(lambda: sum(
+            1 for p in mgr.informers["Pod"].items()
+            if p.status.phase == "Running") == 2)
+        mgr.hpa.sync_all()
+        assert store.get("ReplicaSet", "api").replicas == 2
+
+    asyncio.run(run())
+
+
+# ---- cronjob ----
+
+
+def test_cronjob_spawns_and_forbids():
+    async def run():
+        store = ObjectStore()
+        mgr = await start_mgr(store)
+        cj = store.create(CronJob.from_dict({
+            "metadata": {"name": "tick", "namespace": "default"},
+            "spec": {"schedule": "* * * * *",
+                     "concurrencyPolicy": "Forbid",
+                     "jobTemplate": {
+                         "metadata": {"labels": {"cron": "tick"}},
+                         "spec": {"completions": 1,
+                                  "template": {
+                                      "metadata": {},
+                                      "spec": {"containers": [
+                                          {"name": "c"}]}}}}}}))
+        # drive time by hand: fire one minute after creation
+        await until(lambda: mgr.informers["CronJob"].get("tick") is not None)
+        now = cj.metadata.creation_timestamp
+        mgr.cronjob.now = lambda: now + 61
+        mgr.cronjob.sync_all()
+        jobs = store.list("Job", namespace="default")
+        assert len(jobs) == 1
+        assert jobs[0].metadata.owner_references[0]["kind"] == "CronJob"
+        assert jobs[0].metadata.labels == {"cron": "tick"}
+        # next minute, previous job still active + Forbid -> no new job
+        mgr.cronjob.now = lambda: now + 121
+        await until(lambda: mgr.informers["Job"].get(
+            jobs[0].metadata.name) is not None)
+        mgr.cronjob.sync_all()
+        assert len(store.list("Job", namespace="default")) == 1
+        # job completes -> the next slot fires
+        done = store.get("Job", jobs[0].metadata.name)
+        done.status["conditions"] = [{"type": "Complete", "status": "True"}]
+        store.update(done, check_version=False)
+        mgr.cronjob.now = lambda: now + 181
+        await until(lambda: any(
+            c.get("type") == "Complete"
+            for c in (mgr.informers["Job"].get(jobs[0].metadata.name)
+                      or jobs[0]).status.get("conditions", [])))
+        mgr.cronjob.sync_all()
+        assert len(store.list("Job", namespace="default")) == 2
+
+    asyncio.run(run())
+
+
+def test_cronjob_replace_policy():
+    async def run():
+        store = ObjectStore()
+        mgr = await start_mgr(store)
+        cj = store.create(CronJob.from_dict({
+            "metadata": {"name": "tick", "namespace": "default"},
+            "spec": {"schedule": "* * * * *",
+                     "concurrencyPolicy": "Replace",
+                     "jobTemplate": {"spec": {"template": {
+                         "metadata": {},
+                         "spec": {"containers": [{"name": "c"}]}}}}}}))
+        await until(lambda: mgr.informers["CronJob"].get("tick") is not None)
+        now = cj.metadata.creation_timestamp
+        mgr.cronjob.now = lambda: now + 61
+        mgr.cronjob.sync_all()
+        first = store.list("Job", namespace="default")
+        assert len(first) == 1
+        mgr.cronjob.now = lambda: now + 121
+        await until(lambda: mgr.informers["Job"].get(
+            first[0].metadata.name) is not None)
+        mgr.cronjob.sync_all()
+        jobs = store.list("Job", namespace="default")
+        assert len(jobs) == 1  # old one replaced
+        assert jobs[0].metadata.name != first[0].metadata.name
+
+    asyncio.run(run())
+
+
+# ---- daemonset ----
+
+
+def ds_obj(name="agent", node_selector=None):
+    spec = {"template": {"metadata": {"labels": {"ds": name}},
+                         "spec": {"containers": [{"name": "c"}]}}}
+    if node_selector:
+        spec["template"]["spec"]["nodeSelector"] = node_selector
+    return DaemonSet.from_dict({
+        "metadata": {"name": name, "namespace": "default"}, "spec": spec})
+
+
+def test_daemonset_covers_eligible_nodes():
+    async def run():
+        store = ObjectStore()
+        for i in range(3):
+            store.create(ready_node(f"n{i}"))
+        # one node not ready -> no daemon pod there
+        store.create(Node.from_dict({
+            "metadata": {"name": "dead"},
+            "status": {"allocatable": {"cpu": "4", "memory": "8Gi"},
+                       "conditions": [{"type": "Ready",
+                                       "status": "False"}]}}))
+        await start_mgr(store)
+        store.create(ds_obj())
+        await until(lambda: sorted(
+            p.spec.node_name for p in store.list("Pod")) ==
+            ["n0", "n1", "n2"])
+        # pods are pre-bound (scheduler bypassed) with an ownerRef
+        for p in store.list("Pod"):
+            assert p.spec.node_name
+            assert p.metadata.owner_references[0]["kind"] == "DaemonSet"
+        # a new eligible node gets covered
+        store.create(ready_node("n3"))
+        await until(lambda: sorted(
+            p.spec.node_name for p in store.list("Pod")) ==
+            ["n0", "n1", "n2", "n3"])
+        # status reflects coverage
+        await until(lambda: store.get("DaemonSet", "agent").status.get(
+            "desiredNumberScheduled") == 4)
+        # node removed -> its pod cleaned up
+        store.delete("Node", "n3")
+        await until(lambda: sorted(
+            p.spec.node_name for p in store.list("Pod")) ==
+            ["n0", "n1", "n2"])
+
+    asyncio.run(run())
+
+
+def test_daemonset_respects_node_selector_and_taints():
+    async def run():
+        store = ObjectStore()
+        store.create(ready_node("gpu0", labels={"accel": "tpu"}))
+        store.create(ready_node("cpu0"))
+        tainted = ready_node("gpu1", labels={"accel": "tpu"})
+        tainted.spec.taints = []
+        d = tainted.to_dict()
+        d["spec"] = {"taints": [{"key": "dedicated", "value": "infra",
+                                 "effect": "NoSchedule"}]}
+        store.create(Node.from_dict(d))
+        await start_mgr(store)
+        store.create(ds_obj(node_selector={"accel": "tpu"}))
+        await until(lambda: [p.spec.node_name
+                             for p in store.list("Pod")] == ["gpu0"])
+        # tolerating daemonset covers the tainted node too
+        ds = store.get("DaemonSet", "agent")
+        ds.spec["template"]["spec"]["tolerations"] = [
+            {"key": "dedicated", "operator": "Exists"}]
+        store.update(ds, check_version=False)
+        await until(lambda: sorted(p.spec.node_name
+                                   for p in store.list("Pod")) ==
+                    ["gpu0", "gpu1"])
+
+    asyncio.run(run())
+
+
+def test_daemonset_resource_fit():
+    async def run():
+        store = ObjectStore()
+        store.create(ready_node("big", cpu="4"))
+        store.create(ready_node("small", cpu="100m"))
+        # the small node is full: an existing pod holds its cpu
+        store.create(Pod.from_dict({
+            "metadata": {"name": "hog"},
+            "spec": {"nodeName": "small", "containers": [
+                {"name": "c",
+                 "resources": {"requests": {"cpu": "100m"}}}]}}))
+        await start_mgr(store)
+        ds = ds_obj()
+        ds.spec["template"]["spec"]["containers"][0]["resources"] = {
+            "requests": {"cpu": "500m"}}
+        store.create(ds)
+        await until(lambda: [p.spec.node_name for p in store.list("Pod")
+                             if p.metadata.name != "hog"] == ["big"])
+
+    asyncio.run(run())
+
+
+def test_hpa_leaves_zeroed_workload_alone():
+    """An operator-zeroed target stays at 0 — autoscaling is disabled at 0
+    and the min clamp must not resurrect it (horizontal.go:273)."""
+    async def run():
+        store = ObjectStore()
+        from kubernetes_tpu.controllers.hpa import StaticMetrics
+
+        mgr = await start_mgr(store, hpa_metrics=StaticMetrics(0.9))
+        rs_with_pods(store, replicas=0)
+        store.create(HorizontalPodAutoscaler.from_dict({
+            "metadata": {"name": "api-hpa", "namespace": "default"},
+            "spec": {"scaleTargetRef": {"kind": "ReplicaSet",
+                                        "name": "api"},
+                     "minReplicas": 1, "maxReplicas": 10}}))
+        await until(lambda: mgr.informers[
+            "HorizontalPodAutoscaler"].get("api-hpa") is not None)
+        mgr.hpa.sync_all()
+        assert store.get("ReplicaSet", "api").replicas == 0
+
+    asyncio.run(run())
+
+
+def test_hpa_skips_without_metrics():
+    """No metrics (rollout in flight / source down) -> no scaling action;
+    the reference aborts the sync rather than scaling on absent data."""
+    async def run():
+        store = ObjectStore()
+        mgr = await start_mgr(store)  # default StaticMetrics(): no data
+        rs_with_pods(store, replicas=4)
+        store.create(HorizontalPodAutoscaler.from_dict({
+            "metadata": {"name": "api-hpa", "namespace": "default"},
+            "spec": {"scaleTargetRef": {"kind": "ReplicaSet",
+                                        "name": "api"},
+                     "minReplicas": 1, "maxReplicas": 10}}))
+        await until(lambda: len(store.list("Pod")) == 4)
+        for p in store.list("Pod"):
+            mark_ready(store, p)
+        await until(lambda: sum(
+            1 for p in mgr.informers["Pod"].items()
+            if p.status.phase == "Running") == 4)
+        mgr.hpa.sync_all()
+        assert store.get("ReplicaSet", "api").replicas == 4
+
+    asyncio.run(run())
+
+
+def test_gc_cascades_cronjob_jobs():
+    """Deleting a CronJob collects its spawned Jobs (and transitively
+    their pods) through the ownerRef graph — the first non-Pod dependent
+    edge (garbagecollector.go cascade)."""
+    async def run():
+        store = ObjectStore()
+        mgr = await start_mgr(store)
+        cj = store.create(CronJob.from_dict({
+            "metadata": {"name": "tick", "namespace": "default"},
+            "spec": {"schedule": "* * * * *",
+                     "jobTemplate": {"spec": {"parallelism": 2,
+                                              "completions": 2,
+                                              "template": {
+                         "metadata": {"labels": {"cron": "tick"}},
+                         "spec": {"containers": [{"name": "c"}]}}}}}}))
+        await until(lambda: mgr.informers["CronJob"].get("tick") is not None)
+        mgr.cronjob.now = lambda: cj.metadata.creation_timestamp + 61
+        mgr.cronjob.sync_all()
+        assert len(store.list("Job", namespace="default")) == 1
+        # the job controller spins up worker pods
+        await until(lambda: len(store.list("Pod")) == 2)
+        store.delete("CronJob", "tick")
+        await until(lambda: not store.list("Job", namespace="default"),
+                    msg="job collected")
+        await until(lambda: not store.list("Pod"), msg="pods collected")
+
+    asyncio.run(run())
+
+
+def test_cronjob_forbid_slot_fires_after_completion():
+    """A Forbid-skipped slot is NOT spent: once the active Job completes,
+    the missed run fires (reference syncOne returns without recording)."""
+    async def run():
+        store = ObjectStore()
+        mgr = await start_mgr(store)
+        cj = store.create(CronJob.from_dict({
+            "metadata": {"name": "tick", "namespace": "default"},
+            "spec": {"schedule": "0 3 * * *",  # daily at 03:00
+                     "concurrencyPolicy": "Forbid",
+                     "jobTemplate": {"spec": {"template": {
+                         "metadata": {},
+                         "spec": {"containers": [{"name": "c"}]}}}}}}))
+        await until(lambda: mgr.informers["CronJob"].get("tick") is not None)
+        import time as _t
+
+        # pick the next 03:00 after creation, then pretend an older job is
+        # still active across it
+        created = cj.metadata.creation_timestamp
+        lt = _t.localtime(created)
+        fire = _t.mktime((lt.tm_year, lt.tm_mon, lt.tm_mday, 3, 0, 0,
+                          0, 0, -1))
+        while fire <= created:
+            fire += 24 * 3600
+        mgr.cronjob.now = lambda: fire + 60
+        mgr.cronjob.sync_all()
+        first = store.list("Job", namespace="default")
+        assert len(first) == 1
+        # an hour later: the job is STILL active, Forbid skips, slot unspent
+        mgr.cronjob.now = lambda: fire + 3600
+        await until(lambda: mgr.informers["Job"].get(
+            first[0].metadata.name) is not None)
+        mgr.cronjob.sync_all()
+        assert len(store.list("Job", namespace="default")) == 1
+        assert store.get("CronJob", "tick").status.get(
+            "lastScheduleTime") == fire
+        # job completes two hours later -> the same daily slot does not
+        # re-fire (already recorded), but the NEXT day's does
+        done = store.get("Job", first[0].metadata.name)
+        done.status["conditions"] = [{"type": "Complete", "status": "True"}]
+        store.update(done, check_version=False)
+        await until(lambda: any(
+            c.get("type") == "Complete"
+            for c in (mgr.informers["Job"].get(first[0].metadata.name)
+                      or first[0]).status.get("conditions", [])))
+        mgr.cronjob.now = lambda: fire + 24 * 3600 + 60
+        mgr.cronjob.sync_all()
+        assert len(store.list("Job", namespace="default")) == 2
+
+    asyncio.run(run())

@@ -165,3 +165,32 @@ def test_extender_backed_by_tcp_control_plane():
             sched.stop()
 
     asyncio.run(run())
+
+
+def test_eviction_subresource_honors_pdb():
+    """pods/eviction: PDB budget gates deletion with 429, spends once
+    (registry eviction.go checkAndDecrement semantics)."""
+    from kubernetes_tpu.api.objects import PodDisruptionBudget
+
+    store = ObjectStore()
+    pdb = PodDisruptionBudget.from_dict({
+        "metadata": {"name": "budget", "namespace": "default"},
+        "spec": {"minAvailable": 1,
+                 "selector": {"matchLabels": {"app": "web"}}}})
+    pdb.status = {"expectedPods": 2, "currentHealthy": 2,
+                  "desiredHealthy": 1, "disruptionsAllowed": 1}
+    store.create(pdb)
+    for name in ("w0", "w1"):
+        d = mk_pod_dict(name)
+        d["metadata"]["labels"] = {"app": "web"}
+        store.create(Pod.from_dict(d))
+    with http_store(store) as (client, _store):
+        assert client.evict("w0") is True
+        with pytest.raises(NotFound):
+            client.get("Pod", "w0")
+        # budget now exhausted: 429, pod remains
+        assert client.evict("w1") is False
+        assert client.get("Pod", "w1").metadata.name == "w1"
+        # a pod no PDB covers evicts freely
+        client.create(Pod.from_dict(mk_pod_dict("free")))
+        assert client.evict("free") is True

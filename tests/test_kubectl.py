@@ -90,3 +90,37 @@ def test_get_nodes_status_column():
         rc, out = run_cli(client, "get", "nodes")
         assert rc == 0
         assert "ready-node" in out and "Ready" in out
+
+
+def test_cordon_drain_uncordon():
+    """drain = cordon + evict through the budget-gated subresource,
+    skipping DaemonSet pods (pkg/kubectl/cmd/drain.go semantics)."""
+    from kubernetes_tpu.api.objects import Pod
+
+    with http_store() as (client, _store):
+        client.create(mk_node("n0"))
+        d = mk_pod_dict("app-pod")
+        client.create(Pod.from_dict(d))
+        ds_pod = mk_pod_dict("agent-pod")
+        ds_pod["metadata"]["ownerReferences"] = [
+            {"kind": "DaemonSet", "name": "agent", "uid": "u1",
+             "controller": True}]
+        client.create(Pod.from_dict(ds_pod))
+        from kubernetes_tpu.api.objects import Binding
+        client.bind(Binding(pod_name="app-pod", namespace="default",
+                            target_node="n0"))
+        client.bind(Binding(pod_name="agent-pod", namespace="default",
+                            target_node="n0"))
+
+        rc, out = run_cli(client, "cordon", "n0")
+        assert rc == 0
+        assert client.get("Node", "n0").spec.unschedulable is True
+
+        rc, out = run_cli(client, "drain", "n0", "--timeout", "5")
+        assert rc == 0 and "pod/app-pod evicted" in out
+        names = [p.metadata.name for p in client.list("Pod")]
+        assert names == ["agent-pod"]  # daemonset pod survives
+
+        rc, _ = run_cli(client, "uncordon", "n0")
+        assert rc == 0
+        assert client.get("Node", "n0").spec.unschedulable is False

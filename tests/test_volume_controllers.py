@@ -1,0 +1,170 @@
+"""PV binder + attach/detach controllers (pkg/controller/volume analogs):
+claims bind to the smallest satisfying volume, reclaim policies apply on
+claim deletion, and node.status.volumesAttached mirrors the PV-backed
+volumes of each node's active pods."""
+
+import asyncio
+
+from kubernetes_tpu.api.objects import (
+    Node,
+    PersistentVolume,
+    PersistentVolumeClaim,
+    Pod,
+)
+from kubernetes_tpu.apiserver import ObjectStore
+
+from tests.test_controllers import until
+from tests.test_controllers3 import ready_node, start_mgr
+
+
+def pv_obj(name, storage="10Gi", modes=("ReadWriteOnce",), policy="Retain",
+           labels=None, cls=""):
+    spec = {"capacity": {"storage": storage},
+            "accessModes": list(modes),
+            "persistentVolumeReclaimPolicy": policy}
+    if cls:
+        spec["storageClassName"] = cls
+    return PersistentVolume.from_dict({
+        "metadata": {"name": name, "labels": labels or {}}, "spec": spec})
+
+
+def pvc_obj(name, storage="5Gi", modes=("ReadWriteOnce",), ns="default",
+            selector=None, cls=""):
+    spec = {"resources": {"requests": {"storage": storage}},
+            "accessModes": list(modes)}
+    if selector:
+        spec["selector"] = selector
+    if cls:
+        spec["storageClassName"] = cls
+    return PersistentVolumeClaim.from_dict({
+        "metadata": {"name": name, "namespace": ns}, "spec": spec})
+
+
+def test_binder_picks_smallest_satisfying_volume():
+    async def run():
+        store = ObjectStore()
+        store.create(pv_obj("big", "100Gi"))
+        store.create(pv_obj("small", "10Gi"))
+        store.create(pv_obj("tiny", "1Gi"))
+        await start_mgr(store)
+        store.create(pvc_obj("data", "5Gi"))
+        await until(lambda: store.get(
+            "PersistentVolumeClaim", "data").volume_name == "small")
+        pvc = store.get("PersistentVolumeClaim", "data")
+        pv = store.get("PersistentVolume", "small")
+        assert pvc.phase == "Bound" and pv.phase == "Bound"
+        assert pv.spec["claimRef"]["name"] == "data"
+        assert pv.spec["claimRef"]["uid"] == pvc.metadata.uid
+        # the others stay unclaimed
+        assert not store.get("PersistentVolume", "big").spec.get("claimRef")
+        assert not store.get("PersistentVolume", "tiny").spec.get("claimRef")
+
+    asyncio.run(run())
+
+
+def test_binder_honors_modes_selector_and_class():
+    async def run():
+        store = ObjectStore()
+        store.create(pv_obj("rwo", "10Gi", modes=("ReadWriteOnce",)))
+        store.create(pv_obj("rwx-wrong-label", "10Gi",
+                            modes=("ReadWriteMany",),
+                            labels={"tier": "cold"}))
+        store.create(pv_obj("rwx-good", "10Gi", modes=("ReadWriteMany",),
+                            labels={"tier": "fast"}))
+        store.create(pv_obj("classed", "10Gi", modes=("ReadWriteMany",),
+                            labels={"tier": "fast"}, cls="ssd"))
+        await start_mgr(store)
+        store.create(pvc_obj(
+            "shared", "5Gi", modes=("ReadWriteMany",),
+            selector={"matchLabels": {"tier": "fast"}}))
+        await until(lambda: store.get(
+            "PersistentVolumeClaim", "shared").volume_name == "rwx-good")
+        # a claim requiring the class binds the classed volume
+        store.create(pvc_obj("fast", "5Gi", modes=("ReadWriteMany",),
+                             cls="ssd"))
+        await until(lambda: store.get(
+            "PersistentVolumeClaim", "fast").volume_name == "classed")
+
+    asyncio.run(run())
+
+
+def test_binder_no_match_stays_pending_then_binds():
+    async def run():
+        store = ObjectStore()
+        await start_mgr(store)
+        store.create(pvc_obj("data", "50Gi"))
+        await until(lambda: store.get(
+            "PersistentVolumeClaim", "data").phase == "Pending")
+        # a satisfying volume appears later
+        store.create(pv_obj("late", "100Gi"))
+        await until(lambda: store.get(
+            "PersistentVolumeClaim", "data").volume_name == "late")
+
+    asyncio.run(run())
+
+
+def test_reclaim_policies():
+    async def run():
+        store = ObjectStore()
+        store.create(pv_obj("keep", "10Gi", policy="Retain"))
+        await start_mgr(store)
+        store.create(pvc_obj("a"))
+        await until(lambda: store.get(
+            "PersistentVolumeClaim", "a").volume_name == "keep")
+        store.delete("PersistentVolumeClaim", "a")
+        await until(lambda: store.get(
+            "PersistentVolume", "keep").phase == "Released")
+        # Released volumes are NOT re-bindable (claimRef still set)
+        store.create(pvc_obj("b"))
+        await until(lambda: store.get(
+            "PersistentVolumeClaim", "b").phase == "Pending")
+
+        # Recycle: scrubbed back to Available and re-bound to the waiter
+        store.create(pv_obj("cycle", "10Gi", policy="Recycle"))
+        await until(lambda: store.get(
+            "PersistentVolumeClaim", "b").volume_name == "cycle")
+        store.delete("PersistentVolumeClaim", "b")
+        await until(lambda: store.get(
+            "PersistentVolume", "cycle").phase == "Available")
+        assert not store.get("PersistentVolume", "cycle").spec.get(
+            "claimRef")
+
+        # Delete: the volume object goes away with its claim
+        store.create(pv_obj("gone", "10Gi", policy="Delete"))
+        store.create(pvc_obj("c"))
+        await until(lambda: store.get(
+            "PersistentVolumeClaim", "c").volume_name in ("cycle", "gone"))
+        bound = store.get("PersistentVolumeClaim", "c").volume_name
+        store.delete("PersistentVolumeClaim", "c")
+        if bound == "gone":
+            await until(lambda: not any(
+                pv.metadata.name == "gone"
+                for pv in store.list("PersistentVolume")))
+
+    asyncio.run(run())
+
+
+def test_attach_detach_mirrors_pod_volumes():
+    async def run():
+        store = ObjectStore()
+        store.create(ready_node("n0"))
+        store.create(pv_obj("disk", "10Gi"))
+        await start_mgr(store)
+        store.create(pvc_obj("data"))
+        await until(lambda: store.get(
+            "PersistentVolumeClaim", "data").volume_name == "disk")
+        store.create(Pod.from_dict({
+            "metadata": {"name": "db"},
+            "spec": {"nodeName": "n0", "containers": [{"name": "c"}],
+                     "volumes": [{"name": "v",
+                                  "persistentVolumeClaim": {
+                                      "claimName": "data"}}]}}))
+        await until(lambda: [a["name"] for a in store.get(
+            "Node", "n0").status.volumes_attached] ==
+            ["kubernetes.io/pv/disk"])
+        # pod removed -> volume detached
+        store.delete("Pod", "db")
+        await until(lambda: store.get(
+            "Node", "n0").status.volumes_attached == [])
+
+    asyncio.run(run())

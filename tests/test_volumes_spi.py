@@ -1,0 +1,146 @@
+"""Volume plugin SPI + kubelet volume manager (pkg/volume,
+volumemanager/reconciler analogs): projection plugins resolve API content
+at mount time, missing sources block pod start and retry, PVC volumes wait
+for bind + attach."""
+
+import asyncio
+
+import pytest
+
+from kubernetes_tpu.agent.kubelet import KubeletCluster
+from kubernetes_tpu.agent.volumes import (
+    MountError,
+    VolumeManager,
+    default_plugins,
+)
+from kubernetes_tpu.api.objects import Binding, ConfigMap, Pod, Secret
+from kubernetes_tpu.apiserver import ObjectStore
+
+from tests.test_controllers import until
+from tests.test_controllers3 import start_mgr
+from tests.test_volume_controllers import pv_obj, pvc_obj
+
+
+def vol_pod(name, volumes, node="node-0"):
+    return Pod.from_dict({
+        "metadata": {"name": name},
+        "spec": {"containers": [{"name": "c"}], "volumes": volumes,
+                 "nodeName": node}})
+
+
+def test_plugin_projection_and_errors():
+    store = ObjectStore()
+    store.create(Secret.from_dict({
+        "metadata": {"name": "creds"},
+        "data": {"user": "admin", "pass": "hunter2"}}))
+    store.create(ConfigMap.from_dict({
+        "metadata": {"name": "conf"}, "data": {"mode": "fast"}}))
+    vm = VolumeManager(store, "n0", require_attach=False)
+    pod = vol_pod("p", [
+        {"name": "scratch", "emptyDir": {}},
+        {"name": "host", "hostPath": {"path": "/var/log"}},
+        {"name": "sec", "secret": {"secretName": "creds"}},
+        {"name": "cfg", "configMap": {"name": "conf"}},
+        {"name": "meta", "downwardAPI": {"items": [
+            {"path": "podname", "fieldRef": {
+                "fieldPath": "metadata.name"}}]}},
+    ])
+    mounts = {m.volume_name: m for m in vm.mount_pod(pod)}
+    assert mounts["host"].path == "/var/log"
+    assert mounts["sec"].data == {"user": "admin", "pass": "hunter2"}
+    assert mounts["cfg"].data == {"mode": "fast"}
+    assert mounts["meta"].data == {"podname": "p"}
+    assert len(vm.mounts(pod.key)) == 5
+    vm.unmount_pod(pod.key)
+    assert vm.mounts(pod.key) == []
+
+    # missing secret: MountError, nothing partially mounted for a NEW pod
+    bad = vol_pod("q", [{"name": "sec", "secret": {"secretName": "nope"}}])
+    with pytest.raises(MountError):
+        vm.mount_pod(bad)
+    assert vm.mounts(bad.key) == []
+
+    # unknown volume source
+    with pytest.raises(MountError):
+        vm.mount_pod(vol_pod("r", [{"name": "x", "quobyte": {}}]))
+
+
+def test_pvc_mount_requires_bind_and_attach():
+    store = ObjectStore()
+    store.create(pv_obj("disk", "10Gi"))
+    claim = pvc_obj("data")
+    store.create(claim)
+    from tests.test_controllers3 import ready_node
+
+    store.create(ready_node("n0"))
+    plugins = default_plugins(store)
+    vm = VolumeManager(store, "n0", plugins=plugins)
+    pod = vol_pod("db", [{"name": "v", "persistentVolumeClaim": {
+        "claimName": "data"}}], node="n0")
+    # unbound claim: blocked
+    with pytest.raises(MountError, match="not bound"):
+        vm.mount_pod(pod)
+    # bind it by hand (no controllers in this unit test)
+    pvc = store.get("PersistentVolumeClaim", "data")
+    pvc.spec["volumeName"] = "disk"
+    store.update(pvc, check_version=False)
+    # bound but not attached: still blocked
+    with pytest.raises(MountError, match="not yet attached"):
+        vm.mount_pod(pod)
+    node = store.get("Node", "n0")
+    node.status.volumes_attached = [{"name": "kubernetes.io/pv/disk",
+                                     "devicePath": "/dev/disk/disk"}]
+    store.update(node, check_version=False)
+    mounts = vm.mount_pod(pod)
+    assert mounts[0].data == {"pv": "disk"}
+
+
+def test_kubelet_blocks_pod_until_secret_appears():
+    """The reconciler retry: a pod whose Secret does not exist yet starts
+    only after the Secret is created (reference MountVolume backoff)."""
+    async def run():
+        store = ObjectStore()
+        cluster = KubeletCluster(store, n_nodes=1, heartbeat_every=5.0)
+        await cluster.start()
+        store.create(vol_pod("web", [
+            {"name": "sec", "secret": {"secretName": "late"}}], node=""))
+        store.bind(Binding(pod_name="web", namespace="default",
+                           target_node="node-0"))
+        await asyncio.sleep(0.3)
+        assert store.get("Pod", "web").status.phase == "Pending"
+        store.create(Secret.from_dict({
+            "metadata": {"name": "late"}, "data": {"k": "v"}}))
+        await until(lambda: store.get("Pod", "web").status.phase
+                    == "Running")
+        kubelet = cluster.kubelets["node-0"]
+        assert kubelet.volumes.mounts("default/web")[0].data == {"k": "v"}
+        cluster.stop()
+
+    asyncio.run(run())
+
+
+def test_full_stack_pvc_pod_runs_after_attach():
+    """End-to-end: PVC binds (binder), PV attaches (attach/detach
+    controller), then the kubelet mounts and starts the pod."""
+    async def run():
+        store = ObjectStore()
+        from tests.test_controllers3 import ready_node
+
+        mgr = await start_mgr(store)
+        cluster = KubeletCluster(store, n_nodes=1, heartbeat_every=0.5)
+        await cluster.start()
+        store.create(pv_obj("disk", "10Gi"))
+        store.create(pvc_obj("data"))
+        store.create(vol_pod("db", [{"name": "v", "persistentVolumeClaim": {
+            "claimName": "data"}}], node=""))
+        store.bind(Binding(pod_name="db", namespace="default",
+                           target_node="node-0"))
+        await until(lambda: store.get("Pod", "db").status.phase
+                    == "Running", timeout=8.0)
+        node = store.get("Node", "node-0")
+        assert [a["name"] for a in node.status.volumes_attached] == \
+            ["kubernetes.io/pv/disk"]
+        cluster.stop()
+        mgr.stop()
+
+    asyncio.run(run())
