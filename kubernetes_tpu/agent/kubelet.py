@@ -43,10 +43,13 @@ EXIT_CODE_ANNOTATION = "kubernetes-tpu/exit-code"
 
 
 class FakeRuntime:
-    """CRI-shaped fake: instant sandbox/container start, scripted exits."""
+    """CRI-shaped fake: instant sandbox/container start, scripted exits,
+    per-pod log buffers and exec (the kubelet server's southbound surface:
+    ReadLogs / ExecSync in the CRI)."""
 
     def __init__(self):
         self._pods: dict[str, dict] = {}
+        self._logs: dict[str, list[str]] = {}
 
     def sync_pod(self, pod: Pod) -> None:
         """RunPodSandbox + CreateContainer + StartContainer, collapsed."""
@@ -61,10 +64,40 @@ class FakeRuntime:
                            float(ann.get(RUN_SECONDS_ANNOTATION, 0) or 0)),
             "exit_code": int(ann.get(EXIT_CODE_ANNOTATION, 0) or 0),
         }
+        names = ", ".join(c.name for c in pod.spec.containers) or "c"
+        self._logs.setdefault(pod.key, []).append(
+            f"{pod.metadata.name}: started containers [{names}]")
+
+    def read_logs(self, key: str) -> list[str]:
+        """CRI ReadLogs analog."""
+        return list(self._logs.get(key, ()))
+
+    def append_log(self, key: str, line: str) -> None:
+        self._logs.setdefault(key, []).append(line)
+
+    def exec_sync(self, key: str, command: list[str]) -> tuple[int, str]:
+        """CRI ExecSync analog: echo-style fake shell against the running
+        sandbox; exits 126 when the pod isn't running."""
+        entry = self._pods.get(key)
+        if entry is None or entry["state"] != "running":
+            return 126, f"container not running in {key}\n"
+        if command[:1] == ["echo"]:
+            return 0, " ".join(command[1:]) + "\n"
+        if command[:1] == ["hostname"]:
+            return 0, key.split("/", 1)[1] + "\n"
+        if command[:1] == ["false"]:
+            return 1, ""
+        return 0, f"exec: {' '.join(command)}\n"
 
     def kill_pod(self, key: str) -> None:
-        """StopPodSandbox + RemovePodSandbox."""
+        """StopPodSandbox + RemovePodSandbox. Logs survive (a finished
+        Job's logs stay readable until the pod object is deleted)."""
         self._pods.pop(key, None)
+
+    def purge(self, key: str) -> None:
+        """Pod object deleted: sandbox AND logs go."""
+        self._pods.pop(key, None)
+        self._logs.pop(key, None)
 
     def __contains__(self, key: str) -> bool:
         """Part of the runtime interface: is this pod's sandbox present?"""
@@ -91,13 +124,15 @@ class Kubelet(HollowKubelet):
 
     def __init__(self, store: ObjectStore, node_name: str,
                  runtime: FakeRuntime | None = None,
-                 volume_manager=None, **kw):
+                 volume_manager=None, serve_api: bool = False, **kw):
         super().__init__(store, node_name, **kw)
         from kubernetes_tpu.agent.volumes import VolumeManager
 
         self.runtime = runtime if runtime is not None else FakeRuntime()
         self.volumes = volume_manager if volume_manager is not None \
             else VolumeManager(store, node_name)
+        self.serve_api = serve_api
+        self.server = None  # KubeletServer when serve_api
         self._workers: dict[str, asyncio.Queue] = {}
         self._worker_tasks: dict[str, asyncio.Task] = {}
         self._pleg_task: asyncio.Task | None = None
@@ -111,7 +146,7 @@ class Kubelet(HollowKubelet):
             return
         if event_type == "DELETED":
             self._stop_worker(pod.key)
-            self.runtime.kill_pod(pod.key)
+            self.runtime.purge(pod.key)
             self.volumes.unmount_pod(pod.key)
             self._reported.pop(pod.key, None)
             return
@@ -214,12 +249,29 @@ class Kubelet(HollowKubelet):
         await super().start()
         self._pleg_task = asyncio.get_running_loop().create_task(
             self._pleg_loop())
+        if self.serve_api:
+            from kubernetes_tpu.agent.server import KubeletServer
+
+            self.server = KubeletServer(self)
+            await self.server.start()
+            # publish the endpoint so the apiserver node proxy can find us
+            # (kubelet_node_status.go sets DaemonEndpoints on registration)
+            try:
+                node = self.store.get("Node", self.node_name)
+                node.status.daemon_endpoints = {
+                    "kubeletEndpoint": {"Port": self.server.port}}
+                self.store.update(node, check_version=False)
+            except (Conflict, NotFound):
+                pass
 
     def stop(self) -> None:
         super().stop()
         if self._pleg_task is not None:
             self._pleg_task.cancel()
             self._pleg_task = None
+        if self.server is not None:
+            self.server.close()
+            self.server = None
         for key in list(self._worker_tasks):
             self._stop_worker(key)
 
@@ -234,7 +286,7 @@ class KubeletCluster:
 
     def __init__(self, store: ObjectStore, n_nodes: int = 0,
                  name_prefix: str = "node", heartbeat_every: float = 10.0,
-                 capacity: dict | None = None):
+                 capacity: dict | None = None, serve_api: bool = False):
         self.store = store
         self.kubelets: dict[str, Kubelet] = {}
         self.pod_informer = Informer(store, "Pod")
@@ -243,7 +295,7 @@ class KubeletCluster:
             name = f"{name_prefix}-{i}"
             self.kubelets[name] = Kubelet(
                 store, name, heartbeat_every=heartbeat_every,
-                capacity=capacity)
+                capacity=capacity, serve_api=serve_api)
 
     def _on_pod(self, event) -> None:
         pod = event.obj

@@ -1,0 +1,146 @@
+"""Kubelet API server: the :10250 surface (logs, exec, running pods).
+
+Analog of pkg/kubelet/server: the kubelet exposes a small HTTP API the
+apiserver proxies to (`kubectl logs/exec` ride apiserver -> node proxy ->
+kubelet, the reference's SPDY remotecommand path collapsed to plain
+chunked HTTP — same topology, simpler framing):
+
+  GET  /containerLogs/{ns}/{pod}/{container}[?follow=true]
+  POST /exec/{ns}/{pod}/{container}?command=<json list>
+  GET  /runningpods/              (debug handler, server.go)
+  GET  /healthz
+
+Log following streams chunked lines as the runtime appends them — the
+`kubectl logs -f` experience over the fake runtime.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+from urllib.parse import parse_qs, urlsplit
+
+log = logging.getLogger(__name__)
+
+
+class KubeletServer:
+    def __init__(self, kubelet, host: str = "127.0.0.1", port: int = 0):
+        self.kubelet = kubelet
+        self.host = host
+        self.port = port
+        self._server: asyncio.AbstractServer | None = None
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_server(self._handle, self.host,
+                                                  self.port)
+        self.port = self._server.sockets[0].getsockname()[1]
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None
+
+    def close(self) -> None:
+        """Synchronous shutdown (for callers outside the loop — the
+        kubelet's stop() path); sockets close, no wait for in-flight
+        handlers."""
+        if self._server is not None:
+            self._server.close()
+            self._server = None
+
+    async def _handle(self, reader: asyncio.StreamReader,
+                      writer: asyncio.StreamWriter) -> None:
+        from kubernetes_tpu.apiserver.http import read_http_request
+
+        try:
+            try:
+                parsed = await read_http_request(reader)
+            except ValueError:
+                await self._respond(writer, 400, b"bad request")
+                return
+            if parsed is None:
+                return
+            method, target, _headers, _body = parsed
+            url = urlsplit(target)
+            query = {k: v[-1] for k, v in parse_qs(url.query).items()}
+            await self._route(writer, method, url.path, query)
+        except (asyncio.IncompleteReadError, ConnectionError):
+            pass
+        finally:
+            writer.close()
+
+    async def _route(self, writer, method: str, path: str,
+                     query: dict) -> None:
+        parts = [p for p in path.strip("/").split("/") if p]
+        if parts == ["healthz"]:
+            await self._respond(writer, 200, b"ok")
+            return
+        if parts == ["runningpods"]:
+            pods = sorted(self.kubelet.runtime.list_pods())
+            await self._respond(writer, 200,
+                                json.dumps({"pods": pods}).encode())
+            return
+        if len(parts) == 4 and parts[0] == "containerLogs" \
+                and method == "GET":
+            _, ns, pod, _container = parts
+            await self._serve_logs(writer, f"{ns}/{pod}",
+                                   follow=query.get("follow") in
+                                   ("1", "true"))
+            return
+        if len(parts) == 4 and parts[0] == "exec" and method == "POST":
+            _, ns, pod, _container = parts
+            try:
+                command = json.loads(query.get("command", "[]"))
+            except ValueError:
+                command = []
+            if not isinstance(command, list) or not command:
+                await self._respond(writer, 400, b"command required")
+                return
+            code, output = self.kubelet.runtime.exec_sync(
+                f"{ns}/{pod}", [str(c) for c in command])
+            await self._respond(
+                writer, 200,
+                json.dumps({"exitCode": code, "output": output}).encode())
+            return
+        await self._respond(writer, 404, b"not found")
+
+    async def _serve_logs(self, writer, key: str, follow: bool) -> None:
+        runtime = self.kubelet.runtime
+        lines = runtime.read_logs(key)
+        if not follow:
+            body = "".join(f"{ln}\n" for ln in lines).encode()
+            await self._respond(writer, 200, body)
+            return
+        writer.write(b"HTTP/1.1 200 OK\r\n"
+                     b"Content-Type: text/plain\r\n"
+                     b"Transfer-Encoding: chunked\r\n\r\n")
+        sent = 0
+        try:
+            while True:
+                lines = runtime.read_logs(key)
+                for ln in lines[sent:]:
+                    chunk = f"{ln}\n".encode()
+                    writer.write(f"{len(chunk):x}\r\n".encode() + chunk
+                                 + b"\r\n")
+                sent = len(lines)
+                await writer.drain()
+                if key not in runtime:  # sandbox gone: stream ends
+                    break
+                await asyncio.sleep(0.05)
+        except (ConnectionError, asyncio.CancelledError):
+            return
+        writer.write(b"0\r\n\r\n")
+        await writer.drain()
+
+    @staticmethod
+    async def _respond(writer, status: int, body: bytes) -> None:
+        reason = {200: "OK", 400: "Bad Request", 404: "Not Found"}.get(
+            status, "?")
+        writer.write(
+            f"HTTP/1.1 {status} {reason}\r\n"
+            f"Content-Type: text/plain\r\n"
+            f"Content-Length: {len(body)}\r\n"
+            f"Connection: close\r\n\r\n".encode() + body)
+        await writer.drain()

@@ -467,6 +467,9 @@ class NodeStatus:
     # ...}] + kubelet's in-use marks (v1.NodeStatus VolumesAttached/InUse)
     volumes_attached: list[dict[str, Any]] = field(default_factory=list)
     volumes_in_use: list[str] = field(default_factory=list)
+    # {"kubeletEndpoint": {"Port": N}} — how the apiserver node proxy finds
+    # the kubelet's API (v1.NodeStatus DaemonEndpoints)
+    daemon_endpoints: dict[str, Any] = field(default_factory=dict)
 
     @classmethod
     def from_dict(cls, d: dict[str, Any]) -> "NodeStatus":
@@ -477,6 +480,7 @@ class NodeStatus:
             images=copy.deepcopy(d.get("images") or []),
             volumes_attached=copy.deepcopy(d.get("volumesAttached") or []),
             volumes_in_use=list(d.get("volumesInUse") or []),
+            daemon_endpoints=copy.deepcopy(d.get("daemonEndpoints") or {}),
         )
 
     def to_dict(self) -> dict[str, Any]:
@@ -493,6 +497,8 @@ class NodeStatus:
             out["volumesAttached"] = copy.deepcopy(self.volumes_attached)
         if self.volumes_in_use:
             out["volumesInUse"] = list(self.volumes_in_use)
+        if self.daemon_endpoints:
+            out["daemonEndpoints"] = copy.deepcopy(self.daemon_endpoints)
         return out
 
     def effective_allocatable(self) -> dict[str, str]:
@@ -532,7 +538,9 @@ class Node:
                               volumes_attached=copy.deepcopy(
                                   self.status.volumes_attached),
                               volumes_in_use=list(
-                                  self.status.volumes_in_use)),
+                                  self.status.volumes_in_use),
+                              daemon_endpoints=copy.deepcopy(
+                                  self.status.daemon_endpoints)),
         )
 
     @classmethod

@@ -97,6 +97,69 @@ KIND_TO_CLS = {cls.kind: cls for cls in (
 PLURAL_OF = {kind: plural for plural, kind in RESOURCES.items()}
 
 
+async def read_http_request(reader: asyncio.StreamReader):
+    """Parse one request off a stream -> (method, target, headers, body),
+    or None at EOF. The one HTTP/1.1 request parser shared by the
+    apiserver and the kubelet API server."""
+    request_line = await reader.readline()
+    if not request_line:
+        return None
+    try:
+        method, target, _ = request_line.decode().split(None, 2)
+    except ValueError:
+        raise ValueError("bad request line") from None
+    headers: dict[str, str] = {}
+    while True:
+        line = await reader.readline()
+        if line in (b"\r\n", b"\n", b""):
+            break
+        name, _, value = line.decode().partition(":")
+        headers[name.strip().lower()] = value.strip()
+    length = int(headers.get("content-length", 0))
+    body = await reader.readexactly(length) if length else b""
+    return method, target, headers, body
+
+
+def parse_status_line(head: bytes) -> int:
+    """Status code from a response head, or ValueError on non-HTTP."""
+    try:
+        return int(head.split(None, 2)[1])
+    except (IndexError, ValueError):
+        raise ValueError("empty or non-HTTP reply") from None
+
+
+def _split_path(path: str):
+    """-> (ns | None, plural, name | None, subresource | None) — the raw
+    resource shape of a request path, no kind resolution. Authorization
+    runs on THIS (so aggregated/unknown resources stay inside ABAC — a
+    proxied group must not bypass the authorizer just because the core
+    registry can't resolve its plural); routing resolves the kind after.
+
+    `/namespaces/{x}` with nothing after it addresses the Namespace
+    RESOURCE itself (cluster-scoped); with a trailing resource segment it
+    scopes the request to namespace x (installer.go path shapes)."""
+    parts = [p for p in path.strip("/").split("/") if p]
+    # strip the version prefix: api/v1 or apis/{group}/{version}
+    if parts[:1] == ["api"]:
+        parts = parts[2:]
+    elif parts[:1] == ["apis"]:
+        parts = parts[3:]
+    else:
+        raise NotFound(f"unknown path {path!r}")
+    ns = None
+    if parts[:1] == ["namespaces"] and len(parts) >= 3:
+        ns = parts[1]
+        parts = parts[2:]
+    if not parts:
+        raise NotFound(f"unknown path {path!r}")
+    plural, name, sub = parts[0], None, None
+    if len(parts) >= 2:
+        name = parts[1]
+    if len(parts) >= 3:
+        sub = parts[2]
+    return ns, plural, name, sub
+
+
 def decode_object(kind: str, body: dict) -> Any:
     cls = KIND_TO_CLS.get(kind)
     if cls is None:
@@ -145,9 +208,9 @@ class APIServer:
         if self.authorizer is None:
             return None
         try:
-            ns, plural, _kind, name, _sub = self._parse_path(path)
+            ns, plural, name, _sub = _split_path(path)
         except NotFound:
-            return None  # let routing produce the 404
+            return None  # no resource shape at all: routing 404s it
         verb = {"GET": "get" if name else "list", "POST": "create",
                 "PUT": "update", "DELETE": "delete"}.get(method, method)
         # cluster-scoped (and cross-namespace) requests authorize against
@@ -179,23 +242,14 @@ class APIServer:
                       writer: asyncio.StreamWriter) -> None:
         try:
             while True:
-                request_line = await reader.readline()
-                if not request_line:
-                    return
                 try:
-                    method, target, _ = request_line.decode().split(None, 2)
+                    parsed = await read_http_request(reader)
                 except ValueError:
                     await _respond(writer, 400, {"message": "bad request"})
                     return
-                headers: dict[str, str] = {}
-                while True:
-                    line = await reader.readline()
-                    if line in (b"\r\n", b"\n", b""):
-                        break
-                    name, _, value = line.decode().partition(":")
-                    headers[name.strip().lower()] = value.strip()
-                length = int(headers.get("content-length", 0))
-                body = await reader.readexactly(length) if length else b""
+                if parsed is None:
+                    return
+                method, target, headers, body = parsed
 
                 url = urlsplit(target)
                 query = {k: v[-1] for k, v in parse_qs(url.query).items()}
@@ -206,9 +260,28 @@ class APIServer:
                     await _respond(writer, *denied)
                     return
                 if query.get("watch") in ("1", "true"):
+                    svc = self._api_service_for(url.path)
+                    if svc is not None:
+                        # aggregated watch: relay the byte stream to the
+                        # extension apiserver (chunked frames pass through)
+                        addr = urlsplit(svc.spec["serverAddress"])
+                        await self._relay_raw(
+                            writer, addr.hostname, addr.port or 80,
+                            method, target, body)
+                        return
                     await self._serve_watch(writer, url.path, query)
                     return  # watch owns the connection until it closes
-                status, payload = self._route(method, url.path, query, body)
+                node_proxy = self._node_proxy_target(url.path)
+                if node_proxy is not None:
+                    await self._proxy_to_node(writer, method, node_proxy,
+                                              url.query, body)
+                    return  # the relay owns the connection
+                proxied = await self._aggregate(method, target, body)
+                if proxied is not None:
+                    status, payload = proxied
+                else:
+                    status, payload = self._route(method, url.path, query,
+                                                  body)
                 keep = headers.get("connection", "keep-alive").lower() != "close"
                 await _respond(writer, status, payload, keep_alive=keep)
                 if not keep:
@@ -217,6 +290,164 @@ class APIServer:
             pass
         finally:
             writer.close()
+
+    # ---- node proxy (pkg/registry/core/node/rest proxy subresource) ----
+
+    def _node_proxy_target(self, path: str):
+        """/api/v1/nodes/{name}/proxy/{rest} -> (kubelet host, port, rest)
+        from the node's published daemonEndpoints, or None."""
+        parts = [p for p in path.strip("/").split("/") if p]
+        if len(parts) < 5 or parts[:2] != ["api", "v1"] \
+                or parts[2] != "nodes" or parts[4] != "proxy":
+            return None
+        try:
+            node = self.store.get("Node", parts[3])
+        except NotFound:
+            return ("", 0, "")  # sentinel: 404 downstream
+        port = ((node.status.daemon_endpoints.get("kubeletEndpoint")
+                 or {}).get("Port", 0))
+        if not port:
+            return ("", 0, "")
+        return ("127.0.0.1", int(port), "/" + "/".join(parts[5:]))
+
+    async def _proxy_to_node(self, writer, method: str, target, query: str,
+                             body: bytes) -> None:
+        """Relay the request to the kubelet API and pipe the raw response
+        bytes back — chunked log-follow streams straight through (the
+        reference's upgrade-aware proxy handler, collapsed to a byte
+        relay)."""
+        host, port, rest = target
+        if not port:
+            await _respond(writer, 404, {
+                "kind": "Status", "reason": "NotFound",
+                "message": "node has no kubelet endpoint"})
+            return
+        path = rest + (f"?{query}" if query else "")
+        await self._relay_raw(writer, host, port, method, path, body,
+                              unreachable_message="kubelet unreachable")
+
+    async def _relay_raw(self, writer, host: str, port: int, method: str,
+                         path: str, body: bytes, *,
+                         unreachable_message: str = "backend unreachable"
+                         ) -> None:
+        """Pipe one request to a backend and its raw response bytes back —
+        the streaming relay under both the node proxy and aggregated
+        watches."""
+        try:
+            up_reader, up_writer = await asyncio.wait_for(
+                asyncio.open_connection(host, port), timeout=5.0)
+        except (OSError, asyncio.TimeoutError):
+            await _respond(writer, 503, {
+                "kind": "Status", "reason": "ServiceUnavailable",
+                "message": unreachable_message})
+            return
+        try:
+            up_writer.write(
+                f"{method} {path} HTTP/1.1\r\n"
+                f"Host: {host}\r\n"
+                f"Content-Length: {len(body)}\r\n"
+                f"Connection: close\r\n\r\n".encode() + body)
+            await up_writer.drain()
+            while True:
+                chunk = await up_reader.read(65536)
+                if not chunk:
+                    break
+                writer.write(chunk)
+                await writer.drain()
+        except (ConnectionError, asyncio.CancelledError):
+            pass
+        finally:
+            up_writer.close()
+
+    # ---- aggregation (kube-aggregator analog) ----
+
+    def _api_service_for(self, path: str):
+        """An APIService whose spec.group/version owns this /apis path and
+        names a remote backend (spec.serverAddress). Local APIServices
+        (no backend) fall through to the core handlers — the aggregator's
+        'Local' services (kube-aggregator apiserver/handler_proxy.go)."""
+        parts = [p for p in path.strip("/").split("/") if p]
+        if len(parts) < 3 or parts[0] != "apis":
+            return None
+        group, version = parts[1], parts[2]
+        for svc in self.store.list("APIService", copy_objects=False):
+            if svc.group_version == (group, version) \
+                    and svc.spec.get("serverAddress"):
+                return svc
+        return None
+
+    async def _aggregate(self, method: str, target: str, body: bytes):
+        """Proxy one request to the owning extension apiserver, or None to
+        serve locally. Unreachable backends are 503 + Available=False on
+        the APIService (the aggregator's availability controller,
+        kube-aggregator pkg/apiserver/handler_proxy.go + status
+        controller)."""
+        svc = self._api_service_for(urlsplit(target).path)
+        if svc is None:
+            return None
+        addr = urlsplit(svc.spec["serverAddress"])
+        try:
+            reader, writer = await asyncio.wait_for(
+                asyncio.open_connection(addr.hostname, addr.port or 80),
+                timeout=5.0)
+        except (OSError, asyncio.TimeoutError):
+            self._mark_available(svc.metadata.name, False)
+            return 503, {"kind": "Status", "reason": "ServiceUnavailable",
+                         "message": f"APIService {svc.metadata.name}: "
+                                    f"backend unreachable"}
+        try:
+            writer.write(
+                f"{method} {target} HTTP/1.1\r\n"
+                f"Host: {addr.hostname}\r\n"
+                f"Content-Type: application/json\r\n"
+                f"Content-Length: {len(body)}\r\n"
+                f"Connection: close\r\n\r\n".encode() + body)
+            await writer.drain()
+            raw = await asyncio.wait_for(reader.read(), timeout=30.0)
+        except (OSError, asyncio.TimeoutError):
+            self._mark_available(svc.metadata.name, False)
+            return 503, {"kind": "Status", "reason": "ServiceUnavailable",
+                         "message": f"APIService {svc.metadata.name}: "
+                                    f"backend failed mid-request"}
+        finally:
+            writer.close()
+        head, _, resp_body = raw.partition(b"\r\n\r\n")
+        try:
+            status = parse_status_line(head)
+        except ValueError:
+            # backend accepted the connection but spoke no HTTP (crashed
+            # handler / wrong service): that's unavailable too
+            self._mark_available(svc.metadata.name, False)
+            return 503, {"kind": "Status", "reason": "ServiceUnavailable",
+                         "message": f"APIService {svc.metadata.name}: "
+                                    f"backend sent no HTTP response"}
+        self._mark_available(svc.metadata.name, True)
+        try:
+            payload = json.loads(resp_body) if resp_body else {}
+        except ValueError:
+            payload = {"message": resp_body.decode(errors="replace")}
+        return status, payload
+
+    def _mark_available(self, name: str, ok: bool) -> None:
+        cond = {"type": "Available", "status": "True" if ok else "False"}
+
+        def mutate(obj):
+            conds = [c for c in obj.status.get("conditions", [])
+                     if c.get("type") != "Available"]
+            conds.append(cond)
+            obj.status["conditions"] = conds
+            return obj
+
+        try:
+            current = self.store.get("APIService", name)
+            have = next((c for c in current.status.get("conditions", [])
+                         if c.get("type") == "Available"), None)
+            if have and have.get("status") == cond["status"]:
+                return
+            self.store.guaranteed_update("APIService", name, "default",
+                                         mutate)
+        except (NotFound, Conflict):
+            pass
 
     # ---- routing ----
 
@@ -234,31 +465,9 @@ class APIServer:
 
     def _parse_path(self, path: str):
         """-> (ns | None, plural, kind, name | None, subresource | None).
-
-        `/namespaces/{x}` with nothing after it addresses the Namespace
-        RESOURCE itself (cluster-scoped); with a trailing resource segment
-        it scopes the request to namespace x (installer.go path shapes).
         Resolves the kind exactly once per request (CRD lookups scan the
         store)."""
-        parts = [p for p in path.strip("/").split("/") if p]
-        # strip the version prefix: api/v1 or apis/{group}/{version}
-        if parts[:1] == ["api"]:
-            parts = parts[2:]
-        elif parts[:1] == ["apis"]:
-            parts = parts[3:]
-        else:
-            raise NotFound(f"unknown path {path!r}")
-        ns = None
-        if parts[:1] == ["namespaces"] and len(parts) >= 3:
-            ns = parts[1]
-            parts = parts[2:]
-        if not parts:
-            raise NotFound(f"unknown path {path!r}")
-        plural, name, sub = parts[0], None, None
-        if len(parts) >= 2:
-            name = parts[1]
-        if len(parts) >= 3:
-            sub = parts[2]
+        ns, plural, name, sub = _split_path(path)
         return ns, plural, self._resolve_plural(plural), name, sub
 
     def _route(self, method: str, path: str, query: dict, body: bytes):
@@ -604,6 +813,44 @@ class RemoteStore:
             + "/binding",
             {"target": {"kind": "Node", "name": binding.target_node},
              "metadata": {"name": binding.pod_name}})
+
+    def raw(self, method: str, path: str) -> tuple[int, str]:
+        """Non-JSON request (node-proxy surfaces: logs, exec). Returns
+        (status, body-text) with chunked transfer decoding."""
+        with socket.create_connection((self.host, self.port),
+                                      timeout=30) as sock:
+            sock.sendall(
+                f"{method} {path} HTTP/1.1\r\n"
+                f"Host: {self.host}\r\n"
+                f"{self._auth_header()}"
+                f"Content-Length: 0\r\n"
+                f"Connection: close\r\n\r\n".encode())
+            data = b""
+            while True:
+                chunk = sock.recv(65536)
+                if not chunk:
+                    break
+                data += chunk
+        head, _, body = data.partition(b"\r\n\r\n")
+        try:
+            status = parse_status_line(head)
+        except ValueError:
+            raise ConnectionError(
+                "empty or non-HTTP reply from server") from None
+        if b"transfer-encoding: chunked" in head.lower():
+            out, rest = b"", body
+            while rest:
+                size_line, _, rest = rest.partition(b"\r\n")
+                try:
+                    size = int(size_line, 16)
+                except ValueError:
+                    break
+                if size == 0:
+                    break
+                out += rest[:size]
+                rest = rest[size + 2:]
+            body = out
+        return status, body.decode(errors="replace")
 
     def evict(self, name: str, namespace: str = "default") -> bool:
         """pods/eviction subresource. False = the pod's disruption budget

@@ -231,6 +231,93 @@ def cmd_bind(client, args) -> int:
     return 0
 
 
+def _node_proxy_path(client, args) -> tuple[str, str]:
+    """(node-proxy path prefix, container name) for the pod, via its
+    spec.nodeName (the apiserver -> kubelet hop kubectl logs/exec ride)."""
+    pod = client.get("Pod", args.name, args.namespace)
+    node = pod.spec.node_name
+    if not node:
+        raise NotFound(f"pod {args.name} is not scheduled yet")
+    container = getattr(args, "container", "") or (
+        pod.spec.containers[0].name if pod.spec.containers else "c")
+    return f"/api/v1/nodes/{node}/proxy", container
+
+
+def cmd_logs(client, args) -> int:
+    prefix, container = _node_proxy_path(client, args)
+    status, body = client.raw(
+        "GET", f"{prefix}/containerLogs/{args.namespace}/{args.name}/"
+               f"{container}")
+    if status != 200:
+        print(f"Error from server: {body.strip()}", file=sys.stderr)
+        return 1
+    sys.stdout.write(body)
+    return 0
+
+
+def cmd_exec(client, args) -> int:
+    from urllib.parse import quote
+
+    prefix, container = _node_proxy_path(client, args)
+    status, body = client.raw(
+        "POST", f"{prefix}/exec/{args.namespace}/{args.name}/{container}"
+                f"?command={quote(json.dumps(args.command))}")
+    if status != 200:
+        print(f"Error from server: {body.strip()}", file=sys.stderr)
+        return 1
+    result = json.loads(body)
+    sys.stdout.write(result.get("output", ""))
+    return int(result.get("exitCode", 0))
+
+
+def cmd_rollout(client, args) -> int:
+    """rollout status|history|undo deployment/<name> (pkg/kubectl/cmd/
+    rollout + rollback semantics through spec.rollbackTo)."""
+    kind = RESOURCES[resolve_resource(args.resource)]
+    if kind != "Deployment":
+        print(f"error: rollout is only supported for deployments, "
+              f"got {kind}", file=sys.stderr)
+        return 1
+    from kubernetes_tpu.controllers.deployment import REVISION_ANNOTATION
+
+    deploy = client.get(kind, args.name, args.namespace)
+    if args.action == "status":
+        status = deploy.status or {}
+        desired = deploy.replicas
+        updated = int(status.get("updatedReplicas", 0))
+        available = int(status.get("availableReplicas", 0))
+        if updated >= desired and available >= desired:
+            print(f"deployment \"{args.name}\" successfully rolled out")
+            return 0
+        print(f"Waiting for rollout to finish: {updated} out of "
+              f"{desired} new replicas have been updated "
+              f"({available} available)...")
+        return 1
+    owned = [rs for rs in client.list("ReplicaSet", args.namespace)
+             if any(r.get("uid") == deploy.metadata.uid
+                    for r in rs.metadata.owner_references)]
+    owned.sort(key=lambda r: int(
+        r.metadata.annotations.get(REVISION_ANNOTATION, 0) or 0))
+    if args.action == "history":
+        print("REVISION  REPLICASET")
+        for rs in owned:
+            rev = rs.metadata.annotations.get(REVISION_ANNOTATION, "?")
+            print(f"{rev:<9} {rs.metadata.name}")
+        return 0
+    if args.action == "undo":
+        def mutate(obj):
+            obj.spec["rollbackTo"] = (
+                {"revision": args.to_revision} if args.to_revision else {})
+            return obj
+
+        client.guaranteed_update(kind, args.name, args.namespace, mutate)
+        print(f"deployment/{args.name} rolled back")
+        return 0
+    print(f"error: unknown rollout action {args.action!r}",
+          file=sys.stderr)
+    return 1
+
+
 def _set_unschedulable(client, node: str, value: bool) -> None:
     def mutate(obj):
         obj.spec.unschedulable = value
@@ -344,6 +431,24 @@ def build_parser() -> argparse.ArgumentParser:
     dr.add_argument("name")
     dr.add_argument("--timeout", type=float, default=30.0)
     dr.set_defaults(fn=cmd_drain)
+    ro = sub.add_parser("rollout")
+    ro.add_argument("action", choices=["status", "history", "undo"])
+    ro.add_argument("resource")
+    ro.add_argument("name")
+    ro.add_argument("-n", "--namespace", default="default")
+    ro.add_argument("--to-revision", type=int, default=0)
+    ro.set_defaults(fn=cmd_rollout)
+    lg = sub.add_parser("logs")
+    lg.add_argument("name")
+    lg.add_argument("-n", "--namespace", default="default")
+    lg.add_argument("-c", "--container", default="")
+    lg.set_defaults(fn=cmd_logs)
+    ex = sub.add_parser("exec")
+    ex.add_argument("name")
+    ex.add_argument("-n", "--namespace", default="default")
+    ex.add_argument("-c", "--container", default="")
+    ex.add_argument("command", nargs="+")
+    ex.set_defaults(fn=cmd_exec)
     return p
 
 

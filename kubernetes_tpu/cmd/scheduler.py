@@ -58,6 +58,9 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--policy-config-file", default="",
                    help="scheduler Policy JSON (api/types.go:38)")
     p.add_argument("--leader-elect", action="store_true")
+    p.add_argument("--feature-gates", default="",
+                   help="comma-separated Name=true|false overrides "
+                        "(utils.features registry)")
     p.add_argument("--lock-object-name", default="kube-scheduler")
     p.add_argument("--lock-object-namespace", default="kube-system")
     p.add_argument("--num-nodes", type=int, default=1024,
@@ -162,8 +165,14 @@ def main(argv=None) -> int:
     logging.basicConfig(
         level=logging.INFO,
         format="%(asctime)s %(levelname).1s %(name)s: %(message)s")
+    args = parse_args(argv)
+    if args.feature_gates:
+        from kubernetes_tpu.utils.features import DEFAULT_FEATURE_GATE
+
+        DEFAULT_FEATURE_GATE.set_from_string(args.feature_gates)
+        log.info("feature gates: %s", DEFAULT_FEATURE_GATE.known())
     try:
-        asyncio.run(run(parse_args(argv)))
+        asyncio.run(run(args))
     except KeyboardInterrupt:
         pass
     return 0

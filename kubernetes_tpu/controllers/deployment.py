@@ -23,6 +23,7 @@ from kubernetes_tpu.controllers.base import ReconcileController
 from kubernetes_tpu.controllers.replicaset import controller_ref, make_controller_ref
 
 HASH_LABEL = "pod-template-hash"  # extensions.DefaultDeploymentUniqueLabelKey
+REVISION_ANNOTATION = "deployment.kubernetes.io/revision"  # util.RevisionAnnotation
 
 
 def template_hash(template: dict) -> str:
@@ -105,11 +106,15 @@ class DeploymentController(ReconcileController):
         tmeta["labels"][HASH_LABEL] = h
         selector = copy.deepcopy(deploy.spec.get("selector") or {})
         selector.setdefault("matchLabels", {})[HASH_LABEL] = h
+        revision = 1 + max(
+            (int(r.metadata.annotations.get(REVISION_ANNOTATION, 0) or 0)
+             for r in self._owned_rss(deploy)), default=0)
         rs = ReplicaSet.from_dict({
             "metadata": {
                 "name": f"{deploy.metadata.name}-{h}",
                 "namespace": deploy.metadata.namespace,
                 "labels": dict(tmeta["labels"]),
+                "annotations": {REVISION_ANNOTATION: str(revision)},
                 "ownerReferences": [make_controller_ref(deploy)],
             },
             "spec": {"replicas": initial_replicas, "selector": selector,
@@ -134,13 +139,78 @@ class DeploymentController(ReconcileController):
 
     # ---- reconcile ----
 
+    def _rollback(self, deploy, rss: list[ReplicaSet]) -> bool:
+        """spec.rollbackTo (rollback.go rollback): point the deployment's
+        template at the target revision's RS template and clear the marker;
+        the normal rolling machinery then rolls 'forward' to it."""
+        import copy
+
+        target_rev = int((deploy.spec.get("rollbackTo") or {}).get(
+            "revision", 0) or 0)
+        by_rev = sorted(
+            rss, key=lambda r: int(
+                r.metadata.annotations.get(REVISION_ANNOTATION, 0) or 0))
+        current_hash = template_hash(deploy.spec.get("template") or {})
+        candidates = [r for r in by_rev
+                      if template_hash(r.spec.get("template") or {})
+                      != current_hash]
+        if target_rev:
+            pick = next(
+                (r for r in by_rev
+                 if int(r.metadata.annotations.get(REVISION_ANNOTATION, 0)
+                        or 0) == target_rev), None)
+        else:
+            pick = candidates[-1] if candidates else None  # last revision
+        def clear(obj):
+            obj.spec.pop("rollbackTo", None)
+            if pick is not None:
+                template = copy.deepcopy(pick.spec.get("template") or {})
+                labels = (template.get("metadata") or {}).get("labels")
+                if labels:
+                    labels.pop(HASH_LABEL, None)
+                obj.spec["template"] = template
+            return obj
+
+        try:
+            self.store.guaranteed_update(
+                "Deployment", deploy.metadata.name,
+                deploy.metadata.namespace, clear)
+        except (NotFound, Conflict):
+            return False
+        return True
+
     async def sync(self, key: str) -> None:
         ns, name = key.split("/", 1)
         deploy = self.deployments.get(name, ns)
         if deploy is None:
             return
         rss = self._owned_rss(deploy)
+        if deploy.spec.get("rollbackTo") is not None:
+            # rewrite the spec, then reconcile the NEXT observation of it
+            self._rollback(deploy, rss)
+            self.enqueue_after(key, 0.05)
+            return
         new_rs = self._new_rs(deploy, rss)
+        if new_rs is not None:
+            # a rollback re-activated an old template: its RS is "new"
+            # again and takes the next revision number (rollback.go
+            # updates the revision on rollback)
+            max_rev = max(
+                (int(r.metadata.annotations.get(REVISION_ANNOTATION, 0)
+                     or 0) for r in rss), default=0)
+            my_rev = int(new_rs.metadata.annotations.get(
+                REVISION_ANNOTATION, 0) or 0)
+            if my_rev < max_rev:
+                def bump(obj):
+                    obj.metadata.annotations[REVISION_ANNOTATION] = str(
+                        max_rev + 1)
+                    return obj
+
+                try:
+                    self.store.guaranteed_update(
+                        "ReplicaSet", new_rs.metadata.name, ns, bump)
+                except (NotFound, Conflict):
+                    pass
         old_rss = [rs for rs in rss if new_rs is None
                    or rs.metadata.uid != new_rs.metadata.uid]
         desired = deploy.replicas

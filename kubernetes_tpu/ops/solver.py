@@ -277,7 +277,10 @@ def _use_fused_static(policy: Policy, state, batch) -> bool:
     partitioning rule, so the kernel must never trace under a mesh."""
     import os
 
-    if os.environ.get("KTPU_PALLAS") != "1":
+    from kubernetes_tpu.utils.features import enabled
+
+    if os.environ.get("KTPU_PALLAS") != "1" \
+            and not enabled("PallasFusedScoring"):
         return False
     return (
         state.valid.shape[0] % 128 == 0    # lane width (tiles adapt above)

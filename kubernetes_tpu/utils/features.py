@@ -1,0 +1,89 @@
+"""Feature gates: the --feature-gates registry.
+
+Analog of pkg/features/kube_features.go + apimachinery util/feature: a
+process-wide registry of named boolean gates with defaults, settable from
+a `--feature-gates=A=true,B=false` flag or the KUBERNETES_TPU_FEATURE_GATES
+env var, queried at decision points. Unknown gates are an error at parse
+time (the reference fails fast on typos too).
+"""
+
+from __future__ import annotations
+
+import os
+
+# gate -> default (the registry; kube_features.go:139 registers defaults)
+_DEFAULTS: dict[str, bool] = {
+    # fused Pallas scoring kernel (opt-in; parity-pinned but single-chip)
+    "PallasFusedScoring": False,
+    # device-side assignment-ledger chaining across batches
+    "ChainedLedgers": True,
+    # batch-content gating (skip provably-neutral kernels per batch)
+    "BatchContentGating": True,
+    # equivalence-class packed-row encode cache
+    "EncodeCache": True,
+    # rate-limited node eviction in the node lifecycle controller
+    "RateLimitedEviction": True,
+}
+
+
+class FeatureGateError(ValueError):
+    pass
+
+
+class FeatureGate:
+    def __init__(self, defaults: dict[str, bool] | None = None):
+        self._defaults = dict(defaults if defaults is not None
+                              else _DEFAULTS)
+        self._overrides: dict[str, bool] = {}
+
+    def enabled(self, name: str) -> bool:
+        if name not in self._defaults:
+            raise FeatureGateError(f"unknown feature gate {name!r}")
+        return self._overrides.get(name, self._defaults[name])
+
+    def set_from_map(self, overrides: dict[str, bool]) -> None:
+        unknown = [k for k in overrides if k not in self._defaults]
+        if unknown:
+            raise FeatureGateError(
+                f"unknown feature gate(s): {sorted(unknown)}; "
+                f"known: {sorted(self._defaults)}")
+        self._overrides.update(overrides)
+
+    def set_from_string(self, spec: str) -> None:
+        """Parse 'A=true,B=false' (the --feature-gates flag grammar)."""
+        overrides: dict[str, bool] = {}
+        for part in spec.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            name, eq, value = part.partition("=")
+            if not eq or value.lower() not in ("true", "false"):
+                raise FeatureGateError(
+                    f"bad --feature-gates entry {part!r} "
+                    f"(want Name=true|false)")
+            overrides[name.strip()] = value.lower() == "true"
+        self.set_from_map(overrides)
+
+    def known(self) -> dict[str, bool]:
+        return {k: self.enabled(k) for k in sorted(self._defaults)}
+
+
+# the process-default gate (utilfeature.DefaultFeatureGate)
+DEFAULT_FEATURE_GATE = FeatureGate()
+_env = os.environ.get("KUBERNETES_TPU_FEATURE_GATES", "")
+if _env:
+    try:
+        DEFAULT_FEATURE_GATE.set_from_string(_env)
+    except FeatureGateError as e:
+        # the module imports lazily from hot paths — a typo'd env var must
+        # not crash the first scheduling batch; warn loudly and run with
+        # defaults (binaries that pass --feature-gates still fail fast in
+        # their flag parsing)
+        import logging
+
+        logging.getLogger(__name__).error(
+            "ignoring KUBERNETES_TPU_FEATURE_GATES: %s", e)
+
+
+def enabled(name: str) -> bool:
+    return DEFAULT_FEATURE_GATE.enabled(name)

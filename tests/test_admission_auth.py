@@ -158,3 +158,62 @@ def test_unauthenticated_authorizer_matrix():
     assert authz.authorize(dev, "create", "pods", "team-a")
     assert not authz.authorize(dev, "create", "pods", "default")
     assert not authz.authorize(dev, "create", "nodes", "team-a")
+
+
+def test_aggregated_paths_stay_inside_authorization():
+    """An APIService-proxied group must NOT bypass ABAC just because the
+    core registry can't resolve its plural (authz runs on the raw request
+    shape, then routing/aggregation resolves)."""
+    import asyncio
+    import threading
+
+    from kubernetes_tpu.api.objects import APIService
+    from kubernetes_tpu.apiserver.http import APIServer, RemoteStore
+
+    authn = TokenAuthenticator.from_csv(
+        "devtoken,dev,1,\"devs\"\n")
+    # dev may only touch pods in team-a — nothing grants 'widgets'
+    authz = ABACAuthorizer.from_policy_file(
+        '{"user": "dev", "resource": "pods", "namespace": "team-a"}\n')
+    store = ObjectStore()
+    store.create(APIService.from_dict({
+        "metadata": {"name": "v1.metrics.example.com"},
+        "spec": {"group": "metrics.example.com", "version": "v1",
+                 "serverAddress": "http://127.0.0.1:1"}}))
+    started = threading.Event()
+    holder = {}
+
+    def run():
+        async def main():
+            server = APIServer(store, authenticator=authn,
+                               authorizer=authz)
+            await server.start()
+            holder["server"] = server
+            holder["loop"] = asyncio.get_running_loop()
+            holder["stop"] = asyncio.Event()
+            started.set()
+            await holder["stop"].wait()
+            await server.stop()
+
+        asyncio.run(main())
+
+    thread = threading.Thread(target=run, daemon=True)
+    thread.start()
+    assert started.wait(10)
+    server = holder["server"]
+    try:
+        dev = RemoteStore(server.host, server.port, token="devtoken")
+        # 403 BEFORE any proxying is attempted (the backend is a dead
+        # port — a bypass would surface as 503, not 403)
+        with pytest.raises(PermissionError, match="cannot list"):
+            dev._request(
+                "GET", "/apis/metrics.example.com/v1/namespaces/team-a/"
+                       "widgets")
+        with pytest.raises(PermissionError, match="cannot create"):
+            dev._request(
+                "POST", "/apis/metrics.example.com/v1/namespaces/team-a/"
+                        "widgets", {"kind": "Widget",
+                                    "metadata": {"name": "w"}})
+    finally:
+        holder["loop"].call_soon_threadsafe(holder["stop"].set)
+        thread.join(timeout=10)

@@ -603,3 +603,61 @@ def test_cronjob_forbid_slot_fires_after_completion():
         assert len(store.list("Job", namespace="default")) == 2
 
     asyncio.run(run())
+
+
+def test_deployment_rollback_to_previous_revision():
+    """spec.rollbackTo rolls the template back to the prior revision's
+    RS template; revisions are tracked via the conventional annotation
+    (pkg/controller/deployment/rollback.go)."""
+    async def run():
+        from kubernetes_tpu.api.objects import Deployment
+        from kubernetes_tpu.controllers.deployment import (
+            REVISION_ANNOTATION,
+        )
+
+        store = ObjectStore()
+        await start_mgr(store)
+        store.create(Deployment.from_dict({
+            "metadata": {"name": "web", "namespace": "default"},
+            "spec": {"replicas": 2,
+                     "strategy": {"type": "Recreate"},
+                     "selector": {"matchLabels": {"app": "web"}},
+                     "template": {
+                         "metadata": {"labels": {"app": "web"}},
+                         "spec": {"containers": [
+                             {"name": "c", "image": "web:v1"}]}}}}))
+
+        def image_of_new_rs():
+            for rs in store.list("ReplicaSet"):
+                if rs.replicas > 0:
+                    return (rs.spec["template"]["spec"]["containers"][0]
+                            ["image"])
+            return None
+
+        await until(lambda: image_of_new_rs() == "web:v1")
+        # rollout v2
+        d = store.get("Deployment", "web")
+        d.spec["template"]["spec"]["containers"][0]["image"] = "web:v2"
+        store.update(d, check_version=False)
+        await until(lambda: image_of_new_rs() == "web:v2")
+        await until(lambda: len(store.list("ReplicaSet")) == 2)
+        revs = {rs.spec["template"]["spec"]["containers"][0]["image"]:
+                int(rs.metadata.annotations.get(REVISION_ANNOTATION, 0))
+                for rs in store.list("ReplicaSet")}
+        assert revs["web:v2"] > revs["web:v1"]
+        # undo -> v1 active again, no third RS (template hash matches v1)
+        d = store.get("Deployment", "web")
+        d.spec["rollbackTo"] = {}
+        store.update(d, check_version=False)
+        await until(lambda: image_of_new_rs() == "web:v1")
+        assert "rollbackTo" not in store.get("Deployment", "web").spec
+        assert len(store.list("ReplicaSet")) == 2
+        # the re-activated RS took the next revision number
+        v1_rev = next(
+            int(rs.metadata.annotations.get(REVISION_ANNOTATION, 0))
+            for rs in store.list("ReplicaSet")
+            if rs.spec["template"]["spec"]["containers"][0]["image"]
+            == "web:v1")
+        assert v1_rev > revs["web:v2"]
+
+    asyncio.run(run())

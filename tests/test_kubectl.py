@@ -124,3 +124,83 @@ def test_cordon_drain_uncordon():
         rc, _ = run_cli(client, "uncordon", "n0")
         assert rc == 0
         assert client.get("Node", "n0").spec.unschedulable is False
+
+
+def test_rollout_history_and_undo():
+    """rollout status/history/undo against a live server with the
+    controller manager reconciling (cmd/rollout + rollback.go chain)."""
+    import asyncio
+    import threading
+    import time
+
+    from kubernetes_tpu.api.objects import Deployment
+    from kubernetes_tpu.apiserver import ObjectStore
+    from kubernetes_tpu.apiserver.http import APIServer, RemoteStore
+    from kubernetes_tpu.controllers import ControllerManager
+
+    store = ObjectStore()
+    started = threading.Event()
+    holder = {}
+
+    def run():
+        async def main():
+            mgr = ControllerManager(store, enable_node_lifecycle=False)
+            await mgr.start()
+            server = APIServer(store)
+            await server.start()
+            holder["server"] = server
+            holder["loop"] = asyncio.get_running_loop()
+            holder["stop"] = asyncio.Event()
+            started.set()
+            await holder["stop"].wait()
+            mgr.stop()
+            await server.stop()
+
+        asyncio.run(main())
+
+    thread = threading.Thread(target=run, daemon=True)
+    thread.start()
+    assert started.wait(10)
+    server = holder["server"]
+    client = RemoteStore(server.host, server.port)
+    try:
+        client.create(Deployment.from_dict({
+            "metadata": {"name": "web", "namespace": "default"},
+            "spec": {"replicas": 1,
+                     "strategy": {"type": "Recreate"},
+                     "selector": {"matchLabels": {"app": "web"}},
+                     "template": {
+                         "metadata": {"labels": {"app": "web"}},
+                         "spec": {"containers": [
+                             {"name": "c", "image": "web:v1"}]}}}}))
+
+        def active_image():
+            for rs in client.list("ReplicaSet"):
+                if rs.replicas > 0:
+                    return (rs.spec["template"]["spec"]["containers"][0]
+                            ["image"])
+            return None
+
+        deadline = time.monotonic() + 10
+        while active_image() != "web:v1" and time.monotonic() < deadline:
+            time.sleep(0.05)
+        d = client.get("Deployment", "web")
+        d.spec["template"]["spec"]["containers"][0]["image"] = "web:v2"
+        client.update(d, check_version=False)
+        while active_image() != "web:v2" and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert active_image() == "web:v2"
+
+        rc, out = run_cli(client, "rollout", "history", "deployment",
+                          "web")
+        assert rc == 0 and "REVISION" in out
+        assert len(out.strip().splitlines()) == 3  # header + 2 revisions
+        rc, out = run_cli(client, "rollout", "undo", "deployment", "web")
+        assert rc == 0
+        deadline = time.monotonic() + 10
+        while active_image() != "web:v1" and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert active_image() == "web:v1"
+    finally:
+        holder["loop"].call_soon_threadsafe(holder["stop"].set)
+        thread.join(timeout=10)
