@@ -181,7 +181,10 @@ class Scheduler:
         # in-flight batches, oldest first; depth >1 hides the per-batch
         # dispatch/readback round trip (dominant on remote-device
         # transports: ~120ms RTT vs ~10ms of device compute per batch)
-        self.pipeline_depth = 3
+        import os
+
+        self.pipeline_depth = int(
+            os.environ.get("KTPU_PIPELINE_DEPTH", "3") or 3)
         self._inflight_q: deque = deque()
 
     def _get_schedule_fn(self, flags):
@@ -325,7 +328,7 @@ class Scheduler:
         keys = await self.queue.get_batch(self.caps.batch_pods,
                                           wait=effective_wait)
         if not keys:
-            return self._settle_inflight()
+            return await self._asettle_inflight()
 
         fblob, iblob = self._next_blobs()
         pods: list[Pod] = []
@@ -349,7 +352,7 @@ class Scheduler:
             pods.append(pod)
             live_keys.append(key)
         if not pods:
-            return self._settle_inflight()
+            return await self._asettle_inflight()
         if self.statedb.table.pod_row_epoch != epoch_before:
             # a later pod in this batch interned new podsel/avoid entries:
             # earlier pods' match/carry rows (encoded, possibly cached,
@@ -375,7 +378,7 @@ class Scheduler:
                                  or self.statedb.ledger_dirty):
             # a dirty flush would re-upload host truth that misses the
             # in-flight batches' charges: settle them first
-            settled += self._settle_inflight()
+            settled += await self._asettle_inflight()
         state = self.statedb.flush()
         timer.step("encode + flush")
 
@@ -400,20 +403,44 @@ class Scheduler:
             self._inflight_q.append((result, pods, live_keys, (fblob, iblob),
                                      flags, t0, timer, True))
             while len(self._inflight_q) > self.pipeline_depth:
-                settled += self._settle_one()
+                settled += await self._asettle_one()
             return settled
         self._inflight_q.append((result, pods, live_keys, (fblob, iblob),
                                  flags, t0, timer, False))
-        return settled + self._settle_inflight()
+        return settled + await self._asettle_inflight()
 
     def _settle_inflight(self) -> int:
-        """Settle every in-flight batch, oldest first."""
+        """Settle every in-flight batch, oldest first (synchronous —
+        the stop() path)."""
         settled = 0
         while self._inflight_q:
             settled += self._settle_one()
         return settled
 
-    def _settle_one(self) -> int:
+    async def _asettle_inflight(self) -> int:
+        settled = 0
+        while self._inflight_q:
+            settled += await self._asettle_one()
+        return settled
+
+    async def _asettle_one(self) -> int:
+        """Async settle: the device->host readback blocks in a worker
+        thread, so the event loop keeps running informers / encoding the
+        next batch during the transport round trip (~120 ms on the remote
+        tunnel) instead of stalling the whole driver on np.asarray."""
+        if not self._inflight_q:
+            return 0
+        entry = self._inflight_q[0]
+        t0 = time.monotonic()
+        assignments = await asyncio.to_thread(np.asarray,
+                                              entry[0].assignments)
+        waited = time.monotonic() - t0
+        if not self._inflight_q or self._inflight_q[0] is not entry:
+            return 0  # settled by stop() while we waited
+        return self._settle_one(assignments, waited=waited)
+
+    def _settle_one(self, assignments: np.ndarray | None = None,
+                    waited: float | None = None) -> int:
         """Read back the oldest in-flight solve, bind its assignments, and
         commit the ledger (the synchronous tail of schedule_pending)."""
         if not self._inflight_q:
@@ -421,12 +448,19 @@ class Scheduler:
         (result, pods, live_keys, blobs, flags, t0, timer,
          adopted) = self._inflight_q.popleft()
         t_wait = time.monotonic()
-        assignments = np.asarray(result.assignments)
+        if assignments is None:
+            assignments = np.asarray(result.assignments)
         # synchronous batches observe the true dispatch-to-ready span; for a
-        # pipelined batch only the residual blocking wait is observable (the
-        # full span would count the successor's host work as algorithm time)
-        self.metrics.algorithm_latency.append(
-            time.monotonic() - (t_wait if adopted else t0))
+        # pipelined batch only the readback wait is observable (the full
+        # span would count the successor's host work as algorithm time) —
+        # when the readback ran in _asettle_one's thread, `waited` carries
+        # that span here
+        if adopted:
+            residual = waited if waited is not None \
+                else time.monotonic() - t_wait
+        else:
+            residual = time.monotonic() - t0
+        self.metrics.algorithm_latency.append(residual)
         timer.step("device solve")
 
         fblob, iblob = blobs

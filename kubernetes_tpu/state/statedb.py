@@ -325,17 +325,34 @@ class StateDB:
         vol_any = want_rw + colv("vol_want_ro")
         att = colv("att_onehot")
 
+        # one sort + segmented reduction over the WHOLE packed blob, then
+        # per-group slices += at the unique rows — np.add.at is 10-50×
+        # slower than reduceat on wide duplicate-heavy scatters, and this
+        # is the hot half of the commit path (profile: 0.28 s/batch at 16k
+        # nodes before, dominated by ufunc.at dispatch)
+        order = np.argsort(rows, kind="stable")
+        rows_sorted = rows[order]
+        boundaries = np.flatnonzero(
+            np.diff(rows_sorted, prepend=rows_sorted[0] - 1))
+        uniq = rows_sorted[boundaries]
+        sums = np.add.reduceat(gathered[order], boundaries, axis=0)
+
+        def colsum(ref):
+            _blob, off, width, _trailing, _dtype = layout[ref]
+            return sums[:, off:off + width]
+
         host = self.host
-        np.add.at(host.requested, rows, req)
-        np.add.at(host.nonzero_requested, rows, nz)
-        np.add.at(host.port_count, rows, ports)
-        np.add.at(host.podsel_count, rows, match)
-        np.add.at(host.term_count, rows, carry)
+        host.requested[uniq] += colsum("requests")
+        host.nonzero_requested[uniq] += colsum("nonzero_requests")
+        host.port_count[uniq] += colsum("port_onehot")
+        host.podsel_count[uniq] += colsum("pod_matches_q")
+        host.term_count[uniq] += colsum("pod_carries_e")
         if vol_any.any():
-            np.add.at(host.vol_any, rows, vol_any)
-            np.add.at(host.vol_rw, rows, want_rw)
+            rw_sum = colsum("vol_want_rw")
+            host.vol_any[uniq] += rw_sum + colsum("vol_want_ro")
+            host.vol_rw[uniq] += rw_sum
         if att.any():
-            np.add.at(host.attach_count, rows, att)
+            host.attach_count[uniq] += colsum("att_onehot")
         gen0 = self.table._gen_counter
         self.table.generation[rows] = np.arange(
             gen0 + 1, gen0 + 1 + len(rows))
