@@ -31,10 +31,42 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--leader-elect", action="store_true")
     p.add_argument("--lock-object-name", default="kube-controller-manager")
     p.add_argument("--lock-object-namespace", default="kube-system")
+    p.add_argument("--node-monitor-period", type=float, default=5.0)
     p.add_argument("--node-monitor-grace-period", type=float, default=40.0)
     p.add_argument("--pod-eviction-timeout", type=float, default=300.0)
     p.add_argument("--node-eviction-rate", type=float, default=0.1)
-    return p.parse_args(argv)
+    p.add_argument("--terminated-pod-gc-threshold", type=int,
+                   default=12500)
+    # leader-election timing (reference --leader-elect-lease-duration etc.)
+    p.add_argument("--lease-duration", type=float, default=15.0)
+    p.add_argument("--renew-deadline", type=float, default=10.0)
+    p.add_argument("--retry-period", type=float, default=2.0)
+    p.add_argument("--config", default="",
+                   help="KubeControllerManagerConfiguration JSON "
+                        "(componentconfig; explicit flags take precedence)")
+    args = p.parse_args(argv)
+    if args.config:
+        from kubernetes_tpu.models.componentconfig import (
+            KubeControllerManagerConfiguration,
+            apply_config_to_args,
+            explicit_dests,
+        )
+
+        cfg = KubeControllerManagerConfiguration.from_file(args.config)
+        apply_config_to_args(cfg, args, explicit_dests(p, argv), {
+            "leaderElect": "leader_elect",
+            "lockObjectName": "lock_object_name",
+            "lockObjectNamespace": "lock_object_namespace",
+            "nodeMonitorPeriod": "node_monitor_period",
+            "nodeMonitorGracePeriod": "node_monitor_grace_period",
+            "podEvictionTimeout": "pod_eviction_timeout",
+            "terminatedPodGCThreshold": "terminated_pod_gc_threshold",
+        })
+        if cfg.featureGates:
+            from kubernetes_tpu.utils.features import DEFAULT_FEATURE_GATE
+
+            DEFAULT_FEATURE_GATE.set_from_map(cfg.featureGates)
+    return args
 
 
 async def run(args: argparse.Namespace) -> None:
@@ -43,10 +75,14 @@ async def run(args: argparse.Namespace) -> None:
 
     url = urlsplit(args.apiserver)
     store = RemoteStore(url.hostname, url.port or 80, token=args.token)
-    mgr = ControllerManager(store, node_lifecycle_kwargs=dict(
-        grace_period=args.node_monitor_grace_period,
-        eviction_timeout=args.pod_eviction_timeout,
-        eviction_rate=args.node_eviction_rate))
+    mgr = ControllerManager(
+        store,
+        node_lifecycle_kwargs=dict(
+            monitor_period=args.node_monitor_period,
+            grace_period=args.node_monitor_grace_period,
+            eviction_timeout=args.pod_eviction_timeout,
+            eviction_rate=args.node_eviction_rate),
+        podgc_threshold=args.terminated_pod_gc_threshold)
 
     async def lead():
         await mgr.start()
@@ -61,6 +97,9 @@ async def run(args: argparse.Namespace) -> None:
                 store, f"{socket.gethostname()}_{os.getpid()}",
                 lock_name=args.lock_object_name,
                 lock_namespace=args.lock_object_namespace,
+                lease_duration=args.lease_duration,
+                renew_deadline=args.renew_deadline,
+                retry_period=args.retry_period,
                 on_started_leading=lead)
             await elector.run()
             log.warning("lost leader lease; exiting")

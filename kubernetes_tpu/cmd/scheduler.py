@@ -67,7 +67,35 @@ def parse_args(argv=None) -> argparse.Namespace:
                    help="node-axis capacity (padded)")
     p.add_argument("--batch-pods", type=int, default=256,
                    help="pending pods per solver batch")
-    return p.parse_args(argv)
+    p.add_argument("--config", default="",
+                   help="KubeSchedulerConfiguration JSON (componentconfig;"
+                        " explicit flags take precedence)")
+    args = p.parse_args(argv)
+    if args.config:
+        from kubernetes_tpu.models.componentconfig import (
+            KubeSchedulerConfiguration,
+            apply_config_to_args,
+            explicit_dests,
+        )
+
+        cfg = KubeSchedulerConfiguration.from_file(args.config)
+        apply_config_to_args(cfg, args, explicit_dests(p, argv), {
+            "schedulerName": "scheduler_name",
+            "policyConfigFile": "policy_config_file",
+            "leaderElect": "leader_elect",
+            "lockObjectName": "lock_object_name",
+            "lockObjectNamespace": "lock_object_namespace",
+            "port": "port",
+            "numNodes": "num_nodes",
+            "batchPods": "batch_pods",
+        })
+        if cfg.featureGates:
+            # config gates apply first; a --feature-gates flag re-applies
+            # per-key in main(), so flags override config per gate
+            from kubernetes_tpu.utils.features import DEFAULT_FEATURE_GATE
+
+            DEFAULT_FEATURE_GATE.set_from_map(cfg.featureGates)
+    return args
 
 
 def load_policy(path: str) -> Policy:

@@ -22,7 +22,8 @@ class ControllerManager:
     def __init__(self, store: ObjectStore, enable_gc: bool = True,
                  enable_node_lifecycle: bool = True,
                  node_lifecycle_kwargs: dict | None = None,
-                 cloud=None, hpa_metrics=None):
+                 cloud=None, hpa_metrics=None,
+                 podgc_threshold: int | None = None):
         self.store = store
         self.informers: dict[str, Informer] = {
             kind: Informer(store, kind)
@@ -51,7 +52,9 @@ class ControllerManager:
 
         self.namespace = NamespaceController(store,
                                              self.informers["Namespace"])
-        self.podgc = PodGCController(store, pods)
+        self.podgc = PodGCController(
+            store, pods, **({} if podgc_threshold is None
+                            else {"threshold": podgc_threshold}))
         from kubernetes_tpu.controllers.cronjob import CronJobController
         from kubernetes_tpu.controllers.daemonset import DaemonSetController
         from kubernetes_tpu.controllers.disruption import DisruptionController

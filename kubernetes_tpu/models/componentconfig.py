@@ -1,0 +1,133 @@
+"""componentconfig: versioned per-binary configuration objects.
+
+Analog of pkg/apis/componentconfig (reference types.go:562-600 for
+KubeSchedulerConfiguration): each binary's knobs are an API-shaped object
+— kind/apiVersion + defaulted fields — loadable from a JSON file via
+`--config`, with explicit command-line flags taking precedence (the
+reference's flag/config layering, SURVEY.md §5.6a-b). Unknown fields are
+an error: a typo'd knob must not silently run with defaults.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field, fields
+
+
+class ConfigError(ValueError):
+    pass
+
+
+# field annotations are strings under `from __future__ import annotations`
+_TYPE_OK = {"int": (int,), "float": (int, float), "bool": (bool,),
+            "str": (str,)}
+
+
+def _load(cls, kind: str, path: str):
+    with open(path, encoding="utf-8") as f:
+        try:
+            data = json.load(f)
+        except ValueError as e:
+            raise ConfigError(f"{path}: not JSON: {e}") from None
+    if not isinstance(data, dict):
+        raise ConfigError(f"{path}: top level must be an object, "
+                          f"got {type(data).__name__}")
+    got_kind = data.pop("kind", kind)
+    if got_kind != kind:
+        raise ConfigError(f"{path}: kind {got_kind!r}, want {kind!r}")
+    data.pop("apiVersion", None)
+    by_name = {f.name: f for f in fields(cls)}
+    unknown = sorted(set(data) - set(by_name))
+    if unknown:
+        raise ConfigError(f"{path}: unknown field(s) {unknown}; "
+                          f"known: {sorted(by_name)}")
+    for name, value in data.items():
+        declared = str(by_name[name].type)
+        want = _TYPE_OK.get(declared)
+        if want is not None:
+            # bool is an int subclass: reject bools for numeric knobs
+            if not isinstance(value, want) or (
+                    declared != "bool" and isinstance(value, bool)):
+                raise ConfigError(
+                    f"{path}: field {name!r} wants {declared}, got "
+                    f"{type(value).__name__} ({value!r})")
+        elif declared.startswith("dict") and not isinstance(value, dict):
+            raise ConfigError(f"{path}: field {name!r} wants an object, "
+                              f"got {type(value).__name__}")
+    return cls(**data)
+
+
+@dataclass
+class KubeSchedulerConfiguration:
+    """componentconfig/v1alpha1 KubeSchedulerConfiguration subset
+    (reference types.go:562-600: SchedulerName, AlgorithmSource->policy
+    file, LeaderElection, HealthzBindAddress ports)."""
+
+    schedulerName: str = "default-scheduler"
+    policyConfigFile: str = ""
+    leaderElect: bool = False
+    lockObjectName: str = "kube-scheduler"
+    lockObjectNamespace: str = "kube-system"
+    port: int = 10251
+    numNodes: int = 1024
+    batchPods: int = 256
+    featureGates: dict[str, bool] = field(default_factory=dict)
+
+    kind = "KubeSchedulerConfiguration"
+    api_version = "componentconfig/v1alpha1"
+
+    @classmethod
+    def from_file(cls, path: str) -> "KubeSchedulerConfiguration":
+        return _load(cls, cls.kind, path)
+
+
+@dataclass
+class KubeControllerManagerConfiguration:
+    """componentconfig KubeControllerManagerConfiguration subset
+    (reference types.go KubeControllerManagerConfiguration: controllers
+    toggle list, leader election, node-monitor knobs)."""
+
+    leaderElect: bool = False
+    lockObjectName: str = "kube-controller-manager"
+    lockObjectNamespace: str = "kube-system"
+    nodeMonitorPeriod: float = 5.0
+    nodeMonitorGracePeriod: float = 40.0
+    podEvictionTimeout: float = 300.0
+    terminatedPodGCThreshold: int = 12500
+    featureGates: dict[str, bool] = field(default_factory=dict)
+
+    kind = "KubeControllerManagerConfiguration"
+    api_version = "componentconfig/v1alpha1"
+
+    @classmethod
+    def from_file(cls, path: str) -> "KubeControllerManagerConfiguration":
+        return _load(cls, cls.kind, path)
+
+
+def explicit_dests(parser, argv) -> set[str]:
+    """The dests the user actually typed on the command line. Parsing a
+    second time with every default suppressed leaves only provided flags
+    in the namespace — value-equality against defaults would wrongly let
+    the config override an explicit flag that happens to equal the
+    default (`--port 10251 --config …` must keep 10251)."""
+    import argparse
+
+    saved = [(a, a.default) for a in parser._actions]
+    try:
+        for a in parser._actions:
+            a.default = argparse.SUPPRESS
+        ns, _ = parser.parse_known_args(argv)
+        return set(vars(ns))
+    finally:
+        for a, d in saved:
+            a.default = d
+
+
+def apply_config_to_args(config, args, explicit: set[str],
+                         mapping: dict[str, str]) -> None:
+    """Layering: a config-file value applies only where the flag was NOT
+    explicitly provided — explicit flags win (the reference applies flags
+    after config deserialization)."""
+    for cfg_field, arg_name in mapping.items():
+        if arg_name not in explicit:
+            setattr(args, arg_name, getattr(config, cfg_field))

@@ -1,0 +1,106 @@
+"""componentconfig: versioned per-binary config files layered under
+explicit flags (pkg/apis/componentconfig analog, SURVEY §5.6a-b)."""
+
+import json
+
+import pytest
+
+from kubernetes_tpu.models.componentconfig import (
+    ConfigError,
+    KubeControllerManagerConfiguration,
+    KubeSchedulerConfiguration,
+)
+
+
+def test_scheduler_config_load_and_flag_precedence(tmp_path):
+    cfg_file = tmp_path / "sched.json"
+    cfg_file.write_text(json.dumps({
+        "kind": "KubeSchedulerConfiguration",
+        "apiVersion": "componentconfig/v1alpha1",
+        "schedulerName": "tpu-sched",
+        "leaderElect": True,
+        "numNodes": 4096,
+        "batchPods": 512}))
+    from kubernetes_tpu.cmd.scheduler import parse_args
+
+    # config values apply where flags are defaulted...
+    args = parse_args(["--config", str(cfg_file)])
+    assert args.scheduler_name == "tpu-sched"
+    assert args.leader_elect is True
+    assert args.num_nodes == 4096 and args.batch_pods == 512
+    # ...but explicit flags win
+    args = parse_args(["--config", str(cfg_file), "--num-nodes", "128"])
+    assert args.num_nodes == 128
+    assert args.batch_pods == 512
+
+
+def test_config_rejects_typos_and_wrong_kind(tmp_path):
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps({
+        "kind": "KubeSchedulerConfiguration",
+        "schedulrName": "oops"}))
+    with pytest.raises(ConfigError, match="unknown field"):
+        KubeSchedulerConfiguration.from_file(str(bad))
+    wrong = tmp_path / "wrong.json"
+    wrong.write_text(json.dumps({"kind": "Pod"}))
+    with pytest.raises(ConfigError, match="kind"):
+        KubeSchedulerConfiguration.from_file(str(wrong))
+
+
+def test_controller_manager_config(tmp_path):
+    cfg_file = tmp_path / "cm.json"
+    cfg_file.write_text(json.dumps({
+        "kind": "KubeControllerManagerConfiguration",
+        "nodeMonitorGracePeriod": 10.0,
+        "podEvictionTimeout": 30.0}))
+    cfg = KubeControllerManagerConfiguration.from_file(str(cfg_file))
+    assert cfg.nodeMonitorGracePeriod == 10.0
+    from kubernetes_tpu.cmd.controller_manager import parse_args
+
+    args = parse_args(["--apiserver", "http://127.0.0.1:1",
+                       "--config", str(cfg_file)])
+    assert args.node_monitor_grace_period == 10.0
+    assert args.pod_eviction_timeout == 30.0
+
+
+def test_explicit_flag_equal_to_default_still_wins(tmp_path):
+    cfg_file = tmp_path / "sched.json"
+    cfg_file.write_text(json.dumps({
+        "kind": "KubeSchedulerConfiguration", "port": 9999}))
+    from kubernetes_tpu.cmd.scheduler import parse_args
+
+    # --port 10251 is the parser default VALUE but explicitly typed: the
+    # config must not override it
+    args = parse_args(["--config", str(cfg_file), "--port", "10251"])
+    assert args.port == 10251
+    args = parse_args(["--config", str(cfg_file)])
+    assert args.port == 9999
+
+
+def test_config_type_errors(tmp_path):
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps({
+        "kind": "KubeSchedulerConfiguration", "port": "10251"}))
+    with pytest.raises(ConfigError, match="port"):
+        KubeSchedulerConfiguration.from_file(str(bad))
+    bad.write_text(json.dumps({
+        "kind": "KubeSchedulerConfiguration", "leaderElect": "false"}))
+    with pytest.raises(ConfigError, match="leaderElect"):
+        KubeSchedulerConfiguration.from_file(str(bad))
+    bad.write_text(json.dumps(["not", "an", "object"]))
+    with pytest.raises(ConfigError, match="object"):
+        KubeSchedulerConfiguration.from_file(str(bad))
+
+
+def test_controller_manager_wires_all_config_knobs(tmp_path):
+    cfg_file = tmp_path / "cm.json"
+    cfg_file.write_text(json.dumps({
+        "kind": "KubeControllerManagerConfiguration",
+        "nodeMonitorPeriod": 1.0,
+        "terminatedPodGCThreshold": 100}))
+    from kubernetes_tpu.cmd.controller_manager import parse_args
+
+    args = parse_args(["--apiserver", "http://127.0.0.1:1",
+                       "--config", str(cfg_file)])
+    assert args.node_monitor_period == 1.0
+    assert args.terminated_pod_gc_threshold == 100

@@ -1,0 +1,143 @@
+"""Multi-binary integration drill (SURVEY §4.4/§5.3): the scheduler binary
+(in-process apiserver mode) + TWO leader-elected controller-manager
+binaries as real subprocesses over TCP. A Deployment reconciles through
+whichever manager leads and schedules through the scheduler; killing the
+leader hands reconciliation to the standby within the lease window."""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+import urllib.request
+
+from kubernetes_tpu.api.objects import Deployment, Node
+from kubernetes_tpu.apiserver.http import RemoteStore
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def spawn(args):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    return subprocess.Popen(
+        [sys.executable, "-m", *args], env=env, cwd=REPO,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+
+
+def leader_identity(client):
+    try:
+        ep = client.get("Endpoints", "kube-controller-manager",
+                        "kube-system")
+    except Exception:  # noqa: BLE001 — not created yet
+        return None
+    record = ep.metadata.annotations.get(
+        "control-plane.alpha.kubernetes.io/leader", "")
+    if not record:
+        return None
+    return json.loads(record).get("holderIdentity") or None
+
+
+def test_leader_failover_across_controller_manager_binaries():
+    api_port, health_port = free_port(), free_port()
+    sched = spawn(["kubernetes_tpu.cmd.scheduler",
+                   "--apiserver-port", str(api_port),
+                   "--port", str(health_port),
+                   "--num-nodes", "64", "--batch-pods", "16"])
+    managers = []
+    try:
+        client = RemoteStore("127.0.0.1", api_port)
+        deadline = time.time() + 60
+        while True:
+            try:
+                client.list("Node")
+                break
+            except OSError:
+                if time.time() > deadline:
+                    raise TimeoutError("apiserver never came up")
+                time.sleep(0.2)
+
+        for _ in range(2):
+            managers.append(spawn([
+                "kubernetes_tpu.cmd.controller_manager",
+                "--apiserver", f"http://127.0.0.1:{api_port}",
+                "--leader-elect",
+                "--lease-duration", "1.0",
+                "--renew-deadline", "0.7",
+                "--retry-period", "0.2"]))
+
+        client.create(Node.from_dict({
+            "metadata": {"name": "n0"},
+            "status": {"allocatable": {"cpu": "16", "memory": "32Gi",
+                                       "pods": "110"},
+                       "conditions": [{"type": "Ready",
+                                       "status": "True"}]}}))
+        client.create(Deployment.from_dict({
+            "metadata": {"name": "web", "namespace": "default"},
+            "spec": {"replicas": 2,
+                     "strategy": {"type": "Recreate"},
+                     "selector": {"matchLabels": {"app": "web"}},
+                     "template": {
+                         "metadata": {"labels": {"app": "web"}},
+                         "spec": {"containers": [{
+                             "name": "c", "resources": {"requests": {
+                                 "cpu": "100m"}}}]}}}}))
+
+        def bound_pods():
+            return [p for p in client.list("Pod")
+                    if p.metadata.labels.get("app") == "web"
+                    and p.spec.node_name == "n0"]
+
+        deadline = time.time() + 120  # CPU jit compile included
+        while len(bound_pods()) < 2:
+            if time.time() > deadline:
+                raise TimeoutError(
+                    f"deployment never reconciled+scheduled: "
+                    f"{len(bound_pods())}")
+            time.sleep(0.3)
+
+        # exactly one manager leads
+        deadline = time.time() + 30
+        leader = None
+        while leader is None:
+            leader = leader_identity(client)
+            if time.time() > deadline:
+                raise TimeoutError("no leader elected")
+            time.sleep(0.2)
+
+        # kill the LEADING manager process (identity is host_pid)
+        leader_pid = int(leader.rsplit("_", 1)[-1])
+        victim = next(m for m in managers if m.pid == leader_pid)
+        victim.kill()
+        victim.wait(timeout=10)
+
+        # the standby takes over and keeps reconciling: scale up
+        def scale(obj):
+            obj.spec["replicas"] = 4
+            return obj
+
+        client.guaranteed_update("Deployment", "web", "default", scale)
+        deadline = time.time() + 60
+        while len(bound_pods()) < 4:
+            if time.time() > deadline:
+                raise TimeoutError(
+                    f"standby never took over: {len(bound_pods())} pods, "
+                    f"leader={leader_identity(client)}")
+            time.sleep(0.3)
+        new_leader = leader_identity(client)
+        assert new_leader and new_leader != leader
+    finally:
+        for proc in managers + [sched]:
+            proc.terminate()
+        for proc in managers + [sched]:
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
