@@ -36,6 +36,9 @@ class ObjectMeta:
     owner_references: list[dict[str, Any]] = field(default_factory=list)
     creation_timestamp: float = 0.0
     deletion_timestamp: float | None = None
+    # deletion blocks until every finalizer is removed (registry
+    # finalization, registry/generic/registry/store.go deletion flow)
+    finalizers: list[str] = field(default_factory=list)
 
     def clone(self) -> "ObjectMeta":
         return ObjectMeta(
@@ -45,6 +48,7 @@ class ObjectMeta:
             owner_references=[dict(r) for r in self.owner_references],
             creation_timestamp=self.creation_timestamp,
             deletion_timestamp=self.deletion_timestamp,
+            finalizers=list(self.finalizers),
         )
 
     @classmethod
@@ -60,6 +64,7 @@ class ObjectMeta:
             owner_references=list(d.get("ownerReferences") or []),
             creation_timestamp=_cond_time(d.get("creationTimestamp")),
             deletion_timestamp=None if dts is None else _cond_time(dts),
+            finalizers=list(d.get("finalizers") or []),
         )
 
     def to_dict(self) -> dict[str, Any]:
@@ -78,6 +83,8 @@ class ObjectMeta:
             out["creationTimestamp"] = _rfc3339(self.creation_timestamp)
         if self.deletion_timestamp is not None:
             out["deletionTimestamp"] = _rfc3339(self.deletion_timestamp)
+        if self.finalizers:
+            out["finalizers"] = list(self.finalizers)
         return out
 
 

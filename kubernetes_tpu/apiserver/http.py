@@ -188,38 +188,59 @@ class APIServer:
     (else 401) and, with an authorizer, must pass ABAC (else 403)."""
 
     def __init__(self, store: ObjectStore, host: str = "127.0.0.1",
-                 port: int = 0, authenticator=None, authorizer=None):
+                 port: int = 0, authenticator=None, authorizer=None,
+                 audit_path: str | None = None,
+                 max_in_flight: int = 400):
         self.store = store
         self.host = host
         self.port = port
         self.authenticator = authenticator
         self.authorizer = authorizer
         self._server: asyncio.AbstractServer | None = None
+        # WithAudit (config.go:474): one JSON line per request decision
+        self._audit = open(audit_path, "a", encoding="utf-8") \
+            if audit_path else None
+        # WithMaxInFlightLimit (config.go:471): surplus requests get 429
+        self._in_flight = 0
+        self.max_in_flight = max_in_flight
+
+    def _audit_log(self, user, method: str, path: str,
+                   status: int) -> None:
+        if self._audit is None:
+            return
+        import time as _time
+
+        self._audit.write(json.dumps({
+            "ts": _time.time(),
+            "user": getattr(user, "name", "") or "system:anonymous",
+            "verb": method, "requestURI": path,
+            "responseStatus": status}) + "\n")
+        self._audit.flush()
 
     def _authfilter(self, method: str, path: str,
-                    headers: dict[str, str]) -> tuple[int, dict] | None:
-        """-> (status, payload) to short-circuit, or None to proceed."""
+                    headers: dict[str, str]):
+        """-> ((status, payload) | None to proceed, authenticated user)."""
         if self.authenticator is None:
-            return None
+            return None, None
         user = self.authenticator.authenticate(headers)
         if user is None:
-            return 401, {"kind": "Status", "reason": "Unauthorized",
-                         "message": "invalid or missing bearer token"}
+            return (401, {"kind": "Status", "reason": "Unauthorized",
+                          "message": "invalid or missing bearer token"}), None
         if self.authorizer is None:
-            return None
+            return None, user
         try:
             ns, plural, name, _sub = _split_path(path)
         except NotFound:
-            return None  # no resource shape at all: routing 404s it
+            return None, user  # no resource shape at all: routing 404s it
         verb = {"GET": "get" if name else "list", "POST": "create",
                 "PUT": "update", "DELETE": "delete"}.get(method, method)
         # cluster-scoped (and cross-namespace) requests authorize against
         # namespace "" — only wildcard-namespace policies may grant them
         if self.authorizer.authorize(user, verb, plural, ns or ""):
-            return None
-        return 403, {"kind": "Status", "reason": "Forbidden",
-                     "message": f"user {user.name!r} cannot {verb} "
-                                f"{plural} in {ns or 'cluster scope'}"}
+            return None, user
+        return (403, {"kind": "Status", "reason": "Forbidden",
+                      "message": f"user {user.name!r} cannot {verb} "
+                                 f"{plural} in {ns or 'cluster scope'}"}), user
 
     @property
     def url(self) -> str:
@@ -235,6 +256,9 @@ class APIServer:
             self._server.close()
             await self._server.wait_closed()
             self._server = None
+        if self._audit is not None:
+            self._audit.close()
+            self._audit = None
 
     # ---- connection handling ----
 
@@ -253,11 +277,21 @@ class APIServer:
 
                 url = urlsplit(target)
                 query = {k: v[-1] for k, v in parse_qs(url.query).items()}
-                denied = self._authfilter(
+                denied, user = self._authfilter(
                     "GET" if query.get("watch") in ("1", "true") else method,
                     url.path, headers)
                 if denied is not None:
+                    self._audit_log(user, method, target, denied[0])
                     await _respond(writer, *denied)
+                    return
+                if self._in_flight >= self.max_in_flight:
+                    # WithMaxInFlightLimit: shed load instead of queueing
+                    # unboundedly (reference returns 429 + Retry-After)
+                    self._audit_log(user, method, target, 429)
+                    await _respond(writer, 429, {
+                        "kind": "Status", "reason": "TooManyRequests",
+                        "message": "too many requests, please try again "
+                                   "later"})
                     return
                 if query.get("watch") in ("1", "true"):
                     svc = self._api_service_for(url.path)
@@ -265,23 +299,31 @@ class APIServer:
                         # aggregated watch: relay the byte stream to the
                         # extension apiserver (chunked frames pass through)
                         addr = urlsplit(svc.spec["serverAddress"])
-                        await self._relay_raw(
+                        status = await self._relay_raw(
                             writer, addr.hostname, addr.port or 80,
                             method, target, body)
+                        self._audit_log(user, method, target, status)
                         return
+                    self._audit_log(user, method, target, 200)
                     await self._serve_watch(writer, url.path, query)
                     return  # watch owns the connection until it closes
                 node_proxy = self._node_proxy_target(url.path)
                 if node_proxy is not None:
-                    await self._proxy_to_node(writer, method, node_proxy,
-                                              url.query, body)
+                    status = await self._proxy_to_node(
+                        writer, method, node_proxy, url.query, body)
+                    self._audit_log(user, method, target, status)
                     return  # the relay owns the connection
-                proxied = await self._aggregate(method, target, body)
-                if proxied is not None:
-                    status, payload = proxied
-                else:
-                    status, payload = self._route(method, url.path, query,
-                                                  body)
+                self._in_flight += 1
+                try:
+                    proxied = await self._aggregate(method, target, body)
+                    if proxied is not None:
+                        status, payload = proxied
+                    else:
+                        status, payload = self._route(method, url.path,
+                                                      query, body)
+                finally:
+                    self._in_flight -= 1
+                self._audit_log(user, method, target, status)
                 keep = headers.get("connection", "keep-alive").lower() != "close"
                 await _respond(writer, status, payload, keep_alive=keep)
                 if not keep:
@@ -321,18 +363,19 @@ class APIServer:
             await _respond(writer, 404, {
                 "kind": "Status", "reason": "NotFound",
                 "message": "node has no kubelet endpoint"})
-            return
+            return 404
         path = rest + (f"?{query}" if query else "")
-        await self._relay_raw(writer, host, port, method, path, body,
-                              unreachable_message="kubelet unreachable")
+        return await self._relay_raw(
+            writer, host, port, method, path, body,
+            unreachable_message="kubelet unreachable")
 
     async def _relay_raw(self, writer, host: str, port: int, method: str,
                          path: str, body: bytes, *,
                          unreachable_message: str = "backend unreachable"
-                         ) -> None:
+                         ) -> int:
         """Pipe one request to a backend and its raw response bytes back —
         the streaming relay under both the node proxy and aggregated
-        watches."""
+        watches. Returns the relayed status code (for the audit trail)."""
         try:
             up_reader, up_writer = await asyncio.wait_for(
                 asyncio.open_connection(host, port), timeout=5.0)
@@ -340,7 +383,9 @@ class APIServer:
             await _respond(writer, 503, {
                 "kind": "Status", "reason": "ServiceUnavailable",
                 "message": unreachable_message})
-            return
+            return 503
+        status = 0
+        head = b""
         try:
             up_writer.write(
                 f"{method} {path} HTTP/1.1\r\n"
@@ -352,12 +397,20 @@ class APIServer:
                 chunk = await up_reader.read(65536)
                 if not chunk:
                     break
+                if not status:
+                    head += chunk
+                    try:
+                        status = parse_status_line(
+                            head.partition(b"\r\n")[0])
+                    except ValueError:
+                        status = 0 if b"\r\n" not in head else -1
                 writer.write(chunk)
                 await writer.drain()
         except (ConnectionError, asyncio.CancelledError):
             pass
         finally:
             up_writer.close()
+        return status
 
     # ---- aggregation (kube-aggregator analog) ----
 
@@ -547,6 +600,49 @@ class APIServer:
                                                 f"still being deleted"}
                 deleted = self.store.delete(kind, name, ns or "default")
                 return 200, encode_object(deleted)
+            if method == "DELETE":
+                # DeleteCollection (generic registry store.go): every
+                # object in the (kind, namespace) scope, optional
+                # labelSelector. Namespaces go through their Terminating
+                # flow (same as single delete — a hard sweep would orphan
+                # their contents); finalizer-bearing objects soft-delete
+                # and are reported separately so retry loops converge
+                selector = None
+                if query.get("labelSelector"):
+                    selector = dict(
+                        part.split("=", 1)
+                        for part in query["labelSelector"].split(",")
+                        if "=" in part)
+                victims = self.store.list(kind, namespace=ns,
+                                          label_selector=selector,
+                                          copy_objects=False)
+                count = terminating = 0
+                for obj in list(victims):
+                    if kind == "Namespace":
+                        from kubernetes_tpu.controllers.namespace import (
+                            request_namespace_deletion,
+                        )
+
+                        if obj.phase != "Terminating":
+                            try:
+                                request_namespace_deletion(
+                                    self.store, obj.metadata.name)
+                            except (NotFound, Conflict):
+                                continue
+                        terminating += 1
+                        continue
+                    try:
+                        out = self.store.delete(kind, obj.metadata.name,
+                                                obj.metadata.namespace)
+                    except NotFound:
+                        continue
+                    if out.metadata.finalizers:
+                        terminating += 1  # soft-deleted, still present
+                    else:
+                        count += 1
+                return 200, {"kind": "Status", "status": "Success",
+                             "details": {"deleted": count,
+                                         "terminating": terminating}}
             return 405, {"message": f"method {method} not allowed"}
         except NotFound as e:
             return 404, {"kind": "Status", "reason": "NotFound",
@@ -851,6 +947,18 @@ class RemoteStore:
                 rest = rest[size + 2:]
             body = out
         return status, body.decode(errors="replace")
+
+    def delete_collection(self, kind: str, namespace: str | None = None,
+                          label_selector: dict[str, str] | None = None
+                          ) -> int:
+        from urllib.parse import quote
+
+        path = self._path(kind, namespace)
+        if label_selector:
+            sel = ",".join(f"{k}={v}" for k, v in label_selector.items())
+            path += f"?labelSelector={quote(sel, safe='')}"
+        decoded = self._request("DELETE", path)
+        return int((decoded.get("details") or {}).get("deleted", 0))
 
     def evict(self, name: str, namespace: str = "default") -> bool:
         """pods/eviction subresource. False = the pod's disruption budget

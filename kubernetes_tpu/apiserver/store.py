@@ -227,6 +227,10 @@ class ObjectStore:
         rv = self._next_rv()
         stored.metadata.resource_version = str(rv)
         stored.metadata.creation_timestamp = current.metadata.creation_timestamp
+        if current.metadata.deletion_timestamp is not None:
+            # terminating is one-way: an update cannot "undelete"
+            stored.metadata.deletion_timestamp = \
+                current.metadata.deletion_timestamp
         if kind == "Service" and not stored.spec.get("clusterIP"):
             # clusterIP is allocate-once, immutable: a spec-replacing update
             # (kubectl apply) must not wipe it (service strategy
@@ -234,6 +238,17 @@ class ObjectStore:
             ip = current.spec.get("clusterIP")
             if ip:
                 stored.spec["clusterIP"] = ip
+        # a terminating object whose last finalizer was just removed is
+        # finalized: it leaves the store now (DELETED, not MODIFIED).
+        # Gated on the PRIOR object having had finalizers, so soft-deletes
+        # that never used finalizers (the namespace phase flow) update
+        # normally
+        if current.metadata.deletion_timestamp is not None \
+                and current.metadata.finalizers \
+                and not stored.metadata.finalizers:
+            bucket.pop(key, None)
+            self._publish(WatchEvent("DELETED", kind, stored, rv))
+            return stored.clone()
         bucket[key] = stored
         self._publish(WatchEvent("MODIFIED", kind, stored, rv))
         return stored.clone()
@@ -253,9 +268,24 @@ class ObjectStore:
     def delete(self, kind: str, name: str, namespace: str = "default") -> Any:
         bucket = self._bucket(kind)
         key = _key(namespace, name)
-        obj = bucket.pop(key, None)
+        obj = bucket.get(key)
         if obj is None:
             raise NotFound(f"{kind} {namespace}/{name} not found")
+        if obj.metadata.finalizers:
+            # finalization: mark terminating and wait — the object is
+            # removed only when the last finalizer is cleared by an update
+            # (generic registry deletion flow, store.go; the GC's
+            # blockOwnerDeletion rides this)
+            if obj.metadata.deletion_timestamp is None:
+                marked = obj.clone()
+                marked.metadata.deletion_timestamp = time.time()
+                rv = self._next_rv()
+                marked.metadata.resource_version = str(rv)
+                bucket[key] = marked
+                self._publish(WatchEvent("MODIFIED", kind, marked, rv))
+                return marked.clone()
+            return obj.clone()  # already terminating: idempotent
+        bucket.pop(key)
         rv = self._next_rv()
         self._publish(WatchEvent("DELETED", kind, obj, rv))
         return obj.clone()

@@ -46,6 +46,21 @@ ALIASES = {
     "sts": "statefulsets", "statefulset": "statefulsets",
     "deploy": "deployments", "deployment": "deployments",
     "job": "jobs",
+    "ds": "daemonsets", "daemonset": "daemonsets",
+    "cj": "cronjobs", "cronjob": "cronjobs",
+    "sa": "serviceaccounts", "serviceaccount": "serviceaccounts",
+    "cm": "configmaps", "configmap": "configmaps",
+    "secret": "secrets",
+    "ns": "namespaces", "namespace": "namespaces",
+    "hpa": "horizontalpodautoscalers",
+    "horizontalpodautoscaler": "horizontalpodautoscalers",
+    "pdb": "poddisruptionbudgets",
+    "poddisruptionbudget": "poddisruptionbudgets",
+    "quota": "resourcequotas", "resourcequota": "resourcequotas",
+    "limits": "limitranges", "limitrange": "limitranges",
+    "crd": "customresourcedefinitions",
+    "customresourcedefinition": "customresourcedefinitions",
+    "apiservice": "apiservices",
 }
 
 

@@ -13,17 +13,18 @@ from kubernetes_tpu.apiserver.http import APIServer, RemoteStore
 
 
 @contextlib.contextmanager
-def http_store(store: ObjectStore | None = None):
+def http_store(store: ObjectStore | None = None, **server_kwargs):
     """-> (RemoteStore client, backing ObjectStore). The backing store must
     only be touched from the server thread after startup; tests assert on
-    final state through the client."""
+    final state through the client. Extra kwargs go to APIServer
+    (audit_path, max_in_flight, authenticator, ...)."""
     store = store if store is not None else ObjectStore()
     started = threading.Event()
     holder: dict = {}
 
     def run():
         async def main():
-            server = APIServer(store)
+            server = APIServer(store, **server_kwargs)
             await server.start()
             holder["server"] = server
             holder["loop"] = asyncio.get_running_loop()

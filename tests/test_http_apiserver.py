@@ -194,3 +194,28 @@ def test_eviction_subresource_honors_pdb():
         # a pod no PDB covers evicts freely
         client.create(Pod.from_dict(mk_pod_dict("free")))
         assert client.evict("free") is True
+
+
+def test_audit_log_and_max_in_flight(tmp_path):
+    """WithAudit + WithMaxInFlightLimit chain positions (config.go:471,
+    :474): each request decision is one JSON audit line; a saturated
+    server sheds with 429 instead of queueing unboundedly."""
+    import json as _json
+
+    audit = tmp_path / "audit.jsonl"
+    with http_store(audit_path=str(audit)) as (client, _store):
+        client.create(Pod.from_dict(mk_pod_dict("a0")))
+        with pytest.raises(NotFound):
+            client.get("Pod", "missing")
+    lines = [_json.loads(x) for x in audit.read_text().splitlines()]
+    assert len(lines) == 2
+    assert lines[0]["verb"] == "POST" and lines[0]["responseStatus"] == 201
+    assert lines[1]["responseStatus"] == 404
+    assert all(ln["user"] == "system:anonymous" for ln in lines)
+
+    # saturated server sheds with 429
+    from kubernetes_tpu.apiserver.store import TooManyRequests
+
+    with http_store(max_in_flight=0) as (client, _store):
+        with pytest.raises(TooManyRequests):
+            client.list("Pod")

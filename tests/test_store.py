@@ -120,3 +120,71 @@ def test_watch_expired_window():
             store.watch("Pod", since=1)
 
     asyncio.run(run())
+
+
+def test_finalizers_block_deletion_until_cleared():
+    """Finalization (generic registry deletion flow): DELETE on a
+    finalizer-bearing object marks it terminating (MODIFIED); the DELETED
+    event fires only when the last finalizer is removed by an update."""
+    import asyncio
+
+    async def run():
+        store = ObjectStore()
+        pod = Pod.from_dict({
+            "metadata": {"name": "guarded",
+                         "finalizers": ["example.com/cleanup"]},
+            "spec": {"containers": [{"name": "c"}]}})
+        store.create(pod)
+        watch = store.watch("Pod", since=store.resource_version)
+        marked = store.delete("Pod", "guarded")
+        assert marked.metadata.deletion_timestamp is not None
+        # still present, terminating
+        live = store.get("Pod", "guarded")
+        assert live.metadata.deletion_timestamp is not None
+        ev = await watch.next(timeout=1)
+        assert ev.type == "MODIFIED"
+        # repeat DELETE is idempotent while terminating
+        again = store.delete("Pod", "guarded")
+        assert again.metadata.deletion_timestamp == \
+            marked.metadata.deletion_timestamp
+        # an update cannot undelete
+        tamper = store.get("Pod", "guarded")
+        tamper.metadata.deletion_timestamp = None
+        updated = store.update(tamper, check_version=False)
+        assert updated.metadata.deletion_timestamp is not None
+        # clearing the finalizer finalizes: object gone, DELETED fires
+        done = store.get("Pod", "guarded")
+        done.metadata.finalizers = []
+        store.update(done, check_version=False)
+        with pytest.raises(NotFound):
+            store.get("Pod", "guarded")
+        while True:
+            ev = await watch.next(timeout=1)
+            if ev.type == "DELETED":
+                break
+        watch.stop()
+
+    asyncio.run(run())
+
+
+def test_delete_collection_over_http():
+    from kubernetes_tpu.api.objects import Pod as _Pod
+
+    from tests.http_util import http_store
+
+    store = ObjectStore()
+    for i in range(4):
+        store.create(_Pod.from_dict({
+            "metadata": {"name": f"p{i}",
+                         "labels": {"app": "web" if i % 2 else "db"}},
+            "spec": {"containers": [{"name": "c"}]}}))
+    with http_store(store) as (client, _):
+        # selector-scoped sweep
+        n = client.delete_collection("Pod", "default",
+                                     label_selector={"app": "web"})
+        assert n == 2
+        names = sorted(p.metadata.name for p in client.list("Pod"))
+        assert names == ["p0", "p2"]
+        # full-collection sweep
+        assert client.delete_collection("Pod", "default") == 2
+        assert client.list("Pod") == []
