@@ -75,6 +75,8 @@ async def run(args: argparse.Namespace) -> None:
 
     url = urlsplit(args.apiserver)
     store = RemoteStore(url.hostname, url.port or 80, token=args.token)
+    from kubernetes_tpu.controllers.hpa import AnnotationMetrics
+
     mgr = ControllerManager(
         store,
         node_lifecycle_kwargs=dict(
@@ -82,7 +84,8 @@ async def run(args: argparse.Namespace) -> None:
             grace_period=args.node_monitor_grace_period,
             eviction_timeout=args.pod_eviction_timeout,
             eviction_rate=args.node_eviction_rate),
-        podgc_threshold=args.terminated_pod_gc_threshold)
+        podgc_threshold=args.terminated_pod_gc_threshold,
+        hpa_metrics=AnnotationMetrics(store))
 
     async def lead():
         await mgr.start()

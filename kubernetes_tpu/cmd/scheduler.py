@@ -158,7 +158,10 @@ async def run(args: argparse.Namespace) -> None:
         if args.controllers:
             from kubernetes_tpu.controllers import ControllerManager
 
-            mgr = ControllerManager(store)
+            from kubernetes_tpu.controllers.hpa import AnnotationMetrics
+
+            mgr = ControllerManager(
+                store, hpa_metrics=AnnotationMetrics(store))
             mgr_holder.append(mgr)
             await mgr.start()
             log.info("in-process controller manager running")

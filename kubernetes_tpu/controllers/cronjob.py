@@ -80,7 +80,9 @@ class CronJobController:
 
     @staticmethod
     def _job_active(job) -> bool:
-        return not any(c.get("type") == "Complete"
+        # IsJobFinished (job utils): Complete OR Failed ends a job —
+        # a deadline-failed job must not wedge Forbid forever
+        return not any(c.get("type") in ("Complete", "Failed")
                        and c.get("status") == "True"
                        for c in job.status.get("conditions", []))
 

@@ -35,9 +35,40 @@ SCALABLE_KINDS = ("ReplicationController", "ReplicaSet", "Deployment",
 
 
 class MetricsSource(Protocol):
-    def utilization(self, namespace: str, pod_names: list[str]
-                    ) -> dict[str, float]:
-        """pod name → CPU utilization fraction of request (1.0 = 100%)."""
+    def utilization(self, namespace: str, pods: list) -> dict[str, float]:
+        """pod name → CPU utilization fraction of request (1.0 = 100%).
+        `pods` are the informer-cached Pod objects — a source must not do
+        per-pod I/O on the event loop (an HPA over hundreds of pods syncs
+        every 30s)."""
+
+
+class AnnotationMetrics:
+    """Cluster-fed metrics source: pods carry their CPU utilization (as a
+    fraction of request) in the `kubernetes-tpu/cpu-usage` annotation —
+    the hollow/fake kubelet's stand-in for the heapster pipeline the
+    reference queries (metrics_client.go). Reads straight off the
+    informer-cached pod objects: zero I/O per sync. Pods without the
+    annotation report nothing, so the controller skips rather than
+    guesses."""
+
+    ANNOTATION = "kubernetes-tpu/cpu-usage"
+
+    def __init__(self, store=None):
+        # `store` accepted for constructor symmetry; unused (the informer
+        # pods carry the annotation)
+        self.store = store
+
+    def utilization(self, namespace: str, pods: list) -> dict[str, float]:
+        out: dict[str, float] = {}
+        for pod in pods:
+            raw = pod.metadata.annotations.get(self.ANNOTATION)
+            if raw is None:
+                continue
+            try:
+                out[pod.metadata.name] = float(raw)
+            except ValueError:
+                continue
+        return out
 
 
 class StaticMetrics:
@@ -54,12 +85,12 @@ class StaticMetrics:
     def set(self, pod_name: str, utilization: float) -> None:
         self.per_pod[pod_name] = utilization
 
-    def utilization(self, namespace: str, pod_names: list[str]
-                    ) -> dict[str, float]:
+    def utilization(self, namespace: str, pods: list) -> dict[str, float]:
+        names = [p.metadata.name for p in pods]
         if self.default is None:
-            return {n: self.per_pod[n] for n in pod_names
+            return {n: self.per_pod[n] for n in names
                     if n in self.per_pod}
-        return {n: self.per_pod.get(n, self.default) for n in pod_names}
+        return {n: self.per_pod.get(n, self.default) for n in names}
 
 
 class HorizontalController:
@@ -136,8 +167,7 @@ class HorizontalController:
             # rollout in flight (pods Pending) — no data, no action; the
             # reference aborts the sync when metrics are unavailable
             return
-        usage = self.metrics.utilization(
-            hpa.metadata.namespace, [p.metadata.name for p in pods])
+        usage = self.metrics.utilization(hpa.metadata.namespace, pods)
         if len(usage) < len(pods):
             # partial coverage must not drive fleet-wide scaling (one hot
             # sample would double the workload); the reference aborts the

@@ -12,7 +12,7 @@ from __future__ import annotations
 import time
 
 from kubernetes_tpu.api.objects import Pod
-from kubernetes_tpu.apiserver.store import NotFound, ObjectStore
+from kubernetes_tpu.apiserver.store import Conflict, NotFound, ObjectStore
 from kubernetes_tpu.client.informer import Informer
 from kubernetes_tpu.controllers.base import ReconcileController, slow_start_batch
 from kubernetes_tpu.controllers.replicaset import (
@@ -79,11 +79,34 @@ class JobController(ReconcileController):
             return
         if not self.expectations.satisfied(key):
             return
+        if any(c.get("type") == "Failed" and c.get("status") == "True"
+               for c in job.status.get("conditions", [])):
+            return  # terminally failed: never respawn workers
         pods = self._owned(job)
         succeeded = sum(1 for p in pods if p.status.phase == "Succeeded")
         failed = sum(1 for p in pods if p.status.phase == "Failed")
         active = [p for p in pods if is_active(p)]
         complete = succeeded >= job.completions
+
+        # activeDeadlineSeconds (syncJob :474 pastActiveDeadline): a job
+        # over its wall-clock budget fails — kill workers, mark Failed
+        deadline = job.spec.get("activeDeadlineSeconds")
+        started = job.status.get("startTime")
+        if not complete and deadline is not None and started is not None \
+                and time.time() - float(started) > float(deadline):
+            for pod in active:
+                try:
+                    self.store.delete("Pod", pod.metadata.name, ns)
+                except NotFound:
+                    pass
+            self._mark_failed(job, "DeadlineExceeded",
+                              "Job was active longer than specified "
+                              "deadline")
+            return
+        if not complete and deadline is not None and started is not None:
+            # re-check when the deadline lapses even with no events
+            remaining = float(started) + float(deadline) - time.time()
+            self.enqueue_after(key, max(0.05, remaining))
 
         if complete:
             # excess active workers are no longer needed (syncJob :520)
@@ -136,6 +159,32 @@ class JobController(ReconcileController):
 
         self._update_status(job, len(active), succeeded, failed, complete)
 
+    def _mark_failed(self, job, reason: str, message: str) -> None:
+        # mutate the STORE object via CAS: an informer-stale overwrite
+        # would clobber succeeded/failed counts forever, since the Failed
+        # guard stops all later status syncs
+        try:
+            current = self.store.get("Job", job.metadata.name,
+                                     job.metadata.namespace)
+        except NotFound:
+            return
+        if any(c.get("type") == "Failed"
+               for c in current.status.get("conditions", [])):
+            return
+
+        def mutate(obj):
+            obj.status.setdefault("conditions", []).append({
+                "type": "Failed", "status": "True", "reason": reason,
+                "message": message, "lastTransitionTime": time.time()})
+            obj.status["active"] = 0
+            return obj
+
+        try:
+            self.store.guaranteed_update("Job", job.metadata.name,
+                                         job.metadata.namespace, mutate)
+        except (NotFound, Conflict):
+            pass
+
     def _update_status(self, job, active: int, succeeded: int, failed: int,
                        complete: bool) -> None:
         fresh = self.jobs.get(job.metadata.name, job.metadata.namespace)
@@ -144,6 +193,7 @@ class JobController(ReconcileController):
         status = dict(fresh.status)
         status.update({"active": active, "succeeded": succeeded,
                        "failed": failed})
+        status.setdefault("startTime", time.time())
         if complete and not any(
                 c.get("type") == "Complete"
                 for c in status.get("conditions", [])):

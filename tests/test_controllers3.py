@@ -661,3 +661,117 @@ def test_deployment_rollback_to_previous_revision():
         assert v1_rev > revs["web:v2"]
 
     asyncio.run(run())
+
+
+def test_hpa_scales_from_pod_reported_usage():
+    """The cluster-fed metrics loop: pods annotate their own utilization
+    (the hollow-kubelet heapster stand-in), HPA reads it and scales."""
+    async def run():
+        from kubernetes_tpu.controllers.hpa import AnnotationMetrics
+
+        store = ObjectStore()
+        mgr = await start_mgr(store,
+                              hpa_metrics=AnnotationMetrics(store))
+        rs_with_pods(store, replicas=2)
+        store.create(HorizontalPodAutoscaler.from_dict({
+            "metadata": {"name": "api-hpa", "namespace": "default"},
+            "spec": {"scaleTargetRef": {"kind": "ReplicaSet",
+                                        "name": "api"},
+                     "minReplicas": 1, "maxReplicas": 10,
+                     "targetCPUUtilizationPercentage": 50}}))
+        await until(lambda: len(store.list("Pod")) == 2)
+        for p in store.list("Pod"):
+            fresh = store.get("Pod", p.metadata.name)
+            fresh.status.phase = "Running"
+            fresh.status.conditions = [{"type": "Ready", "status": "True"}]
+            fresh.metadata.annotations["kubernetes-tpu/cpu-usage"] = "1.0"
+            store.update(fresh, check_version=False)
+        await until(lambda: sum(
+            1 for p in mgr.informers["Pod"].items()
+            if p.status.phase == "Running") == 2)
+        mgr.hpa.sync_all()
+        # ceil(2 * 100/50) = 4
+        assert store.get("ReplicaSet", "api").replicas == 4
+        # one pod missing its annotation -> partial coverage -> no action
+        victim = store.list("Pod")[0]
+        fresh = store.get("Pod", victim.metadata.name)
+        del fresh.metadata.annotations["kubernetes-tpu/cpu-usage"]
+        store.update(fresh, check_version=False)
+        await until(lambda: mgr.informers["Pod"].get(
+            victim.metadata.name).metadata.annotations.get(
+                "kubernetes-tpu/cpu-usage") is None)
+        mgr.hpa.sync_all()
+        assert store.get("ReplicaSet", "api").replicas == 4
+
+    asyncio.run(run())
+
+
+def test_job_active_deadline_fails_and_kills_workers():
+    """spec.activeDeadlineSeconds (jobcontroller syncJob :474): a job
+    over its wall-clock budget gets the Failed condition, its workers
+    are killed, and nothing respawns."""
+    async def run():
+        from kubernetes_tpu.api.objects import Job
+
+        store = ObjectStore()
+        mgr = await start_mgr(store)
+        store.create(Job.from_dict({
+            "metadata": {"name": "slow", "namespace": "default"},
+            "spec": {"parallelism": 2, "completions": 4,
+                     "activeDeadlineSeconds": 0.3,
+                     "template": {"metadata": {"labels": {"j": "slow"}},
+                                  "spec": {"containers": [
+                                      {"name": "c"}]}}}}))
+        await until(lambda: len(store.list("Pod")) == 2)
+        # workers never finish; the deadline lapses
+        await until(lambda: any(
+            c.get("type") == "Failed" and c.get("reason")
+            == "DeadlineExceeded"
+            for c in store.get("Job", "slow").status.get(
+                "conditions", [])), timeout=8.0)
+        await until(lambda: store.list("Pod") == [])
+        # no respawn after failure
+        await asyncio.sleep(0.3)
+        assert store.list("Pod") == []
+        assert store.get("Job", "slow").status["active"] == 0
+
+    asyncio.run(run())
+
+
+def test_cronjob_forbid_unblocks_after_job_failure():
+    """A deadline-Failed job counts as finished (IsJobFinished: Complete
+    OR Failed) — Forbid must not wedge on it."""
+    async def run():
+        store = ObjectStore()
+        mgr = await start_mgr(store)
+        cj = store.create(CronJob.from_dict({
+            "metadata": {"name": "tick", "namespace": "default"},
+            "spec": {"schedule": "* * * * *",
+                     "concurrencyPolicy": "Forbid",
+                     "jobTemplate": {"spec": {
+                         "activeDeadlineSeconds": 0.2,
+                         "template": {"metadata": {},
+                                      "spec": {"containers": [
+                                          {"name": "c"}]}}}}}}))
+        await until(lambda: mgr.informers["CronJob"].get("tick")
+                    is not None)
+        now = cj.metadata.creation_timestamp
+        mgr.cronjob.now = lambda: now + 61
+        mgr.cronjob.sync_all()
+        first = store.list("Job", namespace="default")
+        assert len(first) == 1
+        # the job fails at its deadline
+        await until(lambda: any(
+            c.get("type") == "Failed"
+            for c in store.get("Job", first[0].metadata.name).status.get(
+                "conditions", [])), timeout=8.0)
+        await until(lambda: any(
+            c.get("type") == "Failed"
+            for c in (mgr.informers["Job"].get(first[0].metadata.name)
+                      or first[0]).status.get("conditions", [])))
+        # next slot fires despite Forbid: the failed job is finished
+        mgr.cronjob.now = lambda: now + 121
+        mgr.cronjob.sync_all()
+        assert len(store.list("Job", namespace="default")) == 2
+
+    asyncio.run(run())
