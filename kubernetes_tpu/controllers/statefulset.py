@@ -15,7 +15,7 @@ from __future__ import annotations
 import copy
 import re
 
-from kubernetes_tpu.api.objects import Pod
+from kubernetes_tpu.api.objects import PersistentVolumeClaim, Pod
 from kubernetes_tpu.apiserver.store import AlreadyExists, NotFound, ObjectStore
 from kubernetes_tpu.client.informer import Informer
 from kubernetes_tpu.controllers.base import ReconcileController
@@ -69,6 +69,45 @@ class StatefulSetController(ReconcileController):
                 owned[ordinal] = pod
         return owned
 
+    def _ensure_claims(self, sts, ordinal: int) -> None:
+        """volumeClaimTemplates → one PVC per (template, ordinal), named
+        `<tpl>-<set>-<ordinal>` (stateful_set_utils.go:118
+        getPersistentVolumeClaimName). Claims are created with the pod and
+        deliberately RETAINED on scale-down — the ordinal's storage
+        identity survives (createPersistentVolumeClaims semantics).
+        Claim labels come from the set's selector matchLabels
+        (getPersistentVolumeClaims sets claim.Labels from
+        set.Spec.Selector.MatchLabels)."""
+        set_labels = dict((sts.spec.get("selector") or {})
+                          .get("matchLabels") or {})
+        for tpl_name, vct in self._claim_templates(sts).items():
+            claim_name = f"{tpl_name}-{sts.metadata.name}-{ordinal}"
+            try:
+                self.store.get("PersistentVolumeClaim", claim_name,
+                               sts.metadata.namespace)
+                continue
+            except NotFound:
+                pass
+            pvc = PersistentVolumeClaim.from_dict({
+                "metadata": {"name": claim_name,
+                             "namespace": sts.metadata.namespace,
+                             "labels": set_labels},
+                "spec": copy.deepcopy(vct.get("spec") or {})})
+            try:
+                self.store.create(pvc)
+            except AlreadyExists:
+                pass
+
+    @staticmethod
+    def _claim_templates(sts) -> dict:
+        """name → template, deduplicated (a duplicate/defaulted name must
+        not yield duplicate pod volumes over one PVC)."""
+        out: dict = {}
+        for vct in sts.spec.get("volumeClaimTemplates") or []:
+            out.setdefault((vct.get("metadata") or {}).get("name", "data"),
+                           vct)
+        return out
+
     def _make_pod(self, sts, ordinal: int) -> Pod:
         d = copy.deepcopy(sts.spec.get("template") or {})
         meta = d.setdefault("metadata", {})
@@ -82,6 +121,21 @@ class StatefulSetController(ReconcileController):
         # the stable-identity labels (stateful_set_utils.go:95)
         labels["statefulset.kubernetes.io/pod-name"] = meta["name"]
         meta["ownerReferences"] = [make_controller_ref(sts)]
+        # wire the ordinal's claims in as volumes (updateStorage,
+        # stateful_set_utils.go:135): the claim REPLACES any same-named
+        # template volume — persistent identity wins over an ephemeral
+        # stand-in the template happened to declare
+        spec = d.setdefault("spec", {})
+        claim_names = set(self._claim_templates(sts))
+        volumes = [v for v in spec.get("volumes") or []
+                   if v.get("name") not in claim_names]
+        for tpl_name in claim_names:
+            volumes.append({
+                "name": tpl_name,
+                "persistentVolumeClaim": {
+                    "claimName":
+                        f"{tpl_name}-{sts.metadata.name}-{ordinal}"}})
+        spec["volumes"] = volumes
         return Pod.from_dict(d)
 
     async def sync(self, key: str) -> None:
@@ -99,6 +153,7 @@ class StatefulSetController(ReconcileController):
             if pod is None:
                 if all(pod_ready(owned[i]) for i in range(ordinal)
                        if i in owned):
+                    self._ensure_claims(sts, ordinal)
                     try:
                         self.store.create(self._make_pod(sts, ordinal))
                     except AlreadyExists:

@@ -168,3 +168,103 @@ def test_attach_detach_mirrors_pod_volumes():
             "Node", "n0").status.volumes_attached == [])
 
     asyncio.run(run())
+
+
+def test_statefulset_volume_claim_templates():
+    """volumeClaimTemplates: each ordinal gets its own PVC (bound by the
+    binder), wired into the pod as a volume; claims survive scale-down so
+    the ordinal's storage identity persists (stateful_set_utils.go:118)."""
+    async def run():
+        from kubernetes_tpu.api.objects import StatefulSet
+
+        store = ObjectStore()
+        for i in range(3):
+            store.create(pv_obj(f"disk-{i}", "10Gi"))
+        mgr = await start_mgr(store)
+        store.create(StatefulSet.from_dict({
+            "metadata": {"name": "db", "namespace": "default"},
+            "spec": {"replicas": 2,
+                     "selector": {"matchLabels": {"app": "db"}},
+                     "volumeClaimTemplates": [
+                         {"metadata": {"name": "data"},
+                          "spec": {"resources": {"requests": {
+                              "storage": "5Gi"}},
+                              "accessModes": ["ReadWriteOnce"]}}],
+                     "template": {"metadata": {"labels": {"app": "db"}},
+                                  "spec": {"containers": [
+                                      {"name": "c"}]}}}}))
+        # ordinal 0 created with its claim; mark Ready to unblock ordinal 1
+        await until(lambda: store.list("Pod") != [])
+
+        from tests.test_controllers import mark_ready
+
+        async def ready_up_to(n):
+            for i in range(n):
+                await until(lambda i=i: any(
+                    p.metadata.name == f"db-{i}"
+                    for p in store.list("Pod")))
+                mark_ready(store, store.get("Pod", f"db-{i}"))
+
+        await ready_up_to(2)
+        await until(lambda: sorted(
+            c.metadata.name
+            for c in store.list("PersistentVolumeClaim")) ==
+            ["data-db-0", "data-db-1"])
+        # the pod's volume references its ordinal's claim
+        pod0 = store.get("Pod", "db-0")
+        assert pod0.spec.volumes[0]["persistentVolumeClaim"][
+            "claimName"] == "data-db-0"
+        # the binder pairs each claim with a volume
+        await until(lambda: all(
+            c.volume_name for c in store.list("PersistentVolumeClaim")))
+        # scale down: pod goes, claim stays
+        sts = store.get("StatefulSet", "db")
+        sts.spec["replicas"] = 1
+        store.update(sts, check_version=False)
+        await until(lambda: not any(
+            p.metadata.name == "db-1" for p in store.list("Pod")))
+        assert any(c.metadata.name == "data-db-1"
+                   for c in store.list("PersistentVolumeClaim"))
+        mgr.stop()
+
+    asyncio.run(run())
+
+
+def test_claim_template_replaces_same_named_template_volume():
+    """updateStorage semantics: a volumeClaimTemplate REPLACES a
+    same-named pod-template volume (persistent identity beats the
+    template's ephemeral stand-in); claim labels come from the set
+    selector."""
+    async def run():
+        from kubernetes_tpu.api.objects import StatefulSet
+
+        store = ObjectStore()
+        store.create(pv_obj("disk", "10Gi"))
+        await start_mgr(store)
+        store.create(StatefulSet.from_dict({
+            "metadata": {"name": "db", "namespace": "default"},
+            "spec": {"replicas": 1,
+                     "selector": {"matchLabels": {"app": "db"}},
+                     "volumeClaimTemplates": [
+                         {"metadata": {"name": "data"},
+                          "spec": {"resources": {"requests": {
+                              "storage": "5Gi"}},
+                              "accessModes": ["ReadWriteOnce"]}}],
+                     "template": {
+                         "metadata": {"labels": {"app": "db"}},
+                         "spec": {"volumes": [
+                             {"name": "data", "emptyDir": {}}],
+                             "containers": [{"name": "c"}]}}}}))
+        await until(lambda: any(p.metadata.name == "db-0"
+                                for p in store.list("Pod")))
+        pod = store.get("Pod", "db-0")
+        data_vols = [v for v in pod.spec.volumes
+                     if v.get("name") == "data"]
+        assert len(data_vols) == 1
+        assert data_vols[0]["persistentVolumeClaim"][
+            "claimName"] == "data-db-0"
+        assert "emptyDir" not in data_vols[0]
+        claim = store.get("PersistentVolumeClaim", "data-db-0")
+        assert claim.metadata.labels == {"app": "db"}
+
+    asyncio.run(run())
