@@ -128,6 +128,25 @@ def parse_status_line(head: bytes) -> int:
         raise ValueError("empty or non-HTTP reply") from None
 
 
+def parse_label_selector(spec: str) -> dict[str, str]:
+    """The equality-only labelSelector grammar this API serves
+    (`k=v,k2=v2`). Malformed parts raise instead of silently matching
+    everything/nothing — shared by the kubectl -l flag and the
+    DeleteCollection query parameter."""
+    out: dict[str, str] = {}
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        key, eq, value = part.partition("=")
+        if not eq or not key or "!" in key:
+            raise ValueError(
+                f"bad label selector {part!r}: only k=v,... equality "
+                f"selectors are supported")
+        out[key] = value
+    return out
+
+
 def _split_path(path: str):
     """-> (ns | None, plural, name | None, subresource | None) — the raw
     resource shape of a request path, no kind resolution. Authorization
@@ -609,10 +628,8 @@ class APIServer:
                 # and are reported separately so retry loops converge
                 selector = None
                 if query.get("labelSelector"):
-                    selector = dict(
-                        part.split("=", 1)
-                        for part in query["labelSelector"].split(",")
-                        if "=" in part)
+                    selector = parse_label_selector(
+                        query["labelSelector"])
                 victims = self.store.list(kind, namespace=ns,
                                           label_selector=selector,
                                           copy_objects=False)

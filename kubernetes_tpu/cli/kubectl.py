@@ -165,9 +165,22 @@ def cmd_get(client, args) -> int:
     kind = RESOURCES[plural]
     ns = None if args.all_namespaces else args.namespace
     if args.name:
+        if getattr(args, "selector", ""):
+            print("error: a resource name cannot be combined with "
+                  "--selector", file=sys.stderr)
+            return 1
         objs = [client.get(kind, args.name, args.namespace)]
     else:
-        objs = client.list(kind, namespace=ns)
+        selector = None
+        if getattr(args, "selector", ""):
+            from kubernetes_tpu.apiserver.http import parse_label_selector
+
+            try:
+                selector = parse_label_selector(args.selector)
+            except ValueError as e:
+                print(f"error: {e}", file=sys.stderr)
+                return 1
+        objs = client.list(kind, namespace=ns, label_selector=selector)
         objs.sort(key=lambda o: (o.metadata.namespace, o.metadata.name))
     if args.output == "json":
         docs = [o.to_dict() for o in objs]
@@ -416,6 +429,8 @@ def build_parser() -> argparse.ArgumentParser:
     g.add_argument("name", nargs="?")
     g.add_argument("-n", "--namespace", default="default")
     g.add_argument("--all-namespaces", action="store_true")
+    g.add_argument("-l", "--selector", default="",
+                   help="label selector, e.g. app=web,tier=frontend")
     g.add_argument("-o", "--output", default="",
                    choices=["", "json", "wide", "name"])
     g.set_defaults(fn=cmd_get)

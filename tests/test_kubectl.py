@@ -204,3 +204,32 @@ def test_rollout_history_and_undo():
     finally:
         holder["loop"].call_soon_threadsafe(holder["stop"].set)
         thread.join(timeout=10)
+
+
+def test_get_with_label_selector():
+    from kubernetes_tpu.api.objects import Pod
+
+    with http_store() as (client, _store):
+        for i in range(3):
+            d = mk_pod_dict(f"p{i}")
+            d["metadata"]["labels"] = {"app": "web" if i < 2 else "db"}
+            client.create(Pod.from_dict(d))
+        rc, out = run_cli(client, "get", "pods", "-l", "app=web",
+                          "-o", "name")
+        assert rc == 0
+        assert out.splitlines() == ["pods/p0", "pods/p1"]
+
+
+def test_selector_rejects_malformed_and_name_combo():
+    from kubernetes_tpu.api.objects import Pod
+
+    with http_store() as (client, _store):
+        client.create(Pod.from_dict(mk_pod_dict("p0")))
+        # non-equality selectors error instead of silently matching all
+        rc, _ = run_cli(client, "get", "pods", "-l", "app")
+        assert rc == 1
+        rc, _ = run_cli(client, "get", "pods", "-l", "app!=web")
+        assert rc == 1
+        # name + selector is rejected, like real kubectl
+        rc, _ = run_cli(client, "get", "pods", "p0", "-l", "app=web")
+        assert rc == 1
