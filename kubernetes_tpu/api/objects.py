@@ -442,6 +442,9 @@ class NodeSpec:
     unschedulable: bool = False
     taints: list[Taint] = field(default_factory=list)
     provider_id: str = ""
+    # per-node pod subnet (v1.NodeSpec PodCIDR; the route controller
+    # programs a cloud route per CIDR)
+    pod_cidr: str = ""
 
     @classmethod
     def from_dict(cls, d: dict[str, Any]) -> "NodeSpec":
@@ -449,6 +452,7 @@ class NodeSpec:
             unschedulable=bool(d.get("unschedulable", False)),
             taints=[Taint.from_dict(t) for t in d.get("taints") or []],
             provider_id=d.get("providerID", "") or "",
+            pod_cidr=d.get("podCIDR", "") or "",
         )
 
     def to_dict(self) -> dict[str, Any]:
@@ -459,6 +463,8 @@ class NodeSpec:
             out["taints"] = [t.to_dict() for t in self.taints]
         if self.provider_id:
             out["providerID"] = self.provider_id
+        if self.pod_cidr:
+            out["podCIDR"] = self.pod_cidr
         return out
 
 
@@ -532,7 +538,8 @@ class Node:
             spec=NodeSpec(unschedulable=self.spec.unschedulable,
                           taints=[Taint(t.key, t.value, t.effect)
                                   for t in self.spec.taints],
-                          provider_id=self.spec.provider_id),
+                          provider_id=self.spec.provider_id,
+                          pod_cidr=self.spec.pod_cidr),
             status=NodeStatus(capacity=dict(self.status.capacity),
                               allocatable=dict(self.status.allocatable),
                               conditions=[

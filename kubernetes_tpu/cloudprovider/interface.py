@@ -39,6 +39,17 @@ class CloudProvider:
         """(failure domain, region)."""
         raise NotImplementedError
 
+    # -- Routes (cloud.go Routes interface; route controller consumer) --
+    def list_routes(self) -> dict[str, str]:
+        """node name -> destination CIDR."""
+        raise NotImplementedError
+
+    def create_route(self, node_name: str, cidr: str) -> None:
+        raise NotImplementedError
+
+    def delete_route(self, node_name: str) -> None:
+        raise NotImplementedError
+
 
 @dataclass
 class FakeCloud(CloudProvider):
@@ -49,6 +60,7 @@ class FakeCloud(CloudProvider):
     backends: dict[str, tuple[str, ...]] = field(default_factory=dict)
     instances: set = field(default_factory=set)
     zone: tuple[str, str] = ("fake-zone-a", "fake-region")
+    routes: dict[str, str] = field(default_factory=dict)
     calls: list[str] = field(default_factory=list)
     _ip_counter: itertools.count = field(
         default_factory=lambda: itertools.count(1))
@@ -77,3 +89,14 @@ class FakeCloud(CloudProvider):
 
     def get_zone(self, node_name: str) -> tuple[str, str]:
         return self.zone
+
+    def list_routes(self) -> dict[str, str]:
+        return dict(self.routes)
+
+    def create_route(self, node_name: str, cidr: str) -> None:
+        self.calls.append(f"route+:{node_name}={cidr}")
+        self.routes[node_name] = cidr
+
+    def delete_route(self, node_name: str) -> None:
+        self.calls.append(f"route-:{node_name}")
+        self.routes.pop(node_name, None)

@@ -114,6 +114,13 @@ class ControllerManager:
                 store, self.informers["Node"], pods,
                 **(node_lifecycle_kwargs or {}))
             self.controllers.append(self.node_lifecycle)
+        from kubernetes_tpu.controllers.nodeipam import (
+            NodeIpamController,
+            RouteController,
+        )
+
+        self.node_ipam = NodeIpamController(store, self.informers["Node"])
+        self.controllers.append(self.node_ipam)
         if cloud is not None:
             from kubernetes_tpu.controllers.service_lb import (
                 ServiceLBController,
@@ -123,6 +130,9 @@ class ControllerManager:
                 store, cloud, self.informers["Service"],
                 self.informers["Node"])
             self.controllers.append(self.service_lb)
+            self.route = RouteController(store, cloud,
+                                         self.informers["Node"])
+            self.controllers.append(self.route)
 
     async def start(self) -> None:
         for informer in self.informers.values():

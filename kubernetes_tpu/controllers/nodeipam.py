@@ -1,0 +1,123 @@
+"""Node IPAM (pod-CIDR allocation) + cloud route controllers.
+
+NodeIpamController — analog of the CIDR allocator half of the reference
+node controller (pkg/controller/node/cidr_allocator.go): carve the
+cluster CIDR into per-node /`node_mask` subnets and write each new node's
+spec.podCIDR; released on node delete, reused for new nodes.
+
+RouteController — analog of pkg/controller/route/routecontroller.go:
+reconcile the cloud's route table against the nodes' pod CIDRs — a route
+per (node, podCIDR), stale routes (node gone or CIDR changed) deleted.
+"""
+
+from __future__ import annotations
+
+import ipaddress
+import logging
+
+from kubernetes_tpu.apiserver.store import Conflict, NotFound, ObjectStore
+from kubernetes_tpu.client.informer import Informer
+from kubernetes_tpu.controllers.base import ReconcileController
+
+log = logging.getLogger(__name__)
+
+
+class NodeIpamController(ReconcileController):
+    workers = 1
+
+    def __init__(self, store: ObjectStore, node_informer: Informer,
+                 cluster_cidr: str = "10.244.0.0/16",
+                 node_mask: int = 24):
+        super().__init__()
+        self.name = "node-ipam-controller"
+        self.store = store
+        self.nodes = node_informer
+        net = ipaddress.ip_network(cluster_cidr)
+        self._subnets = [str(s) for s in net.subnets(
+            new_prefix=node_mask)]
+        self._assigned: dict[str, str] = {}  # node -> cidr
+        self._starved: set[str] = set()  # waiting on pool exhaustion
+        node_informer.add_handler(self._on_node)
+
+    def _on_node(self, event) -> None:
+        name = event.obj.metadata.name
+        if event.type == "DELETED":
+            self._assigned.pop(name, None)  # cidr returns to the pool
+            # a freed subnet may unblock a node starved at exhaustion
+            for starved in list(self._starved):
+                self.enqueue(starved)
+            return
+        if not event.obj.spec.pod_cidr:
+            self.enqueue(name)
+        else:
+            # adopt pre-assigned CIDRs (restart path: the informer relist
+            # replays every node) so the pool doesn't double-allocate
+            self._assigned.setdefault(name, event.obj.spec.pod_cidr)
+
+    async def sync(self, key: str) -> None:
+        if key in self._assigned:
+            return  # already allocated; a stale-cache re-run must not
+            # reassign an immutable podCIDR (heartbeat raced our write)
+        node = self.nodes.get(key)
+        if node is None or node.spec.pod_cidr:
+            return
+        in_use = set(self._assigned.values())
+        cidr = next((s for s in self._subnets if s not in in_use), None)
+        if cidr is None:
+            log.error("node-ipam: cluster CIDR exhausted at %d nodes",
+                      len(in_use))
+            self._starved.add(key)  # re-enqueued when a node frees one
+            return
+        self._starved.discard(key)
+        self._assigned[key] = cidr
+
+        def mutate(obj):
+            obj.spec.pod_cidr = cidr
+            return obj
+
+        try:
+            self.store.guaranteed_update("Node", key, "default", mutate)
+        except (NotFound, Conflict):
+            self._assigned.pop(key, None)
+
+
+class RouteController(ReconcileController):
+    workers = 1
+    RESYNC = 10.0  # the reference loops every 10s (routecontroller.go)
+
+    def __init__(self, store: ObjectStore, cloud, node_informer: Informer,
+                 resync_period: float = RESYNC):
+        super().__init__()
+        self.name = "route-controller"
+        self.store = store
+        self.cloud = cloud
+        self.nodes = node_informer
+        self.resync_period = resync_period
+        node_informer.add_handler(self._on_node)
+
+    async def start(self) -> None:
+        await super().start()
+        # periodic whole-table reconcile: cloud-side drift (routes
+        # deleted out-of-band, stale routes from a prior run) heals even
+        # with zero node events
+        self.enqueue("reconcile")
+
+    def _on_node(self, event) -> None:
+        self.enqueue("reconcile")
+
+    async def sync(self, key: str) -> None:
+        want = {n.metadata.name: n.spec.pod_cidr
+                for n in self.nodes.items() if n.spec.pod_cidr}
+        have = self.cloud.list_routes()
+        for node, cidr in want.items():
+            if have.get(node) != cidr:
+                if node in have:
+                    # replace, don't rely on provider upsert semantics: a
+                    # table keyed by destination CIDR would keep routing
+                    # the STALE subnet to this node
+                    self.cloud.delete_route(node)
+                self.cloud.create_route(node, cidr)
+        for node in have:
+            if node not in want:
+                self.cloud.delete_route(node)
+        self.enqueue_after("reconcile", self.resync_period)

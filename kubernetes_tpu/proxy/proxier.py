@@ -209,6 +209,14 @@ class Proxier:
                         f"{comment} -j REJECT")
                     continue
                 lines.append(f":{svcc} - [0:0]")
+                if self.cluster_cidr:
+                    # off-cluster sources hitting the VIP get masqueraded
+                    # (proxier.go:1136 "!--src <clusterCIDR> -> MASQ")
+                    rules.append(
+                        f"-A KUBE-SERVICES ! -s {self.cluster_cidr} "
+                        f"-d {cluster_ip}/32 -p {proto} -m {proto} "
+                        f"--dport {port} -m comment --comment {comment} "
+                        f"-j KUBE-MARK-MASQ")
                 rules.append(
                     f"-A KUBE-SERVICES -d {cluster_ip}/32 -p {proto} "
                     f"-m {proto} --dport {port} -m comment --comment "
