@@ -233,6 +233,7 @@ class PodSpec:
     scheduler_name: str = "default-scheduler"
     restart_policy: str = "Always"
     priority: int = 0
+    service_account_name: str = ""
 
     def clone(self) -> "PodSpec":
         return PodSpec(
@@ -245,6 +246,7 @@ class PodSpec:
             volumes=copy.deepcopy(self.volumes) if self.volumes else [],
             scheduler_name=self.scheduler_name,
             restart_policy=self.restart_policy, priority=self.priority,
+            service_account_name=self.service_account_name,
         )
 
     @classmethod
@@ -259,6 +261,7 @@ class PodSpec:
             scheduler_name=d.get("schedulerName", "default-scheduler") or "default-scheduler",
             restart_policy=d.get("restartPolicy", "Always") or "Always",
             priority=int(d.get("priority", 0) or 0),
+            service_account_name=d.get("serviceAccountName", "") or "",
         )
 
     def to_dict(self) -> dict[str, Any]:
@@ -279,6 +282,10 @@ class PodSpec:
             out["schedulerName"] = self.scheduler_name
         if self.priority:
             out["priority"] = self.priority
+        if self.service_account_name:
+            out["serviceAccountName"] = self.service_account_name
+        if self.restart_policy != "Always":
+            out["restartPolicy"] = self.restart_policy
         return out
 
 

@@ -53,6 +53,7 @@ def chain_for(names: str) -> AdmissionChain:
     registry = {
         "NamespaceLifecycle": NamespaceLifecycle,
         "DefaultTolerationSeconds": DefaultTolerationSeconds,
+        "ServiceAccount": ServiceAccountPlugin,
         "LimitRanger": LimitRanger,
         "ResourceQuota": ResourceQuotaPlugin,
     }
@@ -93,6 +94,34 @@ class NamespaceLifecycle:
             raise AdmissionError(
                 f"unable to create new content in namespace {ns} because "
                 f"it is being terminated")
+
+
+class ServiceAccountPlugin:
+    """plugin/pkg/admission/serviceaccount: default pods'
+    spec.serviceAccountName to "default", and reject pods referencing an
+    account that does not exist (admission.go DefaultServiceAccountName +
+    the MountServiceAccountToken existence check). The "default" account
+    itself is auto-managed by the serviceaccounts controller, so its
+    momentary absence in a brand-new namespace must not block pods —
+    only EXPLICIT references are validated."""
+
+    def admit(self, store, obj: Any, operation: str) -> None:
+        if obj.kind != "Pod" or operation != "CREATE":
+            return
+        if not obj.spec.service_account_name:
+            obj.spec.service_account_name = "default"
+        if obj.spec.service_account_name == "default":
+            # auto-managed account: its momentary absence in a brand-new
+            # namespace must not block pods, explicit or implicit
+            return
+        try:
+            store.get("ServiceAccount", obj.spec.service_account_name,
+                      obj.metadata.namespace)
+        except KeyError:
+            raise AdmissionError(
+                f"error looking up service account "
+                f"{obj.metadata.namespace}/"
+                f"{obj.spec.service_account_name}: not found") from None
 
 
 NOT_READY_KEY = "node.alpha.kubernetes.io/notReady"

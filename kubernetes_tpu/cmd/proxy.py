@@ -1,0 +1,73 @@
+"""The kube-proxy binary (cmd/kube-proxy analog).
+
+Watches Services/Endpoints on an HTTP apiserver and keeps the node's NAT
+table synced (iptables mode). --fake-iptables runs against the in-memory
+table (the hollow-proxy / test topology); otherwise rules go through
+iptables-restore.
+
+    python -m kubernetes_tpu.cmd.proxy \
+        --apiserver http://127.0.0.1:8080 --cluster-cidr 10.244.0.0/16
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+import os
+import sys
+from urllib.parse import urlsplit
+
+log = logging.getLogger(__name__)
+
+
+def parse_args(argv=None) -> argparse.Namespace:
+    p = argparse.ArgumentParser(
+        prog="kubernetes-tpu-proxy",
+        description="service dataplane proxy (kube-proxy analog)")
+    p.add_argument("--apiserver", required=True)
+    p.add_argument("--token", default=os.environ.get("KUBE_TOKEN", ""))
+    p.add_argument("--cluster-cidr", default="",
+                   help="pod CIDR; off-cluster VIP clients get "
+                        "masqueraded (proxier.go:1136)")
+    p.add_argument("--fake-iptables", action="store_true",
+                   help="in-memory table instead of iptables-restore "
+                        "(hollow topology)")
+    return p.parse_args(argv)
+
+
+async def run(args: argparse.Namespace) -> None:
+    from kubernetes_tpu.apiserver.http import RemoteStore
+    from kubernetes_tpu.proxy.proxier import (
+        FakeIptables,
+        Proxier,
+        SystemIptables,
+    )
+
+    url = urlsplit(args.apiserver)
+    store = RemoteStore(url.hostname, url.port or 80, token=args.token)
+    iptables = FakeIptables() if args.fake_iptables else SystemIptables()
+    proxier = Proxier(store, iptables=iptables,
+                      cluster_cidr=args.cluster_cidr)
+    await proxier.start()
+    log.info("kube-proxy syncing against %s (cluster-cidr=%s)",
+             args.apiserver, args.cluster_cidr or "<none>")
+    try:
+        await asyncio.Event().wait()
+    finally:
+        proxier.stop()
+
+
+def main(argv=None) -> int:
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(levelname).1s %(name)s: %(message)s")
+    try:
+        asyncio.run(run(parse_args(argv)))
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -43,6 +43,7 @@ class NodeIpamController(ReconcileController):
         name = event.obj.metadata.name
         if event.type == "DELETED":
             self._assigned.pop(name, None)  # cidr returns to the pool
+            self._starved.discard(name)  # a dead node stops waiting
             # a freed subnet may unblock a node starved at exhaustion
             for starved in list(self._starved):
                 self.enqueue(starved)
@@ -93,14 +94,33 @@ class RouteController(ReconcileController):
         self.cloud = cloud
         self.nodes = node_informer
         self.resync_period = resync_period
+        self._resync_task = None
         node_informer.add_handler(self._on_node)
 
     async def start(self) -> None:
         await super().start()
-        # periodic whole-table reconcile: cloud-side drift (routes
-        # deleted out-of-band, stale routes from a prior run) heals even
-        # with zero node events
+        # ONE dedicated periodic task (the quota controller's pattern):
+        # rescheduling from sync() would spawn a new timer chain per
+        # event-triggered sync and multiply the reconcile rate without
+        # bound under node heartbeats
+        import asyncio
+
         self.enqueue("reconcile")
+        self._resync_task = asyncio.get_running_loop().create_task(
+            self._resync_loop())
+
+    def stop(self) -> None:
+        if self._resync_task is not None:
+            self._resync_task.cancel()
+            self._resync_task = None
+        super().stop()
+
+    async def _resync_loop(self) -> None:
+        import asyncio
+
+        while True:
+            await asyncio.sleep(self.resync_period)
+            self.enqueue("reconcile")
 
     def _on_node(self, event) -> None:
         self.enqueue("reconcile")
@@ -120,4 +140,3 @@ class RouteController(ReconcileController):
         for node in have:
             if node not in want:
                 self.cloud.delete_route(node)
-        self.enqueue_after("reconcile", self.resync_period)

@@ -217,3 +217,45 @@ def test_aggregated_paths_stay_inside_authorization():
     finally:
         holder["loop"].call_soon_threadsafe(holder["stop"].set)
         thread.join(timeout=10)
+
+
+def test_serviceaccount_admission_defaults_and_validates():
+    """ServiceAccount admission (plugin/pkg/admission/serviceaccount):
+    pods default to the "default" account; explicit references to a
+    missing account are rejected."""
+    from kubernetes_tpu.api.objects import Pod, ServiceAccount
+    from kubernetes_tpu.apiserver.admission import chain_for
+
+    store = ObjectStore()
+    store.admission = chain_for("ServiceAccount")
+    created = store.create(Pod.from_dict({
+        "metadata": {"name": "p0"},
+        "spec": {"containers": [{"name": "c"}]}}))
+    assert created.spec.service_account_name == "default"
+    # restartPolicy and serviceAccountName survive the wire round-trip
+    rt = Pod.from_dict(created.to_dict())
+    assert rt.spec.service_account_name == "default"
+
+    with pytest.raises(AdmissionError, match="not found"):
+        store.create(Pod.from_dict({
+            "metadata": {"name": "p1"},
+            "spec": {"containers": [{"name": "c"}],
+                     "serviceAccountName": "robot"}}))
+    store.create(ServiceAccount.from_dict(
+        {"metadata": {"name": "robot", "namespace": "default"}}))
+    ok = store.create(Pod.from_dict({
+        "metadata": {"name": "p1"},
+        "spec": {"containers": [{"name": "c"}],
+                 "serviceAccountName": "robot"}}))
+    assert ok.spec.service_account_name == "robot"
+
+
+def test_restart_policy_round_trips():
+    """restartPolicy was silently dropped by to_dict before — Job pods
+    crossing HTTP/WAL would degrade Never -> Always and run forever."""
+    from kubernetes_tpu.api.objects import Pod
+
+    pod = Pod.from_dict({"metadata": {"name": "j"},
+                         "spec": {"containers": [{"name": "c"}],
+                                  "restartPolicy": "Never"}})
+    assert Pod.from_dict(pod.to_dict()).spec.restart_policy == "Never"

@@ -113,3 +113,54 @@ def test_endpoint_controller_feeds_proxier():
         mgr.stop()
 
     asyncio.run(run())
+
+
+def test_cluster_cidr_masquerade_rule():
+    """--cluster-cidr emits the off-cluster masquerade rule before the
+    service-chain jump (proxier.go:1136 '! -s clusterCIDR -> MASQ')."""
+    import asyncio
+
+    from kubernetes_tpu.api.objects import Pod, Service
+    from kubernetes_tpu.apiserver import ObjectStore
+    from kubernetes_tpu.proxy.proxier import Proxier
+
+    from tests.test_controllers import until
+
+    async def run():
+        store = ObjectStore()
+        store.create(Service.from_dict({
+            "metadata": {"name": "web", "namespace": "default"},
+            "spec": {"selector": {"app": "web"},
+                     "ports": [{"port": 80, "protocol": "TCP"}]}}))
+        pod = store.create(Pod.from_dict({
+            "metadata": {"name": "w0", "labels": {"app": "web"}},
+            "spec": {"containers": [{"name": "c"}],
+                     "nodeName": "n0"}}))
+        fresh = store.get("Pod", "w0")
+        fresh.status.phase = "Running"
+        fresh.status.conditions = [{"type": "Ready", "status": "True"}]
+        fresh.status.host_ip = "10.244.0.9"
+        store.update(fresh, check_version=False)
+        # endpoints maintained by hand (no controller in this unit test)
+        from kubernetes_tpu.api.objects import Endpoints
+
+        store.create(Endpoints.from_dict({
+            "metadata": {"name": "web", "namespace": "default"},
+            "subsets": [{"addresses": [{"ip": "10.244.0.9"}],
+                         "ports": [{"port": 80, "protocol": "TCP"}]}]}))
+        proxier = Proxier(store, cluster_cidr="10.244.0.0/16")
+        await proxier.start()
+        await asyncio.sleep(0.1)
+        rules = proxier.sync_proxy_rules()
+        vip = store.get("Service", "web").spec["clusterIP"]
+        masq = [r for r in rules.splitlines()
+                if r.startswith("-A KUBE-SERVICES ! -s 10.244.0.0/16")]
+        assert len(masq) == 1 and f"-d {vip}/32" in masq[0] \
+            and masq[0].endswith("-j KUBE-MARK-MASQ")
+        # ordered before the service-chain jump
+        jump = next(i for i, r in enumerate(rules.splitlines())
+                    if r.startswith(f"-A KUBE-SERVICES -d {vip}/32"))
+        assert rules.splitlines().index(masq[0]) < jump
+        proxier.stop()
+
+    asyncio.run(run())
