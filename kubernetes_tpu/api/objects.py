@@ -909,6 +909,7 @@ class CustomResourceDefinition(_SpecStatusObject):
     spec: {group, version, names: {plural, kind}, scope})."""
 
     kind = "CustomResourceDefinition"
+    api_version = "apiextensions.k8s.io/v1beta1"
 
     @property
     def plural(self) -> str:
@@ -957,6 +958,7 @@ class Cluster(_SpecStatusObject):
     spec.serverAddress points at the member apiserver)."""
 
     kind = "Cluster"
+    api_version = "federation/v1beta1"
 
     @property
     def server_address(self) -> str:

@@ -250,7 +250,11 @@ class APIServer:
         try:
             ns, plural, name, _sub = _split_path(path)
         except NotFound:
-            return None, user  # no resource shape at all: routing 404s it
+            # shape-less paths serve only GET discovery (/api, /apis,
+            # /apis/{g}/{v}, /version) — open to every AUTHENTICATED user
+            # like the reference's system:discovery role; anything else
+            # 404s in routing. Resource-shaped paths never land here.
+            return None, user
         verb = {"GET": "get" if name else "list", "POST": "create",
                 "PUT": "update", "DELETE": "delete"}.get(method, method)
         # cluster-scoped (and cross-namespace) requests authorize against
@@ -542,7 +546,82 @@ class APIServer:
         ns, plural, name, sub = _split_path(path)
         return ns, plural, self._resolve_plural(plural), name, sub
 
+    # ---- discovery (server/routes + endpoints/discovery analogs) ----
+
+    # group/version per non-core kind, DERIVED from each class's
+    # api_version (the scheme registration) — one source of truth, so a
+    # new grouped kind only declares api_version on its class
+    GROUPS = {
+        kind: tuple(cls.api_version.split("/", 1))
+        for kind, cls in KIND_TO_CLS.items()
+        if "/" in getattr(cls, "api_version", "v1")}
+    CLUSTER_SCOPED = frozenset({
+        "Node", "PersistentVolume", "Namespace",
+        "CustomResourceDefinition", "APIService", "Cluster"})
+
+    def _discovery(self, method: str, path: str):
+        """-> (status, payload) for discovery paths, else None."""
+        if method != "GET":
+            return None
+        parts = [p for p in path.strip("/").split("/") if p]
+        if parts == ["version"]:
+            return 200, {"major": "1", "minor": "8",
+                         "gitVersion": "v1.8.0-tpu",
+                         "platform": "tpu/xla"}
+        if parts == ["api"]:
+            return 200, {"kind": "APIVersions", "versions": ["v1"]}
+        if parts == ["apis"]:
+            groups: dict[str, set] = {}
+            for kind, (group, version) in self.GROUPS.items():
+                groups.setdefault(group, set()).add(version)
+            for svc in self.store.list("APIService", copy_objects=False):
+                g, v = svc.group_version
+                if g:
+                    groups.setdefault(g, set()).add(v)
+            for crd in self.store.list("CustomResourceDefinition",
+                                       copy_objects=False):
+                g = crd.spec.get("group", "")
+                if g:
+                    groups.setdefault(g, set()).add(
+                        crd.spec.get("version") or "v1")
+            return 200, {"kind": "APIGroupList", "groups": [
+                {"name": g, "versions": [
+                    {"groupVersion": f"{g}/{v}", "version": v}
+                    for v in sorted(vs)]}
+                for g, vs in sorted(groups.items())]}
+        if parts == ["api", "v1"] or (
+                len(parts) == 3 and parts[0] == "apis"):
+            if parts == ["api", "v1"]:
+                want = lambda kind: kind not in self.GROUPS  # noqa: E731
+                gv = "v1"
+            else:
+                gv = f"{parts[1]}/{parts[2]}"
+                want = lambda kind: self.GROUPS.get(kind) == (  # noqa: E731
+                    parts[1], parts[2])
+            resources = [
+                {"name": plural, "kind": kind,
+                 "namespaced": kind not in self.CLUSTER_SCOPED}
+                for plural, kind in sorted(RESOURCES.items())
+                if want(kind)]
+            for crd in self.store.list("CustomResourceDefinition",
+                                       copy_objects=False):
+                crd_gv = (f"{crd.spec.get('group')}/"
+                          f"{crd.spec.get('version') or 'v1'}")
+                if crd_gv == gv and crd.plural:
+                    resources.append({
+                        "name": crd.plural, "kind": crd.target_kind,
+                        "namespaced": crd.spec.get("scope", "Namespaced")
+                        == "Namespaced"})
+            if not resources and parts[:1] == ["apis"]:
+                return None  # unknown group: fall through to routing 404
+            return 200, {"kind": "APIResourceList", "groupVersion": gv,
+                         "resources": resources}
+        return None
+
     def _route(self, method: str, path: str, query: dict, body: bytes):
+        discovered = self._discovery(method, path)
+        if discovered is not None:
+            return discovered
         try:
             ns, _plural, kind, name, sub = self._parse_path(path)
             if sub == "binding" and method == "POST" and kind == "Pod":

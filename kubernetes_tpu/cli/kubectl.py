@@ -298,6 +298,29 @@ def cmd_exec(client, args) -> int:
     return int(result.get("exitCode", 0))
 
 
+def cmd_api_resources(client, args) -> int:
+    """Discovery walk: /api/v1 + every /apis group version
+    (pkg/kubectl/cmd/apiresources analog)."""
+    rows = []
+    core = client._request("GET", "/api/v1")
+    for r in core.get("resources", []):
+        rows.append((r["name"], "v1", r["namespaced"], r["kind"]))
+    groups = client._request("GET", "/apis")
+    for g in groups.get("groups", []):
+        for v in g.get("versions", []):
+            gv = v["groupVersion"]
+            try:
+                listing = client._request("GET", f"/apis/{gv}")
+            except Exception:  # noqa: BLE001 — unreachable aggregated group
+                continue
+            for r in listing.get("resources", []):
+                rows.append((r["name"], gv, r["namespaced"], r["kind"]))
+    print(f"{'NAME':<32} {'APIVERSION':<34} {'NAMESPACED':<11} KIND")
+    for name, gv, namespaced, kind in sorted(rows):
+        print(f"{name:<32} {gv:<34} {str(namespaced).lower():<11} {kind}")
+    return 0
+
+
 def cmd_rollout(client, args) -> int:
     """rollout status|history|undo deployment/<name> (pkg/kubectl/cmd/
     rollout + rollback semantics through spec.rollbackTo)."""
@@ -461,6 +484,8 @@ def build_parser() -> argparse.ArgumentParser:
     dr.add_argument("name")
     dr.add_argument("--timeout", type=float, default=30.0)
     dr.set_defaults(fn=cmd_drain)
+    ar = sub.add_parser("api-resources")
+    ar.set_defaults(fn=cmd_api_resources)
     ro = sub.add_parser("rollout")
     ro.add_argument("action", choices=["status", "history", "undo"])
     ro.add_argument("resource")

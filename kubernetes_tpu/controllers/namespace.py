@@ -13,15 +13,20 @@ from kubernetes_tpu.apiserver.store import Conflict, NotFound, ObjectStore
 from kubernetes_tpu.client.informer import Informer
 from kubernetes_tpu.controllers.base import ReconcileController
 
-# the namespaced kinds swept on termination (deletion.go's
-# groupVersionResources discovery, statically known here)
-NAMESPACED_KINDS = (
-    "Pod", "Service", "Endpoints", "Event", "ReplicaSet",
-    "ReplicationController", "StatefulSet", "Deployment", "Job",
-    "PersistentVolumeClaim", "LimitRange", "ResourceQuota",
-    "Secret", "ConfigMap", "ServiceAccount", "DaemonSet", "CronJob",
-    "HorizontalPodAutoscaler", "PodDisruptionBudget",
-)
+def _namespaced_kinds() -> tuple[str, ...]:
+    """Derived from the serving tables (deletion.go discovers resources
+    dynamically): every served kind that is neither cluster-scoped nor a
+    virtual subresource payload. One source of truth with discovery, so
+    the sweep and `namespaced:` in APIResourceList can't drift."""
+    from kubernetes_tpu.apiserver.http import RESOURCES, APIServer
+
+    return tuple(sorted(
+        kind for kind in set(RESOURCES.values())
+        if kind not in APIServer.CLUSTER_SCOPED and kind != "Binding"))
+
+
+# the namespaced kinds swept on termination
+NAMESPACED_KINDS = _namespaced_kinds()
 
 
 class NamespaceController(ReconcileController):

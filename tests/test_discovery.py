@@ -1,0 +1,48 @@
+"""API discovery (/version, /api, /apis, APIResourceList) + kubectl
+api-resources (endpoints/discovery + cmd/apiresources analogs)."""
+
+from kubernetes_tpu.api.objects import CustomResourceDefinition
+from kubernetes_tpu.apiserver import ObjectStore
+
+from tests.http_util import http_store
+from tests.test_kubectl import run_cli
+
+
+def test_discovery_endpoints():
+    store = ObjectStore()
+    store.create(CustomResourceDefinition.from_dict({
+        "metadata": {"name": "gauges.metrics.example.com"},
+        "spec": {"group": "metrics.example.com", "version": "v1",
+                 "names": {"plural": "gauges", "kind": "Gauge"}}}))
+    with http_store(store) as (client, _):
+        version = client._request("GET", "/version")
+        assert version["major"] == "1" and version["minor"] == "8"
+        api = client._request("GET", "/api")
+        assert api["versions"] == ["v1"]
+        groups = client._request("GET", "/apis")
+        names = {g["name"] for g in groups["groups"]}
+        assert {"apps", "batch", "extensions", "autoscaling",
+                "policy", "metrics.example.com"} <= names
+        core = client._request("GET", "/api/v1")
+        by_name = {r["name"]: r for r in core["resources"]}
+        assert by_name["pods"]["namespaced"] is True
+        assert by_name["nodes"]["namespaced"] is False
+        assert "deployments" not in by_name  # group resource, not core
+        batch = client._request("GET", "/apis/batch/v1")
+        assert [r["kind"] for r in batch["resources"]] == ["Job"]
+        crd_group = client._request("GET", "/apis/metrics.example.com/v1")
+        assert crd_group["resources"][0]["name"] == "gauges"
+        assert crd_group["resources"][0]["kind"] == "Gauge"
+
+
+def test_kubectl_api_resources():
+    with http_store() as (client, _):
+        rc, out = run_cli(client, "api-resources")
+        assert rc == 0
+        lines = out.splitlines()
+        assert lines[0].split() == ["NAME", "APIVERSION", "NAMESPACED",
+                                    "KIND"]
+        body = "\n".join(lines[1:])
+        assert "pods" in body and "Pod" in body
+        assert "deployments" in body and "extensions/v1beta1" in body
+        assert "cronjobs" in body and "batch/v2alpha1" in body
