@@ -392,6 +392,18 @@ class Scheduler:
         except AttributeError:
             pass
         timer.step("device dispatch")
+        # start the blocking host fetch NOW in a worker thread: by settle
+        # time (up to pipeline_depth dispatches later) the round trip has
+        # already been paid in the background — profiling showed the event
+        # loop idling ~0.3s per batch in select() when the fetch thread
+        # only started at settle
+        fetch = asyncio.get_running_loop().create_task(
+            asyncio.to_thread(np.asarray, result.assignments))
+        # retrieve (and discard) failures so an entry popped by the sync
+        # stop() path can't leave an un-retrieved task exception behind;
+        # the settle path handles the error itself via a fresh fetch
+        fetch.add_done_callback(
+            lambda t: None if t.cancelled() else t.exception())
         # pipeline only under sustained load (more pods already queued →
         # another call is imminent); a drained queue settles synchronously
         # so small/interactive workloads keep request-response semantics
@@ -401,12 +413,12 @@ class Scheduler:
             # oldest batches while this one computes
             self.statedb.adopt_result(result)
             self._inflight_q.append((result, pods, live_keys, (fblob, iblob),
-                                     flags, t0, timer, True))
+                                     flags, t0, timer, True, fetch))
             while len(self._inflight_q) > self.pipeline_depth:
                 settled += await self._asettle_one()
             return settled
         self._inflight_q.append((result, pods, live_keys, (fblob, iblob),
-                                 flags, t0, timer, False))
+                                 flags, t0, timer, False, fetch))
         return settled + await self._asettle_inflight()
 
     def _settle_inflight(self) -> int:
@@ -424,16 +436,30 @@ class Scheduler:
         return settled
 
     async def _asettle_one(self) -> int:
-        """Async settle: the device->host readback blocks in a worker
-        thread, so the event loop keeps running informers / encoding the
-        next batch during the transport round trip (~120 ms on the remote
-        tunnel) instead of stalling the whole driver on np.asarray."""
+        """Async settle: the readback was started in a worker thread AT
+        DISPATCH, so by now (up to pipeline_depth dispatches later) the
+        transport round trip has usually already completed — this await
+        is a cache hit in steady state; the event loop keeps running
+        informers / encoding during any residual wait."""
         if not self._inflight_q:
             return 0
         entry = self._inflight_q[0]
         t0 = time.monotonic()
-        assignments = await asyncio.to_thread(np.asarray,
-                                              entry[0].assignments)
+        try:
+            assignments = await entry[8]
+        except asyncio.CancelledError:
+            if not entry[8].cancelled():
+                raise  # WE were cancelled, not the fetch task
+            assignments = None  # fetch cancelled: re-read below
+        except Exception:  # noqa: BLE001 — transient transport failure
+            # a poisoned prefetch must not wedge the queue forever: the
+            # old per-settle fetch retried fresh every attempt; do the same
+            log.warning("prefetch failed; re-reading assignments",
+                        exc_info=True)
+            assignments = None
+        if assignments is None:
+            assignments = await asyncio.to_thread(
+                np.asarray, entry[0].assignments)
         waited = time.monotonic() - t0
         if not self._inflight_q or self._inflight_q[0] is not entry:
             return 0  # settled by stop() while we waited
@@ -446,7 +472,14 @@ class Scheduler:
         if not self._inflight_q:
             return 0
         (result, pods, live_keys, blobs, flags, t0, timer,
-         adopted) = self._inflight_q.popleft()
+         adopted, fetch) = self._inflight_q.popleft()
+        if assignments is None and fetch.done() \
+                and not fetch.cancelled() and fetch.exception() is None:
+            assignments = fetch.result()  # prefetch already landed
+        # NOTE: never cancel() an unfinished fetch here — a concurrently
+        # suspended _asettle_one is awaiting it, and cancellation would
+        # propagate into that coroutine; the duplicate synchronous read
+        # below is harmless
         t_wait = time.monotonic()
         if assignments is None:
             assignments = np.asarray(result.assignments)
