@@ -50,7 +50,7 @@ def main() -> None:
     n_nodes = int(os.environ.get("BENCH_NODES", "15000"))
     n_pods = int(os.environ.get("BENCH_PODS", "30000"))
     configs = os.environ.get("BENCH_CONFIGS",
-                             "headline,interpod,spread,recovery")
+                             "headline,interpod,spread,recovery,device")
     configs = [c.strip() for c in configs.split(",") if c.strip()]
 
     import jax
@@ -72,6 +72,8 @@ def main() -> None:
         RESULT["vs_baseline"] = round(r.pods_per_sec / baseline, 2)
         extras["headline_e2e_p50_ms"] = round(r.metrics["e2e_p50_ms"], 1)
         extras["headline_e2e_p99_ms"] = round(r.metrics["e2e_p99_ms"], 1)
+        if "phase_us_per_pod" in r.metrics:
+            extras["headline_phase_us_per_pod"] = r.metrics["phase_us_per_pod"]
 
     if "interpod" in configs:
         interpod_nodes = min(n_nodes, 5000)
@@ -96,6 +98,8 @@ def main() -> None:
         extras["spread_15k_pods_per_sec"] = round(r.pods_per_sec, 1)
         extras["spread_vs_baseline"] = round(r.pods_per_sec / baseline, 2)
         extras["spread_e2e_p50_ms"] = round(r.metrics["e2e_p50_ms"], 1)
+        if "phase_us_per_pod" in r.metrics:
+            extras["spread_phase_us_per_pod"] = r.metrics["phase_us_per_pod"]
 
     if "recovery" in configs:
         from kubernetes_tpu.perf.harness import run_recovery
@@ -105,6 +109,16 @@ def main() -> None:
         extras["recovery_seconds_kill10pct_200n"] = round(
             r.seconds_to_recover, 2)
         extras["recovery_stranded_pods"] = r.stranded
+
+    if "device" in configs:
+        # transport-independent: steady-state compiled-solver throughput
+        # with device-resident state (stable vs tunnel weather, PERF.md)
+        from kubernetes_tpu.perf.harness import run_device_solve
+
+        r = run_device_solve(min(n_nodes, 15000), batch_pods=4096)
+        print(f"bench[device]: {r}", file=sys.stderr, flush=True)
+        extras["device_solve_pods_per_sec"] = round(r.pods_per_sec, 1)
+        extras["device_solve_ms"] = round(r.ms_per_solve, 2)
 
     if RESULT["value"] is None and extras:
         # headline config not selected: promote the first metric actually

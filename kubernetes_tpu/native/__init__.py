@@ -1,15 +1,16 @@
 """Native (C) host-plane kernels, built on first import.
 
 The reference's runtime is compiled Go; this package gives the framework's
-host plane the same native tier where it does byte-level work — currently
-the FNV-1a hashing kernel behind universe interning (utils/hashing.py).
+host plane the same native tier where it does byte-level work — the FNV-1a
+hashing kernel behind universe interning (utils/hashing.py) and the
+ledger scatter-add behind batch commit (state/statedb.py commit_batch).
 
-Build strategy: compile `fnv.c` with the system C compiler into the
+Build strategy: compile each .c with the system C compiler into the
 package's `_build/` directory the first time it is imported (a few ms,
 cached thereafter, keyed by source mtime) and bind it with ctypes — the
 image ships g++/cc but not pybind11. Any failure (no compiler, read-only
-filesystem) degrades silently to the pure-Python implementations; callers
-check `fnv1a64 is not None`.
+filesystem) degrades silently to the pure-Python/numpy implementations;
+callers check the function for None.
 """
 
 from __future__ import annotations
@@ -24,26 +25,41 @@ log = logging.getLogger(__name__)
 
 fnv1a64 = None          # (bytes) -> int, or None when unavailable
 lanes_batch = None      # (list[bytes]) -> (np.uint32[n], np.uint32[n])
+scatter_add_cols = None  # (dst2d, src2d, off, rows_i64, width) -> touched
 
 
-def _build_and_bind():
-    global fnv1a64, lanes_batch
-
-    src = os.path.join(os.path.dirname(__file__), "fnv.c")
+def _build_lib(src_name: str) -> ctypes.CDLL | None:
+    """Compile `src_name` (beside this file) into _build/ if stale and load
+    it. Build via a temp file + rename so concurrent importers can race.
+    Returns None on any failure (callers degrade to pure Python)."""
+    src = os.path.join(os.path.dirname(__file__), src_name)
     build_dir = os.path.join(os.path.dirname(__file__), "_build")
-    lib_path = os.path.join(build_dir, "libfnv.so")
+    stem = os.path.splitext(src_name)[0]
+    lib_path = os.path.join(build_dir, f"lib{stem}.so")
     try:
         if (not os.path.exists(lib_path)
                 or os.path.getmtime(lib_path) < os.path.getmtime(src)):
             os.makedirs(build_dir, exist_ok=True)
-            # build via a temp file + rename: concurrent importers race
             fd, tmp = tempfile.mkstemp(dir=build_dir, suffix=".so")
             os.close(fd)
             subprocess.run(
                 ["cc", "-O2", "-shared", "-fPIC", "-o", tmp, src],
                 check=True, capture_output=True, timeout=60)
             os.replace(tmp, lib_path)
-        lib = ctypes.CDLL(lib_path)
+        return ctypes.CDLL(lib_path)
+    except (OSError, subprocess.SubprocessError) as e:
+        log.debug("native %s unavailable (%s); using pure Python",
+                  src_name, e)
+        return None
+
+
+def _bind_fnv():
+    global fnv1a64, lanes_batch
+
+    lib = _build_lib("fnv.c")
+    if lib is None:
+        return
+    try:
         # symbol binding stays inside the guard: a stale .so missing a
         # symbol must degrade to pure Python, not crash the import
         lib.fnv1a64.restype = ctypes.c_uint64
@@ -53,8 +69,8 @@ def _build_and_bind():
             ctypes.c_char_p, ctypes.POINTER(ctypes.c_size_t),
             ctypes.c_size_t, ctypes.POINTER(ctypes.c_uint32),
             ctypes.POINTER(ctypes.c_uint32)]
-    except (OSError, subprocess.SubprocessError, AttributeError) as e:
-        log.debug("native fnv unavailable (%s); using pure Python", e)
+    except AttributeError as e:
+        log.debug("native fnv symbols unavailable (%s)", e)
         return
 
     def _fnv1a64(data: bytes) -> int:
@@ -83,4 +99,38 @@ def _build_and_bind():
     lanes_batch = _lanes_batch
 
 
-_build_and_bind()
+def _bind_commitops():
+    global scatter_add_cols
+
+    lib = _build_lib("commitops.c")
+    if lib is None:
+        return
+    try:
+        lib.scatter_add_cols.restype = ctypes.c_uint64
+        lib.scatter_add_cols.argtypes = [
+            ctypes.POINTER(ctypes.c_float), ctypes.c_size_t,
+            ctypes.POINTER(ctypes.c_float), ctypes.c_size_t, ctypes.c_size_t,
+            ctypes.POINTER(ctypes.c_int64), ctypes.c_size_t, ctypes.c_size_t]
+    except AttributeError as e:
+        log.debug("native commitops symbols unavailable (%s)", e)
+        return
+
+    c_float_p = ctypes.POINTER(ctypes.c_float)
+    c_int64_p = ctypes.POINTER(ctypes.c_int64)
+
+    def _scatter_add_cols(dst, src, off: int, rows, width: int) -> int:
+        """dst[rows[k], :width] += src[k, off:off+width] for every k.
+
+        dst: C-contiguous float32 (N, W>=width); src: C-contiguous float32
+        (n, F); rows: int64 (n,). Returns how many k had a nonzero source
+        slice."""
+        return lib.scatter_add_cols(
+            dst.ctypes.data_as(c_float_p), dst.strides[0] // 4,
+            src.ctypes.data_as(c_float_p), src.strides[0] // 4, off,
+            rows.ctypes.data_as(c_int64_p), len(rows), width)
+
+    scatter_add_cols = _scatter_add_cols
+
+
+_bind_fnv()
+_bind_commitops()

@@ -1,0 +1,35 @@
+/* Host-ledger scatter-add — the commit half of the scheduler's bind path.
+ *
+ * After every solved batch the driver mirrors the device ledger into host
+ * numpy: for each committed pod, add its packed encode-row columns onto its
+ * node's ledger row (kubernetes_tpu/state/statedb.py commit_batch; the host
+ * analog of the scheduler cache's AssumePod accounting,
+ * reference plugin/pkg/scheduler/schedulercache/cache.go:109). numpy's
+ * segmented-reduction formulation (argsort + add.reduceat) measured
+ * ~17 us/pod at bench scale; this loop is the same arithmetic done once,
+ * in row order, at memory bandwidth.
+ *
+ * Returns the number of pods whose source slice had any nonzero element —
+ * the callers' cheap "did this group participate at all" signal (drives
+ * coverage-based dirtiness in commit_batch).
+ */
+
+#include <stddef.h>
+#include <stdint.h>
+
+uint64_t scatter_add_cols(float *dst, size_t dst_stride,
+                          const float *src, size_t src_stride, size_t off,
+                          const int64_t *rows, size_t n, size_t width) {
+    uint64_t touched = 0;
+    for (size_t k = 0; k < n; k++) {
+        float *d = dst + (size_t)rows[k] * dst_stride;
+        const float *s = src + (size_t)k * src_stride + off;
+        uint64_t any = 0;
+        for (size_t w = 0; w < width; w++) {
+            d[w] += s[w];
+            any |= (s[w] != 0.0f);
+        }
+        touched += any;
+    }
+    return touched;
+}

@@ -94,6 +94,77 @@ async def _run(n_nodes: int, n_pods: int, caps: Capacities, policy: Policy,
 
 
 @dataclass
+class DeviceSolveResult:
+    """Steady-state compiled-solver throughput with device-resident state —
+    the transport-independent number (tunnel RTT/bandwidth variance moves
+    the e2e figure up to 3×; this one is stable run-to-run)."""
+
+    n_nodes: int
+    batch_pods: int
+    iters: int
+    ms_per_solve: float
+    pods_per_sec: float
+
+    def __str__(self) -> str:
+        return (f"device solve N={self.n_nodes} P={self.batch_pods}: "
+                f"{self.ms_per_solve:.2f} ms/solve = "
+                f"{self.pods_per_sec:.0f} pods/s")
+
+
+def run_device_solve(
+    n_nodes: int,
+    batch_pods: int = 4096,
+    iters: int = 16,
+    policy: Policy = DEFAULT_POLICY,
+    node_kwargs: dict | None = None,
+    pod_kwargs: dict | None = None,
+) -> DeviceSolveResult:
+    """Time the compiled solver alone: encode one batch, then dispatch it
+    `iters` times against device-resident state and block once at the end.
+    The chained-dispatch shape matches the driver's steady state (PERF.md's
+    'device-only solve' rows)."""
+    import numpy as np
+
+    from kubernetes_tpu.state.pod_batch import packed_batch_flags
+
+    store = ObjectStore()
+    for node in make_nodes(n_nodes, **(node_kwargs or {})):
+        store.create(node)
+    num = 1 << max(6, (n_nodes - 1).bit_length())
+    caps = Capacities(num_nodes=num, batch_pods=batch_pods)
+    sched = Scheduler(store, caps=caps, policy=policy)
+    for node in store.list("Node", copy_objects=False):
+        sched.statedb.upsert_node(node)
+    fblob, iblob = sched._next_blobs()
+    for i, pod in enumerate(make_pods(batch_pods, **(pod_kwargs or {}))):
+        sched.encode_cache.encode_packed_into(fblob, iblob, i, pod)
+    flags = packed_batch_flags(fblob, iblob, batch_pods,
+                               sched.statedb.table, caps)
+    fn = sched._get_schedule_fn(flags)
+    state = sched.statedb.flush()
+    rr = np.uint32(0)
+    import jax
+
+    # pin the packed batch on device once: this measures the solver, not
+    # the per-call blob upload (which the e2e figure already carries)
+    fblob, iblob = jax.device_put(fblob), jax.device_put(iblob)
+    warm = fn(state, fblob, iblob, rr)   # compile + device warmup
+    np.asarray(warm.assignments)
+    rr = warm.rr_end                     # device-resident, chained like the
+    t0 = time.perf_counter()             # driver's steady state
+    last = None
+    for _ in range(iters):
+        last = fn(state, fblob, iblob, rr)
+        rr = last.rr_end
+    np.asarray(last.assignments)
+    dt = time.perf_counter() - t0
+    return DeviceSolveResult(
+        n_nodes=n_nodes, batch_pods=batch_pods, iters=iters,
+        ms_per_solve=1e3 * dt / iters,
+        pods_per_sec=iters * batch_pods / dt if dt > 0 else 0.0)
+
+
+@dataclass
 class RecoveryResult:
     nodes: int
     killed: int

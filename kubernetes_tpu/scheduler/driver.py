@@ -55,10 +55,17 @@ class SchedulerMetrics:
     e2e_latency: deque = field(default_factory=lambda: deque(maxlen=8192))
     algorithm_latency: deque = field(default_factory=lambda: deque(maxlen=8192))
     binding_latency: deque = field(default_factory=lambda: deque(maxlen=8192))
+    # cumulative host-plane phase costs (seconds) — the transport-independent
+    # breakdown: tunnel weather moves settle_wait, not encode/bind/commit
+    phase_s: dict = field(default_factory=dict)
+    phase_pods: int = 0
+
+    def add_phase(self, name: str, seconds: float) -> None:
+        self.phase_s[name] = self.phase_s.get(name, 0.0) + seconds
 
     def snapshot(self) -> dict:
         lat = sorted(self.e2e_latency) or [0.0]
-        return {
+        out = {
             "scheduled": self.scheduled,
             "failed": self.failed,
             "binding_errors": self.binding_errors,
@@ -66,6 +73,11 @@ class SchedulerMetrics:
             "e2e_p50_ms": 1e3 * lat[len(lat) // 2],
             "e2e_p99_ms": 1e3 * lat[min(len(lat) - 1, int(len(lat) * 0.99))],
         }
+        if self.phase_pods:
+            out["phase_us_per_pod"] = {
+                k: round(1e6 * v / self.phase_pods, 2)
+                for k, v in sorted(self.phase_s.items())}
+        return out
 
 
 def store_encode_context(store: ObjectStore, policy: Policy = DEFAULT_POLICY,
@@ -330,6 +342,7 @@ class Scheduler:
         if not keys:
             return await self._asettle_inflight()
 
+        t_phase = time.perf_counter()
         fblob, iblob = self._next_blobs()
         pods: list[Pod] = []
         live_keys: list[str] = []
@@ -366,6 +379,8 @@ class Scheduler:
         if len(pods) < self.caps.batch_pods:
             fblob[len(pods):] = 0.0
             iblob[len(pods):] = 0
+        self.metrics.add_phase("encode", time.perf_counter() - t_phase)
+        self.metrics.phase_pods += len(pods)
 
         timer = StepTimer(f"scheduling batch of {len(pods)}")
         from kubernetes_tpu.state.pod_batch import packed_batch_flags
@@ -379,7 +394,9 @@ class Scheduler:
             # a dirty flush would re-upload host truth that misses the
             # in-flight batches' charges: settle them first
             settled += await self._asettle_inflight()
+        t_phase = time.perf_counter()
         state = self.statedb.flush()
+        self.metrics.add_phase("flush", time.perf_counter() - t_phase)
         timer.step("encode + flush")
 
         t0 = time.monotonic()
@@ -391,6 +408,7 @@ class Scheduler:
             result.assignments.copy_to_host_async()
         except AttributeError:
             pass
+        self.metrics.add_phase("dispatch", time.monotonic() - t0)
         timer.step("device dispatch")
         # start the blocking host fetch NOW in a worker thread: by settle
         # time (up to pipeline_depth dispatches later) the round trip has
@@ -461,6 +479,7 @@ class Scheduler:
             assignments = await asyncio.to_thread(
                 np.asarray, entry[0].assignments)
         waited = time.monotonic() - t0
+        self.metrics.add_phase("settle_wait", waited)
         if not self._inflight_q or self._inflight_q[0] is not entry:
             return 0  # settled by stop() while we waited
         return self._settle_one(assignments, waited=waited)
@@ -483,6 +502,8 @@ class Scheduler:
         t_wait = time.monotonic()
         if assignments is None:
             assignments = np.asarray(result.assignments)
+            self.metrics.add_phase("settle_wait",
+                                   time.monotonic() - t_wait)
         # synchronous batches observe the true dispatch-to-ready span; for a
         # pipelined batch only the readback wait is observable (the full
         # span would count the successor's host work as algorithm time) —
@@ -501,37 +522,74 @@ class Scheduler:
         committed: list[tuple[Pod, str, int]] = []
         any_rejected = False
         t_bind = time.monotonic()
+        # partition the batch: assigned rows to bind vs solver rejections
+        name_of = self.statedb.table.name_of
+        rows = assignments[:len(pods)].tolist()
+        to_bind: list[tuple[int, str, Pod, str]] = []
         for i, (key, pod) in enumerate(zip(live_keys, pods)):
-            row = int(assignments[i])
+            row = rows[i]
             if row < 0:
                 self._fail(key, pod, "no nodes available to schedule pods")
                 continue
-            node_name = self.statedb.table.name_of[row]
+            node_name = name_of[row]
             if node_name is None:
                 any_rejected = True  # the vanished node left a ledger charge
                 self._fail(key, pod, "assigned node vanished")
                 continue
-            try:
-                self.store.bind(Binding(pod_name=pod.metadata.name,
-                                        namespace=pod.metadata.namespace,
-                                        target_node=node_name))
-            except (Conflict, NotFound) as e:
+            to_bind.append((i, key, pod, node_name))
+
+        # one bulk store transaction for the whole batch's bindings (the
+        # serial per-pod path was the measured e2e wall, PERF.md); stores
+        # without the bulk verb (RemoteStore) fall back per pod
+        bind_many = getattr(self.store, "bind_many", None)
+        if to_bind and bind_many is not None:
+            errs = bind_many(
+                [Binding(pod_name=pod.metadata.name,
+                         namespace=pod.metadata.namespace,
+                         target_node=node_name)
+                 for _i, _k, pod, node_name in to_bind])[1]
+        elif to_bind:
+            errs = []
+            for _i, _key, pod, node_name in to_bind:
+                try:
+                    self.store.bind(Binding(pod_name=pod.metadata.name,
+                                            namespace=pod.metadata.namespace,
+                                            target_node=node_name))
+                    errs.append(None)
+                except (Conflict, NotFound) as e:
+                    errs.append(e)
+        else:
+            errs = []
+
+        now = time.monotonic()
+        event_entries: list[tuple[Pod, str]] = []
+        assumed_add = self._assumed.add
+        queue_done = self.queue.done
+        backoff_reset = self.backoff.reset
+        enq_pop = self._enqueue_time.pop
+        e2e_append = self.metrics.e2e_latency.append
+        for (i, key, pod, node_name), err in zip(to_bind, errs):
+            if err is not None:
                 # the solver's ledger charged this pod; drop that ledger below
                 any_rejected = True
                 self.metrics.binding_errors += 1
-                self._fail(key, pod, f"binding rejected: {e}")
+                self._fail(key, pod, f"binding rejected: {err}")
                 continue
-            self._assumed.add(key)
+            assumed_add(key)
             committed.append((pod, node_name, i))
             scheduled += 1
-            self.queue.done(key)
-            self.backoff.reset(key)
-            enq = self._enqueue_time.pop(key, None)
+            queue_done(key)
+            backoff_reset(key)
+            enq = enq_pop(key, None)
             if enq is not None:
-                self.metrics.e2e_latency.append(time.monotonic() - enq)
-            self.events.record(pod, "Normal", "Scheduled",
-                               f"Successfully assigned {key} to {node_name}")
+                e2e_append(now - enq)
+            event_entries.append(
+                (pod, f"Successfully assigned {key} to {node_name}"))
+        if event_entries:
+            self.events.record_many(event_entries, "Normal", "Scheduled")
+        self.metrics.add_phase("bind", time.monotonic() - t_bind)
 
+        t_commit = time.monotonic()
         if any_rejected:
             # the solver output charges pods whose binding failed: keep the
             # host truth (accounting only bound pods) and force a re-upload
@@ -548,6 +606,7 @@ class Scheduler:
             self.statedb.commit_batch(
                 result, fblob, committed, replace_device=not adopted,
                 coverage=ledger_coverage(self.policy, flags))
+        self.metrics.add_phase("commit", time.monotonic() - t_commit)
         if scheduled:
             # per-pod binding latency (the batch amortizes one write loop)
             self.metrics.binding_latency.append(

@@ -26,6 +26,76 @@ class EventRecorder:
         # under load; bounded so a long-lived process cannot grow it forever
         self._known: OrderedDict[tuple[str, str], None] = OrderedDict()
 
+    def record_many(
+            self, entries: list[tuple], event_type: str, reason: str) -> None:
+        """Batched recording of one (type, reason) across many objects — the
+        scheduler's per-batch `Scheduled` burst. entries = (obj, message)
+        pairs. First-time names (the overwhelming case: event names embed
+        the per-pod object name) go through the store's bulk-create path in
+        one pass; repeats fall back to the aggregating record()."""
+        fresh: list[Event] = []
+        fresh_keys: list[tuple[str, str]] = []
+        reason_suffix = f".{reason.lower()}"
+        for obj, message in entries:
+            name = obj.metadata.name + reason_suffix
+            namespace = obj.metadata.namespace
+            key = (namespace, name)
+            if key in self._known:
+                self.record(obj, event_type, reason, message)
+                continue
+            fresh.append(Event(
+                metadata=ObjectMeta(name=name, namespace=namespace),
+                involved_object={
+                    "kind": obj.kind,
+                    "name": obj.metadata.name,
+                    "namespace": namespace,
+                    "uid": obj.metadata.uid,
+                },
+                reason=reason,
+                message=message,
+                type=event_type,
+                source_component=self.component,
+            ))
+            fresh_keys.append(key)
+        if not fresh:
+            return
+        create_many = getattr(self.store, "create_many", None)
+        if create_many is None:
+            for event in fresh:
+                try:
+                    self.store.create(event, copy=False)
+                except AlreadyExists:
+                    # aggregate like record(): the name exists, bump count
+                    existing = self.store.get("Event", event.metadata.name,
+                                              event.metadata.namespace)
+                    existing.count += 1
+                    existing.message = event.message
+                    self.store.update(existing, check_version=False)
+        else:
+            try:
+                create_many(fresh)
+            except AlreadyExists:
+                # a name existed that _known had forgotten: replay per-event,
+                # aggregating onto existing objects (count += 1); an existing
+                # object carrying OUR uid was the batch's own committed
+                # prefix and is left alone
+                for event in fresh:
+                    try:
+                        self.store.create(event, copy=False)
+                    except AlreadyExists:
+                        existing = self.store.get(
+                            "Event", event.metadata.name,
+                            event.metadata.namespace)
+                        if existing.metadata.uid == event.metadata.uid:
+                            continue
+                        existing.count += 1
+                        existing.message = event.message
+                        self.store.update(existing, check_version=False)
+        for key in fresh_keys:
+            self._known[key] = None
+        while len(self._known) > _KNOWN_MAX:
+            self._known.popitem(last=False)
+
     def record(self, obj, event_type: str, reason: str, message: str) -> Event:
         name = f"{obj.metadata.name}.{reason.lower()}"
         namespace = obj.metadata.namespace

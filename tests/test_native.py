@@ -46,3 +46,28 @@ def test_zero_lane_remap_in_batch():
 def test_public_fnv_uses_some_backend():
     # whichever backend is live, the public function stays deterministic
     assert fnv1a64("abc") == fnv1a64(b"abc") == _fnv1a64_py(b"abc")
+
+
+def test_scatter_add_cols_matches_numpy():
+    import numpy as np
+
+    if native.scatter_add_cols is None:
+        import pytest
+
+        pytest.skip("native commitops unavailable")
+    rng = np.random.default_rng(3)
+    n_nodes, n_pods, width_total = 37, 211, 29
+    src = rng.random((n_pods, width_total), np.float32)
+    src[rng.random((n_pods, width_total)) < 0.5] = 0.0
+    rows = rng.integers(0, n_nodes, n_pods).astype(np.int64)
+    for off, width in ((0, 7), (7, 1), (8, 21), (3, 0)):
+        dst = rng.random((n_nodes, width), np.float32).copy() if width else \
+            np.zeros((n_nodes, 0), np.float32)
+        want = dst.copy()
+        np.add.at(want, rows, src[:, off:off + width])
+        touched = native.scatter_add_cols(dst, src, off, rows, width) \
+            if width else 0
+        np.testing.assert_allclose(dst, want, rtol=1e-6)
+        if width:
+            assert touched == int(
+                (src[:, off:off + width] != 0).any(axis=1).sum())

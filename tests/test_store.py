@@ -188,3 +188,103 @@ def test_delete_collection_over_http():
         # full-collection sweep
         assert client.delete_collection("Pod", "default") == 2
         assert client.list("Pod") == []
+
+
+def test_bind_many_matches_serial_semantics():
+    """Bulk bindings: per-pod rv/event parity with serial bind(); per-entry
+    failures (not-found, already-bound) don't fail the batch."""
+    import asyncio
+
+    from kubernetes_tpu.api.objects import Binding, Pod
+
+    async def run():
+        store = ObjectStore()
+        for i in range(3):
+            store.create(Pod.from_dict({
+                "metadata": {"name": f"p{i}"},
+                "spec": {"containers": [{"name": "c"}]}}))
+        store.bind(Binding(pod_name="p1", namespace="default",
+                           target_node="taken"))
+        stream = store.watch("Pod")
+        bound, errs = store.bind_many([
+            Binding(pod_name="p0", namespace="default", target_node="n0"),
+            Binding(pod_name="p1", namespace="default", target_node="n1"),
+            Binding(pod_name="ghost", namespace="default", target_node="n2"),
+            Binding(pod_name="p2", namespace="default", target_node="n3"),
+        ])
+        assert bound[0].spec.node_name == "n0" and errs[0] is None
+        assert bound[1] is None and isinstance(errs[1], Conflict)
+        assert bound[2] is None and isinstance(errs[2], NotFound)
+        assert bound[3].spec.node_name == "n3" and errs[3] is None
+        # each successful bind got its own rv, in order, and one MODIFIED
+        ev0 = await stream.next(timeout=1)
+        ev3 = await stream.next(timeout=1)
+        assert (ev0.obj.metadata.name, ev3.obj.metadata.name) == ("p0", "p2")
+        assert ev0.resource_version < ev3.resource_version
+        assert store.get("Pod", "p1").spec.node_name == "taken"
+        # stored pods share immutable innards but fresh spec/meta shells
+        assert store.get("Pod", "p0").spec.node_name == "n0"
+        stream.stop()
+
+    asyncio.run(run())
+
+
+def test_create_many_events_and_watch_order():
+    import asyncio
+
+    from kubernetes_tpu.api.objects import Event, ObjectMeta
+
+    async def run():
+        store = ObjectStore()
+        stream = store.watch("Event")
+        events = [Event(metadata=ObjectMeta(name=f"e{i}"), reason="R",
+                        message=f"m{i}") for i in range(4)]
+        out = store.create_many(events)
+        assert [o.metadata.name for o in out] == [f"e{i}" for i in range(4)]
+        rvs = [int(o.metadata.resource_version) for o in out]
+        assert rvs == sorted(rvs) and len(set(rvs)) == 4
+        for i in range(4):
+            ev = await stream.next(timeout=1)
+            assert ev.type == "ADDED" and ev.obj.metadata.name == f"e{i}"
+        stream.stop()
+
+    asyncio.run(run())
+
+
+def test_create_many_duplicate_raises_after_prefix_commit():
+    from kubernetes_tpu.api.objects import Event, ObjectMeta
+
+    store = ObjectStore()
+    store.create(Event(metadata=ObjectMeta(name="dup"), reason="R"))
+    events = [Event(metadata=ObjectMeta(name="ok"), reason="R"),
+              Event(metadata=ObjectMeta(name="dup"), reason="R")]
+    try:
+        store.create_many(events)
+        raise AssertionError("expected AlreadyExists")
+    except AlreadyExists:
+        pass
+    # prefix committed (serial-loop semantics)
+    assert store.get("Event", "ok").reason == "R"
+
+
+def test_record_many_aggregates_on_existing_names():
+    from kubernetes_tpu.api.objects import Pod
+    from kubernetes_tpu.utils.events import EventRecorder
+
+    store = ObjectStore()
+    pods = [Pod.from_dict({"metadata": {"name": f"p{i}"},
+                           "spec": {"containers": [{"name": "c"}]}})
+            for i in range(3)]
+    rec = EventRecorder(store)
+    rec.record(pods[0], "Normal", "Scheduled", "first")
+    rec.record_many([(p, f"assigned {p.metadata.name}") for p in pods],
+                    "Normal", "Scheduled")
+    evs = {e.metadata.name: e for e in store.list("Event",
+                                                  copy_objects=False)}
+    assert len(evs) == 3
+    assert evs["p0.scheduled"].count == 2          # aggregated, not duped
+    assert evs["p1.scheduled"].count == 1
+    # a name present in the store but unknown to the recorder aggregates too
+    rec2 = EventRecorder(store)
+    rec2.record_many([(pods[1], "again")], "Normal", "Scheduled")
+    assert store.get("Event", "p1.scheduled").count == 2
