@@ -159,6 +159,12 @@ class Scheduler:
         self._enqueue_time: dict[str, float] = {}
         self._rr = np.uint32(0)
         self._blob_pool: list = []
+        # deferred Scheduled-event buffer: recording is off the
+        # batch-critical path, flushed when the loop next idles (the
+        # EventBroadcaster's buffered-channel shape, record/event.go:78);
+        # stop() flushes synchronously so no event is ever dropped
+        self._pending_events: list[tuple[Pod, str]] = []
+        self._event_flush_scheduled = False
         # node name -> keys of bound pods seen on it (indexed even before
         # the node itself is known, so a late node event re-accounts them);
         # replaces the O(nodes x pods) informer sweep per node event
@@ -299,9 +305,37 @@ class Scheduler:
         await self.node_informer.wait_for_sync()
         await self.pod_informer.wait_for_sync()
 
+    def _flush_events(self) -> None:
+        """Record buffered Scheduled events (runs when the event loop next
+        idles — typically inside the transport wait of the following
+        batch's settle — or synchronously from stop()). A failing store
+        keeps the entries for the next flush (bounded retries) instead of
+        silently dropping them into the loop's exception handler."""
+        self._event_flush_scheduled = False
+        if not self._pending_events:
+            return
+        entries, self._pending_events = self._pending_events, []
+        t0 = time.monotonic()
+        try:
+            self.events.record_many(entries, "Normal", "Scheduled")
+            self._event_flush_failures = 0
+        except Exception:  # noqa: BLE001 — events must not kill the driver
+            self._event_flush_failures = getattr(
+                self, "_event_flush_failures", 0) + 1
+            if self._event_flush_failures <= 3:
+                log.warning("event flush failed (attempt %d); retrying on "
+                            "next flush", self._event_flush_failures,
+                            exc_info=True)
+                self._pending_events = entries + self._pending_events
+            else:
+                log.error("event flush failed %d times; dropping %d events",
+                          self._event_flush_failures, len(entries))
+        self.metrics.add_phase("events_async", time.monotonic() - t0)
+
     def stop(self) -> None:
         self._stopped = True
         self._settle_inflight()
+        self._flush_events()
         self.queue.close()
         self.node_informer.stop()
         self.pod_informer.stop()
@@ -451,6 +485,11 @@ class Scheduler:
         settled = 0
         while self._inflight_q:
             settled += await self._asettle_one()
+        # fully drained: make deferred events visible before returning, so
+        # non-pipelined callers keep request-response semantics (under
+        # sustained pipelined load the call_soon flush runs instead)
+        if self._pending_events:
+            self._flush_events()
         return settled
 
     async def _asettle_one(self) -> int:
@@ -586,7 +625,13 @@ class Scheduler:
             event_entries.append(
                 (pod, f"Successfully assigned {key} to {node_name}"))
         if event_entries:
-            self.events.record_many(event_entries, "Normal", "Scheduled")
+            self._pending_events.extend(event_entries)
+            if not self._event_flush_scheduled:
+                try:
+                    asyncio.get_running_loop().call_soon(self._flush_events)
+                    self._event_flush_scheduled = True
+                except RuntimeError:   # sync stop() path: no running loop
+                    self._flush_events()
         self.metrics.add_phase("bind", time.monotonic() - t_bind)
 
         t_commit = time.monotonic()
