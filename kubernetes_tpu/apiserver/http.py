@@ -41,6 +41,7 @@ from typing import Any
 from urllib.parse import parse_qs, urlsplit
 
 from kubernetes_tpu.api import objects as objs
+from kubernetes_tpu.api import wire
 from kubernetes_tpu.api.objects import Binding
 from kubernetes_tpu.apiserver.admission import AdmissionError
 from kubernetes_tpu.apiserver.validation import ValidationError
@@ -300,6 +301,15 @@ class APIServer:
 
                 url = urlsplit(target)
                 query = {k: v[-1] for k, v in parse_qs(url.query).items()}
+                # content negotiation (CodecFactory position): protobuf
+                # in/out when the peer asks for it, JSON otherwise
+                accept_pb = wire.available() and \
+                    wire.CONTENT_TYPE in headers.get("accept", "")
+                if wire.available() and headers.get(
+                        "content-type", "").startswith(wire.CONTENT_TYPE):
+                    loads = _wire_loads
+                else:
+                    loads = json.loads
                 denied, user = self._authfilter(
                     "GET" if query.get("watch") in ("1", "true") else method,
                     url.path, headers)
@@ -328,7 +338,8 @@ class APIServer:
                         self._audit_log(user, method, target, status)
                         return
                     self._audit_log(user, method, target, 200)
-                    await self._serve_watch(writer, url.path, query)
+                    await self._serve_watch(writer, url.path, query,
+                                            binary=accept_pb)
                     return  # watch owns the connection until it closes
                 node_proxy = self._node_proxy_target(url.path)
                 if node_proxy is not None:
@@ -338,17 +349,22 @@ class APIServer:
                     return  # the relay owns the connection
                 self._in_flight += 1
                 try:
-                    proxied = await self._aggregate(method, target, body)
+                    proxied = await self._aggregate(
+                        method, target, body,
+                        content_type=headers.get("content-type",
+                                                 "application/json"))
                     if proxied is not None:
                         status, payload = proxied
                     else:
                         status, payload = self._route(method, url.path,
-                                                      query, body)
+                                                      query, body,
+                                                      loads=loads)
                 finally:
                     self._in_flight -= 1
                 self._audit_log(user, method, target, status)
                 keep = headers.get("connection", "keep-alive").lower() != "close"
-                await _respond(writer, status, payload, keep_alive=keep)
+                await _respond(writer, status, payload, keep_alive=keep,
+                               binary=accept_pb)
                 if not keep:
                     return
         except (asyncio.IncompleteReadError, ConnectionError):
@@ -452,12 +468,16 @@ class APIServer:
                 return svc
         return None
 
-    async def _aggregate(self, method: str, target: str, body: bytes):
+    async def _aggregate(self, method: str, target: str, body: bytes,
+                         content_type: str = "application/json"):
         """Proxy one request to the owning extension apiserver, or None to
         serve locally. Unreachable backends are 503 + Available=False on
         the APIService (the aggregator's availability controller,
         kube-aggregator pkg/apiserver/handler_proxy.go + status
-        controller)."""
+        controller). The peer's Content-Type is forwarded (a protobuf body
+        must reach the extension server labeled as such); the backend's
+        response decodes by ITS content-type — the aggregator re-encodes
+        for the original client at _respond."""
         svc = self._api_service_for(urlsplit(target).path)
         if svc is None:
             return None
@@ -475,7 +495,7 @@ class APIServer:
             writer.write(
                 f"{method} {target} HTTP/1.1\r\n"
                 f"Host: {addr.hostname}\r\n"
-                f"Content-Type: application/json\r\n"
+                f"Content-Type: {content_type}\r\n"
                 f"Content-Length: {len(body)}\r\n"
                 f"Connection: close\r\n\r\n".encode() + body)
             await writer.drain()
@@ -499,7 +519,10 @@ class APIServer:
                                     f"backend sent no HTTP response"}
         self._mark_available(svc.metadata.name, True)
         try:
-            payload = json.loads(resp_body) if resp_body else {}
+            if resp_body and wire.CONTENT_TYPE.encode() in head.lower():
+                payload = wire.decode_payload(resp_body)
+            else:
+                payload = json.loads(resp_body) if resp_body else {}
         except ValueError:
             payload = {"message": resp_body.decode(errors="replace")}
         return status, payload
@@ -618,14 +641,15 @@ class APIServer:
                          "resources": resources}
         return None
 
-    def _route(self, method: str, path: str, query: dict, body: bytes):
+    def _route(self, method: str, path: str, query: dict, body: bytes,
+               loads=json.loads):
         discovered = self._discovery(method, path)
         if discovered is not None:
             return discovered
         try:
             ns, _plural, kind, name, sub = self._parse_path(path)
             if sub == "binding" and method == "POST" and kind == "Pod":
-                args = json.loads(body)
+                args = loads(body)
                 target = (args.get("target") or {}).get("name", "")
                 self.store.bind(Binding(pod_name=name,
                                         namespace=ns or "default",
@@ -661,13 +685,13 @@ class APIServer:
                                  str(self.store.resource_version)},
                     "items": [encode_object(o) for o in items]}
             if method == "POST":
-                obj = decode_object(kind, json.loads(body))
+                obj = decode_object(kind, loads(body))
                 if ns:
                     obj.metadata.namespace = ns
                 created = self.store.create(obj)
                 return 201, encode_object(created)
             if method == "PUT" and name is not None:
-                obj = decode_object(kind, json.loads(body))
+                obj = decode_object(kind, loads(body))
                 if ns:
                     obj.metadata.namespace = ns
                 updated = self.store.update(obj)
@@ -762,7 +786,7 @@ class APIServer:
     # ---- watch streaming ----
 
     async def _serve_watch(self, writer: asyncio.StreamWriter, path: str,
-                           query: dict) -> None:
+                           query: dict, binary: bool = False) -> None:
         try:
             ns, _plural, kind, _name, _sub = self._parse_path(path)
         except NotFound as e:
@@ -777,24 +801,30 @@ class APIServer:
             await _respond(writer, 410, {"kind": "Status", "reason": "Gone",
                                          "message": str(e)})
             return
-        writer.write(b"HTTP/1.1 200 OK\r\n"
-                     b"Content-Type: application/json\r\n"
-                     b"Transfer-Encoding: identity\r\n"
-                     b"Connection: close\r\n\r\n")
+        content_type = wire.CONTENT_TYPE if binary else "application/json"
+        writer.write(f"HTTP/1.1 200 OK\r\n"
+                     f"Content-Type: {content_type}\r\n"
+                     f"Transfer-Encoding: identity\r\n"
+                     f"Connection: close\r\n\r\n".encode())
         try:
             while True:
                 event = await stream.next(timeout=30.0)
                 if event is None:
                     # heartbeat frame keeps half-open detection simple
-                    writer.write(b"\n")
+                    writer.write(wire.HEARTBEAT if binary else b"\n")
                     await writer.drain()
                     continue
                 if ns and event.obj.metadata.namespace != ns:
                     continue
-                frame = {"type": event.type,
-                         "resourceVersion": event.resource_version,
-                         "object": encode_object(event.obj)}
-                writer.write(json.dumps(frame).encode() + b"\n")
+                if binary:
+                    writer.write(wire.encode_watch_frame(
+                        event.type, event.resource_version,
+                        encode_object(event.obj)))
+                else:
+                    frame = {"type": event.type,
+                             "resourceVersion": event.resource_version,
+                             "object": encode_object(event.obj)}
+                    writer.write(json.dumps(frame).encode() + b"\n")
                 await writer.drain()
         except (ConnectionError, asyncio.CancelledError):
             pass
@@ -803,16 +833,32 @@ class APIServer:
             writer.close()
 
 
+def _wire_loads(body: bytes) -> dict:
+    """Protobuf request-body decode, failures normalized onto the JSON
+    error path (the 400 BadRequest handler catches ValueError)."""
+    try:
+        return wire.decode_payload(body)
+    except ValueError:
+        raise
+    except Exception as e:  # protobuf DecodeError isn't a ValueError
+        raise ValueError(f"undecodable protobuf body: {e}") from e
+
+
 async def _respond(writer: asyncio.StreamWriter, status: int, payload,
-                   keep_alive: bool = False) -> None:
-    body = json.dumps(payload).encode()
+                   keep_alive: bool = False, binary: bool = False) -> None:
+    content_type = "application/json"
+    if binary and isinstance(payload, dict) and payload.get("kind"):
+        body = wire.encode_payload(payload)
+        content_type = wire.CONTENT_TYPE
+    else:
+        body = json.dumps(payload).encode()
     reason = {200: "OK", 201: "Created", 400: "Bad Request", 404: "Not Found",
               405: "Method Not Allowed", 409: "Conflict",
               410: "Gone"}.get(status, "Error")
     conn = "keep-alive" if keep_alive else "close"
     writer.write(
         f"HTTP/1.1 {status} {reason}\r\n"
-        f"Content-Type: application/json\r\n"
+        f"Content-Type: {content_type}\r\n"
         f"Content-Length: {len(body)}\r\n"
         f"Connection: {conn}\r\n\r\n".encode() + body)
     await writer.drain()
@@ -824,13 +870,43 @@ async def _respond(writer: asyncio.StreamWriter, status: int, payload,
 
 
 class RemoteWatchStream:
-    """Async line-delimited watch frames -> WatchEvent, Informer-compatible."""
+    """Async watch frames -> WatchEvent, Informer-compatible. Frames are
+    JSON lines, or length-prefixed protobuf WatchFrames when the stream was
+    negotiated binary (`binary=True`)."""
 
     def __init__(self, reader: asyncio.StreamReader,
-                 writer: asyncio.StreamWriter):
+                 writer: asyncio.StreamWriter, binary: bool = False):
         self._reader = reader
         self._writer = writer
         self._stopped = False
+        self._binary = binary
+        # a timeout can cancel _read_frame between the length prefix and
+        # the body; the parsed length survives here so the next call
+        # resumes mid-frame instead of desyncing the stream (readexactly
+        # leaves the buffer intact when cancelled mid-wait, so only the
+        # already-consumed prefix needs carrying)
+        self._pending_len: int | None = None
+
+    async def _read_frame(self) -> dict | None:
+        """One frame dict, or None for a heartbeat."""
+        if self._binary:
+            if self._pending_len is None:
+                prefix = await self._reader.readexactly(4)
+                self._pending_len = int.from_bytes(prefix, "big")
+            length = self._pending_len
+            if length == 0:
+                self._pending_len = None
+                return None  # heartbeat
+            body = await self._reader.readexactly(length)
+            self._pending_len = None
+            return wire.decode_watch_frame(body)
+        line = await self._reader.readline()
+        if not line:
+            raise ConnectionError("watch stream closed")
+        line = line.strip()
+        if not line:
+            return None  # heartbeat
+        return json.loads(line)
 
     async def next(self, timeout: float | None = None) -> WatchEvent | None:
         if self._stopped:
@@ -838,20 +914,18 @@ class RemoteWatchStream:
         try:
             while True:
                 if timeout is None:
-                    line = await self._reader.readline()
+                    frame = await self._read_frame()
                 else:
-                    line = await asyncio.wait_for(self._reader.readline(),
-                                                  timeout)
-                if not line:
-                    raise ConnectionError("watch stream closed")
-                line = line.strip()
-                if not line:
+                    frame = await asyncio.wait_for(self._read_frame(),
+                                                   timeout)
+                if frame is None:
                     continue  # heartbeat
-                frame = json.loads(line)
                 obj = decode_object(frame["object"].get("kind"),
                                     frame["object"])
                 return WatchEvent(frame["type"], obj.kind, obj,
                                   int(frame.get("resourceVersion", 0)))
+        except asyncio.IncompleteReadError:
+            raise ConnectionError("watch stream closed") from None
         except asyncio.TimeoutError:
             return None
 
@@ -875,31 +949,58 @@ class RemoteStore:
     scheduler driver, controllers, and the extender run over TCP unchanged."""
 
     def __init__(self, host: str, port: int, token: str = "",
-                 rate_limiter=None):
+                 rate_limiter=None, wire_format: str | None = None):
         self.host = host
         self.port = port
         self.token = token
         # client-go-style token bucket (client/flowcontrol.py); None = no
         # throttling, the in-process/test default
         self.rate_limiter = rate_limiter
+        # content negotiation: "protobuf" (default when the codec is
+        # available — the reference's hot-path default content type) or
+        # "json"; KTPU_WIRE=json forces JSON fleet-wide
+        import os as _os
+
+        fmt = (wire_format or _os.environ.get("KTPU_WIRE", "protobuf"))
+        self._pb = wire.available() and fmt == "protobuf"
 
     def _auth_header(self) -> str:
         return (f"Authorization: Bearer {self.token}\r\n"
                 if self.token else "")
 
-    # ---- blocking HTTP core (CRUD: small JSON on a trusted network) ----
+    # ---- blocking HTTP core (CRUD: small payloads on a trusted network) ----
 
     def _request(self, method: str, path: str, body: dict | None = None):
         if self.rate_limiter is not None:
             self.rate_limiter.accept()
-        payload = json.dumps(body).encode() if body is not None else b""
+        status, decoded = self._request_once(method, path, body)
+        if status == 400 and self._pb and body is not None:
+            # codec-asymmetric fleet: a server without the codec can't
+            # decode protobuf bodies (400). Downgrade this client to JSON
+            # permanently and retry — negotiation degrades, nothing breaks
+            self._pb = False
+            log.warning("server cannot decode protobuf bodies; "
+                        "downgrading client to JSON")
+            status, decoded = self._request_once(method, path, body)
+        return self._raise_for_status(status, decoded)
+
+    def _request_once(self, method: str, path: str,
+                      body: dict | None = None):
+        if self._pb:
+            payload = wire.encode_payload(body) if body is not None else b""
+            content_type = wire.CONTENT_TYPE
+            accept = f"{wire.CONTENT_TYPE}, application/json"
+        else:
+            payload = json.dumps(body).encode() if body is not None else b""
+            content_type = accept = "application/json"
         with socket.create_connection((self.host, self.port),
                                       timeout=30) as sock:
             sock.sendall(
                 f"{method} {path} HTTP/1.1\r\n"
                 f"Host: {self.host}\r\n"
                 f"{self._auth_header()}"
-                f"Content-Type: application/json\r\n"
+                f"Content-Type: {content_type}\r\n"
+                f"Accept: {accept}\r\n"
                 f"Content-Length: {len(payload)}\r\n"
                 f"Connection: close\r\n\r\n".encode() + payload)
             data = b""
@@ -910,7 +1011,14 @@ class RemoteStore:
                 data += chunk
         head, _, resp_body = data.partition(b"\r\n\r\n")
         status = int(head.split(None, 2)[1])
-        decoded = json.loads(resp_body) if resp_body else {}
+        if resp_body and wire.CONTENT_TYPE.encode() in head.lower():
+            decoded = wire.decode_payload(resp_body)  # ValueError on corrupt
+        else:
+            decoded = json.loads(resp_body) if resp_body else {}
+        return status, decoded
+
+    @staticmethod
+    def _raise_for_status(status: int, decoded: dict):
         if status == 404:
             raise NotFound(decoded.get("message", "not found"))
         if status in (401, 403):
@@ -1082,9 +1190,11 @@ class RemoteStore:
         return _LazyWatch(fut)
 
     async def _open_watch(self, plural: str, query: str):
+        accept = (f"Accept: {wire.CONTENT_TYPE}, application/json\r\n"
+                  if self._pb else "")
         reader, writer = await asyncio.open_connection(self.host, self.port)
         writer.write(f"GET /api/v1/{plural}?{query} HTTP/1.1\r\n"
-                     f"Host: {self.host}\r\n{self._auth_header()}"
+                     f"Host: {self.host}\r\n{self._auth_header()}{accept}"
                      f"Connection: keep-alive\r\n\r\n"
                      .encode())
         await writer.drain()
@@ -1105,7 +1215,9 @@ class RemoteStore:
         if status != 200:
             writer.close()
             raise ValueError(f"watch failed: HTTP {status}")
-        return RemoteWatchStream(reader, writer)
+        binary = headers.get("content-type", "").startswith(
+            wire.CONTENT_TYPE)
+        return RemoteWatchStream(reader, writer, binary=binary)
 
 
 class _LazyWatch:

@@ -1,0 +1,133 @@
+"""Protobuf wire codec: round trips and HTTP content negotiation.
+
+The reference negotiates application/vnd.kubernetes.protobuf per request
+(runtime/serializer/protobuf/protobuf.go:75, codec_factory.go); these tests
+pin that both content types carry the same objects end-to-end."""
+
+import asyncio
+
+import pytest
+
+from kubernetes_tpu.api import wire
+from kubernetes_tpu.api.objects import Binding, Event, Node, ObjectMeta, Pod
+from kubernetes_tpu.perf.fixtures import make_nodes, make_pods
+
+pytestmark = pytest.mark.skipif(not wire.available(),
+                                reason="protobuf codec unavailable")
+
+
+def rt(d: dict) -> dict:
+    return wire.decode_payload(wire.encode_payload(d))
+
+
+def test_pod_round_trip_through_typed_message():
+    pod = make_pods(1, app_groups=4, anti_affinity_every=1,
+                    pref_affinity_every=1, selector_every=1, tolerate=True)[0]
+    pod.spec.volumes = [{"name": "v", "emptyDir": {}}]
+    pod.metadata.annotations["a"] = "b"
+    pod.metadata.finalizers.append("example.com/f")
+    d = pod.to_dict()
+    assert Pod.from_dict(rt(d)).to_dict() == Pod.from_dict(d).to_dict()
+    # typed message, not the JSON escape hatch — and smaller on the wire
+    import json
+    assert len(wire.encode_payload(d)) < len(json.dumps(d).encode())
+
+
+def test_node_event_binding_round_trips():
+    node = make_nodes(1, taint_every=1, labels_per_node=3)[0]
+    node.status.volumes_attached = [{"name": "pv-1", "devicePath": "/d"}]
+    node.status.daemon_endpoints = {"kubeletEndpoint": {"Port": 10250}}
+    nd = node.to_dict()
+    assert Node.from_dict(rt(nd)).to_dict() == Node.from_dict(nd).to_dict()
+
+    ev = Event(metadata=ObjectMeta(name="p.scheduled"),
+               involved_object={"kind": "Pod", "name": "p"},
+               reason="Scheduled", message="assigned", count=3,
+               source_component="default-scheduler")
+    ed = ev.to_dict()
+    assert Event.from_dict(rt(ed)).to_dict() == Event.from_dict(ed).to_dict()
+
+    b = Binding(pod_name="p", namespace="ns", target_node="n-1")
+    back = Binding.from_dict(rt(b.to_dict()))
+    assert (back.pod_name, back.namespace, back.target_node) == \
+        ("p", "ns", "n-1")
+
+
+def test_untyped_kind_rides_raw_json_envelope():
+    d = {"kind": "Status", "reason": "NotFound", "message": "x"}
+    assert rt(d) == d
+    svc = {"kind": "Service", "metadata": {"name": "s"},
+           "spec": {"selector": {"app": "a"}, "clusterIP": "10.96.0.1"}}
+    assert rt(svc) == svc
+
+
+def test_list_and_watch_frame_round_trip():
+    pods = [p.to_dict() for p in make_pods(5)]
+    lst = {"kind": "PodList", "metadata": {"resourceVersion": "42"},
+           "items": pods}
+    back = rt(lst)
+    assert back["kind"] == "PodList"
+    assert back["metadata"]["resourceVersion"] == "42"
+    assert [Pod.from_dict(i).key for i in back["items"]] == \
+        [Pod.from_dict(p).key for p in pods]
+
+    framed = wire.encode_watch_frame("MODIFIED", 7, pods[0])
+    length = int.from_bytes(framed[:4], "big")
+    frame = wire.decode_watch_frame(framed[4:4 + length])
+    assert frame["type"] == "MODIFIED" and frame["resourceVersion"] == 7
+    assert Pod.from_dict(frame["object"]).key == Pod.from_dict(pods[0]).key
+
+
+@pytest.mark.parametrize("fmt", ["protobuf", "json"])
+def test_negotiated_crud_and_watch_over_http(fmt):
+    """Same drive under both content types: CRUD + binding + watch."""
+    from http_util import http_store
+    from kubernetes_tpu.apiserver.http import RemoteStore
+
+    with http_store() as (base_client, _back):
+        client = RemoteStore(base_client.host, base_client.port,
+                             wire_format=fmt)
+        node = make_nodes(1)[0]
+        client.create(node)
+        pod = make_pods(1, name_prefix=f"wire-{fmt}")[0]
+        created = client.create(pod)
+        assert created.metadata.resource_version
+        got = client.get("Pod", pod.metadata.name)
+        assert got.spec.containers[0].requests == {"cpu": "100m",
+                                                   "memory": "250Mi"}
+        items, rv = client.list_with_version("Pod")
+        assert len(items) == 1 and rv >= 2
+
+        async def watch_one():
+            stream = client.watch("Pod", since=rv)
+            try:
+                client.bind(Binding(pod_name=pod.metadata.name,
+                                    namespace="default",
+                                    target_node=node.metadata.name))
+                ev = await asyncio.wait_for(stream.next(timeout=5), 10)
+                return ev
+            finally:
+                stream.stop()
+
+        ev = asyncio.run(watch_one())
+        assert ev.type == "MODIFIED"
+        assert ev.obj.spec.node_name == node.metadata.name
+
+
+def test_mixed_clients_share_one_server():
+    """A protobuf writer and a JSON reader observe the same object."""
+    from http_util import http_store
+    from kubernetes_tpu.apiserver.http import RemoteStore
+
+    with http_store() as (base_client, _back):
+        pb = RemoteStore(base_client.host, base_client.port,
+                         wire_format="protobuf")
+        js = RemoteStore(base_client.host, base_client.port,
+                         wire_format="json")
+        pod = make_pods(1, name_prefix="mixed")[0]
+        pb.create(pod)
+        seen = js.get("Pod", pod.metadata.name)
+        assert seen.metadata.name == pod.metadata.name
+        js.delete("Pod", pod.metadata.name)
+        with pytest.raises(KeyError):
+            pb.get("Pod", pod.metadata.name)
