@@ -1237,3 +1237,90 @@ class Binding:
             "metadata": {"name": self.pod_name, "namespace": self.namespace},
             "target": {"apiVersion": "v1", "kind": "Node", "name": self.target_node},
         }
+
+
+@dataclass
+class _RBACRuleObject:
+    """Shared shape of Role/ClusterRole: a list of PolicyRules
+    (staging/src/k8s.io/api/rbac/v1beta1/types.go PolicyRule —
+    apiGroups/resources/verbs/resourceNames, '*' wildcards)."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    rules: list[dict[str, Any]] = field(default_factory=list)
+
+    kind = ""
+    api_version = "rbac.authorization.k8s.io/v1beta1"
+
+    def clone(self):
+        return type(self)(metadata=self.metadata.clone(),
+                          rules=copy.deepcopy(self.rules))
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]):
+        return cls(metadata=ObjectMeta.from_dict(d.get("metadata") or {}),
+                   rules=copy.deepcopy(d.get("rules") or []))
+
+    def to_dict(self) -> dict[str, Any]:
+        return {"apiVersion": self.api_version,
+                "kind": self.kind,
+                "metadata": self.metadata.to_dict(),
+                "rules": copy.deepcopy(self.rules)}
+
+
+@dataclass
+class Role(_RBACRuleObject):
+    """Namespaced RBAC rules (rbac/v1beta1 Role)."""
+
+    kind = "Role"
+
+
+@dataclass
+class ClusterRole(_RBACRuleObject):
+    """Cluster-wide RBAC rules (rbac/v1beta1 ClusterRole)."""
+
+    kind = "ClusterRole"
+
+
+@dataclass
+class _RBACBindingObject:
+    """Shared shape of (Cluster)RoleBinding: subjects + roleRef
+    (rbac/v1beta1 Subject kinds User/Group/ServiceAccount)."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    subjects: list[dict[str, Any]] = field(default_factory=list)
+    role_ref: dict[str, Any] = field(default_factory=dict)
+
+    kind = ""
+    api_version = "rbac.authorization.k8s.io/v1beta1"
+
+    def clone(self):
+        return type(self)(metadata=self.metadata.clone(),
+                          subjects=copy.deepcopy(self.subjects),
+                          role_ref=dict(self.role_ref))
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]):
+        return cls(metadata=ObjectMeta.from_dict(d.get("metadata") or {}),
+                   subjects=copy.deepcopy(d.get("subjects") or []),
+                   role_ref=dict(d.get("roleRef") or {}))
+
+    def to_dict(self) -> dict[str, Any]:
+        return {"apiVersion": self.api_version,
+                "kind": self.kind,
+                "metadata": self.metadata.to_dict(),
+                "subjects": copy.deepcopy(self.subjects),
+                "roleRef": dict(self.role_ref)}
+
+
+@dataclass
+class RoleBinding(_RBACBindingObject):
+    """Grants a Role (or ClusterRole) within one namespace."""
+
+    kind = "RoleBinding"
+
+
+@dataclass
+class ClusterRoleBinding(_RBACBindingObject):
+    """Grants a ClusterRole across every namespace + cluster scope."""
+
+    kind = "ClusterRoleBinding"

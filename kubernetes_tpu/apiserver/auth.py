@@ -108,6 +108,97 @@ class ABACAuthorizer:
         return cls(policies)
 
     def authorize(self, user: UserInfo, verb: str, resource: str,
-                  namespace: str) -> bool:
+                  namespace: str, name: str = "") -> bool:
+        del name  # ABAC has no per-object-name scoping (abac.go)
         return any(p.matches(user, verb, resource, namespace)
                    for p in self.policies)
+
+
+# ---- RBAC (plugin/pkg/auth/authorizer/rbac/rbac.go:43) ----
+
+
+def _rule_allows(rule: dict, verb: str, resource: str,
+                 name: str = "") -> bool:
+    """PolicyRule match (rbac.go RuleAllows / VerbMatches etc.):
+    '*' wildcards; apiGroups are accepted wholesale (single-group wire).
+    A rule carrying resourceNames matches only named requests whose name
+    is listed (so list/create, which have no name, never match it —
+    rbac.go ResourceNameMatches)."""
+    verbs = rule.get("verbs") or []
+    if "*" not in verbs and verb not in verbs:
+        return False
+    resources = rule.get("resources") or []
+    if "*" not in resources and resource not in resources:
+        return False
+    names = rule.get("resourceNames") or []
+    return not names or (bool(name) and name in names)
+
+
+def _subject_matches(subject: dict, user) -> bool:
+    kind = subject.get("kind", "")
+    name = subject.get("name", "")
+    if kind == "User":
+        return name == user.name or name == "*"
+    if kind == "Group":
+        return name in user.groups or name == "*"
+    if kind == "ServiceAccount":
+        ns = subject.get("namespace", "default")
+        return user.name == f"system:serviceaccount:{ns}:{name}"
+    return False
+
+
+class RBACAuthorizer:
+    """Role/ClusterRole rule matching over the live store
+    (rbac.go:43 RBACAuthorizer.Authorize: walk the bindings whose subjects
+    cover the user, collect their roles' rules, allow on any match).
+
+    ClusterRoleBindings grant in every namespace and at cluster scope;
+    RoleBindings grant only inside their own namespace and may reference
+    either a Role (same namespace) or a ClusterRole (rule reuse)."""
+
+    def __init__(self, store):
+        self.store = store
+
+    def _rules_for_ref(self, role_ref: dict, namespace: str | None):
+        kind = role_ref.get("kind", "")
+        name = role_ref.get("name", "")
+        try:
+            if kind == "ClusterRole":
+                return self.store.get("ClusterRole", name, "default").rules
+            if kind == "Role" and namespace is not None:
+                return self.store.get("Role", name, namespace).rules
+        except KeyError:
+            return []
+        return []
+
+    def authorize(self, user, verb: str, resource: str,
+                  namespace: str, name: str = "") -> bool:
+        for crb in self.store.list("ClusterRoleBinding",
+                                   copy_objects=False):
+            if any(_subject_matches(s, user) for s in crb.subjects):
+                rules = self._rules_for_ref(crb.role_ref, None)
+                if any(_rule_allows(r, verb, resource, name)
+                       for r in rules):
+                    return True
+        if namespace:
+            for rb in self.store.list("RoleBinding", namespace,
+                                      copy_objects=False):
+                if any(_subject_matches(s, user) for s in rb.subjects):
+                    rules = self._rules_for_ref(rb.role_ref, namespace)
+                    if any(_rule_allows(r, verb, resource, name)
+                           for r in rules):
+                        return True
+        return False
+
+
+class UnionAuthorizer:
+    """--authorization-mode=ABAC,RBAC chaining: allow when ANY mode allows
+    (apiserver/pkg/authorization/union)."""
+
+    def __init__(self, *authorizers):
+        self.authorizers = [a for a in authorizers if a is not None]
+
+    def authorize(self, user, verb: str, resource: str,
+                  namespace: str, name: str = "") -> bool:
+        return any(a.authorize(user, verb, resource, namespace, name)
+                   for a in self.authorizers)

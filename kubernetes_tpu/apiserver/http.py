@@ -85,6 +85,10 @@ RESOURCES: dict[str, str] = {
     "horizontalpodautoscalers": "HorizontalPodAutoscaler",
     "poddisruptionbudgets": "PodDisruptionBudget",
     "apiservices": "APIService",
+    "roles": "Role",
+    "clusterroles": "ClusterRole",
+    "rolebindings": "RoleBinding",
+    "clusterrolebindings": "ClusterRoleBinding",
 }
 KIND_TO_CLS = {cls.kind: cls for cls in (
     objs.Pod, objs.Node, objs.Service, objs.Endpoints, objs.Event,
@@ -94,7 +98,8 @@ KIND_TO_CLS = {cls.kind: cls for cls in (
     objs.Namespace, objs.CustomResourceDefinition, objs.Cluster,
     objs.Secret, objs.ConfigMap, objs.ServiceAccount, objs.DaemonSet,
     objs.CronJob, objs.HorizontalPodAutoscaler, objs.PodDisruptionBudget,
-    objs.APIService)}
+    objs.APIService, objs.Role, objs.ClusterRole, objs.RoleBinding,
+    objs.ClusterRoleBinding)}
 PLURAL_OF = {kind: plural for plural, kind in RESOURCES.items()}
 
 
@@ -210,12 +215,18 @@ class APIServer:
     def __init__(self, store: ObjectStore, host: str = "127.0.0.1",
                  port: int = 0, authenticator=None, authorizer=None,
                  audit_path: str | None = None,
-                 max_in_flight: int = 400):
+                 max_in_flight: int = 400,
+                 tls_cert_file: str | None = None,
+                 tls_key_file: str | None = None):
         self.store = store
         self.host = host
         self.port = port
         self.authenticator = authenticator
         self.authorizer = authorizer
+        # secure serving (apiserver/pkg/server/secure_serving.go:
+        # --tls-cert-file/--tls-private-key-file); None = plaintext
+        self.tls_cert_file = tls_cert_file
+        self.tls_key_file = tls_key_file
         self._server: asyncio.AbstractServer | None = None
         # WithAudit (config.go:474): one JSON line per request decision
         self._audit = open(audit_path, "a", encoding="utf-8") \
@@ -257,10 +268,13 @@ class APIServer:
             # 404s in routing. Resource-shaped paths never land here.
             return None, user
         verb = {"GET": "get" if name else "list", "POST": "create",
-                "PUT": "update", "DELETE": "delete"}.get(method, method)
+                "PUT": "update", "PATCH": "patch",
+                "DELETE": "delete"}.get(method, method)
         # cluster-scoped (and cross-namespace) requests authorize against
-        # namespace "" — only wildcard-namespace policies may grant them
-        if self.authorizer.authorize(user, verb, plural, ns or ""):
+        # namespace "" — only wildcard-namespace policies may grant them;
+        # the object name feeds RBAC resourceNames scoping
+        if self.authorizer.authorize(user, verb, plural, ns or "",
+                                     name or ""):
             return None, user
         return (403, {"kind": "Status", "reason": "Forbidden",
                       "message": f"user {user.name!r} cannot {verb} "
@@ -271,8 +285,14 @@ class APIServer:
         return f"http://{self.host}:{self.port}"
 
     async def start(self) -> None:
+        ssl_ctx = None
+        if self.tls_cert_file and self.tls_key_file:
+            import ssl
+
+            ssl_ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            ssl_ctx.load_cert_chain(self.tls_cert_file, self.tls_key_file)
         self._server = await asyncio.start_server(
-            self._handle, self.host, self.port)
+            self._handle, self.host, self.port, ssl=ssl_ctx)
         self.port = self._server.sockets[0].getsockname()[1]
 
     async def stop(self) -> None:
@@ -356,9 +376,9 @@ class APIServer:
                     if proxied is not None:
                         status, payload = proxied
                     else:
-                        status, payload = self._route(method, url.path,
-                                                      query, body,
-                                                      loads=loads)
+                        status, payload = self._route(
+                            method, url.path, query, body, loads=loads,
+                            content_type=headers.get("content-type", ""))
                 finally:
                     self._in_flight -= 1
                 self._audit_log(user, method, target, status)
@@ -580,7 +600,8 @@ class APIServer:
         if "/" in getattr(cls, "api_version", "v1")}
     CLUSTER_SCOPED = frozenset({
         "Node", "PersistentVolume", "Namespace",
-        "CustomResourceDefinition", "APIService", "Cluster"})
+        "CustomResourceDefinition", "APIService", "Cluster",
+        "ClusterRole", "ClusterRoleBinding"})
 
     def _discovery(self, method: str, path: str):
         """-> (status, payload) for discovery paths, else None."""
@@ -642,7 +663,7 @@ class APIServer:
         return None
 
     def _route(self, method: str, path: str, query: dict, body: bytes,
-               loads=json.loads):
+               loads=json.loads, content_type: str = ""):
         discovered = self._discovery(method, path)
         if discovered is not None:
             return discovered
@@ -690,6 +711,19 @@ class APIServer:
                     obj.metadata.namespace = ns
                 created = self.store.create(obj)
                 return 201, encode_object(created)
+            if method == "PATCH" and name is not None:
+                # patch bodies are JSON under every patch content type
+                # (patch.go:51 negotiates the three +json types)
+                from kubernetes_tpu.apiserver.strategicpatch import PatchError
+
+                try:
+                    patched = self.store.patch(kind, name, ns or "default",
+                                               json.loads(body),
+                                               content_type)
+                except PatchError as e:
+                    return 400, {"kind": "Status", "reason": "BadRequest",
+                                 "message": str(e)}
+                return 200, encode_object(patched)
             if method == "PUT" and name is not None:
                 obj = decode_object(kind, loads(body))
                 if ns:
@@ -949,13 +983,33 @@ class RemoteStore:
     scheduler driver, controllers, and the extender run over TCP unchanged."""
 
     def __init__(self, host: str, port: int, token: str = "",
-                 rate_limiter=None, wire_format: str | None = None):
+                 rate_limiter=None, wire_format: str | None = None,
+                 tls: bool = False, ca_file: str | None = None,
+                 insecure_skip_verify: bool = False):
         self.host = host
         self.port = port
         self.token = token
         # client-go-style token bucket (client/flowcontrol.py); None = no
         # throttling, the in-process/test default
         self.rate_limiter = rate_limiter
+        # TLS client side (kubeconfig's certificate-authority /
+        # insecure-skip-tls-verify): ca_file pins the server cert; skip
+        # verification only when explicitly asked
+        self._ssl = None
+        if tls:
+            import ssl
+
+            if ca_file:
+                # full verification against the CA bundle INCLUDING the
+                # hostname/IP-SAN check — trusting any cert the CA signed
+                # regardless of host would let one leaked leaf cert
+                # impersonate the apiserver
+                self._ssl = ssl.create_default_context(cafile=ca_file)
+            else:
+                self._ssl = ssl.create_default_context()
+                if insecure_skip_verify:
+                    self._ssl.check_hostname = False
+                    self._ssl.verify_mode = ssl.CERT_NONE
         # content negotiation: "protobuf" (default when the codec is
         # available — the reference's hot-path default content type) or
         # "json"; KTPU_WIRE=json forces JSON fleet-wide
@@ -968,13 +1022,27 @@ class RemoteStore:
         return (f"Authorization: Bearer {self.token}\r\n"
                 if self.token else "")
 
+    def _connect(self):
+        sock = socket.create_connection((self.host, self.port), timeout=30)
+        if self._ssl is not None:
+            try:
+                return self._ssl.wrap_socket(sock,
+                                             server_hostname=self.host)
+            except Exception:
+                sock.close()
+                raise
+        return sock
+
     # ---- blocking HTTP core (CRUD: small payloads on a trusted network) ----
 
-    def _request(self, method: str, path: str, body: dict | None = None):
+    def _request(self, method: str, path: str, body: dict | None = None,
+                 content_type: str | None = None):
         if self.rate_limiter is not None:
             self.rate_limiter.accept()
-        status, decoded = self._request_once(method, path, body)
-        if status == 400 and self._pb and body is not None:
+        status, decoded = self._request_once(method, path, body,
+                                             content_type)
+        if status == 400 and self._pb and body is not None \
+                and content_type is None:
             # codec-asymmetric fleet: a server without the codec can't
             # decode protobuf bodies (400). Downgrade this client to JSON
             # permanently and retry — negotiation degrades, nothing breaks
@@ -985,16 +1053,22 @@ class RemoteStore:
         return self._raise_for_status(status, decoded)
 
     def _request_once(self, method: str, path: str,
-                      body: dict | None = None):
-        if self._pb:
+                      body: dict | None = None,
+                      content_type: str | None = None):
+        if content_type is not None:
+            # caller-specified body type (the PATCH verb's three
+            # +json patch types ride JSON regardless of negotiation)
+            payload = json.dumps(body).encode() if body is not None else b""
+            accept = (f"{wire.CONTENT_TYPE}, application/json"
+                      if self._pb else "application/json")
+        elif self._pb:
             payload = wire.encode_payload(body) if body is not None else b""
             content_type = wire.CONTENT_TYPE
             accept = f"{wire.CONTENT_TYPE}, application/json"
         else:
             payload = json.dumps(body).encode() if body is not None else b""
             content_type = accept = "application/json"
-        with socket.create_connection((self.host, self.port),
-                                      timeout=30) as sock:
+        with self._connect() as sock:
             sock.sendall(
                 f"{method} {path} HTTP/1.1\r\n"
                 f"Host: {self.host}\r\n"
@@ -1010,7 +1084,13 @@ class RemoteStore:
                     break
                 data += chunk
         head, _, resp_body = data.partition(b"\r\n\r\n")
-        status = int(head.split(None, 2)[1])
+        try:
+            status = int(head.split(None, 2)[1])
+        except (IndexError, ValueError):
+            # empty or non-HTTP reply (e.g. a plaintext request hitting a
+            # TLS socket): a transport failure, not a protocol answer
+            raise ConnectionError(
+                "empty or non-HTTP reply from server") from None
         if resp_body and wire.CONTENT_TYPE.encode() in head.lower():
             decoded = wire.decode_payload(resp_body)  # ValueError on corrupt
         else:
@@ -1102,6 +1182,14 @@ class RemoteStore:
                 continue
         raise Conflict(f"{kind} {namespace}/{name}: too many CAS retries")
 
+    def patch(self, kind: str, name: str, namespace: str, patch,
+              content_type: str) -> Any:
+        """PATCH with one of the three patch content types
+        (strategicpatch.STRATEGIC / MERGE / JSONPATCH)."""
+        return decode_object(kind, self._request(
+            "PATCH", self._path(kind, namespace, name), patch,
+            content_type=content_type))
+
     def delete(self, kind: str, name: str, namespace: str = "default") -> Any:
         return decode_object(kind, self._request(
             "DELETE", self._path(kind, namespace, name)))
@@ -1117,8 +1205,7 @@ class RemoteStore:
     def raw(self, method: str, path: str) -> tuple[int, str]:
         """Non-JSON request (node-proxy surfaces: logs, exec). Returns
         (status, body-text) with chunked transfer decoding."""
-        with socket.create_connection((self.host, self.port),
-                                      timeout=30) as sock:
+        with self._connect() as sock:
             sock.sendall(
                 f"{method} {path} HTTP/1.1\r\n"
                 f"Host: {self.host}\r\n"
@@ -1192,7 +1279,9 @@ class RemoteStore:
     async def _open_watch(self, plural: str, query: str):
         accept = (f"Accept: {wire.CONTENT_TYPE}, application/json\r\n"
                   if self._pb else "")
-        reader, writer = await asyncio.open_connection(self.host, self.port)
+        reader, writer = await asyncio.open_connection(
+            self.host, self.port, ssl=self._ssl,
+            server_hostname=self.host if self._ssl is not None else None)
         writer.write(f"GET /api/v1/{plural}?{query} HTTP/1.1\r\n"
                      f"Host: {self.host}\r\n{self._auth_header()}{accept}"
                      f"Connection: keep-alive\r\n\r\n"

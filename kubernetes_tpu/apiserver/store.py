@@ -313,12 +313,51 @@ class ObjectStore:
         self._publish(WatchEvent("MODIFIED", kind, stored, rv))
         return stored.clone()
 
+    def patch(self, kind: str, name: str, namespace: str, patch,
+              content_type: str, retries: int = 5) -> Any:
+        """PATCH verb (apiserver/pkg/endpoints/handlers/patch.go:51):
+        apply a strategic-merge / merge / JSON patch to the live object
+        and CAS the result back, retrying on write conflicts like the
+        reference handler (rides guaranteed_update — one CAS policy). A
+        patch that pins metadata.resourceVersion to a stale version is a
+        hard 409 (raised from the transform, so no retry) — that is the
+        optimistic-concurrency contract kubectl apply relies on."""
+        from kubernetes_tpu.apiserver.http import decode_object, encode_object
+        from kubernetes_tpu.apiserver.strategicpatch import apply_patch
+
+        pinned = None
+        if isinstance(patch, dict):
+            pinned = (patch.get("metadata") or {}).get("resourceVersion")
+
+        def transform(current):
+            if pinned and pinned != current.metadata.resource_version:
+                raise Conflict(
+                    f"{kind} {namespace}/{name}: patch resourceVersion "
+                    f"{pinned} != {current.metadata.resource_version}")
+            merged = apply_patch(encode_object(current), patch,
+                                 content_type)
+            # identity fields never patch away
+            merged.setdefault("metadata", {})["name"] = name
+            merged["metadata"]["namespace"] = namespace
+            obj = decode_object(kind, merged)
+            obj.metadata.resource_version = \
+                current.metadata.resource_version
+            return obj
+
+        return self.guaranteed_update(kind, name, namespace, transform,
+                                      retries=retries)
+
     def guaranteed_update(self, kind: str, name: str, namespace: str,
                           mutate: Callable[[Any], Any], retries: int = 16) -> Any:
-        """CAS retry loop (GuaranteedUpdate, etcd3/store.go:257)."""
+        """CAS retry loop (GuaranteedUpdate, etcd3/store.go:257). `mutate`
+        may update the object in place, or return a replacement; an
+        exception it raises (including Conflict for a pinned stale
+        version) aborts the loop."""
         for _ in range(retries):
             obj = self.get(kind, name, namespace)
-            mutate(obj)
+            replacement = mutate(obj)
+            if replacement is not None:
+                obj = replacement
             try:
                 return self.update(obj)
             except Conflict:

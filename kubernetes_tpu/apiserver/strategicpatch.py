@@ -1,0 +1,355 @@
+"""Strategic merge patch + JSON merge patch + JSON patch.
+
+The reference's patch machinery (pkg/util/strategicpatch/patch.go applied
+by the PATCH verb handler, apiserver/pkg/endpoints/handlers/patch.go:51)
+re-derived over plain dicts:
+
+- **strategic merge patch** (application/strategic-merge-patch+json):
+  maps merge recursively, `null` deletes a key; lists whose field carries a
+  `patchMergeKey` in the API schema merge element-wise by that key (the Go
+  types carry this in struct tags; here it is the MERGE_KEYS table);
+  `$patch: delete|replace` directives inside maps/list items override.
+- **JSON merge patch** (RFC 7386, application/merge-patch+json): like the
+  above but every list replaces wholesale.
+- **JSON patch** (RFC 6902, application/json-patch+json): an op list
+  (add/remove/replace/test) against JSON-pointer paths.
+
+`create_three_way_patch` is the kubectl-apply half
+(strategicpatch.CreateThreeWayMergePatch): deletions come from
+last-applied-vs-manifest, additions/updates from manifest-vs-live — so
+fields a controller wrote (and the manifest never mentioned) survive.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Any
+
+# Content types the PATCH verb negotiates (patch.go:51 patchTypes)
+STRATEGIC = "application/strategic-merge-patch+json"
+MERGE = "application/merge-patch+json"
+JSONPATCH = "application/json-patch+json"
+
+# field name -> merge-key candidates: the patchMergeKey struct tags of the
+# v1 types (staging/src/k8s.io/api/core/v1/types.go); lists not named here
+# replace. The Go tags are per-type; dict shapes only carry field names, so
+# ambiguous fields list candidates in priority order and the key actually
+# present in the items wins ("ports" is containerPort on a Container but
+# port on a ServiceSpec).
+MERGE_KEYS: dict[str, tuple[str, ...] | None] = {
+    "containers": ("name",),
+    "initContainers": ("name",),
+    "ports": ("containerPort", "port"),
+    "env": ("name",),
+    "volumes": ("name",),
+    "volumeMounts": ("mountPath",),
+    "tolerations": ("key",),
+    "taints": ("key",),
+    "conditions": ("type",),
+    "imagePullSecrets": ("name",),
+    "hostAliases": ("ip",),
+    "finalizers": None,  # merge as a set of scalars (patchStrategy: merge)
+}
+
+# parallel-list directive prefix for scalar-set deletions
+# (strategicpatch's deleteFromPrimitiveList)
+DELETE_PRIMITIVE = "$deleteFromPrimitiveList/"
+
+
+def _resolve_merge_key(field: str, *item_lists) -> str:
+    """Pick the merge-key candidate that the actual items carry."""
+    candidates = MERGE_KEYS[field]
+    for cand in candidates:
+        for items in item_lists:
+            for item in items:
+                if isinstance(item, dict) and cand in item:
+                    return cand
+    return candidates[0]
+
+
+class PatchError(ValueError):
+    pass
+
+
+def _merge_keyed_list(current: list, patch: list, merge_key: str,
+                      strategic: bool) -> list:
+    out: list = [copy.deepcopy(i) for i in current]
+
+    def index_of(key_val):
+        for i, item in enumerate(out):
+            if isinstance(item, dict) and item.get(merge_key) == key_val:
+                return i
+        return None
+
+    for p in patch:
+        if not isinstance(p, dict):
+            raise PatchError(
+                f"merge-key list patch item must be an object, got {p!r}")
+        directive = p.get("$patch")
+        if directive == "replace":
+            # {"$patch": "replace"} as a bare item: the REST of the patch
+            # list replaces the current list wholesale
+            rest = [i for i in patch if i is not p]
+            return [copy.deepcopy(i) for i in rest]
+        key_val = p.get(merge_key)
+        if key_val is None:
+            raise PatchError(
+                f"list patch item missing merge key {merge_key!r}: {p!r}")
+        idx = index_of(key_val)
+        if directive == "delete":
+            if idx is not None:
+                out.pop(idx)
+            continue
+        if idx is None:
+            item = {k: copy.deepcopy(v) for k, v in p.items()
+                    if k != "$patch"}
+            out.append(item)
+        else:
+            out[idx] = strategic_merge(out[idx], p)
+    return out
+
+
+def _merge_scalar_set(current: list, patch: list) -> list:
+    out = list(current)
+    for v in patch:
+        if v not in out:
+            out.append(v)
+    return out
+
+
+def strategic_merge(current: Any, patch: Any) -> Any:
+    """Apply one strategic-merge-patch level. current/patch are the JSON
+    dict shapes; returns a new value (inputs unmodified)."""
+    if not isinstance(patch, dict) or not isinstance(current, dict):
+        return copy.deepcopy(patch)
+    if patch.get("$patch") == "replace":
+        out = {k: copy.deepcopy(v) for k, v in patch.items()
+               if k != "$patch"}
+        return out
+    out = {k: copy.deepcopy(v) for k, v in current.items()}
+    for key, pval in patch.items():
+        if key == "$patch":
+            continue
+        if key.startswith(DELETE_PRIMITIVE):
+            # parallel-list deletion for scalar-set lists: remove the named
+            # values from the target list (deleteFromPrimitiveList)
+            field = key[len(DELETE_PRIMITIVE):]
+            cur_list = out.get(field)
+            if isinstance(cur_list, list) and isinstance(pval, list):
+                remaining = [v for v in cur_list if v not in pval]
+                if remaining:
+                    out[field] = remaining
+                else:
+                    out.pop(field, None)
+            continue
+        if pval is None:
+            out.pop(key, None)  # null deletes (patch.go map semantics)
+            continue
+        cval = out.get(key)
+        if isinstance(pval, list) and key in MERGE_KEYS:
+            base = cval if isinstance(cval, list) else []
+            if MERGE_KEYS[key] is None:
+                out[key] = _merge_scalar_set(base, pval)
+            else:
+                merge_key = _resolve_merge_key(key, base, pval)
+                out[key] = _merge_keyed_list(base, pval, merge_key,
+                                             strategic=True)
+        elif isinstance(pval, dict):
+            out[key] = strategic_merge(
+                cval if isinstance(cval, dict) else {}, pval)
+        else:
+            out[key] = copy.deepcopy(pval)
+    return out
+
+
+def json_merge(current: Any, patch: Any) -> Any:
+    """RFC 7386 merge patch: like strategic merge but lists replace."""
+    if not isinstance(patch, dict):
+        return copy.deepcopy(patch)
+    out = {k: copy.deepcopy(v) for k, v in current.items()} \
+        if isinstance(current, dict) else {}
+    for key, pval in patch.items():
+        if pval is None:
+            out.pop(key, None)
+        elif isinstance(pval, dict):
+            out[key] = json_merge(out.get(key), pval)
+        else:
+            out[key] = copy.deepcopy(pval)
+    return out
+
+
+def json_patch(current: Any, ops: list) -> Any:
+    """RFC 6902: add/remove/replace/test against JSON-pointer paths."""
+    doc = copy.deepcopy(current)
+
+    def walk(path: str):
+        if not path.startswith("/"):
+            raise PatchError(f"bad JSON pointer {path!r}")
+        parts = [p.replace("~1", "/").replace("~0", "~")
+                 for p in path.split("/")[1:]]
+        parent, key = None, None
+        node = doc
+        for part in parts:
+            parent = node
+            if isinstance(node, list):
+                key = len(node) if part == "-" else int(part)
+                node = node[key] if key < len(node) else None
+            elif isinstance(node, dict):
+                key = part
+                node = node.get(part)
+            else:
+                raise PatchError(f"path {path!r} traverses a scalar")
+        return parent, key, node
+
+    for op in ops:
+        action = op.get("op")
+        try:
+            parent, key, node = walk(op.get("path", ""))
+            if action == "add":
+                if isinstance(parent, list):
+                    parent.insert(key, copy.deepcopy(op["value"]))
+                else:
+                    parent[key] = copy.deepcopy(op["value"])
+            elif action == "replace":
+                parent[key] = copy.deepcopy(op["value"])
+            elif action == "remove":
+                if isinstance(parent, list):
+                    parent.pop(key)
+                else:
+                    parent.pop(key, None)
+            elif action == "test":
+                if node != op.get("value"):
+                    raise PatchError(
+                        f"test failed at {op.get('path')}: {node!r} != "
+                        f"{op.get('value')!r}")
+            else:
+                raise PatchError(f"unsupported JSON patch op {action!r}")
+        except PatchError:
+            raise
+        except (IndexError, KeyError, TypeError, ValueError) as e:
+            # out-of-range index, missing value field, scalar traversal —
+            # all client errors, normalized so the server answers 400
+            raise PatchError(
+                f"bad JSON patch op {op!r}: {type(e).__name__}: {e}") from e
+    return doc
+
+
+def apply_patch(current: dict, patch, content_type: str) -> dict:
+    if content_type.startswith(STRATEGIC):
+        return strategic_merge(current, patch)
+    if content_type.startswith(MERGE):
+        return json_merge(current, patch)
+    if content_type.startswith(JSONPATCH):
+        if not isinstance(patch, list):
+            raise PatchError("JSON patch body must be an op list")
+        return json_patch(current, patch)
+    raise PatchError(f"unsupported patch content type {content_type!r}")
+
+
+# ---- three-way merge (kubectl apply) ----
+
+
+def _diff_for_update(modified: Any, live: Any) -> Any:
+    """Patch fragment turning `live` into `modified` for every field
+    `modified` mentions (fields only in `live` are untouched)."""
+    if not isinstance(modified, dict) or not isinstance(live, dict):
+        return copy.deepcopy(modified)
+    out: dict = {}
+    for key, mval in modified.items():
+        lval = live.get(key)
+        if isinstance(mval, list) and key in MERGE_KEYS \
+                and MERGE_KEYS[key] is not None:
+            base = lval if isinstance(lval, list) else []
+            merge_key = _resolve_merge_key(key, base, mval)
+            frag = []
+            for item in mval:
+                key_val = item.get(merge_key) if isinstance(item, dict) \
+                    else None
+                match = next((b for b in base
+                              if isinstance(b, dict)
+                              and b.get(merge_key) == key_val), None)
+                if match is None:
+                    frag.append(copy.deepcopy(item))
+                else:
+                    d = _diff_for_update(item, match)
+                    if d:
+                        d[merge_key] = key_val
+                        frag.append(d)
+            if frag:
+                out[key] = frag
+        elif isinstance(mval, dict):
+            d = _diff_for_update(mval, lval if isinstance(lval, dict)
+                                 else {})
+            if d or not isinstance(lval, dict):
+                out[key] = d
+        elif mval != lval:
+            out[key] = copy.deepcopy(mval)
+    return out
+
+
+def _deletions(original: Any, modified: Any) -> Any:
+    """Patch fragment deleting what `original` had and `modified` dropped."""
+    if not isinstance(original, dict) or not isinstance(modified, dict):
+        return {}
+    out: dict = {}
+    for key, oval in original.items():
+        scalar_set = isinstance(oval, list) and key in MERGE_KEYS \
+            and MERGE_KEYS[key] is None
+        keyed = isinstance(oval, list) and key in MERGE_KEYS \
+            and MERGE_KEYS[key] is not None
+        mval = modified.get(key) if key in modified else None
+        if key not in modified:
+            if keyed:
+                merge_key = _resolve_merge_key(key, oval)
+                out[key] = [{merge_key: i.get(merge_key),
+                             "$patch": "delete"}
+                            for i in oval if isinstance(i, dict)]
+            elif scalar_set:
+                # delete only the values apply owned — controller-appended
+                # entries (e.g. protection finalizers) must survive
+                out[DELETE_PRIMITIVE + key] = list(oval)
+            else:
+                out[key] = None
+            continue
+        if isinstance(oval, dict) and isinstance(mval, dict):
+            d = _deletions(oval, mval)
+            if d:
+                out[key] = d
+        elif keyed and isinstance(mval, list):
+            merge_key = _resolve_merge_key(key, oval, mval)
+            have = {i.get(merge_key) for i in mval if isinstance(i, dict)}
+            dels = [{merge_key: i.get(merge_key), "$patch": "delete"}
+                    for i in oval
+                    if isinstance(i, dict) and i.get(merge_key) not in have]
+            if dels:
+                out[key] = dels
+        elif scalar_set and isinstance(mval, list):
+            dropped = [v for v in oval if v not in mval]
+            if dropped:
+                out[DELETE_PRIMITIVE + key] = dropped
+    return out
+
+
+def create_three_way_patch(original: dict, modified: dict,
+                           live: dict) -> dict:
+    """CreateThreeWayMergePatch: deletions from original->modified merged
+    under updates from live->modified — controller-owned fields the
+    manifest never mentioned survive the apply."""
+    patch = _diff_for_update(modified, live)
+    dels = _deletions(original, modified)
+    return _overlay(dels, patch)
+
+
+def _overlay(base: dict, over: dict) -> dict:
+    """Deep-merge two patch fragments (over wins; keyed lists concatenate,
+    delete directives first so a re-added item lands after its deletion)."""
+    out = copy.deepcopy(base)
+    for key, oval in over.items():
+        bval = out.get(key)
+        if isinstance(bval, dict) and isinstance(oval, dict):
+            out[key] = _overlay(bval, oval)
+        elif isinstance(bval, list) and isinstance(oval, list):
+            out[key] = bval + copy.deepcopy(oval)
+        else:
+            out[key] = copy.deepcopy(oval)
+    return out

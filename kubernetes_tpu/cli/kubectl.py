@@ -28,7 +28,14 @@ from urllib.parse import urlsplit
 
 from kubernetes_tpu.api.objects import Binding
 from kubernetes_tpu.apiserver.http import RESOURCES, RemoteStore, decode_object
-from kubernetes_tpu.apiserver.store import AlreadyExists, Conflict, NotFound
+from kubernetes_tpu.apiserver.store import (
+    AlreadyExists,
+    Conflict,
+    Expired,
+    NotFound,
+    TooManyRequests,
+)
+from kubernetes_tpu.apiserver.validation import ValidationError
 
 # singular/short aliases -> plural resource (kubectl's RESTMapper role)
 ALIASES = {
@@ -221,15 +228,53 @@ def cmd_create(client, args) -> int:
     return 0
 
 
+LAST_APPLIED = "kubectl.kubernetes.io/last-applied-configuration"
+
+
 def cmd_apply(client, args) -> int:
+    """Declarative apply via the three-way strategic merge the reference
+    kubectl performs (pkg/kubectl/cmd/apply.go + strategicpatch
+    CreateThreeWayMergePatch): deletions come from comparing the
+    last-applied annotation to the manifest, updates from comparing the
+    manifest to the live object — fields written by controllers (status,
+    allocated clusterIP, scale changes the manifest doesn't pin) survive."""
+    import copy as _copy
+
+    from kubernetes_tpu.apiserver.strategicpatch import (
+        STRATEGIC,
+        create_three_way_patch,
+    )
+
     for doc in load_manifest(args.filename):
-        obj = decode_object(doc.get("kind", ""), doc)
+        kind = doc.get("kind", "")
+        name = (doc.get("metadata") or {}).get("name", "")
+        ns = (doc.get("metadata") or {}).get("namespace",
+                                             args.namespace or "default")
+        modified = _copy.deepcopy(doc)
+        # -n applies to manifests that don't pin a namespace — on BOTH the
+        # create and patch paths, or the first apply would land the object
+        # somewhere later applies don't look
+        modified.setdefault("metadata", {})["namespace"] = ns
+        modified["metadata"].setdefault(
+            "annotations", {})[LAST_APPLIED] = json.dumps(
+                doc, sort_keys=True, separators=(",", ":"))
         try:
-            client.create(obj)
-            print(f"{obj.kind.lower()}/{obj.metadata.name} created")
+            client.create(decode_object(kind, modified))
+            print(f"{kind.lower()}/{name} created")
+            continue
         except AlreadyExists:
-            client.update(obj, check_version=False)
-            print(f"{obj.kind.lower()}/{obj.metadata.name} configured")
+            pass
+        live = client.get(kind, name, ns)
+        live_dict = live.to_dict()
+        last = (live.metadata.annotations or {}).get(LAST_APPLIED)
+        original = json.loads(last) if last else {}
+        patch = create_three_way_patch(original, modified, live_dict)
+        patch.get("metadata", {}).pop("resourceVersion", None)
+        if not any(k for k in patch if k != "apiVersion"):
+            print(f"{kind.lower()}/{name} unchanged")
+            continue
+        client.patch(kind, name, ns, patch, STRATEGIC)
+        print(f"{kind.lower()}/{name} configured")
     return 0
 
 
@@ -237,6 +282,54 @@ def cmd_delete(client, args) -> int:
     kind = RESOURCES[resolve_resource(args.resource)]
     client.delete(kind, args.name, args.namespace)
     print(f"{kind.lower()}/{args.name} deleted")
+    return 0
+
+
+def cmd_patch(client, args) -> int:
+    """kubectl patch -p '...' --type strategic|merge|json
+    (pkg/kubectl/cmd/patch.go)."""
+    from kubernetes_tpu.apiserver import strategicpatch as sp
+
+    kind = RESOURCES[resolve_resource(args.resource)]
+    content_type = {"strategic": sp.STRATEGIC, "merge": sp.MERGE,
+                    "json": sp.JSONPATCH}[args.type]
+    client.patch(kind, args.name, args.namespace, json.loads(args.patch),
+                 content_type)
+    print(f"{kind.lower()}/{args.name} patched")
+    return 0
+
+
+def _pairs_patch(pairs: list[str], field: str) -> dict:
+    values: dict = {}
+    for pair in pairs:
+        if pair.endswith("-") and "=" not in pair:
+            values[pair[:-1]] = None  # strategic null deletes the key
+        else:
+            k, _, v = pair.partition("=")
+            values[k] = v
+    return {"metadata": {field: values}}
+
+
+def cmd_label(client, args) -> int:
+    """kubectl label: a strategic merge patch on metadata.labels
+    (pkg/kubectl/cmd/label.go)."""
+    from kubernetes_tpu.apiserver import strategicpatch as sp
+
+    kind = RESOURCES[resolve_resource(args.resource)]
+    client.patch(kind, args.name, args.namespace,
+                 _pairs_patch(args.pairs, "labels"), sp.STRATEGIC)
+    print(f"{kind.lower()}/{args.name} labeled")
+    return 0
+
+
+def cmd_annotate(client, args) -> int:
+    """kubectl annotate (pkg/kubectl/cmd/annotate.go)."""
+    from kubernetes_tpu.apiserver import strategicpatch as sp
+
+    kind = RESOURCES[resolve_resource(args.resource)]
+    client.patch(kind, args.name, args.namespace,
+                 _pairs_patch(args.pairs, "annotations"), sp.STRATEGIC)
+    print(f"{kind.lower()}/{args.name} annotated")
     return 0
 
 
@@ -439,6 +532,9 @@ def build_parser() -> argparse.ArgumentParser:
                    default=os.environ.get("KUBECTL_TOKEN", ""),
                    help="bearer token for an authn-enabled apiserver "
                         "(env KUBECTL_TOKEN)")
+    p.add_argument("--certificate-authority", default="",
+                   help="CA bundle for an https server")
+    p.add_argument("--insecure-skip-tls-verify", action="store_true")
     sub = p.add_subparsers(dest="verb", required=True)
 
     def common(sp, name=True):
@@ -463,10 +559,28 @@ def build_parser() -> argparse.ArgumentParser:
     for verb, fn in (("create", cmd_create), ("apply", cmd_apply)):
         c = sub.add_parser(verb)
         c.add_argument("-f", "--filename", required=True)
+        c.add_argument("-n", "--namespace", default="default")
         c.set_defaults(fn=fn)
     de = sub.add_parser("delete")
     common(de)
     de.set_defaults(fn=cmd_delete)
+    pa = sub.add_parser("patch")
+    common(pa)
+    pa.add_argument("-p", "--patch", required=True,
+                    help="patch document (JSON)")
+    pa.add_argument("--type", default="strategic",
+                    choices=["strategic", "merge", "json"])
+    pa.set_defaults(fn=cmd_patch)
+    lb = sub.add_parser("label")
+    common(lb)
+    lb.add_argument("pairs", nargs="+",
+                    help="key=value to set, key- to remove")
+    lb.set_defaults(fn=cmd_label)
+    an = sub.add_parser("annotate")
+    common(an)
+    an.add_argument("pairs", nargs="+",
+                    help="key=value to set, key- to remove")
+    an.set_defaults(fn=cmd_annotate)
     sc = sub.add_parser("scale")
     common(sc)
     sc.add_argument("--replicas", type=int, required=True)
@@ -510,7 +624,11 @@ def build_parser() -> argparse.ArgumentParser:
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     url = urlsplit(args.server)
-    client = RemoteStore(url.hostname, url.port or 80, token=args.token)
+    tls = url.scheme == "https"
+    client = RemoteStore(
+        url.hostname, url.port or (443 if tls else 80), token=args.token,
+        tls=tls, ca_file=args.certificate_authority or None,
+        insecure_skip_verify=args.insecure_skip_tls_verify)
     try:
         return args.fn(client, args)
     except NotFound as e:
@@ -524,6 +642,23 @@ def main(argv=None) -> int:
         return 1
     except ConnectionError as e:
         print(f"Unable to connect to the server: {e}", file=sys.stderr)
+        return 1
+    except json.JSONDecodeError as e:
+        print(f"error: invalid JSON: {e}", file=sys.stderr)
+        return 1
+    except ValidationError as e:
+        print(f"Error from server (Invalid): {e}", file=sys.stderr)
+        return 1
+    except TooManyRequests as e:
+        print(f"Error from server (TooManyRequests): {e}", file=sys.stderr)
+        return 1
+    except Expired as e:
+        print(f"Error from server (Gone): {e}", file=sys.stderr)
+        return 1
+    except ValueError as e:
+        # remaining server-side rejections (400 BadRequest) surface as
+        # ValueError from the client; a traceback is not a CLI answer
+        print(f"Error from server (BadRequest): {e}", file=sys.stderr)
         return 1
 
 
