@@ -40,6 +40,11 @@ log = logging.getLogger(__name__)
 
 RUN_SECONDS_ANNOTATION = "kubernetes-tpu/run-seconds"
 EXIT_CODE_ANNOTATION = "kubernetes-tpu/exit-code"
+# fake-runtime probe answers (the scripted half of probing; exec probes run
+# against the fake shell instead): flip these annotations on the live pod
+# to fail its readiness/liveness, like breaking the real endpoint would
+READY_ANNOTATION = "kubernetes-tpu/ready"
+LIVE_ANNOTATION = "kubernetes-tpu/live"
 
 
 class FakeRuntime:
@@ -89,6 +94,23 @@ class FakeRuntime:
             return 1, ""
         return 0, f"exec: {' '.join(command)}\n"
 
+    def probe(self, key: str, pod: Pod, probe: dict, kind: str) -> bool:
+        """Execute one probe (prober/prober.go runProbe collapsed onto the
+        fake): exec probes run the fake shell (rc 0 = success); httpGet/
+        tcpSocket have nothing real behind them, so the scripted
+        annotations answer (the kubemark-style fake boundary)."""
+        entry = self._pods.get(key)
+        if entry is None or entry["state"] != "running":
+            return False
+        ex = (probe or {}).get("exec")
+        if ex:
+            rc, _out = self.exec_sync(key, list(ex.get("command") or []))
+            return rc == 0
+        ann = pod.metadata.annotations
+        if kind == "readiness":
+            return ann.get(READY_ANNOTATION, "true") != "false"
+        return ann.get(LIVE_ANNOTATION, "true") != "false"
+
     def kill_pod(self, key: str) -> None:
         """StopPodSandbox + RemovePodSandbox. Logs survive (a finished
         Job's logs stay readable until the pod object is deleted)."""
@@ -136,7 +158,15 @@ class Kubelet(HollowKubelet):
         self._workers: dict[str, asyncio.Queue] = {}
         self._worker_tasks: dict[str, asyncio.Task] = {}
         self._pleg_task: asyncio.Task | None = None
-        self._reported: dict[str, str] = {}  # status-manager dedup cache
+        self._probe_task: asyncio.Task | None = None
+        self._reported: dict[str, tuple] = {}  # status-manager dedup cache
+        # prober manager state (prober/prober_manager.go:60): last pod spec
+        # seen per worker, readiness results, consecutive liveness failures,
+        # restart counts
+        self._active: dict[str, Pod] = {}
+        self._ready_state: dict[str, bool] = {}
+        self._liveness_fails: dict[str, int] = {}
+        self.restart_counts: dict[str, int] = {}
 
     # ---- config source (dispatch from the shared informer) ----
 
@@ -149,6 +179,7 @@ class Kubelet(HollowKubelet):
             self.runtime.purge(pod.key)
             self.volumes.unmount_pod(pod.key)
             self._reported.pop(pod.key, None)
+            self._forget_probes(pod.key)
             return
         if pod.spec.node_name != self.node_name:
             return
@@ -201,12 +232,23 @@ class Kubelet(HollowKubelet):
         if pod.key not in self.runtime:
             self.volumes.mount_pod(pod)
         self.runtime.sync_pod(pod)
-        self._set_status(pod.key, "Running")
+        self._active[pod.key] = pod
+        self._set_status(pod.key, "Running",
+                         ready=self._ready_state.get(
+                             pod.key, self._default_ready(pod)))
 
     # ---- status manager (status/status_manager.go) ----
 
-    def _set_status(self, key: str, phase: str) -> None:
-        if self._reported.get(key) == phase:
+    def _set_status(self, key: str, phase: str,
+                    ready: bool | None = None,
+                    exit_code: int = 0) -> None:
+        """ready: the prober's readiness verdict (None = derive from the
+        phase, the pre-prober behavior for probe-less pods)."""
+        if ready is None:
+            ready = phase == "Running"
+        restarts = self.restart_counts.get(key, 0)
+        fingerprint = (phase, ready and phase == "Running", restarts)
+        if self._reported.get(key) == fingerprint:
             return  # dedup: only status *changes* reach the apiserver
         ns, name = key.split("/", 1)
         try:
@@ -216,15 +258,110 @@ class Kubelet(HollowKubelet):
         if fresh.spec.node_name != self.node_name:
             return
         fresh.status.phase = phase
-        ready = "True" if phase == "Running" else "False"
+        ready_s = "True" if (ready and phase == "Running") else "False"
         fresh.status.conditions = [
-            {"type": "Ready", "status": ready,
+            {"type": "Ready", "status": ready_s,
              "lastTransitionTime": time.time()}]
+        running = phase == "Running"
+        fresh.status.container_statuses = [
+            {"name": c.name, "ready": ready_s == "True",
+             "restartCount": restarts,
+             "state": {"running": {}} if running else
+                      {"terminated": {"exitCode": exit_code}}}
+            for c in fresh.spec.containers]
         try:
             self.store.update(fresh, check_version=False)
-            self._reported[key] = phase
+            self._reported[key] = fingerprint
         except (Conflict, NotFound):
             pass
+
+    # ---- probers (prober/prober_manager.go:60, worker.go) ----
+
+    PROBE_PERIOD = 0.1  # reference defaults to 10s; fakes are faster
+
+    @staticmethod
+    def _default_ready(pod: Pod) -> bool:
+        """A pod with a readiness probe starts NOT ready until its first
+        successful probe (the reference prober's initial-result contract);
+        probe-less pods are ready as soon as they run."""
+        return not any(c.readiness_probe for c in pod.spec.containers)
+
+    def _forget_probes(self, key: str) -> None:
+        self._active.pop(key, None)
+        self._ready_state.pop(key, None)
+        self._liveness_fails.pop(key, None)
+        self.restart_counts.pop(key, None)
+
+    async def _probe_loop(self) -> None:
+        while True:
+            await asyncio.sleep(self.PROBE_PERIOD)
+            if not self.running:
+                return
+            # probes answer against _active, which the informer dispatch
+            # path keeps fresh (handle_pod -> _sync_pod) — no per-tick
+            # store round trips (over a RemoteStore each would be a
+            # blocking HTTP call inside the event loop)
+            for key, pod in list(self._active.items()):
+                try:
+                    if key not in self.runtime:
+                        continue
+                    has_liveness = any(c.liveness_probe
+                                       for c in pod.spec.containers)
+                    has_readiness = any(c.readiness_probe
+                                        for c in pod.spec.containers)
+                    if not (has_liveness or has_readiness):
+                        continue
+                    if has_liveness and self._probe_liveness(key, pod):
+                        continue  # restarted: readiness settles next tick
+                    if has_readiness:
+                        self._probe_readiness(key, pod)
+                except Exception:  # noqa: BLE001 — probing must not die
+                    log.exception("probe pass failed for %s", key)
+
+    def _probe_liveness(self, key: str, pod: Pod) -> bool:
+        """True = the probe failed hard and the pod was restarted or
+        terminated this tick."""
+        ok = all(self.runtime.probe(key, pod, c.liveness_probe, "liveness")
+                 for c in pod.spec.containers if c.liveness_probe)
+        if ok:
+            self._liveness_fails.pop(key, None)
+            return False
+        fails = self._liveness_fails.get(key, 0) + 1
+        self._liveness_fails[key] = fails
+        threshold = max((int((c.liveness_probe or {}).get(
+            "failureThreshold", 3)) for c in pod.spec.containers
+            if c.liveness_probe), default=3)
+        if fails < threshold:
+            return False
+        # kill, then restartPolicy decides (the sync loop's liveness
+        # channel, kubelet.go syncLoopIteration livenessManager.Updates):
+        # Never -> the pod goes Failed and stays down
+        self._liveness_fails[key] = 0
+        self.runtime.kill_pod(key)
+        if pod.spec.restart_policy == "Never":
+            self._set_status(key, "Failed", exit_code=137)
+            self._stop_worker(key)
+            self._forget_probes(key)
+            log.info("liveness: %s failed, restartPolicy Never -> Failed",
+                     key)
+            return True
+        self.restart_counts[key] = self.restart_counts.get(key, 0) + 1
+        self.runtime.sync_pod(pod)
+        self._reported.pop(key, None)  # force the restartCount write
+        self._set_status(key, "Running",
+                         ready=self._ready_state.get(
+                             key, self._default_ready(pod)))
+        log.info("liveness: restarted %s (count %d)", key,
+                 self.restart_counts[key])
+        return True
+
+    def _probe_readiness(self, key: str, pod: Pod) -> None:
+        ok = all(self.runtime.probe(key, pod, c.readiness_probe,
+                                    "readiness")
+                 for c in pod.spec.containers if c.readiness_probe)
+        if self._ready_state.get(key) != ok:
+            self._ready_state[key] = ok
+            self._set_status(key, "Running", ready=ok)
 
     # ---- PLEG (pleg/generic.go:181) ----
 
@@ -234,14 +371,17 @@ class Kubelet(HollowKubelet):
             if not self.running:
                 return
             for key, entry in self.runtime.list_pods().items():
+                reported_phase = (self._reported.get(key) or (None,))[0]
                 if entry["state"] == "exited" \
-                        and self._reported.get(key) == "Running":
+                        and reported_phase == "Running":
                     phase = "Succeeded" if entry["exit_code"] == 0 \
                         else "Failed"
-                    self._set_status(key, phase)
+                    self._set_status(key, phase,
+                                     exit_code=entry["exit_code"])
                     self._stop_worker(key)
                     self.runtime.kill_pod(key)
                     self.volumes.unmount_pod(key)
+                    self._forget_probes(key)
 
     # ---- lifecycle ----
 
@@ -249,6 +389,8 @@ class Kubelet(HollowKubelet):
         await super().start()
         self._pleg_task = asyncio.get_running_loop().create_task(
             self._pleg_loop())
+        self._probe_task = asyncio.get_running_loop().create_task(
+            self._probe_loop())
         if self.serve_api:
             from kubernetes_tpu.agent.server import KubeletServer
 
@@ -269,6 +411,9 @@ class Kubelet(HollowKubelet):
         if self._pleg_task is not None:
             self._pleg_task.cancel()
             self._pleg_task = None
+        if self._probe_task is not None:
+            self._probe_task.cancel()
+            self._probe_task = None
         if self.server is not None:
             self.server.close()
             self.server = None

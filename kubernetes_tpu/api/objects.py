@@ -122,6 +122,10 @@ class Container:
     requests: dict[str, str] = field(default_factory=dict)
     limits: dict[str, str] = field(default_factory=dict)
     ports: list[ContainerPort] = field(default_factory=list)
+    # raw v1 Probe dicts (exec/httpGet/tcpSocket + thresholds) — consumed
+    # by the agent's prober manager (pkg/kubelet/prober)
+    liveness_probe: dict[str, Any] | None = None
+    readiness_probe: dict[str, Any] | None = None
 
     def clone(self) -> "Container":
         return Container(
@@ -129,6 +133,8 @@ class Container:
             limits=dict(self.limits),
             ports=[ContainerPort(p.container_port, p.host_port, p.protocol,
                                  p.host_ip) for p in self.ports],
+            liveness_probe=copy.deepcopy(self.liveness_probe),
+            readiness_probe=copy.deepcopy(self.readiness_probe),
         )
 
     @classmethod
@@ -140,6 +146,8 @@ class Container:
             requests={k: str(v) for k, v in (res.get("requests") or {}).items()},
             limits={k: str(v) for k, v in (res.get("limits") or {}).items()},
             ports=[ContainerPort.from_dict(p) for p in d.get("ports") or []],
+            liveness_probe=copy.deepcopy(d.get("livenessProbe")),
+            readiness_probe=copy.deepcopy(d.get("readinessProbe")),
         )
 
     def to_dict(self) -> dict[str, Any]:
@@ -155,6 +163,10 @@ class Container:
             out["resources"] = res
         if self.ports:
             out["ports"] = [p.to_dict() for p in self.ports]
+        if self.liveness_probe is not None:
+            out["livenessProbe"] = copy.deepcopy(self.liveness_probe)
+        if self.readiness_probe is not None:
+            out["readinessProbe"] = copy.deepcopy(self.readiness_probe)
         return out
 
 
@@ -294,11 +306,18 @@ class PodStatus:
     phase: str = "Pending"
     conditions: list[dict[str, Any]] = field(default_factory=list)
     host_ip: str = ""
+    # raw v1 ContainerStatus dicts (restartCount/ready/state) written by
+    # the agent's status manager, read by kubectl get (RESTARTS column)
+    container_statuses: list[dict[str, Any]] = field(default_factory=list)
 
     def clone(self) -> "PodStatus":
+        # containerStatuses entries nest state dicts — deep-copy so a
+        # caller mutating a clone can't reach the store's canonical object
         return PodStatus(phase=self.phase,
                          conditions=[dict(c) for c in self.conditions],
-                         host_ip=self.host_ip)
+                         host_ip=self.host_ip,
+                         container_statuses=copy.deepcopy(
+                             self.container_statuses))
 
     @classmethod
     def from_dict(cls, d: dict[str, Any]) -> "PodStatus":
@@ -306,6 +325,7 @@ class PodStatus:
             phase=d.get("phase", "Pending") or "Pending",
             conditions=list(d.get("conditions") or []),
             host_ip=d.get("hostIP", "") or "",
+            container_statuses=list(d.get("containerStatuses") or []),
         )
 
     def to_dict(self) -> dict[str, Any]:
@@ -314,6 +334,8 @@ class PodStatus:
             out["conditions"] = list(self.conditions)
         if self.host_ip:
             out["hostIP"] = self.host_ip
+        if self.container_statuses:
+            out["containerStatuses"] = list(self.container_statuses)
         return out
 
 

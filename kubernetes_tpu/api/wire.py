@@ -143,6 +143,11 @@ def _pod_to(msg, d: dict) -> None:
             pp.host_port = int(p.get("hostPort", 0))
             pp.protocol = p.get("protocol", "") or ""
             pp.host_ip = p.get("hostIP", "") or ""
+        if c.get("livenessProbe"):
+            pc.liveness_probe_json = json.dumps(c["livenessProbe"]).encode()
+        if c.get("readinessProbe"):
+            pc.readiness_probe_json = json.dumps(
+                c["readinessProbe"]).encode()
     for t in spec.get("tolerations") or []:
         pt = s.tolerations.add()
         pt.key = t.get("key", "") or ""
@@ -165,6 +170,9 @@ def _pod_to(msg, d: dict) -> None:
         msg.status.conditions_json = json.dumps(
             status["conditions"]).encode()
     msg.status.host_ip = status.get("hostIP", "") or ""
+    if status.get("containerStatuses"):
+        msg.status.container_statuses_json = json.dumps(
+            status["containerStatuses"]).encode()
 
 
 def _pod_from(msg) -> dict:
@@ -194,6 +202,10 @@ def _pod_from(msg) -> dict:
                     **({"protocol": pp.protocol} if pp.protocol else {}),
                     **({"hostIP": pp.host_ip} if pp.host_ip else {}),
                 } for pp in pc.ports]
+            if pc.liveness_probe_json:
+                c["livenessProbe"] = json.loads(pc.liveness_probe_json)
+            if pc.readiness_probe_json:
+                c["readinessProbe"] = json.loads(pc.readiness_probe_json)
             containers.append(c)
         spec["containers"] = containers
     if s.tolerations:
@@ -231,6 +243,9 @@ def _pod_from(msg) -> dict:
         status["conditions"] = json.loads(msg.status.conditions_json)
     if msg.status.host_ip:
         status["hostIP"] = msg.status.host_ip
+    if msg.status.container_statuses_json:
+        status["containerStatuses"] = json.loads(
+            msg.status.container_statuses_json)
     return {"kind": "Pod", "apiVersion": "v1",
             "metadata": _meta_from(msg.metadata), "spec": spec,
             "status": status}

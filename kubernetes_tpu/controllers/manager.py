@@ -114,6 +114,13 @@ class ControllerManager:
                 store, self.informers["Node"], pods,
                 **(node_lifecycle_kwargs or {}))
             self.controllers.append(self.node_lifecycle)
+            from kubernetes_tpu.controllers.taintmanager import (
+                NoExecuteTaintManager,
+            )
+
+            self.taint_manager = NoExecuteTaintManager(
+                store, self.informers["Node"], pods)
+            self.controllers.append(self.taint_manager)
         from kubernetes_tpu.controllers.nodeipam import (
             NodeIpamController,
             RouteController,

@@ -27,9 +27,13 @@ import asyncio
 import logging
 import time
 
-from kubernetes_tpu.api.objects import NodeCondition
+from kubernetes_tpu.api.objects import NodeCondition, Taint
 from kubernetes_tpu.apiserver.store import Conflict, NotFound, ObjectStore
 from kubernetes_tpu.client.informer import Informer
+from kubernetes_tpu.controllers.taintmanager import (
+    NOT_READY_TAINT,
+    UNREACHABLE_TAINT,
+)
 from kubernetes_tpu.utils.events import EventRecorder
 
 log = logging.getLogger(__name__)
@@ -53,7 +57,8 @@ class NodeLifecycleController:
                  grace_period: float = GRACE_PERIOD,
                  startup_grace_period: float = STARTUP_GRACE_PERIOD,
                  eviction_timeout: float = EVICTION_TIMEOUT,
-                 eviction_rate: float = EVICTION_RATE):
+                 eviction_rate: float = EVICTION_RATE,
+                 taint_based_evictions: bool = True):
         self.store = store
         self.nodes = node_informer
         self.pods = pod_informer
@@ -62,6 +67,10 @@ class NodeLifecycleController:
         self.startup_grace_period = startup_grace_period
         self.eviction_timeout = eviction_timeout
         self.eviction_rate = eviction_rate
+        # stamp NotReady/unreachable NoExecute taints so the taint manager
+        # can run its tolerationSeconds eviction flow
+        # (node_controller.go:274-302, alpha TaintBasedEvictions)
+        self.taint_based_evictions = taint_based_evictions
         self.events = EventRecorder(store, component="node-controller")
         # node -> wall time the controller first saw it not-Ready
         self._not_ready_since: dict[str, float] = {}
@@ -113,12 +122,20 @@ class NodeLifecycleController:
                 if now - hb > self.grace_period:
                     self._mark_unknown(name, now)
                     self._track_not_ready(name, now)
+                    self._ensure_condition_taint(name, UNREACHABLE_TAINT)
                 else:
                     # healthy: clear tracking, cancel any pending eviction
                     self._not_ready_since.pop(name, None)
                     self._queued.discard(name)
                     self._evicted.discard(name)
+                    self._ensure_condition_taint(name, None)
             else:
+                # not ready: Unknown (stale heartbeat) taints unreachable,
+                # False (the kubelet itself reports NotReady) taints
+                # notReady (node_controller.go:274-302)
+                self._ensure_condition_taint(
+                    name, UNREACHABLE_TAINT if ready.status == "Unknown"
+                    else NOT_READY_TAINT)
                 since = self._track_not_ready(
                     name, min(now, ready.last_transition_time or now))
                 if now - since > self.eviction_timeout \
@@ -144,6 +161,34 @@ class NodeLifecycleController:
 
     def _track_not_ready(self, name: str, when: float) -> float:
         return self._not_ready_since.setdefault(name, when)
+
+    def _ensure_condition_taint(self, name: str, want: str | None) -> None:
+        """Converge the node's condition taints to exactly `want` (one of
+        the NoExecute condition taints, or None for a healthy node)."""
+        if not self.taint_based_evictions:
+            return
+        node = self.nodes.get(name)
+        if node is None:
+            return
+        have = {t.key for t in node.spec.taints
+                if t.effect == "NoExecute"
+                and t.key in (NOT_READY_TAINT, UNREACHABLE_TAINT)}
+        if have == ({want} if want else set()):
+            return
+
+        def mutate(n):
+            n.spec.taints = [
+                t for t in n.spec.taints
+                if not (t.effect == "NoExecute"
+                        and t.key in (NOT_READY_TAINT, UNREACHABLE_TAINT))]
+            if want:
+                n.spec.taints.append(Taint(key=want, effect="NoExecute"))
+            return n
+
+        try:
+            self.store.guaranteed_update("Node", name, "default", mutate)
+        except (NotFound, Conflict):
+            pass
 
     def _mark_unknown(self, name: str, now: float) -> None:
         """Ready -> Unknown (NodeStatusUnknown, node_controller.go:684)."""

@@ -1,0 +1,176 @@
+"""NoExecute taint manager: evict running pods from tainted nodes.
+
+The NoExecuteTaintManager analog (reference
+pkg/controller/node/scheduler/taint_controller.go:167 NewNoExecuteTaintManager,
+:238 handlePodUpdate/handleNodeUpdate; wired into the node controller at
+node_controller.go:162,274-302). Semantics:
+
+- a pod on a node with NoExecute taints must tolerate EVERY such taint or
+  it is evicted immediately;
+- tolerations carrying tolerationSeconds bound the stay: the pod is
+  evicted after min(tolerationSeconds over the tolerations used)
+  (getMinTolerationTime, taint_controller.go:146) — the timer restarts
+  only when the taint set changes;
+- tolerations without tolerationSeconds tolerate forever;
+- removing the taints cancels pending evictions.
+
+The node lifecycle controller feeds this by tainting NotReady/unreachable
+nodes (node_controller.go:274-302's alpha TaintBasedEvictions flow), and
+the DefaultTolerationSeconds admission plugin stamps the 300s default
+tolerations on every pod — together: node dies -> taint lands -> pods get
+300s to be rescued -> taint manager deletes them.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+
+from kubernetes_tpu.apiserver.store import NotFound, ObjectStore, WatchEvent
+from kubernetes_tpu.client.informer import Informer
+from kubernetes_tpu.utils.events import EventRecorder
+
+log = logging.getLogger(__name__)
+
+# the node-condition taints the node lifecycle controller manages
+# (metav1 TaintNodeNotReady/TaintNodeUnreachable at the alpha vintage)
+NOT_READY_TAINT = "node.alpha.kubernetes.io/notReady"
+UNREACHABLE_TAINT = "node.alpha.kubernetes.io/unreachable"
+
+
+def noexecute_taints(node) -> list:
+    return [t for t in node.spec.taints if t.effect == "NoExecute"]
+
+
+def _fingerprint(taints) -> tuple:
+    return tuple(sorted((t.key, t.value) for t in taints))
+
+
+def min_toleration_seconds(pod, taints) -> float | None:
+    """None = not tolerated (evict now); float('inf') = tolerated forever;
+    else seconds until eviction (getMinTolerationTime)."""
+    best = float("inf")
+    for taint in taints:
+        matching = [t for t in pod.spec.tolerations if t.tolerates(taint)]
+        if not matching:
+            return None
+        bounded = [t.toleration_seconds for t in matching
+                   if t.toleration_seconds is not None]
+        if bounded and not any(t.toleration_seconds is None
+                               for t in matching):
+            best = min(best, max(0, min(bounded)))
+    return best
+
+
+class NoExecuteTaintManager:
+    name = "taint-manager"
+
+    def __init__(self, store: ObjectStore, node_informer: Informer,
+                 pod_informer: Informer):
+        self.store = store
+        self.nodes = node_informer
+        self.pods = pod_informer
+        self.events = EventRecorder(store, component="taint-controller")
+        # pod key -> (taint fingerprint the timer was armed for, timer task)
+        self._timers: dict[str, tuple[tuple, asyncio.Task]] = {}
+        # node -> last-seen NoExecute taint fingerprint (handleNodeUpdate's
+        # old-vs-new diff: heartbeat MODIFIED events with unchanged taints
+        # must not trigger a full pod rescan)
+        self._node_taints: dict[str, tuple] = {}
+        self.evicted_pods = 0
+        node_informer.add_handler(self._on_node_event)
+        pod_informer.add_handler(self._on_pod_event)
+
+    async def start(self) -> None:
+        for pod in self.pods.items():
+            self._process_pod(pod)
+
+    def stop(self) -> None:
+        for _deadline, task in self._timers.values():
+            task.cancel()
+        self._timers.clear()
+
+    # ---- informer handlers ----
+
+    def _on_node_event(self, event: WatchEvent) -> None:
+        node = event.obj
+        name = node.metadata.name
+        if event.type == "DELETED":
+            taints = []
+            self._node_taints.pop(name, None)
+            fingerprint = ()
+        else:
+            taints = noexecute_taints(node)
+            fingerprint = _fingerprint(taints)
+            if self._node_taints.get(name) == fingerprint:
+                return  # heartbeat noise: taint set unchanged
+            self._node_taints[name] = fingerprint
+        for pod in self.pods.items():
+            if pod.spec.node_name == name:
+                self._process_pod(pod, taints)
+
+    def _on_pod_event(self, event: WatchEvent) -> None:
+        pod = event.obj
+        if event.type == "DELETED":
+            self._cancel(pod.key)
+            return
+        if pod.spec.node_name:
+            self._process_pod(pod)
+
+    # ---- eviction decisions ----
+
+    def _process_pod(self, pod, taints=None) -> None:
+        if not pod.spec.node_name:
+            return
+        if taints is None:
+            node = self.nodes.get(pod.spec.node_name)
+            taints = noexecute_taints(node) if node is not None else []
+        if not taints:
+            self._cancel(pod.key)
+            return
+        seconds = min_toleration_seconds(pod, taints)
+        if seconds is None:
+            self._cancel(pod.key)
+            self._evict(pod.key)
+            return
+        if seconds == float("inf"):
+            self._cancel(pod.key)
+            return
+        fingerprint = _fingerprint(taints)
+        existing = self._timers.get(pod.key)
+        if existing is not None:
+            if existing[0] == fingerprint:
+                # same taint set: keep the original timer — re-arming on
+                # every pod update would let a chatty status writer extend
+                # the stay forever
+                return
+            # the taint set changed (e.g. notReady swapped for
+            # unreachable): the old deadline no longer applies
+            existing[1].cancel()
+        task = asyncio.get_running_loop().create_task(
+            self._evict_later(pod.key, seconds))
+        self._timers[pod.key] = (fingerprint, task)
+
+    def _cancel(self, pod_key: str) -> None:
+        entry = self._timers.pop(pod_key, None)
+        if entry is not None:
+            entry[1].cancel()
+
+    async def _evict_later(self, pod_key: str, seconds: float) -> None:
+        await asyncio.sleep(seconds)
+        self._timers.pop(pod_key, None)
+        self._evict(pod_key)
+
+    def _evict(self, pod_key: str) -> None:
+        ns, name = pod_key.split("/", 1)
+        pod = self.pods.get(name, ns)
+        try:
+            self.store.delete("Pod", name, ns)
+        except NotFound:
+            return
+        self.evicted_pods += 1
+        if pod is not None:
+            self.events.record(pod, "Normal", "TaintManagerEviction",
+                               f"Marking for deletion Pod {pod_key}")
+        log.info("taint manager: evicted %s", pod_key)

@@ -241,7 +241,11 @@ def test_kill_nodes_under_load_pods_rescheduled():
                 await asyncio.sleep(0.05)
 
         assert ready_status(store, victim) == "Unknown"
-        assert mgr.node_lifecycle.evicted_pods >= n_on_victim
+        # either eviction mechanism may win the race: the taint manager
+        # (immediate, no toleration on these pods) or the lifecycle
+        # controller's rate-limited queue
+        assert (mgr.node_lifecycle.evicted_pods
+                + mgr.taint_manager.evicted_pods) >= n_on_victim
         sched.stop()
         driver.cancel()
         mgr.stop()

@@ -1,0 +1,183 @@
+"""Kubelet probers: readiness gating Endpoints + proxier, liveness restarts.
+
+Pins prober_manager.go:60 / worker.go semantics at the kubemark boundary
+(probe execution is scripted via annotations or runs against the fake exec
+shell), and the readiness->Endpoints->proxier chain the reference wires
+through IsPodReady (endpoints_controller.go:383)."""
+
+import asyncio
+
+from kubernetes_tpu.api.objects import Node, Pod, Service
+from kubernetes_tpu.apiserver import ObjectStore
+from kubernetes_tpu.agent.kubelet import (
+    LIVE_ANNOTATION,
+    READY_ANNOTATION,
+    Kubelet,
+)
+from kubernetes_tpu.client.informer import Informer
+from kubernetes_tpu.controllers.endpoints import EndpointController
+
+
+async def _until(cond, timeout=10.0, period=0.02):
+    async with asyncio.timeout(timeout):
+        while not cond():
+            await asyncio.sleep(period)
+
+
+def _mkpod(store, name, node="n1", readiness=None, liveness=None,
+           labels=None, annotations=None):
+    c: dict = {"name": "c"}
+    if readiness:
+        c["readinessProbe"] = readiness
+    if liveness:
+        c["livenessProbe"] = liveness
+    return store.create(Pod.from_dict({
+        "metadata": {"name": name, "labels": labels or {},
+                     "annotations": annotations or {}},
+        "spec": {"containers": [c], "nodeName": node},
+        "status": {"hostIP": "10.0.0.1"}}))
+
+
+def _flip_annotation(store, name, key, value, kubelet=None):
+    def mutate(pod):
+        pod.metadata.annotations[key] = value
+        return pod
+
+    store.guaranteed_update("Pod", name, "default", mutate)
+    if kubelet is not None:
+        # deliver the update the way the informer dispatch path would
+        # (KubeletCluster._on_pod -> handle_pod); the prober reads the
+        # worker-refreshed spec, not the store, each tick
+        kubelet.handle_pod("MODIFIED", store.get("Pod", name))
+
+
+def test_readiness_gates_endpoints_and_proxier():
+    """Failing readiness removes the pod from Endpoints.addresses (it moves
+    to notReadyAddresses) and from the proxier's compiled restore payload;
+    recovery restores both."""
+
+    async def run():
+        store = ObjectStore()
+        store.create(Node.from_dict({"metadata": {"name": "n1"}}))
+        store.create(Service.from_dict({
+            "metadata": {"name": "web"},
+            "spec": {"selector": {"app": "web"},
+                     "ports": [{"port": 80, "targetPort": 8080}]}}))
+        _mkpod(store, "w1", labels={"app": "web"},
+               readiness={"httpGet": {"path": "/healthz", "port": 8080}})
+        kubelet = Kubelet(store, "n1", heartbeat_every=5.0)
+        await kubelet.start()
+        pods = Informer(store, "Pod")
+        services = Informer(store, "Service")
+        pods.start(), services.start()
+        await pods.wait_for_sync()
+        await services.wait_for_sync()
+        endpoints = EndpointController(store, services, pods)
+        await endpoints.start()
+        kubelet.handle_pod("ADDED", store.get("Pod", "w1"))
+
+        def addresses():
+            try:
+                ep = store.get("Endpoints", "web")
+            except KeyError:
+                return None
+            if not ep.subsets:
+                return []
+            return [a["targetRef"]["name"]
+                    for a in ep.subsets[0].get("addresses", [])]
+
+        def not_ready():
+            try:
+                ep = store.get("Endpoints", "web")
+            except KeyError:
+                return []
+            if not ep.subsets:
+                return []
+            return [a["targetRef"]["name"]
+                    for a in ep.subsets[0].get("notReadyAddresses", [])]
+
+        await _until(lambda: addresses() == ["w1"])
+
+        from kubernetes_tpu.proxy.proxier import FakeIptables, Proxier
+
+        proxier = Proxier(store, iptables=FakeIptables())
+        await proxier.start()
+        await _until(lambda: "10.0.0.1" in proxier.iptables.current)
+
+        # readiness fails -> out of addresses, out of the NAT payload
+        _flip_annotation(store, "w1", READY_ANNOTATION, "false", kubelet)
+        await _until(lambda: addresses() == [] and not_ready() == ["w1"])
+        await _until(lambda: "10.0.0.1" not in proxier.iptables.current)
+
+        # recovery -> back in
+        _flip_annotation(store, "w1", READY_ANNOTATION, "true", kubelet)
+        await _until(lambda: addresses() == ["w1"])
+        await _until(lambda: "10.0.0.1" in proxier.iptables.current)
+
+        proxier.stop()
+        endpoints.stop()
+        pods.stop(), services.stop()
+        kubelet.stop()
+
+    asyncio.run(run())
+
+
+def test_liveness_failure_bumps_restart_count():
+    async def run():
+        store = ObjectStore()
+        store.create(Node.from_dict({"metadata": {"name": "n1"}}))
+        _mkpod(store, "flaky",
+               liveness={"httpGet": {"path": "/live", "port": 80},
+                         "failureThreshold": 2})
+        kubelet = Kubelet(store, "n1", heartbeat_every=5.0)
+        await kubelet.start()
+        kubelet.handle_pod("ADDED", store.get("Pod", "flaky"))
+
+        def restarts():
+            pod = store.get("Pod", "flaky")
+            cs = pod.status.container_statuses
+            return cs[0]["restartCount"] if cs else 0
+
+        await _until(lambda: store.get("Pod", "flaky").status.phase
+                     == "Running")
+        assert restarts() == 0
+        _flip_annotation(store, "flaky", LIVE_ANNOTATION, "false", kubelet)
+        await _until(lambda: restarts() >= 1)
+        # keeps failing -> keeps restarting
+        await _until(lambda: restarts() >= 2)
+        # recovers -> restart count stops growing and the pod stays Running
+        _flip_annotation(store, "flaky", LIVE_ANNOTATION, "true", kubelet)
+        await asyncio.sleep(0.3)
+        level = restarts()
+        await asyncio.sleep(0.4)
+        assert restarts() == level
+        assert store.get("Pod", "flaky").status.phase == "Running"
+        kubelet.stop()
+
+    asyncio.run(run())
+
+
+def test_exec_probe_runs_against_fake_shell():
+    async def run():
+        store = ObjectStore()
+        store.create(Node.from_dict({"metadata": {"name": "n1"}}))
+        _mkpod(store, "execprobe",
+               readiness={"exec": {"command": ["false"]}})
+        kubelet = Kubelet(store, "n1", heartbeat_every=5.0)
+        await kubelet.start()
+        kubelet.handle_pod("ADDED", store.get("Pod", "execprobe"))
+
+        def ready():
+            pod = store.get("Pod", "execprobe")
+            return any(c.get("type") == "Ready"
+                       and c.get("status") == "True"
+                       for c in pod.status.conditions)
+
+        await _until(lambda: store.get("Pod", "execprobe").status.phase
+                     == "Running")
+        # `false` exits 1 -> readiness never True
+        await asyncio.sleep(0.4)
+        assert not ready()
+        kubelet.stop()
+
+    asyncio.run(run())

@@ -1,0 +1,216 @@
+"""NoExecute taint manager drills.
+
+Pins taint_controller.go:167 semantics: immediate eviction of
+non-tolerating pods, tolerationSeconds-bounded stays, forever-toleration,
+cancellation on taint removal — and the node lifecycle wiring that stamps
+notReady/unreachable NoExecute taints (node_controller.go:274-302)."""
+
+import asyncio
+import time
+
+import pytest
+
+from kubernetes_tpu.api.objects import Node, Pod, Taint, Toleration
+from kubernetes_tpu.apiserver import ObjectStore
+from kubernetes_tpu.client.informer import Informer
+from kubernetes_tpu.controllers.nodelifecycle import NodeLifecycleController
+from kubernetes_tpu.controllers.taintmanager import (
+    NOT_READY_TAINT,
+    UNREACHABLE_TAINT,
+    NoExecuteTaintManager,
+    min_toleration_seconds,
+)
+
+
+def _taint(key="dedicated", effect="NoExecute"):
+    return Taint(key=key, value="", effect=effect)
+
+
+def test_min_toleration_seconds_semantics():
+    pod = Pod.from_dict({
+        "metadata": {"name": "p"},
+        "spec": {"containers": [{"name": "c"}]}})
+    # no toleration -> evict now
+    assert min_toleration_seconds(pod, [_taint()]) is None
+    # unbounded toleration -> forever
+    pod.spec.tolerations = [Toleration(key="dedicated",
+                                       operator="Exists")]
+    assert min_toleration_seconds(pod, [_taint()]) == float("inf")
+    # bounded -> min over taints
+    pod.spec.tolerations = [
+        Toleration(key="dedicated", operator="Exists",
+                   toleration_seconds=30),
+        Toleration(key="other", operator="Exists", toleration_seconds=5)]
+    assert min_toleration_seconds(
+        pod, [_taint(), _taint("other")]) == 5
+    # one taint untolerated among several -> evict now
+    assert min_toleration_seconds(
+        pod, [_taint(), _taint("lonely")]) is None
+
+
+async def _cluster():
+    store = ObjectStore()
+    nodes = Informer(store, "Node")
+    pods = Informer(store, "Pod")
+    nodes.start()
+    pods.start()
+    await nodes.wait_for_sync()
+    await pods.wait_for_sync()
+    return store, nodes, pods
+
+
+def _mkpod(store, name, node="n1", tolerations=None):
+    store.create(Pod.from_dict({
+        "metadata": {"name": name},
+        "spec": {"containers": [{"name": "c"}],
+                 "nodeName": node,
+                 "tolerations": tolerations or []}}))
+
+
+def test_noexecute_eviction_drill():
+    """VERDICT done-criterion drill: taint a node NoExecute — tolerating
+    pods survive their tolerationSeconds, others evict immediately."""
+
+    async def run():
+        store, nodes, pods = await _cluster()
+        store.create(Node.from_dict({"metadata": {"name": "n1"}}))
+        _mkpod(store, "doomed")
+        _mkpod(store, "short", tolerations=[
+            {"key": "dedicated", "operator": "Exists",
+             "tolerationSeconds": 1}])
+        _mkpod(store, "forever", tolerations=[
+            {"key": "dedicated", "operator": "Exists"}])
+        mgr = NoExecuteTaintManager(store, nodes, pods)
+        await mgr.start()
+        await asyncio.sleep(0.05)
+
+        def mutate(n):
+            n.spec.taints.append(_taint())
+            return n
+
+        store.guaranteed_update("Node", "n1", "default", mutate)
+        await asyncio.sleep(0.3)
+        alive = {p.metadata.name for p in store.list("Pod")}
+        assert alive == {"short", "forever"}, alive  # doomed went now
+        await asyncio.sleep(1.2)
+        alive = {p.metadata.name for p in store.list("Pod")}
+        assert alive == {"forever"}, alive          # short expired
+        mgr.stop()
+
+    asyncio.run(run())
+
+
+def test_taint_removal_cancels_pending_eviction():
+    async def run():
+        store, nodes, pods = await _cluster()
+        store.create(Node.from_dict({
+            "metadata": {"name": "n1"},
+            "spec": {"taints": [{"key": "dedicated",
+                                 "effect": "NoExecute"}]}}))
+        _mkpod(store, "spared", tolerations=[
+            {"key": "dedicated", "operator": "Exists",
+             "tolerationSeconds": 1}])
+        mgr = NoExecuteTaintManager(store, nodes, pods)
+        await mgr.start()
+        await asyncio.sleep(0.2)
+
+        def untaint(n):
+            n.spec.taints = []
+            return n
+
+        store.guaranteed_update("Node", "n1", "default", untaint)
+        await asyncio.sleep(1.2)
+        assert [p.metadata.name for p in store.list("Pod")] == ["spared"]
+        mgr.stop()
+
+    asyncio.run(run())
+
+
+def test_nodelifecycle_stamps_condition_taints():
+    """A stale heartbeat taints unreachable; a NotReady report taints
+    notReady; recovery clears both."""
+
+    async def run():
+        store, nodes, pods = await _cluster()
+        now = time.time()
+        store.create(Node.from_dict({
+            "metadata": {"name": "n1"},
+            "status": {"conditions": [{
+                "type": "Ready", "status": "True",
+                "lastHeartbeatTime": now}]}}))
+        ctl = NodeLifecycleController(store, nodes, pods,
+                                      grace_period=10.0,
+                                      eviction_timeout=1000.0)
+        await asyncio.sleep(0.05)
+        # healthy: no condition taints
+        ctl.monitor_once(now=now + 1)
+        assert not store.get("Node", "n1").spec.taints
+        # heartbeat goes stale -> Unknown + unreachable taint
+        ctl.monitor_once(now=now + 60)
+        await asyncio.sleep(0.05)
+        node = store.get("Node", "n1")
+        keys = {t.key for t in node.spec.taints}
+        assert keys == {UNREACHABLE_TAINT}
+        ready = next(c for c in node.status.conditions
+                     if c.type == "Ready")
+        assert ready.status == "Unknown"
+        # kubelet reports NotReady explicitly -> notReady taint replaces it
+        def report_notready(n):
+            c = next(c for c in n.status.conditions if c.type == "Ready")
+            c.status = "False"
+            c.last_heartbeat_time = now + 61
+            return n
+
+        store.guaranteed_update("Node", "n1", "default", report_notready)
+        await asyncio.sleep(0.05)
+        ctl.monitor_once(now=now + 62)
+        await asyncio.sleep(0.05)
+        keys = {t.key for t in store.get("Node", "n1").spec.taints}
+        assert keys == {NOT_READY_TAINT}
+        # recovery clears the condition taints
+        def recover(n):
+            c = next(c for c in n.status.conditions if c.type == "Ready")
+            c.status = "True"
+            c.last_heartbeat_time = now + 63
+            return n
+
+        store.guaranteed_update("Node", "n1", "default", recover)
+        await asyncio.sleep(0.05)
+        ctl.monitor_once(now=now + 64)
+        await asyncio.sleep(0.05)
+        assert not store.get("Node", "n1").spec.taints
+
+    asyncio.run(run())
+
+
+def test_end_to_end_taint_based_eviction_via_manager():
+    """Node dies -> lifecycle taints unreachable -> taint manager deletes
+    the non-tolerating pod immediately (tolerationSeconds path covered
+    above); pods with the default 300s toleration stay."""
+
+    async def run():
+        store, nodes, pods = await _cluster()
+        now = time.time()
+        store.create(Node.from_dict({
+            "metadata": {"name": "n1"},
+            "status": {"conditions": [{
+                "type": "Ready", "status": "True",
+                "lastHeartbeatTime": now}]}}))
+        _mkpod(store, "naked")
+        _mkpod(store, "defaulted", tolerations=[
+            {"key": UNREACHABLE_TAINT, "operator": "Exists",
+             "effect": "NoExecute", "tolerationSeconds": 300}])
+        ctl = NodeLifecycleController(store, nodes, pods,
+                                      grace_period=5.0,
+                                      eviction_timeout=1000.0)
+        mgr = NoExecuteTaintManager(store, nodes, pods)
+        await mgr.start()
+        await asyncio.sleep(0.05)
+        ctl.monitor_once(now=now + 60)
+        await asyncio.sleep(0.3)
+        alive = {p.metadata.name for p in store.list("Pod")}
+        assert alive == {"defaulted"}, alive
+        assert mgr.evicted_pods == 1
+        mgr.stop()
+
+    asyncio.run(run())
