@@ -1,0 +1,112 @@
+"""HTTP extender client: the driver's outbound half of the extender seam.
+
+The HTTPExtender analog (reference plugin/pkg/scheduler/core/extender.go:40;
+Filter :100, Prioritize :143, POST mechanics :227-243): after the device
+evaluates a pod, each configured extender (api/types.go:129 ExtenderConfig)
+gets ExtenderArgs JSON and may veto candidates (Filter) and add weighted
+scores (Prioritize). nodeCacheCapable extenders receive only node names;
+others get full Node objects. A Filter error fails the pod's scheduling
+attempt (generic_scheduler.go:211-228 returns the error), which requeues
+it with backoff like any other failure."""
+
+from __future__ import annotations
+
+import json
+import socket
+from urllib.parse import urlsplit
+
+from kubernetes_tpu.models.policy import ExtenderConfig
+
+
+class ExtenderError(Exception):
+    """Transport failure, non-200, or an error field in the reply."""
+
+
+class HTTPExtender:
+    def __init__(self, config: ExtenderConfig):
+        self.config = config
+        url = urlsplit(config.url_prefix)
+        self.host = url.hostname or "127.0.0.1"
+        self.port = url.port or 80
+        self.path_prefix = (url.path or "").rstrip("/")
+
+    def _post(self, verb: str, args: dict) -> dict | list:
+        payload = json.dumps(args).encode()
+        path = f"{self.path_prefix}/{verb}"
+        try:
+            with socket.create_connection(
+                    (self.host, self.port),
+                    timeout=self.config.http_timeout) as sock:
+                sock.sendall(
+                    f"POST {path} HTTP/1.1\r\n"
+                    f"Host: {self.host}\r\n"
+                    f"Content-Type: application/json\r\n"
+                    f"Content-Length: {len(payload)}\r\n"
+                    f"Connection: close\r\n\r\n".encode() + payload)
+                data = b""
+                while True:
+                    chunk = sock.recv(65536)
+                    if not chunk:
+                        break
+                    data += chunk
+        except OSError as e:
+            raise ExtenderError(
+                f"extender {self.config.url_prefix}/{verb}: {e}") from e
+        head, _, body = data.partition(b"\r\n\r\n")
+        try:
+            status = int(head.split(None, 2)[1])
+        except (IndexError, ValueError):
+            raise ExtenderError(
+                f"extender {self.config.url_prefix}/{verb}: bad reply"
+            ) from None
+        if status != 200:
+            raise ExtenderError(
+                f"extender {self.config.url_prefix}/{verb}: HTTP {status}")
+        try:
+            return json.loads(body)
+        except ValueError as e:
+            raise ExtenderError(
+                f"extender {self.config.url_prefix}/{verb}: bad JSON: {e}"
+            ) from e
+
+    def _args(self, pod, names: list[str], nodes_by_name) -> dict:
+        if self.config.node_cache_capable or nodes_by_name is None:
+            return {"pod": pod.to_dict(), "nodenames": list(names)}
+        return {"pod": pod.to_dict(),
+                "nodes": {"apiVersion": "v1", "kind": "NodeList",
+                          "items": [nodes_by_name[n].to_dict()
+                                    for n in names if n in nodes_by_name]}}
+
+    def filter(self, pod, names: list[str],
+               nodes_by_name=None) -> tuple[list[str], dict[str, str]]:
+        """-> (passed names, failed name -> reason). No filter verb
+        configured = pass-through (extender.go:105)."""
+        if not self.config.filter_verb:
+            return list(names), {}
+        reply = self._post(self.config.filter_verb,
+                           self._args(pod, names, nodes_by_name))
+        if not isinstance(reply, dict):
+            raise ExtenderError("filter reply must be an object")
+        if reply.get("error"):
+            raise ExtenderError(str(reply["error"]))
+        if reply.get("nodenames") is not None:
+            passed = list(reply["nodenames"])
+        elif reply.get("nodes") is not None:
+            passed = [((n.get("metadata") or {}).get("name", ""))
+                      for n in (reply["nodes"].get("items") or [])]
+        else:
+            passed = []
+        return passed, dict(reply.get("failedNodes") or {})
+
+    def prioritize(self, pod, names: list[str],
+                   nodes_by_name=None) -> dict[str, float]:
+        """-> name -> extender score x configured weight
+        (generic_scheduler.go:381-401 combines them additively)."""
+        if not self.config.prioritize_verb:
+            return {}
+        reply = self._post(self.config.prioritize_verb,
+                           self._args(pod, names, nodes_by_name))
+        if not isinstance(reply, list):
+            raise ExtenderError("prioritize reply must be a list")
+        return {e.get("host", ""): float(e.get("score", 0))
+                * self.config.weight for e in reply}

@@ -70,6 +70,20 @@ KNOWN_PRIORITIES = frozenset({
 
 
 @dataclass(frozen=True)
+class ExtenderConfig:
+    """One configured external extender (api/types.go:129 ExtenderConfig):
+    the scheduler POSTs ExtenderArgs to urlPrefix/verb after its own
+    evaluation (core/extender.go:100 Filter, :143 Prioritize)."""
+
+    url_prefix: str = ""
+    filter_verb: str = ""
+    prioritize_verb: str = ""
+    weight: int = 1
+    node_cache_capable: bool = False
+    http_timeout: float = 5.0  # extender.go:36 DefaultExtenderTimeout
+
+
+@dataclass(frozen=True)
 class Policy:
     predicates: tuple[str, ...] = DEFAULT_PREDICATES
     priorities: tuple[tuple[str, int], ...] = DEFAULT_PRIORITIES
@@ -93,6 +107,9 @@ class Policy:
     label_priorities: tuple = ()
     # (name, label) — ServiceAntiAffinityPriority instances
     service_anti_priorities: tuple = ()
+    # ExtenderConfigs (api/types.go:129): external extenders the driver
+    # calls after device evaluation (core/extender.go:211-228,381-401)
+    extenders: tuple = ()
 
     def __post_init__(self):
         arg_preds = ({n for n, _, _ in self.label_presence_predicates}
@@ -185,6 +202,15 @@ class Policy:
             elif "serviceAntiAffinity" in arg:
                 sa = arg["serviceAntiAffinity"] or {}
                 svc_anti.append((name, sa.get("label", "")))
+        extenders = tuple(
+            ExtenderConfig(
+                url_prefix=e.get("urlPrefix", ""),
+                filter_verb=e.get("filterVerb", "") or "",
+                prioritize_verb=e.get("prioritizeVerb", "") or "",
+                weight=int(e.get("weight", 1) or 1),
+                node_cache_capable=bool(e.get("nodeCacheCapable", False)),
+                http_timeout=float(e.get("httpTimeout", 5.0) or 5.0))
+            for e in d.get("extenders") or [])
         return cls(predicates=tuple(preds) or DEFAULT_PREDICATES,
                    priorities=tuple(prios) or DEFAULT_PRIORITIES,
                    hard_pod_affinity_weight=int(
@@ -192,7 +218,8 @@ class Policy:
                    label_presence_predicates=tuple(label_presence),
                    service_affinity_predicates=tuple(svc_aff),
                    label_priorities=tuple(label_prios),
-                   service_anti_priorities=tuple(svc_anti))
+                   service_anti_priorities=tuple(svc_anti),
+                   extenders=extenders)
 
     def to_json(self) -> str:
         pred_args = {n: {"labelsPresence": {"labels": list(labels),
@@ -205,7 +232,7 @@ class Policy:
                      for n, label, presence in self.label_priorities}
         prio_args.update({n: {"serviceAntiAffinity": {"label": label}}
                           for n, label in self.service_anti_priorities})
-        return json.dumps({
+        out = {
             "kind": "Policy",
             "apiVersion": "v1",
             "predicates": [
@@ -216,7 +243,18 @@ class Policy:
                  **({"argument": prio_args[n]} if n in prio_args else {})}
                 for n, w in self.priorities],
             "hardPodAffinitySymmetricWeight": self.hard_pod_affinity_weight,
-        })
+        }
+        if self.extenders:
+            out["extenders"] = [{
+                "urlPrefix": e.url_prefix,
+                **({"filterVerb": e.filter_verb} if e.filter_verb else {}),
+                **({"prioritizeVerb": e.prioritize_verb}
+                   if e.prioritize_verb else {}),
+                "weight": e.weight,
+                "nodeCacheCapable": e.node_cache_capable,
+                "httpTimeout": e.http_timeout,
+            } for e in self.extenders]
+        return json.dumps(out)
 
     def service_affinity_labels(self) -> tuple:
         """Union of all configured ServiceAffinity labels (for the encode

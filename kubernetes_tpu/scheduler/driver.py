@@ -187,6 +187,14 @@ class Scheduler:
 
         self.mesh = mesh
         self._schedule_fns: dict = {}
+        # policy-configured external extenders (core/extender.go:40): when
+        # present, scheduling runs per pod — device evaluation first, then
+        # each extender's Filter/Prioritize (the reference's composition
+        # points, generic_scheduler.go:211-228,381-401)
+        from kubernetes_tpu.extender.client import HTTPExtender
+
+        self._extenders = [HTTPExtender(c) for c in policy.extenders]
+        self._pod_eval_fn = None
         self._stopped = False
         # Pipelining: dispatch batch k+1 while batch k's result is still in
         # flight on the device, hiding dispatch/readback round-trip latency
@@ -416,6 +424,10 @@ class Scheduler:
         self.metrics.add_phase("encode", time.perf_counter() - t_phase)
         self.metrics.phase_pods += len(pods)
 
+        if self._extenders:
+            return await self._schedule_with_extenders(pods, live_keys,
+                                                       fblob, iblob)
+
         timer = StepTimer(f"scheduling batch of {len(pods)}")
         from kubernetes_tpu.state.pod_batch import packed_batch_flags
 
@@ -472,6 +484,110 @@ class Scheduler:
         self._inflight_q.append((result, pods, live_keys, (fblob, iblob),
                                  flags, t0, timer, False, fetch))
         return settled + await self._asettle_inflight()
+
+    async def _schedule_with_extenders(self, pods: list[Pod],
+                                       live_keys: list[str],
+                                       fblob, iblob) -> int:
+        """Serial per-pod scheduling with extender composition: device
+        evaluation (the full policy's predicates+priorities, the same
+        _pod_eval the batch solver scans) -> each extender's Filter veto ->
+        Prioritize scores added to the device's weighted sum ->
+        round-robin selectHost -> bind. Later pods see earlier assumptions
+        (scheduleOne's serial contract) because the ledger re-flushes per
+        pod. Extender errors fail the pod's attempt and requeue with
+        backoff (generic_scheduler.go:211-228)."""
+        import jax
+
+        from kubernetes_tpu.extender.client import ExtenderError
+        from kubernetes_tpu.ops.solver import evaluate_pod
+        from kubernetes_tpu.state.pod_batch import unpack_batch
+
+        if self._pod_eval_fn is None:
+            caps, policy, prows = self.caps, self.policy, self._prows
+
+            def _eval(state, fb, ib, i):
+                batch = unpack_batch(fb, ib, caps)
+                row = jax.tree.map(lambda a: a[i], batch)
+                return evaluate_pod(state, row, policy, caps=caps,
+                                    prows=prows)
+
+            self._pod_eval_fn = jax.jit(_eval)
+        scheduled = 0
+        full_mode = any(not e.config.node_cache_capable
+                        for e in self._extenders)
+        for i, (key, pod) in enumerate(zip(live_keys, pods)):
+            # per-pod flush: pod k+1 must see pod k's assumption
+            state = self.statedb.flush()
+            feasible, score = self._pod_eval_fn(state, fblob, iblob, i)
+            feasible = np.asarray(feasible)
+            score = np.asarray(score)
+            name_of = self.statedb.table.name_of
+            rows: dict[str, int] = {}
+            names: list[str] = []
+            for row in np.flatnonzero(feasible):
+                node_name = name_of[int(row)]
+                if node_name is not None:
+                    names.append(node_name)
+                    rows[node_name] = int(row)
+            if not names:
+                # nothing feasible device-side: FitError before any
+                # extender round trip (findNodesThatFit returns early)
+                self._fail(key, pod, "no nodes available to schedule pods")
+                continue
+            nodes_by_name = None
+            if full_mode:
+                nodes_by_name = {n: obj for n in names
+                                 if (obj := self.node_informer.get(n))
+                                 is not None}
+            try:
+                ext_scores: dict[str, float] = {}
+                for ext in self._extenders:
+                    names = (await asyncio.to_thread(
+                        ext.filter, pod, names, nodes_by_name))[0]
+                    if not names:
+                        break
+                if names:
+                    for ext in self._extenders:
+                        for node_name, sc in (await asyncio.to_thread(
+                                ext.prioritize, pod, names,
+                                nodes_by_name)).items():
+                            ext_scores[node_name] = \
+                                ext_scores.get(node_name, 0.0) + sc
+            except ExtenderError as e:
+                self._fail(key, pod, f"extender error: {e}")
+                continue
+            names = [n for n in names if n in rows]
+            if not names:
+                self._fail(key, pod, "no nodes available to schedule pods")
+                continue
+            totals = [(float(score[rows[n]]) + ext_scores.get(n, 0.0), n)
+                      for n in names]
+            best = max(total for total, _ in totals)
+            ties = [n for total, n in totals if total == best]
+            choice = ties[int(self._rr) % len(ties)]
+            self._rr = np.uint32(int(self._rr) + 1)
+            try:
+                self.store.bind(Binding(pod_name=pod.metadata.name,
+                                        namespace=pod.metadata.namespace,
+                                        target_node=choice))
+            except (Conflict, NotFound) as e:
+                self.metrics.binding_errors += 1
+                self._fail(key, pod, f"binding rejected: {e}")
+                continue
+            self._assumed.add(key)
+            self.statedb.add_pod(pod, choice)
+            scheduled += 1
+            self.queue.done(key)
+            self.backoff.reset(key)
+            enqueued = self._enqueue_time.pop(key, None)
+            if enqueued is not None:
+                self.metrics.e2e_latency.append(
+                    time.monotonic() - enqueued)
+            self.events.record(pod, "Normal", "Scheduled",
+                               f"Successfully assigned {key} to {choice}")
+        self.metrics.scheduled += scheduled
+        self.metrics.batches += 1
+        return scheduled
 
     def _settle_inflight(self) -> int:
         """Settle every in-flight batch, oldest first (synchronous —
