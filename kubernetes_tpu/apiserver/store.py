@@ -136,6 +136,47 @@ class ObjectStore:
         if flush:
             self._wal.flush()
 
+    def _allocate_node_ports(self, svc) -> None:
+        """NodePort allocation from the conventional 30000-32767 range for
+        type=NodePort/LoadBalancer ports without one; explicit values must
+        be in range and not held by another service (the service
+        registry's portallocator, pkg/registry/core/service)."""
+        from kubernetes_tpu.apiserver.validation import ValidationError
+
+        if svc.spec.get("type") not in ("NodePort", "LoadBalancer"):
+            return
+        key = _key(svc.metadata.namespace, svc.metadata.name)
+        used = {int(p.get("nodePort") or 0)
+                for other_key, other in self._bucket("Service").items()
+                if other_key != key
+                for p in other.spec.get("ports") or []}
+        used.discard(0)
+        explicit: set[int] = set()
+        for p in svc.spec.get("ports") or []:
+            node_port = int(p.get("nodePort") or 0)
+            if not node_port:
+                continue
+            if not 30000 <= node_port < 32768:
+                raise ValidationError(
+                    f"spec.ports.nodePort: {node_port} is out of range "
+                    f"30000-32767")
+            if node_port in used or node_port in explicit:
+                raise ValidationError(
+                    f"spec.ports.nodePort: provided port {node_port} is "
+                    f"already allocated")
+            explicit.add(node_port)
+        used |= explicit
+        nxt = 30000
+        for p in svc.spec.get("ports") or []:
+            if int(p.get("nodePort") or 0):
+                continue
+            while nxt in used and nxt < 32768:
+                nxt += 1
+            if nxt >= 32768:
+                raise ValidationError("node port range exhausted")
+            p["nodePort"] = nxt
+            used.add(nxt)
+
     def _reserve_cluster_ip(self, ip: str) -> None:
         """Advance the allocator past an explicitly-given clusterIP so a
         later auto-allocation cannot hand out a duplicate."""
@@ -195,6 +236,7 @@ class ObjectStore:
                 self._cluster_ip_counter += 1
                 c = self._cluster_ip_counter
                 stored.spec["clusterIP"] = f"10.96.{c // 250}.{c % 250 + 1}"
+            self._allocate_node_ports(stored)
         bucket[key] = stored
         # watch consumers get the stored instance itself and MUST NOT mutate
         # it (same contract as client-go informer caches)
@@ -298,6 +340,26 @@ class ObjectStore:
             ip = current.spec.get("clusterIP")
             if ip:
                 stored.spec["clusterIP"] = ip
+        if kind == "Service":
+            if stored.spec.get("type") in ("NodePort", "LoadBalancer"):
+                # nodePorts are allocate-once: an update that drops them
+                # re-inherits by (port, protocol), then fills gaps
+                have = {(int(p.get("port") or 0),
+                         p.get("protocol", "TCP")):
+                        int(p.get("nodePort") or 0)
+                        for p in current.spec.get("ports") or []}
+                for p in stored.spec.get("ports") or []:
+                    if not int(p.get("nodePort") or 0):
+                        inherited = have.get((int(p.get("port") or 0),
+                                              p.get("protocol", "TCP")))
+                        if inherited:
+                            p["nodePort"] = inherited
+                self._allocate_node_ports(stored)
+            else:
+                # NodePort -> ClusterIP releases the ports (the reference
+                # registry strips them on that transition)
+                for p in stored.spec.get("ports") or []:
+                    p.pop("nodePort", None)
         # a terminating object whose last finalizer was just removed is
         # finalized: it leaves the store now (DELETED, not MODIFIED).
         # Gated on the PRIOR object having had finalizers, so soft-deletes

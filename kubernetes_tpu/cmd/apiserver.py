@@ -1,0 +1,143 @@
+"""The standalone apiserver binary: the kube-apiserver analog.
+
+The reference's primary binary (cmd/kube-apiserver/app/server.go:125
+CreateServerChain wires storage + authn/authz + admission + secure
+serving); this binary serves the same surface from the in-memory store:
+
+    python -m kubernetes_tpu.cmd.apiserver --port 8080 \
+        --wal /var/lib/ktpu/apiserver.wal \
+        --token-auth-file tokens.csv \
+        --authorization-mode ABAC,RBAC \
+        --authorization-policy-file abac.jsonl \
+        --admission-control NamespaceLifecycle,LimitRanger \
+        --tls-cert-file tls.crt --tls-private-key-file tls.key
+
+Flags mirror the reference's options (cmd/kube-apiserver/app/options):
+the WAL path is the etcd analog (checkpoint/resume per SURVEY.md §5.4 —
+kill -9 the process, restart with the same --wal, state and
+resourceVersions resume), --authorization-mode chains authorizers as a
+union, and the admission list picks plugins by name.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+import os
+import sys
+
+log = logging.getLogger(__name__)
+
+
+def parse_args(argv=None) -> argparse.Namespace:
+    p = argparse.ArgumentParser(
+        prog="kubernetes-tpu-apiserver",
+        description="REST API server over the object store "
+                    "(kube-apiserver analog)")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8080)
+    p.add_argument("--wal", default="",
+                   help="write-ahead log path (persistence + resume); "
+                        "empty = in-memory only")
+    p.add_argument("--token-auth-file", default="",
+                   help="csv of token,user,uid[,groups] "
+                        "(--token-auth-file)")
+    p.add_argument("--authorization-mode", default="AlwaysAllow",
+                   help="comma list of AlwaysAllow,ABAC,RBAC "
+                        "(union semantics)")
+    p.add_argument("--authorization-policy-file", default="",
+                   help="ABAC policy file (JSON lines)")
+    p.add_argument("--admission-control",
+                   default="NamespaceLifecycle,DefaultTolerationSeconds,"
+                           "LimitRanger,ResourceQuota,ServiceAccount",
+                   help="ordered comma list of admission plugins")
+    p.add_argument("--tls-cert-file", default="")
+    p.add_argument("--tls-private-key-file", default="")
+    p.add_argument("--audit-log-path", default="")
+    p.add_argument("--max-requests-inflight", type=int, default=400)
+    p.add_argument("--watch-cache-size", type=int, default=1 << 16)
+    return p.parse_args(argv)
+
+
+def build_server(args):
+    """-> (APIServer, ObjectStore). Separated for in-process reuse."""
+    from kubernetes_tpu.apiserver.admission import chain_for
+    from kubernetes_tpu.apiserver.auth import (
+        ABACAuthorizer,
+        RBACAuthorizer,
+        TokenAuthenticator,
+        UnionAuthorizer,
+    )
+    from kubernetes_tpu.apiserver.http import APIServer
+    from kubernetes_tpu.apiserver.store import ObjectStore
+
+    store = ObjectStore(
+        watch_window=args.watch_cache_size,
+        persist_path=args.wal or None,
+        admission=chain_for(args.admission_control)
+        if args.admission_control else None)
+
+    authenticator = None
+    if args.token_auth_file:
+        with open(args.token_auth_file, encoding="utf-8") as f:
+            authenticator = TokenAuthenticator.from_csv(f.read())
+
+    modes = [m.strip() for m in args.authorization_mode.split(",")
+             if m.strip()]
+    authorizers = []
+    for mode in modes:
+        if mode == "AlwaysAllow":
+            authorizers = []  # no authorizer = open (authn-only)
+            break
+        if mode == "ABAC":
+            if not args.authorization_policy_file:
+                raise SystemExit(
+                    "--authorization-mode ABAC needs "
+                    "--authorization-policy-file")
+            with open(args.authorization_policy_file,
+                      encoding="utf-8") as f:
+                authorizers.append(ABACAuthorizer.from_policy_file(
+                    f.read()))
+        elif mode == "RBAC":
+            authorizers.append(RBACAuthorizer(store))
+        else:
+            raise SystemExit(f"unknown authorization mode {mode!r}")
+    authorizer = UnionAuthorizer(*authorizers) if authorizers else None
+
+    server = APIServer(
+        store, host=args.host, port=args.port,
+        authenticator=authenticator, authorizer=authorizer,
+        audit_path=args.audit_log_path or None,
+        max_in_flight=args.max_requests_inflight,
+        tls_cert_file=args.tls_cert_file or None,
+        tls_key_file=args.tls_private_key_file or None)
+    return server, store
+
+
+async def run(args) -> None:
+    server, _store = build_server(args)
+    await server.start()
+    scheme = "https" if args.tls_cert_file else "http"
+    log.info("apiserver serving on %s://%s:%d (wal=%s)",
+             scheme, server.host, server.port, args.wal or "<memory>")
+    print(f"READY {scheme}://{server.host}:{server.port}", flush=True)
+    try:
+        await asyncio.Event().wait()  # serve until killed
+    finally:
+        await server.stop()
+
+
+def main(argv=None) -> int:
+    logging.basicConfig(
+        level=os.environ.get("KUBE_LOG_LEVEL", "INFO"),
+        format="%(asctime)s %(levelname).1s %(name)s: %(message)s")
+    try:
+        asyncio.run(run(parse_args(argv)))
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -33,6 +33,9 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--fake-iptables", action="store_true",
                    help="in-memory table instead of iptables-restore "
                         "(hollow topology)")
+    p.add_argument("--dump-rules-path", default="",
+                   help="write the latest restore payload to this file "
+                        "after every sync (hollow-topology observability)")
     return p.parse_args(argv)
 
 
@@ -47,6 +50,19 @@ async def run(args: argparse.Namespace) -> None:
     url = urlsplit(args.apiserver)
     store = RemoteStore(url.hostname, url.port or 80, token=args.token)
     iptables = FakeIptables() if args.fake_iptables else SystemIptables()
+    if args.dump_rules_path:
+        # observability wrapper over WHICHEVER backend was selected — a
+        # dump request must never silently swap out the real dataplane
+        base_restore = iptables.restore
+
+        def restore(rules: str) -> None:
+            base_restore(rules)
+            tmp = args.dump_rules_path + ".tmp"
+            with open(tmp, "w", encoding="utf-8") as f:
+                f.write(rules)
+            os.replace(tmp, args.dump_rules_path)
+
+        iptables.restore = restore
     proxier = Proxier(store, iptables=iptables,
                       cluster_cidr=args.cluster_cidr)
     await proxier.start()

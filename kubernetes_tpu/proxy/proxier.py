@@ -45,14 +45,21 @@ def sep_chain(ns: str, name: str, port_name: str, endpoint: str) -> str:
 # jump rules from the built-in chains into the kube chains — without these
 # the whole ruleset is unreachable (the reference EnsureRule()s them outside
 # the restore payload, proxier.go:565-600, because declaring a built-in
-# chain in a restore would flush unrelated rules from it)
+# chain in a restore would flush unrelated rules from it). The filter-table
+# KUBE-SERVICES chain carries the no-endpoints REJECTs (REJECT is not a
+# valid nat-table target; proxier.go:544-556).
 JUMP_RULES = (
-    ("PREROUTING", "-m comment --comment kubernetes-service-portals "
-                   "-j KUBE-SERVICES"),
-    ("OUTPUT", "-m comment --comment kubernetes-service-portals "
-               "-j KUBE-SERVICES"),
-    ("POSTROUTING", "-m comment --comment kubernetes-postrouting-rules "
-                    "-j KUBE-POSTROUTING"),
+    ("nat", "PREROUTING", "-m comment --comment "
+                          "kubernetes-service-portals -j KUBE-SERVICES"),
+    ("nat", "OUTPUT", "-m comment --comment kubernetes-service-portals "
+                      "-j KUBE-SERVICES"),
+    ("nat", "POSTROUTING", "-m comment --comment "
+                           "kubernetes-postrouting-rules "
+                           "-j KUBE-POSTROUTING"),
+    ("filter", "INPUT", "-m comment --comment kubernetes-service-portals "
+                        "-j KUBE-SERVICES"),
+    ("filter", "OUTPUT", "-m comment --comment "
+                         "kubernetes-service-portals -j KUBE-SERVICES"),
 )
 
 
@@ -78,13 +85,13 @@ class SystemIptables:
     """Execs the real iptables binaries (iptables.go:98,356)."""
 
     def ensure_jumps(self) -> None:
-        for chain, rule in JUMP_RULES:
+        for table, chain, rule in JUMP_RULES:
             check = subprocess.run(
-                ["iptables", "-t", "nat", "-C", chain, *rule.split()],
+                ["iptables", "-t", table, "-C", chain, *rule.split()],
                 capture_output=True, timeout=30)
             if check.returncode != 0:
                 subprocess.run(
-                    ["iptables", "-t", "nat", "-A", chain, *rule.split()],
+                    ["iptables", "-t", table, "-A", chain, *rule.split()],
                     check=True, timeout=30)
 
     def restore(self, rules: str) -> None:
@@ -178,12 +185,15 @@ class Proxier:
         restore payload (for observability/tests)."""
         lines = ["*nat",
                  ":KUBE-SERVICES - [0:0]",
+                 ":KUBE-NODEPORTS - [0:0]",
                  ":KUBE-MARK-MASQ - [0:0]",
                  ":KUBE-POSTROUTING - [0:0]"]
         rules: list[str] = [
             "-A KUBE-MARK-MASQ -j MARK --set-xmark 0x4000/0x4000",
             "-A KUBE-POSTROUTING -m mark --mark 0x4000/0x4000 -j MASQUERADE",
         ]
+        nodeport_rules: list[str] = []
+        reject_rules: list[str] = []  # filter-table section (REJECTs)
         for svc in sorted(self.services.items(),
                           key=lambda s: (s.metadata.namespace,
                                          s.metadata.name)):
@@ -191,22 +201,37 @@ class Proxier:
             cluster_ip = svc.spec.get("clusterIP", "")
             if not cluster_ip or cluster_ip == "None":
                 continue  # headless / not yet allocated
+            # ClientIP session affinity pins a source to one backend via
+            # the `recent` match (proxier.go:880 affinityMap; timeout from
+            # sessionAffinityConfig, default 10800s)
+            affinity = svc.spec.get("sessionAffinity", "") == "ClientIP"
+            affinity_timeout = int(
+                ((svc.spec.get("sessionAffinityConfig") or {})
+                 .get("clientIP") or {}).get("timeoutSeconds") or 10800)
             for p in svc.spec.get("ports") or []:
                 port = int(p.get("port") or 0)
                 if not port:
                     continue
                 proto = p.get("protocol", "TCP").lower()
                 port_name = p.get("name", "")
+                node_port = int(p.get("nodePort") or 0)
                 endpoints = self._endpoints_for(ns, name, port_name)
                 svcc = svc_chain(ns, name, port_name)
                 comment = f'"{ns}/{name}:{port_name}"'
                 if not endpoints:
-                    # no backends: REJECT, so clients fail fast
-                    # (proxier.go:1171 serviceNoEndpointsChain semantics)
-                    rules.append(
+                    # no backends: REJECT so clients fail fast — in the
+                    # FILTER table (REJECT is not a valid nat target;
+                    # proxier.go:1171 writes these to filterChains)
+                    reject_rules.append(
                         f"-A KUBE-SERVICES -d {cluster_ip}/32 -p {proto} "
                         f"-m {proto} --dport {port} -m comment --comment "
                         f"{comment} -j REJECT")
+                    if node_port:
+                        reject_rules.append(
+                            f"-A KUBE-SERVICES -p {proto} -m {proto} "
+                            f"--dport {node_port} -m addrtype "
+                            f"--dst-type LOCAL -m comment --comment "
+                            f"{comment} -j REJECT")
                     continue
                 lines.append(f":{svcc} - [0:0]")
                 if self.cluster_cidr:
@@ -221,11 +246,35 @@ class Proxier:
                     f"-A KUBE-SERVICES -d {cluster_ip}/32 -p {proto} "
                     f"-m {proto} --dport {port} -m comment --comment "
                     f"{comment} -j {svcc}")
+                if node_port:
+                    # nodePort traffic always masquerades (the reply must
+                    # return via this node; proxier.go:1158-1169), then
+                    # shares the service chain
+                    nodeport_rules.append(
+                        f"-A KUBE-NODEPORTS -p {proto} -m {proto} "
+                        f"--dport {node_port} -m comment --comment "
+                        f"{comment} -j KUBE-MARK-MASQ")
+                    nodeport_rules.append(
+                        f"-A KUBE-NODEPORTS -p {proto} -m {proto} "
+                        f"--dport {node_port} -m comment --comment "
+                        f"{comment} -j {svcc}")
                 n = len(endpoints)
-                for i, ep in enumerate(endpoints):
+                sep_chains = []
+                for ep in endpoints:
                     endpoint = f"{ep['ip']}:{ep['port']}"
-                    sepc = sep_chain(ns, name, port_name, endpoint)
-                    lines.append(f":{sepc} - [0:0]")
+                    sep_chains.append(
+                        (sep_chain(ns, name, port_name, endpoint), ep,
+                         endpoint))
+                    lines.append(f":{sep_chains[-1][0]} - [0:0]")
+                if affinity:
+                    # returning clients short-circuit to their recorded
+                    # backend before the random split (proxier.go:1484)
+                    for sepc, _ep, _endpoint in sep_chains:
+                        rules.append(
+                            f"-A {svcc} -m recent --name {sepc} --rcheck "
+                            f"--seconds {affinity_timeout} --reap "
+                            f"-j {sepc}")
+                for i, (sepc, ep, endpoint) in enumerate(sep_chains):
                     if i < n - 1:
                         # statistic-mode random split over the remaining
                         # backends (proxier.go:1500)
@@ -236,10 +285,29 @@ class Proxier:
                         rules.append(f"-A {svcc} -j {sepc}")
                     rules.append(
                         f"-A {sepc} -s {ep['ip']}/32 -j KUBE-MARK-MASQ")
-                    rules.append(
-                        f"-A {sepc} -p {proto} -m {proto} -j DNAT "
-                        f"--to-destination {endpoint}")
-        payload = "\n".join(lines + rules + ["COMMIT", ""])
+                    if affinity:
+                        rules.append(
+                            f"-A {sepc} -m recent --name {sepc} --set "
+                            f"-p {proto} -m {proto} -j DNAT "
+                            f"--to-destination {endpoint}")
+                    else:
+                        rules.append(
+                            f"-A {sepc} -p {proto} -m {proto} -j DNAT "
+                            f"--to-destination {endpoint}")
+        if nodeport_rules:
+            # the nodeports dispatch anchors LAST in KUBE-SERVICES
+            # (proxier.go:1189: clusterIP rules take precedence)
+            rules.append(
+                "-A KUBE-SERVICES -m comment --comment "
+                '"kubernetes service nodeports" -m addrtype '
+                "--dst-type LOCAL -j KUBE-NODEPORTS")
+            rules.extend(nodeport_rules)
+        sections = lines + rules + ["COMMIT"]
+        # filter-table section: the no-endpoints REJECT chain
+        sections += ["*filter", ":KUBE-SERVICES - [0:0]"]
+        sections += reject_rules
+        sections += ["COMMIT", ""]
+        payload = "\n".join(sections)
         self.iptables.restore(payload)
         self.sync_count += 1
         return payload
