@@ -46,6 +46,11 @@ class HollowKubelet:
                                      "pods": "110"}
         self._task: asyncio.Task | None = None
         self.running = False
+        # False = heartbeats report NotReady (kubelet-detected local
+        # trouble, e.g. runtime down) WITHOUT stopping — the flapping /
+        # partial-failure shape the reference's zone handling sees
+        # (node_controller.go:170); stop() remains the hard-death lever
+        self.report_ready = True
 
     # ---- registration + heartbeat ----
 
@@ -67,25 +72,32 @@ class HollowKubelet:
         self._heartbeat()
 
     def _heartbeat(self) -> None:
-        try:
-            node = self.store.get("Node", self.node_name, "default")
-        except NotFound:
-            return
         now = time.time()
-        ready = None
-        for c in node.status.conditions:
-            if c.type == "Ready":
-                ready = c
-        if ready is None:
-            ready = NodeCondition(type="Ready")
-            node.status.conditions.append(ready)
-        if ready.status != "True":
-            ready.last_transition_time = now
-        ready.status = "True"
-        ready.reason = "KubeletReady"
-        ready.last_heartbeat_time = now
+        want = "True" if self.report_ready else "False"
+        reason = "KubeletReady" if self.report_ready else "KubeletNotReady"
+
+        def mutate(node):
+            # CAS mutating ONLY the Ready condition: a blind full-object
+            # write here raced the lifecycle controller's taint writes and
+            # the TTL annotation (every heartbeat could wipe a just-added
+            # NoExecute taint, flapping evictions forever)
+            ready = None
+            for c in node.status.conditions:
+                if c.type == "Ready":
+                    ready = c
+            if ready is None:
+                ready = NodeCondition(type="Ready")
+                node.status.conditions.append(ready)
+            if ready.status != want:
+                ready.last_transition_time = now
+            ready.status = want
+            ready.reason = reason
+            ready.last_heartbeat_time = now
+            return node
+
         try:
-            self.store.update(node, check_version=False)
+            self.store.guaranteed_update("Node", self.node_name, "default",
+                                         mutate)
         except (Conflict, NotFound):
             pass
 

@@ -397,12 +397,21 @@ class Kubelet(HollowKubelet):
             self.server = KubeletServer(self)
             await self.server.start()
             # publish the endpoint so the apiserver node proxy can find us
-            # (kubelet_node_status.go sets DaemonEndpoints on registration)
-            try:
-                node = self.store.get("Node", self.node_name)
+            # (kubelet_node_status.go sets DaemonEndpoints on registration).
+            # CAS on the Node mutating ONLY daemonEndpoints — a blind
+            # read-modify-write here raced concurrent Node writers over a
+            # RemoteStore and could erase spec.podCIDR/volumesAttached
+            # written between the GET and PUT
+            port = self.server.port
+
+            def mutate(node):
                 node.status.daemon_endpoints = {
-                    "kubeletEndpoint": {"Port": self.server.port}}
-                self.store.update(node, check_version=False)
+                    "kubeletEndpoint": {"Port": port}}
+                return node
+
+            try:
+                self.store.guaranteed_update("Node", self.node_name,
+                                             "default", mutate)
             except (Conflict, NotFound):
                 pass
 

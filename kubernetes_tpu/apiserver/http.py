@@ -231,7 +231,13 @@ class APIServer:
         # WithAudit (config.go:474): one JSON line per request decision
         self._audit = open(audit_path, "a", encoding="utf-8") \
             if audit_path else None
-        # WithMaxInFlightLimit (config.go:471): surplus requests get 429
+        # WithMaxInFlightLimit (config.go:471): surplus requests get 429.
+        # Watches and node-proxy/aggregated relays bypass the counter BY
+        # DESIGN — the reference's longRunningRequestCheck exempts them
+        # (maxinflight.go), since informer watches would otherwise pin the
+        # budget permanently. On this single event loop the counter only
+        # exceeds 1 across awaits (the aggregation relay), which is also
+        # where a slow backend would otherwise queue unboundedly.
         self._in_flight = 0
         self.max_in_flight = max_in_flight
 
@@ -685,11 +691,17 @@ class APIServer:
 
                 pod = self.store.get("Pod", name, ns or "default")
                 if not can_evict(self.store, pod):
+                    # the DisruptionBudget cause distinguishes this 429
+                    # from max-in-flight load shedding (eviction.go returns
+                    # the same shape) — clients must not misread a shed as
+                    # a PDB denial
                     return 429, {"kind": "Status",
                                  "reason": "TooManyRequests",
                                  "message": "Cannot evict pod as it would "
                                             "violate the pod's disruption "
-                                            "budget."}
+                                            "budget.",
+                                 "details": {"causes": [
+                                     {"reason": "DisruptionBudget"}]}}
                 self.store.delete("Pod", name, ns or "default")
                 return 201, {"kind": "Status", "status": "Success"}
             if sub is not None:
@@ -1112,7 +1124,14 @@ class RemoteStore:
         if status == 410:
             raise Expired(decoded.get("message", ""))
         if status == 429:
-            raise TooManyRequests(decoded.get("message", ""))
+            exc = TooManyRequests(decoded.get("message", ""))
+            # machine-readable causes (Status.details.causes) ride the
+            # exception so callers can distinguish a PDB denial from a
+            # load shed without parsing prose
+            exc.causes = tuple(
+                c.get("reason", "") for c in
+                (decoded.get("details") or {}).get("causes") or [])
+            raise exc
         if status >= 400:
             raise ValueError(f"HTTP {status}: {decoded.get('message')}")
         return decoded
@@ -1253,14 +1272,19 @@ class RemoteStore:
 
     def evict(self, name: str, namespace: str = "default") -> bool:
         """pods/eviction subresource. False = the pod's disruption budget
-        refused (HTTP 429) — retry later, like kubectl drain."""
+        refused (HTTP 429 with a DisruptionBudget cause) — retry later,
+        like kubectl drain. A load-shed 429 (max-in-flight, no such cause)
+        re-raises: that is server pressure, not a PDB answer."""
         try:
             self._request(
                 "POST", self._path("Pod", namespace, name) + "/eviction",
                 {"apiVersion": "policy/v1beta1", "kind": "Eviction",
                  "metadata": {"name": name, "namespace": namespace}})
-        except TooManyRequests:
-            return False
+        except TooManyRequests as e:
+            if "DisruptionBudget" in getattr(e, "causes", ()) \
+                    or "disruption budget" in str(e):
+                return False
+            raise
         return True
 
     def watch(self, kind: str | None = None,

@@ -506,6 +506,10 @@ def cmd_drain(client, args) -> int:
                                        pod.metadata.namespace)
             except NotFound:
                 continue  # went away on its own mid-drain: success
+            except TooManyRequests:
+                # load-shed 429 (not a PDB answer): server pressure —
+                # retry on the next pass like real drain does
+                evicted = False
             if evicted:
                 print(f"pod/{pod.metadata.name} evicted")
             else:

@@ -39,6 +39,9 @@ class ServiceAccountController(ReconcileController):
         self.store = store
         self.namespaces = ns_informer
         self.accounts = sa_informer
+        # namespace -> account names index, maintained from the watch —
+        # sync() must not scan every account cluster-wide per namespace
+        self._by_ns: dict[str, set[str]] = {}
         ns_informer.add_handler(self._on_namespace)
         sa_informer.add_handler(self._on_account)
 
@@ -48,7 +51,13 @@ class ServiceAccountController(ReconcileController):
 
     def _on_account(self, event) -> None:
         # account deleted (or token list mutated) → re-ensure its namespace
-        self.enqueue(event.obj.metadata.namespace)
+        ns = event.obj.metadata.namespace
+        name = event.obj.metadata.name
+        if event.type == "DELETED":
+            self._by_ns.get(ns, set()).discard(name)
+        else:
+            self._by_ns.setdefault(ns, set()).add(name)
+        self.enqueue(ns)
 
     async def sync(self, key: str) -> None:
         ns = self.namespaces.get(key)
@@ -58,11 +67,27 @@ class ServiceAccountController(ReconcileController):
             sa = self.accounts.get(name, key)
             if sa is None:
                 try:
-                    sa = self.store.create(ServiceAccount.from_dict(
+                    self.store.create(ServiceAccount.from_dict(
                         {"metadata": {"name": name, "namespace": key}}))
                 except AlreadyExists:
-                    sa = self.store.get("ServiceAccount", name, key)
-            self._ensure_token(sa)
+                    pass
+        # EVERY account in the namespace owns a token Secret — user-created
+        # ones included (tokens_controller.go syncServiceAccount covers all
+        # accounts, not just the managed 'default'); the ns index keeps
+        # this O(accounts in namespace), not O(accounts cluster-wide)
+        for name in list(self._by_ns.get(key, ())):
+            sa = self.accounts.get(name, key)
+            if sa is not None:
+                self._ensure_token(sa)
+        for name in MANAGED_ACCOUNTS:
+            # a just-created managed account may not have reached the
+            # informer cache yet: ensure its token from the store copy
+            if self.accounts.get(name, key) is None:
+                try:
+                    self._ensure_token(
+                        self.store.get("ServiceAccount", name, key))
+                except NotFound:
+                    pass
 
     def _ensure_token(self, sa: ServiceAccount) -> None:
         """TokensController.syncServiceAccount: a token Secret bound to the

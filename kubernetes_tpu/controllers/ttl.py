@@ -39,18 +39,37 @@ class TTLController(ReconcileController):
         node_informer.add_handler(self._on_node)
 
     def _on_node(self, event) -> None:
-        if event.type == "ADDED" or event.type == "DELETED":
-            # cluster size changed: every node may need a new tier
-            for node in self.nodes.items():
-                self.enqueue(node.metadata.name)
-        elif event.type == "MODIFIED":
-            self.enqueue(event.obj.metadata.name)
+        name = event.obj.metadata.name
+        if event.type in ("ADDED", "DELETED"):
+            # track membership in the handler itself (relist replays fire
+            # handlers BEFORE the informer swaps its cache, so reading the
+            # cache size here undercounts), and fan out to every node ONLY
+            # when the count crossed a TTL tier boundary — an
+            # unconditional fan-out made startup O(N^2) at 15k nodes
+            known = getattr(self, "_known_nodes", None)
+            if known is None:
+                known = self._known_nodes = set()
+            if event.type == "ADDED":
+                known.add(name)
+            else:
+                known.discard(name)
+            ttl = desired_ttl(len(known))
+            if ttl != getattr(self, "_last_ttl", None):
+                self._last_ttl = ttl
+                for node_name in known:
+                    self.enqueue(node_name)
+            elif event.type == "ADDED":
+                self.enqueue(name)
+        else:
+            self.enqueue(name)
 
     async def sync(self, key: str) -> None:
         node = self.nodes.get(key)
         if node is None:
             return
-        want = str(desired_ttl(len(self.nodes.items())))
+        count = len(getattr(self, "_known_nodes", ())) \
+            or len(self.nodes.items())
+        want = str(desired_ttl(count))
         if node.metadata.annotations.get(TTL_ANNOTATION) == want:
             return
 
