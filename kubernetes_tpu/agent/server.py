@@ -7,11 +7,20 @@ chunked HTTP — same topology, simpler framing):
 
   GET  /containerLogs/{ns}/{pod}/{container}[?follow=true]
   POST /exec/{ns}/{pod}/{container}?command=<json list>
+  POST /exec/{ns}/{pod}/{container}   + Upgrade  (interactive streaming)
+  POST /portForward/{ns}/{pod}?port=N + Upgrade  (byte tunnel)
   GET  /runningpods/              (debug handler, server.go)
   GET  /healthz
 
 Log following streams chunked lines as the runtime appends them — the
-`kubectl logs -f` experience over the fake runtime.
+`kubectl logs -f` experience over the fake runtime. The Upgrade flows
+speak the channel framing of client/remotecommand.py (the SPDY
+remotecommand/portforward analog, pkg/kubelet/server/remotecommand):
+stdin lines run through the fake shell with stdout/stderr framed back and
+an exit status on the error channel; port-forward relays bytes to the
+pod's port backend (an echo service by default, or a real TCP target
+named by the `kubernetes-tpu/port-map` annotation — {"8080":
+"tcp:host:port"}).
 """
 
 from __future__ import annotations
@@ -62,9 +71,13 @@ class KubeletServer:
                 return
             if parsed is None:
                 return
-            method, target, _headers, _body = parsed
+            method, target, headers, _body = parsed
             url = urlsplit(target)
             query = {k: v[-1] for k, v in parse_qs(url.query).items()}
+            if headers.get("upgrade"):
+                await self._route_upgrade(reader, writer, method, url.path,
+                                          query)
+                return
             await self._route(writer, method, url.path, query)
         except (asyncio.IncompleteReadError, ConnectionError):
             pass
@@ -133,6 +146,170 @@ class KubeletServer:
             return
         writer.write(b"0\r\n\r\n")
         await writer.drain()
+
+    # ---- upgraded streams (remotecommand/portforward analog) ----
+
+    async def _route_upgrade(self, reader, writer, method: str, path: str,
+                             query: dict) -> None:
+        parts = [p for p in path.strip("/").split("/") if p]
+        if len(parts) == 4 and parts[0] == "exec" and method == "POST":
+            key = f"{parts[1]}/{parts[2]}"
+            await self._accept_upgrade(writer)
+            await self._exec_session(reader, writer, key)
+            return
+        if len(parts) == 3 and parts[0] == "portForward" \
+                and method == "POST":
+            key = f"{parts[1]}/{parts[2]}"
+            try:
+                port = int(query.get("port", 0))
+            except ValueError:
+                port = 0
+            await self._accept_upgrade(writer)
+            await self._portforward_session(reader, writer, key, port)
+            return
+        await self._respond(writer, 404, b"not found")
+
+    @staticmethod
+    async def _accept_upgrade(writer) -> None:
+        from kubernetes_tpu.client.remotecommand import UPGRADE_HEADER
+
+        writer.write(f"HTTP/1.1 101 Switching Protocols\r\n"
+                     f"Upgrade: {UPGRADE_HEADER}\r\n"
+                     f"Connection: Upgrade\r\n\r\n".encode())
+        await writer.drain()
+
+    async def _exec_session(self, reader, writer, key: str) -> None:
+        """Interactive shell: each stdin LINE runs through the fake
+        runtime's exec; `exit` (or stdin EOF) ends the session with the
+        last command's exit code on the error channel."""
+        import shlex
+
+        from kubernetes_tpu.client import remotecommand as rc
+
+        runtime = self.kubelet.runtime
+        buffer = b""
+        last_code = 0
+
+        async def run_line(line: bytes) -> None:
+            nonlocal last_code
+            text = line.decode(errors="replace").strip()
+            if not text:
+                return
+            if text == "exit":
+                raise EOFError
+            try:
+                argv = shlex.split(text)
+            except ValueError as e:
+                writer.write(rc.frame(
+                    rc.STDERR, f"parse error: {e}\n".encode()))
+                last_code = 2
+                return
+            code, output = runtime.exec_sync(key, argv)
+            last_code = code
+            target = rc.STDOUT if code == 0 else rc.STDERR
+            writer.write(rc.frame(target, output.encode()))
+            await writer.drain()
+
+        try:
+            while True:
+                got = await rc.read_frame(reader)
+                if got is None:
+                    break
+                channel, payload = got
+                if channel != rc.STDIN:
+                    continue
+                if not payload:
+                    # stdin EOF: a residual line without a trailing newline
+                    # still runs (printf 'cmd' | exec -i must not no-op)
+                    if buffer:
+                        await run_line(buffer)
+                        buffer = b""
+                    break
+                buffer += payload
+                while b"\n" in buffer:
+                    line, _, buffer = buffer.partition(b"\n")
+                    await run_line(line)
+        except (EOFError, ConnectionError, asyncio.CancelledError):
+            pass
+        try:
+            writer.write(rc.frame(rc.ERROR, json.dumps(
+                {"exitCode": last_code}).encode()))
+            await writer.drain()
+        except ConnectionError:
+            pass
+
+    async def _portforward_session(self, reader, writer, key: str,
+                                   port: int) -> None:
+        """Relay STDIN frames to the pod's port backend and its bytes back
+        as STDOUT frames. Backend resolution: the pod's
+        kubernetes-tpu/port-map annotation may name "tcp:host:port" for a
+        real TCP target; anything else (or no entry) is the built-in echo
+        service — enough to prove the tunnel end to end over fakes."""
+        from kubernetes_tpu.apiserver.store import NotFound
+        from kubernetes_tpu.client import remotecommand as rc
+
+        ns, name = key.split("/", 1)
+        target = ""
+        try:
+            pod = self.kubelet.store.get("Pod", name, ns)
+            port_map = json.loads(pod.metadata.annotations.get(
+                "kubernetes-tpu/port-map", "{}"))
+            target = str(port_map.get(str(port), ""))
+        except (NotFound, ValueError):
+            pass
+        up_reader = up_writer = None
+        if target.startswith("tcp:"):
+            _, host, tcp_port = target.split(":", 2)
+            try:
+                up_reader, up_writer = await asyncio.wait_for(
+                    asyncio.open_connection(host, int(tcp_port)), 5.0)
+            except (OSError, asyncio.TimeoutError, ValueError):
+                writer.write(rc.frame(rc.ERROR, json.dumps(
+                    {"error": f"dial {target} failed"}).encode()))
+                await writer.drain()
+                return
+
+        async def downstream():
+            if up_reader is None:
+                return
+            while True:
+                data = await up_reader.read(65536)
+                if not data:
+                    break
+                writer.write(rc.frame(rc.STDOUT, data))
+                await writer.drain()
+            writer.write(rc.frame(rc.STDOUT, b""))
+            await writer.drain()
+
+        down_task = asyncio.get_running_loop().create_task(downstream())
+        try:
+            while True:
+                got = await rc.read_frame(reader)
+                if got is None:
+                    break
+                channel, payload = got
+                if channel != rc.STDIN:
+                    continue
+                if not payload:
+                    break
+                if up_writer is not None:
+                    up_writer.write(payload)
+                    await up_writer.drain()
+                else:
+                    # echo backend: prove the tunnel without a real server
+                    writer.write(rc.frame(rc.STDOUT, payload))
+                    await writer.drain()
+        except (ConnectionError, asyncio.CancelledError):
+            pass
+        finally:
+            down_task.cancel()
+            if up_writer is not None:
+                up_writer.close()
+            try:
+                writer.write(rc.frame(rc.ERROR, b"{}"))
+                await writer.drain()
+            except ConnectionError:
+                pass
 
     @staticmethod
     async def _respond(writer, status: int, body: bytes) -> None:

@@ -370,7 +370,9 @@ class APIServer:
                 node_proxy = self._node_proxy_target(url.path)
                 if node_proxy is not None:
                     status = await self._proxy_to_node(
-                        writer, method, node_proxy, url.query, body)
+                        writer, method, node_proxy, url.query, body,
+                        upgrade=headers.get("upgrade", ""),
+                        client_reader=reader)
                     self._audit_log(user, method, target, status)
                     return  # the relay owns the connection
                 self._in_flight += 1
@@ -418,11 +420,14 @@ class APIServer:
         return ("127.0.0.1", int(port), "/" + "/".join(parts[5:]))
 
     async def _proxy_to_node(self, writer, method: str, target, query: str,
-                             body: bytes) -> None:
+                             body: bytes, upgrade: str = "",
+                             client_reader=None) -> None:
         """Relay the request to the kubelet API and pipe the raw response
         bytes back — chunked log-follow streams straight through (the
         reference's upgrade-aware proxy handler, collapsed to a byte
-        relay)."""
+        relay). With `upgrade` set the relay is BIDIRECTIONAL after the
+        backend answers: exec/port-forward frames flow both ways (the
+        SPDY-tunneling half of the reference proxy)."""
         host, port, rest = target
         if not port:
             await _respond(writer, 404, {
@@ -432,12 +437,13 @@ class APIServer:
         path = rest + (f"?{query}" if query else "")
         return await self._relay_raw(
             writer, host, port, method, path, body,
-            unreachable_message="kubelet unreachable")
+            unreachable_message="kubelet unreachable",
+            upgrade=upgrade, client_reader=client_reader)
 
     async def _relay_raw(self, writer, host: str, port: int, method: str,
                          path: str, body: bytes, *,
-                         unreachable_message: str = "backend unreachable"
-                         ) -> int:
+                         unreachable_message: str = "backend unreachable",
+                         upgrade: str = "", client_reader=None) -> int:
         """Pipe one request to a backend and its raw response bytes back —
         the streaming relay under both the node proxy and aggregated
         watches. Returns the relayed status code (for the audit trail)."""
@@ -451,13 +457,30 @@ class APIServer:
             return 503
         status = 0
         head = b""
+        pump_task = None
         try:
+            extra = (f"Connection: Upgrade\r\nUpgrade: {upgrade}\r\n"
+                     if upgrade else "Connection: close\r\n")
             up_writer.write(
                 f"{method} {path} HTTP/1.1\r\n"
                 f"Host: {host}\r\n"
                 f"Content-Length: {len(body)}\r\n"
-                f"Connection: close\r\n\r\n".encode() + body)
+                f"{extra}\r\n".encode() + body)
             await up_writer.drain()
+            if upgrade and client_reader is not None:
+                async def pump_up():
+                    try:
+                        while True:
+                            data = await client_reader.read(65536)
+                            if not data:
+                                break
+                            up_writer.write(data)
+                            await up_writer.drain()
+                    except (ConnectionError, asyncio.CancelledError):
+                        pass
+
+                pump_task = asyncio.get_running_loop().create_task(
+                    pump_up())
             while True:
                 chunk = await up_reader.read(65536)
                 if not chunk:
@@ -474,6 +497,8 @@ class APIServer:
         except (ConnectionError, asyncio.CancelledError):
             pass
         finally:
+            if pump_task is not None:
+                pump_task.cancel()
             up_writer.close()
         return status
 
@@ -618,6 +643,13 @@ class APIServer:
             return 200, {"major": "1", "minor": "8",
                          "gitVersion": "v1.8.0-tpu",
                          "platform": "tpu/xla"}
+        if parts in (["swagger.json"], ["openapi", "v2"]):
+            # schema introspection (routes/openapi.go): what kubectl
+            # explain reads; generated once from the object model
+            if not hasattr(self, "_swagger"):
+                from kubernetes_tpu.apiserver.openapi import build_swagger
+                self._swagger = build_swagger()
+            return 200, self._swagger
         if parts == ["api"]:
             return 200, {"kind": "APIVersions", "versions": ["v1"]}
         if parts == ["apis"]:

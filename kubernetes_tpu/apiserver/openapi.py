@@ -1,0 +1,166 @@
+"""OpenAPI (swagger v2) schema serving — the introspection surface.
+
+The reference serves /swagger.json + /openapi/v2 generated from its Go
+types (apiserver/pkg/server/routes/openapi.go); kubectl explain reads it
+to describe resources field by field (pkg/kubectl/explain). Here the
+definitions are derived from the dataclass object model at import time:
+dataclass fields map to swagger properties (snake_case -> the wire's
+camelCase), nested dataclasses become $ref'd definitions, and docstrings
+become descriptions — one source of truth with the codec, no generated
+files."""
+
+from __future__ import annotations
+
+import dataclasses
+import typing
+
+# tokens that stay upper-case on the wire (hostIP, podCIDR, ...)
+_ACRONYMS = {"ip", "cidr", "id", "uid", "tls", "ips"}
+
+# fields whose wire name is not derivable mechanically
+_OVERRIDES = {
+    "source_component": "source",
+}
+
+
+def wire_name(field_name: str) -> str:
+    if field_name in _OVERRIDES:
+        return _OVERRIDES[field_name]
+    parts = field_name.split("_")
+    out = [parts[0]]
+    for part in parts[1:]:
+        out.append(part.upper() if part in _ACRONYMS
+                   else part.capitalize())
+    return "".join(out)
+
+
+_PRIMITIVES = {
+    str: {"type": "string"},
+    int: {"type": "integer", "format": "int64"},
+    float: {"type": "number", "format": "double"},
+    bool: {"type": "boolean"},
+}
+
+
+def _type_schema(tp, definitions: dict) -> dict:
+    origin = typing.get_origin(tp)
+    args = typing.get_args(tp)
+    if origin is typing.Union or str(origin) == "types.UnionType":
+        non_none = [a for a in args if a is not type(None)]
+        if len(non_none) == 1:
+            return _type_schema(non_none[0], definitions)
+        return {"type": "object"}
+    if origin in (list, tuple):
+        item = _type_schema(args[0], definitions) if args \
+            else {"type": "object"}
+        return {"type": "array", "items": item}
+    if origin is dict:
+        value = _type_schema(args[1], definitions) if len(args) == 2 \
+            else {"type": "object"}
+        return {"type": "object", "additionalProperties": value}
+    if tp in _PRIMITIVES:
+        return dict(_PRIMITIVES[tp])
+    if dataclasses.is_dataclass(tp):
+        return {"$ref": f"#/definitions/{_define(tp, definitions)}"}
+    if tp is typing.Any:
+        return {"type": "object"}
+    return {"type": "object"}
+
+
+def _define(cls, definitions: dict) -> str:
+    name = f"v1.{cls.__name__}"
+    if name in definitions:
+        return name
+    definitions[name] = {}  # cycle guard
+    props = {}
+    hints = typing.get_type_hints(cls)
+    for f in dataclasses.fields(cls):
+        schema = _type_schema(hints.get(f.name, str), definitions)
+        props[wire_name(f.name)] = schema
+    definitions[name] = {
+        "description": (cls.__doc__ or "").strip().split("\n\n")[0],
+        "type": "object",
+        "properties": props,
+    }
+    return name
+
+
+def build_swagger() -> dict:
+    """The full swagger v2 document (cached by the server)."""
+    from kubernetes_tpu.apiserver.http import KIND_TO_CLS, PLURAL_OF
+
+    definitions: dict = {}
+    paths: dict = {}
+    for kind, cls in sorted(KIND_TO_CLS.items()):
+        if not dataclasses.is_dataclass(cls):
+            continue
+        name = _define(cls, definitions)
+        plural = PLURAL_OF.get(kind)
+        if plural:
+            paths[f"/api/v1/namespaces/{{namespace}}/{plural}"] = {
+                "get": {"description": f"list {kind} objects",
+                        "responses": {"200": {"schema": {
+                            "$ref": f"#/definitions/{name}"}}}}}
+    return {
+        "swagger": "2.0",
+        "info": {"title": "kubernetes-tpu", "version": "v1"},
+        "definitions": definitions,
+        "paths": paths,
+    }
+
+
+def explain(swagger: dict, kind: str, field_path: list[str]) -> str:
+    """Render the kubectl-explain view of `kind` (optionally descending
+    into field_path, e.g. ["spec", "containers"])."""
+    definitions = swagger.get("definitions") or {}
+    name = f"v1.{kind}"
+    schema = definitions.get(name)
+    if schema is None:
+        return f"error: no documentation found for {kind}"
+
+    def resolve(s: dict) -> dict:
+        while "$ref" in s:
+            s = definitions.get(s["$ref"].split("/")[-1], {})
+        if s.get("type") == "array":
+            return resolve(s.get("items") or {})
+        return s
+
+    trail = [kind]
+    for part in field_path:
+        props = resolve(schema).get("properties") or {}
+        if part not in props:
+            return (f"error: field \"{part}\" does not exist in "
+                    f"{'.'.join(trail)}")
+        schema = props[part]
+        trail.append(part)
+
+    resolved = resolve(schema)
+    lines = [f"KIND:     {kind}", "VERSION:  v1", ""]
+    if len(trail) > 1:
+        kind_str = schema.get("type") or "Object"
+        if "$ref" in schema:
+            kind_str = "Object"
+        elif schema.get("type") == "array":
+            kind_str = "[]Object" if "$ref" in (schema.get("items") or {}) \
+                else f"[]{(schema.get('items') or {}).get('type', 'object')}"
+        lines.append(f"FIELD:    {trail[-1]} <{kind_str}>")
+        lines.append("")
+    desc = resolved.get("description") or "<empty>"
+    lines.append("DESCRIPTION:")
+    lines.append(f"     {desc}")
+    props = resolved.get("properties")
+    if props:
+        lines.append("")
+        lines.append("FIELDS:")
+        for prop_name in sorted(props):
+            prop = props[prop_name]
+            if "$ref" in prop:
+                type_str = "Object"
+            elif prop.get("type") == "array":
+                items = prop.get("items") or {}
+                type_str = "[]Object" if "$ref" in items \
+                    else f"[]{items.get('type', 'object')}"
+            else:
+                type_str = prop.get("type", "object")
+            lines.append(f"   {prop_name}\t<{type_str}>")
+    return "\n".join(lines)

@@ -285,6 +285,23 @@ def cmd_delete(client, args) -> int:
     return 0
 
 
+def cmd_explain(client, args) -> int:
+    """kubectl explain resource[.field...]: field docs read from the
+    server's swagger (pkg/kubectl/explain over routes/openapi.go)."""
+    from kubernetes_tpu.apiserver.openapi import explain
+
+    dotted = args.resource.split(".")
+    kind = RESOURCES[resolve_resource(dotted[0])]
+    status, body = client.raw("GET", "/swagger.json")
+    if status != 200:
+        print(f"error: server returned {status} for /swagger.json",
+              file=sys.stderr)
+        return 1
+    out = explain(json.loads(body), kind, dotted[1:])
+    print(out)
+    return 0 if not out.startswith("error:") else 1
+
+
 def cmd_patch(client, args) -> int:
     """kubectl patch -p '...' --type strategic|merge|json
     (pkg/kubectl/cmd/patch.go)."""
@@ -380,6 +397,30 @@ def cmd_exec(client, args) -> int:
     from urllib.parse import quote
 
     prefix, container = _node_proxy_path(client, args)
+    if not args.stdin and not args.command:
+        print("error: you must specify a command (or -i for interactive)",
+              file=sys.stderr)
+        return 1
+    if args.stdin:
+        # interactive: channel-framed stream through the apiserver's
+        # bidirectional node proxy (remotecommand.go:27 topology)
+        import shlex
+
+        from kubernetes_tpu.client.remotecommand import exec_stream
+
+        # quote argv so the server-side shlex re-split preserves the
+        # argument boundaries the non-interactive JSON path keeps
+        lines = [(" ".join(shlex.quote(c) for c in args.command)
+                  + "\n").encode()] if args.command else []
+        lines += [line.encode() if isinstance(line, str) else line
+                  for line in sys.stdin]
+        code, out, err = exec_stream(
+            client.host, client.port,
+            f"{prefix}/exec/{args.namespace}/{args.name}/{container}",
+            lines, token=client.token)
+        sys.stdout.write(out)
+        sys.stderr.write(err)
+        return code
     status, body = client.raw(
         "POST", f"{prefix}/exec/{args.namespace}/{args.name}/{container}"
                 f"?command={quote(json.dumps(args.command))}")
@@ -389,6 +430,57 @@ def cmd_exec(client, args) -> int:
     result = json.loads(body)
     sys.stdout.write(result.get("output", ""))
     return int(result.get("exitCode", 0))
+
+
+def cmd_port_forward(client, args) -> int:
+    """kubectl port-forward pod LOCAL:REMOTE — a local listener whose
+    connections tunnel through apiserver -> node proxy -> kubelet ->
+    pod port backend (client-go/tools/portforward topology over the
+    channel framing)."""
+    import asyncio
+
+    from kubernetes_tpu.client.remotecommand import (
+        open_upgraded,
+        pump_socket_frames,
+    )
+
+    local, _, remote = args.ports.partition(":")
+    remote = remote or local
+    args.name = args.pod
+    prefix, _container = _node_proxy_path(client, args)
+    path = (f"{prefix}/portForward/{args.namespace}/{args.pod}"
+            f"?port={int(remote)}")
+
+    async def serve():
+        async def handle(reader, writer):
+            try:
+                # the blocking connect+handshake must not stall the loop
+                # (other tunnels keep pumping while this one dials)
+                sock = await asyncio.to_thread(
+                    open_upgraded, client.host, client.port, path,
+                    token=client.token)
+            except (OSError, ConnectionError) as e:
+                print(f"error: {e}", file=sys.stderr)
+                writer.close()
+                return
+            try:
+                await pump_socket_frames(sock, reader, writer)
+            finally:
+                sock.close()
+
+        server = await asyncio.start_server(handle, "127.0.0.1",
+                                            int(local))
+        bound = server.sockets[0].getsockname()[1]
+        print(f"Forwarding from 127.0.0.1:{bound} -> {remote}",
+              flush=True)
+        async with server:
+            await server.serve_forever()
+
+    try:
+        asyncio.run(serve())
+    except KeyboardInterrupt:
+        pass
+    return 0
 
 
 def cmd_api_resources(client, args) -> int:
@@ -604,6 +696,10 @@ def build_parser() -> argparse.ArgumentParser:
     dr.set_defaults(fn=cmd_drain)
     ar = sub.add_parser("api-resources")
     ar.set_defaults(fn=cmd_api_resources)
+    ex2 = sub.add_parser("explain")
+    ex2.add_argument("resource",
+                     help="resource[.field...], e.g. pods.spec.containers")
+    ex2.set_defaults(fn=cmd_explain)
     ro = sub.add_parser("rollout")
     ro.add_argument("action", choices=["status", "history", "undo"])
     ro.add_argument("resource")
@@ -620,8 +716,16 @@ def build_parser() -> argparse.ArgumentParser:
     ex.add_argument("name")
     ex.add_argument("-n", "--namespace", default="default")
     ex.add_argument("-c", "--container", default="")
-    ex.add_argument("command", nargs="+")
+    ex.add_argument("-i", "--stdin", action="store_true",
+                    help="stream stdin lines through the interactive "
+                         "exec channel")
+    ex.add_argument("command", nargs="*", default=[])
     ex.set_defaults(fn=cmd_exec)
+    pf = sub.add_parser("port-forward")
+    pf.add_argument("pod")
+    pf.add_argument("ports", help="LOCAL:REMOTE (or PORT for both)")
+    pf.add_argument("-n", "--namespace", default="default")
+    pf.set_defaults(fn=cmd_port_forward)
     return p
 
 

@@ -1,0 +1,175 @@
+"""SPDY-class streaming: channel-framed bidirectional exec/port-forward.
+
+The reference multiplexes exec/attach/port-forward streams over one SPDY
+connection with numbered channels (client-go/tools/remotecommand/
+remotecommand.go:27 stdin=0/stdout=1/stderr=2/error=3; kubelet side at
+pkg/kubelet/server/remotecommand; portforward framing in
+client-go/tools/portforward). This framework keeps the topology and the
+channel model but swaps SPDY's framing for a minimal explicit one over an
+HTTP/1.1 Upgrade:
+
+    request:  POST <path> HTTP/1.1 + Connection: Upgrade
+              + Upgrade: ktpu-stream
+    response: HTTP/1.1 101 Switching Protocols, then raw frames each way:
+              [1-byte channel][4-byte big-endian length][payload]
+
+Channels: 0 stdin/up, 1 stdout/down, 2 stderr, 3 error/status (one JSON
+object, e.g. {"exitCode": 0} — the v4 error-channel shape). A zero-length
+frame on a data channel closes that direction."""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import socket
+
+STDIN, STDOUT, STDERR, ERROR = 0, 1, 2, 3
+
+UPGRADE_HEADER = "ktpu-stream"
+
+
+def frame(channel: int, payload: bytes) -> bytes:
+    return bytes([channel]) + len(payload).to_bytes(4, "big") + payload
+
+
+async def read_frame(reader: asyncio.StreamReader):
+    """-> (channel, payload) or None at EOF."""
+    try:
+        head = await reader.readexactly(5)
+    except (asyncio.IncompleteReadError, ConnectionError):
+        return None
+    length = int.from_bytes(head[1:], "big")
+    payload = await reader.readexactly(length) if length else b""
+    return head[0], payload
+
+
+def recv_frame_sync(sock: socket.socket):
+    """Blocking-socket read of one frame; None at EOF."""
+    head = b""
+    while len(head) < 5:
+        chunk = sock.recv(5 - len(head))
+        if not chunk:
+            return None
+        head += chunk
+    length = int.from_bytes(head[1:], "big")
+    payload = b""
+    while len(payload) < length:
+        chunk = sock.recv(length - len(payload))
+        if not chunk:
+            return None
+        payload += chunk
+    return head[0], payload
+
+
+def open_upgraded(host: str, port: int, path: str, token: str = "",
+                  timeout: float = 30.0) -> socket.socket:
+    """Blocking client handshake: connect, upgrade, return the raw socket
+    positioned after the 101 response headers."""
+    sock = socket.create_connection((host, port), timeout=timeout)
+    auth = f"Authorization: Bearer {token}\r\n" if token else ""
+    try:
+        sock.sendall(
+            f"POST {path} HTTP/1.1\r\n"
+            f"Host: {host}\r\n{auth}"
+            f"Connection: Upgrade\r\n"
+            f"Upgrade: {UPGRADE_HEADER}\r\n"
+            f"Content-Length: 0\r\n\r\n".encode())
+        head = b""
+        while b"\r\n\r\n" not in head:
+            chunk = sock.recv(4096)
+            if not chunk:
+                raise ConnectionError("connection closed during upgrade")
+            head += chunk
+        status_line = head.split(b"\r\n", 1)[0]
+        if b"101" not in status_line:
+            raise ConnectionError(
+                f"upgrade refused: {status_line.decode(errors='replace')}")
+        return sock
+    except Exception:
+        sock.close()
+        raise
+
+
+def exec_stream(host: str, port: int, path: str, stdin_chunks,
+                token: str = "") -> tuple[int, str, str]:
+    """Blocking interactive exec: stream stdin chunks while collecting
+    stdout/stderr until the error-channel status arrives.
+    -> (exit_code, stdout, stderr). Sending runs on its own thread so a
+    large stdin and a large output cannot deadlock on TCP flow control
+    (the server writes per line; a send-everything-first client would
+    fill both socket buffers and stall)."""
+    import threading
+
+    sock = open_upgraded(host, port, path, token=token)
+    out: list[bytes] = []
+    err: list[bytes] = []
+    code = 0
+
+    def send_all():
+        try:
+            for chunk in stdin_chunks:
+                sock.sendall(frame(STDIN, chunk if isinstance(chunk, bytes)
+                                   else chunk.encode()))
+            sock.sendall(frame(STDIN, b""))  # EOF upstream
+        except OSError:
+            pass  # receiver side reports the failure
+
+    sender = threading.Thread(target=send_all, daemon=True)
+    sender.start()
+    try:
+        while True:
+            got = recv_frame_sync(sock)
+            if got is None:
+                break
+            channel, payload = got
+            if channel == STDOUT:
+                out.append(payload)
+            elif channel == STDERR:
+                err.append(payload)
+            elif channel == ERROR:
+                try:
+                    code = int(json.loads(payload).get("exitCode", 0))
+                except ValueError:
+                    code = 1
+                break
+    finally:
+        sock.close()
+        sender.join(timeout=5)
+    return code, b"".join(out).decode(errors="replace"), \
+        b"".join(err).decode(errors="replace")
+
+
+async def pump_socket_frames(sock: socket.socket, local_reader,
+                             local_writer) -> None:
+    """Port-forward client half: relay local TCP bytes into STDIN frames
+    and STDOUT frames back into the local connection until either side
+    closes (the portforward.go copy loops)."""
+    loop = asyncio.get_running_loop()
+
+    async def up():
+        while True:
+            data = await local_reader.read(65536)
+            await loop.run_in_executor(None, sock.sendall,
+                                       frame(STDIN, data))
+            if not data:
+                return
+
+    async def down():
+        while True:
+            got = await loop.run_in_executor(None, recv_frame_sync, sock)
+            if got is None:
+                break
+            channel, payload = got
+            if channel == STDOUT:
+                if not payload:
+                    break
+                local_writer.write(payload)
+                await local_writer.drain()
+            elif channel == ERROR:
+                break
+        local_writer.close()
+
+    try:
+        await asyncio.gather(up(), down())
+    except (ConnectionError, OSError):
+        pass

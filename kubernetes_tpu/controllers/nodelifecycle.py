@@ -43,6 +43,16 @@ GRACE_PERIOD = 40.0         # nodeMonitorGracePeriod
 STARTUP_GRACE_PERIOD = 60.0  # nodeStartupGracePeriod
 EVICTION_TIMEOUT = 300.0    # podEvictionTimeout
 EVICTION_RATE = 0.1         # evictionLimiterQPS
+SECONDARY_EVICTION_RATE = 0.01   # secondaryEvictionLimiterQPS
+UNHEALTHY_ZONE_THRESHOLD = 0.55  # unhealthyZoneThreshold
+LARGE_CLUSTER_THRESHOLD = 50     # largeClusterSizeThreshold (nodes/zone)
+
+ZONE_LABEL = "failure-domain.beta.kubernetes.io/zone"
+
+# zone states (node_controller.go:170 ZoneState)
+ZONE_NORMAL = "Normal"
+ZONE_PARTIAL = "PartialDisruption"
+ZONE_FULL = "FullDisruption"
 
 
 class NodeLifecycleController:
@@ -58,7 +68,10 @@ class NodeLifecycleController:
                  startup_grace_period: float = STARTUP_GRACE_PERIOD,
                  eviction_timeout: float = EVICTION_TIMEOUT,
                  eviction_rate: float = EVICTION_RATE,
-                 taint_based_evictions: bool = True):
+                 taint_based_evictions: bool = True,
+                 secondary_eviction_rate: float = SECONDARY_EVICTION_RATE,
+                 unhealthy_zone_threshold: float = UNHEALTHY_ZONE_THRESHOLD,
+                 large_cluster_threshold: int = LARGE_CLUSTER_THRESHOLD):
         self.store = store
         self.nodes = node_informer
         self.pods = pod_informer
@@ -71,6 +84,18 @@ class NodeLifecycleController:
         # can run its tolerationSeconds eviction flow
         # (node_controller.go:274-302, alpha TaintBasedEvictions)
         self.taint_based_evictions = taint_based_evictions
+        # per-zone disruption handling (node_controller.go:170 zone states
+        # + handleDisruption): a zone where >= unhealthy_zone_threshold of
+        # nodes are not ready is PartialDisruption — large zones evict at
+        # the reduced secondary rate, small zones halt; when EVERY zone is
+        # fully down the controller assumes it is the partitioned one and
+        # stops evicting entirely
+        self.secondary_eviction_rate = secondary_eviction_rate
+        self.unhealthy_zone_threshold = unhealthy_zone_threshold
+        self.large_cluster_threshold = large_cluster_threshold
+        self.zone_states: dict[str, str] = {}
+        self.zone_sizes: dict[str, int] = {}
+        self._all_zones_full = False
         self.events = EventRecorder(store, component="node-controller")
         # node -> wall time the controller first saw it not-Ready
         self._not_ready_since: dict[str, float] = {}
@@ -95,9 +120,41 @@ class NodeLifecycleController:
 
     # ---- heartbeat monitoring ----
 
+    def _compute_zone_states(self) -> None:
+        """Classify every zone from the informer's current Ready conditions
+        (handleDisruption's zoneState computation)."""
+        tally: dict[str, list[int]] = {}   # zone -> [ready, not_ready]
+        for node in self.nodes.items():
+            zone = node.metadata.labels.get(ZONE_LABEL, "")
+            ready = next((c for c in node.status.conditions
+                          if c.type == "Ready"), None)
+            ok = ready is not None and ready.status == "True"
+            counts = tally.setdefault(zone, [0, 0])
+            counts[0 if ok else 1] += 1
+        states: dict[str, str] = {}
+        for zone, (ready, not_ready) in tally.items():
+            total = ready + not_ready
+            if not_ready == total and total > 0:
+                states[zone] = ZONE_FULL
+            elif not_ready / total >= self.unhealthy_zone_threshold:
+                states[zone] = ZONE_PARTIAL
+            else:
+                states[zone] = ZONE_NORMAL
+            self.zone_sizes[zone] = total
+        self.zone_states = states
+        self._all_zones_full = bool(states) and all(
+            s == ZONE_FULL for s in states.values())
+
+    def _zone_of(self, name: str) -> str:
+        node = self.nodes.get(name)
+        if node is None:
+            return ""
+        return node.metadata.labels.get(ZONE_LABEL, "")
+
     def monitor_once(self, now: float | None = None) -> None:
         """One monitorNodeStatus pass (exposed for tests)."""
         now = time.time() if now is None else now
+        self._compute_zone_states()
         pods_on: dict[str, int] = {}
         for p in self.pods.items():
             if p.spec.node_name:
@@ -250,13 +307,31 @@ class NodeLifecycleController:
                 log.exception("monitor pass failed")
 
     async def _eviction_loop(self) -> None:
+        loop = asyncio.get_running_loop()
         while True:
             name = await self._eviction_q.get()
             if name not in self._queued:
                 continue  # cancelled by a recovery before the token came up
+            zone_state = self.zone_states.get(self._zone_of(name),
+                                              ZONE_NORMAL)
+            small = self.zone_sizes.get(self._zone_of(name), 0) \
+                <= self.large_cluster_threshold
+            if self._all_zones_full or (zone_state == ZONE_PARTIAL
+                                        and small):
+                # halted (handleDisruption): every zone down looks like OUR
+                # network partition; a small partially-disrupted zone waits
+                # out the disruption instead of evicting what's left —
+                # re-check after the next monitor pass
+                loop.call_later(self.monitor_period,
+                                self._eviction_q.put_nowait, name)
+                await asyncio.sleep(0)
+                continue
             self._queued.discard(name)
             if self._still_dead(name):
                 self.evict_node_pods(name)
                 self._evicted.add(name)
-            # token pacing: at most eviction_rate nodes drained per second
-            await asyncio.sleep(1.0 / max(self.eviction_rate, 1e-9))
+            # token pacing: partial disruption in a large zone drains at
+            # the reduced secondary rate (secondaryEvictionLimiterQPS)
+            rate = self.secondary_eviction_rate \
+                if zone_state == ZONE_PARTIAL else self.eviction_rate
+            await asyncio.sleep(1.0 / max(rate, 1e-9))

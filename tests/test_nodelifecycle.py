@@ -310,3 +310,90 @@ def test_flapping_node_reports_notready_then_recovers():
         nodes.stop(), pods.stop()
 
     asyncio.run(run())
+
+
+def test_zone_disruption_states_and_backoff():
+    """Per-zone disruption handling (node_controller.go:170
+    handleDisruption): >=55% not-ready marks PartialDisruption; a small
+    partial zone halts evictions; every zone fully down halts everything
+    (the controller assumes IT is partitioned); a healthy zone next to a
+    broken one keeps the normal rate."""
+    import asyncio
+    import time as _time
+
+    from kubernetes_tpu.api.objects import Node
+    from kubernetes_tpu.apiserver import ObjectStore
+    from kubernetes_tpu.client.informer import Informer
+    from kubernetes_tpu.controllers.nodelifecycle import (
+        ZONE_FULL,
+        ZONE_LABEL,
+        ZONE_NORMAL,
+        ZONE_PARTIAL,
+        NodeLifecycleController,
+    )
+
+    async def run():
+        store = ObjectStore()
+        now = _time.time()
+
+        def mknode(name, zone, ready):
+            store.create(Node.from_dict({
+                "metadata": {"name": name, "labels": {ZONE_LABEL: zone}},
+                "status": {"conditions": [{
+                    "type": "Ready",
+                    "status": "True" if ready else "False",
+                    "lastHeartbeatTime": now,
+                    "lastTransitionTime": now - 100}]}}))
+
+        # zone-a: 4 nodes, 3 not ready (75% >= 55% -> partial, small)
+        mknode("a0", "zone-a", True)
+        for i in range(1, 4):
+            mknode(f"a{i}", "zone-a", False)
+        # zone-b: healthy
+        for i in range(3):
+            mknode(f"b{i}", "zone-b", True)
+        nodes = Informer(store, "Node")
+        pods = Informer(store, "Pod")
+        nodes.start(), pods.start()
+        await nodes.wait_for_sync()
+        await pods.wait_for_sync()
+        ctl = NodeLifecycleController(store, nodes, pods,
+                                      grace_period=1000.0,
+                                      eviction_timeout=10.0,
+                                      taint_based_evictions=False)
+        ctl.monitor_once(now=now)
+        assert ctl.zone_states["zone-a"] == ZONE_PARTIAL
+        assert ctl.zone_states["zone-b"] == ZONE_NORMAL
+        assert not ctl._all_zones_full
+        # past the eviction timeout: zone-a nodes queue...
+        ctl.monitor_once(now=now + 200)
+        assert not ctl._eviction_q.empty()
+        # ...but the eviction loop HALTS them (small partial zone): drain
+        # one queue round and confirm nothing was evicted
+        task = asyncio.get_running_loop().create_task(ctl._eviction_loop())
+        await asyncio.sleep(0.1)
+        task.cancel()
+        assert ctl.evicted_pods == 0
+        assert not ctl._evicted      # the halt branch, not slow pacing
+        assert ctl._queued  # still queued, not dropped
+
+        # all zones fully down -> global halt flag
+        for i in range(3):
+            def kill(n):
+                for c in n.status.conditions:
+                    c.status = "False"
+                return n
+            store.guaranteed_update("Node", f"b{i}", "default", kill)
+        def kill_a0(n):
+            for c in n.status.conditions:
+                c.status = "False"
+            return n
+        store.guaranteed_update("Node", "a0", "default", kill_a0)
+        await asyncio.sleep(0.05)
+        ctl.monitor_once(now=now + 300)
+        assert ctl.zone_states["zone-a"] == ZONE_FULL
+        assert ctl.zone_states["zone-b"] == ZONE_FULL
+        assert ctl._all_zones_full
+        nodes.stop(), pods.stop()
+
+    asyncio.run(run())

@@ -1,0 +1,61 @@
+"""OpenAPI/swagger serving + kubectl explain (routes/openapi.go,
+pkg/kubectl/explain)."""
+
+import json
+import os
+import subprocess
+import sys
+
+from kubernetes_tpu.apiserver.openapi import build_swagger, explain, wire_name
+
+
+def test_wire_names():
+    assert wire_name("resource_version") == "resourceVersion"
+    assert wire_name("host_ip") == "hostIP"
+    assert wire_name("pod_cidr") == "podCIDR"
+    assert wire_name("node_name") == "nodeName"
+    assert wire_name("phase") == "phase"
+
+
+def test_swagger_definitions_cover_served_kinds():
+    doc = build_swagger()
+    defs = doc["definitions"]
+    for kind in ("Pod", "Node", "Service", "Deployment", "Role"):
+        assert f"v1.{kind}" in defs, kind
+    pod = defs["v1.Pod"]
+    assert set(pod["properties"]) >= {"metadata", "spec", "status"}
+    spec = defs["v1.PodSpec"]["properties"]
+    assert spec["nodeName"] == {"type": "string"}
+    assert spec["containers"]["type"] == "array"
+    assert "$ref" in spec["containers"]["items"]
+    status = defs["v1.PodStatus"]["properties"]
+    assert status["hostIP"] == {"type": "string"}
+
+
+def test_explain_walks_field_paths():
+    doc = build_swagger()
+    top = explain(doc, "Pod", [])
+    assert "KIND:     Pod" in top and "spec" in top
+    deep = explain(doc, "Pod", ["spec", "containers"])
+    assert "FIELD:    containers <[]Object>" in deep
+    assert "livenessProbe" in deep
+    missing = explain(doc, "Pod", ["spec", "nosuch"])
+    assert missing.startswith("error:")
+
+
+def test_kubectl_explain_over_http():
+    from http_util import http_store
+
+    with http_store() as (client, _):
+        url = f"http://{client.host}:{client.port}"
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   PYTHONPATH="/root/repo:/root/.axon_site")
+        out = subprocess.run(
+            [sys.executable, "-m", "kubernetes_tpu.cli.kubectl",
+             "--server", url, "explain", "pods.spec"],
+            capture_output=True, text=True, timeout=90, env=env)
+        assert out.returncode == 0, out.stdout + out.stderr
+        assert "schedulerName" in out.stdout
+        # raw swagger endpoint is also directly fetchable
+        status, body = client.raw("GET", "/openapi/v2")
+        assert status == 200 and "v1.Node" in body
