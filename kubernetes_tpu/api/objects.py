@@ -1346,3 +1346,35 @@ class ClusterRoleBinding(_RBACBindingObject):
     """Grants a ClusterRole across every namespace + cluster scope."""
 
     kind = "ClusterRoleBinding"
+
+
+@dataclass
+class CertificateSigningRequest:
+    """certificates.k8s.io/v1beta1 CSR: spec carries the base64 PEM request
+    + requestor identity; status carries Approved/Denied conditions and
+    the issued certificate (signed by the certificate controller)."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: dict[str, Any] = field(default_factory=dict)
+    status: dict[str, Any] = field(default_factory=dict)
+
+    kind = "CertificateSigningRequest"
+    api_version = "certificates.k8s.io/v1beta1"
+
+    def clone(self) -> "CertificateSigningRequest":
+        return CertificateSigningRequest(
+            metadata=self.metadata.clone(),
+            spec=copy.deepcopy(self.spec),
+            status=copy.deepcopy(self.status))
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]) -> "CertificateSigningRequest":
+        return cls(metadata=ObjectMeta.from_dict(d.get("metadata") or {}),
+                   spec=copy.deepcopy(d.get("spec") or {}),
+                   status=copy.deepcopy(d.get("status") or {}))
+
+    def to_dict(self) -> dict[str, Any]:
+        return {"apiVersion": self.api_version, "kind": self.kind,
+                "metadata": self.metadata.to_dict(),
+                "spec": copy.deepcopy(self.spec),
+                "status": copy.deepcopy(self.status)}

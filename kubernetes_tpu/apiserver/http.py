@@ -89,6 +89,7 @@ RESOURCES: dict[str, str] = {
     "clusterroles": "ClusterRole",
     "rolebindings": "RoleBinding",
     "clusterrolebindings": "ClusterRoleBinding",
+    "certificatesigningrequests": "CertificateSigningRequest",
 }
 KIND_TO_CLS = {cls.kind: cls for cls in (
     objs.Pod, objs.Node, objs.Service, objs.Endpoints, objs.Event,
@@ -99,7 +100,7 @@ KIND_TO_CLS = {cls.kind: cls for cls in (
     objs.Secret, objs.ConfigMap, objs.ServiceAccount, objs.DaemonSet,
     objs.CronJob, objs.HorizontalPodAutoscaler, objs.PodDisruptionBudget,
     objs.APIService, objs.Role, objs.ClusterRole, objs.RoleBinding,
-    objs.ClusterRoleBinding)}
+    objs.ClusterRoleBinding, objs.CertificateSigningRequest)}
 PLURAL_OF = {kind: plural for plural, kind in RESOURCES.items()}
 
 
@@ -632,7 +633,8 @@ class APIServer:
     CLUSTER_SCOPED = frozenset({
         "Node", "PersistentVolume", "Namespace",
         "CustomResourceDefinition", "APIService", "Cluster",
-        "ClusterRole", "ClusterRoleBinding"})
+        "ClusterRole", "ClusterRoleBinding",
+        "CertificateSigningRequest"})
 
     def _discovery(self, method: str, path: str):
         """-> (status, payload) for discovery paths, else None."""

@@ -68,6 +68,12 @@ ALIASES = {
     "crd": "customresourcedefinitions",
     "customresourcedefinition": "customresourcedefinitions",
     "apiservice": "apiservices",
+    "csr": "certificatesigningrequests",
+    "certificatesigningrequest": "certificatesigningrequests",
+    "role": "roles",
+    "clusterrole": "clusterroles",
+    "rolebinding": "rolebindings",
+    "clusterrolebinding": "clusterrolebindings",
 }
 
 
@@ -408,12 +414,16 @@ def cmd_exec(client, args) -> int:
 
         from kubernetes_tpu.client.remotecommand import exec_stream
 
+        import itertools
+
         # quote argv so the server-side shlex re-split preserves the
-        # argument boundaries the non-interactive JSON path keeps
-        lines = [(" ".join(shlex.quote(c) for c in args.command)
-                  + "\n").encode()] if args.command else []
-        lines += [line.encode() if isinstance(line, str) else line
-                  for line in sys.stdin]
+        # argument boundaries the non-interactive JSON path keeps; stdin
+        # streams LAZILY so the session is actually interactive (and a
+        # piped gigabyte doesn't buffer in memory)
+        initial = [(" ".join(shlex.quote(c) for c in args.command)
+                    + "\n").encode()] if args.command else []
+        lines = itertools.chain(
+            initial, (line.encode() for line in sys.stdin))
         code, out, err = exec_stream(
             client.host, client.port,
             f"{prefix}/exec/{args.namespace}/{args.name}/{container}",

@@ -74,12 +74,15 @@ def open_upgraded(host: str, port: int, path: str, token: str = "",
             f"Connection: Upgrade\r\n"
             f"Upgrade: {UPGRADE_HEADER}\r\n"
             f"Content-Length: 0\r\n\r\n".encode())
+        # read byte-wise to the end of headers: a frame the server sends
+        # immediately after the 101 must stay in the socket buffer, not be
+        # swallowed by an over-read (headers are tiny; this runs once)
         head = b""
-        while b"\r\n\r\n" not in head:
-            chunk = sock.recv(4096)
-            if not chunk:
+        while not head.endswith(b"\r\n\r\n"):
+            byte = sock.recv(1)
+            if not byte:
                 raise ConnectionError("connection closed during upgrade")
-            head += chunk
+            head += byte
         status_line = head.split(b"\r\n", 1)[0]
         if b"101" not in status_line:
             raise ConnectionError(

@@ -1,0 +1,168 @@
+"""CertificateSigningRequest controllers: approve + sign.
+
+The pkg/controller/certificates analog (csrapproving + csrsigning wired
+at cmd/kube-controller-manager/app/controllermanager.go:315-339): kubelets
+bootstrapping TLS post a CSR object; the approving controller
+auto-approves requests from the bootstrap group (the reference's
+sufficient-permissions check collapsed to the group convention), and the
+signing controller issues a certificate from the cluster CA and writes it
+to status.certificate. Signing is REAL x509 via the openssl binary (the
+reference uses Go's crypto/x509; the native boundary here is the same
+shape as the proxier's iptables exec)."""
+
+from __future__ import annotations
+
+import base64
+import logging
+import subprocess
+import tempfile
+
+from kubernetes_tpu.apiserver.store import Conflict, NotFound, ObjectStore
+from kubernetes_tpu.client.informer import Informer
+from kubernetes_tpu.controllers.base import ReconcileController
+
+log = logging.getLogger(__name__)
+
+BOOTSTRAP_GROUP = "system:bootstrappers"
+AUTO_APPROVED_USAGES = {"digital signature", "key encipherment",
+                        "client auth", "server auth"}
+
+
+def generate_ca(cn: str = "kubernetes-tpu-ca") -> tuple[bytes, bytes]:
+    """(ca_cert_pem, ca_key_pem) — a self-signed cluster CA."""
+    with tempfile.TemporaryDirectory() as tmp:
+        crt, key = f"{tmp}/ca.crt", f"{tmp}/ca.key"
+        subprocess.run(
+            ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+             "-keyout", key, "-out", crt, "-days", "365",
+             "-subj", f"/CN={cn}"],
+            check=True, capture_output=True, timeout=60)
+        with open(crt, "rb") as f:
+            cert_pem = f.read()
+        with open(key, "rb") as f:
+            key_pem = f.read()
+    return cert_pem, key_pem
+
+
+class CSRController(ReconcileController):
+    """Approve bootstrap-group CSRs, then sign approved ones."""
+
+    workers = 1
+
+    def __init__(self, store: ObjectStore, csr_informer: Informer,
+                 ca_cert_pem: bytes | None = None,
+                 ca_key_pem: bytes | None = None):
+        super().__init__()
+        self.name = "certificate-controller"
+        self.store = store
+        self.csrs = csr_informer
+        # the CA generates lazily on the first signing: RSA keygen costs
+        # real time and most processes never see a CSR. Configuring only
+        # half a CA is a config error, caught now rather than at sign time.
+        if (ca_cert_pem is None) != (ca_key_pem is None):
+            raise ValueError("ca_cert_pem and ca_key_pem must be "
+                             "given together")
+        self._ca_cert_pem = ca_cert_pem
+        self._ca_key_pem = ca_key_pem
+        csr_informer.add_handler(
+            lambda e: self.enqueue(e.obj.metadata.name))
+
+    @property
+    def ca_cert_pem(self) -> bytes:
+        if self._ca_cert_pem is None:
+            self._ca_cert_pem, self._ca_key_pem = generate_ca()
+        return self._ca_cert_pem
+
+    @property
+    def ca_key_pem(self) -> bytes:
+        if self._ca_key_pem is None:
+            self.ca_cert_pem  # noqa: B018 — triggers generation
+        return self._ca_key_pem
+
+    @staticmethod
+    def _has(conditions, cond_type: str) -> bool:
+        return any(c.get("type") == cond_type for c in conditions)
+
+    def _approvable(self, csr) -> bool:
+        """The csrapproving policy collapsed to the bootstrap convention:
+        requestor in system:bootstrappers (or a node user) asking for
+        standard usages only."""
+        spec = csr.spec
+        groups = set(spec.get("groups") or [])
+        username = spec.get("username", "")
+        usages = set(spec.get("usages") or [])
+        subject_ok = BOOTSTRAP_GROUP in groups \
+            or username.startswith("system:node:")
+        return subject_ok and usages <= AUTO_APPROVED_USAGES
+
+    def _sign(self, request_pem: bytes) -> bytes:
+        with tempfile.TemporaryDirectory() as tmp:
+            paths = {n: f"{tmp}/{n}" for n in
+                     ("req.csr", "ca.crt", "ca.key", "out.crt")}
+            with open(paths["req.csr"], "wb") as f:
+                f.write(request_pem)
+            with open(paths["ca.crt"], "wb") as f:
+                f.write(self.ca_cert_pem)
+            with open(paths["ca.key"], "wb") as f:
+                f.write(self.ca_key_pem)
+            subprocess.run(
+                ["openssl", "x509", "-req", "-in", paths["req.csr"],
+                 "-CA", paths["ca.crt"], "-CAkey", paths["ca.key"],
+                 "-CAcreateserial", "-days", "30",
+                 "-out", paths["out.crt"]],
+                check=True, capture_output=True, timeout=60)
+            with open(paths["out.crt"], "rb") as f:
+                return f.read()
+
+    async def sync(self, key: str) -> None:
+        import asyncio
+
+        csr = self.csrs.get(key)
+        if csr is None:
+            return
+        conditions = list(csr.status.get("conditions") or [])
+        if self._has(conditions, "Denied"):
+            return
+        if not self._has(conditions, "Approved"):
+            if not self._approvable(csr):
+                return  # left Pending for manual approval
+            def approve(obj):
+                conds = obj.status.setdefault("conditions", [])
+                if not any(c.get("type") == "Approved" for c in conds):
+                    conds.append({"type": "Approved",
+                                  "reason": "AutoApproved",
+                                  "message": "bootstrap auto-approval"})
+                return obj
+
+            try:
+                self.store.guaranteed_update(
+                    "CertificateSigningRequest", key, "default", approve)
+            except (NotFound, Conflict):
+                return
+            # the approval's MODIFIED watch event re-enqueues for signing
+            # once the informer cache carries it — re-enqueueing HERE would
+            # spin against the stale cache and starve the informer
+            return
+        if csr.status.get("certificate"):
+            return  # already issued
+        request_b64 = csr.spec.get("request", "")
+        try:
+            # keygen + signing are real subprocess work: off the shared
+            # controller-manager loop (leader renewal must not stall)
+            request_pem = base64.b64decode(request_b64)
+            cert_pem = await asyncio.to_thread(self._sign, request_pem)
+        except (ValueError, subprocess.SubprocessError) as e:
+            log.warning("CSR %s: signing failed: %s", key, e)
+            return
+
+        def put_cert(obj):
+            obj.status["certificate"] = \
+                base64.b64encode(cert_pem).decode()
+            return obj
+
+        try:
+            self.store.guaranteed_update(
+                "CertificateSigningRequest", key, "default", put_cert)
+            log.info("CSR %s: certificate issued", key)
+        except (NotFound, Conflict):
+            pass

@@ -33,7 +33,8 @@ class ControllerManager:
                          "ServiceAccount", "ResourceQuota", "CronJob",
                          "HorizontalPodAutoscaler", "PodDisruptionBudget",
                          "DaemonSet", "PersistentVolume",
-                         "PersistentVolumeClaim")}
+                         "PersistentVolumeClaim",
+                         "CertificateSigningRequest")}
         pods = self.informers["Pod"]
         self.replicaset = ReplicaManager(
             store, "ReplicaSet", self.informers["ReplicaSet"], pods)
@@ -128,6 +129,11 @@ class ControllerManager:
 
         self.node_ipam = NodeIpamController(store, self.informers["Node"])
         self.controllers.append(self.node_ipam)
+        from kubernetes_tpu.controllers.certificates import CSRController
+
+        self.csr = CSRController(
+            store, self.informers["CertificateSigningRequest"])
+        self.controllers.append(self.csr)
         if cloud is not None:
             from kubernetes_tpu.controllers.service_lb import (
                 ServiceLBController,

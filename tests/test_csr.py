@@ -1,0 +1,105 @@
+"""CSR approve + sign flow (pkg/controller/certificates): a bootstrap
+kubelet's CSR is auto-approved and signed by the cluster CA with REAL
+x509 — the issued certificate verifies against the CA."""
+
+import asyncio
+import base64
+import subprocess
+import tempfile
+
+from kubernetes_tpu.api.objects import CertificateSigningRequest
+from kubernetes_tpu.apiserver import ObjectStore
+from kubernetes_tpu.client.informer import Informer
+from kubernetes_tpu.controllers.certificates import CSRController
+
+
+def _make_csr_pem(cn: str) -> bytes:
+    with tempfile.TemporaryDirectory() as tmp:
+        subprocess.run(
+            ["openssl", "req", "-new", "-newkey", "rsa:2048", "-nodes",
+             "-keyout", f"{tmp}/k.key", "-out", f"{tmp}/r.csr",
+             "-subj", f"/CN={cn}/O=system:nodes"],
+            check=True, capture_output=True, timeout=60)
+        with open(f"{tmp}/r.csr", "rb") as f:
+            return f.read()
+
+
+def _csr_object(name, groups, usages=None):
+    return CertificateSigningRequest.from_dict({
+        "kind": "CertificateSigningRequest",
+        "metadata": {"name": name},
+        "spec": {
+            "request": base64.b64encode(_make_csr_pem(
+                f"system:node:{name}")).decode(),
+            "username": f"system:node:{name}",
+            "groups": groups,
+            "usages": usages or ["digital signature", "key encipherment",
+                                 "server auth"]}})
+
+
+def test_bootstrap_csr_is_approved_and_signed():
+    async def run():
+        store = ObjectStore()
+        csrs = Informer(store, "CertificateSigningRequest")
+        csrs.start()
+        await csrs.wait_for_sync()
+        ctl = CSRController(store, csrs)
+        await ctl.start()
+        store.create(_csr_object("n1", ["system:bootstrappers"]))
+
+        async with asyncio.timeout(60):
+            while True:
+                csr = store.get("CertificateSigningRequest", "n1")
+                status = csr.status
+                if status.get("certificate"):
+                    break
+                await asyncio.sleep(0.05)
+        conds = {c["type"] for c in status["conditions"]}
+        assert "Approved" in conds
+        cert_pem = base64.b64decode(status["certificate"])
+        # the issued cert really verifies against the cluster CA
+        with tempfile.TemporaryDirectory() as tmp:
+            with open(f"{tmp}/ca.crt", "wb") as f:
+                f.write(ctl.ca_cert_pem)
+            with open(f"{tmp}/leaf.crt", "wb") as f:
+                f.write(cert_pem)
+            out = subprocess.run(
+                ["openssl", "verify", "-CAfile", f"{tmp}/ca.crt",
+                 f"{tmp}/leaf.crt"],
+                capture_output=True, text=True, timeout=60)
+            assert out.returncode == 0, out.stdout + out.stderr
+            subject = subprocess.run(
+                ["openssl", "x509", "-noout", "-subject", "-in",
+                 f"{tmp}/leaf.crt"],
+                capture_output=True, text=True, timeout=60)
+            assert "system:node:n1" in subject.stdout
+        ctl.stop()
+        csrs.stop()
+
+    asyncio.run(run())
+
+
+def test_non_bootstrap_csr_stays_pending():
+    async def run():
+        store = ObjectStore()
+        csrs = Informer(store, "CertificateSigningRequest")
+        csrs.start()
+        await csrs.wait_for_sync()
+        ctl = CSRController(store, csrs)
+        await ctl.start()
+        store.create(CertificateSigningRequest.from_dict({
+            "kind": "CertificateSigningRequest",
+            "metadata": {"name": "rogue"},
+            "spec": {"request": "", "username": "mallory",
+                     "groups": ["strangers"],
+                     "usages": ["code signing"]}}))
+        await asyncio.sleep(0.3)
+        csr = store.get("CertificateSigningRequest", "rogue")
+        status = csr.status
+        assert not status.get("certificate")
+        assert not any(c.get("type") == "Approved"
+                       for c in status.get("conditions") or [])
+        ctl.stop()
+        csrs.stop()
+
+    asyncio.run(run())

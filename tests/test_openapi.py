@@ -48,8 +48,10 @@ def test_kubectl_explain_over_http():
 
     with http_store() as (client, _):
         url = f"http://{client.host}:{client.port}"
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
         env = dict(os.environ, JAX_PLATFORMS="cpu",
-                   PYTHONPATH="/root/repo:/root/.axon_site")
+                   PYTHONPATH=repo + os.pathsep
+                   + os.environ.get("PYTHONPATH", ""))
         out = subprocess.run(
             [sys.executable, "-m", "kubernetes_tpu.cli.kubectl",
              "--server", url, "explain", "pods.spec"],
