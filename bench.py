@@ -104,9 +104,10 @@ def main() -> None:
     if "recovery" in configs:
         from kubernetes_tpu.perf.harness import run_recovery
 
-        r = run_recovery(200, 600, kill_frac=0.1)
+        rec_nodes = int(os.environ.get("BENCH_RECOVERY_NODES", "200"))
+        r = run_recovery(rec_nodes, 3 * rec_nodes, kill_frac=0.1)
         print(f"bench[recovery]: {r}", file=sys.stderr, flush=True)
-        extras["recovery_seconds_kill10pct_200n"] = round(
+        extras[f"recovery_seconds_kill10pct_{rec_nodes}n"] = round(
             r.seconds_to_recover, 2)
         extras["recovery_stranded_pods"] = r.stranded
 
