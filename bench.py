@@ -113,13 +113,32 @@ def main() -> None:
 
     if "device" in configs:
         # transport-independent: steady-state compiled-solver throughput
-        # with device-resident state (stable vs tunnel weather, PERF.md)
+        # with device-resident state (stable vs tunnel weather, PERF.md).
+        # Two shapes: P=4096 (the r3/r4 cross-round-comparable row) and
+        # P=16384 (the deep-batch steady state after the round-5 op diet
+        # removed the old P=8192 layout cliff).
         from kubernetes_tpu.perf.harness import run_device_solve
 
         r = run_device_solve(min(n_nodes, 15000), batch_pods=4096)
         print(f"bench[device]: {r}", file=sys.stderr, flush=True)
         extras["device_solve_pods_per_sec"] = round(r.pods_per_sec, 1)
         extras["device_solve_ms"] = round(r.ms_per_solve, 2)
+        rd = run_device_solve(min(n_nodes, 15000), batch_pods=16384, iters=8)
+        print(f"bench[device]: {rd}", file=sys.stderr, flush=True)
+        extras["device_solve_deep_pods_per_sec"] = round(rd.pods_per_sec, 1)
+        extras["device_solve_deep_ms"] = round(rd.ms_per_solve, 2)
+        # device perf regression gate (bench-side, on the real chip — the
+        # CPU-mesh pytest floor cannot see TPU regressions): the round-5
+        # recorded steady state is 53.1k (deep) / 49.2k (P=4096); tunnel-day
+        # swing on these chained-compute numbers is <5%, so an 80% floor
+        # (42.5k deep) only trips on a real compiled-program regression.
+        gate_floor = float(os.environ.get("BENCH_DEVICE_GATE", "42500"))
+        extras["device_gate_floor_pods_per_sec"] = gate_floor
+        extras["device_gate_ok"] = bool(rd.pods_per_sec >= gate_floor)
+        if not extras["device_gate_ok"]:
+            RESULT["error"] = (
+                f"device solve regression: deep {rd.pods_per_sec:.0f} pods/s "
+                f"< gate {gate_floor:.0f}")
 
     if RESULT["value"] is None and extras:
         # headline config not selected: promote the first metric actually

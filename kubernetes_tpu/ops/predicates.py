@@ -45,33 +45,19 @@ from kubernetes_tpu.state.layout import Condition, Effect, Resource, TolOp, VolT
 from kubernetes_tpu.state.pod_batch import PodBatch
 
 
-def fits_resources(state: ClusterState, pod: PodBatch, requested=None) -> jnp.ndarray:
-    """PodFitsResources (predicates.go:556) against all nodes.
-
-    `requested` overrides state.requested — the solver passes the running
-    ledger that includes in-batch assumptions (the analog of scheduling
-    against assumed pods, scheduler.go:188).
-    """
-    req = state.requested if requested is None else requested
-    alloc = state.allocatable
-
-    pods_ok = req[:, Resource.PODS] + 1.0 <= alloc[:, Resource.PODS]
-
-    r = pod.requests
-    # all-zero shortcut: a pod requesting nothing only pays the pod-count
-    # check (predicates.go:576-578)
-    all_zero = (
+def _requests_all_zero(r) -> jnp.ndarray:
+    """The all-zero shortcut: a pod requesting nothing only pays the
+    pod-count check (predicates.go:576-578)."""
+    return (
         (r[Resource.CPU] == 0) & (r[Resource.MEMORY] == 0) & (r[Resource.GPU] == 0)
         & (r[Resource.SCRATCH] == 0) & (r[Resource.OVERLAY] == 0)
     )
 
-    def fits(row):
-        return alloc[:, row] >= r[row] + req[:, row]
 
-    basic = fits(Resource.CPU) & fits(Resource.MEMORY) & fits(Resource.GPU)
-
-    # storage: when the node exposes no overlay allocatable, overlay requests
-    # fall through to scratch space (predicates.go:590-605)
+def _storage_fit(req, alloc, r) -> jnp.ndarray:
+    """Storage half of PodFitsResources: when the node exposes no overlay
+    allocatable, overlay requests fall through to scratch space
+    (predicates.go:590-605)."""
     no_overlay = alloc[:, Resource.OVERLAY] == 0
     scratch_req_no_overlay = r[Resource.SCRATCH] + r[Resource.OVERLAY]
     node_scratch_no_overlay = req[:, Resource.OVERLAY] + req[:, Resource.SCRATCH]
@@ -81,9 +67,62 @@ def fits_resources(state: ClusterState, pod: PodBatch, requested=None) -> jnp.nd
     scratch_ok_overlay = (
         alloc[:, Resource.SCRATCH] >= r[Resource.SCRATCH] + req[:, Resource.SCRATCH]
     ) & (alloc[:, Resource.OVERLAY] >= r[Resource.OVERLAY] + req[:, Resource.OVERLAY])
-    storage = jnp.where(no_overlay, scratch_ok_no_overlay, scratch_ok_overlay)
+    return jnp.where(no_overlay, scratch_ok_no_overlay, scratch_ok_overlay)
 
-    return pods_ok & (all_zero | (basic & storage))
+
+def fits_resources_static(state: ClusterState, pod: PodBatch,
+                          dyn_gpu: bool, dyn_storage: bool) -> jnp.ndarray:
+    """The assignment-independent remainder of PodFitsResources under batch
+    gates: resource columns no pod in the batch requests never change through
+    the scan, so their compares hold against the batch-start ledger for the
+    whole batch and hoist out of the per-pod step (solver BatchFlags.gpu/
+    storage). The all-zero OR is distributed across the split —
+    `(z | a) & (z | b) == z | (a & b)` keeps the conjunction with
+    `fits_resources_dyn` exactly equal to predicates.go:556's composition."""
+    req = state.requested
+    alloc = state.allocatable
+    ok = jnp.ones(alloc.shape[0], dtype=bool)
+    r = pod.requests
+    if not dyn_gpu:
+        ok = ok & (alloc[:, Resource.GPU] >= r[Resource.GPU] + req[:, Resource.GPU])
+    if not dyn_storage:
+        ok = ok & _storage_fit(req, alloc, r)
+    return _requests_all_zero(r) | ok
+
+
+def fits_resources_dyn(state: ClusterState, pod: PodBatch, requested,
+                       dyn_gpu: bool = True,
+                       dyn_storage: bool = True) -> jnp.ndarray:
+    """The in-scan half of PodFitsResources: the pod count always moves with
+    in-batch claims; cpu/mem always (every claim charges at least the
+    non-zero scoring defaults is irrelevant here — requests themselves may be
+    zero, but the compare is cheap and claims can change it); gpu/storage
+    only when the batch requests them (`dyn_*`)."""
+    req = requested
+    alloc = state.allocatable
+    pods_ok = req[:, Resource.PODS] + 1.0 <= alloc[:, Resource.PODS]
+    r = pod.requests
+
+    def fits(row):
+        return alloc[:, row] >= r[row] + req[:, row]
+
+    basic = fits(Resource.CPU) & fits(Resource.MEMORY)
+    if dyn_gpu:
+        basic = basic & fits(Resource.GPU)
+    if dyn_storage:
+        basic = basic & _storage_fit(req, alloc, r)
+    return pods_ok & (_requests_all_zero(r) | basic)
+
+
+def fits_resources(state: ClusterState, pod: PodBatch, requested=None) -> jnp.ndarray:
+    """PodFitsResources (predicates.go:556) against all nodes.
+
+    `requested` overrides state.requested — the solver passes the running
+    ledger that includes in-batch assumptions (the analog of scheduling
+    against assumed pods, scheduler.go:188).
+    """
+    req = state.requested if requested is None else requested
+    return fits_resources_dyn(state, pod, req)
 
 
 def fits_host(state: ClusterState, pod: PodBatch) -> jnp.ndarray:

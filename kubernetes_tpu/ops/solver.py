@@ -43,7 +43,7 @@ from kubernetes_tpu.ops import predicates as preds
 from kubernetes_tpu.ops import priorities as prios
 from kubernetes_tpu.ops import spread as spreadops
 from kubernetes_tpu.state.cluster_state import ClusterState
-from kubernetes_tpu.state.layout import MAX_PRIORITY
+from kubernetes_tpu.state.layout import MAX_PRIORITY, Resource
 from kubernetes_tpu.state.pod_batch import PodBatch
 
 # Domain-axis size for inter-pod affinity aggregates; must equal the encoding
@@ -78,6 +78,15 @@ class BatchFlags:
     tt: bool = True       # any PreferNoSchedule taint interned (TaintToleration
                           # counts can be nonzero) — else uniform MaxPriority
     na: bool = True       # any preferred node-affinity term in batch
+    ports: bool = True    # any host port wanted: with none, PodFitsHostPorts
+                          # is constant-true (conflicts = count @ 0) whatever
+                          # the ledger — skip the kernel and the ledger update
+    gpu: bool = True      # any GPU request in batch: with none, the GPU fit
+                          # column never changes through the scan — fold into
+                          # the assignment-independent Phase A fit
+    storage: bool = True  # any scratch/overlay request in batch: same —
+                          # the storage fallthrough logic (predicates.go:
+                          # 590-605) becomes assignment-independent
 
 
 ALL_ACTIVE = BatchFlags()
@@ -93,6 +102,8 @@ class PolicyGates:
 
     use_resources: bool
     use_ports: bool
+    dyn_gpu: bool      # GPU fit must track the in-batch ledger
+    dyn_storage: bool  # scratch/overlay fit must track the in-batch ledger
     w_lr: float
     w_mr: float
     w_ba: float
@@ -143,8 +154,14 @@ def policy_gates(policy: Policy, flags: BatchFlags) -> PolicyGates:
     return PolicyGates(
         use_resources=policy.has_predicate("GeneralPredicates",
                                            "PodFitsResources"),
+        # no host port wanted anywhere in the batch: conflicts = count @ 0
+        # == 0 on every node whatever the ledger — the predicate is
+        # constant-true and the port ledger passes through untouched
         use_ports=policy.has_predicate("GeneralPredicates",
-                                       "PodFitsHostPorts", "PodFitsPorts"),
+                                       "PodFitsHostPorts",
+                                       "PodFitsPorts") and flags.ports,
+        dyn_gpu=flags.gpu,
+        dyn_storage=flags.storage,
         w_lr=policy.weight("LeastRequestedPriority"),
         w_mr=policy.weight("MostRequestedPriority"),
         w_ba=policy.weight("BalancedResourceAllocation"),
@@ -189,6 +206,10 @@ def batch_flags(batch: PodBatch, n_pods: int, table) -> BatchFlags:
         attach=any_(batch.att_onehot) or any_(batch.att_fail),
         tt=table_has_prefer_taints(table),
         na=any_(batch.pref_weight > 0),
+        ports=any_(batch.port_onehot),
+        gpu=any_(batch.requests[:, Resource.GPU]),
+        storage=any_(batch.requests[:, Resource.SCRATCH])
+        or any_(batch.requests[:, Resource.OVERLAY]),
     )
 
 
@@ -222,7 +243,13 @@ class SolverResult:
 class Carry:
     """Scan-carried assume ledger: every assignment-dependent count. Fields
     gated off by the policy stay None (None is an empty pytree, so the scan
-    carry structure remains static per policy)."""
+    carry structure remains static per policy).
+
+    requested and nonzero stay SEPARATE arrays on purpose: fusing them into
+    one [N, R+2] ledger (one scatter per claim instead of two) measured 4x
+    SLOWER (365 ms vs 91 ms per 4,096-pod solve) — the static column slices
+    feeding the predicates break XLA's in-place while-loop buffer aliasing,
+    so every step copies the whole ledger instead of scattering in place."""
 
     requested: jnp.ndarray
     nonzero: jnp.ndarray
@@ -389,8 +416,8 @@ def _pod_eval(state: ClusterState, g: PolicyGates, carry: Carry, pod,
     in-batch scheduling is by construction, not by re-implementation)."""
     feasible = s_mask
     if g.use_resources:
-        feasible = feasible & preds.fits_resources(
-            state, pod, requested=carry.requested)
+        feasible = feasible & preds.fits_resources_dyn(
+            state, pod, carry.requested, g.dyn_gpu, g.dyn_storage)
     if g.use_ports:
         feasible = feasible & preds.fits_host_ports(
             state, pod, port_count=carry.port_count)
@@ -443,13 +470,23 @@ def _pod_eval(state: ClusterState, g: PolicyGates, carry: Carry, pod,
 
 def _select_host(masked_score: jnp.ndarray, feasible: jnp.ndarray, rr: jnp.ndarray):
     """selectHost parity (generic_scheduler.go:144): among max-score feasible
-    nodes, pick the (rr % ties)-th in node order."""
+    nodes, pick the (rr % ties)-th in node order.
+
+    The tie count is read off the cumsum's last element rather than a
+    separate sum (one less serial reduction in the scan step), and the
+    cumsum runs in f32 — the VPU's native dtype, exact for counts < 2^24.
+    A two-level reshape select ([N/128, 128] row-reduce + 128-wide rank
+    find) measured SLOWER (99 ms vs 88 ms per 4,096-pod solve at N=16k):
+    the 1-D->2-D retile of the tie vector costs more than the flat
+    reduce-window cumsum it saves."""
     best = jnp.max(masked_score)
     ties = feasible & (masked_score == best)
-    ntie = jnp.sum(ties.astype(jnp.int32))
+    cum = jnp.cumsum(ties.astype(jnp.float32))
+    ntie = cum[-1].astype(jnp.int32)
     k = (rr % jnp.maximum(ntie, 1).astype(jnp.uint32)).astype(jnp.int32)
-    cum = jnp.cumsum(ties.astype(jnp.int32))
-    node = jnp.argmax(ties & (cum == k + 1)).astype(jnp.int32)
+    # cum is nondecreasing and steps exactly at tie positions: the first
+    # index reaching k+1 IS the (k+1)-th tie
+    node = jnp.argmax(cum >= (k + 1).astype(jnp.float32)).astype(jnp.int32)
     return node, best, ntie
 
 
@@ -513,19 +550,21 @@ def schedule_batch(
             lambda p: _static_mask(state, p, policy, base_mask))(batch)
     static_score = jax.vmap(
         lambda p: _static_score(state, p, policy, base_score))(batch)
-    p_pods = static_mask.shape[0]
+
+    # resource columns the batch cannot touch (gpu/storage under the
+    # BatchFlags gates) hold against the batch-start ledger for the whole
+    # batch: hoist their compares out of the scan into the static mask
+    if g.use_resources and not (g.dyn_gpu and g.dyn_storage):
+        static_mask = static_mask & jax.vmap(
+            lambda p: preds.fits_resources_static(
+                state, p, g.dyn_gpu, g.dyn_storage))(batch)
+
     if w_tt:
         prefer_counts = jax.vmap(
             lambda p: preds.count_untolerated_prefer_taints(state, p))(batch)
-    else:
-        # unused by the step when the weight is zero: a (P, 1) placeholder
-        # keeps the scan xs tiny instead of carrying a dead (P, N) array
-        prefer_counts = jnp.zeros((p_pods, 1), jnp.int32)
     if w_na:
         na_counts = jax.vmap(
             lambda p: prios.node_affinity_counts(state, p))(batch)
-    else:
-        na_counts = jnp.zeros((p_pods, 1), jnp.float32)
 
     # domain->node broadcast matrix, shared by every interpod/spread kernel
     # (pod-independent; hoisted so scan steps do matmuls, not gathers)
@@ -533,15 +572,40 @@ def schedule_batch(
                    if use_ip_ledger else None)
 
     # ---- Phase B: scan over the pod axis, vector over nodes ----
+    # Every scan-xs leaf costs one dynamic-slice per step inside the compiled
+    # while loop (~1 us each on TPU — the dominant per-pod cost when the xs
+    # is the ~45-leaf PodBatch pytree; PERF.md round 5). So the step consumes
+    # the batch as TWO packed blob rows (pod fields become static slices that
+    # fuse into the step body) plus one combined (mask, score) row: the
+    # static mask rides the score row as -inf.
+    # the static mask AND the pod-valid bit ride the static-score row as
+    # -inf: one fused (P, N) xs leaf instead of three per-step reads. A
+    # padding row is all--inf, so its tie count is 0 and it can never be
+    # assigned — the step needs no separate `valid` test (its feasible
+    # count reads 0, which is also the honest verdict for a non-pod).
+    masked_static = jnp.where(batch.valid[:, None] & static_mask,
+                              static_score, -jnp.inf)
+    xs_list = [batch, masked_static]
+    if w_tt:
+        xs_list.append(prefer_counts)
+    if w_na:
+        xs_list.append(na_counts)
+    zero_i = jnp.zeros((1,), jnp.int32)
+    zero_f = jnp.zeros((1,), jnp.float32)
+
     def step(carry: Carry, xs):
-        pod, s_mask, s_score, p_counts, na_count = xs
+        pod, ms_row = xs[0], xs[1]
+        rest = list(xs[2:])
+        p_counts = rest.pop(0) if w_tt else zero_i
+        na_count = rest.pop(0) if w_na else zero_f
+        s_mask = ms_row > -jnp.inf
         feasible, score = _pod_eval(
-            state, g, carry, pod, s_mask, s_score, p_counts, na_count,
+            state, g, carry, pod, s_mask, ms_row, p_counts, na_count,
             topo_onehot, prows, hard_w, domain_universe)
 
         masked = jnp.where(feasible, score, -jnp.inf)
         node, best, ntie = _select_host(masked, feasible, carry.rr)
-        assigned = (ntie > 0) & pod.valid
+        assigned = ntie > 0   # a padding row is all--inf: ntie == 0
         node_idx = jnp.where(assigned, node, -1)
 
         add = jnp.where(assigned, 1.0, 0.0)
@@ -562,13 +626,22 @@ def schedule_batch(
             attach_count=(carry.attach_count.at[node].add(add * pod.att_onehot)
                           if attach_maxes else None),
         )
-        out = (node_idx, jnp.where(assigned, best, 0.0),
-               jnp.sum(feasible.astype(jnp.int32)))
-        return new_carry, out
+        # the feasible row is emitted whole and summed AFTER the scan (an
+        # in-step scalar sum measured SLOWER: the reduction does not fuse
+        # into the select chain, while the row's dynamic-update-slice is one
+        # 16 KB write), and the two scalar outputs ride one [2] f32 vector —
+        # each ys leaf costs its own dynamic-update-slice per step (node
+        # index is exact in f32: < 2^24)
+        packed = jnp.stack([node_idx.astype(jnp.float32),
+                            jnp.where(assigned, best, 0.0)])
+        return new_carry, (packed, feasible)
 
     init = _init_carry(state, g, rr_start, domain_universe)
-    final, (nodes, scores, counts) = jax.lax.scan(
-        step, init, (batch, static_mask, static_score, prefer_counts, na_counts))
+    final, (packed_out, feas_rows) = jax.lax.scan(
+        step, init, tuple(xs_list))
+    nodes = packed_out[:, 0].astype(jnp.int32)
+    scores = packed_out[:, 1]
+    counts = jnp.sum(feas_rows.astype(jnp.int32), axis=1)
 
     return SolverResult(
         assignments=nodes,

@@ -250,6 +250,7 @@ def packed_batch_flags(fblob, iblob, n: int, table, caps: Capacities):
 
     from kubernetes_tpu.ops.solver import table_has_prefer_taints
 
+    requests = np.asarray(blob_col(fblob, iblob, "requests", caps, n))
     return BatchFlags(
         ipa=bool(table.terms) or any_id("paff_q") or any_id("panti_q")
         or any_id("ppref_q") or any_("ipaff_fail"),
@@ -260,6 +261,10 @@ def packed_batch_flags(fblob, iblob, n: int, table, caps: Capacities):
         tt=table_has_prefer_taints(table),
         na=bool((np.asarray(blob_col(fblob, iblob, "pref_weight", caps, n))
                  > 0).any()),
+        ports=any_("port_onehot"),
+        gpu=bool(requests[:, Resource.GPU].any()),
+        storage=bool(requests[:, Resource.SCRATCH].any()
+                     or requests[:, Resource.OVERLAY].any()),
     )
 
 

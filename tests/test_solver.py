@@ -188,3 +188,81 @@ def test_serial_parity_random(seed):
     expected = SerialScheduler(nodes).schedule(pods)
     got, _, _ = solve(nodes, pods, caps=Capacities(num_nodes=16, batch_pods=24))
     assert got == expected
+
+
+def _random_pernode_cluster(rng, n_nodes, n_pods):
+    """Per-node-ledger random fixtures: resources, host ports, disk-conflict
+    + attachable volumes (NoSchedule taints are static), no PreferNoSchedule
+    taints and no affinity/spread surfaces — with tight node capacities so
+    in-batch claims keep flipping feasibility mid-batch."""
+    nodes = []
+    for i in range(n_nodes):
+        labels = {"disk": "ssd"} if rng.rand() < 0.3 else {}
+        taints = []
+        if rng.rand() < 0.2:
+            taints.append({"key": "dedicated", "value": "infra",
+                           "effect": "NoSchedule"})
+        nodes.append(mk_node(
+            f"n{i}", cpu=f"{rng.randint(2, 7)}", mem=f"{rng.randint(4, 13)}Gi",
+            pods=str(rng.randint(2, 6)), labels=labels, taints=taints))
+    pods = []
+    for i in range(n_pods):
+        spec = {}
+        if rng.rand() < 0.25:
+            spec["nodeSelector"] = {"disk": "ssd"}
+        if rng.rand() < 0.3:
+            spec["tolerations"] = [{"key": "dedicated", "operator": "Exists"}]
+        if rng.rand() < 0.25:
+            spec["volumes"] = [{"name": "d", "gcePersistentDisk": {
+                "pdName": f"disk-{rng.randint(4)}",
+                "readOnly": bool(rng.rand() < 0.5)}}]
+        cpu = f"{rng.choice([250, 500, 1000, 1500])}m" if rng.rand() < 0.8 else None
+        mem = f"{rng.choice([256, 512, 1024, 2048])}Mi" if rng.rand() < 0.8 else None
+        pod = mk_pod(f"p{i}", cpu=cpu, mem=mem, **spec)
+        if rng.rand() < 0.2:
+            # host port ON TOP of the resource requests (mk_pod's container
+            # must not be replaced, or port pods would lose their requests
+            # and dodge the pressure this fixture exists to create)
+            from kubernetes_tpu.api.objects import ContainerPort
+            pod.spec.containers[0].ports = [
+                ContainerPort.from_dict({
+                    "containerPort": 80,
+                    "hostPort": int(8000 + rng.randint(3))})]
+        pods.append(pod)
+    return nodes, pods
+
+
+@pytest.mark.parametrize("seed", range(5))
+def test_content_gated_parity_random(seed):
+    """Programs compiled with batch-content gates (including the round-5
+    ports/gpu/storage fit hoisting) must be bit-identical to the ALL_ACTIVE
+    program on every output — assignments, scores, feasible counts and all
+    post-batch ledgers — and match the serial Python spec, on batches whose
+    pressure keeps flipping node feasibility mid-batch."""
+    from kubernetes_tpu.ops.solver import ALL_ACTIVE, batch_flags
+
+    rng = np.random.RandomState(100 + seed)
+    nodes, pods = _random_pernode_cluster(rng, n_nodes=10, n_pods=40)
+    caps = Capacities(num_nodes=16, batch_pods=48)
+    state, batch, table = encode_cluster(nodes, pods, caps)
+    flags = batch_flags(batch, len(pods), table)
+    gated = schedule_batch(state, batch, 0, DEFAULT_POLICY, caps=caps,
+                           flags=flags)
+    full = schedule_batch(state, batch, 0, DEFAULT_POLICY, caps=caps,
+                          flags=ALL_ACTIVE)
+    for field in ("assignments", "scores", "feasible_counts",
+                  "new_requested", "new_nonzero", "new_port_count",
+                  "new_vol_any", "new_vol_rw", "new_attach"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(gated, field)),
+            np.asarray(getattr(full, field)), err_msg=field)
+    assert int(gated.rr_end) == int(full.rr_end)
+    # some pods must actually have been refused by in-batch pressure
+    assert (np.asarray(gated.assignments)[:len(pods)] == -1).any()
+
+    expected = SerialScheduler(
+        nodes, with_volumes=True,
+        attach_limits={"ebs": 39, "gce": 16, "azure": 16}).schedule(pods)
+    got = [table.name_of[int(a)] if a >= 0 else None
+           for a in np.asarray(gated.assignments)[:len(pods)]]
+    assert got == expected
