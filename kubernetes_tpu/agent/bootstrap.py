@@ -1,0 +1,81 @@
+"""Kubelet TLS bootstrap: bootstrap token -> CSR -> signed cert -> mTLS.
+
+The pkg/kubelet/certificate + bootstrap flow (reference
+pkg/kubelet/kubeletconfig/../certificate/bootstrap/bootstrap.go:60
+LoadClientCert): a kubelet that only holds a cluster-join bootstrap token
+creates a CertificateSigningRequest with CN=system:node:<name>,
+O=system:nodes, waits for the approve/sign controllers
+(controllers/certificates.py) to issue status.certificate, writes the key
+pair to disk, and reconnects with the client certificate as its identity.
+From then on the apiserver's X509Authenticator resolves it to
+system:node:<name> and the NodeAuthorizer scopes what it may touch.
+
+Key generation and CSR creation use the openssl binary — the same native
+boundary the signing controller uses.
+"""
+
+from __future__ import annotations
+
+import base64
+import subprocess
+import time
+
+from kubernetes_tpu.api.objects import CertificateSigningRequest
+
+NODE_USER_PREFIX = "system:node:"
+NODES_GROUP = "system:nodes"
+
+
+def make_node_csr(node_name: str, workdir: str) -> tuple[str, bytes]:
+    """Generate a key + CSR for the node identity.
+
+    Returns (key_file_path, csr_pem). Subject is exactly what the node
+    authorizer expects: CN=system:node:<name>, O=system:nodes
+    (bootstrap.go:132 builds the same subject)."""
+    key_file = f"{workdir}/kubelet-{node_name}.key"
+    csr_file = f"{workdir}/kubelet-{node_name}.csr"
+    subj = f"/O={NODES_GROUP}/CN={NODE_USER_PREFIX}{node_name}"
+    subprocess.run(
+        ["openssl", "req", "-new", "-newkey", "rsa:2048", "-nodes",
+         "-keyout", key_file, "-out", csr_file, "-subj", subj],
+        check=True, capture_output=True, timeout=60)
+    with open(csr_file, "rb") as f:
+        return key_file, f.read()
+
+
+def bootstrap_node_cert(client, node_name: str, workdir: str,
+                        timeout: float = 30.0,
+                        poll: float = 0.2) -> tuple[str, str]:
+    """Drive the full bootstrap against a (bootstrap-token) API client.
+
+    `client` is any store-shaped client (RemoteStore or ObjectStore).
+    Returns (cert_file, key_file) ready for RemoteStore(cert_file=...,
+    key_file=...). Raises TimeoutError if the controllers never issue."""
+    key_file, csr_pem = make_node_csr(node_name, workdir)
+    name = f"node-csr-{node_name}"
+    # Over HTTP the apiserver STAMPS spec.username/groups from the
+    # authenticated requester (strategy.go:45), overwriting these values;
+    # they only take effect for the in-process ObjectStore topology, where
+    # there is no authenticated identity to stamp from.
+    csr = CertificateSigningRequest.from_dict({
+        "metadata": {"name": name, "namespace": "default"},
+        "spec": {
+            "request": base64.b64encode(csr_pem).decode(),
+            "username": "kubelet-bootstrap",
+            "groups": ["system:bootstrappers"],
+            "usages": ["digital signature", "key encipherment",
+                       "client auth"],
+        }})
+    client.create(csr)
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        obj = client.get("CertificateSigningRequest", name, "default")
+        cert_b64 = (obj.status or {}).get("certificate", "")
+        if cert_b64:
+            cert_file = f"{workdir}/kubelet-{node_name}.crt"
+            with open(cert_file, "wb") as f:
+                f.write(base64.b64decode(cert_b64))
+            return cert_file, key_file
+        time.sleep(poll)
+    raise TimeoutError(
+        f"CSR {name}: no certificate issued within {timeout}s")

@@ -50,11 +50,62 @@ class TokenAuthenticator:
             tokens[token] = UserInfo(name=user, groups=groups)
         return cls(tokens)
 
-    def authenticate(self, headers: dict[str, str]) -> UserInfo | None:
+    def authenticate(self, headers: dict[str, str],
+                     peercert: dict | None = None) -> UserInfo | None:
+        del peercert  # header-only authenticator
         auth = headers.get("authorization", "")
         if not auth.lower().startswith("bearer "):
             return None
         return self.tokens.get(auth[7:].strip())
+
+
+class X509Authenticator:
+    """Client-certificate authentication (reference
+    apiserver/pkg/authentication/request/x509/x509.go:149
+    CommonNameUserConversion): a TLS peer certificate verified against the
+    --client-ca-file resolves to user = Subject.CommonName and
+    groups = Subject.Organization entries.
+
+    Verification itself happens in the TLS handshake (the server's
+    SSLContext carries the client CA with CERT_OPTIONAL, so a connection
+    may also arrive certless and fall through to the next authenticator) —
+    by the time `peercert` is non-None here, the chain already validated.
+    """
+
+    def authenticate(self, headers: dict[str, str],
+                     peercert: dict | None = None) -> UserInfo | None:
+        del headers
+        if not peercert:
+            return None
+        name = ""
+        groups: list[str] = []
+        for rdn in peercert.get("subject", ()):
+            for key, value in rdn:
+                if key == "commonName":
+                    name = value
+                elif key == "organizationName":
+                    groups.append(value)
+        if not name:
+            return None
+        return UserInfo(name=name, groups=tuple(groups))
+
+
+class UnionAuthenticator:
+    """Request-union authentication (apiserver/pkg/authentication/request/
+    union/union.go): first authenticator to resolve a user wins. The
+    apiserver composes x509 before bearer tokens, like the reference's
+    --client-ca-file + --token-auth-file stack."""
+
+    def __init__(self, *authenticators):
+        self.authenticators = [a for a in authenticators if a is not None]
+
+    def authenticate(self, headers: dict[str, str],
+                     peercert: dict | None = None) -> UserInfo | None:
+        for a in self.authenticators:
+            user = a.authenticate(headers, peercert)
+            if user is not None:
+                return user
+        return None
 
 
 READONLY_VERBS = frozenset({"get", "list", "watch"})
@@ -192,8 +243,8 @@ class RBACAuthorizer:
 
 
 class UnionAuthorizer:
-    """--authorization-mode=ABAC,RBAC chaining: allow when ANY mode allows
-    (apiserver/pkg/authorization/union)."""
+    """--authorization-mode=Node,ABAC,RBAC chaining: allow when ANY mode
+    allows (apiserver/pkg/authorization/union)."""
 
     def __init__(self, *authorizers):
         self.authorizers = [a for a in authorizers if a is not None]
@@ -202,3 +253,122 @@ class UnionAuthorizer:
                   namespace: str, name: str = "") -> bool:
         return any(a.authorize(user, verb, resource, namespace, name)
                    for a in self.authorizers)
+
+
+# ---- Node authorizer (plugin/pkg/auth/authorizer/node/node_authorizer.go) ----
+
+NODES_GROUP = "system:nodes"
+NODE_USER_PREFIX = "system:node:"
+
+# read surface every kubelet needs (node_authorizer.go:70-86 delegates these
+# to the system:node cluster role's read rules)
+_NODE_READ_RESOURCES = frozenset({
+    "nodes", "pods", "services", "endpoints", "persistentvolumes",
+    "persistentvolumeclaims",
+})
+# pod-referenced object kinds whose reads are scoped through the node's
+# bound pods (the reference's graph edges, node_authorizer.go:112-160)
+_POD_SCOPED_RESOURCES = frozenset({"secrets", "configmaps"})
+
+
+class NodeAuthorizer:
+    """Scope node identities to their own objects (the reference builds a
+    live graph, plugin/pkg/auth/authorizer/node/graph.go; at this store's
+    scale the same edges are answered by direct lookups):
+
+    - only handles users named system:node:<name> in group system:nodes —
+      anyone else defers to the next authorizer in the union;
+    - cluster-wide reads of the kubelet's informer surface
+      (nodes/pods/services/endpoints/PVs/PVCs);
+    - secrets/configmaps readable only when a pod BOUND TO THIS NODE
+      references them (graph.go edge semantics);
+    - node writes only on its own Node object (status updates/heartbeats);
+    - pod writes (status update, delete, binding-free create for mirror
+      pods) only for pods bound to this node;
+    - event creation and CSR creation (certificate rotation) allowed.
+    """
+
+    def __init__(self, store):
+        self.store = store
+
+    @staticmethod
+    def _node_name(user) -> str | None:
+        if NODES_GROUP not in user.groups:
+            return None
+        if not user.name.startswith(NODE_USER_PREFIX):
+            return None
+        return user.name[len(NODE_USER_PREFIX):]
+
+    def _pod_on_node(self, node: str, namespace: str, name: str) -> bool:
+        try:
+            pod = self.store.get("Pod", name, namespace or "default")
+        except KeyError:
+            return False
+        return pod.spec.node_name == node
+
+    def _references_from_node_pods(self, node: str, resource: str,
+                                   namespace: str, name: str) -> bool:
+        for pod in self.store.list("Pod", namespace or "default",
+                                   copy_objects=False):
+            if pod.spec.node_name != node:
+                continue
+            for vol in pod.spec.volumes:
+                src = vol.get("secret") if resource == "secrets" \
+                    else vol.get("configMap")
+                if src and src.get("secretName", src.get("name")) == name:
+                    return True
+        return False
+
+    def authorize(self, user, verb: str, resource: str,
+                  namespace: str, name: str = "") -> bool:
+        node = self._node_name(user)
+        if node is None:
+            return False  # not a node identity: defer to the union
+        if resource in _NODE_READ_RESOURCES and verb in READONLY_VERBS:
+            return True
+        if resource in _POD_SCOPED_RESOURCES and verb == "get":
+            return self._references_from_node_pods(
+                node, resource, namespace, name)
+        if resource == "nodes":
+            # heartbeats + status: only the node's own object
+            return verb in ("create", "update", "patch") and (
+                not name or name == node)
+        if resource == "pods":
+            if verb == "create":
+                return True  # mirror pods (binding happens server-side)
+            if verb in ("update", "patch", "delete"):
+                return bool(name) and self._pod_on_node(
+                    node, namespace, name)
+            return False
+        if resource == "events":
+            return verb in ("create", "update", "patch")
+        if resource == "certificatesigningrequests":
+            return verb in ("create", "get", "list", "watch")
+        return False
+
+
+# ---- impersonation (apiserver/pkg/endpoints/filters/impersonation.go:39) --
+
+
+def impersonate(authorizer, user: UserInfo,
+                headers: dict[str, str]) -> tuple[UserInfo | None, bool]:
+    """Apply Impersonate-User / Impersonate-Group headers.
+
+    Returns (effective_user, ok). The requester must be authorized for the
+    `impersonate` verb on `users` (and on `groups` for each requested
+    group) — filters/impersonation.go:66-102; on any failure the request
+    is forbidden rather than served as the original user (the reference
+    401/403s instead of silently dropping the headers)."""
+    target = headers.get("impersonate-user", "")
+    if not target:
+        return user, True
+    if authorizer is None or not authorizer.authorize(
+            user, "impersonate", "users", "", target):
+        return None, False
+    groups = tuple(v.strip() for k, v in headers.items()
+                   if k == "impersonate-group" for v in v.split(",")
+                   if v.strip())
+    for g in groups:
+        if not authorizer.authorize(user, "impersonate", "groups", "", g):
+            return None, False
+    return UserInfo(name=target, groups=groups), True

@@ -121,7 +121,15 @@ async def read_http_request(reader: asyncio.StreamReader):
         if line in (b"\r\n", b"\n", b""):
             break
         name, _, value = line.decode().partition(":")
-        headers[name.strip().lower()] = value.strip()
+        key = name.strip().lower()
+        if key in headers:
+            # repeated list-valued headers (e.g. kubectl's multiple
+            # Impersonate-Group lines) combine per RFC 7230 §3.2.2 —
+            # dropping all but the last would silently skip their
+            # authorization checks
+            headers[key] += ", " + value.strip()
+        else:
+            headers[key] = value.strip()
     length = int(headers.get("content-length", 0))
     body = await reader.readexactly(length) if length else b""
     return method, target, headers, body
@@ -218,7 +226,8 @@ class APIServer:
                  audit_path: str | None = None,
                  max_in_flight: int = 400,
                  tls_cert_file: str | None = None,
-                 tls_key_file: str | None = None):
+                 tls_key_file: str | None = None,
+                 client_ca_file: str | None = None):
         self.store = store
         self.host = host
         self.port = port
@@ -228,6 +237,10 @@ class APIServer:
         # --tls-cert-file/--tls-private-key-file); None = plaintext
         self.tls_cert_file = tls_cert_file
         self.tls_key_file = tls_key_file
+        # --client-ca-file: client certs verified against this CA resolve
+        # to users via X509Authenticator (CN/O); optional, so token-only
+        # clients still connect certless
+        self.client_ca_file = client_ca_file
         self._server: asyncio.AbstractServer | None = None
         # WithAudit (config.go:474): one JSON line per request decision
         self._audit = open(audit_path, "a", encoding="utf-8") \
@@ -256,14 +269,27 @@ class APIServer:
         self._audit.flush()
 
     def _authfilter(self, method: str, path: str,
-                    headers: dict[str, str]):
+                    headers: dict[str, str], peercert: dict | None = None):
         """-> ((status, payload) | None to proceed, authenticated user)."""
         if self.authenticator is None:
             return None, None
-        user = self.authenticator.authenticate(headers)
+        user = self.authenticator.authenticate(headers, peercert)
         if user is None:
             return (401, {"kind": "Status", "reason": "Unauthorized",
-                          "message": "invalid or missing bearer token"}), None
+                          "message": "no client certificate or valid "
+                                     "bearer token presented"}), None
+        # WithImpersonation (filters/impersonation.go:39) sits between
+        # authn and authz: the effective user must be granted, and all
+        # later authorization runs as the impersonated identity
+        from kubernetes_tpu.apiserver.auth import impersonate
+
+        requester = user
+        user, ok = impersonate(self.authorizer, user, headers)
+        if not ok:
+            # audit the REQUESTER: the denied escalation attempt is the
+            # one event that must stay attributed
+            return (403, {"kind": "Status", "reason": "Forbidden",
+                          "message": "impersonation denied"}), requester
         if self.authorizer is None:
             return None, user
         try:
@@ -298,6 +324,12 @@ class APIServer:
 
             ssl_ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
             ssl_ctx.load_cert_chain(self.tls_cert_file, self.tls_key_file)
+            if self.client_ca_file:
+                ssl_ctx.load_verify_locations(cafile=self.client_ca_file)
+                # OPTIONAL, not REQUIRED: bearer-token clients without a
+                # certificate must still be able to connect (the union
+                # authenticator tries x509 first, then tokens)
+                ssl_ctx.verify_mode = ssl.CERT_OPTIONAL
         self._server = await asyncio.start_server(
             self._handle, self.host, self.port, ssl=ssl_ctx)
         self.port = self._server.sockets[0].getsockname()[1]
@@ -339,7 +371,8 @@ class APIServer:
                     loads = json.loads
                 denied, user = self._authfilter(
                     "GET" if query.get("watch") in ("1", "true") else method,
-                    url.path, headers)
+                    url.path, headers,
+                    peercert=writer.get_extra_info("peercert"))
                 if denied is not None:
                     self._audit_log(user, method, target, denied[0])
                     await _respond(writer, *denied)
@@ -387,7 +420,8 @@ class APIServer:
                     else:
                         status, payload = self._route(
                             method, url.path, query, body, loads=loads,
-                            content_type=headers.get("content-type", ""))
+                            content_type=headers.get("content-type", ""),
+                            user=user)
                 finally:
                     self._in_flight -= 1
                 self._audit_log(user, method, target, status)
@@ -703,7 +737,7 @@ class APIServer:
         return None
 
     def _route(self, method: str, path: str, query: dict, body: bytes,
-               loads=json.loads, content_type: str = ""):
+               loads=json.loads, content_type: str = "", user=None):
         discovered = self._discovery(method, path)
         if discovered is not None:
             return discovered
@@ -755,6 +789,13 @@ class APIServer:
                 obj = decode_object(kind, loads(body))
                 if ns:
                     obj.metadata.namespace = ns
+                if kind == "CertificateSigningRequest" and user is not None:
+                    # registry strategy stamps the REQUESTER's identity
+                    # (pkg/registry/certificates/certificates/strategy.go:
+                    # 45 PrepareForCreate) — clients cannot forge the
+                    # username/groups the approving controller trusts
+                    obj.spec["username"] = user.name
+                    obj.spec["groups"] = list(user.groups)
                 created = self.store.create(obj)
                 return 201, encode_object(created)
             if method == "PATCH" and name is not None:
@@ -1031,7 +1072,9 @@ class RemoteStore:
     def __init__(self, host: str, port: int, token: str = "",
                  rate_limiter=None, wire_format: str | None = None,
                  tls: bool = False, ca_file: str | None = None,
-                 insecure_skip_verify: bool = False):
+                 insecure_skip_verify: bool = False,
+                 cert_file: str | None = None,
+                 key_file: str | None = None):
         self.host = host
         self.port = port
         self.token = token
@@ -1056,6 +1099,10 @@ class RemoteStore:
                 if insecure_skip_verify:
                     self._ssl.check_hostname = False
                     self._ssl.verify_mode = ssl.CERT_NONE
+            if cert_file and key_file:
+                # kubeconfig client-certificate/client-key: mTLS identity
+                # (CN=user, O=groups via the server's X509Authenticator)
+                self._ssl.load_cert_chain(cert_file, key_file)
         # content negotiation: "protobuf" (default when the codec is
         # available — the reference's hot-path default content type) or
         # "json"; KTPU_WIRE=json forces JSON fleet-wide

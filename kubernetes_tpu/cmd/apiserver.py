@@ -54,6 +54,9 @@ def parse_args(argv=None) -> argparse.Namespace:
                    help="ordered comma list of admission plugins")
     p.add_argument("--tls-cert-file", default="")
     p.add_argument("--tls-private-key-file", default="")
+    p.add_argument("--client-ca-file", default="",
+                   help="CA bundle for client-certificate authentication "
+                        "(CN=user, O=groups)")
     p.add_argument("--audit-log-path", default="")
     p.add_argument("--max-requests-inflight", type=int, default=400)
     p.add_argument("--watch-cache-size", type=int, default=1 << 16)
@@ -65,9 +68,12 @@ def build_server(args):
     from kubernetes_tpu.apiserver.admission import chain_for
     from kubernetes_tpu.apiserver.auth import (
         ABACAuthorizer,
+        NodeAuthorizer,
         RBACAuthorizer,
         TokenAuthenticator,
+        UnionAuthenticator,
         UnionAuthorizer,
+        X509Authenticator,
     )
     from kubernetes_tpu.apiserver.http import APIServer
     from kubernetes_tpu.apiserver.store import ObjectStore
@@ -78,10 +84,19 @@ def build_server(args):
         admission=chain_for(args.admission_control)
         if args.admission_control else None)
 
-    authenticator = None
+    authns = []
+    if args.client_ca_file:
+        if not (args.tls_cert_file and args.tls_private_key_file):
+            # without TLS serving there is no handshake to carry the
+            # client cert: the flag would be silently inert
+            raise SystemExit("--client-ca-file requires --tls-cert-file "
+                             "and --tls-private-key-file")
+        # x509 first, like the reference's authenticator union ordering
+        authns.append(X509Authenticator())
     if args.token_auth_file:
         with open(args.token_auth_file, encoding="utf-8") as f:
-            authenticator = TokenAuthenticator.from_csv(f.read())
+            authns.append(TokenAuthenticator.from_csv(f.read()))
+    authenticator = UnionAuthenticator(*authns) if authns else None
 
     modes = [m.strip() for m in args.authorization_mode.split(",")
              if m.strip()]
@@ -101,6 +116,8 @@ def build_server(args):
                     f.read()))
         elif mode == "RBAC":
             authorizers.append(RBACAuthorizer(store))
+        elif mode == "Node":
+            authorizers.append(NodeAuthorizer(store))
         else:
             raise SystemExit(f"unknown authorization mode {mode!r}")
     authorizer = UnionAuthorizer(*authorizers) if authorizers else None
@@ -111,7 +128,8 @@ def build_server(args):
         audit_path=args.audit_log_path or None,
         max_in_flight=args.max_requests_inflight,
         tls_cert_file=args.tls_cert_file or None,
-        tls_key_file=args.tls_private_key_file or None)
+        tls_key_file=args.tls_private_key_file or None,
+        client_ca_file=args.client_ca_file or None)
     return server, store
 
 

@@ -24,6 +24,7 @@ from kubernetes_tpu.controllers.base import ReconcileController
 log = logging.getLogger(__name__)
 
 BOOTSTRAP_GROUP = "system:bootstrappers"
+NODES_GROUP_NAME = "system:nodes"
 AUTO_APPROVED_USAGES = {"digital signature", "key encipherment",
                         "client auth", "server auth"}
 
@@ -86,7 +87,8 @@ class CSRController(ReconcileController):
     def _approvable(self, csr) -> bool:
         """The csrapproving policy collapsed to the bootstrap convention:
         requestor in system:bootstrappers (or a node user) asking for
-        standard usages only."""
+        standard usages only. The PEM subject check (`_subject_allowed`)
+        runs separately — this is just the cheap identity/usages gate."""
         spec = csr.spec
         groups = set(spec.get("groups") or [])
         username = spec.get("username", "")
@@ -94,6 +96,40 @@ class CSRController(ReconcileController):
         subject_ok = BOOTSTRAP_GROUP in groups \
             or username.startswith("system:node:")
         return subject_ok and usages <= AUTO_APPROVED_USAGES
+
+    @staticmethod
+    def _csr_subject(request_pem: bytes) -> tuple[str, list[str]]:
+        """(CN, [O...]) parsed from the CSR PEM via openssl RFC2253."""
+        out = subprocess.run(
+            ["openssl", "req", "-noout", "-subject", "-nameopt", "RFC2253"],
+            input=request_pem, check=True, capture_output=True, timeout=60)
+        text = out.stdout.decode().strip()
+        text = text.partition("=")[2] if text.startswith("subject") else text
+        cn, orgs = "", []
+        for part in text.split(","):
+            key, _, value = part.strip().partition("=")
+            if key == "CN":
+                cn = value
+            elif key == "O":
+                orgs.append(value)
+        return cn, orgs
+
+    def _subject_allowed(self, csr, cn: str, orgs: list[str]) -> bool:
+        """What the signer refuses to mint: auto-approval only covers NODE
+        client identities (CN=system:node:<x>, O=[system:nodes]) — the
+        reference's isNodeClientCert/isSelfNodeClientCert recognizers
+        (pkg/controller/certificates/approver/sarapprove.go:150). Without
+        this, a bootstrap token could post a CSR whose PEM says CN=admin,
+        get it signed, and walk through the x509 authenticator as admin —
+        the stamped spec.username is the REQUESTER, not the requested
+        subject, and both must be checked. A renewal (requester already a
+        node) must ask for its own identity."""
+        if not cn.startswith("system:node:") or orgs != [NODES_GROUP_NAME]:
+            return False
+        username = csr.spec.get("username", "")
+        if username.startswith("system:node:") and username != cn:
+            return False
+        return True
 
     def _sign(self, request_pem: bytes) -> bytes:
         with tempfile.TemporaryDirectory() as tmp:
@@ -126,6 +162,18 @@ class CSRController(ReconcileController):
         if not self._has(conditions, "Approved"):
             if not self._approvable(csr):
                 return  # left Pending for manual approval
+            try:
+                cn, orgs = await asyncio.to_thread(
+                    self._csr_subject,
+                    base64.b64decode(csr.spec.get("request", "")))
+            except (ValueError, subprocess.SubprocessError) as e:
+                log.warning("CSR %s: unparseable request: %s", key, e)
+                return  # left Pending
+            if not self._subject_allowed(csr, cn, orgs):
+                log.warning("CSR %s: subject %r/%r not auto-approvable",
+                            key, cn, orgs)
+                return  # left Pending for manual review
+
             def approve(obj):
                 conds = obj.status.setdefault("conditions", [])
                 if not any(c.get("type") == "Approved" for c in conds):

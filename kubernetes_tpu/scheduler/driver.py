@@ -284,6 +284,7 @@ class Scheduler:
             self._enqueue_time.pop(key, None)
             self._unindex_pod(key)
             self.statedb.remove_pod(key)
+            self.encode_cache.forget(key)
             return
         if pod.spec.node_name:
             if self._pod_node.get(key) != pod.spec.node_name:
@@ -292,6 +293,7 @@ class Scheduler:
                 self._pods_by_node.setdefault(
                     pod.spec.node_name, set()).add(key)
             self._enqueue_time.pop(key, None)
+            self.encode_cache.forget(key)
             if key in self._assumed:
                 # our own binding confirmed by the watch
                 self._assumed.discard(key)
@@ -302,6 +304,10 @@ class Scheduler:
         elif self._wants(pod):
             self._enqueue_time.setdefault(key, time.monotonic())
             self.queue.add(key)
+            # encode-on-watch: fingerprint + class encode now, while the
+            # previous batch is on the wire/device, so batch assembly on
+            # the critical path is a key lookup + two row memcpys
+            self.encode_cache.premake(pod)
 
     # ---- lifecycle ----
 

@@ -79,6 +79,11 @@ class EncodeCache:
         self.max_entries = max_entries
         self._rows: OrderedDict[tuple, tuple[np.ndarray, ...]] = OrderedDict()
         self._packed: OrderedDict[tuple, tuple[np.ndarray, np.ndarray]] = OrderedDict()
+        # pod key -> (epoch, generation, shared packed row): premade rows
+        # point at the SAME arrays as _packed (no copies), so the map costs
+        # one small tuple per pending pod
+        self._by_key: OrderedDict[str, tuple] = OrderedDict()
+        self.max_premade = 1 << 16
         self._scratch = empty_batch(caps)
         self.hits = 0
         self.misses = 0
@@ -117,19 +122,11 @@ class EncodeCache:
         for f, val in zip(_FIELDS, row):
             getattr(batch, f)[i] = val
 
-    def encode_packed_into(self, fblob: np.ndarray, iblob: np.ndarray,
-                           i: int, pod: Pod) -> None:
-        """Encode one pod directly into packed blob row i: a cache hit is
-        two row memcpys (vs ~45 per-field assignments), which is what makes
-        host encoding ~µs/pod under sustained template load."""
+    def _packed_row(self, pod: Pod) -> tuple[np.ndarray, np.ndarray]:
+        """The shared packed row for this pod's equivalence class (encoding
+        it on first sight)."""
         from kubernetes_tpu.state.pod_batch import pack_row
 
-        if self._must_reencode(pod):
-            encode_pod_into(self._scratch, 0, pod, self.caps, self.table,
-                            ctx=self.volume_ctx)
-            frow, irow = pack_row(self._scratch, 0, self.caps)
-            fblob[i], iblob[i] = frow, irow
-            return
         fp = (pod_fingerprint(pod), self.table.pod_row_epoch, self.generation)
         packed = self._packed.get(fp)
         if packed is None:
@@ -143,5 +140,51 @@ class EncodeCache:
         else:
             self.hits += 1
             self._packed.move_to_end(fp)
+        return packed
+
+    def premake(self, pod: Pod) -> None:
+        """Encode-on-watch: fingerprint + encode at informer-event time —
+        which overlaps the previous batch's device solve and transport
+        waits — and pin the class's shared packed row under the pod's key,
+        so batch assembly on the critical path is one dict hit plus two row
+        memcpys (~1.5 us/pod) instead of a ~10 us fingerprint+lookup. The
+        epoch/generation stamp is validated at use; a stale entry just
+        falls back to the fingerprint path."""
+        if self._must_reencode(pod):
+            # the pod may have MOVED into the non-cacheable class (e.g. a
+            # claim-backed volume added): a premade row from its cacheable
+            # past must not be served
+            self.forget(pod.key)
+            return
+        self._by_key[pod.key] = (self.table.pod_row_epoch, self.generation,
+                                 self._packed_row(pod))
+        if len(self._by_key) > self.max_premade:
+            self._by_key.popitem(last=False)
+
+    def forget(self, key: str) -> None:
+        """Drop a premade row (pod bound or deleted)."""
+        self._by_key.pop(key, None)
+
+    def encode_packed_into(self, fblob: np.ndarray, iblob: np.ndarray,
+                           i: int, pod: Pod) -> None:
+        """Encode one pod directly into packed blob row i: a premade hit is
+        two row memcpys; a class hit is a fingerprint + two memcpys (vs ~45
+        per-field assignments), which is what makes host encoding ~µs/pod
+        under sustained template load."""
+        pre = self._by_key.get(pod.key)
+        if pre is not None and pre[0] == self.table.pod_row_epoch \
+                and pre[1] == self.generation:
+            fblob[i], iblob[i] = pre[2]
+            self.hits += 1
+            return
+        if self._must_reencode(pod):
+            from kubernetes_tpu.state.pod_batch import pack_row
+
+            encode_pod_into(self._scratch, 0, pod, self.caps, self.table,
+                            ctx=self.volume_ctx)
+            frow, irow = pack_row(self._scratch, 0, self.caps)
+            fblob[i], iblob[i] = frow, irow
+            return
+        packed = self._packed_row(pod)
         fblob[i] = packed[0]
         iblob[i] = packed[1]
