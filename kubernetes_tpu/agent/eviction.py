@@ -1,0 +1,248 @@
+"""Kubelet eviction manager: pressure detection, QoS-ranked eviction,
+node-condition feedback.
+
+The pkg/kubelet/eviction analog (eviction_manager.go:213 `synchronize`:
+observe usage, compare against thresholds, update node conditions with a
+transition period, rank candidate pods, evict ONE victim per pass;
+ranking in helpers.go — BestEffort first, then Burstable pods over their
+requests, Guaranteed last).
+
+Signals at hollow fidelity: the fake runtime has no cgroups, so per-pod
+usage comes from annotations (``kubernetes-tpu/memory-usage-mib`` /
+``kubernetes-tpu/disk-usage-mib``), defaulting to the pod's requests —
+the same shape kubemark's fake stats provider takes. Node capacity comes
+from the Node object's allocatable.
+
+The conditions this manager raises (MemoryPressure / DiskPressure) are
+exactly what the scheduler's CheckNodeMemoryPressure /
+CheckNodeDiskPressure predicate kernels consume (ops/predicates.py), so
+the full loop closes: pressure -> evict -> scheduler avoids the node ->
+pressure clears -> (after the transition period) schedulable again.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+
+from kubernetes_tpu.api.quantity import parse_quantity
+from kubernetes_tpu.apiserver.store import Conflict, NotFound, ObjectStore
+
+log = logging.getLogger(__name__)
+
+MEMORY_USAGE_ANNOTATION = "kubernetes-tpu/memory-usage-mib"
+DISK_USAGE_ANNOTATION = "kubernetes-tpu/disk-usage-mib"
+MIB = 1024 * 1024
+
+
+def qos_class(pod) -> str:
+    """PodQOSClass (pkg/api/v1/helper/qos/qos.go GetPodQOS): Guaranteed
+    when every container sets limits == requests for cpu+memory;
+    BestEffort when nothing is set; Burstable otherwise."""
+    if pod.is_best_effort():
+        return "BestEffort"
+    for c in pod.spec.containers:
+        for res in ("cpu", "memory"):
+            req = c.requests.get(res)
+            lim = c.limits.get(res)
+            if req is None or lim is None \
+                    or parse_quantity(req) != parse_quantity(lim):
+                return "Burstable"
+    return "Guaranteed"
+
+
+def pod_memory_usage_mib(pod) -> float:
+    """Observed memory at hollow fidelity: the usage annotation, else the
+    summed container requests."""
+    ann = pod.metadata.annotations.get(MEMORY_USAGE_ANNOTATION)
+    if ann:
+        return float(ann)
+    total = 0.0
+    for c in pod.spec.containers:
+        if "memory" in c.requests:
+            total += parse_quantity(c.requests["memory"]) / MIB
+    return total
+
+
+def pod_disk_usage_mib(pod) -> float:
+    ann = pod.metadata.annotations.get(DISK_USAGE_ANNOTATION)
+    return float(ann) if ann else 0.0
+
+
+def _rank_key(pod, signal: str):
+    """Eviction order (helpers.go rankMemoryPressure / rankDiskPressure —
+    the ranker is PER SIGNAL): BestEffort first, then Burstable consuming
+    above requests, then the rest; within a tier the largest consumer OF
+    THE PRESSURED RESOURCE goes first (a memory ranking under disk
+    pressure would evict bystanders while the disk hog survives)."""
+    cls = qos_class(pod)
+    if signal == "DiskPressure":
+        usage = pod_disk_usage_mib(pod)
+        requests = 0.0  # no disk requests at this vintage: usage>0 = over
+    else:
+        usage = pod_memory_usage_mib(pod)
+        requests = 0.0
+        for c in pod.spec.containers:
+            if "memory" in c.requests:
+                requests += parse_quantity(c.requests["memory"]) / MIB
+    if cls == "BestEffort":
+        tier = 0
+    elif cls == "Burstable" and usage > requests:
+        tier = 1
+    else:
+        tier = 2
+    return (tier, -usage)
+
+
+class EvictionManager:
+    """One kubelet's eviction loop state. `synchronize()` is called by the
+    kubelet on its monitor period (Kubelet._eviction_loop)."""
+
+    def __init__(self, store: ObjectStore, node_name: str,
+                 memory_available_mib: float = 0.0,
+                 disk_available_mib: float = 0.0,
+                 pressure_transition_period: float = 5.0,
+                 runtime=None):
+        self.store = store
+        self.node_name = node_name
+        # hard thresholds (--eviction-hard memory.available<X,
+        # nodefs.available<Y); 0 disables the signal
+        self.memory_available_mib = memory_available_mib
+        self.disk_available_mib = disk_available_mib
+        # hysteresis (--eviction-pressure-transition-period, default 5m):
+        # a condition only CLEARS after staying below threshold this long
+        self.transition_period = pressure_transition_period
+        self.runtime = runtime
+        self._last_observed_over: dict[str, float] = {}
+        # last condition (status, reason) written per type: the Node is
+        # only touched when something CHANGES — a write per monitor pass
+        # would emit ~10 Node events/s/node and flood every informer
+        self._written: dict[str, tuple] = {}
+        self.evicted: list[str] = []
+
+    # ---- observation ----
+
+    def _node_allocatable_mib(self, resource: str) -> float:
+        try:
+            node = self.store.get("Node", self.node_name, "default")
+        except NotFound:
+            return 0.0
+        raw = node.status.allocatable.get(resource)
+        if raw is None:
+            return 0.0
+        return parse_quantity(str(raw)) / MIB
+
+    def _my_pods(self):
+        return [p for p in self.store.list("Pod", copy_objects=False)
+                if p.spec.node_name == self.node_name
+                and p.status.phase not in ("Succeeded", "Failed")]
+
+    def observe(self) -> dict[str, float]:
+        """available MiB per signal (summary API stand-in)."""
+        pods = self._my_pods()
+        mem_cap = self._node_allocatable_mib("memory")
+        mem_used = sum(pod_memory_usage_mib(p) for p in pods)
+        disk_cap = self._node_allocatable_mib(
+            "storage.kubernetes.io/scratch")
+        disk_used = sum(pod_disk_usage_mib(p) for p in pods)
+        return {"MemoryPressure": mem_cap - mem_used,
+                "DiskPressure": disk_cap - disk_used}
+
+    # ---- the synchronize pass (eviction_manager.go:213) ----
+
+    def synchronize(self) -> str | None:
+        """One pass: update conditions, evict at most one pod. Returns the
+        evicted pod key, if any."""
+        thresholds = {"MemoryPressure": self.memory_available_mib,
+                      "DiskPressure": self.disk_available_mib}
+        available = self.observe()
+        now = time.monotonic()
+        under = {}
+        for cond, threshold in thresholds.items():
+            if threshold <= 0:
+                under[cond] = False
+                continue
+            if available[cond] < threshold:
+                under[cond] = True
+                self._last_observed_over[cond] = now
+            else:
+                under[cond] = False
+                # hysteresis: stay "under pressure" until the transition
+                # period has passed since the last under-threshold reading
+                last = self._last_observed_over.get(cond)
+                if last is not None \
+                        and now - last < self.transition_period:
+                    under[cond] = True
+        self._write_conditions(under)
+        # evict only while a signal is ACTUALLY under threshold — the
+        # hysteresis tail keeps the condition up (scheduler keeps avoiding
+        # the node) but must not keep killing recovered workloads
+        for cond in ("MemoryPressure", "DiskPressure"):
+            if thresholds[cond] > 0 and available[cond] < thresholds[cond]:
+                return self._evict_one(cond)
+        return None
+
+    def _evict_one(self, signal: str = "MemoryPressure") -> str | None:
+        candidates = sorted(self._my_pods(),
+                            key=lambda p: _rank_key(p, signal))
+        if not candidates:
+            return None
+        victim = candidates[0]
+        key = victim.key
+
+        def fail(obj):
+            obj.status.phase = "Failed"
+            obj.status.reason = "Evicted"
+            obj.status.message = ("The node was low on resource: "
+                                  "memory/ephemeral-storage.")
+            return obj
+
+        try:
+            self.store.guaranteed_update(
+                "Pod", victim.metadata.name,
+                victim.metadata.namespace, fail)
+        except (NotFound, Conflict):
+            return None
+        if self.runtime is not None:
+            self.runtime.kill_pod(key)
+        self.evicted.append(key)
+        log.info("evicted %s (%s) under pressure", key, qos_class(victim))
+        return key
+
+    def _write_conditions(self, under: dict[str, bool]) -> None:
+        from kubernetes_tpu.api.objects import NodeCondition
+
+        wanted = {c: ("True" if u else "False") for c, u in under.items()}
+        if all(self._written.get(c) == w for c, w in wanted.items()):
+            return  # nothing flipped: don't spam Node watch events
+        now = time.time()
+
+        def mutate(node):
+            for cond_type, is_under in under.items():
+                want = "True" if is_under else "False"
+                reason = ("KubeletHasInsufficientMemory"
+                          if cond_type == "MemoryPressure"
+                          else "KubeletHasDiskPressure") if is_under else (
+                    "KubeletHasSufficientMemory"
+                    if cond_type == "MemoryPressure"
+                    else "KubeletHasNoDiskPressure")
+                existing = None
+                for c in node.status.conditions:
+                    if c.type == cond_type:
+                        existing = c
+                if existing is None:
+                    existing = NodeCondition(type=cond_type, status="")
+                    node.status.conditions.append(existing)
+                if existing.status != want:
+                    existing.last_transition_time = now
+                existing.status = want
+                existing.reason = reason
+                existing.last_heartbeat_time = now
+            return node
+
+        try:
+            self.store.guaranteed_update("Node", self.node_name, "default",
+                                         mutate)
+            self._written.update(wanted)
+        except (Conflict, NotFound):
+            pass

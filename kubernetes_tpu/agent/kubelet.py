@@ -144,15 +144,24 @@ class Kubelet(HollowKubelet):
 
     MOUNT_RETRY = 0.1  # reconciler retry period over fakes
 
+    EVICTION_PERIOD = 0.1  # reference monitors every 10s; fakes are faster
+
     def __init__(self, store: ObjectStore, node_name: str,
                  runtime: FakeRuntime | None = None,
-                 volume_manager=None, serve_api: bool = False, **kw):
+                 volume_manager=None, serve_api: bool = False,
+                 eviction=None, **kw):
         super().__init__(store, node_name, **kw)
         from kubernetes_tpu.agent.volumes import VolumeManager
 
         self.runtime = runtime if runtime is not None else FakeRuntime()
         self.volumes = volume_manager if volume_manager is not None \
             else VolumeManager(store, node_name)
+        # eviction manager (agent/eviction.py); None = no eviction loop
+        # (the reference's --eviction-hard= empty disables it too)
+        self.eviction = eviction
+        if eviction is not None and eviction.runtime is None:
+            eviction.runtime = self.runtime
+        self._eviction_task: asyncio.Task | None = None
         self.serve_api = serve_api
         self.server = None  # KubeletServer when serve_api
         self._workers: dict[str, asyncio.Queue] = {}
@@ -385,12 +394,31 @@ class Kubelet(HollowKubelet):
 
     # ---- lifecycle ----
 
+    async def _eviction_loop(self) -> None:
+        """eviction_manager.go:177 Start: synchronize on the monitor
+        period (cheap at hollow scale — a store scan plus at most one
+        eviction write per pass)."""
+        while True:
+            await asyncio.sleep(self.EVICTION_PERIOD)
+            if not self.running:
+                return
+            try:
+                evicted = self.eviction.synchronize()
+                if evicted:
+                    self._stop_worker(evicted)
+                    self._forget_probes(evicted)
+            except Exception:  # noqa: BLE001 — the loop must survive
+                log.exception("eviction synchronize failed")
+
     async def start(self) -> None:
         await super().start()
         self._pleg_task = asyncio.get_running_loop().create_task(
             self._pleg_loop())
         self._probe_task = asyncio.get_running_loop().create_task(
             self._probe_loop())
+        if self.eviction is not None:
+            self._eviction_task = asyncio.get_running_loop().create_task(
+                self._eviction_loop())
         if self.serve_api:
             from kubernetes_tpu.agent.server import KubeletServer
 
@@ -423,6 +451,9 @@ class Kubelet(HollowKubelet):
         if self._probe_task is not None:
             self._probe_task.cancel()
             self._probe_task = None
+        if self._eviction_task is not None:
+            self._eviction_task.cancel()
+            self._eviction_task = None
         if self.server is not None:
             self.server.close()
             self.server = None

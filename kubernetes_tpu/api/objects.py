@@ -306,6 +306,10 @@ class PodStatus:
     phase: str = "Pending"
     conditions: list[dict[str, Any]] = field(default_factory=list)
     host_ip: str = ""
+    # terminal-state attribution (v1 PodStatus.Reason/Message — the
+    # eviction manager writes Reason="Evicted", eviction_manager.go:560)
+    reason: str = ""
+    message: str = ""
     # raw v1 ContainerStatus dicts (restartCount/ready/state) written by
     # the agent's status manager, read by kubectl get (RESTARTS column)
     container_statuses: list[dict[str, Any]] = field(default_factory=list)
@@ -316,6 +320,7 @@ class PodStatus:
         return PodStatus(phase=self.phase,
                          conditions=[dict(c) for c in self.conditions],
                          host_ip=self.host_ip,
+                         reason=self.reason, message=self.message,
                          container_statuses=copy.deepcopy(
                              self.container_statuses))
 
@@ -325,6 +330,8 @@ class PodStatus:
             phase=d.get("phase", "Pending") or "Pending",
             conditions=list(d.get("conditions") or []),
             host_ip=d.get("hostIP", "") or "",
+            reason=d.get("reason", "") or "",
+            message=d.get("message", "") or "",
             container_statuses=list(d.get("containerStatuses") or []),
         )
 
@@ -334,6 +341,10 @@ class PodStatus:
             out["conditions"] = list(self.conditions)
         if self.host_ip:
             out["hostIP"] = self.host_ip
+        if self.reason:
+            out["reason"] = self.reason
+        if self.message:
+            out["message"] = self.message
         if self.container_statuses:
             out["containerStatuses"] = list(self.container_statuses)
         return out

@@ -286,6 +286,11 @@ class NodeAuthorizer:
     - pod writes (status update, delete, binding-free create for mirror
       pods) only for pods bound to this node;
     - event creation and CSR creation (certificate rotation) allowed.
+
+    Body-level scoping (a node minting a pod that *references* someone
+    else's secret to walk through the pod-scoped read edge) is the
+    NodeRestriction admission plugin's job (admission.NodeRestriction) —
+    the authorizer only ever sees (verb, resource, name).
     """
 
     def __init__(self, store):
@@ -345,6 +350,65 @@ class NodeAuthorizer:
         if resource == "certificatesigningrequests":
             return verb in ("create", "get", "list", "watch")
         return False
+
+
+# ---- webhook authorizer (plugin/pkg/auth/authorizer/webhook) -------------
+
+
+class WebhookAuthorizer:
+    """SubjectAccessReview over HTTP (plugin/pkg/auth/authorizer/webhook/
+    webhook.go:153): POST a SAR for each decision, read status.allowed.
+    Allowed decisions cache for `authorized_ttl` seconds (webhook.go's
+    --authorization-webhook-cache-authorized-ttl); denials are not cached,
+    so a new grant takes effect immediately. An unreachable webhook denies
+    (fail closed, like the reference's error path)."""
+
+    def __init__(self, url: str, authorized_ttl: float = 60.0,
+                 timeout: float = 5.0):
+        self.url = url
+        self.authorized_ttl = authorized_ttl
+        self.timeout = timeout
+        self._cache: dict[tuple, float] = {}
+
+    def authorize(self, user, verb: str, resource: str,
+                  namespace: str, name: str = "") -> bool:
+        import json as _json
+        import time
+        import urllib.error
+        import urllib.request
+
+        key = (user.name, user.groups, verb, resource, namespace, name)
+        expires = self._cache.get(key)
+        if expires is not None and expires > time.monotonic():
+            return True
+        review = {
+            "kind": "SubjectAccessReview",
+            "spec": {
+                "user": user.name,
+                "groups": list(user.groups),
+                "resourceAttributes": {
+                    "verb": verb, "resource": resource,
+                    "namespace": namespace, "name": name,
+                },
+            },
+        }
+        try:
+            req = urllib.request.Request(
+                self.url, data=_json.dumps(review).encode(),
+                headers={"Content-Type": "application/json"},
+                method="POST")
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                answer = _json.loads(resp.read())
+        except (urllib.error.URLError, OSError, ValueError, TimeoutError):
+            return False  # fail closed
+        allowed = bool((answer.get("status") or {}).get("allowed", False))
+        if allowed:
+            self._cache[key] = time.monotonic() + self.authorized_ttl
+            if len(self._cache) > 4096:
+                now = time.monotonic()
+                self._cache = {k: v for k, v in self._cache.items()
+                               if v > now}
+        return allowed
 
 
 # ---- impersonation (apiserver/pkg/endpoints/filters/impersonation.go:39) --

@@ -90,6 +90,10 @@ RESOURCES: dict[str, str] = {
     "rolebindings": "RoleBinding",
     "clusterrolebindings": "ClusterRoleBinding",
     "certificatesigningrequests": "CertificateSigningRequest",
+    # admissionregistration.k8s.io (served as a GenericObject; consumed by
+    # the GenericAdmissionWebhook plugin)
+    "externaladmissionhookconfigurations":
+        "ExternalAdmissionHookConfiguration",
 }
 KIND_TO_CLS = {cls.kind: cls for cls in (
     objs.Pod, objs.Node, objs.Service, objs.Endpoints, objs.Event,
@@ -418,10 +422,16 @@ class APIServer:
                     if proxied is not None:
                         status, payload = proxied
                     else:
-                        status, payload = self._route(
-                            method, url.path, query, body, loads=loads,
-                            content_type=headers.get("content-type", ""),
-                            user=user)
+                        from kubernetes_tpu.apiserver.admission import (
+                            request_user,
+                        )
+
+                        with request_user(user):
+                            status, payload = self._route(
+                                method, url.path, query, body, loads=loads,
+                                content_type=headers.get("content-type",
+                                                         ""),
+                                user=user)
                 finally:
                     self._in_flight -= 1
                 self._audit_log(user, method, target, status)

@@ -44,10 +44,13 @@ def parse_args(argv=None) -> argparse.Namespace:
                    help="csv of token,user,uid[,groups] "
                         "(--token-auth-file)")
     p.add_argument("--authorization-mode", default="AlwaysAllow",
-                   help="comma list of AlwaysAllow,ABAC,RBAC "
+                   help="comma list of AlwaysAllow,Node,ABAC,RBAC,Webhook "
                         "(union semantics)")
     p.add_argument("--authorization-policy-file", default="",
                    help="ABAC policy file (JSON lines)")
+    p.add_argument("--authorization-webhook-url", default="",
+                   help="SubjectAccessReview endpoint for the Webhook "
+                        "authorization mode")
     p.add_argument("--admission-control",
                    default="NamespaceLifecycle,DefaultTolerationSeconds,"
                            "LimitRanger,ResourceQuota,ServiceAccount",
@@ -118,6 +121,14 @@ def build_server(args):
             authorizers.append(RBACAuthorizer(store))
         elif mode == "Node":
             authorizers.append(NodeAuthorizer(store))
+        elif mode == "Webhook":
+            if not args.authorization_webhook_url:
+                raise SystemExit("--authorization-mode Webhook needs "
+                                 "--authorization-webhook-url")
+            from kubernetes_tpu.apiserver.auth import WebhookAuthorizer
+
+            authorizers.append(
+                WebhookAuthorizer(args.authorization_webhook_url))
         else:
             raise SystemExit(f"unknown authorization mode {mode!r}")
     authorizer = UnionAuthorizer(*authorizers) if authorizers else None

@@ -1,0 +1,246 @@
+"""Webhook admission + webhook authorizer + NodeRestriction +
+PodNodeSelector (VERDICT r4 #5).
+
+- plugin/pkg/admission/webhook/admission.go: AdmissionReview to an
+  external HTTP endpoint; failurePolicy Fail vs Ignore; deny + mutate.
+- plugin/pkg/auth/authorizer/webhook/webhook.go:153: SubjectAccessReview
+  POST, allowed-decision caching, fail-closed on unreachable.
+- plugin/pkg/admission/noderestriction/admission.go: node identities may
+  only create self-bound mirror pods without secret refs — the body-level
+  check the NodeAuthorizer cannot do.
+- plugin/pkg/admission/podnodeselector/admission.go: namespace annotation
+  merged into pods; conflicts rejected.
+"""
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+import pytest
+
+from kubernetes_tpu.api.objects import Namespace, Pod
+from kubernetes_tpu.apiserver import ObjectStore
+from kubernetes_tpu.apiserver.admission import (
+    AdmissionChain,
+    AdmissionError,
+    GenericAdmissionWebhook,
+    NodeRestriction,
+    PodNodeSelector,
+    request_user,
+)
+from kubernetes_tpu.apiserver.auth import UserInfo, WebhookAuthorizer
+
+NODE_USER = UserInfo(name="system:node:n1", groups=("system:nodes",))
+
+
+def mk_pod(name, node_name=None, mirror=False, volumes=None, selector=None):
+    d = {"metadata": {"name": name, "namespace": "default",
+                      "annotations": (
+                          {"kubernetes.io/config.mirror": "x"}
+                          if mirror else {})},
+         "spec": {"containers": [{"name": "c"}]}}
+    if volumes:
+        d["spec"]["volumes"] = volumes
+    if selector:
+        d["spec"]["nodeSelector"] = selector
+    pod = Pod.from_dict(d)
+    if node_name:
+        pod.spec.node_name = node_name
+    return pod
+
+
+# ---- NodeRestriction ----
+
+
+def test_node_restriction_scopes_pod_creation():
+    store = ObjectStore(admission=AdmissionChain([NodeRestriction()]))
+    with request_user(NODE_USER):
+        # non-mirror pod from a node: denied
+        with pytest.raises(AdmissionError, match="mirror"):
+            store.create(mk_pod("plain", node_name="n1"))
+        # mirror pod on ANOTHER node: denied
+        with pytest.raises(AdmissionError, match="itself"):
+            store.create(mk_pod("other", node_name="n2", mirror=True))
+        # mirror pod with a secret volume: denied (the self-grant-a-secret
+        # escalation the authorizer alone cannot see)
+        with pytest.raises(AdmissionError, match="secret"):
+            store.create(mk_pod(
+                "sneaky", node_name="n1", mirror=True,
+                volumes=[{"name": "v",
+                          "secret": {"secretName": "db-password"}}]))
+        # clean self-bound mirror pod: allowed
+        store.create(mk_pod("ok", node_name="n1", mirror=True))
+    # users that are not nodes are untouched
+    with request_user(UserInfo(name="alice")):
+        store.create(mk_pod("user-pod"))
+    # in-process writes (no user) are untouched
+    store.create(mk_pod("controller-pod", node_name="n2"))
+
+
+def test_node_restriction_update_cannot_grow_volumes():
+    """The UPDATE half: a node writing a pod bound to itself may not add
+    volume references (the post-hoc self-grant path)."""
+    store = ObjectStore(admission=AdmissionChain([NodeRestriction()]))
+    store.create(mk_pod("p", node_name="n1"))  # created in-process
+    with request_user(NODE_USER):
+        pod = store.get("Pod", "p")
+        pod.status.phase = "Running"
+        store.update(pod)  # status write: fine
+        sneaky = store.get("Pod", "p")
+        sneaky.spec.volumes.append(
+            {"name": "v", "secret": {"secretName": "db-password"}})
+        with pytest.raises(AdmissionError, match="volumes"):
+            store.update(sneaky)
+
+
+def test_node_restriction_own_node_only():
+    from kubernetes_tpu.api.objects import Node
+
+    store = ObjectStore(admission=AdmissionChain([NodeRestriction()]))
+    with request_user(NODE_USER):
+        store.create(Node.from_dict({"metadata": {"name": "n1"}}))
+        with pytest.raises(AdmissionError, match="cannot modify"):
+            store.create(Node.from_dict({"metadata": {"name": "n2"}}))
+
+
+# ---- PodNodeSelector ----
+
+
+def test_pod_node_selector_merges_and_conflicts():
+    store = ObjectStore(admission=AdmissionChain([PodNodeSelector()]))
+    store.create(Namespace.from_dict({
+        "metadata": {
+            "name": "default",
+            "annotations": {"scheduler.alpha.kubernetes.io/node-selector":
+                            "env=prod, tier=web"}}}))
+    created = store.create(mk_pod("p1", selector={"disk": "ssd"}))
+    assert created.spec.node_selector == {
+        "disk": "ssd", "env": "prod", "tier": "web"}
+    with pytest.raises(AdmissionError, match="conflicts"):
+        store.create(mk_pod("p2", selector={"env": "dev"}))
+
+
+# ---- webhook plumbing ----
+
+
+class _Hook(BaseHTTPRequestHandler):
+    """Fake external webhook: denies pods labeled forbidden=true; patches
+    a marker label onto everything else. Doubles as the SAR authorizer:
+    allows only user 'alice' on pods."""
+
+    reviews: list = []
+
+    def do_POST(self):
+        body = json.loads(self.rfile.read(
+            int(self.headers["Content-Length"])))
+        type(self).reviews.append(body)
+        if body.get("kind") == "SubjectAccessReview":
+            spec = body["spec"]
+            allowed = (spec["user"] == "alice"
+                       and spec["resourceAttributes"]["resource"] == "pods")
+            answer = {"status": {"allowed": allowed}}
+        else:
+            obj = body["spec"]["object"]
+            labels = (obj.get("metadata") or {}).get("labels") or {}
+            if labels.get("forbidden") == "true":
+                answer = {"status": {"allowed": False, "result": {
+                    "message": "forbidden label"}}}
+            else:
+                import base64
+                patch = [{"op": "add",
+                          "path": "/metadata/labels",
+                          "value": {**labels, "webhooked": "yes"}}]
+                answer = {"status": {
+                    "allowed": True,
+                    "patch": base64.b64encode(
+                        json.dumps(patch).encode()).decode()}}
+        payload = json.dumps(answer).encode()
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(payload)))
+        self.end_headers()
+        self.wfile.write(payload)
+
+    def log_message(self, *a):
+        pass
+
+
+@pytest.fixture
+def hook_server():
+    _Hook.reviews = []
+    server = HTTPServer(("127.0.0.1", 0), _Hook)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    yield f"http://127.0.0.1:{server.server_port}/"
+    server.shutdown()
+
+
+def _hook_config(store, url, failure_policy="Ignore", name="check.test"):
+    from kubernetes_tpu.api.objects import GenericObject
+
+    cfg = GenericObject.from_dict({
+        "metadata": {"name": "hooks"},
+        "externalAdmissionHooks": [{
+            "name": name,
+            "clientConfig": {"url": url},
+            "failurePolicy": failure_policy,
+            "rules": [{"operations": ["CREATE"], "resources": ["pods"]}],
+        }]})
+    cfg.kind = "ExternalAdmissionHookConfiguration"
+    store.create(cfg)
+
+
+def test_webhook_denies_and_mutates(hook_server):
+    store = ObjectStore(
+        admission=AdmissionChain([GenericAdmissionWebhook()]))
+    _hook_config(store, hook_server)
+    # denied by the external webhook
+    bad = mk_pod("bad")
+    bad.metadata.labels["forbidden"] = "true"
+    with pytest.raises(AdmissionError, match="forbidden label"):
+        with request_user(UserInfo(name="alice")):
+            store.create(bad)
+    # allowed + mutated via the response patch
+    with request_user(UserInfo(name="alice")):
+        created = store.create(mk_pod("good"))
+    assert created.metadata.labels.get("webhooked") == "yes"
+    # the AdmissionReview carried the requesting identity
+    review = next(r for r in _Hook.reviews
+                  if r.get("kind") == "AdmissionReview")
+    assert review["spec"]["userInfo"]["username"] == "alice"
+    # non-matching resources skip the hook entirely
+    from kubernetes_tpu.api.objects import Node
+
+    n_before = len(_Hook.reviews)
+    store.create(Node.from_dict({"metadata": {"name": "n1"}}))
+    assert len(_Hook.reviews) == n_before
+
+
+def test_webhook_failure_policy():
+    dead = "http://127.0.0.1:1/"  # nothing listens
+    # Ignore: fails open
+    store = ObjectStore(
+        admission=AdmissionChain([GenericAdmissionWebhook()]))
+    _hook_config(store, dead, failure_policy="Ignore")
+    store.create(mk_pod("passes"))
+    # Fail: fails closed
+    store2 = ObjectStore(
+        admission=AdmissionChain([GenericAdmissionWebhook()]))
+    _hook_config(store2, dead, failure_policy="Fail")
+    with pytest.raises(AdmissionError, match="failed"):
+        store2.create(mk_pod("rejected"))
+
+
+def test_webhook_authorizer(hook_server):
+    authz = WebhookAuthorizer(hook_server, authorized_ttl=60)
+    alice = UserInfo(name="alice")
+    bob = UserInfo(name="bob")
+    assert authz.authorize(alice, "get", "pods", "default")
+    assert not authz.authorize(bob, "get", "pods", "default")
+    assert not authz.authorize(alice, "get", "secrets", "default")
+    # allowed decisions cache: a second identical check must not re-POST
+    n = len(_Hook.reviews)
+    assert authz.authorize(alice, "get", "pods", "default")
+    assert len(_Hook.reviews) == n
+    # unreachable endpoint fails closed
+    dead = WebhookAuthorizer("http://127.0.0.1:1/", timeout=0.5)
+    assert not dead.authorize(alice, "get", "pods", "default")
