@@ -94,6 +94,9 @@ RESOURCES: dict[str, str] = {
     # the GenericAdmissionWebhook plugin)
     "externaladmissionhookconfigurations":
         "ExternalAdmissionHookConfiguration",
+    # storage.k8s.io (served as a GenericObject; consumed by the PV
+    # binder's dynamic-provisioning path)
+    "storageclasses": "StorageClass",
 }
 KIND_TO_CLS = {cls.kind: cls for cls in (
     objs.Pod, objs.Node, objs.Service, objs.Endpoints, objs.Event,

@@ -493,6 +493,241 @@ def cmd_port_forward(client, args) -> int:
     return 0
 
 
+def cmd_run(client, args) -> int:
+    """kubectl run (pkg/kubectl/cmd/run.go): --restart picks the
+    generator — Always -> Deployment (the 1.8 default), OnFailure -> Job,
+    Never -> bare Pod (run.go:253 generator selection)."""
+    labels = dict(kv.split("=", 1) for kv in args.labels.split(",")
+                  if "=" in kv) if args.labels else {"run": args.name}
+    container = {"name": args.name, "image": args.image}
+    if args.command:
+        container["command"] = list(args.command)
+    pod_spec = {"containers": [container]}
+    template = {"metadata": {"labels": labels}, "spec": dict(pod_spec)}
+    if args.restart == "Never":
+        obj = decode_object("Pod", {
+            "kind": "Pod",
+            "metadata": {"name": args.name, "namespace": args.namespace,
+                         "labels": labels},
+            "spec": pod_spec})
+        created_kind = "pod"
+    elif args.restart == "OnFailure":
+        template["spec"]["restartPolicy"] = "OnFailure"
+        obj = decode_object("Job", {
+            "kind": "Job",
+            "metadata": {"name": args.name, "namespace": args.namespace},
+            "spec": {"template": template}})
+        created_kind = "job"
+    else:
+        obj = decode_object("Deployment", {
+            "kind": "Deployment",
+            "metadata": {"name": args.name, "namespace": args.namespace},
+            "spec": {"replicas": args.replicas,
+                     "selector": {"matchLabels": labels},
+                     "template": template}})
+        created_kind = "deployment"
+    client.create(obj)
+    print(f"{created_kind}/{args.name} created")
+    return 0
+
+
+def cmd_expose(client, args) -> int:
+    """kubectl expose (pkg/kubectl/cmd/expose.go): derive a Service
+    selector from the exposed workload (its spec.selector, or a pod's
+    labels) and create the Service."""
+    kind = RESOURCES[resolve_resource(args.resource)]
+    obj = client.get(kind, args.name, args.namespace)
+    if kind == "Pod":
+        selector = dict(obj.metadata.labels)
+    else:
+        sel = (obj.spec.get("selector") or {})
+        selector = dict(sel.get("matchLabels") or sel or {})
+    if not selector:
+        print(f"error: couldn't find a selector on {kind}/{args.name}",
+              file=sys.stderr)
+        return 1
+    port = {"port": args.port}
+    if args.target_port:
+        port["targetPort"] = args.target_port
+    svc = decode_object("Service", {
+        "kind": "Service",
+        "metadata": {"name": args.service_name or args.name,
+                     "namespace": args.namespace},
+        "spec": {"selector": selector, "ports": [port],
+                 "type": args.type}})
+    client.create(svc)
+    print(f"service/{svc.metadata.name} exposed")
+    return 0
+
+
+def cmd_set(client, args) -> int:
+    """kubectl set image (pkg/kubectl/cmd/set/set_image.go): patch the
+    named containers' images through the workload's pod template."""
+    if args.what != "image":
+        print(f"error: unknown set subcommand {args.what!r}",
+              file=sys.stderr)
+        return 1
+    kind = RESOURCES[resolve_resource(args.resource)]
+    updates = dict(kv.split("=", 1) for kv in args.pairs)
+
+    def mutate(obj):
+        containers = (obj.spec.get("template") or {}).get(
+            "spec", {}).get("containers", []) if kind != "Pod" \
+            else [c.to_dict() for c in obj.spec.containers]
+        hit = False
+        for c in containers:
+            if c.get("name") in updates or "*" in updates:
+                c["image"] = updates.get(c.get("name"), updates.get("*"))
+                hit = True
+        if not hit:
+            raise NotFound(
+                f"container(s) {sorted(updates)} not found in "
+                f"{kind}/{args.name}")
+        if kind == "Pod":
+            from kubernetes_tpu.api.objects import Container
+
+            obj.spec.containers = [Container.from_dict(c)
+                                   for c in containers]
+        return obj
+
+    client.guaranteed_update(kind, args.name, args.namespace, mutate)
+    print(f"{kind.lower()}/{args.name} image updated")
+    return 0
+
+
+def cmd_edit(client, args) -> int:
+    """kubectl edit (pkg/kubectl/cmd/editor/editoptions.go): fetch, open
+    $EDITOR on the JSON, PUT the result back; an unchanged buffer is a
+    no-op ('Edit cancelled')."""
+    import os
+    import subprocess
+    import tempfile
+
+    kind = RESOURCES[resolve_resource(args.resource)]
+    obj = client.get(kind, args.name, args.namespace)
+    doc = obj.to_dict()
+    doc.setdefault("kind", kind)
+    before = json.dumps(doc, indent=2, sort_keys=True)
+    editor = os.environ.get("EDITOR", "vi")
+    with tempfile.NamedTemporaryFile(
+            "w+", suffix=".json", delete=False) as f:
+        f.write(before)
+        path = f.name
+    try:
+        try:
+            subprocess.run(f"{editor} {path}", shell=True, check=True)
+        except subprocess.CalledProcessError:
+            # vim :cq / any editor abort: cancel, don't traceback
+            print("Edit cancelled (editor exited nonzero).")
+            return 0
+        with open(path) as f:
+            after = f.read()
+    finally:
+        os.unlink(path)
+    if after.strip() == before.strip():
+        print("Edit cancelled, no changes made.")
+        return 0
+    edited = decode_object(kind, json.loads(after))
+    edited.metadata.namespace = obj.metadata.namespace
+    client.update(edited, check_version=False)
+    print(f"{kind.lower()}/{args.name} edited")
+    return 0
+
+
+def cmd_top(client, args) -> int:
+    """kubectl top node|pod. The reference reads heapster metrics
+    (top_node.go); at hollow fidelity the 'usage' signal is the
+    scheduler's own accounting — summed pod requests per node (plus the
+    eviction manager's usage annotations for pods that carry them)."""
+    from kubernetes_tpu.agent.eviction import pod_memory_usage_mib
+    from kubernetes_tpu.api.quantity import parse_quantity
+
+    what = resolve_resource(args.resource)
+    if what == "nodes":
+        pods = client.list("Pod")
+        by_node: dict[str, dict] = {}
+        for pod in pods:
+            if not pod.spec.node_name \
+                    or pod.status.phase in ("Succeeded", "Failed"):
+                continue
+            agg = by_node.setdefault(pod.spec.node_name,
+                                     {"cpu": 0.0, "mem": 0.0})
+            for c in pod.spec.containers:
+                if "cpu" in c.requests:
+                    agg["cpu"] += parse_quantity(c.requests["cpu"])
+                if "memory" in c.requests:
+                    agg["mem"] += parse_quantity(c.requests["memory"])
+        print(f"{'NAME':24} {'CPU(cores)':>12} {'CPU%':>6} "
+              f"{'MEMORY(Mi)':>12} {'MEM%':>6}")
+        for node in client.list("Node"):
+            agg = by_node.get(node.metadata.name, {"cpu": 0.0, "mem": 0.0})
+            cap_cpu = parse_quantity(
+                str(node.status.allocatable.get("cpu", "0")))
+            cap_mem = parse_quantity(
+                str(node.status.allocatable.get("memory", "0")))
+            cpu_pct = 100 * agg["cpu"] / cap_cpu if cap_cpu else 0
+            mem_pct = 100 * agg["mem"] / cap_mem if cap_mem else 0
+            print(f"{node.metadata.name:24} {agg['cpu']:>11.2f} "
+                  f"{cpu_pct:>5.0f}% {agg['mem'] / (1 << 20):>12.0f} "
+                  f"{mem_pct:>5.0f}%")
+        return 0
+    if what == "pods":
+        print(f"{'NAME':32} {'CPU(cores)':>12} {'MEMORY(Mi)':>12}")
+        for pod in client.list("Pod", namespace=args.namespace):
+            if pod.status.phase in ("Succeeded", "Failed"):
+                continue
+            cpu = sum(parse_quantity(c.requests["cpu"])
+                      for c in pod.spec.containers if "cpu" in c.requests)
+            print(f"{pod.metadata.name:32} {cpu:>11.2f} "
+                  f"{pod_memory_usage_mib(pod):>12.0f}")
+        return 0
+    print("error: top supports nodes|pods", file=sys.stderr)
+    return 1
+
+
+def cmd_autoscale(client, args) -> int:
+    """kubectl autoscale (pkg/kubectl/cmd/autoscale.go): create an HPA
+    targeting the workload."""
+    kind = RESOURCES[resolve_resource(args.resource)]
+    hpa = decode_object("HorizontalPodAutoscaler", {
+        "kind": "HorizontalPodAutoscaler",
+        "metadata": {"name": args.name, "namespace": args.namespace},
+        "spec": {"scaleTargetRef": {"kind": kind, "name": args.name},
+                 "minReplicas": args.min,
+                 "maxReplicas": args.max,
+                 "targetCPUUtilizationPercentage": args.cpu_percent}})
+    client.create(hpa)
+    print(f"horizontalpodautoscaler/{args.name} autoscaled")
+    return 0
+
+
+def cmd_attach(client, args) -> int:
+    """kubectl attach (pkg/kubectl/cmd/attach.go): join the running
+    container's streams — at hollow fidelity the output stream is the
+    container log buffer; -i additionally opens the interactive exec
+    channel (the same SPDY-analog transport kubectl exec -i uses)."""
+    prefix, container = _node_proxy_path(client, args)
+    status, body = client.raw(
+        "GET", f"{prefix}/containerLogs/{args.namespace}/{args.name}/"
+               f"{container}")
+    if status != 200:
+        print(f"Error from server: {body.strip()}", file=sys.stderr)
+        return 1
+    sys.stdout.write(body)
+    if not args.stdin:
+        return 0
+    from kubernetes_tpu.client.remotecommand import exec_stream
+
+    lines = (line.encode() for line in sys.stdin)
+    code, out, err = exec_stream(
+        client.host, client.port,
+        f"{prefix}/exec/{args.namespace}/{args.name}/{container}",
+        lines, token=client.token)
+    sys.stdout.write(out)
+    sys.stderr.write(err)
+    return code
+
+
 def cmd_api_resources(client, args) -> int:
     """Discovery walk: /api/v1 + every /apis group version
     (pkg/kubectl/cmd/apiresources analog)."""
@@ -706,6 +941,51 @@ def build_parser() -> argparse.ArgumentParser:
     dr.set_defaults(fn=cmd_drain)
     ar = sub.add_parser("api-resources")
     ar.set_defaults(fn=cmd_api_resources)
+    rn = sub.add_parser("run")
+    rn.add_argument("name")
+    rn.add_argument("--image", required=True)
+    rn.add_argument("--replicas", type=int, default=1)
+    rn.add_argument("--restart", default="Always",
+                    choices=["Always", "OnFailure", "Never"])
+    rn.add_argument("--labels", default="",
+                    help="comma list of key=value")
+    rn.add_argument("-n", "--namespace", default="default")
+    rn.add_argument("command", nargs="*", default=[])
+    rn.set_defaults(fn=cmd_run)
+    xp = sub.add_parser("expose")
+    common(xp)
+    xp.add_argument("--port", type=int, required=True)
+    xp.add_argument("--target-port", type=int, default=0)
+    xp.add_argument("--name", dest="service_name", default="")
+    xp.add_argument("--type", default="ClusterIP")
+    xp.set_defaults(fn=cmd_expose)
+    st = sub.add_parser("set")
+    st.add_argument("what", help="set subcommand (image)")
+    st.add_argument("resource")
+    st.add_argument("name")
+    st.add_argument("pairs", nargs="+",
+                    help="container=image (or *=image)")
+    st.add_argument("-n", "--namespace", default="default")
+    st.set_defaults(fn=cmd_set)
+    ed = sub.add_parser("edit")
+    common(ed)
+    ed.set_defaults(fn=cmd_edit)
+    tp = sub.add_parser("top")
+    tp.add_argument("resource", help="nodes|pods")
+    tp.add_argument("-n", "--namespace", default="default")
+    tp.set_defaults(fn=cmd_top)
+    asc = sub.add_parser("autoscale")
+    common(asc)
+    asc.add_argument("--min", type=int, default=1)
+    asc.add_argument("--max", type=int, required=True)
+    asc.add_argument("--cpu-percent", type=int, default=80)
+    asc.set_defaults(fn=cmd_autoscale)
+    at = sub.add_parser("attach")
+    at.add_argument("name")
+    at.add_argument("-n", "--namespace", default="default")
+    at.add_argument("-c", "--container", default="")
+    at.add_argument("-i", "--stdin", action="store_true")
+    at.set_defaults(fn=cmd_attach)
     ex2 = sub.add_parser("explain")
     ex2.add_argument("resource",
                      help="resource[.field...], e.g. pods.spec.containers")

@@ -18,8 +18,15 @@ the store (the kubelet volumemanager then mounts what is attached).
 
 from __future__ import annotations
 
+import logging
+
 from kubernetes_tpu.api.quantity import parse_quantity
-from kubernetes_tpu.apiserver.store import Conflict, NotFound, ObjectStore
+from kubernetes_tpu.apiserver.store import (
+    AlreadyExists,
+    Conflict,
+    NotFound,
+    ObjectStore,
+)
 from kubernetes_tpu.client.informer import Informer
 from kubernetes_tpu.controllers.base import ReconcileController
 from kubernetes_tpu.controllers.replicaset import is_active
@@ -28,6 +35,8 @@ from kubernetes_tpu.state.podaffinity import (
     canonical_selector,
     selector_matches,
 )
+
+log = logging.getLogger(__name__)
 
 ACCESS_MODES = ("ReadWriteOnce", "ReadOnlyMany", "ReadWriteMany")
 
@@ -65,16 +74,47 @@ def pv_matches_claim(pv, pvc) -> bool:
     return True
 
 
+PROVISIONED_BY_ANNOTATION = "pv.kubernetes.io/provisioned-by"
+# annotation-era class reference (the 1.8 wire still honors it alongside
+# spec.storageClassName, pv_controller.go GetClaimStorageClass)
+BETA_CLASS_ANNOTATION = "volume.beta.kubernetes.io/storage-class"
+FAKE_PROVISIONER = "kubernetes.io/fake"
+
+
+def fake_provision(claim, storage_class: dict, pv_name: str) -> dict:
+    """Default provisioner SPI implementation — the fake-cloud analog of
+    the gce-pd/aws-ebs provisioners (pkg/cloudprovider-backed plugins'
+    Provision(): allocate a disk sized to the claim, return a PV spec).
+    `storage_class` is the StorageClass body (provisioner/parameters/
+    reclaimPolicy); parameters.type names the fake disk family."""
+    requests = (claim.spec.get("resources") or {}).get("requests") or {}
+    params = storage_class.get("parameters") or {}
+    return {
+        "capacity": {"storage": requests.get("storage", "1Gi")},
+        "accessModes": list(claim.spec.get("accessModes")
+                            or ["ReadWriteOnce"]),
+        "persistentVolumeReclaimPolicy":
+            storage_class.get("reclaimPolicy", "Delete"),
+        "gcePersistentDisk": {"pdName": f"{params.get('type', 'fake')}-"
+                                        f"{pv_name}",
+                              "fsType": params.get("fsType", "ext4")},
+    }
+
+
 class PersistentVolumeBinder(ReconcileController):
     workers = 1
 
     def __init__(self, store: ObjectStore, pvc_informer: Informer,
-                 pv_informer: Informer):
+                 pv_informer: Informer, provisioners: dict | None = None):
         super().__init__()
         self.name = "persistentvolume-binder"
         self.store = store
         self.claims = pvc_informer
         self.volumes = pv_informer
+        # provisioner name -> fn(claim, class_body, pv_name) -> pv spec
+        # (the dynamic-provisioning half of the volume SPI)
+        self.provisioners = {FAKE_PROVISIONER: fake_provision}
+        self.provisioners.update(provisioners or {})
         pvc_informer.add_handler(self._on_claim)
         pv_informer.add_handler(self._on_volume)
 
@@ -97,10 +137,24 @@ class PersistentVolumeBinder(ReconcileController):
         pvc = self.claims.get(name, ns)
         if pvc is None or pvc.volume_name:
             return
-        # smallest satisfying Available volume wins
+        # a volume already claimRef'd to THIS claim finishes its half-done
+        # bind first (the provision-then-crash resume path,
+        # pv_controller.go syncUnboundClaim's found-by-claimref branch)
+        for pv in self.volumes.items():
+            if (pv.spec.get("claimRef") or {}).get("uid") \
+                    == pvc.metadata.uid:
+                self._finish_bind(pvc, pv.metadata.name)
+                return
+        # smallest satisfying Available volume wins (pv_matches_claim
+        # already excludes claimRef'd volumes)
         candidates = [pv for pv in self.volumes.items()
                       if pv_matches_claim(pv, pvc)]
         if not candidates:
+            # dynamic provisioning (pv_controller.go:1230 provisionClaim):
+            # a claim naming a StorageClass gets a volume minted by the
+            # class's provisioner instead of waiting forever
+            if self._provision(pvc):
+                return
             self._set_phase_pvc(pvc, "Pending")
             return
         best = min(candidates, key=lambda pv: (_capacity(pv.spec),
@@ -134,6 +188,84 @@ class PersistentVolumeBinder(ReconcileController):
         except (NotFound, Conflict):
             # claim vanished mid-bind: roll the volume back
             self._scrub(best.metadata.name)
+
+    def _provision(self, pvc) -> bool:
+        """provisionClaimOperation (pv_controller.go:1282): create a PV
+        from the class's provisioner, PRE-BOUND to the claim (claimRef set
+        at creation so no other claim can race onto it), then point the
+        claim at it. Returns True when the claim is being handled by
+        provisioning (even if a step raced — the next sync retries)."""
+        cls_name = (pvc.spec.get("storageClassName")
+                    or pvc.metadata.annotations.get(BETA_CLASS_ANNOTATION)
+                    or "")
+        if not cls_name:
+            return False
+        try:
+            storage_class = self.store.get("StorageClass", cls_name)
+        except NotFound:
+            return False
+        body = getattr(storage_class, "body", None) or {}
+        provision = self.provisioners.get(body.get("provisioner", ""))
+        if provision is None:
+            log.warning("claim %s: no provisioner %r registered",
+                        pvc.key, body.get("provisioner"))
+            return False
+        pv_name = f"pvc-{pvc.metadata.uid}"
+        claim_ref = {"kind": "PersistentVolumeClaim",
+                     "namespace": pvc.metadata.namespace,
+                     "name": pvc.metadata.name, "uid": pvc.metadata.uid}
+        try:
+            self.store.get("PersistentVolume", pv_name)
+        except NotFound:
+            from kubernetes_tpu.api.objects import PersistentVolume
+
+            spec = provision(pvc, body, pv_name)
+            spec["claimRef"] = claim_ref
+            spec["storageClassName"] = cls_name
+            pv = PersistentVolume.from_dict({
+                "metadata": {"name": pv_name,
+                             "annotations": {PROVISIONED_BY_ANNOTATION:
+                                             body.get("provisioner", "")}},
+                "spec": spec})
+            pv.status["phase"] = "Bound"
+            try:
+                self.store.create(pv)
+            except AlreadyExists:
+                pass  # another worker won the race: fall through to bind
+
+        self._finish_bind(pvc, pv_name)
+        return True
+
+    def _finish_bind(self, pvc, pv_name: str) -> None:
+        """Point the claim at a volume that already claimRefs it."""
+        def bind_pvc(obj):
+            obj.spec["volumeName"] = pv_name
+            obj.status["phase"] = "Bound"
+            return obj
+
+        try:
+            self.store.guaranteed_update(
+                "PersistentVolumeClaim", pvc.metadata.name,
+                pvc.metadata.namespace, bind_pvc)
+        except (NotFound, Conflict):
+            # claim vanished mid-bind: a dynamically PROVISIONED volume
+            # honors its Delete reclaim policy (pv_controller deletes
+            # orphaned provisioned volumes — recycling one as Available
+            # would hand a future claim a used fake disk); pre-existing
+            # volumes just free up
+            try:
+                pv = self.store.get("PersistentVolume", pv_name)
+            except NotFound:
+                return
+            if PROVISIONED_BY_ANNOTATION in pv.metadata.annotations \
+                    and pv.spec.get("persistentVolumeReclaimPolicy") \
+                    == "Delete":
+                try:
+                    self.store.delete("PersistentVolume", pv_name)
+                except NotFound:
+                    pass
+            else:
+                self._scrub(pv_name)
 
     def _set_phase_pvc(self, pvc, phase: str) -> None:
         if pvc.phase == phase:

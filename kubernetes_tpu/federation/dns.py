@@ -1,0 +1,222 @@
+"""Federated Services + cross-cluster DNS.
+
+The federation service controller + dnsprovider analogs (reference
+federation/pkg/federation-controller/service/servicecontroller.go and
+federation/pkg/dnsprovider/dns.go):
+
+- **DNSProvider SPI**: the zone/rrset surface the reference abstracts over
+  google-clouddns/aws-route53/coredns; `FakeDNSProvider` is the in-memory
+  member of the family (the reference ships one too, for its tests).
+- **FederatedServiceController**: watches Services in the federation
+  control plane, ensures a copy in every Ready member, collects each
+  member's LoadBalancer ingress, and maintains the reference's DNS record
+  chain (service/dns.go ensureDNSRrsets):
+
+    <svc>.<ns>.<federation>.svc.<zone>                global A: all healthy
+    <svc>.<ns>.<federation>.svc.<cluster>.<zone>      per-cluster: A when
+        the member is healthy and has ingress, else CNAME falling back to
+        the global name (the reference's zone->region->global fallback,
+        collapsed one level because members are the placement unit here)
+
+  A member outage therefore FLIPS its record from A to CNAME and drops
+  its IPs from the global set — the cross-cluster failover signal DNS
+  clients follow.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+
+from kubernetes_tpu.apiserver.store import AlreadyExists, NotFound, ObjectStore
+from kubernetes_tpu.client.informer import Informer
+from kubernetes_tpu.controllers.base import ReconcileController
+from kubernetes_tpu.federation.sync import CLUSTER_LABEL
+
+log = logging.getLogger(__name__)
+
+
+class FakeDNSProvider:
+    """In-memory dnsprovider (reference dnsprovider/providers/.../fake):
+    rrsets keyed by (fqdn, type)."""
+
+    def __init__(self):
+        self.records: dict[tuple[str, str], tuple[str, ...]] = {}
+
+    def ensure(self, name: str, rrtype: str, rrdatas: list[str]) -> None:
+        """Create-or-replace one rrset (EnsureResourceRecordSet)."""
+        if rrdatas:
+            self.records[(name, rrtype)] = tuple(sorted(rrdatas))
+        else:
+            self.records.pop((name, rrtype), None)
+
+    def delete(self, name: str, rrtype: str | None = None) -> None:
+        if rrtype is not None:
+            self.records.pop((name, rrtype), None)
+            return
+        for key in [k for k in self.records if k[0] == name]:
+            self.records.pop(key, None)
+
+    def lookup(self, name: str, rrtype: str) -> tuple[str, ...]:
+        return self.records.get((name, rrtype), ())
+
+
+def service_ingress_ips(svc) -> list[str]:
+    """LoadBalancer ingress IPs of one (member) Service object."""
+    status = getattr(svc, "status", None) or {}
+    if hasattr(status, "get"):
+        lb = status.get("loadBalancer") or {}
+    else:
+        lb = getattr(status, "load_balancer", None) or {}
+    return [e.get("ip") for e in (lb.get("ingress") or []) if e.get("ip")]
+
+
+class FederatedServiceController(ReconcileController):
+    """Propagate Services to Ready members and keep the DNS chain fresh.
+
+    DNS health re-evaluates on the monitor cadence as well as on watch
+    events — a member's ingress appearing/vanishing happens in the MEMBER
+    cluster, which the federation control plane only sees by polling."""
+
+    workers = 2
+
+    def __init__(self, fed_store: ObjectStore, svc_informer: Informer,
+                 cluster_informer: Informer, client_factory,
+                 dns: FakeDNSProvider, federation_name: str = "fed",
+                 dns_zone: str = "example.com",
+                 monitor_period: float = 0.5):
+        super().__init__()
+        self.name = "federated-service-controller"
+        self.store = fed_store
+        self.services = svc_informer
+        self.clusters = cluster_informer
+        self.client_factory = client_factory
+        self.dns = dns
+        self.federation_name = federation_name
+        self.dns_zone = dns_zone
+        self.monitor_period = monitor_period
+        self._monitor_task: asyncio.Task | None = None
+        # per-cluster record names we have written, per service key — so a
+        # member UNJOINED from the federation gets its records retracted
+        # (sync only iterates current members; without this, an unjoined
+        # cluster's A record would serve its stale IP forever)
+        self._written: dict[str, set[str]] = {}
+        svc_informer.add_handler(self._on_service)
+        cluster_informer.add_handler(self._on_cluster)
+
+    def _on_service(self, event) -> None:
+        self.enqueue(event.obj.key)
+
+    def _on_cluster(self, event) -> None:
+        for svc in self.services.items():
+            self.enqueue(svc.key)
+
+    async def start(self) -> None:
+        await super().start()
+        self._monitor_task = asyncio.get_running_loop().create_task(
+            self._monitor())
+
+    def stop(self) -> None:
+        super().stop()
+        if self._monitor_task is not None:
+            self._monitor_task.cancel()
+            self._monitor_task = None
+
+    async def _monitor(self) -> None:
+        while True:
+            await asyncio.sleep(self.monitor_period)
+            for svc in self.services.items():
+                self.enqueue(svc.key)
+
+    # ---- naming (service/dns.go getResolvedEndpoints naming scheme) ----
+
+    def global_name(self, ns: str, name: str) -> str:
+        return (f"{name}.{ns}.{self.federation_name}.svc."
+                f"{self.dns_zone}")
+
+    def cluster_name(self, ns: str, name: str, cluster: str) -> str:
+        return (f"{name}.{ns}.{self.federation_name}.svc.{cluster}."
+                f"{self.dns_zone}")
+
+    # ---- reconcile ----
+
+    async def sync(self, key: str) -> None:
+        ns, name = key.split("/", 1)
+        svc = self.services.get(name, ns)
+        members = sorted(self.clusters.items(),
+                         key=lambda c: c.metadata.name)
+        if svc is None:
+            # federated service deleted: clean members + all DNS records
+            for cluster in members:
+                def delete_one(cluster=cluster):
+                    try:
+                        self.client_factory(cluster).delete(
+                            "Service", name, ns)
+                    except NotFound:
+                        pass
+                try:
+                    await asyncio.to_thread(delete_one)
+                except Exception:  # noqa: BLE001 — unreachable: retry
+                    self.enqueue_after(key, 1.0)
+            self.dns.delete(self.global_name(ns, name))
+            for cname in self._written.pop(key, set()) | {
+                    c.metadata.name for c in members}:
+                self.dns.delete(self.cluster_name(ns, name, cname))
+            return
+
+        healthy_ips: dict[str, list[str]] = {}
+        for cluster in members:
+            cname = cluster.metadata.name
+            if not cluster.ready:
+                continue
+            ips = await asyncio.to_thread(
+                self._reconcile_member, cluster, svc, ns, name)
+            if ips is None:
+                self.enqueue_after(key, 0.2)
+                ips = []
+            if ips:
+                healthy_ips[cname] = ips
+
+        all_ips = sorted({ip for ips in healthy_ips.values()
+                          for ip in ips})
+        gname = self.global_name(ns, name)
+        self.dns.ensure(gname, "A", all_ips)
+        member_names = {c.metadata.name for c in members}
+        for cname in member_names:
+            record = self.cluster_name(ns, name, cname)
+            ips = healthy_ips.get(cname)
+            if ips:
+                self.dns.ensure(record, "A", ips)
+                self.dns.delete(record, "CNAME")
+            else:
+                # unhealthy/ingress-less member: fall back to the
+                # federation-wide name (service/dns.go's CNAME chain)
+                self.dns.delete(record, "A")
+                self.dns.ensure(record, "CNAME", [gname] if all_ips else [])
+        # retract records of clusters that LEFT the federation
+        for gone in self._written.get(key, set()) - member_names:
+            self.dns.delete(self.cluster_name(ns, name, gone))
+        self._written[key] = member_names
+
+    def _reconcile_member(self, cluster, svc, ns: str,
+                          name: str) -> list[str] | None:
+        """Ensure the member's Service and return its ingress IPs (runs in
+        a worker thread; None = member unreachable, retry)."""
+        client = self.client_factory(cluster)
+        try:
+            current = client.get("Service", name, ns)
+        except NotFound:
+            copy = svc.clone()
+            copy.metadata.resource_version = ""
+            copy.metadata.labels = dict(copy.metadata.labels)
+            copy.metadata.labels[CLUSTER_LABEL] = cluster.metadata.name
+            try:
+                client.create(copy)
+            except AlreadyExists:
+                pass
+            except Exception:  # noqa: BLE001
+                return None
+            return []
+        except Exception:  # noqa: BLE001
+            return None
+        return service_ingress_ips(current)

@@ -1,0 +1,133 @@
+"""kubefed: federation bootstrap + member join/unjoin.
+
+The federation/cmd/kubefed analog (kubefed.go; init_.go deploys the
+federation control plane into a host cluster, join.go registers a member
+by creating a Cluster object + credentials secret). Here the control
+plane is an in-process store + controller set, and joining wires a
+Cluster object whose `spec.serverAddressByClientCIDRs` points at the
+member's apiserver:
+
+    python -m kubernetes_tpu.federation.kubefed join mem-1 \
+        --host-server http://fed-apiserver:8080 \
+        --cluster-server http://member-apiserver:8080
+
+`FederationControlPlane` is the library form used by tests and embedders:
+one call builds the health/sync/service-DNS controllers over a federation
+store (kubefed init's controller-manager half).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from kubernetes_tpu.api.objects import Cluster
+from kubernetes_tpu.apiserver.store import AlreadyExists, NotFound, ObjectStore
+from kubernetes_tpu.client.informer import Informer
+
+FEDERATION_NAMESPACE = "federation-system"
+
+
+def make_cluster(name: str, server_address: str = "") -> Cluster:
+    """The Cluster registry object kubefed join creates (join.go:214)."""
+    return Cluster.from_dict({
+        "metadata": {"name": name},
+        "spec": {"serverAddressByClientCIDRs": [
+            {"clientCIDR": "0.0.0.0/0",
+             "serverAddress": server_address}]},
+    })
+
+
+def join(fed_store, name: str, server_address: str = "") -> None:
+    """Register a member cluster (idempotent)."""
+    try:
+        fed_store.create(make_cluster(name, server_address))
+    except AlreadyExists:
+        pass
+
+
+def unjoin(fed_store, name: str) -> None:
+    try:
+        fed_store.delete("Cluster", name, "default")
+    except NotFound:
+        pass
+
+
+class FederationControlPlane:
+    """kubefed init's controller half over a federation store: cluster
+    health + workload sync + service DNS, one start()/stop() pair."""
+
+    def __init__(self, fed_store: ObjectStore, client_factory,
+                 dns=None, federation_name: str = "fed",
+                 dns_zone: str = "example.com",
+                 health_period: float = 1.0):
+        from kubernetes_tpu.federation.dns import (
+            FakeDNSProvider,
+            FederatedServiceController,
+        )
+        from kubernetes_tpu.federation.sync import (
+            ClusterHealthController,
+            FederatedSyncController,
+        )
+
+        self.store = fed_store
+        self.dns = dns if dns is not None else FakeDNSProvider()
+        self.clusters = Informer(fed_store, "Cluster")
+        self.workloads = Informer(fed_store, "ReplicaSet")
+        self.services = Informer(fed_store, "Service")
+        self.health = ClusterHealthController(
+            fed_store, self.clusters, client_factory,
+            monitor_period=health_period)
+        self.sync = FederatedSyncController(
+            fed_store, self.workloads, self.clusters, client_factory)
+        self.service_dns = FederatedServiceController(
+            fed_store, self.services, self.clusters, client_factory,
+            self.dns, federation_name=federation_name, dns_zone=dns_zone)
+
+    async def start(self) -> None:
+        for informer in (self.clusters, self.workloads, self.services):
+            informer.start()
+        for informer in (self.clusters, self.workloads, self.services):
+            await informer.wait_for_sync()
+        await self.health.start()
+        await self.sync.start()
+        await self.service_dns.start()
+
+    def stop(self) -> None:
+        self.service_dns.stop()
+        self.sync.stop()
+        self.health.stop()
+        for informer in (self.clusters, self.workloads, self.services):
+            informer.stop()
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="kubefed", description="federation bootstrap (join/unjoin)")
+    p.add_argument("command", choices=["join", "unjoin"])
+    p.add_argument("name")
+    p.add_argument("--host-server", required=True,
+                   help="federation control-plane apiserver URL")
+    p.add_argument("--cluster-server", default="",
+                   help="member apiserver URL (join)")
+    p.add_argument("--token", default="")
+    args = p.parse_args(argv)
+
+    from urllib.parse import urlparse
+
+    from kubernetes_tpu.apiserver.http import RemoteStore
+
+    url = urlparse(args.host_server)
+    fed = RemoteStore(url.hostname, url.port or 8080, token=args.token,
+                      tls=url.scheme == "https")
+    if args.command == "join":
+        join(fed, args.name, args.cluster_server)
+        print(f"cluster {args.name!r} joined")
+    else:
+        unjoin(fed, args.name)
+        print(f"cluster {args.name!r} unjoined")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -233,3 +233,105 @@ def test_selector_rejects_malformed_and_name_combo():
         # name + selector is rejected, like real kubectl
         rc, _ = run_cli(client, "get", "pods", "p0", "-l", "app=web")
         assert rc == 1
+
+
+# ---- round-5 breadth verbs (VERDICT r4 #10) ----
+
+
+def test_run_generators():
+    """run.go generator selection: Always -> Deployment, OnFailure -> Job,
+    Never -> Pod."""
+    with http_store() as (client, _store):
+        rc, out = run_cli(client, "run", "web", "--image", "nginx:1.13")
+        assert rc == 0 and "deployment/web created" in out
+        dep = client.get("Deployment", "web")
+        assert dep.spec["template"]["spec"]["containers"][0]["image"] \
+            == "nginx:1.13"
+        rc, out = run_cli(client, "run", "once", "--image", "busybox",
+                          "--restart", "OnFailure")
+        assert rc == 0 and "job/once created" in out
+        rc, out = run_cli(client, "run", "bare", "--image", "busybox",
+                          "--restart", "Never")
+        assert rc == 0 and "pod/bare created" in out
+        assert client.get("Pod", "bare").spec.containers[0].image \
+            == "busybox"
+
+
+def test_expose_and_autoscale():
+    with http_store() as (client, _store):
+        rc, _ = run_cli(client, "run", "api", "--image", "img",
+                        "--labels", "app=api")
+        assert rc == 0
+        rc, out = run_cli(client, "expose", "deployment", "api",
+                          "--port", "80", "--target-port", "8080")
+        assert rc == 0 and "service/api exposed" in out
+        svc = client.get("Service", "api")
+        assert svc.spec["selector"] == {"app": "api"}
+        assert svc.spec["ports"][0] == {"port": 80, "targetPort": 8080}
+        rc, out = run_cli(client, "autoscale", "deployment", "api",
+                          "--min", "2", "--max", "5")
+        assert rc == 0 and "autoscaled" in out
+        hpa = client.get("HorizontalPodAutoscaler", "api")
+        assert hpa.spec["minReplicas"] == 2
+        assert hpa.spec["maxReplicas"] == 5
+        assert hpa.spec["scaleTargetRef"]["name"] == "api"
+
+
+def test_set_image():
+    with http_store() as (client, _store):
+        run_cli(client, "run", "web", "--image", "nginx:1.13")
+        rc, out = run_cli(client, "set", "image", "deployment", "web",
+                          "web=nginx:1.14")
+        assert rc == 0 and "image updated" in out
+        dep = client.get("Deployment", "web")
+        assert dep.spec["template"]["spec"]["containers"][0]["image"] \
+            == "nginx:1.14"
+        # unknown container name errors
+        rc, _ = run_cli(client, "set", "image", "deployment", "web",
+                        "nope=img")
+        assert rc != 0
+
+
+def test_edit_roundtrip(monkeypatch):
+    """edit.go: $EDITOR mutates the buffer; the PUT lands. A sed one-liner
+    is the editor (the reference drives the same EDITOR contract)."""
+    with http_store() as (client, _store):
+        run_cli(client, "run", "bare", "--image", "busybox",
+                "--restart", "Never")
+        monkeypatch.setenv(
+            "EDITOR", "sed -i s/busybox/alpine/")
+        rc, out = run_cli(client, "edit", "pod", "bare")
+        assert rc == 0 and "edited" in out
+        assert client.get("Pod", "bare").spec.containers[0].image \
+            == "alpine"
+        # unchanged buffer = cancelled edit
+        monkeypatch.setenv("EDITOR", "true")
+        rc, out = run_cli(client, "edit", "pod", "bare")
+        assert rc == 0 and "Edit cancelled" in out
+
+
+def test_top_nodes_and_pods():
+    with http_store() as (client, _store):
+        from kubernetes_tpu.api.objects import Node
+
+        client.create(Node.from_dict({
+            "metadata": {"name": "n1"},
+            "status": {"allocatable": {"cpu": "4", "memory": "8Gi",
+                                       "pods": "110"},
+                       "conditions": [{"type": "Ready",
+                                       "status": "True"}]}}))
+        pod = mk_pod_dict("p1")
+        pod["spec"]["containers"][0]["resources"] = {
+            "requests": {"cpu": "500m", "memory": "1Gi"}}
+        pod["spec"]["nodeName"] = "n1"
+        from kubernetes_tpu.apiserver.http import decode_object
+
+        client.create(decode_object("Pod", pod))
+        rc, out = run_cli(client, "top", "nodes")
+        assert rc == 0
+        line = next(ln for ln in out.splitlines() if ln.startswith("n1"))
+        assert "0.50" in line and "12%" in line  # 0.5/4 cpu cores
+        rc, out = run_cli(client, "top", "pods")
+        assert rc == 0 and "p1" in out
+        line = next(ln for ln in out.splitlines() if ln.startswith("p1"))
+        assert "0.50" in line and "1024" in line
