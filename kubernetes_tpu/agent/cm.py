@@ -1,0 +1,85 @@
+"""Container manager: node-allocatable accounting + kubelet admission.
+
+The pkg/kubelet/cm analog at hollow fidelity: no cgroups exist, so the
+faithful model is the ACCOUNTING — which pods' requests fit inside node
+allocatable, per QoS tier. The kubelet consults it before starting a pod
+(canAdmitPod, kubelet.go:1548 + the GeneralPredicates admission check in
+lifecycle/predicate.go): a pod whose requests no longer fit (the
+scheduler raced a capacity change, or a static/mirror pod bypassed
+scheduling) is REJECTED with the reference's OutOfcpu/OutOfmemory status
+rather than silently overcommitted.
+"""
+
+from __future__ import annotations
+
+from kubernetes_tpu.agent.eviction import qos_class
+from kubernetes_tpu.api.quantity import parse_quantity
+from kubernetes_tpu.apiserver.store import NotFound
+
+
+def pod_requests(pod) -> dict[str, float]:
+    out = {"cpu": 0.0, "memory": 0.0}
+    for c in pod.spec.containers:
+        if "cpu" in c.requests:
+            out["cpu"] += parse_quantity(c.requests["cpu"])
+        if "memory" in c.requests:
+            out["memory"] += parse_quantity(c.requests["memory"])
+    return out
+
+
+class ContainerManager:
+    """Per-kubelet allocatable ledger (container_manager_linux.go's
+    NodeAllocatable view): active pods' requests, grouped by QoS tier for
+    observability, checked against node allocatable at admission."""
+
+    def __init__(self, store, node_name: str):
+        self.store = store
+        self.node_name = node_name
+        self._active: dict[str, dict[str, float]] = {}  # key -> requests
+        self._qos: dict[str, str] = {}                   # key -> class
+
+    def _allocatable(self) -> dict[str, float]:
+        try:
+            node = self.store.get("Node", self.node_name, "default")
+        except NotFound:
+            return {}
+        alloc = node.status.allocatable
+        out = {}
+        for res in ("cpu", "memory"):
+            if res in alloc:
+                out[res] = parse_quantity(str(alloc[res]))
+        return out
+
+    def admit(self, pod) -> str | None:
+        """None = admitted (and accounted); else the rejection reason
+        (OutOfcpu / OutOfmemory — kubelet.go's canAdmitPod message)."""
+        if pod.key in self._active:
+            return None  # already running here: resync, not re-admission
+        alloc = self._allocatable()
+        want = pod_requests(pod)
+        used = {"cpu": 0.0, "memory": 0.0}
+        for reqs in self._active.values():
+            used["cpu"] += reqs["cpu"]
+            used["memory"] += reqs["memory"]
+        for res in ("cpu", "memory"):
+            cap = alloc.get(res)
+            if cap is not None and used[res] + want[res] > cap:
+                return f"OutOf{res}"
+        self._active[pod.key] = want
+        self._qos[pod.key] = qos_class(pod)
+        return None
+
+    def release(self, key: str) -> None:
+        self._active.pop(key, None)
+        self._qos.pop(key, None)
+
+    def qos_usage(self) -> dict[str, dict[str, float]]:
+        """Aggregate requests per QoS tier (the cm's pod-tier cgroup
+        accounting surface, observability for tests/metrics)."""
+        out: dict[str, dict[str, float]] = {}
+        for key, reqs in self._active.items():
+            tier = out.setdefault(self._qos.get(key, "BestEffort"),
+                                  {"cpu": 0.0, "memory": 0.0})
+            tier["cpu"] += reqs["cpu"]
+            tier["memory"] += reqs["memory"]
+        return out

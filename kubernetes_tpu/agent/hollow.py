@@ -38,12 +38,14 @@ class HollowKubelet:
 
     def __init__(self, store: ObjectStore, node_name: str,
                  heartbeat_every: float = DEFAULT_HEARTBEAT,
-                 capacity: dict | None = None):
+                 capacity: dict | None = None,
+                 labels: dict | None = None):
         self.store = store
         self.node_name = node_name
         self.heartbeat_every = heartbeat_every
         self.capacity = capacity or {"cpu": "4", "memory": "8Gi",
                                      "pods": "110"}
+        self.labels = labels or {}
         self._task: asyncio.Task | None = None
         self.running = False
         # False = heartbeats report NotReady (kubelet-detected local
@@ -62,7 +64,7 @@ class HollowKubelet:
             node = Node.from_dict({
                 "metadata": {"name": self.node_name,
                              "labels": {"kubernetes.io/hostname":
-                                        self.node_name}},
+                                        self.node_name, **self.labels}},
                 "status": {"allocatable": dict(self.capacity),
                            "capacity": dict(self.capacity)}})
             try:
@@ -155,16 +157,18 @@ class HollowCluster:
     def __init__(self, store: ObjectStore, n_nodes: int = 0,
                  name_prefix: str = "hollow",
                  heartbeat_every: float = DEFAULT_HEARTBEAT,
-                 capacity: dict | None = None):
+                 capacity: dict | None = None, zones: int = 0):
         self.store = store
         self.kubelets: dict[str, HollowKubelet] = {}
         self.pod_informer = Informer(store, "Pod")
         self.pod_informer.add_handler(self._on_pod)
         for i in range(n_nodes):
             name = f"{name_prefix}-{i}"
+            labels = ({"failure-domain.beta.kubernetes.io/zone":
+                       f"zone-{i % zones}"} if zones else None)
             self.kubelets[name] = HollowKubelet(
                 store, name, heartbeat_every=heartbeat_every,
-                capacity=capacity)
+                capacity=capacity, labels=labels)
 
     def add(self, kubelet: HollowKubelet) -> None:
         self.kubelets[kubelet.node_name] = kubelet

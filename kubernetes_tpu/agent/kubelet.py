@@ -149,7 +149,7 @@ class Kubelet(HollowKubelet):
     def __init__(self, store: ObjectStore, node_name: str,
                  runtime: FakeRuntime | None = None,
                  volume_manager=None, serve_api: bool = False,
-                 eviction=None, **kw):
+                 eviction=None, config_dir: str | None = None, **kw):
         super().__init__(store, node_name, **kw)
         from kubernetes_tpu.agent.volumes import VolumeManager
 
@@ -162,6 +162,18 @@ class Kubelet(HollowKubelet):
         if eviction is not None and eviction.runtime is None:
             eviction.runtime = self.runtime
         self._eviction_task: asyncio.Task | None = None
+        # dynamic kubelet config (agent/kubeletconfig.py): a checkpoint
+        # dir enables the Node.spec.configSource sync loop
+        self.config_sync = None
+        self._config_task: asyncio.Task | None = None
+        if config_dir is not None:
+            from kubernetes_tpu.agent.kubeletconfig import ConfigSync
+
+            self.config_sync = ConfigSync(self, config_dir)
+        # allocatable accounting + kubelet-side admission (agent/cm.py)
+        from kubernetes_tpu.agent.cm import ContainerManager
+
+        self.cm = ContainerManager(store, node_name)
         self.serve_api = serve_api
         self.server = None  # KubeletServer when serve_api
         self._workers: dict[str, asyncio.Queue] = {}
@@ -187,6 +199,7 @@ class Kubelet(HollowKubelet):
             self._stop_worker(pod.key)
             self.runtime.purge(pod.key)
             self.volumes.unmount_pod(pod.key)
+            self.cm.release(pod.key)
             self._reported.pop(pod.key, None)
             self._forget_probes(pod.key)
             return
@@ -234,11 +247,24 @@ class Kubelet(HollowKubelet):
                 log.exception("syncPod(%s) failed", key)
 
     def _sync_pod(self, pod: Pod) -> None:
-        """syncPod (kubelet.go:1390): volumes first (WaitForAttachAndMount,
-        kubelet.go:1447), then the runtime, then report status."""
+        """syncPod (kubelet.go:1390): kubelet admission first (canAdmitPod
+        — allocatable accounting, agent/cm.py), then volumes
+        (WaitForAttachAndMount, kubelet.go:1447), then the runtime, then
+        report status."""
         if pod.status.phase in ("Succeeded", "Failed"):
             return
         if pod.key not in self.runtime:
+            reason = self.cm.admit(pod)
+            if reason is not None:
+                # the reference rejects with status Failed reason OutOf*
+                # (kubelet.go rejectPod) — the controller recreates, the
+                # scheduler places the replacement elsewhere
+                self._set_status(pod.key, "Failed", ready=False,
+                                 reason=reason)
+                self._stop_worker(pod.key)
+                log.warning("kubelet %s: rejected %s: %s",
+                            self.node_name, pod.key, reason)
+                return
             self.volumes.mount_pod(pod)
         self.runtime.sync_pod(pod)
         self._active[pod.key] = pod
@@ -250,7 +276,7 @@ class Kubelet(HollowKubelet):
 
     def _set_status(self, key: str, phase: str,
                     ready: bool | None = None,
-                    exit_code: int = 0) -> None:
+                    exit_code: int = 0, reason: str = "") -> None:
         """ready: the prober's readiness verdict (None = derive from the
         phase, the pre-prober behavior for probe-less pods)."""
         if ready is None:
@@ -267,6 +293,8 @@ class Kubelet(HollowKubelet):
         if fresh.spec.node_name != self.node_name:
             return
         fresh.status.phase = phase
+        if reason:
+            fresh.status.reason = reason
         ready_s = "True" if (ready and phase == "Running") else "False"
         fresh.status.conditions = [
             {"type": "Ready", "status": ready_s,
@@ -390,6 +418,7 @@ class Kubelet(HollowKubelet):
                     self._stop_worker(key)
                     self.runtime.kill_pod(key)
                     self.volumes.unmount_pod(key)
+                    self.cm.release(key)
                     self._forget_probes(key)
 
     # ---- lifecycle ----
@@ -406,6 +435,7 @@ class Kubelet(HollowKubelet):
                 evicted = self.eviction.synchronize()
                 if evicted:
                     self._stop_worker(evicted)
+                    self.cm.release(evicted)
                     self._forget_probes(evicted)
             except Exception:  # noqa: BLE001 — the loop must survive
                 log.exception("eviction synchronize failed")
@@ -419,6 +449,19 @@ class Kubelet(HollowKubelet):
         if self.eviction is not None:
             self._eviction_task = asyncio.get_running_loop().create_task(
                 self._eviction_loop())
+        if self.config_sync is not None:
+            async def config_loop():
+                while True:
+                    await asyncio.sleep(self.EVICTION_PERIOD)
+                    if not self.running:
+                        return
+                    try:
+                        self.config_sync.sync()
+                    except Exception:  # noqa: BLE001 — survive bad cfg
+                        log.exception("kubelet config sync failed")
+
+            self._config_task = asyncio.get_running_loop().create_task(
+                config_loop())
         if self.serve_api:
             from kubernetes_tpu.agent.server import KubeletServer
 
@@ -454,6 +497,9 @@ class Kubelet(HollowKubelet):
         if self._eviction_task is not None:
             self._eviction_task.cancel()
             self._eviction_task = None
+        if self._config_task is not None:
+            self._config_task.cancel()
+            self._config_task = None
         if self.server is not None:
             self.server.close()
             self.server = None

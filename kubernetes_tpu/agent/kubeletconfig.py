@@ -1,0 +1,195 @@
+"""Dynamic kubelet configuration: ConfigMap-sourced config with
+checkpoint + last-known-good rollback.
+
+The pkg/kubelet/kubeletconfig analog (controller.go: watch
+Node.spec.configSource, download the named ConfigMap, checkpoint it on
+local disk, apply on the next sync; a config that fails validation rolls
+back to the last-known-good checkpoint and reports the failure through
+the node's KubeletConfigOk condition — status.go:71).
+
+Applied fields at hollow fidelity (the knobs this kubelet actually has):
+``heartbeatIntervalSeconds``, ``evictionHard`` (``memory.available`` /
+``nodefs.available`` Mi thresholds), ``plegPeriodSeconds``. The config
+payload lives under the ConfigMap's ``kubelet`` key as JSON, mirroring
+the reference's kubelet.config.k8s.io serialization seam.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import time
+
+from kubernetes_tpu.api.objects import NodeCondition
+from kubernetes_tpu.apiserver.store import Conflict, NotFound
+
+log = logging.getLogger(__name__)
+
+CONFIG_OK_CONDITION = "KubeletConfigOk"
+ALLOWED_KEYS = {"heartbeatIntervalSeconds", "evictionHard",
+                "plegPeriodSeconds"}
+
+
+def validate_config(cfg: dict) -> str | None:
+    """None when valid, else the rejection reason (the reference's
+    kubeletconfig validation gate before a config may be adopted)."""
+    if not isinstance(cfg, dict):
+        return "config payload is not an object"
+    unknown = set(cfg) - ALLOWED_KEYS
+    if unknown:
+        return f"unknown config keys: {sorted(unknown)}"
+    hb = cfg.get("heartbeatIntervalSeconds")
+    if hb is not None and (not isinstance(hb, (int, float)) or hb <= 0):
+        return "heartbeatIntervalSeconds must be > 0"
+    pleg = cfg.get("plegPeriodSeconds")
+    if pleg is not None and (not isinstance(pleg, (int, float))
+                             or pleg <= 0):
+        return "plegPeriodSeconds must be > 0"
+    ev = cfg.get("evictionHard")
+    if ev is not None:
+        if not isinstance(ev, dict):
+            return "evictionHard must be an object"
+        for key, value in ev.items():
+            if key not in ("memory.available", "nodefs.available"):
+                return f"unknown eviction signal {key!r}"
+            if not isinstance(value, (int, float)) or value < 0:
+                return f"evictionHard[{key!r}] must be >= 0"
+    return None
+
+
+class ConfigSync:
+    """One kubelet's dynamic-config loop state (kubeletconfig's
+    Controller). `sync()` runs on the kubelet's heartbeat cadence."""
+
+    def __init__(self, kubelet, checkpoint_dir: str):
+        self.kubelet = kubelet
+        self.checkpoint_dir = checkpoint_dir
+        os.makedirs(checkpoint_dir, exist_ok=True)
+        self._last_applied_uid = ""
+        self._load_checkpoints()
+
+    # ---- checkpoint store (kubeletconfig/checkpoint/store) ----
+
+    def _path(self, which: str) -> str:
+        return os.path.join(self.checkpoint_dir,
+                            f"{self.kubelet.node_name}-{which}.json")
+
+    def _load_checkpoints(self) -> None:
+        """Resume after restart: re-apply the current checkpoint (or the
+        last-known-good) before the first watch delivery."""
+        for which in ("current", "last-known-good"):
+            try:
+                with open(self._path(which)) as f:
+                    doc = json.load(f)
+            except (OSError, ValueError):
+                continue
+            if validate_config(doc.get("config", {})) is None:
+                self._apply(doc["config"])
+                self._last_applied_uid = doc.get("uid", "")
+                return
+
+    def _checkpoint(self, which: str, uid: str, cfg: dict) -> None:
+        with open(self._path(which), "w") as f:
+            json.dump({"uid": uid, "config": cfg}, f)
+
+    # ---- the sync pass ----
+
+    def sync(self) -> None:
+        store = self.kubelet.store
+        try:
+            node = store.get("Node", self.kubelet.node_name, "default")
+        except NotFound:
+            return
+        source = (node.spec.config_source or {}).get("configMap")
+        if not source:
+            return
+        try:
+            cm = store.get("ConfigMap", source.get("name", ""),
+                           source.get("namespace", "default"))
+        except NotFound:
+            self._set_condition(False, "ConfigMapNotFound",
+                                f"configmap {source} not found")
+            return
+        uid = f"{cm.metadata.uid}/{cm.metadata.resource_version}"
+        if uid == self._last_applied_uid:
+            return
+        try:
+            cfg = json.loads((cm.data or {}).get("kubelet", "{}"))
+            reason = validate_config(cfg)
+        except ValueError:
+            reason = "config payload is not valid JSON"
+            cfg = None
+        if reason is not None:
+            # bad config: ROLL BACK to last-known-good (status.go's
+            # lkg path) and report through the condition
+            log.warning("kubelet %s: rejecting config %s: %s",
+                        self.kubelet.node_name, uid, reason)
+            self._last_applied_uid = uid  # don't re-try a bad payload
+            rolled = self._rollback()
+            self._set_condition(
+                False, "FailedValidation",
+                f"{reason}; "
+                + ("rolled back to last-known-good" if rolled
+                   else "keeping built-in defaults"))
+            return
+        self._apply(cfg)
+        self._checkpoint("current", uid, cfg)
+        self._checkpoint("last-known-good", uid, cfg)
+        self._last_applied_uid = uid
+        self._set_condition(True, "KubeletConfigOk",
+                            f"using config {source.get('name')}")
+
+    def _rollback(self) -> bool:
+        try:
+            with open(self._path("last-known-good")) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            return False
+        if validate_config(doc.get("config", {})) is not None:
+            return False
+        self._apply(doc["config"])
+        return True
+
+    def _apply(self, cfg: dict) -> None:
+        kubelet = self.kubelet
+        if "heartbeatIntervalSeconds" in cfg:
+            kubelet.heartbeat_every = float(
+                cfg["heartbeatIntervalSeconds"])
+        if "plegPeriodSeconds" in cfg:
+            kubelet.PLEG_PERIOD = float(cfg["plegPeriodSeconds"])
+        ev = cfg.get("evictionHard")
+        if ev and getattr(kubelet, "eviction", None) is not None:
+            if "memory.available" in ev:
+                kubelet.eviction.memory_available_mib = float(
+                    ev["memory.available"])
+            if "nodefs.available" in ev:
+                kubelet.eviction.disk_available_mib = float(
+                    ev["nodefs.available"])
+
+    def _set_condition(self, ok: bool, reason: str, message: str) -> None:
+        want = "True" if ok else "False"
+        now = time.time()
+
+        def mutate(node):
+            existing = None
+            for c in node.status.conditions:
+                if c.type == CONFIG_OK_CONDITION:
+                    existing = c
+            if existing is None:
+                existing = NodeCondition(type=CONFIG_OK_CONDITION,
+                                         status="")
+                node.status.conditions.append(existing)
+            if existing.status != want:
+                existing.last_transition_time = now
+            existing.status = want
+            existing.reason = reason
+            existing.message = message
+            existing.last_heartbeat_time = now
+            return node
+
+        try:
+            self.kubelet.store.guaranteed_update(
+                "Node", self.kubelet.node_name, "default", mutate)
+        except (Conflict, NotFound):
+            pass

@@ -170,11 +170,73 @@ class PVCPlugin:
                      data={"pv": pvc.volume_name})
 
 
+class CloudDiskPlugin:
+    """The attachable-cloud family (pkg/volume/gce_pd, aws_ebs,
+    azure_dd): an inline cloud-disk volume must ATTACH to this instance
+    through the cloud provider before it mounts (attacher.go Attach +
+    WaitForAttach collapsed to the synchronous fake). Single-writer
+    semantics ride the cloud: a disk attached read-write elsewhere fails
+    the mount, and the reconciler retries until it detaches."""
+
+    source_key = ""     # pod-spec volume source field
+    disk_field = ""     # the disk-name field inside the source
+
+    def __init__(self, cloud):
+        self.cloud = cloud
+
+    def supports(self, vol: dict) -> bool:
+        return self.source_key in vol
+
+    def mount(self, pod: Pod, vol: dict, node_name: str) -> Mount:
+        src = vol[self.source_key] or {}
+        disk = src.get(self.disk_field, "")
+        if not disk:
+            raise MountError(f"{self.source_key} volume "
+                             f"{vol.get('name')!r} names no disk")
+        if self.cloud is None:
+            raise MountError(
+                f"{self.source_key}: no cloud provider configured")
+        try:
+            self.cloud.attach_disk(disk, node_name,
+                                   read_only=bool(src.get("readOnly")))
+        except RuntimeError as e:
+            raise MountError(str(e)) from None
+        return Mount(vol["name"], self.source_key,
+                     f"/var/lib/kubelet/pods/{pod.metadata.uid}/volumes/"
+                     f"{self.source_key}/{disk}",
+                     data={"disk": disk})
+
+    def unmount(self, mount: Mount, node_name: str) -> None:
+        # release the single-writer lock so a rescheduled pod can attach
+        # the disk on its new node (detacher.go Detach)
+        self.cloud.detach_disk(mount.data.get("disk", ""), node_name)
+
+
+class GCEPersistentDiskPlugin(CloudDiskPlugin):
+    name = source_key = "gcePersistentDisk"
+    disk_field = "pdName"
+
+
+class AWSElasticBlockStorePlugin(CloudDiskPlugin):
+    name = source_key = "awsElasticBlockStore"
+    disk_field = "volumeID"
+
+
+class AzureDiskPlugin(CloudDiskPlugin):
+    name = source_key = "azureDisk"
+    disk_field = "diskName"
+
+
 def default_plugins(store: ObjectStore,
-                    require_attach: bool = True) -> list:
-    return [EmptyDirPlugin(), HostPathPlugin(), SecretPlugin(store),
-            ConfigMapPlugin(store), DownwardAPIPlugin(),
-            PVCPlugin(store, require_attach=require_attach)]
+                    require_attach: bool = True, cloud=None) -> list:
+    plugins = [EmptyDirPlugin(), HostPathPlugin(), SecretPlugin(store),
+               ConfigMapPlugin(store), DownwardAPIPlugin(),
+               PVCPlugin(store, require_attach=require_attach)]
+    if cloud is not None:
+        plugins += [GCEPersistentDiskPlugin(cloud),
+                    AWSElasticBlockStorePlugin(cloud),
+                    AzureDiskPlugin(cloud)]
+    return plugins
 
 
 class VolumeManager:
@@ -183,10 +245,11 @@ class VolumeManager:
     mounts over fakes)."""
 
     def __init__(self, store: ObjectStore, node_name: str,
-                 plugins: list | None = None, require_attach: bool = True):
+                 plugins: list | None = None, require_attach: bool = True,
+                 cloud=None):
         self.node_name = node_name
         self.plugins = plugins if plugins is not None else default_plugins(
-            store, require_attach=require_attach)
+            store, require_attach=require_attach, cloud=cloud)
         self._mounts: dict[str, list[Mount]] = {}  # pod key -> mounts
 
     def _plugin_for(self, vol: dict):
@@ -210,7 +273,11 @@ class VolumeManager:
         return mounts
 
     def unmount_pod(self, pod_key: str) -> None:
-        self._mounts.pop(pod_key, None)
+        for mount in self._mounts.pop(pod_key, ()):
+            plugin = next((p for p in self.plugins
+                           if getattr(p, "name", "") == mount.plugin), None)
+            if plugin is not None and hasattr(plugin, "unmount"):
+                plugin.unmount(mount, self.node_name)
 
     def mounts(self, pod_key: str) -> list[Mount]:
         return list(self._mounts.get(pod_key, ()))

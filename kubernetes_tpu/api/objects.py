@@ -456,13 +456,15 @@ class NodeCondition:
     last_heartbeat_time: float = 0.0
     last_transition_time: float = 0.0
     reason: str = ""
+    message: str = ""
 
     @classmethod
     def from_dict(cls, d: dict[str, Any]) -> "NodeCondition":
         return cls(type=d.get("type", ""), status=d.get("status", "Unknown"),
                    last_heartbeat_time=_cond_time(d.get("lastHeartbeatTime")),
                    last_transition_time=_cond_time(d.get("lastTransitionTime")),
-                   reason=d.get("reason", "") or "")
+                   reason=d.get("reason", "") or "",
+                   message=d.get("message", "") or "")
 
     def to_dict(self) -> dict[str, Any]:
         # wire format is RFC3339 (metav1.Time) so a stock Go control plane
@@ -474,6 +476,8 @@ class NodeCondition:
             out["lastTransitionTime"] = _rfc3339(self.last_transition_time)
         if self.reason:
             out["reason"] = self.reason
+        if self.message:
+            out["message"] = self.message
         return out
 
 
@@ -485,6 +489,9 @@ class NodeSpec:
     # per-node pod subnet (v1.NodeSpec PodCIDR; the route controller
     # programs a cloud route per CIDR)
     pod_cidr: str = ""
+    # dynamic kubelet config (alpha v1.NodeSpec.ConfigSource,
+    # pkg/kubelet/kubeletconfig): {"configMap": {"name", "namespace"}}
+    config_source: dict[str, Any] | None = None
 
     @classmethod
     def from_dict(cls, d: dict[str, Any]) -> "NodeSpec":
@@ -493,6 +500,7 @@ class NodeSpec:
             taints=[Taint.from_dict(t) for t in d.get("taints") or []],
             provider_id=d.get("providerID", "") or "",
             pod_cidr=d.get("podCIDR", "") or "",
+            config_source=copy.deepcopy(d.get("configSource")),
         )
 
     def to_dict(self) -> dict[str, Any]:
@@ -505,6 +513,8 @@ class NodeSpec:
             out["providerID"] = self.provider_id
         if self.pod_cidr:
             out["podCIDR"] = self.pod_cidr
+        if self.config_source is not None:
+            out["configSource"] = copy.deepcopy(self.config_source)
         return out
 
 
@@ -579,7 +589,9 @@ class Node:
                           taints=[Taint(t.key, t.value, t.effect)
                                   for t in self.spec.taints],
                           provider_id=self.spec.provider_id,
-                          pod_cidr=self.spec.pod_cidr),
+                          pod_cidr=self.spec.pod_cidr,
+                          config_source=copy.deepcopy(
+                              self.spec.config_source)),
             status=NodeStatus(capacity=dict(self.status.capacity),
                               allocatable=dict(self.status.allocatable),
                               conditions=[

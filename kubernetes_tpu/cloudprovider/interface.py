@@ -39,6 +39,18 @@ class CloudProvider:
         """(failure domain, region)."""
         raise NotImplementedError
 
+    # -- Disks (the Attacher/Detacher seam the attachable volume plugin
+    # family consumes — gce_pd/attacher.go, aws_ebs/attacher.go) --
+    def attach_disk(self, disk_name: str, node_name: str,
+                    read_only: bool = False) -> None:
+        raise NotImplementedError
+
+    def detach_disk(self, disk_name: str, node_name: str) -> None:
+        raise NotImplementedError
+
+    def disk_attached_to(self, disk_name: str) -> str | None:
+        raise NotImplementedError
+
     # -- Routes (cloud.go Routes interface; route controller consumer) --
     def list_routes(self) -> dict[str, str]:
         """node name -> destination CIDR."""
@@ -61,6 +73,7 @@ class FakeCloud(CloudProvider):
     instances: set = field(default_factory=set)
     zone: tuple[str, str] = ("fake-zone-a", "fake-region")
     routes: dict[str, str] = field(default_factory=dict)
+    disk_attachments: dict[str, str] = field(default_factory=dict)
     calls: list[str] = field(default_factory=list)
     _ip_counter: itertools.count = field(
         default_factory=lambda: itertools.count(1))
@@ -89,6 +102,26 @@ class FakeCloud(CloudProvider):
 
     def get_zone(self, node_name: str) -> tuple[str, str]:
         return self.zone
+
+    def attach_disk(self, disk_name: str, node_name: str,
+                    read_only: bool = False) -> None:
+        """Single-writer semantics (a PD/EBS disk attaches to one instance
+        unless read-only): attaching elsewhere raises, exactly the cloud
+        error the reference's attacher surfaces and retries."""
+        self.calls.append(f"attach:{disk_name}@{node_name}")
+        current = self.disk_attachments.get(disk_name)
+        if current and current != node_name and not read_only:
+            raise RuntimeError(
+                f"disk {disk_name!r} is attached to {current!r}")
+        self.disk_attachments[disk_name] = node_name
+
+    def detach_disk(self, disk_name: str, node_name: str) -> None:
+        self.calls.append(f"detach:{disk_name}@{node_name}")
+        if self.disk_attachments.get(disk_name) == node_name:
+            del self.disk_attachments[disk_name]
+
+    def disk_attached_to(self, disk_name: str) -> str | None:
+        return self.disk_attachments.get(disk_name)
 
     def list_routes(self) -> dict[str, str]:
         return dict(self.routes)

@@ -22,6 +22,7 @@ class ControllerManager:
     def __init__(self, store: ObjectStore, enable_gc: bool = True,
                  enable_node_lifecycle: bool = True,
                  node_lifecycle_kwargs: dict | None = None,
+                 node_ipam_kwargs: dict | None = None,
                  cloud=None, hpa_metrics=None,
                  podgc_threshold: int | None = None):
         self.store = store
@@ -127,7 +128,8 @@ class ControllerManager:
             RouteController,
         )
 
-        self.node_ipam = NodeIpamController(store, self.informers["Node"])
+        self.node_ipam = NodeIpamController(store, self.informers["Node"],
+                                            **(node_ipam_kwargs or {}))
         self.controllers.append(self.node_ipam)
         from kubernetes_tpu.controllers.certificates import CSRController
 

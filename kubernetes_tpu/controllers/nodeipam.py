@@ -37,12 +37,21 @@ class NodeIpamController(ReconcileController):
             new_prefix=node_mask)]
         self._assigned: dict[str, str] = {}  # node -> cidr
         self._starved: set[str] = set()  # waiting on pool exhaustion
+        # allocation is a monotonic pointer + a free stack (a linear scan
+        # of the subnet list per allocation is O(N^2) across a large
+        # cluster's startup); adopted CIDRs are skipped at hand-out time
+        self._next = 0
+        self._free: list[str] = []
+        self._starved_logged = False
         node_informer.add_handler(self._on_node)
 
     def _on_node(self, event) -> None:
         name = event.obj.metadata.name
         if event.type == "DELETED":
-            self._assigned.pop(name, None)  # cidr returns to the pool
+            freed = self._assigned.pop(name, None)
+            if freed is not None:
+                self._free.append(freed)  # cidr returns to the pool
+                self._starved_logged = False
             self._starved.discard(name)  # a dead node stops waiting
             # a freed subnet may unblock a node starved at exhaustion
             for starved in list(self._starved):
@@ -55,6 +64,18 @@ class NodeIpamController(ReconcileController):
             # replays every node) so the pool doesn't double-allocate
             self._assigned.setdefault(name, event.obj.spec.pod_cidr)
 
+    def _alloc(self, in_use: set[str]) -> str | None:
+        while self._free:
+            s = self._free.pop()
+            if s not in in_use:
+                return s
+        while self._next < len(self._subnets):
+            s = self._subnets[self._next]
+            self._next += 1
+            if s not in in_use:  # adopted by a restarted node: skip
+                return s
+        return None
+
     async def sync(self, key: str) -> None:
         if key in self._assigned:
             return  # already allocated; a stale-cache re-run must not
@@ -63,10 +84,12 @@ class NodeIpamController(ReconcileController):
         if node is None or node.spec.pod_cidr:
             return
         in_use = set(self._assigned.values())
-        cidr = next((s for s in self._subnets if s not in in_use), None)
+        cidr = self._alloc(in_use)
         if cidr is None:
-            log.error("node-ipam: cluster CIDR exhausted at %d nodes",
-                      len(in_use))
+            if not self._starved_logged:
+                log.error("node-ipam: cluster CIDR exhausted at %d nodes",
+                          len(in_use))
+                self._starved_logged = True
             self._starved.add(key)  # re-enqueued when a node frees one
             return
         self._starved.discard(key)

@@ -171,31 +171,49 @@ class RecoveryResult:
     pods: int
     stranded: int
     seconds_to_recover: float
+    # zone-disruption observability (node_controller.go handleDisruption):
+    # the killed zone's state observed DURING the outage, and after
+    zone_state_during: str = ""
+    zone_state_after: str = ""
 
     def __str__(self) -> str:
         return (f"killed {self.killed}/{self.nodes} nodes ({self.stranded} "
                 f"stranded pods): all {self.pods} pods Running on live "
-                f"nodes in {self.seconds_to_recover:.2f}s")
+                f"nodes in {self.seconds_to_recover:.2f}s "
+                f"(killed zone {self.zone_state_during or '?'} -> "
+                f"{self.zone_state_after or '?'})")
 
 
 async def _run_recovery(n_nodes: int, n_pods: int,
                         kill_frac: float) -> RecoveryResult:
-    """Chaos mode: hollow cluster under RS load, kill a node fraction, and
-    measure wall time until every pod is Running on a live node again (the
-    kubemark-style failure drill — node lifecycle controller detects, evicts;
-    ReplicaSet recreates; scheduler re-places; hollow kubelets ack)."""
+    """Chaos mode: hollow cluster under RS load, kill a node fraction
+    CONCENTRATED IN ONE ZONE (so the kill crosses the unhealthy-zone
+    threshold and the per-zone disruption machinery engages), and measure
+    wall time until every pod is Running on a live node again (the
+    kubemark-style failure drill — node lifecycle controller detects,
+    evicts; ReplicaSet recreates; scheduler re-places; hollow kubelets
+    ack). Heartbeat cadence scales with cluster size so a 5k+-node drill
+    does not melt the host plane under heartbeat writes alone."""
     from kubernetes_tpu.agent.hollow import HollowCluster
     from kubernetes_tpu.api.objects import ReplicaSet
     from kubernetes_tpu.controllers import ControllerManager
 
+    heartbeat = max(0.5, n_nodes / 2000.0)
+    grace = max(1.5, 2.5 * heartbeat)
     store = ObjectStore(watch_window=max(1 << 18, 16 * (n_pods + n_nodes)))
-    cluster = HollowCluster(store, n_nodes=n_nodes, heartbeat_every=0.5,
+    cluster = HollowCluster(store, n_nodes=n_nodes,
+                            heartbeat_every=heartbeat, zones=3,
                             capacity={"cpu": "32", "memory": "64Gi",
                                       "pods": "110"})
     await cluster.start()
-    mgr = ControllerManager(store, node_lifecycle_kwargs=dict(
-        monitor_period=0.2, grace_period=1.5, eviction_timeout=0.5,
-        eviction_rate=1e9))
+    mgr = ControllerManager(
+        store,
+        node_lifecycle_kwargs=dict(
+            monitor_period=0.2, grace_period=grace, eviction_timeout=0.5,
+            eviction_rate=1e9, secondary_eviction_rate=1e9),
+        # /10 cut into /24s covers 16k hollow nodes (the default /16's
+        # 256 starves a headline-scale drill)
+        node_ipam_kwargs=dict(cluster_cidr="10.0.0.0/10"))
     await mgr.start()
     num = 1 << max(6, (n_nodes - 1).bit_length())
     sched = Scheduler(store, caps=Capacities(
@@ -225,22 +243,40 @@ async def _run_recovery(n_nodes: int, n_pods: int,
     by_node: dict[str, int] = {}
     for p in store.list("Pod", copy_objects=False):
         by_node[p.spec.node_name] = by_node.get(p.spec.node_name, 0) + 1
-    victims = sorted(by_node, key=by_node.get, reverse=True)[
-        :max(1, int(kill_frac * n_nodes))]
-    stranded = sum(by_node[v] for v in victims)
+    # victims all come from zone-0 (node i is in zone i%3): killing
+    # kill_frac of the CLUSTER takes 3*kill_frac of the zone — at the
+    # default 10% that is 30%... so take 60% of zone-0 or the requested
+    # cluster fraction, whichever is larger, to cross the 55% unhealthy
+    # threshold and flip the zone's disruption state
+    zone0 = [k.node_name for k in cluster.kubelets.values()
+             if k.labels.get("failure-domain.beta.kubernetes.io/zone")
+             == "zone-0"]
+    n_kill = max(max(1, int(kill_frac * n_nodes)),
+                 int(0.6 * len(zone0)))
+    n_kill = min(n_kill, len(zone0))
+    victims = sorted(zone0, key=lambda n: by_node.get(n, 0),
+                     reverse=True)[:n_kill]
+    stranded = sum(by_node.get(v, 0) for v in victims)
     t0 = time.perf_counter()
     cluster.stop(victims)
     dead = frozenset(victims)
-    async with asyncio.timeout(120):
+    zone_during = ""
+    async with asyncio.timeout(600):
         while running_off(dead) < n_pods:
+            state = mgr.node_lifecycle.zone_states.get("zone-0", "")
+            if state and state != "Normal":
+                zone_during = state  # disruption machinery engaged
             await asyncio.sleep(0.1)
     seconds = time.perf_counter() - t0
+    zone_after = mgr.node_lifecycle.zone_states.get("zone-0", "")
     sched.stop()
     driver.cancel()
     mgr.stop()
     cluster.stop()
     return RecoveryResult(nodes=n_nodes, killed=len(victims), pods=n_pods,
-                          stranded=stranded, seconds_to_recover=seconds)
+                          stranded=stranded, seconds_to_recover=seconds,
+                          zone_state_during=zone_during,
+                          zone_state_after=zone_after)
 
 
 def run_recovery(n_nodes: int = 200, n_pods: int = 600,
