@@ -19,6 +19,10 @@ vs_baseline = headline value / 30.
 Prints exactly ONE JSON line on stdout (headline metric + per-config
 extras). Diagnostics go to stderr. Env overrides: BENCH_NODES, BENCH_PODS,
 BENCH_TIMEOUT_S, BENCH_CONFIGS (comma list of headline,interpod,spread).
+
+--metrics-snapshot (or BENCH_METRICS_SNAPSHOT=1) embeds the scheduler's
+per-phase registry histograms (encode/flush/dispatch/solve/bind/commit:
+count, sum_ms, p50_ms, p99_ms) in extras for each throughput config.
 """
 
 import faulthandler
@@ -52,6 +56,8 @@ def main() -> None:
     configs = os.environ.get("BENCH_CONFIGS",
                              "headline,interpod,spread,recovery,device")
     configs = [c.strip() for c in configs.split(",") if c.strip()]
+    metrics_snapshot = "--metrics-snapshot" in sys.argv[1:] or \
+        os.environ.get("BENCH_METRICS_SNAPSHOT", "") in ("1", "true")
 
     import jax
 
@@ -74,6 +80,8 @@ def main() -> None:
         extras["headline_e2e_p99_ms"] = round(r.metrics["e2e_p99_ms"], 1)
         if "phase_us_per_pod" in r.metrics:
             extras["headline_phase_us_per_pod"] = r.metrics["phase_us_per_pod"]
+        if metrics_snapshot:
+            extras["headline_phase_hist"] = r.phase_hist
 
     if "interpod" in configs:
         interpod_nodes = min(n_nodes, 5000)
@@ -86,6 +94,8 @@ def main() -> None:
               flush=True)
         extras["interpod_5k_pods_per_sec"] = round(r.pods_per_sec, 1)
         extras["interpod_vs_baseline"] = round(r.pods_per_sec / baseline, 2)
+        if metrics_snapshot:
+            extras["interpod_phase_hist"] = r.phase_hist
 
     if "spread" in configs:
         r = run_throughput(
@@ -100,6 +110,8 @@ def main() -> None:
         extras["spread_e2e_p50_ms"] = round(r.metrics["e2e_p50_ms"], 1)
         if "phase_us_per_pod" in r.metrics:
             extras["spread_phase_us_per_pod"] = r.metrics["phase_us_per_pod"]
+        if metrics_snapshot:
+            extras["spread_phase_hist"] = r.phase_hist
 
     if "recovery" in configs:
         from kubernetes_tpu.perf.harness import run_recovery

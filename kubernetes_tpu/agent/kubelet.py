@@ -38,6 +38,29 @@ from kubernetes_tpu.client.informer import Informer
 
 log = logging.getLogger(__name__)
 
+_kubelet_mx: tuple | None = None
+
+
+def _kubelet_metrics() -> tuple:
+    """(sync_pod_duration, pleg_relist_duration) histograms — the
+    kubelet's sync-loop metrics (pkg/kubelet/metrics), unlabeled: one
+    per-process pair, not per-pod (a hollow fleet runs thousands)."""
+    global _kubelet_mx
+    if _kubelet_mx is None:
+        from kubernetes_tpu.obs import metrics as m
+
+        buckets = m.exponential_buckets(1e-5, 4.0, 10)
+        _kubelet_mx = (
+            m.REGISTRY.histogram("kubelet_sync_pod_duration_seconds",
+                                 "Duration of one syncPod pass.",
+                                 buckets=buckets),
+            m.REGISTRY.histogram("kubelet_pleg_relist_duration_seconds",
+                                 "Duration of one PLEG relist pass.",
+                                 buckets=buckets),
+        )
+    return _kubelet_mx
+
+
 RUN_SECONDS_ANNOTATION = "kubernetes-tpu/run-seconds"
 EXIT_CODE_ANNOTATION = "kubernetes-tpu/exit-code"
 # fake-runtime probe answers (the scripted half of probing; exec probes run
@@ -234,6 +257,7 @@ class Kubelet(HollowKubelet):
             # always sync against the latest spec (UpdatePod :198)
             while not queue.empty():
                 pod = queue.get_nowait()
+            t0 = time.perf_counter()
             try:
                 self._sync_pod(pod)
             except MountError as e:
@@ -245,6 +269,8 @@ class Kubelet(HollowKubelet):
                 loop.call_later(self.MOUNT_RETRY, queue.put_nowait, pod)
             except Exception:  # noqa: BLE001 — a worker must not die
                 log.exception("syncPod(%s) failed", key)
+            finally:
+                _kubelet_metrics()[0].observe(time.perf_counter() - t0)
 
     def _sync_pod(self, pod: Pod) -> None:
         """syncPod (kubelet.go:1390): kubelet admission first (canAdmitPod
@@ -407,6 +433,7 @@ class Kubelet(HollowKubelet):
             await asyncio.sleep(self.PLEG_PERIOD)
             if not self.running:
                 return
+            t0 = time.perf_counter()
             for key, entry in self.runtime.list_pods().items():
                 reported_phase = (self._reported.get(key) or (None,))[0]
                 if entry["state"] == "exited" \
@@ -420,6 +447,7 @@ class Kubelet(HollowKubelet):
                     self.volumes.unmount_pod(key)
                     self.cm.release(key)
                     self._forget_probes(key)
+            _kubelet_metrics()[1].observe(time.perf_counter() - t0)
 
     # ---- lifecycle ----
 

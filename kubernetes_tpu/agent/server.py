@@ -86,10 +86,19 @@ class KubeletServer:
 
     async def _route(self, writer, method: str, path: str,
                      query: dict) -> None:
-        parts = [p for p in path.strip("/").split("/") if p]
-        if parts == ["healthz"]:
-            await self._respond(writer, 200, b"ok")
+        from kubernetes_tpu.obs import metrics as obs_metrics
+        from kubernetes_tpu.obs.http import obs_response
+
+        obs = obs_response(
+            method, "/" + path.strip("/"),
+            registry=obs_metrics.REGISTRY,
+            ready_checks={
+                "syncing": lambda: getattr(self.kubelet, "running", True)})
+        if obs is not None:
+            status, body, ctype = obs
+            await self._respond(writer, status, body, content_type=ctype)
             return
+        parts = [p for p in path.strip("/").split("/") if p]
         if parts == ["runningpods"]:
             pods = sorted(self.kubelet.runtime.list_pods())
             await self._respond(writer, 200,
@@ -312,12 +321,14 @@ class KubeletServer:
                 pass
 
     @staticmethod
-    async def _respond(writer, status: int, body: bytes) -> None:
-        reason = {200: "OK", 400: "Bad Request", 404: "Not Found"}.get(
-            status, "?")
+    async def _respond(writer, status: int, body: bytes,
+                       content_type: str = "text/plain") -> None:
+        reason = {200: "OK", 400: "Bad Request", 404: "Not Found",
+                  405: "Method Not Allowed",
+                  503: "Service Unavailable"}.get(status, "?")
         writer.write(
             f"HTTP/1.1 {status} {reason}\r\n"
-            f"Content-Type: text/plain\r\n"
+            f"Content-Type: {content_type}\r\n"
             f"Content-Length: {len(body)}\r\n"
             f"Connection: close\r\n\r\n".encode() + body)
         await writer.drain()

@@ -43,6 +43,8 @@ from urllib.parse import parse_qs, urlsplit
 from kubernetes_tpu.api import objects as objs
 from kubernetes_tpu.api import wire
 from kubernetes_tpu.api.objects import Binding
+from kubernetes_tpu.obs import metrics as obs_metrics
+from kubernetes_tpu.obs.http import http_head, obs_response
 from kubernetes_tpu.apiserver.admission import AdmissionError
 from kubernetes_tpu.apiserver.validation import ValidationError
 from kubernetes_tpu.apiserver.store import (
@@ -109,6 +111,42 @@ KIND_TO_CLS = {cls.kind: cls for cls in (
     objs.APIService, objs.Role, objs.ClusterRole, objs.RoleBinding,
     objs.ClusterRoleBinding, objs.CertificateSigningRequest)}
 PLURAL_OF = {kind: plural for plural, kind in RESOURCES.items()}
+
+_req_mx: tuple | None = None
+
+
+def _request_metrics() -> tuple:
+    """(request_count, request_latencies, inflight) — the reference's
+    apiserver metrics families (endpoints/metrics/metrics.go), registered
+    on first request."""
+    global _req_mx
+    if _req_mx is None:
+        m = obs_metrics
+        _req_mx = (
+            m.REGISTRY.counter(
+                "apiserver_request_count",
+                "Requests handled, by verb, resource and response code.",
+                ("verb", "resource", "code")),
+            m.REGISTRY.histogram(
+                "apiserver_request_latencies_microseconds",
+                "Request handling latency, by verb and resource.",
+                ("verb", "resource"),
+                buckets=m.exponential_buckets(100.0, 2.0, 16)),
+            m.REGISTRY.gauge(
+                "apiserver_current_inflight_requests",
+                "Requests currently being served (non-long-running)."),
+        )
+    return _req_mx
+
+
+def _resource_of(path: str) -> str:
+    """The plural resource segment of a request path ("" for discovery
+    and other shapeless paths) — the metric label, no kind resolution."""
+    try:
+        _ns, plural, _name, _sub = _split_path(path)
+        return plural
+    except NotFound:
+        return ""
 
 
 async def read_http_request(reader: asyncio.StreamReader):
@@ -262,18 +300,31 @@ class APIServer:
         self._in_flight = 0
         self.max_in_flight = max_in_flight
 
-    def _audit_log(self, user, method: str, path: str,
-                   status: int) -> None:
+    def _audit_log(self, user, method: str, path: str, status: int,
+                   latency_ms: float | None = None,
+                   response_bytes: int | None = None) -> None:
         if self._audit is None:
             return
         import time as _time
 
-        self._audit.write(json.dumps({
+        record = {
             "ts": _time.time(),
             "user": getattr(user, "name", "") or "system:anonymous",
             "verb": method, "requestURI": path,
-            "responseStatus": status}) + "\n")
+            "responseStatus": status}
+        if latency_ms is not None:
+            record["latencyMs"] = round(latency_ms, 3)
+        if response_bytes is not None:
+            record["responseBytes"] = response_bytes
+        self._audit.write(json.dumps(record) + "\n")
         self._audit.flush()
+
+    def _observe_request(self, method: str, path: str, status: int,
+                         seconds: float) -> None:
+        mx = _request_metrics()
+        resource = _resource_of(path)
+        mx[0].labels(method, resource, str(status)).inc()
+        mx[1].labels(method, resource).observe(1e6 * seconds)
 
     def _authfilter(self, method: str, path: str,
                     headers: dict[str, str], peercert: dict | None = None):
@@ -364,9 +415,23 @@ class APIServer:
                 if parsed is None:
                     return
                 method, target, headers, body = parsed
+                import time as _time
 
+                t_start = _time.perf_counter()
                 url = urlsplit(target)
                 query = {k: v[-1] for k, v in parse_qs(url.query).items()}
+                # observability endpoints sit in FRONT of the filter chain
+                # (the reference installs /metrics and healthz on the mux
+                # before the resource handlers, server/config.go:513)
+                obs = obs_response(
+                    method, url.path, registry=obs_metrics.REGISTRY,
+                    ready_checks={
+                        "serving": lambda: self._server is not None})
+                if obs is not None:
+                    status, obs_body, ctype = obs
+                    writer.write(http_head(status, obs_body, ctype))
+                    await writer.drain()
+                    return
                 # content negotiation (CodecFactory position): protobuf
                 # in/out when the peer asks for it, JSON otherwise
                 accept_pb = wire.available() and \
@@ -381,17 +446,25 @@ class APIServer:
                     url.path, headers,
                     peercert=writer.get_extra_info("peercert"))
                 if denied is not None:
-                    self._audit_log(user, method, target, denied[0])
-                    await _respond(writer, *denied)
+                    nbytes = await _respond(writer, *denied)
+                    lat = _time.perf_counter() - t_start
+                    self._observe_request(method, url.path, denied[0], lat)
+                    self._audit_log(user, method, target, denied[0],
+                                    latency_ms=1e3 * lat,
+                                    response_bytes=nbytes)
                     return
                 if self._in_flight >= self.max_in_flight:
                     # WithMaxInFlightLimit: shed load instead of queueing
                     # unboundedly (reference returns 429 + Retry-After)
-                    self._audit_log(user, method, target, 429)
-                    await _respond(writer, 429, {
+                    nbytes = await _respond(writer, 429, {
                         "kind": "Status", "reason": "TooManyRequests",
                         "message": "too many requests, please try again "
                                    "later"})
+                    lat = _time.perf_counter() - t_start
+                    self._observe_request(method, url.path, 429, lat)
+                    self._audit_log(user, method, target, 429,
+                                    latency_ms=1e3 * lat,
+                                    response_bytes=nbytes)
                     return
                 if query.get("watch") in ("1", "true"):
                     svc = self._api_service_for(url.path)
@@ -402,7 +475,10 @@ class APIServer:
                         status = await self._relay_raw(
                             writer, addr.hostname, addr.port or 80,
                             method, target, body)
-                        self._audit_log(user, method, target, status)
+                        self._audit_log(
+                            user, method, target, status,
+                            latency_ms=1e3 * (_time.perf_counter()
+                                              - t_start))
                         return
                     self._audit_log(user, method, target, 200)
                     await self._serve_watch(writer, url.path, query,
@@ -414,9 +490,12 @@ class APIServer:
                         writer, method, node_proxy, url.query, body,
                         upgrade=headers.get("upgrade", ""),
                         client_reader=reader)
-                    self._audit_log(user, method, target, status)
+                    self._audit_log(
+                        user, method, target, status,
+                        latency_ms=1e3 * (_time.perf_counter() - t_start))
                     return  # the relay owns the connection
                 self._in_flight += 1
+                _request_metrics()[2].set(self._in_flight)
                 try:
                     proxied = await self._aggregate(
                         method, target, body,
@@ -437,10 +516,14 @@ class APIServer:
                                 user=user)
                 finally:
                     self._in_flight -= 1
-                self._audit_log(user, method, target, status)
+                    _request_metrics()[2].set(self._in_flight)
                 keep = headers.get("connection", "keep-alive").lower() != "close"
-                await _respond(writer, status, payload, keep_alive=keep,
-                               binary=accept_pb)
+                nbytes = await _respond(writer, status, payload,
+                                        keep_alive=keep, binary=accept_pb)
+                lat = _time.perf_counter() - t_start
+                self._observe_request(method, url.path, status, lat)
+                self._audit_log(user, method, target, status,
+                                latency_ms=1e3 * lat, response_bytes=nbytes)
                 if not keep:
                     return
         except (asyncio.IncompleteReadError, ConnectionError):
@@ -979,7 +1062,9 @@ def _wire_loads(body: bytes) -> dict:
 
 
 async def _respond(writer: asyncio.StreamWriter, status: int, payload,
-                   keep_alive: bool = False, binary: bool = False) -> None:
+                   keep_alive: bool = False, binary: bool = False) -> int:
+    """Write one response; returns the body size in bytes (the audit
+    trail's responseBytes field)."""
     content_type = "application/json"
     if binary and isinstance(payload, dict) and payload.get("kind"):
         body = wire.encode_payload(payload)
@@ -996,6 +1081,7 @@ async def _respond(writer: asyncio.StreamWriter, status: int, payload,
         f"Content-Length: {len(body)}\r\n"
         f"Connection: {conn}\r\n\r\n".encode() + body)
     await writer.drain()
+    return len(body)
 
 
 # ---------------------------------------------------------------------------

@@ -174,6 +174,21 @@ def print_table(rows: list[list[str]], headers: list[str]) -> None:
 
 
 def cmd_get(client, args) -> int:
+    if getattr(args, "raw", ""):
+        # `kubectl get --raw /metrics` (kubectl get flags.go RawURI):
+        # print the body verbatim, non-2xx is an error
+        status, text = client.raw("GET", args.raw)
+        if status >= 400:
+            print(f"error: the server returned HTTP {status} for "
+                  f"{args.raw}", file=sys.stderr)
+            return 1
+        sys.stdout.write(text if text.endswith("\n") or not text
+                         else text + "\n")
+        return 0
+    if not args.resource:
+        print("error: resource type required (or use --raw)",
+              file=sys.stderr)
+        return 1
     plural = resolve_resource(args.resource)
     kind = RESOURCES[plural]
     ns = None if args.all_namespaces else args.namespace
@@ -885,8 +900,11 @@ def build_parser() -> argparse.ArgumentParser:
         sp.add_argument("-n", "--namespace", default="default")
 
     g = sub.add_parser("get")
-    g.add_argument("resource")
+    g.add_argument("resource", nargs="?", default="")
     g.add_argument("name", nargs="?")
+    g.add_argument("--raw", default="",
+                   help="request a raw server path and print the body, "
+                        "e.g. --raw /metrics or --raw /healthz")
     g.add_argument("-n", "--namespace", default="default")
     g.add_argument("--all-namespaces", action="store_true")
     g.add_argument("-l", "--selector", default="",

@@ -19,6 +19,31 @@ log = logging.getLogger(__name__)
 
 Handler = Callable[[WatchEvent], None]
 
+_reflector_mx: dict[str, tuple] = {}
+
+
+def _metrics(kind: str) -> tuple:
+    """(lists, list_duration, watches) children for one kind — the
+    client-go reflector metrics families (cache/reflector_metrics.go),
+    labeled by watched kind."""
+    mx = _reflector_mx.get(kind)
+    if mx is None:
+        from kubernetes_tpu.obs import metrics as m
+
+        mx = (
+            m.REGISTRY.counter("reflector_lists_total",
+                               "Full lists performed by informers.",
+                               ("kind",)).labels(kind),
+            m.REGISTRY.histogram("reflector_list_duration_seconds",
+                                 "How long an informer's full list+replay "
+                                 "takes.", ("kind",)).labels(kind),
+            m.REGISTRY.counter("reflector_watches_total",
+                               "Watch streams opened by informers.",
+                               ("kind",)).labels(kind),
+        )
+        _reflector_mx[kind] = mx
+    return mx
+
 
 class Informer:
     def __init__(self, store: ObjectStore, kind: str):
@@ -65,6 +90,10 @@ class Informer:
                 await asyncio.sleep(0.05)
 
     async def _list_and_watch(self) -> None:
+        import time
+
+        mx = _metrics(self.kind)
+        t_list = time.monotonic()
         items, rv = self.store.list_with_version(self.kind)
         fresh = {(o.metadata.namespace, o.metadata.name): o for o in items}
         # replay the delta between cache and fresh list as synthetic events
@@ -78,11 +107,14 @@ class Informer:
             self._dispatch(WatchEvent("DELETED", self.kind, self.cache[key], rv))
         self.cache = dict(fresh)
         self._synced.set()
+        mx[0].inc()
+        mx[1].observe(time.monotonic() - t_list)
 
         try:
             stream = self.store.watch(self.kind, since=rv)
         except Expired:
             return  # relist
+        mx[2].inc()
         try:
             async for event in stream:
                 self._apply(event)

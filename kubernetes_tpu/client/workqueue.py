@@ -52,7 +52,7 @@ class BackoffQueue:
     - `done(item)`: finish processing; if dirtied meanwhile, requeue
     """
 
-    def __init__(self):
+    def __init__(self, name: str | None = None, registry=None):
         self._queue: list[Hashable] = []
         self._queued: set[Hashable] = set()
         self._processing: set[Hashable] = set()
@@ -61,6 +61,51 @@ class BackoffQueue:
         self._seq = 0
         self._event = asyncio.Event()
         self._closed = False
+        # metrics engage only for NAMED queues (client-go's
+        # NewNamedRateLimitingQueue contract — unnamed queues stay free of
+        # per-item accounting); `name` may be assigned after construction
+        # (controllers learn their name post-__init__) and children
+        # re-resolve lazily
+        self.name = name
+        self._registry = registry
+        self._mx: tuple | None = None
+        self._added_at: dict[Hashable, float] = {}
+        self._started_at: dict[Hashable, float] = {}
+
+    def _metrics(self) -> tuple | None:
+        """(name, depth, adds, retries, queue_dur, work_dur) children for
+        the current queue name — the client-go workqueue metrics provider
+        families (workqueue/metrics.go), labeled by queue."""
+        if self.name is None:
+            return None
+        if self._mx is None or self._mx[0] != self.name:
+            from kubernetes_tpu.obs import metrics as m
+
+            reg = self._registry if self._registry is not None else m.REGISTRY
+            lat_buckets = m.exponential_buckets(1e-5, 4.0, 10)
+            self._mx = (
+                self.name,
+                reg.gauge("workqueue_depth",
+                          "Current depth of the workqueue.",
+                          ("name",)).labels(self.name),
+                reg.counter("workqueue_adds_total",
+                            "Total adds handled by the workqueue.",
+                            ("name",)).labels(self.name),
+                reg.counter("workqueue_retries_total",
+                            "Total delayed (backoff) re-adds of items "
+                            "requeued after a failure.",
+                            ("name",)).labels(self.name),
+                reg.histogram("workqueue_queue_duration_seconds",
+                              "How long an item stays queued before "
+                              "processing starts.",
+                              ("name",), buckets=lat_buckets
+                              ).labels(self.name),
+                reg.histogram("workqueue_work_duration_seconds",
+                              "How long processing an item takes.",
+                              ("name",), buckets=lat_buckets
+                              ).labels(self.name),
+            )
+        return self._mx
 
     def __len__(self) -> int:
         return len(self._queue)
@@ -73,18 +118,31 @@ class BackoffQueue:
             return
         self._queued.add(item)
         self._queue.append(item)
+        mx = self._metrics()
+        if mx is not None:
+            mx[2].inc()
+            mx[1].set(len(self._queue))
+            self._added_at[item] = time.monotonic()
         self._event.set()
 
     def add_after(self, item: Hashable, delay: float) -> None:
         if delay <= 0:
             self.add(item)
             return
+        mx = self._metrics()
+        if mx is not None:
+            mx[3].inc()
         self._seq += 1
         heapq.heappush(self._delayed, (time.monotonic() + delay, self._seq, item))
         self._event.set()
 
     def done(self, item: Hashable) -> None:
         self._processing.discard(item)
+        mx = self._metrics()
+        if mx is not None:
+            started = self._started_at.pop(item, None)
+            if started is not None:
+                mx[5].observe(time.monotonic() - started)
         if item in self._dirty:
             self._dirty.discard(item)
             self.add(item)
@@ -117,6 +175,15 @@ class BackoffQueue:
                 for item in items:
                     self._queued.discard(item)
                     self._processing.add(item)
+                mx = self._metrics()
+                if mx is not None:
+                    now = time.monotonic()
+                    observe = mx[4].observe
+                    added_pop = self._added_at.pop
+                    for item in items:
+                        observe(now - added_pop(item, now))
+                        self._started_at[item] = now
+                    mx[1].set(len(self._queue))
                 return items
             timeout = next_delay
             if deadline is not None:

@@ -29,6 +29,9 @@ def parse_args(argv=None) -> argparse.Namespace:
                    help="bearer token for an authn-enabled apiserver "
                         "(env KUBE_TOKEN)")
     p.add_argument("--leader-elect", action="store_true")
+    p.add_argument("--port", type=int, default=10252,
+                   help="serve /metrics, /healthz and /readyz here "
+                        "(0 = ephemeral; reference --port default 10252)")
     p.add_argument("--lock-object-name", default="kube-controller-manager")
     p.add_argument("--lock-object-namespace", default="kube-system")
     p.add_argument("--node-monitor-period", type=float, default=5.0)
@@ -87,6 +90,22 @@ async def run(args: argparse.Namespace) -> None:
         podgc_threshold=args.terminated_pod_gc_threshold,
         hpa_metrics=AnnotationMetrics(store))
 
+    # the healthz/metrics mux every component serves
+    # (controllermanager.go:141 starts it before the election)
+    from kubernetes_tpu.obs.http import ObsServer
+
+    obs = ObsServer(ready_checks={"informers-synced": lambda: mgr.synced},
+                    port=args.port)
+    try:
+        await obs.start()
+        log.info("observability endpoints on %s", obs.url)
+    except OSError as e:
+        # a standby on the same host must still contend for the lease
+        # even when the leader holds the default port
+        log.warning("observability endpoints disabled "
+                    "(port %d unavailable: %s)", args.port, e)
+        obs = None
+
     async def lead():
         await mgr.start()
         log.info("controllers running against %s", args.apiserver)
@@ -110,6 +129,8 @@ async def run(args: argparse.Namespace) -> None:
             await lead()
     finally:
         mgr.stop()
+        if obs is not None:
+            await obs.stop()
 
 
 def main(argv=None) -> int:

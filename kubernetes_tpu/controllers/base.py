@@ -28,6 +28,9 @@ log = logging.getLogger(__name__)
 EXPECTATIONS_TTL = 5 * 60.0
 # controller.SlowStartInitialBatchSize (controller_utils.go:744 callers)
 SLOW_START_INITIAL = 1
+# per-item backoff entries older than this are swept from the worker loop
+# (Backoff.gc — the reference runs a periodic gc goroutine per backoff)
+BACKOFF_GC_PERIOD = 60.0
 
 
 class Expectations:
@@ -102,6 +105,9 @@ class ReconcileController:
         self.backoff = Backoff(initial=0.01, max_duration=30.0)
         self._tasks: list[asyncio.Task] = []
         self.expectations = Expectations()
+        self._last_backoff_gc = time.monotonic()
+        self._mx_reconcile = None
+        self._mx_errors = None
 
     def enqueue(self, key: str) -> None:
         self.queue.add(key)
@@ -110,6 +116,19 @@ class ReconcileController:
         self.queue.add_after(key, delay)
 
     async def start(self) -> None:
+        # subclasses assign self.name after super().__init__, so the
+        # queue's metric name and the reconcile families bind here
+        from kubernetes_tpu.obs import metrics as obs_metrics
+
+        self.queue.name = self.name
+        self._mx_reconcile = obs_metrics.REGISTRY.histogram(
+            "controller_reconcile_duration_seconds",
+            "How long one sync(key) reconcile takes.",
+            ("controller",)).labels(self.name)
+        self._mx_errors = obs_metrics.REGISTRY.counter(
+            "controller_reconcile_errors_total",
+            "Reconciles that failed and were requeued with backoff.",
+            ("controller",)).labels(self.name)
         loop = asyncio.get_running_loop()
         for _ in range(self.workers):
             self._tasks.append(loop.create_task(self._worker()))
@@ -125,17 +144,33 @@ class ReconcileController:
             key = await self.queue.get()
             if key is None:
                 return
+            t0 = time.monotonic()
             try:
                 await self.sync(key)
             except asyncio.CancelledError:
                 return
             except Exception as e:  # noqa: BLE001 — requeue w/ backoff
                 log.warning("%s: sync(%s) failed: %s", self.name, key, e)
+                if self._mx_errors is not None:
+                    self._mx_errors.inc()
+                    self._mx_reconcile.observe(time.monotonic() - t0)
                 self.queue.done(key)
                 self.queue.add_after(key, self.backoff.next_delay(key))
+                self._maybe_gc_backoff()
                 continue
+            if self._mx_reconcile is not None:
+                self._mx_reconcile.observe(time.monotonic() - t0)
             self.queue.done(key)
             self.backoff.reset(key)
+            self._maybe_gc_backoff()
+
+    def _maybe_gc_backoff(self) -> None:
+        """Sweep stale per-item backoff entries from the run loop — the
+        Backoff map otherwise grows one entry per key that ever failed."""
+        now = time.monotonic()
+        if now - self._last_backoff_gc >= BACKOFF_GC_PERIOD:
+            self._last_backoff_gc = now
+            self.backoff.gc()
 
     async def sync(self, key: str) -> None:  # pragma: no cover - interface
         raise NotImplementedError

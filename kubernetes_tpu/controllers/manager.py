@@ -149,6 +149,12 @@ class ControllerManager:
                                          self.informers["Node"])
             self.controllers.append(self.route)
 
+    @property
+    def synced(self) -> bool:
+        """All shared informers have completed their initial list — the
+        controller-manager's /readyz signal."""
+        return all(inf._synced.is_set() for inf in self.informers.values())
+
     async def start(self) -> None:
         for informer in self.informers.values():
             informer.start()

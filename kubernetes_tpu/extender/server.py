@@ -201,10 +201,12 @@ class ExtenderServer:
         self.host = host
         self.port = port
         self._server: asyncio.AbstractServer | None = None
+        self._ready = False  # /readyz: true once warmup compiled
 
     async def start(self) -> None:
         await asyncio.get_running_loop().run_in_executor(
             None, self.service.warmup)
+        self._ready = True
         self._server = await asyncio.start_server(self._handle, self.host, self.port)
         self.port = self._server.sockets[0].getsockname()[1]
 
@@ -240,6 +242,22 @@ class ExtenderServer:
                 length = int(headers.get("content-length", 0))
                 body = await reader.readexactly(length) if length else b""
 
+                p = path.split("?", 1)[0].rstrip("/")
+                if p in ("/metrics", "/readyz", "/livez"):
+                    # text obs endpoints; /healthz keeps its JSON shape
+                    # (the reference extender contract this server serves)
+                    from kubernetes_tpu.obs import metrics as obs_metrics
+                    from kubernetes_tpu.obs.http import (
+                        http_head,
+                        obs_response,
+                    )
+
+                    status, rbody, ctype = obs_response(
+                        method, p, registry=obs_metrics.REGISTRY,
+                        ready_checks={"warmed-up": lambda: self._ready})
+                    writer.write(http_head(status, rbody, ctype))
+                    await writer.drain()
+                    return
                 status, payload = self._route(method, path, body)
                 keep = headers.get("connection", "keep-alive").lower() != "close"
                 await self._respond(writer, status, payload, keep_alive=keep)

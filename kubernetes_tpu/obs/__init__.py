@@ -1,0 +1,14 @@
+"""Process-wide observability: Prometheus-style metrics registry and the
+shared /metrics //healthz //readyz HTTP surface every component serves.
+
+`obs.metrics.REGISTRY` is the process-global default registry (the
+prometheus.DefaultRegisterer position); `obs.http.obs_response` is the one
+handler helper behind the apiserver, scheduler, kubelet, controller-manager
+and extender endpoints.
+"""
+
+from kubernetes_tpu.obs.metrics import (  # noqa: F401
+    REGISTRY,
+    Registry,
+    exponential_buckets,
+)

@@ -1,0 +1,138 @@
+"""The uniform component observability surface: one handler helper for
+GET /metrics, /healthz, /readyz (+/livez alias) shared by the apiserver,
+scheduler, kubelet, controller-manager and extender servers, plus a
+standalone asyncio server for components with no HTTP surface of their own
+(the controller-manager binary).
+
+Check semantics follow the reference's healthz package
+(apiserver/pkg/server/healthz): named checks, 200 "ok" when all pass,
+500/503 with the failing check names otherwise. /healthz is liveness
+(default: always ok once serving), /readyz is readiness (informers synced,
+warmup done, ...).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Callable, Mapping
+
+from kubernetes_tpu.obs import metrics as _metrics
+
+METRICS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+TEXT_CONTENT_TYPE = "text/plain; charset=utf-8"
+
+Check = Callable[[], bool]
+
+OBS_PATHS = ("/metrics", "/healthz", "/readyz", "/livez")
+
+
+def _run_checks(checks: Mapping[str, Check] | None
+                ) -> tuple[int, bytes]:
+    failed = []
+    for name, check in (checks or {}).items():
+        try:
+            ok = bool(check())
+        except Exception:  # noqa: BLE001 — a broken check is a failed check
+            ok = False
+        if not ok:
+            failed.append(name)
+    if failed:
+        return 503, ("checks failed: " + ",".join(sorted(failed))).encode()
+    return 200, b"ok"
+
+
+def obs_response(method: str, path: str,
+                 registry: _metrics.Registry | None = None,
+                 health_checks: Mapping[str, Check] | None = None,
+                 ready_checks: Mapping[str, Check] | None = None,
+                 extra_text: Callable[[], str] | None = None,
+                 ) -> tuple[int, bytes, str] | None:
+    """-> (status, body, content-type) for the three obs endpoints, or
+    None when `path` is not one of them (the caller routes on). Any
+    method but GET on an obs path gets 405. `extra_text` appends
+    component-local exposition after the registry render (the scheduler's
+    per-instance families)."""
+    path = path.split("?", 1)[0].rstrip("/") or "/"
+    if path not in OBS_PATHS:
+        return None
+    if method != "GET":
+        return 405, b"method not allowed", TEXT_CONTENT_TYPE
+    if path == "/metrics":
+        body = (registry or _metrics.REGISTRY).render()
+        if extra_text is not None:
+            body = extra_text() + body
+        return 200, body.encode(), METRICS_CONTENT_TYPE
+    if path == "/healthz" or path == "/livez":
+        status, body = _run_checks(health_checks)
+    else:
+        status, body = _run_checks(ready_checks)
+    return status, body, TEXT_CONTENT_TYPE
+
+
+def http_head(status: int, body: bytes, content_type: str,
+              keep_alive: bool = False) -> bytes:
+    """A full HTTP/1.1 response for hand-rolled asyncio servers."""
+    reason = {200: "OK", 404: "Not Found", 405: "Method Not Allowed",
+              503: "Service Unavailable"}.get(status, "Error")
+    conn = "keep-alive" if keep_alive else "close"
+    return (f"HTTP/1.1 {status} {reason}\r\n"
+            f"Content-Type: {content_type}\r\n"
+            f"Content-Length: {len(body)}\r\n"
+            f"Connection: {conn}\r\n\r\n").encode() + body
+
+
+class ObsServer:
+    """Standalone /metrics //healthz //readyz server for components that
+    have no other HTTP surface (controller-manager)."""
+
+    def __init__(self, registry: _metrics.Registry | None = None,
+                 health_checks: Mapping[str, Check] | None = None,
+                 ready_checks: Mapping[str, Check] | None = None,
+                 host: str = "127.0.0.1", port: int = 0):
+        self.registry = registry
+        self.health_checks = health_checks
+        self.ready_checks = ready_checks
+        self.host = host
+        self.port = port
+        self._server: asyncio.AbstractServer | None = None
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_server(
+            self._handle, self.host, self.port)
+        self.port = self._server.sockets[0].getsockname()[1]
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None
+
+    async def _handle(self, reader: asyncio.StreamReader,
+                      writer: asyncio.StreamWriter) -> None:
+        try:
+            request_line = await reader.readline()
+            if not request_line:
+                return
+            try:
+                method, target, _ = request_line.decode().split(None, 2)
+            except ValueError:
+                return
+            while True:  # drain headers
+                line = await reader.readline()
+                if line in (b"\r\n", b"\n", b""):
+                    break
+            resp = obs_response(method, target, registry=self.registry,
+                                health_checks=self.health_checks,
+                                ready_checks=self.ready_checks)
+            if resp is None:
+                resp = (404, b"not found", TEXT_CONTENT_TYPE)
+            writer.write(http_head(*resp))
+            await writer.drain()
+        except (ConnectionError, asyncio.IncompleteReadError):
+            pass
+        finally:
+            writer.close()

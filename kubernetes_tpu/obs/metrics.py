@@ -1,0 +1,279 @@
+"""Prometheus-style metrics: Counter/Gauge/Histogram families with labels
+and text exposition format 0.0.4.
+
+The client_golang shape the reference's binaries register against
+(prometheus.MustRegister in plugin/pkg/scheduler/metrics/metrics.go:31-50,
+apiserver request metrics, the client-go workqueue metrics provider), cut
+down to what this framework scrapes: label escaping, cumulative histogram
+buckets, and a default process-global registry. Registration is
+get-or-create so components constructed many times per process (tests,
+benches) share one family instead of colliding.
+
+Thread safety: servers run on whatever thread owns their event loop while
+tests scrape from another, so child creation is guarded by the registry
+lock and every sample update by a per-child lock (uncontended in the
+single-loop steady state).
+"""
+
+from __future__ import annotations
+
+import threading
+from bisect import bisect_left
+from typing import Iterable
+
+# client_golang prometheus.DefBuckets (seconds)
+DEFAULT_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0,
+                   2.5, 5.0, 10.0)
+
+
+def exponential_buckets(start: float, factor: float, count: int
+                        ) -> tuple[float, ...]:
+    """prometheus.ExponentialBuckets (histogram.go): `count` upper bounds
+    starting at `start`, each `factor` times the previous."""
+    return tuple(start * factor ** i for i in range(count))
+
+
+def _escape_label(value: str) -> str:
+    return (str(value).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _escape_help(text: str) -> str:
+    return str(text).replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def _fmt(v: float) -> str:
+    """Sample value formatting: integral values render bare (the Go %v
+    shape tests pin, e.g. `scheduler_pods_scheduled_total 1`)."""
+    f = float(v)
+    if f == int(f) and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+class _Child:
+    __slots__ = ("_lock",)
+
+    def __init__(self):
+        self._lock = threading.Lock()
+
+
+class Counter(_Child):
+    __slots__ = ("value",)
+
+    def __init__(self):
+        super().__init__()
+        self.value = 0.0
+
+    def inc(self, amount: float = 1.0) -> None:
+        if amount < 0:
+            raise ValueError("counters only go up")
+        with self._lock:
+            self.value += amount
+
+
+class Gauge(_Child):
+    __slots__ = ("value",)
+
+    def __init__(self):
+        super().__init__()
+        self.value = 0.0
+
+    def set(self, value: float) -> None:
+        with self._lock:
+            self.value = float(value)
+
+    def inc(self, amount: float = 1.0) -> None:
+        with self._lock:
+            self.value += amount
+
+    def dec(self, amount: float = 1.0) -> None:
+        self.inc(-amount)
+
+
+class Histogram(_Child):
+    __slots__ = ("buckets", "counts", "sum", "count")
+
+    def __init__(self, buckets: tuple[float, ...]):
+        super().__init__()
+        self.buckets = buckets            # finite upper bounds, ascending
+        self.counts = [0] * (len(buckets) + 1)  # per-bucket, last = +Inf
+        self.sum = 0.0
+        self.count = 0
+
+    def observe(self, value: float) -> None:
+        i = bisect_left(self.buckets, value)
+        with self._lock:
+            self.counts[i] += 1
+            self.sum += value
+            self.count += 1
+
+    def quantile(self, q: float) -> float:
+        """histogram_quantile-style estimate: linear interpolation within
+        the bucket holding the q-th sample (0.0 when empty; the last finite
+        bound when the sample lands in the +Inf bucket)."""
+        with self._lock:
+            total = self.count
+            counts = list(self.counts)
+        if total == 0:
+            return 0.0
+        rank = q * total
+        cumulative = 0
+        for i, c in enumerate(counts):
+            if c == 0:
+                continue
+            if cumulative + c >= rank:
+                if i >= len(self.buckets):       # +Inf bucket
+                    return self.buckets[-1] if self.buckets else 0.0
+                lo = self.buckets[i - 1] if i > 0 else 0.0
+                hi = self.buckets[i]
+                return lo + (hi - lo) * max(0.0, rank - cumulative) / c
+            cumulative += c
+        return self.buckets[-1] if self.buckets else 0.0
+
+
+_CHILD_TYPES = {"counter": Counter, "gauge": Gauge, "histogram": Histogram}
+
+
+class Family:
+    """One metric family: name + type + label names, children per label
+    values. An unlabeled family proxies sample methods to its single
+    child, so `registry.counter(...).inc()` works without `.labels()`."""
+
+    def __init__(self, name: str, help_text: str, kind: str,
+                 labelnames: tuple[str, ...],
+                 buckets: tuple[float, ...] | None = None):
+        self.name = name
+        self.help = help_text
+        self.kind = kind
+        self.labelnames = labelnames
+        self.buckets = buckets
+        self._children: dict[tuple[str, ...], _Child] = {}
+        self._lock = threading.Lock()
+
+    def labels(self, *values) -> Counter | Gauge | Histogram:
+        if len(values) != len(self.labelnames):
+            raise ValueError(
+                f"{self.name}: expected labels {self.labelnames}, "
+                f"got {len(values)} values")
+        key = tuple(str(v) for v in values)
+        child = self._children.get(key)
+        if child is None:
+            with self._lock:
+                child = self._children.get(key)
+                if child is None:
+                    if self.kind == "histogram":
+                        child = Histogram(self.buckets)
+                    else:
+                        child = _CHILD_TYPES[self.kind]()
+                    self._children[key] = child
+        return child
+
+    def children(self) -> list[tuple[tuple[str, ...], _Child]]:
+        with self._lock:
+            return sorted(self._children.items())
+
+    # unlabeled convenience: family as the single child
+    def inc(self, amount: float = 1.0) -> None:
+        self.labels().inc(amount)
+
+    def set(self, value: float) -> None:
+        self.labels().set(value)
+
+    def dec(self, amount: float = 1.0) -> None:
+        self.labels().dec(amount)
+
+    def observe(self, value: float) -> None:
+        self.labels().observe(value)
+
+    def quantile(self, q: float) -> float:
+        return self.labels().quantile(q)
+
+    def _label_str(self, values: tuple[str, ...],
+                   extra: str = "") -> str:
+        pairs = [f'{n}="{_escape_label(v)}"'
+                 for n, v in zip(self.labelnames, values)]
+        if extra:
+            pairs.append(extra)
+        return "{" + ",".join(pairs) + "}" if pairs else ""
+
+    def render(self) -> list[str]:
+        lines = [f"# HELP {self.name} {_escape_help(self.help)}",
+                 f"# TYPE {self.name} {self.kind}"]
+        for values, child in self.children():
+            if self.kind == "histogram":
+                assert isinstance(child, Histogram)
+                cumulative = 0
+                with child._lock:
+                    counts = list(child.counts)
+                    total, s = child.count, child.sum
+                for bound, c in zip(child.buckets, counts):
+                    cumulative += c
+                    le = self._label_str(values, f'le="{bound:g}"')
+                    lines.append(f"{self.name}_bucket{le} {cumulative}")
+                inf = self._label_str(values, 'le="+Inf"')
+                lines.append(f"{self.name}_bucket{inf} {total}")
+                lbl = self._label_str(values)
+                lines.append(f"{self.name}_sum{lbl} {_fmt(s)}")
+                lines.append(f"{self.name}_count{lbl} {total}")
+            else:
+                lines.append(f"{self.name}{self._label_str(values)} "
+                             f"{_fmt(child.value)}")
+        return lines
+
+
+class Registry:
+    """Get-or-create family registry + text exposition renderer."""
+
+    def __init__(self):
+        self._families: dict[str, Family] = {}
+        self._lock = threading.Lock()
+
+    def _register(self, name: str, help_text: str, kind: str,
+                  labelnames: Iterable[str],
+                  buckets: tuple[float, ...] | None = None) -> Family:
+        labelnames = tuple(labelnames)
+        with self._lock:
+            fam = self._families.get(name)
+            if fam is not None:
+                if fam.kind != kind or fam.labelnames != labelnames:
+                    raise ValueError(
+                        f"metric {name!r} already registered as "
+                        f"{fam.kind}{fam.labelnames}, not "
+                        f"{kind}{labelnames}")
+                return fam
+            fam = Family(name, help_text, kind, labelnames, buckets)
+            self._families[name] = fam
+            return fam
+
+    def counter(self, name: str, help_text: str = "",
+                labels: Iterable[str] = ()) -> Family:
+        return self._register(name, help_text, "counter", labels)
+
+    def gauge(self, name: str, help_text: str = "",
+              labels: Iterable[str] = ()) -> Family:
+        return self._register(name, help_text, "gauge", labels)
+
+    def histogram(self, name: str, help_text: str = "",
+                  labels: Iterable[str] = (),
+                  buckets: Iterable[float] | None = None) -> Family:
+        bounds = tuple(sorted(buckets)) if buckets is not None \
+            else DEFAULT_BUCKETS
+        return self._register(name, help_text, "histogram", labels,
+                              buckets=bounds)
+
+    def get(self, name: str) -> Family | None:
+        with self._lock:
+            return self._families.get(name)
+
+    def render(self) -> str:
+        with self._lock:
+            families = [self._families[k] for k in sorted(self._families)]
+        lines: list[str] = []
+        for fam in families:
+            lines.extend(fam.render())
+        return "\n".join(lines) + "\n" if lines else ""
+
+
+# the process-global default (prometheus.DefaultRegisterer position)
+REGISTRY = Registry()

@@ -13,7 +13,7 @@ from __future__ import annotations
 
 import asyncio
 import time
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 
 from kubernetes_tpu.apiserver import ObjectStore
 from kubernetes_tpu.models.policy import DEFAULT_POLICY, Policy
@@ -29,6 +29,10 @@ class ThroughputResult:
     pods_per_sec: float
     batches: int
     metrics: dict
+    # per-phase registry histogram snapshot of the timed wave
+    # ({phase: {count, sum_ms, p50_ms, p99_ms}}) — bench.py's
+    # --metrics-snapshot payload
+    phase_hist: dict = field(default_factory=dict)
 
     def __str__(self) -> str:
         return (f"{self.scheduled} pods in {self.seconds:.2f}s = "
@@ -88,6 +92,7 @@ async def _run(n_nodes: int, n_pods: int, caps: Capacities, policy: Policy,
         pods_per_sec=done / dt if dt > 0 else 0.0,
         batches=sched.metrics.batches - batches_before,
         metrics=sched.metrics.snapshot(),
+        phase_hist=sched.metrics.phase_histograms(),
     )
     sched.stop()
     return result

@@ -22,7 +22,6 @@ import asyncio
 import logging
 import time
 from collections import deque
-from dataclasses import dataclass, field
 
 import numpy as np
 
@@ -31,6 +30,7 @@ from kubernetes_tpu.apiserver.store import Conflict, NotFound, ObjectStore, Watc
 from kubernetes_tpu.client.informer import Informer
 from kubernetes_tpu.client.workqueue import Backoff, BackoffQueue
 from kubernetes_tpu.models.policy import DEFAULT_POLICY, Policy
+from kubernetes_tpu.obs import metrics as obs_metrics
 from kubernetes_tpu.ops.solver import schedule_batch
 from kubernetes_tpu.state import Capacities
 from kubernetes_tpu.state.encode_cache import EncodeCache
@@ -42,26 +42,156 @@ from kubernetes_tpu.utils.trace import StepTimer
 log = logging.getLogger(__name__)
 
 
-@dataclass
+# ExponentialBuckets(1000, 2, 15) in microseconds (reference metrics.go:36)
+LATENCY_BUCKETS_US = obs_metrics.exponential_buckets(1000.0, 2.0, 15)
+# phase spans run ~10us (cache-hit encode) to tens of seconds (cold solve)
+PHASE_BUCKETS_S = obs_metrics.exponential_buckets(1e-5, 2.0, 22)
+
+
+class _LatencyWindow(deque):
+    """Bounded sample window (seconds) whose append also observes a
+    registry histogram in microseconds — the reference's fixed-bucket
+    Prometheus histograms; the window keeps snapshot() percentiles exact.
+    Call sites alias `.append`, so the mirror lives here."""
+
+    def __init__(self, hist, extra=None):
+        super().__init__(maxlen=8192)
+        self._hist = hist
+        self._extra = extra
+
+    def append(self, seconds: float) -> None:
+        self._hist.observe(1e6 * seconds)
+        if self._extra is not None:
+            self._extra(seconds)
+        super().append(seconds)
+
+
 class SchedulerMetrics:
     """Counters/latency mirrors of the reference's Prometheus metrics
-    (plugin/pkg/scheduler/metrics/metrics.go:31-50)."""
+    (plugin/pkg/scheduler/metrics/metrics.go:31-50), backed by an obs
+    registry. Each instance owns a PRIVATE registry by default: tests and
+    the perf harness construct many schedulers per process and assert
+    exact per-instance counts, so scheduler families must not accumulate
+    across instances. The scheduler's /metrics endpoint renders this
+    registry plus the process-global one (workqueue/informer families)."""
 
-    scheduled: int = 0
-    failed: int = 0
-    binding_errors: int = 0
-    batches: int = 0
-    # bounded windows (the reference uses fixed-bucket Prometheus histograms)
-    e2e_latency: deque = field(default_factory=lambda: deque(maxlen=8192))
-    algorithm_latency: deque = field(default_factory=lambda: deque(maxlen=8192))
-    binding_latency: deque = field(default_factory=lambda: deque(maxlen=8192))
-    # cumulative host-plane phase costs (seconds) — the transport-independent
-    # breakdown: tunnel weather moves settle_wait, not encode/bind/commit
-    phase_s: dict = field(default_factory=dict)
-    phase_pods: int = 0
+    def __init__(self, registry: obs_metrics.Registry | None = None):
+        self.registry = registry if registry is not None \
+            else obs_metrics.Registry()
+        r = self.registry
+        self._c_scheduled = r.counter(
+            "scheduler_pods_scheduled_total", "Pods successfully bound.")
+        self._c_failed = r.counter(
+            "scheduler_pods_failed_total",
+            "Scheduling attempts that failed.")
+        self._c_binding_errors = r.counter(
+            "scheduler_binding_errors_total", "Bind writes rejected.")
+        self._c_batches = r.counter(
+            "scheduler_batches_total", "Solver batches dispatched.")
+        self._c_jit_hits = r.counter(
+            "scheduler_jit_cache_hits_total",
+            "Batches served by an already-compiled solver variant.")
+        self._c_jit_misses = r.counter(
+            "scheduler_jit_cache_misses_total",
+            "Batches that compiled a new solver variant (BatchFlags).")
+        self._h_phase = r.histogram(
+            "scheduler_phase_duration_seconds",
+            "Per-batch scheduling phase durations "
+            "(encode/flush/dispatch/solve/settle_wait/bind/commit).",
+            ("phase",), buckets=PHASE_BUCKETS_S)
+        self.trace_steps = r.histogram(
+            "scheduler_trace_step_duration_seconds",
+            "Scheduling-batch trace spans (StepTimer steps).",
+            ("step",), buckets=PHASE_BUCKETS_S)
+        self._scheduled = 0
+        self._failed = 0
+        self._binding_errors = 0
+        self._batches = 0
+        # bounded windows (the registry histograms are cumulative; the
+        # windows keep the recent-sample percentiles snapshot() reports)
+        self.e2e_latency = _LatencyWindow(r.histogram(
+            "e2e_scheduling_latency_microseconds",
+            "E2e scheduling latency (queue arrival to bind).",
+            buckets=LATENCY_BUCKETS_US))
+        self.algorithm_latency = _LatencyWindow(
+            r.histogram("scheduling_algorithm_latency_microseconds",
+                        "Scheduling algorithm (device solve) latency.",
+                        buckets=LATENCY_BUCKETS_US),
+            extra=lambda s: self.add_phase("solve", s))
+        self.binding_latency = _LatencyWindow(r.histogram(
+            "binding_latency_microseconds", "Binding latency per pod.",
+            buckets=LATENCY_BUCKETS_US))
+        # cumulative host-plane phase costs (seconds) — the
+        # transport-independent breakdown: tunnel weather moves
+        # settle_wait, not encode/bind/commit
+        self.phase_s: dict = {}
+        self.phase_pods = 0
+
+    # counter attributes stay plain-int readable/writable (tests assert
+    # `metrics.scheduled == 40`); writes mirror the delta to the registry
+    @property
+    def scheduled(self) -> int:
+        return self._scheduled
+
+    @scheduled.setter
+    def scheduled(self, value: int) -> None:
+        if value > self._scheduled:
+            self._c_scheduled.inc(value - self._scheduled)
+        self._scheduled = value
+
+    @property
+    def failed(self) -> int:
+        return self._failed
+
+    @failed.setter
+    def failed(self, value: int) -> None:
+        if value > self._failed:
+            self._c_failed.inc(value - self._failed)
+        self._failed = value
+
+    @property
+    def binding_errors(self) -> int:
+        return self._binding_errors
+
+    @binding_errors.setter
+    def binding_errors(self, value: int) -> None:
+        if value > self._binding_errors:
+            self._c_binding_errors.inc(value - self._binding_errors)
+        self._binding_errors = value
+
+    @property
+    def batches(self) -> int:
+        return self._batches
+
+    @batches.setter
+    def batches(self, value: int) -> None:
+        if value > self._batches:
+            self._c_batches.inc(value - self._batches)
+        self._batches = value
+
+    def jit_hit(self) -> None:
+        self._c_jit_hits.inc()
+
+    def jit_miss(self) -> None:
+        self._c_jit_misses.inc()
 
     def add_phase(self, name: str, seconds: float) -> None:
         self.phase_s[name] = self.phase_s.get(name, 0.0) + seconds
+        self._h_phase.labels(name).observe(seconds)
+
+    def phase_histograms(self) -> dict:
+        """Per-phase histogram snapshot {phase: {count, sum_ms, p50_ms,
+        p99_ms}} — the bench.py --metrics-snapshot payload, quantiles
+        estimated from the registry buckets (histogram_quantile shape)."""
+        out: dict = {}
+        for (phase,), child in self._h_phase.children():
+            out[phase] = {
+                "count": child.count,
+                "sum_ms": round(1e3 * child.sum, 3),
+                "p50_ms": round(1e3 * child.quantile(0.5), 3),
+                "p99_ms": round(1e3 * child.quantile(0.99), 3),
+            }
+        return out
 
     def snapshot(self) -> dict:
         lat = sorted(self.e2e_latency) or [0.0]
@@ -151,7 +281,7 @@ class Scheduler:
         from kubernetes_tpu.models.policy import build_policy_rows
 
         self._prows = build_policy_rows(policy, self.statedb.table, self.caps)
-        self.queue = BackoffQueue()
+        self.queue = BackoffQueue(name="scheduler")
         self.backoff = Backoff(initial=0.05, max_duration=5.0)
         self.metrics = SchedulerMetrics()
         self.events = EventRecorder(store)
@@ -219,7 +349,10 @@ class Scheduler:
         import jax
 
         fn = self._schedule_fns.get(flags)
-        if fn is None:
+        if fn is not None:
+            self.metrics.jit_hit()
+        else:
+            self.metrics.jit_miss()
             from kubernetes_tpu.state.pod_batch import unpack_batch
 
             caps, policy, prows = self.caps, self.policy, self._prows
@@ -310,6 +443,13 @@ class Scheduler:
             self.encode_cache.premake(pod)
 
     # ---- lifecycle ----
+
+    @property
+    def synced(self) -> bool:
+        """Both core informers completed their initial list — the
+        scheduler's /readyz signal."""
+        return (self.node_informer._synced.is_set()
+                and self.pod_informer._synced.is_set())
 
     async def start(self) -> None:
         self.node_informer.start()
@@ -434,7 +574,8 @@ class Scheduler:
             return await self._schedule_with_extenders(pods, live_keys,
                                                        fblob, iblob)
 
-        timer = StepTimer(f"scheduling batch of {len(pods)}")
+        timer = StepTimer(f"scheduling batch of {len(pods)}",
+                          step_hist=self.metrics.trace_steps)
         from kubernetes_tpu.state.pod_batch import packed_batch_flags
 
         flags = packed_batch_flags(fblob, iblob, len(pods),

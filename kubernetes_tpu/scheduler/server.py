@@ -1,73 +1,43 @@
-"""Scheduler observability endpoints: /healthz + Prometheus /metrics.
+"""Scheduler observability endpoints: /metrics + /healthz + /readyz.
 
 The plugin/cmd/kube-scheduler server surface (app/server.go:151 installs
-healthz and the Prometheus handler): text exposition of the reference's
-scheduler histograms (metrics/metrics.go:31-50 —
+healthz and the Prometheus handler). Metrics are the driver's registry —
+the reference's scheduler histograms (metrics/metrics.go:31-50 —
 e2e_scheduling_latency_microseconds, scheduling_algorithm_latency_
 microseconds, binding_latency_microseconds with ExponentialBuckets(1000, 2,
-15)) plus the framework's counters. Latency windows are converted to
-cumulative histogram buckets at scrape time.
+15)) plus phase/trace/jit families — rendered together with the
+process-global registry (workqueue, informer families).
 """
 
 from __future__ import annotations
 
 import asyncio
-from typing import Iterable
 
+from kubernetes_tpu.obs import metrics as obs_metrics
+from kubernetes_tpu.obs.http import (
+    METRICS_CONTENT_TYPE,
+    http_head,
+    obs_response,
+)
 from kubernetes_tpu.scheduler.driver import Scheduler
 
-# ExponentialBuckets(1000, 2, 15) in microseconds (metrics.go:36)
+# ExponentialBuckets(1000, 2, 15) in microseconds (metrics.go:36);
+# kept as the canonical bucket list for the latency families
 BUCKETS_US = [1000.0 * (2 ** i) for i in range(15)]
 
 
-def _histogram(name: str, help_text: str,
-               samples_seconds: Iterable[float]) -> str:
-    samples = [1e6 * s for s in samples_seconds]  # seconds -> microseconds
-    lines = [f"# HELP {name} {help_text}", f"# TYPE {name} histogram"]
-    cumulative = 0
-    remaining = sorted(samples)
-    idx = 0
-    for bound in BUCKETS_US:
-        while idx < len(remaining) and remaining[idx] <= bound:
-            idx += 1
-        cumulative = idx
-        lines.append(f'{name}_bucket{{le="{bound:g}"}} {cumulative}')
-    lines.append(f'{name}_bucket{{le="+Inf"}} {len(remaining)}')
-    lines.append(f"{name}_sum {sum(remaining):g}")
-    lines.append(f"{name}_count {len(remaining)}")
-    return "\n".join(lines)
-
-
 def render_metrics(sched: Scheduler) -> str:
-    m = sched.metrics
-    parts = [
-        "# HELP scheduler_pods_scheduled_total Pods successfully bound.",
-        "# TYPE scheduler_pods_scheduled_total counter",
-        f"scheduler_pods_scheduled_total {m.scheduled}",
-        "# HELP scheduler_pods_failed_total Scheduling attempts that failed.",
-        "# TYPE scheduler_pods_failed_total counter",
-        f"scheduler_pods_failed_total {m.failed}",
-        "# HELP scheduler_binding_errors_total Bind writes rejected.",
-        "# TYPE scheduler_binding_errors_total counter",
-        f"scheduler_binding_errors_total {m.binding_errors}",
-        "# HELP scheduler_batches_total Solver batches dispatched.",
-        "# TYPE scheduler_batches_total counter",
-        f"scheduler_batches_total {m.batches}",
-        _histogram("e2e_scheduling_latency_microseconds",
-                   "E2e scheduling latency (queue arrival to bind).",
-                   m.e2e_latency),
-        _histogram("scheduling_algorithm_latency_microseconds",
-                   "Scheduling algorithm (device solve) latency.",
-                   m.algorithm_latency),
-        _histogram("binding_latency_microseconds",
-                   "Binding latency per pod.",
-                   m.binding_latency),
-    ]
-    return "\n".join(parts) + "\n"
+    """The driver's (usually private) registry plus the process-global
+    one. Family names don't overlap: scheduler families live on the
+    driver's registry, workqueue/informer families on the global one."""
+    text = sched.metrics.registry.render()
+    if sched.metrics.registry is not obs_metrics.REGISTRY:
+        text += obs_metrics.REGISTRY.render()
+    return text
 
 
 class SchedulerServer:
-    """Asyncio HTTP server for /healthz and /metrics."""
+    """Asyncio HTTP server for /metrics, /healthz and /readyz."""
 
     def __init__(self, sched: Scheduler, host: str = "127.0.0.1",
                  port: int = 0):
@@ -106,22 +76,22 @@ class SchedulerServer:
                 if line in (b"\r\n", b"\n", b""):
                     break
             path = path.split("?", 1)[0].rstrip("/") or "/"
-            if method != "GET":
-                body, status, ctype = b"method not allowed", 405, "text/plain"
-            elif path in ("/", "/healthz"):
-                body, status, ctype = b"ok", 200, "text/plain"
-            elif path == "/metrics":
-                body = render_metrics(self.sched).encode()
-                status, ctype = 200, "text/plain; version=0.0.4"
+            if path == "/":  # healthz alias, kube-scheduler's root ping
+                path = "/healthz"
+            if method == "GET" and path == "/metrics":
+                status, body, ctype = (
+                    200, render_metrics(self.sched).encode(),
+                    METRICS_CONTENT_TYPE)
             else:
-                body, status, ctype = b"not found", 404, "text/plain"
-            reason = {200: "OK", 404: "Not Found",
-                      405: "Method Not Allowed"}.get(status, "Error")
-            writer.write(
-                f"HTTP/1.1 {status} {reason}\r\n"
-                f"Content-Type: {ctype}\r\n"
-                f"Content-Length: {len(body)}\r\n"
-                f"Connection: close\r\n\r\n".encode() + body)
+                resp = obs_response(
+                    method, path,
+                    ready_checks={
+                        "informers-synced": lambda: self.sched.synced})
+                if resp is None:
+                    status, body, ctype = 404, b"not found", "text/plain"
+                else:
+                    status, body, ctype = resp
+            writer.write(http_head(status, body, ctype))
             await writer.drain()
         except (ConnectionError, asyncio.IncompleteReadError):
             pass

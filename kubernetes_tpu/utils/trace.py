@@ -1,23 +1,63 @@
-"""In-request step timing, logged only when over threshold.
+"""In-request step timing: over-threshold logging plus exportable spans.
 
 The util/trace.Trace analog (reference apiserver/pkg/util/trace/trace.go:28-90;
 the scheduler wraps Schedule with trace.Step(...) + LogIfLong(100ms),
-core/generic_scheduler.go:89-126).
+core/generic_scheduler.go:89-126), extended into a span tracer: every
+finished trace can feed a registry histogram family (per-step durations)
+and a structured-JSON sink, while the log line stays thresholded.
+
+The sink is process-global: `set_trace_sink(callable | path | None)`, or
+the KTPU_TRACE_FILE environment variable (one JSON object per line,
+append mode) read at import.
 """
 
 from __future__ import annotations
 
+import json
 import logging
+import os
 import time
+from typing import Callable
 
 log = logging.getLogger("kubernetes_tpu.trace")
 
+_sink: Callable[[dict], None] | None = None
+
+
+def set_trace_sink(sink) -> None:
+    """Install the structured trace sink: a callable(dict), a file path
+    (JSON lines, appended), or None to disable."""
+    global _sink
+    if sink is None or callable(sink):
+        _sink = sink
+        return
+    f = open(sink, "a", encoding="utf-8")
+
+    def write(record: dict) -> None:
+        f.write(json.dumps(record) + "\n")
+        f.flush()
+
+    _sink = write
+
+
+def trace_sink() -> Callable[[dict], None] | None:
+    return _sink
+
+
+if os.environ.get("KTPU_TRACE_FILE"):
+    set_trace_sink(os.environ["KTPU_TRACE_FILE"])
+
 
 class StepTimer:
-    def __init__(self, name: str):
+    """Named step spans off one start point. `step_hist`, when given, is a
+    histogram family labeled by step name; each finished trace observes
+    its per-step durations there (log_if_long is the finish point)."""
+
+    def __init__(self, name: str, step_hist=None):
         self.name = name
         self.start = time.monotonic()
         self.steps: list[tuple[str, float]] = []
+        self.step_hist = step_hist
 
     def step(self, label: str) -> None:
         self.steps.append((label, time.monotonic()))
@@ -25,15 +65,40 @@ class StepTimer:
     def total(self) -> float:
         return time.monotonic() - self.start
 
+    def spans(self) -> list[tuple[str, float]]:
+        """-> [(step label, duration seconds)] between consecutive marks."""
+        prev = self.start
+        out = []
+        for label, t in self.steps:
+            out.append((label, t - prev))
+            prev = t
+        return out
+
+    def export(self, total: float | None = None) -> None:
+        """Feed the step histogram and the JSON sink (no-ops when neither
+        is configured)."""
+        spans = None
+        if self.step_hist is not None:
+            spans = self.spans()
+            for label, dur in spans:
+                self.step_hist.labels(label).observe(dur)
+        if _sink is not None:
+            spans = spans if spans is not None else self.spans()
+            _sink({"ts": time.time(), "name": self.name,
+                   "total_ms": round(1e3 * (total if total is not None
+                                            else self.total()), 3),
+                   "steps": [{"step": label, "ms": round(1e3 * dur, 3)}
+                             for label, dur in spans]})
+
     def log_if_long(self, threshold: float) -> bool:
+        """Finish the trace: always export spans; log only when the total
+        exceeds `threshold` (the reference's LogIfLong contract)."""
         total = self.total()
+        self.export(total=total)
         if total < threshold:
             return False
-        prev = self.start
-        parts = []
-        for label, t in self.steps:
-            parts.append(f"{label}: {1e3 * (t - prev):.1f}ms")
-            prev = t
+        parts = [f"{label}: {1e3 * dur:.1f}ms"
+                 for label, dur in self.spans()]
         log.warning("trace %s (total %.1fms): %s",
                     self.name, 1e3 * total, "; ".join(parts))
         return True
