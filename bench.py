@@ -18,7 +18,10 @@ vs_baseline = headline value / 30.
 
 Prints exactly ONE JSON line on stdout (headline metric + per-config
 extras). Diagnostics go to stderr. Env overrides: BENCH_NODES, BENCH_PODS,
-BENCH_TIMEOUT_S, BENCH_CONFIGS (comma list of headline,interpod,spread).
+BENCH_TIMEOUT_S, BENCH_CONFIGS (comma list of
+headline,interpod,spread,gang,recovery,device), BENCH_GANG_NODES /
+BENCH_GANG_PODS / BENCH_GANG_SIZE (gang config shape, default 50k nodes /
+24576 pods in 8-wide groups).
 
 --metrics-snapshot (or BENCH_METRICS_SNAPSHOT=1) embeds the scheduler's
 per-phase registry histograms (encode/flush/dispatch/solve/bind/commit:
@@ -54,7 +57,7 @@ def main() -> None:
     n_nodes = int(os.environ.get("BENCH_NODES", "15000"))
     n_pods = int(os.environ.get("BENCH_PODS", "30000"))
     configs = os.environ.get("BENCH_CONFIGS",
-                             "headline,interpod,spread,recovery,device")
+                             "headline,interpod,spread,gang,recovery,device")
     configs = [c.strip() for c in configs.split(",") if c.strip()]
     metrics_snapshot = "--metrics-snapshot" in sys.argv[1:] or \
         os.environ.get("BENCH_METRICS_SNAPSHOT", "") in ("1", "true")
@@ -113,6 +116,34 @@ def main() -> None:
         if metrics_snapshot:
             extras["spread_phase_hist"] = r.phase_hist
 
+    if "gang" in configs:
+        # gang scheduling at TPU-pod scale: 50k nodes, every pod a member
+        # of an 8-wide all-or-nothing group (the multi-host-slice shape) —
+        # measures the group-revert solver + group-aware driver end to end
+        gang_nodes = int(os.environ.get("BENCH_GANG_NODES", "50000"))
+        gang_pods = int(os.environ.get("BENCH_GANG_PODS", "24576"))
+        gang_size = int(os.environ.get("BENCH_GANG_SIZE", "8"))
+        gang_pods -= gang_pods % gang_size  # no trailing partial group
+        r = run_throughput(gang_nodes, gang_pods,
+                           node_kwargs={"zones": 3},
+                           pod_kwargs={"gang_size": gang_size})
+        print(f"bench[gang]: {r} | {r.metrics}", file=sys.stderr, flush=True)
+        key = f"gang_{gang_nodes // 1000}k_pods_per_sec"
+        extras[key] = round(r.pods_per_sec, 1)
+        extras["gang_vs_baseline"] = round(r.pods_per_sec / baseline, 2)
+        gang_stats = r.metrics.get("gang", {})
+        extras["gang_groups_placed"] = gang_stats.get("placed", 0)
+        extras["gang_groups_reverted"] = gang_stats.get("reverted", 0)
+        expected_groups = gang_pods // gang_size
+        if gang_stats.get("placed", 0) + gang_stats.get("reverted", 0) \
+                < expected_groups:
+            RESULT["error"] = (
+                f"gang bench: only "
+                f"{gang_stats.get('placed', 0) + gang_stats.get('reverted', 0)}"
+                f"/{expected_groups} groups settled")
+        if metrics_snapshot:
+            extras["gang_phase_hist"] = r.phase_hist
+
     if "recovery" in configs:
         from kubernetes_tpu.perf.harness import run_recovery
 
@@ -152,9 +183,10 @@ def main() -> None:
         # device perf regression gate (bench-side, on the real chip — the
         # CPU-mesh pytest floor cannot see TPU regressions): the round-5
         # recorded steady state is 53.1k (deep) / 49.2k (P=4096); tunnel-day
-        # swing on these chained-compute numbers is <5%, so an 80% floor
-        # (42.5k deep) only trips on a real compiled-program regression.
-        gate_floor = float(os.environ.get("BENCH_DEVICE_GATE", "42500"))
+        # swing on these chained-compute numbers is <5%, so a 50k floor
+        # (~94% of the recorded deep rate) trips on any real compiled-program
+        # regression — in particular a gang-gate leak into non-gang batches.
+        gate_floor = float(os.environ.get("BENCH_DEVICE_GATE", "50000"))
         extras["device_gate_floor_pods_per_sec"] = gate_floor
         extras["device_gate_ok"] = bool(rd.pods_per_sec >= gate_floor)
         if not extras["device_gate_ok"]:
@@ -165,7 +197,10 @@ def main() -> None:
     if RESULT["value"] is None and extras:
         # headline config not selected: promote the first metric actually
         # run so a filtered invocation is distinguishable from a failed one
-        for key in ("interpod_5k_pods_per_sec", "spread_15k_pods_per_sec"):
+        gang_keys = [k for k in extras
+                     if k.startswith("gang_") and k.endswith("_pods_per_sec")]
+        for key in ("interpod_5k_pods_per_sec", "spread_15k_pods_per_sec",
+                    *gang_keys):
             if key in extras:
                 RESULT["metric"] = key
                 RESULT["value"] = extras[key]

@@ -260,24 +260,42 @@ class VolumeManager:
 
     def mount_pod(self, pod: Pod) -> list[Mount]:
         """Mount every declared volume or raise MountError (all-or-nothing:
-        a pod with any unmountable volume must not start)."""
+        a pod with any unmountable volume must not start). A failure part
+        way through rolls the earlier mounts back before raising — a cloud
+        disk attached for a pod that never starts would otherwise hold its
+        single-writer lock (and the attach) until a pod with the same key
+        was deleted on this exact node."""
         mounts: list[Mount] = []
         for vol in pod.spec.volumes:
             plugin = self._plugin_for(vol)
             if plugin is None:
+                self._unmount_all(mounts)
                 raise MountError(
                     f"no plugin for volume {vol.get('name')!r} "
                     f"(sources: {sorted(k for k in vol if k != 'name')})")
-            mounts.append(plugin.mount(pod, vol, self.node_name))
+            try:
+                mounts.append(plugin.mount(pod, vol, self.node_name))
+            except Exception:
+                self._unmount_all(mounts)
+                raise
         self._mounts[pod.key] = mounts
         return mounts
 
-    def unmount_pod(self, pod_key: str) -> None:
-        for mount in self._mounts.pop(pod_key, ()):
+    def _unmount_all(self, mounts: list[Mount]) -> None:
+        """Best-effort teardown of a mount list, newest first (the partial
+        set never entered the mount table, so unmount_pod can't reach it)."""
+        for mount in reversed(mounts):
             plugin = next((p for p in self.plugins
                            if getattr(p, "name", "") == mount.plugin), None)
-            if plugin is not None and hasattr(plugin, "unmount"):
+            if plugin is None or not hasattr(plugin, "unmount"):
+                continue
+            try:
                 plugin.unmount(mount, self.node_name)
+            except Exception:  # noqa: BLE001 — rollback must not mask
+                pass           # the original mount failure
+
+    def unmount_pod(self, pod_key: str) -> None:
+        self._unmount_all(self._mounts.pop(pod_key, []))
 
     def mounts(self, pod_key: str) -> list[Mount]:
         return list(self._mounts.get(pod_key, ()))

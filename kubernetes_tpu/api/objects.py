@@ -1063,6 +1063,37 @@ class Job(_Workload):
 
 
 @dataclass
+class PodGroup(_SpecStatusObject):
+    """Gang-scheduling group: spec.minMember pods must place atomically or
+    none do (the coscheduling PodGroup shape — kube-batch/scheduler-plugins
+    PodGroup CRD — over this tree's all-or-nothing batched solver).
+
+    spec: minMember (int, required), scheduleTimeoutSeconds (float,
+    optional — pending members requeue once a group waits this long for
+    quorum). status: phase Pending | Placing | Placed | Timeout, plus the
+    gang controller's counters (placed, members)."""
+
+    kind = "PodGroup"
+    api_version = "scheduling.ktpu.io/v1alpha1"
+
+    PHASES = ("Pending", "Placing", "Placed", "Timeout")
+
+    @property
+    def min_member(self) -> int:
+        m = self.spec.get("minMember")
+        return 1 if m is None else int(m)
+
+    @property
+    def schedule_timeout_seconds(self) -> float:
+        t = self.spec.get("scheduleTimeoutSeconds")
+        return float(t) if t is not None else 30.0
+
+    @property
+    def phase(self) -> str:
+        return self.status.get("phase") or "Pending"
+
+
+@dataclass
 class _DataObject:
     """Shared shape of the data-map kinds (Secret/ConfigMap): metadata + a
     string-keyed payload map (reference staging/src/k8s.io/api/core/v1/

@@ -359,16 +359,22 @@ class WebhookAuthorizer:
     """SubjectAccessReview over HTTP (plugin/pkg/auth/authorizer/webhook/
     webhook.go:153): POST a SAR for each decision, read status.allowed.
     Allowed decisions cache for `authorized_ttl` seconds (webhook.go's
-    --authorization-webhook-cache-authorized-ttl); denials are not cached,
-    so a new grant takes effect immediately. An unreachable webhook denies
-    (fail closed, like the reference's error path)."""
+    --authorization-webhook-cache-authorized-ttl); denials cache for the
+    much shorter `unauthorized_ttl` (the reference's
+    --authorization-webhook-cache-unauthorized-ttl, default 30s vs our 10s)
+    so a retry storm from a denied client doesn't hammer the webhook while
+    a new grant still takes effect quickly. An unreachable webhook denies
+    (fail closed, like the reference's error path) without caching — an
+    outage must not pin denials past its own end."""
 
     def __init__(self, url: str, authorized_ttl: float = 60.0,
-                 timeout: float = 5.0):
+                 timeout: float = 2.0, unauthorized_ttl: float = 10.0):
         self.url = url
         self.authorized_ttl = authorized_ttl
+        self.unauthorized_ttl = unauthorized_ttl
         self.timeout = timeout
         self._cache: dict[tuple, float] = {}
+        self._denied: dict[tuple, float] = {}
 
     def authorize(self, user, verb: str, resource: str,
                   namespace: str, name: str = "") -> bool:
@@ -381,6 +387,9 @@ class WebhookAuthorizer:
         expires = self._cache.get(key)
         if expires is not None and expires > time.monotonic():
             return True
+        expires = self._denied.get(key)
+        if expires is not None and expires > time.monotonic():
+            return False
         review = {
             "kind": "SubjectAccessReview",
             "spec": {
@@ -408,6 +417,12 @@ class WebhookAuthorizer:
                 now = time.monotonic()
                 self._cache = {k: v for k, v in self._cache.items()
                                if v > now}
+        else:
+            self._denied[key] = time.monotonic() + self.unauthorized_ttl
+            if len(self._denied) > 4096:
+                now = time.monotonic()
+                self._denied = {k: v for k, v in self._denied.items()
+                                if v > now}
         return allowed
 
 
@@ -435,4 +450,9 @@ def impersonate(authorizer, user: UserInfo,
     for g in groups:
         if not authorizer.authorize(user, "impersonate", "groups", "", g):
             return None, False
+    # every impersonated identity is an authenticated one — the reference
+    # unconditionally appends system:authenticated (impersonation.go:124)
+    # so RBAC rules bound to that group keep applying to the new identity
+    if "system:authenticated" not in groups:
+        groups = groups + ("system:authenticated",)
     return UserInfo(name=target, groups=groups), True

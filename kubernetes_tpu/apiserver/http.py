@@ -87,6 +87,8 @@ RESOURCES: dict[str, str] = {
     "horizontalpodautoscalers": "HorizontalPodAutoscaler",
     "poddisruptionbudgets": "PodDisruptionBudget",
     "apiservices": "APIService",
+    # scheduling.ktpu.io (gang scheduling)
+    "podgroups": "PodGroup",
     "roles": "Role",
     "clusterroles": "ClusterRole",
     "rolebindings": "RoleBinding",
@@ -108,8 +110,9 @@ KIND_TO_CLS = {cls.kind: cls for cls in (
     objs.Namespace, objs.CustomResourceDefinition, objs.Cluster,
     objs.Secret, objs.ConfigMap, objs.ServiceAccount, objs.DaemonSet,
     objs.CronJob, objs.HorizontalPodAutoscaler, objs.PodDisruptionBudget,
-    objs.APIService, objs.Role, objs.ClusterRole, objs.RoleBinding,
-    objs.ClusterRoleBinding, objs.CertificateSigningRequest)}
+    objs.APIService, objs.PodGroup, objs.Role, objs.ClusterRole,
+    objs.RoleBinding, objs.ClusterRoleBinding,
+    objs.CertificateSigningRequest)}
 PLURAL_OF = {kind: plural for plural, kind in RESOURCES.items()}
 
 _req_mx: tuple | None = None
@@ -278,6 +281,7 @@ class APIServer:
         self.port = port
         self.authenticator = authenticator
         self.authorizer = authorizer
+        self._authz_blocking: bool | None = None  # resolved on first request
         # secure serving (apiserver/pkg/server/secure_serving.go:
         # --tls-cert-file/--tls-private-key-file); None = plaintext
         self.tls_cert_file = tls_cert_file
@@ -325,6 +329,18 @@ class APIServer:
         resource = _resource_of(path)
         mx[0].labels(method, resource, str(status)).inc()
         mx[1].labels(method, resource).observe(1e6 * seconds)
+
+    def _authz_blocks(self) -> bool:
+        """True when the authorizer chain can do network I/O (a webhook
+        SAR POST): those decisions must run off the event loop or one slow
+        webhook stalls every connection."""
+        from kubernetes_tpu.apiserver.auth import WebhookAuthorizer
+
+        a = self.authorizer
+        if isinstance(a, WebhookAuthorizer):
+            return True
+        chain = getattr(a, "authorizers", None) or ()
+        return any(isinstance(x, WebhookAuthorizer) for x in chain)
 
     def _authfilter(self, method: str, path: str,
                     headers: dict[str, str], peercert: dict | None = None):
@@ -441,10 +457,19 @@ class APIServer:
                     loads = _wire_loads
                 else:
                     loads = json.loads
-                denied, user = self._authfilter(
-                    "GET" if query.get("watch") in ("1", "true") else method,
-                    url.path, headers,
-                    peercert=writer.get_extra_info("peercert"))
+                if self._authz_blocking is None:
+                    self._authz_blocking = self._authz_blocks()
+                auth_verb = "GET" if query.get("watch") in ("1", "true") \
+                    else method
+                peercert = writer.get_extra_info("peercert")
+                if self._authz_blocking:
+                    # webhook SAR does a blocking POST: keep the loop free
+                    denied, user = await asyncio.to_thread(
+                        self._authfilter, auth_verb, url.path, headers,
+                        peercert)
+                else:
+                    denied, user = self._authfilter(auth_verb, url.path,
+                                                    headers, peercert)
                 if denied is not None:
                     nbytes = await _respond(writer, *denied)
                     lat = _time.perf_counter() - t_start
@@ -1214,16 +1239,37 @@ class RemoteStore:
         return (f"Authorization: Bearer {self.token}\r\n"
                 if self.token else "")
 
+    # overall connect deadline; within it, transient failures (a server
+    # still binding its port after restart, a loaded box dropping SYNs,
+    # kernel accept-queue overflow resets) retry instead of surfacing —
+    # a fixed single-shot timeout made checkpoint-resume tests flake
+    # whenever the CI box was busy at the moment of the one attempt
+    connect_deadline_s = 30.0
+
     def _connect(self):
-        sock = socket.create_connection((self.host, self.port), timeout=30)
-        if self._ssl is not None:
+        import time as _time
+
+        deadline = _time.monotonic() + self.connect_deadline_s
+        delay = 0.05
+        while True:
+            remaining = deadline - _time.monotonic()
             try:
-                return self._ssl.wrap_socket(sock,
-                                             server_hostname=self.host)
-            except Exception:
-                sock.close()
-                raise
-        return sock
+                sock = socket.create_connection(
+                    (self.host, self.port), timeout=max(1.0, remaining))
+            except (ConnectionError, TimeoutError, OSError):
+                if _time.monotonic() + delay >= deadline:
+                    raise
+                _time.sleep(delay)
+                delay = min(1.0, 2 * delay)
+                continue
+            if self._ssl is not None:
+                try:
+                    return self._ssl.wrap_socket(sock,
+                                                 server_hostname=self.host)
+                except Exception:
+                    sock.close()
+                    raise
+            return sock
 
     # ---- blocking HTTP core (CRUD: small payloads on a trusted network) ----
 

@@ -42,6 +42,8 @@ def validate(obj: Any) -> None:
     elif kind in ("ReplicaSet", "ReplicationController", "StatefulSet",
                   "Deployment", "Job"):
         _validate_workload(obj)
+    elif kind == "PodGroup":
+        _validate_podgroup(obj)
 
 
 def _validate_quantities(where: str, quantities: dict) -> dict:
@@ -97,6 +99,28 @@ def _validate_service(svc) -> None:
                 f"spec.ports[{i}].port: invalid {port!r}")
         if not 0 < number <= 65535:
             raise ValidationError(f"spec.ports[{i}].port: invalid {port}")
+
+
+def _validate_podgroup(obj) -> None:
+    try:
+        min_member = obj.min_member
+    except (TypeError, ValueError):
+        raise ValidationError(
+            f"spec.minMember: invalid value {obj.spec.get('minMember')!r}")
+    if min_member < 1:
+        raise ValidationError("spec.minMember: must be >= 1")
+    try:
+        timeout = obj.schedule_timeout_seconds
+    except (TypeError, ValueError):
+        raise ValidationError(
+            f"spec.scheduleTimeoutSeconds: invalid value "
+            f"{obj.spec.get('scheduleTimeoutSeconds')!r}")
+    if timeout <= 0:
+        raise ValidationError("spec.scheduleTimeoutSeconds: must be > 0")
+    phase = obj.status.get("phase")
+    if phase and phase not in type(obj).PHASES:
+        raise ValidationError(
+            f"status.phase: unsupported value {phase!r}")
 
 
 def _validate_workload(obj) -> None:

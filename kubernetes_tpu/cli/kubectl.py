@@ -63,6 +63,7 @@ ALIASES = {
     "horizontalpodautoscaler": "horizontalpodautoscalers",
     "pdb": "poddisruptionbudgets",
     "poddisruptionbudget": "poddisruptionbudgets",
+    "pg": "podgroups", "podgroup": "podgroups",
     "quota": "resourcequotas", "resourcequota": "resourcequotas",
     "limits": "limitranges", "limitrange": "limitranges",
     "crd": "customresourcedefinitions",
@@ -146,6 +147,10 @@ def _row(kind: str, obj, wide: bool) -> list[str]:
     if kind == "Event":
         return [obj.metadata.name, obj.type, obj.reason,
                 str(getattr(obj, "count", 1)), obj.message[:60]]
+    if kind == "PodGroup":
+        status = obj.status or {}
+        return [obj.metadata.name, obj.phase,
+                f"{status.get('placed', 0)}/{obj.min_member}", _age(obj)]
     return [obj.metadata.name, _age(obj)]
 
 
@@ -161,6 +166,7 @@ HEADERS = {
     "Service": ["NAME", "AGE"],
     "Endpoints": ["NAME", "ADDRESSES", "AGE"],
     "Event": ["NAME", "TYPE", "REASON", "COUNT", "MESSAGE"],
+    "PodGroup": ["NAME", "PHASE", "PLACED", "AGE"],
 }
 
 

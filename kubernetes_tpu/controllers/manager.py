@@ -136,6 +136,13 @@ class ControllerManager:
         self.csr = CSRController(
             store, self.informers["CertificateSigningRequest"])
         self.controllers.append(self.csr)
+        from kubernetes_tpu.gang.controller import GangController
+
+        # gang/PodGroup reconciliation (materializes groups from annotated
+        # parallel workloads; carries its own informers — it watches
+        # PodGroup, which the shared factory set predates)
+        self.gang = GangController(store)
+        self.controllers.append(self.gang)
         if cloud is not None:
             from kubernetes_tpu.controllers.service_lb import (
                 ServiceLBController,

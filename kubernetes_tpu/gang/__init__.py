@@ -1,0 +1,56 @@
+"""Gang scheduling: all-or-nothing placement of pod groups.
+
+The TPU-first capability the batched solver exists for: a multi-host slice
+workload (a 256-host pjit job) is useless at 255 placements, so its pods
+must place atomically or not at all. The subsystem spans four layers:
+
+- `api/objects.py PodGroup` — the coscheduling group object (minMember
+  quorum, schedule timeout, phase);
+- `state/pod_batch.py` — per-pod gang_id/gang_min columns, groups
+  contiguous in the batch;
+- `ops/solver.py` — the group-revert scan carry (BatchFlags.gang): a group
+  that exits the scan below quorum restores its entry ledger snapshot so no
+  partial gang ever reaches bind;
+- `scheduler/driver.py` — stages annotated pods per group, admits a group
+  into a batch only whole and only at quorum, requeues reverted groups with
+  group-level backoff, and releases members for individual scheduling when
+  quorum never arrives within the timeout;
+- `gang/controller.py` — materializes PodGroups from gang-annotated
+  parallel workloads and reconciles their phase from observed bindings.
+
+Pods opt in with the `scheduling.ktpu.io/group-name` annotation (the
+pod-group label convention of kube-batch / scheduler-plugins coscheduling,
+as an annotation so plain v1 pods carry it).
+"""
+
+from __future__ import annotations
+
+# group membership: pods carrying the same group-name annotation in one
+# namespace form a gang
+GROUP_NAME_ANNOTATION = "scheduling.ktpu.io/group-name"
+# quorum override carried on pods or workloads when no PodGroup exists yet
+GROUP_MIN_ANNOTATION = "scheduling.ktpu.io/group-min"
+# quorum-wait override (seconds) on workloads the controller materializes
+GROUP_TIMEOUT_ANNOTATION = "scheduling.ktpu.io/group-timeout-seconds"
+
+DEFAULT_SCHEDULE_TIMEOUT_S = 30.0
+
+
+def pod_group_key(pod) -> str | None:
+    """\"namespace/groupname\" for a gang-annotated pod, else None."""
+    name = pod.metadata.annotations.get(GROUP_NAME_ANNOTATION)
+    if not name:
+        return None
+    return f"{pod.metadata.namespace}/{name}"
+
+
+def annotation_min(obj) -> int | None:
+    """The group-min annotation as an int, None when absent/invalid."""
+    raw = obj.metadata.annotations.get(GROUP_MIN_ANNOTATION)
+    if raw is None:
+        return None
+    try:
+        value = int(raw)
+    except (TypeError, ValueError):
+        return None
+    return value if value >= 1 else None

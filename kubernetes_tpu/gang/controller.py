@@ -1,0 +1,214 @@
+"""Gang controller: PodGroups materialized from parallel workloads, phase
+reconciled from observed bindings.
+
+The controller side of gang scheduling (the scheduler driver does the
+actual atomic admission; see gang/__init__.py for the layer map):
+
+- a Job or ReplicaSet carrying the group-name annotation (on its own
+  metadata or its pod template) declares a gang — the controller creates
+  the matching PodGroup with minMember defaulted from the workload's
+  parallelism/replicas, so workload authors never hand-write group objects
+  (the kube-batch/scheduler-plugins shape, where a PodGroup CRD fronts the
+  coscheduling plugin);
+- the PodGroup's status tracks what the cluster actually shows: member and
+  placed counts from the pod informer, and a phase ladder
+  Pending -> Placing -> Placed, with Timeout when quorum has not arrived
+  within spec.scheduleTimeoutSeconds. Members of a timed-out group are
+  released atomically — one event per group, and the scheduler's own
+  timeout release (scheduler/driver.py) requeues them for individual
+  scheduling — rather than leaking a forever-Pending gang.
+"""
+
+from __future__ import annotations
+
+import time
+
+from kubernetes_tpu.api.objects import PodGroup
+from kubernetes_tpu.apiserver.store import Conflict, NotFound, ObjectStore
+from kubernetes_tpu.client.informer import Informer
+from kubernetes_tpu.controllers.base import ReconcileController
+from kubernetes_tpu.gang import (
+    DEFAULT_SCHEDULE_TIMEOUT_S,
+    GROUP_NAME_ANNOTATION,
+    GROUP_TIMEOUT_ANNOTATION,
+    annotation_min,
+    pod_group_key,
+)
+from kubernetes_tpu.utils.events import EventRecorder
+
+GANG_WORKLOAD_KINDS = ("Job", "ReplicaSet")
+
+
+def workload_group_name(obj) -> str | None:
+    """The gang a workload declares: its own annotation, else its pod
+    template's (workload authors usually annotate the template so the
+    created pods inherit membership)."""
+    name = obj.metadata.annotations.get(GROUP_NAME_ANNOTATION)
+    if name:
+        return name
+    template = (obj.spec.get("template") or {})
+    annotations = ((template.get("metadata") or {})
+                   .get("annotations") or {})
+    return annotations.get(GROUP_NAME_ANNOTATION) or None
+
+
+def workload_min_member(obj) -> int:
+    """Quorum a workload implies: explicit group-min annotation, else the
+    whole parallel width (a pjit job needs every host)."""
+    explicit = annotation_min(obj)
+    if explicit is not None:
+        return explicit
+    if obj.kind == "Job":
+        return max(1, obj.parallelism)
+    return max(1, obj.replicas)
+
+
+class GangController(ReconcileController):
+    """Reconciles one key per gang: \"namespace/groupname\"."""
+
+    workers = 2
+
+    def __init__(self, store: ObjectStore):
+        super().__init__()
+        self.name = "gang-controller"
+        self.store = store
+        self.events = EventRecorder(store)
+        self.podgroups = Informer(store, "PodGroup")
+        self.pods = Informer(store, "Pod")
+        self.workloads = [Informer(store, kind)
+                          for kind in GANG_WORKLOAD_KINDS]
+        self.podgroups.add_handler(self._on_podgroup)
+        self.pods.add_handler(self._on_pod)
+        for informer in self.workloads:
+            informer.add_handler(self._on_workload)
+
+    async def start(self) -> None:
+        await super().start()
+        self.podgroups.start()
+        self.pods.start()
+        for informer in self.workloads:
+            informer.start()
+        await self.podgroups.wait_for_sync()
+        await self.pods.wait_for_sync()
+        for informer in self.workloads:
+            await informer.wait_for_sync()
+
+    def stop(self) -> None:
+        super().stop()
+        self.podgroups.stop()
+        self.pods.stop()
+        for informer in self.workloads:
+            informer.stop()
+
+    # ---- informer handlers ----
+
+    def _on_podgroup(self, event) -> None:
+        obj = event.obj
+        self.enqueue(f"{obj.metadata.namespace}/{obj.metadata.name}")
+
+    def _on_pod(self, event) -> None:
+        key = pod_group_key(event.obj)
+        if key is not None:
+            self.enqueue(key)
+
+    def _on_workload(self, event) -> None:
+        name = workload_group_name(event.obj)
+        if name is not None:
+            self.enqueue(f"{event.obj.metadata.namespace}/{name}")
+
+    # ---- reconcile ----
+
+    def _declaring_workloads(self, namespace: str, name: str) -> list:
+        return [obj for informer in self.workloads
+                for obj in informer.items()
+                if obj.metadata.namespace == namespace
+                and workload_group_name(obj) == name]
+
+    def _members(self, namespace: str, name: str) -> tuple[int, int, float]:
+        """(members, placed, oldest_pending_age_s) from the pod cache."""
+        members = placed = 0
+        oldest = None
+        now = time.time()
+        for pod in self.pods.items():
+            if pod.metadata.namespace != namespace:
+                continue
+            if pod.metadata.annotations.get(GROUP_NAME_ANNOTATION) != name:
+                continue
+            members += 1
+            if pod.spec.node_name:
+                placed += 1
+            else:
+                created = getattr(pod.metadata, "creation_timestamp", None)
+                if created:
+                    age = max(0.0, now - created)
+                    oldest = age if oldest is None else max(oldest, age)
+        return members, placed, oldest or 0.0
+
+    async def sync(self, key: str) -> None:
+        namespace, name = key.split("/", 1)
+        group = self.podgroups.get(name, namespace)
+        declaring = self._declaring_workloads(namespace, name)
+
+        if group is None:
+            if not declaring:
+                return  # nothing declares this gang anymore
+            # materialize the PodGroup from the widest declaring workload
+            # (two workloads sharing a group name pool their quorum needs)
+            min_member = max(workload_min_member(w) for w in declaring)
+            spec: dict = {"minMember": min_member}
+            for w in declaring:
+                raw = w.metadata.annotations.get(GROUP_TIMEOUT_ANNOTATION)
+                if raw:
+                    try:
+                        spec["scheduleTimeoutSeconds"] = float(raw)
+                    except (TypeError, ValueError):
+                        pass
+                    break
+            group = PodGroup.from_dict({
+                "metadata": {"name": name, "namespace": namespace},
+                "spec": spec,
+                "status": {"phase": "Pending"},
+            })
+            try:
+                self.store.create(group)
+            except Conflict:
+                pass  # another worker won the race; resync picks it up
+            return
+
+        members, placed, oldest_age = self._members(namespace, name)
+        min_member = group.min_member
+        timeout = group.schedule_timeout_seconds or DEFAULT_SCHEDULE_TIMEOUT_S
+        if placed >= min_member:
+            phase = "Placed"
+        elif placed > 0:
+            phase = "Placing"
+        elif members > 0 and oldest_age > timeout:
+            phase = "Timeout"
+        else:
+            phase = "Pending"
+
+        status = {"phase": phase, "placed": placed, "members": members}
+        if all(group.status.get(k) == v for k, v in status.items()):
+            if phase in ("Pending", "Placing") and members > 0:
+                # come back to flip to Timeout even with no further events
+                self.enqueue_after(key, timeout)
+            return
+
+        def mutate(obj):
+            obj.status.update(status)
+            return obj
+
+        try:
+            self.store.guaranteed_update("PodGroup", name, namespace, mutate)
+        except (NotFound, Conflict):
+            return
+        if phase == "Timeout" and group.status.get("phase") != "Timeout":
+            # one group-level event; the scheduler's quorum-timeout release
+            # requeues the members themselves
+            self.events.record(
+                group, "Warning", "GangTimeout",
+                f"pod group {key} waited {timeout:.0f}s without reaching "
+                f"quorum ({placed}/{min_member} placed, {members} members); "
+                f"members released for individual scheduling")
+        if phase in ("Pending", "Placing") and members > 0:
+            self.enqueue_after(key, timeout)

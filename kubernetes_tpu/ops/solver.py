@@ -87,6 +87,10 @@ class BatchFlags:
     storage: bool = True  # any scratch/overlay request in batch: same —
                           # the storage fallthrough logic (predicates.go:
                           # 590-605) becomes assignment-independent
+    gang: bool = True     # any gang member (gang_id > 0) in batch: with none
+                          # the group-revert carry extension is dead weight —
+                          # whole-ledger selects per scan step — so the gate
+                          # keeps the non-gang program untaxed
 
 
 ALL_ACTIVE = BatchFlags()
@@ -210,6 +214,7 @@ def batch_flags(batch: PodBatch, n_pods: int, table) -> BatchFlags:
         gpu=any_(batch.requests[:, Resource.GPU]),
         storage=any_(batch.requests[:, Resource.SCRATCH])
         or any_(batch.requests[:, Resource.OVERLAY]),
+        gang=any_(batch.gang_id > 0),
     )
 
 
@@ -259,6 +264,26 @@ class Carry:
     vol_any: object = None      # f32[N, UV] | None
     vol_rw: object = None
     attach_count: object = None  # f32[N, UA] | None
+    # gang group-revert extension (BatchFlags.gang; None when gated off).
+    # gang_snap holds the whole live ledger (incl. rr) as of the current
+    # group's entry; a group that exits with fewer than gang_min_cur placed
+    # members restores it wholesale — the batched analog of forgetting every
+    # AssumePod of a gang that cannot complete. Whole-ledger selects per
+    # step are the known cost (see the fused-ledger note above); they are
+    # only ever compiled into gang-gated programs.
+    gang_snap: object = None     # ledger tuple | None
+    gang_cur: object = None      # i32 current group id, 0 = not in a group
+    gang_placed: object = None   # i32 members assigned in the current group
+    gang_min_cur: object = None  # i32 current group's quorum
+
+
+def _live_ledger(c: Carry):
+    """The revertible ledger as one pytree — every assignment-dependent
+    count a gang revert must restore, the round-robin counter included (a
+    reverted member's rr bump must not survive, or tie-breaks downstream of
+    a failed gang would diverge from the serial oracle)."""
+    return (c.requested, c.nonzero, c.port_count, c.rr,
+            c.ipa, c.vol_any, c.vol_rw, c.attach_count)
 
 
 def _static_mask(state: ClusterState, pod, policy: Policy,
@@ -391,9 +416,9 @@ def _base_rows(state: ClusterState, policy: Policy, prows,
 
 
 def _init_carry(state: ClusterState, g: PolicyGates, rr_start,
-                domain_universe: int) -> Carry:
+                domain_universe: int, use_gang: bool = False) -> Carry:
     """The assume ledger as of batch start — the accounted cluster state."""
-    return Carry(
+    carry = Carry(
         requested=state.requested,
         nonzero=state.nonzero_requested,
         port_count=state.port_count,
@@ -405,6 +430,14 @@ def _init_carry(state: ClusterState, g: PolicyGates, rr_start,
         vol_rw=state.vol_rw if g.use_nodisk else None,
         attach_count=state.attach_count if g.attach_maxes else None,
     )
+    if use_gang:
+        carry = carry.replace(
+            gang_snap=_live_ledger(carry),
+            gang_cur=jnp.int32(0),
+            gang_placed=jnp.int32(0),
+            gang_min_cur=jnp.int32(0),
+        )
+    return carry
 
 
 def _pod_eval(state: ClusterState, g: PolicyGates, carry: Carry, pod,
@@ -519,6 +552,7 @@ def schedule_batch(
     w_tt, w_na, use_ports, svcanti = g.w_tt, g.w_na, g.use_ports, g.svcanti
     use_terms, use_ip_ledger = g.use_terms, g.use_ip_ledger
     use_nodisk, attach_maxes = g.use_nodisk, g.attach_maxes
+    use_gang = flags.gang
     if prows is None and (svcanti or active_label_presence(policy)
                           or active_label_priorities(policy)):
         raise ValueError(
@@ -598,6 +632,33 @@ def schedule_batch(
         rest = list(xs[2:])
         p_counts = rest.pop(0) if w_tt else zero_i
         na_count = rest.pop(0) if w_na else zero_f
+        if use_gang:
+            # group boundary crossing: first settle the group being left —
+            # below quorum, restore its entry snapshot (forget every member
+            # charge, rr included) — then, if this pod opens a new group,
+            # snapshot the settled ledger as its revert point
+            gid = pod.gang_id
+            boundary = gid != carry.gang_cur
+            revert = boundary & (carry.gang_cur > 0) \
+                & (carry.gang_placed < carry.gang_min_cur)
+            ledger = jax.tree.map(
+                lambda cur, snap: jnp.where(revert, snap, cur),
+                _live_ledger(carry), carry.gang_snap)
+            entering = boundary & (gid > 0)
+            snap = jax.tree.map(
+                lambda led, sn: jnp.where(entering, led, sn),
+                ledger, carry.gang_snap)
+            requested, nonzero, port_count, rr, ipa, vol_any, vol_rw, \
+                attach_count = ledger
+            carry = Carry(
+                requested=requested, nonzero=nonzero,
+                port_count=port_count, rr=rr, ipa=ipa, vol_any=vol_any,
+                vol_rw=vol_rw, attach_count=attach_count,
+                gang_snap=snap, gang_cur=gid,
+                gang_placed=jnp.where(entering, jnp.int32(0),
+                                      carry.gang_placed),
+                gang_min_cur=jnp.where(entering, pod.gang_min,
+                                       carry.gang_min_cur))
         s_mask = ms_row > -jnp.inf
         feasible, score = _pod_eval(
             state, g, carry, pod, s_mask, ms_row, p_counts, na_count,
@@ -625,6 +686,13 @@ def schedule_batch(
                     if use_nodisk else None),
             attach_count=(carry.attach_count.at[node].add(add * pod.att_onehot)
                           if attach_maxes else None),
+            gang_snap=carry.gang_snap,
+            gang_cur=carry.gang_cur,
+            gang_placed=(carry.gang_placed
+                         + jnp.where(assigned & (carry.gang_cur > 0),
+                                     jnp.int32(1), jnp.int32(0))
+                         if use_gang else None),
+            gang_min_cur=carry.gang_min_cur,
         )
         # the feasible row is emitted whole and summed AFTER the scan (an
         # in-step scalar sum measured SLOWER: the reduction does not fuse
@@ -636,12 +704,42 @@ def schedule_batch(
                             jnp.where(assigned, best, 0.0)])
         return new_carry, (packed, feasible)
 
-    init = _init_carry(state, g, rr_start, domain_universe)
+    init = _init_carry(state, g, rr_start, domain_universe, use_gang=use_gang)
     final, (packed_out, feas_rows) = jax.lax.scan(
         step, init, tuple(xs_list))
     nodes = packed_out[:, 0].astype(jnp.int32)
     scores = packed_out[:, 1]
     counts = jnp.sum(feas_rows.astype(jnp.int32), axis=1)
+
+    if use_gang:
+        # close out the group still open at scan end (the step only settles
+        # groups on a boundary crossing; the last group has none)
+        revert_last = (final.gang_cur > 0) \
+            & (final.gang_placed < final.gang_min_cur)
+        requested, nonzero, port_count, rr, ipa, vol_any, vol_rw, \
+            attach_count = jax.tree.map(
+                lambda cur, snap: jnp.where(revert_last, snap, cur),
+                _live_ledger(final), final.gang_snap)
+        final = final.replace(
+            requested=requested, nonzero=nonzero, port_count=port_count,
+            rr=rr, ipa=ipa, vol_any=vol_any, vol_rw=vol_rw,
+            attach_count=attach_count)
+        # mask every member of a below-quorum group out of the result: the
+        # scan already forgot their ledger charges, and no partial gang may
+        # reach bind. Groups are contiguous runs of equal gang_id, so
+        # boundary-cumsum segment ids + one segment_sum of the per-row
+        # assigned bits give each group's placed count without an O(P^2)
+        # member-by-member comparison.
+        gid_col = batch.gang_id
+        seg = jnp.cumsum(jnp.concatenate(
+            [jnp.zeros((1,), jnp.int32),
+             (gid_col[1:] != gid_col[:-1]).astype(jnp.int32)]))
+        placed_per_seg = jax.ops.segment_sum(
+            (nodes >= 0).astype(jnp.int32), seg,
+            num_segments=gid_col.shape[0])
+        group_failed = (gid_col > 0) & (placed_per_seg[seg] < batch.gang_min)
+        nodes = jnp.where(group_failed, -1, nodes)
+        scores = jnp.where(group_failed, 0.0, scores)
 
     return SolverResult(
         assignments=nodes,

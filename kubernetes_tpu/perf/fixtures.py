@@ -58,6 +58,8 @@ def make_pods(
     app_groups: int = 0,
     anti_affinity_every: int = 0,
     pref_affinity_every: int = 0,
+    gang_size: int = 0,
+    gang_min: int | None = None,
 ) -> list[Pod]:
     """Templated pending pods (the basic scheduler_perf pod spec: small
     cpu/memory requests).
@@ -65,12 +67,22 @@ def make_pods(
     app_groups labels pods app=app-{i%g} (service/spread targets);
     anti_affinity_every adds required hostname anti-affinity against the
     pod's own app group; pref_affinity_every adds preferred zone affinity
-    toward it (the interpod-heavy config shape, BASELINE.md)."""
+    toward it (the interpod-heavy config shape, BASELINE.md);
+    gang_size groups consecutive pods into all-or-nothing gangs of that
+    size (quorum gang_min, default the full size) — keep n divisible by
+    gang_size or the trailing partial group waits out its quorum timeout."""
     out = []
     for i in range(n):
         meta: dict = {"name": f"{name_prefix}-{i}", "namespace": namespace}
         if app_groups:
             meta["labels"] = {"app": f"app-{i % app_groups}"}
+        if gang_size:
+            from kubernetes_tpu.gang import (GROUP_MIN_ANNOTATION,
+                                             GROUP_NAME_ANNOTATION)
+            meta["annotations"] = {
+                GROUP_NAME_ANNOTATION:
+                    f"{name_prefix}-gang-{i // gang_size}",
+                GROUP_MIN_ANNOTATION: str(gang_min or gang_size)}
         spec: dict = {"containers": [{
             "name": "app",
             "image": "k8s.gcr.io/pause:3.0",

@@ -29,6 +29,11 @@ from kubernetes_tpu.api.objects import Binding, Pod
 from kubernetes_tpu.apiserver.store import Conflict, NotFound, ObjectStore, WatchEvent
 from kubernetes_tpu.client.informer import Informer
 from kubernetes_tpu.client.workqueue import Backoff, BackoffQueue
+from kubernetes_tpu.gang import (
+    DEFAULT_SCHEDULE_TIMEOUT_S,
+    annotation_min,
+    pod_group_key,
+)
 from kubernetes_tpu.models.policy import DEFAULT_POLICY, Policy
 from kubernetes_tpu.obs import metrics as obs_metrics
 from kubernetes_tpu.ops.solver import schedule_batch
@@ -41,6 +46,10 @@ from kubernetes_tpu.utils.trace import StepTimer
 
 log = logging.getLogger(__name__)
 
+
+# queue-key namespace for gang groups: pod keys are "ns/name" (DNS-1123
+# names cannot contain ":"), so the prefix cannot collide
+_GANG_KEY_PREFIX = "gang:"
 
 # ExponentialBuckets(1000, 2, 15) in microseconds (reference metrics.go:36)
 LATENCY_BUCKETS_US = obs_metrics.exponential_buckets(1000.0, 2.0, 15)
@@ -94,6 +103,15 @@ class SchedulerMetrics:
         self._c_jit_misses = r.counter(
             "scheduler_jit_cache_misses_total",
             "Batches that compiled a new solver variant (BatchFlags).")
+        self._c_gang_placed = r.counter(
+            "scheduler_gang_groups_placed_total",
+            "Gangs whose quorum placed and bound atomically.")
+        self._c_gang_reverted = r.counter(
+            "scheduler_gang_groups_reverted_total",
+            "Gangs the solver reverted below quorum (no member bound).")
+        self._c_gang_timeouts = r.counter(
+            "scheduler_gang_groups_timeout_total",
+            "Gangs that timed out waiting for quorum; members released.")
         self._h_phase = r.histogram(
             "scheduler_phase_duration_seconds",
             "Per-batch scheduling phase durations "
@@ -107,6 +125,9 @@ class SchedulerMetrics:
         self._failed = 0
         self._binding_errors = 0
         self._batches = 0
+        self.gang_placed = 0
+        self.gang_reverted = 0
+        self.gang_timeouts = 0
         # bounded windows (the registry histograms are cumulative; the
         # windows keep the recent-sample percentiles snapshot() reports)
         self.e2e_latency = _LatencyWindow(r.histogram(
@@ -175,6 +196,18 @@ class SchedulerMetrics:
     def jit_miss(self) -> None:
         self._c_jit_misses.inc()
 
+    def gang_placed_inc(self) -> None:
+        self.gang_placed += 1
+        self._c_gang_placed.inc()
+
+    def gang_reverted_inc(self) -> None:
+        self.gang_reverted += 1
+        self._c_gang_reverted.inc()
+
+    def gang_timeout_inc(self) -> None:
+        self.gang_timeouts += 1
+        self._c_gang_timeouts.inc()
+
     def add_phase(self, name: str, seconds: float) -> None:
         self.phase_s[name] = self.phase_s.get(name, 0.0) + seconds
         self._h_phase.labels(name).observe(seconds)
@@ -207,6 +240,10 @@ class SchedulerMetrics:
             out["phase_us_per_pod"] = {
                 k: round(1e6 * v / self.phase_pods, 2)
                 for k, v in sorted(self.phase_s.items())}
+        if self.gang_placed or self.gang_reverted or self.gang_timeouts:
+            out["gang"] = {"placed": self.gang_placed,
+                           "reverted": self.gang_reverted,
+                           "timeouts": self.gang_timeouts}
         return out
 
 
@@ -300,11 +337,20 @@ class Scheduler:
         # replaces the O(nodes x pods) informer sweep per node event
         self._pods_by_node: dict[str, set[str]] = {}
         self._pod_node: dict[str, str] = {}
+        # gang staging: annotated members wait here until their group
+        # reaches quorum, then the whole group enters ONE batch (never
+        # split — the solver's revert window is a contiguous in-batch run)
+        self._gang_members: dict[str, set[str]] = {}
+        self._gang_of_pod: dict[str, str] = {}
+        self._gang_first_seen: dict[str, float] = {}
+        self._gang_min_hint: dict[str, int] = {}
 
         self.node_informer = Informer(store, "Node")
         self.pod_informer = Informer(store, "Pod")
+        self.podgroup_informer = Informer(store, "PodGroup")
         self.node_informer.add_handler(self._on_node_event)
         self.pod_informer.add_handler(self._on_pod_event)
+        self.podgroup_informer.add_handler(self._on_podgroup_event)
         # workload objects feed cached pod encodings (spreading entries):
         # any change invalidates the encode cache (the reference invalidates
         # its equivalence cache from the same informers, factory.go:160-250)
@@ -416,6 +462,7 @@ class Scheduler:
             self._assumed.discard(key)
             self._enqueue_time.pop(key, None)
             self._unindex_pod(key)
+            self._gang_forget(key)
             self.statedb.remove_pod(key)
             self.encode_cache.forget(key)
             return
@@ -426,6 +473,7 @@ class Scheduler:
                 self._pods_by_node.setdefault(
                     pod.spec.node_name, set()).add(key)
             self._enqueue_time.pop(key, None)
+            self._gang_forget(key)
             self.encode_cache.forget(key)
             if key in self._assumed:
                 # our own binding confirmed by the watch
@@ -436,11 +484,170 @@ class Scheduler:
                 self.statedb.add_pod(pod)
         elif self._wants(pod):
             self._enqueue_time.setdefault(key, time.monotonic())
-            self.queue.add(key)
             # encode-on-watch: fingerprint + class encode now, while the
             # previous batch is on the wire/device, so batch assembly on
             # the critical path is a key lookup + two row memcpys
             self.encode_cache.premake(pod)
+            # gang members wait in staging until their group reaches
+            # quorum — the extender path is per-pod and cannot place a
+            # group atomically, so it schedules them individually
+            if not self._extenders and self._stage_gang_member(key, pod):
+                return
+            self.queue.add(key)
+
+    # ---- gang scheduling (all-or-nothing groups) ----
+
+    def _on_podgroup_event(self, event: WatchEvent) -> None:
+        """A PodGroup write can change a group's quorum: re-check whether
+        the staged members now satisfy it."""
+        group = event.obj
+        gkey = f"{group.metadata.namespace}/{group.metadata.name}"
+        members = self._gang_members.get(gkey)
+        if members and len(members) >= self._gang_quorum(gkey):
+            self.queue.add(_GANG_KEY_PREFIX + gkey)
+
+    def _gang_quorum(self, gkey: str) -> int:
+        """minMember for a group: the PodGroup object when it exists, else
+        the largest group-min annotation seen on a member, else 1."""
+        ns, name = gkey.split("/", 1)
+        group = self.podgroup_informer.get(name, ns)
+        if group is not None:
+            return max(1, group.min_member)
+        return max(1, self._gang_min_hint.get(gkey, 1))
+
+    def _gang_timeout(self, gkey: str) -> float:
+        ns, name = gkey.split("/", 1)
+        group = self.podgroup_informer.get(name, ns)
+        if group is not None and group.schedule_timeout_seconds:
+            return float(group.schedule_timeout_seconds)
+        return DEFAULT_SCHEDULE_TIMEOUT_S
+
+    def _gang_forget(self, key: str) -> None:
+        """Drop one pod from gang staging (deleted, bound, or released)."""
+        gkey = self._gang_of_pod.pop(key, None)
+        if gkey is None:
+            return
+        members = self._gang_members.get(gkey)
+        if members is not None:
+            members.discard(key)
+            if not members:
+                del self._gang_members[gkey]
+                self._gang_first_seen.pop(gkey, None)
+                self._gang_min_hint.pop(gkey, None)
+
+    def _stage_gang_member(self, key: str, pod: Pod) -> bool:
+        """Stage a gang-annotated pending pod; enqueue its GROUP (not the
+        pod) once quorum is staged. Returns False for non-gang pods."""
+        gkey = pod_group_key(pod)
+        if gkey is None:
+            return False
+        prev = self._gang_of_pod.get(key)
+        if prev is not None and prev != gkey:
+            self._gang_forget(key)  # annotation changed: move groups
+        self._gang_of_pod[key] = gkey
+        members = self._gang_members.setdefault(gkey, set())
+        members.add(key)
+        self._gang_first_seen.setdefault(gkey, time.monotonic())
+        hint = annotation_min(pod)
+        if hint is not None:
+            self._gang_min_hint[gkey] = max(
+                self._gang_min_hint.get(gkey, 1), hint)
+        if len(members) >= self._gang_quorum(gkey):
+            self.queue.add(_GANG_KEY_PREFIX + gkey)
+        return True
+
+    def _check_gang_timeouts(self) -> None:
+        """Release groups that never reached quorum within their schedule
+        timeout: members go back to the queue as individual pods (the
+        PodGroup's phase flips to Timeout via gang/controller.py)."""
+        if not self._gang_first_seen:
+            return
+        now = time.monotonic()
+        for gkey in list(self._gang_first_seen):
+            timeout = self._gang_timeout(gkey)
+            if now - self._gang_first_seen[gkey] < timeout:
+                continue
+            members = self._gang_members.get(gkey, set())
+            if len(members) >= self._gang_quorum(gkey):
+                continue  # at quorum: the group key is queued, not stuck
+            self.metrics.gang_timeout_inc()
+            for key in sorted(members):
+                ns, name = key.split("/", 1)
+                pod = self.pod_informer.get(name, ns)
+                if pod is not None:
+                    self.events.record(
+                        pod, "Warning", "FailedScheduling",
+                        f"pod group {gkey} did not reach quorum within "
+                        f"{timeout:.0f}s; scheduling individually")
+                self.queue.add(key)
+            for key in list(members):
+                self._gang_forget(key)
+            self._gang_first_seen.pop(gkey, None)
+
+    def _admit_gang(self, qkey: str, fblob, iblob, pods: list[Pod],
+                    live_keys: list[str], gang_cols: list[tuple[int, int]],
+                    gang_groups: dict) -> None:
+        """Admit a quorate group into the current batch — whole or not at
+        all (the solver's revert window is a contiguous in-batch run, so a
+        group is never split across batches)."""
+        gkey = qkey[len(_GANG_KEY_PREFIX):]
+        self.queue.done(qkey)
+        members: list[tuple[str, Pod]] = []
+        for key in sorted(self._gang_members.get(gkey, ())):
+            ns, name = key.split("/", 1)
+            pod = self.pod_informer.get(name, ns)
+            if pod is None or pod.spec.node_name:
+                self._gang_forget(key)  # deleted or bound since staging
+                self._enqueue_time.pop(key, None)
+                continue
+            members.append((key, pod))
+        quorum = self._gang_quorum(gkey)
+        if len(members) < quorum:
+            return  # wait for more members (or the timeout sweep)
+        if len(members) > self.caps.batch_pods:
+            # can never fit one batch: release the members individually
+            # rather than stalling the group forever
+            for key, pod in members:
+                self._gang_forget(key)
+                self.metrics.failed += 1
+                self.events.record(
+                    pod, "Warning", "FailedScheduling",
+                    f"pod group {gkey} has {len(members)} members but "
+                    f"batch capacity is {self.caps.batch_pods}; a group "
+                    f"cannot be split across solver batches")
+                self.queue.add(key)
+            return
+        if len(pods) + len(members) > self.caps.batch_pods:
+            self.queue.add(qkey)  # whole group in the NEXT batch
+            return
+        start = len(pods)
+        seq = len(gang_groups) + 1  # batch-local id; 0 = non-gang
+        positions: list[int] = []
+        for key, pod in members:
+            try:
+                self.encode_cache.encode_packed_into(fblob, iblob,
+                                                     len(pods), pod)
+            except CapacityError as e:
+                # un-admit the group (rows past len(pods) are re-zeroed by
+                # the caller's tail wipe) and release its members — the
+                # oversized member can never encode
+                del pods[start:]
+                del live_keys[start:]
+                del gang_cols[start:]
+                for mkey, mpod in members:
+                    self._gang_forget(mkey)
+                    self.queue.add(mkey)
+                self.metrics.failed += 1
+                self.events.record(
+                    pod, "Warning", "FailedScheduling",
+                    f"pod group {gkey}: member exceeds scheduler "
+                    f"capacities: {e}")
+                return
+            positions.append(len(pods))
+            pods.append(pod)
+            live_keys.append(key)
+            gang_cols.append((seq, quorum))
+        gang_groups[seq] = (gkey, quorum, positions)
 
     # ---- lifecycle ----
 
@@ -454,10 +661,12 @@ class Scheduler:
     async def start(self) -> None:
         self.node_informer.start()
         self.pod_informer.start()
+        self.podgroup_informer.start()
         for informer in self.workload_informers:
             informer.start()
         await self.node_informer.wait_for_sync()
         await self.pod_informer.wait_for_sync()
+        await self.podgroup_informer.wait_for_sync()
 
     def _flush_events(self) -> None:
         """Record buffered Scheduled events (runs when the event loop next
@@ -493,6 +702,7 @@ class Scheduler:
         self.queue.close()
         self.node_informer.stop()
         self.pod_informer.stop()
+        self.podgroup_informer.stop()
         for informer in self.workload_informers:
             informer.stop()
 
@@ -524,6 +734,7 @@ class Scheduler:
     async def schedule_pending(self, wait: float | None = None) -> int:
         """Pop up to a batch of pending pods, schedule, bind. Returns the
         number of pods scheduled (in pipeline mode: settled this call)."""
+        self._check_gang_timeouts()
         effective_wait = 0 if self._inflight_q else wait
         keys = await self.queue.get_batch(self.caps.batch_pods,
                                           wait=effective_wait)
@@ -534,8 +745,16 @@ class Scheduler:
         fblob, iblob = self._next_blobs()
         pods: list[Pod] = []
         live_keys: list[str] = []
+        # per-row (gang_id, gang_min) parallel to pods, (0, 0) = non-gang;
+        # gang_groups: batch-local id -> (group key, quorum, row positions)
+        gang_cols: list[tuple[int, int]] = []
+        gang_groups: dict[int, tuple[str, int, list[int]]] = {}
         epoch_before = self.statedb.table.pod_row_epoch
         for key in keys:
+            if key.startswith(_GANG_KEY_PREFIX):
+                self._admit_gang(key, fblob, iblob, pods, live_keys,
+                                 gang_cols, gang_groups)
+                continue
             ns, name = key.split("/", 1)
             pod = self.pod_informer.get(name, ns)
             if pod is None or pod.spec.node_name:
@@ -552,6 +771,7 @@ class Scheduler:
                 continue
             pods.append(pod)
             live_keys.append(key)
+            gang_cols.append((0, 0))
         if not pods:
             return await self._asettle_inflight()
         if self.statedb.table.pod_row_epoch != epoch_before:
@@ -567,6 +787,18 @@ class Scheduler:
         if len(pods) < self.caps.batch_pods:
             fblob[len(pods):] = 0.0
             iblob[len(pods):] = 0
+        if gang_groups:
+            # gang columns go in AFTER encoding: cached packed rows carry
+            # zeros (a batch-local group id cannot be cached), and the
+            # epoch re-encode above would have reset earlier writes
+            from kubernetes_tpu.state.pod_batch import blob_col
+
+            gid_col = blob_col(fblob, iblob, "gang_id", self.caps)
+            gmin_col = blob_col(fblob, iblob, "gang_min", self.caps)
+            for i, (gid, gmin) in enumerate(gang_cols):
+                if gid:
+                    gid_col[i] = gid
+                    gmin_col[i] = gmin
         self.metrics.add_phase("encode", time.perf_counter() - t_phase)
         self.metrics.phase_pods += len(pods)
 
@@ -624,12 +856,13 @@ class Scheduler:
             # oldest batches while this one computes
             self.statedb.adopt_result(result)
             self._inflight_q.append((result, pods, live_keys, (fblob, iblob),
-                                     flags, t0, timer, True, fetch))
+                                     flags, t0, timer, True, fetch,
+                                     gang_groups))
             while len(self._inflight_q) > self.pipeline_depth:
                 settled += await self._asettle_one()
             return settled
         self._inflight_q.append((result, pods, live_keys, (fblob, iblob),
-                                 flags, t0, timer, False, fetch))
+                                 flags, t0, timer, False, fetch, gang_groups))
         return settled + await self._asettle_inflight()
 
     async def _schedule_with_extenders(self, pods: list[Pod],
@@ -793,7 +1026,7 @@ class Scheduler:
         if not self._inflight_q:
             return 0
         (result, pods, live_keys, blobs, flags, t0, timer,
-         adopted, fetch) = self._inflight_q.popleft()
+         adopted, fetch, gang_groups) = self._inflight_q.popleft()
         if assignments is None and fetch.done() \
                 and not fetch.cancelled() and fetch.exception() is None:
             assignments = fetch.result()  # prefetch already landed
@@ -827,10 +1060,40 @@ class Scheduler:
         # partition the batch: assigned rows to bind vs solver rejections
         name_of = self.statedb.table.name_of
         rows = assignments[:len(pods)].tolist()
+        # settle gangs at the GROUP level first: a reverted group requeues
+        # as one unit with group backoff (its members' -1 rows are the
+        # solver's revert, not individual rejections); a placed group's
+        # below-quorum stragglers fall through to individual failure
+        gang_handled: set[str] = set()
+        for _seq, (gkey, quorum, positions) in gang_groups.items():
+            placed = sum(1 for p in positions if rows[p] >= 0)
+            if placed >= quorum:
+                self.metrics.gang_placed_inc()
+                qkey = _GANG_KEY_PREFIX + gkey
+                self.backoff.reset(qkey)
+                self._gang_first_seen.pop(gkey, None)
+                for p in positions:
+                    if rows[p] < 0:
+                        # straggler past quorum: the gang is satisfied, the
+                        # leftover member schedules (and fails) on its own
+                        self._gang_forget(live_keys[p])
+                continue
+            self.metrics.gang_reverted_inc()
+            qkey = _GANG_KEY_PREFIX + gkey
+            for p in positions:
+                gang_handled.add(live_keys[p])
+                self.metrics.failed += 1
+                self.events.record(
+                    pods[p], "Warning", "FailedScheduling",
+                    f"pod group {gkey} placed {placed}/{quorum} members; "
+                    f"group reverted (all-or-nothing)")
+            self.queue.add_after(qkey, self.backoff.next_delay(qkey))
         to_bind: list[tuple[int, str, Pod, str]] = []
         for i, (key, pod) in enumerate(zip(live_keys, pods)):
             row = rows[i]
             if row < 0:
+                if key in gang_handled:
+                    continue  # group-level requeue already recorded
                 self._fail(key, pod, "no nodes available to schedule pods")
                 continue
             node_name = name_of[row]
@@ -871,6 +1134,10 @@ class Scheduler:
         enq_pop = self._enqueue_time.pop
         e2e_append = self.metrics.e2e_latency.append
         for (i, key, pod, node_name), err in zip(to_bind, errs):
+            if gang_groups:
+                # settled either way: eagerly unstage (the watch event
+                # confirming the bind would do it too, but later)
+                self._gang_forget(key)
             if err is not None:
                 # the solver's ledger charged this pod; drop that ledger below
                 any_rejected = True

@@ -96,6 +96,13 @@ class PodBatch:
     # image locality / prefer-avoid
     img_onehot: np.ndarray      # f32[P, UI] container-image multiplicities
     avoid_onehot: np.ndarray    # f32[P, UO] controllerRef signature, if interned
+    # gang scheduling (all-or-nothing groups; ops/solver.py group revert).
+    # gang_id is a batch-local group index, 0 = not a gang member (zeroed
+    # padding rows are therefore automatically non-gang). Members of one
+    # group MUST be contiguous in the batch — the scan's revert window is a
+    # contiguous run; the driver never splits a group across batches.
+    gang_id: np.ndarray         # i32[P] batch-local group index, 0 = none
+    gang_min: np.ndarray        # i32[P] group minMember quorum (0 when no gang)
 
     @property
     def batch_pods(self) -> int:
@@ -155,6 +162,8 @@ def empty_batch(caps: Capacities) -> PodBatch:
         svcaff_fail=np.zeros((p,), np.bool_),
         img_onehot=np.zeros((p, caps.image_universe), np.float32),
         avoid_onehot=np.zeros((p, caps.avoid_universe), np.float32),
+        gang_id=np.zeros((p,), np.int32),
+        gang_min=np.zeros((p,), np.int32),
     )
 
 
@@ -265,6 +274,8 @@ def packed_batch_flags(fblob, iblob, n: int, table, caps: Capacities):
         gpu=bool(requests[:, Resource.GPU].any()),
         storage=bool(requests[:, Resource.SCRATCH].any()
                      or requests[:, Resource.OVERLAY].any()),
+        gang=bool((np.asarray(blob_col(fblob, iblob, "gang_id", caps, n))
+                   > 0).any()),
     )
 
 

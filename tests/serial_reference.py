@@ -876,3 +876,58 @@ class SerialScheduler:
 
     def schedule(self, pods: list[Pod]) -> list[str | None]:
         return [self.schedule_one(p) for p in pods]
+
+    # ---- gang scheduling (all-or-nothing groups) ----
+
+    def _snapshot(self):
+        """Every mutable assume-state the scheduler carries: the per-node
+        ledgers, the round-robin counter, and the placed-list length."""
+        return ([(ns.req_cpu, ns.req_mem, ns.req_gpu, ns.req_scratch,
+                  ns.req_overlay, ns.nz_cpu, ns.nz_mem, ns.num_pods,
+                  set(ns.ports), len(ns.pods)) for ns in self.states],
+                self.rr, len(self.placed))
+
+    def _restore(self, snap) -> None:
+        rows, rr, placed_len = snap
+        for ns, row in zip(self.states, rows):
+            (ns.req_cpu, ns.req_mem, ns.req_gpu, ns.req_scratch,
+             ns.req_overlay, ns.nz_cpu, ns.nz_mem, ns.num_pods,
+             ports, pods_len) = row
+            ns.ports = set(ports)
+            del ns.pods[pods_len:]
+        self.rr = rr
+        del self.placed[placed_len:]
+
+    def schedule_gang(self, pods: list[Pod], gang_ids: list[int],
+                      gang_mins: list[int]) -> list[str | None]:
+        """Gang-aware scheduleOne loop: contiguous runs of equal nonzero
+        gang_id are all-or-nothing groups. Every member is attempted in
+        order (later members see earlier members' assume charges); a group
+        that ends with fewer than its quorum placed is reverted wholesale —
+        node ledgers, placed list, and the round-robin counter roll back to
+        the group's entry state and every member reports None. This is the
+        behavioral spec the device solver's group-revert carry
+        (ops/solver.py BatchFlags.gang) is pinned against."""
+        results: list[str | None] = [None] * len(pods)
+        i = 0
+        while i < len(pods):
+            gid = gang_ids[i]
+            if gid == 0:
+                results[i] = self.schedule_one(pods[i])
+                i += 1
+                continue
+            j = i
+            while j < len(pods) and gang_ids[j] == gid:
+                j += 1
+            snap = self._snapshot()
+            placed = 0
+            for k in range(i, j):
+                results[k] = self.schedule_one(pods[k])
+                if results[k] is not None:
+                    placed += 1
+            if placed < gang_mins[i]:
+                self._restore(snap)
+                for k in range(i, j):
+                    results[k] = None
+            i = j
+        return results

@@ -1,0 +1,366 @@
+"""Gang scheduling tests: the device solver's group-revert carry pinned
+against the gang-aware serial oracle (tests/serial_reference.py
+schedule_gang), revert edge cases, and the guarantee that gang support is
+exactly neutral for non-gang batches."""
+
+import jax
+import numpy as np
+import pytest
+
+from kubernetes_tpu.api.objects import Node, Pod
+from kubernetes_tpu.models.policy import DEFAULT_POLICY
+from kubernetes_tpu.ops.solver import ALL_ACTIVE, batch_flags, schedule_batch
+from kubernetes_tpu.state import Capacities, Resource, encode_cluster
+from tests.serial_reference import SerialScheduler
+
+jit_schedule = jax.jit(schedule_batch, static_argnames=("policy", "flags"))
+
+
+def mk_node(name, cpu="4", mem="8Gi", pods="110"):
+    return Node.from_dict({
+        "metadata": {"name": name},
+        "status": {"allocatable": {"cpu": cpu, "memory": mem, "pods": pods},
+                   "conditions": [{"type": "Ready", "status": "True"}]},
+    })
+
+
+def mk_pod(name, cpu=None, mem=None, **spec):
+    req = {}
+    if cpu:
+        req["cpu"] = cpu
+    if mem:
+        req["memory"] = mem
+    c = {"name": "c"}
+    if req:
+        c["resources"] = {"requests": req}
+    return Pod.from_dict({"metadata": {"name": name},
+                          "spec": {"containers": [c], **spec}})
+
+
+def solve_gang(nodes, pods, gang_ids, gang_mins, caps=None, rr_start=0):
+    caps = caps or Capacities(num_nodes=16, batch_pods=16)
+    state, batch, table = encode_cluster(nodes, pods, caps)
+    batch.gang_id[:len(pods)] = np.asarray(gang_ids, np.int32)
+    batch.gang_min[:len(pods)] = np.asarray(gang_mins, np.int32)
+    flags = batch_flags(batch, len(pods), table)
+    result = jit_schedule(state, batch, rr_start, DEFAULT_POLICY, flags=flags)
+    names = []
+    for i in range(len(pods)):
+        idx = int(result.assignments[i])
+        names.append(table.name_of[idx] if idx >= 0 else None)
+    return names, result, state, table
+
+
+def test_complete_gang_places():
+    nodes = [mk_node(f"n{i}", cpu="2") for i in range(4)]
+    pods = [mk_pod(f"p{i}", cpu="1500m") for i in range(4)]
+    names, result, _, _ = solve_gang(nodes, pods, [1, 1, 1, 1], [4, 4, 4, 4])
+    assert sorted(names) == ["n0", "n1", "n2", "n3"]
+
+
+def test_partial_gang_reverts_everything():
+    # 2-core nodes, 1.5-core members: only 2 of 3 can place, quorum is 3 —
+    # the whole group must vanish from the result AND the ledger
+    nodes = [mk_node("a", cpu="2"), mk_node("b", cpu="2")]
+    pods = [mk_pod(f"g{i}", cpu="1500m") for i in range(3)] \
+        + [mk_pod("solo", cpu="1500m")]
+    names, result, state, _ = solve_gang(
+        nodes, pods, [1, 1, 1, 0], [3, 3, 3, 0])
+    assert names[:3] == [None, None, None]
+    # the trailing non-gang pod schedules as if the gang never ran
+    assert names[3] == "a"
+    # ledger holds exactly the solo pod's charge — no gang residue
+    expected = np.asarray(state.requested).sum(axis=0).copy()
+    expected[Resource.PODS] += 1
+    expected[Resource.CPU] += 1500
+    np.testing.assert_array_equal(
+        np.asarray(result.new_requested).sum(axis=0), expected)
+
+
+def test_min_member_quorum_allows_partial_group():
+    # same shape but quorum 2: two members commit, the third fails alone
+    nodes = [mk_node("a", cpu="2"), mk_node("b", cpu="2")]
+    pods = [mk_pod(f"g{i}", cpu="1500m") for i in range(3)]
+    names, _, _, _ = solve_gang(nodes, pods, [1, 1, 1], [2, 2, 2])
+    assert set(names[:2]) == {"a", "b"}
+    assert names[2] is None
+
+
+def test_gang_larger_than_any_node_capacity():
+    # every member outsizes every node: zero placements, ledger untouched
+    nodes = [mk_node(f"n{i}", cpu="2") for i in range(3)]
+    pods = [mk_pod(f"g{i}", cpu="3") for i in range(3)]
+    names, result, state, _ = solve_gang(nodes, pods, [1, 1, 1], [3, 3, 3])
+    assert names == [None, None, None]
+    np.testing.assert_array_equal(np.asarray(result.new_requested),
+                                  np.asarray(state.requested))
+    assert int(result.rr_end) == 0
+
+
+def test_gang_revert_restores_round_robin():
+    # all-zero requests -> every node ties; the failed gang's rr bumps must
+    # not survive or the trailing pods' rotation would shift
+    nodes = [mk_node(f"n{i}") for i in range(3)]
+    pods = [mk_pod("g0"), mk_pod("g1", cpu="100"),  # g1 can't fit: cpu=100
+            mk_pod("t0"), mk_pod("t1")]
+    names, _, _, _ = solve_gang(nodes, pods, [1, 1, 0, 0], [2, 2, 0, 0])
+    assert names[:2] == [None, None]
+    assert names[2:] == ["n0", "n1"]
+
+
+def test_back_to_back_groups():
+    # adjacent groups with different ids must settle independently
+    nodes = [mk_node("a", cpu="2"), mk_node("b", cpu="2")]
+    pods = [mk_pod("g0", cpu="1500m"), mk_pod("g1", cpu="1500m"),
+            mk_pod("h0", cpu="1500m"), mk_pod("h1", cpu="1500m")]
+    names, _, _, _ = solve_gang(nodes, pods, [1, 1, 2, 2], [2, 2, 2, 2])
+    # first group takes both nodes; second group cannot complete -> reverted
+    assert set(names[:2]) == {"a", "b"}
+    assert names[2:] == [None, None]
+
+
+def test_gang_serial_parity_random():
+    rng = np.random.RandomState(7)
+    for trial in range(6):
+        nodes = [mk_node(f"n{i}", cpu=str(rng.randint(1, 5)),
+                         mem=f"{rng.randint(1, 9)}Gi") for i in range(6)]
+        pods, gang_ids, gang_mins = [], [], []
+        gid = 0
+        while len(pods) < 12:
+            size = int(rng.randint(1, 4))
+            size = min(size, 12 - len(pods))
+            gang = rng.rand() < 0.6
+            gid += 1
+            quorum = int(rng.randint(1, size + 1)) if gang else 0
+            for m in range(size):
+                cpu = rng.choice(["250m", "500m", "1", "2"])
+                pods.append(mk_pod(f"t{trial}-p{len(pods)}", cpu=cpu))
+                gang_ids.append(gid if gang else 0)
+                gang_mins.append(quorum)
+        names, _, _, _ = solve_gang(nodes, pods, gang_ids, gang_mins)
+        oracle = SerialScheduler(nodes).schedule_gang(pods, gang_ids,
+                                                      gang_mins)
+        assert names == oracle, (trial, names, oracle)
+
+
+# ---- driver integration: staging, atomic admission, group requeue ----
+
+import asyncio
+import time
+
+from kubernetes_tpu.api.objects import Job, PodGroup
+from kubernetes_tpu.apiserver import ObjectStore
+from kubernetes_tpu.gang import GROUP_MIN_ANNOTATION, GROUP_NAME_ANNOTATION
+from kubernetes_tpu.gang.controller import GangController
+from kubernetes_tpu.perf.fixtures import make_nodes
+from kubernetes_tpu.scheduler import Scheduler
+
+
+def gang_pod(name, group, min_members=None, cpu="1500m"):
+    annotations = {GROUP_NAME_ANNOTATION: group}
+    if min_members is not None:
+        annotations[GROUP_MIN_ANNOTATION] = str(min_members)
+    return Pod.from_dict({
+        "metadata": {"name": name, "annotations": annotations},
+        "spec": {"containers": [
+            {"name": "c", "resources": {"requests": {"cpu": cpu}}}]}})
+
+
+async def until(cond, timeout=5.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while not cond():
+        assert time.monotonic() < deadline, f"timed out waiting for {msg}"
+        await asyncio.sleep(0.01)
+
+
+async def drain(sched, total, timeout=10.0):
+    scheduled = 0
+    deadline = time.monotonic() + timeout
+    while scheduled < total and time.monotonic() < deadline:
+        scheduled += await sched.schedule_pending(wait=0.1)
+    return scheduled
+
+
+def bound_pods(store):
+    return [p for p in store.list("Pod") if p.spec.node_name]
+
+
+def get_or_none(store, kind, name):
+    from kubernetes_tpu.apiserver.store import NotFound
+    try:
+        return store.get(kind, name)
+    except NotFound:
+        return None
+
+
+def test_driver_gang_places_atomically():
+    async def run():
+        store = ObjectStore()
+        for node in make_nodes(4, cpu="2"):
+            store.create(node)
+        sched = Scheduler(store, caps=Capacities(num_nodes=8, batch_pods=8))
+        await sched.start()
+        for i in range(4):
+            store.create(gang_pod(f"g{i}", "train", min_members=4))
+        await asyncio.sleep(0)
+        got = await drain(sched, 4)
+        assert got == 4
+        assert len(bound_pods(store)) == 4
+        assert sched.metrics.gang_placed == 1
+        assert sched.metrics.gang_reverted == 0
+        sched.stop()
+
+    asyncio.run(run())
+
+
+def test_driver_gang_reverts_without_partial_bind():
+    async def run():
+        store = ObjectStore()
+        for node in make_nodes(2, cpu="2"):
+            store.create(node)
+        sched = Scheduler(store, caps=Capacities(num_nodes=8, batch_pods=8))
+        await sched.start()
+        # 3x 1.5-core members on 2x 2-core nodes: only 2 can ever place
+        for i in range(3):
+            store.create(gang_pod(f"g{i}", "train", min_members=3))
+        await asyncio.sleep(0)
+        got = await sched.schedule_pending(wait=0.2)
+        assert got == 0
+        assert bound_pods(store) == []  # the all-or-nothing guarantee
+        assert sched.metrics.gang_reverted == 1
+        assert sched.metrics.gang_placed == 0
+        events = store.list("Event")
+        assert any("group reverted" in e.message for e in events)
+        sched.stop()
+
+    asyncio.run(run())
+
+
+def test_driver_gang_split_across_batches_rejected():
+    async def run():
+        store = ObjectStore()
+        for node in make_nodes(4, cpu="4"):
+            store.create(node)
+        # a 6-member group can never fit a 4-pod batch: released, members
+        # then schedule individually
+        sched = Scheduler(store, caps=Capacities(num_nodes=8, batch_pods=4))
+        await sched.start()
+        for i in range(6):
+            store.create(gang_pod(f"g{i}", "wide", min_members=6,
+                                  cpu="100m"))
+        await asyncio.sleep(0)
+        got = await drain(sched, 6)
+        assert got == 6
+        assert sched.metrics.gang_placed == 0
+        events = store.list("Event")
+        assert any("cannot be split" in e.message for e in events)
+        sched.stop()
+
+    asyncio.run(run())
+
+
+def test_driver_gang_timeout_releases_members():
+    async def run():
+        store = ObjectStore()
+        for node in make_nodes(2, cpu="2"):
+            store.create(node)
+        store.create(PodGroup.from_dict({
+            "metadata": {"name": "half"},
+            "spec": {"minMember": 3, "scheduleTimeoutSeconds": 0.05}}))
+        sched = Scheduler(store, caps=Capacities(num_nodes=8, batch_pods=8))
+        await sched.start()
+        # only 2 of the 3 required members ever arrive
+        for i in range(2):
+            store.create(gang_pod(f"g{i}", "half", cpu="100m"))
+        await asyncio.sleep(0.1)  # past the group's schedule timeout
+        got = await drain(sched, 2)
+        assert got == 2  # members released to individual scheduling
+        assert sched.metrics.gang_timeouts == 1
+        events = store.list("Event")
+        assert any("did not reach quorum" in e.message for e in events)
+        sched.stop()
+
+    asyncio.run(run())
+
+
+# ---- controller: PodGroup materialization + phase ----
+
+
+def test_gang_controller_materializes_podgroup_from_job():
+    async def run():
+        store = ObjectStore()
+        ctrl = GangController(store)
+        await ctrl.start()
+        store.create(Job.from_dict({
+            "metadata": {"name": "train-job",
+                         "annotations": {GROUP_NAME_ANNOTATION: "train"}},
+            "spec": {"parallelism": 3,
+                     "template": {"spec": {"containers": [{"name": "c"}]}}}}))
+        await until(lambda: get_or_none(store, "PodGroup", "train")
+                    is not None, msg="PodGroup created")
+        group = store.get("PodGroup", "train")
+        assert group.min_member == 3
+        assert group.phase == "Pending"
+        ctrl.stop()
+
+    asyncio.run(run())
+
+
+def test_gang_controller_phase_reaches_placed():
+    async def run():
+        store = ObjectStore()
+        store.create(make_nodes(1, cpu="4")[0])
+        store.create(PodGroup.from_dict({
+            "metadata": {"name": "g"},
+            "spec": {"minMember": 2, "scheduleTimeoutSeconds": 600}}))
+        ctrl = GangController(store)
+        await ctrl.start()
+        from kubernetes_tpu.api.objects import Binding
+        for i in range(2):
+            store.create(gang_pod(f"m{i}", "g", cpu="100m"))
+            store.bind(Binding(pod_name=f"m{i}", namespace="default",
+                               target_node="node-0"))
+        await until(lambda: store.get("PodGroup", "g").phase == "Placed",
+                    msg="phase Placed")
+        status = store.get("PodGroup", "g").status
+        assert status["placed"] == 2 and status["members"] == 2
+        ctrl.stop()
+
+    asyncio.run(run())
+
+
+def test_gang_controller_times_out_unquorate_group():
+    async def run():
+        store = ObjectStore()
+        store.create(PodGroup.from_dict({
+            "metadata": {"name": "late"},
+            "spec": {"minMember": 4, "scheduleTimeoutSeconds": 0.05}}))
+        ctrl = GangController(store)
+        await ctrl.start()
+        store.create(gang_pod("m0", "late", cpu="100m"))
+        await until(lambda: store.get("PodGroup", "late").phase == "Timeout",
+                    msg="phase Timeout")
+        events = store.list("Event")
+        assert any(e.reason == "GangTimeout" for e in events)
+        ctrl.stop()
+
+    asyncio.run(run())
+
+
+def test_non_gang_batch_is_bit_identical_to_all_active():
+    # the gang gate must be provably neutral: a batch with no gang member
+    # solved by the gang-compiled program (ALL_ACTIVE) and by the gang-gated
+    # program must agree on every result field
+    nodes = [mk_node(f"n{i}", cpu="2") for i in range(4)]
+    pods = [mk_pod(f"p{i}", cpu=c) for i, c in
+            enumerate(["500m", "1", "1500m", "250m", "2"])]
+    caps = Capacities(num_nodes=16, batch_pods=16)
+    state, batch, table = encode_cluster(nodes, pods, caps)
+    flags = batch_flags(batch, len(pods), table)
+    assert not flags.gang
+    gated = jit_schedule(state, batch, 0, DEFAULT_POLICY, flags=flags)
+    full = jit_schedule(state, batch, 0, DEFAULT_POLICY, flags=ALL_ACTIVE)
+    for name in type(gated).__dataclass_fields__:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(gated, name)),
+            np.asarray(getattr(full, name)), err_msg=name)

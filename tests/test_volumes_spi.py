@@ -65,6 +65,31 @@ def test_plugin_projection_and_errors():
         vm.mount_pod(vol_pod("r", [{"name": "x", "quobyte": {}}]))
 
 
+def test_partial_mount_failure_detaches_cloud_disks():
+    """A pod whose LAST volume fails to mount must not leak the cloud
+    attaches its earlier volumes already took: the single-writer disk
+    lock would otherwise survive the pod (reconciler has no record of the
+    partial set — mount_pod never returned)."""
+    from kubernetes_tpu.cloudprovider.interface import FakeCloud
+
+    store = ObjectStore()
+    cloud = FakeCloud()
+    vm = VolumeManager(store, "n0", require_attach=False, cloud=cloud)
+    pod = vol_pod("p", [
+        {"name": "data", "gcePersistentDisk": {"pdName": "pd-1"}},
+        {"name": "sec", "secret": {"secretName": "missing"}},
+    ], node="n0")
+    with pytest.raises(MountError):
+        vm.mount_pod(pod)
+    # the attach was rolled back, not recorded under the pod key
+    assert cloud.disk_attached_to("pd-1") is None
+    assert "detach:pd-1@n0" in cloud.calls
+    assert vm.mounts(pod.key) == []
+    # and the disk is immediately attachable elsewhere
+    cloud.attach_disk("pd-1", "n1")
+    assert cloud.disk_attached_to("pd-1") == "n1"
+
+
 def test_pvc_mount_requires_bind_and_attach():
     store = ObjectStore()
     store.create(pv_obj("disk", "10Gi"))

@@ -125,7 +125,11 @@ def test_impersonation_filter():
     user, ok = impersonate(authz, admin,
                            {"impersonate-user": "alice",
                             "impersonate-group": "devs, qa"})
-    assert ok and user.name == "alice" and user.groups == ("devs", "qa")
+    # system:authenticated is always appended so bindings on that group
+    # apply to impersonated requests (the reference's authentication.go
+    # post-authenticate group injection)
+    assert ok and user.name == "alice"
+    assert user.groups == ("devs", "qa", "system:authenticated")
     # without the grant: forbidden, not silently served as self
     user, ok = impersonate(authz, mallory, {"impersonate-user": "alice"})
     assert not ok and user is None
