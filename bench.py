@@ -19,9 +19,10 @@ vs_baseline = headline value / 30.
 Prints exactly ONE JSON line on stdout (headline metric + per-config
 extras). Diagnostics go to stderr. Env overrides: BENCH_NODES, BENCH_PODS,
 BENCH_TIMEOUT_S, BENCH_CONFIGS (comma list of
-headline,interpod,spread,gang,recovery,device), BENCH_GANG_NODES /
+headline,interpod,spread,gang,preemption,recovery,device), BENCH_GANG_NODES /
 BENCH_GANG_PODS / BENCH_GANG_SIZE (gang config shape, default 50k nodes /
-24576 pods in 8-wide groups).
+24576 pods in 8-wide groups), BENCH_PREEMPT_NODES (preemption drill size,
+default 512 nodes saturated with low-priority filler).
 
 --metrics-snapshot (or BENCH_METRICS_SNAPSHOT=1) embeds the scheduler's
 per-phase registry histograms (encode/flush/dispatch/solve/bind/commit:
@@ -56,8 +57,9 @@ def main() -> None:
 
     n_nodes = int(os.environ.get("BENCH_NODES", "15000"))
     n_pods = int(os.environ.get("BENCH_PODS", "30000"))
-    configs = os.environ.get("BENCH_CONFIGS",
-                             "headline,interpod,spread,gang,recovery,device")
+    configs = os.environ.get(
+        "BENCH_CONFIGS",
+        "headline,interpod,spread,gang,preemption,recovery,device")
     configs = [c.strip() for c in configs.split(",") if c.strip()]
     metrics_snapshot = "--metrics-snapshot" in sys.argv[1:] or \
         os.environ.get("BENCH_METRICS_SNAPSHOT", "") in ("1", "true")
@@ -143,6 +145,29 @@ def main() -> None:
                 f"/{expected_groups} groups settled")
         if metrics_snapshot:
             extras["gang_phase_hist"] = r.phase_hist
+
+    if "preemption" in configs:
+        from kubernetes_tpu.perf.harness import run_preemption
+
+        # priority/preemption drill: saturate CPU with globalDefault-
+        # priority filler, then land a higher-PriorityClass wave through
+        # the full unschedulable -> victim-select -> evict+nominate ->
+        # rebind path (ROADMAP priority & preemption tentpole)
+        pre_nodes = int(os.environ.get("BENCH_PREEMPT_NODES", "512"))
+        r = run_preemption(pre_nodes)
+        print(f"bench[preemption]: {r}", file=sys.stderr, flush=True)
+        extras["preemption_latency_ms"] = round(r.preemption_latency_ms, 1)
+        extras["victims_per_sec"] = round(r.victims_per_sec, 1)
+        extras["preemption_wave_bound"] = r.bound_wave
+        extras["preemption_victims"] = r.victims
+        extras["preemption_attempts"] = r.attempts
+        if r.bound_wave < r.wave:
+            RESULT["error"] = (
+                f"preemption bench: only {r.bound_wave}/{r.wave} "
+                f"high-priority pods landed")
+        elif r.victims == 0:
+            RESULT["error"] = ("preemption bench: wave landed without any "
+                               "evictions (cluster was not saturated)")
 
     if "recovery" in configs:
         from kubernetes_tpu.perf.harness import run_recovery

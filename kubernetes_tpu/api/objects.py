@@ -245,6 +245,7 @@ class PodSpec:
     scheduler_name: str = "default-scheduler"
     restart_policy: str = "Always"
     priority: int = 0
+    priority_class_name: str = ""
     service_account_name: str = ""
 
     def clone(self) -> "PodSpec":
@@ -258,6 +259,7 @@ class PodSpec:
             volumes=copy.deepcopy(self.volumes) if self.volumes else [],
             scheduler_name=self.scheduler_name,
             restart_policy=self.restart_policy, priority=self.priority,
+            priority_class_name=self.priority_class_name,
             service_account_name=self.service_account_name,
         )
 
@@ -273,6 +275,7 @@ class PodSpec:
             scheduler_name=d.get("schedulerName", "default-scheduler") or "default-scheduler",
             restart_policy=d.get("restartPolicy", "Always") or "Always",
             priority=int(d.get("priority", 0) or 0),
+            priority_class_name=d.get("priorityClassName", "") or "",
             service_account_name=d.get("serviceAccountName", "") or "",
         )
 
@@ -294,6 +297,8 @@ class PodSpec:
             out["schedulerName"] = self.scheduler_name
         if self.priority:
             out["priority"] = self.priority
+        if self.priority_class_name:
+            out["priorityClassName"] = self.priority_class_name
         if self.service_account_name:
             out["serviceAccountName"] = self.service_account_name
         if self.restart_policy != "Always":
@@ -313,6 +318,10 @@ class PodStatus:
     # raw v1 ContainerStatus dicts (restartCount/ready/state) written by
     # the agent's status manager, read by kubectl get (RESTARTS column)
     container_statuses: list[dict[str, Any]] = field(default_factory=list)
+    # node the scheduler preempted victims on for this pod (v1
+    # PodStatus.NominatedNodeName; the preemptor retries there first and
+    # the freed capacity is held against lower-priority pods)
+    nominated_node_name: str = ""
 
     def clone(self) -> "PodStatus":
         # containerStatuses entries nest state dicts — deep-copy so a
@@ -322,7 +331,8 @@ class PodStatus:
                          host_ip=self.host_ip,
                          reason=self.reason, message=self.message,
                          container_statuses=copy.deepcopy(
-                             self.container_statuses))
+                             self.container_statuses),
+                         nominated_node_name=self.nominated_node_name)
 
     @classmethod
     def from_dict(cls, d: dict[str, Any]) -> "PodStatus":
@@ -333,6 +343,7 @@ class PodStatus:
             reason=d.get("reason", "") or "",
             message=d.get("message", "") or "",
             container_statuses=list(d.get("containerStatuses") or []),
+            nominated_node_name=d.get("nominatedNodeName", "") or "",
         )
 
     def to_dict(self) -> dict[str, Any]:
@@ -347,6 +358,8 @@ class PodStatus:
             out["message"] = self.message
         if self.container_statuses:
             out["containerStatuses"] = list(self.container_statuses)
+        if self.nominated_node_name:
+            out["nominatedNodeName"] = self.nominated_node_name
         return out
 
 
@@ -1091,6 +1104,50 @@ class PodGroup(_SpecStatusObject):
     @property
     def phase(self) -> str:
         return self.status.get("phase") or "Pending"
+
+
+@dataclass
+class PriorityClass:
+    """scheduling.k8s.io PriorityClass (the v1.8-alpha shape,
+    pkg/apis/scheduling/types.go): maps a name to an integer priority
+    stamped onto pod specs at admission. Non-namespaced; top-level
+    value/globalDefault/description rather than spec/status."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    value: int = 0
+    global_default: bool = False
+    description: str = ""
+
+    kind = "PriorityClass"
+    api_version = "scheduling.k8s.io/v1alpha1"
+
+    @property
+    def key(self) -> str:
+        return f"{self.metadata.namespace}/{self.metadata.name}"
+
+    def clone(self) -> "PriorityClass":
+        return PriorityClass(metadata=self.metadata.clone(),
+                             value=self.value,
+                             global_default=self.global_default,
+                             description=self.description)
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]) -> "PriorityClass":
+        return cls(metadata=ObjectMeta.from_dict(d.get("metadata") or {}),
+                   value=int(d.get("value", 0) or 0),
+                   global_default=bool(d.get("globalDefault", False)),
+                   description=d.get("description", "") or "")
+
+    def to_dict(self) -> dict[str, Any]:
+        out: dict[str, Any] = {"apiVersion": self.api_version,
+                               "kind": self.kind,
+                               "metadata": self.metadata.to_dict(),
+                               "value": self.value}
+        if self.global_default:
+            out["globalDefault"] = True
+        if self.description:
+            out["description"] = self.description
+        return out
 
 
 @dataclass

@@ -73,7 +73,8 @@ def default_chain() -> AdmissionChain:
 # the reference 1.8 recommended set we implement in-tree; webhook and the
 # node/selector restrictors are opt-in by name, like --admission-control
 DEFAULT_PLUGINS = ("NamespaceLifecycle", "DefaultTolerationSeconds",
-                   "ServiceAccount", "LimitRanger", "ResourceQuota")
+                   "ServiceAccount", "Priority", "LimitRanger",
+                   "ResourceQuota")
 
 
 def chain_for(names: str) -> AdmissionChain:
@@ -86,6 +87,7 @@ def chain_for(names: str) -> AdmissionChain:
         "ServiceAccount": ServiceAccountPlugin,
         "LimitRanger": LimitRanger,
         "ResourceQuota": ResourceQuotaPlugin,
+        "Priority": PriorityPlugin,
         "NodeRestriction": NodeRestriction,
         "PodNodeSelector": PodNodeSelector,
         "GenericAdmissionWebhook": GenericAdmissionWebhook,
@@ -293,6 +295,49 @@ class ResourceQuotaPlugin:
         return total
 
 
+class PriorityPlugin:
+    """plugin/pkg/admission/priority: resolve spec.priorityClassName to the
+    numeric spec.priority at pod CREATE (the scheduler and preemption pass
+    only ever read the resolved integer), and keep the PriorityClass
+    universe sane — at most one globalDefault class.
+
+    A pod naming an unknown class is rejected; a pod naming no class gets
+    the globalDefault class's value if one exists, else priority 0. A pod
+    that arrives with a bare spec.priority and no class name keeps it
+    (trusted in-process writers — the bench and tests — pre-resolve)."""
+
+    def admit(self, store, obj: Any, operation: str,
+              user=None) -> None:
+        del user
+        if obj.kind == "PriorityClass":
+            if operation in ("CREATE", "UPDATE") and obj.global_default:
+                for pc in store.list("PriorityClass", copy_objects=False):
+                    if pc.global_default \
+                            and pc.metadata.name != obj.metadata.name:
+                        raise AdmissionError(
+                            f"PriorityClass {pc.metadata.name!r} is already "
+                            f"marked as globalDefault")
+            return
+        if obj.kind != "Pod" or operation != "CREATE":
+            return
+        name = obj.spec.priority_class_name
+        if name:
+            try:
+                pc = store.get("PriorityClass", name)
+            except KeyError:
+                raise AdmissionError(
+                    f"no PriorityClass with name {name!r} was found")
+            obj.spec.priority = int(pc.value)
+            return
+        if obj.spec.priority:
+            return
+        for pc in store.list("PriorityClass", copy_objects=False):
+            if pc.global_default:
+                obj.spec.priority_class_name = pc.metadata.name
+                obj.spec.priority = int(pc.value)
+                return
+
+
 # ---- user-aware restrictors + the external-webhook seam ----------------
 
 
@@ -340,17 +385,22 @@ class NodeRestriction:
         if obj.kind != "Pod":
             return
         if operation == "UPDATE":
-            # a node may write pod STATUS, but must not grow the pod's
-            # volume references (adding a secret ref post-hoc would reopen
-            # the self-grant escalation via the authorizer's pod edge)
+            # a node may write pod STATUS only — ANY spec mutation is
+            # rejected (admission.go:166 admitPod compares the incoming
+            # spec against storage; letting a kubelet grow volume refs or
+            # retarget nodeName would reopen the self-grant escalation via
+            # the authorizer's pod edge)
             try:
                 stored = store.get("Pod", obj.metadata.name,
                                    obj.metadata.namespace)
             except KeyError:
                 return
-            if obj.spec.volumes != stored.spec.volumes:
+            if obj.spec != stored.spec:
+                changed = [f for f in stored.spec.__dataclass_fields__
+                           if getattr(obj.spec, f) != getattr(stored.spec, f)]
                 raise AdmissionError(
-                    f"node {node!r} may not change pod volumes")
+                    f"node {node!r} may only update pod status, not spec "
+                    f"({', '.join(changed) or 'spec'})")
             return
         if operation != "CREATE":
             return

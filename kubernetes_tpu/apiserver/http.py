@@ -89,6 +89,8 @@ RESOURCES: dict[str, str] = {
     "apiservices": "APIService",
     # scheduling.ktpu.io (gang scheduling)
     "podgroups": "PodGroup",
+    # scheduling.k8s.io (pod priority & preemption)
+    "priorityclasses": "PriorityClass",
     "roles": "Role",
     "clusterroles": "ClusterRole",
     "rolebindings": "RoleBinding",
@@ -110,7 +112,8 @@ KIND_TO_CLS = {cls.kind: cls for cls in (
     objs.Namespace, objs.CustomResourceDefinition, objs.Cluster,
     objs.Secret, objs.ConfigMap, objs.ServiceAccount, objs.DaemonSet,
     objs.CronJob, objs.HorizontalPodAutoscaler, objs.PodDisruptionBudget,
-    objs.APIService, objs.PodGroup, objs.Role, objs.ClusterRole,
+    objs.APIService, objs.PodGroup, objs.PriorityClass,
+    objs.Role, objs.ClusterRole,
     objs.RoleBinding, objs.ClusterRoleBinding,
     objs.CertificateSigningRequest)}
 PLURAL_OF = {kind: plural for plural, kind in RESOURCES.items()}

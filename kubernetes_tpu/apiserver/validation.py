@@ -44,6 +44,8 @@ def validate(obj: Any) -> None:
         _validate_workload(obj)
     elif kind == "PodGroup":
         _validate_podgroup(obj)
+    elif kind == "PriorityClass":
+        _validate_priorityclass(obj)
 
 
 def _validate_quantities(where: str, quantities: dict) -> dict:
@@ -121,6 +123,20 @@ def _validate_podgroup(obj) -> None:
     if phase and phase not in type(obj).PHASES:
         raise ValidationError(
             f"status.phase: unsupported value {phase!r}")
+
+
+def _validate_priorityclass(obj) -> None:
+    try:
+        value = int(obj.value)
+    except (TypeError, ValueError):
+        raise ValidationError(
+            f"value: invalid value {obj.value!r}: must be an integer")
+    # HighestUserDefinablePriority (pkg/apis/scheduling/types.go): values
+    # above one billion are reserved for system classes
+    if value > 1_000_000_000:
+        raise ValidationError(
+            f"value: {value} is greater than the highest user-definable "
+            f"priority (1000000000)")
 
 
 def _validate_workload(obj) -> None:

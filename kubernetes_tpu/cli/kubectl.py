@@ -64,6 +64,7 @@ ALIASES = {
     "pdb": "poddisruptionbudgets",
     "poddisruptionbudget": "poddisruptionbudgets",
     "pg": "podgroups", "podgroup": "podgroups",
+    "pc": "priorityclasses", "priorityclass": "priorityclasses",
     "quota": "resourcequotas", "resourcequota": "resourcequotas",
     "limits": "limitranges", "limitrange": "limitranges",
     "crd": "customresourcedefinitions",
@@ -120,6 +121,7 @@ def _row(kind: str, obj, wide: bool) -> list[str]:
         row = [obj.metadata.name, obj.status.phase or "Pending", _age(obj)]
         if wide:
             row.append(obj.spec.node_name or "<none>")
+            row.append(obj.status.nominated_node_name or "<none>")
         return row
     if kind == "Node":
         ready = next((c.status for c in obj.status.conditions
@@ -151,12 +153,15 @@ def _row(kind: str, obj, wide: bool) -> list[str]:
         status = obj.status or {}
         return [obj.metadata.name, obj.phase,
                 f"{status.get('placed', 0)}/{obj.min_member}", _age(obj)]
+    if kind == "PriorityClass":
+        return [obj.metadata.name, str(obj.value),
+                str(bool(obj.global_default)).lower(), _age(obj)]
     return [obj.metadata.name, _age(obj)]
 
 
 HEADERS = {
     "Pod": ["NAME", "STATUS", "AGE"],
-    "Pod-wide": ["NAME", "STATUS", "AGE", "NODE"],
+    "Pod-wide": ["NAME", "STATUS", "AGE", "NODE", "NOMINATED NODE"],
     "Node": ["NAME", "STATUS", "AGE"],
     "ReplicaSet": ["NAME", "REPLICAS", "READY", "AGE"],
     "ReplicationController": ["NAME", "REPLICAS", "READY", "AGE"],
@@ -167,6 +172,7 @@ HEADERS = {
     "Endpoints": ["NAME", "ADDRESSES", "AGE"],
     "Event": ["NAME", "TYPE", "REASON", "COUNT", "MESSAGE"],
     "PodGroup": ["NAME", "PHASE", "PLACED", "AGE"],
+    "PriorityClass": ["NAME", "VALUE", "GLOBAL-DEFAULT", "AGE"],
 }
 
 
@@ -697,8 +703,10 @@ def cmd_top(client, args) -> int:
         for pod in client.list("Pod", namespace=args.namespace):
             if pod.status.phase in ("Succeeded", "Failed"):
                 continue
-            cpu = sum(parse_quantity(c.requests["cpu"])
-                      for c in pod.spec.containers if "cpu" in c.requests)
+            # parse_quantity returns Fraction, which float-format rejects
+            cpu = float(sum(parse_quantity(c.requests["cpu"])
+                            for c in pod.spec.containers
+                            if "cpu" in c.requests))
             print(f"{pod.metadata.name:32} {cpu:>11.2f} "
                   f"{pod_memory_usage_mib(pod):>12.0f}")
         return 0

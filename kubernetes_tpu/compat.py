@@ -1,0 +1,60 @@
+"""Runtime compatibility shims.
+
+The codebase targets Python 3.11; the deployment images pin whatever the
+jax toolchain ships, which today is 3.10. The one 3.11-ism used
+pervasively (library + tests) is ``asyncio.timeout``. On 3.10 we install
+a minimal backport with the same observable semantics for our usage:
+
+- entering schedules a cancellation of the *current task* at the
+  deadline;
+- a cancellation caused by that deadline surfaces as ``TimeoutError``
+  at the ``async with`` exit (external cancellations pass through);
+- exiting before the deadline cancels the timer.
+
+Nested timeouts compose (each level converts only its own expiry). The
+3.11 ``Task.uncancel`` bookkeeping has no 3.10 equivalent, so a timeout
+that fires in the same instant as an external cancel is reported as a
+timeout — acceptable for the bounded-wait loops this codebase uses it
+for.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+
+class _TimeoutBackport:
+    """``async with asyncio.timeout(delay):`` for Python 3.10."""
+
+    def __init__(self, delay: float | None):
+        self._delay = delay
+        self._handle = None
+        self._expired = False
+
+    async def __aenter__(self):
+        if self._delay is not None:
+            task = asyncio.current_task()
+
+            def _fire() -> None:
+                self._expired = True
+                task.cancel()
+
+            self._handle = asyncio.get_running_loop().call_later(
+                self._delay, _fire)
+        return self
+
+    async def __aexit__(self, exc_type, exc, tb):
+        if self._handle is not None:
+            self._handle.cancel()
+        if self._expired and exc_type is asyncio.CancelledError:
+            raise TimeoutError from exc
+        return False
+
+
+def install() -> None:
+    """Idempotently fill in ``asyncio.timeout`` when the stdlib lacks it."""
+    if not hasattr(asyncio, "timeout"):
+        asyncio.timeout = _TimeoutBackport  # type: ignore[attr-defined]
+
+
+install()

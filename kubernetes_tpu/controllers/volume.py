@@ -227,7 +227,11 @@ class PersistentVolumeBinder(ReconcileController):
                              "annotations": {PROVISIONED_BY_ANNOTATION:
                                              body.get("provisioner", "")}},
                 "spec": spec})
-            pv.status["phase"] = "Bound"
+            # born Pending like the reference's provisioned volumes
+            # (pv_controller.go ctrl.provisionClaimOperation creates with
+            # no phase); _finish_bind flips it to Bound only once the
+            # claim side of the bind actually lands
+            pv.status["phase"] = "Pending"
             try:
                 self.store.create(pv)
             except AlreadyExists:
@@ -247,12 +251,19 @@ class PersistentVolumeBinder(ReconcileController):
             self.store.guaranteed_update(
                 "PersistentVolumeClaim", pvc.metadata.name,
                 pvc.metadata.namespace, bind_pvc)
-        except (NotFound, Conflict):
-            # claim vanished mid-bind: a dynamically PROVISIONED volume
-            # honors its Delete reclaim policy (pv_controller deletes
-            # orphaned provisioned volumes — recycling one as Available
-            # would hand a future claim a used fake disk); pre-existing
-            # volumes just free up
+        except Conflict:
+            # a CAS miss only means SOMEONE ELSE wrote the claim — it is
+            # still there. Treating it as "claim vanished" (and deleting
+            # the freshly provisioned volume) would strand the claim;
+            # retry the bind on a later sync instead.
+            self.enqueue_after(pvc.key, 0.05)
+            return
+        except NotFound:
+            # claim genuinely vanished mid-bind: a dynamically PROVISIONED
+            # volume honors its Delete reclaim policy (pv_controller
+            # deletes orphaned provisioned volumes — recycling one as
+            # Available would hand a future claim a used fake disk);
+            # pre-existing volumes just free up
             try:
                 pv = self.store.get("PersistentVolume", pv_name)
             except NotFound:
@@ -266,6 +277,17 @@ class PersistentVolumeBinder(ReconcileController):
                     pass
             else:
                 self._scrub(pv_name)
+            return
+
+        def pv_bound(obj):
+            obj.status["phase"] = "Bound"
+            return obj
+
+        try:
+            self.store.guaranteed_update("PersistentVolume", pv_name,
+                                         "default", pv_bound)
+        except (NotFound, Conflict):
+            pass
 
     def _set_phase_pvc(self, pvc, phase: str) -> None:
         if pvc.phase == phase:

@@ -91,6 +91,12 @@ class BatchFlags:
                           # the group-revert carry extension is dead weight —
                           # whole-ledger selects per scan step — so the gate
                           # keeps the non-gang program untaxed
+    preempt: bool = True  # any nonzero pod priority in batch: all-zero
+                          # priorities can never out-rank a victim, so the
+                          # victim-selection pass is provably neutral and the
+                          # pre-preemption program compiles unchanged (the
+                          # pass also needs a VictimTable — absent one,
+                          # schedule_batch skips it at trace time regardless)
 
 
 ALL_ACTIVE = BatchFlags()
@@ -215,6 +221,7 @@ def batch_flags(batch: PodBatch, n_pods: int, table) -> BatchFlags:
         storage=any_(batch.requests[:, Resource.SCRATCH])
         or any_(batch.requests[:, Resource.OVERLAY]),
         gang=any_(batch.gang_id > 0),
+        preempt=any_(batch.priority != 0),
     )
 
 
@@ -223,6 +230,27 @@ def table_has_prefer_taints(table) -> bool:
     count (the map input is taint_prefer_member, populated only by
     PreferNoSchedule taints)."""
     return any(effect == "PreferNoSchedule" for _k, _v, effect in table.taints)
+
+
+@struct.dataclass
+class VictimTable:
+    """Per-node preemption candidates — the bound-pods tensor the victim-
+    selection pass scans (the batched analog of selectNodesForPreemption's
+    per-node pod lists, generic_scheduler.go). Built host-side by
+    kubernetes_tpu/preemption/victims.py from the StateDB accounting:
+
+    - slots within a node are sorted ASCENDING by (priority, pod key), so
+      "evict lowest-priority victims first" is a prefix of the slot axis
+      and (node, k) identifies the victim set reproducibly on the host;
+    - `ok` is False for empty slots and for pods any covering
+      PodDisruptionBudget refuses to disrupt (disruptionsAllowed <= 0) —
+      the pass never selects a PDB-protected victim;
+    - `prio` is INT32_MAX on empty slots so they sort last.
+    """
+
+    prio: jnp.ndarray   # i32[N, S] victim priority (INT32_MAX = empty slot)
+    req: jnp.ndarray    # f32[N, S, R] victim resource requests (device units)
+    ok: jnp.ndarray     # bool[N, S] evictable (PDB allows; slot occupied)
 
 
 @struct.dataclass
@@ -242,6 +270,13 @@ class SolverResult:
     new_vol_any: jnp.ndarray   # f32[N, UV]
     new_vol_rw: jnp.ndarray    # f32[N, UV]
     new_attach: jnp.ndarray    # f32[N, UA]
+    # preemption verdicts for pods the scan left unassigned: the node whose
+    # minimal victim set the pass chose (-1 = none found / pass off) and the
+    # victim count k — the first k ok-slots of that node's VictimTable row.
+    # Constant (-1, 0) when the pass is compiled out, so gated and
+    # ALL_ACTIVE programs stay field-for-field comparable.
+    preempt_node: jnp.ndarray = None   # i32[P]
+    victim_count: jnp.ndarray = None   # i32[P]
 
 
 @struct.dataclass
@@ -532,6 +567,7 @@ def schedule_batch(
     prows=None,
     flags: BatchFlags = ALL_ACTIVE,
     allow_fused: bool = True,
+    victims: VictimTable | None = None,
 ) -> SolverResult:
     """Schedule a whole pending batch in one device program.
 
@@ -540,11 +576,22 @@ def schedule_batch(
     when the policy has none — models/policy.py build_policy_rows). Returns
     per-pod assignments plus the post-batch resource ledger for the host to
     commit (assume semantics).
+
+    `victims` (a VictimTable) enables the preemption pass: pods the scan
+    leaves unassigned get a per-node minimal-victim-set search and a
+    pickOneNodeForPreemption node choice reported via
+    (preempt_node, victim_count). The pass is traced only when BOTH
+    flags.preempt is set AND a table is given — a batch with no priorities,
+    or a driver with nothing evictable, compiles the exact pre-preemption
+    program.
     """
     # normalize to jnp arrays: un-jitted callers pass host numpy, and numpy
     # arrays cannot be indexed by traced scalars inside the scan
     state = jax.tree.map(jnp.asarray, state)
     batch = jax.tree.map(jnp.asarray, batch)
+    use_preempt = flags.preempt and victims is not None
+    if use_preempt:
+        victims = jax.tree.map(jnp.asarray, victims)
 
     g = policy_gates(policy, flags)
     # only the gates the remaining inline code reads; _base_rows/_init_carry/
@@ -741,6 +788,14 @@ def schedule_batch(
         nodes = jnp.where(group_failed, -1, nodes)
         scores = jnp.where(group_failed, 0.0, scores)
 
+    if use_preempt:
+        preempt_node, victim_count = _preemption_pass(
+            state, batch, masked_static, nodes, final.requested, victims,
+            use_gang)
+    else:
+        preempt_node = jnp.full(nodes.shape, -1, jnp.int32)
+        victim_count = jnp.zeros(nodes.shape, jnp.int32)
+
     return SolverResult(
         assignments=nodes,
         scores=scores,
@@ -756,7 +811,148 @@ def schedule_batch(
         new_vol_any=final.vol_any if use_nodisk else state.vol_any,
         new_vol_rw=final.vol_rw if use_nodisk else state.vol_rw,
         new_attach=final.attach_count if attach_maxes else state.attach_count,
+        preempt_node=preempt_node,
+        victim_count=victim_count,
     )
+
+
+class _PodRequests:
+    """Minimal pod shim for preds.fits_resources_dyn, which reads only
+    `.requests` — lets the preemption pass reuse the exact
+    predicates.go:556 fit composition without scanning the full batch
+    pytree a second time."""
+
+    __slots__ = ("requests",)
+
+    def __init__(self, requests):
+        self.requests = requests
+
+
+def _preemption_pass(state: ClusterState, batch: PodBatch, masked_static,
+                     nodes, base_requested, victims: VictimTable,
+                     use_gang: bool):
+    """Batched victim selection for pods the scan left unassigned.
+
+    Mirrors the reference preemption flow (generic_scheduler.go
+    selectNodesForPreemption / pickOneNodeForPreemption) over the
+    VictimTable: for each participating pod, on every statically-feasible
+    node, find the minimal k such that evicting the k lowest-priority
+    evictable candidates (priority strictly below the preemptor's, PDBs
+    respected via `ok`) makes PodFitsResources pass against the post-batch
+    ledger; then pick the node lexicographically minimizing
+    (highest victim priority, victim count, node index).
+
+    A second scan over the pod axis carries in-batch preemption bookings —
+    chosen victims are marked taken and the preemptor's requests are
+    charged against the freed node, so two preemptors in one batch never
+    double-book the same freed capacity. Gang groups are all-or-nothing:
+    if ANY participating member of a group finds no victim set, the whole
+    group's bookings revert at the group boundary and its verdicts are
+    masked out — no evictions happen for a gang that cannot fully land.
+
+    Returns (preempt_node i32[P] (-1 = none), victim_count i32[P]).
+    Resource-only semantics: the freed capacity re-check covers the
+    resource fit; the preemptor still reschedules through the full solver
+    after the evictions land, so the other dynamic predicates (ports,
+    disk conflicts) are enforced at placement time, not here.
+    """
+    n_nodes = base_requested.shape[0]
+    n_slots = victims.prio.shape[1]
+    imin = jnp.iinfo(jnp.int32).min
+    imax = jnp.iinfo(jnp.int32).max
+    participate = batch.valid & (nodes < 0)
+    static_ok = masked_static > -jnp.inf
+    node_iota = jnp.arange(n_nodes, dtype=jnp.int32)
+    ks = jnp.arange(n_slots + 1, dtype=jnp.float32)
+
+    def pstep(carry, xs):
+        extra, taken, snap_e, snap_t, cur, bad = carry
+        req_p, prio_p, part, s_ok, gid = xs
+        # gang boundary: settle the group being left (revert its bookings
+        # if any member failed), then snapshot for a newly entered group
+        boundary = gid != cur
+        revert = boundary & (cur > 0) & bad
+        extra = jnp.where(revert, snap_e, extra)
+        taken = jnp.where(revert, snap_t, taken)
+        entering = boundary & (gid > 0)
+        snap_e = jnp.where(entering, extra, snap_e)
+        snap_t = jnp.where(entering, taken, snap_t)
+        bad = bad & ~boundary
+
+        # candidates: evictable, not already booked by an earlier
+        # preemptor, strictly lower priority than this pod
+        cand = victims.ok & ~taken & (victims.prio < prio_p)
+        cand_f = cand.astype(jnp.float32)
+        rank = jnp.cumsum(cand_f, axis=1)              # f32[N, S], 1-based
+        count = rank[:, -1]                            # f32[N]
+        freed_cum = jnp.cumsum(cand_f[:, :, None] * victims.req, axis=1)
+        ledger = base_requested + extra
+        # ledgers after evicting the first 0..S candidates: [S+1, N, R]
+        adj = jnp.concatenate(
+            [ledger[None], ledger[None] - jnp.moveaxis(freed_cum, 1, 0)],
+            axis=0)
+        shim = _PodRequests(req_p)
+        fit_k = jax.vmap(
+            lambda led: preds.fits_resources_dyn(state, shim, led))(adj)
+        # k beyond the candidate count frees nothing more — exclude it so
+        # "minimal k" is well-defined and (node, k) names real victims
+        ok_k = fit_k & (ks[:, None] <= count[None, :]) & s_ok[None, :]
+        feas = jnp.any(ok_k, axis=0)                   # bool[N]
+        k_n = jnp.argmax(ok_k, axis=0).astype(jnp.int32)  # first feasible k
+        chosen = cand & (rank <= k_n[:, None].astype(jnp.float32))
+        # highest victim priority of the minimal set (imin when k == 0:
+        # a no-eviction node dominates every evicting one)
+        top_prio = jnp.max(jnp.where(chosen, victims.prio, imin), axis=1)
+        # pickOneNodeForPreemption: lexicographic min over
+        # (top victim priority, victim count, node index)
+        tp = jnp.where(feas, top_prio, imax)
+        m1 = feas & (tp == jnp.min(tp))
+        kk = jnp.where(m1, k_n, imax)
+        m2 = m1 & (kk == jnp.min(kk))
+        node = jnp.argmax(m2).astype(jnp.int32)
+        found = jnp.any(feas)
+        act = part & found
+
+        k_sel = k_n[node]
+        freed_sel = jnp.where(
+            k_sel > 0, freed_cum[node, jnp.maximum(k_sel - 1, 0)], 0.0)
+        add = jnp.where(act, 1.0, 0.0)
+        extra = extra.at[node].add(add * (req_p - freed_sel))
+        taken = taken | (chosen & (node_iota == node)[:, None] & act)
+        bad = bad | (part & ~found & (gid > 0))
+        out = jnp.stack([jnp.where(act, node, jnp.int32(-1)),
+                         jnp.where(act, k_sel, jnp.int32(0))])
+        return (extra, taken, snap_e, snap_t, gid, bad), out
+
+    zero_extra = jnp.zeros_like(base_requested)
+    zero_taken = jnp.zeros((n_nodes, n_slots), bool)
+    init = (zero_extra, zero_taken, zero_extra, zero_taken,
+            jnp.int32(0), jnp.bool_(False))
+    _, packed = jax.lax.scan(
+        pstep, init,
+        (batch.requests, batch.priority, participate, static_ok,
+         batch.gang_id))
+    preempt_node = packed[:, 0]
+    victim_count = packed[:, 1]
+
+    if use_gang:
+        # all-or-nothing over each group's PARTICIPANTS: if any failed to
+        # find a victim set, the scan already reverted the group's
+        # bookings — mask its verdicts so the driver evicts nothing
+        gid_col = batch.gang_id
+        seg = jnp.cumsum(jnp.concatenate(
+            [jnp.zeros((1,), jnp.int32),
+             (gid_col[1:] != gid_col[:-1]).astype(jnp.int32)]))
+        n_part = jax.ops.segment_sum(
+            participate.astype(jnp.int32), seg,
+            num_segments=gid_col.shape[0])
+        n_found = jax.ops.segment_sum(
+            (participate & (preempt_node >= 0)).astype(jnp.int32), seg,
+            num_segments=gid_col.shape[0])
+        group_bad = (gid_col > 0) & (n_found[seg] < n_part[seg])
+        preempt_node = jnp.where(group_bad, -1, preempt_node)
+        victim_count = jnp.where(group_bad, 0, victim_count)
+    return preempt_node, victim_count
 
 
 def evaluate_pod(

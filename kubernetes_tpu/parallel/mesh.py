@@ -100,17 +100,26 @@ def make_sharded_scheduler(mesh: Mesh, policy: Policy = DEFAULT_POLICY,
         new_podsel=nodes_spec, new_term=nodes_spec,
         new_vol_any=nodes_spec, new_vol_rw=nodes_spec,
         new_attach=nodes_spec,
+        preempt_node=repl, victim_count=repl,
     )
     if packed:
         from kubernetes_tpu.state.pod_batch import unpack_batch
 
-        return jax.jit(
-            lambda state, fblob, iblob, rr: schedule_batch(
+        # victims (a VictimTable or None) rides replicated: the in_shardings
+        # leaf is a pytree prefix, valid for both structures
+        jfn = jax.jit(
+            lambda state, fblob, iblob, rr, victims: schedule_batch(
                 state, unpack_batch(fblob, iblob, caps), rr, policy,
-                caps=caps, prows=prows, flags=flags, allow_fused=False),
-            in_shardings=(st, repl, repl, repl),
+                caps=caps, prows=prows, flags=flags, allow_fused=False,
+                victims=victims),
+            in_shardings=(st, repl, repl, repl, repl),
             out_shardings=out_shardings,
         )
+
+        def packed_fn(state, fblob, iblob, rr, victims=None):
+            return jfn(state, fblob, iblob, rr, victims)
+
+        return packed_fn
     return jax.jit(
         lambda state, batch, rr: schedule_batch(state, batch, rr, policy,
                                                 caps=caps, prows=prows,

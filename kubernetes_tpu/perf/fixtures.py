@@ -60,6 +60,7 @@ def make_pods(
     pref_affinity_every: int = 0,
     gang_size: int = 0,
     gang_min: int | None = None,
+    priority_class_name: str = "",
 ) -> list[Pod]:
     """Templated pending pods (the basic scheduler_perf pod spec: small
     cpu/memory requests).
@@ -70,7 +71,9 @@ def make_pods(
     toward it (the interpod-heavy config shape, BASELINE.md);
     gang_size groups consecutive pods into all-or-nothing gangs of that
     size (quorum gang_min, default the full size) — keep n divisible by
-    gang_size or the trailing partial group waits out its quorum timeout."""
+    gang_size or the trailing partial group waits out its quorum timeout;
+    priority_class_name stamps spec.priorityClassName (resolved to a
+    numeric priority at admission when the store runs the default chain)."""
     out = []
     for i in range(n):
         meta: dict = {"name": f"{name_prefix}-{i}", "namespace": namespace}
@@ -88,6 +91,8 @@ def make_pods(
             "image": "k8s.gcr.io/pause:3.0",
             "resources": {"requests": {"cpu": cpu, "memory": memory}},
         }]}
+        if priority_class_name:
+            spec["priorityClassName"] = priority_class_name
         if selector_every and i % selector_every == 0:
             spec["nodeSelector"] = {"label-0": f"value-{i % 7}"}
         if tolerate:

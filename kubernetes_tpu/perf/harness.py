@@ -170,6 +170,114 @@ def run_device_solve(
 
 
 @dataclass
+class PreemptionResult:
+    """Priority/preemption drill: saturate the cluster with low-priority
+    filler, then drive a high-priority wave through the nominate-evict-
+    rebind flow and measure how fast displaced capacity turns into bound
+    high-priority pods."""
+
+    n_nodes: int
+    fillers: int
+    wave: int
+    bound_wave: int
+    attempts: int
+    victims: int
+    seconds: float
+    preemption_latency_ms: float
+    victims_per_sec: float
+
+    def __str__(self) -> str:
+        return (f"preemption N={self.n_nodes}: {self.bound_wave}/{self.wave} "
+                f"high-prio pods landed in {self.seconds:.2f}s via "
+                f"{self.victims} victims ({self.victims_per_sec:.0f} "
+                f"victims/s, p50 latency {self.preemption_latency_ms:.1f}ms)")
+
+
+async def _run_preemption(n_nodes: int, wave: int,
+                          fillers_per_node: int) -> PreemptionResult:
+    """Saturate every node's CPU with globalDefault-priority filler, then
+    create a wave of pods whose PriorityClass outranks the filler and whose
+    request only fits after an eviction. Each wave pod must take the full
+    unschedulable -> solver victim pick -> evict + nominate -> requeue ->
+    bind path, so the timed wave exercises all three preemption layers."""
+    from kubernetes_tpu.api.objects import PriorityClass
+    from kubernetes_tpu.apiserver.admission import default_chain
+
+    store = ObjectStore(admission=default_chain(),
+                        watch_window=max(1 << 18, 16 * n_nodes))
+    store.create(PriorityClass.from_dict({
+        "metadata": {"name": "bench-filler"}, "value": 0,
+        "globalDefault": True,
+        "description": "preemptible bench filler"}))
+    store.create(PriorityClass.from_dict({
+        "metadata": {"name": "bench-critical"}, "value": 100_000,
+        "description": "preempting bench wave"}))
+    for node in make_nodes(n_nodes, cpu="4", memory="8Gi"):
+        store.create(node)
+
+    num = 1 << max(6, (n_nodes - 1).bit_length())
+    caps = Capacities(num_nodes=num,
+                      batch_pods=min(2048, max(64, n_nodes)))
+    sched = Scheduler(store, caps=caps)
+    await sched.start()
+
+    async def drain(expect: int) -> int:
+        done = 0
+        idle = 0
+        while done < expect and idle < 6:
+            got = await sched.schedule_pending(wait=0.2)
+            done += got
+            busy = got > 0 or sched.inflight_batches > 0
+            idle = 0 if busy else idle + 1
+        return done
+
+    # two fillers per node leave 4000m - 2*1900m = 200m free: any wave
+    # pod with a 2-core request is unschedulable until a filler is evicted
+    n_fill = fillers_per_node * n_nodes
+    for pod in make_pods(n_fill, cpu="1900m", memory="256Mi",
+                         name_prefix="filler"):
+        store.create(pod)
+    await asyncio.sleep(0)
+    filled = await drain(n_fill)
+    if filled < n_fill:
+        sched.stop()
+        raise RuntimeError(
+            f"preemption bench: only {filled}/{n_fill} fillers bound")
+
+    # the timed wave's metrics must not include the filler phase
+    from kubernetes_tpu.scheduler.driver import SchedulerMetrics
+    sched.metrics = SchedulerMetrics()
+
+    for pod in make_pods(wave, cpu="2", memory="512Mi",
+                         name_prefix="crit",
+                         priority_class_name="bench-critical"):
+        store.create(pod)
+    await asyncio.sleep(0)
+    t0 = time.perf_counter()
+    bound = await drain(wave)
+    dt = time.perf_counter() - t0
+    snap = sched.metrics.snapshot()
+    pre = snap.get("preemption", {})
+    sched.stop()
+    return PreemptionResult(
+        n_nodes=n_nodes, fillers=n_fill, wave=wave, bound_wave=bound,
+        attempts=pre.get("attempts", 0), victims=pre.get("victims", 0),
+        seconds=dt,
+        # each wave pod's e2e sample spans first-seen -> bound, i.e. the
+        # whole preemption cycle including the post-eviction requeue
+        preemption_latency_ms=snap.get("e2e_p50_ms", 0.0),
+        victims_per_sec=pre.get("victims", 0) / dt if dt > 0 else 0.0)
+
+
+def run_preemption(n_nodes: int = 512, wave: int | None = None,
+                   fillers_per_node: int = 2) -> PreemptionResult:
+    """Blocking entry point for the priority/preemption drill."""
+    if wave is None:
+        wave = max(8, n_nodes // 4)
+    return asyncio.run(_run_preemption(n_nodes, wave, fillers_per_node))
+
+
+@dataclass
 class RecoveryResult:
     nodes: int
     killed: int

@@ -1,0 +1,52 @@
+"""Pod priority & preemption: batched victim selection for pods that
+don't fit.
+
+The reference flow (plugin/pkg/scheduler/core/generic_scheduler.go
+Preempt / selectNodesForPreemption / pickOneNodeForPreemption): when a pod
+fails scheduling, try evicting lower-priority pods until it fits, pick the
+node whose minimal victim set has the lowest highest-priority victim (then
+the fewest victims), nominate the pod onto that node, and evict through
+the eviction subresource so PodDisruptionBudgets are honored. The
+subsystem spans four layers:
+
+- `api/objects.py PriorityClass` — the scheduling.k8s.io priority class
+  (value, globalDefault); the Priority admission plugin resolves
+  `spec.priorityClassName` to the numeric `spec.priority` at create time;
+- `state/pod_batch.py` — a per-pod `priority` column
+  (BatchFlags.preempt gates the pass out of batches with no priority
+  spread, keeping the pre-preemption program bit-identical);
+- `ops/solver.py` — the batched victim-selection scan over a
+  `VictimTable` (S lowest-priority bound pods per node, PDB-evictable
+  bits precomputed host-side): minimal victim sets per node via a cumsum
+  over priority-ascending candidates, node pick mirroring
+  pickOneNodeForPreemption, in-batch double-booking prevented by an
+  (extra, taken) carry, gangs all-or-nothing;
+- `scheduler/driver.py` — records status.nominatedNodeName, issues
+  victim evictions through `disruption.can_evict` + graceful delete,
+  holds the freed capacity against lower-priority pods until the
+  preemptor lands or the hold times out, and exports
+  scheduler_preemption_{attempts,victims,success}_total.
+
+Victim identity is positional: host and device share the same ascending
+(priority, pod key) slot order, so a device verdict (node, k) names
+exactly the first k still-evictable lower-priority slots on that node —
+`resolve_victims` reconstructs the set without shipping strings to device.
+"""
+
+from __future__ import annotations
+
+from kubernetes_tpu.preemption.victims import (
+    DEFAULT_NOMINATION_TTL_S,
+    NominatedNodes,
+    build_victim_table,
+    pdb_evictable,
+    resolve_victims,
+)
+
+__all__ = [
+    "DEFAULT_NOMINATION_TTL_S",
+    "NominatedNodes",
+    "build_victim_table",
+    "pdb_evictable",
+    "resolve_victims",
+]

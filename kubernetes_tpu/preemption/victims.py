@@ -1,0 +1,193 @@
+"""Host-side half of the preemption pass: VictimTable assembly, verdict →
+victim-identity resolution, and nominated-node capacity holds.
+
+The device pass (ops/solver.py `_preemption_pass`) sees victims only as
+tensors — priorities, request rows, evictability bits — in a fixed slot
+order. This module owns that order: slots are the S lowest-priority
+accounted pods per node, ascending by (priority, pod key), so a device
+verdict "evict k victims on node n" deterministically names the first k
+slots still evictable for that preemptor. No pod identity ever crosses
+the host/device boundary.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from kubernetes_tpu.state.layout import Resource
+
+INT32_MAX = np.iinfo(np.int32).max
+
+# How long a nominated node's freed capacity is defended against
+# lower-priority pods while the preemptor's victims terminate and it
+# reschedules (the reference holds the nomination until the pod lands or
+# scheduling gives up on it).
+DEFAULT_NOMINATION_TTL_S = 30.0
+
+
+def pdb_evictable(store, pod) -> bool:
+    """Read-only mirror of `disruption.can_evict`'s covering check: every
+    PDB covering the pod currently has disruptionsAllowed > 0. Used to
+    precompute the VictimTable's `ok` bits — the actual budget spend still
+    happens at eviction time through `can_evict`, so a budget that drains
+    between batch assembly and eviction refuses the evict (the driver then
+    drops the nomination)."""
+    from kubernetes_tpu.state.podaffinity import (
+        PARSE_ERROR,
+        canonical_selector,
+        selector_matches,
+    )
+
+    ns = pod.metadata.namespace
+    for pdb in store.list("PodDisruptionBudget", namespace=ns,
+                          copy_objects=False):
+        canon = canonical_selector(pdb.selector or None)
+        if canon in ((), PARSE_ERROR) \
+                or not selector_matches(canon, pod.metadata.labels):
+            continue
+        if int(pdb.status.get("disruptionsAllowed", 0)) <= 0:
+            return False
+    return True
+
+
+def build_victim_table(statedb, pods_by_key: dict, *, store=None,
+                       evictable=None):
+    """Assemble the device VictimTable + the host identity map from the
+    StateDB's accounted (bound + assumed) pods.
+
+    pods_by_key: pod key -> Pod for every accounted pod the informer still
+    knows (priority comes from the resolved spec.priority; keys missing
+    from the map — e.g. a pod deleted between accounting and assembly —
+    are skipped). `evictable` overrides the PDB check (tests); otherwise
+    `store` is consulted via `pdb_evictable`, and with neither every
+    victim is evictable.
+
+    Returns (victims, slots):
+    - victims: ops.solver.VictimTable as numpy arrays, or None when no
+      node has any candidate (the caller then omits the pass entirely and
+      the pre-preemption program runs);
+    - slots: node row -> list of (pod_key, priority, evictable) in slot
+      order, for `resolve_victims`.
+
+    Only the S = caps.victim_slots lowest-priority pods per node become
+    candidates; a node needing deeper eviction than S simply reports no
+    feasible set that round (capacity approximation, like every other
+    padded universe here).
+    """
+    from kubernetes_tpu.ops.solver import VictimTable
+
+    caps = statedb.caps
+    n, s = caps.num_nodes, caps.victim_slots
+    prio = np.full((n, s), INT32_MAX, np.int32)
+    req = np.zeros((n, s, Resource.COUNT), np.float32)
+    ok = np.zeros((n, s), bool)
+    slots: dict[int, list] = {}
+
+    per_node: dict[int, list] = {}
+    for key, acc in statedb._accounted.items():
+        pod = pods_by_key.get(key)
+        if pod is None:
+            continue
+        row = statedb.table.row_of.get(acc.node_name)
+        if row is None:
+            continue
+        per_node.setdefault(row, []).append(
+            (int(pod.spec.priority), key, acc, pod))
+
+    any_candidate = False
+    for row, entries in per_node.items():
+        entries.sort(key=lambda e: (e[0], e[1]))
+        entries = entries[:s]
+        slot_list = []
+        for i, (p, key, acc, pod) in enumerate(entries):
+            prio[row, i] = p
+            req[row, i] = acc.requests
+            if evictable is not None:
+                ev = bool(evictable(pod))
+            elif store is not None:
+                ev = pdb_evictable(store, pod)
+            else:
+                ev = True
+            ok[row, i] = ev
+            any_candidate = any_candidate or ev
+            slot_list.append((key, p, ev))
+        slots[row] = slot_list
+
+    if not any_candidate:
+        return None, slots
+    return VictimTable(prio=prio, req=req, ok=ok), slots
+
+
+def resolve_victims(slots: dict, node_row: int, k: int,
+                    preemptor_priority: int, taken: set) -> list[str] | None:
+    """Reconstruct the device's chosen victim set for a (node, k) verdict:
+    the first k slots on the node that are evictable, strictly lower
+    priority than the preemptor, and not already claimed by an earlier
+    preemptor this settle (`taken`, which this call extends). Returns the
+    pod keys, or None if the table can no longer supply k victims — the
+    state moved since the solve; the caller drops the nomination and the
+    pod retries next batch."""
+    chosen: list[str] = []
+    for key, p, ev in slots.get(node_row, ()):
+        if len(chosen) == k:
+            break
+        if not ev or key in taken or p >= preemptor_priority:
+            continue
+        chosen.append(key)
+    if len(chosen) < k:
+        return None
+    taken.update(chosen)
+    return chosen
+
+
+@dataclass
+class _Hold:
+    node_name: str
+    priority: int
+    deadline: float
+
+
+@dataclass
+class NominatedNodes:
+    """Capacity holds for preemptors in flight: after victims are evicted,
+    the freed room on the nominated node is defended against LOWER-priority
+    pods until the preemptor lands there or the hold times out — otherwise
+    the next batch backfills the hole and the preemption loops forever
+    (the reference keeps pod.Status.NominatedNodeName visible to the
+    scheduler's assume cache for exactly this reason)."""
+
+    ttl: float = DEFAULT_NOMINATION_TTL_S
+    _holds: dict[str, _Hold] = field(default_factory=dict)
+
+    def nominate(self, pod_key: str, node_name: str, priority: int,
+                 now: float) -> None:
+        self._holds[pod_key] = _Hold(node_name, priority, now + self.ttl)
+
+    def release(self, pod_key: str) -> None:
+        """The preemptor bound (anywhere) or gave up — drop its hold."""
+        self._holds.pop(pod_key, None)
+
+    def expire(self, now: float) -> list[str]:
+        """Drop stale holds; returns the expired pod keys."""
+        dead = [k for k, h in self._holds.items() if h.deadline <= now]
+        for k in dead:
+            del self._holds[k]
+        return dead
+
+    def blocks(self, node_name: str, priority: int, now: float) -> bool:
+        """Would placing a pod of `priority` on `node_name` steal an active
+        hold from a strictly-higher-priority preemptor?"""
+        for h in self._holds.values():
+            if h.node_name == node_name and h.priority > priority \
+                    and h.deadline > now:
+                return True
+        return False
+
+    def node_of(self, pod_key: str) -> str | None:
+        h = self._holds.get(pod_key)
+        return h.node_name if h else None
+
+    def __len__(self) -> int:
+        return len(self._holds)

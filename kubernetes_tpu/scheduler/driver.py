@@ -112,6 +112,15 @@ class SchedulerMetrics:
         self._c_gang_timeouts = r.counter(
             "scheduler_gang_groups_timeout_total",
             "Gangs that timed out waiting for quorum; members released.")
+        self._c_preempt_attempts = r.counter(
+            "scheduler_preemption_attempts_total",
+            "Preemption attempts (pods with a victim-set verdict).")
+        self._c_preempt_victims = r.counter(
+            "scheduler_preemption_victims_total",
+            "Pods evicted to make room for higher-priority pods.")
+        self._c_preempt_success = r.counter(
+            "scheduler_preemption_success_total",
+            "Preemptions that evicted their victims and nominated a node.")
         self._h_phase = r.histogram(
             "scheduler_phase_duration_seconds",
             "Per-batch scheduling phase durations "
@@ -128,6 +137,9 @@ class SchedulerMetrics:
         self.gang_placed = 0
         self.gang_reverted = 0
         self.gang_timeouts = 0
+        self.preempt_attempts = 0
+        self.preempt_victims = 0
+        self.preempt_success = 0
         # bounded windows (the registry histograms are cumulative; the
         # windows keep the recent-sample percentiles snapshot() reports)
         self.e2e_latency = _LatencyWindow(r.histogram(
@@ -208,6 +220,18 @@ class SchedulerMetrics:
         self.gang_timeouts += 1
         self._c_gang_timeouts.inc()
 
+    def preempt_attempt_inc(self) -> None:
+        self.preempt_attempts += 1
+        self._c_preempt_attempts.inc()
+
+    def preempt_victims_add(self, n: int) -> None:
+        self.preempt_victims += n
+        self._c_preempt_victims.inc(n)
+
+    def preempt_success_inc(self) -> None:
+        self.preempt_success += 1
+        self._c_preempt_success.inc()
+
     def add_phase(self, name: str, seconds: float) -> None:
         self.phase_s[name] = self.phase_s.get(name, 0.0) + seconds
         self._h_phase.labels(name).observe(seconds)
@@ -244,6 +268,10 @@ class SchedulerMetrics:
             out["gang"] = {"placed": self.gang_placed,
                            "reverted": self.gang_reverted,
                            "timeouts": self.gang_timeouts}
+        if self.preempt_attempts:
+            out["preemption"] = {"attempts": self.preempt_attempts,
+                                 "victims": self.preempt_victims,
+                                 "success": self.preempt_success}
         return out
 
 
@@ -299,6 +327,7 @@ class Scheduler:
         mesh=None,
         scheduler_name: str = "default-scheduler",
         batch_wait: float = 0.002,
+        enable_preemption: bool = True,
     ):
         from kubernetes_tpu.utils.compilation_cache import enable
 
@@ -344,6 +373,13 @@ class Scheduler:
         self._gang_of_pod: dict[str, str] = {}
         self._gang_first_seen: dict[str, float] = {}
         self._gang_min_hint: dict[str, int] = {}
+        # priority preemption: nominated-node capacity holds + the flag
+        # (BatchFlags.preempt additionally gates the pass per batch, so a
+        # priority-free workload never compiles the preemption program)
+        from kubernetes_tpu.preemption import NominatedNodes
+
+        self.enable_preemption = enable_preemption
+        self.nominated = NominatedNodes()
 
         self.node_informer = Informer(store, "Node")
         self.pod_informer = Informer(store, "Pod")
@@ -409,9 +445,9 @@ class Scheduler:
                                             packed=True)
             else:
                 fn = jax.jit(
-                    lambda s, fb, ib, rr: schedule_batch(
+                    lambda s, fb, ib, rr, v=None: schedule_batch(
                         s, unpack_batch(fb, ib, caps), rr, policy,
-                        caps=caps, prows=prows, flags=flags))
+                        caps=caps, prows=prows, flags=flags, victims=v))
             self._schedule_fns[flags] = fn
         return fn
 
@@ -487,7 +523,13 @@ class Scheduler:
             # encode-on-watch: fingerprint + class encode now, while the
             # previous batch is on the wire/device, so batch assembly on
             # the critical path is a key lookup + two row memcpys
-            self.encode_cache.premake(pod)
+            try:
+                self.encode_cache.premake(pod)
+            except CapacityError:
+                # over-capacity pods still enqueue: batch assembly re-raises
+                # and its per-pod failure path records the FailedScheduling
+                # event instead of wedging the informer handler
+                pass
             # gang members wait in staging until their group reaches
             # quorum — the extender path is per-pod and cannot place a
             # group atomically, so it schedules them individually
@@ -735,6 +777,8 @@ class Scheduler:
         """Pop up to a batch of pending pods, schedule, bind. Returns the
         number of pods scheduled (in pipeline mode: settled this call)."""
         self._check_gang_timeouts()
+        if len(self.nominated):
+            self.nominated.expire(time.monotonic())
         effective_wait = 0 if self._inflight_q else wait
         keys = await self.queue.get_batch(self.caps.batch_pods,
                                           wait=effective_wait)
@@ -813,6 +857,7 @@ class Scheduler:
         flags = packed_batch_flags(fblob, iblob, len(pods),
                                    self.statedb.table, self.caps)
         schedule_fn = self._get_schedule_fn(flags)
+        victims, vslots = self._build_victims(flags)
         settled = 0
         if self._inflight_q and (not self._pipeline
                                  or self.statedb.ledger_dirty):
@@ -825,7 +870,7 @@ class Scheduler:
         timer.step("encode + flush")
 
         t0 = time.monotonic()
-        result = schedule_fn(state, fblob, iblob, self._rr)
+        result = schedule_fn(state, fblob, iblob, self._rr, victims)
         self._rr = result.rr_end
         try:
             # start the device->host copy now; by settle time (after the
@@ -857,12 +902,13 @@ class Scheduler:
             self.statedb.adopt_result(result)
             self._inflight_q.append((result, pods, live_keys, (fblob, iblob),
                                      flags, t0, timer, True, fetch,
-                                     gang_groups))
+                                     gang_groups, vslots))
             while len(self._inflight_q) > self.pipeline_depth:
                 settled += await self._asettle_one()
             return settled
         self._inflight_q.append((result, pods, live_keys, (fblob, iblob),
-                                 flags, t0, timer, False, fetch, gang_groups))
+                                 flags, t0, timer, False, fetch, gang_groups,
+                                 vslots))
         return settled + await self._asettle_inflight()
 
     async def _schedule_with_extenders(self, pods: list[Pod],
@@ -1026,7 +1072,7 @@ class Scheduler:
         if not self._inflight_q:
             return 0
         (result, pods, live_keys, blobs, flags, t0, timer,
-         adopted, fetch, gang_groups) = self._inflight_q.popleft()
+         adopted, fetch, gang_groups, vslots) = self._inflight_q.popleft()
         if assignments is None and fetch.done() \
                 and not fetch.cancelled() and fetch.exception() is None:
             assignments = fetch.result()  # prefetch already landed
@@ -1060,6 +1106,15 @@ class Scheduler:
         # partition the batch: assigned rows to bind vs solver rejections
         name_of = self.statedb.table.name_of
         rows = assignments[:len(pods)].tolist()
+        # preemption verdicts ride the same result; resolve them only when
+        # this batch actually carried a victim table
+        preempt_rows = victim_counts = None
+        if vslots is not None:
+            preempt_rows = np.asarray(
+                result.preempt_node)[:len(pods)].tolist()
+            victim_counts = np.asarray(
+                result.victim_count)[:len(pods)].tolist()
+        taken_victims: set[str] = set()
         # settle gangs at the GROUP level first: a reverted group requeues
         # as one unit with group backoff (its members' -1 rows are the
         # solver's revert, not individual rejections); a placed group's
@@ -1087,19 +1142,50 @@ class Scheduler:
                     pods[p], "Warning", "FailedScheduling",
                     f"pod group {gkey} placed {placed}/{quorum} members; "
                     f"group reverted (all-or-nothing)")
+            # gang preemption composes all-or-nothing: the solver emits
+            # verdicts only when EVERY unplaced member found a victim set,
+            # so either the whole group's victims are evicted or none are
+            if preempt_rows is not None:
+                unplaced = [p for p in positions if rows[p] < 0]
+                if unplaced and all(preempt_rows[p] >= 0 for p in unplaced):
+                    for p in unplaced:
+                        if not self._preempt_one(
+                                live_keys[p], pods[p], preempt_rows[p],
+                                victim_counts[p], vslots, taken_victims):
+                            break
             self.queue.add_after(qkey, self.backoff.next_delay(qkey))
         to_bind: list[tuple[int, str, Pod, str]] = []
+        now_mono = time.monotonic()
+        holds_active = len(self.nominated) > 0
         for i, (key, pod) in enumerate(zip(live_keys, pods)):
             row = rows[i]
             if row < 0:
                 if key in gang_handled:
                     continue  # group-level requeue already recorded
+                if preempt_rows is not None and preempt_rows[i] >= 0 \
+                        and self._preempt_one(key, pod, preempt_rows[i],
+                                              victim_counts[i], vslots,
+                                              taken_victims):
+                    # nominated + victims evicted: retry once they vanish
+                    self.queue.done(key)
+                    self.queue.add_after(key, 0.05)
+                    continue
                 self._fail(key, pod, "no nodes available to schedule pods")
                 continue
             node_name = name_of[row]
             if node_name is None:
                 any_rejected = True  # the vanished node left a ledger charge
                 self._fail(key, pod, "assigned node vanished")
+                continue
+            if holds_active and self.nominated.blocks(
+                    node_name, int(pod.spec.priority), now_mono):
+                # the solver saw the victims' freed room, but it is being
+                # held for a nominated higher-priority preemptor — backing
+                # off here is what makes the eviction actually pay off
+                any_rejected = True
+                self._fail(key, pod,
+                           f"node {node_name} capacity is held for a "
+                           f"nominated higher-priority pod")
                 continue
             to_bind.append((i, key, pod, node_name))
 
@@ -1149,6 +1235,7 @@ class Scheduler:
             scheduled += 1
             queue_done(key)
             backoff_reset(key)
+            self.nominated.release(key)
             enq = enq_pop(key, None)
             if enq is not None:
                 e2e_append(now - enq)
@@ -1193,6 +1280,90 @@ class Scheduler:
         timer.step("bind + commit")
         timer.log_if_long(0.1 * len(pods))
         return scheduled
+
+    def _build_victims(self, flags):
+        """Victim-candidate table for this batch: the StateDB's accounted
+        pods joined with informer priorities, PDB-evictable bits read from
+        the store. Returns (None, None) when the pass is off — preemption
+        disabled, no priority spread in the batch (flags.preempt), or no
+        evictable candidate anywhere — so the pre-preemption program runs
+        unchanged."""
+        if not (self.enable_preemption and flags.preempt):
+            return None, None
+        from kubernetes_tpu.preemption import build_victim_table
+
+        pods_by_key: dict[str, Pod] = {}
+        for key in self.statedb._accounted:
+            ns, name = key.split("/", 1)
+            victim = self.pod_informer.get(name, ns)
+            if victim is not None:
+                pods_by_key[key] = victim
+        victims, vslots = build_victim_table(
+            self.statedb, pods_by_key, store=self.store)
+        if victims is None:
+            return None, None
+        return victims, vslots
+
+    def _preempt_one(self, key: str, pod: Pod, node_row: int, k: int,
+                     vslots: dict, taken: set) -> bool:
+        """Act on one preemption verdict: evict the victim set through the
+        PDB-checked eviction path, record status.nominatedNodeName on the
+        preemptor, and hold the freed capacity. Returns True when the
+        nomination stands. Already-evicted victims are never rolled back
+        on a later refusal (the reference evicts asynchronously too) — the
+        preemptor just retries against the partially-freed node."""
+        from kubernetes_tpu.controllers.disruption import can_evict
+        from kubernetes_tpu.preemption import resolve_victims
+
+        self.metrics.preempt_attempt_inc()
+        node_name = self.statedb.table.name_of[node_row]
+        if node_name is None:
+            return False  # verdict node vanished since the solve
+        vkeys = resolve_victims(vslots, node_row, int(k),
+                                int(pod.spec.priority), taken)
+        if vkeys is None:
+            return False  # table went stale: retry next batch
+        evicted = 0
+        for vkey in vkeys:
+            vns, vname = vkey.split("/", 1)
+            victim = self.pod_informer.get(vname, vns)
+            if victim is None:
+                continue  # already gone; its capacity is already free
+            if not can_evict(self.store, victim):
+                # a budget drained between table assembly and now: refuse
+                # the rest (eviction-subresource 429 semantics)
+                self.events.record(
+                    pod, "Warning", "FailedPreemption",
+                    f"eviction of {vkey} refused by disruption budget")
+                return False
+            try:
+                self.store.delete("Pod", vname, vns)
+            except (NotFound, Conflict):
+                continue
+            evicted += 1
+            self.events.record(
+                victim, "Normal", "Preempted",
+                f"Preempted by {key} to make room on node {node_name}")
+
+        def set_nominated(obj):
+            obj.status.nominated_node_name = node_name
+            return obj
+
+        try:
+            self.store.guaranteed_update(
+                "Pod", pod.metadata.name, pod.metadata.namespace,
+                set_nominated)
+        except (NotFound, Conflict):
+            return False  # preemptor vanished mid-preemption
+        self.nominated.nominate(key, node_name, int(pod.spec.priority),
+                                time.monotonic())
+        self.metrics.preempt_victims_add(evicted)
+        self.metrics.preempt_success_inc()
+        self.events.record(
+            pod, "Normal", "Preempting",
+            f"Evicted {evicted} lower-priority pod(s) on {node_name}; "
+            f"nominated")
+        return True
 
     def _fail(self, key: str, pod: Pod, message: str) -> None:
         self.metrics.failed += 1

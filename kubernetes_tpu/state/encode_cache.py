@@ -51,6 +51,8 @@ def pod_fingerprint(pod: Pod) -> tuple:
         tuple(sorted(spec.node_selector.items())),
         tuple((t.key, t.operator, t.value, t.effect) for t in spec.tolerations),
         spec.node_name,
+        # the preemption pass reads the resolved priority column
+        spec.priority,
         # pod-affinity matching reads namespace + labels (pod_match_row)
         pod.metadata.namespace,
         tuple(sorted(pod.metadata.labels.items())),

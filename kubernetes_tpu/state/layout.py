@@ -193,6 +193,7 @@ class Capacities:
     image_universe: int = 64       # UI: distinct container-image names
     avoid_universe: int = 16       # UO: distinct preferAvoidPods signatures
     volsel_universe: int = 16      # UVS: distinct PV node-affinity selectors
+    victim_slots: int = 16         # S: preemption victim candidates per node
 
 
 class CapacityError(ValueError):

@@ -103,6 +103,10 @@ class PodBatch:
     # contiguous run; the driver never splits a group across batches.
     gang_id: np.ndarray         # i32[P] batch-local group index, 0 = none
     gang_min: np.ndarray        # i32[P] group minMember quorum (0 when no gang)
+    # pod priority (spec.priority, admission-resolved from the
+    # PriorityClass); read by the preemption pass — a pod may only evict
+    # victims of strictly lower priority
+    priority: np.ndarray        # i32[P]
 
     @property
     def batch_pods(self) -> int:
@@ -164,6 +168,7 @@ def empty_batch(caps: Capacities) -> PodBatch:
         avoid_onehot=np.zeros((p, caps.avoid_universe), np.float32),
         gang_id=np.zeros((p,), np.int32),
         gang_min=np.zeros((p,), np.int32),
+        priority=np.zeros((p,), np.int32),
     )
 
 
@@ -276,6 +281,10 @@ def packed_batch_flags(fblob, iblob, n: int, table, caps: Capacities):
                      or requests[:, Resource.OVERLAY].any()),
         gang=bool((np.asarray(blob_col(fblob, iblob, "gang_id", caps, n))
                    > 0).any()),
+        # absent (all-zero) priorities can never out-rank anything: the
+        # preemption pass is provably neutral, so skip compiling it
+        preempt=bool((np.asarray(blob_col(fblob, iblob, "priority", caps, n))
+                      != 0).any()),
     )
 
 
@@ -337,6 +346,7 @@ def encode_pod_into(batch: PodBatch, i: int, pod: Pod, caps: Capacities,
         batch.node_name_lo[i] = 0
         batch.node_name_hi[i] = 0
     batch.best_effort[i] = pod.is_best_effort()
+    batch.priority[i] = pod.spec.priority
     _encode_node_affinity(batch, i, pod, caps, table)
     _encode_interpod_affinity(batch, i, pod, caps, table)
     _encode_volumes(batch, i, pod, caps, table, ctx)

@@ -931,3 +931,142 @@ class SerialScheduler:
                     results[k] = None
             i = j
         return results
+
+    # ---- priority preemption (victim selection) ----
+
+    def _static_ok(self, ns: NodeState, pod: Pod) -> bool:
+        """The device Phase-A static mask for default-policy fixtures:
+        everything assignment-independent — NOT resources (rechecked
+        against the evicted ledger) and NOT ports (dynamic; the preemptor
+        re-schedules through the full solver after evictions land)."""
+        return (fits_host(ns, pod) and match_selector(ns, pod)
+                and tolerates_taints(ns, pod) and conditions_ok(ns, pod))
+
+    def _fits_evicted(self, ns: NodeState, pod: Pod, extra, freed) -> bool:
+        """fits_resources against the node's post-batch ledger plus earlier
+        preemptors' bookings (`extra`) minus this victim set's requests
+        (`freed`) — the serial twin of the device pass's vmapped
+        fits_resources_dyn over adjusted ledgers. Tuples are
+        (cpu, mem, gpu, scratch, overlay, pods)."""
+        req_cpu = ns.req_cpu + extra[0] - freed[0]
+        req_mem = ns.req_mem + extra[1] - freed[1]
+        req_gpu = ns.req_gpu + extra[2] - freed[2]
+        req_scr = ns.req_scratch + extra[3] - freed[3]
+        req_ovl = ns.req_overlay + extra[4] - freed[4]
+        num_pods = ns.num_pods + extra[5] - freed[5]
+        if num_pods + 1 > ns.alloc_pods:
+            return False
+        cpu, mem, gpu, scratch, overlay = pod_request(pod)
+        if cpu == 0 and mem == 0 and gpu == 0 and scratch == 0 and overlay == 0:
+            return True
+        if not (ns.alloc_cpu >= cpu + req_cpu
+                and ns.alloc_mem >= mem + req_mem
+                and ns.alloc_gpu >= gpu + req_gpu):
+            return False
+        if ns.alloc_overlay == 0:
+            if ns.alloc_scratch < (scratch + overlay) + (req_ovl + req_scr):
+                return False
+        else:
+            if ns.alloc_scratch < scratch + req_scr:
+                return False
+            if ns.alloc_overlay < overlay + req_ovl:
+                return False
+        return True
+
+    def preempt(self, pods: list[Pod], results: list[str | None],
+                victims_by_node: dict, gang_ids: list[int] | None = None):
+        """Try-evict-then-fit oracle: the behavioral spec the device
+        preemption pass (ops/solver.py _preemption_pass) is pinned against.
+
+        For each pod the batch left unplaced (results[i] is None), over
+        every statically-feasible node: candidates are the node's victim
+        slots — `victims_by_node[name]` is a list of
+        (priority, pod_key, Pod, evictable) ASCENDING by (priority, key),
+        truncated to Capacities.victim_slots, the serial twin of the
+        VictimTable — filtered to evictable, not taken by an earlier
+        preemptor, and strictly lower priority than the preemptor. The
+        minimal k (0 allowed) whose first-k eviction makes the resource
+        fit pass wins; the node pick minimizes (highest victim priority
+        [k=0 sorts below every real set], victim count, node order),
+        mirroring pickOneNodeForPreemption. Bookings carry across pods:
+        chosen victims are taken and the preemptor's requests charge the
+        node. Gangs (contiguous nonzero gang_ids) are all-or-nothing over
+        their unplaced members: any member without a victim set reverts
+        the whole group's bookings and verdicts.
+
+        Returns a list of (node name | None, tuple of victim pod keys).
+        """
+        gang_ids = gang_ids or [0] * len(pods)
+        extra: dict[str, list] = {}       # node -> booked requests
+        taken: set[str] = set()
+        verdicts: list[tuple[str | None, tuple]] = \
+            [(None, ()) for _ in pods]
+
+        def attempt(i: int) -> bool:
+            pod, prio_p = pods[i], pods[i].spec.priority
+            best = None  # (top_prio, k, node_idx, node, chosen_keys, freed)
+            for idx, ns in enumerate(self.states):
+                name = ns.node.metadata.name
+                if not self._static_ok(ns, pod):
+                    continue
+                cand = [(p, key, vpod) for (p, key, vpod, ev)
+                        in victims_by_node.get(name, ())
+                        if ev and key not in taken and p < prio_p]
+                booked = extra.get(name, [0] * 6)
+                freed = [0] * 6
+                found = None
+                for k in range(len(cand) + 1):
+                    if k > 0:
+                        vr = pod_request(cand[k - 1][2])
+                        for j in range(5):
+                            freed[j] += vr[j]
+                        freed[5] += 1
+                    if self._fits_evicted(ns, pod, booked, freed):
+                        found = k
+                        break
+                if found is None:
+                    continue
+                top = cand[found - 1][0] if found > 0 else float("-inf")
+                entry = (top, found, idx, ns,
+                         tuple(key for _p, key, _v in cand[:found]),
+                         tuple(freed[:5]) + (freed[5],))
+                if best is None or entry[:3] < best[:3]:
+                    best = entry
+            if best is None:
+                return False
+            _top, _k, _idx, ns, chosen, freed = best
+            name = ns.node.metadata.name
+            booked = extra.setdefault(name, [0] * 6)
+            preq = pod_request(pods[i])
+            for j in range(5):
+                booked[j] += preq[j] - freed[j]
+            booked[5] += 1 - freed[5]
+            taken.update(chosen)
+            verdicts[i] = (name, chosen)
+            return True
+
+        i = 0
+        while i < len(pods):
+            gid = gang_ids[i]
+            if gid == 0:
+                if results[i] is None:
+                    attempt(i)
+                i += 1
+                continue
+            j = i
+            while j < len(pods) and gang_ids[j] == gid:
+                j += 1
+            snap = ({k: list(v) for k, v in extra.items()}, set(taken))
+            bad = False
+            for k in range(i, j):
+                if results[k] is None and not attempt(k):
+                    bad = True
+            if bad:
+                extra.clear()
+                extra.update({k: list(v) for k, v in snap[0].items()})
+                taken.clear()
+                taken.update(snap[1])
+                for k in range(i, j):
+                    verdicts[k] = (None, ())
+            i = j
+        return verdicts

@@ -1,0 +1,467 @@
+"""Priority & preemption: the device victim-selection pass pinned against
+the serial try-evict-then-fit oracle (tests/serial_reference.py preempt),
+the PriorityClass admission resolver, the neutrality guarantee for
+priority-free batches, and the driver's nominate-evict-rebind flow."""
+
+import asyncio
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from kubernetes_tpu.api.objects import Node, ObjectMeta, Pod, PriorityClass
+from kubernetes_tpu.apiserver import ObjectStore
+from kubernetes_tpu.apiserver.admission import AdmissionError, default_chain
+from kubernetes_tpu.apiserver.validation import ValidationError
+from kubernetes_tpu.models.policy import DEFAULT_POLICY
+from kubernetes_tpu.ops.solver import (
+    ALL_ACTIVE,
+    VictimTable,
+    batch_flags,
+    schedule_batch,
+)
+from kubernetes_tpu.preemption import resolve_victims
+from kubernetes_tpu.state import Capacities, Resource, encode_cluster
+from kubernetes_tpu.state.cluster_state import pod_requests
+from tests.serial_reference import SerialScheduler
+
+jit_schedule = jax.jit(schedule_batch, static_argnames=("policy", "flags"))
+
+INT32_MAX = np.iinfo(np.int32).max
+
+
+def mk_node(name, cpu="4", mem="8Gi", pods="110"):
+    return Node.from_dict({
+        "metadata": {"name": name},
+        "status": {"allocatable": {"cpu": cpu, "memory": mem, "pods": pods},
+                   "conditions": [{"type": "Ready", "status": "True"}]},
+    })
+
+
+def mk_pod(name, cpu=None, mem=None, priority=0, node=None):
+    c = {"name": "c"}
+    req = {}
+    if cpu:
+        req["cpu"] = cpu
+    if mem:
+        req["memory"] = mem
+    if req:
+        c["resources"] = {"requests": req}
+    spec = {"containers": [c], "priority": priority}
+    if node:
+        spec["nodeName"] = node
+    return Pod.from_dict({"metadata": {"name": name}, "spec": spec})
+
+
+def build_tables(filler, table, caps, evictable=None):
+    """Device VictimTable + the serial oracle's victims_by_node + the
+    driver-shaped slots map, all from the same bound pods with the same
+    ascending (priority, key) slot order."""
+    evictable = evictable or (lambda p: True)
+    per_node: dict[str, list] = {}
+    for pod in filler:
+        per_node.setdefault(pod.spec.node_name, []).append(pod)
+    prio = np.full((caps.num_nodes, caps.victim_slots), INT32_MAX, np.int32)
+    req = np.zeros((caps.num_nodes, caps.victim_slots, Resource.COUNT),
+                   np.float32)
+    ok = np.zeros((caps.num_nodes, caps.victim_slots), bool)
+    by_name: dict[str, list] = {}
+    slots: dict[int, list] = {}
+    for name, podlist in per_node.items():
+        podlist.sort(key=lambda p: (p.spec.priority, p.key))
+        podlist = podlist[:caps.victim_slots]
+        row = table.row_of[name]
+        by_name[name] = [(p.spec.priority, p.key, p, evictable(p))
+                         for p in podlist]
+        slots[row] = [(p.key, p.spec.priority, evictable(p))
+                      for p in podlist]
+        for i, p in enumerate(podlist):
+            prio[row, i] = p.spec.priority
+            req[row, i] = pod_requests(p)
+            ok[row, i] = evictable(p)
+    return (VictimTable(prio=prio, req=req, ok=ok), by_name, slots)
+
+
+def solve_preempt(nodes, pods, filler, caps=None, evictable=None,
+                  gang=None):
+    caps = caps or Capacities(num_nodes=16, batch_pods=16, victim_slots=8)
+    state, batch, table = encode_cluster(nodes, pods, caps,
+                                         assigned_pods=filler)
+    if gang:
+        batch.gang_id[:len(pods)] = np.asarray(gang[0], np.int32)
+        batch.gang_min[:len(pods)] = np.asarray(gang[1], np.int32)
+    victims, by_name, slots = build_tables(filler, table, caps, evictable)
+    flags = batch_flags(batch, len(pods), table)
+    result = jit_schedule(state, batch, 0, DEFAULT_POLICY, flags=flags,
+                          victims=victims)
+    return result, table, by_name, slots, caps
+
+
+def serial_verdicts(nodes, pods, filler, by_name, gang=None):
+    ser = SerialScheduler(nodes, assigned_pods=filler)
+    if gang:
+        results = ser.schedule_gang(pods, gang[0], gang[1])
+    else:
+        results = ser.schedule(pods)
+    return results, ser.preempt(pods, results, by_name,
+                                gang_ids=gang[0] if gang else None)
+
+
+def assert_parity(result, table, pods, serial_results, verdicts, slots):
+    """Device assignments + preemption verdicts == serial oracle, and the
+    driver-side victim resolution reproduces the oracle's victim sets."""
+    got = [table.name_of[int(a)] if a >= 0 else None
+           for a in np.asarray(result.assignments)[:len(pods)]]
+    assert got == serial_results
+    pnode = np.asarray(result.preempt_node)[:len(pods)]
+    pcount = np.asarray(result.victim_count)[:len(pods)]
+    taken: set = set()
+    for i, (want_node, want_victims) in enumerate(verdicts):
+        got_node = table.name_of[int(pnode[i])] if pnode[i] >= 0 else None
+        assert got_node == want_node, \
+            f"pod {i}: verdict node {got_node} != oracle {want_node}"
+        assert int(pcount[i]) == len(want_victims), \
+            f"pod {i}: victim count {int(pcount[i])} != {len(want_victims)}"
+        if want_node is not None:
+            resolved = resolve_victims(slots, int(pnode[i]), int(pcount[i]),
+                                       pods[i].spec.priority, taken)
+            assert tuple(resolved) == want_victims
+
+
+# ---- solver vs serial oracle ----
+
+
+def test_basic_preemption_picks_lowest_priority_victims():
+    # both nodes full; n0 needs two prio-1/2 victims, n1 one prio-5 victim;
+    # pickOneNode minimizes the highest victim priority -> n0 with k=2
+    nodes = [mk_node("n0", cpu="4"), mk_node("n1", cpu="4")]
+    filler = [mk_pod("f0", cpu="1800m", priority=1, node="n0"),
+              mk_pod("f1", cpu="1800m", priority=2, node="n0"),
+              mk_pod("f2", cpu="3600m", priority=5, node="n1")]
+    pods = [mk_pod("hi", cpu="3500m", priority=100)]
+    result, table, by_name, slots, _ = solve_preempt(nodes, pods, filler)
+    serial_results, verdicts = serial_verdicts(nodes, pods, filler, by_name)
+    assert serial_results == [None]
+    assert verdicts[0][0] == "n0" and len(verdicts[0][1]) == 2
+    assert_parity(result, table, pods, serial_results, verdicts, slots)
+
+
+def test_equal_or_higher_priority_never_victim():
+    nodes = [mk_node("n0", cpu="2")]
+    filler = [mk_pod("f0", cpu="1800m", priority=100, node="n0")]
+    pods = [mk_pod("same", cpu="1500m", priority=100),
+            mk_pod("lower", cpu="1500m", priority=50)]
+    result, table, by_name, slots, _ = solve_preempt(nodes, pods, filler)
+    serial_results, verdicts = serial_verdicts(nodes, pods, filler, by_name)
+    assert verdicts == [(None, ()), (None, ())]
+    assert_parity(result, table, pods, serial_results, verdicts, slots)
+
+
+def test_pdb_protected_victims_never_evicted():
+    nodes = [mk_node("n0", cpu="2"), mk_node("n1", cpu="2")]
+    filler = [mk_pod("f0", cpu="1800m", priority=1, node="n0"),
+              mk_pod("f1", cpu="1800m", priority=2, node="n1")]
+    pods = [mk_pod("hi", cpu="1500m", priority=100)]
+    protected = lambda p: p.metadata.name != "f0"  # noqa: E731
+    result, table, by_name, slots, _ = solve_preempt(
+        nodes, pods, filler, evictable=protected)
+    serial_results, verdicts = serial_verdicts(nodes, pods, filler, by_name)
+    # f0's node would win on priority (1 < 2) but f0 is PDB-protected:
+    # the verdict must fall to n1 and never name f0
+    assert verdicts[0][0] == "n1" and verdicts[0][1] == ("default/f1",)
+    assert_parity(result, table, pods, serial_results, verdicts, slots)
+
+
+def test_no_feasible_victim_set_yields_no_verdict():
+    # the only victim is too small to free enough cpu
+    nodes = [mk_node("n0", cpu="2")]
+    filler = [mk_pod("f0", cpu="500m", priority=1, node="n0"),
+              mk_pod("keep", cpu="1400m", priority=200, node="n0")]
+    pods = [mk_pod("hi", cpu="1800m", priority=100)]
+    result, table, by_name, slots, _ = solve_preempt(nodes, pods, filler)
+    serial_results, verdicts = serial_verdicts(nodes, pods, filler, by_name)
+    assert verdicts == [(None, ())]
+    assert_parity(result, table, pods, serial_results, verdicts, slots)
+
+
+def test_in_batch_preemptors_never_double_book_victims():
+    # two preemptors, one 2-cpu node each fully used by one victim: the
+    # second preemptor must not reuse the first's victim or freed room
+    nodes = [mk_node("n0", cpu="2"), mk_node("n1", cpu="2")]
+    filler = [mk_pod("f0", cpu="1800m", priority=1, node="n0"),
+              mk_pod("f1", cpu="1800m", priority=2, node="n1")]
+    pods = [mk_pod("hi-a", cpu="1500m", priority=100),
+            mk_pod("hi-b", cpu="1500m", priority=100)]
+    result, table, by_name, slots, _ = solve_preempt(nodes, pods, filler)
+    serial_results, verdicts = serial_verdicts(nodes, pods, filler, by_name)
+    assert {v[0] for v in verdicts} == {"n0", "n1"}
+    assert {k for v in verdicts for k in v[1]} \
+        == {"default/f0", "default/f1"}
+    assert_parity(result, table, pods, serial_results, verdicts, slots)
+
+
+def test_gang_preempts_whole_quorum_or_nothing():
+    # a 3-member gang on two 2-cpu nodes with one evictable victim each:
+    # only 2 members can ever fit, so NO verdicts may be emitted
+    nodes = [mk_node("n0", cpu="2"), mk_node("n1", cpu="2")]
+    filler = [mk_pod("f0", cpu="1800m", priority=1, node="n0"),
+              mk_pod("f1", cpu="1800m", priority=1, node="n1")]
+    pods = [mk_pod(f"g{i}", cpu="1500m", priority=100) for i in range(3)]
+    gang = ([1, 1, 1], [3, 3, 3])
+    result, table, by_name, slots, _ = solve_preempt(
+        nodes, pods, filler, gang=gang)
+    serial_results, verdicts = serial_verdicts(
+        nodes, pods, filler, by_name, gang=gang)
+    assert verdicts == [(None, ())] * 3
+    assert_parity(result, table, pods, serial_results, verdicts, slots)
+
+
+def test_gang_preempts_when_whole_quorum_has_victims():
+    nodes = [mk_node("n0", cpu="2"), mk_node("n1", cpu="2")]
+    filler = [mk_pod("f0", cpu="1800m", priority=1, node="n0"),
+              mk_pod("f1", cpu="1800m", priority=1, node="n1")]
+    pods = [mk_pod(f"g{i}", cpu="1500m", priority=100) for i in range(2)]
+    gang = ([1, 1], [2, 2])
+    result, table, by_name, slots, _ = solve_preempt(
+        nodes, pods, filler, gang=gang)
+    serial_results, verdicts = serial_verdicts(
+        nodes, pods, filler, by_name, gang=gang)
+    assert sorted(v[0] for v in verdicts) == ["n0", "n1"]
+    assert_parity(result, table, pods, serial_results, verdicts, slots)
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_randomized_oracle_parity(seed):
+    """Random priorities, requests, filler layouts and PDB bits: the
+    device pass must agree with the serial try-evict-then-fit oracle on
+    every verdict (node, victim count, victim identities)."""
+    rng = np.random.RandomState(1000 + seed)
+    n_nodes = 6
+    nodes = [mk_node(f"n{i}", cpu=str(rng.randint(2, 5)))
+             for i in range(n_nodes)]
+    filler = []
+    for i in range(rng.randint(4, 14)):
+        filler.append(mk_pod(
+            f"f{i}", cpu=f"{int(rng.randint(2, 16)) * 100}m",
+            priority=int(rng.randint(0, 6)),
+            node=f"n{rng.randint(n_nodes)}"))
+    protected = frozenset(
+        f.metadata.name for f in filler if rng.rand() < 0.25)
+    evictable = lambda p: p.metadata.name not in protected  # noqa: E731
+    pods = [mk_pod(f"p{i}", cpu=f"{int(rng.randint(4, 24)) * 100}m",
+                   priority=int(rng.randint(0, 12)))
+            for i in range(rng.randint(2, 8))]
+    result, table, by_name, slots, _ = solve_preempt(
+        nodes, pods, filler, evictable=evictable)
+    serial_results, verdicts = serial_verdicts(nodes, pods, filler, by_name)
+    for want_node, want_victims in verdicts:
+        assert not any(k.split("/", 1)[1] in protected
+                       for k in want_victims)
+    assert_parity(result, table, pods, serial_results, verdicts, slots)
+
+
+# ---- neutrality: priority-free batches compile the pre-preemption program
+
+
+def test_priority_free_batch_has_preempt_flag_off():
+    nodes = [mk_node("n0")]
+    pods = [mk_pod("p0", cpu="100m"), mk_pod("p1", cpu="100m")]
+    caps = Capacities(num_nodes=16, batch_pods=16)
+    _state, batch, table = encode_cluster(nodes, pods, caps)
+    assert not batch_flags(batch, len(pods), table).preempt
+    batch.priority[1] = 7
+    assert batch_flags(batch, len(pods), table).preempt
+
+
+def test_no_victims_compiles_bit_identical_pre_preemption_program():
+    """victims=None must be COMPILED out, not just inert: the lowered
+    program for a preempt-flagged batch without a victim table is
+    textually identical to the preempt=False program (the gang-gate
+    neutrality guarantee, extended to preemption)."""
+    nodes = [mk_node(f"n{i}", cpu="2") for i in range(4)]
+    pods = [mk_pod(f"p{i}", cpu="500m", priority=i) for i in range(4)]
+    caps = Capacities(num_nodes=16, batch_pods=16)
+    state, batch, table = encode_cluster(nodes, pods, caps)
+    flags = batch_flags(batch, len(pods), table)
+    assert flags.preempt
+    import dataclasses
+
+    off = dataclasses.replace(flags, preempt=False)
+    lowered_on = jax.jit(
+        schedule_batch, static_argnames=("policy", "flags")).lower(
+            state, batch, 0, DEFAULT_POLICY, flags=flags).as_text()
+    lowered_off = jax.jit(
+        schedule_batch, static_argnames=("policy", "flags")).lower(
+            state, batch, 0, DEFAULT_POLICY, flags=off).as_text()
+    assert lowered_on == lowered_off
+
+
+def test_priority_free_batch_results_unchanged_by_victim_table():
+    """A batch with no priority spread must produce the exact ALL_ACTIVE
+    result on every field even when a victim table is supplied — the
+    preempt flag gates the pass, not the caller."""
+    nodes = [mk_node(f"n{i}", cpu="2") for i in range(4)]
+    filler = [mk_pod("f0", cpu="1800m", priority=0, node="n0")]
+    pods = [mk_pod(f"p{i}", cpu=c)
+            for i, c in enumerate(["500m", "1", "1500m", "250m", "2"])]
+    caps = Capacities(num_nodes=16, batch_pods=16)
+    state, batch, table = encode_cluster(nodes, pods, caps,
+                                         assigned_pods=filler)
+    victims, _, _ = build_tables(filler, table, caps)
+    flags = batch_flags(batch, len(pods), table)
+    assert not flags.preempt
+    gated = jit_schedule(state, batch, 0, DEFAULT_POLICY, flags=flags,
+                         victims=victims)
+    full = jit_schedule(state, batch, 0, DEFAULT_POLICY, flags=ALL_ACTIVE)
+    for name in type(gated).__dataclass_fields__:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(gated, name)),
+            np.asarray(getattr(full, name)), err_msg=name)
+
+
+# ---- PriorityClass API + admission ----
+
+
+def test_priorityclass_validation_rejects_out_of_range():
+    store = ObjectStore(admission=default_chain())
+    with pytest.raises(ValidationError):
+        store.create(PriorityClass(metadata=ObjectMeta(name="too-big"),
+                                   value=2_000_000_000))
+    store.create(PriorityClass(metadata=ObjectMeta(name="ok"),
+                               value=1_000_000))
+
+
+def test_admission_resolves_priority_class_at_create():
+    store = ObjectStore(admission=default_chain())
+    store.create(PriorityClass(metadata=ObjectMeta(name="high"), value=500,
+                               description="critical work"))
+    pod = mk_pod("p0", cpu="100m")
+    pod.spec.priority_class_name = "high"
+    stored = store.create(pod)
+    assert stored.spec.priority == 500
+    # unknown class is rejected outright
+    bad = mk_pod("p1", cpu="100m")
+    bad.spec.priority_class_name = "no-such-class"
+    with pytest.raises(AdmissionError):
+        store.create(bad)
+
+
+def test_admission_enforces_single_global_default():
+    store = ObjectStore(admission=default_chain())
+    store.create(PriorityClass(metadata=ObjectMeta(name="default-a"),
+                               value=10, global_default=True))
+    with pytest.raises(AdmissionError):
+        store.create(PriorityClass(metadata=ObjectMeta(name="default-b"),
+                                   value=20, global_default=True))
+    # pods with no class name get the global default stamped
+    stored = store.create(mk_pod("p0", cpu="100m"))
+    assert stored.spec.priority == 10
+    assert stored.spec.priority_class_name == "default-a"
+
+
+def test_priorityclass_roundtrips_through_dict():
+    pc = PriorityClass(metadata=ObjectMeta(name="gold"), value=1000,
+                       global_default=True, description="gold tier")
+    again = PriorityClass.from_dict(pc.to_dict())
+    assert again.value == 1000 and again.global_default
+    assert again.description == "gold tier"
+    pod = mk_pod("p", cpu="1")
+    pod.spec.priority_class_name = "gold"
+    pod.spec.priority = 1000
+    pod.status.nominated_node_name = "n0"
+    d = pod.to_dict()
+    assert d["spec"]["priorityClassName"] == "gold"
+    assert d["status"]["nominatedNodeName"] == "n0"
+    back = Pod.from_dict(d)
+    assert back.spec.priority == 1000
+    assert back.status.nominated_node_name == "n0"
+
+
+# ---- driver flow ----
+
+
+async def _drain(sched, total, timeout=15.0):
+    scheduled = 0
+    deadline = time.monotonic() + timeout
+    while scheduled < total:
+        if time.monotonic() > deadline:
+            raise TimeoutError(f"drained {scheduled}/{total}")
+        scheduled += await sched.schedule_pending(wait=0.1)
+    return scheduled
+
+
+def test_driver_preempts_evicts_and_rebinds():
+    from kubernetes_tpu.scheduler import Scheduler
+
+    async def run():
+        store = ObjectStore(admission=default_chain())
+        store.create(PriorityClass(metadata=ObjectMeta(name="low"), value=1))
+        store.create(PriorityClass(metadata=ObjectMeta(name="high"),
+                                   value=100))
+        for i in range(2):
+            store.create(mk_node(f"n{i}", cpu="2"))
+        caps = Capacities(num_nodes=8, batch_pods=8, victim_slots=4)
+        sched = Scheduler(store, caps=caps)
+        await sched.start()
+        for i in range(2):
+            filler = mk_pod(f"filler-{i}", cpu="1800m")
+            filler.spec.priority_class_name = "low"
+            store.create(filler)
+        await asyncio.sleep(0)
+        assert await _drain(sched, 2) == 2
+        hi = mk_pod("hi", cpu="1500m")
+        hi.spec.priority_class_name = "high"
+        store.create(hi)
+        await asyncio.sleep(0)
+        assert await _drain(sched, 1) == 1
+        bound = store.get("Pod", "hi")
+        assert bound.spec.node_name
+        # the nomination was recorded before the rebind
+        assert bound.status.nominated_node_name == bound.spec.node_name
+        snap = sched.metrics.snapshot()["preemption"]
+        assert snap["attempts"] >= 1
+        assert snap["victims"] == 1
+        assert snap["success"] >= 1
+        # exactly one filler was evicted, through a real store delete
+        names = [p.metadata.name for p in store.list("Pod")]
+        assert sum(n.startswith("filler") for n in names) == 1
+        events = store.list("Event")
+        assert any(e.reason == "Preempted" for e in events)
+        sched.stop()
+
+    asyncio.run(run())
+
+
+def test_driver_respects_pdb_at_eviction_time():
+    """A PDB covering the only victim refuses the eviction: the preemptor
+    must stay pending and the victim must survive."""
+    from kubernetes_tpu.api.objects import PodDisruptionBudget
+    from kubernetes_tpu.scheduler import Scheduler
+
+    async def run():
+        store = ObjectStore(admission=default_chain())
+        store.create(mk_node("n0", cpu="2"))
+        caps = Capacities(num_nodes=8, batch_pods=8, victim_slots=4)
+        sched = Scheduler(store, caps=caps)
+        await sched.start()
+        filler = mk_pod("filler", cpu="1800m", priority=1)
+        filler.metadata.labels = {"app": "protected"}
+        store.create(filler)
+        await asyncio.sleep(0)
+        assert await _drain(sched, 1) == 1
+        store.create(PodDisruptionBudget.from_dict({
+            "metadata": {"name": "pdb"},
+            "spec": {"minAvailable": 1,
+                     "selector": {"matchLabels": {"app": "protected"}}}}))
+        # disruptionsAllowed stays 0 (status never synced by a controller
+        # here), so the victim table marks the filler non-evictable
+        store.create(mk_pod("hi", cpu="1500m", priority=100))
+        await asyncio.sleep(0)
+        for _ in range(4):
+            await sched.schedule_pending(wait=0.05)
+        assert store.get("Pod", "hi").spec.node_name == ""
+        assert store.get("Pod", "filler").spec.node_name == "n0"
+        sched.stop()
+
+    asyncio.run(run())
