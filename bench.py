@@ -19,10 +19,13 @@ vs_baseline = headline value / 30.
 Prints exactly ONE JSON line on stdout (headline metric + per-config
 extras). Diagnostics go to stderr. Env overrides: BENCH_NODES, BENCH_PODS,
 BENCH_TIMEOUT_S, BENCH_CONFIGS (comma list of
-headline,interpod,spread,gang,preemption,recovery,device), BENCH_GANG_NODES /
-BENCH_GANG_PODS / BENCH_GANG_SIZE (gang config shape, default 50k nodes /
-24576 pods in 8-wide groups), BENCH_PREEMPT_NODES (preemption drill size,
-default 512 nodes saturated with low-priority filler).
+headline,interpod,spread,gang,preemption,recovery,chaos,device),
+BENCH_GANG_NODES / BENCH_GANG_PODS / BENCH_GANG_SIZE (gang config shape,
+default 50k nodes / 24576 pods in 8-wide groups), BENCH_PREEMPT_NODES
+(preemption drill size, default 512 nodes saturated with low-priority
+filler), BENCH_CHAOS_NODES / BENCH_CHAOS_SEED (convergence-under-chaos
+drill: seeded FaultPlane + watch expiry + scheduler crash; reports
+chaos_recovery_ms).
 
 --metrics-snapshot (or BENCH_METRICS_SNAPSHOT=1) embeds the scheduler's
 per-phase registry histograms (encode/flush/dispatch/solve/bind/commit:
@@ -59,7 +62,7 @@ def main() -> None:
     n_pods = int(os.environ.get("BENCH_PODS", "30000"))
     configs = os.environ.get(
         "BENCH_CONFIGS",
-        "headline,interpod,spread,gang,preemption,recovery,device")
+        "headline,interpod,spread,gang,preemption,recovery,chaos,device")
     configs = [c.strip() for c in configs.split(",") if c.strip()]
     metrics_snapshot = "--metrics-snapshot" in sys.argv[1:] or \
         os.environ.get("BENCH_METRICS_SNAPSHOT", "") in ("1", "true")
@@ -188,6 +191,29 @@ def main() -> None:
             RESULT["error"] = (
                 "recovery drill: killed zone never left Normal "
                 f"({r.zone_state_during!r})")
+
+    if "chaos" in configs:
+        from kubernetes_tpu.perf.harness import run_chaos
+
+        # convergence-under-chaos drill: the whole control plane talks
+        # through a seeded FaultPlane (5% store 429/Conflict), a forced
+        # watch expiry + watcher drop + hard scheduler crash lands
+        # mid-workload, and the cluster must converge with every pod
+        # bound exactly once (tests/test_faults.py is the assert-heavy
+        # twin; this row records the recovery figure on real hardware)
+        chaos_nodes = int(os.environ.get("BENCH_CHAOS_NODES", "128"))
+        chaos_seed = int(os.environ.get("BENCH_CHAOS_SEED", "1234"))
+        r = run_chaos(chaos_nodes, n_pods=max(200, 2 * chaos_nodes),
+                      seed=chaos_seed)
+        print(f"bench[chaos]: {r}", file=sys.stderr, flush=True)
+        extras["chaos_recovery_ms"] = round(r.recovery_ms, 1)
+        extras["chaos_faults_injected"] = r.faults_injected
+        extras["chaos_seed"] = r.seed
+        if not r.converged:
+            RESULT["error"] = (
+                f"chaos drill did not converge (seed {r.seed}): "
+                f"{r.bound}/{r.pods} bound, "
+                f"{r.double_binds} double-binds")
 
     if "device" in configs:
         # transport-independent: steady-state compiled-solver throughput

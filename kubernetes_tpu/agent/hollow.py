@@ -25,7 +25,13 @@ import logging
 import time
 
 from kubernetes_tpu.api.objects import Node, NodeCondition, Pod
-from kubernetes_tpu.apiserver.store import AlreadyExists, Conflict, NotFound, ObjectStore
+from kubernetes_tpu.apiserver.store import (
+    AlreadyExists,
+    Conflict,
+    NotFound,
+    ObjectStore,
+    TooManyRequests,
+)
 from kubernetes_tpu.client.informer import Informer
 
 log = logging.getLogger(__name__)
@@ -100,7 +106,9 @@ class HollowKubelet:
         try:
             self.store.guaranteed_update("Node", self.node_name, "default",
                                          mutate)
-        except (Conflict, NotFound):
+        except (Conflict, NotFound, TooManyRequests):
+            # a throttled heartbeat is a missed heartbeat, not a crash:
+            # the next period retries (tryUpdateNodeStatus's retry shape)
             pass
 
     # ---- pod lifecycle ----
@@ -116,6 +124,8 @@ class HollowKubelet:
                                    pod.metadata.namespace)
         except NotFound:
             return
+        except TooManyRequests:
+            return  # throttled ack: the resync sweep retries it
         if fresh.spec.node_name != self.node_name \
                 or fresh.status.phase == "Running":
             return
@@ -125,7 +135,7 @@ class HollowKubelet:
             {"type": "Ready", "status": "True", "lastTransitionTime": now}]
         try:
             self.store.update(fresh, check_version=False)
-        except (Conflict, NotFound):
+        except (Conflict, NotFound, TooManyRequests):
             pass
 
     # ---- lifecycle ----
@@ -157,11 +167,18 @@ class HollowCluster:
     def __init__(self, store: ObjectStore, n_nodes: int = 0,
                  name_prefix: str = "hollow",
                  heartbeat_every: float = DEFAULT_HEARTBEAT,
-                 capacity: dict | None = None, zones: int = 0):
+                 capacity: dict | None = None, zones: int = 0,
+                 resync_every: float = 0.0):
         self.store = store
         self.kubelets: dict[str, HollowKubelet] = {}
         self.pod_informer = Informer(store, "Pod")
         self.pod_informer.add_handler(self._on_pod)
+        # resync_every > 0 turns on a level-triggered sweep re-acking bound
+        # pods that are not Running yet: an ack dropped by a store fault or
+        # a watch gap is retried instead of lost forever (the kubelet's
+        # periodic syncPod, not just edge-triggered status writes)
+        self.resync_every = resync_every
+        self._resync_task: asyncio.Task | None = None
         for i in range(n_nodes):
             name = f"{name_prefix}-{i}"
             labels = ({"failure-domain.beta.kubernetes.io/zone":
@@ -183,17 +200,33 @@ class HollowCluster:
         if kubelet is not None and kubelet.running:
             kubelet.ack_pod(pod)
 
+    def _resync(self) -> None:
+        """Re-ack every bound-but-not-Running pod from the informer cache
+        (level-triggered: whatever events were missed, the state heals)."""
+        for pod in self.pod_informer.items():
+            if pod.spec.node_name and pod.status.phase != "Running":
+                kubelet = self.kubelets.get(pod.spec.node_name)
+                if kubelet is not None and kubelet.running:
+                    kubelet.ack_pod(pod)
+
+    async def _resync_loop(self) -> None:
+        while True:
+            await asyncio.sleep(self.resync_every)
+            try:
+                self._resync()
+            except Exception:  # noqa: BLE001 — the sweep must survive faults
+                log.exception("hollow resync sweep failed; retrying")
+
     async def start(self) -> None:
         self.pod_informer.start()
         for kubelet in self.kubelets.values():
             await kubelet.start()
         await self.pod_informer.wait_for_sync()
         # ack pods bound before the informer synced
-        for pod in self.pod_informer.items():
-            if pod.spec.node_name:
-                kubelet = self.kubelets.get(pod.spec.node_name)
-                if kubelet is not None and kubelet.running:
-                    kubelet.ack_pod(pod)
+        self._resync()
+        if self.resync_every > 0:
+            self._resync_task = asyncio.get_running_loop().create_task(
+                self._resync_loop())
 
     def stop(self, node_names=None) -> None:
         """Stop all agents (or the named subset — partial failure)."""
@@ -203,3 +236,6 @@ class HollowCluster:
             self.kubelets[name].stop()
         if node_names is None:
             self.pod_informer.stop()
+            if self._resync_task is not None:
+                self._resync_task.cancel()
+                self._resync_task = None

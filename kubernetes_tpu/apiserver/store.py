@@ -19,12 +19,15 @@ from __future__ import annotations
 
 import asyncio
 import fnmatch
+import logging
 import time
 from collections import deque
 from dataclasses import dataclass
 from typing import Any, Callable
 
 from kubernetes_tpu.api.objects import Binding
+
+log = logging.getLogger(__name__)
 
 
 class NotFound(KeyError):
@@ -59,6 +62,42 @@ def _key(namespace: str, name: str) -> tuple[str, str]:
     return (namespace or "default", name)
 
 
+# end-of-stream marker delivered to an evicted watcher's queue: the stream
+# drains buffered events, sees this, and terminates (consumer relists)
+_EVICTED = object()
+
+
+class _Watcher:
+    """One watch subscriber: kind filter + bounded delivery queue.
+
+    A subscriber that stops consuming would otherwise buffer every event
+    forever; when its queue overflows the store EVICTS it — stream ends,
+    client relists — the watch cache's terminate-blocked-watchers behavior
+    (storage/cacher.go:1261)."""
+
+    __slots__ = ("kind", "queue", "evicted")
+
+    def __init__(self, kind: str | None, maxsize: int):
+        self.kind = kind
+        self.queue: asyncio.Queue = asyncio.Queue(maxsize)
+        self.evicted = False
+
+
+_mx_evicted = None
+
+
+def _watch_evictions():
+    global _mx_evicted
+    if _mx_evicted is None:
+        from kubernetes_tpu.obs import metrics as m
+
+        _mx_evicted = m.REGISTRY.counter(
+            "store_watchers_evicted_total",
+            "Watch subscribers evicted for exceeding the per-watcher "
+            "queue bound (slow consumers must relist).")
+    return _mx_evicted
+
+
 class ObjectStore:
     """One store instance == one apiserver+etcd.
 
@@ -72,11 +111,18 @@ class ObjectStore:
     drained; replay cost is linear in total writes."""
 
     def __init__(self, watch_window: int = 4096,
-                 persist_path: str | None = None, admission=None):
+                 persist_path: str | None = None, admission=None,
+                 watcher_queue_limit: int | None = None):
         self._objects: dict[str, dict[tuple[str, str], Any]] = {}
         self._rv = 0
         self._history: deque[WatchEvent] = deque(maxlen=watch_window)
-        self._watchers: list[tuple[str | None, asyncio.Queue]] = []
+        # per-watcher queue bound: a consumer that falls this many events
+        # behind is evicted rather than buffered unboundedly (0 disables).
+        # Defaults to the history window — a watcher that far behind could
+        # not resume from its last seen version anyway
+        self._watcher_queue_limit = watch_window \
+            if watcher_queue_limit is None else watcher_queue_limit
+        self._watchers: list[_Watcher] = []
         self._wal = None
         self._cluster_ip_counter = 0
         # admission chain (apiserver/admission.py) applied to create/update
@@ -96,28 +142,42 @@ class ObjectStore:
 
         if not os.path.exists(path):
             return
-        with open(path, encoding="utf-8") as f:
+        # errors="replace" so a crash that tore a multi-byte character in
+        # half cannot abort the whole replay with UnicodeDecodeError — the
+        # mangled record then fails json parsing and is skipped like any
+        # other torn tail write
+        recovered = skipped = 0
+        with open(path, encoding="utf-8", errors="replace") as f:
             for line in f:
                 line = line.strip()
                 if not line:
                     continue
                 try:
                     entry = json.loads(line)
-                except ValueError:
-                    continue  # torn tail write from the crash: stop-safe
-                kind = entry["kind"]
-                rv = int(entry["rv"])
-                if entry["op"] == "DELETE":
-                    self._bucket(kind).pop(
-                        (entry["ns"], entry["name"]), None)
-                else:
-                    obj = decode_object(kind, entry["obj"])
-                    obj.metadata.resource_version = str(rv)
-                    self._bucket(kind)[(entry["ns"], entry["name"])] = obj
-                    if kind == "Service":
-                        self._reserve_cluster_ip(
-                            obj.spec.get("clusterIP", ""))
+                    kind = entry["kind"]
+                    rv = int(entry["rv"])
+                    if entry["op"] == "DELETE":
+                        self._bucket(kind).pop(
+                            (entry["ns"], entry["name"]), None)
+                    else:
+                        obj = decode_object(kind, entry["obj"])
+                        obj.metadata.resource_version = str(rv)
+                        self._bucket(kind)[(entry["ns"], entry["name"])] = obj
+                        if kind == "Service":
+                            self._reserve_cluster_ip(
+                                obj.spec.get("clusterIP", ""))
+                except Exception:  # noqa: BLE001 — crash recovery keeps the
+                    # valid prefix: a torn/truncated/corrupt record (bad
+                    # json, missing fields, undecodable object) is skipped,
+                    # never fatal — losing the tail write is the WAL's
+                    # contract, losing the whole log is not
+                    skipped += 1
+                    continue
+                recovered += 1
                 self._rv = max(self._rv, rv)
+        if skipped:
+            log.warning("WAL replay: recovered %d records, skipped %d "
+                        "corrupt/torn records", recovered, skipped)
 
     def _append_wal(self, event: WatchEvent, flush: bool = True) -> None:
         import json
@@ -294,11 +354,15 @@ class ObjectStore:
                 self._append_wal(ev, flush=False)
             self._wal.flush()
         self._history.extend(events)
-        for kind, queue in self._watchers:
-            put = queue.put_nowait
-            for ev in events:
-                if kind is None or kind == ev.kind:
-                    put(ev)
+        for watcher in list(self._watchers):
+            kind = watcher.kind
+            put = watcher.queue.put_nowait
+            try:
+                for ev in events:
+                    if kind is None or kind == ev.kind:
+                        put(ev)
+            except asyncio.QueueFull:
+                self._evict_watcher(watcher)
         events.clear()
         return []
 
@@ -501,8 +565,8 @@ class ObjectStore:
             return new
 
         bucket = self._bucket("Pod")
-        pod_watchers = [q for kind, q in self._watchers
-                        if kind is None or kind == "Pod"]
+        pod_watchers = [w for w in self._watchers
+                        if w.kind is None or w.kind == "Pod"]
         bound: list[Any] = []
         errors: list[Exception | None] = []
         events: list[WatchEvent] = []
@@ -537,10 +601,13 @@ class ObjectStore:
                 self._append_wal(ev, flush=False)
             self._wal.flush()
         self._history.extend(events)
-        for queue in pod_watchers:
-            put = queue.put_nowait
-            for ev in events:
-                put(ev)
+        for watcher in pod_watchers:
+            put = watcher.queue.put_nowait
+            try:
+                for ev in events:
+                    put(ev)
+            except asyncio.QueueFull:
+                self._evict_watcher(watcher)
         return bound, errors
 
     def bind(self, binding: Binding) -> Any:
@@ -582,35 +649,63 @@ class ObjectStore:
         if self._wal is not None:
             self._append_wal(event)
         self._history.append(event)
-        for kind, queue in self._watchers:
-            if kind is None or kind == event.kind:
-                queue.put_nowait(event)
+        for watcher in list(self._watchers):
+            if watcher.kind is None or watcher.kind == event.kind:
+                try:
+                    watcher.queue.put_nowait(event)
+                except asyncio.QueueFull:
+                    self._evict_watcher(watcher)
+
+    def _evict_watcher(self, watcher: _Watcher) -> None:
+        """Terminate one subscriber: unsubscribe it, mark it evicted, and
+        (best effort) enqueue the end-of-stream sentinel so a consumer
+        blocked in queue.get() wakes immediately. Its stream drains any
+        buffered events, then ends — the consumer relists."""
+        try:
+            self._watchers.remove(watcher)
+        except ValueError:
+            return  # already evicted/stopped
+        watcher.evicted = True
+        try:
+            watcher.queue.put_nowait(_EVICTED)
+        except asyncio.QueueFull:
+            pass  # a full queue can't block in get(): the flag suffices
+        _watch_evictions().inc()
 
     def watch(self, kind: str | None = None,
               since: int | None = None) -> "WatchStream":
         """Subscribe to events after resourceVersion `since` (None = now).
 
         Raises Expired if `since` predates the ring buffer — the caller must
-        relist, like a Reflector on 410.
+        relist, like a Reflector on 410. A resume backlog that already
+        exceeds the per-watcher queue bound is also Expired: delivering it
+        would evict the subscriber immediately, so an honest 410 now saves
+        the round trip.
         """
-        queue: asyncio.Queue = asyncio.Queue()
         backlog: list[WatchEvent] = []
         if since is not None and since < self._rv:
             oldest = self._history[0].resource_version if self._history else self._rv + 1
             if since < oldest - 1:
                 raise Expired(f"resourceVersion {since} is too old "
                               f"(window starts at {oldest})")
-            backlog = [e for e in self._history if e.resource_version > since]
-        entry = (kind, queue)
-        self._watchers.append(entry)
+            backlog = [e for e in self._history
+                       if e.resource_version > since
+                       and (kind is None or kind == e.kind)]
+        limit = self._watcher_queue_limit
+        if limit and len(backlog) >= limit:
+            raise Expired(
+                f"resume backlog of {len(backlog)} events exceeds the "
+                f"{limit}-event watcher bound")
+        watcher = _Watcher(kind, limit)
+        self._watchers.append(watcher)
         for e in backlog:
-            if kind is None or kind == e.kind:
-                queue.put_nowait(e)
-        return WatchStream(self, entry, queue)
+            watcher.queue.put_nowait(e)
+        return WatchStream(self, watcher, watcher.queue)
 
 
 class WatchStream:
-    def __init__(self, store: ObjectStore, entry, queue: asyncio.Queue):
+    def __init__(self, store: ObjectStore, entry: _Watcher,
+                 queue: asyncio.Queue):
         self._store = store
         self._entry = entry
         self._queue = queue
@@ -619,12 +714,22 @@ class WatchStream:
     async def next(self, timeout: float | None = None) -> WatchEvent | None:
         if self._stopped:
             return None
+        if self._entry.evicted and self._queue.empty():
+            # evicted with the backlog fully drained (the sentinel may have
+            # been dropped if the queue was full at eviction time)
+            self._stopped = True
+            return None
         try:
             if timeout is None:
-                return await self._queue.get()
-            return await asyncio.wait_for(self._queue.get(), timeout)
+                ev = await self._queue.get()
+            else:
+                ev = await asyncio.wait_for(self._queue.get(), timeout)
         except asyncio.TimeoutError:
             return None
+        if ev is _EVICTED:
+            self._stopped = True  # stream over: the consumer must relist
+            return None
+        return ev
 
     def stop(self) -> None:
         if not self._stopped:

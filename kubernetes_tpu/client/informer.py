@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import random
 from typing import Any, Callable
 
 from kubernetes_tpu.apiserver.store import Expired, ObjectStore, WatchEvent
@@ -19,11 +20,17 @@ log = logging.getLogger(__name__)
 
 Handler = Callable[[WatchEvent], None]
 
+# relist backoff: base->cap doubling, reset after a successful list (the
+# Reflector's backoff manager shape — a dead store must not be hammered at
+# a fixed 50ms by every informer in the process at once)
+RELIST_BACKOFF_INITIAL = 0.05
+RELIST_BACKOFF_MAX = 5.0
+
 _reflector_mx: dict[str, tuple] = {}
 
 
 def _metrics(kind: str) -> tuple:
-    """(lists, list_duration, watches) children for one kind — the
+    """(lists, list_duration, watches, relists) children for one kind — the
     client-go reflector metrics families (cache/reflector_metrics.go),
     labeled by watched kind."""
     mx = _reflector_mx.get(kind)
@@ -40,19 +47,30 @@ def _metrics(kind: str) -> tuple:
             m.REGISTRY.counter("reflector_watches_total",
                                "Watch streams opened by informers.",
                                ("kind",)).labels(kind),
+            m.REGISTRY.counter("informer_relists_total",
+                               "Relists after a watch ended, expired, or "
+                               "the list/watch cycle failed.",
+                               ("kind",)).labels(kind),
         )
         _reflector_mx[kind] = mx
     return mx
 
 
 class Informer:
-    def __init__(self, store: ObjectStore, kind: str):
+    def __init__(self, store: ObjectStore, kind: str,
+                 relist_backoff_initial: float = RELIST_BACKOFF_INITIAL,
+                 relist_backoff_max: float = RELIST_BACKOFF_MAX,
+                 rng: random.Random | None = None):
         self.store = store
         self.kind = kind
         self.cache: dict[tuple[str, str], Any] = {}
         self._handlers: list[Handler] = []
         self._task: asyncio.Task | None = None
         self._synced = asyncio.Event()
+        self._backoff_initial = relist_backoff_initial
+        self._backoff_max = relist_backoff_max
+        self._relist_delay = relist_backoff_initial
+        self._rng = rng if rng is not None else random
 
     def add_handler(self, handler: Handler) -> None:
         self._handlers.append(handler)
@@ -79,15 +97,33 @@ class Informer:
             self._task.cancel()
             self._task = None
 
+    def _backoff_next(self) -> float:
+        """Current relist delay; doubles toward the cap until a successful
+        list resets it (client-go's ListAndWatch backoff manager)."""
+        delay = self._relist_delay
+        self._relist_delay = min(2 * delay, self._backoff_max)
+        return delay
+
     async def _run(self) -> None:
+        first = True
         while True:
+            if not first:
+                # jittered (0.5x-1.5x) so N informers relisting after one
+                # store hiccup don't stampede it in lockstep
+                _metrics(self.kind)[3].inc()
+                delay = self._backoff_next()
+                await asyncio.sleep(delay * (0.5 + self._rng.random()))
+            first = False
             try:
                 await self._list_and_watch()
+                # clean watch end (expired resume point or evicted as a
+                # slow consumer): the successful list inside already reset
+                # the backoff, so the next relist runs at the base delay
             except asyncio.CancelledError:
                 return
             except Exception:  # noqa: BLE001 — reflector loops survive anything
-                log.exception("informer %s: list/watch failed; relisting", self.kind)
-                await asyncio.sleep(0.05)
+                log.exception("informer %s: list/watch failed; relisting",
+                              self.kind)
 
     async def _list_and_watch(self) -> None:
         import time
@@ -107,6 +143,7 @@ class Informer:
             self._dispatch(WatchEvent("DELETED", self.kind, self.cache[key], rv))
         self.cache = dict(fresh)
         self._synced.set()
+        self._relist_delay = self._backoff_initial  # healthy again
         mx[0].inc()
         mx[1].observe(time.monotonic() - t_list)
 

@@ -22,12 +22,18 @@ from __future__ import annotations
 import asyncio
 import json
 import logging
+import random
 import time
 from dataclasses import dataclass
 from typing import Awaitable, Callable
 
 from kubernetes_tpu.api.objects import Endpoints, ObjectMeta
-from kubernetes_tpu.apiserver.store import AlreadyExists, Conflict, NotFound
+from kubernetes_tpu.apiserver.store import (
+    AlreadyExists,
+    Conflict,
+    NotFound,
+    TooManyRequests,
+)
 
 log = logging.getLogger(__name__)
 
@@ -80,7 +86,8 @@ class LeaderElector:
                  renew_deadline: float = RENEW_DEADLINE,
                  retry_period: float = RETRY_PERIOD,
                  on_started_leading: Callable[[], Awaitable] | None = None,
-                 on_stopped_leading: Callable[[], None] | None = None):
+                 on_stopped_leading: Callable[[], None] | None = None,
+                 rng: random.Random | None = None):
         self.store = store
         self.identity = identity
         self.lock_name = lock_name
@@ -92,6 +99,13 @@ class LeaderElector:
         self.on_stopped_leading = on_stopped_leading
         self.is_leader = False
         self._stop = False
+        self._rng = rng if rng is not None else random.Random()
+
+    def _jittered(self, period: float) -> float:
+        """retry_period with ±10% jitter (wait.JitterUntil's JitterFactor):
+        standbys polling for an expired lease — and leaders renewing —
+        must not thunder against the store in lockstep."""
+        return period * (0.9 + 0.2 * self._rng.random())
 
     # ---- lock record I/O ----
 
@@ -99,7 +113,9 @@ class LeaderElector:
         try:
             obj = self.store.get("Endpoints", self.lock_name,
                                  self.lock_namespace)
-        except NotFound:
+        except (NotFound, TooManyRequests):
+            # a throttled read is a failed attempt, not a crash: the
+            # acquire/renew loop retries on its jittered period
             return None
         raw = obj.metadata.annotations.get(LEADER_ANNOTATION)
         return LeaderElectionRecord.from_json(raw) if raw else None
@@ -140,6 +156,8 @@ class LeaderElector:
                 return True
             except AlreadyExists:
                 pass  # raced another candidate: fall through to CAS update
+            except TooManyRequests:
+                return False  # throttled: this attempt failed, retry later
 
         def mutate(obj):
             # re-check under the CAS: a racing writer may have renewed
@@ -156,7 +174,7 @@ class LeaderElector:
             self.store.guaranteed_update("Endpoints", self.lock_name,
                                          self.lock_namespace, mutate)
             return True
-        except (_Lost, Conflict, NotFound):
+        except (_Lost, Conflict, NotFound, TooManyRequests):
             return False
 
     # ---- run loop ----
@@ -168,7 +186,7 @@ class LeaderElector:
         while not self._stop:
             if self._try_acquire_or_renew(time.time()):
                 break
-            await asyncio.sleep(self.retry_period)
+            await asyncio.sleep(self._jittered(self.retry_period))
         if self._stop:
             return
         self.is_leader = True
@@ -179,9 +197,13 @@ class LeaderElector:
             work = asyncio.get_running_loop().create_task(
                 self.on_started_leading())
         try:
-            deadline = time.time() + self.renew_deadline
+            # the renew deadline anchors to the last SUCCESSFUL renew (the
+            # acquire counts as one): a leader whose renews fail transiently
+            # but land again within the deadline keeps the lease — only
+            # renew_deadline of CONSECUTIVE failure loses it
+            last_renew = time.time()
             while not self._stop:
-                await asyncio.sleep(self.retry_period)
+                await asyncio.sleep(self._jittered(self.retry_period))
                 if work is not None and work.done():
                     # the led work died: stop renewing so a standby can take
                     # over (the reference process would have exited)
@@ -190,8 +212,8 @@ class LeaderElector:
                                   self.identity, work.exception())
                     break
                 if self._try_acquire_or_renew(time.time()):
-                    deadline = time.time() + self.renew_deadline
-                elif time.time() > deadline:
+                    last_renew = time.time()
+                elif time.time() - last_renew > self.renew_deadline:
                     log.warning("%s: failed to renew lease within %.1fs",
                                 self.identity, self.renew_deadline)
                     break

@@ -45,13 +45,18 @@ def obs_response(method: str, path: str,
                  registry: _metrics.Registry | None = None,
                  health_checks: Mapping[str, Check] | None = None,
                  ready_checks: Mapping[str, Check] | None = None,
+                 degraded_checks: Mapping[str, Check] | None = None,
                  extra_text: Callable[[], str] | None = None,
                  ) -> tuple[int, bytes, str] | None:
     """-> (status, body, content-type) for the three obs endpoints, or
     None when `path` is not one of them (the caller routes on). Any
     method but GET on an obs path gets 405. `extra_text` appends
     component-local exposition after the registry render (the scheduler's
-    per-instance families)."""
+    per-instance families). `degraded_checks` report on /healthz WITHOUT
+    failing it: a degraded component (e.g. the scheduler running its
+    serial fallback while pods are quarantined) is alive and must not be
+    restarted by a liveness probe — the check names are annotated in the
+    200 body instead."""
     path = path.split("?", 1)[0].rstrip("/") or "/"
     if path not in OBS_PATHS:
         return None
@@ -64,6 +69,11 @@ def obs_response(method: str, path: str,
         return 200, body.encode(), METRICS_CONTENT_TYPE
     if path == "/healthz" or path == "/livez":
         status, body = _run_checks(health_checks)
+        if status == 200 and degraded_checks:
+            _status, report = _run_checks(degraded_checks)
+            if _status != 200:
+                names = report.decode().removeprefix("checks failed: ")
+                body = b"ok\ndegraded: " + names.encode()
     else:
         status, body = _run_checks(ready_checks)
     return status, body, TEXT_CONTENT_TYPE

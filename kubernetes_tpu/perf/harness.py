@@ -398,6 +398,114 @@ def run_recovery(n_nodes: int = 200, n_pods: int = 600,
     return asyncio.run(_run_recovery(n_nodes, n_pods, kill_frac))
 
 
+@dataclass
+class ChaosResult:
+    """Convergence-under-chaos drill: a workload scheduled through a
+    seeded FaultPlane (store 429s/Conflicts), with a forced watch expiry +
+    watcher drop + scheduler crash mid-workload. The cluster must
+    converge — every pod bound exactly once and Running — and the figure
+    is how fast it does after the disruption."""
+
+    nodes: int
+    pods: int
+    seed: int
+    bound: int
+    double_binds: int
+    faults_injected: int
+    recovery_ms: float
+    converged: bool
+
+    def __str__(self) -> str:
+        return (f"chaos N={self.nodes} P={self.pods} seed={self.seed}: "
+                f"{self.bound}/{self.pods} bound "
+                f"({self.double_binds} double-binds, "
+                f"{self.faults_injected} faults injected), recovered in "
+                f"{self.recovery_ms:.0f}ms")
+
+
+async def _run_chaos(n_nodes: int, n_pods: int, seed: int,
+                     error_rate: float) -> ChaosResult:
+    """Every control-plane verb (scheduler, hollow kubelets, informers)
+    goes through one seeded FaultPlane; observation reads go to the inner
+    store so the observer never draws injection. Mid-workload the plane
+    expires the watch history, evicts every watcher, and the scheduler
+    crashes (driver task cancelled, informers stopped, in-flight device
+    results dropped) and restarts cold."""
+    from kubernetes_tpu.agent.hollow import HollowCluster
+    from kubernetes_tpu.api.objects import Node
+    from kubernetes_tpu.testing.faults import FaultPlane
+
+    cap = {"cpu": "16", "memory": "32Gi", "pods": "110"}
+    inner = ObjectStore(watch_window=max(1 << 16, 8 * (n_pods + n_nodes)))
+    # nodes pre-registered through the inner store: setup is not the thing
+    # under test (the kubelets' get finds them, so registration never
+    # draws an injected create failure at start)
+    for i in range(n_nodes):
+        inner.create(Node.from_dict({
+            "metadata": {"name": f"hollow-{i}",
+                         "labels": {"kubernetes.io/hostname": f"hollow-{i}"}},
+            "status": {"allocatable": dict(cap), "capacity": dict(cap)}}))
+    plane = FaultPlane(inner, seed=seed, error_rate=error_rate)
+    cluster = HollowCluster(plane, n_nodes=n_nodes, heartbeat_every=0.5,
+                            capacity=cap, resync_every=0.2)
+    await cluster.start()
+    num = 1 << max(6, (n_nodes - 1).bit_length())
+    caps = Capacities(num_nodes=num,
+                      batch_pods=min(256, max(64, n_pods)))
+    loop = asyncio.get_running_loop()
+    sched = Scheduler(plane, caps=caps)
+    driver = loop.create_task(sched.run())
+
+    for pod in make_pods(n_pods, cpu="100m", memory="64Mi",
+                         name_prefix="chaos"):
+        inner.create(pod)
+
+    def crash_scheduler() -> None:
+        # hard kill: no stop() — in-flight device results are dropped on
+        # the floor, assumed-but-unconfirmed state is lost
+        driver.cancel()
+        for informer in (sched.node_informer, sched.pod_informer,
+                         sched.podgroup_informer, *sched.workload_informers):
+            informer.stop()
+
+    async with asyncio.timeout(180):
+        while len(plane.bind_counts) < max(1, n_pods // 3):
+            await asyncio.sleep(0.02)
+    crash_scheduler()
+    plane.expire_watch_history()
+    plane.drop_watchers()
+    t0 = time.perf_counter()
+    sched = Scheduler(plane, caps=caps)
+    driver = loop.create_task(sched.run())
+
+    def converged() -> bool:
+        pods = inner.list("Pod", copy_objects=False)
+        return (len(pods) >= n_pods
+                and all(p.spec.node_name and p.status.phase == "Running"
+                        for p in pods))
+
+    async with asyncio.timeout(300):
+        while not converged():
+            await asyncio.sleep(0.05)
+    recovery_ms = 1e3 * (time.perf_counter() - t0)
+    driver.cancel()
+    sched.stop()
+    cluster.stop()
+    double = sum(1 for v in plane.bind_counts.values() if v > 1)
+    return ChaosResult(
+        nodes=n_nodes, pods=n_pods, seed=seed,
+        bound=len(plane.bind_counts), double_binds=double,
+        faults_injected=plane.stats.injected_total,
+        recovery_ms=recovery_ms,
+        converged=double == 0 and len(plane.bind_counts) >= n_pods)
+
+
+def run_chaos(n_nodes: int = 128, n_pods: int = 200, seed: int = 1234,
+              error_rate: float = 0.05) -> ChaosResult:
+    """Blocking entry point for the convergence-under-chaos drill."""
+    return asyncio.run(_run_chaos(n_nodes, n_pods, seed, error_rate))
+
+
 def run_throughput(
     n_nodes: int,
     n_pods: int,

@@ -26,7 +26,13 @@ from collections import deque
 import numpy as np
 
 from kubernetes_tpu.api.objects import Binding, Pod
-from kubernetes_tpu.apiserver.store import Conflict, NotFound, ObjectStore, WatchEvent
+from kubernetes_tpu.apiserver.store import (
+    Conflict,
+    NotFound,
+    ObjectStore,
+    TooManyRequests,
+    WatchEvent,
+)
 from kubernetes_tpu.client.informer import Informer
 from kubernetes_tpu.client.workqueue import Backoff, BackoffQueue
 from kubernetes_tpu.gang import (
@@ -50,6 +56,15 @@ log = logging.getLogger(__name__)
 # queue-key namespace for gang groups: pod keys are "ns/name" (DNS-1123
 # names cannot contain ":"), so the prefix cannot collide
 _GANG_KEY_PREFIX = "gang:"
+
+# requeue delay for a quarantined poison pod: long enough that one bad pod
+# cannot re-poison every batch, short enough that a transient cause clears
+QUARANTINE_BACKOFF_S = 30.0
+
+
+class _SolveFailed(RuntimeError):
+    """The device solve failed twice for one batch (raised internally to
+    route schedule_pending into bisect/quarantine recovery)."""
 
 # ExponentialBuckets(1000, 2, 15) in microseconds (reference metrics.go:36)
 LATENCY_BUCKETS_US = obs_metrics.exponential_buckets(1000.0, 2.0, 15)
@@ -121,6 +136,20 @@ class SchedulerMetrics:
         self._c_preempt_success = r.counter(
             "scheduler_preemption_success_total",
             "Preemptions that evicted their victims and nominated a node.")
+        self._c_solve_failures = r.counter(
+            "scheduler_solve_failures_total",
+            "Device solve attempts that raised or timed out.")
+        self._c_solve_retries = r.counter(
+            "scheduler_solve_retries_total",
+            "Batches re-dispatched after a failed device solve.")
+        self._c_quarantined = r.counter(
+            "scheduler_pods_quarantined_total",
+            "Pods quarantined after bisection isolated them as the cause "
+            "of persistent solve failures.")
+        self._c_serial_fallback = r.counter(
+            "scheduler_serial_fallback_pods_total",
+            "Pods placed by the degraded serial host path while the "
+            "device solver was failing.")
         self._h_phase = r.histogram(
             "scheduler_phase_duration_seconds",
             "Per-batch scheduling phase durations "
@@ -140,6 +169,10 @@ class SchedulerMetrics:
         self.preempt_attempts = 0
         self.preempt_victims = 0
         self.preempt_success = 0
+        self.solve_failures = 0
+        self.solve_retries = 0
+        self.quarantined = 0
+        self.serial_fallback = 0
         # bounded windows (the registry histograms are cumulative; the
         # windows keep the recent-sample percentiles snapshot() reports)
         self.e2e_latency = _LatencyWindow(r.histogram(
@@ -232,6 +265,22 @@ class SchedulerMetrics:
         self.preempt_success += 1
         self._c_preempt_success.inc()
 
+    def solve_failure_inc(self) -> None:
+        self.solve_failures += 1
+        self._c_solve_failures.inc()
+
+    def solve_retry_inc(self) -> None:
+        self.solve_retries += 1
+        self._c_solve_retries.inc()
+
+    def quarantine_inc(self) -> None:
+        self.quarantined += 1
+        self._c_quarantined.inc()
+
+    def serial_fallback_inc(self) -> None:
+        self.serial_fallback += 1
+        self._c_serial_fallback.inc()
+
     def add_phase(self, name: str, seconds: float) -> None:
         self.phase_s[name] = self.phase_s.get(name, 0.0) + seconds
         self._h_phase.labels(name).observe(seconds)
@@ -272,6 +321,11 @@ class SchedulerMetrics:
             out["preemption"] = {"attempts": self.preempt_attempts,
                                  "victims": self.preempt_victims,
                                  "success": self.preempt_success}
+        if self.solve_failures or self.quarantined or self.serial_fallback:
+            out["faults"] = {"solve_failures": self.solve_failures,
+                             "solve_retries": self.solve_retries,
+                             "quarantined": self.quarantined,
+                             "serial_fallback": self.serial_fallback}
         return out
 
 
@@ -424,6 +478,18 @@ class Scheduler:
         self.pipeline_depth = int(
             os.environ.get("KTPU_PIPELINE_DEPTH", "3") or 3)
         self._inflight_q: deque = deque()
+        # solve-failure hardening (the batched analog of the reference's
+        # MakeDefaultErrorFunc: an algorithm error must never kill the
+        # scheduling loop). With a timeout set, each dispatch+readback runs
+        # in a worker thread under a watchdog deadline — trading pipelined
+        # dispatch for boundedness against a wedged device
+        self.solve_timeout_s = float(
+            os.environ.get("KTPU_SOLVE_TIMEOUT_S", "0") or 0) or None
+        # testing seam: called with the batch's pod keys right before every
+        # dispatch (FaultPlane.solve_hook injects failures through it)
+        self.solve_fault_hook = None
+        self.quarantine_backoff_s = QUARANTINE_BACKOFF_S
+        self._quarantined: set[str] = set()
 
     def _get_schedule_fn(self, flags):
         """Compiled solver variant for this batch's content gates — a
@@ -496,6 +562,7 @@ class Scheduler:
         key = pod.key
         if event.type == "DELETED":
             self._assumed.discard(key)
+            self._quarantined.discard(key)
             self._enqueue_time.pop(key, None)
             self._unindex_pod(key)
             self._gang_forget(key)
@@ -509,6 +576,7 @@ class Scheduler:
                 self._pods_by_node.setdefault(
                     pod.spec.node_name, set()).add(key)
             self._enqueue_time.pop(key, None)
+            self._quarantined.discard(key)  # bound after all: not poison
             self._gang_forget(key)
             self.encode_cache.forget(key)
             if key in self._assumed:
@@ -700,6 +768,13 @@ class Scheduler:
         return (self.node_informer._synced.is_set()
                 and self.pod_informer._synced.is_set())
 
+    @property
+    def solver_degraded(self) -> bool:
+        """True while any pod is quarantined for poisoning device solves —
+        the /healthz degraded signal (alive and scheduling, but some work
+        is parked; liveness must NOT fail, a restart wouldn't help)."""
+        return bool(self._quarantined)
+
     async def start(self) -> None:
         self.node_informer.start()
         self.pod_informer.start()
@@ -749,10 +824,21 @@ class Scheduler:
             informer.stop()
 
     async def run(self) -> None:
-        """Schedule until stopped (wait.Until(scheduleOne) analog)."""
+        """Schedule until stopped (wait.Until(scheduleOne) analog). A
+        scheduling pass that raises (store 429s, transport failure) is
+        logged and retried with backoff — the loop itself is crash-only
+        state, so surviving beats dying and losing the queue."""
         await self.start()
+        run_key = "__run_loop__"
         while not self._stopped:
-            await self.schedule_pending(wait=0.5)
+            try:
+                await self.schedule_pending(wait=0.5)
+                self.backoff.reset(run_key)
+            except asyncio.CancelledError:
+                raise
+            except Exception:  # noqa: BLE001 — the loop survives anything
+                log.exception("scheduling pass failed; backing off")
+                await asyncio.sleep(self.backoff.next_delay(run_key))
 
     # ---- one batch ----
 
@@ -784,7 +870,21 @@ class Scheduler:
                                           wait=effective_wait)
         if not keys:
             return await self._asettle_inflight()
+        try:
+            return await self._schedule_batch(keys)
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            # level-triggered hardening: a popped key must never be lost to
+            # an exception — the informer won't re-announce an unchanged
+            # pending pod, so re-add every key before propagating (done()
+            # first: add() on a processing key only marks it dirty)
+            for key in keys:
+                self.queue.done(key)
+                self.queue.add(key)
+            raise
 
+    async def _schedule_batch(self, keys: list[str]) -> int:
         t_phase = time.perf_counter()
         fblob, iblob = self._next_blobs()
         pods: list[Pod] = []
@@ -870,7 +970,13 @@ class Scheduler:
         timer.step("encode + flush")
 
         t0 = time.monotonic()
-        result = schedule_fn(state, fblob, iblob, self._rr, victims)
+        try:
+            result = await self._dispatch_guarded(schedule_fn, state, fblob,
+                                                  iblob, victims, live_keys)
+        except _SolveFailed as e:
+            self.metrics.add_phase("dispatch", time.monotonic() - t0)
+            return settled + await self._recover_solve_failure(
+                pods, live_keys, gang_groups, e)
         self._rr = result.rr_end
         try:
             # start the device->host copy now; by settle time (after the
@@ -996,7 +1102,7 @@ class Scheduler:
                 self.store.bind(Binding(pod_name=pod.metadata.name,
                                         namespace=pod.metadata.namespace,
                                         target_node=choice))
-            except (Conflict, NotFound) as e:
+            except (Conflict, NotFound, TooManyRequests) as e:
                 self.metrics.binding_errors += 1
                 self._fail(key, pod, f"binding rejected: {e}")
                 continue
@@ -1011,6 +1117,221 @@ class Scheduler:
                     time.monotonic() - enqueued)
             self.events.record(pod, "Normal", "Scheduled",
                                f"Successfully assigned {key} to {choice}")
+        self.metrics.scheduled += scheduled
+        self.metrics.batches += 1
+        return scheduled
+
+    # ---- solve-failure hardening ----
+    #
+    # The degradation ladder for a failing device solve:
+    #   1. retry the dispatch once (transient transport/compiler faults);
+    #   2. on a second failure, settle the pipeline, then BISECT the batch
+    #      with probe solves to isolate pods whose presence fails the
+    #      solve — those are quarantined (event + long unschedulable
+    #      requeue);
+    #   3. the healthy remainder degrades to the serial HOST placement
+    #      path (capacity-only greedy fit over the StateDB ledger) so the
+    #      cluster keeps making progress while the device path is down;
+    #   4. if bisection finds no poison (the fault cleared), everything
+    #      requeues for a normal batch.
+    # All of it is host-side: the compiled solver program is untouched
+    # (the HLO pin test in tests/test_faults.py proves bit-identity).
+
+    async def _call_solve(self, schedule_fn, state, fblob, iblob, victims,
+                          live_keys: list[str]):
+        """One dispatch. With solve_timeout_s set, dispatch AND readback
+        complete inside the deadline in a worker thread (a wedged device
+        otherwise hangs the readback forever); the event loop keeps
+        serving informers during the solve either way."""
+        if self.solve_timeout_s:
+            hook = self.solve_fault_hook
+
+            def call():
+                if hook is not None:
+                    hook(list(live_keys))
+                result = schedule_fn(state, fblob, iblob, self._rr, victims)
+                np.asarray(result.assignments)  # force completion in-deadline
+                return result
+
+            # NOTE: on timeout the worker thread is abandoned, not killed —
+            # a truly wedged dispatch leaks one thread (the watchdog's cost)
+            return await asyncio.wait_for(asyncio.to_thread(call),
+                                          self.solve_timeout_s)
+        if self.solve_fault_hook is not None:
+            self.solve_fault_hook(list(live_keys))
+        return schedule_fn(state, fblob, iblob, self._rr, victims)
+
+    async def _dispatch_guarded(self, schedule_fn, state, fblob, iblob,
+                                victims, live_keys: list[str]):
+        """Dispatch with one retry; raises _SolveFailed after the second
+        failure (scheduleOne survives algorithm errors through
+        MakeDefaultErrorFunc — the batched analog must survive a failing
+        or wedged device solve)."""
+        last: Exception | None = None
+        for attempt in (1, 2):
+            try:
+                return await self._call_solve(schedule_fn, state, fblob,
+                                              iblob, victims, live_keys)
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:  # noqa: BLE001 — incl. TimeoutError
+                last = e
+                self.metrics.solve_failure_inc()
+                if attempt == 1:
+                    self.metrics.solve_retry_inc()
+                    log.warning("device solve failed (attempt 1/2): %s; "
+                                "retrying", e)
+        raise _SolveFailed(str(last)) from last
+
+    async def _recover_solve_failure(self, pods: list[Pod],
+                                     live_keys: list[str],
+                                     gang_groups: dict,
+                                     error: Exception) -> int:
+        """Persistent solve failure for one batch: drain the pipeline,
+        requeue gang groups whole (all-or-nothing survives degradation),
+        bisect the rest for poison pods, and place the healthy remainder
+        via the serial host path."""
+        log.error("device solve failed after retry for a %d-pod batch "
+                  "(%s); bisecting", len(pods), error)
+        settled = await self._asettle_inflight()
+        # the failed dispatch may have half-consumed device state: force
+        # the next flush to re-upload host truth
+        self.statedb.mark_ledger_dirty()
+        gang_rows: set[int] = set()
+        for gkey, _quorum, positions in gang_groups.values():
+            gang_rows.update(positions)
+            # a gang is never split or serial-bound: the whole group
+            # requeues with backoff and re-enters a future batch
+            qkey = _GANG_KEY_PREFIX + gkey
+            self.queue.add_after(qkey, self.backoff.next_delay(qkey))
+        items = [(k, p) for i, (k, p) in enumerate(zip(live_keys, pods))
+                 if i not in gang_rows]
+        poison = await self._bisect_poison(items)
+        if not poison:
+            # probes pass now: the failure was transient after all —
+            # requeue everything for a normal batched retry
+            for key, _pod in items:
+                self.queue.done(key)
+                self.queue.add_after(key, self.backoff.next_delay(key))
+            return settled
+        poison_keys = {k for k, _ in poison}
+        for key, pod in poison:
+            self._quarantine(key, pod)
+        survivors = [(k, p) for k, p in items if k not in poison_keys]
+        return settled + self._schedule_serial_host(survivors)
+
+    async def _bisect_poison(
+            self, items: list[tuple[str, Pod]]) -> list[tuple[str, Pod]]:
+        """Pods whose presence makes the solve fail, found by recursive
+        probe solves — O(k log n) probes for k poison pods."""
+        if not items:
+            return []
+        if await self._probe_solve(items):
+            return []
+        if len(items) == 1:
+            return list(items)
+        mid = len(items) // 2
+        return (await self._bisect_poison(items[:mid])
+                + await self._bisect_poison(items[mid:]))
+
+    async def _probe_solve(self, items: list[tuple[str, Pod]]) -> bool:
+        """True when a device solve over exactly these pods completes.
+        Reuses the compiled variant cache; the probe's output ledger is
+        never adopted (the ledger was already marked dirty), so results
+        are discarded without side effects."""
+        from kubernetes_tpu.state.pod_batch import packed_batch_flags
+
+        try:
+            keys = [k for k, _ in items]
+            fblob, iblob = self._next_blobs()
+            for i, (_key, pod) in enumerate(items):
+                self.encode_cache.encode_packed_into(fblob, iblob, i, pod)
+            if len(items) < self.caps.batch_pods:
+                fblob[len(items):] = 0.0
+                iblob[len(items):] = 0
+            flags = packed_batch_flags(fblob, iblob, len(items),
+                                       self.statedb.table, self.caps)
+            schedule_fn = self._get_schedule_fn(flags)
+            state = self.statedb.flush()
+            result = await self._call_solve(schedule_fn, state, fblob,
+                                            iblob, None, keys)
+            await asyncio.to_thread(np.asarray, result.assignments)
+            self.statedb.mark_ledger_dirty()  # never adopt probe output
+            return True
+        except asyncio.CancelledError:
+            raise
+        except Exception:  # noqa: BLE001 — a failed probe is an answer
+            self.metrics.solve_failure_inc()
+            return False
+
+    def _quarantine(self, key: str, pod: Pod) -> None:
+        """Poison pod: surface the verdict as an event and park it with a
+        long unschedulable requeue so one bad pod cannot re-poison every
+        batch; a later delete/bind clears the quarantine."""
+        self.metrics.quarantine_inc()
+        self._quarantined.add(key)
+        self.metrics.failed += 1
+        self.queue.done(key)
+        self.queue.add_after(key, self.quarantine_backoff_s)
+        log.error("pod %s quarantined: device solve fails whenever it is "
+                  "in the batch", key)
+        self.events.record(
+            pod, "Warning", "FailedScheduling",
+            f"pod quarantined: device solve fails whenever this pod is in "
+            f"the batch; retrying in {self.quarantine_backoff_s:.0f}s")
+
+    def _schedule_serial_host(self, items: list[tuple[str, Pod]]) -> int:
+        """Degraded placement: greedy first-fit over the StateDB host
+        ledger (capacity predicate only — no device program involved).
+        Keeps the healthy remainder of a poisoned batch moving while the
+        device path is down; pods that don't fit requeue with normal
+        backoff and re-enter the full solver once it recovers."""
+        if not items:
+            return 0
+        from kubernetes_tpu.state.cluster_state import pod_requests
+
+        host = self.statedb.host
+        name_of = self.statedb.table.name_of
+        scheduled = 0
+        for key, pod in items:
+            req = pod_requests(pod)
+            free = host.allocatable - host.requested
+            fits = np.flatnonzero(host.valid & np.all(free >= req, axis=1))
+            choice = None
+            n = len(fits)
+            start = int(self._rr) % n if n else 0
+            for off in range(n):
+                row = int(fits[(start + off) % n])
+                node_name = name_of[row]
+                if node_name is not None:
+                    choice = node_name
+                    break
+            self._rr = np.uint32(int(self._rr) + 1)
+            if choice is None:
+                self._fail(key, pod, "no nodes available to schedule pods "
+                                     "(degraded host path)")
+                continue
+            try:
+                self.store.bind(Binding(pod_name=pod.metadata.name,
+                                        namespace=pod.metadata.namespace,
+                                        target_node=choice))
+            except (Conflict, NotFound, TooManyRequests) as e:
+                self.metrics.binding_errors += 1
+                self._fail(key, pod, f"binding rejected: {e}")
+                continue
+            self._assumed.add(key)
+            self.statedb.add_pod(pod, choice)
+            self.metrics.serial_fallback_inc()
+            scheduled += 1
+            self.queue.done(key)
+            self.backoff.reset(key)
+            enqueued = self._enqueue_time.pop(key, None)
+            if enqueued is not None:
+                self.metrics.e2e_latency.append(time.monotonic() - enqueued)
+            self.events.record(
+                pod, "Normal", "Scheduled",
+                f"Successfully assigned {key} to {choice} "
+                f"(degraded host path)")
         self.metrics.scheduled += scheduled
         self.metrics.batches += 1
         return scheduled
@@ -1194,11 +1515,17 @@ class Scheduler:
         # without the bulk verb (RemoteStore) fall back per pod
         bind_many = getattr(self.store, "bind_many", None)
         if to_bind and bind_many is not None:
-            errs = bind_many(
-                [Binding(pod_name=pod.metadata.name,
-                         namespace=pod.metadata.namespace,
-                         target_node=node_name)
-                 for _i, _k, pod, node_name in to_bind])[1]
+            try:
+                errs = bind_many(
+                    [Binding(pod_name=pod.metadata.name,
+                             namespace=pod.metadata.namespace,
+                             target_node=node_name)
+                     for _i, _k, pod, node_name in to_bind])[1]
+            except Exception as e:  # noqa: BLE001 — e.g. a store 429
+                # the whole transaction failed before any per-pod verdicts:
+                # every pod takes the bind-rejected path (requeue + event)
+                log.warning("bulk bind failed: %s", e)
+                errs = [e] * len(to_bind)
         elif to_bind:
             errs = []
             for _i, _key, pod, node_name in to_bind:
@@ -1207,7 +1534,7 @@ class Scheduler:
                                             namespace=pod.metadata.namespace,
                                             target_node=node_name))
                     errs.append(None)
-                except (Conflict, NotFound) as e:
+                except (Conflict, NotFound, TooManyRequests) as e:
                     errs.append(e)
         else:
             errs = []

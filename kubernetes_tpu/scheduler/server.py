@@ -86,7 +86,10 @@ class SchedulerServer:
                 resp = obs_response(
                     method, path,
                     ready_checks={
-                        "informers-synced": lambda: self.sched.synced})
+                        "informers-synced": lambda: self.sched.synced},
+                    degraded_checks={
+                        "device-solver":
+                            lambda: not self.sched.solver_degraded})
                 if resp is None:
                     status, body, ctype = 404, b"not found", "text/plain"
                 else:

@@ -1,2 +1,3 @@
 from kubernetes_tpu.testing.framework import ClusterFixture  # noqa: F401
 from kubernetes_tpu.testing.chaos import ChaosMonkey  # noqa: F401
+from kubernetes_tpu.testing.faults import FaultPlane, SolveFault  # noqa: F401

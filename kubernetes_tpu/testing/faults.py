@@ -1,0 +1,243 @@
+"""Seeded fault-injection plane over the ObjectStore.
+
+The chaos-engineering lever the convergence suites drive (Basiri et al.,
+IEEE Software '16; the reference's APF/429 and etcd-flake behavior seen by
+every client): a FaultPlane wraps a live ObjectStore and injects
+
+- TooManyRequests / Conflict on the write+list verbs (seeded probability),
+- synthetic request latency,
+- forced watch expiry (the history window "shrinks" to nothing, so any
+  resume point raises Expired and the Reflector contract kicks in),
+- watcher drops (every subscriber is evicted mid-stream),
+- device-solve failures via a hook the scheduler driver calls before each
+  dispatch (poison pods / fail-the-next-k / hang injection).
+
+Determinism is the point: everything random comes from one
+``random.Random(seed)`` stream in op order, so a failing schedule replays
+exactly from its seed. Component kills/restarts stay with the existing
+ChaosMonkey/ClusterFixture machinery — a FaultPlane composes as the store
+those components talk through, while the monkey's disruption callable
+fires `expire_watch_history()` / `drop_watchers()` / restarts:
+
+    plane = FaultPlane(store, seed=7, error_rate=0.05)
+    sched = Scheduler(plane)            # every verb goes through the plane
+    monkey = ChaosMonkey(disruption)    # disruption() pokes the plane
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Iterable
+
+from kubernetes_tpu.apiserver.store import (
+    Conflict,
+    ObjectStore,
+    TooManyRequests,
+)
+
+# the verbs that default to fault injection: the write+list plane the
+# control-plane components retry around (watch death is a separate lever)
+DEFAULT_ERROR_OPS = ("create", "update", "list")
+
+
+class SolveFault(RuntimeError):
+    """Injected device-solve failure (raised from the driver's
+    solve_fault_hook before dispatch — host-side only, so the compiled
+    program is untouched; the HLO pin test proves it)."""
+
+
+@dataclass
+class _Action:
+    """One scheduled disruption: fires once when the plane's op counter
+    reaches `after_ops` (deterministic in op order, not wall time)."""
+
+    after_ops: int
+    fn: Callable[["FaultPlane"], None]
+    name: str = ""
+    fired: bool = False
+
+
+@dataclass
+class FaultStats:
+    """What actually fired — asserted by tests, exported by the bench."""
+
+    ops: int = 0
+    injected: dict = field(default_factory=dict)   # op -> error count
+    delayed: int = 0
+    solve_faults: int = 0
+    actions_fired: list = field(default_factory=list)
+
+    @property
+    def injected_total(self) -> int:
+        return sum(self.injected.values())
+
+
+class FaultPlane:
+    """Seeded fault-injecting proxy around an ObjectStore.
+
+    Every store verb ticks one op: the tick fires due scheduled actions,
+    draws latency, then draws an error for ops in `error_ops` (updates
+    alternate Conflict/TooManyRequests — the two retryable write failures;
+    everything else raises TooManyRequests, the APF/429 shape). Unknown
+    attributes delegate to the wrapped store, so the plane is drop-in
+    anywhere an ObjectStore is (scheduler, kubelets, controllers,
+    informers)."""
+
+    def __init__(self, store: ObjectStore, seed: int = 0, *,
+                 error_rate: float = 0.0,
+                 error_ops: Iterable[str] = DEFAULT_ERROR_OPS,
+                 latency_s: float = 0.0, latency_rate: float = 0.0,
+                 solve_failures: int = 0,
+                 solve_poison: Iterable[str] = ()):
+        self.inner = store
+        self.seed = seed
+        self.error_rate = error_rate
+        self.error_ops = frozenset(error_ops)
+        self.latency_s = latency_s
+        self.latency_rate = latency_rate
+        # solve hook config: fail the next k solves outright, and/or fail
+        # any solve whose batch contains a poison pod key ("ns/name")
+        self.solve_failures = solve_failures
+        self.solve_poison = set(solve_poison)
+        self.solve_hang_s = 0.0
+        self.solve_hangs = 0
+        self.stats = FaultStats()
+        self.bind_counts: dict[str, int] = {}
+        self._rng = random.Random(seed)
+        self._schedule: list[_Action] = []
+
+    # ---- schedule-driven disruptions ----
+
+    def schedule(self, after_ops: int, fn: Callable[["FaultPlane"], None],
+                 name: str = "") -> None:
+        """Fire `fn(plane)` once, when the op counter reaches `after_ops`
+        (op-indexed, so the disruption lands at the same point of the
+        workload every replay)."""
+        self._schedule.append(_Action(after_ops, fn, name or fn.__name__))
+
+    def expire_watch_history(self) -> None:
+        """Shrink the history window to nothing: any watch resume from a
+        pre-expiry version now raises Expired (HTTP 410), forcing every
+        consumer through the relist path."""
+        self.inner._history.clear()
+
+    def drop_watchers(self) -> None:
+        """Evict every live watch subscriber mid-stream (their streams end;
+        informers must notice and relist)."""
+        for watcher in list(self.inner._watchers):
+            self.inner._evict_watcher(watcher)
+
+    # ---- the injection tick ----
+
+    def _tick(self, op: str) -> None:
+        self.stats.ops += 1
+        for action in self._schedule:
+            if not action.fired and self.stats.ops >= action.after_ops:
+                action.fired = True
+                self.stats.actions_fired.append(action.name)
+                action.fn(self)
+        if self.latency_rate and self._rng.random() < self.latency_rate:
+            self.stats.delayed += 1
+            time.sleep(self.latency_s)
+        if op in self.error_ops and self.error_rate \
+                and self._rng.random() < self.error_rate:
+            self.stats.injected[op] = self.stats.injected.get(op, 0) + 1
+            if op == "update" and self._rng.random() < 0.5:
+                raise Conflict(
+                    f"injected fault: {op} op #{self.stats.ops} "
+                    f"(seed {self.seed})")
+            raise TooManyRequests(
+                f"injected fault: {op} op #{self.stats.ops} "
+                f"(seed {self.seed})")
+
+    # ---- proxied store verbs ----
+
+    def create(self, obj: Any, **kw) -> Any:
+        self._tick("create")
+        return self.inner.create(obj, **kw)
+
+    def create_many(self, objs: list) -> list:
+        self._tick("create")
+        return self.inner.create_many(objs)
+
+    def get(self, kind: str, name: str, namespace: str = "default") -> Any:
+        self._tick("get")
+        return self.inner.get(kind, name, namespace)
+
+    def update(self, obj: Any, **kw) -> Any:
+        self._tick("update")
+        return self.inner.update(obj, **kw)
+
+    def delete(self, kind: str, name: str,
+               namespace: str = "default") -> Any:
+        self._tick("delete")
+        return self.inner.delete(kind, name, namespace)
+
+    def list(self, *a, **kw) -> list:
+        self._tick("list")
+        return self.inner.list(*a, **kw)
+
+    def list_with_version(self, kind: str):
+        self._tick("list")
+        return self.inner.list_with_version(kind)
+
+    def watch(self, kind: str | None = None, since: int | None = None):
+        self._tick("watch")
+        return self.inner.watch(kind, since=since)
+
+    def bind(self, binding) -> Any:
+        self._tick("bind")
+        out = self.inner.bind(binding)
+        key = f"{binding.namespace or 'default'}/{binding.pod_name}"
+        self.bind_counts[key] = self.bind_counts.get(key, 0) + 1
+        return out
+
+    def bind_many(self, bindings: list):
+        self._tick("bind")
+        bound, errors = self.inner.bind_many(bindings)
+        for binding, err in zip(bindings, errors):
+            if err is None:
+                key = f"{binding.namespace or 'default'}/{binding.pod_name}"
+                self.bind_counts[key] = self.bind_counts.get(key, 0) + 1
+        return bound, errors
+
+    # CAS flows run the *store's* algorithm over the *plane's* get/update,
+    # so every inner read/write of a guaranteed_update draws injection
+    def guaranteed_update(self, kind: str, name: str, namespace: str,
+                          mutate, retries: int = 16) -> Any:
+        return ObjectStore.guaranteed_update(self, kind, name, namespace,
+                                             mutate, retries=retries)
+
+    def patch(self, kind: str, name: str, namespace: str, patch,
+              content_type: str, retries: int = 5) -> Any:
+        return ObjectStore.patch(self, kind, name, namespace, patch,
+                                 content_type, retries=retries)
+
+    def __getattr__(self, name: str) -> Any:
+        return getattr(self.inner, name)
+
+    # ---- device-solve faults (driver hook) ----
+
+    def solve_hook(self, live_keys: list[str]) -> None:
+        """Install as ``scheduler.solve_fault_hook``: the driver calls it
+        with the batch's pod keys right before dispatch."""
+        if self.solve_failures > 0:
+            self.solve_failures -= 1
+            self.stats.solve_faults += 1
+            raise SolveFault(
+                f"injected solve failure (seed {self.seed}, "
+                f"{self.solve_failures} left)")
+        poisoned = self.solve_poison.intersection(live_keys)
+        if poisoned:
+            self.stats.solve_faults += 1
+            raise SolveFault(
+                f"injected poison-pod solve failure: {sorted(poisoned)} "
+                f"(seed {self.seed})")
+        if self.solve_hangs > 0 and self.solve_hang_s > 0:
+            # wedged-device injection: runs inside the driver's watchdog
+            # thread, so a configured solve timeout fires around it
+            self.solve_hangs -= 1
+            self.stats.solve_faults += 1
+            time.sleep(self.solve_hang_s)

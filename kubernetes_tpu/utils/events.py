@@ -12,7 +12,13 @@ import time
 from collections import OrderedDict
 
 from kubernetes_tpu.api.objects import Event, ObjectMeta
-from kubernetes_tpu.apiserver.store import AlreadyExists, NotFound, ObjectStore
+from kubernetes_tpu.apiserver.store import (
+    AlreadyExists,
+    Conflict,
+    NotFound,
+    ObjectStore,
+    TooManyRequests,
+)
 
 _KNOWN_MAX = 65536
 
@@ -96,7 +102,17 @@ class EventRecorder:
         while len(self._known) > _KNOWN_MAX:
             self._known.popitem(last=False)
 
-    def record(self, obj, event_type: str, reason: str, message: str) -> Event:
+    def record(self, obj, event_type: str, reason: str,
+               message: str) -> Event | None:
+        """Best-effort: a throttled or conflicted store drops the event
+        (the broadcaster's lossy contract — events are observability, and
+        losing one must never fail the component's control flow)."""
+        try:
+            return self._record(obj, event_type, reason, message)
+        except (TooManyRequests, Conflict):
+            return None
+
+    def _record(self, obj, event_type: str, reason: str, message: str) -> Event:
         name = f"{obj.metadata.name}.{reason.lower()}"
         namespace = obj.metadata.namespace
         key = (namespace, name)

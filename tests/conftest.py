@@ -23,3 +23,12 @@ os.environ.setdefault("JAX_ENABLE_X64", "0")
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+
+def pytest_configure(config):
+    # no pytest.ini/setup.cfg in this repo: register the marker here so
+    # `-m 'not slow'` (the tier-1 selection) runs warning-free
+    config.addinivalue_line(
+        "markers",
+        "slow: multi-second drills (chaos convergence); excluded from the "
+        "tier-1 `-m 'not slow'` run")
