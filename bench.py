@@ -30,6 +30,12 @@ chaos_recovery_ms).
 --metrics-snapshot (or BENCH_METRICS_SNAPSHOT=1) embeds the scheduler's
 per-phase registry histograms (encode/flush/dispatch/solve/bind/commit:
 count, sum_ms, p50_ms, p99_ms) in extras for each throughput config.
+
+--smoke (or BENCH_SMOKE=1) shrinks every config to seconds-scale CI
+shapes (hundreds of nodes, no device gate) so the whole bench path —
+including the autoscaler config — runs inside a tier-1 test and drift
+breaks the suite instead of the next real bench run. Explicit env
+overrides still win.
 """
 
 import faulthandler
@@ -54,6 +60,22 @@ def _die_with_timeout(signum, frame):
 
 
 def main() -> None:
+    smoke = "--smoke" in sys.argv[1:] or \
+        os.environ.get("BENCH_SMOKE", "") in ("1", "true")
+    if smoke:
+        # CI shapes: every default shrinks to seconds-scale; explicit env
+        # overrides still take precedence below
+        os.environ.setdefault("BENCH_NODES", "200")
+        os.environ.setdefault("BENCH_PODS", "400")
+        os.environ.setdefault("BENCH_GANG_NODES", "256")
+        os.environ.setdefault("BENCH_GANG_PODS", "64")
+        os.environ.setdefault("BENCH_PREEMPT_NODES", "32")
+        os.environ.setdefault("BENCH_CHAOS_NODES", "32")
+        os.environ.setdefault("BENCH_AUTOSCALER_PODS", "64")
+        os.environ.setdefault("BENCH_DEVICE_GATE", "0")  # CPU CI: no gate
+        os.environ.setdefault(
+            "BENCH_CONFIGS", "headline,gang,preemption,autoscaler")
+        os.environ.setdefault("BENCH_TIMEOUT_S", "600")
     timeout = int(os.environ.get("BENCH_TIMEOUT_S", "1800"))
     signal.signal(signal.SIGALRM, _die_with_timeout)
     signal.alarm(timeout)
@@ -62,7 +84,8 @@ def main() -> None:
     n_pods = int(os.environ.get("BENCH_PODS", "30000"))
     configs = os.environ.get(
         "BENCH_CONFIGS",
-        "headline,interpod,spread,gang,preemption,recovery,chaos,device")
+        "headline,interpod,spread,gang,preemption,recovery,chaos,device,"
+        "autoscaler")
     configs = [c.strip() for c in configs.split(",") if c.strip()]
     metrics_snapshot = "--metrics-snapshot" in sys.argv[1:] or \
         os.environ.get("BENCH_METRICS_SNAPSHOT", "") in ("1", "true")
@@ -214,6 +237,25 @@ def main() -> None:
                 f"chaos drill did not converge (seed {r.seed}): "
                 f"{r.bound}/{r.pods} bound, "
                 f"{r.double_binds} double-binds")
+
+    if "autoscaler" in configs:
+        from kubernetes_tpu.perf.harness import run_autoscaler
+
+        # cluster-autoscaler drill: a pod burst lands on an empty node
+        # group; the autoscaler's what-if probe solves must grow the group
+        # until everything binds. Reports wall time to all-bound plus the
+        # probe-solve cost (the device-batched simulation figure, PERF.md)
+        as_pods = int(os.environ.get("BENCH_AUTOSCALER_PODS", "256"))
+        as_max = int(os.environ.get("BENCH_AUTOSCALER_GROUP_MAX", "16"))
+        r = run_autoscaler(n_pods=as_pods, group_max=as_max)
+        print(f"bench[autoscaler]: {r}", file=sys.stderr, flush=True)
+        extras["scaleup_convergence_ms"] = round(r.scaleup_convergence_ms, 1)
+        extras["autoscaler_nodes_added"] = r.nodes_added
+        extras["autoscaler_sim_solves"] = r.sim_solves
+        extras["autoscaler_sim_ms_per_solve"] = round(r.sim_ms_per_solve, 2)
+        if r.nodes_added == 0:
+            RESULT["error"] = ("autoscaler bench: burst bound without any "
+                               "scale-up (cluster was not empty)")
 
     if "device" in configs:
         # transport-independent: steady-state compiled-solver throughput

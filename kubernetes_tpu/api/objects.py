@@ -1107,6 +1107,42 @@ class PodGroup(_SpecStatusObject):
 
 
 @dataclass
+class NodeGroup(_SpecStatusObject):
+    """Autoscaler node group: the API mirror of one cloud-provider pool
+    (the cluster-autoscaler NodeGroup contract surfaced as an object, so
+    `kubectl get nodegroups` shows pool bounds and the autoscaler's view).
+
+    spec: minSize/maxSize (ints, maxSize >= minSize >= 0),
+    cloudProviderGroup (the provider-side pool name; defaults to
+    metadata.name). status: targetSize (cloud desired count), readyNodes
+    (registered Ready members), lastScaleUp/lastScaleDown (unix seconds,
+    0 = never) — written by the autoscaler's reconcile, never by users."""
+
+    kind = "NodeGroup"
+    api_version = "autoscaling.ktpu.io/v1alpha1"
+
+    @property
+    def min_size(self) -> int:
+        return int(self.spec.get("minSize", 0) or 0)
+
+    @property
+    def max_size(self) -> int:
+        return int(self.spec.get("maxSize", 0) or 0)
+
+    @property
+    def cloud_provider_group(self) -> str:
+        return self.spec.get("cloudProviderGroup") or self.metadata.name
+
+    @property
+    def target_size(self) -> int:
+        return int(self.status.get("targetSize", 0) or 0)
+
+    @property
+    def ready_nodes(self) -> int:
+        return int(self.status.get("readyNodes", 0) or 0)
+
+
+@dataclass
 class PriorityClass:
     """scheduling.k8s.io PriorityClass (the v1.8-alpha shape,
     pkg/apis/scheduling/types.go): maps a name to an integer priority

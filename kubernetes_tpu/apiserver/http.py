@@ -89,6 +89,8 @@ RESOURCES: dict[str, str] = {
     "apiservices": "APIService",
     # scheduling.ktpu.io (gang scheduling)
     "podgroups": "PodGroup",
+    # autoscaling.ktpu.io (cluster autoscaler node pools)
+    "nodegroups": "NodeGroup",
     # scheduling.k8s.io (pod priority & preemption)
     "priorityclasses": "PriorityClass",
     "roles": "Role",
@@ -112,7 +114,7 @@ KIND_TO_CLS = {cls.kind: cls for cls in (
     objs.Namespace, objs.CustomResourceDefinition, objs.Cluster,
     objs.Secret, objs.ConfigMap, objs.ServiceAccount, objs.DaemonSet,
     objs.CronJob, objs.HorizontalPodAutoscaler, objs.PodDisruptionBudget,
-    objs.APIService, objs.PodGroup, objs.PriorityClass,
+    objs.APIService, objs.PodGroup, objs.NodeGroup, objs.PriorityClass,
     objs.Role, objs.ClusterRole,
     objs.RoleBinding, objs.ClusterRoleBinding,
     objs.CertificateSigningRequest)}

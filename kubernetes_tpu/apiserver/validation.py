@@ -44,6 +44,8 @@ def validate(obj: Any) -> None:
         _validate_workload(obj)
     elif kind == "PodGroup":
         _validate_podgroup(obj)
+    elif kind == "NodeGroup":
+        _validate_nodegroup(obj)
     elif kind == "PriorityClass":
         _validate_priorityclass(obj)
 
@@ -123,6 +125,20 @@ def _validate_podgroup(obj) -> None:
     if phase and phase not in type(obj).PHASES:
         raise ValidationError(
             f"status.phase: unsupported value {phase!r}")
+
+
+def _validate_nodegroup(obj) -> None:
+    try:
+        min_size, max_size = obj.min_size, obj.max_size
+    except (TypeError, ValueError):
+        raise ValidationError(
+            f"spec.minSize/maxSize: invalid values "
+            f"{obj.spec.get('minSize')!r}/{obj.spec.get('maxSize')!r}")
+    if min_size < 0:
+        raise ValidationError("spec.minSize: must be >= 0")
+    if max_size < min_size:
+        raise ValidationError(
+            f"spec.maxSize: must be >= minSize ({max_size} < {min_size})")
 
 
 def _validate_priorityclass(obj) -> None:

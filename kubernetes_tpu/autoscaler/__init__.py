@@ -1,0 +1,21 @@
+"""Cluster autoscaler: node-group SPI consumers + device-batched what-if
+scale simulation. See core.ClusterAutoscaler (the loop) and
+simulator.ScaleSimulator (the probe-solve engine)."""
+
+from kubernetes_tpu.autoscaler.core import (
+    DELETION_TAINT,
+    ClusterAutoscaler,
+)
+from kubernetes_tpu.autoscaler.simulator import (
+    SIM_NODE_PREFIX,
+    ScaleSimulator,
+    ScaleUpProbe,
+)
+
+__all__ = [
+    "DELETION_TAINT",
+    "SIM_NODE_PREFIX",
+    "ClusterAutoscaler",
+    "ScaleSimulator",
+    "ScaleUpProbe",
+]

@@ -1,0 +1,458 @@
+"""ClusterAutoscaler: the node-group control loop.
+
+The cluster-autoscaler analog (RunOnce in the reference autoscaler's
+static_autoscaler.go), rebuilt around the batched solver: instead of a
+serial bin-packing estimator per node group, every expansion candidate is
+scored with one device what-if solve (ScaleSimulator.probe_scale_up) and
+every drain candidate is verified the same way (probe_scale_down). One
+leader-elected loop per cluster, living in the controller-manager next to
+the other loops or standing alone via cmd/autoscaler.py.
+
+Scale-up: pending (unschedulable) pods are batched; each group with
+headroom is offered k hypothetical template nodes and scored by
+pods-placed-per-node-added; the winner is expanded through the cloud SPI
+(CloudProvider.increase_size) with per-group cooldowns and max-size caps.
+
+Scale-down is two-phase across ticks so the what-if can genuinely go
+stale and be rolled back: tick t finds a node underutilized past the
+unneeded dwell, verifies drainability (PDBs via eviction_allowed, no gang
+members, no pods above the priority cutoff, probe_scale_down fits), then
+cordons + taints it; tick t+1 RE-verifies against fresh informer state —
+stale answers uncordon and roll back, fresh ones drain through can_evict
+(the spending PDB gate) and delete through the SPI.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+
+from kubernetes_tpu.api.objects import NodeGroup, Taint
+from kubernetes_tpu.api.quantity import parse_quantity
+from kubernetes_tpu.apiserver.store import (
+    AlreadyExists,
+    Conflict,
+    NotFound,
+    ObjectStore,
+)
+from kubernetes_tpu.autoscaler.simulator import ScaleSimulator
+from kubernetes_tpu.client.informer import Informer
+from kubernetes_tpu.cloudprovider.interface import CloudProvider
+from kubernetes_tpu.controllers.disruption import can_evict, eviction_allowed
+from kubernetes_tpu.gang import pod_group_key
+from kubernetes_tpu.models.policy import DEFAULT_POLICY
+from kubernetes_tpu.state.layout import Capacities
+
+log = logging.getLogger(__name__)
+
+# cordon marker: the reference autoscaler's deletetaint.ToBeDeletedTaint
+DELETION_TAINT = "ToBeDeletedByClusterAutoscaler"
+
+SCAN_INTERVAL = 2.0            # --scan-interval (reference: 10s)
+SCALEUP_COOLDOWN = 30.0        # per-group, after an increase_size
+SCALEDOWN_COOLDOWN = 60.0      # --scale-down-delay-after-add spirit
+UNNEEDED_TIME = 30.0           # --scale-down-unneeded-time (ref: 10m)
+UTILIZATION_THRESHOLD = 0.5    # --scale-down-utilization-threshold
+MAX_EXPANSION = 8              # hypothetical rows offered per probe
+
+_mx_cache: tuple | None = None
+
+
+def _metrics() -> tuple:
+    """(scaleup_total, scaledown_total, rollback_total, sim_seconds,
+    backlog_gauge) — the autoscaler_* families (obs satellite)."""
+    global _mx_cache
+    if _mx_cache is None:
+        from kubernetes_tpu.obs import metrics as m
+
+        _mx_cache = (
+            m.REGISTRY.counter("autoscaler_scaleup_total",
+                               "Nodes added by scale-up, by group.",
+                               ("group",)),
+            m.REGISTRY.counter("autoscaler_scaledown_total",
+                               "Nodes removed by scale-down, by group.",
+                               ("group",)),
+            m.REGISTRY.counter("autoscaler_scaledown_rollback_total",
+                               "Drains aborted because the what-if went "
+                               "stale between cordon and drain."),
+            m.REGISTRY.histogram("autoscaler_simulation_seconds",
+                                 "Wall time of one what-if probe solve."),
+            m.REGISTRY.gauge("autoscaler_unschedulable_pods",
+                             "Pending pods the autoscaler currently sees."),
+        )
+    return _mx_cache
+
+
+def _pod_pending(pod) -> bool:
+    return not pod.spec.node_name \
+        and pod.status.phase in ("", "Pending") \
+        and not pod.metadata.deletion_timestamp
+
+
+def _node_ready(node) -> bool:
+    ready = next((c for c in node.status.conditions if c.type == "Ready"),
+                 None)
+    return ready is not None and ready.status == "True"
+
+
+class ClusterAutoscaler:
+    """One periodic pass (`run_once`) over pending pods and node groups;
+    not a keyed reconcile loop — the whole cluster is one reconciliation
+    unit, exactly the reference RunOnce's shape."""
+
+    name = "cluster-autoscaler"
+
+    def __init__(self, store: ObjectStore, cloud: CloudProvider, *,
+                 caps: Capacities | None = None,
+                 policy=DEFAULT_POLICY,
+                 node_informer: Informer | None = None,
+                 pod_informer: Informer | None = None,
+                 scan_interval: float = SCAN_INTERVAL,
+                 scaleup_cooldown: float = SCALEUP_COOLDOWN,
+                 scaledown_cooldown: float = SCALEDOWN_COOLDOWN,
+                 unneeded_time: float = UNNEEDED_TIME,
+                 utilization_threshold: float = UTILIZATION_THRESHOLD,
+                 scaledown_priority_cutoff: int = 0,
+                 max_expansion: int = MAX_EXPANSION,
+                 register_nodes: bool = True,
+                 now=time.monotonic):
+        self.store = store
+        self.cloud = cloud
+        self.scan_interval = scan_interval
+        self.scaleup_cooldown = scaleup_cooldown
+        self.scaledown_cooldown = scaledown_cooldown
+        self.unneeded_time = unneeded_time
+        self.utilization_threshold = utilization_threshold
+        # pods above this spec.priority pin their node (the reference's
+        # --expendable-pods-priority-cutoff, inverted to "not expendable")
+        self.scaledown_priority_cutoff = scaledown_priority_cutoff
+        self.max_expansion = max_expansion
+        # materialize created instances as Node objects (the fake-kubelet
+        # role: no agent process exists to register them in tests/bench)
+        self.register_nodes = register_nodes
+        self.now = now
+        self._own_informers = node_informer is None or pod_informer is None
+        self.nodes = node_informer or Informer(store, "Node")
+        self.pods = pod_informer or Informer(store, "Pod")
+        self.simulator = ScaleSimulator(caps=caps, policy=policy)
+        self.nodes.add_handler(self._on_node_event)
+        self.pods.add_handler(self._on_pod_event)
+        # group -> monotonic deadline before which it may not scale again
+        self._scaleup_after: dict[str, float] = {}
+        self._scaledown_after: dict[str, float] = {}
+        # node -> monotonic time it was first seen underutilized
+        self._unneeded_since: dict[str, float] = {}
+        # node -> group: cordoned last tick, re-verify + drain this tick
+        self._draining: dict[str, str] = {}
+        # wall-clock scale timestamps for NodeGroup status
+        self._last_scaleup: dict[str, float] = {}
+        self._last_scaledown: dict[str, float] = {}
+        self._task = None
+        # counters mirrored as attributes for tests/bench
+        self.scaleups = 0
+        self.scaledowns = 0
+        self.rollbacks = 0
+
+    # ---- informer mirror (same shape as the scheduler driver's) ----
+
+    def _on_node_event(self, event) -> None:
+        node = event.obj
+        if event.type == "DELETED":
+            if self.simulator.has_node(node.metadata.name):
+                self.simulator.remove_node(node.metadata.name)
+            return
+        self.simulator.upsert_node(node)
+
+    def _on_pod_event(self, event) -> None:
+        pod = event.obj
+        if event.type == "DELETED":
+            self.simulator.remove_pod(pod.key)
+            return
+        if pod.spec.node_name:
+            self.simulator.add_pod(pod)
+
+    def _sweep_accounting(self) -> None:
+        """Re-account bound pods whose events raced their node's, or whose
+        accounting a node delete+recreate dropped (the driver does this via
+        a node->pods index; the autoscaler's pass is already O(pods))."""
+        for pod in self.pods.items():
+            if pod.spec.node_name \
+                    and not self.simulator.is_accounted(pod.key) \
+                    and self.simulator.has_node(pod.spec.node_name):
+                self.simulator.add_pod(pod)
+
+    # ---- lifecycle ----
+
+    async def start(self) -> None:
+        import asyncio
+
+        if self._own_informers:
+            self.nodes.start()
+            self.pods.start()
+            await self.nodes.wait_for_sync()
+            await self.pods.wait_for_sync()
+        self._task = asyncio.get_running_loop().create_task(self._loop())
+
+    def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            self._task = None
+        if self._own_informers:
+            self.nodes.stop()
+            self.pods.stop()
+
+    async def _loop(self) -> None:
+        import asyncio
+
+        while True:
+            await asyncio.sleep(self.scan_interval)
+            try:
+                self.run_once()
+            except Exception:  # noqa: BLE001 — the loop must not die
+                log.exception("autoscaler pass failed")
+
+    # ---- one pass ----
+
+    def run_once(self) -> None:
+        now = self.now()
+        self._sweep_accounting()
+        self._reconcile_nodegroups()
+        pending = self._pending_pods()
+        _metrics()[4].set(len(pending))
+        if pending:
+            self._scale_up(pending, now)
+        else:
+            self._scale_down(now)
+
+    def _pending_pods(self) -> list:
+        """Unschedulable pods, gang members contiguous (the simulator's
+        gang columns are assigned over contiguous runs, mirroring the
+        driver's admission shape)."""
+        pending = [p for p in self.pods.items() if _pod_pending(p)]
+        pending.sort(key=lambda p: (pod_group_key(p) or f"\x7f{p.key}",
+                                    p.key))
+        return pending
+
+    # ---- NodeGroup object reconciliation ----
+
+    def _reconcile_nodegroups(self) -> None:
+        ready_by_group: dict[str, int] = {}
+        for node in self.nodes.items():
+            group = self.cloud.node_group_of(node.metadata.name)
+            if group and _node_ready(node):
+                ready_by_group[group] = ready_by_group.get(group, 0) + 1
+        for group in self.cloud.node_groups():
+            lo, hi = self.cloud.group_size_range(group)
+            spec = {"minSize": lo, "maxSize": hi,
+                    "cloudProviderGroup": group}
+            status = {"targetSize": self.cloud.target_size(group),
+                      "readyNodes": ready_by_group.get(group, 0),
+                      "lastScaleUp": self._last_scaleup.get(group, 0),
+                      "lastScaleDown": self._last_scaledown.get(group, 0)}
+
+            def mutate(obj, spec=spec, status=status):
+                obj.spec = spec
+                obj.status = status
+                return obj
+
+            try:
+                self.store.guaranteed_update("NodeGroup", group, "default",
+                                             mutate)
+            except NotFound:
+                try:
+                    self.store.create(NodeGroup.from_dict(
+                        {"metadata": {"name": group}, "spec": spec,
+                         "status": status}))
+                except (AlreadyExists, Conflict):
+                    pass
+            except Conflict:
+                pass
+
+    # ---- scale-up ----
+
+    def _scale_up(self, pending: list, now: float) -> None:
+        baseline = self.simulator.baseline_placed(pending)
+        if baseline >= min(len(pending), self.simulator.caps.batch_pods):
+            return  # the head of the backlog fits as-is: scheduler's job
+        best = None      # (score, group, nodes_to_add, template)
+        for group in self.cloud.node_groups():
+            if now < self._scaleup_after.get(group, 0.0):
+                continue
+            _lo, hi = self.cloud.group_size_range(group)
+            headroom = hi - self.cloud.target_size(group)
+            if headroom <= 0:
+                continue
+            k = min(headroom, self.max_expansion)
+            template = self.cloud.template_node(group)
+            t0 = time.perf_counter()
+            probe = self.simulator.probe_scale_up(pending, template, k,
+                                                  baseline=baseline)
+            _metrics()[3].observe(time.perf_counter() - t0)
+            if probe is None or probe.newly_placed <= 0:
+                continue
+            want = max(1, probe.used_nodes)
+            score = probe.newly_placed / want
+            if best is None or score > best[0]:
+                best = (score, group, min(want, headroom), template)
+        if best is None:
+            return
+        _score, group, count, template = best
+        created = self.cloud.increase_size(group, count)
+        self._scaleup_after[group] = now + self.scaleup_cooldown
+        # a fresh capacity add shouldn't be immediately re-shrunk
+        self._scaledown_after[group] = now + self.scaledown_cooldown
+        self._last_scaleup[group] = time.time()
+        self.scaleups += len(created)
+        _metrics()[0].labels(group).inc(len(created))
+        log.info("scale-up: group %s +%d (score %.2f, baseline %d/%d)",
+                 group, len(created), _score, baseline, len(pending))
+        if self.register_nodes:
+            for name in created:
+                node = template.clone()
+                node.metadata.name = name
+                node.metadata.labels["kubernetes.io/hostname"] = name
+                try:
+                    self.store.create(node)
+                except (AlreadyExists, Conflict):
+                    pass
+
+    # ---- scale-down ----
+
+    def _utilization(self, node) -> float:
+        """max(cpu, memory) requested fraction of effective allocatable —
+        the reference's simulator.CalculateUtilization."""
+        alloc = node.status.effective_allocatable()
+        cap_cpu = float(parse_quantity(alloc.get("cpu", "0") or "0"))
+        cap_mem = float(parse_quantity(alloc.get("memory", "0") or "0"))
+        used_cpu = used_mem = 0.0
+        for pod in self.pods.items():
+            if pod.spec.node_name != node.metadata.name \
+                    or pod.status.phase in ("Succeeded", "Failed"):
+                continue
+            for c in pod.spec.containers:
+                if "cpu" in c.requests:
+                    used_cpu += float(parse_quantity(c.requests["cpu"]))
+                if "memory" in c.requests:
+                    used_mem += float(parse_quantity(c.requests["memory"]))
+        fracs = []
+        if cap_cpu > 0:
+            fracs.append(used_cpu / cap_cpu)
+        if cap_mem > 0:
+            fracs.append(used_mem / cap_mem)
+        return max(fracs) if fracs else 1.0
+
+    def _node_pods(self, name: str) -> list:
+        return [p for p in self.pods.items()
+                if p.spec.node_name == name
+                and p.status.phase not in ("Succeeded", "Failed")]
+
+    def _drain_blocked(self, pods) -> str | None:
+        """Why this node must not be drained, or None if it may be."""
+        for pod in pods:
+            if pod_group_key(pod) is not None:
+                return f"gang member {pod.key}"  # never split a gang
+            if (pod.spec.priority or 0) > self.scaledown_priority_cutoff:
+                return f"pod {pod.key} above priority cutoff"
+            if not eviction_allowed(self.store, pod):
+                return f"PDB forbids evicting {pod.key}"
+        return None
+
+    def _verify_scale_down(self, node) -> bool:
+        pods = self._node_pods(node.metadata.name)
+        if self._drain_blocked(pods) is not None:
+            return False
+        t0 = time.perf_counter()
+        ok = self.simulator.probe_scale_down(node, pods)
+        _metrics()[3].observe(time.perf_counter() - t0)
+        return ok
+
+    def _scale_down(self, now: float) -> None:
+        # phase 2 first: nodes cordoned last tick drain (or roll back) now
+        for name in list(self._draining):
+            self._finish_drain(name)
+            return  # one scale-down action per tick
+        # phase 1: find a newly-unneeded node, verify, cordon + taint
+        for node in self.nodes.items():
+            name = node.metadata.name
+            group = self.cloud.node_group_of(name)
+            if group is None or name in self._draining:
+                continue
+            lo, _hi = self.cloud.group_size_range(group)
+            if self.cloud.target_size(group) <= lo:
+                continue
+            if now < self._scaledown_after.get(group, 0.0):
+                continue
+            if node.spec.unschedulable or not _node_ready(node):
+                self._unneeded_since.pop(name, None)
+                continue
+            if self._utilization(node) >= self.utilization_threshold:
+                self._unneeded_since.pop(name, None)
+                continue
+            since = self._unneeded_since.setdefault(name, now)
+            if now - since < self.unneeded_time:
+                continue
+            if not self._verify_scale_down(node):
+                continue
+            if not self._cordon(name, True):
+                continue
+            self._draining[name] = group
+            self._unneeded_since.pop(name, None)
+            log.info("scale-down: cordoned %s (group %s), draining next "
+                     "tick", name, group)
+            return  # one scale-down action per tick
+
+    def _cordon(self, name: str, on: bool) -> bool:
+        def mutate(node):
+            node.spec.unschedulable = on
+            node.spec.taints = [t for t in node.spec.taints
+                                if t.key != DELETION_TAINT]
+            if on:
+                node.spec.taints.append(
+                    Taint(key=DELETION_TAINT, effect="NoSchedule"))
+            return node
+
+        try:
+            self.store.guaranteed_update("Node", name, "default", mutate)
+            return True
+        except (NotFound, Conflict):
+            return False
+
+    def _finish_drain(self, name: str) -> None:
+        """Phase 2: re-verify the cordoned node against fresh informer
+        state, then evict + delete — or roll the cordon back."""
+        group = self._draining.pop(name)
+        node = self.nodes.get(name)
+        if node is None:
+            return  # already gone (lifecycle GC beat us): nothing to do
+        if not self._verify_scale_down(node):
+            # the what-if went stale (new pods landed, a PDB tightened,
+            # the remainder shrank): give the node back
+            self._cordon(name, False)
+            self.rollbacks += 1
+            _metrics()[2].inc()
+            log.info("scale-down: what-if stale for %s, rolled back", name)
+            return
+        for pod in self._node_pods(name):
+            if not can_evict(self.store, pod):
+                self._cordon(name, False)
+                self.rollbacks += 1
+                _metrics()[2].inc()
+                log.info("scale-down: eviction refused mid-drain on %s, "
+                         "rolled back", name)
+                return
+            try:
+                self.store.delete("Pod", pod.metadata.name,
+                                  pod.metadata.namespace)
+            except NotFound:
+                pass
+        self.cloud.delete_nodes(group, [name])
+        try:
+            self.store.delete("Node", name, "default")
+        except NotFound:
+            pass
+        self._scaledown_after[group] = self.now() + self.scaledown_cooldown
+        self._last_scaledown[group] = time.time()
+        self.scaledowns += 1
+        _metrics()[1].labels(group).inc()
+        log.info("scale-down: drained and deleted %s (group %s)", name,
+                 group)

@@ -64,6 +64,7 @@ ALIASES = {
     "pdb": "poddisruptionbudgets",
     "poddisruptionbudget": "poddisruptionbudgets",
     "pg": "podgroups", "podgroup": "podgroups",
+    "ng": "nodegroups", "nodegroup": "nodegroups",
     "pc": "priorityclasses", "priorityclass": "priorityclasses",
     "quota": "resourcequotas", "resourcequota": "resourcequotas",
     "limits": "limitranges", "limitrange": "limitranges",
@@ -156,6 +157,9 @@ def _row(kind: str, obj, wide: bool) -> list[str]:
     if kind == "PriorityClass":
         return [obj.metadata.name, str(obj.value),
                 str(bool(obj.global_default)).lower(), _age(obj)]
+    if kind == "NodeGroup":
+        return [obj.metadata.name, str(obj.min_size), str(obj.max_size),
+                str(obj.target_size), str(obj.ready_nodes), _age(obj)]
     return [obj.metadata.name, _age(obj)]
 
 
@@ -173,6 +177,7 @@ HEADERS = {
     "Event": ["NAME", "TYPE", "REASON", "COUNT", "MESSAGE"],
     "PodGroup": ["NAME", "PHASE", "PLACED", "AGE"],
     "PriorityClass": ["NAME", "VALUE", "GLOBAL-DEFAULT", "AGE"],
+    "NodeGroup": ["NAME", "MIN", "MAX", "TARGET", "READY", "AGE"],
 }
 
 

@@ -1,4 +1,8 @@
 from kubernetes_tpu.cloudprovider.interface import (  # noqa: F401
+    NODE_GROUP_LABEL,
+    REGION_LABEL,
+    ZONE_LABEL,
     CloudProvider,
     FakeCloud,
+    FakeNodeGroup,
 )

@@ -4,12 +4,21 @@ The pkg/cloudprovider analog (Interface at pkg/cloudprovider/cloud.go:
 LoadBalancer/Instances/Zones sub-interfaces; nine real providers + the fake
 at pkg/cloudprovider/providers/fake used by every controller test). The
 service controller consumes LoadBalancer; the node lifecycle consumes
-Instances (does a cloud instance still exist?); Zones labels nodes."""
+Instances (does a cloud instance still exist?); Zones labels nodes; the
+cluster autoscaler consumes NodeGroups (the autoscaler/cloudprovider
+CloudProvider/NodeGroup contract: TargetSize/IncreaseSize/DeleteNodes plus
+a template node per group for what-if simulation)."""
 
 from __future__ import annotations
 
 import itertools
 from dataclasses import dataclass, field
+
+# the autoscaler's group-membership label on template/created nodes (the
+# upstream analog is the per-cloud group tag, e.g. the MIG/ASG name label)
+NODE_GROUP_LABEL = "ktpu.io/nodegroup"
+ZONE_LABEL = "failure-domain.beta.kubernetes.io/zone"
+REGION_LABEL = "failure-domain.beta.kubernetes.io/region"
 
 
 @dataclass
@@ -62,6 +71,57 @@ class CloudProvider:
     def delete_route(self, node_name: str) -> None:
         raise NotImplementedError
 
+    # -- NodeGroups (the cluster-autoscaler SPI; default: no groups, so a
+    # provider that predates autoscaling keeps working unchanged) --
+    def node_groups(self) -> list[str]:
+        """Names of the autoscalable node groups, stable order."""
+        return []
+
+    def node_group_of(self, node_name: str) -> str | None:
+        """The group an instance belongs to (None: unmanaged node — the
+        autoscaler never scales it down)."""
+        return None
+
+    def group_size_range(self, group: str) -> tuple[int, int]:
+        """(min_size, max_size) bounds for the group."""
+        raise NotImplementedError
+
+    def target_size(self, group: str) -> int:
+        """Current desired instance count (cloud-side source of truth)."""
+        raise NotImplementedError
+
+    def increase_size(self, group: str, delta: int) -> list[str]:
+        """Grow the group by `delta` instances; returns the new instance
+        names. Must reject growth past max_size."""
+        raise NotImplementedError
+
+    def delete_nodes(self, group: str, node_names: list[str]) -> None:
+        """Remove specific instances from the group (scale-down). Must
+        reject shrinking below min_size or deleting a non-member."""
+        raise NotImplementedError
+
+    def template_node(self, group: str):
+        """A Node object shaped like a fresh instance of this group
+        (allocatable, labels incl. zone, Ready condition) — what the
+        autoscaler encodes as hypothetical rows in probe solves."""
+        raise NotImplementedError
+
+
+@dataclass
+class FakeNodeGroup:
+    """One autoscalable pool of identical fake instances."""
+
+    name: str
+    min_size: int = 0
+    max_size: int = 10
+    cpu: str = "4"
+    memory: str = "8Gi"
+    pods: str = "110"
+    zone: str = ""                 # "" = provider default zone
+    labels: dict = field(default_factory=dict)
+    members: set = field(default_factory=set)
+    _seq: itertools.count = field(default_factory=lambda: itertools.count())
+
 
 @dataclass
 class FakeCloud(CloudProvider):
@@ -74,6 +134,7 @@ class FakeCloud(CloudProvider):
     zone: tuple[str, str] = ("fake-zone-a", "fake-region")
     routes: dict[str, str] = field(default_factory=dict)
     disk_attachments: dict[str, str] = field(default_factory=dict)
+    groups: dict[str, FakeNodeGroup] = field(default_factory=dict)
     calls: list[str] = field(default_factory=list)
     _ip_counter: itertools.count = field(
         default_factory=lambda: itertools.count(1))
@@ -101,6 +162,9 @@ class FakeCloud(CloudProvider):
         return node_name in self.instances
 
     def get_zone(self, node_name: str) -> tuple[str, str]:
+        group = self.node_group_of(node_name)
+        if group is not None and self.groups[group].zone:
+            return (self.groups[group].zone, self.zone[1])
         return self.zone
 
     def attach_disk(self, disk_name: str, node_name: str,
@@ -133,3 +197,94 @@ class FakeCloud(CloudProvider):
     def delete_route(self, node_name: str) -> None:
         self.calls.append(f"route-:{node_name}")
         self.routes.pop(node_name, None)
+
+    # -- NodeGroups --
+
+    def add_node_group(self, name: str, min_size: int = 0,
+                       max_size: int = 10, *, cpu: str = "4",
+                       memory: str = "8Gi", pods: str = "110",
+                       zone: str = "", labels: dict | None = None,
+                       initial: int = 0) -> FakeNodeGroup:
+        """Register a pool; `initial` pre-provisions that many instances
+        (without Node objects — registration is the autoscaler's job)."""
+        if not (0 <= min_size <= max_size):
+            raise ValueError(f"bad size range [{min_size}, {max_size}]")
+        group = FakeNodeGroup(name=name, min_size=min_size,
+                              max_size=max_size, cpu=cpu, memory=memory,
+                              pods=pods, zone=zone, labels=dict(labels or {}))
+        self.groups[name] = group
+        if initial:
+            self.increase_size(name, initial)
+        return group
+
+    def node_groups(self) -> list[str]:
+        return sorted(self.groups)
+
+    def node_group_of(self, node_name: str) -> str | None:
+        for name, group in self.groups.items():
+            if node_name in group.members:
+                return name
+        return None
+
+    def group_size_range(self, group: str) -> tuple[int, int]:
+        g = self.groups[group]
+        return (g.min_size, g.max_size)
+
+    def target_size(self, group: str) -> int:
+        return len(self.groups[group].members)
+
+    def increase_size(self, group: str, delta: int) -> list[str]:
+        g = self.groups[group]
+        if delta <= 0:
+            raise ValueError(f"increase_size delta must be > 0, got {delta}")
+        if len(g.members) + delta > g.max_size:
+            raise ValueError(
+                f"group {group!r}: {len(g.members)}+{delta} exceeds "
+                f"max_size {g.max_size}")
+        self.calls.append(f"scaleup:{group}+{delta}")
+        created = []
+        for _ in range(delta):
+            name = f"{g.name}-{next(g._seq):04d}"
+            g.members.add(name)
+            self.instances.add(name)
+            created.append(name)
+        return created
+
+    def delete_nodes(self, group: str, node_names: list[str]) -> None:
+        g = self.groups[group]
+        missing = [n for n in node_names if n not in g.members]
+        if missing:
+            raise ValueError(f"group {group!r}: not members: {missing}")
+        if len(g.members) - len(node_names) < g.min_size:
+            raise ValueError(
+                f"group {group!r}: deleting {len(node_names)} would go "
+                f"below min_size {g.min_size}")
+        self.calls.append(
+            f"scaledown:{group}-{','.join(sorted(node_names))}")
+        for name in node_names:
+            g.members.discard(name)
+            self.instances.discard(name)
+
+    def template_node(self, group: str):
+        """Fresh-instance Node shape: allocatable + zone/group labels +
+        Ready condition — exactly what a new member registers with, so
+        probe rows and real rows encode identically."""
+        from kubernetes_tpu.api.objects import Node
+
+        g = self.groups[group]
+        zone, region = (g.zone or self.zone[0]), self.zone[1]
+        labels = {
+            "kubernetes.io/hostname": f"{g.name}-template",
+            ZONE_LABEL: zone,
+            REGION_LABEL: region,
+            NODE_GROUP_LABEL: g.name,
+        }
+        labels.update(g.labels)
+        return Node.from_dict({
+            "metadata": {"name": f"{g.name}-template", "labels": labels},
+            "status": {
+                "allocatable": {"cpu": g.cpu, "memory": g.memory,
+                                "pods": g.pods},
+                "conditions": [{"type": "Ready", "status": "True"}],
+            },
+        })

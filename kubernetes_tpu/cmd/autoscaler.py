@@ -1,0 +1,154 @@
+"""The standalone cluster-autoscaler binary.
+
+The reference cluster-autoscaler runs as its own leader-elected deployment
+rather than inside the controller-manager; this entrypoint mirrors that
+topology (the in-manager loop is the default — use one or the other, never
+both, or they will fight over cordons).
+
+    python -m kubernetes_tpu.cmd.autoscaler \
+        --apiserver http://127.0.0.1:8080 --leader-elect \
+        --node-groups '{"pool-a": {"minSize": 0, "maxSize": 10,
+                                   "cpu": "4", "memory": "8Gi"}}'
+
+--node-groups configures a FakeCloud provider (the only one shipped); a
+real provider would be injected the way the controller-manager takes
+`cloud`.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import logging
+import os
+import socket
+import sys
+from urllib.parse import urlsplit
+
+log = logging.getLogger(__name__)
+
+
+def build_cloud(spec: str):
+    """FakeCloud from the --node-groups JSON: either a map
+    {name: {minSize, maxSize, cpu, memory, pods, zone, initial}} or a
+    list of the same objects carrying a "name" key ("min"/"max" are
+    accepted as aliases)."""
+    from kubernetes_tpu.cloudprovider import FakeCloud
+
+    cloud = FakeCloud()
+    parsed = json.loads(spec) if spec else {}
+    if isinstance(parsed, list):
+        parsed = {cfg["name"]: cfg for cfg in parsed}
+    for name, cfg in parsed.items():
+        cloud.add_node_group(
+            name,
+            int(cfg.get("minSize", cfg.get("min", 0))),
+            int(cfg.get("maxSize", cfg.get("max", 10))),
+            cpu=str(cfg.get("cpu", "4")),
+            memory=str(cfg.get("memory", "8Gi")),
+            pods=str(cfg.get("pods", "110")),
+            zone=str(cfg.get("zone", "")),
+            labels=cfg.get("labels") or {},
+            initial=int(cfg.get("initial", 0)))
+    return cloud
+
+
+def parse_args(argv=None) -> argparse.Namespace:
+    p = argparse.ArgumentParser(
+        prog="kubernetes-tpu-autoscaler",
+        description="cluster autoscaler (node-group scale-up/scale-down)")
+    p.add_argument("--apiserver", required=True,
+                   help="HTTP apiserver URL (apiserver.http.APIServer)")
+    p.add_argument("--token", default=os.environ.get("KUBE_TOKEN", ""),
+                   help="bearer token for an authn-enabled apiserver "
+                        "(env KUBE_TOKEN)")
+    p.add_argument("--leader-elect", action="store_true")
+    p.add_argument("--port", type=int, default=10260,
+                   help="serve /metrics, /healthz and /readyz here "
+                        "(0 = ephemeral)")
+    p.add_argument("--lock-object-name", default="cluster-autoscaler")
+    p.add_argument("--lock-object-namespace", default="kube-system")
+    p.add_argument("--node-groups", default="",
+                   help="JSON map of fake node groups (see module doc)")
+    p.add_argument("--scan-interval", type=float, default=2.0)
+    p.add_argument("--scale-down-unneeded-time", type=float, default=30.0)
+    p.add_argument("--scale-down-utilization-threshold", type=float,
+                   default=0.5)
+    p.add_argument("--expendable-pods-priority-cutoff", type=int, default=0)
+    p.add_argument("--lease-duration", type=float, default=15.0)
+    p.add_argument("--renew-deadline", type=float, default=10.0)
+    p.add_argument("--retry-period", type=float, default=2.0)
+    return p.parse_args(argv)
+
+
+async def run(args: argparse.Namespace) -> None:
+    from kubernetes_tpu.apiserver.http import RemoteStore
+    from kubernetes_tpu.autoscaler import ClusterAutoscaler
+
+    url = urlsplit(args.apiserver)
+    store = RemoteStore(url.hostname, url.port or 80, token=args.token)
+    cloud = build_cloud(args.node_groups)
+    autoscaler = ClusterAutoscaler(
+        store, cloud,
+        scan_interval=args.scan_interval,
+        unneeded_time=args.scale_down_unneeded_time,
+        utilization_threshold=args.scale_down_utilization_threshold,
+        scaledown_priority_cutoff=args.expendable_pods_priority_cutoff)
+
+    from kubernetes_tpu.obs.http import ObsServer
+
+    obs = ObsServer(
+        ready_checks={"informers-synced":
+                      lambda: autoscaler.nodes._synced.is_set()
+                      and autoscaler.pods._synced.is_set()},
+        port=args.port)
+    try:
+        await obs.start()
+        log.info("observability endpoints on %s", obs.url)
+    except OSError as e:
+        log.warning("observability endpoints disabled "
+                    "(port %d unavailable: %s)", args.port, e)
+        obs = None
+
+    async def lead():
+        await autoscaler.start()
+        log.info("autoscaler running against %s (groups: %s)",
+                 args.apiserver, ", ".join(cloud.node_groups()) or "none")
+        await asyncio.Event().wait()
+
+    try:
+        if args.leader_elect:
+            from kubernetes_tpu.client.leaderelection import LeaderElector
+
+            elector = LeaderElector(
+                store, f"{socket.gethostname()}_{os.getpid()}",
+                lock_name=args.lock_object_name,
+                lock_namespace=args.lock_object_namespace,
+                lease_duration=args.lease_duration,
+                renew_deadline=args.renew_deadline,
+                retry_period=args.retry_period,
+                on_started_leading=lead)
+            await elector.run()
+            log.warning("lost leader lease; exiting")
+        else:
+            await lead()
+    finally:
+        autoscaler.stop()
+        if obs is not None:
+            await obs.stop()
+
+
+def main(argv=None) -> int:
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(levelname).1s %(name)s: %(message)s")
+    try:
+        asyncio.run(run(parse_args(argv)))
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -97,6 +97,25 @@ class DisruptionController(ReconcileController):
             pass
 
 
+def eviction_allowed(store: ObjectStore, pod) -> bool:
+    """Read-only twin of `can_evict`: would every PDB covering the pod
+    permit a disruption right now? Spends nothing — the autoscaler's
+    what-if phase uses it to rule candidate nodes in or out without
+    consuming budget it may never need (the real spend still happens
+    through `can_evict` at drain time, so the answer can go stale and the
+    drain must re-check)."""
+    ns = pod.metadata.namespace
+    for pdb in store.list("PodDisruptionBudget", namespace=ns,
+                          copy_objects=False):
+        canon = canonical_selector(pdb.selector or None)
+        if canon in ((), PARSE_ERROR) \
+                or not selector_matches(canon, pod.metadata.labels):
+            continue
+        if int(pdb.status.get("disruptionsAllowed", 0)) <= 0:
+            return False
+    return True
+
+
 def can_evict(store: ObjectStore, pod) -> bool:
     """Eviction-subresource budget check: spend one disruption from every
     PDB covering the pod, or refuse without spending anything. Check-all-

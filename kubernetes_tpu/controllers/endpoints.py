@@ -58,22 +58,47 @@ class EndpointController(ReconcileController):
     workers = 2
 
     def __init__(self, store: ObjectStore, service_informer: Informer,
-                 pod_informer: Informer):
+                 pod_informer: Informer,
+                 node_informer: Informer | None = None):
         super().__init__()
         self.name = "endpoint-controller"
         self.store = store
         self.services = service_informer
         self.pods = pod_informer
+        # node hygiene: a deleted Node's pods linger as objects until the
+        # lifecycle controller evicts them (minutes) — with a node informer
+        # their addresses drop from Endpoints the moment the Node goes,
+        # instead of serving traffic to a machine that isn't there. Only
+        # OBSERVED deletions count (a pod bound to a node name the watch
+        # never saw — hollow setups — keeps serving).
+        self.nodes = node_informer
+        self._gone_nodes: set[str] = set()
         service_informer.add_handler(self._on_service)
         pod_informer.add_handler(self._on_pod)
+        if node_informer is not None:
+            node_informer.add_handler(self._on_node)
 
     def _on_service(self, event) -> None:
         self.enqueue(event.obj.key)
 
+    def _on_node(self, event) -> None:
+        name = event.obj.metadata.name
+        if event.type != "DELETED":
+            self._gone_nodes.discard(name)  # (re)registered: serve again
+            return
+        # a Node delete orphans its pods' addresses: re-sync every service
+        # backed by a pod on that node NOW, not at the next full resync
+        self._gone_nodes.add(name)
+        for pod in self.pods.items():
+            if pod.spec.node_name == name:
+                self._enqueue_pod_services(pod)
+
     def _on_pod(self, event) -> None:
         # enqueue every service whose selector matches the pod's labels
         # (addPod, endpoints_controller.go:150 getPodServiceMemberships)
-        pod = event.obj
+        self._enqueue_pod_services(event.obj)
+
+    def _enqueue_pod_services(self, pod) -> None:
         for svc in self.services.items():
             if svc.metadata.namespace != pod.metadata.namespace:
                 continue
@@ -103,6 +128,8 @@ class EndpointController(ReconcileController):
                 continue
             if pod.status.phase in ("Succeeded", "Failed"):
                 continue
+            if pod.spec.node_name in self._gone_nodes:
+                continue  # node deleted: the backend machine is gone
             if not all(pod.metadata.labels.get(k) == v
                        for k, v in sel.items()):
                 continue

@@ -12,6 +12,14 @@ clamped to [minReplicas, maxReplicas], skipping changes inside the 10%
 tolerance band (horizontal.go:251 tolerance = 0.1). Scaling writes
 spec.replicas through the workload kinds' scale shape (the reference's
 /scale subresource).
+
+Downscale stabilization (the reference's
+--horizontal-pod-autoscaler-downscale-stabilization, replicacalculator's
+stabilizeRecommendation): each sync records the raw desired-replica
+recommendation; a scale DOWN only goes to the maximum recommendation seen
+inside the stabilization window, so a transient dip in load can't flap the
+workload — it shrinks only after the recommendation has stayed low for the
+whole window. Scale-ups apply immediately.
 """
 
 from __future__ import annotations
@@ -30,6 +38,7 @@ from kubernetes_tpu.state.podaffinity import PARSE_ERROR, selector_matches
 log = logging.getLogger(__name__)
 
 TOLERANCE = 0.1  # horizontal.go tolerance
+DOWNSCALE_STABILIZATION = 300.0  # downscaleStabilisationWindow (5m)
 SCALABLE_KINDS = ("ReplicationController", "ReplicaSet", "Deployment",
                   "StatefulSet")
 
@@ -99,14 +108,24 @@ class HorizontalController:
     def __init__(self, store: ObjectStore, hpa_informer: Informer,
                  pod_informer: Informer, metrics: MetricsSource,
                  sync_period: float = 30.0,
+                 stabilization_window_s: float = DOWNSCALE_STABILIZATION,
                  now: Callable[[], float] = time.time):
         self.store = store
         self.hpas = hpa_informer
         self.pods = pod_informer
         self.metrics = metrics
         self.sync_period = sync_period
+        self.stabilization_window_s = stabilization_window_s
         self.now = now
+        # hpa key -> [(timestamp, raw desired)] recommendations inside the
+        # stabilization window (horizontal.go recommendations map)
+        self._recommendations: dict[str, list[tuple[float, int]]] = {}
+        hpa_informer.add_handler(self._on_hpa)
         self._task: asyncio.Task | None = None
+
+    def _on_hpa(self, event) -> None:
+        if event.type == "DELETED":
+            self._recommendations.pop(event.obj.key, None)
 
     async def start(self) -> None:
         self._task = asyncio.get_running_loop().create_task(self._loop())
@@ -180,6 +199,7 @@ class HorizontalController:
         if abs(ratio - 1.0) > TOLERANCE:
             desired = math.ceil(current * ratio)
         desired = max(hpa.min_replicas, min(hpa.max_replicas, desired))
+        desired = self._stabilize(hpa.key, current, desired)
         if desired != current:
             def scale(obj):
                 obj.spec["replicas"] = desired
@@ -192,6 +212,19 @@ class HorizontalController:
             except (NotFound, Conflict):
                 return
         self._write_status(hpa, current, desired, avg_pct)
+
+    def _stabilize(self, key: str, current: int, desired: int) -> int:
+        """Record this sync's recommendation and clamp a downscale to the
+        window's maximum (stabilizeRecommendation): the workload only
+        shrinks to a size every recommendation in the window agreed on."""
+        now = self.now()
+        window = [(t, d) for t, d in self._recommendations.get(key, [])
+                  if now - t < self.stabilization_window_s]
+        window.append((now, desired))
+        self._recommendations[key] = window
+        if desired < current:
+            desired = min(current, max(d for _t, d in window))
+        return desired
 
     def _write_status(self, hpa, current: int, desired: int,
                       avg_pct: float | None) -> None:

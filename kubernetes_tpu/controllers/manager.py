@@ -24,7 +24,9 @@ class ControllerManager:
                  node_lifecycle_kwargs: dict | None = None,
                  node_ipam_kwargs: dict | None = None,
                  cloud=None, hpa_metrics=None,
-                 podgc_threshold: int | None = None):
+                 podgc_threshold: int | None = None,
+                 enable_autoscaler: bool = True,
+                 autoscaler_kwargs: dict | None = None):
         self.store = store
         self.informers: dict[str, Informer] = {
             kind: Informer(store, kind)
@@ -48,7 +50,8 @@ class ControllerManager:
             store, self.informers["StatefulSet"], pods)
         self.job = JobController(store, self.informers["Job"], pods)
         self.endpoints = EndpointController(
-            store, self.informers["Service"], pods)
+            store, self.informers["Service"], pods,
+            node_informer=self.informers["Node"])
         from kubernetes_tpu.controllers.namespace import NamespaceController
         from kubernetes_tpu.controllers.podgc import PodGCController
 
@@ -114,7 +117,7 @@ class ControllerManager:
         if enable_node_lifecycle:
             self.node_lifecycle = NodeLifecycleController(
                 store, self.informers["Node"], pods,
-                **(node_lifecycle_kwargs or {}))
+                **{"cloud": cloud, **(node_lifecycle_kwargs or {})})
             self.controllers.append(self.node_lifecycle)
             from kubernetes_tpu.controllers.taintmanager import (
                 NoExecuteTaintManager,
@@ -155,6 +158,16 @@ class ControllerManager:
             self.route = RouteController(store, cloud,
                                          self.informers["Node"])
             self.controllers.append(self.route)
+            # cluster autoscaler: only when the provider actually exposes
+            # node groups — a group-less cloud (every pre-existing test)
+            # pays nothing, not even a JAX import
+            if enable_autoscaler and cloud.node_groups():
+                from kubernetes_tpu.autoscaler import ClusterAutoscaler
+
+                self.autoscaler = ClusterAutoscaler(
+                    store, cloud, node_informer=self.informers["Node"],
+                    pod_informer=pods, **(autoscaler_kwargs or {}))
+                self.controllers.append(self.autoscaler)
 
     @property
     def synced(self) -> bool:

@@ -71,10 +71,19 @@ class NodeLifecycleController:
                  taint_based_evictions: bool = True,
                  secondary_eviction_rate: float = SECONDARY_EVICTION_RATE,
                  unhealthy_zone_threshold: float = UNHEALTHY_ZONE_THRESHOLD,
-                 large_cluster_threshold: int = LARGE_CLUSTER_THRESHOLD):
+                 large_cluster_threshold: int = LARGE_CLUSTER_THRESHOLD,
+                 cloud=None):
         self.store = store
         self.nodes = node_informer
         self.pods = pod_informer
+        # cloud-instance GC (node_controller.go:411 cloud-node existence
+        # check): a Node whose backing instance is gone — autoscaler
+        # delete_nodes, manual pool shrink — is deleted instead of sitting
+        # NotReady for the eviction timeout. Only nodes stamped with the
+        # group label (cloud-created) are eligible: membership itself
+        # vanishes with the instance, but the label survives on the Node
+        # object; unmanaged/static nodes never GC.
+        self.cloud = cloud
         self.monitor_period = monitor_period
         self.grace_period = grace_period
         self.startup_grace_period = startup_grace_period
@@ -154,6 +163,7 @@ class NodeLifecycleController:
     def monitor_once(self, now: float | None = None) -> None:
         """One monitorNodeStatus pass (exposed for tests)."""
         now = time.time() if now is None else now
+        self._gc_cloud_nodes()
         self._compute_zone_states()
         pods_on: dict[str, int] = {}
         for p in self.pods.items():
@@ -215,6 +225,31 @@ class NodeLifecycleController:
             # keep any queued eviction: a deleted Node's pods still need
             # deleting even though tracking ends here
             self._not_ready_since.pop(gone, None)
+
+    def _gc_cloud_nodes(self) -> None:
+        """Delete Node objects whose cloud instance no longer exists (the
+        cloud node lifecycle's shouldDeleteNode). Pods are NOT deleted
+        here: the node DELETED event cascades through the stranded-pods
+        path below on the next pass."""
+        if self.cloud is None:
+            return
+        from kubernetes_tpu.cloudprovider.interface import NODE_GROUP_LABEL
+
+        for node in self.nodes.items():
+            name = node.metadata.name
+            if NODE_GROUP_LABEL not in node.metadata.labels:
+                continue
+            if self.cloud.instance_exists(name):
+                continue
+            try:
+                self.store.delete("Node", name, "default")
+            except NotFound:
+                continue
+            self.events.record(
+                node, "Normal", "DeletingNode",
+                f"Node {name} no longer exists in the cloud provider")
+            log.info("node %s: cloud instance gone, deleted Node object",
+                     name)
 
     def _track_not_ready(self, name: str, when: float) -> float:
         return self._not_ready_since.setdefault(name, when)

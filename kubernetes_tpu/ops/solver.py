@@ -97,6 +97,16 @@ class BatchFlags:
                           # pre-preemption program compiles unchanged (the
                           # pass also needs a VictimTable — absent one,
                           # schedule_batch skips it at trace time regardless)
+    scale_sim: bool = False  # autoscaler probe solve: additionally emit the
+                          # per-node placed count (how many batch pods landed
+                          # on each node row) so a what-if simulation can
+                          # score hypothetical rows. Unlike every flag above
+                          # this one defaults OFF and is never derived from
+                          # batch content (packed_batch_flags leaves it
+                          # False) — real scheduling batches compile the
+                          # bit-identical pre-autoscaler program, and the
+                          # extra segment-sum is traced only into programs
+                          # the autoscaler itself requests.
 
 
 ALL_ACTIVE = BatchFlags()
@@ -277,6 +287,10 @@ class SolverResult:
     # ALL_ACTIVE programs stay field-for-field comparable.
     preempt_node: jnp.ndarray = None   # i32[P]
     victim_count: jnp.ndarray = None   # i32[P]
+    # autoscaler probe output (BatchFlags.scale_sim): batch pods placed per
+    # node row. None — an empty pytree leaf, zero HLO — on every real
+    # scheduling program; the simulator reads its hypothetical rows from it.
+    placed_per_node: jnp.ndarray = None  # i32[N]
 
 
 @struct.dataclass
@@ -796,6 +810,15 @@ def schedule_batch(
         preempt_node = jnp.full(nodes.shape, -1, jnp.int32)
         victim_count = jnp.zeros(nodes.shape, jnp.int32)
 
+    # autoscaler probe: per-node placed counts (unassigned rows scatter to
+    # row 0 but contribute 0). Off — the default — leaves the field None,
+    # so the program is the byte-identical pre-autoscaler HLO.
+    placed_per_node = None
+    if flags.scale_sim:
+        placed_per_node = jax.ops.segment_sum(
+            (nodes >= 0).astype(jnp.int32), jnp.maximum(nodes, 0),
+            num_segments=state.valid.shape[0])
+
     return SolverResult(
         assignments=nodes,
         scores=scores,
@@ -813,6 +836,7 @@ def schedule_batch(
         new_attach=final.attach_count if attach_maxes else state.attach_count,
         preempt_node=preempt_node,
         victim_count=victim_count,
+        placed_per_node=placed_per_node,
     )
 
 

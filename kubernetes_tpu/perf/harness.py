@@ -506,6 +506,83 @@ def run_chaos(n_nodes: int = 128, n_pods: int = 200, seed: int = 1234,
     return asyncio.run(_run_chaos(n_nodes, n_pods, seed, error_rate))
 
 
+@dataclass
+class AutoscalerResult:
+    """Scale-up drill: a burst of pods lands on an empty (or undersized)
+    cluster and the autoscaler must grow a node group until everything
+    binds. The headline figure is wall time from burst to all-bound
+    (scaleup_convergence_ms); the secondary one is the what-if probe cost
+    (ms/solve on the simulator's device program)."""
+
+    pods: int
+    nodes_added: int
+    group_max: int
+    seconds: float
+    scaleup_convergence_ms: float
+    sim_solves: int
+    sim_ms_per_solve: float
+
+    def __str__(self) -> str:
+        return (f"autoscaler: {self.pods} pods bound after adding "
+                f"{self.nodes_added}/{self.group_max} nodes in "
+                f"{self.seconds:.2f}s ({self.sim_solves} probe solves, "
+                f"{self.sim_ms_per_solve:.2f} ms/solve)")
+
+
+async def _run_autoscaler(n_pods: int, group_max: int,
+                          pod_cpu: str) -> AutoscalerResult:
+    from kubernetes_tpu.autoscaler import ClusterAutoscaler
+    from kubernetes_tpu.cloudprovider import FakeCloud
+
+    store = ObjectStore(watch_window=max(1 << 16, 16 * n_pods))
+    cloud = FakeCloud()
+    cloud.add_node_group("bench-pool", 0, group_max,
+                         cpu="16", memory="32Gi", pods="110")
+    num = 1 << max(6, (group_max - 1).bit_length())
+    sched = Scheduler(store, caps=Capacities(
+        num_nodes=num, batch_pods=min(1024, max(64, n_pods // 2))))
+    loop = asyncio.get_running_loop()
+    driver = loop.create_task(sched.run())
+    autoscaler = ClusterAutoscaler(
+        store, cloud,
+        caps=Capacities(num_nodes=num, batch_pods=min(256, max(64, n_pods))),
+        scan_interval=0.05, scaleup_cooldown=0.0,
+        scaledown_cooldown=3600.0, unneeded_time=3600.0,
+        max_expansion=min(8, group_max))
+    await autoscaler.start()
+
+    for pod in make_pods(n_pods, cpu=pod_cpu, memory="128Mi",
+                         name_prefix="burst"):
+        store.create(pod)
+
+    def all_bound() -> bool:
+        pods = store.list("Pod", copy_objects=False)
+        return len(pods) >= n_pods and all(p.spec.node_name for p in pods)
+
+    t0 = time.perf_counter()
+    async with asyncio.timeout(300):
+        while not all_bound():
+            await asyncio.sleep(0.02)
+    dt = time.perf_counter() - t0
+    sim = autoscaler.simulator
+    autoscaler.stop()
+    driver.cancel()
+    sched.stop()
+    return AutoscalerResult(
+        pods=n_pods, nodes_added=autoscaler.scaleups,
+        group_max=group_max, seconds=dt,
+        scaleup_convergence_ms=1e3 * dt,
+        sim_solves=sim.solve_count,
+        sim_ms_per_solve=(1e3 * sim.solve_seconds / sim.solve_count
+                          if sim.solve_count else 0.0))
+
+
+def run_autoscaler(n_pods: int = 256, group_max: int = 16,
+                   pod_cpu: str = "500m") -> AutoscalerResult:
+    """Blocking entry point for the autoscaler scale-up drill."""
+    return asyncio.run(_run_autoscaler(n_pods, group_max, pod_cpu))
+
+
 def run_throughput(
     n_nodes: int,
     n_pods: int,

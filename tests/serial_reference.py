@@ -1070,3 +1070,51 @@ class SerialScheduler:
                     verdicts[k] = (None, ())
             i = j
         return verdicts
+
+
+# ---- cluster-autoscaler probe oracles ----
+#
+# The serial twins of ScaleSimulator.probe_scale_up / probe_scale_down:
+# "do these pending pods fit after adding k clones of a template node?"
+# and "do this node's pods re-fit on the remainder after removing it?" —
+# answered by the scheduleOne loop over Python objects, so the device
+# what-if programs have a behavioral spec to randomize against.
+
+
+def fits_after_adding(nodes, assigned_pods, pending, template, k,
+                      gang_ids=None, gang_mins=None):
+    """Assignments for `pending` on `nodes` + k fresh clones of
+    `template` (named "<template>~<j>" with the hostname label updated,
+    mirroring the simulator's hypothetical rows)."""
+    clones = []
+    for j in range(k):
+        node = template.clone()
+        name = f"{node.metadata.name}~{j}"
+        node.metadata.name = name
+        node.metadata.labels["kubernetes.io/hostname"] = name
+        clones.append(node)
+    sched = SerialScheduler(list(nodes) + clones,
+                            assigned_pods=list(assigned_pods))
+    if gang_ids is not None:
+        return sched.schedule_gang(list(pending), list(gang_ids),
+                                   list(gang_mins))
+    return sched.schedule(list(pending))
+
+
+def fits_after_removing(nodes, assigned_pods, node_name):
+    """True iff every pod bound to `node_name` re-fits somewhere on the
+    remaining nodes (with all other assigned pods still charged) — the
+    drainability answer probe_scale_down computes on device. Displaced
+    pods are scheduled as unbound clones, exactly how the simulator
+    strips spec.node_name before encoding."""
+    remaining = [n for n in nodes if n.metadata.name != node_name]
+    keep, displaced = [], []
+    for pod in assigned_pods:
+        if pod.spec.node_name == node_name:
+            clone = pod.clone()
+            clone.spec.node_name = ""
+            displaced.append(clone)
+        else:
+            keep.append(pod)
+    sched = SerialScheduler(remaining, assigned_pods=keep)
+    return all(a is not None for a in sched.schedule(displaced))

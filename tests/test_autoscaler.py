@@ -1,0 +1,716 @@
+"""Cluster autoscaler: the NodeGroup SPI, the device what-if simulator
+pinned against the serial probe oracles (tests/serial_reference.py
+fits_after_adding / fits_after_removing), the scale_sim HLO pin (real
+scheduling batches compile the bit-identical pre-autoscaler program), the
+scale-up / scale-down control loops end-to-end, and the satellite hygiene
+(cloud-node GC, endpoints on node delete, HPA downscale stabilization,
+bench --smoke drift gate)."""
+
+import asyncio
+import dataclasses
+import io
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import jax
+import numpy as np
+import pytest
+
+from kubernetes_tpu.api.objects import Node, NodeGroup, Pod
+from kubernetes_tpu.apiserver import ObjectStore
+from kubernetes_tpu.apiserver.validation import ValidationError
+from kubernetes_tpu.autoscaler import (
+    DELETION_TAINT,
+    SIM_NODE_PREFIX,
+    ClusterAutoscaler,
+    ScaleSimulator,
+)
+from kubernetes_tpu.client.informer import Informer
+from kubernetes_tpu.cloudprovider import FakeCloud
+from kubernetes_tpu.cloudprovider.interface import (
+    NODE_GROUP_LABEL,
+    ZONE_LABEL,
+)
+from kubernetes_tpu.models.policy import DEFAULT_POLICY
+from kubernetes_tpu.ops.solver import batch_flags, schedule_batch
+from kubernetes_tpu.perf.fixtures import make_pods
+from kubernetes_tpu.state import Capacities, encode_cluster
+from tests.serial_reference import fits_after_adding, fits_after_removing
+
+jit_schedule = jax.jit(schedule_batch, static_argnames=("policy", "flags"))
+
+
+def mk_node(name, cpu="4", mem="8Gi", pods="110", labels=None):
+    return Node.from_dict({
+        "metadata": {"name": name, "labels": labels or {}},
+        "status": {"allocatable": {"cpu": cpu, "memory": mem, "pods": pods},
+                   "conditions": [{"type": "Ready", "status": "True"}]},
+    })
+
+
+def mk_pod(name, cpu=None, mem=None, node=None, labels=None,
+           annotations=None, priority=0):
+    c = {"name": "c"}
+    req = {}
+    if cpu:
+        req["cpu"] = cpu
+    if mem:
+        req["memory"] = mem
+    if req:
+        c["resources"] = {"requests": req}
+    spec = {"containers": [c], "priority": priority}
+    if node:
+        spec["nodeName"] = node
+    return Pod.from_dict({
+        "metadata": {"name": name, "labels": labels or {},
+                     "annotations": annotations or {}},
+        "spec": spec})
+
+
+async def until(cond, timeout=10.0):
+    async with asyncio.timeout(timeout):
+        while not cond():
+            await asyncio.sleep(0.01)
+
+
+# ---- NodeGroup SPI (fake provider) ----
+
+
+def test_fake_cloud_nodegroup_spi():
+    cloud = FakeCloud()
+    cloud.add_node_group("b-pool", 1, 4, initial=2)
+    cloud.add_node_group("a-pool", 0, 2)
+    assert cloud.node_groups() == ["a-pool", "b-pool"]
+    assert cloud.group_size_range("b-pool") == (1, 4)
+    assert cloud.target_size("b-pool") == 2
+    assert cloud.target_size("a-pool") == 0
+
+    created = cloud.increase_size("b-pool", 2)
+    assert len(created) == 2
+    for name in created:
+        assert cloud.instance_exists(name)
+        assert cloud.node_group_of(name) == "b-pool"
+    assert "scaleup:b-pool+2" in cloud.calls
+
+    # bounds are the provider's contract, not the autoscaler's courtesy
+    with pytest.raises(ValueError):
+        cloud.increase_size("b-pool", 1)  # 4+1 > max_size 4
+    with pytest.raises(ValueError):
+        cloud.increase_size("a-pool", 0)
+    with pytest.raises(ValueError):
+        cloud.delete_nodes("b-pool", ["not-a-member"])
+    with pytest.raises(ValueError):
+        cloud.add_node_group("bad", 5, 2)
+
+    cloud.delete_nodes("b-pool", created)
+    assert cloud.target_size("b-pool") == 2
+    assert not cloud.instance_exists(created[0])
+    assert any(c.startswith("scaledown:b-pool-") for c in cloud.calls)
+    # min_size floor
+    members = sorted(cloud.groups["b-pool"].members)
+    with pytest.raises(ValueError):
+        cloud.delete_nodes("b-pool", members)  # 2-2 < min_size 1
+
+
+def test_fake_cloud_zone_labels():
+    cloud = FakeCloud()
+    cloud.add_node_group("zonal", 0, 4, zone="fake-zone-c",
+                         labels={"pool-tier": "spot"})
+    cloud.add_node_group("plain", 0, 4)
+    template = cloud.template_node("zonal")
+    assert template.metadata.labels[ZONE_LABEL] == "fake-zone-c"
+    assert template.metadata.labels[NODE_GROUP_LABEL] == "zonal"
+    assert template.metadata.labels["pool-tier"] == "spot"
+    (name,) = cloud.increase_size("zonal", 1)
+    assert cloud.get_zone(name) == ("fake-zone-c", "fake-region")
+    # zone-less group falls back to the provider default zone
+    assert cloud.template_node("plain").metadata.labels[ZONE_LABEL] \
+        == "fake-zone-a"
+    (other,) = cloud.increase_size("plain", 1)
+    assert cloud.get_zone(other) == ("fake-zone-a", "fake-region")
+
+
+# ---- NodeGroup API object + kubectl ----
+
+
+def test_nodegroup_validation_rejects_bad_bounds():
+    store = ObjectStore()
+    with pytest.raises(ValidationError):
+        store.create(NodeGroup.from_dict({
+            "metadata": {"name": "bad"},
+            "spec": {"minSize": 5, "maxSize": 2}}))
+    with pytest.raises(ValidationError):
+        store.create(NodeGroup.from_dict({
+            "metadata": {"name": "bad"},
+            "spec": {"minSize": -1, "maxSize": 2}}))
+
+
+def test_kubectl_get_nodegroups():
+    from kubernetes_tpu.cli.kubectl import main
+
+    from tests.http_util import http_store
+
+    def run_cli(client, *argv):
+        out, old = io.StringIO(), sys.stdout
+        sys.stdout = out
+        try:
+            rc = main(["--server", f"http://{client.host}:{client.port}",
+                       *argv])
+        finally:
+            sys.stdout = old
+        return rc, out.getvalue()
+
+    with http_store() as (client, store):
+        store.create(NodeGroup.from_dict({
+            "metadata": {"name": "pool", "namespace": "default"},
+            "spec": {"minSize": 0, "maxSize": 5,
+                     "cloudProviderGroup": "pool"},
+            "status": {"targetSize": 3, "readyNodes": 2}}))
+        rc, out = run_cli(client, "get", "nodegroups")
+        assert rc == 0
+        lines = out.splitlines()
+        assert lines[0].split() == ["NAME", "MIN", "MAX", "TARGET",
+                                    "READY", "AGE"]
+        row = next(ln for ln in lines[1:] if ln.startswith("pool"))
+        assert row.split()[:5] == ["pool", "0", "5", "3", "2"]
+        rc, out = run_cli(client, "get", "ng")  # the short name
+        assert rc == 0 and "pool" in out
+
+
+# ---- scale_sim HLO pin ----
+
+
+def _pin_fixture():
+    caps = Capacities(num_nodes=4, batch_pods=4)
+    nodes = [mk_node(f"n{i}", cpu="2") for i in range(3)]
+    pods = [mk_pod(f"p{i}", cpu="500m", mem="256Mi") for i in range(4)]
+    state, batch, table = encode_cluster(nodes, pods, caps)
+    return state, batch, table, batch_flags(batch, len(pods), table)
+
+
+def test_scale_sim_never_derived_from_batch_content():
+    """The one flag the driver must never infer: content-derived flags
+    (the real scheduling path) leave scale_sim off, so autoscaler-off
+    deployments compile the bit-identical pre-autoscaler program."""
+    _state, _batch, _table, flags = _pin_fixture()
+    assert flags.scale_sim is False
+
+
+def test_hlo_pin_scheduling_program_unchanged_by_autoscaler():
+    state, batch, _table, flags = _pin_fixture()
+
+    def lower(f):
+        return jit_schedule.lower(state, batch, 0, DEFAULT_POLICY,
+                                  flags=f).as_text()
+
+    off = lower(flags)
+    explicit_off = lower(dataclasses.replace(flags, scale_sim=False))
+    on = lower(dataclasses.replace(flags, scale_sim=True))
+    assert off == explicit_off  # the scheduling program is pinned
+    assert on != off            # probes really compile a different program
+
+
+def test_placed_per_node_only_emitted_under_scale_sim():
+    state, batch, _table, flags = _pin_fixture()
+    res_off = jit_schedule(state, batch, 0, DEFAULT_POLICY, flags=flags)
+    assert res_off.placed_per_node is None
+    res_on = jit_schedule(
+        state, batch, 0, DEFAULT_POLICY,
+        flags=dataclasses.replace(flags, scale_sim=True))
+    assignments = np.asarray(res_on.assignments)
+    np.testing.assert_array_equal(assignments,
+                                  np.asarray(res_off.assignments))
+    placed = np.asarray(res_on.placed_per_node)
+    want = np.zeros(placed.shape[0], np.int32)
+    for a in assignments[:4]:
+        if a >= 0:
+            want[a] += 1
+    np.testing.assert_array_equal(placed, want)
+
+
+# ---- probe-solve parity against the serial oracles ----
+
+
+@pytest.mark.parametrize("seed", range(5))
+def test_probe_scale_up_parity_random(seed):
+    rng = np.random.RandomState(seed)
+    existing = [mk_node(f"n{i}", cpu=f"{rng.randint(2, 5)}",
+                        mem=f"{rng.randint(4, 9)}Gi",
+                        pods=str(rng.randint(3, 8)))
+                for i in range(rng.randint(0, 3))]
+    template = mk_node("tmpl", cpu="4", mem="8Gi", pods="6",
+                       labels={"kubernetes.io/hostname": "tmpl"})
+    pods = [mk_pod(f"p{i}", cpu=f"{rng.choice([500, 1000, 1500, 2500])}m",
+                   mem=f"{rng.choice([256, 512, 1024])}Mi")
+            for i in range(rng.randint(4, 12))]
+    k = int(rng.randint(1, 5))
+
+    sim = ScaleSimulator(caps=Capacities(num_nodes=16, batch_pods=16))
+    for node in existing:
+        sim.upsert_node(node)
+    baseline = sim.baseline_placed(pods)
+    probe = sim.probe_scale_up(pods, template, k)
+
+    oracle_0 = fits_after_adding(existing, [], pods, template, 0)
+    oracle_k = fits_after_adding(existing, [], pods, template, k)
+    assert baseline == sum(a is not None for a in oracle_0)
+    assert [int(a) >= 0 for a in probe.assignments] \
+        == [a is not None for a in oracle_k]
+    assert probe.newly_placed == \
+        sum(a is not None for a in oracle_k) - baseline
+    # hypothetical rows never leak into the persistent mirror
+    assert not any(name.startswith(SIM_NODE_PREFIX)
+                   for name in sim.statedb.table.row_of)
+
+
+@pytest.mark.parametrize("seed", range(5))
+def test_probe_scale_down_parity_random(seed):
+    rng = np.random.RandomState(seed)
+    nodes = [mk_node(f"n{i}", cpu="4", mem="8Gi", pods="10")
+             for i in range(4)]
+    sim = ScaleSimulator(caps=Capacities(num_nodes=8, batch_pods=16))
+    for node in nodes:
+        sim.upsert_node(node)
+    assigned = []
+    for i in range(rng.randint(4, 10)):
+        pod = mk_pod(f"b{i}", cpu=f"{rng.choice([500, 1000, 2000])}m",
+                     mem=f"{rng.choice([512, 1024, 2048])}Mi",
+                     node=f"n{rng.randint(0, 4)}")
+        if sim.add_pod(pod):
+            assigned.append(pod)
+    victim = nodes[int(rng.randint(0, 4))]
+    victim_pods = [p for p in assigned
+                   if p.spec.node_name == victim.metadata.name]
+
+    got = sim.probe_scale_down(victim, victim_pods)
+    want = fits_after_removing(nodes, assigned, victim.metadata.name)
+    assert got == want
+    # the what-if fully reverts: same question, same answer, node intact
+    assert sim.has_node(victim.metadata.name)
+    assert sim.probe_scale_down(victim, victim_pods) == got
+
+
+def test_probe_gang_all_or_nothing():
+    """An oversized gang must probe as a unit: offering fewer nodes than
+    its quorum needs places nothing (no phantom partial placements the
+    real scheduler would refuse)."""
+    sim = ScaleSimulator(caps=Capacities(num_nodes=8, batch_pods=8))
+    template = mk_node("tmpl", cpu="4", mem="8Gi")
+    gang = make_pods(4, cpu="3", memory="256Mi", name_prefix="g",
+                     gang_size=4)
+    short = sim.probe_scale_up(gang, template, 2)
+    assert short is not None and short.newly_placed == 0
+    full = sim.probe_scale_up(gang, template, 4)
+    assert full.newly_placed == 4 and full.used_nodes == 4
+
+
+def test_probe_scale_up_rejects_over_capacity():
+    sim = ScaleSimulator(caps=Capacities(num_nodes=4, batch_pods=8))
+    for i in range(3):
+        sim.upsert_node(mk_node(f"n{i}"))
+    probe = sim.probe_scale_up([mk_pod("p0", cpu="1")],
+                               mk_node("tmpl"), 4)
+    assert probe is None  # 3 real + 4 hypothetical rows > num_nodes 4
+    assert not any(name.startswith(SIM_NODE_PREFIX)
+                   for name in sim.statedb.table.row_of)
+
+
+# ---- autoscaler control loop ----
+
+
+SMALL_CAPS = Capacities(num_nodes=16, batch_pods=16)
+
+
+class _Env:
+    """ClusterAutoscaler on manually-driven informers: tests call
+    run_once() against an injectable clock instead of racing the loop."""
+
+    def __init__(self, store, cloud, **kw):
+        self.store = store
+        self.clock = [0.0]
+        self.nodes = Informer(store, "Node")
+        self.pods = Informer(store, "Pod")
+        kw.setdefault("caps", SMALL_CAPS)
+        kw.setdefault("unneeded_time", 30.0)
+        kw.setdefault("scaledown_cooldown", 0.0)
+        self.autoscaler = ClusterAutoscaler(
+            store, cloud, node_informer=self.nodes,
+            pod_informer=self.pods, now=lambda: self.clock[0], **kw)
+
+    async def start(self):
+        self.nodes.start()
+        self.pods.start()
+        await self.nodes.wait_for_sync()
+        await self.pods.wait_for_sync()
+        return self
+
+    def stop(self):
+        self.nodes.stop()
+        self.pods.stop()
+
+
+def _register_members(store, cloud, group):
+    for name in sorted(cloud.groups[group].members):
+        node = cloud.template_node(group).clone()
+        node.metadata.name = name
+        node.metadata.labels["kubernetes.io/hostname"] = name
+        store.create(node)
+
+
+def test_scale_up_respects_max_size_and_cooldown():
+    async def run():
+        store = ObjectStore()
+        cloud = FakeCloud()
+        cloud.add_node_group("tiny", 0, 2, zone="zone-x")
+        env = await _Env(store, cloud, scaleup_cooldown=30.0).start()
+        try:
+            for pod in make_pods(6, cpu="3", memory="256Mi",
+                                 name_prefix="want"):
+                store.create(pod)
+            await until(lambda: len(list(env.pods.items())) == 6)
+            env.autoscaler.run_once()
+            scaleups = [c for c in cloud.calls if c.startswith("scaleup")]
+            assert scaleups == ["scaleup:tiny+2"]  # capped by max_size
+            assert cloud.target_size("tiny") == 2
+
+            # created instances materialize as Nodes with the group's
+            # zone label (no kubelet registers them in this control plane)
+            await until(lambda: len(list(env.nodes.items())) == 2)
+            for node in env.nodes.items():
+                assert node.metadata.labels[ZONE_LABEL] == "zone-x"
+                assert node.metadata.labels[NODE_GROUP_LABEL] == "tiny"
+                assert node.metadata.labels["kubernetes.io/hostname"] \
+                    == node.metadata.name
+
+            # still 4 pending pods, but no headroom and a hot cooldown:
+            # repeated passes must not touch the cloud again
+            env.autoscaler.run_once()
+            env.clock[0] = 100.0
+            env.autoscaler.run_once()
+            assert [c for c in cloud.calls
+                    if c.startswith("scaleup")] == scaleups
+
+            group = store.get("NodeGroup", "tiny", "default")
+            assert group.spec["maxSize"] == 2
+            assert group.status["targetSize"] == 2
+        finally:
+            env.stop()
+
+    asyncio.run(run())
+
+
+def test_scale_down_drains_idle_node_two_phase():
+    async def run():
+        store = ObjectStore()
+        cloud = FakeCloud()
+        cloud.add_node_group("pool", 0, 4, initial=2)
+        _register_members(store, cloud, "pool")
+        busy, idle = sorted(cloud.groups["pool"].members)
+        store.create(mk_pod("heavy", cpu="3", node=busy))
+        env = await _Env(store, cloud).start()
+        a = env.autoscaler
+        try:
+            a.run_once()  # starts the unneeded dwell for the idle node
+            assert not a._draining
+            env.clock[0] = 31.0
+            a.run_once()  # dwell elapsed: verify + cordon (phase 1)
+            assert a._draining == {idle: "pool"}
+            await until(lambda: env.nodes.get(idle).spec.unschedulable)
+            node = store.get("Node", idle, "default")
+            assert any(t.key == DELETION_TAINT for t in node.spec.taints)
+
+            env.clock[0] = 32.0
+            a.run_once()  # phase 2: re-verify, drain, delete
+            await until(lambda: env.nodes.get(idle) is None)
+            assert cloud.groups["pool"].members == {busy}
+            assert not cloud.instance_exists(idle)
+            assert f"scaledown:pool-{idle}" in cloud.calls
+            assert a.scaledowns == 1 and a.rollbacks == 0
+            # the loaded node was never a candidate (utilization 0.75)
+            assert store.get("Node", busy, "default").spec.unschedulable \
+                is False
+        finally:
+            env.stop()
+
+    asyncio.run(run())
+
+
+def test_scale_down_skips_pdb_gang_and_priority_pods():
+    from kubernetes_tpu.api.objects import PodDisruptionBudget
+    from kubernetes_tpu.gang import (
+        GROUP_MIN_ANNOTATION,
+        GROUP_NAME_ANNOTATION,
+    )
+
+    async def run():
+        store = ObjectStore()
+        cloud = FakeCloud()
+        cloud.add_node_group("pool", 0, 4, initial=3)
+        _register_members(store, cloud, "pool")
+        n_pdb, n_gang, n_prio = sorted(cloud.groups["pool"].members)
+        # a PDB with never-synced status allows zero disruptions
+        store.create(PodDisruptionBudget.from_dict({
+            "metadata": {"name": "guard", "namespace": "default"},
+            "spec": {"minAvailable": 1,
+                     "selector": {"matchLabels": {"app": "guarded"}}}}))
+        store.create(mk_pod("guarded", cpu="100m", node=n_pdb,
+                            labels={"app": "guarded"}))
+        store.create(mk_pod("member", cpu="100m", node=n_gang,
+                            annotations={GROUP_NAME_ANNOTATION: "ring",
+                                         GROUP_MIN_ANNOTATION: "1"}))
+        store.create(mk_pod("vip", cpu="100m", node=n_prio, priority=5))
+        env = await _Env(store, cloud).start()
+        a = env.autoscaler
+        try:
+            a.run_once()
+            env.clock[0] = 31.0
+            a.run_once()
+            env.clock[0] = 60.0
+            a.run_once()
+            # every node is underutilized and past the dwell, but each
+            # hosts a pod the drain gate must refuse
+            assert a._draining == {} and a.scaledowns == 0
+            for name in (n_pdb, n_gang, n_prio):
+                assert store.get("Node", name, "default") \
+                    .spec.unschedulable is False
+            assert not any(c.startswith("scaledown") for c in cloud.calls)
+        finally:
+            env.stop()
+
+    asyncio.run(run())
+
+
+def test_scale_down_rolls_back_stale_what_if():
+    async def run():
+        store = ObjectStore()
+        cloud = FakeCloud()
+        cloud.add_node_group("pool", 0, 4, initial=2)
+        _register_members(store, cloud, "pool")
+        busy, idle = sorted(cloud.groups["pool"].members)
+        store.create(mk_pod("heavy", cpu="3", node=busy))
+        store.create(mk_pod("small", cpu="100m", node=idle))
+        env = await _Env(store, cloud).start()
+        a = env.autoscaler
+        try:
+            a.run_once()
+            env.clock[0] = 31.0
+            a.run_once()
+            assert a._draining == {idle: "pool"}
+            # the what-if goes stale between cordon and drain: a pod lands
+            # on the cordoned node that cannot re-fit on the remainder
+            # (3.5 cpu asked, only 1 free on the other node)
+            store.create(mk_pod("late", cpu="3500m", node=idle))
+            await until(lambda: env.pods.get("late") is not None)
+            env.clock[0] = 32.0
+            a.run_once()
+            assert a.rollbacks == 1 and a.scaledowns == 0
+            node = store.get("Node", idle, "default")
+            assert node.spec.unschedulable is False
+            assert not any(t.key == DELETION_TAINT
+                           for t in node.spec.taints)
+            assert cloud.groups["pool"].members == {busy, idle}
+            assert not any(c.startswith("scaledown") for c in cloud.calls)
+        finally:
+            env.stop()
+
+    asyncio.run(run())
+
+
+def test_e2e_burst_scales_up_until_everything_binds():
+    """The acceptance drill: a burst of unschedulable pods — including a
+    gang too big for the (empty) cluster — drives scale-up through the
+    SPI and every pod ends up bound by the real scheduler."""
+    from kubernetes_tpu.scheduler import Scheduler
+
+    async def run():
+        store = ObjectStore()
+        cloud = FakeCloud()
+        cloud.add_node_group("pool", 0, 8, zone="zone-b")
+        sched = Scheduler(store, caps=Capacities(num_nodes=16,
+                                                 batch_pods=24))
+        driver = asyncio.get_running_loop().create_task(sched.run())
+        autoscaler = ClusterAutoscaler(
+            store, cloud, caps=Capacities(num_nodes=16, batch_pods=24),
+            scan_interval=0.05, scaleup_cooldown=0.1,
+            scaledown_cooldown=3600.0, unneeded_time=3600.0)
+        await autoscaler.start()
+        try:
+            for pod in make_pods(12, cpu="500m", memory="128Mi",
+                                 name_prefix="burst"):
+                store.create(pod)
+            for pod in make_pods(4, cpu="3", memory="256Mi",
+                                 name_prefix="ring", gang_size=4):
+                store.create(pod)
+
+            def all_bound():
+                pods = store.list("Pod", copy_objects=False)
+                return len(pods) == 16 and \
+                    all(p.spec.node_name for p in pods)
+
+            async with asyncio.timeout(120):
+                while not all_bound():
+                    await asyncio.sleep(0.05)
+
+            nodes = store.list("Node", copy_objects=False)
+            assert 0 < len(nodes) <= 8
+            assert autoscaler.scaleups == len(nodes)
+            for node in nodes:
+                assert cloud.instance_exists(node.metadata.name)
+                assert node.metadata.labels[ZONE_LABEL] == "zone-b"
+                assert node.metadata.labels[NODE_GROUP_LABEL] == "pool"
+            # the gang landed whole
+            gang_nodes = [p.spec.node_name
+                          for p in store.list("Pod", copy_objects=False)
+                          if p.metadata.name.startswith("ring")]
+            assert len(gang_nodes) == 4 and all(gang_nodes)
+            group = store.get("NodeGroup", "pool", "default")
+            assert group.status["targetSize"] == len(nodes)
+            assert autoscaler.simulator.solve_count > 0
+        finally:
+            autoscaler.stop()
+            driver.cancel()
+            sched.stop()
+
+    asyncio.run(run())
+
+
+# ---- satellite: cloud-instance GC in the node lifecycle ----
+
+
+def test_node_lifecycle_gcs_deprovisioned_cloud_nodes():
+    from kubernetes_tpu.controllers.nodelifecycle import (
+        NodeLifecycleController,
+    )
+
+    async def run():
+        store = ObjectStore()
+        cloud = FakeCloud()
+        cloud.add_node_group("pool", 0, 4, initial=2)
+        _register_members(store, cloud, "pool")
+        keep, gone = sorted(cloud.groups["pool"].members)
+        cloud.delete_nodes("pool", [gone])  # deprovisioned cloud-side
+        # an unmanaged node with no cloud instance must never be GC'd
+        store.create(mk_node("static"))
+        nodes = Informer(store, "Node")
+        pods = Informer(store, "Pod")
+        lifecycle = NodeLifecycleController(store, nodes, pods,
+                                            cloud=cloud)
+        nodes.start()
+        pods.start()
+        await nodes.wait_for_sync()
+        await pods.wait_for_sync()
+        try:
+            lifecycle.monitor_once()
+            names = {n.metadata.name
+                     for n in store.list("Node", copy_objects=False)}
+            assert gone not in names
+            assert {keep, "static"} <= names
+        finally:
+            nodes.stop()
+            pods.stop()
+
+    asyncio.run(run())
+
+
+# ---- satellite: endpoints drop deleted-node pods promptly ----
+
+
+def test_endpoints_drop_pods_on_deleted_node():
+    from kubernetes_tpu.api.objects import Service
+    from kubernetes_tpu.controllers import ControllerManager
+
+    async def run():
+        store = ObjectStore()
+        mgr = ControllerManager(store, enable_gc=False,
+                                enable_node_lifecycle=False)
+        await mgr.start()
+        try:
+            store.create(mk_node("ep-n0"))
+            store.create(Service.from_dict({
+                "metadata": {"name": "web", "namespace": "default"},
+                "spec": {"selector": {"app": "web"},
+                         "ports": [{"port": 80}]}}))
+            pod = mk_pod("w0", cpu="100m", node="ep-n0",
+                         labels={"app": "web"})
+            pod.status.phase = "Running"
+            pod.status.conditions = [{"type": "Ready", "status": "True"}]
+            store.create(pod)
+
+            def addresses():
+                try:
+                    ep = store.get("Endpoints", "web", "default")
+                except Exception:
+                    return []
+                return [a for s in ep.subsets
+                        for a in s.get("addresses", [])]
+
+            await until(lambda: len(addresses()) == 1)
+            # the node goes away: its pod object lingers, but the backend
+            # machine is gone — the address must drop now, not when the
+            # lifecycle controller finally evicts the pod
+            store.delete("Node", "ep-n0", "default")
+            await until(lambda: addresses() == [])
+            assert store.get("Pod", "w0", "default") is not None
+        finally:
+            mgr.stop()
+
+    asyncio.run(run())
+
+
+# ---- satellite: HPA downscale stabilization ----
+
+
+def test_hpa_downscale_stabilization_window():
+    from kubernetes_tpu.controllers.hpa import (
+        HorizontalController,
+        StaticMetrics,
+    )
+
+    store = ObjectStore()
+    hc = HorizontalController(store,
+                              Informer(store, "HorizontalPodAutoscaler"),
+                              Informer(store, "Pod"), StaticMetrics(0.5))
+    clock = [1000.0]
+    hc.now = lambda: clock[0]
+    key = "default/web"
+    assert hc._stabilize(key, 4, 6) == 6   # scale-up applies immediately
+    assert hc._stabilize(key, 6, 2) == 6   # held by the recent 6
+    clock[0] += 150.0
+    assert hc._stabilize(key, 6, 2) == 6   # still inside the window
+    clock[0] += 200.0                      # the 6 recommendation expires
+    assert hc._stabilize(key, 6, 2) == 2   # low held for the full window
+    # a downscale never overshoots current replicas upward
+    assert hc._stabilize(key, 3, 2) == 2
+
+
+# ---- satellite: bench --smoke drift gate ----
+
+
+def test_bench_smoke_mode():
+    """bench.py --smoke must stay runnable end-to-end (including the
+    autoscaler config): config drift breaks this test, not a nightly."""
+    repo = Path(__file__).resolve().parents[1]
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    # trim to the headline + the new autoscaler config for CI runtime
+    env["BENCH_CONFIGS"] = "headline,autoscaler"
+    env["BENCH_NODES"] = "64"
+    env["BENCH_PODS"] = "128"
+    env["BENCH_AUTOSCALER_PODS"] = "32"
+    env["BENCH_AUTOSCALER_GROUP_MAX"] = "4"
+    proc = subprocess.run(
+        [sys.executable, "bench.py", "--smoke"], cwd=repo, env=env,
+        capture_output=True, text=True, timeout=420)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    line = [ln for ln in proc.stdout.splitlines() if ln.strip()][-1]
+    result = json.loads(line)
+    assert "error" not in result, result
+    extras = result["extras"]
+    assert result["value"] is not None
+    assert extras["scaleup_convergence_ms"] > 0
+    assert extras["autoscaler_nodes_added"] >= 1
+    assert extras["autoscaler_sim_solves"] >= 1
+    assert extras["autoscaler_sim_ms_per_solve"] > 0
