@@ -226,17 +226,32 @@ def main() -> None:
         # twin; this row records the recovery figure on real hardware)
         chaos_nodes = int(os.environ.get("BENCH_CHAOS_NODES", "128"))
         chaos_seed = int(os.environ.get("BENCH_CHAOS_SEED", "1234"))
+        # --with-race-detector: run the same drill under the RaceDetector
+        # store proxy + event-loop stall watchdog (testing/races.py) and
+        # fail the row on any racy write or >100ms stall — the runtime
+        # half of the ktpu-lint contract, on real hardware
+        race_detect = "--with-race-detector" in sys.argv[1:] or \
+            os.environ.get("BENCH_RACE_DETECTOR", "") in ("1", "true")
         r = run_chaos(chaos_nodes, n_pods=max(200, 2 * chaos_nodes),
-                      seed=chaos_seed)
+                      seed=chaos_seed, race_detect=race_detect)
         print(f"bench[chaos]: {r}", file=sys.stderr, flush=True)
         extras["chaos_recovery_ms"] = round(r.recovery_ms, 1)
         extras["chaos_faults_injected"] = r.faults_injected
         extras["chaos_seed"] = r.seed
+        if race_detect:
+            extras["chaos_racy_writes"] = r.racy_writes
+            extras["chaos_loop_stalls"] = r.loop_stalls
+            extras["chaos_max_stall_ms"] = round(r.max_stall_ms, 1)
         if not r.converged:
             RESULT["error"] = (
                 f"chaos drill did not converge (seed {r.seed}): "
                 f"{r.bound}/{r.pods} bound, "
                 f"{r.double_binds} double-binds")
+        elif race_detect and (r.racy_writes or r.loop_stalls):
+            RESULT["error"] = (
+                f"chaos drill under race detector (seed {r.seed}): "
+                f"{r.racy_writes} racy writes, {r.loop_stalls} event-loop "
+                f"stalls (max {r.max_stall_ms:.0f}ms)")
 
     if "autoscaler" in configs:
         from kubernetes_tpu.perf.harness import run_autoscaler

@@ -76,6 +76,8 @@ def bootstrap_node_cert(client, node_name: str, workdir: str,
             with open(cert_file, "wb") as f:
                 f.write(base64.b64decode(cert_b64))
             return cert_file, key_file
-        time.sleep(poll)
+        # bootstrap runs before the kubelet has a loop: a plain blocking
+        # poll on the caller's (bootstrap) thread
+        time.sleep(poll)  # ktpu: allow[blocking-in-async]
     raise TimeoutError(
         f"CSR {name}: no certificate issued within {timeout}s")

@@ -1,0 +1,15 @@
+"""ktpu-lint: AST invariant checks for the control plane and solver.
+
+`python -m kubernetes_tpu.analysis --strict` is the CI gate
+(tests/test_lint.py runs it over the whole tree in tier-1); see
+analysis/lint.py for the engine and analysis/rules.py for the catalog.
+"""
+
+from kubernetes_tpu.analysis.lint import (  # noqa: F401
+    AnalysisResult,
+    Finding,
+    lint_source,
+    load_baseline,
+    run_analysis,
+)
+from kubernetes_tpu.analysis.rules import RULE_NAMES, RULES  # noqa: F401

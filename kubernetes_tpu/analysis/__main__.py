@@ -1,0 +1,70 @@
+"""CLI: python -m kubernetes_tpu.analysis [--strict] [--json] [paths...]
+
+Exit codes: 0 clean (or informational run), 1 new findings under
+--strict, 2 usage error. `--no-baseline` shows the whole debt;
+`--rules r1,r2` narrows the catalog (names as in rules.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from kubernetes_tpu.analysis.lint import run_analysis
+from kubernetes_tpu.analysis.rules import RULE_NAMES, RULES
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m kubernetes_tpu.analysis",
+        description="ktpu-lint: AST invariant checks (event-loop purity, "
+                    "trace purity, BatchFlags discipline, determinism, "
+                    "store write discipline)")
+    p.add_argument("paths", nargs="*",
+                   help="files/dirs to lint (default: the whole package)")
+    p.add_argument("--strict", action="store_true",
+                   help="exit 1 on any new (non-baselined) finding")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="machine-readable output")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="ignore analysis/baseline.txt (show all findings)")
+    p.add_argument("--rules", default="",
+                   help="comma list of rule names to run (default: all)")
+    args = p.parse_args(argv)
+
+    rules = RULES
+    if args.rules:
+        wanted = {r.strip() for r in args.rules.split(",") if r.strip()}
+        unknown = wanted - RULE_NAMES
+        if unknown:
+            print(f"unknown rules: {sorted(unknown)} "
+                  f"(have: {sorted(RULE_NAMES)})", file=sys.stderr)
+            return 2
+        rules = [r for r in RULES if r.name in wanted]
+
+    result = run_analysis(args.paths or None, rules=rules,
+                          use_baseline=not args.no_baseline)
+
+    if args.as_json:
+        print(json.dumps({
+            "findings": [f.as_dict() for f in result.findings],
+            "baselined": [f.as_dict() for f in result.baselined],
+            "suppressed_inline": result.suppressed,
+            "modules": result.modules,
+            "stale_baseline": result.stale_baseline,
+        }, indent=2))
+    else:
+        for f in result.findings:
+            print(f.render())
+        for note in result.stale_baseline:
+            print(f"stale baseline: {note}", file=sys.stderr)
+        print(f"ktpu-lint: {result.modules} modules, "
+              f"{len(result.findings)} new finding(s), "
+              f"{len(result.baselined)} baselined, "
+              f"{result.suppressed} suppressed inline", file=sys.stderr)
+    return 1 if (args.strict and result.findings) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

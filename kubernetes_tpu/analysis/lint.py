@@ -1,0 +1,240 @@
+"""ktpu-lint: the AST invariant engine.
+
+The hack/verify-* position of the reference build (golint/go-vet gates
+that run before any test does), turned inward: the invariants this
+codebase actually rests on — event-loop purity, trace purity of the
+jit-compiled solver kernels, BatchFlags gate discipline, seeded
+determinism, store write discipline — encoded as AST rules over every
+first-party module, so the Round-6 driver refactors land against a
+machine-checked contract instead of reviewer memory.
+
+Mechanics:
+
+- `run_analysis()` walks `kubernetes_tpu/` (skipping __pycache__ and
+  generated trees), parses each module once, and runs every registered
+  rule over it.
+- A finding on line L is suppressed by ``# ktpu: allow[rule]`` on line L
+  or L-1 (``allow[all]`` silences every rule). Suppressions are the
+  reviewed escape hatch: the comment sits next to the code it excuses.
+- `analysis/baseline.txt` grandfathers pre-existing findings as
+  ``rule<SP>path<SP>count`` ratchet lines: strict mode fails only when a
+  (rule, path) pair exceeds its baselined count, so new code adds zero
+  findings while old debt is paid down file by file.
+
+Rules live in `analysis/rules.py`; the CLI in `analysis/__main__.py`.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass, field
+
+PKG_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+REPO_ROOT = os.path.dirname(PKG_DIR)
+BASELINE_PATH = os.path.join(PKG_DIR, "analysis", "baseline.txt")
+
+# trees never linted: bytecode caches, generated wire code, C build output
+SKIP_DIRS = {"__pycache__", "_wiregen", "_build"}
+
+_ALLOW_RE = re.compile(r"ktpu:\s*allow\[([A-Za-z0-9_,\- ]+)\]")
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str      # repo-relative, forward slashes
+    line: int
+    col: int
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: [{self.rule}] " \
+               f"{self.message}"
+
+    def as_dict(self) -> dict:
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "col": self.col, "message": self.message}
+
+
+class Module:
+    """One parsed first-party module plus the name-resolution maps the
+    rules share (import aliases, so `_time.sleep` and `from time import
+    sleep as zzz` both resolve to `time.sleep`)."""
+
+    def __init__(self, path: str, relpath: str, source: str):
+        self.path = path
+        self.relpath = relpath.replace(os.sep, "/")
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=relpath)
+        self.module_aliases: dict[str, str] = {}
+        self.name_imports: dict[str, str] = {}
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    local = alias.asname or alias.name.split(".")[0]
+                    self.module_aliases[local] = (
+                        alias.name if alias.asname else alias.name.split(".")[0])
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                for alias in node.names:
+                    self.name_imports[alias.asname or alias.name] = \
+                        f"{node.module}.{alias.name}"
+
+    # ---- name resolution ----
+
+    def dotted(self, node: ast.expr) -> list[str] | None:
+        """['a', 'b', 'c'] for the expression a.b.c, else None."""
+        parts: list[str] = []
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if isinstance(node, ast.Name):
+            parts.append(node.id)
+            parts.reverse()
+            return parts
+        return None
+
+    def resolve(self, func: ast.expr) -> str | None:
+        """Dotted name of a call target with import aliases unwound:
+        `_time.sleep` -> 'time.sleep', bare `sleep` imported from time ->
+        'time.sleep'. Attribute chains rooted in non-names (e.g.
+        `self._rng.random`) resolve to their literal spelling."""
+        parts = self.dotted(func)
+        if not parts:
+            return None
+        head = parts[0]
+        if head in self.module_aliases:
+            return ".".join([self.module_aliases[head]] + parts[1:])
+        if head in self.name_imports:
+            return ".".join([self.name_imports[head]] + parts[1:])
+        return ".".join(parts)
+
+    def allowed(self, rule: str, line: int) -> bool:
+        """True when line `line` (1-based) or the line above carries a
+        `# ktpu: allow[rule]` suppression for this rule."""
+        for idx in (line - 1, line - 2):
+            if 0 <= idx < len(self.lines):
+                m = _ALLOW_RE.search(self.lines[idx])
+                if m:
+                    rules = {r.strip() for r in m.group(1).split(",")}
+                    if rule in rules or "all" in rules:
+                        return True
+        return False
+
+
+@dataclass
+class AnalysisResult:
+    findings: list[Finding] = field(default_factory=list)   # new (gating)
+    baselined: list[Finding] = field(default_factory=list)
+    suppressed: int = 0       # inline allow[...] count
+    modules: int = 0
+    stale_baseline: list[str] = field(default_factory=list)
+
+    @property
+    def clean(self) -> bool:
+        return not self.findings
+
+
+def iter_module_paths(root: str | None = None) -> list[tuple[str, str]]:
+    """(abspath, repo-relative path) for every first-party module under
+    `root` (default: the kubernetes_tpu package)."""
+    root = root or PKG_DIR
+    root = os.path.abspath(root)
+    if os.path.isfile(root):
+        return [(root, os.path.relpath(root, REPO_ROOT))]
+    out = []
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = sorted(d for d in dirnames if d not in SKIP_DIRS)
+        for name in sorted(filenames):
+            if name.endswith(".py"):
+                path = os.path.join(dirpath, name)
+                out.append((path, os.path.relpath(path, REPO_ROOT)))
+    return out
+
+
+def load_baseline(path: str | None = None) -> dict[tuple[str, str], int]:
+    """`rule path count` ratchet lines -> {(rule, path): count}."""
+    path = path or BASELINE_PATH
+    baseline: dict[tuple[str, str], int] = {}
+    if not os.path.exists(path):
+        return baseline
+    with open(path, encoding="utf-8") as f:
+        for raw in f:
+            line = raw.split("#", 1)[0].strip()
+            if not line:
+                continue
+            parts = line.split()
+            if len(parts) != 3:
+                raise ValueError(f"baseline.txt: bad line {raw!r} "
+                                 "(want: rule path count)")
+            baseline[(parts[0], parts[1])] = int(parts[2])
+    return baseline
+
+
+def lint_module(mod: Module, rules=None) -> tuple[list[Finding], int]:
+    """All unsuppressed findings for one module + the inline-suppressed
+    count. Rule exceptions become findings themselves (a broken rule must
+    fail loudly in CI, not silently stop checking)."""
+    from kubernetes_tpu.analysis.rules import RULES
+
+    findings: list[Finding] = []
+    suppressed = 0
+    for rule in (rules if rules is not None else RULES):
+        try:
+            produced = list(rule.check(mod))
+        except Exception as exc:  # pragma: no cover - rule bug surface
+            findings.append(Finding(rule.name, mod.relpath, 1, 0,
+                                    f"rule crashed: {exc!r}"))
+            continue
+        for f in produced:
+            if mod.allowed(f.rule, f.line):
+                suppressed += 1
+            else:
+                findings.append(f)
+    return findings, suppressed
+
+
+def lint_source(source: str, relpath: str = "fixture.py",
+                rules=None) -> list[Finding]:
+    """Lint an in-memory snippet (the fixture-test entry point)."""
+    mod = Module(relpath, relpath, source)
+    findings, _ = lint_module(mod, rules=rules)
+    return findings
+
+
+def run_analysis(paths: list[str] | None = None, *,
+                 rules=None,
+                 baseline: dict | None = None,
+                 use_baseline: bool = True) -> AnalysisResult:
+    """Lint every module under `paths` (default: the whole package) and
+    split findings into new-vs-baselined. The ratchet: per (rule, path),
+    the first `count` findings ride the baseline, any excess is new."""
+    if baseline is None:
+        baseline = load_baseline() if use_baseline else {}
+    result = AnalysisResult()
+    module_paths: list[tuple[str, str]] = []
+    for p in (paths or [PKG_DIR]):
+        module_paths.extend(iter_module_paths(p))
+    seen_counts: dict[tuple[str, str], int] = {}
+    for path, relpath in module_paths:
+        with open(path, encoding="utf-8") as f:
+            source = f.read()
+        mod = Module(path, relpath.replace(os.sep, "/"), source)
+        result.modules += 1
+        findings, suppressed = lint_module(mod, rules=rules)
+        result.suppressed += suppressed
+        for f in sorted(findings, key=lambda f: (f.line, f.col)):
+            key = (f.rule, f.path)
+            seen_counts[key] = seen_counts.get(key, 0) + 1
+            if seen_counts[key] <= baseline.get(key, 0):
+                result.baselined.append(f)
+            else:
+                result.findings.append(f)
+    for (rule, path), count in sorted(baseline.items()):
+        if seen_counts.get((rule, path), 0) < count:
+            result.stale_baseline.append(
+                f"{rule} {path}: baseline grants {count}, found "
+                f"{seen_counts.get((rule, path), 0)} — ratchet it down")
+    return result

@@ -1,0 +1,546 @@
+"""The ktpu-lint rule catalog: five invariants the codebase rests on.
+
+R1 blocking-in-async   — event-loop purity: no blocking call reachable on
+                         the asyncio loop (the PR-2 webhook-SAR bug class).
+R2 trace-impure        — jit-kernel purity: no host sync / wall clock /
+                         Python control flow on traced values inside the
+                         solver kernels (guards the HLO-pin invariant).
+R3 batchflags-gate     — BatchFlags discipline: every flag pinned by a
+                         gating-parity test, and no flag computed from
+                         batch content outside the sanctioned gate fns.
+R4 nondeterminism      — seeded replay: no ambient RNG / wall clock in the
+                         solve path (the FaultPlane seed-replay contract).
+R5 store-rmw           — write discipline: read-modify-write must carry a
+                         resourceVersion precondition or ride the
+                         sanctioned CAS helpers (the lost-update class).
+
+Each rule is a small class with a `name` and `check(Module) -> [Finding]`.
+Heuristics err toward precision: a rule that cries wolf gets suppressed
+wholesale and protects nothing. The runtime complement (what static
+analysis cannot see: actual interleavings, actual stalls) lives in
+`kubernetes_tpu/testing/races.py`.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+
+from kubernetes_tpu.analysis.lint import (
+    Finding,
+    Module,
+    PKG_DIR,
+    REPO_ROOT,
+)
+
+# ---------------------------------------------------------------------------
+# R1: event-loop purity
+
+
+# Calls that park the calling thread. Inside `async def` that thread owns
+# the event loop: every timer, watch stream and server on it freezes (the
+# webhook-SAR bug PR 2 fixed by hand — now a machine-checked class).
+BLOCKING_CALLS = {
+    "time.sleep",
+    "os.system",
+    "os.wait",
+    "socket.create_connection",
+    "select.select",
+    "urllib.request.urlopen",
+    "subprocess.run",
+    "subprocess.call",
+    "subprocess.check_call",
+    "subprocess.check_output",
+    "subprocess.Popen",
+}
+
+
+def _walk_own_body(fn: ast.AST):
+    """Yield nodes of a function body WITHOUT descending into nested
+    function/lambda definitions (their bodies execute elsewhere — e.g. a
+    worker passed to asyncio.to_thread — and are judged separately)."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+class EventLoopPurity:
+    name = "blocking-in-async"
+
+    def check(self, mod: Module):
+        reported: set[int] = set()
+        for fn in ast.walk(mod.tree):
+            if not isinstance(fn, ast.AsyncFunctionDef):
+                continue
+            for node in _walk_own_body(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                target = mod.resolve(node.func)
+                if target in BLOCKING_CALLS:
+                    reported.add(id(node))
+                    yield Finding(
+                        self.name, mod.relpath, node.lineno, node.col_offset,
+                        f"blocking call {target}() inside "
+                        f"'async def {fn.name}' parks the event loop — "
+                        "use the await equivalent or asyncio.to_thread")
+                elif (isinstance(node.func, ast.Attribute)
+                      and node.func.attr == "accept"
+                      and "limiter" in (mod.dotted(node.func.value)
+                                        or ["?"])[-1]):
+                    # the flowcontrol token bucket: sync accept() sleeps
+                    reported.add(id(node))
+                    yield Finding(
+                        self.name, mod.relpath, node.lineno, node.col_offset,
+                        f"sync rate-limiter accept() inside "
+                        f"'async def {fn.name}' sleeps on the loop — "
+                        "await accept_async() instead")
+        # tier 2: a bare time.sleep anywhere in control-plane code is an
+        # event-loop hazard the moment a coroutine reaches it (most of
+        # this codebase runs on one loop). Legitimately-threaded sites
+        # carry an explicit `# ktpu: allow[blocking-in-async]` so the
+        # audit stays honest.
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Call) and id(node) not in reported \
+                    and mod.resolve(node.func) == "time.sleep":
+                yield Finding(
+                    self.name, mod.relpath, node.lineno, node.col_offset,
+                    "time.sleep in control-plane code blocks any event "
+                    "loop that reaches it — asyncio.sleep / to_thread it, "
+                    "or annotate the thread-only path with "
+                    "`# ktpu: allow[blocking-in-async]`")
+
+
+# ---------------------------------------------------------------------------
+# R2: trace purity of jit-compiled kernels
+
+
+# modules holding jit-compiled kernels (the HLO-pinned surface)
+KERNEL_MODULES = (
+    "kubernetes_tpu/ops/solver.py",
+    "kubernetes_tpu/ops/pallas_kernels.py",
+    "kubernetes_tpu/autoscaler/simulator.py",
+    "kubernetes_tpu/parallel/mesh.py",
+    "kubernetes_tpu/state/pod_batch.py",
+)
+
+# kernel entry points jitted from OTHER modules (the driver wraps
+# schedule_batch in jax.jit at its call site, so decorator detection
+# alone cannot see these roots)
+EXTRA_KERNEL_ROOTS = {
+    "kubernetes_tpu/ops/solver.py": {"schedule_batch", "evaluate_pod"},
+    "kubernetes_tpu/state/pod_batch.py": {"unpack_batch"},
+}
+
+# parameters that are static under jit (part of the compile key), so
+# Python control flow on them is trace-time program selection, not a
+# data-dependent branch
+STATIC_PARAM_NAMES = {
+    "self", "policy", "flags", "caps", "prows", "g", "gates", "table",
+    "mesh", "interpret", "axis_name", "n", "num", "allow_fused",
+}
+
+TRACE_CLOCKS = {"time.time", "time.monotonic", "time.perf_counter",
+                "time.process_time"}
+
+
+def _is_jit_expr(mod: Module, node: ast.expr) -> bool:
+    """True for `jax.jit`, bare `jit`, and partial(jax.jit, ...)."""
+    target = mod.resolve(node)
+    if target in ("jax.jit", "jit"):
+        return True
+    if isinstance(node, ast.Call) and \
+            mod.resolve(node.func) in ("functools.partial", "partial"):
+        return bool(node.args) and _is_jit_expr(mod, node.args[0])
+    return False
+
+
+class TracePurity:
+    name = "trace-impure"
+
+    def check(self, mod: Module):
+        if not any(mod.relpath.endswith(k) for k in KERNEL_MODULES):
+            return
+        # module function table (top-level and nested, by bare name)
+        fns: dict[str, list[ast.AST]] = {}
+        for node in ast.walk(mod.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                fns.setdefault(node.name, []).append(node)
+
+        roots: set[str] = set(EXTRA_KERNEL_ROOTS.get(mod.relpath, set()))
+        for node in ast.walk(mod.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if any(_is_jit_expr(mod, d) for d in node.decorator_list):
+                    roots.add(node.name)
+            elif isinstance(node, ast.Call) and \
+                    mod.resolve(node.func) in ("jax.jit", "jit"):
+                for arg in node.args[:1]:
+                    if isinstance(arg, ast.Name):
+                        roots.add(arg.id)
+                    elif isinstance(arg, ast.Lambda):
+                        for sub in ast.walk(arg.body):
+                            if isinstance(sub, ast.Call) and \
+                                    isinstance(sub.func, ast.Name):
+                                roots.add(sub.func.id)
+
+        # transitive closure over same-module bare-name calls
+        kernel_names: set[str] = set()
+        frontier = [r for r in roots if r in fns]
+        while frontier:
+            name = frontier.pop()
+            if name in kernel_names:
+                continue
+            kernel_names.add(name)
+            for fn in fns[name]:
+                for node in ast.walk(fn):
+                    if isinstance(node, ast.Call) and \
+                            isinstance(node.func, ast.Name) and \
+                            node.func.id in fns:
+                        frontier.append(node.func.id)
+
+        visited: set[int] = set()
+        for name in sorted(kernel_names):
+            for fn in fns[name]:
+                if id(fn) in visited:
+                    continue
+                visited.add(id(fn))
+                yield from self._check_kernel(mod, fn)
+
+    def _check_kernel(self, mod: Module, fn: ast.AST):
+        traced = {a.arg for a in (fn.args.posonlyargs + fn.args.args
+                                  + fn.args.kwonlyargs)
+                  if a.arg not in STATIC_PARAM_NAMES
+                  and not self._static_annotation(a)}
+        where = f"jit kernel '{fn.name}'"
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                target = mod.resolve(node.func)
+                if target in TRACE_CLOCKS:
+                    yield Finding(
+                        self.name, mod.relpath, node.lineno, node.col_offset,
+                        f"{target}() inside {where} is evaluated once at "
+                        "trace time and baked into the compiled program")
+                elif target and (target.startswith("random.")
+                                 or target.startswith("numpy.random.")):
+                    yield Finding(
+                        self.name, mod.relpath, node.lineno, node.col_offset,
+                        f"{target}() inside {where}: host RNG burns into "
+                        "the trace — thread a jax PRNG key instead")
+                elif target in ("numpy.asarray", "numpy.array"):
+                    yield Finding(
+                        self.name, mod.relpath, node.lineno, node.col_offset,
+                        f"{target}() inside {where} forces a host sync on "
+                        "traced values — use jnp inside the kernel")
+                elif isinstance(node.func, ast.Attribute) and \
+                        node.func.attr == "item":
+                    yield Finding(
+                        self.name, mod.relpath, node.lineno, node.col_offset,
+                        f".item() inside {where} forces a device->host "
+                        "readback at trace time")
+                elif isinstance(node.func, ast.Name) and \
+                        node.func.id in ("float", "int", "bool") and \
+                        any(self._direct_traced(a, traced)
+                            for a in node.args):
+                    yield Finding(
+                        self.name, mod.relpath, node.lineno, node.col_offset,
+                        f"{node.func.id}() on traced value inside {where} "
+                        "concretizes the tracer (breaks under jit)")
+            elif isinstance(node, (ast.If, ast.While)) and \
+                    not self._is_structure_test(node.test) and \
+                    self._traced_outside_calls(node.test, traced):
+                yield Finding(
+                    self.name, mod.relpath, node.lineno, node.col_offset,
+                    f"Python `{'if' if isinstance(node, ast.If) else 'while'}`"
+                    f" on traced value inside {where} — data-dependent "
+                    "control flow must be lax.cond/jnp.where")
+
+    @staticmethod
+    def _static_annotation(arg: ast.arg) -> bool:
+        ann = arg.annotation
+        return isinstance(ann, ast.Name) and \
+            ann.id in ("int", "bool", "str", "float", "Policy",
+                       "PolicyGates", "BatchFlags", "Capacities")
+
+    @classmethod
+    def _direct_traced(cls, expr: ast.expr, traced: set[str]) -> bool:
+        """Name / attribute / subscript chain rooted at a traced param
+        (batch, state.requested, carry.rr[0] — a raw traced value, not an
+        expression that merely mentions one)."""
+        while isinstance(expr, (ast.Attribute, ast.Subscript)):
+            expr = expr.value
+        return isinstance(expr, ast.Name) and expr.id in traced
+
+    @classmethod
+    def _traced_outside_calls(cls, test: ast.expr, traced: set[str]) -> bool:
+        """A traced value used directly in a branch test. Values passed as
+        CALL ARGUMENTS are skipped: a helper that worked at first trace is
+        trace-time-static by construction (a data read inside it would
+        have raised TracerBoolConversionError already), while direct uses
+        (`if batch.gang_id`, `if x.any()`) are data-dependent branches."""
+        if cls._direct_traced(test, traced):
+            return True
+        if isinstance(test, ast.Call):
+            # receiver chain of a method call is a direct use (.any());
+            # arguments are the helper's problem
+            return isinstance(test.func, ast.Attribute) and \
+                cls._direct_traced(test.func.value, traced)
+        if isinstance(test, ast.BoolOp):
+            return any(cls._traced_outside_calls(v, traced)
+                       for v in test.values)
+        if isinstance(test, ast.UnaryOp):
+            return cls._traced_outside_calls(test.operand, traced)
+        if isinstance(test, ast.Compare):
+            return any(cls._traced_outside_calls(e, traced)
+                       for e in (test.left, *test.comparators))
+        if isinstance(test, ast.BinOp):
+            return any(cls._traced_outside_calls(e, traced)
+                       for e in (test.left, test.right))
+        return False
+
+    @staticmethod
+    def _is_structure_test(test: ast.expr) -> bool:
+        """`x is None` / `x is not None` picks the traced pytree
+        STRUCTURE (part of the jit key), not a data value — legal."""
+        return isinstance(test, ast.Compare) and \
+            all(isinstance(op, (ast.Is, ast.IsNot)) for op in test.ops)
+
+
+# ---------------------------------------------------------------------------
+# R3: BatchFlags discipline
+
+
+# the only functions allowed to derive flag values from batch content
+# (the driver/encoder gate fns whose outputs the parity tests pin)
+SANCTIONED_GATES = {
+    ("kubernetes_tpu/ops/solver.py", "batch_flags"),
+    ("kubernetes_tpu/state/pod_batch.py", "packed_batch_flags"),
+}
+
+_SOLVER_RELPATH = "kubernetes_tpu/ops/solver.py"
+_PIN_TEST_RELPATH = "tests/test_batch_flags.py"
+
+
+def _batchflags_fields() -> dict[str, int]:
+    """{field: lineno} of the BatchFlags dataclass, parsed from source so
+    the rule needs no jax import."""
+    path = os.path.join(REPO_ROOT, _SOLVER_RELPATH)
+    if not os.path.exists(path):  # pragma: no cover - repo layout moved
+        return {}
+    with open(path, encoding="utf-8") as f:
+        tree = ast.parse(f.read())
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef) and node.name == "BatchFlags":
+            return {stmt.target.id: stmt.lineno for stmt in node.body
+                    if isinstance(stmt, ast.AnnAssign)
+                    and isinstance(stmt.target, ast.Name)}
+    return {}
+
+
+def _pinned_flags() -> set[str] | None:
+    """Keys of the PIN_COVERAGE map in tests/test_batch_flags.py, or None
+    when the map (or the test file) is missing."""
+    path = os.path.join(REPO_ROOT, _PIN_TEST_RELPATH)
+    if not os.path.exists(path):
+        return None
+    with open(path, encoding="utf-8") as f:
+        tree = ast.parse(f.read())
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and any(
+                isinstance(t, ast.Name) and t.id == "PIN_COVERAGE"
+                for t in node.targets) and \
+                isinstance(node.value, ast.Dict):
+            return {k.value for k in node.value.keys
+                    if isinstance(k, ast.Constant)}
+    return None
+
+
+def _const(expr: ast.expr) -> bool:
+    return isinstance(expr, ast.Constant)
+
+
+class BatchFlagsDiscipline:
+    name = "batchflags-gate"
+
+    def check(self, mod: Module):
+        if mod.relpath == _SOLVER_RELPATH:
+            yield from self._check_pin_coverage(mod)
+        yield from self._check_gate_sites(mod)
+
+    def _check_pin_coverage(self, mod: Module):
+        fields = _batchflags_fields()
+        pinned = _pinned_flags()
+        if pinned is None:
+            if fields:
+                line = min(fields.values())
+                yield Finding(
+                    self.name, mod.relpath, line, 0,
+                    f"no PIN_COVERAGE map in {_PIN_TEST_RELPATH}: every "
+                    "BatchFlags field needs a named gating-parity pin")
+            return
+        for name, line in sorted(fields.items(), key=lambda kv: kv[1]):
+            if name not in pinned:
+                yield Finding(
+                    self.name, mod.relpath, line, 0,
+                    f"BatchFlags.{name} is not listed in PIN_COVERAGE "
+                    f"({_PIN_TEST_RELPATH}) — a flag without a "
+                    "gating-parity pin can silently change the compiled "
+                    "program")
+
+    def _check_gate_sites(self, mod: Module):
+        fields = set(_batchflags_fields())
+        # enclosing-function map for sanction checks
+        enclosing: dict[int, str] = {}
+        for fn in ast.walk(mod.tree):
+            if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for node in ast.walk(fn):
+                    enclosing.setdefault(id(node), fn.name)
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            target = mod.resolve(node.func)
+            if target and target.split(".")[-1] == "BatchFlags":
+                derived = bool(node.args) or any(
+                    not _const(kw.value) for kw in node.keywords)
+                sanctioned = (mod.relpath,
+                              enclosing.get(id(node), "")) in SANCTIONED_GATES
+                if derived and not sanctioned:
+                    yield Finding(
+                        self.name, mod.relpath, node.lineno, node.col_offset,
+                        "BatchFlags derived from batch content outside the "
+                        "sanctioned gate functions (solver.batch_flags / "
+                        "pod_batch.packed_batch_flags) — ad-hoc gates skip "
+                        "the parity pins")
+            elif target and target.split(".")[-1] == "replace" and fields \
+                    and self._flags_receiver(mod, node):
+                hit = [kw.arg for kw in node.keywords
+                       if kw.arg in fields and not _const(kw.value)]
+                if hit:
+                    yield Finding(
+                        self.name, mod.relpath, node.lineno, node.col_offset,
+                        f"replace({', '.join(hit)}=...) derives a "
+                        "BatchFlags field from a non-constant outside the "
+                        "sanctioned gate functions")
+
+    @staticmethod
+    def _flags_receiver(mod: Module, node: ast.Call) -> bool:
+        """Is this replace() plausibly operating on a BatchFlags value?
+        Method style: receiver named *flag*; dataclasses.replace style:
+        first arg named *flag* or built by a sanctioned gate fn. Keeps
+        Carry.replace(ipa=...) and other field-name collisions out."""
+        if isinstance(node.func, ast.Attribute):
+            d = mod.dotted(node.func.value)
+            return bool(d) and "flag" in d[-1].lower()
+        arg = node.args[0] if node.args else None
+        if isinstance(arg, ast.Call):
+            inner = mod.resolve(arg.func) or ""
+            return inner.split(".")[-1] in ("batch_flags",
+                                            "packed_batch_flags",
+                                            "BatchFlags")
+        d = mod.dotted(arg) if arg is not None else None
+        return bool(d) and "flag" in d[-1].lower()
+
+
+# ---------------------------------------------------------------------------
+# R4: seeded determinism of the solve path
+
+
+R4_SCOPES = ("kubernetes_tpu/ops/", "kubernetes_tpu/state/",
+             "kubernetes_tpu/scheduler/")
+R4_FILES = ("kubernetes_tpu/autoscaler/simulator.py",)
+
+AMBIENT_ENTROPY = {"uuid.uuid4", "uuid.uuid1", "os.urandom",
+                   "numpy.random.seed"}
+
+
+class Determinism:
+    name = "nondeterminism"
+
+    def check(self, mod: Module):
+        if not (mod.relpath.startswith(R4_SCOPES)
+                or mod.relpath in R4_FILES):
+            return
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            target = mod.resolve(node.func)
+            if target is None:
+                continue
+            # module-level random.* only: a seeded random.Random instance
+            # (self._rng.random()) is the injected, replayable source —
+            # and constructing one IS the sanctioned injection move
+            if target in ("random.Random", "numpy.random.default_rng"):
+                continue
+            if target.startswith(("random.", "numpy.random.",
+                                  "secrets.")) or \
+                    target in AMBIENT_ENTROPY:
+                base = target.split(".")[0]
+                head = (mod.dotted(node.func) or ["?"])[0]
+                if base in ("random", "numpy", "secrets", "uuid", "os") \
+                        and (head in mod.module_aliases
+                             or head in mod.name_imports
+                             or head in ("random", "np", "numpy", "uuid",
+                                         "os", "secrets")):
+                    yield Finding(
+                        self.name, mod.relpath, node.lineno, node.col_offset,
+                        f"ambient {target}() in the solve path breaks "
+                        "seed-replay (FaultPlane contract) — inject a "
+                        "random.Random(seed) / jax PRNG key")
+            elif target == "time.time":
+                yield Finding(
+                    self.name, mod.relpath, node.lineno, node.col_offset,
+                    "wall-clock time.time() in the solve path breaks "
+                    "seed-replay — inject utils.clock.Clock (tests warp "
+                    "it; perf_counter is fine for metrics)")
+
+
+# ---------------------------------------------------------------------------
+# R5: store write discipline
+
+
+class StoreWriteDiscipline:
+    name = "store-rmw"
+
+    # the store itself defines the checked/unchecked semantics
+    EXEMPT = ("kubernetes_tpu/apiserver/store.py",)
+
+    def check(self, mod: Module):
+        if mod.relpath in self.EXEMPT:
+            return
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    node.func.attr == "update":
+                for kw in node.keywords:
+                    if kw.arg == "check_version" and \
+                            isinstance(kw.value, ast.Constant) and \
+                            kw.value.value is False:
+                        yield Finding(
+                            self.name, mod.relpath, node.lineno,
+                            node.col_offset,
+                            "update(check_version=False) discards the "
+                            "resourceVersion precondition: a concurrent "
+                            "writer's change is silently lost — use "
+                            "guaranteed_update/patch, or carry the read "
+                            "version")
+            elif isinstance(node, ast.Assign):
+                for tgt in node.targets:
+                    d = mod.dotted(tgt)
+                    if d and d[-2:] == ["metadata", "resource_version"] \
+                            and isinstance(node.value, ast.Constant) \
+                            and not node.value.value:
+                        yield Finding(
+                            self.name, mod.relpath, node.lineno,
+                            node.col_offset,
+                            "stripping metadata.resource_version before a "
+                            "write defeats optimistic concurrency (the "
+                            "lost-update race class)")
+
+
+RULES = [EventLoopPurity(), TracePurity(), BatchFlagsDiscipline(),
+         Determinism(), StoreWriteDiscipline()]
+
+RULE_NAMES = {r.name for r in RULES}

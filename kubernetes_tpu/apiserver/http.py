@@ -1264,7 +1264,9 @@ class RemoteStore:
             except (ConnectionError, TimeoutError, OSError):
                 if _time.monotonic() + delay >= deadline:
                     raise
-                _time.sleep(delay)
+                # blocking HTTP core: runs on client threads (or inside
+                # to_thread), never on the event loop
+                _time.sleep(delay)  # ktpu: allow[blocking-in-async]
                 delay = min(1.0, 2 * delay)
                 continue
             if self._ssl is not None:
@@ -1532,6 +1534,10 @@ class RemoteStore:
         return _LazyWatch(fut)
 
     async def _open_watch(self, plural: str, query: str):
+        if self.rate_limiter is not None:
+            # async acquire: the sync accept() would park the event loop
+            # this watch (and every other stream) runs on
+            await self.rate_limiter.accept_async()
         accept = (f"Accept: {wire.CONTENT_TYPE}, application/json\r\n"
                   if self._pb else "")
         reader, writer = await asyncio.open_connection(

@@ -38,6 +38,7 @@ from kubernetes_tpu.apiserver.store import (
 from kubernetes_tpu.autoscaler.simulator import ScaleSimulator
 from kubernetes_tpu.client.informer import Informer
 from kubernetes_tpu.cloudprovider.interface import CloudProvider
+from kubernetes_tpu.utils.clock import SYSTEM_CLOCK, Clock
 from kubernetes_tpu.controllers.disruption import can_evict, eviction_allowed
 from kubernetes_tpu.gang import pod_group_key
 from kubernetes_tpu.models.policy import DEFAULT_POLICY
@@ -115,7 +116,8 @@ class ClusterAutoscaler:
                  scaledown_priority_cutoff: int = 0,
                  max_expansion: int = MAX_EXPANSION,
                  register_nodes: bool = True,
-                 now=time.monotonic):
+                 now=time.monotonic,
+                 clock: Clock = SYSTEM_CLOCK):
         self.store = store
         self.cloud = cloud
         self.scan_interval = scan_interval
@@ -131,6 +133,9 @@ class ClusterAutoscaler:
         # role: no agent process exists to register them in tests/bench)
         self.register_nodes = register_nodes
         self.now = now
+        # wall-clock stamps (status/reporting) ride the injectable clock;
+        # cooldown arithmetic stays on the monotonic `now` above
+        self.clock = clock
         self._own_informers = node_informer is None or pod_informer is None
         self.nodes = node_informer or Informer(store, "Node")
         self.pods = pod_informer or Informer(store, "Pod")
@@ -301,7 +306,7 @@ class ClusterAutoscaler:
         self._scaleup_after[group] = now + self.scaleup_cooldown
         # a fresh capacity add shouldn't be immediately re-shrunk
         self._scaledown_after[group] = now + self.scaledown_cooldown
-        self._last_scaleup[group] = time.time()
+        self._last_scaleup[group] = self.clock.now()
         self.scaleups += len(created)
         _metrics()[0].labels(group).inc(len(created))
         log.info("scale-up: group %s +%d (score %.2f, baseline %d/%d)",
@@ -451,7 +456,7 @@ class ClusterAutoscaler:
         except NotFound:
             pass
         self._scaledown_after[group] = self.now() + self.scaledown_cooldown
-        self._last_scaledown[group] = time.time()
+        self._last_scaledown[group] = self.clock.now()
         self.scaledowns += 1
         _metrics()[1].labels(group).inc()
         log.info("scale-down: drained and deleted %s (group %s)", name,

@@ -890,7 +890,8 @@ def cmd_drain(client, args) -> int:
                 print(f"error: pods not evictable within budget: "
                       f"{', '.join(sorted(pending))}", file=sys.stderr)
                 return 1
-            _time.sleep(0.5)
+            # kubectl is a synchronous CLI process: no event loop to block
+            _time.sleep(0.5)  # ktpu: allow[blocking-in-async]
     print(f"node/{args.name} drained")
     return 0
 

@@ -4,10 +4,16 @@ The client-go util/flowcontrol analog (throttle.go tokenBucketRateLimiter:
 qps refill, burst capacity) that caps a client's request rate against the
 apiserver — the scheduler_perf harness configures the reference's client at
 5000 QPS / 5000 burst (test/integration/scheduler_perf/util.go:46).
-`RemoteStore(rate_limiter=...)` applies it to every blocking request."""
+`RemoteStore(rate_limiter=...)` applies it to every blocking request via
+`accept()`; coroutine callers (the async watch-open path, any future async
+client verb) MUST go through `accept_async()` instead — the sync path
+parks whatever thread it runs on, and on the event-loop thread that means
+every watcher, timer and server in the process (ktpu-lint R1
+blocking-in-async polices exactly this class)."""
 
 from __future__ import annotations
 
+import asyncio
 import time
 
 
@@ -25,21 +31,37 @@ class TokenBucketRateLimiter:
                            self._tokens + (now - self._last) * self.qps)
         self._last = now
 
-    def try_accept(self) -> bool:
-        """Non-blocking TryAccept (throttle.go:103)."""
+    def _take(self) -> float:
+        """Take a token if available; else the seconds until one refills.
+        Returns 0.0 on success (shared by both acquire paths, so sync and
+        async callers drain one bucket with identical semantics)."""
         self._refill(time.monotonic())
         if self._tokens >= 1.0:
             self._tokens -= 1.0
-            return True
-        return False
+            return 0.0
+        return max((1.0 - self._tokens) / self.qps, 1e-4)
+
+    def try_accept(self) -> bool:
+        """Non-blocking TryAccept (throttle.go:103)."""
+        return self._take() == 0.0
 
     def accept(self) -> None:
         """Blocking Accept: sleep until a token is available
-        (throttle.go:91)."""
+        (throttle.go:91). Thread-only — from a coroutine, await
+        accept_async() so the event loop keeps turning."""
         while True:
-            now = time.monotonic()
-            self._refill(now)
-            if self._tokens >= 1.0:
-                self._tokens -= 1.0
+            wait = self._take()
+            if wait == 0.0:
                 return
-            time.sleep(max((1.0 - self._tokens) / self.qps, 1e-4))
+            # threaded blocking client path only; async callers are routed
+            # to accept_async (enforced by lint R1)
+            time.sleep(wait)  # ktpu: allow[blocking-in-async]
+
+    async def accept_async(self) -> None:
+        """Async Accept: await a token without blocking the event loop
+        (the same bucket — mixed sync/async callers contend fairly)."""
+        while True:
+            wait = self._take()
+            if wait == 0.0:
+                return
+            await asyncio.sleep(wait)

@@ -1159,7 +1159,14 @@ class Scheduler:
                                           self.solve_timeout_s)
         if self.solve_fault_hook is not None:
             self.solve_fault_hook(list(live_keys))
-        return schedule_fn(state, fblob, iblob, self._rr, victims)
+        # dispatch in a worker thread: tracing/compiling a new BatchFlags
+        # variant (and the whole solve on CPU backends) is synchronous in
+        # the runtime and would hold the event loop for the duration —
+        # informers/heartbeats stall, which the LoopStallWatchdog flags.
+        # The device result stays lazy; readback still overlaps via the
+        # fetch task downstream.
+        return await asyncio.to_thread(
+            schedule_fn, state, fblob, iblob, self._rr, victims)
 
     async def _dispatch_guarded(self, schedule_fn, state, fblob, iblob,
                                 victims, live_keys: list[str]):

@@ -140,7 +140,10 @@ class FaultPlane:
                 action.fn(self)
         if self.latency_rate and self._rng.random() < self.latency_rate:
             self.stats.delayed += 1
-            time.sleep(self.latency_s)
+            # deliberate: injected latency MUST stall the caller exactly
+            # where a slow store would (on the loop if the caller is a
+            # coroutine — that is the scenario under test)
+            time.sleep(self.latency_s)  # ktpu: allow[blocking-in-async]
         if op in self.error_ops and self.error_rate \
                 and self._rng.random() < self.error_rate:
             self.stats.injected[op] = self.stats.injected.get(op, 0) + 1
@@ -240,4 +243,4 @@ class FaultPlane:
             # thread, so a configured solve timeout fires around it
             self.solve_hangs -= 1
             self.stats.solve_faults += 1
-            time.sleep(self.solve_hang_s)
+            time.sleep(self.solve_hang_s)  # ktpu: allow[blocking-in-async]

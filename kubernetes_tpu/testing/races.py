@@ -1,0 +1,261 @@
+"""Runtime race/stall detection: what static analysis cannot see.
+
+ktpu-lint (kubernetes_tpu/analysis) proves properties of the SOURCE —
+no blocking call inside `async def`, no unguarded update(...) call site.
+This module proves properties of an EXECUTION:
+
+- `RaceDetector` is a drop-in ObjectStore proxy (the FaultPlane shape —
+  the two compose, detector around plane) that watches every verb and
+  records *racy read-modify-write interleavings*: a write that carries no
+  resourceVersion precondition AND lands on a version its writer never
+  observed — i.e. it just silently destroyed a concurrent writer's
+  update. A single-writer heartbeat that read-then-writes back-to-back is
+  NOT racy (its last read matches the stored version); the same code
+  interleaved with another actor is. It also keeps the exactly-once bind
+  ledger, so "zero double-binds" and "zero racy writes" come from one
+  witness.
+
+- `LoopStallWatchdog` measures event-loop health from inside the loop: a
+  high-frequency sleeper whose oversleep IS the time some callback held
+  the loop (the asyncio slow_callback_duration idea, but always-on,
+  threshold-tagged and exported via obs as `eventloop_stalls_total` /
+  `eventloop_stall_seconds`). The chaos drill runs under both and must
+  finish with zero racy writes and zero stalls over 100 ms — the runtime
+  complement of lint rules R1/R5.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+from dataclasses import dataclass
+from typing import Any
+
+from kubernetes_tpu.apiserver.store import ObjectStore
+
+STALL_THRESHOLD_S = 0.1   # the "zero stalls > 100 ms" drill contract
+
+
+def _metrics():
+    from kubernetes_tpu.obs import REGISTRY
+
+    return (
+        REGISTRY.counter(
+            "eventloop_stalls_total",
+            "Event-loop stalls longer than the watchdog threshold"),
+        REGISTRY.histogram(
+            "eventloop_stall_seconds",
+            "Observed event-loop stall durations (seconds)",
+            buckets=(0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0)),
+    )
+
+
+@dataclass(frozen=True)
+class RacyWrite:
+    """One recorded lost-update: `actor` wrote `kind` `key` without a
+    version precondition while the stored version was `rv_found`, but the
+    last version this actor ever observed for the key was `rv_seen`
+    (None: it never read it at all)."""
+
+    kind: str
+    key: str            # "namespace/name"
+    rv_seen: str | None
+    rv_found: str | None
+    actor: tuple
+    reason: str
+
+    def __str__(self) -> str:
+        return (f"racy write: {self.kind} {self.key} ({self.reason}: "
+                f"saw rv={self.rv_seen}, stored rv={self.rv_found})")
+
+
+class RaceDetector:
+    """Recording ObjectStore proxy for racy read-modify-write detection.
+
+    Wrap any store-shaped object (a live ObjectStore, a FaultPlane):
+    every get/list records the version each actor has SEEN per object;
+    every unguarded update (no resourceVersion on the object, or
+    check_version=False) is checked against it. Guarded updates are never
+    racy — the store's own Conflict is the correctness mechanism. Actors
+    are (thread, asyncio task) pairs, so two coroutines interleaving on
+    one loop are distinguished exactly like two threads.
+
+    Unknown attributes delegate to the wrapped store, so the detector is
+    drop-in anywhere an ObjectStore is (and composes with FaultPlane:
+    RaceDetector(FaultPlane(store)) draws injection *and* records races).
+    """
+
+    def __init__(self, store: Any):
+        self.inner = store
+        self.racy_writes: list[RacyWrite] = []
+        self.bind_counts: dict[str, int] = {}
+        self._seen: dict[tuple, str | None] = {}
+        self._lock = threading.Lock()
+
+    # ---- accounting helpers ----
+
+    @staticmethod
+    def _actor() -> tuple:
+        try:
+            task = asyncio.current_task()
+        except RuntimeError:
+            task = None
+        return (threading.get_ident(), id(task) if task is not None else 0)
+
+    @staticmethod
+    def _key(obj: Any) -> tuple[str, str]:
+        return (obj.kind,
+                f"{obj.metadata.namespace or 'default'}/{obj.metadata.name}")
+
+    def _note_seen(self, obj: Any, actor: tuple | None = None) -> None:
+        kind, key = self._key(obj)
+        with self._lock:
+            self._seen[(actor or self._actor(), kind, key)] = \
+                obj.metadata.resource_version
+
+    @property
+    def double_binds(self) -> int:
+        return sum(1 for v in self.bind_counts.values() if v > 1)
+
+    # ---- proxied read verbs (record what each actor has seen) ----
+
+    def get(self, kind: str, name: str, namespace: str = "default") -> Any:
+        obj = self.inner.get(kind, name, namespace)
+        self._note_seen(obj)
+        return obj
+
+    def list(self, *a, **kw) -> list:
+        out = self.inner.list(*a, **kw)
+        actor = self._actor()
+        for obj in out:
+            self._note_seen(obj, actor)
+        return out
+
+    def list_with_version(self, kind: str):
+        out, rv = self.inner.list_with_version(kind)
+        actor = self._actor()
+        for obj in out:
+            self._note_seen(obj, actor)
+        return out, rv
+
+    # ---- proxied write verbs (the detection point) ----
+
+    def create(self, obj: Any, **kw) -> Any:
+        created = self.inner.create(obj, **kw)
+        self._note_seen(created)
+        return created
+
+    def create_many(self, objs: list) -> list:
+        out = self.inner.create_many(objs)
+        actor = self._actor()
+        for obj in out:
+            self._note_seen(obj, actor)
+        return out
+
+    def update(self, obj: Any, **kw) -> Any:
+        kind, key = self._key(obj)
+        actor = self._actor()
+        unguarded = (not obj.metadata.resource_version
+                     or kw.get("check_version") is False)
+        if unguarded:
+            # what does the store hold right now? read the bucket directly
+            # (not through a wrapped FaultPlane verb — observation must not
+            # draw injection or perturb op order)
+            current = self.inner._bucket(kind).get(
+                (obj.metadata.namespace or "default", obj.metadata.name))
+            rv_found = current.metadata.resource_version \
+                if current is not None else None
+            with self._lock:
+                rv_seen = self._seen.get((actor, kind, key))
+            if rv_found is not None and rv_seen != rv_found:
+                self.racy_writes.append(RacyWrite(
+                    kind, key, rv_seen, rv_found, actor,
+                    "write-without-read" if rv_seen is None
+                    else "lost-update"))
+        out = self.inner.update(obj, **kw)
+        self._note_seen(out, actor)
+        return out
+
+    def delete(self, kind: str, name: str,
+               namespace: str = "default") -> Any:
+        return self.inner.delete(kind, name, namespace)
+
+    # CAS helpers run the store's algorithm over OUR get/update, so every
+    # inner read/write is accounted (and never racy: the loop carries rv)
+    def guaranteed_update(self, kind: str, name: str, namespace: str,
+                          mutate, retries: int = 16) -> Any:
+        return ObjectStore.guaranteed_update(self, kind, name, namespace,
+                                             mutate, retries=retries)
+
+    def patch(self, kind: str, name: str, namespace: str, patch,
+              content_type: str, retries: int = 5) -> Any:
+        return ObjectStore.patch(self, kind, name, namespace, patch,
+                                 content_type, retries=retries)
+
+    # ---- bind ledger (exactly-once witness, FaultPlane-compatible) ----
+
+    def bind(self, binding) -> Any:
+        out = self.inner.bind(binding)
+        key = f"{binding.namespace or 'default'}/{binding.pod_name}"
+        with self._lock:
+            self.bind_counts[key] = self.bind_counts.get(key, 0) + 1
+        return out
+
+    def bind_many(self, bindings: list):
+        bound, errors = self.inner.bind_many(bindings)
+        with self._lock:
+            for binding, err in zip(bindings, errors):
+                if err is None:
+                    key = f"{binding.namespace or 'default'}/" \
+                          f"{binding.pod_name}"
+                    self.bind_counts[key] = self.bind_counts.get(key, 0) + 1
+        return bound, errors
+
+    def __getattr__(self, name: str) -> Any:
+        return getattr(self.inner, name)
+
+
+class LoopStallWatchdog:
+    """Event-loop stall detector: a tick task that measures its own
+    oversleep. When `await asyncio.sleep(tick)` returns `lag` seconds
+    late, some callback(s) held the loop for ~`lag` — past the threshold
+    that is a recorded stall (and an `eventloop_stalls_total` increment).
+
+    start() from loop code; stop() returns the stall list. `max_stall_s`
+    is the drill's headline figure ("zero stalls > 100 ms" = empty
+    list at the default threshold)."""
+
+    def __init__(self, threshold_s: float = STALL_THRESHOLD_S,
+                 tick_s: float = 0.01):
+        self.threshold_s = threshold_s
+        self.tick_s = tick_s
+        self.stalls: list[float] = []
+        self._task: asyncio.Task | None = None
+
+    @property
+    def max_stall_s(self) -> float:
+        return max(self.stalls, default=0.0)
+
+    def start(self) -> "LoopStallWatchdog":
+        self._task = asyncio.get_running_loop().create_task(self._run())
+        return self
+
+    def stop(self) -> list[float]:
+        if self._task is not None:
+            self._task.cancel()
+            self._task = None
+        return self.stalls
+
+    async def _run(self) -> None:
+        counter, hist = _metrics()
+        loop = asyncio.get_running_loop()
+        last = loop.time()
+        while True:
+            await asyncio.sleep(self.tick_s)
+            now = loop.time()
+            lag = now - last - self.tick_s
+            last = now
+            if lag > self.threshold_s:
+                self.stalls.append(lag)
+                counter.inc()
+                hist.observe(lag)

@@ -1,0 +1,49 @@
+"""Injectable wall clock: the utils/clock position of the reference
+(k8s.io/utils/clock) that lets controllers stamp real time in production
+and warped time in tests.
+
+Two needs meet here: lint rule R4 (nondeterminism) bans ambient
+`time.time()` from the solve path because the FaultPlane's seed-replay
+contract requires a schedule to be a pure function of (seed, workload) —
+and the fault plane wants to WARP time in tests (cooldowns, deadlines,
+dwell windows) without sleeping through them. Components take a `Clock`
+and call `.now()`; tests hand them a `ManualClock` and advance it.
+"""
+
+from __future__ import annotations
+
+import time
+
+
+class Clock:
+    """Wall clock with an injectable source. `now()` returns POSIX
+    seconds (float), same contract as time.time."""
+
+    __slots__ = ("_now",)
+
+    def __init__(self, now=time.time):
+        self._now = now
+
+    def now(self) -> float:
+        return self._now()
+
+
+class ManualClock(Clock):
+    """Test clock: starts at `start`, moves only when told to."""
+
+    __slots__ = ("_t",)
+
+    def __init__(self, start: float = 0.0):
+        self._t = float(start)
+        super().__init__(lambda: self._t)
+
+    def set(self, t: float) -> None:
+        self._t = float(t)
+
+    def advance(self, seconds: float) -> None:
+        self._t += seconds
+
+
+# the process default: real wall time (components default to this so
+# construction sites don't change; tests override per instance)
+SYSTEM_CLOCK = Clock()

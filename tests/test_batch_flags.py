@@ -14,6 +14,36 @@ from kubernetes_tpu.state.context import EncodeContext
 CAPS = Capacities(num_nodes=16, batch_pods=8)
 ZONE = "failure-domain.beta.kubernetes.io/zone"
 
+# Every BatchFlags field -> the test module that pins its gating contract
+# (gated program bit-identical to ALL_ACTIVE when the flag is derived, or —
+# for scale_sim — never derived from content at all). ktpu-lint rule R3
+# reads this map: adding a BatchFlags field without extending it is a lint
+# failure, so a new gate cannot ship without a named parity pin.
+PIN_COVERAGE = {
+    "ipa": "tests/test_batch_flags.py",
+    "spread": "tests/test_batch_flags.py",
+    "svcanti": "tests/test_batch_flags.py",
+    "vol": "tests/test_batch_flags.py",
+    "attach": "tests/test_batch_flags.py",
+    "tt": "tests/test_solver.py",        # mixed-workload gating parity
+    "na": "tests/test_solver.py",
+    "ports": "tests/test_solver.py",
+    "gpu": "tests/test_solver.py",
+    "storage": "tests/test_solver.py",
+    "gang": "tests/test_gang.py",
+    "preempt": "tests/test_preemption.py",
+    "scale_sim": "tests/test_autoscaler.py",
+}
+
+
+def test_pin_coverage_matches_batchflags_fields():
+    import dataclasses
+
+    from kubernetes_tpu.ops.solver import BatchFlags
+
+    assert set(PIN_COVERAGE) == {f.name for f in
+                                 dataclasses.fields(BatchFlags)}
+
 
 def mk_node(name, zone="a"):
     return Node.from_dict({
