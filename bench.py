@@ -19,13 +19,16 @@ vs_baseline = headline value / 30.
 Prints exactly ONE JSON line on stdout (headline metric + per-config
 extras). Diagnostics go to stderr. Env overrides: BENCH_NODES, BENCH_PODS,
 BENCH_TIMEOUT_S, BENCH_CONFIGS (comma list of
-headline,interpod,spread,gang,preemption,recovery,chaos,device),
+headline,interpod,spread,gang,preemption,recovery,chaos,overload,device),
 BENCH_GANG_NODES / BENCH_GANG_PODS / BENCH_GANG_SIZE (gang config shape,
 default 50k nodes / 24576 pods in 8-wide groups), BENCH_PREEMPT_NODES
 (preemption drill size, default 512 nodes saturated with low-priority
 filler), BENCH_CHAOS_NODES / BENCH_CHAOS_SEED (convergence-under-chaos
 drill: seeded FaultPlane + watch expiry + scheduler crash; reports
-chaos_recovery_ms).
+chaos_recovery_ms), BENCH_OVERLOAD_NODES / BENCH_OVERLOAD_PODS /
+BENCH_OVERLOAD_MULT / BENCH_OVERLOAD_SEED + BENCH_FANOUT_WATCHERS /
+BENCH_FANOUT_EVENTS (noisy-tenant APF drill + watch-cache fan-out;
+reports overload_p99_ms and watch_fanout_events_per_sec).
 
 --metrics-snapshot (or BENCH_METRICS_SNAPSHOT=1) embeds the scheduler's
 per-phase registry histograms (encode/flush/dispatch/solve/bind/commit:
@@ -72,6 +75,11 @@ def main() -> None:
         os.environ.setdefault("BENCH_PREEMPT_NODES", "32")
         os.environ.setdefault("BENCH_CHAOS_NODES", "32")
         os.environ.setdefault("BENCH_AUTOSCALER_PODS", "64")
+        os.environ.setdefault("BENCH_OVERLOAD_NODES", "16")
+        os.environ.setdefault("BENCH_OVERLOAD_PODS", "32")
+        os.environ.setdefault("BENCH_OVERLOAD_MULT", "10")
+        os.environ.setdefault("BENCH_FANOUT_WATCHERS", "500")
+        os.environ.setdefault("BENCH_FANOUT_EVENTS", "20")
         os.environ.setdefault("BENCH_DEVICE_GATE", "0")  # CPU CI: no gate
         os.environ.setdefault(
             "BENCH_CONFIGS", "headline,gang,preemption,autoscaler")
@@ -84,8 +92,8 @@ def main() -> None:
     n_pods = int(os.environ.get("BENCH_PODS", "30000"))
     configs = os.environ.get(
         "BENCH_CONFIGS",
-        "headline,interpod,spread,gang,preemption,recovery,chaos,device,"
-        "autoscaler")
+        "headline,interpod,spread,gang,preemption,recovery,chaos,overload,"
+        "device,autoscaler")
     configs = [c.strip() for c in configs.split(",") if c.strip()]
     metrics_snapshot = "--metrics-snapshot" in sys.argv[1:] or \
         os.environ.get("BENCH_METRICS_SNAPSHOT", "") in ("1", "true")
@@ -252,6 +260,67 @@ def main() -> None:
                 f"chaos drill under race detector (seed {r.seed}): "
                 f"{r.racy_writes} racy writes, {r.loop_stalls} event-loop "
                 f"stalls (max {r.max_stall_ms:.0f}ms)")
+
+    if "overload" in configs:
+        from kubernetes_tpu.perf.harness import run_overload, run_watch_fanout
+
+        # noisy-tenant overload drill: a tenant floods the HTTP apiserver
+        # at BENCH_OVERLOAD_MULT x the scheduler's own request rate while
+        # a workload schedules through it over TCP. APF must keep the
+        # scheduler flow's p99 within 5x the unloaded baseline and every
+        # pod bound exactly once; --with-race-detector additionally runs
+        # the server under the RaceDetector + loop-stall watchdog
+        ovl_nodes = int(os.environ.get("BENCH_OVERLOAD_NODES", "64"))
+        ovl_pods = int(os.environ.get("BENCH_OVERLOAD_PODS", "256"))
+        ovl_mult = float(os.environ.get("BENCH_OVERLOAD_MULT", "50"))
+        ovl_seed = int(os.environ.get("BENCH_OVERLOAD_SEED", "2026"))
+        race_detect = "--with-race-detector" in sys.argv[1:] or \
+            os.environ.get("BENCH_RACE_DETECTOR", "") in ("1", "true")
+        r = run_overload(ovl_nodes, ovl_pods, seed=ovl_seed,
+                         flood_multiplier=ovl_mult,
+                         race_detect=race_detect)
+        print(f"bench[overload]: {r}", file=sys.stderr, flush=True)
+        extras["overload_p99_ms"] = round(r.p99_loaded_ms, 2)
+        extras["overload_p99_unloaded_ms"] = round(r.p99_unloaded_ms, 2)
+        extras["overload_flood_requests"] = r.flood_requests
+        extras["overload_flood_rejected"] = r.flood_rejected
+        extras["overload_sched_rps"] = round(r.sched_rps, 1)
+        extras["overload_seed"] = r.seed
+        if race_detect:
+            extras["overload_racy_writes"] = r.racy_writes
+            extras["overload_loop_stalls"] = r.loop_stalls
+            extras["overload_max_stall_ms"] = round(r.max_stall_ms, 1)
+        if not r.converged:
+            RESULT["error"] = (
+                f"overload drill did not converge (seed {r.seed}): "
+                f"{r.bound}/{r.pods} bound, "
+                f"{r.double_binds} double-binds")
+        elif not r.p99_bounded:
+            RESULT["error"] = (
+                f"overload drill: scheduler-flow p99 {r.p99_loaded_ms:.1f}"
+                f"ms breached 5x unloaded baseline "
+                f"({r.p99_unloaded_ms:.1f}ms)")
+        elif race_detect and (r.racy_writes or r.loop_stalls):
+            RESULT["error"] = (
+                f"overload drill under race detector (seed {r.seed}): "
+                f"{r.racy_writes} racy writes, {r.loop_stalls} event-loop "
+                f"stalls (max {r.max_stall_ms:.0f}ms)")
+
+        # watch-cache fan-out twin: N watchers, M events, and the store
+        # must do exactly M queue puts (one subscription, the cache fans
+        # out) — the O(watchers) -> O(1) write-path claim, measured
+        fan_watchers = int(os.environ.get("BENCH_FANOUT_WATCHERS", "10000"))
+        fan_events = int(os.environ.get("BENCH_FANOUT_EVENTS", "100"))
+        fr = run_watch_fanout(fan_watchers, fan_events)
+        print(f"bench[fanout]: {fr}", file=sys.stderr, flush=True)
+        extras["watch_fanout_events_per_sec"] = round(fr.events_per_sec, 1)
+        extras["watch_fanout_store_puts"] = fr.store_fanout_puts
+        extras["watch_fanout_deliveries"] = fr.deliveries
+        if fr.store_fanout_puts != fan_events:
+            RESULT["error"] = (
+                f"watch fanout: store did {fr.store_fanout_puts} puts for "
+                f"{fan_events} events (the cache is not the only "
+                f"subscriber)")
 
     if "autoscaler" in configs:
         from kubernetes_tpu.perf.harness import run_autoscaler

@@ -134,7 +134,9 @@ class HollowKubelet:
         fresh.status.conditions = [
             {"type": "Ready", "status": "True", "lastTransitionTime": now}]
         try:
-            self.store.update(fresh, check_version=False)
+            # CAS against the version just read: a concurrent writer wins
+            # and the resync sweep retries the ack
+            self.store.update(fresh)
         except (Conflict, NotFound, TooManyRequests):
             pass
 

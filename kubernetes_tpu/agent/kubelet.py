@@ -333,7 +333,9 @@ class Kubelet(HollowKubelet):
                       {"terminated": {"exitCode": exit_code}}}
             for c in fresh.spec.containers]
         try:
-            self.store.update(fresh, check_version=False)
+            # CAS against the version just read: losing the race leaves
+            # the fingerprint unreported, so the next sync retries
+            self.store.update(fresh)
             self._reported[key] = fingerprint
         except (Conflict, NotFound):
             pass

@@ -1187,6 +1187,65 @@ class PriorityClass:
 
 
 @dataclass
+class FlowSchema(_SpecStatusObject):
+    """API Priority & Fairness flow schema (the reference's
+    flowcontrol.apiserver.k8s.io FlowSchema): classifies requests onto a
+    priority level by user/group/verb/resource rules.
+
+    spec: priorityLevel (name of a PriorityLevelConfiguration or built-in
+    level), matchingPrecedence (int, lower matches first), rules (list of
+    {users, groups, verbs, resources} constraint dicts; a rule matches when
+    every PRESENT constraint matches, "*" in users means any authenticated
+    user). Cluster-scoped."""
+
+    kind = "FlowSchema"
+    api_version = "flowcontrol.ktpu.io/v1alpha1"
+
+    @property
+    def priority_level(self) -> str:
+        return self.spec.get("priorityLevel", "") or ""
+
+    @property
+    def matching_precedence(self) -> int:
+        return int(self.spec.get("matchingPrecedence", 1000) or 1000)
+
+    @property
+    def rules(self) -> list:
+        return self.spec.get("rules") or []
+
+
+@dataclass
+class PriorityLevelConfiguration(_SpecStatusObject):
+    """API Priority & Fairness priority level (the reference's
+    PriorityLevelConfiguration, collapsed to the queueing knobs this
+    server's FlowController uses).
+
+    spec: shares (int — this level's slice of the server's total
+    concurrency), queues (fair-queue count), queueLengthLimit (bound per
+    queue; beyond it requests shed with 429), handSize (shuffle-sharding
+    hand). Cluster-scoped."""
+
+    kind = "PriorityLevelConfiguration"
+    api_version = "flowcontrol.ktpu.io/v1alpha1"
+
+    @property
+    def shares(self) -> int:
+        return int(self.spec.get("shares", 1) or 1)
+
+    @property
+    def queues(self) -> int:
+        return int(self.spec.get("queues", 4) or 4)
+
+    @property
+    def queue_length_limit(self) -> int:
+        return int(self.spec.get("queueLengthLimit", 16) or 16)
+
+    @property
+    def hand_size(self) -> int:
+        return int(self.spec.get("handSize", 2) or 2)
+
+
+@dataclass
 class _DataObject:
     """Shared shape of the data-map kinds (Secret/ConfigMap): metadata + a
     string-keyed payload map (reference staging/src/k8s.io/api/core/v1/

@@ -269,7 +269,9 @@ class ResourceQuotaPlugin:
             fresh = quota.clone()
             fresh.status = status
             try:
-                store.update(fresh, check_version=False)
+                # CAS against the listed version: a racing mirror write
+                # wins and the next admission recomputes from scratch
+                store.update(fresh)
             except Exception:  # noqa: BLE001 — usage mirror is best-effort
                 pass
 

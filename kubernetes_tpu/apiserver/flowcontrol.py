@@ -1,0 +1,422 @@
+"""API Priority & Fairness: per-flow fair queues replacing flat max-in-flight.
+
+The server-side analog of the reference's APF feature (staging/src/k8s.io/
+apiserver/pkg/util/flowcontrol): requests are classified by FlowSchema
+(match on user/group/verb/resource, ordered by matchingPrecedence) onto a
+PriorityLevel, each level owning a slice of the server's total concurrency
+plus a set of bounded fair queues. A flow (schema + user distinguisher)
+shuffle-shards onto a small hand of queues and enqueues on the shortest, so
+one noisy tenant saturates its own queues while other flows — above all the
+scheduler/kubelet `system` level — keep their assured seats. Surplus load
+gets an honest 429 with a Retry-After hint instead of unbounded queueing
+(the flat WithMaxInFlightLimit behavior this replaces).
+
+Built-in config (overridable by FlowSchema / PriorityLevelConfiguration
+objects in the store, reloaded on a short TTL):
+
+    system    — `system:kube-*` users and the `system:nodes`/`system:masters`
+                groups (scheduler, kubelets, controller manager); most shares
+    workload  — every other authenticated user
+    catch-all — everything else (including anonymous); fewest shares
+
+Single-event-loop discipline: all state is touched only from the serving
+loop, so there are no locks; the latency sample deques are read cross-thread
+by the overload drill (append/iterate are atomic under the GIL).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import fnmatch
+import time
+import zlib
+from collections import deque
+from typing import Any
+
+_ANONYMOUS = "system:anonymous"
+
+# built-in priority levels: name -> (shares, queues, queue_length, hand_size)
+DEFAULT_PRIORITY_LEVELS: dict[str, dict] = {
+    "system": {"shares": 30, "queues": 8, "queueLengthLimit": 128,
+               "handSize": 4},
+    "workload": {"shares": 20, "queues": 16, "queueLengthLimit": 64,
+                 "handSize": 4},
+    "catch-all": {"shares": 5, "queues": 4, "queueLengthLimit": 16,
+                  "handSize": 2},
+}
+
+# built-in flow schemas, ordered by matchingPrecedence (lower wins). A rule
+# matches when every present constraint matches; "*" in `users` means any
+# AUTHENTICATED user (never system:anonymous — the reference's catch-all
+# subject split between system:authenticated and system:unauthenticated).
+DEFAULT_FLOW_SCHEMAS: list[dict] = [
+    {"name": "system", "priorityLevel": "system",
+     "matchingPrecedence": 100,
+     "rules": [{"users": ["system:kube-*", "system:apiserver",
+                          "system:kubelet*"]},
+               {"groups": ["system:nodes", "system:masters"]}]},
+    {"name": "workload", "priorityLevel": "workload",
+     "matchingPrecedence": 9000,
+     "rules": [{"users": ["*"]}]},
+    {"name": "catch-all", "priorityLevel": "catch-all",
+     "matchingPrecedence": 10000,
+     "rules": [{}]},
+]
+
+_mx: tuple | None = None
+
+
+def _flow_metrics() -> tuple:
+    """(dispatched, rejected, queued) counters labeled by flow schema —
+    the apiserver_flowcontrol_* families (apf metrics.go), registered on
+    first use."""
+    global _mx
+    if _mx is None:
+        from kubernetes_tpu.obs import metrics as m
+
+        _mx = (
+            m.REGISTRY.counter(
+                "apiserver_flowcontrol_dispatched_total",
+                "Requests that got a seat, by flow schema.", ("flow",)),
+            m.REGISTRY.counter(
+                "apiserver_flowcontrol_rejected_total",
+                "Requests shed with 429, by flow schema.", ("flow",)),
+            m.REGISTRY.counter(
+                "apiserver_flowcontrol_queued_total",
+                "Requests that waited in a fair queue, by flow schema.",
+                ("flow",)),
+        )
+    return _mx
+
+
+class FlowRejected(Exception):
+    """Request shed by flow control — HTTP 429 + Retry-After."""
+
+    def __init__(self, message: str, retry_after: float = 1.0):
+        super().__init__(message)
+        self.retry_after = retry_after
+
+
+class _Level:
+    """One priority level: a concurrency slice + shuffle-sharded queues.
+
+    Mutated in place on config reload so seats held across a reload still
+    release against the same counters."""
+
+    __slots__ = ("name", "shares", "limit", "queues", "queue_length",
+                 "hand_size", "in_flight", "_next_q")
+
+    def __init__(self, name: str, spec: dict):
+        self.name = name
+        self.in_flight = 0
+        self._next_q = 0
+        self.queues: list[deque] = []
+        self.limit = 0
+        self.configure(spec)
+
+    def configure(self, spec: dict) -> None:
+        self.shares = max(1, int(spec.get("shares", 1)))
+        n_queues = max(1, int(spec.get("queues", 4)))
+        self.queue_length = max(1, int(spec.get("queueLengthLimit", 16)))
+        self.hand_size = max(1, min(int(spec.get("handSize", 2)), n_queues))
+        # grow-only so waiters parked in existing queues survive a reload
+        while len(self.queues) < n_queues:
+            self.queues.append(deque())
+
+    def queued(self) -> int:
+        return sum(len(q) for q in self.queues)
+
+
+class _Seat:
+    """One admitted request. Held until release(); carries the flow schema
+    name for metrics/latency attribution and the seat width the request
+    was charged (the work estimator's LIST cost)."""
+
+    __slots__ = ("level", "flow", "width")
+
+    def __init__(self, level: _Level, flow: str, width: int = 1):
+        self.level = level
+        self.flow = flow
+        self.width = width
+
+
+class _Schema:
+    __slots__ = ("name", "level", "precedence", "rules")
+
+    def __init__(self, name: str, level: str, precedence: int, rules: list):
+        self.name = name
+        self.level = level
+        self.precedence = precedence
+        self.rules = rules or [{}]
+
+    def matches(self, user_name: str, groups: tuple, verb: str,
+                resource: str) -> bool:
+        for rule in self.rules:
+            if self._rule_matches(rule, user_name, groups, verb, resource):
+                return True
+        return False
+
+    @staticmethod
+    def _rule_matches(rule: dict, user_name: str, groups: tuple, verb: str,
+                      resource: str) -> bool:
+        users = rule.get("users")
+        if users:
+            for pat in users:
+                if pat == "*":
+                    if user_name != _ANONYMOUS:
+                        break
+                elif fnmatch.fnmatchcase(user_name, pat):
+                    break
+            else:
+                return False
+        want_groups = rule.get("groups")
+        if want_groups and not set(want_groups) & set(groups):
+            return False
+        verbs = rule.get("verbs")
+        if verbs and "*" not in verbs and verb not in verbs:
+            return False
+        resources = rule.get("resources")
+        if resources and "*" not in resources \
+                and resource not in resources:
+            return False
+        return True
+
+
+class FlowController:
+    """Seats + fair queues over one total concurrency budget.
+
+    `total_concurrency` keeps the old max_in_flight meaning: the sum of
+    seats across levels (0 = shed everything, preserving the flat gate's
+    test contract). `store` (optional) supplies FlowSchema /
+    PriorityLevelConfiguration overrides, reloaded at most every
+    `refresh_s` seconds."""
+
+    def __init__(self, total_concurrency: int = 400, store: Any = None,
+                 queue_wait_s: float = 2.0, refresh_s: float = 1.0):
+        self.total = total_concurrency
+        self.store = store
+        self.queue_wait_s = queue_wait_s
+        self.refresh_s = refresh_s
+        self._last_refresh = 0.0
+        self.levels: dict[str, _Level] = {}
+        self.schemas: list[_Schema] = []
+        # plain mirrors of the labeled counters, readable cross-thread by
+        # the overload drill without scraping the registry
+        self.dispatched: dict[str, int] = {}
+        self.rejected: dict[str, int] = {}
+        self.queued: dict[str, int] = {}
+        # per-schema seat-to-release latency samples (seconds)
+        self.latency_samples: dict[str, deque] = {}
+        self._apply_config(DEFAULT_PRIORITY_LEVELS, DEFAULT_FLOW_SCHEMAS)
+
+    # ---- configuration ----
+
+    def _apply_config(self, levels: dict[str, dict],
+                      schemas: list[dict]) -> None:
+        for name, spec in levels.items():
+            lvl = self.levels.get(name)
+            if lvl is None:
+                self.levels[name] = _Level(name, spec)
+            else:
+                lvl.configure(spec)
+        total_shares = sum(lv.shares for lv in self.levels.values()) or 1
+        for lv in self.levels.values():
+            lv.limit = 0 if self.total <= 0 else max(
+                1, self.total * lv.shares // total_shares)
+        parsed = []
+        for s in schemas:
+            level = s.get("priorityLevel") or "catch-all"
+            if level not in self.levels:
+                level = "catch-all"
+            parsed.append(_Schema(
+                s.get("name") or level, level,
+                int(s.get("matchingPrecedence", 1000)),
+                s.get("rules") or [{}]))
+        parsed.sort(key=lambda s: (s.precedence, s.name))
+        self.schemas = parsed
+
+    def _maybe_refresh(self) -> None:
+        """Layer store-defined FlowSchema / PriorityLevelConfiguration
+        objects over the built-ins (objects win by name; unknown levels on
+        a schema fall back to catch-all)."""
+        if self.store is None:
+            return
+        now = time.monotonic()
+        if now - self._last_refresh < self.refresh_s:
+            return
+        self._last_refresh = now
+        try:
+            plcs = self.store.list("PriorityLevelConfiguration",
+                                   copy_objects=False)
+            fss = self.store.list("FlowSchema", copy_objects=False)
+        except Exception:  # noqa: BLE001 — config reload is best-effort;
+            # a throttled/faulted store must not take admission down with it
+            return
+        levels = {name: dict(spec)
+                  for name, spec in DEFAULT_PRIORITY_LEVELS.items()}
+        for plc in plcs:
+            levels[plc.metadata.name] = dict(plc.spec)
+        schemas = {s["name"]: dict(s) for s in DEFAULT_FLOW_SCHEMAS}
+        for fs in fss:
+            schemas[fs.metadata.name] = {"name": fs.metadata.name,
+                                         **fs.spec}
+        self._apply_config(levels, list(schemas.values()))
+
+    # ---- classification ----
+
+    def classify(self, user: Any, verb: str,
+                 resource: str) -> tuple[_Schema, str]:
+        """-> (schema, flow key). The distinguisher is the user name (the
+        reference's ByUser flow distinguisher), so each tenant is its own
+        flow inside the level."""
+        self._maybe_refresh()
+        name = getattr(user, "name", None) or _ANONYMOUS
+        groups = tuple(getattr(user, "groups", ()) or ())
+        if name == _ANONYMOUS:
+            groups = groups + ("system:unauthenticated",)
+        for schema in self.schemas:
+            if schema.matches(name, groups, verb, resource):
+                return schema, f"{schema.name}/{name}"
+        return self.schemas[-1], f"{self.schemas[-1].name}/{name}"
+
+    # ---- seats ----
+
+    def _shuffle_shard(self, level: _Level, flow: str) -> deque:
+        """Hash the flow key over `hand_size` candidate queues and take the
+        shortest — two flows rarely share a whole hand, so a saturated flow
+        cannot blanket every queue (shuffle sharding, apf queueset)."""
+        best = None
+        n = len(level.queues)
+        for i in range(level.hand_size):
+            idx = zlib.crc32(f"{flow}/{i}".encode()) % n
+            q = level.queues[idx]
+            if best is None or len(q) < len(best):
+                best = q
+        return best
+
+    def _retry_after(self, level: _Level) -> float:
+        """Honest hint: roughly how long until this level's backlog drains
+        at its seat budget (floored at 1s, the reference's constant)."""
+        if level.limit <= 0:
+            return 1.0
+        return max(1.0, round(level.queued() / level.limit, 1))
+
+    async def acquire(self, user: Any, verb: str, resource: str,
+                      width: int = 1) -> _Seat:
+        """Admit or queue one request; raises FlowRejected (429) when the
+        level is saturated and its fair queue is full, when the controller
+        has no concurrency at all, or when the queue wait times out.
+
+        `width` is the work estimate in seats (the reference's APF work
+        estimator): an expensive collection LIST occupies several seats so
+        a handful of big lists cannot monopolize the level the way a
+        handful of cheap GETs never could. Clamped to the level's limit so
+        an over-wide request can still be admitted on an idle level."""
+        schema, flow = self.classify(user, verb, resource)
+        level = self.levels[schema.level]
+        mx = _flow_metrics()
+        if level.limit <= 0:
+            self._count(self.rejected, schema.name)
+            mx[1].labels(schema.name).inc()
+            raise FlowRejected(
+                f"too many requests: priority level {level.name!r} has no "
+                f"concurrency", retry_after=self._retry_after(level))
+        width = max(1, min(int(width), level.limit))
+        # fast path only when nobody is queued: with widths, spare seats can
+        # coexist with a parked wide waiter, and a fresh narrow request must
+        # not sneak past it
+        if level.in_flight + width <= level.limit and level.queued() == 0:
+            level.in_flight += width
+            self._count(self.dispatched, schema.name)
+            mx[0].labels(schema.name).inc()
+            return _Seat(level, schema.name, width)
+        queue = self._shuffle_shard(level, flow)
+        if len(queue) >= level.queue_length:
+            self._count(self.rejected, schema.name)
+            mx[1].labels(schema.name).inc()
+            raise FlowRejected(
+                f"too many requests: flow {flow!r} queue is full "
+                f"({level.queue_length} waiting)",
+                retry_after=self._retry_after(level))
+        fut = asyncio.get_running_loop().create_future()
+        entry = (fut, width)
+        queue.append(entry)
+        self._count(self.queued, schema.name)
+        mx[2].labels(schema.name).inc()
+        try:
+            await asyncio.wait_for(fut, self.queue_wait_s)
+        except asyncio.TimeoutError:
+            try:
+                queue.remove(entry)
+            except ValueError:
+                pass
+            self._count(self.rejected, schema.name)
+            mx[1].labels(schema.name).inc()
+            raise FlowRejected(
+                f"too many requests: flow {flow!r} timed out after "
+                f"{self.queue_wait_s:.0f}s in queue",
+                retry_after=self._retry_after(level)) from None
+        # _dispatch_waiters already charged our width against in_flight
+        self._count(self.dispatched, schema.name)
+        mx[0].labels(schema.name).inc()
+        return _Seat(level, schema.name, width)
+
+    def release(self, seat: _Seat | None) -> None:
+        """Return the seat's width to the level, then hand the freed
+        capacity to queued waiters (round-robin across non-empty queues, so
+        no flow's queue starves)."""
+        if seat is None:
+            return
+        seat.level.in_flight -= seat.width
+        self._dispatch_waiters(seat.level)
+
+    @staticmethod
+    def _dispatch_waiters(level: _Level) -> None:
+        """Wake queued waiters while their widths fit in the freed
+        capacity. One waiter per queue per pass (round-robin); within a
+        queue strict FIFO, so a narrow request never sneaks past a wide
+        one parked ahead of it in the same queue."""
+        n = len(level.queues)
+        while True:
+            dispatched = False
+            for off in range(n):
+                qi = (level._next_q + off) % n
+                q = level.queues[qi]
+                while q and q[0][0].cancelled():
+                    q.popleft()  # timed-out waiter already gave up
+                if not q:
+                    continue
+                fut, width = q[0]
+                if level.in_flight + width > level.limit:
+                    continue  # this queue's head doesn't fit; try others
+                q.popleft()
+                level.in_flight += width
+                level._next_q = (qi + 1) % n
+                fut.set_result(True)
+                dispatched = True
+                break  # restart the scan from the new round-robin cursor
+            if not dispatched:
+                return
+
+    def note_latency(self, seat: _Seat | None, seconds: float) -> None:
+        if seat is None:
+            return
+        samples = self.latency_samples.get(seat.flow)
+        if samples is None:
+            samples = self.latency_samples.setdefault(
+                seat.flow, deque(maxlen=8192))
+        samples.append(seconds)
+
+    @staticmethod
+    def _count(counter: dict, flow: str) -> None:
+        counter[flow] = counter.get(flow, 0) + 1
+
+    def p99_ms(self, flow: str) -> float:
+        """p99 of the recorded seat latencies for one flow schema, in ms
+        (0.0 with no samples) — the overload drill's bounded-latency
+        figure, readable cross-thread."""
+        samples = sorted(self.latency_samples.get(flow, ()))
+        if not samples:
+            return 0.0
+        return 1e3 * samples[min(len(samples) - 1,
+                                 int(0.99 * (len(samples) - 1)))]

@@ -46,6 +46,7 @@ from kubernetes_tpu.api.objects import Binding
 from kubernetes_tpu.obs import metrics as obs_metrics
 from kubernetes_tpu.obs.http import http_head, obs_response
 from kubernetes_tpu.apiserver.admission import AdmissionError
+from kubernetes_tpu.apiserver.flowcontrol import FlowRejected
 from kubernetes_tpu.apiserver.validation import ValidationError
 from kubernetes_tpu.apiserver.store import (
     AlreadyExists,
@@ -93,6 +94,9 @@ RESOURCES: dict[str, str] = {
     "nodegroups": "NodeGroup",
     # scheduling.k8s.io (pod priority & preemption)
     "priorityclasses": "PriorityClass",
+    # flowcontrol.ktpu.io (API priority & fairness)
+    "flowschemas": "FlowSchema",
+    "prioritylevelconfigurations": "PriorityLevelConfiguration",
     "roles": "Role",
     "clusterroles": "ClusterRole",
     "rolebindings": "RoleBinding",
@@ -115,6 +119,7 @@ KIND_TO_CLS = {cls.kind: cls for cls in (
     objs.Secret, objs.ConfigMap, objs.ServiceAccount, objs.DaemonSet,
     objs.CronJob, objs.HorizontalPodAutoscaler, objs.PodDisruptionBudget,
     objs.APIService, objs.PodGroup, objs.NodeGroup, objs.PriorityClass,
+    objs.FlowSchema, objs.PriorityLevelConfiguration,
     objs.Role, objs.ClusterRole,
     objs.RoleBinding, objs.ClusterRoleBinding,
     objs.CertificateSigningRequest)}
@@ -280,7 +285,8 @@ class APIServer:
                  max_in_flight: int = 400,
                  tls_cert_file: str | None = None,
                  tls_key_file: str | None = None,
-                 client_ca_file: str | None = None):
+                 client_ca_file: str | None = None,
+                 watch_cache: bool = False):
         self.store = store
         self.host = host
         self.port = port
@@ -299,15 +305,24 @@ class APIServer:
         # WithAudit (config.go:474): one JSON line per request decision
         self._audit = open(audit_path, "a", encoding="utf-8") \
             if audit_path else None
-        # WithMaxInFlightLimit (config.go:471): surplus requests get 429.
-        # Watches and node-proxy/aggregated relays bypass the counter BY
-        # DESIGN — the reference's longRunningRequestCheck exempts them
-        # (maxinflight.go), since informer watches would otherwise pin the
-        # budget permanently. On this single event loop the counter only
-        # exceeds 1 across awaits (the aggregation relay), which is also
-        # where a slow backend would otherwise queue unboundedly.
+        # APF (WithPriorityAndFairness, config.go:470) replaces the flat
+        # WithMaxInFlightLimit gate: `max_in_flight` becomes the total seat
+        # budget split across priority levels by their shares, with per-flow
+        # fair queues behind it — a noisy tenant saturates its own level's
+        # queues and gets honest 429+Retry-After while scheduler/kubelet
+        # traffic keeps flowing through the `system` level. Watches and
+        # node-proxy/aggregated relays bypass the filter BY DESIGN — the
+        # reference's longRunningRequestCheck exempts them (maxinflight.go),
+        # since informer watches would otherwise pin the budget permanently.
         self._in_flight = 0
         self.max_in_flight = max_in_flight
+        from kubernetes_tpu.apiserver.flowcontrol import FlowController
+
+        self.flow = FlowController(max_in_flight, store=store)
+        # watch cache: one store subscription fanned out to N HTTP watchers
+        # (constructed lazily on the serving loop at first watch)
+        self._watch_cache_enabled = watch_cache
+        self.watch_cache = None
 
     def _audit_log(self, user, method: str, path: str, status: int,
                    latency_ms: float | None = None,
@@ -414,6 +429,9 @@ class APIServer:
         self.port = self._server.sockets[0].getsockname()[1]
 
     async def stop(self) -> None:
+        if self.watch_cache is not None:
+            self.watch_cache.stop()
+            self.watch_cache = None
         if self._server is not None:
             self._server.close()
             await self._server.wait_closed()
@@ -483,19 +501,6 @@ class APIServer:
                                     latency_ms=1e3 * lat,
                                     response_bytes=nbytes)
                     return
-                if self._in_flight >= self.max_in_flight:
-                    # WithMaxInFlightLimit: shed load instead of queueing
-                    # unboundedly (reference returns 429 + Retry-After)
-                    nbytes = await _respond(writer, 429, {
-                        "kind": "Status", "reason": "TooManyRequests",
-                        "message": "too many requests, please try again "
-                                   "later"})
-                    lat = _time.perf_counter() - t_start
-                    self._observe_request(method, url.path, 429, lat)
-                    self._audit_log(user, method, target, 429,
-                                    latency_ms=1e3 * lat,
-                                    response_bytes=nbytes)
-                    return
                 if query.get("watch") in ("1", "true"):
                     svc = self._api_service_for(url.path)
                     if svc is not None:
@@ -524,9 +529,35 @@ class APIServer:
                         user, method, target, status,
                         latency_ms=1e3 * (_time.perf_counter() - t_start))
                     return  # the relay owns the connection
+                # APF: classify into a flow and take a seat, queueing
+                # fairly behind the level's concurrency share — or shed
+                # with an honest 429 + Retry-After hint when the flow's
+                # queues are full (WithPriorityAndFairness position)
+                try:
+                    seat = await self.flow.acquire(
+                        user, method, _resource_of(url.path),
+                        width=self._request_width(method, url.path))
+                except FlowRejected as rejected:
+                    nbytes = await _respond(
+                        writer, 429, {
+                            "kind": "Status", "reason": "TooManyRequests",
+                            "message": str(rejected)},
+                        extra_headers={"Retry-After": str(
+                            max(1, round(rejected.retry_after)))})
+                    lat = _time.perf_counter() - t_start
+                    self._observe_request(method, url.path, 429, lat)
+                    self._audit_log(user, method, target, 429,
+                                    latency_ms=1e3 * lat,
+                                    response_bytes=nbytes)
+                    return
                 self._in_flight += 1
                 _request_metrics()[2].set(self._in_flight)
                 try:
+                    # hold the seat across one loop tick: the route work
+                    # below is synchronous, so without a suspension point
+                    # here no two requests would ever hold seats at once
+                    # and the fair queues could never engage
+                    await asyncio.sleep(0)
                     proxied = await self._aggregate(
                         method, target, body,
                         content_type=headers.get("content-type",
@@ -547,10 +578,12 @@ class APIServer:
                 finally:
                     self._in_flight -= 1
                     _request_metrics()[2].set(self._in_flight)
+                    self.flow.release(seat)
                 keep = headers.get("connection", "keep-alive").lower() != "close"
                 nbytes = await _respond(writer, status, payload,
                                         keep_alive=keep, binary=accept_pb)
                 lat = _time.perf_counter() - t_start
+                self.flow.note_latency(seat, lat)
                 self._observe_request(method, url.path, status, lat)
                 self._audit_log(user, method, target, status,
                                 latency_ms=1e3 * lat, response_bytes=nbytes)
@@ -560,6 +593,24 @@ class APIServer:
             pass
         finally:
             writer.close()
+
+    def _request_width(self, method: str, path: str) -> int:
+        """APF work estimator (apf listWorkEstimator): a collection GET
+        costs extra seats proportional to the collection size, so a few
+        concurrent big LISTs fill their level and the surplus queues or
+        sheds instead of stacking serialization work on the serving loop.
+        Everything else costs 1 seat."""
+        if method != "GET":
+            return 1
+        try:
+            _ns, plural, name, _sub = _split_path(path)
+        except NotFound:
+            return 1
+        kind = RESOURCES.get(plural)
+        if name is not None or kind is None:
+            return 1
+        count = len(self.store._objects.get(kind, ()))
+        return 1 + min(9, count // 50)
 
     # ---- node proxy (pkg/registry/core/node/rest proxy subresource) ----
 
@@ -794,7 +845,8 @@ class APIServer:
         "Node", "PersistentVolume", "Namespace",
         "CustomResourceDefinition", "APIService", "Cluster",
         "ClusterRole", "ClusterRoleBinding",
-        "CertificateSigningRequest"})
+        "CertificateSigningRequest",
+        "FlowSchema", "PriorityLevelConfiguration"})
 
     def _discovery(self, method: str, path: str):
         """-> (status, payload) for discovery paths, else None."""
@@ -1040,8 +1092,18 @@ class APIServer:
             await _respond(writer, 404, {"message": str(e)})
             return
         since = query.get("resourceVersion")
+        source = self.store
+        if self._watch_cache_enabled:
+            if self.watch_cache is None:
+                # first watch constructs + primes the cache ON the serving
+                # loop (start() is synchronous up to task spawn, so no
+                # event lands between priming and subscribing)
+                from kubernetes_tpu.apiserver.watchcache import WatchCache
+
+                self.watch_cache = WatchCache(self.store).start()
+            source = self.watch_cache
         try:
-            stream = self.store.watch(
+            stream = source.watch(
                 kind, since=int(since) if since else None)
         except Expired as e:
             # 410 Gone — the Reflector relists (watch.go / cacher semantics)
@@ -1092,7 +1154,8 @@ def _wire_loads(body: bytes) -> dict:
 
 
 async def _respond(writer: asyncio.StreamWriter, status: int, payload,
-                   keep_alive: bool = False, binary: bool = False) -> int:
+                   keep_alive: bool = False, binary: bool = False,
+                   extra_headers: dict[str, str] | None = None) -> int:
     """Write one response; returns the body size in bytes (the audit
     trail's responseBytes field)."""
     content_type = "application/json"
@@ -1103,12 +1166,15 @@ async def _respond(writer: asyncio.StreamWriter, status: int, payload,
         body = json.dumps(payload).encode()
     reason = {200: "OK", 201: "Created", 400: "Bad Request", 404: "Not Found",
               405: "Method Not Allowed", 409: "Conflict",
-              410: "Gone"}.get(status, "Error")
+              410: "Gone", 429: "Too Many Requests"}.get(status, "Error")
     conn = "keep-alive" if keep_alive else "close"
+    extras = "".join(f"{k}: {v}\r\n"
+                     for k, v in (extra_headers or {}).items())
     writer.write(
         f"HTTP/1.1 {status} {reason}\r\n"
         f"Content-Type: {content_type}\r\n"
         f"Content-Length: {len(body)}\r\n"
+        f"{extras}"
         f"Connection: {conn}\r\n\r\n".encode() + body)
     await writer.drain()
     return len(body)
@@ -1284,8 +1350,8 @@ class RemoteStore:
                  content_type: str | None = None):
         if self.rate_limiter is not None:
             self.rate_limiter.accept()
-        status, decoded = self._request_once(method, path, body,
-                                             content_type)
+        status, decoded, resp_headers = self._request_once(
+            method, path, body, content_type)
         if status == 400 and self._pb and body is not None \
                 and content_type is None:
             # codec-asymmetric fleet: a server without the codec can't
@@ -1294,8 +1360,19 @@ class RemoteStore:
             self._pb = False
             log.warning("server cannot decode protobuf bodies; "
                         "downgrading client to JSON")
-            status, decoded = self._request_once(method, path, body)
-        return self._raise_for_status(status, decoded)
+            status, decoded, resp_headers = self._request_once(
+                method, path, body)
+        try:
+            return self._raise_for_status(status, decoded, resp_headers)
+        except TooManyRequests as e:
+            # server-side flow control: the Retry-After hint pauses this
+            # client's own token bucket so every later call backs off too,
+            # not just the caller that saw the 429
+            hint = getattr(e, "retry_after", 0.0)
+            if hint and self.rate_limiter is not None \
+                    and hasattr(self.rate_limiter, "note_retry_after"):
+                self.rate_limiter.note_retry_after(hint)
+            raise
 
     def _request_once(self, method: str, path: str,
                       body: dict | None = None,
@@ -1336,14 +1413,19 @@ class RemoteStore:
             # TLS socket): a transport failure, not a protocol answer
             raise ConnectionError(
                 "empty or non-HTTP reply from server") from None
+        resp_headers: dict[str, str] = {}
+        for line in head.split(b"\r\n")[1:]:
+            hname, _, hval = line.decode("latin-1").partition(":")
+            resp_headers[hname.strip().lower()] = hval.strip()
         if resp_body and wire.CONTENT_TYPE.encode() in head.lower():
             decoded = wire.decode_payload(resp_body)  # ValueError on corrupt
         else:
             decoded = json.loads(resp_body) if resp_body else {}
-        return status, decoded
+        return status, decoded, resp_headers
 
     @staticmethod
-    def _raise_for_status(status: int, decoded: dict):
+    def _raise_for_status(status: int, decoded: dict,
+                          headers: dict[str, str] | None = None):
         if status == 404:
             raise NotFound(decoded.get("message", "not found"))
         if status in (401, 403):
@@ -1364,6 +1446,11 @@ class RemoteStore:
             exc.causes = tuple(
                 c.get("reason", "") for c in
                 (decoded.get("details") or {}).get("causes") or [])
+            try:
+                exc.retry_after = float(
+                    (headers or {}).get("retry-after", 0))
+            except ValueError:
+                exc.retry_after = 0.0
             raise exc
         if status >= 400:
             raise ValueError(f"HTTP {status}: {decoded.get('message')}")

@@ -112,7 +112,8 @@ class ObjectStore:
 
     def __init__(self, watch_window: int = 4096,
                  persist_path: str | None = None, admission=None,
-                 watcher_queue_limit: int | None = None):
+                 watcher_queue_limit: int | None = None,
+                 snapshot_every: int = 0):
         self._objects: dict[str, dict[tuple[str, str], Any]] = {}
         self._rv = 0
         self._history: deque[WatchEvent] = deque(maxlen=watch_window)
@@ -125,16 +126,85 @@ class ObjectStore:
         self._watchers: list[_Watcher] = []
         self._wal = None
         self._cluster_ip_counter = 0
+        # store-side watch fan-out cost: one count per event put onto one
+        # subscriber queue. With the WatchCache in front, the store has ONE
+        # subscriber and this advances exactly once per published event no
+        # matter how many HTTP watchers exist — the fan-out drill's counter
+        self.fanout_puts = 0
+        # snapshot-backed WAL: after `snapshot_every` log appends, compact()
+        # writes a snapshot and truncates the log (0 = manual compact only)
+        self.snapshot_every = snapshot_every
+        self.compactions = 0
+        self._wal_records = 0
+        self._persist_path = persist_path
         # admission chain (apiserver/admission.py) applied to create/update
         # — the reference's handler-chain position in front of the registry
         self.admission = admission
         if persist_path:
-            self._replay_wal(persist_path)
+            snap_rv, snap_valid = self._load_snapshot(persist_path + ".snap")
+            self._replay_wal(persist_path,
+                             min_rv=snap_rv if snap_valid else 0)
             self._wal = open(persist_path, "a", encoding="utf-8")
 
     # ---- write-ahead log ----
 
-    def _replay_wal(self, path: str) -> None:
+    def _load_snapshot(self, snap_path: str) -> tuple[int, bool]:
+        """Load a compaction snapshot -> (snapshot rv, trailer valid).
+
+        Snapshot format is JSON lines: a SNAP header carrying the
+        resourceVersion at snapshot time, one OBJ line per stored object,
+        and an END trailer with the object count. Torn snapshots (crash/
+        truncation mid-write — the tmp+rename protocol makes this rare but
+        a torn tail is still possible on some filesystems) keep the valid
+        prefix, exactly the WAL's torn-record contract; an invalid trailer
+        additionally disables the WAL's rv-guard so no record is skipped
+        on the strength of a snapshot that cannot vouch for itself."""
+        import json
+        import os
+
+        from kubernetes_tpu.apiserver.http import decode_object
+
+        if not os.path.exists(snap_path):
+            return 0, True
+        snap_rv = 0
+        loaded = skipped = 0
+        expected: int | None = None
+        with open(snap_path, encoding="utf-8", errors="replace") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    entry = json.loads(line)
+                    op = entry["op"]
+                    if op == "SNAP":
+                        snap_rv = int(entry["rv"])
+                        continue
+                    if op == "END":
+                        expected = int(entry["count"])
+                        break
+                    obj = decode_object(entry["kind"], entry["obj"])
+                    obj.metadata.resource_version = str(int(entry["rv"]))
+                    self._bucket(entry["kind"])[
+                        (entry["ns"], entry["name"])] = obj
+                    if entry["kind"] == "Service":
+                        self._reserve_cluster_ip(
+                            obj.spec.get("clusterIP", ""))
+                    self._rv = max(self._rv, int(entry["rv"]))
+                except Exception:  # noqa: BLE001 — keep the valid prefix
+                    skipped += 1
+                    continue
+                loaded += 1
+        valid = expected is not None and expected == loaded and not skipped
+        self._rv = max(self._rv, snap_rv if valid else 0)
+        if not valid:
+            log.warning(
+                "torn snapshot %s: loaded %d objects (trailer %s, %d "
+                "corrupt lines); replaying the full WAL on top",
+                snap_path, loaded, expected, skipped)
+        return snap_rv, valid
+
+    def _replay_wal(self, path: str, min_rv: int = 0) -> None:
         import json
         import os
 
@@ -156,6 +226,12 @@ class ObjectStore:
                     entry = json.loads(line)
                     kind = entry["kind"]
                     rv = int(entry["rv"])
+                    if rv <= min_rv:
+                        # predates the snapshot: a crash between the
+                        # snapshot rename and the WAL truncate leaves the
+                        # old log behind; the snapshot already holds this
+                        # state (rv-guarded only when its trailer is valid)
+                        continue
                     if entry["op"] == "DELETE":
                         self._bucket(kind).pop(
                             (entry["ns"], entry["name"]), None)
@@ -195,6 +271,48 @@ class ObjectStore:
         self._wal.write(json.dumps(entry) + "\n")
         if flush:
             self._wal.flush()
+        self._wal_records += 1
+        if self.snapshot_every and self._wal_records >= self.snapshot_every:
+            self.compact()
+
+    def compact(self) -> None:
+        """Revision compaction: snapshot the live object set and truncate
+        the WAL (etcd's compact+snapshot collapsed into one step — replay
+        cost and log size become proportional to live state, not total
+        writes, so a week-long churn run doesn't grow the log unboundedly).
+
+        Crash-safe: the snapshot is written to a tmp file, fsynced, and
+        atomically renamed before the log truncates. A crash between the
+        rename and the truncate leaves stale WAL records behind; recovery
+        skips records at or below the snapshot's revision (only when the
+        snapshot trailer validates — a torn snapshot replays everything,
+        preferring a double-apply over data loss)."""
+        import json
+        import os
+
+        if not self._persist_path:
+            return
+        snap_path = self._persist_path + ".snap"
+        tmp_path = snap_path + ".tmp"
+        count = 0
+        with open(tmp_path, "w", encoding="utf-8") as f:
+            f.write(json.dumps({"op": "SNAP", "rv": self._rv}) + "\n")
+            for kind, bucket in self._objects.items():
+                for (ns, name), obj in bucket.items():
+                    f.write(json.dumps({
+                        "op": "OBJ", "kind": kind, "ns": ns, "name": name,
+                        "rv": int(obj.metadata.resource_version or 0),
+                        "obj": obj.to_dict()}) + "\n")
+                    count += 1
+            f.write(json.dumps({"op": "END", "count": count}) + "\n")
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp_path, snap_path)
+        if self._wal is not None:
+            self._wal.close()
+            self._wal = open(self._persist_path, "w", encoding="utf-8")
+        self._wal_records = 0
+        self.compactions += 1
 
     def _allocate_node_ports(self, svc) -> None:
         """NodePort allocation from the conventional 30000-32767 range for
@@ -361,6 +479,7 @@ class ObjectStore:
                 for ev in events:
                     if kind is None or kind == ev.kind:
                         put(ev)
+                        self.fanout_puts += 1
             except asyncio.QueueFull:
                 self._evict_watcher(watcher)
         events.clear()
@@ -606,6 +725,7 @@ class ObjectStore:
             try:
                 for ev in events:
                     put(ev)
+                    self.fanout_puts += 1
             except asyncio.QueueFull:
                 self._evict_watcher(watcher)
         return bound, errors
@@ -653,6 +773,7 @@ class ObjectStore:
             if watcher.kind is None or watcher.kind == event.kind:
                 try:
                     watcher.queue.put_nowait(event)
+                    self.fanout_puts += 1
                 except asyncio.QueueFull:
                     self._evict_watcher(watcher)
 

@@ -48,6 +48,10 @@ def validate(obj: Any) -> None:
         _validate_nodegroup(obj)
     elif kind == "PriorityClass":
         _validate_priorityclass(obj)
+    elif kind == "FlowSchema":
+        _validate_flowschema(obj)
+    elif kind == "PriorityLevelConfiguration":
+        _validate_prioritylevel(obj)
 
 
 def _validate_quantities(where: str, quantities: dict) -> dict:
@@ -153,6 +157,50 @@ def _validate_priorityclass(obj) -> None:
         raise ValidationError(
             f"value: {value} is greater than the highest user-definable "
             f"priority (1000000000)")
+
+
+def _validate_flowschema(obj) -> None:
+    if not obj.priority_level:
+        raise ValidationError("spec.priorityLevel: must name a priority "
+                              "level")
+    try:
+        precedence = obj.matching_precedence
+    except (TypeError, ValueError):
+        raise ValidationError(
+            f"spec.matchingPrecedence: invalid value "
+            f"{obj.spec.get('matchingPrecedence')!r}")
+    if precedence < 1:
+        raise ValidationError("spec.matchingPrecedence: must be >= 1")
+    rules = obj.spec.get("rules")
+    if rules is not None and not isinstance(rules, list):
+        raise ValidationError("spec.rules: must be a list of rule objects")
+    for i, rule in enumerate(rules or []):
+        if not isinstance(rule, dict):
+            raise ValidationError(f"spec.rules[{i}]: must be an object")
+        for key in ("users", "groups", "verbs", "resources"):
+            val = rule.get(key)
+            if val is not None and not isinstance(val, list):
+                raise ValidationError(
+                    f"spec.rules[{i}].{key}: must be a list")
+
+
+def _validate_prioritylevel(obj) -> None:
+    try:
+        shares, queues = obj.shares, obj.queues
+        qlen, hand = obj.queue_length_limit, obj.hand_size
+    except (TypeError, ValueError):
+        raise ValidationError(
+            f"spec: invalid queueing configuration {obj.spec!r}")
+    if shares < 1:
+        raise ValidationError("spec.shares: must be >= 1")
+    if queues < 1:
+        raise ValidationError("spec.queues: must be >= 1")
+    if qlen < 1:
+        raise ValidationError("spec.queueLengthLimit: must be >= 1")
+    if not 1 <= hand <= queues:
+        raise ValidationError(
+            f"spec.handSize: must be between 1 and spec.queues "
+            f"({hand} vs {queues})")
 
 
 def _validate_workload(obj) -> None:

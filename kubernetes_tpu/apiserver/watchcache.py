@@ -1,0 +1,293 @@
+"""Watch cache: one store subscription fanned out to N watch subscribers.
+
+The cacher analog (reference apiserver/pkg/storage/cacher.go): without it,
+every HTTP watcher is its own store subscriber, so each published event
+costs one store-side queue put per watcher — O(watchers) work inside the
+write path. The WatchCache subscribes to the store exactly ONCE (so 10k
+watchers cost one store read per event — `ObjectStore.fanout_puts` is the
+counter that proves it), keeps its own ring of recent events plus a
+latest-object map per kind, and dedicated fan-out worker tasks deliver to
+subscriber queues OFF the write path. Slow consumers are absorbed by their
+bounded queue and evicted when it overflows — without ever touching the
+store. A resume point older than the ring raises `Expired` (HTTP 410), the
+same Reflector-relist contract as the store itself.
+
+Single-loop discipline: everything here runs on the serving loop; `start()`
+primes the ring from the store's own history synchronously, so no event can
+land between priming and subscribing.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from collections import deque
+from typing import Any
+
+from kubernetes_tpu.apiserver.store import Expired, WatchEvent
+
+log = logging.getLogger(__name__)
+
+# end-of-stream marker for evicted subscribers (same protocol as the store)
+_EVICTED = object()
+
+_mx_evicted = None
+
+
+def _cache_evictions():
+    global _mx_evicted
+    if _mx_evicted is None:
+        from kubernetes_tpu.obs import metrics as m
+
+        _mx_evicted = m.REGISTRY.counter(
+            "watchcache_subscribers_evicted_total",
+            "Watch-cache subscribers evicted for exceeding their queue "
+            "bound (slow consumers must relist).")
+    return _mx_evicted
+
+
+class _CacheSub:
+    __slots__ = ("kind", "queue", "evicted", "worker", "min_rv")
+
+    def __init__(self, kind: str | None, maxsize: int, min_rv: int = 0):
+        self.kind = kind
+        self.queue: asyncio.Queue = asyncio.Queue(maxsize)
+        self.evicted = False
+        self.worker: _Worker | None = None
+        # events at or below this rv were already served from the ring
+        # backlog (or predate the subscriber's "now"): the fan-out skips
+        # them — unlike the store's synchronous subscribe, an event can
+        # already be in flight through the pump when a subscriber joins
+        self.min_rv = min_rv
+
+
+class _Worker:
+    """One fan-out shard: its own dispatch queue + subscriber slice."""
+
+    __slots__ = ("queue", "subs", "task")
+
+    def __init__(self):
+        self.queue: asyncio.Queue = asyncio.Queue()
+        self.subs: list[_CacheSub] = []
+        self.task: asyncio.Task | None = None
+
+
+class WatchCache:
+    """Fan-out cache in front of `ObjectStore.watch`.
+
+    `store` may be the raw ObjectStore or any proxy over it (FaultPlane,
+    RaceDetector) — the single subscription goes through the proxy, the
+    ring priming reads the underlying history."""
+
+    def __init__(self, store: Any, window: int | None = None,
+                 workers: int = 4, queue_limit: int | None = None):
+        self.store = store
+        self._ring: deque[WatchEvent] = deque(
+            maxlen=window or store._history.maxlen or 4096)
+        self._latest: dict[str, dict] = {}
+        self._queue_limit = store._watcher_queue_limit \
+            if queue_limit is None else queue_limit
+        self._workers = [_Worker() for _ in range(max(1, workers))]
+        self._last_rv = 0
+        self._stream = None
+        self._pump_task: asyncio.Task | None = None
+        self.started = False
+        # drill/test counters
+        self.events_total = 0
+        self.evictions = 0
+        self.rebuilds = 0
+
+    # ---- lifecycle ----
+
+    def start(self) -> "WatchCache":
+        """Prime from the store and subscribe — all synchronous on the
+        serving loop, so no event lands between priming and subscribing."""
+        if self.started:
+            return self
+        self._ring.extend(self.store._history)
+        self._last_rv = self.store.resource_version
+        self._latest = {kind: dict(bucket)
+                        for kind, bucket in self.store._objects.items()}
+        self._stream = self.store.watch(None)
+        loop = asyncio.get_running_loop()
+        self._pump_task = loop.create_task(self._pump())
+        for w in self._workers:
+            w.task = loop.create_task(self._fan_out(w))
+        self.started = True
+        return self
+
+    def stop(self) -> None:
+        if self._pump_task is not None:
+            self._pump_task.cancel()
+            self._pump_task = None
+        for w in self._workers:
+            if w.task is not None:
+                w.task.cancel()
+                w.task = None
+        if self._stream is not None:
+            self._stream.stop()
+            self._stream = None
+        self.started = False
+
+    # ---- the one store subscription ----
+
+    async def _pump(self) -> None:
+        while True:
+            event = await self._stream.next(timeout=5.0)
+            if event is None:
+                if getattr(self._stream, "_stopped", False):
+                    await self._resubscribe()
+                continue
+            self._ingest(event)
+
+    def _ingest(self, event: WatchEvent) -> None:
+        self._ring.append(event)
+        self._last_rv = max(self._last_rv, event.resource_version)
+        obj = event.obj
+        key = (obj.metadata.namespace or "default", obj.metadata.name)
+        bucket = self._latest.setdefault(event.kind, {})
+        if event.type == "DELETED":
+            bucket.pop(key, None)
+        else:
+            bucket[key] = obj
+        self.events_total += 1
+        for w in self._workers:
+            w.queue.put_nowait(event)
+
+    async def _resubscribe(self) -> None:
+        """The cache's own subscription died (forced expiry / eviction):
+        resume from the last seen revision, or — when that point is gone —
+        rebuild from a store snapshot and evict every subscriber, who must
+        relist exactly as if they had watched the store directly."""
+        try:
+            self._stream = self.store.watch(None, since=self._last_rv)
+            return
+        except Expired:
+            pass
+        self._latest = {kind: dict(bucket)
+                        for kind, bucket in self.store._objects.items()}
+        self._ring.clear()
+        self._last_rv = self.store.resource_version
+        self._stream = self.store.watch(None)
+        self.rebuilds += 1
+        for w in self._workers:
+            for sub in list(w.subs):
+                self._evict(sub)
+        log.warning("watch cache: resume point expired; rebuilt from "
+                    "store snapshot and evicted all subscribers")
+
+    # ---- fan-out ----
+
+    async def _fan_out(self, worker: _Worker) -> None:
+        while True:
+            event = await worker.queue.get()
+            for sub in list(worker.subs):
+                if event.resource_version <= sub.min_rv:
+                    continue
+                if sub.kind is None or sub.kind == event.kind:
+                    try:
+                        sub.queue.put_nowait(event)
+                    except asyncio.QueueFull:
+                        self._evict(sub)
+
+    def _evict(self, sub: _CacheSub) -> None:
+        worker = sub.worker
+        if worker is None:
+            return
+        try:
+            worker.subs.remove(sub)
+        except ValueError:
+            return  # already evicted/stopped
+        sub.evicted = True
+        try:
+            sub.queue.put_nowait(_EVICTED)
+        except asyncio.QueueFull:
+            pass  # a full queue can't block in get(): the flag suffices
+        self.evictions += 1
+        _cache_evictions().inc()
+
+    # ---- reads ----
+
+    def get_cached(self, kind: str, name: str,
+                   namespace: str = "default") -> Any | None:
+        """Latest object the cache has seen (read-only; may trail the
+        store by in-flight fan-out)."""
+        return self._latest.get(kind, {}).get((namespace or "default", name))
+
+    def watch(self, kind: str | None = None,
+              since: int | None = None) -> "CacheWatchStream":
+        """Subscribe through the cache — the `ObjectStore.watch` contract
+        (backlog from the ring, Expired when the resume point predates it),
+        but the subscriber costs the store nothing."""
+        backlog: list[WatchEvent] = []
+        if since is not None and since < self._last_rv:
+            oldest = self._ring[0].resource_version if self._ring \
+                else self._last_rv + 1
+            if since < oldest - 1:
+                raise Expired(f"resourceVersion {since} is too old "
+                              f"(cache window starts at {oldest})")
+            backlog = [e for e in self._ring
+                       if e.resource_version > since
+                       and (kind is None or kind == e.kind)]
+        if self._queue_limit and len(backlog) >= self._queue_limit:
+            raise Expired(
+                f"resume backlog of {len(backlog)} events exceeds the "
+                f"{self._queue_limit}-event subscriber bound")
+        sub = _CacheSub(kind, self._queue_limit,
+                        min_rv=self._last_rv if since is None else since)
+        worker = min(self._workers, key=lambda w: len(w.subs))
+        sub.worker = worker
+        worker.subs.append(sub)
+        for e in backlog:
+            sub.queue.put_nowait(e)
+        return CacheWatchStream(sub)
+
+    @property
+    def subscriber_count(self) -> int:
+        return sum(len(w.subs) for w in self._workers)
+
+
+class CacheWatchStream:
+    """WatchStream-compatible consumer side of one cache subscription."""
+
+    def __init__(self, sub: _CacheSub):
+        self._sub = sub
+        self._stopped = False
+
+    async def next(self, timeout: float | None = None) -> WatchEvent | None:
+        if self._stopped:
+            return None
+        if self._sub.evicted and self._sub.queue.empty():
+            self._stopped = True
+            return None
+        try:
+            if timeout is None:
+                ev = await self._sub.queue.get()
+            else:
+                ev = await asyncio.wait_for(self._sub.queue.get(), timeout)
+        except asyncio.TimeoutError:
+            return None
+        if ev is _EVICTED:
+            self._stopped = True  # stream over: the consumer must relist
+            return None
+        return ev
+
+    def stop(self) -> None:
+        if self._stopped:
+            return
+        self._stopped = True
+        worker = self._sub.worker
+        if worker is not None:
+            try:
+                worker.subs.remove(self._sub)
+            except ValueError:
+                pass
+
+    def __aiter__(self):
+        return self
+
+    async def __anext__(self) -> WatchEvent:
+        ev = await self.next()
+        if ev is None:
+            raise StopAsyncIteration
+        return ev

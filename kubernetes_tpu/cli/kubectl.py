@@ -661,7 +661,17 @@ def cmd_edit(client, args) -> int:
         return 0
     edited = decode_object(kind, json.loads(after))
     edited.metadata.namespace = obj.metadata.namespace
-    client.update(edited, check_version=False)
+    # pin the PUT to the version the editor buffer was rendered from: a
+    # write that landed while the editor was open must surface as a
+    # conflict, not be silently overwritten (the reference's edit loop
+    # re-opens the editor on exactly this error)
+    edited.metadata.resource_version = obj.metadata.resource_version
+    try:
+        client.update(edited)
+    except Conflict:
+        print(f"Error: {kind.lower()}/{args.name} changed while editing; "
+              f"re-run edit against the new version")
+        return 1
     print(f"{kind.lower()}/{args.name} edited")
     return 0
 

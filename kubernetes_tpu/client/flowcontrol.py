@@ -25,6 +25,20 @@ class TokenBucketRateLimiter:
         self.burst = max(1, burst)
         self._tokens = float(self.burst)
         self._last = time.monotonic()
+        # server-pushed backpressure: a 429's Retry-After hint holds the
+        # whole bucket shut until this deadline (client-go's
+        # WithRetryAfter coupling of server hints into client pacing)
+        self._hold_until = 0.0
+
+    def note_retry_after(self, seconds: float) -> None:
+        """Honor a server Retry-After hint: no token is granted until the
+        hint elapses (capped so one garbled header can't park a client
+        for minutes). RemoteStore calls this on every 429 that carries
+        the header."""
+        if seconds <= 0:
+            return
+        self._hold_until = max(
+            self._hold_until, time.monotonic() + min(seconds, 60.0))
 
     def _refill(self, now: float) -> None:
         self._tokens = min(self.burst,
@@ -35,7 +49,10 @@ class TokenBucketRateLimiter:
         """Take a token if available; else the seconds until one refills.
         Returns 0.0 on success (shared by both acquire paths, so sync and
         async callers drain one bucket with identical semantics)."""
-        self._refill(time.monotonic())
+        now = time.monotonic()
+        if now < self._hold_until:
+            return self._hold_until - now
+        self._refill(now)
         if self._tokens >= 1.0:
             self._tokens -= 1.0
             return 0.0

@@ -71,6 +71,9 @@ class Informer:
         self._backoff_max = relist_backoff_max
         self._relist_delay = relist_backoff_initial
         self._rng = rng if rng is not None else random
+        # server Retry-After hint from the last failed cycle: the next
+        # relist waits at least this long, whatever the local backoff says
+        self._retry_hint = 0.0
 
     def add_handler(self, handler: Handler) -> None:
         self._handlers.append(handler)
@@ -109,10 +112,14 @@ class Informer:
         while True:
             if not first:
                 # jittered (0.5x-1.5x) so N informers relisting after one
-                # store hiccup don't stampede it in lockstep
+                # store hiccup don't stampede it in lockstep; an APF
+                # Retry-After hint from the last 429 sets the floor — the
+                # server knows its queue depth better than local doubling
                 _metrics(self.kind)[3].inc()
                 delay = self._backoff_next()
-                await asyncio.sleep(delay * (0.5 + self._rng.random()))
+                hint, self._retry_hint = self._retry_hint, 0.0
+                await asyncio.sleep(
+                    max(hint, delay * (0.5 + self._rng.random())))
             first = False
             try:
                 await self._list_and_watch()
@@ -121,7 +128,9 @@ class Informer:
                 # the backoff, so the next relist runs at the base delay
             except asyncio.CancelledError:
                 return
-            except Exception:  # noqa: BLE001 — reflector loops survive anything
+            except Exception as e:  # noqa: BLE001 — reflector loops survive anything
+                self._retry_hint = float(
+                    getattr(e, "retry_after", 0.0) or 0.0)
                 log.exception("informer %s: list/watch failed; relisting",
                               self.kind)
 

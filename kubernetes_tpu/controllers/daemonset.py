@@ -280,6 +280,8 @@ class DaemonSetController(ReconcileController):
         fresh = fresh.clone()
         fresh.status = status
         try:
-            self.store.update(fresh, check_version=False)
+            # CAS against the informer-cache version: stale loses and the
+            # next resync writes the recomputed status
+            self.store.update(fresh)
         except Exception:  # noqa: BLE001 — status write is best-effort
             pass

@@ -207,7 +207,8 @@ class FederatedServiceController(ReconcileController):
             current = client.get("Service", name, ns)
         except NotFound:
             copy = svc.clone()
-            copy.metadata.resource_version = ""
+            # hub rv is meaningless in the member store: strip before CREATE
+            copy.metadata.resource_version = ""  # ktpu: allow[store-rmw]
             copy.metadata.labels = dict(copy.metadata.labels)
             copy.metadata.labels[CLUSTER_LABEL] = cluster.metadata.name
             try:

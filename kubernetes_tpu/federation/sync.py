@@ -218,7 +218,8 @@ class FederatedSyncController(ReconcileController):
             current = None
         if current is None:
             copy = rs.clone()
-            copy.metadata.resource_version = ""
+            # hub rv is meaningless in the member store: strip before CREATE
+            copy.metadata.resource_version = ""  # ktpu: allow[store-rmw]
             copy.metadata.labels = dict(copy.metadata.labels)
             copy.metadata.labels[CLUSTER_LABEL] = cluster.metadata.name
             copy.spec["replicas"] = want
@@ -233,7 +234,9 @@ class FederatedSyncController(ReconcileController):
             fresh.spec = dict(rs.spec)
             fresh.spec["replicas"] = want
             try:
-                client.update(fresh, check_version=False)
+                # CAS against the member's version just read: a racing
+                # member-side writer wins and the key is retried
+                client.update(fresh)
             except (Conflict, NotFound):
                 return True
         return False

@@ -630,3 +630,334 @@ def run_throughput(
     return asyncio.run(_run(n_nodes, n_pods, caps, policy, warmup_pods,
                             node_kwargs or {}, pod_kwargs or {}, mesh,
                             n_services=n_services))
+
+
+@dataclass
+class OverloadResult:
+    """Noisy-tenant overload drill: a tenant floods the HTTP apiserver at a
+    multiple of the scheduler's own request rate while a workload
+    schedules through it. APF must keep the scheduler flow's latency
+    bounded (p99 within 5x the unloaded baseline), every pod must bind
+    exactly once, and the flood must be shed with honest 429s — the API
+    plane stays alive instead of melting uniformly."""
+
+    nodes: int
+    pods: int
+    seed: int
+    flood_multiplier: float
+    bound: int
+    double_binds: int
+    # p99s are SERVER-side seat-to-response latencies for the scheduler's
+    # flow schema (FlowController.latency_samples) — what the API plane
+    # actually did to the scheduler, unpolluted by client-process GIL
+    # contention from the flood threads sharing the drill process
+    p99_unloaded_ms: float
+    p99_loaded_ms: float
+    flood_requests: int
+    flood_rejected: int
+    sched_rps: float
+    converged: bool
+    racy_writes: int = 0
+    loop_stalls: int = 0
+    max_stall_ms: float = 0.0
+    dispatched: dict = field(default_factory=dict)
+    rejected: dict = field(default_factory=dict)
+
+    @property
+    def p99_bounded(self) -> bool:
+        """The drill's latency contract: loaded p99 within 5x unloaded,
+        with a 100ms floor so a millisecond-scale unloaded baseline on a
+        busy CI box can't fail the drill on scheduler-jitter noise (at
+        drill scale the 5x term dominates)."""
+        return self.p99_loaded_ms <= max(5 * self.p99_unloaded_ms, 100.0)
+
+    def __str__(self) -> str:
+        return (f"overload N={self.nodes} P={self.pods} "
+                f"x{self.flood_multiplier:.0f} flood: {self.bound}/"
+                f"{self.pods} bound, sched p99 {self.p99_unloaded_ms:.1f}ms"
+                f" -> {self.p99_loaded_ms:.1f}ms, flood "
+                f"{self.flood_rejected}/{self.flood_requests} shed")
+
+
+def _p99_ms(samples) -> float:
+    if not samples:
+        return 0.0
+    ordered = sorted(samples)
+    return 1e3 * ordered[int(0.99 * (len(ordered) - 1))]
+
+
+def run_overload(n_nodes: int = 64, n_pods: int = 256, seed: int = 2026,
+                 flood_multiplier: float = 50.0, race_detect: bool = True,
+                 warm_pods: int = 32, probes: int = 40) -> OverloadResult:
+    """Blocking entry point for the noisy-tenant overload drill.
+
+    Topology is the deployment shape (tests/http_util.py): the APIServer —
+    APF + watch cache on, over a seeded FaultPlane (and RaceDetector +
+    loop-stall watchdog when race_detect) — runs its own event loop in a
+    background thread; the scheduler drives it over TCP as
+    system:kube-scheduler, and `FaultPlane.flood` fires the tenant's
+    seeded traffic storm from client threads."""
+    import random as _random
+    import socket as _socket
+    import threading
+
+    from kubernetes_tpu.api.objects import Node
+    from kubernetes_tpu.apiserver.auth import TokenAuthenticator, UserInfo
+    from kubernetes_tpu.apiserver.http import APIServer, RemoteStore
+    from kubernetes_tpu.apiserver.store import TooManyRequests
+    from kubernetes_tpu.scheduler import Scheduler
+    from kubernetes_tpu.testing.faults import FaultPlane
+    from kubernetes_tpu.testing.races import LoopStallWatchdog, RaceDetector
+
+    cap = {"cpu": "16", "memory": "32Gi", "pods": "110"}
+    inner = ObjectStore(watch_window=max(1 << 16, 8 * (n_pods + n_nodes)))
+    for i in range(n_nodes):
+        inner.create(Node.from_dict({
+            "metadata": {"name": f"ovl-{i}",
+                         "labels": {"kubernetes.io/hostname": f"ovl-{i}"}},
+            "status": {"allocatable": dict(cap), "capacity": dict(cap)}}))
+    plane = FaultPlane(inner, seed=seed)
+    server_store = RaceDetector(plane) if race_detect else plane
+    auth = TokenAuthenticator({
+        "sched-token": UserInfo("system:kube-scheduler",
+                                ("system:authenticated",)),
+        "tenant-token": UserInfo("tenant-a", ("system:authenticated",))})
+
+    started = threading.Event()
+    holder: dict = {}
+
+    def serve() -> None:
+        async def main():
+            server = APIServer(server_store, authenticator=auth,
+                               max_in_flight=64, watch_cache=True)
+            await server.start()
+            watchdog = LoopStallWatchdog().start() if race_detect else None
+            holder["server"] = server
+            holder["loop"] = asyncio.get_running_loop()
+            holder["shutdown"] = asyncio.Event()
+            started.set()
+            await holder["shutdown"].wait()
+            holder["stalls"] = watchdog.stop() if watchdog else []
+            await server.stop()
+
+        asyncio.run(main())
+
+    thread = threading.Thread(target=serve, daemon=True)
+    thread.start()
+    if not started.wait(30):
+        raise RuntimeError("overload drill: APIServer thread failed to start")
+    server = holder["server"]
+    host, port = server.host, server.port
+
+    flood_stop = threading.Event()
+    flood_lock = threading.Lock()
+    flood_counts = {"requests": 0, "rejected": 0}
+    flood_threads: list[threading.Thread] = []
+    flood_rate = {"rps": 20.0}
+
+    def flood_hook(flow: str, mult: float, rng: _random.Random) -> None:
+        # one thread per ~100 target rps, each pacing its share with
+        # seeded jitter so the burst pattern replays from the fault seed
+        rate = max(20.0, flood_rate["rps"]) * mult
+        n_threads = min(8, max(1, round(rate / 100)))
+
+        def storm(thread_seed: int) -> None:
+            r = _random.Random(thread_seed)
+            per = rate / n_threads
+            req = (f"GET /api/v1/pods HTTP/1.1\r\nHost: {host}\r\n"
+                   "Authorization: Bearer tenant-token\r\n"
+                   "Accept: application/json\r\n"
+                   "Connection: close\r\n\r\n").encode()
+            while not flood_stop.is_set():
+                status = 0
+                try:
+                    with _socket.create_connection((host, port),
+                                                   timeout=10) as sock:
+                        sock.sendall(req)
+                        head = b""
+                        while b"\r\n\r\n" not in head and len(head) < 65536:
+                            chunk = sock.recv(65536)
+                            if not chunk:
+                                break
+                            head += chunk
+                        # drain and DISCARD the body undecoded: the flood
+                        # must cost the SERVER — a real tenant parses its
+                        # responses on the tenant's machine, and json-
+                        # decoding 8 threads' worth of big lists in this
+                        # process would starve the serving loop's GIL and
+                        # corrupt the stall measurement
+                        while sock.recv(65536):
+                            pass
+                    status = int(head.split(None, 2)[1])
+                except Exception:
+                    pass
+                with flood_lock:
+                    flood_counts["requests"] += 1
+                    if status == 429:
+                        flood_counts["rejected"] += 1
+                flood_stop.wait(r.uniform(0.5, 1.5) / per)
+
+        for _ in range(n_threads):
+            t = threading.Thread(target=storm,
+                                 args=(rng.randrange(1 << 32),),
+                                 daemon=True)
+            t.start()
+            flood_threads.append(t)
+
+    plane.flood_hook = flood_hook
+
+    async def drive() -> OverloadResult:
+        # small bind batches on purpose: one bulk bind is a single
+        # synchronous store op on the serving loop, and the drill's
+        # zero->100ms-stall contract bounds how long any one op may run
+        caps = Capacities(num_nodes=1 << max(6, (n_nodes - 1).bit_length()),
+                          batch_pods=min(64, max(16, n_pods)))
+        sched_client = RemoteStore(host, port, token="sched-token")
+        creator = RemoteStore(host, port, token="sched-token")
+        sched = Scheduler(sched_client, caps=caps)
+        loop = asyncio.get_running_loop()
+        driver = loop.create_task(sched.run())
+
+        def create_with_retry(pod) -> None:
+            while True:
+                try:
+                    creator.create(pod)
+                    return
+                except TooManyRequests as e:
+                    # runs under asyncio.to_thread — never on the event loop
+                    time.sleep(max(0.05, getattr(e, "retry_after", 0.0)))  # ktpu: allow[blocking-in-async]
+
+        async def wait_bound(expect: int, timeout_s: float) -> bool:
+            deadline = time.monotonic() + timeout_s
+            while time.monotonic() < deadline:
+                pods = await asyncio.to_thread(creator.list, "Pod")
+                if sum(1 for p in pods if p.spec.node_name) >= expect:
+                    return True
+                await asyncio.sleep(0.1)
+            return False
+
+        # the scheduler flow's server-side latency samples — every create/
+        # list/bind the scheduler identity makes lands here, so each phase
+        # has the same request mix and the two p99s compare like for like
+        def sched_samples() -> list[float]:
+            return list(server.flow.latency_samples.get("system", ()))
+
+        # ---- phase A: unloaded baseline (convergence polling while the
+        # warm workload binds, then idle probes) ----
+        t_warm = time.perf_counter()
+        for pod in make_pods(warm_pods, cpu="100m", memory="64Mi",
+                             name_prefix="warm"):
+            await asyncio.to_thread(create_with_retry, pod)
+        warm_ok = await wait_bound(warm_pods, 120)
+        warm_s = max(time.perf_counter() - t_warm, 1e-3)
+        flood_rate["rps"] = max(
+            20.0, server.flow.dispatched.get("system", 0) / warm_s)
+        probe = RemoteStore(host, port, token="sched-token")
+        for _ in range(probes):
+            await asyncio.to_thread(probe.list, "Pod")
+            await asyncio.sleep(0.01)
+        n_unloaded = len(sched_samples())
+
+        # ---- phase B: the storm ----
+        plane.flood("tenant-a", flood_multiplier)
+        for pod in make_pods(n_pods, cpu="100m", memory="64Mi",
+                             name_prefix="ovl"):
+            await asyncio.to_thread(create_with_retry, pod)
+        conv = await wait_bound(warm_pods + n_pods, 240)
+        for _ in range(probes):
+            await asyncio.to_thread(probe.list, "Pod")
+        samples = sched_samples()
+        unloaded, loaded = samples[:n_unloaded], samples[n_unloaded:]
+        flood_stop.set()
+        for t in flood_threads:
+            t.join(timeout=5)
+        driver.cancel()
+        sched.stop()
+
+        double = sum(1 for v in plane.bind_counts.values() if v > 1)
+        return OverloadResult(
+            nodes=n_nodes, pods=warm_pods + n_pods, seed=seed,
+            flood_multiplier=flood_multiplier,
+            bound=len(plane.bind_counts), double_binds=double,
+            p99_unloaded_ms=_p99_ms(unloaded),
+            p99_loaded_ms=_p99_ms(loaded),
+            flood_requests=flood_counts["requests"],
+            flood_rejected=flood_counts["rejected"],
+            sched_rps=flood_rate["rps"],
+            converged=(warm_ok and conv and double == 0
+                       and len(plane.bind_counts) >= warm_pods + n_pods),
+            racy_writes=len(server_store.racy_writes) if race_detect else 0,
+            dispatched=dict(server.flow.dispatched),
+            rejected=dict(server.flow.rejected))
+
+    try:
+        result = asyncio.run(drive())
+    finally:
+        flood_stop.set()
+        holder["loop"].call_soon_threadsafe(holder["shutdown"].set)
+        thread.join(timeout=15)
+    stalls = holder.get("stalls", [])
+    result.loop_stalls = len(stalls)
+    result.max_stall_ms = 1e3 * max(stalls, default=0.0)
+    return result
+
+
+@dataclass
+class FanoutResult:
+    """Watch-cache fan-out drill: N subscribers, M store events, and the
+    proof that the store did O(M) work — `store_fanout_puts` counts one
+    queue put per event (the cache's single subscription), not N*M."""
+
+    watchers: int
+    events: int
+    store_fanout_puts: int
+    deliveries: int
+    events_per_sec: float
+    evicted: int
+
+    def __str__(self) -> str:
+        return (f"fanout W={self.watchers} E={self.events}: store did "
+                f"{self.store_fanout_puts} puts, cache delivered "
+                f"{self.deliveries} ({self.events_per_sec:.0f}/s, "
+                f"{self.evicted} evicted)")
+
+
+async def _run_watch_fanout(watchers: int, events: int) -> FanoutResult:
+    from kubernetes_tpu.api.objects import Node
+    from kubernetes_tpu.apiserver.watchcache import WatchCache
+
+    store = ObjectStore(watch_window=max(1 << 14, 4 * events))
+    cache = WatchCache(store).start()
+    subs = [cache.watch("Node") for _ in range(watchers)]
+    base = store.fanout_puts
+    t0 = time.perf_counter()
+    store.create(Node.from_dict({"metadata": {"name": "fan"}}))
+    for i in range(events - 1):
+        store.guaranteed_update(
+            "Node", "fan", "default",
+            lambda n, i=i: n.metadata.labels.update({"tick": str(i)}))
+
+    async def drain(sub) -> int:
+        got = 0
+        while got < events:
+            ev = await sub.next(timeout=10.0)
+            if ev is None:
+                break
+            got += 1
+        return got
+
+    counts = await asyncio.gather(*(drain(s) for s in subs))
+    dt = max(time.perf_counter() - t0, 1e-9)
+    cache.stop()
+    return FanoutResult(
+        watchers=watchers, events=events,
+        store_fanout_puts=store.fanout_puts - base,
+        deliveries=sum(counts),
+        events_per_sec=sum(counts) / dt,
+        evicted=cache.evictions)
+
+
+def run_watch_fanout(watchers: int = 10_000,
+                     events: int = 100) -> FanoutResult:
+    """Blocking entry point for the watch-cache fan-out drill."""
+    return asyncio.run(_run_watch_fanout(watchers, events))

@@ -68,6 +68,7 @@ class FaultStats:
     delayed: int = 0
     solve_faults: int = 0
     actions_fired: list = field(default_factory=list)
+    floods: list = field(default_factory=list)     # noisy-tenant bursts
 
     @property
     def injected_total(self) -> int:
@@ -107,6 +108,10 @@ class FaultPlane:
         self.bind_counts: dict[str, int] = {}
         self._rng = random.Random(seed)
         self._schedule: list[_Action] = []
+        # noisy-tenant hook: flood() calls it with (flow, multiplier, rng)
+        # — the overload harness installs the traffic generator here
+        self.flood_hook: Callable[[str, float, random.Random], Any] | None \
+            = None
 
     # ---- schedule-driven disruptions ----
 
@@ -128,6 +133,19 @@ class FaultPlane:
         informers must notice and relist)."""
         for watcher in list(self.inner._watchers):
             self.inner._evict_watcher(watcher)
+
+    def flood(self, flow: str, rate_multiplier: float) -> None:
+        """Noisy-tenant burst: drive `flow`'s request rate to
+        `rate_multiplier`x the baseline. The plane records the action and
+        derives a child rng from its own seeded stream, so the traffic
+        generator installed via `flood_hook` (jitter, payload choice) is
+        replayable from KTPU_FAULT_SEED like every other action; without
+        a hook it is a recorded no-op (schedules still replay)."""
+        self.stats.floods.append(
+            {"flow": flow, "multiplier": rate_multiplier})
+        if self.flood_hook is not None:
+            self.flood_hook(flow, rate_multiplier,
+                            random.Random(self._rng.randrange(1 << 32)))
 
     # ---- the injection tick ----
 

@@ -1,0 +1,546 @@
+"""Overload resilience: APF flow control, the watch cache, and the
+snapshot-backed WAL (the noisy-tenant PR's test surface).
+
+Covers the server-side fairness plane (classification, seat accounting,
+shedding with honest Retry-After), the client side honoring those hints
+(rate limiter hold, informer relist floor), the watch cache's
+one-store-read-per-event contract with slow-consumer eviction and the
+Expired/410 relist path, store compaction/torn-snapshot recovery, the
+seeded flood action's replayability, and the bench --smoke overload config
+end to end.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import pytest
+
+from kubernetes_tpu.api.objects import (
+    FlowSchema,
+    Node,
+    ObjectMeta,
+    PriorityLevelConfiguration,
+)
+from kubernetes_tpu.apiserver.auth import TokenAuthenticator, UserInfo
+from kubernetes_tpu.apiserver.flowcontrol import FlowController, FlowRejected
+from kubernetes_tpu.apiserver.http import RemoteStore
+from kubernetes_tpu.apiserver.store import (
+    Expired,
+    ObjectStore,
+    TooManyRequests,
+)
+from kubernetes_tpu.apiserver.validation import ValidationError
+from kubernetes_tpu.apiserver.watchcache import WatchCache
+from kubernetes_tpu.client.flowcontrol import TokenBucketRateLimiter
+from kubernetes_tpu.client.informer import Informer
+from kubernetes_tpu.testing.faults import FaultPlane
+
+from tests.http_util import http_store
+
+SCHED = UserInfo("system:kube-scheduler", ("system:authenticated",))
+TENANT = UserInfo("tenant-a", ("system:authenticated",))
+
+
+# ---- APF classification + seats ----
+
+
+def test_classify_builtin_levels():
+    fc = FlowController(100)
+    schema, flow = fc.classify(SCHED, "list", "pods")
+    assert schema.name == "system"
+    assert flow == "system/system:kube-scheduler"
+    schema, flow = fc.classify(TENANT, "list", "pods")
+    assert schema.name == "workload"
+    # anonymous (user=None) falls through "*" (authenticated-only) to
+    # catch-all
+    schema, _ = fc.classify(None, "get", "nodes")
+    assert schema.name == "catch-all"
+
+
+def test_zero_concurrency_sheds_everything():
+    """total_concurrency=0 keeps the flat gate's test contract: every
+    request is rejected immediately with a Retry-After hint."""
+    fc = FlowController(0)
+
+    async def run():
+        with pytest.raises(FlowRejected) as ei:
+            await fc.acquire(SCHED, "list", "pods")
+        assert ei.value.retry_after >= 1.0
+
+    asyncio.run(run())
+    assert fc.rejected.get("system") == 1
+    assert not fc.dispatched
+
+
+def test_noisy_flow_sheds_while_system_keeps_seats():
+    """A tenant saturating its level queues and then sheds with 429 while
+    the scheduler flow still gets a seat — the drill's core property, at
+    unit scale via store-supplied PriorityLevelConfiguration overrides."""
+    store = ObjectStore()
+    store.create(PriorityLevelConfiguration(
+        metadata=ObjectMeta(name="workload"),
+        spec={"shares": 1, "queues": 1, "queueLengthLimit": 1,
+              "handSize": 1}))
+    fc = FlowController(4, store=store, queue_wait_s=0.1, refresh_s=0.0)
+
+    async def run():
+        # the override is live (refresh_s=0 reloads on classify)
+        schema, _ = fc.classify(TENANT, "create", "pods")
+        assert schema.name == "workload"
+        level = fc.levels["workload"]
+        assert level.limit == 1 and level.queue_length == 1
+
+        seat = await fc.acquire(TENANT, "create", "pods")
+        waiter = asyncio.ensure_future(fc.acquire(TENANT, "create", "pods"))
+        await asyncio.sleep(0.01)  # waiter parks in the fair queue
+        assert level.queued() == 1
+        # queue full -> immediate shed with an honest hint
+        with pytest.raises(FlowRejected) as ei:
+            await fc.acquire(TENANT, "create", "pods")
+        assert ei.value.retry_after >= 1.0
+        # the system flow is a different level: still admitted
+        sys_seat = await fc.acquire(SCHED, "bind", "pods")
+        fc.release(sys_seat)
+        # releasing transfers the seat to the queued waiter without
+        # touching in_flight
+        fc.release(seat)
+        seat2 = await waiter
+        assert level.in_flight == 1
+        fc.release(seat2)
+        assert level.in_flight == 0
+
+    asyncio.run(run())
+    assert fc.rejected.get("workload") == 1
+    assert fc.dispatched.get("system") == 1
+    assert fc.dispatched.get("workload") == 2
+    assert fc.queued.get("workload") == 1
+
+
+def test_queue_wait_timeout_sheds():
+    fc = FlowController(1, queue_wait_s=0.05)
+
+    async def run():
+        seat = await fc.acquire(SCHED, "list", "pods")
+        with pytest.raises(FlowRejected):
+            await fc.acquire(SCHED, "list", "pods")
+        fc.release(seat)
+
+    asyncio.run(run())
+    assert fc.rejected.get("system") == 1
+
+
+def test_flowschema_objects_route_flows():
+    """A store FlowSchema with lower precedence than the built-ins
+    reroutes its matched users onto a custom level."""
+    store = ObjectStore()
+    store.create(PriorityLevelConfiguration(
+        metadata=ObjectMeta(name="batch"),
+        spec={"shares": 2, "queues": 2, "queueLengthLimit": 4,
+              "handSize": 1}))
+    store.create(FlowSchema(
+        metadata=ObjectMeta(name="batch-users"),
+        spec={"priorityLevel": "batch", "matchingPrecedence": 50,
+              "rules": [{"users": ["batch-*"]}]}))
+    fc = FlowController(10, store=store, refresh_s=0.0)
+    schema, flow = fc.classify(UserInfo("batch-runner", ()), "list", "pods")
+    assert schema.name == "batch-users"
+    assert flow == "batch-users/batch-runner"
+    # unmatched users keep their built-in routing
+    assert fc.classify(TENANT, "list", "pods")[0].name == "workload"
+
+
+def test_flowcontrol_object_validation():
+    store = ObjectStore()
+    with pytest.raises(ValidationError):
+        store.create(FlowSchema(metadata=ObjectMeta(name="bad"),
+                                spec={"priorityLevel": ""}))
+    with pytest.raises(ValidationError):
+        store.create(PriorityLevelConfiguration(
+            metadata=ObjectMeta(name="bad"),
+            spec={"shares": -1}))
+    with pytest.raises(ValidationError):
+        store.create(PriorityLevelConfiguration(
+            metadata=ObjectMeta(name="bad"),
+            spec={"shares": 1, "queues": 2, "handSize": 3}))
+
+
+# ---- satellite: clients honor Retry-After ----
+
+
+def test_http_429_carries_retry_after_and_holds_rate_limiter():
+    """A shed request surfaces the server's Retry-After on the raised
+    TooManyRequests, and a RemoteStore with a rate limiter parks its whole
+    bucket for the hinted duration."""
+    with http_store(max_in_flight=0) as (client, _):
+        with pytest.raises(TooManyRequests) as ei:
+            client.list("Pod")
+        assert getattr(ei.value, "retry_after", 0.0) >= 1.0
+
+        limiter = TokenBucketRateLimiter(qps=1000, burst=10)
+        throttled = RemoteStore(client.host, client.port,
+                                rate_limiter=limiter)
+        with pytest.raises(TooManyRequests):
+            throttled.list("Pod")
+        # the 429 hint closed the bucket: no token until it elapses
+        assert not limiter.try_accept()
+        assert limiter._hold_until > time.monotonic()
+
+
+def test_informer_relist_waits_for_retry_after_hint():
+    """An informer whose list failed with a 429 floors its next relist at
+    the server hint, not the (much smaller) local backoff."""
+    hint = 0.25
+
+    class FlakyStore:
+        def __init__(self):
+            self.calls: list[float] = []
+
+        def list_with_version(self, kind):
+            self.calls.append(time.monotonic())
+            if len(self.calls) == 1:
+                exc = TooManyRequests("try later")
+                exc.retry_after = hint
+                raise exc
+            return [], 1
+
+        def watch(self, kind, since=None):
+            raise Expired("end the cycle after the successful list")
+
+    flaky = FlakyStore()
+
+    async def run():
+        informer = Informer(flaky, "Pod")
+        informer.start()
+        await asyncio.wait_for(informer.wait_for_sync(), 5)
+        informer.stop()
+
+    asyncio.run(run())
+    assert len(flaky.calls) >= 2
+    # base backoff is 50-75ms jittered; only the hint explains >= 0.25s
+    assert flaky.calls[1] - flaky.calls[0] >= hint
+
+
+# ---- watch cache ----
+
+
+def _tick_label(store: ObjectStore, n: int) -> None:
+    def mutate(node):
+        node.metadata.labels = dict(node.metadata.labels)
+        node.metadata.labels["tick"] = str(n)
+        return node
+
+    store.guaranteed_update("Node", "fan", "default", mutate)
+
+
+def test_watch_cache_one_store_read_per_event():
+    """N cache watchers cost the store exactly one queue put per event
+    (`fanout_puts`), while every watcher still sees every event."""
+    watchers = 50
+    events = 8
+
+    async def run():
+        store = ObjectStore()
+        cache = WatchCache(store).start()
+        subs = [cache.watch("Node") for _ in range(watchers)]
+        assert cache.subscriber_count == watchers
+        base = store.fanout_puts
+        store.create(Node.from_dict({"metadata": {"name": "fan"}}))
+        for n in range(events - 1):
+            _tick_label(store, n)
+
+        async def drain(sub):
+            got = 0
+            while got < events:
+                ev = await sub.next(timeout=5.0)
+                assert ev is not None
+                got += 1
+            return got
+
+        delivered = await asyncio.gather(*(drain(s) for s in subs))
+        cache.stop()
+        return store.fanout_puts - base, delivered
+
+    puts, delivered = asyncio.run(run())
+    assert puts == events  # O(1) store work, not O(watchers)
+    assert delivered == [events] * watchers
+
+
+def test_watch_cache_evicts_slow_consumer():
+    """A subscriber that stops draining is evicted at its queue bound and
+    its stream ends (the relist signal); fast subscribers are unaffected."""
+
+    async def run():
+        store = ObjectStore()
+        cache = WatchCache(store, queue_limit=4).start()
+        slow = cache.watch("Node")
+        fast = cache.watch("Node")
+        store.create(Node.from_dict({"metadata": {"name": "fan"}}))
+        for n in range(10):
+            _tick_label(store, n)
+            # drain fast as we go so only slow backs up
+            assert await fast.next(timeout=5.0) is not None
+        await asyncio.sleep(0.05)  # let the fan-out worker hit the bound
+        assert cache.evictions == 1
+        assert cache.subscriber_count == 1
+        # the slow stream serves its buffered backlog, then ends
+        seen = 0
+        while await slow.next(timeout=0.2) is not None:
+            seen += 1
+        assert seen <= 4
+        # fast consumed 10 of the 11 events in the loop (the first next()
+        # returned the ADDED event); drain the last tick, then one more
+        # event still reaches it
+        assert await fast.next(timeout=5.0) is not None
+        _tick_label(store, 99)
+        ev = await fast.next(timeout=5.0)
+        assert ev is not None and ev.obj.metadata.labels["tick"] == "99"
+        cache.stop()
+
+    asyncio.run(run())
+
+
+def test_watch_cache_resume_too_old_then_relist():
+    """A resume point older than the ring raises Expired (HTTP 410); the
+    reflector contract — relist, rewatch from the list's rv — works
+    through the cache."""
+
+    async def run():
+        store = ObjectStore(watch_window=4)
+        cache = WatchCache(store, window=4).start()
+        store.create(Node.from_dict({"metadata": {"name": "fan"}}))
+        for n in range(8):
+            _tick_label(store, n)
+        await asyncio.sleep(0.05)  # ring catches up past rv=1
+        with pytest.raises(Expired):
+            cache.watch("Node", since=1)
+        # relist against the store, resume from the listed rv
+        items, rv = store.list_with_version("Node")
+        assert len(items) == 1
+        sub = cache.watch("Node", since=rv)
+        _tick_label(store, 100)
+        ev = await sub.next(timeout=5.0)
+        assert ev is not None and ev.obj.metadata.labels["tick"] == "100"
+        cache.stop()
+
+    asyncio.run(run())
+
+
+def test_watch_cache_resume_backlog_from_ring():
+    """since= inside the window replays the backlog from the cache ring
+    without touching the store."""
+
+    async def run():
+        store = ObjectStore()
+        store.create(Node.from_dict({"metadata": {"name": "fan"}}))
+        rv = store.resource_version
+        _tick_label(store, 1)
+        _tick_label(store, 2)
+        cache = WatchCache(store).start()
+        base = store.fanout_puts
+        sub = cache.watch("Node", since=rv)
+        first = await sub.next(timeout=5.0)
+        second = await sub.next(timeout=5.0)
+        assert [e.obj.metadata.labels["tick"] for e in (first, second)] \
+            == ["1", "2"]
+        assert store.fanout_puts == base  # served from the ring
+        cache.stop()
+
+    asyncio.run(run())
+
+
+# ---- store longevity: compaction + snapshot-backed WAL ----
+
+
+def _mk_store(path, **kw) -> ObjectStore:
+    return ObjectStore(persist_path=str(path), **kw)
+
+
+def test_compaction_snapshot_roundtrip(tmp_path):
+    wal = tmp_path / "store.wal"
+    store = _mk_store(wal, snapshot_every=5)
+    for i in range(12):
+        store.create(Node.from_dict({"metadata": {"name": f"n{i}"}}))
+    store.delete("Node", "n0")
+    assert store.compactions >= 2  # 13 appends / snapshot_every=5
+    rv = store.resource_version
+
+    reopened = _mk_store(wal)
+    assert {n.metadata.name for n in reopened.list("Node")} \
+        == {f"n{i}" for i in range(1, 12)}
+    # rv continues where it stopped: resumed watchers see one history
+    assert reopened.resource_version == rv
+    next_rv = int(reopened.create(Node.from_dict(
+        {"metadata": {"name": "after"}})).metadata.resource_version)
+    assert next_rv == rv + 1
+
+
+def test_torn_snapshot_replays_full_wal(tmp_path):
+    """A snapshot torn mid-write (no END trailer) cannot vouch for itself:
+    recovery keeps its valid prefix but replays the ENTIRE WAL on top —
+    double-apply over data loss."""
+    wal = tmp_path / "store.wal"
+    store = _mk_store(wal)
+    for i in range(6):
+        store.create(Node.from_dict({"metadata": {"name": f"n{i}"}}))
+    rv = store.resource_version
+    # a torn .snap: valid header + one OBJ line, then truncation
+    snap_lines = [
+        json.dumps({"op": "SNAP", "rv": 999}),
+        json.dumps({"op": "OBJ", "kind": "Node", "ns": "default",
+                    "name": "n0", "rv": 1,
+                    "obj": store.get("Node", "n0").to_dict()}),
+    ]
+    (tmp_path / "store.wal.snap").write_text("\n".join(snap_lines) + "\n")
+
+    reopened = _mk_store(wal)
+    assert {n.metadata.name for n in reopened.list("Node")} \
+        == {f"n{i}" for i in range(6)}
+    # the torn header's rv=999 was NOT trusted
+    assert reopened.resource_version == rv
+
+
+def test_stale_wal_after_snapshot_not_double_applied(tmp_path):
+    """Crash between the snapshot rename and the WAL truncate: the old log
+    survives next to a valid snapshot. The rv-guard skips every record the
+    snapshot already holds — state is applied exactly once."""
+    wal = tmp_path / "store.wal"
+    store = _mk_store(wal)
+    for i in range(4):
+        store.create(Node.from_dict({"metadata": {"name": f"n{i}"}}))
+    store.delete("Node", "n3")
+    stale_wal = wal.read_text()
+    store.compact()
+    assert wal.read_text() == ""  # truncated
+    # simulate the crash window: the pre-compaction log reappears
+    wal.write_text(stale_wal)
+
+    reopened = _mk_store(wal)
+    assert {n.metadata.name for n in reopened.list("Node")} \
+        == {"n0", "n1", "n2"}
+    # the stale WAL's create of n3 (rv <= snapshot rv) was skipped, so the
+    # delete is not resurrected and rv matches the snapshot
+    assert reopened.resource_version == store.resource_version
+
+
+# ---- satellite: seeded flood action ----
+
+
+def test_flood_is_recorded_and_seed_deterministic():
+    """flood() records its action and derives the traffic generator's rng
+    from the plane's seeded stream — two planes with one seed hand the
+    hook identical randomness; different seeds diverge."""
+
+    def draws(seed):
+        plane = FaultPlane(ObjectStore(), seed=seed)
+        got = []
+        plane.flood_hook = \
+            lambda flow, mult, rng: got.extend(rng.random() for _ in range(4))
+        plane.flood("tenant-a", 50.0)
+        plane.flood("tenant-b", 10.0)
+        assert plane.stats.floods == [
+            {"flow": "tenant-a", "multiplier": 50.0},
+            {"flow": "tenant-b", "multiplier": 10.0}]
+        return got
+
+    assert draws(7) == draws(7)
+    assert draws(7) != draws(8)
+
+
+def test_flood_without_hook_is_recorded_noop():
+    plane = FaultPlane(ObjectStore(), seed=1)
+    plane.flood("tenant-a", 50.0)
+    assert plane.stats.floods == [{"flow": "tenant-a", "multiplier": 50.0}]
+
+
+# ---- the drill end to end (scaled down) + bench --smoke gate ----
+
+
+def test_watch_cache_serves_http_watchers():
+    """APIServer(watch_cache=True): HTTP watchers ride the cache — the
+    store keeps ONE subscriber no matter how many clients watch."""
+    authenticator = TokenAuthenticator({
+        "t": UserInfo("tenant-a", ("system:authenticated",))})
+    with http_store(watch_cache=True, authenticator=authenticator,
+                    max_in_flight=32) as (client, store):
+        client.token = "t"
+        n0 = client.create(Node.from_dict({"metadata": {"name": "n0"}}))
+        rv = int(n0.metadata.resource_version)
+        base = store.fanout_puts
+
+        async def run():
+            watcher = RemoteStore(client.host, client.port, token="t")
+            # since=rv: the cache ring replays anything a slow handshake
+            # would otherwise miss
+            streams = [watcher.watch("Node", since=rv) for _ in range(3)]
+            # force the (lazy) handshakes: the server-side cache must be
+            # live and subscribed BEFORE the event publishes, or the store
+            # sees zero subscribers and the ring backlog hides it
+            await asyncio.gather(*(ws.next(timeout=0.3) for ws in streams))
+            await asyncio.to_thread(
+                client.create, Node.from_dict({"metadata": {"name": "n1"}}))
+            names = []
+            for ws in streams:
+                ev = await ws.next(timeout=10.0)
+                assert ev is not None
+                names.append(ev.obj.metadata.name)
+            for ws in streams:
+                ws.stop()
+            return names
+
+        assert asyncio.run(run()) == ["n1"] * 3
+        # one store-side put (the cache's single subscription), not one
+        # per HTTP watcher
+        assert store.fanout_puts - base == 1
+
+
+@pytest.mark.slow
+def test_overload_drill_smoke():
+    """The noisy-tenant drill at CI scale: converges with every pod bound
+    exactly once, zero racy writes, zero loop stalls, bounded p99."""
+    from kubernetes_tpu.perf.harness import run_overload
+
+    r = run_overload(n_nodes=8, n_pods=16, seed=2026, flood_multiplier=5.0,
+                     race_detect=True, warm_pods=8, probes=10)
+    assert r.converged and r.bound == 24
+    assert r.double_binds == 0
+    assert r.racy_writes == 0
+    assert r.loop_stalls == 0
+    assert r.p99_bounded, (r.p99_unloaded_ms, r.p99_loaded_ms)
+    assert r.flood_requests > 0
+
+
+def test_bench_smoke_mode():
+    """bench.py --smoke --with-race-detector with the overload config must
+    stay runnable end-to-end: config drift breaks this test, not a
+    nightly."""
+    repo = Path(__file__).resolve().parents[1]
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["BENCH_CONFIGS"] = "overload"
+    env["BENCH_OVERLOAD_NODES"] = "8"
+    env["BENCH_OVERLOAD_PODS"] = "16"
+    env["BENCH_OVERLOAD_MULT"] = "5"
+    env["BENCH_FANOUT_WATCHERS"] = "200"
+    env["BENCH_FANOUT_EVENTS"] = "20"
+    proc = subprocess.run(
+        [sys.executable, "bench.py", "--smoke", "--with-race-detector"],
+        cwd=repo, env=env, capture_output=True, text=True, timeout=420)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    line = [ln for ln in proc.stdout.splitlines() if ln.strip()][-1]
+    result = json.loads(line)
+    assert "error" not in result, result
+    extras = result["extras"]
+    assert extras["overload_p99_ms"] > 0
+    assert extras["overload_flood_requests"] > 0
+    assert extras["overload_racy_writes"] == 0
+    assert extras["overload_loop_stalls"] == 0
+    assert extras["watch_fanout_events_per_sec"] > 0
+    # the fan-out contract, asserted from outside the process
+    assert extras["watch_fanout_store_puts"] == 20
