@@ -28,7 +28,12 @@ drill: seeded FaultPlane + watch expiry + scheduler crash; reports
 chaos_recovery_ms), BENCH_OVERLOAD_NODES / BENCH_OVERLOAD_PODS /
 BENCH_OVERLOAD_MULT / BENCH_OVERLOAD_SEED + BENCH_FANOUT_WATCHERS /
 BENCH_FANOUT_EVENTS (noisy-tenant APF drill + watch-cache fan-out;
-reports overload_p99_ms and watch_fanout_events_per_sec).
+reports overload_p99_ms and watch_fanout_events_per_sec),
+BENCH_E2E_GATE (headline pods/s hard floor at >=1000 nodes, default
+15000 — pins the staged host pipeline the way BENCH_DEVICE_GATE pins the
+compiled program; 0 disables, and --smoke defaults it off). The headline
+extras also carry the staged pipeline's per-stage busy fractions and
+inter-stage queue high-water marks (headline_pipeline_*).
 
 --metrics-snapshot (or BENCH_METRICS_SNAPSHOT=1) embeds the scheduler's
 per-phase registry histograms (encode/flush/dispatch/solve/bind/commit:
@@ -81,6 +86,7 @@ def main() -> None:
         os.environ.setdefault("BENCH_FANOUT_WATCHERS", "500")
         os.environ.setdefault("BENCH_FANOUT_EVENTS", "20")
         os.environ.setdefault("BENCH_DEVICE_GATE", "0")  # CPU CI: no gate
+        os.environ.setdefault("BENCH_E2E_GATE", "0")     # seconds-scale run
         os.environ.setdefault(
             "BENCH_CONFIGS", "headline,gang,preemption,autoscaler")
         os.environ.setdefault("BENCH_TIMEOUT_S", "600")
@@ -119,6 +125,27 @@ def main() -> None:
         extras["headline_e2e_p99_ms"] = round(r.metrics["e2e_p99_ms"], 1)
         if "phase_us_per_pod" in r.metrics:
             extras["headline_phase_us_per_pod"] = r.metrics["phase_us_per_pod"]
+        if r.pipeline:
+            # where the next wall is: fraction of the timed wave each stage
+            # thread was busy + queue-depth high-water marks between stages
+            extras["headline_pipeline_busy_frac"] = \
+                r.pipeline["stage_busy_frac"]
+            extras["headline_pipeline_queue_max"] = \
+                r.pipeline["queue_depth_max"]
+            extras["headline_pipeline_depth"] = r.pipeline["depth"]
+        # e2e regression gate on the headline figure itself (the device
+        # gate only pins the compiled program; this one pins the host
+        # pipeline too). Default floor is ~75% of the recorded staged-
+        # driver rate, so a host-side regression that eats the pipeline
+        # win trips the bench even when the device program is untouched.
+        e2e_floor = float(os.environ.get("BENCH_E2E_GATE", "15000"))
+        if e2e_floor > 0 and n_nodes >= 1000:
+            extras["e2e_gate_floor_pods_per_sec"] = e2e_floor
+            extras["e2e_gate_ok"] = bool(r.pods_per_sec >= e2e_floor)
+            if not extras["e2e_gate_ok"]:
+                RESULT["error"] = (
+                    f"e2e regression: headline {r.pods_per_sec:.0f} pods/s "
+                    f"< gate {e2e_floor:.0f}")
         if metrics_snapshot:
             extras["headline_phase_hist"] = r.phase_hist
 

@@ -69,11 +69,76 @@ def _walk_own_body(fn: ast.AST):
         stack.extend(ast.iter_child_nodes(node))
 
 
+# loop/asyncio surface that is NOT thread-safe: touching any of it from a
+# stage worker thread corrupts or races the loop. The one sanctioned
+# crossing is call_soon_threadsafe (scheduler/pipeline.py LoopCalls).
+LOOP_ONLY_METHODS = {
+    "call_soon", "call_later", "call_at", "create_task", "ensure_future",
+    "run_until_complete",
+}
+# run_coroutine_threadsafe crosses into a foreign loop safely; asyncio.run
+# (with new/set_event_loop) is a thread OWNING a private loop — the
+# harness's in-process APIServer pattern — not a crossing at all
+THREADSAFE_ASYNCIO = {"asyncio.run_coroutine_threadsafe", "asyncio.run",
+                      "asyncio.new_event_loop", "asyncio.set_event_loop"}
+
+
+def _thread_target_names(mod: Module) -> set[str]:
+    """Function names passed as threading.Thread(target=...) anywhere in
+    the module — the bodies that execute OFF the loop."""
+    targets: set[str] = set()
+    for node in ast.walk(mod.tree):
+        if not (isinstance(node, ast.Call)
+                and mod.resolve(node.func) == "threading.Thread"):
+            continue
+        for kw in node.keywords:
+            if kw.arg != "target":
+                continue
+            if isinstance(kw.value, ast.Attribute):
+                targets.add(kw.value.attr)
+            elif isinstance(kw.value, ast.Name):
+                targets.add(kw.value.id)
+    return targets
+
+
 class EventLoopPurity:
     name = "blocking-in-async"
 
     def check(self, mod: Module):
         reported: set[int] = set()
+        # tier 3: the inverse direction — a function handed to
+        # threading.Thread(target=...) runs OFF the loop, so asyncio/loop
+        # calls from it race loop internals (the staged-pipeline bug
+        # class); only call_soon_threadsafe (and run_coroutine_threadsafe)
+        # legally cross the thread->loop boundary
+        thread_targets = _thread_target_names(mod)
+        if thread_targets:
+            for fn in ast.walk(mod.tree):
+                if not isinstance(fn, ast.FunctionDef) \
+                        or fn.name not in thread_targets:
+                    continue
+                for node in _walk_own_body(fn):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    target = mod.resolve(node.func)
+                    if target and target.startswith("asyncio.") \
+                            and target not in THREADSAFE_ASYNCIO:
+                        yield Finding(
+                            self.name, mod.relpath, node.lineno,
+                            node.col_offset,
+                            f"{target}() inside thread target "
+                            f"'{fn.name}' races the event loop from a "
+                            "worker thread — marshal through "
+                            "loop.call_soon_threadsafe")
+                    elif isinstance(node.func, ast.Attribute) \
+                            and node.func.attr in LOOP_ONLY_METHODS:
+                        yield Finding(
+                            self.name, mod.relpath, node.lineno,
+                            node.col_offset,
+                            f".{node.func.attr}() inside thread target "
+                            f"'{fn.name}' is an event-loop method — not "
+                            "thread-safe off the loop; marshal through "
+                            "loop.call_soon_threadsafe")
         for fn in ast.walk(mod.tree):
             if not isinstance(fn, ast.AsyncFunctionDef):
                 continue

@@ -20,6 +20,7 @@ from __future__ import annotations
 import asyncio
 import fnmatch
 import logging
+import os
 import time
 from collections import deque
 from dataclasses import dataclass
@@ -28,6 +29,29 @@ from typing import Any, Callable
 from kubernetes_tpu.api.objects import Binding
 
 log = logging.getLogger(__name__)
+
+# bulk native bind (native/commitops.c, -DKTPU_HAVE_PYTHON): one C pass
+# over a Binding batch replacing bind_many's per-pod Python loop. None on
+# machines without cc/Python.h — bind_many degrades to the Python loop
+# (one warning) so tier-1 passes without a C toolchain. KTPU_NATIVE_BIND=0
+# forces the fallback (used by the bit-parity tests).
+try:
+    from kubernetes_tpu.native import bulk_bind as _native_bulk_bind
+except Exception:  # pragma: no cover — native layer is strictly best-effort
+    _native_bulk_bind = None
+
+if os.environ.get("KTPU_NATIVE_BIND", "") in ("0", "false"):
+    _native_bulk_bind = None
+
+_bind_fallback_warned = False
+
+
+def _warn_bind_fallback() -> None:
+    global _bind_fallback_warned
+    if not _bind_fallback_warned:
+        _bind_fallback_warned = True
+        log.warning("native bulk bind unavailable; pods/binding falls back "
+                    "to the pure-Python per-pod path")
 
 
 class NotFound(KeyError):
@@ -686,35 +710,45 @@ class ObjectStore:
         bucket = self._bucket("Pod")
         pod_watchers = [w for w in self._watchers
                         if w.kind is None or w.kind == "Pod"]
-        bound: list[Any] = []
-        errors: list[Exception | None] = []
-        events: list[WatchEvent] = []
-        for binding in bindings:
-            key = _key(binding.namespace, binding.pod_name)
-            current = bucket.get(key)
-            if current is None:
-                bound.append(None)
-                errors.append(NotFound(
-                    f"Pod {binding.namespace}/{binding.pod_name} not found"))
-                continue
-            if current.spec.node_name:
-                bound.append(None)
-                errors.append(Conflict(
-                    f"pod {binding.namespace}/{binding.pod_name} already "
-                    f"bound to {current.spec.node_name}"))
-                continue
-            self._rv += 1
-            rv = self._rv
-            meta = shell(current.metadata)
-            meta.resource_version = str(rv)
-            spec = shell(current.spec)
-            spec.node_name = binding.target_node
-            stored = type(current)(metadata=meta, spec=spec,
-                                   status=current.status)
-            bucket[key] = stored
-            events.append(WatchEvent("MODIFIED", "Pod", stored, rv))
-            bound.append(stored)
-            errors.append(None)
+        if (_native_bulk_bind is not None and type(bucket) is dict
+                and type(bindings) is list):
+            # one C pass builds the shells, the rebound pods, the bucket
+            # writes and the watch fan-out buffer (native/commitops.c
+            # ktpu_bulk_bind; bit-parity pinned by tests/test_native_bind)
+            bound, errors, events, self._rv = _native_bulk_bind(
+                bucket, bindings, self._rv, WatchEvent, NotFound, Conflict)
+        else:
+            _warn_bind_fallback()
+            bound = []
+            errors = []
+            events = []
+            for binding in bindings:
+                key = _key(binding.namespace, binding.pod_name)
+                current = bucket.get(key)
+                if current is None:
+                    bound.append(None)
+                    errors.append(NotFound(
+                        f"Pod {binding.namespace}/{binding.pod_name} "
+                        f"not found"))
+                    continue
+                if current.spec.node_name:
+                    bound.append(None)
+                    errors.append(Conflict(
+                        f"pod {binding.namespace}/{binding.pod_name} already "
+                        f"bound to {current.spec.node_name}"))
+                    continue
+                self._rv += 1
+                rv = self._rv
+                meta = shell(current.metadata)
+                meta.resource_version = str(rv)
+                spec = shell(current.spec)
+                spec.node_name = binding.target_node
+                stored = type(current)(metadata=meta, spec=spec,
+                                       status=current.status)
+                bucket[key] = stored
+                events.append(WatchEvent("MODIFIED", "Pod", stored, rv))
+                bound.append(stored)
+                errors.append(None)
         if self._wal is not None and events:
             for ev in events:
                 self._append_wal(ev, flush=False)

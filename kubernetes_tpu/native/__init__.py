@@ -26,15 +26,24 @@ log = logging.getLogger(__name__)
 fnv1a64 = None          # (bytes) -> int, or None when unavailable
 lanes_batch = None      # (list[bytes]) -> (np.uint32[n], np.uint32[n])
 scatter_add_cols = None  # (dst2d, src2d, off, rows_i64, width) -> touched
+bulk_bind = None        # (bucket, bindings, rv_base, WatchEvent, NotFound,
+#                          Conflict) -> (bound, errors, events, rv_end)
 
 
-def _build_lib(src_name: str) -> ctypes.CDLL | None:
+def _build_lib(src_name: str, stem: str | None = None,
+               extra_flags: tuple[str, ...] = (),
+               loader=ctypes.CDLL) -> ctypes.CDLL | None:
     """Compile `src_name` (beside this file) into _build/ if stale and load
     it. Build via a temp file + rename so concurrent importers can race.
-    Returns None on any failure (callers degrade to pure Python)."""
+    `stem` names the output .so (one source can build several variants,
+    e.g. commitops with/without the CPython API); `loader` picks the ctypes
+    binding class (PyDLL for functions that call the Python C-API and must
+    hold the GIL). Returns None on any failure (callers degrade to pure
+    Python)."""
     src = os.path.join(os.path.dirname(__file__), src_name)
     build_dir = os.path.join(os.path.dirname(__file__), "_build")
-    stem = os.path.splitext(src_name)[0]
+    if stem is None:
+        stem = os.path.splitext(src_name)[0]
     lib_path = os.path.join(build_dir, f"lib{stem}.so")
     try:
         if (not os.path.exists(lib_path)
@@ -43,10 +52,11 @@ def _build_lib(src_name: str) -> ctypes.CDLL | None:
             fd, tmp = tempfile.mkstemp(dir=build_dir, suffix=".so")
             os.close(fd)
             subprocess.run(
-                ["cc", "-O2", "-shared", "-fPIC", "-o", tmp, src],
+                ["cc", "-O2", "-shared", "-fPIC", *extra_flags,
+                 "-o", tmp, src],
                 check=True, capture_output=True, timeout=60)
             os.replace(tmp, lib_path)
-        return ctypes.CDLL(lib_path)
+        return loader(lib_path)
     except (OSError, subprocess.SubprocessError) as e:
         log.debug("native %s unavailable (%s); using pure Python",
                   src_name, e)
@@ -132,5 +142,38 @@ def _bind_commitops():
     scatter_add_cols = _scatter_add_cols
 
 
+def _bind_bindops():
+    """Bulk native bind: commitops.c rebuilt with the CPython API enabled
+    (`-DKTPU_HAVE_PYTHON`), bound through PyDLL so the GIL stays held while
+    the C pass walks Python objects. Needs the interpreter headers; a
+    machine without them (or without cc) just keeps the pure-Python
+    bind_many path."""
+    global bulk_bind
+
+    import sysconfig
+
+    inc = sysconfig.get_paths().get("include")
+    if not inc or not os.path.exists(os.path.join(inc, "Python.h")):
+        log.debug("native bulk bind unavailable (no Python.h); "
+                  "using pure Python")
+        return
+    lib = _build_lib("commitops.c", stem="bindops",
+                     extra_flags=("-DKTPU_HAVE_PYTHON", f"-I{inc}"),
+                     loader=ctypes.PyDLL)
+    if lib is None:
+        return
+    try:
+        lib.ktpu_bulk_bind.restype = ctypes.py_object
+        lib.ktpu_bulk_bind.argtypes = [
+            ctypes.py_object, ctypes.py_object, ctypes.c_ssize_t,
+            ctypes.py_object, ctypes.py_object, ctypes.py_object]
+    except AttributeError as e:
+        log.debug("native bulk bind symbols unavailable (%s)", e)
+        return
+
+    bulk_bind = lib.ktpu_bulk_bind
+
+
 _bind_fnv()
 _bind_commitops()
+_bind_bindops()

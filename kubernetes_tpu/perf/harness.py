@@ -33,6 +33,9 @@ class ThroughputResult:
     # ({phase: {count, sum_ms, p50_ms, p99_ms}}) — bench.py's
     # --metrics-snapshot payload
     phase_hist: dict = field(default_factory=dict)
+    # staged-pipeline occupancy over the timed wave (stage_busy_frac +
+    # queue-depth high-water marks); empty when KTPU_STAGED_PIPELINE=0
+    pipeline: dict = field(default_factory=dict)
 
     def __str__(self) -> str:
         return (f"{self.scheduled} pods in {self.seconds:.2f}s = "
@@ -77,6 +80,14 @@ async def _run(n_nodes: int, n_pods: int, caps: Capacities, policy: Policy,
         # the timed wave's metrics must not include warmup samples
         from kubernetes_tpu.scheduler.driver import SchedulerMetrics
         sched.metrics = SchedulerMetrics()
+        if sched._staged is not None:
+            sched._staged.reset_stats()
+        # collect the warmup wave's garbage NOW: a gen2 pass triggered
+        # mid-wave (walking every suite's surviving objects when several
+        # share the process) otherwise lands its pause in whichever stage
+        # thread tripped the allocation threshold, polluting the phase gates
+        import gc
+        gc.collect()
 
     for pod in make_pods(n_pods, **pod_kwargs):
         store.create(pod)
@@ -93,6 +104,8 @@ async def _run(n_nodes: int, n_pods: int, caps: Capacities, policy: Policy,
         batches=sched.metrics.batches - batches_before,
         metrics=sched.metrics.snapshot(),
         phase_hist=sched.metrics.phase_histograms(),
+        pipeline=(sched._staged.snapshot()
+                  if sched._staged is not None else {}),
     )
     sched.stop()
     return result
@@ -447,6 +460,12 @@ async def _run_chaos(n_nodes: int, n_pods: int, seed: int,
     from kubernetes_tpu.testing.faults import FaultPlane
     from kubernetes_tpu.testing.races import LoopStallWatchdog, RaceDetector
 
+    # same GC hygiene as run_overload: the stall contract measures this
+    # drill's loop holds, not a gen2 pass over earlier configs' heaps
+    import gc
+    gc.collect()
+    gc.freeze()
+
     cap = {"cpu": "16", "memory": "32Gi", "pods": "110"}
     inner = ObjectStore(watch_window=max(1 << 16, 8 * (n_pods + n_nodes)))
     # nodes pre-registered through the inner store: setup is not the thing
@@ -478,11 +497,12 @@ async def _run_chaos(n_nodes: int, n_pods: int, seed: int,
 
     def crash_scheduler() -> None:
         # hard kill: no stop() — in-flight device results are dropped on
-        # the floor, assumed-but-unconfirmed state is lost
+        # the floor, assumed-but-unconfirmed state is lost. kill() also
+        # aborts the staged stage threads mid-batch: solved-but-unapplied
+        # work must vanish (crash-consistency), never bind post-mortem
+        # through a still-queued loop closure
         driver.cancel()
-        for informer in (sched.node_informer, sched.pod_informer,
-                         sched.podgroup_informer, *sched.workload_informers):
-            informer.stop()
+        sched.kill()
 
     async with asyncio.timeout(180):
         while len(plane.bind_counts) < max(1, n_pods // 3):
@@ -507,6 +527,7 @@ async def _run_chaos(n_nodes: int, n_pods: int, seed: int,
     driver.cancel()
     sched.stop()
     cluster.stop()
+    gc.unfreeze()
     stalls = watchdog.stop() if watchdog is not None else []
     double = sum(1 for v in plane.bind_counts.values() if v > 1)
     return ChaosResult(
@@ -726,6 +747,16 @@ def run_overload(n_nodes: int = 64, n_pods: int = 256, seed: int = 2026,
     started = threading.Event()
     holder: dict = {}
 
+    # the zero->100ms-stall contract measures the control plane's OWN
+    # loop holds. A gen2 GC pass walking co-resident heaps (a previous
+    # bench config's object graphs, jax caches) holds the GIL 50-220ms
+    # from whichever thread trips the allocation threshold — freeze the
+    # pre-drill heap out of the collector so gen2 passes only walk what
+    # the drill itself allocates (which IS control-plane behavior)
+    import gc
+    gc.collect()
+    gc.freeze()
+
     def serve() -> None:
         async def main():
             server = APIServer(server_store, authenticator=auth,
@@ -896,6 +927,7 @@ def run_overload(n_nodes: int = 64, n_pods: int = 256, seed: int = 2026,
         flood_stop.set()
         holder["loop"].call_soon_threadsafe(holder["shutdown"].set)
         thread.join(timeout=15)
+        gc.unfreeze()
     stalls = holder.get("stalls", [])
     result.loop_stalls = len(stalls)
     result.max_stall_ms = 1e3 * max(stalls, default=0.0)

@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import threading
 import time
 from collections import deque
 
@@ -408,12 +409,20 @@ class Scheduler:
         self._assumed: set[str] = set()
         self._enqueue_time: dict[str, float] = {}
         self._rr = np.uint32(0)
-        self._blob_pool: list = []
-        # deferred Scheduled-event buffer: recording is off the
-        # batch-critical path, flushed when the loop next idles (the
-        # EventBroadcaster's buffered-channel shape, record/event.go:78);
-        # stop() flushes synchronously so no event is ever dropped
-        self._pending_events: list[tuple[Pod, str]] = []
+        # packed transport blob free-list: acquired at batch assembly,
+        # released once the batch's ledger commits (in-flight batches'
+        # blobs stay referenced — commit reads accounting rows from them)
+        self._blob_pool: deque = deque()
+        # host StateDB/EncodeCache guard: the loop mutates them from
+        # informer handlers and encode, the staged dispatch thread reads
+        # them in flush(), the commit thread scatters in commit_batch()
+        self._state_lock = threading.RLock()
+        # deferred event buffer, (obj, type, reason, message): recording
+        # is off the batch-critical path, coalesced per solved batch and
+        # flushed when the loop next idles (the EventBroadcaster's
+        # buffered-channel shape, record/event.go:78); stop() flushes
+        # synchronously so no event is ever dropped
+        self._pending_events: list[tuple[Pod, str, str, str]] = []
         self._event_flush_scheduled = False
         # node name -> keys of bound pods seen on it (indexed even before
         # the node itself is known, so a late node event re-accounts them);
@@ -476,8 +485,33 @@ class Scheduler:
         import os
 
         self.pipeline_depth = int(
-            os.environ.get("KTPU_PIPELINE_DEPTH", "3") or 3)
+            os.environ.get("KTPU_PIPELINE_DEPTH", "4") or 4)
         self._inflight_q: deque = deque()
+        # staged stage-per-thread pipeline (scheduler/pipeline.py):
+        # encode on the loop | dispatch | settle | commit+bind in worker
+        # threads. The default batch path when encoding is
+        # placement-independent; KTPU_STAGED_PIPELINE=0 falls back to the
+        # single-loop pipelined driver
+        from kubernetes_tpu.scheduler.pipeline import (
+            EventShard,
+            LoopCalls,
+            StagedPipeline,
+        )
+
+        self._loop_calls = LoopCalls()
+        staged_on = self._pipeline and (
+            os.environ.get("KTPU_STAGED_PIPELINE", "1") != "0")
+        self._staged = StagedPipeline(self, self.pipeline_depth) \
+            if staged_on else None
+        self._event_shard = EventShard(self.events, self._loop_calls) \
+            if staged_on else None
+        if self._event_shard is not None:
+            self._event_shard._recorder_metrics_hook = \
+                lambda s: self.metrics.add_phase("events_async", s)
+        # settled-count accumulator + failed-batch payloads filled by the
+        # staged pipeline's loop-side closures; schedule_pending drains
+        self._staged_settled = 0
+        self._staged_failures: list = []
         # solve-failure hardening (the batched analog of the reference's
         # MakeDefaultErrorFunc: an algorithm error must never kill the
         # scheduling loop). With a timeout set, each dispatch+readback runs
@@ -524,21 +558,23 @@ class Scheduler:
 
     def _on_node_event(self, event: WatchEvent) -> None:
         node = event.obj
-        if event.type == "DELETED":
-            self.statedb.remove_node(node.metadata.name)
-            return
-        self.statedb.upsert_node(node)
-        # re-account bound pods the state missed: pods whose MODIFIED/ADDED
-        # event raced ahead of this node's, or whose accounting was dropped
-        # by a node delete+recreate — via the node->pods index, not an
-        # O(all pods) informer sweep
-        for key in self._pods_by_node.get(node.metadata.name, ()):
-            if self.statedb.is_accounted(key) or key in self._assumed:
-                continue
-            ns, name = key.split("/", 1)
-            pod = self.pod_informer.get(name, ns)
-            if pod is not None and pod.spec.node_name == node.metadata.name:
-                self.statedb.add_pod(pod)
+        with self._state_lock:
+            if event.type == "DELETED":
+                self.statedb.remove_node(node.metadata.name)
+                return
+            self.statedb.upsert_node(node)
+            # re-account bound pods the state missed: pods whose
+            # MODIFIED/ADDED event raced ahead of this node's, or whose
+            # accounting was dropped by a node delete+recreate — via the
+            # node->pods index, not an O(all pods) informer sweep
+            for key in self._pods_by_node.get(node.metadata.name, ()):
+                if self.statedb.is_accounted(key) or key in self._assumed:
+                    continue
+                ns, name = key.split("/", 1)
+                pod = self.pod_informer.get(name, ns)
+                if pod is not None \
+                        and pod.spec.node_name == node.metadata.name:
+                    self.statedb.add_pod(pod)
 
     def _wants(self, pod: Pod) -> bool:
         return pod.spec.scheduler_name == self.scheduler_name
@@ -546,7 +582,8 @@ class Scheduler:
     @property
     def inflight_batches(self) -> int:
         """Dispatched-but-unsettled batches (pipeline depth in use)."""
-        return len(self._inflight_q)
+        staged = self._staged.inflight if self._staged is not None else 0
+        return len(self._inflight_q) + staged
 
     def _unindex_pod(self, key: str) -> None:
         prev = self._pod_node.pop(key, None)
@@ -566,8 +603,9 @@ class Scheduler:
             self._enqueue_time.pop(key, None)
             self._unindex_pod(key)
             self._gang_forget(key)
-            self.statedb.remove_pod(key)
-            self.encode_cache.forget(key)
+            with self._state_lock:
+                self.statedb.remove_pod(key)
+                self.encode_cache.forget(key)
             return
         if pod.spec.node_name:
             if self._pod_node.get(key) != pod.spec.node_name:
@@ -578,21 +616,23 @@ class Scheduler:
             self._enqueue_time.pop(key, None)
             self._quarantined.discard(key)  # bound after all: not poison
             self._gang_forget(key)
-            self.encode_cache.forget(key)
-            if key in self._assumed:
-                # our own binding confirmed by the watch
-                self._assumed.discard(key)
-            else:
-                # bound elsewhere; if the node is unknown the node-event
-                # handler re-accounts it once the node appears
-                self.statedb.add_pod(pod)
+            with self._state_lock:
+                self.encode_cache.forget(key)
+                if key in self._assumed:
+                    # our own binding confirmed by the watch
+                    self._assumed.discard(key)
+                else:
+                    # bound elsewhere; if the node is unknown the
+                    # node-event handler re-accounts it once it appears
+                    self.statedb.add_pod(pod)
         elif self._wants(pod):
             self._enqueue_time.setdefault(key, time.monotonic())
             # encode-on-watch: fingerprint + class encode now, while the
             # previous batch is on the wire/device, so batch assembly on
             # the critical path is a key lookup + two row memcpys
             try:
-                self.encode_cache.premake(pod)
+                with self._state_lock:
+                    self.encode_cache.premake(pod)
             except CapacityError:
                 # over-capacity pods still enqueue: batch assembly re-raises
                 # and its per-pod failure path records the FailedScheduling
@@ -786,18 +826,24 @@ class Scheduler:
         await self.podgroup_informer.wait_for_sync()
 
     def _flush_events(self) -> None:
-        """Record buffered Scheduled events (runs when the event loop next
-        idles — typically inside the transport wait of the following
-        batch's settle — or synchronously from stop()). A failing store
-        keeps the entries for the next flush (bounded retries) instead of
-        silently dropping them into the loop's exception handler."""
+        """Record buffered per-batch events — Scheduled bursts plus the
+        batch path's FailedScheduling tail — coalesced into one bulk
+        store write per (type, reason) group (runs when the event loop
+        next idles, or synchronously from stop()). In staged mode the
+        event shard builds the objects off-loop first; otherwise a
+        failing store keeps the entries for the next flush (bounded
+        retries) instead of silently dropping them."""
         self._event_flush_scheduled = False
         if not self._pending_events:
             return
         entries, self._pending_events = self._pending_events, []
+        shard = self._event_shard
+        if shard is not None and not shard._stopped:
+            shard.submit(entries)
+            return
         t0 = time.monotonic()
         try:
-            self.events.record_many(entries, "Normal", "Scheduled")
+            self.events.record_grouped(entries)
             self._event_flush_failures = 0
         except Exception:  # noqa: BLE001 — events must not kill the driver
             self._event_flush_failures = getattr(
@@ -812,10 +858,49 @@ class Scheduler:
                           self._event_flush_failures, len(entries))
         self.metrics.add_phase("events_async", time.monotonic() - t0)
 
+    async def _drain_events_async(self) -> None:
+        """Make every buffered/sharded event visible (request-response
+        seam: runs only when the pipeline is drained, so tests observe
+        events as soon as schedule_pending returns idle)."""
+        if self._pending_events:
+            self._flush_events()
+        shard = self._event_shard
+        if shard is not None and shard.outstanding \
+                and (self._staged is None or self._staged.inflight == 0):
+            await shard.drain()
+
     def stop(self) -> None:
         self._stopped = True
+        if self._staged is not None:
+            self._staged.drain_sync()
         self._settle_inflight()
+        if self._event_shard is not None:
+            self._flush_events()  # routes the buffer through the shard
+            self._event_shard.stop()
+            self._event_shard.drain_sync()
         self._flush_events()
+        if self._staged is not None:
+            self._staged.shutdown()
+        self.queue.close()
+        self.node_informer.stop()
+        self.pod_informer.stop()
+        self.podgroup_informer.stop()
+        for informer in self.workload_informers:
+            informer.stop()
+
+    def kill(self) -> None:
+        """Hard abort — the chaos drill's crash simulation. Every stage
+        drops its in-flight work unapplied: batches that never bound are
+        simply rescheduled by the restarted instance from store truth
+        (crash-only contract; stop() is the graceful drain)."""
+        self._stopped = True
+        if self._staged is not None:
+            self._staged.kill()
+        if self._event_shard is not None:
+            self._event_shard.kill()
+        self._loop_calls.clear()
+        self._pending_events = []
+        self._inflight_q.clear()
         self.queue.close()
         self.node_informer.stop()
         self.pod_informer.stop()
@@ -842,22 +927,34 @@ class Scheduler:
 
     # ---- one batch ----
 
-    def _next_blobs(self):
-        """Rotating packed transport blobs: in-flight batches' blobs stay
-        referenced (commit reads accounting rows from them), so depth+2
-        buffer pairs rotate."""
-        from kubernetes_tpu.state.pod_batch import _layout
+    def _acquire_blobs(self):
+        """Packed transport blob pair from the free-list (allocates when
+        empty — in-flight gating bounds steady-state allocation to
+        depth+2 pairs; a leak on an exception path just reallocates)."""
+        try:
+            return self._blob_pool.popleft()
+        except IndexError:
+            from kubernetes_tpu.state.pod_batch import _layout
 
-        if not self._blob_pool:
             _lay, f_width, i_width = _layout(self.caps)
             p = self.caps.batch_pods
-            self._blob_pool = [
-                (np.zeros((p, f_width), np.float32),
-                 np.zeros((p, i_width), np.int32))
-                for _ in range(self.pipeline_depth + 2)]
-        self._blob_pool.append(self._blob_pool.pop(0))
-        fblob, iblob = self._blob_pool[0]
-        return fblob, iblob
+            return (np.zeros((p, f_width), np.float32),
+                    np.zeros((p, i_width), np.int32))
+
+    def _release_blobs(self, blobs) -> None:
+        """Return a blob pair once its batch's ledger commit has read the
+        accounting rows (callable from the commit stage thread — deque
+        append is atomic)."""
+        if len(self._blob_pool) < self.pipeline_depth + 2:
+            self._blob_pool.append(blobs)
+
+    def _next_blobs(self):
+        """Back-compat acquire-without-release (tests' scratch blobs):
+        the pair stays pooled, so sequential callers may see the same
+        arrays."""
+        blobs = self._acquire_blobs()
+        self._release_blobs(blobs)
+        return blobs
 
     async def schedule_pending(self, wait: float | None = None) -> int:
         """Pop up to a batch of pending pods, schedule, bind. Returns the
@@ -865,13 +962,29 @@ class Scheduler:
         self._check_gang_timeouts()
         if len(self.nominated):
             self.nominated.expire(time.monotonic())
-        effective_wait = 0 if self._inflight_q else wait
+        settled = 0
+        if self._staged is not None:
+            self._loop_calls.bind(asyncio.get_running_loop())
+            self._loop_calls.drain()
+            settled = self._take_staged_settled()
+            if self._staged_failures:
+                settled += await self._drain_staged_failures()
+        inflight = self._inflight_q or (
+            self._staged is not None and self._staged.inflight)
+        effective_wait = 0 if inflight else wait
         keys = await self.queue.get_batch(self.caps.batch_pods,
                                           wait=effective_wait)
         if not keys:
-            return await self._asettle_inflight()
+            settled += await self._asettle_inflight()
+            if self._staged is not None and self._staged.inflight:
+                # yield so marshalled apply closures make progress, then
+                # collect whatever settled meanwhile
+                await asyncio.sleep(0.001)
+                self._loop_calls.drain()
+                settled += self._take_staged_settled()
+            return settled
         try:
-            return await self._schedule_batch(keys)
+            return settled + await self._schedule_batch(keys)
         except asyncio.CancelledError:
             raise
         except Exception:
@@ -879,53 +992,58 @@ class Scheduler:
             # an exception — the informer won't re-announce an unchanged
             # pending pod, so re-add every key before propagating (done()
             # first: add() on a processing key only marks it dirty)
-            for key in keys:
-                self.queue.done(key)
-                self.queue.add(key)
+            self._requeue_keys(keys)
             raise
 
     async def _schedule_batch(self, keys: list[str]) -> int:
-        t_phase = time.perf_counter()
-        fblob, iblob = self._next_blobs()
+        t_phase = time.thread_time()
+        fblob, iblob = self._acquire_blobs()
         pods: list[Pod] = []
         live_keys: list[str] = []
         # per-row (gang_id, gang_min) parallel to pods, (0, 0) = non-gang;
         # gang_groups: batch-local id -> (group key, quorum, row positions)
         gang_cols: list[tuple[int, int]] = []
         gang_groups: dict[int, tuple[str, int, list[int]]] = {}
-        epoch_before = self.statedb.table.pod_row_epoch
-        for key in keys:
-            if key.startswith(_GANG_KEY_PREFIX):
-                self._admit_gang(key, fblob, iblob, pods, live_keys,
-                                 gang_cols, gang_groups)
-                continue
-            ns, name = key.split("/", 1)
-            pod = self.pod_informer.get(name, ns)
-            if pod is None or pod.spec.node_name:
-                self._enqueue_time.pop(key, None)
-                self.queue.done(key)  # deleted or already bound: drop
-                continue
-            try:
-                self.encode_cache.encode_packed_into(fblob, iblob,
-                                                     len(pods), pod)
-            except CapacityError as e:
-                # per-pod failure must not wedge the batch
-                # (MakeDefaultErrorFunc parity, factory.go:897)
-                self._fail(key, pod, f"pod exceeds scheduler capacities: {e}")
-                continue
-            pods.append(pod)
-            live_keys.append(key)
-            gang_cols.append((0, 0))
+        # the lock serializes encode-side interning against the staged
+        # dispatch thread's flush (which applies pending refreshes)
+        with self._state_lock:
+            epoch_before = self.statedb.table.pod_row_epoch
+            for key in keys:
+                if key.startswith(_GANG_KEY_PREFIX):
+                    self._admit_gang(key, fblob, iblob, pods, live_keys,
+                                     gang_cols, gang_groups)
+                    continue
+                ns, name = key.split("/", 1)
+                pod = self.pod_informer.get(name, ns)
+                if pod is None or pod.spec.node_name:
+                    self._enqueue_time.pop(key, None)
+                    self.queue.done(key)  # deleted or already bound: drop
+                    continue
+                try:
+                    self.encode_cache.encode_packed_into(fblob, iblob,
+                                                         len(pods), pod)
+                except CapacityError as e:
+                    # per-pod failure must not wedge the batch
+                    # (MakeDefaultErrorFunc parity, factory.go:897)
+                    self._fail(key, pod,
+                               f"pod exceeds scheduler capacities: {e}")
+                    continue
+                pods.append(pod)
+                live_keys.append(key)
+                gang_cols.append((0, 0))
+            if pods and self.statedb.table.pod_row_epoch != epoch_before:
+                # a later pod in this batch interned new podsel/avoid
+                # entries: earlier pods' match/carry rows (encoded,
+                # possibly cached, against the smaller universe) miss
+                # them — re-encode every row against the final universes
+                # (epoch is in the cache key, so stale cached rows cannot
+                # be served)
+                for i, pod in enumerate(pods):
+                    self.encode_cache.encode_packed_into(fblob, iblob, i,
+                                                         pod)
         if not pods:
+            self._release_blobs((fblob, iblob))
             return await self._asettle_inflight()
-        if self.statedb.table.pod_row_epoch != epoch_before:
-            # a later pod in this batch interned new podsel/avoid entries:
-            # earlier pods' match/carry rows (encoded, possibly cached,
-            # against the smaller universe) miss them — re-encode every row
-            # against the final universes (epoch is in the cache key, so
-            # stale cached rows cannot be served)
-            for i, pod in enumerate(pods):
-                self.encode_cache.encode_packed_into(fblob, iblob, i, pod)
         # unused tail rows of a reused blob must not leak the previous
         # batch's encodings (valid flags in particular)
         if len(pods) < self.caps.batch_pods:
@@ -943,12 +1061,20 @@ class Scheduler:
                 if gid:
                     gid_col[i] = gid
                     gmin_col[i] = gmin
-        self.metrics.add_phase("encode", time.perf_counter() - t_phase)
+        # host-phase costs accrue THREAD CPU time (see _apply_batch): wall
+        # time on the loop includes GIL waits on concurrent stage threads
+        self.metrics.add_phase("encode", time.thread_time() - t_phase)
         self.metrics.phase_pods += len(pods)
 
         if self._extenders:
-            return await self._schedule_with_extenders(pods, live_keys,
-                                                       fblob, iblob)
+            try:
+                return await self._schedule_with_extenders(pods, live_keys,
+                                                           fblob, iblob)
+            finally:
+                self._release_blobs((fblob, iblob))
+        if self._staged is not None and not self._stopped:
+            return await self._schedule_batch_staged(
+                pods, live_keys, fblob, iblob, gang_groups)
 
         timer = StepTimer(f"scheduling batch of {len(pods)}",
                           step_hist=self.metrics.trace_steps)
@@ -964,9 +1090,9 @@ class Scheduler:
             # a dirty flush would re-upload host truth that misses the
             # in-flight batches' charges: settle them first
             settled += await self._asettle_inflight()
-        t_phase = time.perf_counter()
+        t_phase = time.thread_time()
         state = self.statedb.flush()
-        self.metrics.add_phase("flush", time.perf_counter() - t_phase)
+        self.metrics.add_phase("flush", time.thread_time() - t_phase)
         timer.step("encode + flush")
 
         t0 = time.monotonic()
@@ -975,6 +1101,7 @@ class Scheduler:
                                                   iblob, victims, live_keys)
         except _SolveFailed as e:
             self.metrics.add_phase("dispatch", time.monotonic() - t0)
+            self._release_blobs((fblob, iblob))
             return settled + await self._recover_solve_failure(
                 pods, live_keys, gang_groups, e)
         self._rr = result.rr_end
@@ -1016,6 +1143,71 @@ class Scheduler:
                                  flags, t0, timer, False, fetch, gang_groups,
                                  vslots))
         return settled + await self._asettle_inflight()
+
+    # ---- staged stage-per-thread path (scheduler/pipeline.py) ----
+
+    async def _schedule_batch_staged(self, pods: list[Pod],
+                                     live_keys: list[str], fblob, iblob,
+                                     gang_groups: dict) -> int:
+        """Hand one encoded batch to the staged pipeline: flush + solve +
+        readback + ledger commit run in stage threads while this loop
+        encodes the next batch (unconditional prefetch — the overlap the
+        single-loop path only got under queue pressure). With the queue
+        drained the call degrades to request-response: it awaits the
+        pipeline so callers observe their pods bound on return."""
+        from kubernetes_tpu.state.pod_batch import packed_batch_flags
+
+        from kubernetes_tpu.scheduler.pipeline import _BatchWork
+
+        flags = packed_batch_flags(fblob, iblob, len(pods),
+                                   self.statedb.table, self.caps)
+        schedule_fn = self._get_schedule_fn(flags)
+        with self._state_lock:
+            victims, vslots = self._build_victims(flags)
+        work = _BatchWork(pods, live_keys, (fblob, iblob), flags,
+                          schedule_fn, victims, vslots, gang_groups)
+        self._loop_calls.bind(asyncio.get_running_loop())
+        await self._staged.wait_capacity()
+        self._staged.submit(work)
+        settled = self._take_staged_settled()
+        if len(self.queue) == 0:
+            await self._staged.drain()
+            settled += self._take_staged_settled()
+            if self._staged_failures:
+                settled += await self._drain_staged_failures()
+            await self._drain_events_async()
+        return settled
+
+    def _take_staged_settled(self) -> int:
+        n, self._staged_settled = self._staged_settled, 0
+        return n
+
+    def _requeue_keys(self, keys: list[str]) -> None:
+        """Level-triggered hardening for a batch whose apply failed: no
+        popped key may be lost (done() first — add() on a processing key
+        only marks it dirty)."""
+        for key in keys:
+            self.queue.done(key)
+            self.queue.add(key)
+
+    def _on_staged_solve_failure(self, work) -> None:
+        """Loop-side landing for a batch whose solve failed twice in the
+        dispatch stage: park the payload; the next schedule_pending
+        drains the pipeline and runs the bisect/quarantine/serial-host
+        recovery ladder on it."""
+        self.statedb.mark_ledger_dirty()
+        self._release_blobs(work.blobs)
+        self._staged_failures.append(
+            (work.pods, work.live_keys, work.gang_groups, work.error))
+
+    async def _drain_staged_failures(self) -> int:
+        await self._staged.drain()
+        settled = self._take_staged_settled()
+        payloads, self._staged_failures = self._staged_failures, []
+        for pods, live_keys, gang_groups, error in payloads:
+            settled += await self._recover_solve_failure(
+                pods, live_keys, gang_groups, error)
+        return settled
 
     async def _schedule_with_extenders(self, pods: list[Pod],
                                        live_keys: list[str],
@@ -1248,18 +1440,20 @@ class Scheduler:
         are discarded without side effects."""
         from kubernetes_tpu.state.pod_batch import packed_batch_flags
 
+        blobs = self._acquire_blobs()
         try:
             keys = [k for k, _ in items]
-            fblob, iblob = self._next_blobs()
-            for i, (_key, pod) in enumerate(items):
-                self.encode_cache.encode_packed_into(fblob, iblob, i, pod)
-            if len(items) < self.caps.batch_pods:
-                fblob[len(items):] = 0.0
-                iblob[len(items):] = 0
-            flags = packed_batch_flags(fblob, iblob, len(items),
-                                       self.statedb.table, self.caps)
-            schedule_fn = self._get_schedule_fn(flags)
-            state = self.statedb.flush()
+            fblob, iblob = blobs
+            with self._state_lock:
+                for i, (_key, pod) in enumerate(items):
+                    self.encode_cache.encode_packed_into(fblob, iblob, i, pod)
+                if len(items) < self.caps.batch_pods:
+                    fblob[len(items):] = 0.0
+                    iblob[len(items):] = 0
+                flags = packed_batch_flags(fblob, iblob, len(items),
+                                           self.statedb.table, self.caps)
+                schedule_fn = self._get_schedule_fn(flags)
+                state = self.statedb.flush()
             result = await self._call_solve(schedule_fn, state, fblob,
                                             iblob, None, keys)
             await asyncio.to_thread(np.asarray, result.assignments)
@@ -1270,6 +1464,8 @@ class Scheduler:
         except Exception:  # noqa: BLE001 — a failed probe is an answer
             self.metrics.solve_failure_inc()
             return False
+        finally:
+            self._release_blobs(blobs)
 
     def _quarantine(self, key: str, pod: Pod) -> None:
         """Poison pod: surface the verdict as an event and park it with a
@@ -1358,8 +1554,7 @@ class Scheduler:
         # fully drained: make deferred events visible before returning, so
         # non-pipelined callers keep request-response semantics (under
         # sustained pipelined load the call_soon flush runs instead)
-        if self._pending_events:
-            self._flush_events()
+        await self._drain_events_async()
         return settled
 
     async def _asettle_one(self) -> int:
@@ -1426,13 +1621,6 @@ class Scheduler:
         self.metrics.algorithm_latency.append(residual)
         timer.step("device solve")
 
-        fblob, iblob = blobs
-        scheduled = 0
-        committed: list[tuple[Pod, str, int]] = []
-        any_rejected = False
-        t_bind = time.monotonic()
-        # partition the batch: assigned rows to bind vs solver rejections
-        name_of = self.statedb.table.name_of
         rows = assignments[:len(pods)].tolist()
         # preemption verdicts ride the same result; resolve them only when
         # this batch actually carried a victim table
@@ -1442,6 +1630,35 @@ class Scheduler:
                 result.preempt_node)[:len(pods)].tolist()
             victim_counts = np.asarray(
                 result.victim_count)[:len(pods)].tolist()
+        scheduled, committed, any_rejected = self._apply_batch(
+            result, pods, live_keys, blobs, flags, rows, preempt_rows,
+            victim_counts, gang_groups, vslots, timer)
+        self._commit_ledger(result, blobs[0], committed, any_rejected,
+                            flags, adopted)
+        self._release_blobs(blobs)
+        timer.step("bind + commit")
+        timer.log_if_long(0.1 * len(pods))
+        return scheduled
+
+    def _apply_batch(self, result, pods: list[Pod], live_keys: list[str],
+                     blobs, flags, rows: list[int],
+                     preempt_rows: list[int] | None,
+                     victim_counts: list[int] | None, gang_groups: dict,
+                     vslots, timer=None) -> tuple[int, list, bool]:
+        """Act on one solved batch's host-side verdicts: settle gangs,
+        partition assigned rows from rejections, bulk-bind through the
+        store, and buffer the per-pod events. Runs ON the event loop (in
+        staged mode the commit thread marshals it here via LoopCalls) so
+        every store write stays loop-serialized. Returns (scheduled,
+        committed, any_rejected) for _commit_ledger."""
+        scheduled = 0
+        committed: list[tuple[Pod, str, int]] = []
+        any_rejected = False
+        t_bind = time.monotonic()
+        t_bind_cpu = time.thread_time()
+        # partition the batch: assigned rows to bind vs solver rejections
+        name_of = self.statedb.table.name_of
+        event_entries: list[tuple[Pod, str, str, str]] = []
         taken_victims: set[str] = set()
         # settle gangs at the GROUP level first: a reverted group requeues
         # as one unit with group backoff (its members' -1 rows are the
@@ -1466,10 +1683,10 @@ class Scheduler:
             for p in positions:
                 gang_handled.add(live_keys[p])
                 self.metrics.failed += 1
-                self.events.record(
-                    pods[p], "Warning", "FailedScheduling",
-                    f"pod group {gkey} placed {placed}/{quorum} members; "
-                    f"group reverted (all-or-nothing)")
+                event_entries.append(
+                    (pods[p], "Warning", "FailedScheduling",
+                     f"pod group {gkey} placed {placed}/{quorum} members; "
+                     f"group reverted (all-or-nothing)"))
             # gang preemption composes all-or-nothing: the solver emits
             # verdicts only when EVERY unplaced member found a victim set,
             # so either the whole group's victims are evicted or none are
@@ -1498,12 +1715,15 @@ class Scheduler:
                     self.queue.done(key)
                     self.queue.add_after(key, 0.05)
                     continue
-                self._fail(key, pod, "no nodes available to schedule pods")
+                self._fail_batch(key, pod,
+                                 "no nodes available to schedule pods",
+                                 event_entries)
                 continue
             node_name = name_of[row]
             if node_name is None:
                 any_rejected = True  # the vanished node left a ledger charge
-                self._fail(key, pod, "assigned node vanished")
+                self._fail_batch(key, pod, "assigned node vanished",
+                                 event_entries)
                 continue
             if holds_active and self.nominated.blocks(
                     node_name, int(pod.spec.priority), now_mono):
@@ -1511,9 +1731,10 @@ class Scheduler:
                 # held for a nominated higher-priority preemptor — backing
                 # off here is what makes the eviction actually pay off
                 any_rejected = True
-                self._fail(key, pod,
-                           f"node {node_name} capacity is held for a "
-                           f"nominated higher-priority pod")
+                self._fail_batch(key, pod,
+                                 f"node {node_name} capacity is held for a "
+                                 f"nominated higher-priority pod",
+                                 event_entries)
                 continue
             to_bind.append((i, key, pod, node_name))
 
@@ -1547,7 +1768,6 @@ class Scheduler:
             errs = []
 
         now = time.monotonic()
-        event_entries: list[tuple[Pod, str]] = []
         assumed_add = self._assumed.add
         queue_done = self.queue.done
         backoff_reset = self.backoff.reset
@@ -1562,7 +1782,8 @@ class Scheduler:
                 # the solver's ledger charged this pod; drop that ledger below
                 any_rejected = True
                 self.metrics.binding_errors += 1
-                self._fail(key, pod, f"binding rejected: {err}")
+                self._fail_batch(key, pod, f"binding rejected: {err}",
+                                 event_entries)
                 continue
             assumed_add(key)
             committed.append((pod, node_name, i))
@@ -1574,7 +1795,8 @@ class Scheduler:
             if enq is not None:
                 e2e_append(now - enq)
             event_entries.append(
-                (pod, f"Successfully assigned {key} to {node_name}"))
+                (pod, "Normal", "Scheduled",
+                 f"Successfully assigned {key} to {node_name}"))
         if event_entries:
             self._pending_events.extend(event_entries)
             if not self._event_flush_scheduled:
@@ -1583,37 +1805,51 @@ class Scheduler:
                     self._event_flush_scheduled = True
                 except RuntimeError:   # sync stop() path: no running loop
                     self._flush_events()
-        self.metrics.add_phase("bind", time.monotonic() - t_bind)
-
-        t_commit = time.monotonic()
-        if any_rejected:
-            # the solver output charges pods whose binding failed: keep the
-            # host truth (accounting only bound pods) and force a re-upload
-            # instead of adopting the device ledger (ForgetPod analog)
-            self.statedb.commit_batch(result, fblob, committed,
-                                      replace_device=False)
-            self.statedb.mark_ledger_dirty()
-        else:
-            # clean batch: adopt the full device ledger, no transfer either
-            # way (a pipelined batch already adopted at dispatch — replacing
-            # now would regress the device ledger past its successor)
-            from kubernetes_tpu.ops.solver import ledger_coverage
-
-            self.statedb.commit_batch(
-                result, fblob, committed, replace_device=not adopted,
-                coverage=ledger_coverage(self.policy, flags))
-        self.metrics.add_phase("commit", time.monotonic() - t_commit)
+        dt_bind = time.monotonic() - t_bind
+        # phase cost in THREAD CPU time: with stage threads overlapping the
+        # loop, wall time here includes GIL waits on a concurrent solve's
+        # trace/compile — CPU time is the stable drift signal the phase
+        # gates watch (wall == cpu when uncontended)
+        self.metrics.add_phase("bind", time.thread_time() - t_bind_cpu)
         if scheduled:
             # per-pod binding latency (the batch amortizes one write loop)
-            self.metrics.binding_latency.append(
-                (time.monotonic() - t_bind) / scheduled)
+            self.metrics.binding_latency.append(dt_bind / scheduled)
         self.metrics.scheduled += scheduled
         self.metrics.batches += 1
         if self.metrics.batches % 128 == 0:
             self.backoff.gc()
-        timer.step("bind + commit")
-        timer.log_if_long(0.1 * len(pods))
-        return scheduled
+        return scheduled, committed, any_rejected
+
+    def _commit_ledger(self, result, fblob, committed: list,
+                       any_rejected: bool, flags, adopted: bool) -> None:
+        """Fold one applied batch into the StateDB ledgers. Safe OFF the
+        loop (the staged commit thread calls it directly): everything runs
+        under the host-state lock, against host/device arrays the loop
+        never mutates mid-batch."""
+        t_commit = time.thread_time()
+        with self._state_lock:
+            if any_rejected:
+                # the solver output charges pods whose binding failed: keep
+                # the host truth (accounting only bound pods) and force a
+                # re-upload instead of adopting the device ledger
+                # (ForgetPod analog)
+                self.statedb.commit_batch(result, fblob, committed,
+                                          replace_device=False)
+                self.statedb.mark_ledger_dirty()
+            else:
+                # clean batch: adopt the full device ledger, no transfer
+                # either way (a pipelined batch already adopted at dispatch —
+                # replacing now would regress the device ledger past its
+                # successor)
+                from kubernetes_tpu.ops.solver import ledger_coverage
+
+                self.statedb.commit_batch(
+                    result, fblob, committed, replace_device=not adopted,
+                    coverage=ledger_coverage(self.policy, flags))
+        # CPU time, not wall: under the staged pipeline this runs in the
+        # commit thread while the dispatch thread may be tracing a new
+        # solver variant (GIL-heavy) — see _apply_batch's bind phase note
+        self.metrics.add_phase("commit", time.thread_time() - t_commit)
 
     def _build_victims(self, flags):
         """Victim-candidate table for this batch: the StateDB's accounted
@@ -1704,3 +1940,13 @@ class Scheduler:
         self.queue.done(key)
         self.queue.add_after(key, self.backoff.next_delay(key))
         self.events.record(pod, "Warning", "FailedScheduling", message)
+
+    def _fail_batch(self, key: str, pod: Pod, message: str,
+                    buf: list) -> None:
+        """_fail for the batch apply path: the event rides the batch's
+        coalesced buffer (one bulk store write per (type, reason)) instead
+        of a per-pod synchronous record."""
+        self.metrics.failed += 1
+        self.queue.done(key)
+        self.queue.add_after(key, self.backoff.next_delay(key))
+        buf.append((pod, "Warning", "FailedScheduling", message))

@@ -23,6 +23,17 @@ from kubernetes_tpu.apiserver.store import (
 _KNOWN_MAX = 65536
 
 
+def _group_entries(entries: list[tuple]):
+    """Merge (obj, type, reason, message) 4-tuples by (type, reason),
+    groups ordered by first appearance: [(type, reason, [(obj, message),
+    ...]), ...]. Within a solved batch no object appears under two
+    reasons (a pod either bound or failed), so merging runs is safe."""
+    groups: dict[tuple[str, str], list[tuple]] = {}
+    for obj, event_type, reason, message in entries:
+        groups.setdefault((event_type, reason), []).append((obj, message))
+    return [(t, r, sub) for (t, r), sub in groups.items()]
+
+
 class EventRecorder:
     def __init__(self, store: ObjectStore, component: str = "default-scheduler"):
         self.store = store
@@ -32,24 +43,21 @@ class EventRecorder:
         # under load; bounded so a long-lived process cannot grow it forever
         self._known: OrderedDict[tuple[str, str], None] = OrderedDict()
 
-    def record_many(
-            self, entries: list[tuple], event_type: str, reason: str) -> None:
-        """Batched recording of one (type, reason) across many objects — the
-        scheduler's per-batch `Scheduled` burst. entries = (obj, message)
-        pairs. First-time names (the overwhelming case: event names embed
-        the per-pod object name) go through the store's bulk-create path in
-        one pass; repeats fall back to the aggregating record()."""
-        fresh: list[Event] = []
-        fresh_keys: list[tuple[str, str]] = []
+    def build_many(self, entries: list[tuple], event_type: str,
+                   reason: str) -> tuple[list[Event], list[tuple[str, str]]]:
+        """Construct (but do not store) the Event objects for a batch of
+        (obj, message) pairs. Pure object construction — no store access, no
+        recorder state — so an event worker shard can run it OFF the event
+        loop while the driver keeps scheduling; install_many() publishes the
+        result on the loop."""
+        built: list[Event] = []
+        keys: list[tuple[str, str]] = []
         reason_suffix = f".{reason.lower()}"
         for obj, message in entries:
             name = obj.metadata.name + reason_suffix
             namespace = obj.metadata.namespace
-            key = (namespace, name)
-            if key in self._known:
-                self.record(obj, event_type, reason, message)
-                continue
-            fresh.append(Event(
+            keys.append((namespace, name))
+            built.append(Event(
                 metadata=ObjectMeta(name=name, namespace=namespace),
                 involved_object={
                     "kind": obj.kind,
@@ -62,7 +70,40 @@ class EventRecorder:
                 type=event_type,
                 source_component=self.component,
             ))
-            fresh_keys.append(key)
+        return built, keys
+
+    def record_many(
+            self, entries: list[tuple], event_type: str, reason: str) -> None:
+        """Batched recording of one (type, reason) across many objects — the
+        scheduler's per-batch `Scheduled` burst. entries = (obj, message)
+        pairs. First-time names (the overwhelming case: event names embed
+        the per-pod object name) go through the store's bulk-create path in
+        one pass; repeats fall back to the aggregating record()."""
+        built, keys = self.build_many(entries, event_type, reason)
+        self.install_many(entries, built, keys, event_type, reason)
+
+    def record_grouped(self, entries: list[tuple]) -> None:
+        """Record (obj, event_type, reason, message) 4-tuples, coalescing
+        runs that share (type, reason) into one batched store write each —
+        a solved batch's Scheduled burst plus its FailedScheduling tail
+        lands in two bulk creates instead of thousands of singles."""
+        for event_type, reason, sub in _group_entries(entries):
+            self.record_many(sub, event_type, reason)
+
+    def install_many(self, entries: list[tuple], built: list[Event],
+                     keys: list[tuple[str, str]], event_type: str,
+                     reason: str) -> None:
+        """Publish pre-built events (build_many) to the store — the
+        loop-side half of record_many. Names already in the aggregation
+        index fall back to the bumping record() path."""
+        fresh: list[Event] = []
+        fresh_keys: list[tuple[str, str]] = []
+        for (obj, message), event, key in zip(entries, built, keys):
+            if key in self._known:
+                self.record(obj, event_type, reason, message)
+            else:
+                fresh.append(event)
+                fresh_keys.append(key)
         if not fresh:
             return
         create_many = getattr(self.store, "create_many", None)

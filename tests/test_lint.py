@@ -109,6 +109,43 @@ def test_r1_tier2_audits_bare_time_sleep_anywhere():
     assert "allow[blocking-in-async]" in f.message
 
 
+def test_r1_tier3_flags_loop_access_from_thread_target():
+    # the staged-pipeline bug class: a stage worker thread touching the
+    # loop (asyncio API or loop methods) races loop internals
+    src = (
+        "import asyncio, threading\n"
+        "class P:\n"
+        "    def start(self):\n"
+        "        threading.Thread(target=self._stage).start()\n"
+        "    def _stage(self):\n"
+        "        asyncio.get_running_loop()\n"
+        "        self.loop.call_soon(self.fn)\n"
+    )
+    found = lint_source(src, rules=R1)
+    assert sorted(f.line for f in found) == [6, 7]
+    assert all("call_soon_threadsafe" in f.message for f in found)
+
+
+def test_r1_tier3_clean_on_threadsafe_marshal_and_own_loop():
+    # call_soon_threadsafe is the sanctioned crossing; asyncio.run is a
+    # thread owning a PRIVATE loop (the harness's in-process APIServer);
+    # functions never handed to Thread(target=...) are not judged
+    src = (
+        "import asyncio, threading\n"
+        "class P:\n"
+        "    def start(self):\n"
+        "        threading.Thread(target=self._stage).start()\n"
+        "        threading.Thread(target=serve).start()\n"
+        "    def _stage(self):\n"
+        "        self.loop.call_soon_threadsafe(self.drain)\n"
+        "    def on_loop(self):\n"
+        "        asyncio.get_running_loop().call_soon(self.drain)\n"
+        "def serve():\n"
+        "    asyncio.run(main())\n"
+    )
+    assert lint_source(src, rules=R1) == []
+
+
 def test_suppression_comment_on_line_and_line_above():
     inline = (
         "import time\n"

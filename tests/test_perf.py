@@ -69,11 +69,11 @@ def test_host_phase_cost_gates():
         node_kwargs={"zones": 3})
     assert result.scheduled == 1200
     phases = result.metrics["phase_us_per_pod"]
-    # individual phases wobble under GIL contention with the pipeline's
-    # readback threads (bind measured 8 us/pod standalone, ~55 when other
-    # suites share the process); the summed host cost is the stable drift
-    # signal — ~35 us/pod standalone, ~80 contended, so 150 catches a 2x
-    # regression of the whole plane or ~10x of any single phase
+    # host phases accrue thread CPU time (stage threads overlap the loop,
+    # so wall time would count GIL waits on a concurrent solve's
+    # trace/compile); the summed host cost is the stable drift signal —
+    # ~35 us/pod, so 150 catches a 2x regression of the whole plane or
+    # ~10x of any single phase
     total = (phases["bind"] + phases["commit"] + phases["encode"]
              + phases["flush"])
     assert total < 150, phases
