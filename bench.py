@@ -35,6 +35,17 @@ compiled program; 0 disables, and --smoke defaults it off). The headline
 extras also carry the staged pipeline's per-stage busy fractions and
 inter-stage queue high-water marks (headline_pipeline_*).
 
+The opt-in `sharded` config (BENCH_CONFIGS=...,sharded) runs
+headline/gang/preemption plus a device-solve gate with the node axis
+GSPMD-sharded across every attached device (BENCH_SHARDED_NODES default
+100000, BENCH_SHARDED_PODS, BENCH_SHARDED_GANG_PODS,
+BENCH_SHARDED_PREEMPT_NODES, BENCH_SHARDED_DEVICE_PODS,
+BENCH_SHARDED_GATE device floor — 0 disables). BENCH_SHARDED_FORCE_HOST=1
+(the --smoke default) forces 8 virtual CPU devices via XLA_FLAGS so the
+whole multi-chip path runs in CI; extras carry per-shard occupancy and
+the StateDB flush-transfer counters proving the hot path never uploads
+full-cluster host arrays.
+
 --metrics-snapshot (or BENCH_METRICS_SNAPSHOT=1) embeds the scheduler's
 per-phase registry histograms (encode/flush/dispatch/solve/bind/commit:
 count, sum_ms, p50_ms, p99_ms) in extras for each throughput config.
@@ -87,8 +98,15 @@ def main() -> None:
         os.environ.setdefault("BENCH_FANOUT_EVENTS", "20")
         os.environ.setdefault("BENCH_DEVICE_GATE", "0")  # CPU CI: no gate
         os.environ.setdefault("BENCH_E2E_GATE", "0")     # seconds-scale run
+        os.environ.setdefault("BENCH_SHARDED_NODES", "64")
+        os.environ.setdefault("BENCH_SHARDED_PODS", "96")
+        os.environ.setdefault("BENCH_SHARDED_GANG_PODS", "32")
+        os.environ.setdefault("BENCH_SHARDED_PREEMPT_NODES", "16")
+        os.environ.setdefault("BENCH_SHARDED_DEVICE_PODS", "64")
+        os.environ.setdefault("BENCH_SHARDED_GATE", "0")  # CPU CI: no gate
+        os.environ.setdefault("BENCH_SHARDED_FORCE_HOST", "1")
         os.environ.setdefault(
-            "BENCH_CONFIGS", "headline,gang,preemption,autoscaler")
+            "BENCH_CONFIGS", "headline,gang,preemption,autoscaler,sharded")
         os.environ.setdefault("BENCH_TIMEOUT_S", "600")
     timeout = int(os.environ.get("BENCH_TIMEOUT_S", "1800"))
     signal.signal(signal.SIGALRM, _die_with_timeout)
@@ -103,6 +121,17 @@ def main() -> None:
     configs = [c.strip() for c in configs.split(",") if c.strip()]
     metrics_snapshot = "--metrics-snapshot" in sys.argv[1:] or \
         os.environ.get("BENCH_METRICS_SNAPSHOT", "") in ("1", "true")
+
+    # the sharded config needs >=2 devices; BENCH_SHARDED_FORCE_HOST=1
+    # (default in --smoke) forces 8 virtual CPU devices. Must land in
+    # XLA_FLAGS before jax is imported anywhere in this process.
+    if "sharded" in configs and \
+            os.environ.get("BENCH_SHARDED_FORCE_HOST", "") in ("1", "true") \
+            and "xla_force_host_platform_device_count" not in \
+            os.environ.get("XLA_FLAGS", ""):
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=8").strip()
 
     import jax
 
@@ -398,13 +427,96 @@ def main() -> None:
                 f"device solve regression: deep {rd.pods_per_sec:.0f} pods/s "
                 f"< gate {gate_floor:.0f}")
 
+    if "sharded" in configs:
+        # multi-chip GSPMD path at 100k+ nodes: headline/gang/preemption
+        # end-to-end with the node axis sharded across every device, plus a
+        # sharded device-solve gate. The StateDB flush counters prove the
+        # hot path never re-materializes full-cluster host arrays
+        # (flush_full_total stays at the setup uploads), and shard_rows
+        # shows the interleaved row addressing keeping occupancy balanced.
+        from kubernetes_tpu.parallel.mesh import make_mesh
+        from kubernetes_tpu.perf.harness import run_device_solve, \
+            run_preemption
+
+        mesh = make_mesh()
+        sh_nodes = int(os.environ.get("BENCH_SHARDED_NODES", "100000"))
+        sh_pods = int(os.environ.get("BENCH_SHARDED_PODS", "16384"))
+        r = run_throughput(sh_nodes, sh_pods, node_kwargs={"zones": 3},
+                           mesh=mesh)
+        print(f"bench[sharded]: {r} | {r.sharding}", file=sys.stderr,
+              flush=True)
+        extras["sharded_nodes"] = sh_nodes
+        extras["sharded_devices"] = mesh.size
+        extras["sharded_pods_per_sec"] = round(r.pods_per_sec, 1)
+        extras["sharded_vs_baseline"] = round(r.pods_per_sec / baseline, 2)
+        extras["sharded_shard_rows"] = r.sharding["shard_rows"]
+        extras["sharded_flush_rows_total"] = r.sharding["flush_rows_total"]
+        extras["sharded_flush_transfers_total"] = \
+            r.sharding["flush_transfers_total"]
+        extras["sharded_flush_full_total"] = r.sharding["flush_full_total"]
+        if r.scheduled < sh_pods:
+            RESULT["error"] = (
+                f"sharded bench: only {r.scheduled}/{sh_pods} pods bound")
+        # incremental flushes must scatter dirty rows, never re-upload the
+        # cluster: full uploads are only legal during node registration
+        elif r.sharding["flush_full_total"] > 4:
+            RESULT["error"] = (
+                f"sharded bench: {r.sharding['flush_full_total']} "
+                "full-cluster host uploads on the hot path (dirty-row "
+                "scatter flush regressed)")
+
+        sh_gang_pods = int(os.environ.get("BENCH_SHARDED_GANG_PODS", "8192"))
+        sh_gang_pods -= sh_gang_pods % 8
+        rg = run_throughput(sh_nodes, sh_gang_pods,
+                            node_kwargs={"zones": 3},
+                            pod_kwargs={"gang_size": 8}, mesh=mesh)
+        print(f"bench[sharded/gang]: {rg}", file=sys.stderr, flush=True)
+        extras["sharded_gang_pods_per_sec"] = round(rg.pods_per_sec, 1)
+        gang_stats = rg.metrics.get("gang", {})
+        extras["sharded_gang_groups_placed"] = gang_stats.get("placed", 0)
+        extras["sharded_gang_groups_reverted"] = gang_stats.get("reverted", 0)
+        settled = gang_stats.get("placed", 0) + gang_stats.get("reverted", 0)
+        if settled < sh_gang_pods // 8 and "error" not in RESULT:
+            RESULT["error"] = (
+                f"sharded gang: only {settled}/{sh_gang_pods // 8} "
+                "groups settled")
+
+        sh_pre = int(os.environ.get("BENCH_SHARDED_PREEMPT_NODES", "512"))
+        rp = run_preemption(sh_pre, mesh=mesh)
+        print(f"bench[sharded/preemption]: {rp}", file=sys.stderr, flush=True)
+        extras["sharded_preemption_latency_ms"] = \
+            round(rp.preemption_latency_ms, 1)
+        extras["sharded_preemption_victims"] = rp.victims
+        if rp.bound_wave < rp.wave and "error" not in RESULT:
+            RESULT["error"] = (
+                f"sharded preemption: only {rp.bound_wave}/{rp.wave} "
+                "high-priority pods landed")
+
+        sh_dev_pods = int(os.environ.get("BENCH_SHARDED_DEVICE_PODS", "4096"))
+        rd = run_device_solve(sh_nodes, batch_pods=sh_dev_pods, iters=8,
+                              mesh=mesh)
+        print(f"bench[sharded/device]: {rd}", file=sys.stderr, flush=True)
+        extras["sharded_device_pods_per_sec"] = round(rd.pods_per_sec, 1)
+        extras["sharded_device_solve_ms"] = round(rd.ms_per_solve, 2)
+        # the sharded device gate: at 100k+ nodes on real chips the sharded
+        # program must beat the single-chip N ceiling's economics; CPU CI
+        # disables it (BENCH_SHARDED_GATE=0 in --smoke)
+        sh_gate = float(os.environ.get("BENCH_SHARDED_GATE", "50000"))
+        extras["sharded_device_gate_floor_pods_per_sec"] = sh_gate
+        extras["sharded_device_gate_ok"] = \
+            bool(sh_gate <= 0 or rd.pods_per_sec >= sh_gate)
+        if not extras["sharded_device_gate_ok"] and "error" not in RESULT:
+            RESULT["error"] = (
+                f"sharded device solve: {rd.pods_per_sec:.0f} pods/s "
+                f"< gate {sh_gate:.0f} at N={sh_nodes}")
+
     if RESULT["value"] is None and extras:
         # headline config not selected: promote the first metric actually
         # run so a filtered invocation is distinguishable from a failed one
         gang_keys = [k for k in extras
                      if k.startswith("gang_") and k.endswith("_pods_per_sec")]
         for key in ("interpod_5k_pods_per_sec", "spread_15k_pods_per_sec",
-                    *gang_keys):
+                    "sharded_pods_per_sec", *gang_keys):
             if key in extras:
                 RESULT["metric"] = key
                 RESULT["value"] = extras[key]

@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import ast
 import os
+import re
 
 from kubernetes_tpu.analysis.lint import (
     Finding,
@@ -405,9 +406,9 @@ def _batchflags_fields() -> dict[str, int]:
     return {}
 
 
-def _pinned_flags() -> set[str] | None:
-    """Keys of the PIN_COVERAGE map in tests/test_batch_flags.py, or None
-    when the map (or the test file) is missing."""
+def _pin_coverage_map() -> dict[str, str] | None:
+    """{flag: pin-test relpath} from the PIN_COVERAGE map in
+    tests/test_batch_flags.py, or None when the map (or file) is missing."""
     path = os.path.join(REPO_ROOT, _PIN_TEST_RELPATH)
     if not os.path.exists(path):
         return None
@@ -418,9 +419,32 @@ def _pinned_flags() -> set[str] | None:
                 isinstance(t, ast.Name) and t.id == "PIN_COVERAGE"
                 for t in node.targets) and \
                 isinstance(node.value, ast.Dict):
-            return {k.value for k in node.value.keys
+            return {k.value: (v.value if isinstance(v, ast.Constant) else "")
+                    for k, v in zip(node.value.keys, node.value.values)
                     if isinstance(k, ast.Constant)}
     return None
+
+
+def _pinned_flags() -> set[str] | None:
+    """Keys of the PIN_COVERAGE map, or None when it is missing."""
+    cov = _pin_coverage_map()
+    return None if cov is None else set(cov)
+
+
+# BatchFlags fields whose gate changes the PARTITIONED program (mesh/
+# sharding related): a gating-parity pin is not enough — the named pin test
+# must also hold an HLO pin (a .lower(...)...as_text() comparison), because
+# GSPMD can move collectives without changing single-device results.
+_MESH_FIELD_RE = re.compile(r"(shard|mesh|spmd|device_axis)", re.IGNORECASE)
+
+
+def _has_hlo_pin(relpath: str) -> bool:
+    path = os.path.join(REPO_ROOT, relpath)
+    if not os.path.exists(path):
+        return False
+    with open(path, encoding="utf-8") as f:
+        src = f.read()
+    return ".lower(" in src and "as_text" in src
 
 
 def _const(expr: ast.expr) -> bool:
@@ -446,6 +470,7 @@ class BatchFlagsDiscipline:
                     f"no PIN_COVERAGE map in {_PIN_TEST_RELPATH}: every "
                     "BatchFlags field needs a named gating-parity pin")
             return
+        coverage = _pin_coverage_map() or {}
         for name, line in sorted(fields.items(), key=lambda kv: kv[1]):
             if name not in pinned:
                 yield Finding(
@@ -454,6 +479,14 @@ class BatchFlagsDiscipline:
                     f"({_PIN_TEST_RELPATH}) — a flag without a "
                     "gating-parity pin can silently change the compiled "
                     "program")
+            elif _MESH_FIELD_RE.search(name) and \
+                    not _has_hlo_pin(coverage.get(name, "")):
+                yield Finding(
+                    self.name, mod.relpath, line, 0,
+                    f"BatchFlags.{name} is mesh-related but its pin test "
+                    f"({coverage.get(name) or 'unset'}) carries no HLO pin "
+                    "(.lower()/as_text comparison) — GSPMD partitioning "
+                    "changes are invisible to value-level parity pins")
 
     def _check_gate_sites(self, mod: Module):
         fields = set(_batchflags_fields())

@@ -61,15 +61,24 @@ class ScaleUpProbe:
 
 class ScaleSimulator:
     def __init__(self, caps: Capacities | None = None,
-                 policy: Policy = DEFAULT_POLICY, volume_ctx=None):
+                 policy: Policy = DEFAULT_POLICY, volume_ctx=None,
+                 mesh=None):
         from kubernetes_tpu.models.policy import build_policy_rows
 
         # probe fleets are small: default capacities sized for control-plane
-        # what-ifs, not 50k-node scheduling batches (callers override)
+        # what-ifs, not 50k-node scheduling batches (callers override).
+        # mesh: run probe solves node-sharded like the scheduler's own
+        # programs — what-ifs against 100k+-node state stay per-shard too
         self.caps = caps or Capacities(num_nodes=128, batch_pods=64)
+        self.mesh = mesh
+        if mesh is not None and self.caps.num_nodes % mesh.size:
+            from kubernetes_tpu.parallel.mesh import padded_num_nodes
+            self.caps = dataclasses.replace(
+                self.caps,
+                num_nodes=padded_num_nodes(self.caps.num_nodes, mesh.size))
         policy = policy.with_env_overrides()
         self.policy = policy
-        self.statedb = StateDB(self.caps, volume_ctx=volume_ctx)
+        self.statedb = StateDB(self.caps, mesh=mesh, volume_ctx=volume_ctx)
         self.encode_cache = EncodeCache(self.caps, self.statedb.table,
                                         volume_ctx=volume_ctx)
         self._prows = build_policy_rows(policy, self.statedb.table, self.caps)
@@ -111,10 +120,16 @@ class ScaleSimulator:
             from kubernetes_tpu.ops.solver import schedule_batch
 
             caps, policy, prows = self.caps, self.policy, self._prows
-            fn = jax.jit(
-                lambda s, fb, ib, rr: schedule_batch(
-                    s, unpack_batch(fb, ib, caps), rr, policy,
-                    caps=caps, prows=prows, flags=flags))
+            if self.mesh is not None:
+                from kubernetes_tpu.parallel.mesh import make_sharded_scheduler
+                fn = make_sharded_scheduler(self.mesh, policy, caps=caps,
+                                            prows=prows, flags=flags,
+                                            packed=True)
+            else:
+                fn = jax.jit(
+                    lambda s, fb, ib, rr: schedule_batch(
+                        s, unpack_batch(fb, ib, caps), rr, policy,
+                        caps=caps, prows=prows, flags=flags))
             self._fns[flags] = fn
         return fn
 

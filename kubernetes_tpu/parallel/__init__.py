@@ -1,6 +1,8 @@
 from kubernetes_tpu.parallel.mesh import (  # noqa: F401
     make_mesh,
     make_sharded_scheduler,
+    pad_state,
+    padded_num_nodes,
     shard_batch,
     shard_state,
 )

@@ -60,11 +60,37 @@ def batch_sharding(mesh: Mesh) -> PodBatch:
         **{f: 0 for f in PodBatch.__dataclass_fields__}))
 
 
+def padded_num_nodes(num_nodes: int, mesh_size: int) -> int:
+    """Smallest multiple of mesh_size >= num_nodes — the node-axis shape a
+    mesh of that size can shard evenly."""
+    return -(-num_nodes // mesh_size) * mesh_size
+
+
+def pad_state(state: ClusterState, mesh: Mesh) -> ClusterState:
+    """Pad the node axis with sentinel rows (valid=False, zero allocatable,
+    topology=-1 — the empty_state row shape) up to the next mesh multiple.
+    Sentinel rows fail the validity predicate, so they can never receive a
+    pod and never contribute to scoring: the padded program's decisions are
+    bit-identical to the unpadded one's."""
+    from kubernetes_tpu.state.cluster_state import NODE_AXIS_FIELDS
+
+    target = padded_num_nodes(state.num_nodes, mesh.size)
+    pad = target - state.num_nodes
+    if pad == 0:
+        return state
+
+    def pad_field(name: str, arr):
+        arr = np.asarray(arr)
+        fill = -1 if name == "topology" else 0
+        return np.pad(arr, [(0, pad)] + [(0, 0)] * (arr.ndim - 1),
+                      constant_values=fill)
+
+    return state.replace(**{f: pad_field(f, getattr(state, f))
+                            for f in NODE_AXIS_FIELDS})
+
+
 def shard_state(state: ClusterState, mesh: Mesh) -> ClusterState:
-    if state.num_nodes % mesh.size != 0:
-        raise ValueError(
-            f"num_nodes={state.num_nodes} not divisible by mesh size {mesh.size}; "
-            f"pick Capacities.num_nodes as a multiple of the device count")
+    state = pad_state(state, mesh)
     return jax.device_put(state, state_sharding(mesh))
 
 
@@ -101,18 +127,25 @@ def make_sharded_scheduler(mesh: Mesh, policy: Policy = DEFAULT_POLICY,
         new_vol_any=nodes_spec, new_vol_rw=nodes_spec,
         new_attach=nodes_spec,
         preempt_node=repl, victim_count=repl,
+        # scale_sim probes: per-node placement counts stay node-sharded
+        # (optional fields are None in non-probe programs; a sharding on a
+        # None output is an empty pytree-prefix match, so one out_shardings
+        # covers every flag combination)
+        placed_per_node=nodes_spec,
     )
     if packed:
         from kubernetes_tpu.state.pod_batch import unpack_batch
 
-        # victims (a VictimTable or None) rides replicated: the in_shardings
-        # leaf is a pytree prefix, valid for both structures
+        # victims (a VictimTable or None) shards its node axis: prio[N,S],
+        # req[N,S,R] and ok[N,S] all lead with the node dim, and the
+        # in_shardings leaf is a pytree prefix, valid for both structures
+        vic = nodes_spec
         jfn = jax.jit(
             lambda state, fblob, iblob, rr, victims: schedule_batch(
                 state, unpack_batch(fblob, iblob, caps), rr, policy,
                 caps=caps, prows=prows, flags=flags, allow_fused=False,
                 victims=victims),
-            in_shardings=(st, repl, repl, repl, repl),
+            in_shardings=(st, repl, repl, repl, vic),
             out_shardings=out_shardings,
         )
 

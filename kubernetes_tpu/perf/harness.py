@@ -36,6 +36,9 @@ class ThroughputResult:
     # staged-pipeline occupancy over the timed wave (stage_busy_frac +
     # queue-depth high-water marks); empty when KTPU_STAGED_PIPELINE=0
     pipeline: dict = field(default_factory=dict)
+    # mesh runs: per-shard live-row occupancy + StateDB flush transfer
+    # counters (bench[sharded] extras); empty without a mesh
+    sharding: dict = field(default_factory=dict)
 
     def __str__(self) -> str:
         return (f"{self.scheduled} pods in {self.seconds:.2f}s = "
@@ -106,6 +109,13 @@ async def _run(n_nodes: int, n_pods: int, caps: Capacities, policy: Policy,
         phase_hist=sched.metrics.phase_histograms(),
         pipeline=(sched._staged.snapshot()
                   if sched._staged is not None else {}),
+        sharding=({
+            "devices": mesh.size,
+            "shard_rows": sched.statedb.shard_occupancy(),
+            "flush_rows_total": sched.statedb.flush_rows_total,
+            "flush_transfers_total": sched.statedb.flush_transfers_total,
+            "flush_full_total": sched.statedb.flush_full_total,
+        } if mesh is not None else {}),
     )
     sched.stop()
     return result
@@ -136,6 +146,7 @@ def run_device_solve(
     policy: Policy = DEFAULT_POLICY,
     node_kwargs: dict | None = None,
     pod_kwargs: dict | None = None,
+    mesh=None,
 ) -> DeviceSolveResult:
     """Time the compiled solver alone: encode one batch, then dispatch it
     `iters` times against device-resident state and block once at the end.
@@ -150,7 +161,7 @@ def run_device_solve(
         store.create(node)
     num = 1 << max(6, (n_nodes - 1).bit_length())
     caps = Capacities(num_nodes=num, batch_pods=batch_pods)
-    sched = Scheduler(store, caps=caps, policy=policy)
+    sched = Scheduler(store, caps=caps, policy=policy, mesh=mesh)
     for node in store.list("Node", copy_objects=False):
         sched.statedb.upsert_node(node)
     fblob, iblob = sched._next_blobs()
@@ -164,8 +175,15 @@ def run_device_solve(
     import jax
 
     # pin the packed batch on device once: this measures the solver, not
-    # the per-call blob upload (which the e2e figure already carries)
-    fblob, iblob = jax.device_put(fblob), jax.device_put(iblob)
+    # the per-call blob upload (which the e2e figure already carries);
+    # under a mesh the batch replicates to every device up front
+    if mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec
+        repl = NamedSharding(mesh, PartitionSpec())
+        fblob, iblob = (jax.device_put(fblob, repl),
+                        jax.device_put(iblob, repl))
+    else:
+        fblob, iblob = jax.device_put(fblob), jax.device_put(iblob)
     warm = fn(state, fblob, iblob, rr)   # compile + device warmup
     np.asarray(warm.assignments)
     rr = warm.rr_end                     # device-resident, chained like the
@@ -207,7 +225,8 @@ class PreemptionResult:
 
 
 async def _run_preemption(n_nodes: int, wave: int,
-                          fillers_per_node: int) -> PreemptionResult:
+                          fillers_per_node: int,
+                          mesh=None) -> PreemptionResult:
     """Saturate every node's CPU with globalDefault-priority filler, then
     create a wave of pods whose PriorityClass outranks the filler and whose
     request only fits after an eviction. Each wave pod must take the full
@@ -231,7 +250,7 @@ async def _run_preemption(n_nodes: int, wave: int,
     num = 1 << max(6, (n_nodes - 1).bit_length())
     caps = Capacities(num_nodes=num,
                       batch_pods=min(2048, max(64, n_nodes)))
-    sched = Scheduler(store, caps=caps)
+    sched = Scheduler(store, caps=caps, mesh=mesh)
     await sched.start()
 
     async def drain(expect: int) -> int:
@@ -283,11 +302,12 @@ async def _run_preemption(n_nodes: int, wave: int,
 
 
 def run_preemption(n_nodes: int = 512, wave: int | None = None,
-                   fillers_per_node: int = 2) -> PreemptionResult:
+                   fillers_per_node: int = 2, mesh=None) -> PreemptionResult:
     """Blocking entry point for the priority/preemption drill."""
     if wave is None:
         wave = max(8, n_nodes // 4)
-    return asyncio.run(_run_preemption(n_nodes, wave, fillers_per_node))
+    return asyncio.run(_run_preemption(n_nodes, wave, fillers_per_node,
+                                       mesh=mesh))
 
 
 @dataclass

@@ -390,6 +390,16 @@ class Scheduler:
 
         self.store = store
         self.caps = caps or Capacities()
+        if mesh is not None and self.caps.num_nodes % mesh.size:
+            # GSPMD shards the node axis evenly: round the row budget up to
+            # the next mesh multiple (the extra rows stay unassigned — same
+            # sentinel shape shard_state pads direct callers with)
+            import dataclasses as _dc
+
+            from kubernetes_tpu.parallel.mesh import padded_num_nodes
+            self.caps = _dc.replace(
+                self.caps,
+                num_nodes=padded_num_nodes(self.caps.num_nodes, mesh.size))
         policy = policy.with_env_overrides()  # KUBE_MAX_PD_VOLS (defaults.go)
         self.policy = policy
         self.scheduler_name = scheduler_name

@@ -232,11 +232,22 @@ class NodeTable:
     for membership refills, topology-domain interning, and per-row generation
     counters (the NodeInfo.generation analog, node_info.go:60)."""
 
-    def __init__(self, caps: Capacities):
+    def __init__(self, caps: Capacities, shards: int = 1):
         self.caps = caps
+        self.shards = shards if caps.num_nodes % max(shards, 1) == 0 else 1
         self.row_of: dict[str, int] = {}
         self.name_of: list[str | None] = [None] * caps.num_nodes
-        self.free: list[int] = list(range(caps.num_nodes - 1, -1, -1))
+        if self.shards > 1:
+            # shard-interleaved addressing (mesh attached): consecutive
+            # assignments land on consecutive shards, so a partially filled
+            # cluster keeps live rows balanced across devices instead of
+            # saturating shard 0 first. Popped from the end -> reversed.
+            chunk = caps.num_nodes // self.shards
+            self.free: list[int] = [
+                s * chunk + loc
+                for loc in range(chunk) for s in range(self.shards)][::-1]
+        else:
+            self.free = list(range(caps.num_nodes - 1, -1, -1))
         self.generation: np.ndarray = np.zeros((caps.num_nodes,), np.int64)
         self._gen_counter = 0
         # universes

@@ -70,12 +70,34 @@ class StateDB:
         self.mesh = mesh
         self.volume_ctx = volume_ctx  # VolumeContext for claim resolution
         self.host: ClusterState = empty_state(caps)
-        self.table = NodeTable(caps)
+        self.table = NodeTable(
+            caps, shards=(mesh.size if mesh is not None else 1))
         self._accounted: dict[str, AccountedPod] = {}
         self._dirty_nodes = True    # static node fields changed
         self._dirty_ledger = True   # requested/nonzero/ports changed on host
         self._dirty_affinity = False  # podsel/term counts changed on host only
         self._device: ClusterState | None = None
+        # exact ledger rows behind _dirty_ledger/_dirty_affinity: when the
+        # set is known and small, flush() scatters just those rows into the
+        # device ledger (one batched transfer) instead of re-uploading whole
+        # [N, W] arrays; _dirty_rows_all falls back to the full path
+        self._dirty_rows: set[int] = set()
+        self._dirty_rows_all = False
+        self._row_updaters: dict = {}   # (fields, K_padded) -> jitted scatter
+        # flush transfer accounting (plain ints mirrored to the obs
+        # registry): rows_total counts ledger rows uploaded, transfers_total
+        # host->device upload operations, full_total whole-state uploads —
+        # the "no full-cluster host materialization on the hot path" figure
+        self.flush_rows_total = 0
+        self.flush_transfers_total = 0
+        self.flush_full_total = 0
+        from kubernetes_tpu.obs import REGISTRY
+        self._m_rows = REGISTRY.counter(
+            "statedb_flush_rows_total",
+            "ledger rows uploaded to device by StateDB.flush")
+        self._m_transfers = REGISTRY.counter(
+            "statedb_flush_transfers_total",
+            "host->device transfers issued by StateDB.flush")
 
     # ---- node lifecycle ----
 
@@ -105,6 +127,7 @@ class StateDB:
     # ---- pod accounting (bound + assumed) ----
 
     def _apply_pod(self, row: int, acc: AccountedPod, sign: int) -> None:
+        self._dirty_rows.add(row)
         self.host.requested[row] += sign * acc.requests
         self.host.nonzero_requested[row] += sign * acc.nonzero
         self.host.port_count[row] += sign * acc.port_onehot
@@ -201,8 +224,10 @@ class StateDB:
     def mark_ledger_dirty(self) -> None:
         """Force the next flush() to re-upload the host ledger — used when the
         device-side ledger is known to carry charges the host truth does not
-        (e.g. a solver assignment whose binding was rolled back)."""
+        (e.g. a solver assignment whose binding was rolled back). The stale
+        device rows are unknown here, so the row-scatter fast path is off."""
         self._dirty_ledger = True
+        self._dirty_rows_all = True
 
     # ---- device mirror ----
 
@@ -222,21 +247,107 @@ class StateDB:
                     row = self.table.row_of.get(acc.node_name)
                     if row is not None:
                         self.host.podsel_count[row, qid] += 1.0
+                        self._dirty_rows.add(row)
                         acc.match_row[qid] = 1.0
         self.table.pending_podsel_refresh.clear()
         self._dirty_affinity = True
 
+    def _ledger_fields(self) -> tuple[str, ...]:
+        """Ledger groups a dirty-ledger/affinity flush must refresh (the
+        f32[N, W] arrays pod accounting mutates), in a stable order."""
+        names = ["requested", "nonzero_requested", "port_count"]
+        if self.table.vol_atoms:
+            names += ["vol_any", "vol_rw"]
+        if self.table.attach_atoms:
+            names.append("attach_count")
+        if self.table.podsels:
+            names += ["podsel_count", "term_count"]
+        return tuple(names)
+
+    def _row_updater(self, fields: tuple[str, ...], k_padded: int):
+        """Jitted per-shard row scatter: (device arrays, rows, packed
+        values) -> updated arrays, keeping node-sharded layout under a
+        mesh. Cached per (field set, padded row count) so steady-state
+        flushes never recompile."""
+        key = (fields, k_padded)
+        fn = self._row_updaters.get(key)
+        if fn is None:
+            widths = [getattr(self.host, f).shape[1] for f in fields]
+
+            def upd(arrays, rows, packed):
+                out = []
+                off = 0
+                for arr, w in zip(arrays, widths):
+                    out.append(arr.at[rows].set(packed[:, off:off + w]))
+                    off += w
+                return tuple(out)
+
+            if self.mesh is not None:
+                from jax.sharding import NamedSharding, PartitionSpec
+                from kubernetes_tpu.parallel.mesh import NODE_AXIS
+                nodes = NamedSharding(self.mesh, PartitionSpec(NODE_AXIS))
+                repl = NamedSharding(self.mesh, PartitionSpec())
+                fn = jax.jit(
+                    upd,
+                    in_shardings=(tuple(nodes for _ in fields), repl, repl),
+                    out_shardings=tuple(nodes for _ in fields))
+            else:
+                fn = jax.jit(upd)
+            self._row_updaters[key] = fn
+        return fn
+
+    def _scatter_rows(self, dev: ClusterState, rows: list[int]) -> ClusterState:
+        """Coalesce the flush's dirty rows into ONE batched host->device
+        transfer: gather every dirty ledger group's rows into a packed
+        (K, sum W) matrix, upload it once, and scatter on device (per shard
+        under a mesh — GSPMD routes each row update to its owning shard).
+        K pads to the next power of two (duplicating row 0's update, which
+        re-sets identical values) to bound compile-cache growth."""
+        fields = self._ledger_fields()
+        k = len(rows)
+        kp = 1 << max(0, (k - 1).bit_length())
+        idx = np.empty((kp,), np.int32)
+        idx[:k] = rows
+        idx[k:] = rows[0]
+        packed = np.concatenate(
+            [getattr(self.host, f)[idx] for f in fields], axis=1)
+        fn = self._row_updater(fields, kp)
+        new = fn(tuple(getattr(dev, f) for f in fields), idx, packed)
+        self.flush_rows_total += k
+        self.flush_transfers_total += 1
+        self._m_rows.inc(k)
+        self._m_transfers.inc()
+        return dev.replace(**dict(zip(fields, new)))
+
     def flush(self) -> ClusterState:
         """Return the device view, re-uploading only what changed. Newly
         interned selector terms / requirements (from pod encoding) refill
-        their membership columns first."""
+        their membership columns first. Ledger dirtiness with a known,
+        small row set takes the coalesced row-scatter path (one batched
+        transfer); everything else re-uploads whole arrays."""
         self._refill_podsel()
         dirty_membership = apply_pending_refreshes(self.host, self.table)
+        ledger_work = self._dirty_ledger or self._dirty_affinity
+        rows = (sorted(self._dirty_rows)
+                if ledger_work and not self._dirty_rows_all else None)
+        can_scatter = (
+            rows is not None and 0 < len(rows)
+            and len(rows) * 4 <= self.caps.num_nodes)
         if self._device is None or self._dirty_nodes:
             dev = self._put(self.host)
-        elif self._dirty_ledger or dirty_membership or self._dirty_affinity:
+            self.flush_full_total += 1
+            self.flush_rows_total += self.caps.num_nodes
+            self.flush_transfers_total += 1
+            self._m_rows.inc(self.caps.num_nodes)
+            self._m_transfers.inc()
+        elif ledger_work or dirty_membership:
             dev = self._device
-            if self._dirty_ledger:
+            if can_scatter and ledger_work:
+                dev = self._scatter_rows(dev, rows)
+            elif self._dirty_ledger:
+                self.flush_full_total += 1
+                self.flush_rows_total += self.caps.num_nodes
+                self._m_rows.inc(self.caps.num_nodes)
                 dev = dev.replace(
                     requested=self._put_arr(self.host.requested),
                     nonzero_requested=self._put_arr(self.host.nonzero_requested),
@@ -249,7 +360,9 @@ class StateDB:
                 if self.table.attach_atoms:
                     dev = dev.replace(
                         attach_count=self._put_arr(self.host.attach_count))
-            if (self._dirty_ledger or self._dirty_affinity) and self.table.podsels:
+            if not (can_scatter and ledger_work) and \
+                    (self._dirty_ledger or self._dirty_affinity) and \
+                    self.table.podsels:
                 dev = dev.replace(
                     podsel_count=self._put_arr(self.host.podsel_count),
                     term_count=self._put_arr(self.host.term_count))
@@ -272,7 +385,21 @@ class StateDB:
         self._dirty_nodes = False
         self._dirty_ledger = False
         self._dirty_affinity = False
+        self._dirty_rows.clear()
+        self._dirty_rows_all = False
         return dev
+
+    def shard_occupancy(self) -> list[int]:
+        """Live node rows per mesh shard (a single-element list without a
+        mesh) — the bench[sharded] balance extra. Row addressing interleaves
+        assignments across shards (NodeTable), so these stay within one of
+        each other until nodes churn."""
+        shards = self.mesh.size if self.mesh is not None else 1
+        chunk = self.caps.num_nodes // shards
+        counts = [0] * shards
+        for row in self.table.row_of.values():
+            counts[row // chunk] += 1
+        return counts
 
     def commit_batch(self, result, fblob: np.ndarray,
                      committed: list[tuple[Pod, str, int]],
@@ -392,10 +519,13 @@ class StateDB:
         ipa_cov, vol_cov, attach_cov = coverage
         if not ipa_cov and (match.any() or carry.any()):
             self._dirty_affinity = True
+            self._dirty_rows.update(rows.tolist())
         if not vol_cov and vol_any.any():
             self._dirty_ledger = True
+            self._dirty_rows.update(rows.tolist())
         if not attach_cov and att.any():
             self._dirty_ledger = True
+            self._dirty_rows.update(rows.tolist())
 
     def _put(self, state: ClusterState) -> ClusterState:
         if self.mesh is not None:
@@ -406,6 +536,8 @@ class StateDB:
         return jax.device_put(jax.tree.map(np.asarray, state))
 
     def _put_arr(self, arr: np.ndarray):
+        self.flush_transfers_total += 1
+        self._m_transfers.inc()
         if self.mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec
             from kubernetes_tpu.parallel.mesh import NODE_AXIS

@@ -311,6 +311,48 @@ def test_r3_pin_coverage_on_real_tree_is_satisfied():
     assert r.findings == []
 
 
+def test_r3_mesh_flag_needs_hlo_pin(monkeypatch, tmp_path):
+    # a mesh-related BatchFlags field whose pin test holds only value-level
+    # parity is flagged; the same field passes once the test carries an HLO
+    # pin (.lower()/as_text comparison)
+    import kubernetes_tpu.analysis.rules as rules_mod
+
+    value_pin = tmp_path / "test_value_pin.py"
+    value_pin.write_text("def test_parity():\n    assert a == b\n")
+    hlo_pin = tmp_path / "test_hlo_pin.py"
+    hlo_pin.write_text(
+        "def test_hlo():\n"
+        "    assert jit_fn.lower(state).as_text() == pinned\n")
+
+    monkeypatch.setattr(rules_mod, "_batchflags_fields",
+                        lambda: {"shard_probe": 7})
+    monkeypatch.setattr(rules_mod, "_pin_coverage_map",
+                        lambda: {"shard_probe": str(value_pin)})
+    (f,) = lint_source("x = 1\n", relpath="kubernetes_tpu/ops/solver.py",
+                       rules=[BatchFlagsDiscipline()])
+    assert f.rule == "batchflags-gate" and "HLO pin" in f.message
+
+    monkeypatch.setattr(rules_mod, "_pin_coverage_map",
+                        lambda: {"shard_probe": str(hlo_pin)})
+    assert lint_source("x = 1\n", relpath="kubernetes_tpu/ops/solver.py",
+                       rules=[BatchFlagsDiscipline()]) == []
+
+
+def test_r3_non_mesh_flag_passes_on_value_pin(monkeypatch, tmp_path):
+    # fields without mesh/shard in the name keep the original contract: a
+    # listed value-level pin suffices
+    import kubernetes_tpu.analysis.rules as rules_mod
+
+    value_pin = tmp_path / "test_value_pin.py"
+    value_pin.write_text("def test_parity():\n    assert a == b\n")
+    monkeypatch.setattr(rules_mod, "_batchflags_fields",
+                        lambda: {"gang": 7})
+    monkeypatch.setattr(rules_mod, "_pin_coverage_map",
+                        lambda: {"gang": str(value_pin)})
+    assert lint_source("x = 1\n", relpath="kubernetes_tpu/ops/solver.py",
+                       rules=[BatchFlagsDiscipline()]) == []
+
+
 # ---------------------------------------------------------------------------
 # R4: determinism of the solve path
 

@@ -1,21 +1,43 @@
-"""Node-axis sharding over a virtual 8-device mesh: sharded and single-device
-execution must produce identical decisions (conftest.py forces 8 CPU
-devices)."""
+"""Node-axis sharding over a virtual 8-device mesh (conftest.py forces 8
+CPU devices): the GSPMD path as a first-class pipeline.
+
+Program-level bit-parity is pinned here for every solver feature — plain
+scoring, gang scan-carry, preemption victim selection, and scale_sim
+what-if probes — by running the sharded and single-device programs over
+the SAME encoded state. (Driver-level runs use interleaved row addressing
+under mesh, so their parity is count/validity-based: test_driver_sharded.)
+
+Also covered: odd node counts auto-pad with sentinel rows, the StateDB
+dirty-row scatter flush keeps incremental updates off the full-cluster
+upload path (with and without a mesh), shard occupancy stays balanced
+under interleaved addressing, a mid-pipeline kill() with a mesh attached
+stays exactly-once under the RaceDetector, and bench --smoke's sharded
+config stays runnable end-to-end."""
+
+import asyncio
+import json
+import os
+import subprocess
+import sys
+import time
+from pathlib import Path
 
 import jax
 import numpy as np
-import pytest
 
 from kubernetes_tpu.models.policy import DEFAULT_POLICY
-from kubernetes_tpu.ops.solver import schedule_batch
+from kubernetes_tpu.ops.solver import batch_flags, schedule_batch
 from kubernetes_tpu.parallel import (
     make_mesh,
     make_sharded_scheduler,
+    padded_num_nodes,
     shard_batch,
     shard_state,
 )
 from kubernetes_tpu.perf.fixtures import make_nodes, make_pods
-from kubernetes_tpu.state import Capacities, encode_cluster, encode_nodes
+from kubernetes_tpu.state import Capacities, encode_cluster
+from kubernetes_tpu.state.pod_batch import pack_batch
+from kubernetes_tpu.state.statedb import StateDB
 
 CAPS = Capacities(num_nodes=64, batch_pods=32)
 
@@ -79,11 +101,33 @@ def test_sharded_matches_single_device_big_shapes():
     assert int(ref.rr_end) == int(got.rr_end)
 
 
-def test_indivisible_node_count_rejected():
-    bad = Capacities(num_nodes=60, batch_pods=32)
-    s, _ = encode_nodes(make_nodes(10), bad)
-    with pytest.raises(ValueError, match="divisible"):
-        shard_state(s, make_mesh())
+def test_indivisible_node_count_auto_pads():
+    """Odd N no longer rejects: shard_state pads the node axis with sentinel
+    rows (valid=False, zero allocatable) up to the next mesh multiple, and
+    the padded program's decisions are bit-identical to the unpadded one's
+    — sentinels fail the validity predicate, so they never score and never
+    receive a pod."""
+    caps = Capacities(num_nodes=60, batch_pods=32)
+    nodes = make_nodes(50, zones=3, labels_per_node=2, taint_every=10)
+    pods = make_pods(30, selector_every=5, tolerate=False)
+    state, batch, _ = encode_cluster(nodes, pods, caps)
+    ref = schedule_batch(state, batch, 0, DEFAULT_POLICY)
+
+    mesh = make_mesh()
+    assert padded_num_nodes(60, mesh.size) == 64
+    fn = make_sharded_scheduler(mesh, DEFAULT_POLICY)
+    got = fn(shard_state(state, mesh), shard_batch(batch, mesh), np.uint32(0))
+
+    assert got.new_requested.shape[0] == 64
+    np.testing.assert_array_equal(np.asarray(ref.assignments),
+                                  np.asarray(got.assignments))
+    np.testing.assert_allclose(np.asarray(ref.scores),
+                               np.asarray(got.scores))
+    np.testing.assert_allclose(np.asarray(ref.new_requested),
+                               np.asarray(got.new_requested)[:60])
+    # pad rows stay empty: no pod ever lands on a sentinel
+    assert not np.asarray(got.new_requested)[60:].any()
+    assert int(ref.rr_end) == int(got.rr_end)
 
 
 def test_chained_batches_on_mesh():
@@ -102,3 +146,319 @@ def test_chained_batches_on_mesh():
     # 60 pods of 100m on 50 4-core nodes: nobody is double-booked beyond capacity
     total = np.bincount(np.concatenate([a1, a2]), minlength=CAPS.num_nodes)
     assert total.max() <= 110
+
+
+# ---------------------------------------------------------------------------
+# feature matrix: gang / preemption / scale_sim run sharded with bit-parity
+
+
+def test_gang_parity_sharded_vs_single_device():
+    """Gang scan-carry under GSPMD: 4 two-core nodes hold 8 pods of 900m,
+    so of three all-or-nothing groups of four exactly two place and one
+    reverts — and the sharded program's per-pod decisions (including the
+    revert) are bit-identical to the single-device program's."""
+    caps = Capacities(num_nodes=16, batch_pods=16)
+    nodes = make_nodes(4, cpu="2")
+    pods = make_pods(12, cpu="900m")
+    state, batch, table = encode_cluster(nodes, pods, caps)
+    batch.gang_id[:12] = np.repeat(np.arange(1, 4, dtype=np.int32), 4)
+    batch.gang_min[:12] = 4
+    flags = batch_flags(batch, 12, table)
+    assert flags.gang
+    ref = schedule_batch(state, batch, 0, DEFAULT_POLICY, flags=flags)
+
+    mesh = make_mesh()
+    fn = make_sharded_scheduler(mesh, DEFAULT_POLICY, flags=flags)
+    got = fn(shard_state(state, mesh), shard_batch(batch, mesh), np.uint32(0))
+
+    a_ref = np.asarray(ref.assignments)[:12]
+    a_got = np.asarray(got.assignments)[:12]
+    np.testing.assert_array_equal(a_ref, a_got)
+    np.testing.assert_allclose(np.asarray(ref.new_requested),
+                               np.asarray(got.new_requested))
+    # the scenario actually exercises the revert: whole groups settle
+    settled = [bool((a_got[g * 4:(g + 1) * 4] >= 0).all()) for g in range(3)]
+    reverted = [bool((a_got[g * 4:(g + 1) * 4] < 0).all()) for g in range(3)]
+    assert all(s or r for s, r in zip(settled, reverted))
+    assert sum(settled) == 2 and sum(reverted) == 1
+
+
+def test_preemption_parity_sharded_packed_path():
+    """Victim selection under GSPMD via the packed (blob-transport) fn the
+    driver actually dispatches: assignments, nominated nodes and victim
+    counts bit-match the single-device program on the same encoded state
+    and VictimTable (whose node axis shards too)."""
+    from tests.test_preemption import build_tables, mk_node, mk_pod
+
+    caps = Capacities(num_nodes=16, batch_pods=16, victim_slots=8)
+    nodes = [mk_node("n0", cpu="4"), mk_node("n1", cpu="4")]
+    filler = [mk_pod("f0", cpu="1800m", priority=1, node="n0"),
+              mk_pod("f1", cpu="1800m", priority=2, node="n0"),
+              mk_pod("f2", cpu="1800m", priority=5, node="n1"),
+              mk_pod("f3", cpu="1800m", priority=6, node="n1")]
+    pods = [mk_pod("p0", cpu="1900m", priority=10),
+            mk_pod("p1", cpu="1900m", priority=10)]
+    state, batch, table = encode_cluster(nodes, pods, caps,
+                                         assigned_pods=filler)
+    victims, _, _ = build_tables(filler, table, caps)
+    flags = batch_flags(batch, len(pods), table)
+    assert flags.preempt
+    ref = schedule_batch(state, batch, 0, DEFAULT_POLICY, flags=flags,
+                         victims=victims)
+
+    mesh = make_mesh()
+    fblob, iblob = pack_batch(batch, caps)
+    fn = make_sharded_scheduler(mesh, DEFAULT_POLICY, caps=caps, flags=flags,
+                                packed=True)
+    got = fn(shard_state(state, mesh), fblob, iblob, np.uint32(0), victims)
+
+    n = len(pods)
+    np.testing.assert_array_equal(np.asarray(ref.assignments)[:n],
+                                  np.asarray(got.assignments)[:n])
+    np.testing.assert_array_equal(np.asarray(ref.preempt_node)[:n],
+                                  np.asarray(got.preempt_node)[:n])
+    np.testing.assert_array_equal(np.asarray(ref.victim_count)[:n],
+                                  np.asarray(got.victim_count)[:n])
+    # the cluster is full: at least one pod preempts rather than fits
+    assert (np.asarray(got.preempt_node)[:n] >= 0).any()
+
+
+def _fill_probe_blobs(sim, pods):
+    """Encode `pods` into a simulator's transfer blobs and derive the probe
+    flags, exactly as ScaleSimulator._solve does."""
+    import dataclasses
+
+    from kubernetes_tpu.state.pod_batch import packed_batch_flags
+
+    n = min(len(pods), sim.caps.batch_pods)
+    sim._fblob[:] = 0.0
+    sim._iblob[:] = 0
+    for i in range(n):
+        sim.encode_cache.encode_packed_into(sim._fblob, sim._iblob, i,
+                                            pods[i])
+    flags = dataclasses.replace(
+        packed_batch_flags(sim._fblob, sim._iblob, n, sim.statedb.table,
+                           sim.caps),
+        scale_sim=True)
+    return n, flags
+
+
+def test_scale_sim_parity_sharded_vs_single_device():
+    """What-if probes under GSPMD: the sharded scale_sim program returns
+    bit-identical assignments AND placed_per_node (the node-sharded output
+    the scale-up scorer reads) on the same simulator state and blobs."""
+    from kubernetes_tpu.autoscaler.simulator import ScaleSimulator
+
+    sim = ScaleSimulator(caps=Capacities(num_nodes=64, batch_pods=32))
+    for node in make_nodes(20, zones=3):
+        sim.upsert_node(node)
+    pods = make_pods(24, cpu="500m", selector_every=6)
+    n, flags = _fill_probe_blobs(sim, pods)
+    assert flags.scale_sim
+    state = sim.statedb.flush()
+    ref = sim._get_fn(flags)(state, sim._fblob, sim._iblob, np.uint32(0))
+
+    mesh = make_mesh()
+    fn = make_sharded_scheduler(mesh, sim.policy, caps=sim.caps,
+                                prows=sim._prows, flags=flags, packed=True)
+    got = fn(shard_state(state, mesh), sim._fblob, sim._iblob, np.uint32(0))
+
+    np.testing.assert_array_equal(np.asarray(ref.assignments)[:n],
+                                  np.asarray(got.assignments)[:n])
+    np.testing.assert_array_equal(np.asarray(ref.placed_per_node),
+                                  np.asarray(got.placed_per_node))
+    assert (np.asarray(got.placed_per_node) > 0).any()
+
+
+def test_scale_simulator_mesh_end_to_end_count_parity():
+    """ScaleSimulator(mesh=...) answers the same what-ifs as the unsharded
+    simulator. Row addressing interleaves under mesh, so parity here is
+    count-based (newly_placed / used_nodes / baseline), not row-based."""
+    from kubernetes_tpu.autoscaler.simulator import ScaleSimulator
+
+    caps = Capacities(num_nodes=64, batch_pods=32)
+    sims = [ScaleSimulator(caps=caps),
+            ScaleSimulator(caps=caps, mesh=make_mesh())]
+    for sim in sims:
+        for node in make_nodes(4, cpu="2"):
+            sim.upsert_node(node)
+    template = make_nodes(1, cpu="4")[0]
+    pods = make_pods(24, cpu="900m")
+    probes = [sim.probe_scale_up(pods, template, k=4) for sim in sims]
+    assert probes[0] is not None and probes[1] is not None
+    assert probes[1].newly_placed == probes[0].newly_placed > 0
+    assert probes[1].used_nodes == probes[0].used_nodes > 0
+    assert sims[1].baseline_placed(pods) == sims[0].baseline_placed(pods)
+    # scale-down verdict parity on the now-restored state
+    down = [sim.probe_scale_down(make_nodes(4, cpu="2")[3], [])
+            for sim in sims]
+    assert down[0] == down[1]
+
+
+# ---------------------------------------------------------------------------
+# StateDB: dirty-row scatter flush (the no-full-upload hot path)
+
+
+def test_statedb_scatter_flush_avoids_full_upload():
+    """After the registration upload, incremental pod churn flushes as ONE
+    batched per-shard scatter (flush_transfers_total +1, dirty rows only)
+    and never re-materializes the full cluster (flush_full_total frozen) —
+    with device arrays staying bit-equal to the host mirror."""
+    caps = Capacities(num_nodes=64, batch_pods=32)
+    db = StateDB(caps)
+    for node in make_nodes(10, zones=2):
+        db.upsert_node(node)
+    db.flush()
+    full0, tx0, rows0 = (db.flush_full_total, db.flush_transfers_total,
+                         db.flush_rows_total)
+    pods = make_pods(4)
+    for pod in pods:
+        pod.spec.node_name = "node-0"
+        assert db.add_pod(pod)
+    dev = db.flush()
+    assert db.flush_full_total == full0          # no full-cluster upload
+    assert db.flush_transfers_total == tx0 + 1   # one coalesced transfer
+    assert db.flush_rows_total == rows0 + 1      # one dirty row
+    np.testing.assert_allclose(np.asarray(dev.requested), db.host.requested)
+    np.testing.assert_array_equal(np.asarray(dev.podsel_count),
+                                  db.host.podsel_count)
+    # removal dirties the same row and scatters again
+    db.remove_pod(pods[0].key)
+    dev = db.flush()
+    assert db.flush_full_total == full0
+    np.testing.assert_allclose(np.asarray(dev.requested), db.host.requested)
+
+
+def test_statedb_scatter_flush_preserves_mesh_sharding():
+    mesh = make_mesh()
+    caps = Capacities(num_nodes=64, batch_pods=32)
+    db = StateDB(caps, mesh=mesh)
+    for node in make_nodes(10, zones=2):
+        db.upsert_node(node)
+    dev = db.flush()
+    shard = dev.requested.sharding.shard_shape(dev.requested.shape)
+    assert shard[0] == caps.num_nodes // 8
+    full0 = db.flush_full_total
+    for pod in make_pods(3, name_prefix="q"):
+        pod.spec.node_name = "node-1"
+        assert db.add_pod(pod)
+    dev = db.flush()
+    assert db.flush_full_total == full0
+    # the scatter write must not gather: outputs stay node-sharded
+    shard = dev.requested.sharding.shard_shape(dev.requested.shape)
+    assert shard[0] == caps.num_nodes // 8
+    np.testing.assert_allclose(np.asarray(dev.requested), db.host.requested)
+
+
+def test_shard_occupancy_interleaves_registrations():
+    """With a mesh attached, NodeTable hands out rows round-robin across
+    the shard chunks, so a partially-filled table keeps every device busy
+    instead of packing shard 0 first."""
+    mesh = make_mesh()
+    db = StateDB(Capacities(num_nodes=64, batch_pods=32), mesh=mesh)
+    for node in make_nodes(10, zones=2):
+        db.upsert_node(node)
+    occ = db.shard_occupancy()
+    assert len(occ) == 8 and sum(occ) == 10
+    assert max(occ) - min(occ) <= 1          # balanced, not front-loaded
+    # without a mesh the table is one chunk
+    assert StateDB(Capacities(num_nodes=64, batch_pods=32)).shard_occupancy() \
+        == [0]
+
+
+# ---------------------------------------------------------------------------
+# crash drill: mid-pipeline kill() with a mesh attached
+
+
+def test_mid_pipeline_kill_exactly_once_on_mesh():
+    """The staged-pipeline crash drill (tests/test_pipeline.py) re-run with
+    the 8-device mesh attached: solved-but-unapplied sharded batches must
+    vanish on kill(), and a cold mesh restart converges exactly-once with
+    zero racy writes and zero >100ms loop stalls."""
+    from kubernetes_tpu.apiserver.store import ObjectStore
+    from kubernetes_tpu.scheduler import Scheduler
+    from kubernetes_tpu.testing.races import LoopStallWatchdog, RaceDetector
+
+    caps = Capacities(num_nodes=64, batch_pods=8)
+
+    async def run():
+        inner = ObjectStore()
+        for node in make_nodes(8, cpu="16", memory="32Gi"):
+            inner.create(node)
+        pod_objs = make_pods(48, cpu="100m", memory="64Mi")
+        det = RaceDetector(inner)
+        watchdog = LoopStallWatchdog().start()
+        sched = Scheduler(det, caps=caps, mesh=make_mesh())
+        assert sched._staged is not None
+        sched.solve_fault_hook = lambda keys: time.sleep(0.03)
+        await sched.start()
+        for pod in pod_objs:
+            inner.create(pod)
+        await asyncio.sleep(0)
+        async with asyncio.timeout(60):
+            while not det.bind_counts:
+                await sched.schedule_pending(wait=0.02)
+        assert sched.inflight_batches > 0   # batches mid-stage at the kill
+        sched.kill()
+        before = dict(det.bind_counts)
+        await asyncio.sleep(0.2)            # stages notice killed and drop
+        assert dict(det.bind_counts) == before, "bind landed post-mortem"
+
+        sched2 = Scheduler(det, caps=caps, mesh=make_mesh())
+        await sched2.start()
+        async with asyncio.timeout(120):
+            while len(det.bind_counts) < 48:
+                await sched2.schedule_pending(wait=0.05)
+        stalls = watchdog.stop()
+        assert len(det.bind_counts) == 48
+        assert all(v == 1 for v in det.bind_counts.values())
+        assert det.double_binds == 0
+        assert det.racy_writes == []
+        assert stalls == [], \
+            f"loop stalls: {[f'{s * 1e3:.0f}ms' for s in stalls]}"
+        sched2.stop()
+
+    asyncio.run(run())
+
+
+# ---------------------------------------------------------------------------
+# bench --smoke: the sharded config stays runnable end-to-end
+
+
+def test_bench_smoke_sharded_config():
+    """bench.py --smoke BENCH_CONFIGS=sharded in a subprocess (the config
+    self-forces an 8-device host platform before importing jax): all four
+    legs run, the flush counters prove the scatter-flush hot path, and the
+    shard occupancy extras cover every device."""
+    repo = Path(__file__).resolve().parents[1]
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)   # the bench must inject the device split
+    env["BENCH_CONFIGS"] = "sharded"
+    env["BENCH_SHARDED_NODES"] = "64"
+    env["BENCH_SHARDED_PODS"] = "96"
+    env["BENCH_SHARDED_GANG_PODS"] = "32"
+    env["BENCH_SHARDED_PREEMPT_NODES"] = "16"
+    env["BENCH_SHARDED_DEVICE_PODS"] = "64"
+    env["BENCH_SHARDED_GATE"] = "0"
+    proc = subprocess.run(
+        [sys.executable, "bench.py", "--smoke"], cwd=repo, env=env,
+        capture_output=True, text=True, timeout=420)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    line = [ln for ln in proc.stdout.splitlines() if ln.strip()][-1]
+    result = json.loads(line)
+    assert "error" not in result, result
+    extras = result["extras"]
+    assert extras["sharded_devices"] == 8
+    assert extras["sharded_pods_per_sec"] > 0
+    assert extras["sharded_gang_pods_per_sec"] > 0
+    assert extras["sharded_preemption_latency_ms"] > 0
+    assert extras["sharded_device_pods_per_sec"] > 0
+    assert len(extras["sharded_shard_rows"]) == 8
+    assert sum(extras["sharded_shard_rows"]) == 64
+    # registration uploads only — pod churn flushed via dirty-row scatter
+    assert extras["sharded_flush_full_total"] <= 4
+    assert extras["sharded_flush_transfers_total"] > 0
+    # with only the sharded config selected, its headline is promoted
+    assert result["metric"] == "sharded_pods_per_sec"
+    assert result["value"] == extras["sharded_pods_per_sec"]
