@@ -55,6 +55,12 @@ shapes (hundreds of nodes, no device gate) so the whole bench path —
 including the autoscaler config — runs inside a tier-1 test and drift
 breaks the suite instead of the next real bench run. Explicit env
 overrides still win.
+
+--trace-out PATH (or BENCH_TRACE_OUT) forces trace sampling to 1.0
+(KTPU_TRACE_SAMPLE stays overridable) and writes every finished span as
+Chrome trace-event JSON — load it in Perfetto / chrome://tracing for one
+row per pipeline stage/thread (client, apiserver, encode, dispatch,
+settle, commit, kubelet).
 """
 
 import faulthandler
@@ -78,9 +84,26 @@ def _die_with_timeout(signum, frame):
     os._exit(2)
 
 
+def _flag_value(flag: str) -> str | None:
+    """--flag value and --flag=value forms, None when absent."""
+    argv = sys.argv[1:]
+    for i, a in enumerate(argv):
+        if a == flag:
+            return argv[i + 1] if i + 1 < len(argv) else None
+        if a.startswith(flag + "="):
+            return a.split("=", 1)[1]
+    return None
+
+
 def main() -> None:
     smoke = "--smoke" in sys.argv[1:] or \
         os.environ.get("BENCH_SMOKE", "") in ("1", "true")
+    trace_out = _flag_value("--trace-out") or \
+        os.environ.get("BENCH_TRACE_OUT") or None
+    if trace_out:
+        # the trace artifact is the point of this run: sample every root
+        # (set before any kubernetes_tpu import; an explicit env wins)
+        os.environ.setdefault("KTPU_TRACE_SAMPLE", "1")
     if smoke:
         # CI shapes: every default shrinks to seconds-scale; explicit env
         # overrides still take precedence below
@@ -522,6 +545,15 @@ def main() -> None:
                 RESULT["value"] = extras[key]
                 RESULT["vs_baseline"] = round(extras[key] / baseline, 2)
                 break
+    if trace_out:
+        from kubernetes_tpu.obs.tracing import TRACER
+
+        with open(trace_out, "w", encoding="utf-8") as f:
+            f.write(TRACER.to_chrome())
+        extras["trace_out"] = trace_out
+        print(f"bench: wrote Chrome trace ({len(TRACER.finished())} "
+              f"spans) to {trace_out}", file=sys.stderr, flush=True)
+
     RESULT["extras"] = extras
     print(json.dumps(RESULT), flush=True)
 

@@ -35,6 +35,7 @@ from kubernetes_tpu.agent.hollow import HollowKubelet
 from kubernetes_tpu.api.objects import Pod
 from kubernetes_tpu.apiserver.store import Conflict, NotFound, ObjectStore
 from kubernetes_tpu.client.informer import Informer
+from kubernetes_tpu.obs.tracing import TRACER, pod_trace_context
 
 log = logging.getLogger(__name__)
 
@@ -211,6 +212,10 @@ class Kubelet(HollowKubelet):
         self._ready_state: dict[str, bool] = {}
         self._liveness_fails: dict[str, int] = {}
         self.restart_counts: dict[str, int] = {}
+        # pods whose bound trace (trace.ktpu.io/context annotation) this
+        # kubelet already joined — one kubelet.sync span per pod life, not
+        # one per reconcile pass
+        self._traced: set[str] = set()
 
     # ---- config source (dispatch from the shared informer) ----
 
@@ -225,6 +230,7 @@ class Kubelet(HollowKubelet):
             self.cm.release(pod.key)
             self._reported.pop(pod.key, None)
             self._forget_probes(pod.key)
+            self._traced.discard(pod.key)
             return
         if pod.spec.node_name != self.node_name:
             return
@@ -276,9 +282,25 @@ class Kubelet(HollowKubelet):
         """syncPod (kubelet.go:1390): kubelet admission first (canAdmitPod
         — allocatable accounting, agent/cm.py), then volumes
         (WaitForAttachAndMount, kubelet.go:1447), then the runtime, then
-        report status."""
+        report status. The first sync of a trace-annotated pod joins the
+        pod's bound trace (the stitched trace's terminal hop)."""
         if pod.status.phase in ("Succeeded", "Failed"):
             return
+        ctx = None
+        if pod.key not in self._traced:
+            ctx = pod_trace_context(pod)
+            if ctx is not None:
+                self._traced.add(pod.key)
+        if ctx is not None:
+            with TRACER.start_span("kubelet.sync", parent=ctx,
+                                   tid="kubelet",
+                                   attrs={"pod": pod.key,
+                                          "node": self.node_name}):
+                self._sync_pod_inner(pod)
+        else:
+            self._sync_pod_inner(pod)
+
+    def _sync_pod_inner(self, pod: Pod) -> None:
         if pod.key not in self.runtime:
             reason = self.cm.admit(pod)
             if reason is not None:

@@ -1,4 +1,4 @@
-"""The ktpu-lint rule catalog: five invariants the codebase rests on.
+"""The ktpu-lint rule catalog: six invariants the codebase rests on.
 
 R1 blocking-in-async   — event-loop purity: no blocking call reachable on
                          the asyncio loop (the PR-2 webhook-SAR bug class).
@@ -13,6 +13,11 @@ R4 nondeterminism      — seeded replay: no ambient RNG / wall clock in the
 R5 store-rmw           — write discipline: read-modify-write must carry a
                          resourceVersion precondition or ride the
                          sanctioned CAS helpers (the lost-update class).
+R6 span-discipline     — observability hygiene: scoped span acquisitions
+                         (start_span) ride `with`/try-finally so no code
+                         path leaks an open span; counter/histogram
+                         family names carry the Prometheus suffix
+                         conventions (_total, _seconds/...).
 
 Each rule is a small class with a `name` and `check(Module) -> [Finding]`.
 Heuristics err toward precision: a rule that cries wolf gets suppressed
@@ -638,7 +643,104 @@ class StoreWriteDiscipline:
                             "lost-update race class)")
 
 
+# ---------------------------------------------------------------------------
+# R6: span lifecycle + metric naming discipline
+
+
+COUNTER_SUFFIXES = ("_total", "_count")
+HISTOGRAM_SUFFIXES = ("_seconds", "_ms", "_microseconds")
+
+
+class SpanDiscipline:
+    """start_span is the SCOPED acquisition API (obs/tracing.py): its
+    return value must be a `with` context (Span.__exit__ ends it and
+    stamps error status on exceptions) or be .end()ed in a try/finally —
+    otherwise a raised exception leaks an open span, which the orphan
+    check (/debug/traces open_spans) then reports forever. begin_span is
+    the EXPLICIT-handoff API for cross-thread spans (the staged
+    pipeline's batch spans) and is exempt by design: its callers own the
+    end on every path.
+
+    Second check: Prometheus naming. Counter families end in _total (or
+    the reference's legacy _count), histogram families in a unit suffix
+    (_seconds/_ms/_microseconds) — a family without one renders
+    dashboards unit-blind."""
+
+    name = "span-discipline"
+
+    def check(self, mod: Module):
+        yield from self._check_span_lifecycle(mod)
+        yield from self._check_metric_names(mod)
+
+    def _check_span_lifecycle(self, mod: Module):
+        sanctioned: set[int] = set()
+        finally_ended: set[str] = set()
+        for node in ast.walk(mod.tree):
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    sanctioned.add(id(item.context_expr))
+            elif isinstance(node, ast.Try) and node.finalbody:
+                for stmt in node.finalbody:
+                    for call in ast.walk(stmt):
+                        if isinstance(call, ast.Call) and \
+                                isinstance(call.func, ast.Attribute) and \
+                                call.func.attr == "end":
+                            d = mod.dotted(call.func.value)
+                            if d:
+                                finally_ended.add(d[-1])
+        # an assignment whose NAME is .end()ed inside some finally in this
+        # module counts as try/finally discipline
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Assign) and \
+                    isinstance(node.value, ast.Call):
+                names = {t.id for t in node.targets
+                         if isinstance(t, ast.Name)}
+                names |= {t.attr for t in node.targets
+                          if isinstance(t, ast.Attribute)}
+                if names & finally_ended:
+                    sanctioned.add(id(node.value))
+        for node in ast.walk(mod.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "start_span"):
+                continue
+            if id(node) in sanctioned:
+                continue
+            yield Finding(
+                self.name, mod.relpath, node.lineno, node.col_offset,
+                "start_span() outside a `with` block or try/finally "
+                "that .end()s it: an exception leaks an open span "
+                "(orphan in /debug/traces) — use `with ...start_span(...)"
+                "` , end it in a finally, or switch to begin_span() and "
+                "own the end on every path")
+
+    def _check_metric_names(self, mod: Module):
+        for node in ast.walk(mod.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in ("counter", "histogram")):
+                continue
+            arg = node.args[0] if node.args else None
+            if not (isinstance(arg, ast.Constant)
+                    and isinstance(arg.value, str)):
+                continue
+            kind, fam = node.func.attr, arg.value
+            if kind == "counter" and not fam.endswith(COUNTER_SUFFIXES):
+                yield Finding(
+                    self.name, mod.relpath, node.lineno, node.col_offset,
+                    f"counter family {fam!r} must end in "
+                    f"{'/'.join(COUNTER_SUFFIXES)} (Prometheus counter "
+                    "naming; renderers and recording rules key on it)")
+            elif kind == "histogram" and \
+                    not fam.endswith(HISTOGRAM_SUFFIXES):
+                yield Finding(
+                    self.name, mod.relpath, node.lineno, node.col_offset,
+                    f"histogram family {fam!r} must carry a unit suffix "
+                    f"({'/'.join(HISTOGRAM_SUFFIXES)}) — unit-blind "
+                    "duration families misread as counts on dashboards")
+
+
 RULES = [EventLoopPurity(), TracePurity(), BatchFlagsDiscipline(),
-         Determinism(), StoreWriteDiscipline()]
+         Determinism(), StoreWriteDiscipline(), SpanDiscipline()]
 
 RULE_NAMES = {r.name for r in RULES}

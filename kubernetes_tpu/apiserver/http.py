@@ -44,6 +44,7 @@ from kubernetes_tpu.api import objects as objs
 from kubernetes_tpu.api import wire
 from kubernetes_tpu.api.objects import Binding
 from kubernetes_tpu.obs import metrics as obs_metrics
+from kubernetes_tpu.obs import tracing as _tracing
 from kubernetes_tpu.obs.http import http_head, obs_response
 from kubernetes_tpu.apiserver.admission import AdmissionError
 from kubernetes_tpu.apiserver.flowcontrol import FlowRejected
@@ -471,6 +472,16 @@ class APIServer:
                     writer.write(http_head(status, obs_body, ctype))
                     await writer.drain()
                     return
+                # distributed tracing: continue the caller's trace when the
+                # request carries a sampled W3C traceparent (head-based
+                # sampling — the ROOT decided; the server never re-rolls)
+                traceparent = headers.get("traceparent")
+                parent_ctx = _tracing.parse_traceparent(traceparent or "")
+                req_span = None
+                if parent_ctx is not None and parent_ctx.sampled:
+                    req_span = _tracing.TRACER.begin_span(
+                        f"apiserver.{method.lower()}", parent=parent_ctx,
+                        tid="apiserver", attrs={"path": url.path})
                 # content negotiation (CodecFactory position): protobuf
                 # in/out when the peer asks for it, JSON otherwise
                 accept_pb = wire.available() and \
@@ -494,6 +505,8 @@ class APIServer:
                     denied, user = self._authfilter(auth_verb, url.path,
                                                     headers, peercert)
                 if denied is not None:
+                    if req_span is not None:
+                        req_span.end("error")
                     nbytes = await _respond(writer, *denied)
                     lat = _time.perf_counter() - t_start
                     self._observe_request(method, url.path, denied[0], lat)
@@ -502,6 +515,10 @@ class APIServer:
                                     response_bytes=nbytes)
                     return
                 if query.get("watch") in ("1", "true"):
+                    if req_span is not None:
+                        # the watch owns the connection from here; the
+                        # server-side span covers admission into it
+                        req_span.end("ok")
                     svc = self._api_service_for(url.path)
                     if svc is not None:
                         # aggregated watch: relay the byte stream to the
@@ -521,6 +538,8 @@ class APIServer:
                     return  # watch owns the connection until it closes
                 node_proxy = self._node_proxy_target(url.path)
                 if node_proxy is not None:
+                    if req_span is not None:
+                        req_span.end("ok")
                     status = await self._proxy_to_node(
                         writer, method, node_proxy, url.query, body,
                         upgrade=headers.get("upgrade", ""),
@@ -538,6 +557,8 @@ class APIServer:
                         user, method, _resource_of(url.path),
                         width=self._request_width(method, url.path))
                 except FlowRejected as rejected:
+                    if req_span is not None:
+                        req_span.end("throttled")
                     nbytes = await _respond(
                         writer, 429, {
                             "kind": "Status", "reason": "TooManyRequests",
@@ -574,7 +595,9 @@ class APIServer:
                                 method, url.path, query, body, loads=loads,
                                 content_type=headers.get("content-type",
                                                          ""),
-                                user=user)
+                                user=user,
+                                traceparent=traceparent
+                                if req_span is not None else None)
                 finally:
                     self._in_flight -= 1
                     _request_metrics()[2].set(self._in_flight)
@@ -585,6 +608,9 @@ class APIServer:
                 lat = _time.perf_counter() - t_start
                 self.flow.note_latency(seat, lat)
                 self._observe_request(method, url.path, status, lat)
+                if req_span is not None:
+                    req_span.set_attr("status", status)
+                    req_span.end("ok" if status < 500 else "error")
                 self._audit_log(user, method, target, status,
                                 latency_ms=1e3 * lat, response_bytes=nbytes)
                 if not keep:
@@ -915,7 +941,8 @@ class APIServer:
         return None
 
     def _route(self, method: str, path: str, query: dict, body: bytes,
-               loads=json.loads, content_type: str = "", user=None):
+               loads=json.loads, content_type: str = "", user=None,
+               traceparent: str | None = None):
         discovered = self._discovery(method, path)
         if discovered is not None:
             return discovered
@@ -967,6 +994,12 @@ class APIServer:
                 obj = decode_object(kind, loads(body))
                 if ns:
                     obj.metadata.namespace = ns
+                if kind == "Pod" and traceparent is not None:
+                    # create is the trace's entry into the store: the
+                    # annotation rides every watch delivery, so the
+                    # scheduler and kubelet join the caller's trace
+                    obj.metadata.annotations.setdefault(
+                        _tracing.TRACE_ANNOTATION, traceparent)
                 if kind == "CertificateSigningRequest" and user is not None:
                     # registry strategy stamps the REQUESTER's identity
                     # (pkg/registry/certificates/certificates/strategy.go:
@@ -1390,21 +1423,31 @@ class RemoteStore:
         else:
             payload = json.dumps(body).encode() if body is not None else b""
             content_type = accept = "application/json"
-        with self._connect() as sock:
-            sock.sendall(
-                f"{method} {path} HTTP/1.1\r\n"
-                f"Host: {self.host}\r\n"
-                f"{self._auth_header()}"
-                f"Content-Type: {content_type}\r\n"
-                f"Accept: {accept}\r\n"
-                f"Content-Length: {len(payload)}\r\n"
-                f"Connection: close\r\n\r\n".encode() + payload)
-            data = b""
-            while True:
-                chunk = sock.recv(65536)
-                if not chunk:
-                    break
-                data += chunk
+        # client tracing: the ROOT sampling decision is made here (head-
+        # based); the traceparent header carries it server-side. Unsampled
+        # spans cost two id generations and skip the ring entirely.
+        with _tracing.TRACER.start_span(
+                f"client.{method.lower()}", tid="client",
+                attrs={"path": path}) as span:
+            trace_header = (f"traceparent: "
+                            f"{span.context.to_traceparent()}\r\n"
+                            if span.sampled else "")
+            with self._connect() as sock:
+                sock.sendall(
+                    f"{method} {path} HTTP/1.1\r\n"
+                    f"Host: {self.host}\r\n"
+                    f"{self._auth_header()}"
+                    f"{trace_header}"
+                    f"Content-Type: {content_type}\r\n"
+                    f"Accept: {accept}\r\n"
+                    f"Content-Length: {len(payload)}\r\n"
+                    f"Connection: close\r\n\r\n".encode() + payload)
+                data = b""
+                while True:
+                    chunk = sock.recv(65536)
+                    if not chunk:
+                        break
+                    data += chunk
         head, _, resp_body = data.partition(b"\r\n\r\n")
         try:
             status = int(head.split(None, 2)[1])

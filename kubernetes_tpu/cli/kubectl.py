@@ -340,6 +340,29 @@ def cmd_explain(client, args) -> int:
     return 0 if not out.startswith("error:") else 1
 
 
+def cmd_explain_pending(client, args) -> int:
+    """kubectl explain-pending pod: why is this pod not scheduled? Prints
+    the pod's latest FailedScheduling message — with KTPU_EXPLAIN (or
+    Scheduler(explain=True)) that is the per-predicate breakdown the
+    device solver emitted ("0/N nodes available: k Insufficient
+    resources, ..."), the reference's findNodesThatFit failure summary."""
+    pod = client.get("Pod", args.name, args.namespace)
+    if pod.spec.node_name:
+        print(f"pod {args.name} is scheduled to {pod.spec.node_name}")
+        return 0
+    events = [e for e in client.list("Event", namespace=args.namespace)
+              if e.involved_object.get("name") == args.name
+              and e.reason == "FailedScheduling"]
+    if not events:
+        print(f"pod {args.name} is pending; no FailedScheduling event "
+              f"recorded yet (still queued, or the scheduler has not "
+              f"retried it)")
+        return 0
+    latest = max(events, key=lambda e: e.metadata.creation_timestamp)
+    print(latest.message)
+    return 0
+
+
 def cmd_patch(client, args) -> int:
     """kubectl patch -p '...' --type strategic|merge|json
     (pkg/kubectl/cmd/patch.go)."""
@@ -1038,6 +1061,10 @@ def build_parser() -> argparse.ArgumentParser:
     ex2.add_argument("resource",
                      help="resource[.field...], e.g. pods.spec.containers")
     ex2.set_defaults(fn=cmd_explain)
+    ep = sub.add_parser("explain-pending")
+    ep.add_argument("name", help="pending pod name")
+    ep.add_argument("-n", "--namespace", default="default")
+    ep.set_defaults(fn=cmd_explain_pending)
     ro = sub.add_parser("rollout")
     ro.add_argument("action", choices=["status", "history", "undo"])
     ro.add_argument("resource")

@@ -14,16 +14,20 @@ warmup done, ...).
 from __future__ import annotations
 
 import asyncio
+import json
 from typing import Callable, Mapping
 
 from kubernetes_tpu.obs import metrics as _metrics
+from kubernetes_tpu.obs import tracing as _tracing
 
 METRICS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 TEXT_CONTENT_TYPE = "text/plain; charset=utf-8"
+JSON_CONTENT_TYPE = "application/json"
 
 Check = Callable[[], bool]
 
-OBS_PATHS = ("/metrics", "/healthz", "/readyz", "/livez")
+TRACE_PATH = "/debug/traces"
+OBS_PATHS = ("/metrics", "/healthz", "/readyz", "/livez", TRACE_PATH)
 
 
 def _run_checks(checks: Mapping[str, Check] | None
@@ -48,7 +52,8 @@ def obs_response(method: str, path: str,
                  degraded_checks: Mapping[str, Check] | None = None,
                  extra_text: Callable[[], str] | None = None,
                  ) -> tuple[int, bytes, str] | None:
-    """-> (status, body, content-type) for the three obs endpoints, or
+    """-> (status, body, content-type) for the obs endpoints (/metrics,
+    health checks, /debug/traces), or
     None when `path` is not one of them (the caller routes on). Any
     method but GET on an obs path gets 405. `extra_text` appends
     component-local exposition after the registry render (the scheduler's
@@ -62,6 +67,9 @@ def obs_response(method: str, path: str,
         return None
     if method != "GET":
         return 405, b"method not allowed", TEXT_CONTENT_TYPE
+    if path == TRACE_PATH:
+        payload = _tracing.TRACER.debug_payload()
+        return 200, json.dumps(payload).encode(), JSON_CONTENT_TYPE
     if path == "/metrics":
         body = (registry or _metrics.REGISTRY).render()
         if extra_text is not None:

@@ -157,6 +157,11 @@ class Family:
                 f"{self.name}: expected labels {self.labelnames}, "
                 f"got {len(values)} values")
         key = tuple(str(v) for v in values)
+        # double-checked create: the lock-free first read is a plain dict
+        # get (atomic under the GIL and never a partial object, because
+        # the child is fully constructed before the guarded insert); the
+        # re-check inside the lock stops two racing threads from each
+        # installing a child and splitting the family's samples
         child = self._children.get(key)
         if child is None:
             with self._lock:

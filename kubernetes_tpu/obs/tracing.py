@@ -1,0 +1,338 @@
+"""Dapper-style distributed tracing for the scheduling pipeline.
+
+One pod's life — client POST → APF queue → encode → dispatch → solve →
+settle → bind → kubelet Running — crosses an HTTP hop, four pipeline
+threads, and the kubelet sync loop.  contextvars do not survive the
+``ktpu-dispatch/settle/commit`` thread boundaries (each stage is a plain
+worker thread fed by a queue), so propagation here is *explicit*:
+
+- over HTTP as a W3C ``traceparent`` header
+  (``00-{trace_id:32x}-{span_id:16x}-{01|00}``),
+- across pipeline stages as a ``Span`` carried on the queue item
+  (``_BatchWork.span``),
+- across the bind boundary as a pod annotation
+  (``trace.ktpu.io/context``) that the kubelet joins on sync.
+
+Sampling is head-based: the root span decides (``KTPU_TRACE_SAMPLE``,
+default 1%) and children inherit, so the headline path pays only the
+coin-flip.  Unsampled spans are real objects with ``sampled=False`` —
+callers never branch — but they are never recorded.
+
+Finished spans land in a bounded ring served at ``/debug/traces`` on the
+obs mux and exportable as JSON-lines or Chrome trace-event JSON
+(Perfetto-loadable; one row per pipeline stage/thread).
+
+Span lifecycle discipline is lint-enforced (R6 ``span-discipline``):
+``start_span`` must be used as a context manager or ended in a
+``finally``; ``begin_span`` is the sanctioned escape hatch for explicit
+cross-thread handoff and is tracked in the tracer's open-span table so
+orphans are still observable (``Tracer.open_spans``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass
+
+TRACE_ANNOTATION = "trace.ktpu.io/context"
+
+# Stage rows every stitched trace is expected to carry (also the Chrome
+# export's thread names).  Order is the pipeline order.
+STAGE_TIDS = ("client", "apiserver", "encode", "dispatch", "settle",
+              "commit", "kubelet")
+
+
+def wall_now() -> float:
+    """Wall-clock timestamp for span records. Lives HERE (obs/ sits
+    outside the R4 determinism lint scope) so solve-path modules can
+    timestamp trace spans without tripping seed-replay checks: a span's
+    ts never feeds a scheduling decision."""
+    return time.time()
+
+
+@dataclass(frozen=True)
+class SpanContext:
+    """Immutable (trace_id, span_id, sampled) triple — the wire identity."""
+
+    trace_id: str   # 32 lowercase hex chars
+    span_id: str    # 16 lowercase hex chars
+    sampled: bool
+
+    def to_traceparent(self) -> str:
+        return f"00-{self.trace_id}-{self.span_id}-" \
+               f"{'01' if self.sampled else '00'}"
+
+
+def parse_traceparent(value: str | None) -> SpanContext | None:
+    """Parse a W3C traceparent header; None on any malformation."""
+    if not value:
+        return None
+    parts = value.strip().split("-")
+    if len(parts) != 4:
+        return None
+    version, trace_id, span_id, flags = parts
+    if len(version) != 2 or len(trace_id) != 32 or len(span_id) != 16 \
+            or len(flags) != 2:
+        return None
+    try:
+        int(trace_id, 16), int(span_id, 16), int(flags, 16)
+    except ValueError:
+        return None
+    if version == "ff" or int(trace_id, 16) == 0 or int(span_id, 16) == 0:
+        return None
+    return SpanContext(trace_id.lower(), span_id.lower(),
+                       sampled=bool(int(flags, 16) & 1))
+
+
+class Span:
+    """One timed operation on one thread row.
+
+    Use ``with tracer.start_span(...)`` for scoped spans; ``end()`` is
+    idempotent so explicit-handoff paths can double up on safety nets.
+    """
+
+    __slots__ = ("_tracer", "name", "context", "parent_id", "tid",
+                 "start_wall", "_start_perf", "attrs", "_ended")
+
+    def __init__(self, tracer: "Tracer", name: str, context: SpanContext,
+                 parent_id: str | None, tid: str,
+                 attrs: dict | None = None):
+        self._tracer = tracer
+        self.name = name
+        self.context = context
+        self.parent_id = parent_id
+        self.tid = tid
+        self.start_wall = time.time()
+        self._start_perf = time.perf_counter()
+        self.attrs = attrs
+        self._ended = False
+
+    @property
+    def sampled(self) -> bool:
+        return self.context.sampled
+
+    def set_attr(self, key: str, value) -> None:
+        if not self.context.sampled:
+            return
+        if self.attrs is None:
+            self.attrs = {}
+        self.attrs[key] = value
+
+    def child(self, name: str, tid: str | None = None) -> "Span":
+        """Begin a child span (explicit handoff — caller must end it)."""
+        return self._tracer.begin_span(name, parent=self.context,
+                                       tid=tid or self.tid)
+
+    def end(self, status: str = "ok") -> None:
+        if self._ended:
+            return
+        self._ended = True
+        dur = time.perf_counter() - self._start_perf
+        self._tracer._finish(self, dur, status)
+
+    def __enter__(self) -> "Span":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.end("error" if exc_type is not None else "ok")
+
+
+class Tracer:
+    """Process-wide span factory + bounded finished-span ring.
+
+    ``sample_rate`` None defers to ``KTPU_TRACE_SAMPLE`` (read per root
+    span, so late env changes — e.g. bench setting it before the heavy
+    imports — take effect); tests pin ``TRACER.sample_rate = 1.0``.
+    """
+
+    def __init__(self, sample_rate: float | None = None,
+                 capacity: int = 512):
+        self.sample_rate = sample_rate
+        self._lock = threading.Lock()
+        self._ring: deque = deque(maxlen=capacity)
+        self._open: dict[str, Span] = {}
+        self.dropped_unfinished = 0
+
+    # -- sampling ----------------------------------------------------------
+
+    def _rate(self) -> float:
+        if self.sample_rate is not None:
+            return self.sample_rate
+        try:
+            return float(os.environ.get("KTPU_TRACE_SAMPLE", "0.01"))
+        except ValueError:
+            return 0.0
+
+    def _sample_root(self) -> bool:
+        rate = self._rate()
+        if rate <= 0.0:
+            return False
+        if rate >= 1.0:
+            return True
+        # obs/ is outside the R4 determinism scope: tracing is diagnostic,
+        # never part of the solve path, so ambient entropy is fine here.
+        return os.urandom(2)[0] / 256.0 < rate
+
+    @staticmethod
+    def _gen_id(nbytes: int) -> str:
+        return os.urandom(nbytes).hex()
+
+    # -- span creation -----------------------------------------------------
+
+    def start_span(self, name: str, parent: SpanContext | None = None,
+                   tid: str = "main", attrs: dict | None = None) -> Span:
+        """Scoped span: use as a context manager (R6-enforced)."""
+        return self.begin_span(name, parent=parent, tid=tid, attrs=attrs)
+
+    def begin_span(self, name: str, parent: SpanContext | None = None,
+                   tid: str = "main", attrs: dict | None = None) -> Span:
+        """Explicit-handoff span: the caller owns ``end()``.
+
+        Sanctioned for queue items that cross thread boundaries; tracked
+        in the open-span table so orphans stay visible.
+        """
+        if parent is not None:
+            sampled = parent.sampled
+            trace_id = parent.trace_id
+            parent_id = parent.span_id
+        else:
+            sampled = self._sample_root()
+            trace_id = self._gen_id(16)
+            parent_id = None
+        ctx = SpanContext(trace_id, self._gen_id(8), sampled)
+        span = Span(self, name, ctx, parent_id, tid, attrs)
+        if sampled:
+            with self._lock:
+                self._open[ctx.span_id] = span
+        return span
+
+    def record_span(self, name: str, parent: SpanContext | None,
+                    start_wall: float, dur_s: float, tid: str = "main",
+                    status: str = "ok", attrs: dict | None = None) -> None:
+        """Record a retroactive span (already timed by the caller)."""
+        if parent is None or not parent.sampled:
+            return
+        rec = {
+            "trace_id": parent.trace_id,
+            "span_id": self._gen_id(8),
+            "parent_id": parent.span_id,
+            "name": name,
+            "tid": tid,
+            "ts_us": int(start_wall * 1e6),
+            "dur_us": max(int(dur_s * 1e6), 0),
+            "status": status,
+        }
+        if attrs:
+            rec["attrs"] = attrs
+        with self._lock:
+            self._ring.append(rec)
+
+    def _finish(self, span: Span, dur_s: float, status: str) -> None:
+        if not span.context.sampled:
+            return
+        rec = {
+            "trace_id": span.context.trace_id,
+            "span_id": span.context.span_id,
+            "parent_id": span.parent_id,
+            "name": span.name,
+            "tid": span.tid,
+            "ts_us": int(span.start_wall * 1e6),
+            "dur_us": max(int(dur_s * 1e6), 0),
+            "status": status,
+        }
+        if span.attrs:
+            rec["attrs"] = span.attrs
+        with self._lock:
+            self._open.pop(span.context.span_id, None)
+            self._ring.append(rec)
+
+    # -- inspection / export -----------------------------------------------
+
+    def open_spans(self) -> list[Span]:
+        """Sampled spans begun but not yet ended (orphan detector)."""
+        with self._lock:
+            return list(self._open.values())
+
+    def finished(self) -> list[dict]:
+        with self._lock:
+            return list(self._ring)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ring.clear()
+            self.dropped_unfinished += len(self._open)
+            self._open.clear()
+
+    def debug_payload(self) -> dict:
+        """The /debug/traces body: finished spans grouped by trace."""
+        spans = self.finished()
+        traces: dict[str, list] = {}
+        for rec in spans:
+            traces.setdefault(rec["trace_id"], []).append(rec)
+        for recs in traces.values():
+            recs.sort(key=lambda r: r["ts_us"])
+        return {
+            "num_traces": len(traces),
+            "num_spans": len(spans),
+            "open_spans": len(self.open_spans()),
+            "traces": traces,
+        }
+
+    def to_jsonl(self) -> str:
+        return "\n".join(json.dumps(rec, sort_keys=True)
+                         for rec in self.finished())
+
+    def to_chrome(self) -> str:
+        """Chrome trace-event JSON: ph:"X" duration events, one row per
+        stage thread (ph:"M" thread_name metadata), Perfetto-loadable."""
+        events = []
+        tids: dict[str, int] = {}
+
+        def tid_row(name: str) -> int:
+            if name not in tids:
+                row = len(tids) + 1
+                tids[name] = row
+                events.append({
+                    "ph": "M", "pid": 1, "tid": row,
+                    "name": "thread_name", "args": {"name": name},
+                })
+            return tids[name]
+
+        # seed the pipeline rows in pipeline order so the viewer lays
+        # them out top-to-bottom regardless of which span finished first
+        for stage in STAGE_TIDS:
+            tid_row(stage)
+        for rec in self.finished():
+            events.append({
+                "ph": "X", "pid": 1,
+                "tid": tid_row(rec["tid"]),
+                "name": rec["name"],
+                "cat": rec.get("status", "ok"),
+                "ts": rec["ts_us"],
+                "dur": rec["dur_us"],
+                "args": {
+                    "trace_id": rec["trace_id"],
+                    "span_id": rec["span_id"],
+                    "parent_id": rec.get("parent_id"),
+                    **(rec.get("attrs") or {}),
+                },
+            })
+        return json.dumps({"traceEvents": events,
+                           "displayTimeUnit": "ms"})
+
+
+TRACER = Tracer()
+
+
+def pod_trace_context(pod) -> SpanContext | None:
+    """Extract the trace context stamped on a pod, if any and sampled."""
+    meta = getattr(pod, "metadata", None)
+    ann = getattr(meta, "annotations", None) or {}
+    ctx = parse_traceparent(ann.get(TRACE_ANNOTATION))
+    if ctx is not None and ctx.sampled:
+        return ctx
+    return None

@@ -97,6 +97,19 @@ class BatchFlags:
                           # pre-preemption program compiles unchanged (the
                           # pass also needs a VictimTable — absent one,
                           # schedule_batch skips it at trace time regardless)
+    explain: bool = False  # explainability probe: additionally emit the
+                          # per-predicate cumulative survivor counts from
+                          # _pod_eval's feasible-mask chain (i32[P, 6] over
+                          # EXPLAIN_STAGES) so the driver can render
+                          # reference-parity FailedScheduling reasons
+                          # (findNodesThatFit's failedPredicateMap,
+                          # core/generic_scheduler.go:163). Like scale_sim
+                          # this defaults OFF and is never derived from
+                          # batch content (packed_batch_flags leaves it
+                          # False) — explain-off batches compile the
+                          # bit-identical pre-explain program, and the
+                          # extra per-step sums are traced only into
+                          # programs the operator requests (KTPU_EXPLAIN).
     scale_sim: bool = False  # autoscaler probe solve: additionally emit the
                           # per-node placed count (how many batch pods landed
                           # on each node row) so a what-if simulation can
@@ -110,6 +123,15 @@ class BatchFlags:
 
 
 ALL_ACTIVE = BatchFlags()
+
+# Stage labels for the BatchFlags.explain breakdown — the order of
+# _pod_eval's feasible-mask chain. Column i holds the survivor count
+# AFTER stage i; a gated-off stage repeats the previous count (it
+# rejected nobody). "static" folds Phase A (selectors, taints,
+# conditions, host name, ports-free fit, and — under the gpu/storage
+# hoist — the static resource columns).
+EXPLAIN_STAGES = ("static", "resources", "ports", "disk", "attach",
+                  "interpod")
 
 
 @dataclass(frozen=True)
@@ -291,6 +313,12 @@ class SolverResult:
     # node row. None — an empty pytree leaf, zero HLO — on every real
     # scheduling program; the simulator reads its hypothetical rows from it.
     placed_per_node: jnp.ndarray = None  # i32[N]
+    # explainability output (BatchFlags.explain): cumulative survivor
+    # counts down _pod_eval's feasible chain, one column per
+    # EXPLAIN_STAGES entry. None — zero HLO — on every explain-off
+    # program; the driver diffs adjacent columns into per-predicate
+    # reject counts for FailedScheduling messages.
+    explain_counts: jnp.ndarray = None  # i32[P, len(EXPLAIN_STAGES)]
 
 
 @struct.dataclass
@@ -491,27 +519,41 @@ def _init_carry(state: ClusterState, g: PolicyGates, rr_start,
 
 def _pod_eval(state: ClusterState, g: PolicyGates, carry: Carry, pod,
               s_mask, s_score, p_counts, na_count, topo_onehot, prows,
-              hard_w: float, domain_universe: int):
-    """One pod's full-policy (feasible[N], score[N]) against an assume
-    ledger — THE evaluation semantics, shared verbatim by the solver's scan
-    step and the extender's Filter/Prioritize verbs (extender parity with
-    in-batch scheduling is by construction, not by re-implementation)."""
+              hard_w: float, domain_universe: int, explain: bool = False):
+    """One pod's full-policy (feasible[N], score[N], breakdown) against an
+    assume ledger — THE evaluation semantics, shared verbatim by the
+    solver's scan step and the extender's Filter/Prioritize verbs (extender
+    parity with in-batch scheduling is by construction, not by
+    re-implementation). `breakdown` is the i32[len(EXPLAIN_STAGES)]
+    cumulative survivor count down the mask chain when `explain`, else
+    None — the trail list below holds plain aliases of `feasible`, so an
+    explain-off trace sees zero extra ops."""
     feasible = s_mask
+    trail = [feasible]
     if g.use_resources:
         feasible = feasible & preds.fits_resources_dyn(
             state, pod, carry.requested, g.dyn_gpu, g.dyn_storage)
+    trail.append(feasible)
     if g.use_ports:
         feasible = feasible & preds.fits_host_ports(
             state, pod, port_count=carry.port_count)
+    trail.append(feasible)
     if g.use_nodisk:
         feasible = feasible & preds.no_disk_conflict(
             state, pod, vol_any=carry.vol_any, vol_rw=carry.vol_rw)
+    trail.append(feasible)
     if g.attach_maxes:
         feasible = feasible & preds.max_attach_ok(
             state, pod, g.attach_maxes, attach_count=carry.attach_count)
+    trail.append(feasible)
     if g.use_ipa:
         feasible = feasible & interpod.interpod_feasible(
             state, pod, carry.ipa, topo_onehot)
+    trail.append(feasible)
+    breakdown = None
+    if explain:
+        breakdown = jnp.stack(
+            [jnp.sum(m.astype(jnp.int32)) for m in trail])
 
     score = s_score
     if g.w_lr:
@@ -547,7 +589,7 @@ def _pod_eval(state: ClusterState, g: PolicyGates, carry: Carry, pod,
                 state, pod.svcanti_q, pod.svcanti_total, carry.ipa,
                 feasible, prows.svcanti_slot[i], domain_universe,
                 topo_onehot)
-    return feasible, score
+    return feasible, score, breakdown
 
 
 def _select_host(masked_score: jnp.ndarray, feasible: jnp.ndarray, rr: jnp.ndarray):
@@ -721,9 +763,10 @@ def schedule_batch(
                 gang_min_cur=jnp.where(entering, pod.gang_min,
                                        carry.gang_min_cur))
         s_mask = ms_row > -jnp.inf
-        feasible, score = _pod_eval(
+        feasible, score, breakdown = _pod_eval(
             state, g, carry, pod, s_mask, ms_row, p_counts, na_count,
-            topo_onehot, prows, hard_w, domain_universe)
+            topo_onehot, prows, hard_w, domain_universe,
+            explain=flags.explain)
 
         masked = jnp.where(feasible, score, -jnp.inf)
         node, best, ntie = _select_host(masked, feasible, carry.rr)
@@ -763,11 +806,18 @@ def schedule_batch(
         # index is exact in f32: < 2^24)
         packed = jnp.stack([node_idx.astype(jnp.float32),
                             jnp.where(assigned, best, 0.0)])
+        if flags.explain:
+            return new_carry, (packed, feasible, breakdown)
         return new_carry, (packed, feasible)
 
     init = _init_carry(state, g, rr_start, domain_universe, use_gang=use_gang)
-    final, (packed_out, feas_rows) = jax.lax.scan(
-        step, init, tuple(xs_list))
+    if flags.explain:
+        final, (packed_out, feas_rows, explain_rows) = jax.lax.scan(
+            step, init, tuple(xs_list))
+    else:
+        final, (packed_out, feas_rows) = jax.lax.scan(
+            step, init, tuple(xs_list))
+        explain_rows = None
     nodes = packed_out[:, 0].astype(jnp.int32)
     scores = packed_out[:, 1]
     counts = jnp.sum(feas_rows.astype(jnp.int32), axis=1)
@@ -819,6 +869,11 @@ def schedule_batch(
             (nodes >= 0).astype(jnp.int32), jnp.maximum(nodes, 0),
             num_segments=state.valid.shape[0])
 
+    # explainability probe: per-pod cumulative survivor counts down the
+    # predicate chain. Off — the default — leaves the field None, so the
+    # program is the byte-identical pre-explain HLO.
+    explain_counts = explain_rows if flags.explain else None
+
     return SolverResult(
         assignments=nodes,
         scores=scores,
@@ -837,6 +892,7 @@ def schedule_batch(
         preempt_node=preempt_node,
         victim_count=victim_count,
         placed_per_node=placed_per_node,
+        explain_counts=explain_counts,
     )
 
 
@@ -1019,5 +1075,7 @@ def evaluate_pod(
     topo_onehot = (interpod.topology_onehot(state.topology, domain_universe)
                    if g.use_ip_ledger else None)
     carry = _init_carry(state, g, 0, domain_universe)
-    return _pod_eval(state, g, carry, pod, s_mask, s_score, p_counts,
-                     na_count, topo_onehot, prows, hard_w, domain_universe)
+    feasible, score, _ = _pod_eval(
+        state, g, carry, pod, s_mask, s_score, p_counts, na_count,
+        topo_onehot, prows, hard_w, domain_universe)
+    return feasible, score

@@ -19,7 +19,9 @@ the point of the re-design:
 from __future__ import annotations
 
 import asyncio
+import dataclasses
 import logging
+import os
 import threading
 import time
 from collections import deque
@@ -43,7 +45,13 @@ from kubernetes_tpu.gang import (
 )
 from kubernetes_tpu.models.policy import DEFAULT_POLICY, Policy
 from kubernetes_tpu.obs import metrics as obs_metrics
-from kubernetes_tpu.ops.solver import schedule_batch
+from kubernetes_tpu.obs.tracing import (
+    TRACE_ANNOTATION,
+    TRACER,
+    pod_trace_context,
+    wall_now,
+)
+from kubernetes_tpu.ops.solver import EXPLAIN_STAGES, schedule_batch
 from kubernetes_tpu.state import Capacities
 from kubernetes_tpu.state.encode_cache import EncodeCache
 from kubernetes_tpu.state.layout import CapacityError
@@ -66,6 +74,34 @@ QUARANTINE_BACKOFF_S = 30.0
 class _SolveFailed(RuntimeError):
     """The device solve failed twice for one batch (raised internally to
     route schedule_pending into bisect/quarantine recovery)."""
+
+# FailedScheduling reason per EXPLAIN_STAGES column — the reference's
+# predicate names as they appear in failedPredicateMap events
+# (findNodesThatFit, core/generic_scheduler.go:163)
+EXPLAIN_REASONS = ("MatchNodeSelector", "Insufficient resources",
+                   "PodFitsHostPorts", "NoDiskConflict", "MaxVolumeCount",
+                   "MatchInterPodAffinity")
+
+
+def render_unschedulable(counts, total_nodes: int) -> str | None:
+    """Render one pod's explain breakdown (cumulative survivor counts
+    down EXPLAIN_STAGES) into the reference's FailedScheduling message
+    shape — "0/15000 nodes available: 11992 Insufficient tpu, 8
+    PodFitsHostPorts". Returns None unless the final survivor count is
+    zero (a schedulable pod is not a render candidate)."""
+    counts = [int(c) for c in counts]
+    if counts[-1] != 0:
+        return None
+    parts = []
+    prev = total_nodes
+    for i, stage in enumerate(EXPLAIN_STAGES):
+        rejected = prev - counts[i]
+        if rejected > 0:
+            parts.append(f"{rejected} {EXPLAIN_REASONS[i]}")
+        prev = counts[i]
+    msg = f"0/{total_nodes} nodes available"
+    return msg + (": " + ", ".join(parts) if parts else "")
+
 
 # ExponentialBuckets(1000, 2, 15) in microseconds (reference metrics.go:36)
 LATENCY_BUCKETS_US = obs_metrics.exponential_buckets(1000.0, 2.0, 15)
@@ -383,6 +419,7 @@ class Scheduler:
         scheduler_name: str = "default-scheduler",
         batch_wait: float = 0.002,
         enable_preemption: bool = True,
+        explain: bool | None = None,
     ):
         from kubernetes_tpu.utils.compilation_cache import enable
 
@@ -534,6 +571,12 @@ class Scheduler:
         self.solve_fault_hook = None
         self.quarantine_backoff_s = QUARANTINE_BACKOFF_S
         self._quarantined: set[str] = set()
+        # "why pending" explainability: compile the explain variant and
+        # render per-predicate FailedScheduling reasons for every
+        # unschedulable pod. An operator switch (KTPU_EXPLAIN / ctor arg),
+        # NEVER batch-content derived — see BatchFlags.explain
+        self.explain = explain if explain is not None \
+            else os.environ.get("KTPU_EXPLAIN", "") in ("1", "true")
 
     def _get_schedule_fn(self, flags):
         """Compiled solver variant for this batch's content gates — a
@@ -910,6 +953,10 @@ class Scheduler:
             self._event_shard.kill()
         self._loop_calls.clear()
         self._pending_events = []
+        for entry in self._inflight_q:
+            timer = entry[6]
+            if getattr(timer, "trace_span", None) is not None:
+                timer.trace_span.end("aborted")
         self._inflight_q.clear()
         self.queue.close()
         self.node_informer.stop()
@@ -1007,6 +1054,7 @@ class Scheduler:
 
     async def _schedule_batch(self, keys: list[str]) -> int:
         t_phase = time.thread_time()
+        t_enc_wall = wall_now()
         fblob, iblob = self._acquire_blobs()
         pods: list[Pod] = []
         live_keys: list[str] = []
@@ -1082,16 +1130,28 @@ class Scheduler:
                                                            fblob, iblob)
             finally:
                 self._release_blobs((fblob, iblob))
+        # the batch span: adopted from the first pod that carries a sampled
+        # trace.ktpu.io/context annotation (stitching the client/apiserver
+        # spans), else a rate-sampled root. Explicit handoff — the span
+        # rides the queue item across stage threads and ends at commit.
+        batch_span = self._begin_batch_span(pods)
+        if batch_span.sampled:
+            TRACER.record_span("encode", batch_span.context, t_enc_wall,
+                               wall_now() - t_enc_wall, tid="encode",
+                               attrs={"pods": len(pods)})
         if self._staged is not None and not self._stopped:
             return await self._schedule_batch_staged(
-                pods, live_keys, fblob, iblob, gang_groups)
+                pods, live_keys, fblob, iblob, gang_groups, batch_span)
 
         timer = StepTimer(f"scheduling batch of {len(pods)}",
-                          step_hist=self.metrics.trace_steps)
+                          step_hist=self.metrics.trace_steps,
+                          trace_span=batch_span)
         from kubernetes_tpu.state.pod_batch import packed_batch_flags
 
         flags = packed_batch_flags(fblob, iblob, len(pods),
                                    self.statedb.table, self.caps)
+        if self.explain:
+            flags = dataclasses.replace(flags, explain=True)
         schedule_fn = self._get_schedule_fn(flags)
         victims, vslots = self._build_victims(flags)
         settled = 0
@@ -1112,6 +1172,7 @@ class Scheduler:
         except _SolveFailed as e:
             self.metrics.add_phase("dispatch", time.monotonic() - t0)
             self._release_blobs((fblob, iblob))
+            batch_span.end("error")
             return settled + await self._recover_solve_failure(
                 pods, live_keys, gang_groups, e)
         self._rr = result.rr_end
@@ -1156,9 +1217,26 @@ class Scheduler:
 
     # ---- staged stage-per-thread path (scheduler/pipeline.py) ----
 
+    def _begin_batch_span(self, pods: list[Pod]):
+        """Begin the batch's root/joined span. Explicit handoff (the span
+        crosses the dispatch/settle/commit threads on the queue item), so
+        ownership of end() is the commit/error/drop path's — tracked in
+        the tracer's open-span table meanwhile."""
+        parent = None
+        for pod in pods:
+            ctx = pod_trace_context(pod)
+            if ctx is not None:
+                parent = ctx
+                break
+        span = TRACER.begin_span("schedule.batch", parent=parent,
+                                 tid="scheduler")
+        span.set_attr("pods", len(pods))
+        return span
+
     async def _schedule_batch_staged(self, pods: list[Pod],
                                      live_keys: list[str], fblob, iblob,
-                                     gang_groups: dict) -> int:
+                                     gang_groups: dict,
+                                     batch_span=None) -> int:
         """Hand one encoded batch to the staged pipeline: flush + solve +
         readback + ledger commit run in stage threads while this loop
         encodes the next batch (unconditional prefetch — the overlap the
@@ -1171,11 +1249,14 @@ class Scheduler:
 
         flags = packed_batch_flags(fblob, iblob, len(pods),
                                    self.statedb.table, self.caps)
+        if self.explain:
+            flags = dataclasses.replace(flags, explain=True)
         schedule_fn = self._get_schedule_fn(flags)
         with self._state_lock:
             victims, vslots = self._build_victims(flags)
         work = _BatchWork(pods, live_keys, (fblob, iblob), flags,
                           schedule_fn, victims, vslots, gang_groups)
+        work.span = batch_span
         self._loop_calls.bind(asyncio.get_running_loop())
         await self._staged.wait_capacity()
         self._staged.submit(work)
@@ -1207,6 +1288,8 @@ class Scheduler:
         recovery ladder on it."""
         self.statedb.mark_ledger_dirty()
         self._release_blobs(work.blobs)
+        if work.span is not None:
+            work.span.end("error")
         self._staged_failures.append(
             (work.pods, work.live_keys, work.gang_groups, work.error))
 
@@ -1640,9 +1723,14 @@ class Scheduler:
                 result.preempt_node)[:len(pods)].tolist()
             victim_counts = np.asarray(
                 result.victim_count)[:len(pods)].tolist()
+        explain_rows = None
+        if flags.explain and result.explain_counts is not None:
+            explain_rows = np.asarray(
+                result.explain_counts)[:len(pods)].tolist()
         scheduled, committed, any_rejected = self._apply_batch(
             result, pods, live_keys, blobs, flags, rows, preempt_rows,
-            victim_counts, gang_groups, vslots, timer)
+            victim_counts, gang_groups, vslots, timer,
+            explain_rows=explain_rows, span=timer.trace_span)
         self._commit_ledger(result, blobs[0], committed, any_rejected,
                             flags, adopted)
         self._release_blobs(blobs)
@@ -1654,7 +1742,8 @@ class Scheduler:
                      blobs, flags, rows: list[int],
                      preempt_rows: list[int] | None,
                      victim_counts: list[int] | None, gang_groups: dict,
-                     vslots, timer=None) -> tuple[int, list, bool]:
+                     vslots, timer=None, explain_rows=None,
+                     span=None) -> tuple[int, list, bool]:
         """Act on one solved batch's host-side verdicts: settle gangs,
         partition assigned rows from rejections, bulk-bind through the
         store, and buffer the per-pod events. Runs ON the event loop (in
@@ -1712,6 +1801,8 @@ class Scheduler:
         to_bind: list[tuple[int, str, Pod, str]] = []
         now_mono = time.monotonic()
         holds_active = len(self.nominated) > 0
+        total_nodes = (sum(1 for n in name_of if n is not None)
+                       if explain_rows is not None else 0)
         for i, (key, pod) in enumerate(zip(live_keys, pods)):
             row = rows[i]
             if row < 0:
@@ -1725,9 +1816,13 @@ class Scheduler:
                     self.queue.done(key)
                     self.queue.add_after(key, 0.05)
                     continue
-                self._fail_batch(key, pod,
-                                 "no nodes available to schedule pods",
-                                 event_entries)
+                message = "no nodes available to schedule pods"
+                if explain_rows is not None:
+                    rendered = render_unschedulable(explain_rows[i],
+                                                    total_nodes)
+                    if rendered is not None:
+                        message = rendered
+                self._fail_batch(key, pod, message, event_entries)
                 continue
             node_name = name_of[row]
             if node_name is None:
@@ -1807,6 +1902,12 @@ class Scheduler:
             event_entries.append(
                 (pod, "Normal", "Scheduled",
                  f"Successfully assigned {key} to {node_name}"))
+        if span is not None and span.sampled and committed:
+            # sampled batch: pods created without a client traceparent get
+            # the batch's context stamped at bind time, so the kubelet's
+            # sync span still joins the stitched trace (1% of batches —
+            # off the headline path)
+            self._stamp_trace_annotations(committed, span)
         if event_entries:
             self._pending_events.extend(event_entries)
             if not self._event_flush_scheduled:
@@ -1829,6 +1930,29 @@ class Scheduler:
         if self.metrics.batches % 128 == 0:
             self.backoff.gc()
         return scheduled, committed, any_rejected
+
+    def _stamp_trace_annotations(self, committed: list, span) -> None:
+        """Stamp the batch's trace context onto just-bound pods that lack
+        one (annotation trace.ktpu.io/context — the kubelet joins it)."""
+        tp = span.context.to_traceparent()
+        for pod, _node_name, _i in committed:
+            ann = pod.metadata.annotations or {}
+            if TRACE_ANNOTATION in ann:
+                continue
+
+            def _mutate(obj):
+                new = dict(obj.metadata.annotations or {})
+                new.setdefault(TRACE_ANNOTATION, tp)
+                obj.metadata.annotations = new
+
+            try:
+                self.store.guaranteed_update(
+                    "Pod", pod.metadata.name, pod.metadata.namespace,
+                    _mutate, retries=4)
+            except Exception:  # noqa: BLE001 — tracing must never fail a bind
+                log.debug("trace annotation stamp failed for %s/%s",
+                          pod.metadata.namespace, pod.metadata.name,
+                          exc_info=True)
 
     def _commit_ledger(self, result, fblob, committed: list,
                        any_rejected: bool, flags, adopted: bool) -> None:

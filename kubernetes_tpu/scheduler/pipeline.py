@@ -40,6 +40,8 @@ from collections import deque
 
 import numpy as np
 
+from kubernetes_tpu.obs.tracing import TRACER, wall_now
+
 log = logging.getLogger(__name__)
 
 # stage workers park on their wake event at most this long between
@@ -243,12 +245,19 @@ class EventShard:
 
 
 class _BatchWork:
-    """One batch's state as it moves through the stages."""
+    """One batch's state as it moves through the stages.
+
+    `span` is the batch's trace span, carried EXPLICITLY on the queue
+    item (contextvars do not cross the stage-thread boundaries): stage
+    work is recorded retroactively against it, and whichever path
+    finishes the batch — commit, solve-failure landing, or a kill-path
+    _drop — owns ending it."""
 
     __slots__ = ("pods", "live_keys", "blobs", "flags", "schedule_fn",
                  "victims", "vslots", "gang_groups", "result",
                  "assignments", "rows", "preempt_rows", "victim_counts",
-                 "error", "solve_span", "active_counted")
+                 "error", "solve_span", "active_counted", "span",
+                 "explain_rows")
 
     def __init__(self, pods, live_keys, blobs, flags, schedule_fn,
                  victims, vslots, gang_groups):
@@ -268,6 +277,8 @@ class _BatchWork:
         self.error = None
         self.solve_span = 0.0
         self.active_counted = False
+        self.span = None
+        self.explain_rows = None
 
 
 class StagedPipeline:
@@ -311,6 +322,9 @@ class StagedPipeline:
     # ---- loop side ----
 
     def submit(self, work: _BatchWork) -> None:
+        if self.killed:
+            self._drop(work)  # a submitter that raced the kill
+            return
         if self._started is None:
             self._started = time.perf_counter()
         self.submitted += 1
@@ -382,6 +396,18 @@ class StagedPipeline:
         them from the store's truth)."""
         self.killed = True
         self._stopped = True
+        # drain-and-drop every queued batch HERE: a stage thread parked on
+        # its wake event exits without another queue pass, which would
+        # strand queued work (and leak its batch span as a forever-open
+        # orphan in /debug/traces). popleft is safe against a concurrently
+        # draining stage thread — each item is popped exactly once.
+        for q in (self._dispatch_q, self._settle_q, self._commit_q):
+            while True:
+                try:
+                    work = q.popleft()
+                except IndexError:
+                    break
+                self._drop(work)
         for ev in (self._dispatch_wake, self._settle_wake,
                    self._commit_wake):
             ev.set()
@@ -433,6 +459,8 @@ class StagedPipeline:
 
     def _drop(self, work: _BatchWork) -> None:
         self.dropped += 1
+        if work.span is not None:
+            work.span.end("aborted")  # no orphan spans on the kill path
         if work.active_counted:
             with self._dcond:
                 self._active -= 1
@@ -463,10 +491,13 @@ class StagedPipeline:
                         self._dcond.wait(0.05)
                     if self.killed:
                         self.dropped += 1
+                        if work.span is not None:
+                            work.span.end("aborted")
                         continue
                     self._active += 1
                     work.active_counted = True
                 t0 = time.perf_counter()
+                t0_wall = wall_now()
                 t0_cpu = time.thread_time()
                 try:
                     with sched._state_lock:
@@ -503,9 +534,20 @@ class StagedPipeline:
                 span = time.perf_counter() - t0
                 work.solve_span = span
                 self.busy["dispatch"] += span
+                if work.span is not None and work.span.sampled:
+                    # retroactive child: flush + solve + adopt on this row
+                    TRACER.record_span(
+                        "dispatch", work.span.context, t0_wall, span,
+                        tid="dispatch",
+                        status="error" if work.error is not None else "ok")
                 sched.metrics.add_phase("dispatch", span)
                 if work.error is None:
                     sched.metrics.algorithm_latency.append(span)
+                if self.killed:
+                    # the settle thread may already be gone: an append now
+                    # would strand the batch (and orphan its span)
+                    self._drop(work)
+                    continue
                 self._settle_q.append(work)
                 self._qmax["settle"] = max(self._qmax["settle"],
                                            len(self._settle_q))
@@ -569,6 +611,7 @@ class StagedPipeline:
                     continue
                 if work.error is None:
                     t0 = time.perf_counter()
+                    t0_wall = wall_now()
                     try:
                         n = len(work.pods)
                         work.assignments = np.asarray(
@@ -579,11 +622,24 @@ class StagedPipeline:
                                 work.result.preempt_node)[:n].tolist()
                             work.victim_counts = np.asarray(
                                 work.result.victim_count)[:n].tolist()
+                        if (work.flags.explain
+                                and work.result.explain_counts is not None):
+                            work.explain_rows = np.asarray(
+                                work.result.explain_counts)[:n].tolist()
                     except Exception as e:  # noqa: BLE001 — transport
                         work.error = e  # routed into solve-failure recovery
                     dt = time.perf_counter() - t0
                     self.busy["settle"] += dt
+                    if work.span is not None and work.span.sampled:
+                        TRACER.record_span(
+                            "settle", work.span.context, t0_wall, dt,
+                            tid="settle",
+                            status="error" if work.error is not None
+                            else "ok")
                     sched.metrics.add_phase("settle_wait", dt)
+                if self.killed:
+                    self._drop(work)  # commit thread may already be gone
+                    continue
                 self._commit_q.append(work)
                 self._qmax["commit"] = max(self._qmax["commit"],
                                            len(self._commit_q))
@@ -629,7 +685,9 @@ class StagedPipeline:
                             work.result, work.pods, work.live_keys,
                             work.blobs, work.flags, work.rows,
                             work.preempt_rows, work.victim_counts,
-                            work.gang_groups, work.vslots, None)
+                            work.gang_groups, work.vslots, None,
+                            explain_rows=work.explain_rows,
+                            span=work.span)
                     except Exception:  # noqa: BLE001
                         log.exception("staged apply failed; requeueing "
                                       "the batch")
@@ -649,6 +707,7 @@ class StagedPipeline:
                 scheduled = 0
                 out = box.get("out")
                 t0 = time.perf_counter()
+                t0_wall = wall_now()
                 if out is not None:
                     scheduled, committed, any_rejected = out
                     try:
@@ -660,7 +719,13 @@ class StagedPipeline:
                                       "marking dirty")
                         sched.statedb.mark_ledger_dirty()
                 sched._release_blobs(work.blobs)
-                self.busy["commit"] += time.perf_counter() - t0
+                dt = time.perf_counter() - t0
+                self.busy["commit"] += dt
+                if work.span is not None and work.span.sampled:
+                    TRACER.record_span("commit", work.span.context,
+                                       t0_wall, dt, tid="commit")
+                if work.span is not None:
+                    work.span.end("ok")
                 with self._dcond:
                     self._active -= 1
                     self._dcond.notify_all()

@@ -8,35 +8,72 @@ and a structured-JSON sink, while the log line stays thresholded.
 
 The sink is process-global: `set_trace_sink(callable | path | None)`, or
 the KTPU_TRACE_FILE environment variable (one JSON object per line,
-append mode) read at import.
+append mode) read at import. File sinks are serialized by a module lock
+(multiple pipeline threads finish traces concurrently) and closed at
+interpreter exit.
+
+A StepTimer may additionally carry a distributed-tracing span
+(obs/tracing.py): pass `trace_span=`, and `export()` folds the step
+marks into that trace as retroactive child spans and ends the batch
+span — so the legacy (non-staged) scheduling path produces the same
+stitched trace shape as the staged pipeline.
 """
 
 from __future__ import annotations
 
+import atexit
 import json
 import logging
 import os
+import threading
 import time
 from typing import Callable
 
 log = logging.getLogger("kubernetes_tpu.trace")
 
 _sink: Callable[[dict], None] | None = None
+_sink_lock = threading.Lock()
+_sink_file = None
+
+
+def _close_sink_file() -> None:
+    global _sink_file
+    with _sink_lock:
+        if _sink_file is not None:
+            try:
+                _sink_file.close()
+            except OSError:
+                pass
+            _sink_file = None
+
+
+atexit.register(_close_sink_file)
 
 
 def set_trace_sink(sink) -> None:
     """Install the structured trace sink: a callable(dict), a file path
-    (JSON lines, appended), or None to disable."""
-    global _sink
+    (JSON lines, appended), or None to disable. Replacing a file sink
+    closes the previous handle."""
+    global _sink, _sink_file
     if sink is None or callable(sink):
+        _close_sink_file()
         _sink = sink
         return
     f = open(sink, "a", encoding="utf-8")
 
     def write(record: dict) -> None:
-        f.write(json.dumps(record) + "\n")
-        f.flush()
+        # one lock around write+flush: records from concurrent pipeline
+        # threads stay line-atomic, and writes cannot race the atexit
+        # close
+        with _sink_lock:
+            if f.closed:
+                return
+            f.write(json.dumps(record) + "\n")
+            f.flush()
 
+    _close_sink_file()
+    with _sink_lock:
+        _sink_file = f
     _sink = write
 
 
@@ -51,13 +88,17 @@ if os.environ.get("KTPU_TRACE_FILE"):
 class StepTimer:
     """Named step spans off one start point. `step_hist`, when given, is a
     histogram family labeled by step name; each finished trace observes
-    its per-step durations there (log_if_long is the finish point)."""
+    its per-step durations there (log_if_long is the finish point).
+    `trace_span`, when given, is an obs/tracing.py Span owned by this
+    timer: export() records each step as a child span and ends it."""
 
-    def __init__(self, name: str, step_hist=None):
+    def __init__(self, name: str, step_hist=None, trace_span=None):
         self.name = name
         self.start = time.monotonic()
+        self.start_wall = time.time()
         self.steps: list[tuple[str, float]] = []
         self.step_hist = step_hist
+        self.trace_span = trace_span
 
     def step(self, label: str) -> None:
         self.steps.append((label, time.monotonic()))
@@ -75,8 +116,8 @@ class StepTimer:
         return out
 
     def export(self, total: float | None = None) -> None:
-        """Feed the step histogram and the JSON sink (no-ops when neither
-        is configured)."""
+        """Feed the step histogram, the JSON sink, and the distributed
+        trace (no-ops when none is configured)."""
         spans = None
         if self.step_hist is not None:
             spans = self.spans()
@@ -89,6 +130,18 @@ class StepTimer:
                                             else self.total()), 3),
                    "steps": [{"step": label, "ms": round(1e3 * dur, 3)}
                              for label, dur in spans]})
+        if self.trace_span is not None:
+            span = self.trace_span
+            self.trace_span = None  # export finishes the trace exactly once
+            if span.sampled:
+                from kubernetes_tpu.obs.tracing import TRACER
+                spans = spans if spans is not None else self.spans()
+                wall = self.start_wall
+                for label, dur in spans:
+                    TRACER.record_span(label, span.context, wall, dur,
+                                       tid="loop")
+                    wall += dur
+            span.end("ok")
 
     def log_if_long(self, threshold: float) -> bool:
         """Finish the trace: always export spans; log only when the total

@@ -33,6 +33,7 @@ PIN_COVERAGE = {
     "gang": "tests/test_gang.py",
     "preempt": "tests/test_preemption.py",
     "scale_sim": "tests/test_autoscaler.py",
+    "explain": "tests/test_explain.py",
 }
 
 

@@ -1,0 +1,209 @@
+"""BatchFlags.explain: the per-predicate survivor-count breakdown.
+
+Pins the same three-way contract every optional solver pass carries
+(gang/preempt/scale_sim discipline):
+
+- explain is NEVER derived from batch content — real scheduling batches
+  compile the bit-identical pre-explain HLO (pinned below),
+- explain-on emits `explain_counts` i32[P, len(EXPLAIN_STAGES)] without
+  changing a single assignment,
+- the counts match the serial oracle's per-predicate reject reasons
+  (tests/serial_reference.py) on randomized seeds,
+- the driver renders them into reference-parity FailedScheduling
+  messages ("0/N nodes available: k Insufficient resources, ...").
+"""
+
+import dataclasses
+
+import jax
+import numpy as np
+import pytest
+
+from kubernetes_tpu.api.objects import Node, Pod
+from kubernetes_tpu.models.policy import DEFAULT_POLICY
+from kubernetes_tpu.ops.solver import (
+    EXPLAIN_STAGES,
+    batch_flags,
+    schedule_batch,
+)
+from kubernetes_tpu.scheduler.driver import render_unschedulable
+from kubernetes_tpu.state import Capacities, encode_cluster
+from tests import serial_reference as sr
+
+jit_schedule = jax.jit(schedule_batch, static_argnames=("policy", "flags"))
+
+
+def mk_node(name, cpu="4", mem="8Gi", pods="110", labels=None, taints=None,
+            unschedulable=False):
+    return Node.from_dict({
+        "metadata": {"name": name, "labels": labels or {}},
+        "spec": {"taints": taints or [], "unschedulable": unschedulable},
+        "status": {"allocatable": {"cpu": cpu, "memory": mem, "pods": pods},
+                   "conditions": [{"type": "Ready", "status": "True"}]},
+    })
+
+
+def mk_pod(name, cpu=None, mem=None, port=None, volume=None, node=None,
+           selector=None):
+    c = {"name": "c"}
+    req = {}
+    if cpu:
+        req["cpu"] = cpu
+    if mem:
+        req["memory"] = mem
+    if req:
+        c["resources"] = {"requests": req}
+    if port:
+        c["ports"] = [{"containerPort": 80, "hostPort": int(port)}]
+    spec = {"containers": [c]}
+    if volume:
+        spec["volumes"] = [volume]
+    if node:
+        spec["nodeName"] = node
+    if selector:
+        spec["nodeSelector"] = selector
+    return Pod.from_dict({"metadata": {"name": name}, "spec": spec})
+
+
+def _pd(name, ro=False):
+    return {"name": name, "gcePersistentDisk": {"pdName": name,
+                                                "readOnly": ro}}
+
+
+# ---- HLO pin: the scale_sim discipline, verbatim ----
+
+
+def _pin_fixture():
+    caps = Capacities(num_nodes=4, batch_pods=4)
+    nodes = [mk_node(f"n{i}", cpu="2") for i in range(3)]
+    pods = [mk_pod(f"p{i}", cpu="500m", mem="256Mi") for i in range(4)]
+    state, batch, table = encode_cluster(nodes, pods, caps)
+    return state, batch, table, batch_flags(batch, len(pods), table)
+
+
+def test_explain_never_derived_from_batch_content():
+    """Content-derived flags (the real scheduling path) leave explain
+    off: explain-off deployments compile the pre-explain program."""
+    _state, _batch, _table, flags = _pin_fixture()
+    assert flags.explain is False
+
+
+def test_hlo_pin_scheduling_program_unchanged_by_explain():
+    state, batch, _table, flags = _pin_fixture()
+
+    def lower(f):
+        return jit_schedule.lower(state, batch, 0, DEFAULT_POLICY,
+                                  flags=f).as_text()
+
+    off = lower(flags)
+    explicit_off = lower(dataclasses.replace(flags, explain=False))
+    on = lower(dataclasses.replace(flags, explain=True))
+    assert off == explicit_off  # the scheduling program is pinned
+    assert on != off            # explain really compiles a different program
+
+
+def test_explain_counts_only_emitted_under_explain():
+    state, batch, _table, flags = _pin_fixture()
+    res_off = jit_schedule(state, batch, 0, DEFAULT_POLICY, flags=flags)
+    assert res_off.explain_counts is None
+    res_on = jit_schedule(
+        state, batch, 0, DEFAULT_POLICY,
+        flags=dataclasses.replace(flags, explain=True))
+    np.testing.assert_array_equal(np.asarray(res_on.assignments),
+                                  np.asarray(res_off.assignments))
+    counts = np.asarray(res_on.explain_counts)
+    assert counts.shape == (batch.valid.shape[0], len(EXPLAIN_STAGES))
+    # cumulative survivor counts are nonincreasing down the chain, and the
+    # last column IS the all-predicates feasible count
+    assert (np.diff(counts, axis=1) <= 0).all()
+    np.testing.assert_array_equal(counts[:, -1],
+                                  np.asarray(res_on.feasible_counts))
+
+
+# ---- parity against the serial oracle's per-predicate reject reasons ----
+
+
+def _oracle_counts(nodes, assigned, pod):
+    """Cumulative survivor counts down the EXPLAIN_STAGES chain, computed
+    with the serial reference predicates. Attach/interpod content is kept
+    below the fixture's thresholds, so those stages repeat the prior
+    count — exactly what the gated device chain emits."""
+    states = []
+    for node in nodes:
+        ns = sr.NodeState.from_node(node)
+        for ap in assigned:
+            if ap.spec.node_name == node.metadata.name:
+                ns.add_pod(ap)
+        states.append(ns)
+    static = [ns for ns in states
+              if sr.conditions_ok(ns, pod) and sr.match_selector(ns, pod)
+              and sr.tolerates_taints(ns, pod) and sr.fits_host(ns, pod)]
+    res = [ns for ns in static if sr.fits_resources(ns, pod)]
+    ports = [ns for ns in res if sr.fits_ports(ns, pod)]
+    disk = [ns for ns in ports if sr.no_disk_conflict(ns, pod)]
+    return [len(static), len(res), len(ports), len(disk), len(disk),
+            len(disk)]
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_explain_matches_serial_oracle(seed):
+    rng = np.random.RandomState(seed)
+    nodes = [
+        mk_node("tiny0", cpu="500m"),
+        mk_node("tiny1", cpu="500m"),
+        mk_node("tainted", taints=[{"key": "dedicated", "value": "db",
+                                    "effect": "NoSchedule"}]),
+        mk_node("porty", cpu="4"),
+        mk_node("disky", cpu="4"),
+        mk_node("cordoned", unschedulable=True),
+    ]
+    assigned = [
+        mk_pod("bound-port", cpu="100m", port=8080, node="porty"),
+        mk_pod("bound-disk", cpu="100m", volume=_pd("disk-x"),
+               node="disky"),
+    ]
+    # every pending pod is unschedulable by a MIX of reasons, so the
+    # assume ledger never changes and each pod evaluates against batch
+    # start — which is what the oracle computes
+    pods = []
+    for i in range(int(rng.randint(2, 6))):
+        kind = rng.choice(["huge", "mixed", "selector"])
+        if kind == "huge":  # survives static, dies at resources everywhere
+            pods.append(mk_pod(f"p{i}", cpu="10", port=8080,
+                               volume=_pd("disk-x")))
+        elif kind == "mixed":  # static 4, resources 2, ports 1, disk 0
+            pods.append(mk_pod(
+                f"p{i}", cpu=f"{int(rng.randint(1000, 3900))}m",
+                mem=f"{int(rng.choice([256, 512, 1024]))}Mi",
+                port=8080, volume=_pd("disk-x")))
+        else:  # nothing matches the selector: all stages 0
+            pods.append(mk_pod(f"p{i}", cpu="1", port=8080,
+                               volume=_pd("disk-x"),
+                               selector={"absent": "label"}))
+    caps = Capacities(num_nodes=8, batch_pods=8)
+    state, batch, table = encode_cluster(nodes, pods, caps,
+                                         assigned_pods=assigned)
+    flags = dataclasses.replace(batch_flags(batch, len(pods), table),
+                                explain=True)
+    res = jit_schedule(state, batch, 0, DEFAULT_POLICY, flags=flags)
+    assert (np.asarray(res.assignments)[:len(pods)] == -1).all()
+    counts = np.asarray(res.explain_counts)
+    for i, pod in enumerate(pods):
+        assert counts[i].tolist() == _oracle_counts(nodes, assigned, pod), \
+            f"pod {pod.metadata.name} (seed {seed})"
+
+
+# ---- driver rendering ----
+
+
+def test_render_unschedulable_reference_parity():
+    # column layout: static, resources, ports, disk, attach, interpod
+    msg = render_unschedulable([4, 2, 1, 0, 0, 0], total_nodes=6)
+    assert msg == ("0/6 nodes available: 2 MatchNodeSelector, "
+                   "2 Insufficient resources, 1 PodFitsHostPorts, "
+                   "1 NoDiskConflict")
+    # a survivor count above zero is not a render candidate
+    assert render_unschedulable([4, 4, 4, 4, 4, 4], total_nodes=6) is None
+    # all static rejects
+    assert render_unschedulable([0, 0, 0, 0, 0, 0], total_nodes=6) == \
+        "0/6 nodes available: 6 MatchNodeSelector"

@@ -25,6 +25,7 @@ from kubernetes_tpu.analysis.rules import (
     BatchFlagsDiscipline,
     Determinism,
     EventLoopPurity,
+    SpanDiscipline,
     StoreWriteDiscipline,
     TracePurity,
 )
@@ -33,7 +34,7 @@ from kubernetes_tpu.apiserver.store import Binding, Conflict, ObjectStore
 from kubernetes_tpu.testing.races import LoopStallWatchdog, RaceDetector
 
 R1, R2, R3 = [EventLoopPurity()], [TracePurity()], [BatchFlagsDiscipline()]
-R4, R5 = [Determinism()], [StoreWriteDiscipline()]
+R4, R5, R6 = [Determinism()], [StoreWriteDiscipline()], [SpanDiscipline()]
 
 KERNEL_PATH = "kubernetes_tpu/parallel/mesh.py"  # any KERNEL_MODULES entry
 
@@ -421,6 +422,62 @@ def test_r5_clean_on_versioned_and_cas_writes():
     )
     assert lint_source(src, relpath="kubernetes_tpu/controllers/x.py",
                        rules=R5) == []
+
+
+# ---------------------------------------------------------------------------
+# R6: span lifecycle + metric naming discipline
+
+
+def test_r6_flags_bare_start_span():
+    src = (
+        "from kubernetes_tpu.obs.tracing import TRACER\n"
+        "def handle(req):\n"
+        "    span = TRACER.start_span('handle')\n"
+        "    do_work(req)\n"
+        "    span.end()\n"  # exception in do_work leaks the span
+    )
+    (f,) = lint_source(src, relpath="kubernetes_tpu/x.py", rules=R6)
+    assert f.rule == "span-discipline" and f.line == 3
+
+
+def test_r6_clean_on_with_and_try_finally_and_begin_span():
+    src = (
+        "from kubernetes_tpu.obs.tracing import TRACER\n"
+        "def scoped(req):\n"
+        "    with TRACER.start_span('handle') as span:\n"
+        "        do_work(req, span)\n"
+        "def manual(req):\n"
+        "    span = TRACER.start_span('handle')\n"
+        "    try:\n"
+        "        do_work(req)\n"
+        "    finally:\n"
+        "        span.end()\n"
+        "def handoff(req):\n"
+        "    # begin_span: explicit cross-thread ownership, exempt\n"
+        "    span = TRACER.begin_span('batch')\n"
+        "    enqueue(req, span)\n"
+    )
+    assert lint_source(src, relpath="kubernetes_tpu/x.py", rules=R6) == []
+
+
+def test_r6_flags_unsuffixed_metric_families():
+    src = (
+        "def metrics(r):\n"
+        "    bad_c = r.counter('scheduler_binds', 'd')\n"
+        "    bad_h = r.histogram('solve_duration', 'd', buckets=(1,))\n"
+        "    ok_c = r.counter('scheduler_binds_total', 'd')\n"
+        "    ok_legacy = r.counter('apiserver_request_count', 'd')\n"
+        "    ok_h = r.histogram('solve_duration_seconds', 'd')\n"
+        "    ok_us = r.histogram('encode_microseconds', 'd')\n"
+    )
+    found = lint_source(src, relpath="kubernetes_tpu/x.py", rules=R6)
+    assert sorted(f.line for f in found) == [2, 3]
+    assert all(f.rule == "span-discipline" for f in found)
+
+
+def test_r6_whole_tree_clean():
+    result = run_analysis(rules=R6, baseline={})
+    assert result.findings == [], [str(f) for f in result.findings]
 
 
 # ---------------------------------------------------------------------------

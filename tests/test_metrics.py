@@ -145,6 +145,55 @@ def test_registry_concurrency():
     assert renders  # scraped while hot
 
 
+def test_metrics_hammer_contended_children():
+    """The hard case test_registry_concurrency leaves out: every thread
+    hammers the SAME child. Counter.inc totals stay exact under
+    contention, Gauge inc/dec pairs net to zero, Histogram per-bucket
+    counts partition the observation count exactly, and racing
+    `labels()` calls on one unseen key converge on a single child (the
+    double-checked create in Family.labels)."""
+    r = Registry()
+    c = r.counter("hammer_ops_total", "ops")
+    g = r.gauge("hammer_inflight", "inflight")
+    h = r.histogram("hammer_dur_seconds", "dur", buckets=[0.1, 1.0])
+    lab = r.counter("hammer_labeled_total", "ops", ("k",))
+    n_threads, n_ops = 8, 5000
+    barrier = threading.Barrier(n_threads)
+    children = [None] * n_threads
+
+    def work(i):
+        barrier.wait()  # maximize interleaving at the racy first get
+        children[i] = lab.labels("same-key")
+        for j in range(n_ops):
+            c.inc()
+            g.inc(2.0)
+            g.dec()
+            g.dec()
+            # alternate buckets so each finite bound gets an exact share
+            h.observe(0.05 if j % 2 == 0 else 0.5)
+            children[i].inc()
+
+    threads = [threading.Thread(target=work, args=(i,))
+               for i in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    total = n_threads * n_ops
+    assert c.labels().value == total
+    assert g.labels().value == 0.0
+    hist = h.labels()
+    assert hist.count == total
+    assert hist.counts[0] == total // 2   # le=0.1
+    assert hist.counts[1] == total // 2   # le=1.0
+    assert hist.counts[2] == 0            # +Inf
+    assert hist.sum == pytest.approx(total // 2 * 0.05
+                                     + total // 2 * 0.5)
+    # the race on first labels(): exactly one child object won
+    assert len({id(ch) for ch in children}) == 1
+    assert children[0].value == total
+
+
 # ---- shared handler helper ----
 
 

@@ -1,0 +1,462 @@
+"""End-to-end distributed tracing (obs/tracing.py).
+
+- W3C traceparent encode/parse (malformed inputs rejected)
+- head-based sampling: the root decides, children inherit, unsampled
+  spans never enter the ring or the open-span table
+- retroactive record_span parenting (the staged pipeline's stage spans)
+- Chrome trace-event export: every pipeline stage row seeded as ph:"M"
+  thread_name metadata, spans as ph:"X"
+- the stitched trace: create -> encode -> dispatch -> settle -> commit
+  spans share ONE trace; bound pods carry trace.ktpu.io/context; the
+  kubelet's first sync joins it
+- trace continuity under failure: a mid-pipeline kill() leaves ZERO
+  orphan (begun-but-never-ended) spans
+- traceparent survives the client -> apiserver -> store round-trip and
+  /debug/traces serves the ring over the shared obs mux
+- bench.py --smoke --trace-out emits a parseable Chrome trace with all
+  four scheduler stage rows (the tier-1 drift gate for the export path)
+
+The "why pending" explainability e2e (FailedScheduling message through
+the driver + kubectl explain-pending) rides here too — it shares the
+fixture shape.
+"""
+
+import asyncio
+import json
+import os
+import subprocess
+import sys
+import time
+import types
+from pathlib import Path
+
+import pytest
+
+from kubernetes_tpu.api.objects import Pod
+from kubernetes_tpu.apiserver.store import ObjectStore
+from kubernetes_tpu.obs.tracing import (
+    STAGE_TIDS,
+    TRACE_ANNOTATION,
+    TRACER,
+    SpanContext,
+    Tracer,
+    parse_traceparent,
+    pod_trace_context,
+)
+from kubernetes_tpu.perf.fixtures import make_nodes, make_pods
+from kubernetes_tpu.scheduler import Scheduler
+from kubernetes_tpu.state import Capacities
+from tests.http_util import http_store
+
+CAPS = Capacities(num_nodes=64, batch_pods=8)
+
+
+@pytest.fixture()
+def sampled_tracer():
+    """Pin the process-global tracer to sample everything, restore
+    after."""
+    prev_rate = TRACER.sample_rate
+    TRACER.clear()
+    TRACER.sample_rate = 1.0
+    yield TRACER
+    TRACER.sample_rate = prev_rate
+    TRACER.clear()
+
+
+# ---------------------------------------------------------------------------
+# traceparent wire format
+
+
+def test_traceparent_roundtrip():
+    ctx = SpanContext("a" * 32, "b" * 16, sampled=True)
+    assert ctx.to_traceparent() == f"00-{'a' * 32}-{'b' * 16}-01"
+    back = parse_traceparent(ctx.to_traceparent())
+    assert back == ctx
+    off = parse_traceparent(f"00-{'a' * 32}-{'b' * 16}-00")
+    assert off is not None and not off.sampled
+
+
+@pytest.mark.parametrize("bad", [
+    None, "", "garbage",
+    "00-short-" + "b" * 16 + "-01",                  # bad trace_id length
+    "00-" + "a" * 32 + "-short-01",                  # bad span_id length
+    "00-" + "g" * 32 + "-" + "b" * 16 + "-01",       # non-hex
+    "ff-" + "a" * 32 + "-" + "b" * 16 + "-01",       # forbidden version
+    "00-" + "0" * 32 + "-" + "b" * 16 + "-01",       # all-zero trace id
+    "00-" + "a" * 32 + "-" + "0" * 16 + "-01",       # all-zero span id
+    "00-" + "a" * 32 + "-" + "b" * 16 + "-01-extra",
+])
+def test_traceparent_rejects_malformed(bad):
+    assert parse_traceparent(bad) is None
+
+
+# ---------------------------------------------------------------------------
+# sampling + ring + orphan table
+
+
+def test_head_based_sampling_root_decides_children_inherit():
+    tr = Tracer(sample_rate=0.0)
+    root = tr.begin_span("root")
+    child = root.child("child")
+    assert not root.sampled and not child.sampled
+    child.end()
+    root.end()
+    assert tr.finished() == []          # unsampled spans never enter
+    assert tr.open_spans() == []        # ... nor the orphan table
+
+    tr.sample_rate = 1.0
+    root = tr.begin_span("root", tid="client")
+    assert root.sampled
+    child = root.child("child", tid="apiserver")
+    assert child.sampled
+    assert child.context.trace_id == root.context.trace_id
+    assert child.parent_id == root.context.span_id
+    assert len(tr.open_spans()) == 2
+    child.end()
+    root.end("error")
+    assert tr.open_spans() == []
+    recs = tr.finished()
+    assert [r["name"] for r in recs] == ["child", "root"]
+    assert recs[1]["status"] == "error"
+    # a sampled CHILD of an unsampled parent cannot exist: inherit only
+    assert not tr.begin_span("x", parent=SpanContext(
+        "c" * 32, "d" * 16, sampled=False)).sampled
+
+
+def test_ring_is_bounded():
+    tr = Tracer(sample_rate=1.0, capacity=16)
+    for i in range(50):
+        tr.begin_span(f"s{i}").end()
+    recs = tr.finished()
+    assert len(recs) == 16
+    assert recs[-1]["name"] == "s49"    # newest kept, oldest evicted
+
+
+def test_record_span_retroactive_parenting():
+    tr = Tracer(sample_rate=1.0)
+    batch = tr.begin_span("schedule.batch", tid="scheduler")
+    t0 = time.time()
+    tr.record_span("dispatch", batch.context, t0, 0.012, tid="dispatch")
+    tr.record_span("ignored", None, t0, 0.5)  # no parent -> no record
+    batch.end()
+    recs = tr.finished()
+    assert len(recs) == 2
+    disp = next(r for r in recs if r["name"] == "dispatch")
+    assert disp["trace_id"] == batch.context.trace_id
+    assert disp["parent_id"] == batch.context.span_id
+    assert disp["dur_us"] == 12000
+    assert disp["tid"] == "dispatch"
+
+
+def test_chrome_export_seeds_all_stage_rows():
+    tr = Tracer(sample_rate=1.0)
+    with tr.start_span("client.post", tid="client"):
+        pass
+    doc = json.loads(tr.to_chrome())
+    assert doc["displayTimeUnit"] == "ms"
+    meta = [e for e in doc["traceEvents"] if e["ph"] == "M"]
+    names = [e["args"]["name"] for e in meta
+             if e["name"] == "thread_name"]
+    assert names[:len(STAGE_TIDS)] == list(STAGE_TIDS)
+    xs = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    assert len(xs) == 1 and xs[0]["name"] == "client.post"
+    assert xs[0]["tid"] == meta[names.index("client")]["tid"]
+
+
+def test_pod_trace_context_extraction():
+    sampled = SpanContext("a" * 32, "b" * 16, True).to_traceparent()
+    unsampled = SpanContext("a" * 32, "b" * 16, False).to_traceparent()
+    mk = lambda ann: Pod.from_dict(  # noqa: E731
+        {"metadata": {"name": "p", "annotations": ann},
+         "spec": {"containers": [{"name": "c"}]}})
+    assert pod_trace_context(mk({TRACE_ANNOTATION: sampled})) is not None
+    assert pod_trace_context(mk({TRACE_ANNOTATION: unsampled})) is None
+    assert pod_trace_context(mk({})) is None
+    assert pod_trace_context(mk({TRACE_ANNOTATION: "junk"})) is None
+
+
+# ---------------------------------------------------------------------------
+# the stitched trace end to end
+
+
+def _cluster(store, n_nodes=8, n_pods=16):
+    for node in make_nodes(n_nodes, cpu="16", memory="32Gi"):
+        store.create(node)
+    return make_pods(n_pods, cpu="100m", memory="64Mi")
+
+
+async def _drain(sched, expect, tries=200, wait=0.05):
+    done = 0
+    for _ in range(tries):
+        done += await sched.schedule_pending(wait=wait)
+        if done >= expect and not sched.inflight_batches:
+            break
+    return done
+
+
+def test_stitched_trace_through_staged_pipeline(sampled_tracer):
+    """One pod's life is ONE trace: the batch span plus encode/dispatch/
+    settle/commit stage spans share a trace_id, bound pods carry the
+    traceparent annotation, and the kubelet's sync joins the same
+    trace."""
+    async def run():
+        store = ObjectStore()
+        pods = _cluster(store, n_pods=16)
+        sched = Scheduler(store, caps=CAPS)
+        assert sched._staged is not None
+        await sched.start()
+        for pod in pods:
+            store.create(pod)
+        await asyncio.sleep(0)
+        got = await _drain(sched, 16)
+        assert got == 16
+        # stage threads record their spans after the apply closure runs
+        # on the loop; give them a beat
+        for _ in range(100):
+            if not sampled_tracer.open_spans():
+                break
+            await asyncio.sleep(0.02)
+        sched.stop()
+        return store
+
+    store = asyncio.run(run())
+    assert sampled_tracer.open_spans() == []
+    recs = sampled_tracer.finished()
+    by_trace: dict = {}
+    for r in recs:
+        by_trace.setdefault(r["trace_id"], set()).add(r["name"])
+    full = [t for t, names in by_trace.items()
+            if {"schedule.batch", "encode", "dispatch", "settle",
+                "commit"} <= names]
+    assert full, f"no stitched trace: {by_trace}"
+    # every bound pod carries the annotation of some finished batch trace
+    bound = [p for p in store.list("Pod") if p.spec.node_name]
+    assert len(bound) == 16
+    for p in bound:
+        ctx = pod_trace_context(p)
+        assert ctx is not None, p.metadata.name
+        assert ctx.trace_id in by_trace
+
+    # kubelet joins via the annotation (first sync only)
+    from kubernetes_tpu.agent.kubelet import Kubelet
+
+    kubelet = Kubelet(store, bound[0].spec.node_name)
+    kubelet.running = True
+    kubelet._sync_pod(bound[0])
+    kubelet._sync_pod(bound[0])  # dedup: second sync adds no span
+    joins = [r for r in sampled_tracer.finished()
+             if r["name"] == "kubelet.sync"]
+    assert len(joins) == 1
+    assert joins[0]["trace_id"] == pod_trace_context(bound[0]).trace_id
+    assert joins[0]["tid"] == "kubelet"
+
+
+def test_mid_pipeline_kill_leaves_no_orphan_spans(sampled_tracer):
+    """Trace continuity under failure: kill() with batches mid-stage must
+    end every begun span (status aborted/error paths) — zero entries left
+    in the open-span table."""
+    async def run():
+        store = ObjectStore()
+        pod_objs = _cluster(store, n_nodes=8, n_pods=48)
+        sched = Scheduler(store, caps=CAPS)
+        assert sched._staged is not None
+        sched.solve_fault_hook = lambda keys: time.sleep(0.03)
+        await sched.start()
+        for pod in pod_objs:
+            store.create(pod)
+        await asyncio.sleep(0)
+        async with asyncio.timeout(30):
+            while not any(p.spec.node_name for p in store.list("Pod")):
+                await sched.schedule_pending(wait=0.02)
+        assert sched.inflight_batches > 0
+        sched.kill()
+        await asyncio.sleep(0.3)       # stages notice killed and drop
+        sched.stop()
+
+    asyncio.run(run())
+    orphans = sampled_tracer.open_spans()
+    assert orphans == [], [(s.name, s.tid) for s in orphans]
+    statuses = {r["status"] for r in sampled_tracer.finished()
+                if r["name"] == "schedule.batch"}
+    assert "aborted" in statuses or "ok" in statuses
+
+
+# ---------------------------------------------------------------------------
+# client -> apiserver -> store round-trip + /debug/traces
+
+
+def test_traceparent_survives_client_apiserver_roundtrip(sampled_tracer):
+    with http_store() as (client, store):
+        client.create(Pod.from_dict({
+            "metadata": {"name": "traced", "namespace": "default"},
+            "spec": {"containers": [{"name": "c"}]}}))
+        # the server stamped the client's traceparent at create
+        pod = client.get("Pod", "traced", "default")
+        ctx = pod_trace_context(pod)
+        assert ctx is not None
+        # ... and it matches a client.post root span in the ring
+        roots = [r for r in sampled_tracer.finished()
+                 if r["name"] == "client.post"]
+        assert ctx.trace_id in {r["trace_id"] for r in roots}
+        # the server-side request span joined the same trace
+        server_spans = [r for r in sampled_tracer.finished()
+                        if r["name"] == "apiserver.post"]
+        assert ctx.trace_id in {r["trace_id"] for r in server_spans}
+
+        # /debug/traces serves the ring over the shared obs mux
+        status, body = client.raw("GET", "/debug/traces")
+        assert status == 200
+        payload = json.loads(body)
+        assert payload["num_spans"] >= 1
+        assert ctx.trace_id in payload["traces"]
+
+
+# ---------------------------------------------------------------------------
+# explainability e2e: driver message + kubectl explain-pending
+
+
+def test_explain_e2e_failed_scheduling_message(sampled_tracer):
+    """Scheduler(explain=True): an unschedulable pod's FailedScheduling
+    event carries the per-predicate breakdown, and kubectl
+    explain-pending prints it."""
+    async def run():
+        store = ObjectStore()
+        for node in make_nodes(4, cpu="1", memory="1Gi"):
+            store.create(node)
+        sched = Scheduler(store, caps=CAPS, explain=True)
+        await sched.start()
+        store.create(Pod.from_dict({
+            "metadata": {"name": "huge", "namespace": "default"},
+            "spec": {"containers": [{"name": "c", "resources": {
+                "requests": {"cpu": "64", "memory": "256Gi"}}}]}}))
+        await asyncio.sleep(0)
+        await sched.schedule_pending(wait=0.2)
+        sched.stop()
+        return store
+
+    store = asyncio.run(run())
+    msgs = [e.message for e in store.list("Event")
+            if e.reason == "FailedScheduling"]
+    assert msgs, "no FailedScheduling event"
+    assert any(m.startswith("0/4 nodes available: 4 Insufficient "
+                            "resources") for m in msgs), msgs
+
+    # kubectl explain-pending renders the same message through the CLI
+    from kubernetes_tpu.cli.kubectl import cmd_explain_pending
+
+    class FakeClient:
+        def get(self, kind, name, ns):
+            return store.get(kind, name, ns)
+
+        def list(self, kind, namespace=None):
+            return store.list(kind, namespace=namespace)
+
+    args = types.SimpleNamespace(name="huge", namespace="default")
+    import io
+    from contextlib import redirect_stdout
+
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        rc = cmd_explain_pending(FakeClient(), args)
+    assert rc == 0
+    assert buf.getvalue().strip().startswith("0/4 nodes available:")
+
+
+def test_explain_off_is_default_and_env_gated(monkeypatch):
+    store = ObjectStore()
+    assert Scheduler(store, caps=CAPS).explain is False
+    monkeypatch.setenv("KTPU_EXPLAIN", "1")
+    assert Scheduler(store, caps=CAPS).explain is True
+    assert Scheduler(store, caps=CAPS, explain=False).explain is False
+
+
+# ---------------------------------------------------------------------------
+# StepTimer -> trace folding (legacy path) + sink thread safety
+
+
+def test_steptimer_folds_steps_into_trace(sampled_tracer):
+    from kubernetes_tpu.utils.trace import StepTimer
+
+    batch = sampled_tracer.begin_span("schedule.batch", tid="scheduler")
+    timer = StepTimer("legacy", trace_span=batch)
+    timer.step("encode")
+    timer.step("device solve")
+    timer.log_if_long(999.0)            # finish: exports + ends the span
+    assert sampled_tracer.open_spans() == []
+    recs = sampled_tracer.finished()
+    names = [r["name"] for r in recs
+             if r["trace_id"] == batch.context.trace_id]
+    assert "encode" in names and "device solve" in names
+    assert "schedule.batch" in names
+    steps = [r for r in recs if r["name"] == "encode"]
+    assert steps[0]["parent_id"] == batch.context.span_id
+    assert steps[0]["tid"] == "loop"
+    # export() must be once-only even if called again
+    timer.export()
+    assert len([r for r in sampled_tracer.finished()
+                if r["name"] == "encode"]) == 1
+
+
+def test_trace_sink_concurrent_writes(tmp_path):
+    from kubernetes_tpu.utils.trace import StepTimer, set_trace_sink
+
+    path = tmp_path / "sink.jsonl"
+    set_trace_sink(str(path))
+    try:
+        import threading
+
+        def work(i):
+            for j in range(50):
+                t = StepTimer(f"w{i}-{j}")
+                t.step("a")
+                t.export()
+
+        threads = [threading.Thread(target=work, args=(i,))
+                   for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    finally:
+        set_trace_sink(None)            # closes the handle
+    lines = path.read_text().splitlines()
+    assert len(lines) == 8 * 50
+    for ln in lines:                    # no interleaved/torn lines
+        json.loads(ln)
+
+
+# ---------------------------------------------------------------------------
+# bench --trace-out: the tier-1 export drift gate
+
+
+def test_bench_smoke_trace_out(tmp_path):
+    """bench.py --smoke --trace-out emits a parseable Chrome trace whose
+    thread rows include all four scheduler stages, with at least one
+    complete stitched batch."""
+    repo = Path(__file__).resolve().parents[1]
+    out = tmp_path / "trace.json"
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["BENCH_CONFIGS"] = "headline"
+    env["BENCH_NODES"] = "64"
+    env["BENCH_PODS"] = "128"
+    proc = subprocess.run(
+        [sys.executable, "bench.py", "--smoke", "--trace-out", str(out)],
+        cwd=repo, env=env, capture_output=True, text=True, timeout=420)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    result = json.loads(
+        [ln for ln in proc.stdout.splitlines() if ln.strip()][-1])
+    assert "error" not in result, result
+    assert result["extras"]["trace_out"] == str(out)
+    doc = json.loads(out.read_text())
+    rows = {e["args"]["name"]: e["tid"] for e in doc["traceEvents"]
+            if e["ph"] == "M" and e["name"] == "thread_name"}
+    for stage in ("encode", "dispatch", "settle", "commit"):
+        assert stage in rows, rows
+    xs = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    assert xs, "no spans in the bench trace"
+    by_trace: dict = {}
+    for e in xs:
+        by_trace.setdefault(e["args"]["trace_id"], set()).add(e["name"])
+    assert any({"encode", "dispatch", "settle", "commit"} <= names
+               for names in by_trace.values()), by_trace
