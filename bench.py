@@ -34,6 +34,10 @@ BENCH_E2E_GATE (headline pods/s hard floor at >=1000 nodes, default
 compiled program; 0 disables, and --smoke defaults it off). The headline
 extras also carry the staged pipeline's per-stage busy fractions and
 inter-stage queue high-water marks (headline_pipeline_*).
+BENCH_MONITOR_TARGETS / BENCH_MONITOR_SECONDS / BENCH_MONITOR_INTERVAL
+shape the monitoring-plane drill (a Monitor scraping a live ObsServer
+fleet; reports scrape p99, samples/s ingested, query p99, and errors on
+any scrape failure or unbounded TSDB growth).
 
 The opt-in `sharded` config (BENCH_CONFIGS=...,sharded) runs
 headline/gang/preemption plus a device-solve gate with the node axis
@@ -119,6 +123,9 @@ def main() -> None:
         os.environ.setdefault("BENCH_OVERLOAD_MULT", "10")
         os.environ.setdefault("BENCH_FANOUT_WATCHERS", "500")
         os.environ.setdefault("BENCH_FANOUT_EVENTS", "20")
+        os.environ.setdefault("BENCH_MONITOR_TARGETS", "3")
+        os.environ.setdefault("BENCH_MONITOR_SECONDS", "2")
+        os.environ.setdefault("BENCH_MONITOR_INTERVAL", "0.2")
         os.environ.setdefault("BENCH_DEVICE_GATE", "0")  # CPU CI: no gate
         os.environ.setdefault("BENCH_E2E_GATE", "0")     # seconds-scale run
         os.environ.setdefault("BENCH_SHARDED_NODES", "64")
@@ -129,7 +136,8 @@ def main() -> None:
         os.environ.setdefault("BENCH_SHARDED_GATE", "0")  # CPU CI: no gate
         os.environ.setdefault("BENCH_SHARDED_FORCE_HOST", "1")
         os.environ.setdefault(
-            "BENCH_CONFIGS", "headline,gang,preemption,autoscaler,sharded")
+            "BENCH_CONFIGS",
+            "headline,gang,preemption,autoscaler,sharded,monitor")
         os.environ.setdefault("BENCH_TIMEOUT_S", "600")
     timeout = int(os.environ.get("BENCH_TIMEOUT_S", "1800"))
     signal.signal(signal.SIGALRM, _die_with_timeout)
@@ -140,7 +148,7 @@ def main() -> None:
     configs = os.environ.get(
         "BENCH_CONFIGS",
         "headline,interpod,spread,gang,preemption,recovery,chaos,overload,"
-        "device,autoscaler")
+        "device,autoscaler,monitor")
     configs = [c.strip() for c in configs.split(",") if c.strip()]
     metrics_snapshot = "--metrics-snapshot" in sys.argv[1:] or \
         os.environ.get("BENCH_METRICS_SNAPSHOT", "") in ("1", "true")
@@ -419,6 +427,35 @@ def main() -> None:
         if r.nodes_added == 0:
             RESULT["error"] = ("autoscaler bench: burst bound without any "
                                "scale-up (cluster was not empty)")
+
+    if "monitor" in configs:
+        from kubernetes_tpu.perf.harness import run_monitor_bench
+
+        # monitoring-plane overhead drill: the Monitor scrapes a fleet of
+        # real ObsServers over churning registries at a fixed interval
+        # while instant queries run against the TSDB. Contract: zero
+        # scrape failures and a bounded TSDB (series count stable once
+        # the fleet's label space is discovered)
+        mon_targets = int(os.environ.get("BENCH_MONITOR_TARGETS", "5"))
+        mon_seconds = float(os.environ.get("BENCH_MONITOR_SECONDS", "10"))
+        mon_interval = float(os.environ.get("BENCH_MONITOR_INTERVAL", "1.0"))
+        r = run_monitor_bench(mon_targets, mon_seconds, mon_interval)
+        print(f"bench[monitor]: {r}", file=sys.stderr, flush=True)
+        extras["monitor_scrape_p99_ms"] = round(r.scrape_p99_ms, 2)
+        extras["monitor_samples_per_sec"] = round(r.samples_per_sec, 1)
+        extras["monitor_query_p99_ms"] = round(r.query_p99_ms, 3)
+        extras["monitor_tsdb_series"] = r.tsdb_series
+        extras["monitor_tsdb_samples"] = r.tsdb_samples
+        extras["monitor_scrape_failures"] = r.scrape_failures
+        if r.scrape_failures:
+            RESULT["error"] = (
+                f"monitor bench: {r.scrape_failures} scrape failures over "
+                f"{r.scrapes} rounds against a healthy fleet")
+        elif not r.series_stable:
+            RESULT["error"] = (
+                f"monitor bench: TSDB series grew past the discovered "
+                f"label space ({r.tsdb_series} series — per-scrape "
+                f"series leak)")
 
     if "device" in configs:
         # transport-independent: steady-state compiled-solver throughput

@@ -62,6 +62,10 @@ def _kubelet_metrics() -> tuple:
     return _kubelet_mx
 
 
+# live cpu usage as a fraction of request (the hollow fleet's stand-in for
+# cadvisor samples; controllers/hpa.py AnnotationMetrics reads the same key)
+CPU_USAGE_ANNOTATION = "kubernetes-tpu/cpu-usage"
+
 RUN_SECONDS_ANNOTATION = "kubernetes-tpu/run-seconds"
 EXIT_CODE_ANNOTATION = "kubernetes-tpu/exit-code"
 # fake-runtime probe answers (the scripted half of probing; exec probes run
@@ -472,6 +476,57 @@ class Kubelet(HollowKubelet):
                     self.cm.release(key)
                     self._forget_probes(key)
             _kubelet_metrics()[1].observe(time.perf_counter() - t0)
+
+    # ---- resource metrics (/stats/summary) ----
+
+    def stats_summary(self) -> dict:
+        """The Summary API payload (pkg/kubelet/server/stats, collapsed to
+        what the Monitor's resource pipeline consumes): node totals plus
+        per-pod cpu/memory usage for every pod with a live sandbox. Usage
+        comes from the same sources the eviction manager trusts — the
+        cpu-usage annotation (fraction of request) and the memory-usage
+        annotation with a requests fallback — so `kubectl top` and HPA see
+        the numbers eviction acts on."""
+        from kubernetes_tpu.agent.eviction import pod_memory_usage_mib
+        from kubernetes_tpu.api.quantity import parse_quantity
+
+        pods_out = []
+        node_cpu = 0.0
+        node_mem = 0.0
+        for key, pod in sorted(self._active.items()):
+            if key not in self.runtime:
+                continue
+            cpu_request = 0.0
+            for c in pod.spec.containers:
+                if "cpu" in c.requests:
+                    try:
+                        cpu_request += float(
+                            parse_quantity(c.requests["cpu"]))
+                    except (ValueError, ArithmeticError):
+                        pass
+            cpu: dict = {}
+            raw = pod.metadata.annotations.get(CPU_USAGE_ANNOTATION)
+            if raw is not None:
+                try:
+                    ratio = float(raw)
+                except (TypeError, ValueError):
+                    ratio = None
+                if ratio is not None:
+                    cpu["usageRatio"] = ratio
+                    cpu["usageCores"] = ratio * cpu_request
+            if "usageCores" not in cpu:
+                # no live sample: a hollow sandbox "uses" its request
+                cpu["usageCores"] = cpu_request
+            mem = float(pod_memory_usage_mib(pod))
+            ns, name = key.split("/", 1)
+            pods_out.append({"podRef": {"name": name, "namespace": ns},
+                             "cpu": cpu, "memory": {"usageMiB": mem}})
+            node_cpu += cpu["usageCores"]
+            node_mem += mem
+        return {"node": {"nodeName": self.node_name,
+                         "cpu": {"usageCores": node_cpu},
+                         "memory": {"usageMiB": node_mem}},
+                "pods": pods_out}
 
     # ---- lifecycle ----
 

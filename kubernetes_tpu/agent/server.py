@@ -104,6 +104,13 @@ class KubeletServer:
             await self._respond(writer, 200,
                                 json.dumps({"pods": pods}).encode())
             return
+        if parts == ["stats", "summary"] and method == "GET":
+            # the metrics-server resource pipeline's source: node + per-pod
+            # usage, scraped by the Monitor into node_*/pod_* series
+            summary = self.kubelet.stats_summary()
+            await self._respond(writer, 200, json.dumps(summary).encode(),
+                                content_type="application/json")
+            return
         if len(parts) == 4 and parts[0] == "containerLogs" \
                 and method == "GET":
             _, ns, pod, _container = parts

@@ -649,6 +649,12 @@ class StoreWriteDiscipline:
 
 COUNTER_SUFFIXES = ("_total", "_count")
 HISTOGRAM_SUFFIXES = ("_seconds", "_ms", "_microseconds")
+# a recording rule's output is itself a family: unit/shape suffixes only
+# (rates and ratios get their own, on top of the counter/histogram set)
+RECORDING_SUFFIXES = COUNTER_SUFFIXES + HISTOGRAM_SUFFIXES + (
+    "_ratio", "_frac", "_per_second", "_bytes", "_mib", "_cores")
+# Prometheus alertname convention: CamelCase, e.g. SchedulerDown
+ALERT_NAME_RE = re.compile(r"^[A-Z][a-zA-Z0-9]*$")
 
 
 class SpanDiscipline:
@@ -664,13 +670,21 @@ class SpanDiscipline:
     Second check: Prometheus naming. Counter families end in _total (or
     the reference's legacy _count), histogram families in a unit suffix
     (_seconds/_ms/_microseconds) — a family without one renders
-    dashboards unit-blind."""
+    dashboards unit-blind.
+
+    Third check: monitoring-rule naming. A RecordingRule's output series
+    is a family like any other, so its name must carry a unit/shape
+    suffix (the counter/histogram set plus _ratio/_frac/_per_second/
+    _bytes/_mib/_cores); an AlertingRule's name must be CamelCase (the
+    Prometheus alertname convention — `kubectl get alerts` and the Event
+    reason both render it)."""
 
     name = "span-discipline"
 
     def check(self, mod: Module):
         yield from self._check_span_lifecycle(mod)
         yield from self._check_metric_names(mod)
+        yield from self._check_rule_names(mod)
 
     def _check_span_lifecycle(self, mod: Module):
         sanctioned: set[int] = set()
@@ -738,6 +752,45 @@ class SpanDiscipline:
                     f"histogram family {fam!r} must carry a unit suffix "
                     f"({'/'.join(HISTOGRAM_SUFFIXES)}) — unit-blind "
                     "duration families misread as counts on dashboards")
+
+    @staticmethod
+    def _rule_name_arg(node: ast.Call, kw_name: str):
+        """First positional arg or the named keyword, when a constant
+        string (dynamic names are a runtime-validation concern)."""
+        arg = node.args[0] if node.args else next(
+            (kw.value for kw in node.keywords if kw.arg == kw_name), None)
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+            return arg
+        return None
+
+    def _check_rule_names(self, mod: Module):
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            ctor = func.id if isinstance(func, ast.Name) else (
+                func.attr if isinstance(func, ast.Attribute) else "")
+            if ctor == "RecordingRule":
+                arg = self._rule_name_arg(node, "record")
+                if arg is not None and \
+                        not arg.value.endswith(RECORDING_SUFFIXES):
+                    yield Finding(
+                        self.name, mod.relpath, node.lineno,
+                        node.col_offset,
+                        f"recording rule output {arg.value!r} must end in "
+                        f"a unit/shape suffix "
+                        f"({'/'.join(RECORDING_SUFFIXES)}) — the recorded "
+                        "series is a metric family like any other")
+            elif ctor == "AlertingRule":
+                arg = self._rule_name_arg(node, "alert")
+                if arg is not None and not ALERT_NAME_RE.match(arg.value):
+                    yield Finding(
+                        self.name, mod.relpath, node.lineno,
+                        node.col_offset,
+                        f"alert name {arg.value!r} must be CamelCase "
+                        "(^[A-Z][a-zA-Z0-9]*$, the Prometheus alertname "
+                        "convention — kubectl and Event reasons render "
+                        "it)")
 
 
 RULES = [EventLoopPurity(), TracePurity(), BatchFlagsDiscipline(),

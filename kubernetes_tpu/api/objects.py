@@ -1246,6 +1246,39 @@ class PriorityLevelConfiguration(_SpecStatusObject):
 
 
 @dataclass
+class AlertRule(_SpecStatusObject):
+    """monitoring.ktpu.io rule consumed by the Monitor's rule engine (the
+    PrometheusRule CRD position in the reference's monitoring addons).
+
+    spec: exactly one of `record` (a recording rule writing the result
+    back into the TSDB under that series name) or `alert` (a CamelCase
+    alert name — CamelCase lives in spec because metadata.name must stay
+    DNS-1123); `expr` (the query expression, validated parseable at
+    admission); `for` (seconds a labelset must stay active before the
+    alert fires); optional `labels`/`annotations` maps. Cluster-scoped:
+    rules judge the whole control plane, not one namespace."""
+
+    kind = "AlertRule"
+    api_version = "monitoring.ktpu.io/v1alpha1"
+
+    @property
+    def record(self) -> str:
+        return self.spec.get("record", "") or ""
+
+    @property
+    def alert(self) -> str:
+        return self.spec.get("alert", "") or ""
+
+    @property
+    def expr(self) -> str:
+        return self.spec.get("expr", "") or ""
+
+    @property
+    def for_s(self) -> float:
+        return float(self.spec.get("for", 0) or 0)
+
+
+@dataclass
 class _DataObject:
     """Shared shape of the data-map kinds (Secret/ConfigMap): metadata + a
     string-keyed payload map (reference staging/src/k8s.io/api/core/v1/

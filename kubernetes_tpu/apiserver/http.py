@@ -98,6 +98,8 @@ RESOURCES: dict[str, str] = {
     # flowcontrol.ktpu.io (API priority & fairness)
     "flowschemas": "FlowSchema",
     "prioritylevelconfigurations": "PriorityLevelConfiguration",
+    # monitoring.ktpu.io (the Monitor's recording/alerting rules)
+    "alertrules": "AlertRule",
     "roles": "Role",
     "clusterroles": "ClusterRole",
     "rolebindings": "RoleBinding",
@@ -120,7 +122,7 @@ KIND_TO_CLS = {cls.kind: cls for cls in (
     objs.Secret, objs.ConfigMap, objs.ServiceAccount, objs.DaemonSet,
     objs.CronJob, objs.HorizontalPodAutoscaler, objs.PodDisruptionBudget,
     objs.APIService, objs.PodGroup, objs.NodeGroup, objs.PriorityClass,
-    objs.FlowSchema, objs.PriorityLevelConfiguration,
+    objs.FlowSchema, objs.PriorityLevelConfiguration, objs.AlertRule,
     objs.Role, objs.ClusterRole,
     objs.RoleBinding, objs.ClusterRoleBinding,
     objs.CertificateSigningRequest)}
@@ -872,7 +874,7 @@ class APIServer:
         "CustomResourceDefinition", "APIService", "Cluster",
         "ClusterRole", "ClusterRoleBinding",
         "CertificateSigningRequest",
-        "FlowSchema", "PriorityLevelConfiguration"})
+        "FlowSchema", "PriorityLevelConfiguration", "AlertRule"})
 
     def _discovery(self, method: str, path: str):
         """-> (status, payload) for discovery paths, else None."""

@@ -52,6 +52,8 @@ def validate(obj: Any) -> None:
         _validate_flowschema(obj)
     elif kind == "PriorityLevelConfiguration":
         _validate_prioritylevel(obj)
+    elif kind == "AlertRule":
+        _validate_alertrule(obj)
 
 
 def _validate_quantities(where: str, quantities: dict) -> dict:
@@ -201,6 +203,44 @@ def _validate_prioritylevel(obj) -> None:
         raise ValidationError(
             f"spec.handSize: must be between 1 and spec.queues "
             f"({hand} vs {queues})")
+
+
+# alert names render in Events/alert payloads CamelCase, Prometheus-style
+_ALERT_NAME_RE = re.compile(r"^[A-Z][a-zA-Z0-9]*$")
+
+
+def _validate_alertrule(obj) -> None:
+    record = obj.spec.get("record", "") or ""
+    alert = obj.spec.get("alert", "") or ""
+    if bool(record) == bool(alert):
+        raise ValidationError(
+            "spec: exactly one of spec.record or spec.alert is required")
+    if alert and not _ALERT_NAME_RE.match(alert):
+        raise ValidationError(
+            f"spec.alert: invalid value {alert!r}: must be CamelCase "
+            f"([A-Z][a-zA-Z0-9]*)")
+    expr = obj.spec.get("expr", "") or ""
+    if not expr:
+        raise ValidationError("spec.expr: required")
+    # the rule engine owns the grammar: reject at admission what the
+    # Monitor could never evaluate (lazy import — validation must not
+    # drag the monitor in for every other kind)
+    from kubernetes_tpu.obs.monitor import QueryError, parse_query
+    try:
+        parse_query(expr)
+    except QueryError as exc:
+        raise ValidationError(f"spec.expr: {exc}")
+    try:
+        for_s = float(obj.spec.get("for", 0) or 0)
+    except (TypeError, ValueError):
+        raise ValidationError(
+            f"spec.for: invalid value {obj.spec.get('for')!r}")
+    if for_s < 0:
+        raise ValidationError("spec.for: must be >= 0")
+    for key in ("labels", "annotations"):
+        val = obj.spec.get(key)
+        if val is not None and not isinstance(val, dict):
+            raise ValidationError(f"spec.{key}: must be a string map")
 
 
 def _validate_workload(obj) -> None:

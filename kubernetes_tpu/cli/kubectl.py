@@ -77,6 +77,7 @@ ALIASES = {
     "clusterrole": "clusterroles",
     "rolebinding": "rolebindings",
     "clusterrolebinding": "clusterrolebindings",
+    "alertrule": "alertrules",
 }
 
 
@@ -160,6 +161,11 @@ def _row(kind: str, obj, wide: bool) -> list[str]:
     if kind == "NodeGroup":
         return [obj.metadata.name, str(obj.min_size), str(obj.max_size),
                 str(obj.target_size), str(obj.ready_nodes), _age(obj)]
+    if kind == "AlertRule":
+        expr = obj.expr if len(obj.expr) <= 44 else obj.expr[:41] + "..."
+        return [obj.metadata.name,
+                "alert" if obj.alert else "record", expr,
+                f"{obj.for_s:g}s" if obj.alert else "-", _age(obj)]
     return [obj.metadata.name, _age(obj)]
 
 
@@ -178,6 +184,7 @@ HEADERS = {
     "PodGroup": ["NAME", "PHASE", "PLACED", "AGE"],
     "PriorityClass": ["NAME", "VALUE", "GLOBAL-DEFAULT", "AGE"],
     "NodeGroup": ["NAME", "MIN", "MAX", "TARGET", "READY", "AGE"],
+    "AlertRule": ["NAME", "TYPE", "EXPR", "FOR", "AGE"],
 }
 
 
@@ -188,6 +195,76 @@ def print_table(rows: list[list[str]], headers: list[str]) -> None:
     print(fmt.format(*headers))
     for r in rows:
         print(fmt.format(*r))
+
+
+def _monitor_url(client) -> str | None:
+    from kubernetes_tpu.obs.monitor import find_monitor_url
+
+    return find_monitor_url(client)
+
+
+def _monitor_get(url: str, path: str) -> dict | None:
+    """GET {url}{path} from the published monitor; parsed JSON, or None
+    when the monitor is unreachable / answers non-200."""
+    import http.client
+
+    u = urlsplit(url)
+    try:
+        conn = http.client.HTTPConnection(u.hostname, u.port or 80,
+                                          timeout=5.0)
+        conn.request("GET", path)
+        resp = conn.getresponse()
+        body = resp.read()
+        conn.close()
+        if resp.status != 200:
+            return None
+        return json.loads(body)
+    except (OSError, ValueError):
+        return None
+
+
+def _monitor_query(url: str, expr: str) -> list[tuple[dict, float]] | None:
+    """Instant-vector query against the monitor's /query endpoint."""
+    from urllib.parse import quote
+
+    doc = _monitor_get(url, f"/query?query={quote(expr)}")
+    if not doc or doc.get("status") != "success":
+        return None
+    return [(d.get("labels", {}), d.get("value", 0.0))
+            for d in doc.get("data", [])]
+
+
+def _cmd_get_alerts(client, args) -> int:
+    """`kubectl get alerts` — live alert state from the running monitor
+    (not a store resource; the store holds AlertRule specs, the monitor
+    holds which ones currently fire)."""
+    url = _monitor_url(client)
+    if url is None:
+        print("error: no monitor is running (kube-system/monitor "
+              "Endpoints not published); alert state lives in the "
+              "monitor, not the store", file=sys.stderr)
+        return 1
+    doc = _monitor_get(url, "/alerts")
+    if doc is None:
+        print(f"error: monitor at {url} did not answer /alerts",
+              file=sys.stderr)
+        return 1
+    if args.output == "json":
+        print(json.dumps(doc, indent=2))
+        return 0
+    rows, now = [], time.time()
+    for a in doc.get("alerts", []):
+        labels = ",".join(f"{k}={v}"
+                          for k, v in sorted(a.get("labels", {}).items()))
+        since = a.get("since")
+        value = a.get("value")
+        rows.append([a.get("alert", "?"), a.get("state", "?"),
+                     "<none>" if value is None else f"{value:g}",
+                     labels or "<none>",
+                     f"{max(0, int(now - since))}s" if since
+                     else "<unknown>"])
+    print_table(rows, ["NAME", "STATE", "VALUE", "LABELS", "SINCE"])
+    return 0
 
 
 def cmd_get(client, args) -> int:
@@ -206,6 +283,8 @@ def cmd_get(client, args) -> int:
         print("error: resource type required (or use --raw)",
               file=sys.stderr)
         return 1
+    if args.resource.lower() in ("alert", "alerts"):
+        return _cmd_get_alerts(client, args)
     plural = resolve_resource(args.resource)
     kind = RESOURCES[plural]
     ns = None if args.all_namespaces else args.namespace
@@ -700,53 +779,80 @@ def cmd_edit(client, args) -> int:
 
 
 def cmd_top(client, args) -> int:
-    """kubectl top node|pod. The reference reads heapster metrics
-    (top_node.go); at hollow fidelity the 'usage' signal is the
-    scheduler's own accounting — summed pod requests per node (plus the
+    """kubectl top node|pod. With a monitor running (its URL published on
+    the kube-system/monitor Endpoints), usage is live: the kubelet
+    /stats/summary -> Monitor TSDB pipeline queried over /query — the
+    metrics-server path of the reference (top_node.go). Without one, fall
+    back to the hollow stand-in: summed pod requests per node (plus the
     eviction manager's usage annotations for pods that carry them)."""
     from kubernetes_tpu.agent.eviction import pod_memory_usage_mib
     from kubernetes_tpu.api.quantity import parse_quantity
 
     what = resolve_resource(args.resource)
+    url = _monitor_url(client)
     if what == "nodes":
-        pods = client.list("Pod")
-        by_node: dict[str, dict] = {}
-        for pod in pods:
-            if not pod.spec.node_name \
-                    or pod.status.phase in ("Succeeded", "Failed"):
-                continue
-            agg = by_node.setdefault(pod.spec.node_name,
-                                     {"cpu": 0.0, "mem": 0.0})
-            for c in pod.spec.containers:
-                if "cpu" in c.requests:
-                    agg["cpu"] += parse_quantity(c.requests["cpu"])
-                if "memory" in c.requests:
-                    agg["mem"] += parse_quantity(c.requests["memory"])
+        cpu = mem = None
+        if url is not None:
+            vec = _monitor_query(url, "node_cpu_usage_cores")
+            if vec:
+                cpu = {lbl.get("node", ""): v for lbl, v in vec}
+                mem = {lbl.get("node", ""): v for lbl, v in
+                       _monitor_query(url, "node_memory_usage_mib") or []}
+        if cpu is None:
+            cpu, mem = {}, {}
+            for pod in client.list("Pod"):
+                if not pod.spec.node_name \
+                        or pod.status.phase in ("Succeeded", "Failed"):
+                    continue
+                name = pod.spec.node_name
+                # parse_quantity returns Fraction; keep the aggregate float
+                cpu[name] = cpu.get(name, 0.0) + float(sum(
+                    parse_quantity(c.requests["cpu"])
+                    for c in pod.spec.containers if "cpu" in c.requests))
+                mem[name] = mem.get(name, 0.0) + float(sum(
+                    parse_quantity(c.requests["memory"])
+                    for c in pod.spec.containers
+                    if "memory" in c.requests)) / (1 << 20)
         print(f"{'NAME':24} {'CPU(cores)':>12} {'CPU%':>6} "
               f"{'MEMORY(Mi)':>12} {'MEM%':>6}")
         for node in client.list("Node"):
-            agg = by_node.get(node.metadata.name, {"cpu": 0.0, "mem": 0.0})
+            name = node.metadata.name
             cap_cpu = parse_quantity(
                 str(node.status.allocatable.get("cpu", "0")))
             cap_mem = parse_quantity(
                 str(node.status.allocatable.get("memory", "0")))
-            cpu_pct = 100 * agg["cpu"] / cap_cpu if cap_cpu else 0
-            mem_pct = 100 * agg["mem"] / cap_mem if cap_mem else 0
-            print(f"{node.metadata.name:24} {agg['cpu']:>11.2f} "
-                  f"{cpu_pct:>5.0f}% {agg['mem'] / (1 << 20):>12.0f} "
-                  f"{mem_pct:>5.0f}%")
+            used_cpu = cpu.get(name, 0.0)
+            used_mib = mem.get(name, 0.0)
+            cpu_pct = 100 * used_cpu / cap_cpu if cap_cpu else 0
+            mem_pct = 100 * used_mib * (1 << 20) / cap_mem if cap_mem else 0
+            print(f"{name:24} {used_cpu:>11.2f} {cpu_pct:>5.0f}% "
+                  f"{used_mib:>12.0f} {mem_pct:>5.0f}%")
         return 0
     if what == "pods":
+        cpu = mem = None
+        if url is not None:
+            vec = _monitor_query(
+                url, f'pod_cpu_usage_cores{{namespace="{args.namespace}"}}')
+            if vec:
+                cpu = {lbl.get("pod", ""): v for lbl, v in vec}
+                mem = {lbl.get("pod", ""): v for lbl, v in _monitor_query(
+                    url, f'pod_memory_usage_mib'
+                         f'{{namespace="{args.namespace}"}}') or []}
         print(f"{'NAME':32} {'CPU(cores)':>12} {'MEMORY(Mi)':>12}")
         for pod in client.list("Pod", namespace=args.namespace):
             if pod.status.phase in ("Succeeded", "Failed"):
                 continue
-            # parse_quantity returns Fraction, which float-format rejects
-            cpu = float(sum(parse_quantity(c.requests["cpu"])
-                            for c in pod.spec.containers
-                            if "cpu" in c.requests))
-            print(f"{pod.metadata.name:32} {cpu:>11.2f} "
-                  f"{pod_memory_usage_mib(pod):>12.0f}")
+            name = pod.metadata.name
+            if cpu is not None and name in cpu:
+                used_cpu, used_mib = cpu[name], mem.get(name, 0.0)
+            else:
+                # parse_quantity returns Fraction, which float-format
+                # rejects
+                used_cpu = float(sum(parse_quantity(c.requests["cpu"])
+                                     for c in pod.spec.containers
+                                     if "cpu" in c.requests))
+                used_mib = pod_memory_usage_mib(pod)
+            print(f"{name:32} {used_cpu:>11.2f} {used_mib:>12.0f}")
         return 0
     print("error: top supports nodes|pods", file=sys.stderr)
     return 1

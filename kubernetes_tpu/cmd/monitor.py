@@ -1,0 +1,141 @@
+"""The standalone monitoring-plane binary.
+
+The metrics-server + prometheus + alertmanager trio of a reference
+cluster, collapsed into one leader-electable process: discover scrape
+targets (Nodes' kubelet endpoints from the store, plus any --target),
+pull their 0.0.4 exposition on a jittered interval into the bounded
+in-memory TSDB, evaluate recording/alerting rules (built-in SLO rules +
+AlertRule objects from the store), and serve the query/alert API on the
+obs mux:
+
+    python -m kubernetes_tpu.cmd.monitor \
+        --apiserver http://127.0.0.1:8080 --leader-elect \
+        --target scheduler=http://127.0.0.1:10251 \
+        --target apiserver=http://127.0.0.1:8080
+
+The serving URL is published on the kube-system/monitor Endpoints
+object so HPA's MonitorMetrics, `kubectl top` and `kubectl get alerts`
+can find it (obs.monitor.find_monitor_url).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+import os
+import socket
+import sys
+from urllib.parse import urlsplit
+
+log = logging.getLogger(__name__)
+
+
+def parse_args(argv=None) -> argparse.Namespace:
+    p = argparse.ArgumentParser(
+        prog="kubernetes-tpu-monitor",
+        description="monitoring plane (fleet scraper, TSDB, SLO alerting)")
+    p.add_argument("--apiserver", required=True,
+                   help="HTTP apiserver URL (apiserver.http.APIServer)")
+    p.add_argument("--token", default=os.environ.get("KUBE_TOKEN", ""),
+                   help="bearer token for an authn-enabled apiserver "
+                        "(env KUBE_TOKEN)")
+    p.add_argument("--leader-elect", action="store_true")
+    p.add_argument("--port", type=int, default=10270,
+                   help="serve /metrics /healthz /alerts /query here "
+                        "(0 = ephemeral)")
+    p.add_argument("--lock-object-name", default="monitor")
+    p.add_argument("--lock-object-namespace", default="kube-system")
+    p.add_argument("--target", action="append", default=[],
+                   metavar="JOB=URL",
+                   help="static scrape target (repeatable), e.g. "
+                        "scheduler=http://127.0.0.1:10251")
+    p.add_argument("--scrape-interval", type=float, default=15.0)
+    p.add_argument("--scrape-timeout", type=float, default=2.0)
+    p.add_argument("--retention-samples", type=int, default=600,
+                   help="ring-buffer depth per series")
+    p.add_argument("--max-series", type=int, default=20000)
+    p.add_argument("--alert-for", type=float, default=0.0,
+                   help="default for-duration of the built-in SLO alerts")
+    p.add_argument("--lease-duration", type=float, default=15.0)
+    p.add_argument("--renew-deadline", type=float, default=10.0)
+    p.add_argument("--retry-period", type=float, default=2.0)
+    return p.parse_args(argv)
+
+
+async def run(args: argparse.Namespace) -> None:
+    from kubernetes_tpu.apiserver.http import RemoteStore
+    from kubernetes_tpu.obs.monitor import Monitor
+
+    url = urlsplit(args.apiserver)
+    store = RemoteStore(url.hostname, url.port or 80, token=args.token)
+    monitor = Monitor(
+        store,
+        interval=args.scrape_interval,
+        scrape_timeout=args.scrape_timeout,
+        retention_samples=args.retention_samples,
+        max_series=args.max_series,
+        alert_for_s=args.alert_for)
+    for spec in args.target:
+        job, _, target_url = spec.partition("=")
+        if not job or not target_url:
+            raise SystemExit(f"--target wants JOB=URL, got {spec!r}")
+        monitor.add_static_target(job, target_url)
+
+    from kubernetes_tpu.obs.http import ObsServer
+
+    obs = ObsServer(registry=monitor.registry,
+                    ready_checks={"scraped-once":
+                                  lambda: monitor.tsdb.series_count() > 0
+                                  or not monitor.targets()},
+                    port=args.port, monitor=monitor)
+    try:
+        await obs.start()
+        log.info("monitor API on %s", obs.url)
+        monitor.publish(obs.url)
+    except OSError as e:
+        log.warning("monitor API disabled (port %d unavailable: %s)",
+                    args.port, e)
+        obs = None
+
+    async def lead():
+        await monitor.start()
+        log.info("monitor scraping %d static targets + store nodes "
+                 "every %.1fs", len(args.target), args.scrape_interval)
+        await asyncio.Event().wait()
+
+    try:
+        if args.leader_elect:
+            from kubernetes_tpu.client.leaderelection import LeaderElector
+
+            elector = LeaderElector(
+                store, f"{socket.gethostname()}_{os.getpid()}",
+                lock_name=args.lock_object_name,
+                lock_namespace=args.lock_object_namespace,
+                lease_duration=args.lease_duration,
+                renew_deadline=args.renew_deadline,
+                retry_period=args.retry_period,
+                on_started_leading=lead)
+            await elector.run()
+            log.warning("lost leader lease; exiting")
+        else:
+            await lead()
+    finally:
+        monitor.stop()
+        if obs is not None:
+            await obs.stop()
+
+
+def main(argv=None) -> int:
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(levelname).1s %(name)s: %(message)s")
+    try:
+        asyncio.run(run(parse_args(argv)))
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -80,6 +80,37 @@ class AnnotationMetrics:
         return out
 
 
+class MonitorMetrics:
+    """The real resource-metrics pipeline: query an in-process Monitor's
+    TSDB for `pod_cpu_usage_ratio` — the series its scraper ingests from
+    kubelet /stats/summary — and fall back to the annotation stand-in
+    when no Monitor runs (or it has not scraped usage yet). The TSDB read
+    is an in-memory instant lookup: zero I/O per sync, per the
+    MetricsSource contract. Pods whose kubelet reports no live cpu sample
+    are absent from the result, so the controller's skip-on-incomplete-
+    coverage guard keeps holding."""
+
+    def __init__(self, monitor=None, fallback: MetricsSource | None = None):
+        self.monitor = monitor
+        self.fallback = fallback if fallback is not None \
+            else AnnotationMetrics()
+
+    def utilization(self, namespace: str, pods: list) -> dict[str, float]:
+        if self.monitor is not None:
+            try:
+                vec = self.monitor.query(
+                    f'pod_cpu_usage_ratio{{namespace="{namespace}"}}')
+            except Exception:  # noqa: BLE001 — no data -> fallback
+                vec = []
+            names = {p.metadata.name for p in pods}
+            usage = {lbl["pod"]: v for lbl, v in vec
+                     if lbl.get("pod") in names}
+            if usage:
+                return usage
+        return self.fallback.utilization(namespace, pods) \
+            if self.fallback is not None else {}
+
+
 class StaticMetrics:
     """Test/hollow metrics source: explicit per-pod utilization, with an
     optional default for unknown pods. default=None reports nothing for

@@ -26,8 +26,18 @@ class ControllerManager:
                  cloud=None, hpa_metrics=None,
                  podgc_threshold: int | None = None,
                  enable_autoscaler: bool = True,
-                 autoscaler_kwargs: dict | None = None):
+                 autoscaler_kwargs: dict | None = None,
+                 enable_monitor: bool = False,
+                 monitor_kwargs: dict | None = None):
         self.store = store
+        # embedded monitoring plane (obs/monitor.py): scrapes the store's
+        # kubelet endpoints + the process registry, and becomes the HPA's
+        # resource-metrics source unless the caller injected one
+        self.monitor = None
+        if enable_monitor:
+            from kubernetes_tpu.obs.monitor import Monitor
+
+            self.monitor = Monitor(store, **(monitor_kwargs or {}))
         self.informers: dict[str, Informer] = {
             kind: Informer(store, kind)
             for kind in ("Pod", "Node", "Service", "ReplicaSet",
@@ -65,6 +75,7 @@ class ControllerManager:
         from kubernetes_tpu.controllers.disruption import DisruptionController
         from kubernetes_tpu.controllers.hpa import (
             HorizontalController,
+            MonitorMetrics,
             StaticMetrics,
         )
         from kubernetes_tpu.controllers.quota import ResourceQuotaController
@@ -81,9 +92,15 @@ class ControllerManager:
         self.ttl = TTLController(store, self.informers["Node"])
         self.disruption = DisruptionController(
             store, self.informers["PodDisruptionBudget"], pods)
+        if hpa_metrics is None:
+            # with an embedded monitor the HPA reads live usage from its
+            # TSDB (annotation fallback inside); without one the hollow
+            # StaticMetrics stand-in stays, as before
+            hpa_metrics = MonitorMetrics(self.monitor) \
+                if self.monitor is not None else StaticMetrics()
         self.hpa = HorizontalController(
             store, self.informers["HorizontalPodAutoscaler"], pods,
-            hpa_metrics if hpa_metrics is not None else StaticMetrics())
+            hpa_metrics)
         self.cronjob = CronJobController(
             store, self.informers["CronJob"], self.informers["Job"])
         self.daemonset = DaemonSetController(
@@ -182,6 +199,8 @@ class ControllerManager:
             await informer.wait_for_sync()
         for controller in self.controllers:
             await controller.start()
+        if self.monitor is not None:
+            await self.monitor.start()
         # reconcile pre-existing objects that predate the watch
         for obj in self.informers["ReplicaSet"].items():
             self.replicaset.enqueue(obj.key)
@@ -208,6 +227,8 @@ class ControllerManager:
             self.pv_binder.enqueue(obj.key)
 
     def stop(self) -> None:
+        if self.monitor is not None:
+            self.monitor.stop()
         for controller in self.controllers:
             controller.stop()
         for informer in self.informers.values():

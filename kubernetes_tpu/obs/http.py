@@ -27,7 +27,10 @@ JSON_CONTENT_TYPE = "application/json"
 Check = Callable[[], bool]
 
 TRACE_PATH = "/debug/traces"
-OBS_PATHS = ("/metrics", "/healthz", "/readyz", "/livez", TRACE_PATH)
+ALERTS_PATH = "/alerts"
+QUERY_PATH = "/query"
+OBS_PATHS = ("/metrics", "/healthz", "/readyz", "/livez", TRACE_PATH,
+             ALERTS_PATH, QUERY_PATH)
 
 
 def _run_checks(checks: Mapping[str, Check] | None
@@ -51,9 +54,11 @@ def obs_response(method: str, path: str,
                  ready_checks: Mapping[str, Check] | None = None,
                  degraded_checks: Mapping[str, Check] | None = None,
                  extra_text: Callable[[], str] | None = None,
+                 monitor=None,
                  ) -> tuple[int, bytes, str] | None:
     """-> (status, body, content-type) for the obs endpoints (/metrics,
-    health checks, /debug/traces), or
+    health checks, /debug/traces, and — on monitor-hosting components —
+    /alerts and /query), or
     None when `path` is not one of them (the caller routes on). Any
     method but GET on an obs path gets 405. `extra_text` appends
     component-local exposition after the registry render (the scheduler's
@@ -61,12 +66,35 @@ def obs_response(method: str, path: str,
     failing it: a degraded component (e.g. the scheduler running its
     serial fallback while pods are quarantined) is alive and must not be
     restarted by a liveness probe — the check names are annotated in the
-    200 body instead."""
+    200 body instead. `monitor` is an obs.monitor.Monitor: /alerts serves
+    its alert states, /query evaluates ?query= instant-vector expressions
+    (components without one fall through to their own 404)."""
+    raw = path
     path = path.split("?", 1)[0].rstrip("/") or "/"
     if path not in OBS_PATHS:
         return None
+    if path in (ALERTS_PATH, QUERY_PATH) and monitor is None:
+        return None
     if method != "GET":
         return 405, b"method not allowed", TEXT_CONTENT_TYPE
+    if path == ALERTS_PATH:
+        return (200, json.dumps(monitor.alerts_payload()).encode(),
+                JSON_CONTENT_TYPE)
+    if path == QUERY_PATH:
+        import urllib.parse
+        qs = raw.split("?", 1)[1] if "?" in raw else ""
+        params = urllib.parse.parse_qs(qs)
+        expr = (params.get("query") or [""])[0]
+        try:
+            at = float(params["time"][0]) if "time" in params else None
+            vec = monitor.query(expr, now=at)
+        except Exception as exc:  # noqa: BLE001 — bad query -> 400
+            body = json.dumps({"status": "error", "error": str(exc)})
+            return 400, body.encode(), JSON_CONTENT_TYPE
+        body = json.dumps({"status": "success",
+                           "data": [{"labels": lbl, "value": v}
+                                    for lbl, v in vec]})
+        return 200, body.encode(), JSON_CONTENT_TYPE
     if path == TRACE_PATH:
         payload = _tracing.TRACER.debug_payload()
         return 200, json.dumps(payload).encode(), JSON_CONTENT_TYPE
@@ -90,7 +118,8 @@ def obs_response(method: str, path: str,
 def http_head(status: int, body: bytes, content_type: str,
               keep_alive: bool = False) -> bytes:
     """A full HTTP/1.1 response for hand-rolled asyncio servers."""
-    reason = {200: "OK", 404: "Not Found", 405: "Method Not Allowed",
+    reason = {200: "OK", 400: "Bad Request", 404: "Not Found",
+              405: "Method Not Allowed",
               503: "Service Unavailable"}.get(status, "Error")
     conn = "keep-alive" if keep_alive else "close"
     return (f"HTTP/1.1 {status} {reason}\r\n"
@@ -106,12 +135,14 @@ class ObsServer:
     def __init__(self, registry: _metrics.Registry | None = None,
                  health_checks: Mapping[str, Check] | None = None,
                  ready_checks: Mapping[str, Check] | None = None,
-                 host: str = "127.0.0.1", port: int = 0):
+                 host: str = "127.0.0.1", port: int = 0,
+                 monitor=None):
         self.registry = registry
         self.health_checks = health_checks
         self.ready_checks = ready_checks
         self.host = host
         self.port = port
+        self.monitor = monitor
         self._server: asyncio.AbstractServer | None = None
 
     @property
@@ -145,7 +176,8 @@ class ObsServer:
                     break
             resp = obs_response(method, target, registry=self.registry,
                                 health_checks=self.health_checks,
-                                ready_checks=self.ready_checks)
+                                ready_checks=self.ready_checks,
+                                monitor=self.monitor)
             if resp is None:
                 resp = (404, b"not found", TEXT_CONTENT_TYPE)
             writer.write(http_head(*resp))

@@ -246,7 +246,19 @@ class Registry:
                         f"metric {name!r} already registered as "
                         f"{fam.kind}{fam.labelnames}, not "
                         f"{kind}{labelnames}")
+                # a family's bucket layout is fixed at first registration:
+                # re-registering with different EXPLICIT bounds would split
+                # one family's observations across incompatible layouts and
+                # silently corrupt every histogram_quantile over it, so it's
+                # an error; omitting buckets keeps get-or-create semantics
+                if kind == "histogram" and buckets is not None \
+                        and fam.buckets != buckets:
+                    raise ValueError(
+                        f"metric {name!r} already registered with buckets "
+                        f"{fam.buckets}, not {buckets}")
                 return fam
+            if kind == "histogram" and buckets is None:
+                buckets = DEFAULT_BUCKETS
             fam = Family(name, help_text, kind, labelnames, buckets)
             self._families[name] = fam
             return fam
@@ -262,8 +274,12 @@ class Registry:
     def histogram(self, name: str, help_text: str = "",
                   labels: Iterable[str] = (),
                   buckets: Iterable[float] | None = None) -> Family:
-        bounds = tuple(sorted(buckets)) if buckets is not None \
-            else DEFAULT_BUCKETS
+        """Explicit `buckets` override the per-family boundaries at first
+        registration (latency SLO quantiles want domain-shaped layouts,
+        e.g. exponential_buckets); omitted, the family keeps
+        client_golang's DefBuckets. A later registration may omit buckets
+        (get-or-create) but passing a DIFFERENT explicit layout raises."""
+        bounds = tuple(sorted(buckets)) if buckets is not None else None
         return self._register(name, help_text, "histogram", labels,
                               buckets=bounds)
 

@@ -1,0 +1,1197 @@
+"""The monitoring plane: a fleet scraper + bounded in-memory TSDB with a
+small rule engine (recording rules, alerting rules with for-duration
+state) and an instant-vector query language.
+
+The metrics-server/Prometheus position in the reference's addon taxonomy
+(SURVEY.md: heapster -> metrics-server pipeline feeding HPA and `kubectl
+top`), built the way Borg and Monarch treat it — as core cluster
+infrastructure: the Monitor discovers targets from the store (Nodes
+publishing kubelet endpoints) plus well-known control-plane URLs, scrapes
+their 0.0.4 text exposition on a seeded-jitter interval, retains samples
+in per-series ring buffers, and continuously evaluates SLO rules whose
+firing alerts surface as Events, `/alerts`, and `kubectl get alerts`.
+
+Query language (shared by rules, the HTTP `/query` endpoint, HPA's
+MonitorMetrics source and `kubectl top`):
+
+    up{job="scheduler"} < 1
+    rate(apiserver_request_total[60s])
+    histogram_quantile(0.99, e2e_scheduling_latency_microseconds[60s])
+    sum by (flow) (rate(apiserver_flowcontrol_rejected_total[60s]))
+      / sum by (flow) (rate(apiserver_flowcontrol_dispatched_total[60s]))
+
+Semantics are the Prometheus subset this framework needs: instant
+selectors read the latest sample within `lookback_s`; rate()/increase()
+are counter-reset aware (a drop is a restart: the post-reset value counts
+in full); histogram_quantile() interpolates over the registry's own
+cumulative bucket layout; binary ops join vectors on exact label sets.
+
+Everything here is loop-friendly: scrapes of HTTP targets are async with
+a hard timeout, local (in-process) targets render synchronously, and the
+TSDB is guarded by one coarse lock so `kubectl top` arriving over HTTP
+and HPA syncing on the same loop see consistent reads.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import math
+import random
+import re
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from types import SimpleNamespace
+from typing import Callable
+
+from kubernetes_tpu.obs import metrics as _metrics
+
+MONITOR_ENDPOINT_NAME = "monitor"
+MONITOR_NAMESPACE = "kube-system"
+MONITOR_URL_ANNOTATION = "kubernetes-tpu/monitor-url"
+
+Labels = dict[str, str]
+Vector = list[tuple[Labels, float]]
+
+
+class QueryError(ValueError):
+    """Malformed or unevaluable query expression."""
+
+
+# ---------------------------------------------------------------------------
+# 0.0.4 text exposition parsing (the scrape side of obs/metrics.py render())
+
+
+def parse_exposition(text: str) -> list[tuple[str, Labels, float]]:
+    """Parse text exposition 0.0.4 into (name, labels, value) samples.
+    Comment/HELP/TYPE lines are skipped; label values un-escape the
+    backslash/quote/newline sequences render() emits."""
+    out: list[tuple[str, Labels, float]] = []
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        try:
+            out.append(_parse_sample_line(line))
+        except ValueError:
+            continue  # one mangled line must not poison the scrape
+    return out
+
+
+def _parse_sample_line(line: str) -> tuple[str, Labels, float]:
+    i = 0
+    n = len(line)
+    while i < n and (line[i].isalnum() or line[i] in "_:"):
+        i += 1
+    name = line[:i]
+    if not name:
+        raise ValueError(f"no metric name in {line!r}")
+    labels: Labels = {}
+    if i < n and line[i] == "{":
+        i += 1
+        while True:
+            while i < n and line[i] in ", ":
+                i += 1
+            if i < n and line[i] == "}":
+                i += 1
+                break
+            j = i
+            while j < n and line[j] not in "=}":
+                j += 1
+            key = line[i:j].strip()
+            if j >= n or line[j] != "=":
+                raise ValueError(f"bad label pair in {line!r}")
+            j += 1
+            if j >= n or line[j] != '"':
+                raise ValueError(f"unquoted label value in {line!r}")
+            j += 1
+            buf: list[str] = []
+            while j < n and line[j] != '"':
+                if line[j] == "\\" and j + 1 < n:
+                    nxt = line[j + 1]
+                    buf.append({"n": "\n", "\\": "\\", '"': '"'}
+                               .get(nxt, "\\" + nxt))
+                    j += 2
+                else:
+                    buf.append(line[j])
+                    j += 1
+            if j >= n:
+                raise ValueError(f"unterminated label value in {line!r}")
+            labels[key] = "".join(buf)
+            i = j + 1
+    rest = line[i:].split()
+    if not rest:
+        raise ValueError(f"no value in {line!r}")
+    return name, labels, float(rest[0])
+
+
+# ---------------------------------------------------------------------------
+# TSDB: bounded per-series ring buffers
+
+
+class _Series:
+    __slots__ = ("name", "labels", "samples", "last_t")
+
+    def __init__(self, name: str, labels: Labels, retention: int):
+        self.name = name
+        self.labels = labels
+        self.samples: deque[tuple[float, float]] = deque(maxlen=retention)
+        self.last_t = -math.inf
+
+    def add(self, t: float, v: float) -> None:
+        self.samples.append((t, v))
+        self.last_t = t
+
+
+class TSDB:
+    """Bounded in-memory time-series store.
+
+    Per-series ring buffers (`retention_samples` deep — memory is
+    series x retention, a hard ceiling) keyed by name + sorted label
+    pairs. When `max_series` is hit, the least-recently-updated series is
+    evicted to admit the new one; `gc()` drops series whose latest sample
+    is older than the staleness horizon (the target disappeared)."""
+
+    def __init__(self, retention_samples: int = 600,
+                 max_series: int = 20000):
+        self.retention_samples = int(retention_samples)
+        self.max_series = int(max_series)
+        self.evictions = 0
+        self._series: dict[tuple[str, tuple], _Series] = {}
+        self._by_name: dict[str, set[tuple[str, tuple]]] = {}
+        self._lock = threading.Lock()
+
+    def add(self, name: str, labels: Labels, value: float,
+            t: float) -> None:
+        key = (name, tuple(sorted(labels.items())))
+        with self._lock:
+            s = self._series.get(key)
+            if s is None:
+                if len(self._series) >= self.max_series:
+                    self._evict_locked()
+                s = _Series(name, dict(labels), self.retention_samples)
+                self._series[key] = s
+                self._by_name.setdefault(name, set()).add(key)
+            s.add(t, float(value))
+
+    def _evict_locked(self) -> None:
+        victim = min(self._series, key=lambda k: self._series[k].last_t)
+        self._drop_locked(victim)
+        self.evictions += 1
+
+    def _drop_locked(self, key: tuple[str, tuple]) -> None:
+        s = self._series.pop(key)
+        names = self._by_name.get(s.name)
+        if names is not None:
+            names.discard(key)
+            if not names:
+                del self._by_name[s.name]
+
+    def gc(self, now: float, staleness_s: float) -> int:
+        """Drop series with no sample newer than `now - staleness_s`."""
+        horizon = now - staleness_s
+        with self._lock:
+            stale = [k for k, s in self._series.items()
+                     if s.last_t < horizon]
+            for k in stale:
+                self._drop_locked(k)
+        return len(stale)
+
+    def _match_locked(self, name: str,
+                      matchers: list[tuple[str, str, str]]) -> list[_Series]:
+        out = []
+        for key in self._by_name.get(name, ()):
+            s = self._series[key]
+            ok = True
+            for lbl, op, val in matchers:
+                have = s.labels.get(lbl, "")
+                if (op == "=" and have != val) or \
+                        (op == "!=" and have == val):
+                    ok = False
+                    break
+            if ok:
+                out.append(s)
+        return out
+
+    def instant(self, name: str, matchers: list[tuple[str, str, str]],
+                now: float, lookback_s: float) -> Vector:
+        """Latest sample per matching series within the lookback window."""
+        out: Vector = []
+        with self._lock:
+            for s in self._match_locked(name, matchers):
+                for t, v in reversed(s.samples):
+                    if t <= now:
+                        if t >= now - lookback_s:
+                            out.append((dict(s.labels), v))
+                        break
+        return out
+
+    def window(self, name: str, matchers: list[tuple[str, str, str]],
+               window_s: float, now: float
+               ) -> list[tuple[Labels, list[tuple[float, float]]]]:
+        """All samples per matching series inside [now - window_s, now]."""
+        lo = now - window_s
+        out = []
+        with self._lock:
+            for s in self._match_locked(name, matchers):
+                pts = [(t, v) for t, v in s.samples if lo <= t <= now]
+                if pts:
+                    out.append((dict(s.labels), pts))
+        return out
+
+    def series_count(self) -> int:
+        with self._lock:
+            return len(self._series)
+
+    def sample_count(self) -> int:
+        with self._lock:
+            return sum(len(s.samples) for s in self._series.values())
+
+
+def counter_increase(samples: list[tuple[float, float]]) -> float:
+    """Counter-reset-aware increase over a sample window: a drop means the
+    target restarted from zero, so the post-reset value counts in full
+    (Prometheus extrapolation is skipped — rules divide by the window)."""
+    inc = 0.0
+    prev = None
+    for _t, v in samples:
+        if prev is not None:
+            inc += v - prev if v >= prev else v
+        prev = v
+    return inc
+
+
+# ---------------------------------------------------------------------------
+# Query language: tokenizer + recursive-descent parser -> tuple AST
+
+_TOKEN_RE = re.compile(
+    r"\s*(?:(?P<number>\d+\.?\d*(?:[eE][+-]?\d+)?)"
+    r"|(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"|(?P<string>\"(?:\\.|[^\"\\])*\")"
+    r"|(?P<op><=|>=|==|!=|[-+*/(){}\[\],=<>]))")
+
+_AGG_OPS = ("sum", "avg", "min", "max", "count")
+_RANGE_FUNCS = ("rate", "increase")
+_DURATION_UNITS = {"ms": 1e-3, "s": 1.0, "m": 60.0, "h": 3600.0}
+
+
+def _tokenize(text: str) -> list[tuple[str, str]]:
+    tokens: list[tuple[str, str]] = []
+    pos = 0
+    while pos < len(text):
+        m = _TOKEN_RE.match(text, pos)
+        if m is None:
+            if text[pos:].strip():
+                raise QueryError(f"bad token at {text[pos:pos + 20]!r}")
+            break
+        pos = m.end()
+        for kind in ("number", "name", "string", "op"):
+            val = m.group(kind)
+            if val is not None:
+                tokens.append((kind, val))
+                break
+    return tokens
+
+
+class _Parser:
+    def __init__(self, tokens: list[tuple[str, str]]):
+        self._tokens = tokens
+        self._i = 0
+
+    def _peek(self, ahead: int = 0) -> tuple[str, str]:
+        j = self._i + ahead
+        return self._tokens[j] if j < len(self._tokens) else ("eof", "")
+
+    def _next(self) -> tuple[str, str]:
+        tok = self._peek()
+        self._i += 1
+        return tok
+
+    def _expect(self, value: str) -> None:
+        kind, val = self._next()
+        if val != value:
+            raise QueryError(f"expected {value!r}, got {val or kind!r}")
+
+    def parse(self) -> tuple:
+        node = self._comparison()
+        if self._peek()[0] != "eof":
+            raise QueryError(
+                f"trailing input at {self._peek()[1]!r}")
+        return node
+
+    def _comparison(self) -> tuple:
+        node = self._additive()
+        kind, val = self._peek()
+        if val in (">", "<", ">=", "<=", "==", "!="):
+            self._next()
+            node = ("bin", val, node, self._additive())
+        return node
+
+    def _additive(self) -> tuple:
+        node = self._multiplicative()
+        while self._peek()[1] in ("+", "-"):
+            op = self._next()[1]
+            node = ("bin", op, node, self._multiplicative())
+        return node
+
+    def _multiplicative(self) -> tuple:
+        node = self._unary()
+        while self._peek()[1] in ("*", "/"):
+            op = self._next()[1]
+            node = ("bin", op, node, self._unary())
+        return node
+
+    def _unary(self) -> tuple:
+        if self._peek()[1] == "-":
+            self._next()
+            return ("neg", self._unary())
+        return self._primary()
+
+    def _primary(self) -> tuple:
+        kind, val = self._peek()
+        if kind == "number":
+            self._next()
+            return ("num", float(val))
+        if val == "(":
+            self._next()
+            node = self._comparison()
+            self._expect(")")
+            return node
+        if kind != "name":
+            raise QueryError(f"unexpected {val or kind!r}")
+        if val in _AGG_OPS:
+            return self._aggregation()
+        if val in _RANGE_FUNCS or val == "histogram_quantile":
+            return self._function()
+        return self._selector()
+
+    def _aggregation(self) -> tuple:
+        op = self._next()[1]
+        by: tuple[str, ...] = ()
+        if self._peek()[1] == "by":
+            self._next()
+            self._expect("(")
+            names = []
+            while self._peek()[1] != ")":
+                k, v = self._next()
+                if k != "name":
+                    raise QueryError(f"bad grouping label {v!r}")
+                names.append(v)
+                if self._peek()[1] == ",":
+                    self._next()
+            self._expect(")")
+            by = tuple(names)
+        self._expect("(")
+        node = self._comparison()
+        self._expect(")")
+        return ("agg", op, by, node)
+
+    def _function(self) -> tuple:
+        fname = self._next()[1]
+        self._expect("(")
+        if fname == "histogram_quantile":
+            qkind, qval = self._next()
+            if qkind != "number":
+                raise QueryError("histogram_quantile needs a literal "
+                                 "quantile first")
+            self._expect(",")
+            rng = self._selector()
+            if rng[0] != "range":
+                raise QueryError("histogram_quantile needs a range "
+                                 "selector, e.g. name[60s]")
+            self._expect(")")
+            return ("quantile", float(qval), rng)
+        rng = self._selector()
+        if rng[0] != "range":
+            raise QueryError(f"{fname}() needs a range selector, "
+                             "e.g. name[60s]")
+        self._expect(")")
+        return (fname, rng)
+
+    def _selector(self) -> tuple:
+        kind, name = self._next()
+        if kind != "name":
+            raise QueryError(f"expected metric name, got {name or kind!r}")
+        matchers: list[tuple[str, str, str]] = []
+        if self._peek()[1] == "{":
+            self._next()
+            while self._peek()[1] != "}":
+                lk, lbl = self._next()
+                if lk != "name":
+                    raise QueryError(f"bad matcher label {lbl!r}")
+                op = self._next()[1]
+                if op == "==":
+                    op = "="
+                if op not in ("=", "!="):
+                    raise QueryError(f"bad matcher op {op!r}")
+                vk, vv = self._next()
+                if vk != "string":
+                    raise QueryError("matcher value must be quoted")
+                matchers.append((lbl, op, _unquote(vv)))
+                if self._peek()[1] == ",":
+                    self._next()
+            self._expect("}")
+        if self._peek()[1] == "[":
+            self._next()
+            nk, nv = self._next()
+            if nk != "number":
+                raise QueryError("range duration must be a number")
+            unit = 1.0
+            if self._peek()[0] == "name":
+                uk = self._next()[1]
+                if uk not in _DURATION_UNITS:
+                    raise QueryError(f"bad duration unit {uk!r}")
+                unit = _DURATION_UNITS[uk]
+            self._expect("]")
+            return ("range", name, matchers, float(nv) * unit)
+        return ("sel", name, matchers)
+
+
+def _unquote(tok: str) -> str:
+    body = tok[1:-1]
+    return (body.replace('\\"', '"').replace("\\n", "\n")
+            .replace("\\\\", "\\"))
+
+
+def parse_query(expr: str) -> tuple:
+    """Parse an expression into an AST for evaluate(); raises QueryError."""
+    if not expr or not expr.strip():
+        raise QueryError("empty query")
+    return _Parser(_tokenize(expr)).parse()
+
+
+# ---------------------------------------------------------------------------
+# Evaluation
+
+_CMPS: dict[str, Callable[[float, float], bool]] = {
+    ">": lambda a, b: a > b, "<": lambda a, b: a < b,
+    ">=": lambda a, b: a >= b, "<=": lambda a, b: a <= b,
+    "==": lambda a, b: a == b, "!=": lambda a, b: a != b,
+}
+_ARITH: dict[str, Callable[[float, float], float]] = {
+    "+": lambda a, b: a + b, "-": lambda a, b: a - b,
+    "*": lambda a, b: a * b,
+    "/": lambda a, b: a / b if b != 0 else math.nan,
+}
+
+
+def evaluate(node: tuple, db: TSDB, now: float,
+             lookback_s: float):
+    """Evaluate an AST -> float (scalar) or Vector. NaN samples (division
+    by zero) are dropped from vector results."""
+    kind = node[0]
+    if kind == "num":
+        return node[1]
+    if kind == "neg":
+        val = evaluate(node[1], db, now, lookback_s)
+        if isinstance(val, float):
+            return -val
+        return [(lbl, -v) for lbl, v in val]
+    if kind == "sel":
+        return db.instant(node[1], node[2], now, lookback_s)
+    if kind == "range":
+        raise QueryError("range selector only valid inside rate(), "
+                         "increase() or histogram_quantile()")
+    if kind in _RANGE_FUNCS:
+        _, name, matchers, window = node[1]
+        out: Vector = []
+        for labels, pts in db.window(name, matchers, window, now):
+            if len(pts) < 2:
+                continue
+            inc = counter_increase(pts)
+            out.append((labels, inc / window if kind == "rate" else inc))
+        return out
+    if kind == "quantile":
+        return _histogram_quantile(node[1], node[2], db, now)
+    if kind == "agg":
+        return _aggregate(node[1], node[2],
+                          _as_vector(evaluate(node[3], db, now, lookback_s)))
+    if kind == "bin":
+        return _binop(node[1],
+                      evaluate(node[2], db, now, lookback_s),
+                      evaluate(node[3], db, now, lookback_s))
+    raise QueryError(f"unknown node {kind!r}")
+
+
+def _as_vector(val) -> Vector:
+    if isinstance(val, float):
+        return [({}, val)]
+    return val
+
+
+def _aggregate(op: str, by: tuple[str, ...], vec: Vector) -> Vector:
+    groups: dict[tuple, tuple[Labels, list[float]]] = {}
+    for labels, v in vec:
+        kept = {k: labels[k] for k in by if k in labels}
+        key = tuple(sorted(kept.items()))
+        groups.setdefault(key, (kept, []))[1].append(v)
+    out: Vector = []
+    for kept, vals in groups.values():
+        if op == "sum":
+            r = sum(vals)
+        elif op == "avg":
+            r = sum(vals) / len(vals)
+        elif op == "min":
+            r = min(vals)
+        elif op == "max":
+            r = max(vals)
+        else:  # count
+            r = float(len(vals))
+        out.append((kept, r))
+    return out
+
+
+def _binop(op: str, lhs, rhs):
+    scalar_l = isinstance(lhs, float)
+    scalar_r = isinstance(rhs, float)
+    if op in _CMPS:
+        cmp = _CMPS[op]
+        if scalar_l and scalar_r:
+            return 1.0 if cmp(lhs, rhs) else 0.0
+        if scalar_r:
+            return [(lbl, v) for lbl, v in lhs if cmp(v, rhs)]
+        if scalar_l:
+            return [(lbl, v) for lbl, v in rhs if cmp(lhs, v)]
+        joined = _join(lhs, rhs)
+        return [(lbl, lv) for lbl, lv, rv in joined if cmp(lv, rv)]
+    fn = _ARITH[op]
+    if scalar_l and scalar_r:
+        return fn(lhs, rhs)
+    if scalar_r:
+        return [(lbl, fn(v, rhs)) for lbl, v in lhs
+                if not math.isnan(fn(v, rhs))]
+    if scalar_l:
+        return [(lbl, fn(lhs, v)) for lbl, v in rhs
+                if not math.isnan(fn(lhs, v))]
+    out: Vector = []
+    for lbl, lv, rv in _join(lhs, rhs):
+        r = fn(lv, rv)
+        if not math.isnan(r):
+            out.append((lbl, r))
+    return out
+
+
+def _join(lhs: Vector, rhs: Vector) -> list[tuple[Labels, float, float]]:
+    """Inner join on exact label sets (the one-to-one vector match)."""
+    index = {tuple(sorted(lbl.items())): v for lbl, v in rhs}
+    out = []
+    for lbl, lv in lhs:
+        key = tuple(sorted(lbl.items()))
+        if key in index:
+            out.append((lbl, lv, index[key]))
+    return out
+
+
+def _histogram_quantile(q: float, rng: tuple, db: TSDB,
+                        now: float) -> Vector:
+    """histogram_quantile over `name_bucket` series: per-le counter-reset-
+    aware increases within the window, grouped by labels minus `le`, then
+    linear interpolation inside the bucket holding the q-th observation
+    (the last finite bound when it lands in +Inf — the obs/metrics.py
+    Histogram.quantile contract)."""
+    _, name, matchers, window = rng
+    if not name.endswith("_bucket"):
+        name += "_bucket"
+    groups: dict[tuple, tuple[Labels, list[tuple[float, float]]]] = {}
+    for labels, pts in db.window(name, matchers, window, now):
+        if len(pts) < 2:
+            continue
+        le = labels.pop("le", None)
+        if le is None:
+            continue
+        bound = math.inf if le == "+Inf" else float(le)
+        key = tuple(sorted(labels.items()))
+        groups.setdefault(key, (labels, []))[1].append(
+            (bound, counter_increase(pts)))
+    out: Vector = []
+    for labels, buckets in groups.values():
+        buckets.sort()
+        # re-impose cumulativity: independent per-le resets can wobble it
+        cum = 0.0
+        fixed = []
+        for bound, c in buckets:
+            cum = max(cum, c)
+            fixed.append((bound, cum))
+        total = fixed[-1][1] if fixed else 0.0
+        if total <= 0:
+            continue
+        rank = q * total
+        prev_bound, prev_cum = 0.0, 0.0
+        value = fixed[-1][0]
+        for bound, c in fixed:
+            if c >= rank:
+                if math.isinf(bound):
+                    value = prev_bound
+                else:
+                    width = c - prev_cum
+                    frac = (rank - prev_cum) / width if width > 0 else 0.0
+                    value = prev_bound + (bound - prev_bound) * frac
+                break
+            prev_bound, prev_cum = (0.0 if math.isinf(bound) else bound), c
+        out.append((labels, value))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Rules
+
+
+class RecordingRule:
+    """Evaluate an expression each round and write the result back into
+    the TSDB under a new metric name (which must carry a unit/kind suffix
+    — ktpu-lint R6 holds recording rules to the same naming discipline as
+    hand-registered families)."""
+
+    def __init__(self, record: str, expr: str,
+                 labels: Labels | None = None):
+        self.record = record
+        self.expr = expr
+        self.labels = dict(labels or {})
+        self.ast = parse_query(expr)
+
+
+class AlertingRule:
+    """An alert expression with for-duration semantics: a labelset must
+    stay active `for_s` seconds (pending) before the alert fires; it
+    resolves the first round the labelset drops out of the result."""
+
+    def __init__(self, alert: str, expr: str, for_s: float = 0.0,
+                 labels: Labels | None = None,
+                 annotations: dict[str, str] | None = None):
+        self.alert = alert
+        self.expr = expr
+        self.for_s = float(for_s)
+        self.labels = dict(labels or {})
+        self.annotations = dict(annotations or {})
+        self.ast = parse_query(expr)
+
+
+def builtin_rules(window_s: float = 60.0,
+                  for_s: float = 0.0,
+                  e2e_slo_seconds: float = 1.0,
+                  apiserver_slo_seconds: float = 1.0,
+                  reject_ratio_max: float = 0.5,
+                  busy_frac_max: float = 0.95) -> list:
+    """The built-in SLO rule set: scheduler e2e p99, apiserver request p99
+    and per-APF-flow rejection burn rate, pipeline stage busy-fraction,
+    event-loop stalls, and scrape-health (`up`) for the scheduler — the
+    alert the chaos drill holds to fires-then-resolves."""
+    w = f"[{window_s:g}s]"
+    return [
+        RecordingRule(
+            "scheduler_e2e_p99_seconds",
+            f"histogram_quantile(0.99, "
+            f"e2e_scheduling_latency_microseconds{w}) / 1000000"),
+        RecordingRule(
+            "apiserver_request_p99_seconds",
+            f"histogram_quantile(0.99, "
+            f"apiserver_request_latencies_microseconds{w}) / 1000000"),
+        RecordingRule(
+            "apiserver_flow_reject_ratio",
+            f"sum by (flow) (rate(apiserver_flowcontrol_rejected_total{w}))"
+            f" / sum by (flow) "
+            f"(rate(apiserver_flowcontrol_dispatched_total{w}))"),
+        RecordingRule(
+            "scheduler_stage_busy_frac",
+            f"sum by (phase) "
+            f"(rate(scheduler_phase_duration_seconds_sum{w}))"),
+        AlertingRule(
+            "SchedulerDown", 'up{job="scheduler"} < 1', for_s=for_s,
+            annotations={"summary": "scheduler target failing scrapes"}),
+        AlertingRule(
+            "SchedulerE2ELatencyHigh",
+            f"scheduler_e2e_p99_seconds > {e2e_slo_seconds:g}", for_s=for_s,
+            annotations={"summary": "scheduler e2e p99 outside SLO"}),
+        AlertingRule(
+            "APIServerLatencyHigh",
+            f"apiserver_request_p99_seconds > {apiserver_slo_seconds:g}",
+            for_s=for_s,
+            annotations={"summary": "apiserver request p99 outside SLO"}),
+        AlertingRule(
+            "APIServerFlowSaturated",
+            f"apiserver_flow_reject_ratio > {reject_ratio_max:g}",
+            for_s=for_s,
+            annotations={"summary": "APF flow shedding beyond burn budget"}),
+        AlertingRule(
+            "SchedulerStageSaturated",
+            f"scheduler_stage_busy_frac > {busy_frac_max:g}", for_s=for_s,
+            annotations={"summary": "pipeline stage at capacity"}),
+        AlertingRule(
+            "EventLoopStalled",
+            f"increase(eventloop_stalls_total{w}) > 0", for_s=for_s,
+            annotations={"summary": "event loop held >100ms"}),
+    ]
+
+
+# ---------------------------------------------------------------------------
+# Targets + Monitor
+
+
+@dataclass
+class Target:
+    """One scrape target: either an HTTP exposition URL or an in-process
+    render callable (a component's registry in the same interpreter).
+    `summary` marks kubelets whose /stats/summary feeds the resource-
+    metrics pipeline."""
+
+    job: str
+    instance: str
+    url: str | None = None
+    render: Callable[[], str] | None = None
+    summary: bool = False
+
+
+async def _http_fetch(url: str, timeout: float) -> str:
+    """Minimal asyncio HTTP GET. A body shorter than Content-Length (the
+    target died mid-response) raises — a partial scrape is a failed
+    scrape, never a half-ingested one."""
+    m = re.match(r"http://([^/:]+)(?::(\d+))?(/.*)?$", url)
+    if m is None:
+        raise ValueError(f"unsupported target url {url!r}")
+    host, port, path = m.group(1), int(m.group(2) or 80), m.group(3) or "/"
+
+    async def fetch() -> str:
+        reader, writer = await asyncio.open_connection(host, port)
+        try:
+            writer.write((f"GET {path} HTTP/1.1\r\nHost: {host}\r\n"
+                          "Connection: close\r\n\r\n").encode())
+            await writer.drain()
+            status_line = await reader.readline()
+            parts = status_line.split(None, 2)
+            if len(parts) < 2 or parts[1] != b"200":
+                raise RuntimeError(
+                    f"scrape {url}: HTTP {parts[1:2] or status_line!r}")
+            length = None
+            while True:
+                line = await reader.readline()
+                if line in (b"\r\n", b"\n", b""):
+                    break
+                if line.lower().startswith(b"content-length:"):
+                    length = int(line.split(b":", 1)[1])
+            if length is not None:
+                body = await reader.readexactly(length)
+            else:
+                body = await reader.read()
+            return body.decode("utf-8", "replace")
+        finally:
+            writer.close()
+
+    return await asyncio.wait_for(fetch(), timeout)
+
+
+class Monitor:
+    """The fleet scraper + TSDB + rule engine.
+
+    Targets come from three places: `add_static_target` (well-known
+    control-plane URLs), `add_local_target` (an embedded component's
+    registry render in this process), and store discovery (Nodes whose
+    status publishes a kubelet endpoint — those are also asked for
+    /stats/summary, which becomes the node_*/pod_* usage series HPA and
+    `kubectl top` query). Every scrape writes a synthetic
+    `up{job,instance}` sample (1 ok / 0 failed) — scrape health is
+    itself a queryable series, which is what makes availability alerts
+    like SchedulerDown possible.
+    """
+
+    def __init__(self, store=None, *, interval: float = 15.0,
+                 scrape_timeout: float = 2.0,
+                 retention_samples: int = 600, max_series: int = 20000,
+                 lookback_s: float | None = None,
+                 staleness_s: float | None = None,
+                 rules: list | None = None,
+                 include_builtin_rules: bool = True,
+                 slo_window_s: float | None = None,
+                 alert_for_s: float = 0.0,
+                 e2e_slo_seconds: float = 1.0,
+                 seed: int = 0, node_host: str = "127.0.0.1",
+                 recorder=None, registry: _metrics.Registry | None = None):
+        self.store = store
+        self.interval = float(interval)
+        self.scrape_timeout = float(scrape_timeout)
+        self.lookback_s = (float(lookback_s) if lookback_s is not None
+                           else max(10.0, 5 * self.interval))
+        self.staleness_s = (float(staleness_s) if staleness_s is not None
+                            else max(60.0, 20 * self.interval))
+        self.tsdb = TSDB(retention_samples=retention_samples,
+                         max_series=max_series)
+        self.rules = list(rules or [])
+        if include_builtin_rules:
+            window = (float(slo_window_s) if slo_window_s is not None
+                      else max(4 * self.interval, 1.0))
+            self.rules.extend(builtin_rules(
+                window_s=window, for_s=alert_for_s,
+                e2e_slo_seconds=e2e_slo_seconds))
+        self._node_host = node_host
+        self._rnd = random.Random(seed)
+        self._recorder = recorder
+        if recorder is None and store is not None:
+            from kubernetes_tpu.utils.events import EventRecorder
+            self._recorder = EventRecorder(store, component="monitor")
+        self._targets: list[Target] = []
+        self._alert_state: dict[str, dict[tuple, dict]] = {}
+        self.alert_log: deque[dict] = deque(maxlen=512)
+        self._store_rule_cache: dict[tuple[str, str, float], object] = {}
+        self._task: asyncio.Task | None = None
+        self.registry = registry or _metrics.Registry()
+        self._mx_scrapes = self.registry.counter(
+            "monitor_scrape_total", "Scrapes attempted per job", ("job",))
+        self._mx_failures = self.registry.counter(
+            "monitor_scrape_failures_total", "Failed scrapes per job",
+            ("job",))
+        self._mx_duration = self.registry.histogram(
+            "monitor_scrape_duration_seconds", "Per-target scrape duration",
+            buckets=_metrics.exponential_buckets(0.0001, 4, 10))
+        self._mx_samples = self.registry.counter(
+            "monitor_samples_ingested_total", "Samples written to the TSDB")
+        self._mx_series = self.registry.gauge(
+            "monitor_tsdb_series", "Live series in the TSDB")
+        self._mx_tsdb_samples = self.registry.gauge(
+            "monitor_tsdb_samples", "Samples resident in the TSDB")
+        self._mx_firing = self.registry.gauge(
+            "monitor_alerts_firing", "Alerts currently firing")
+
+    # -- target management --------------------------------------------------
+
+    def add_static_target(self, job: str, url: str,
+                          instance: str | None = None,
+                          summary: bool = False) -> None:
+        self._targets.append(Target(job=job, instance=instance or url,
+                                    url=url, summary=summary))
+
+    def add_local_target(self, job: str, render: Callable[[], str],
+                         instance: str = "local") -> None:
+        self._targets.append(Target(job=job, instance=instance,
+                                    render=render))
+
+    def remove_target(self, job: str, instance: str | None = None) -> None:
+        self._targets = [
+            t for t in self._targets
+            if not (t.job == job and (instance is None
+                                      or t.instance == instance))]
+
+    def _discovered_targets(self) -> list[Target]:
+        if self.store is None:
+            return []
+        try:
+            nodes = self.store.list("Node")
+        except Exception:  # noqa: BLE001 — discovery is best-effort
+            return []
+        out = []
+        for node in nodes:
+            eps = getattr(node.status, "daemon_endpoints", None) or {}
+            port = (eps.get("kubeletEndpoint") or {}).get("Port")
+            if port:
+                out.append(Target(
+                    job="kubelet", instance=node.metadata.name,
+                    url=f"http://{self._node_host}:{port}", summary=True))
+        return out
+
+    def targets(self) -> list[Target]:
+        return list(self._targets) + self._discovered_targets()
+
+    # -- scraping ------------------------------------------------------------
+
+    async def scrape_once(self, now: float | None = None) -> None:
+        """One scrape round: every target, then GC, then rule evaluation.
+        Per-target failures are counted, marked in `up`, and never abort
+        the round."""
+        now = time.time() if now is None else now
+        for target in self.targets():
+            await self._scrape_target(target, now)
+        # the monitor's own families are fleet citizens too
+        self._mx_series.set(self.tsdb.series_count())
+        self._mx_tsdb_samples.set(self.tsdb.sample_count())
+        self._ingest_text(self.registry.render(),
+                          Target(job="monitor", instance="self"), now)
+        self.tsdb.gc(now, self.staleness_s)
+        self.evaluate_rules(now)
+
+    async def _scrape_target(self, target: Target, now: float) -> None:
+        self._mx_scrapes.labels(target.job).inc()
+        t0 = time.perf_counter()
+        try:
+            if target.render is not None:
+                text = target.render()
+            else:
+                text = await _http_fetch(target.url + "/metrics",
+                                         self.scrape_timeout)
+            self._ingest_text(text, target, now)
+            if target.summary and target.url is not None:
+                payload = await _http_fetch(target.url + "/stats/summary",
+                                            self.scrape_timeout)
+                self._ingest_summary(json.loads(payload), target, now)
+            up = 1.0
+        except Exception:  # noqa: BLE001 — any failure mode is up=0
+            self._mx_failures.labels(target.job).inc()
+            up = 0.0
+        self._mx_duration.observe(time.perf_counter() - t0)
+        self.tsdb.add("up", {"job": target.job, "instance": target.instance},
+                      up, now)
+
+    def _ingest_text(self, text: str, target: Target, now: float) -> None:
+        n = 0
+        for name, labels, value in parse_exposition(text):
+            labels.setdefault("job", target.job)
+            labels.setdefault("instance", target.instance)
+            self.tsdb.add(name, labels, value, now)
+            n += 1
+        self._mx_samples.inc(n)
+
+    def _ingest_summary(self, payload: dict, target: Target,
+                        now: float) -> None:
+        """Kubelet /stats/summary -> the resource-metrics series HPA and
+        `kubectl top` query. pod_cpu_usage_ratio (fraction of request) is
+        only emitted for pods reporting live usage, preserving HPA's
+        skip-on-incomplete-coverage semantics."""
+        node = payload.get("node") or {}
+        node_name = node.get("nodeName", target.instance)
+        base = {"job": target.job, "instance": target.instance,
+                "node": node_name}
+        n = 0
+        cpu = (node.get("cpu") or {}).get("usageCores")
+        if cpu is not None:
+            self.tsdb.add("node_cpu_usage_cores", dict(base),
+                          float(cpu), now)
+            n += 1
+        mem = (node.get("memory") or {}).get("usageMiB")
+        if mem is not None:
+            self.tsdb.add("node_memory_usage_mib", dict(base),
+                          float(mem), now)
+            n += 1
+        for pod in payload.get("pods") or []:
+            ref = pod.get("podRef") or {}
+            labels = dict(base)
+            labels["namespace"] = ref.get("namespace", "default")
+            labels["pod"] = ref.get("name", "")
+            pcpu = pod.get("cpu") or {}
+            if "usageCores" in pcpu:
+                self.tsdb.add("pod_cpu_usage_cores", dict(labels),
+                              float(pcpu["usageCores"]), now)
+                n += 1
+            if "usageRatio" in pcpu:
+                self.tsdb.add("pod_cpu_usage_ratio", dict(labels),
+                              float(pcpu["usageRatio"]), now)
+                n += 1
+            pmem = pod.get("memory") or {}
+            if "usageMiB" in pmem:
+                self.tsdb.add("pod_memory_usage_mib", dict(labels),
+                              float(pmem["usageMiB"]), now)
+                n += 1
+        self._mx_samples.inc(n)
+
+    # -- rules ---------------------------------------------------------------
+
+    def _store_rules(self) -> list:
+        """AlertRule objects (monitoring.ktpu.io) -> compiled rules, so
+        operators reconfigure alerting with `kubectl create` alone. Parse
+        results are cached by (name, expr, for); unparseable specs are
+        skipped (validation rejects them at admission, but the store may
+        predate a rule-engine upgrade)."""
+        if self.store is None:
+            return []
+        try:
+            objs = self.store.list("AlertRule")
+        except Exception:  # noqa: BLE001 — no such kind on old stores
+            return []
+        out = []
+        cache: dict[tuple[str, str, float], object] = {}
+        for obj in objs:
+            spec = getattr(obj, "spec", None) or {}
+            expr = spec.get("expr", "")
+            record = spec.get("record", "")
+            alert = spec.get("alert", "")
+            for_s = float(spec.get("for", 0) or 0)
+            key = (record or alert, expr, for_s)
+            rule = self._store_rule_cache.get(key)
+            if rule is None:
+                try:
+                    if record:
+                        rule = RecordingRule(record, expr,
+                                             labels=spec.get("labels"))
+                    elif alert:
+                        rule = AlertingRule(
+                            alert, expr, for_s=for_s,
+                            labels=spec.get("labels"),
+                            annotations=spec.get("annotations"))
+                    else:
+                        continue
+                except QueryError:
+                    continue
+            cache[key] = rule
+            out.append(rule)
+        self._store_rule_cache = cache
+        return out
+
+    def evaluate_rules(self, now: float | None = None) -> None:
+        now = time.time() if now is None else now
+        rules = self.rules + self._store_rules()
+        live = set()
+        for rule in rules:
+            try:
+                result = evaluate(rule.ast, self.tsdb, now, self.lookback_s)
+            except QueryError:
+                continue
+            if isinstance(rule, RecordingRule):
+                for labels, value in _as_vector(result):
+                    merged = dict(labels)
+                    merged.update(rule.labels)
+                    self.tsdb.add(rule.record, merged, value, now)
+            else:
+                live.add(rule.alert)
+                self._eval_alert(rule, result, now)
+        # rules removed from the store resolve their tracked alerts
+        for name in list(self._alert_state):
+            if name not in live:
+                for state in self._alert_state.pop(name).values():
+                    if state["state"] == "firing":
+                        self._transition(name, state, "resolved", now)
+        self._mx_firing.set(sum(
+            1 for states in self._alert_state.values()
+            for s in states.values() if s["state"] == "firing"))
+
+    def _eval_alert(self, rule: AlertingRule, result, now: float) -> None:
+        if isinstance(result, float):
+            active = ({(): ({}, result)} if result != 0 else {})
+        else:
+            active = {tuple(sorted(lbl.items())): (lbl, v)
+                      for lbl, v in result}
+        states = self._alert_state.setdefault(rule.alert, {})
+        for key, (labels, value) in active.items():
+            s = states.get(key)
+            if s is None:
+                merged = dict(labels)
+                merged.update(rule.labels)
+                s = {"state": "pending", "since": now, "labels": merged,
+                     "annotations": rule.annotations}
+                states[key] = s
+            s["value"] = value
+            if s["state"] == "pending" and now - s["since"] >= rule.for_s:
+                s["state"] = "firing"
+                s["firing_since"] = now
+                self._transition(rule.alert, s, "firing", now)
+        for key in [k for k in states if k not in active]:
+            s = states.pop(key)
+            if s["state"] == "firing":
+                self._transition(rule.alert, s, "resolved", now)
+
+    def _transition(self, alert: str, state: dict, to: str,
+                    now: float) -> None:
+        self.alert_log.append({
+            "alert": alert, "state": to, "labels": dict(state["labels"]),
+            "value": state.get("value"), "t": now})
+        if self._recorder is None:
+            return
+        # alerts surface as Events anchored on a synthetic AlertRule ref,
+        # so `kubectl get events` shows the firing history
+        anchor = SimpleNamespace(
+            kind="AlertRule",
+            metadata=SimpleNamespace(name=_dns_name(alert),
+                                     namespace=MONITOR_NAMESPACE, uid=""))
+        label_str = ",".join(f"{k}={v}"
+                             for k, v in sorted(state["labels"].items()))
+        try:
+            self._recorder.record(
+                anchor, "Warning" if to == "firing" else "Normal",
+                "AlertFiring" if to == "firing" else "AlertResolved",
+                f"{alert}{{{label_str}}} value={state.get('value')}")
+        except Exception:  # noqa: BLE001 — events are best-effort
+            pass
+
+    # -- queries + payloads --------------------------------------------------
+
+    def query(self, expr: str, now: float | None = None) -> Vector:
+        """Evaluate an instant query -> [(labels, value), ...]; scalars
+        come back as one sample with empty labels. Raises QueryError."""
+        now = time.time() if now is None else now
+        return _as_vector(
+            evaluate(parse_query(expr), self.tsdb, now, self.lookback_s))
+
+    def active_alerts(self) -> list[dict]:
+        out = []
+        for alert, states in self._alert_state.items():
+            for s in states.values():
+                out.append({"alert": alert, "state": s["state"],
+                            "labels": dict(s["labels"]),
+                            "value": s.get("value"),
+                            "since": s["since"],
+                            "firing_since": s.get("firing_since"),
+                            "annotations": dict(s.get("annotations") or {})})
+        out.sort(key=lambda a: (a["alert"],
+                                sorted(a["labels"].items())))
+        return out
+
+    def alerts_payload(self) -> dict:
+        return {"alerts": self.active_alerts(),
+                "transitions": list(self.alert_log)}
+
+    def fired(self, alert: str) -> bool:
+        return any(e["alert"] == alert and e["state"] == "firing"
+                   for e in self.alert_log)
+
+    def resolved(self, alert: str) -> bool:
+        return any(e["alert"] == alert and e["state"] == "resolved"
+                   for e in self.alert_log)
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def publish(self, url: str) -> None:
+        """Advertise this monitor's query/alerts URL in the store (an
+        Endpoints object in kube-system — the same object family leader
+        election locks on), so kubectl and remote HPAs can find it."""
+        if self.store is None:
+            return
+        from kubernetes_tpu.api.objects import Endpoints, ObjectMeta
+        from kubernetes_tpu.apiserver.store import AlreadyExists, NotFound
+        try:
+            try:
+                self.store.guaranteed_update(
+                    "Endpoints", MONITOR_ENDPOINT_NAME, MONITOR_NAMESPACE,
+                    lambda ep: ep.metadata.annotations.update(
+                        {MONITOR_URL_ANNOTATION: url}))
+            except NotFound:
+                self.store.create(Endpoints(metadata=ObjectMeta(
+                    name=MONITOR_ENDPOINT_NAME,
+                    namespace=MONITOR_NAMESPACE,
+                    annotations={MONITOR_URL_ANNOTATION: url})))
+        except AlreadyExists:
+            pass
+
+    async def start(self) -> None:
+        if self._task is None:
+            self._task = asyncio.get_running_loop().create_task(self._loop())
+
+    def stop(self) -> None:
+        # sync like every controller's stop(): cancel, don't await (the
+        # loop task dies at the next scheduler pass)
+        if self._task is not None:
+            self._task.cancel()
+            self._task = None
+
+    async def _loop(self) -> None:
+        while True:
+            # seeded jitter de-phases a fleet of monitors from their
+            # targets' own periodic work (and from each other)
+            await asyncio.sleep(self.interval
+                                * (0.9 + 0.2 * self._rnd.random()))
+            try:
+                await self.scrape_once()
+            except Exception:  # noqa: BLE001 — the loop must survive
+                self._mx_failures.labels("_round").inc()
+
+
+def _dns_name(alert: str) -> str:
+    """CamelCase alert name -> DNS-1123 event anchor name."""
+    return re.sub(r"(?<!^)(?=[A-Z])", "-", alert).lower()
+
+
+def find_monitor_url(store) -> str | None:
+    """The published monitor URL, or None when no monitor runs."""
+    try:
+        ep = store.get("Endpoints", MONITOR_ENDPOINT_NAME,
+                       MONITOR_NAMESPACE)
+    except Exception:  # noqa: BLE001 — no monitor published
+        return None
+    return (getattr(ep.metadata, "annotations", None) or {}).get(
+        MONITOR_URL_ANNOTATION)

@@ -452,13 +452,21 @@ class ChaosResult:
     racy_writes: int = 0
     loop_stalls: int = 0
     max_stall_ms: float = 0.0
+    # the embedded Monitor's SLO verdict: SchedulerDown must fire during
+    # the induced outage and resolve once the restarted scheduler scrapes
+    # healthy again
+    slo_alert_fired: bool = False
+    slo_alert_resolved: bool = False
+    monitor_scrapes: int = 0
 
     def __str__(self) -> str:
         return (f"chaos N={self.nodes} P={self.pods} seed={self.seed}: "
                 f"{self.bound}/{self.pods} bound "
                 f"({self.double_binds} double-binds, "
                 f"{self.faults_injected} faults injected), recovered in "
-                f"{self.recovery_ms:.0f}ms")
+                f"{self.recovery_ms:.0f}ms, SLO alert "
+                f"fired={self.slo_alert_fired} "
+                f"resolved={self.slo_alert_resolved}")
 
 
 async def _run_chaos(n_nodes: int, n_pods: int, seed: int,
@@ -511,6 +519,25 @@ async def _run_chaos(n_nodes: int, n_pods: int, seed: int,
     sched = Scheduler(store, caps=caps)
     driver = loop.create_task(sched.run())
 
+    # embedded monitoring plane, deterministically stepped (scrape_once at
+    # fixed drill points, not the jittered background loop): the scheduler
+    # is a local render target through a mutable holder, so the crash
+    # window scrapes as a failure (up=0) and SchedulerDown must fire, then
+    # resolve after the restart. store=None: the monitor must not write
+    # (the RaceDetector audit stays about the control plane under test).
+    from kubernetes_tpu.obs.monitor import Monitor
+
+    schedref = {"sched": sched}
+
+    def scheduler_exposition() -> str:
+        s = schedref["sched"]
+        if s is None:
+            raise ConnectionError("scheduler crashed")
+        return s.metrics.registry.render()
+
+    monitor = Monitor(store=None, interval=0.5, alert_for_s=0.0)
+    monitor.add_local_target("scheduler", scheduler_exposition)
+
     for pod in make_pods(n_pods, cpu="100m", memory="64Mi",
                          name_prefix="chaos"):
         inner.create(pod)
@@ -523,15 +550,21 @@ async def _run_chaos(n_nodes: int, n_pods: int, seed: int,
         # through a still-queued loop closure
         driver.cancel()
         sched.kill()
+        schedref["sched"] = None
 
     async with asyncio.timeout(180):
         while len(plane.bind_counts) < max(1, n_pods // 3):
             await asyncio.sleep(0.02)
+    await monitor.scrape_once()  # healthy baseline: up{job="scheduler"}=1
     crash_scheduler()
     plane.expire_watch_history()
     plane.drop_watchers()
+    # the outage window: the dead scheduler scrapes as down and the SLO
+    # alert must transition to firing before the replacement comes up
+    await monitor.scrape_once()
     t0 = time.perf_counter()
     sched = Scheduler(store, caps=caps)
+    schedref["sched"] = sched
     driver = loop.create_task(sched.run())
 
     def converged() -> bool:
@@ -544,6 +577,9 @@ async def _run_chaos(n_nodes: int, n_pods: int, seed: int,
         while not converged():
             await asyncio.sleep(0.05)
     recovery_ms = 1e3 * (time.perf_counter() - t0)
+    # post-convergence scrape: the restarted scheduler answers again, so
+    # the outage alert must resolve
+    await monitor.scrape_once()
     driver.cancel()
     sched.stop()
     cluster.stop()
@@ -558,7 +594,10 @@ async def _run_chaos(n_nodes: int, n_pods: int, seed: int,
         converged=double == 0 and len(plane.bind_counts) >= n_pods,
         racy_writes=len(store.racy_writes) if race_detect else 0,
         loop_stalls=len(stalls),
-        max_stall_ms=1e3 * max(stalls, default=0.0))
+        max_stall_ms=1e3 * max(stalls, default=0.0),
+        slo_alert_fired=monitor.fired("SchedulerDown"),
+        slo_alert_resolved=monitor.resolved("SchedulerDown"),
+        monitor_scrapes=3)
 
 
 def run_chaos(n_nodes: int = 128, n_pods: int = 200, seed: int = 1234,
@@ -1013,3 +1052,137 @@ def run_watch_fanout(watchers: int = 10_000,
                      events: int = 100) -> FanoutResult:
     """Blocking entry point for the watch-cache fan-out drill."""
     return asyncio.run(_run_watch_fanout(watchers, events))
+
+
+@dataclass
+class MonitorBenchResult:
+    """Monitoring-plane overhead drill: a Monitor scrapes a fleet of real
+    ObsServers (each over its own churning registry) at a fixed interval
+    while instant queries run against the TSDB. The contract: zero scrape
+    failures, and the TSDB stays bounded — the series count stops growing
+    once the fleet's label space is discovered (no per-scrape series
+    leak)."""
+
+    targets: int
+    seconds: float
+    interval: float
+    scrapes: int
+    scrape_failures: int
+    samples_ingested: int
+    samples_per_sec: float
+    scrape_p99_ms: float
+    query_p99_ms: float
+    tsdb_series: int
+    tsdb_samples: int
+    series_stable: bool
+
+    def __str__(self) -> str:
+        return (f"monitor T={self.targets} @{self.interval}s x"
+                f"{self.seconds:.0f}s: {self.scrapes} scrapes "
+                f"({self.scrape_failures} failed), "
+                f"{self.samples_per_sec:.0f} samples/s, scrape p99 "
+                f"{self.scrape_p99_ms:.1f}ms, query p99 "
+                f"{self.query_p99_ms:.2f}ms, {self.tsdb_series} series "
+                f"({'stable' if self.series_stable else 'GROWING'})")
+
+
+async def _run_monitor_bench(n_targets: int, seconds: float,
+                             interval: float,
+                             retention_samples: int = 120,
+                             seed: int = 7) -> MonitorBenchResult:
+    import random as _random
+
+    from kubernetes_tpu.obs.http import ObsServer
+    from kubernetes_tpu.obs.metrics import Registry
+    from kubernetes_tpu.obs.monitor import Monitor
+
+    rng = _random.Random(seed)
+    servers: list[ObsServer] = []
+    churners: list[tuple] = []
+    for i in range(n_targets):
+        reg = Registry()
+        reqs = reg.counter("bench_requests_total", "synthetic traffic",
+                           labels=("code",))
+        lat = reg.histogram("bench_request_duration_seconds",
+                            "synthetic latency")
+        srv = ObsServer(registry=reg)
+        await srv.start()
+        servers.append(srv)
+        churners.append((reqs, lat))
+    monitor = Monitor(store=None, interval=interval,
+                      retention_samples=retention_samples,
+                      include_builtin_rules=False)
+    for i, srv in enumerate(servers):
+        monitor.add_static_target(f"bench-{i}", srv.url)
+
+    stop = asyncio.Event()
+
+    async def churn() -> None:
+        # keep every target's exposition moving between scrapes so counter
+        # deltas and histogram fills are real, not a static page re-read.
+        # Every code label ticks every round: the fleet's full label space
+        # exists from the first scrape, so the stability gate below is a
+        # real leak detector, not label-discovery noise
+        while not stop.is_set():
+            for reqs, lat in churners:
+                for code in ("200", "429", "500"):
+                    reqs.labels(code).inc(rng.randrange(1, 20))
+                lat.observe(rng.random() / 10)
+            await asyncio.sleep(interval / 4)
+
+    churn_task = asyncio.get_running_loop().create_task(churn())
+    scrape_ms: list[float] = []
+    query_ms: list[float] = []
+    series_mid = 0
+    t_end = time.perf_counter() + seconds
+    n_scrapes = 0
+    while time.perf_counter() < t_end:
+        t0 = time.perf_counter()
+        await monitor.scrape_once()
+        scrape_ms.append(1e3 * (time.perf_counter() - t0))
+        n_scrapes += 1
+        for expr in (f'rate(bench_requests_total[{4 * interval}s])',
+                     'histogram_quantile(0.99, '
+                     f'bench_request_duration_seconds_bucket'
+                     f'[{4 * interval}s])',
+                     'sum by (code) (bench_requests_total)'):
+            q0 = time.perf_counter()
+            monitor.query(expr)
+            query_ms.append(1e3 * (time.perf_counter() - q0))
+        if n_scrapes == 2:
+            # by the second scrape every target's full label space has
+            # been seen: growth beyond this point is a series leak
+            series_mid = monitor.tsdb.series_count()
+        await asyncio.sleep(
+            max(0.0, interval - (time.perf_counter() - t0)))
+    stop.set()
+    churn_task.cancel()
+    for srv in servers:
+        await srv.stop()
+
+    failures = sum(
+        child.value
+        for _v, child in monitor._mx_failures.children())
+    ingested = monitor._mx_samples.labels().value
+    return MonitorBenchResult(
+        targets=n_targets, seconds=seconds, interval=interval,
+        scrapes=n_scrapes, scrape_failures=int(failures),
+        samples_ingested=int(ingested),
+        samples_per_sec=ingested / max(seconds, 1e-9),
+        scrape_p99_ms=sorted(scrape_ms)[int(0.99 * (len(scrape_ms) - 1))]
+        if scrape_ms else 0.0,
+        query_p99_ms=sorted(query_ms)[int(0.99 * (len(query_ms) - 1))]
+        if query_ms else 0.0,
+        tsdb_series=monitor.tsdb.series_count(),
+        tsdb_samples=monitor.tsdb.sample_count(),
+        series_stable=(series_mid > 0
+                       and monitor.tsdb.series_count() <= series_mid))
+
+
+def run_monitor_bench(n_targets: int = 5, seconds: float = 10.0,
+                      interval: float = 1.0,
+                      retention_samples: int = 120,
+                      seed: int = 7) -> MonitorBenchResult:
+    """Blocking entry point for the monitoring-plane overhead drill."""
+    return asyncio.run(_run_monitor_bench(n_targets, seconds, interval,
+                                          retention_samples, seed=seed))

@@ -475,6 +475,35 @@ def test_r6_flags_unsuffixed_metric_families():
     assert all(f.rule == "span-discipline" for f in found)
 
 
+def test_r6_flags_badly_named_monitoring_rules():
+    src = (
+        "from kubernetes_tpu.obs.monitor import AlertingRule, RecordingRule\n"
+        "def rules():\n"
+        "    bad_r = RecordingRule('queue_fill', 'queue_depth / 10')\n"
+        "    bad_a = AlertingRule('scheduler_down', 'up < 1')\n"
+        "    bad_kw = AlertingRule(alert='also_bad', expr='up < 1')\n"
+    )
+    found = lint_source(src, relpath="kubernetes_tpu/x.py", rules=R6)
+    assert sorted(f.line for f in found) == [3, 4, 5]
+    assert all(f.rule == "span-discipline" for f in found)
+    msgs = " ".join(f.message for f in found)
+    assert "unit/shape suffix" in msgs and "CamelCase" in msgs
+
+
+def test_r6_clean_monitoring_rule_names():
+    src = (
+        "from kubernetes_tpu.obs.monitor import AlertingRule, RecordingRule\n"
+        "def rules(name):\n"
+        "    ok_r1 = RecordingRule('queue_fill_ratio', 'queue_depth / 10')\n"
+        "    ok_r2 = RecordingRule('sched_e2e_p99_seconds', 'x')\n"
+        "    ok_r3 = RecordingRule('node_cpu_usage_cores', 'x')\n"
+        "    ok_a = AlertingRule('SchedulerDown', 'up < 1', for_s=30)\n"
+        "    # dynamic names are a runtime-validation concern, not lint's\n"
+        "    dyn = AlertingRule(name, 'up < 1')\n"
+    )
+    assert lint_source(src, relpath="kubernetes_tpu/x.py", rules=R6) == []
+
+
 def test_r6_whole_tree_clean():
     result = run_analysis(rules=R6, baseline={})
     assert result.findings == [], [str(f) for f in result.findings]

@@ -454,6 +454,26 @@ def test_fleet_obs_endpoints():
                 assert abs(hist[phase]["sum_ms"] / 1000.0 -
                            sched.metrics.phase_s[phase]) <= \
                     max(1e-6, 0.01 * sched.metrics.phase_s[phase])
+
+            # the Monitor scrapes the whole fleet: every component lands
+            # in the TSDB with up=1 and its series are queryable
+            from kubernetes_tpu.obs.monitor import Monitor
+
+            mon = Monitor(store=None, interval=1.0)
+            for job, base in fleet.items():
+                mon.add_static_target(job, base)
+            await mon.scrape_once()
+            for job in fleet:
+                vec = mon.query(f'up{{job="{job}"}}')
+                assert vec and vec[0][1] == 1.0, f"up missing for {job}"
+            assert len(mon.query("up")) == len(fleet)
+            # a cross-component instant query over scraped series
+            vec = mon.query('scheduler_pods_scheduled_total'
+                            '{job="scheduler"}')
+            assert vec and vec[0][1] == 8.0
+            assert mon.query(
+                'sum by (phase) '
+                '(scheduler_phase_duration_seconds_count)')
         finally:
             await cm_obs.stop()
             await ext_srv.stop()

@@ -1,0 +1,606 @@
+"""Monitoring plane: exposition parsing, the bounded TSDB (counter
+resets, ring + LRU eviction, staleness GC), the mini query language,
+recording/alerting rules with for-duration lifecycle, scrape failure
+modes (timeout, partial body), kubelet /stats/summary -> the resource
+metrics HPA and `kubectl top` consume, the /alerts + /query endpoints,
+AlertRule admission + store-driven rule reconfiguration, and the bench
+monitor config smoke."""
+
+import asyncio
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+from types import SimpleNamespace
+
+import pytest
+
+from kubernetes_tpu.api.objects import AlertRule, Pod
+from kubernetes_tpu.apiserver import ObjectStore
+from kubernetes_tpu.apiserver.validation import ValidationError
+from kubernetes_tpu.controllers.hpa import MonitorMetrics
+from kubernetes_tpu.obs import Registry
+from kubernetes_tpu.obs.http import ObsServer
+from kubernetes_tpu.obs.monitor import (
+    TSDB,
+    AlertingRule,
+    Monitor,
+    QueryError,
+    RecordingRule,
+    counter_increase,
+    find_monitor_url,
+    parse_exposition,
+    parse_query,
+)
+
+from tests.test_metrics import afetch
+
+
+def mk_monitor(**kwargs):
+    """A monitor with deterministic manual stepping and no builtin SLO
+    rules (tests inject exactly the rules they assert on)."""
+    kwargs.setdefault("include_builtin_rules", False)
+    return Monitor(store=kwargs.pop("store", None), **kwargs)
+
+
+# ---- exposition parsing ----
+
+
+def test_parse_exposition_skips_comments_and_mangled_lines():
+    text = (
+        "# HELP requests_total served\n"
+        "# TYPE requests_total counter\n"
+        "requests_total 3\n"
+        "\n"
+        'latency_seconds{code="200",path="/api/v1"} 0.25\n'
+        "mangled{{{ oops\n"
+        "in_flight 2.5\n"
+    )
+    samples = parse_exposition(text)
+    assert ("requests_total", {}, 3.0) in samples
+    assert ("latency_seconds", {"code": "200", "path": "/api/v1"},
+            0.25) in samples
+    assert ("in_flight", {}, 2.5) in samples
+    assert len(samples) == 3  # comments, blanks, mangled all dropped
+
+
+def test_parse_exposition_unescapes_label_values():
+    samples = parse_exposition(
+        'errors_total{msg="line\\none \\"quoted\\" \\\\slash"} 1\n')
+    assert samples == [
+        ("errors_total", {"msg": 'line\none "quoted" \\slash'}, 1.0)]
+
+
+def test_roundtrip_render_to_parse():
+    r = Registry()
+    r.counter("hits_total", "d", ("code",)).labels("200").inc(7)
+    r.histogram("dur_seconds", "d", buckets=(0.1, 1.0)).observe(0.5)
+    samples = parse_exposition(r.render())
+    by_name = {}
+    for name, labels, value in samples:
+        by_name.setdefault(name, []).append((labels, value))
+    assert by_name["hits_total"] == [({"code": "200"}, 7.0)]
+    assert ({"le": "1"}, 1.0) in by_name["dur_seconds_bucket"]
+    assert by_name["dur_seconds_count"] == [({}, 1.0)]
+
+
+# ---- TSDB ----
+
+
+def test_counter_increase_handles_resets():
+    # 10 -> 20 (+10), reset to 5 (+5 post-reset), 5 -> 8 (+3)
+    assert counter_increase(
+        [(0, 10.0), (1, 20.0), (2, 5.0), (3, 8.0)]) == 18.0
+    assert counter_increase([]) == 0.0
+    assert counter_increase([(0, 42.0)]) == 0.0
+
+
+def test_tsdb_ring_buffer_bounds_samples():
+    db = TSDB(retention_samples=5)
+    for t in range(20):
+        db.add("m", {}, float(t), float(t))
+    assert db.sample_count() == 5
+    # the ring kept the newest samples: the window only sees t >= 15
+    (labels, pts), = db.window("m", [], 100.0, 19.0)
+    assert [t for t, _v in pts] == [15.0, 16.0, 17.0, 18.0, 19.0]
+
+
+def test_tsdb_max_series_evicts_least_recently_updated():
+    db = TSDB(max_series=3)
+    db.add("m", {"i": "a"}, 1.0, 1.0)
+    db.add("m", {"i": "b"}, 1.0, 2.0)
+    db.add("m", {"i": "c"}, 1.0, 3.0)
+    db.add("m", {"i": "d"}, 1.0, 4.0)  # evicts a (oldest last_t)
+    assert db.series_count() == 3
+    assert db.evictions == 1
+    assert db.instant("m", [("i", "=", "a")], 10.0, 100.0) == []
+    assert db.instant("m", [("i", "=", "d")], 10.0, 100.0) == [
+        ({"i": "d"}, 1.0)]
+
+
+def test_tsdb_staleness_gc_drops_disappeared_series():
+    db = TSDB()
+    db.add("gone", {}, 1.0, 0.0)
+    db.add("live", {}, 1.0, 90.0)
+    dropped = db.gc(now=100.0, staleness_s=60.0)
+    assert dropped == 1
+    assert db.window("gone", [], 1000.0, 100.0) == []
+    assert db.window("live", [], 1000.0, 100.0) != []
+
+
+def test_monitor_scrape_gcs_stale_target_series():
+    async def run():
+        mon = mk_monitor(interval=1.0, staleness_s=30.0)
+        reg = Registry()
+        reg.counter("demo_total", "d").inc(4)
+        mon.add_local_target("demo", reg.render)
+        await mon.scrape_once(now=0.0)
+        assert mon.tsdb.window("demo_total", [], 1000.0, 0.0)
+        mon.remove_target("demo")
+        await mon.scrape_once(now=100.0)  # 100 > staleness 30
+        assert mon.tsdb.window("demo_total", [], 1000.0, 100.0) == []
+
+    asyncio.run(run())
+
+
+# ---- query language ----
+
+
+def mk_db_monitor():
+    mon = mk_monitor()
+    db = mon.tsdb
+    for t in (0.0, 10.0):
+        db.add("http_total", {"code": "200"}, 10 * (t + 1), t)
+        db.add("http_total", {"code": "500"}, t, t)
+    db.add("cap", {"code": "200"}, 4.0, 10.0)
+    return mon
+
+
+def test_query_instant_selector_and_matchers():
+    mon = mk_db_monitor()
+    assert mon.query('http_total{code="200"}', now=10.0) == [
+        ({"code": "200"}, 110.0)]
+    vec = mon.query('http_total{code!="200"}', now=10.0)
+    assert vec == [({"code": "500"}, 10.0)]
+    # lookback: samples older than the window don't answer instant queries
+    assert mon.query('http_total', now=10.0 + mon.lookback_s + 1) == []
+
+
+def test_query_rate_and_increase():
+    mon = mk_db_monitor()
+    # 200: 10 -> 110 over [0, 10] = increase 100, rate 10/s
+    inc = {lbl["code"]: v
+           for lbl, v in mon.query("increase(http_total[10s])", now=10.0)}
+    assert inc == {"200": 100.0, "500": 10.0}
+    rate = {lbl["code"]: v
+            for lbl, v in mon.query("rate(http_total[10s])", now=10.0)}
+    assert rate == {"200": 10.0, "500": 1.0}
+    # a single in-window sample can't support a rate
+    assert mon.query("rate(http_total[0.5s])", now=10.0) == []
+
+
+def test_query_aggregation_and_scalars():
+    mon = mk_db_monitor()
+    assert mon.query("sum(http_total)", now=10.0) == [({}, 120.0)]
+    by = mon.query("sum by (code) (http_total)", now=10.0)
+    assert sorted((lbl["code"], v) for lbl, v in by) == [
+        ("200", 110.0), ("500", 10.0)]
+    assert mon.query("avg(http_total)", now=10.0) == [({}, 60.0)]
+    assert mon.query("count(http_total)", now=10.0) == [({}, 2.0)]
+    assert mon.query("1 + 2 * 3", now=10.0) == [({}, 7.0)]
+
+
+def test_query_binary_join_and_comparison_filter():
+    mon = mk_db_monitor()
+    # vector / vector joins on the exact label set: only code=200 has cap
+    vec = mon.query('http_total / cap', now=10.0)
+    assert vec == [({"code": "200"}, 27.5)]
+    # comparisons filter the vector rather than returning booleans
+    assert mon.query("http_total > 50", now=10.0) == [
+        ({"code": "200"}, 110.0)]
+    assert mon.query("http_total < 50", now=10.0) == [
+        ({"code": "500"}, 10.0)]
+
+
+def test_query_histogram_quantile():
+    mon = mk_monitor()
+    db = mon.tsdb
+    for le, v in (("1", 0.0), ("+Inf", 0.0)):
+        db.add("lat_seconds_bucket", {"le": le}, v, 0.0)
+    for le, v in (("1", 10.0), ("+Inf", 10.0)):
+        db.add("lat_seconds_bucket", {"le": le}, v, 10.0)
+    # all 10 observations in [0, 1): median interpolates to 0.5
+    vec = mon.query(
+        "histogram_quantile(0.5, lat_seconds_bucket[10s])", now=10.0)
+    assert vec == [({}, 0.5)]
+    # bare family name resolves to its _bucket series
+    vec = mon.query(
+        "histogram_quantile(0.5, lat_seconds[10s])", now=10.0)
+    assert vec == [({}, 0.5)]
+
+
+def test_query_errors():
+    for bad in ("", "   ", "sum by (", 'up{job=}', "rate(up)",
+                # the grammar takes a PLAIN range selector here, not a
+                # nested rate()
+                "histogram_quantile(0.9, rate(lat_bucket[10s]))"):
+        with pytest.raises(QueryError):
+            parse_query(bad)
+    mon = mk_monitor()
+    with pytest.raises(QueryError):
+        mon.query("up[10s]")  # bare range selector is not an instant query
+
+
+# ---- rules + alert lifecycle ----
+
+
+def test_recording_rule_writes_derived_series():
+    mon = mk_monitor(rules=[
+        RecordingRule("http_per_second",
+                      "sum by (code) (rate(http_total[10s]))")])
+    for t in (0.0, 10.0):
+        mon.tsdb.add("http_total", {"code": "200"}, 10 * t, t)
+    mon.evaluate_rules(now=10.0)
+    assert mon.query('http_per_second{code="200"}', now=10.0) == [
+        ({"code": "200"}, 10.0)]
+
+
+def test_alert_for_duration_lifecycle():
+    mon = mk_monitor(rules=[
+        AlertingRule("QueueTooDeep", "queue_depth > 5", for_s=10.0,
+                     annotations={"summary": "backlog"})])
+    mon.tsdb.add("queue_depth", {}, 9.0, 0.0)
+    mon.evaluate_rules(now=0.0)
+    (a,) = mon.active_alerts()
+    assert a["alert"] == "QueueTooDeep" and a["state"] == "pending"
+    assert not mon.fired("QueueTooDeep")
+
+    mon.tsdb.add("queue_depth", {}, 9.0, 5.0)
+    mon.evaluate_rules(now=5.0)  # 5s < for 10s: still pending
+    assert mon.active_alerts()[0]["state"] == "pending"
+
+    mon.tsdb.add("queue_depth", {}, 9.0, 12.0)
+    mon.evaluate_rules(now=12.0)
+    (a,) = mon.active_alerts()
+    assert a["state"] == "firing" and a["firing_since"] == 12.0
+    assert a["annotations"] == {"summary": "backlog"}
+    assert mon.fired("QueueTooDeep") and not mon.resolved("QueueTooDeep")
+    assert mon._mx_firing.labels().value == 1
+
+    mon.tsdb.add("queue_depth", {}, 1.0, 20.0)
+    mon.evaluate_rules(now=20.0)
+    assert mon.active_alerts() == []
+    assert mon.resolved("QueueTooDeep")
+    assert mon._mx_firing.labels().value == 0
+    states = [e["state"] for e in mon.alert_log
+              if e["alert"] == "QueueTooDeep"]
+    assert states == ["firing", "resolved"]
+
+
+def test_alert_transitions_surface_as_events():
+    store = ObjectStore()
+    mon = Monitor(store=store, include_builtin_rules=False,
+                  rules=[AlertingRule("StoreDown", "beat < 1")])
+    mon.tsdb.add("beat", {}, 0.0, 0.0)
+    mon.evaluate_rules(now=0.0)
+    events = store.list("Event", namespace=None)
+    assert any(e.reason == "AlertFiring" and "StoreDown" in e.message
+               for e in events), [e.reason for e in events]
+    mon.tsdb.add("beat", {}, 1.0, 1.0)
+    mon.evaluate_rules(now=1.0)
+    events = store.list("Event", namespace=None)
+    assert any(e.reason == "AlertResolved" for e in events)
+
+
+# ---- scrape failure modes ----
+
+
+def test_scrape_timeout_marks_target_down():
+    async def run():
+        async def hang(reader, writer):
+            await asyncio.sleep(5.0)
+            writer.close()
+
+        server = await asyncio.start_server(hang, "127.0.0.1", 0)
+        port = server.sockets[0].getsockname()[1]
+        mon = mk_monitor(scrape_timeout=0.2)
+        mon.add_static_target("slow", f"http://127.0.0.1:{port}")
+        await mon.scrape_once(now=0.0)
+        assert mon.query('up{job="slow"}', now=0.0)[0][1] == 0.0
+        assert mon._mx_failures.labels("slow").value == 1
+        server.close()
+        await server.wait_closed()
+
+    asyncio.run(run())
+
+
+def test_scrape_partial_body_is_a_failed_scrape():
+    """A body shorter than Content-Length (target died mid-response) must
+    fail the scrape outright — never half-ingest."""
+
+    async def run():
+        async def truncate(reader, writer):
+            await reader.read(1024)
+            writer.write(b"HTTP/1.1 200 OK\r\nContent-Length: 999\r\n\r\n"
+                         b"partial_total 1\n")
+            await writer.drain()
+            writer.close()
+
+        server = await asyncio.start_server(truncate, "127.0.0.1", 0)
+        port = server.sockets[0].getsockname()[1]
+        mon = mk_monitor(scrape_timeout=2.0)
+        mon.add_static_target("flaky", f"http://127.0.0.1:{port}")
+        await mon.scrape_once(now=0.0)
+        assert mon.query('up{job="flaky"}', now=0.0)[0][1] == 0.0
+        assert mon.query("partial_total", now=0.0) == []
+        assert mon._mx_failures.labels("flaky").value == 1
+        server.close()
+        await server.wait_closed()
+
+    asyncio.run(run())
+
+
+def test_failed_local_render_counts_and_up_recovers():
+    async def run():
+        healthy = {"ok": True}
+
+        def render():
+            if not healthy["ok"]:
+                raise ConnectionError("component crashed")
+            return "beat_total 1\n"
+
+        mon = mk_monitor()
+        mon.add_local_target("comp", render)
+        await mon.scrape_once(now=0.0)
+        assert mon.query('up{job="comp"}', now=0.0)[0][1] == 1.0
+        healthy["ok"] = False
+        await mon.scrape_once(now=1.0)
+        assert mon.query('up{job="comp"}', now=1.0)[0][1] == 0.0
+        healthy["ok"] = True
+        await mon.scrape_once(now=2.0)
+        assert mon.query('up{job="comp"}', now=2.0)[0][1] == 1.0
+        assert mon._mx_failures.labels("comp").value == 1
+        assert mon._mx_scrapes.labels("comp").value == 3
+
+    asyncio.run(run())
+
+
+# ---- resource metrics pipeline: /stats/summary -> HPA / kubectl top ----
+
+
+def mk_usage_pod(name, cpu_request="500m", usage_ratio=None):
+    ann = {}
+    if usage_ratio is not None:
+        ann["kubernetes-tpu/cpu-usage"] = str(usage_ratio)
+    return Pod.from_dict({
+        "metadata": {"name": name, "annotations": ann},
+        "spec": {"containers": [{"name": "c", "resources": {
+            "requests": {"cpu": cpu_request, "memory": "64Mi"}}}]}})
+
+
+def test_monitor_discovers_kubelet_and_ingests_summary():
+    """End to end: kubelet registers its API port on the Node, the
+    Monitor discovers it, scrapes /metrics + /stats/summary, and the
+    node_*/pod_* usage series come out queryable."""
+
+    async def run():
+        from kubernetes_tpu.agent.kubelet import KubeletCluster
+        from kubernetes_tpu.api.objects import Binding
+
+        from tests.test_controllers import until
+
+        store = ObjectStore()
+        cluster = KubeletCluster(store, n_nodes=1, serve_api=True)
+        await cluster.start()
+        store.create(mk_usage_pod("hot", usage_ratio=0.8))
+        store.create(mk_usage_pod("quiet"))
+        for name in ("hot", "quiet"):
+            store.bind(Binding(pod_name=name, namespace="default",
+                               target_node="node-0"))
+        await until(lambda: store.get("Pod", "hot").status.phase
+                    == "Running"
+                    and store.get("Pod", "quiet").status.phase == "Running")
+
+        mon = Monitor(store=store, include_builtin_rules=False)
+        targets = mon.targets()
+        assert any(t.job == "kubelet" and t.summary for t in targets), \
+            [t.job for t in targets]
+        await mon.scrape_once(now=100.0)
+
+        # node totals: hot uses 0.8 * 500m = 0.4, quiet falls back to its
+        # 500m request
+        (lbl, cores), = mon.query("node_cpu_usage_cores", now=100.0)
+        assert lbl["node"] == "node-0"
+        assert cores == pytest.approx(0.9)
+        assert mon.query("node_memory_usage_mib", now=100.0)[0][1] > 0
+
+        per_pod = {lbl["pod"]: v for lbl, v in mon.query(
+            'pod_cpu_usage_cores{namespace="default"}', now=100.0)}
+        assert per_pod == {"hot": pytest.approx(0.4),
+                           "quiet": pytest.approx(0.5)}
+        # usageRatio only exists for pods with a live sample — the HPA
+        # skip-on-incomplete-coverage contract
+        ratio = {lbl["pod"]: v for lbl, v in mon.query(
+            "pod_cpu_usage_ratio", now=100.0)}
+        assert ratio == {"hot": pytest.approx(0.8)}
+        # the kubelet's own exposition rode along on the same scrape
+        assert mon.query('up{job="kubelet"}', now=100.0)[0][1] == 1.0
+        cluster.stop()
+
+    asyncio.run(run())
+
+
+def test_hpa_monitor_metrics_source_with_fallback():
+    mon = mk_monitor()
+    pods = [SimpleNamespace(metadata=SimpleNamespace(
+        name=n, annotations={"kubernetes-tpu/cpu-usage": "0.2"}))
+        for n in ("w-1", "w-2")]
+    src = MonitorMetrics(mon)
+    # no usage series yet: the annotation stand-in answers
+    assert src.utilization("default", pods) == {"w-1": 0.2, "w-2": 0.2}
+    # live TSDB samples win over annotations, filtered to informer pods
+    # (the source queries at wall-clock now, so samples must be fresh)
+    import time
+
+    now = time.time()
+    mon.tsdb.add("pod_cpu_usage_ratio",
+                 {"namespace": "default", "pod": "w-1"}, 0.9, now)
+    mon.tsdb.add("pod_cpu_usage_ratio",
+                 {"namespace": "default", "pod": "stranger"}, 0.5, now)
+    assert src.utilization("default", pods) == {"w-1": 0.9}
+    # no monitor at all: clean fallback
+    assert MonitorMetrics(None).utilization("default", pods) == {
+        "w-1": 0.2, "w-2": 0.2}
+
+
+# ---- /alerts + /query HTTP endpoints ----
+
+
+def test_obs_server_alerts_and_query_endpoints():
+    async def run():
+        mon = mk_monitor(rules=[AlertingRule("DiskFull", "disk_frac > 0.9")])
+        mon.tsdb.add("disk_frac", {"node": "n0"}, 0.95, 0.0)
+        mon.evaluate_rules(now=0.0)
+        obs = ObsServer(registry=mon.registry, monitor=mon)
+        await obs.start()
+        try:
+            status, body, ctype = await afetch(obs.url + "/alerts")
+            assert status == 200 and ctype.startswith("application/json")
+            payload = json.loads(body)
+            (alert,) = payload["alerts"]
+            assert alert["alert"] == "DiskFull"
+            assert alert["state"] == "firing"
+            assert payload["transitions"][-1]["state"] == "firing"
+
+            status, body, _ = await afetch(
+                obs.url + '/query?query=disk_frac&time=0')
+            doc = json.loads(body)
+            assert status == 200 and doc["status"] == "success"
+            assert doc["data"] == [
+                {"labels": {"node": "n0"}, "value": 0.95}]
+
+            status, body, _ = await afetch(
+                obs.url + "/query?query=rate(nope")
+            assert status == 400
+            assert json.loads(body)["status"] == "error"
+            # a non-monitor component falls through to its own 404
+            plain = ObsServer(registry=mon.registry)
+            await plain.start()
+            status, _, _ = await afetch(plain.url + "/alerts")
+            assert status == 404
+            await plain.stop()
+        finally:
+            await obs.stop()
+
+    asyncio.run(run())
+
+
+# ---- AlertRule objects: admission + store-driven reconfiguration ----
+
+
+def mk_rule(name, spec):
+    return AlertRule.from_dict({"metadata": {"name": name}, "spec": spec})
+
+
+def test_alertrule_admission_validation():
+    store = ObjectStore()
+    store.create(mk_rule("ok-alert", {
+        "alert": "QueueTooDeep", "expr": "queue_depth > 5", "for": 30}))
+    store.create(mk_rule("ok-record", {
+        "record": "queue_fill_ratio", "expr": "queue_depth / queue_cap"}))
+    cases = [
+        # exactly one of record/alert
+        {"expr": "up < 1"},
+        {"alert": "A", "record": "b_total", "expr": "up < 1"},
+        # alert names are CamelCase
+        {"alert": "snake_case_name", "expr": "up < 1"},
+        # expr must parse
+        {"alert": "BadExpr", "expr": "sum by ("},
+        {"alert": "NoExpr", "expr": ""},
+        # for must be a non-negative number
+        {"alert": "NegFor", "expr": "up < 1", "for": -5},
+        {"alert": "BadFor", "expr": "up < 1", "for": "soon"},
+    ]
+    for i, spec in enumerate(cases):
+        with pytest.raises(ValidationError):
+            store.create(mk_rule(f"bad-{i}", spec))
+
+
+def test_store_rules_reconfigure_monitor_and_removal_resolves():
+    store = ObjectStore()
+    store.create(mk_rule("queue-deep", {
+        "alert": "QueueTooDeep", "expr": "queue_depth > 5",
+        "labels": {"severity": "page"}}))
+    store.create(mk_rule("queue-fill", {
+        "record": "queue_fill_frac", "expr": "queue_depth / 10"}))
+    mon = Monitor(store=store, include_builtin_rules=False)
+    mon.tsdb.add("queue_depth", {}, 8.0, 0.0)
+    mon.evaluate_rules(now=0.0)
+    (a,) = mon.active_alerts()
+    assert a["alert"] == "QueueTooDeep" and a["state"] == "firing"
+    assert a["labels"] == {"severity": "page"}
+    assert mon.query("queue_fill_frac", now=0.0) == [({}, 0.8)]
+    # deleting the rule object resolves its tracked alerts next round
+    store.delete("AlertRule", "queue-deep")
+    mon.evaluate_rules(now=1.0)
+    assert mon.active_alerts() == []
+    assert mon.resolved("QueueTooDeep")
+
+
+def test_publish_and_find_monitor_url_roundtrip():
+    store = ObjectStore()
+    assert find_monitor_url(store) is None
+    mon = Monitor(store=store, include_builtin_rules=False)
+    mon.publish("http://127.0.0.1:10270")
+    assert find_monitor_url(store) == "http://127.0.0.1:10270"
+    # re-publish (restart with a new port) overwrites
+    mon.publish("http://127.0.0.1:10271")
+    assert find_monitor_url(store) == "http://127.0.0.1:10271"
+    assert find_monitor_url(None) is None  # no store -> no monitor
+
+
+def test_kubectl_renders_alertrule_rows():
+    from kubernetes_tpu.cli.kubectl import HEADERS, _row
+
+    store = ObjectStore()
+    rule = store.create(mk_rule("scheduler-down", {
+        "alert": "SchedulerDown", "expr": 'up{job="scheduler"} < 1',
+        "for": 30}))
+    row = _row("AlertRule", rule, False)
+    assert row[:4] == ["scheduler-down", "alert",
+                       'up{job="scheduler"} < 1', "30s"]
+    rec = store.create(mk_rule("fill-frac", {
+        "record": "queue_fill_frac", "expr": "queue_depth / 10"}))
+    rec_row = _row("AlertRule", rec, False)
+    assert rec_row[1] == "record" and rec_row[3] == "-"
+    assert HEADERS["AlertRule"] == ["NAME", "TYPE", "EXPR", "FOR", "AGE"]
+
+
+# ---- bench config smoke ----
+
+
+def test_bench_monitor_smoke_mode():
+    """bench.py --smoke with the monitor config must stay runnable
+    end-to-end: a healthy static fleet scrapes clean (0 failures) and
+    the TSDB series count stays flat after discovery."""
+    repo = Path(__file__).resolve().parents[1]
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["BENCH_CONFIGS"] = "monitor"
+    env["BENCH_MONITOR_TARGETS"] = "3"
+    env["BENCH_MONITOR_SECONDS"] = "2"
+    env["BENCH_MONITOR_INTERVAL"] = "0.2"
+    proc = subprocess.run(
+        [sys.executable, "bench.py", "--smoke"],
+        cwd=repo, env=env, capture_output=True, text=True, timeout=420)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    line = [ln for ln in proc.stdout.splitlines() if ln.strip()][-1]
+    result = json.loads(line)
+    assert "error" not in result, result
+    extras = result["extras"]
+    assert extras["monitor_scrape_failures"] == 0
+    assert extras["monitor_samples_per_sec"] > 0
+    assert extras["monitor_tsdb_series"] > 0
+    assert extras["monitor_scrape_p99_ms"] > 0
+    assert extras["monitor_query_p99_ms"] > 0
