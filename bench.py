@@ -37,7 +37,13 @@ inter-stage queue high-water marks (headline_pipeline_*).
 BENCH_MONITOR_TARGETS / BENCH_MONITOR_SECONDS / BENCH_MONITOR_INTERVAL
 shape the monitoring-plane drill (a Monitor scraping a live ObsServer
 fleet; reports scrape p99, samples/s ingested, query p99, and errors on
-any scrape failure or unbounded TSDB growth).
+any scrape failure or unbounded TSDB growth). BENCH_HA_NODES /
+BENCH_HA_PODS / BENCH_HA_SEED / BENCH_HA_REPLICAS /
+BENCH_HA_FAILOVER_P99_MS shape the rolling-restart HA drill (N stateless
+apiserver replicas over one store, each killed once mid-workload — hard
+and graceful — while scheduler + informers + a coherence watcher run;
+errors on any double-bind, watch gap/duplicate, failover p99 past the
+bound, or relists outnumbering resume-from-rv recoveries).
 
 The opt-in `sharded` config (BENCH_CONFIGS=...,sharded) runs
 headline/gang/preemption plus a device-solve gate with the node axis
@@ -126,6 +132,8 @@ def main() -> None:
         os.environ.setdefault("BENCH_MONITOR_TARGETS", "3")
         os.environ.setdefault("BENCH_MONITOR_SECONDS", "2")
         os.environ.setdefault("BENCH_MONITOR_INTERVAL", "0.2")
+        os.environ.setdefault("BENCH_HA_NODES", "8")
+        os.environ.setdefault("BENCH_HA_PODS", "24")
         os.environ.setdefault("BENCH_DEVICE_GATE", "0")  # CPU CI: no gate
         os.environ.setdefault("BENCH_E2E_GATE", "0")     # seconds-scale run
         os.environ.setdefault("BENCH_SHARDED_NODES", "64")
@@ -148,7 +156,7 @@ def main() -> None:
     configs = os.environ.get(
         "BENCH_CONFIGS",
         "headline,interpod,spread,gang,preemption,recovery,chaos,overload,"
-        "device,autoscaler,monitor")
+        "device,autoscaler,monitor,ha")
     configs = [c.strip() for c in configs.split(",") if c.strip()]
     metrics_snapshot = "--metrics-snapshot" in sys.argv[1:] or \
         os.environ.get("BENCH_METRICS_SNAPSHOT", "") in ("1", "true")
@@ -408,6 +416,66 @@ def main() -> None:
                 f"watch fanout: store did {fr.store_fanout_puts} puts for "
                 f"{fan_events} events (the cache is not the only "
                 f"subscriber)")
+
+    if "ha" in configs:
+        from kubernetes_tpu.perf.harness import run_rolling_restart
+
+        # rolling-restart HA drill: BENCH_HA_REPLICAS stateless apiservers
+        # over ONE shared store serve a live scheduler + informer +
+        # coherence-watcher workload while every replica is killed once
+        # mid-flight (hard aborts and a graceful drain) and restarted.
+        # Contract: every pod bound exactly once, the watcher's rv stream
+        # gapless and duplicate-free against the store's own history,
+        # failover p99 under BENCH_HA_FAILOVER_P99_MS, and resume-from-rv
+        # recoveries at least matching full relists
+        ha_nodes = int(os.environ.get("BENCH_HA_NODES", "16"))
+        ha_pods = int(os.environ.get("BENCH_HA_PODS", "96"))
+        ha_seed = int(os.environ.get("BENCH_HA_SEED", "2027"))
+        ha_replicas = int(os.environ.get("BENCH_HA_REPLICAS", "3"))
+        ha_p99_bound = float(
+            os.environ.get("BENCH_HA_FAILOVER_P99_MS", "2000"))
+        race_detect = "--with-race-detector" in sys.argv[1:] or \
+            os.environ.get("BENCH_RACE_DETECTOR", "") in ("1", "true")
+        r = run_rolling_restart(ha_nodes, ha_pods, seed=ha_seed,
+                                replicas=ha_replicas,
+                                race_detect=race_detect)
+        print(f"bench[ha]: {r}", file=sys.stderr, flush=True)
+        extras["ha_replicas"] = r.replicas
+        extras["ha_replica_faults"] = len(r.replica_faults)
+        extras["ha_failovers"] = r.failovers
+        extras["ha_failover_p99_ms"] = round(r.failover_p99_ms, 2)
+        extras["ha_resumes"] = r.resumes
+        extras["ha_relists"] = r.relists
+        extras["ha_watch_resumes"] = r.watch_resumes
+        extras["ha_watch_events"] = r.watch_events
+        extras["ha_seed"] = r.seed
+        if race_detect:
+            extras["ha_racy_writes"] = r.racy_writes
+            extras["ha_loop_stalls"] = r.loop_stalls
+            extras["ha_max_stall_ms"] = round(r.max_stall_ms, 1)
+        if not r.converged:
+            RESULT["error"] = (
+                f"ha drill did not converge (seed {r.seed}): "
+                f"{r.bound}/{r.pods} bound, {r.double_binds} double-binds")
+        elif r.watch_gaps or r.watch_dupes:
+            RESULT["error"] = (
+                f"ha drill watch incoherence (seed {r.seed}): "
+                f"{r.watch_gaps} gaps, {r.watch_dupes} duplicates across "
+                f"{r.watch_events} events")
+        elif r.failover_p99_ms > ha_p99_bound:
+            RESULT["error"] = (
+                f"ha drill: failover p99 {r.failover_p99_ms:.1f}ms past "
+                f"the {ha_p99_bound:.0f}ms bound")
+        elif r.resumes < r.relists:
+            RESULT["error"] = (
+                f"ha drill: relists ({r.relists}) outnumbered resume-"
+                f"from-rv recoveries ({r.resumes}) — failover is paying "
+                f"full relist prices")
+        elif race_detect and (r.racy_writes or r.loop_stalls):
+            RESULT["error"] = (
+                f"ha drill under race detector (seed {r.seed}): "
+                f"{r.racy_writes} racy writes, {r.loop_stalls} event-loop "
+                f"stalls (max {r.max_stall_ms:.0f}ms)")
 
     if "autoscaler" in configs:
         from kubernetes_tpu.perf.harness import run_autoscaler

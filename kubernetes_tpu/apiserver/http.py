@@ -273,6 +273,27 @@ def encode_object(obj: Any) -> dict:
     return out
 
 
+class _WatchServe:
+    """Server-side handle for one live watch connection: drain() uses it
+    to tell the serve loop to end with a terminal DRAIN frame, waking a
+    stream blocked in next() (cache streams are woken by
+    WatchCache.drain_subscribers; raw store streams by detaching the
+    watcher entry)."""
+
+    __slots__ = ("_store", "_stream", "draining")
+
+    def __init__(self, store, stream):
+        self._store = store
+        self._stream = stream
+        self.draining = False
+
+    def request_drain(self) -> None:
+        self.draining = True
+        entry = getattr(self._stream, "_entry", None)
+        if entry is not None:
+            self._store._detach_watcher(entry)
+
+
 class APIServer:
     """Asyncio HTTP/1.1 apiserver over one ObjectStore.
 
@@ -289,10 +310,28 @@ class APIServer:
                  tls_cert_file: str | None = None,
                  tls_key_file: str | None = None,
                  client_ca_file: str | None = None,
-                 watch_cache: bool = False):
+                 watch_cache: bool = False,
+                 replica_id: str = ""):
         self.store = store
         self.host = host
         self.port = port
+        # HA: which control-plane replica this process is (the reference's
+        # stateless-apiservers-over-shared-etcd shape: N APIServers may
+        # share ONE ObjectStore, each with its own watch cache, APF queues
+        # and obs mux — coherence comes from the store's resourceVersions)
+        self.replica_id = replica_id
+        self._draining = False
+        # fault injection (HA drills): accept connections but never answer
+        # a byte — the worst partial failure, detectable only by client
+        # I/O timeouts (FaultPlane.black_hole_replica flips it)
+        self._black_holed = False
+        # every live connection's writer, so kill() can hard-abort them
+        # (SIGKILL-style: clients see a mid-stream reset, not a drain)
+        self._conns: set[asyncio.StreamWriter] = set()
+        # active watch serves: stream + writer, so drain() can hand them a
+        # terminal "go reconnect now" frame instead of letting them idle
+        # out against a dead replica
+        self._watch_serves: set[Any] = set()
         self.authenticator = authenticator
         self.authorizer = authorizer
         self._authz_blocking: bool | None = None  # resolved on first request
@@ -443,18 +482,125 @@ class APIServer:
             self._audit.close()
             self._audit = None
 
+    # ---- HA replica lifecycle ----
+
+    def kill(self) -> None:
+        """SIGKILL-style death: abort every open transport NOW. Clients
+        see connection resets mid-request/mid-stream — the failure mode a
+        rolling restart must survive. Synchronous on purpose (a killed
+        process doesn't await)."""
+        self._draining = True
+        if self._server is not None:
+            self._server.close()
+            self._server = None
+        for w in list(self._conns):
+            transport = w.transport
+            if transport is not None:
+                transport.abort()
+        self._conns.clear()
+        if self.watch_cache is not None:
+            self.watch_cache.stop()
+            self.watch_cache = None
+
+    async def drain(self, timeout: float = 5.0) -> None:
+        """Graceful shutdown: stop accepting (readyz goes 503 first, new
+        requests bounce), let in-flight requests finish, then hand every
+        live watcher a terminal DRAIN frame — "go reconnect now" — instead
+        of letting them idle against a dead replica. Ends with stop()."""
+        import time as _time
+
+        self._draining = True
+        deadline = _time.monotonic() + timeout
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None
+        while self._in_flight > 0 and _time.monotonic() < deadline:
+            await asyncio.sleep(0.005)
+        if self.watch_cache is not None:
+            self.watch_cache.drain_subscribers()
+        for serve in list(self._watch_serves):
+            serve.request_drain()
+        # the serve loops own their writers; give them a few ticks to
+        # write the terminal frame and close
+        while self._watch_serves and _time.monotonic() < deadline:
+            await asyncio.sleep(0.005)
+        await self.stop()
+
+    _ENDPOINTS_NAME = "kubernetes"
+
+    def advertise(self) -> None:
+        """Publish this replica's host:port into the well-known
+        `default/kubernetes` Endpoints object (the reference's
+        master-count endpoint reconciler) so replica-aware clients can
+        discover the full set with one GET."""
+        addr = {"ip": self.host, "port": self.port,
+                "replica": self.replica_id or f"{self.host}:{self.port}"}
+
+        def mutate(obj):
+            subset = obj.subsets[0] if obj.subsets else {}
+            addrs = [a for a in subset.get("addresses", [])
+                     if (a.get("ip"), a.get("port"))
+                     != (addr["ip"], addr["port"])]
+            addrs.append(dict(addr))
+            obj.subsets = [{"addresses": addrs}]
+            return obj
+
+        try:
+            self.store.guaranteed_update(
+                "Endpoints", self._ENDPOINTS_NAME, "default", mutate)
+        except NotFound:
+            ep = objs.Endpoints()
+            ep.metadata.name = self._ENDPOINTS_NAME
+            ep.metadata.namespace = "default"
+            ep.subsets = [{"addresses": [dict(addr)]}]
+            try:
+                self.store.create(ep)
+            except AlreadyExists:
+                self.store.guaranteed_update(
+                    "Endpoints", self._ENDPOINTS_NAME, "default", mutate)
+
+    def unadvertise(self) -> None:
+        """Remove this replica from the discovery Endpoints (drain path)."""
+        def mutate(obj):
+            subset = obj.subsets[0] if obj.subsets else {}
+            addrs = [a for a in subset.get("addresses", [])
+                     if (a.get("ip"), a.get("port"))
+                     != (self.host, self.port)]
+            obj.subsets = [{"addresses": addrs}] if addrs else []
+            return obj
+
+        try:
+            self.store.guaranteed_update(
+                "Endpoints", self._ENDPOINTS_NAME, "default", mutate)
+        except NotFound:
+            pass
+
     # ---- connection handling ----
 
     async def _handle(self, reader: asyncio.StreamReader,
                       writer: asyncio.StreamWriter) -> None:
+        self._conns.add(writer)
         try:
             while True:
+                if self._black_holed:
+                    # hold the connection open without reading or answering
+                    # until the fault lifts (then close so the client's
+                    # retry lands on a working replica) or the client's
+                    # socket timeout fires
+                    while self._black_holed:
+                        await asyncio.sleep(0.02)
+                    return
                 try:
                     parsed = await read_http_request(reader)
                 except ValueError:
                     await _respond(writer, 400, {"message": "bad request"})
                     return
                 if parsed is None:
+                    return
+                if self._black_holed:  # request arrived as the hole opened
+                    while self._black_holed:
+                        await asyncio.sleep(0.02)
                     return
                 method, target, headers, body = parsed
                 import time as _time
@@ -468,11 +614,24 @@ class APIServer:
                 obs = obs_response(
                     method, url.path, registry=obs_metrics.REGISTRY,
                     ready_checks={
-                        "serving": lambda: self._server is not None})
+                        "serving": lambda: self._server is not None,
+                        # a draining replica fails /readyz FIRST so
+                        # health-checking clients stop picking it before
+                        # its listener closes (load-balancer semantics)
+                        "accepting": lambda: not self._draining})
                 if obs is not None:
                     status, obs_body, ctype = obs
                     writer.write(http_head(status, obs_body, ctype))
                     await writer.drain()
+                    return
+                if self._draining:
+                    # graceful shutdown: new API requests bounce with an
+                    # honest 503 (clients fail over to another replica);
+                    # obs endpoints above still answer so /readyz reports
+                    # the drain rather than timing out
+                    await _respond(writer, 503, {
+                        "kind": "Status", "reason": "ServiceUnavailable",
+                        "message": "apiserver is shutting down"})
                     return
                 # distributed tracing: continue the caller's trace when the
                 # request carries a sampled W3C traceparent (head-based
@@ -620,6 +779,7 @@ class APIServer:
         except (asyncio.IncompleteReadError, ConnectionError):
             pass
         finally:
+            self._conns.discard(writer)
             writer.close()
 
     def _request_width(self, method: str, path: str) -> int:
@@ -1119,6 +1279,10 @@ class APIServer:
 
     # ---- watch streaming ----
 
+    # heartbeat interval for idle watch connections; drills lower it so
+    # black-holed replicas are detected in test time, not 30s
+    watch_heartbeat_s = 30.0
+
     async def _serve_watch(self, writer: asyncio.StreamWriter, path: str,
                            query: dict, binary: bool = False) -> None:
         try:
@@ -1150,16 +1314,31 @@ class APIServer:
                      f"Content-Type: {content_type}\r\n"
                      f"Transfer-Encoding: identity\r\n"
                      f"Connection: close\r\n\r\n".encode())
+        serve = _WatchServe(self.store, stream)
+        self._watch_serves.add(serve)
+        last_rv = int(since) if since else self.store.resource_version
         try:
             while True:
-                event = await stream.next(timeout=30.0)
+                event = await stream.next(timeout=self.watch_heartbeat_s)
                 if event is None:
+                    if getattr(stream, "_stopped", False):
+                        # stream is over (evicted, or this replica is
+                        # draining) — end the connection instead of
+                        # heartbeating a dead stream forever. A drain
+                        # gets the explicit terminal frame: "resume from
+                        # last_rv on another replica, now".
+                        if serve.draining or getattr(stream, "drained",
+                                                     False):
+                            await self._write_drain_frame(
+                                writer, last_rv, binary)
+                        return
                     # heartbeat frame keeps half-open detection simple
                     writer.write(wire.HEARTBEAT if binary else b"\n")
                     await writer.drain()
                     continue
                 if ns and event.obj.metadata.namespace != ns:
                     continue
+                last_rv = event.resource_version
                 if binary:
                     writer.write(wire.encode_watch_frame(
                         event.type, event.resource_version,
@@ -1173,8 +1352,26 @@ class APIServer:
         except (ConnectionError, asyncio.CancelledError):
             pass
         finally:
+            self._watch_serves.discard(serve)
             stream.stop()
             writer.close()
+
+    async def _write_drain_frame(self, writer, last_rv: int,
+                                 binary: bool) -> None:
+        status = {"kind": "Status", "reason": "Draining",
+                  "message": "replica shutting down; resume from "
+                             f"resourceVersion {last_rv} elsewhere"}
+        try:
+            if binary:
+                writer.write(wire.encode_watch_frame(
+                    "DRAIN", last_rv, status))
+            else:
+                writer.write(json.dumps(
+                    {"type": "DRAIN", "resourceVersion": last_rv,
+                     "object": status}).encode() + b"\n")
+            await writer.drain()
+        except (ConnectionError, OSError):
+            pass
 
 
 def _wire_loads(body: bytes) -> dict:
@@ -1231,6 +1428,9 @@ class RemoteWatchStream:
         self._writer = writer
         self._stopped = False
         self._binary = binary
+        # set when the server ended the stream with a graceful DRAIN
+        # frame: the rv to resume from on another replica
+        self.drain_rv: int | None = None
         # a timeout can cancel _read_frame between the length prefix and
         # the body; the parsed length survives here so the next call
         # resumes mid-frame instead of desyncing the stream (readexactly
@@ -1271,6 +1471,16 @@ class RemoteWatchStream:
                                                    timeout)
                 if frame is None:
                     continue  # heartbeat
+                if frame.get("type") == "DRAIN":
+                    # the replica is shutting down gracefully and told us
+                    # to reconnect NOW: surface as the same transport
+                    # signal a hard kill produces, so every consumer's
+                    # failover path (FailoverWatch resume, informer
+                    # resume-then-relist) handles both identically
+                    self.drain_rv = int(frame.get("resourceVersion", 0))
+                    raise ConnectionError(
+                        "replica draining; resume from resourceVersion "
+                        f"{self.drain_rv}")
                 obj = decode_object(frame["object"].get("kind"),
                                     frame["object"])
                 return WatchEvent(frame["type"], obj.kind, obj,
@@ -1295,18 +1505,126 @@ class RemoteWatchStream:
         return ev
 
 
+class FailoverWatch:
+    """One logical watch across the whole replica set.
+
+    Consumes a RemoteStore watch and, when the stream dies in transport
+    (replica killed) or the replica drains (terminal DRAIN frame), reopens
+    it on another endpoint with `since=<last delivered rv>` — so the
+    consumer observes ONE gapless event sequence across any number of
+    replica deaths. Events at or below the last delivered rv are dropped
+    (a resumed stream replays nothing, but dedup by rv makes that a
+    guarantee rather than a hope). A 410 on resume — the rv has aged out
+    of every replica's ring — raises honest `Expired`: the consumer must
+    relist; there is no silent gap path."""
+
+    def __init__(self, store: "RemoteStore", kind: str | None,
+                 since: int | None):
+        self._store = store
+        self._kind = kind
+        self._last_rv = since
+        self._stream = None
+        self._stopped = False
+        self.resumes = 0
+
+    @property
+    def last_rv(self) -> int | None:
+        return self._last_rv
+
+    async def next(self, timeout: float | None = None) -> WatchEvent | None:
+        import random as _random
+        import time as _time
+
+        if self._stopped:
+            return None
+        delay = 0.05
+        fail_start = None
+        while True:
+            if self._stream is None:
+                self._stream = self._store.watch(self._kind,
+                                                 since=self._last_rv)
+            try:
+                event = await self._stream.next(timeout=timeout)
+            except Expired:
+                raise  # honest 410: the consumer must relist
+            except (ConnectionError, OSError, asyncio.TimeoutError):
+                now = _time.monotonic()
+                if fail_start is None:
+                    fail_start = now
+                elif now - fail_start > self._store.connect_deadline_s:
+                    raise
+                self._stream.stop()
+                self._stream = None
+                self.resumes += 1
+                if self._stopped:
+                    return None
+                await asyncio.sleep(delay * (0.5 + _random.random()))
+                delay = min(1.0, 2 * delay)
+                continue
+            if fail_start is not None:
+                self._store.failover_total += 1
+                self._store.failover_samples.append(
+                    1e3 * (_time.monotonic() - fail_start))
+                fail_start = None
+                delay = 0.05
+            if event is None:
+                return None  # heartbeat / idle timeout
+            if self._last_rv is not None \
+                    and event.resource_version <= self._last_rv:
+                continue  # boundary replay after a resume: drop, don't dupe
+            self._last_rv = event.resource_version
+            return event
+
+    def stop(self) -> None:
+        self._stopped = True
+        if self._stream is not None:
+            self._stream.stop()
+
+    def __aiter__(self):
+        return self
+
+    async def __anext__(self) -> WatchEvent:
+        ev = await self.next()
+        if ev is None:
+            raise StopAsyncIteration
+        return ev
+
+
 class RemoteStore:
     """ObjectStore-compatible client over the HTTP API: informers, the
-    scheduler driver, controllers, and the extender run over TCP unchanged."""
+    scheduler driver, controllers, and the extender run over TCP unchanged.
+
+    Replica-aware (HA): pass `endpoints=[(host, port), ...]` and the client
+    treats the control plane as a SET — it health-checks via /readyz,
+    fails over on connect/refused/mid-stream/503 errors with jittered
+    backoff, re-resolves the set from the well-known `default/kubernetes`
+    Endpoints object (`discover_endpoints`), and spreads watch connections
+    round-robin so every replica's fan-out cache carries load. With a
+    single (host, port) the behavior is exactly the pre-HA client."""
 
     def __init__(self, host: str, port: int, token: str = "",
                  rate_limiter=None, wire_format: str | None = None,
                  tls: bool = False, ca_file: str | None = None,
                  insecure_skip_verify: bool = False,
                  cert_file: str | None = None,
-                 key_file: str | None = None):
-        self.host = host
-        self.port = port
+                 key_file: str | None = None,
+                 endpoints: list[tuple[str, int]] | None = None,
+                 request_timeout_s: float | None = None):
+        self._endpoints: list[tuple[str, int]] = \
+            [(h, int(p)) for h, p in endpoints] if endpoints \
+            else [(host, int(port))]
+        self._active = 0
+        # per-connection I/O timeout: a black-holed replica (SYN accepted,
+        # bytes never answered) must surface as an OSError and fail over
+        # instead of hanging the caller forever. None = no bound (the
+        # single-endpoint default: big LISTs may legitimately be slow).
+        self.request_timeout_s = request_timeout_s
+        if request_timeout_s is None and endpoints and len(endpoints) > 1:
+            self.request_timeout_s = 5.0
+        # failover accounting (the rolling-restart drill's p99 source)
+        self.failover_total = 0
+        self.failover_samples: list[float] = []
+        self._watch_seq = 0
         self.token = token
         # client-go-style token bucket (client/flowcontrol.py); None = no
         # throttling, the in-process/test default
@@ -1341,6 +1659,70 @@ class RemoteStore:
         fmt = (wire_format or _os.environ.get("KTPU_WIRE", "protobuf"))
         self._pb = wire.available() and fmt == "protobuf"
 
+    # ---- replica set ----
+
+    @property
+    def host(self) -> str:
+        return self._endpoints[self._active][0]
+
+    @property
+    def port(self) -> int:
+        return self._endpoints[self._active][1]
+
+    @property
+    def endpoints(self) -> list[tuple[str, int]]:
+        return list(self._endpoints)
+
+    def _advance_endpoint(self) -> None:
+        """Round-robin onto the next replica after a transport failure."""
+        self._active = (self._active + 1) % len(self._endpoints)
+
+    def _ready(self, host: str, port: int,
+               timeout: float = 0.5) -> bool:
+        """One short-deadline GET /readyz — False on refused/timeout/503.
+        A draining replica fails this BEFORE its listener closes, so
+        health-checking clients step around it without a single error."""
+        try:
+            with socket.create_connection((host, port),
+                                          timeout=timeout) as sock:
+                if self._ssl is not None:
+                    sock = self._ssl.wrap_socket(sock, server_hostname=host)
+                sock.settimeout(timeout)
+                sock.sendall(f"GET /readyz HTTP/1.1\r\nHost: {host}\r\n"
+                             f"Connection: close\r\n\r\n".encode())
+                data = b""
+                while b"\r\n" not in data:
+                    chunk = sock.recv(4096)
+                    if not chunk:
+                        break
+                    data += chunk
+            return parse_status_line(data.partition(b"\r\n")[0]) == 200
+        except (OSError, ValueError):
+            return False
+
+    def probe_endpoints(self, timeout: float = 0.5) -> list[bool]:
+        """/readyz verdict per configured endpoint, in order."""
+        return [self._ready(h, p, timeout) for h, p in self._endpoints]
+
+    def discover_endpoints(self) -> list[tuple[str, int]]:
+        """Refresh the replica set from the well-known `default/kubernetes`
+        Endpoints object every replica advertises into (the reference's
+        master-count reconciler shape). Keeps the current set on any
+        failure — discovery must never strand a working client."""
+        try:
+            ep = self.get("Endpoints", "kubernetes", "default")
+            addrs = [(a.get("ip", ""), int(a.get("port", 0)))
+                     for subset in ep.subsets
+                     for a in subset.get("addresses", [])]
+            addrs = [(h, p) for h, p in addrs if h and p]
+        except Exception:
+            return list(self._endpoints)
+        if addrs:
+            current = self._endpoints[self._active]
+            self._endpoints = addrs
+            self._active = addrs.index(current) if current in addrs else 0
+        return list(self._endpoints)
+
     def _auth_header(self) -> str:
         return (f"Authorization: Bearer {self.token}\r\n"
                 if self.token else "")
@@ -1353,27 +1735,52 @@ class RemoteStore:
     connect_deadline_s = 30.0
 
     def _connect(self):
+        import random as _random
         import time as _time
 
         deadline = _time.monotonic() + self.connect_deadline_s
         delay = 0.05
+        fail_start = None
+        failed_over = False
         while True:
             remaining = deadline - _time.monotonic()
+            host, port = self._endpoints[self._active]
             try:
-                sock = socket.create_connection(
-                    (self.host, self.port), timeout=max(1.0, remaining))
+                timeout = max(1.0, remaining)
+                if len(self._endpoints) > 1:
+                    # replica set: a dead endpoint must fail FAST so the
+                    # next one gets tried inside the caller's patience
+                    timeout = min(timeout, 1.0)
+                sock = socket.create_connection((host, port),
+                                                timeout=timeout)
             except (ConnectionError, TimeoutError, OSError):
                 if _time.monotonic() + delay >= deadline:
                     raise
+                if fail_start is None:
+                    fail_start = _time.monotonic()
+                if len(self._endpoints) > 1:
+                    # failover: step to the next replica immediately; the
+                    # jittered backoff only ramps once the whole set has
+                    # been walked (all-down ≈ the old single-host retry)
+                    self._advance_endpoint()
+                    failed_over = True
+                    if self._active != 0:
+                        continue
                 # blocking HTTP core: runs on client threads (or inside
                 # to_thread), never on the event loop
-                _time.sleep(delay)  # ktpu: allow[blocking-in-async]
+                _time.sleep(  # ktpu: allow[blocking-in-async]
+                    delay * (0.5 + _random.random()))
                 delay = min(1.0, 2 * delay)
                 continue
+            if self.request_timeout_s is not None:
+                sock.settimeout(self.request_timeout_s)
+            if fail_start is not None and failed_over:
+                self.failover_total += 1
+                self.failover_samples.append(
+                    1e3 * (_time.monotonic() - fail_start))
             if self._ssl is not None:
                 try:
-                    return self._ssl.wrap_socket(sock,
-                                                 server_hostname=self.host)
+                    return self._ssl.wrap_socket(sock, server_hostname=host)
                 except Exception:
                     sock.close()
                     raise
@@ -1385,8 +1792,40 @@ class RemoteStore:
                  content_type: str | None = None):
         if self.rate_limiter is not None:
             self.rate_limiter.accept()
-        status, decoded, resp_headers = self._request_once(
-            method, path, body, content_type)
+        import time as _time
+
+        # replica failover: a mid-stream transport failure (reset, torn
+        # response, black-hole timeout) or a 503 from a draining replica
+        # retries on the next endpoint. Safe for non-idempotent verbs
+        # because the shared store absorbs duplicates — a replayed create
+        # answers AlreadyExists, a replayed bind answers Conflict, both of
+        # which every caller already handles (exactly-once is the STORE's
+        # guarantee, not the transport's).
+        attempts = 2 * len(self._endpoints) if len(self._endpoints) > 1 \
+            else 1
+        episode_start = None
+        for attempt in range(attempts):
+            try:
+                status, decoded, resp_headers = self._request_once(
+                    method, path, body, content_type)
+                if status == 503 and attempt < attempts - 1 \
+                        and len(self._endpoints) > 1:
+                    raise ConnectionError(
+                        decoded.get("message", "HTTP 503"))
+            except (ConnectionError, TimeoutError, OSError):
+                if len(self._endpoints) <= 1 or attempt == attempts - 1:
+                    raise
+                if episode_start is None:
+                    episode_start = _time.monotonic()
+                self._advance_endpoint()
+                continue
+            if episode_start is not None:
+                # one failover episode = first failure -> next success,
+                # however many endpoints it walked (the drill's p99)
+                self.failover_total += 1
+                self.failover_samples.append(
+                    1e3 * (_time.monotonic() - episode_start))
+            break
         if status == 400 and self._pb and body is not None \
                 and content_type is None:
             # codec-asymmetric fleet: a server without the codec can't
@@ -1670,36 +2109,86 @@ class RemoteStore:
             # async acquire: the sync accept() would park the event loop
             # this watch (and every other stream) runs on
             await self.rate_limiter.accept_async()
+        n = len(self._endpoints)
+        if n > 1:
+            # spread watches round-robin across the replica set (each
+            # replica's fan-out cache carries its share), walking the
+            # whole set before giving up so one dead replica never fails
+            # a watch open
+            start = self._watch_seq % n
+            self._watch_seq += 1
+            order = [(start + i) % n for i in range(n)]
+        else:
+            order = [self._active]
+        last_exc: Exception | None = None
+        for idx in order:
+            host, port = self._endpoints[idx]
+            try:
+                return await asyncio.wait_for(
+                    self._open_watch_at(host, port, plural, query),
+                    timeout=5.0 if n > 1 else None)
+            except (Expired, ValueError):
+                raise  # protocol answers: same on every replica
+            except (ConnectionError, OSError, asyncio.TimeoutError) as e:
+                last_exc = e
+                if n > 1:
+                    self.failover_total += 1
+                continue
+        raise ConnectionError(
+            f"no replica would serve the watch "
+            f"({len(order)} endpoint(s) tried)") from last_exc
+
+    async def _open_watch_at(self, host: str, port: int,
+                             plural: str, query: str):
         accept = (f"Accept: {wire.CONTENT_TYPE}, application/json\r\n"
                   if self._pb else "")
         reader, writer = await asyncio.open_connection(
-            self.host, self.port, ssl=self._ssl,
-            server_hostname=self.host if self._ssl is not None else None)
-        writer.write(f"GET /api/v1/{plural}?{query} HTTP/1.1\r\n"
-                     f"Host: {self.host}\r\n{self._auth_header()}{accept}"
-                     f"Connection: keep-alive\r\n\r\n"
-                     .encode())
-        await writer.drain()
-        status_line = await reader.readline()
-        status = int(status_line.split(None, 2)[1])
-        headers = {}
-        while True:
-            line = await reader.readline()
-            if line in (b"\r\n", b"\n", b""):
-                break
-            name, _, value = line.decode().partition(":")
-            headers[name.strip().lower()] = value.strip()
-        if status == 410:
-            length = int(headers.get("content-length", 0))
-            body = await reader.readexactly(length) if length else b"{}"
+            host, port, ssl=self._ssl,
+            server_hostname=host if self._ssl is not None else None)
+        try:
+            writer.write(f"GET /api/v1/{plural}?{query} HTTP/1.1\r\n"
+                         f"Host: {host}\r\n{self._auth_header()}{accept}"
+                         f"Connection: keep-alive\r\n\r\n"
+                         .encode())
+            await writer.drain()
+            status_line = await reader.readline()
+            try:
+                status = int(status_line.split(None, 2)[1])
+            except (IndexError, ValueError):
+                raise ConnectionError(
+                    "empty or non-HTTP watch handshake") from None
+            headers = {}
+            while True:
+                line = await reader.readline()
+                if line in (b"\r\n", b"\n", b""):
+                    break
+                name, _, value = line.decode().partition(":")
+                headers[name.strip().lower()] = value.strip()
+            if status == 410:
+                length = int(headers.get("content-length", 0))
+                body = await reader.readexactly(length) if length else b"{}"
+                raise Expired(json.loads(body).get("message", "410 Gone"))
+            if status == 503:
+                # draining replica: transport-level answer, try the next
+                raise ConnectionError("replica draining (503)")
+            if status != 200:
+                raise ValueError(f"watch failed: HTTP {status}")
+        except BaseException:
             writer.close()
-            raise Expired(json.loads(body).get("message", "410 Gone"))
-        if status != 200:
-            writer.close()
-            raise ValueError(f"watch failed: HTTP {status}")
+            raise
         binary = headers.get("content-type", "").startswith(
             wire.CONTENT_TYPE)
         return RemoteWatchStream(reader, writer, binary=binary)
+
+    def watch_resilient(self, kind: str | None = None,
+                        since: int | None = None) -> "FailoverWatch":
+        """A watch that survives replica death: tracks the last delivered
+        resourceVersion and transparently reopens on another endpoint with
+        `since=last_rv` when the stream dies in transport or the replica
+        drains — the consumer sees one gapless, duplicate-free event
+        sequence. An honest 410 (resume point aged out of every replica's
+        ring) still raises Expired: there is NO silent gap path."""
+        return FailoverWatch(self, kind, since)
 
 
 class _LazyWatch:

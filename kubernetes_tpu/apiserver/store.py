@@ -827,6 +827,20 @@ class ObjectStore:
             pass  # a full queue can't block in get(): the flag suffices
         _watch_evictions().inc()
 
+    def _detach_watcher(self, watcher: _Watcher) -> None:
+        """End one subscriber WITHOUT counting an eviction — the graceful
+        replica-drain path (the subscriber did nothing wrong; the eviction
+        counter must keep meaning "slow consumer")."""
+        try:
+            self._watchers.remove(watcher)
+        except ValueError:
+            return
+        watcher.evicted = True
+        try:
+            watcher.queue.put_nowait(_EVICTED)
+        except asyncio.QueueFull:
+            pass
+
     def watch(self, kind: str | None = None,
               since: int | None = None) -> "WatchStream":
         """Subscribe to events after resourceVersion `since` (None = now).

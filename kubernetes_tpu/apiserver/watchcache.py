@@ -30,6 +30,10 @@ log = logging.getLogger(__name__)
 
 # end-of-stream marker for evicted subscribers (same protocol as the store)
 _EVICTED = object()
+# end-of-stream marker for a graceful replica drain: the stream ends like
+# an eviction, but the consumer is told to RESUME from its last rv on
+# another replica instead of relisting (CacheWatchStream.drained)
+_DRAINED = object()
 
 _mx_evicted = None
 
@@ -133,9 +137,13 @@ class WatchCache:
 
     async def _pump(self) -> None:
         while True:
-            event = await self._stream.next(timeout=5.0)
+            stream = self._stream
+            if stream is None:
+                return  # stop() ran while we were ready-to-run; the
+                # CancelledError only lands at the next suspension point
+            event = await stream.next(timeout=5.0)
             if event is None:
-                if getattr(self._stream, "_stopped", False):
+                if getattr(stream, "_stopped", False):
                     await self._resubscribe()
                 continue
             self._ingest(event)
@@ -206,6 +214,20 @@ class WatchCache:
         self.evictions += 1
         _cache_evictions().inc()
 
+    def drain_subscribers(self) -> None:
+        """Graceful replica shutdown: end every subscription with the
+        DRAINED sentinel (wakes consumers blocked in next() immediately).
+        Not an eviction — subscribers resume from their last rv on another
+        replica rather than relisting."""
+        for w in self._workers:
+            for sub in list(w.subs):
+                w.subs.remove(sub)
+                sub.evicted = True
+                try:
+                    sub.queue.put_nowait(_DRAINED)
+                except asyncio.QueueFull:
+                    pass
+
     # ---- reads ----
 
     def get_cached(self, kind: str, name: str,
@@ -253,6 +275,9 @@ class CacheWatchStream:
     def __init__(self, sub: _CacheSub):
         self._sub = sub
         self._stopped = False
+        # True when the stream ended because the replica drained (resume
+        # elsewhere) rather than because this consumer was evicted (relist)
+        self.drained = False
 
     async def next(self, timeout: float | None = None) -> WatchEvent | None:
         if self._stopped:
@@ -266,6 +291,10 @@ class CacheWatchStream:
             else:
                 ev = await asyncio.wait_for(self._sub.queue.get(), timeout)
         except asyncio.TimeoutError:
+            return None
+        if ev is _DRAINED:
+            self._stopped = True
+            self.drained = True
             return None
         if ev is _EVICTED:
             self._stopped = True  # stream over: the consumer must relist

@@ -51,6 +51,12 @@ def _metrics(kind: str) -> tuple:
                                "Relists after a watch ended, expired, or "
                                "the list/watch cycle failed.",
                                ("kind",)).labels(kind),
+            m.REGISTRY.counter("informer_failover_resumes_total",
+                               "Watches resumed from the last delivered "
+                               "resourceVersion after a transport failure "
+                               "(replica failover) — the cheap path that "
+                               "spares a full relist.",
+                               ("kind",)).labels(kind),
         )
         _reflector_mx[kind] = mx
     return mx
@@ -74,6 +80,13 @@ class Informer:
         # server Retry-After hint from the last failed cycle: the next
         # relist waits at least this long, whatever the local backoff says
         self._retry_hint = 0.0
+        # HA failover: last delivered resourceVersion + whether the last
+        # cycle died in TRANSPORT (replica killed/drained mid-stream) —
+        # only then is resume-from-rv attempted before a full relist. A
+        # clean stream end (evicted slow consumer, expired resume point)
+        # keeps the relist contract.
+        self._last_rv: int | None = None
+        self._resume_next = False
 
     def add_handler(self, handler: Handler) -> None:
         self._handlers.append(handler)
@@ -115,12 +128,15 @@ class Informer:
                 # store hiccup don't stampede it in lockstep; an APF
                 # Retry-After hint from the last 429 sets the floor — the
                 # server knows its queue depth better than local doubling
-                _metrics(self.kind)[3].inc()
                 delay = self._backoff_next()
                 hint, self._retry_hint = self._retry_hint, 0.0
                 await asyncio.sleep(
                     max(hint, delay * (0.5 + self._rng.random())))
+                if self._resume_next and await self._try_resume():
+                    continue
+                _metrics(self.kind)[3].inc()
             first = False
+            self._resume_next = False
             try:
                 await self._list_and_watch()
                 # clean watch end (expired resume point or evicted as a
@@ -131,8 +147,57 @@ class Informer:
             except Exception as e:  # noqa: BLE001 — reflector loops survive anything
                 self._retry_hint = float(
                     getattr(e, "retry_after", 0.0) or 0.0)
-                log.exception("informer %s: list/watch failed; relisting",
-                              self.kind)
+                self._resume_next = isinstance(
+                    e, (ConnectionError, TimeoutError, asyncio.TimeoutError))
+                log.exception(
+                    "informer %s: list/watch failed; %s", self.kind,
+                    "resuming from last rv" if self._resume_next
+                    else "relisting")
+
+    async def _try_resume(self) -> bool:
+        """Failover resume: after a watch died in transport (its replica
+        was killed or drained), try a watch from the last delivered rv —
+        the replica-aware RemoteStore opens it on a surviving endpoint —
+        before paying for a full relist. False (Expired/transport failure
+        on the new endpoint too) falls back to the relist path."""
+        if self._last_rv is None:
+            return False
+        mx = _metrics(self.kind)
+        try:
+            stream = self.store.watch(self.kind, since=self._last_rv)
+        except (Expired, ConnectionError, OSError, ValueError):
+            return False
+        try:
+            # the first next() surfaces a deferred handshake failure
+            # (_LazyWatch): 410 -> Expired, dead endpoint -> ConnectionError
+            event = await stream.next(timeout=1.0)
+        except (Expired, ConnectionError, TimeoutError,
+                asyncio.TimeoutError, OSError, ValueError):
+            stream.stop()
+            return False
+        mx[4].inc()
+        self._relist_delay = self._backoff_initial  # healthy again
+        self._resume_next = False
+        try:
+            while True:
+                if event is not None:
+                    self._last_rv = event.resource_version
+                    self._apply(event)
+                    self._dispatch(event)
+                event = await stream.next()
+                if event is None:  # clean stream end -> relist contract
+                    return True
+        except (ConnectionError, TimeoutError, asyncio.TimeoutError):
+            self._resume_next = True  # died in transport again: re-resume
+            return True
+        except asyncio.CancelledError:
+            raise
+        except Exception:  # noqa: BLE001
+            log.exception("informer %s: resumed watch failed; relisting",
+                          self.kind)
+            return True
+        finally:
+            stream.stop()
 
     async def _list_and_watch(self) -> None:
         import time
@@ -153,6 +218,7 @@ class Informer:
         self.cache = dict(fresh)
         self._synced.set()
         self._relist_delay = self._backoff_initial  # healthy again
+        self._last_rv = rv
         mx[0].inc()
         mx[1].observe(time.monotonic() - t_list)
 
@@ -163,6 +229,7 @@ class Informer:
         mx[2].inc()
         try:
             async for event in stream:
+                self._last_rv = event.resource_version
                 self._apply(event)
                 self._dispatch(event)
         finally:

@@ -113,17 +113,27 @@ class LeaderElector:
         try:
             obj = self.store.get("Endpoints", self.lock_name,
                                  self.lock_namespace)
-        except (NotFound, TooManyRequests):
-            # a throttled read is a failed attempt, not a crash: the
-            # acquire/renew loop retries on its jittered period
+        except NotFound:
             return None
+        except (TooManyRequests, ConnectionError, TimeoutError):
+            # a throttled read — or a dead/draining replica the client is
+            # mid-failover around — is a failed attempt, not a crash AND
+            # not "no record" (treating it as absent would race a create
+            # against the real holder): the acquire/renew loop retries on
+            # its jittered period, and the deadline anchors to the last
+            # SUCCESSFUL renew, so leadership survives any outage shorter
+            # than renew_deadline
+            raise _Unavailable() from None
         raw = obj.metadata.annotations.get(LEADER_ANNOTATION)
         return LeaderElectionRecord.from_json(raw) if raw else None
 
     def _try_acquire_or_renew(self, now: float) -> bool:
         """One acquire-or-renew attempt (tryAcquireOrRenew,
         leaderelection.go:210). Returns True while holding the lease."""
-        current = self._get_record()
+        try:
+            current = self._get_record()
+        except _Unavailable:
+            return False
         if current is None:
             record = LeaderElectionRecord(
                 holder_identity=self.identity,
@@ -156,8 +166,10 @@ class LeaderElector:
                 return True
             except AlreadyExists:
                 pass  # raced another candidate: fall through to CAS update
-            except TooManyRequests:
-                return False  # throttled: this attempt failed, retry later
+            except (TooManyRequests, ConnectionError, TimeoutError):
+                # throttled, or a dead replica mid-failover: this attempt
+                # failed, retry on the jittered period
+                return False
 
         def mutate(obj):
             # re-check under the CAS: a racing writer may have renewed
@@ -174,7 +186,8 @@ class LeaderElector:
             self.store.guaranteed_update("Endpoints", self.lock_name,
                                          self.lock_namespace, mutate)
             return True
-        except (_Lost, Conflict, NotFound, TooManyRequests):
+        except (_Lost, Conflict, NotFound, TooManyRequests,
+                ConnectionError, TimeoutError):
             return False
 
     # ---- run loop ----
@@ -230,3 +243,9 @@ class LeaderElector:
 
 class _Lost(Exception):
     pass
+
+
+class _Unavailable(Exception):
+    """The lock store couldn't be reached at all — distinct from "no
+    record" (which would trigger a racing create) and from "held by
+    another" (which would reset the acquire clock)."""

@@ -63,6 +63,19 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--audit-log-path", default="")
     p.add_argument("--max-requests-inflight", type=int, default=400)
     p.add_argument("--watch-cache-size", type=int, default=1 << 16)
+    # HA: N stateless replicas over one shared store (same --wal)
+    p.add_argument("--replica-id", default="",
+                   help="this replica's identity in an HA control plane "
+                        "(defaults to host:port)")
+    p.add_argument("--advertise", action="store_true",
+                   help="publish this replica's host:port into the "
+                        "default/kubernetes Endpoints object (the endpoint "
+                        "reconciler) so replica-aware clients discover the "
+                        "full set; removed again on graceful shutdown")
+    p.add_argument("--shutdown-drain-seconds", type=float, default=5.0,
+                   help="graceful-shutdown budget: readyz 503s, in-flight "
+                        "requests finish, watchers get the terminal DRAIN "
+                        "frame before the process exits")
     return p.parse_args(argv)
 
 
@@ -140,21 +153,31 @@ def build_server(args):
         max_in_flight=args.max_requests_inflight,
         tls_cert_file=args.tls_cert_file or None,
         tls_key_file=args.tls_private_key_file or None,
-        client_ca_file=args.client_ca_file or None)
+        client_ca_file=args.client_ca_file or None,
+        replica_id=getattr(args, "replica_id", ""))
     return server, store
 
 
 async def run(args) -> None:
     server, _store = build_server(args)
     await server.start()
+    advertise = getattr(args, "advertise", False)
+    if advertise:
+        server.advertise()
     scheme = "https" if args.tls_cert_file else "http"
-    log.info("apiserver serving on %s://%s:%d (wal=%s)",
-             scheme, server.host, server.port, args.wal or "<memory>")
+    log.info("apiserver serving on %s://%s:%d (wal=%s, replica=%s)",
+             scheme, server.host, server.port, args.wal or "<memory>",
+             server.replica_id or "-")
     print(f"READY {scheme}://{server.host}:{server.port}", flush=True)
     try:
         await asyncio.Event().wait()  # serve until killed
     finally:
-        await server.stop()
+        # graceful exit: deregister from discovery, then drain — readyz
+        # 503s, in-flight finishes, watchers get the terminal DRAIN frame
+        # telling them to resume from their last rv on another replica
+        if advertise:
+            server.unadvertise()
+        await server.drain(getattr(args, "shutdown_drain_seconds", 5.0))
 
 
 def main(argv=None) -> int:

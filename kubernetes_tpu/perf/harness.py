@@ -994,6 +994,264 @@ def run_overload(n_nodes: int = 64, n_pods: int = 256, seed: int = 2026,
 
 
 @dataclass
+class RollingRestartResult:
+    """Rolling-restart chaos drill: 3 stateless apiserver replicas over one
+    shared store serve a live scheduler + informer + watcher workload while
+    every replica is killed once mid-flight — hard (SIGKILL-style transport
+    aborts) and graceful (drain: readyz 503, in-flight finishes, watchers
+    get the terminal DRAIN frame) — then restarted. The control plane must
+    come out exactly-once and gapless: every pod bound once, zero racy
+    read-modify-writes, zero loop stalls past 100ms, and the dedicated
+    watcher's resourceVersion stream equal to the store's authoritative
+    Pod history — no gap, no duplicate — across every failover."""
+
+    nodes: int
+    pods: int
+    seed: int
+    replicas: int
+    bound: int
+    double_binds: int
+    failovers: int
+    failover_p99_ms: float
+    resumes: int          # informer resume-from-rv successes (cheap path)
+    relists: int          # informer full relists during the drill
+    watch_resumes: int    # dedicated watcher's transport-level resumes
+    watch_events: int
+    watch_gaps: int
+    watch_dupes: int
+    converged: bool
+    racy_writes: int = 0
+    loop_stalls: int = 0
+    max_stall_ms: float = 0.0
+    replica_faults: list = field(default_factory=list)
+
+    @property
+    def gate(self) -> bool:
+        """The drill's whole contract in one bool (the bench's gate)."""
+        return (self.converged and self.double_binds == 0
+                and self.racy_writes == 0 and self.loop_stalls == 0
+                and self.watch_gaps == 0 and self.watch_dupes == 0
+                and self.watch_resumes >= 1)
+
+    def __str__(self) -> str:
+        return (f"rolling-restart R={self.replicas} N={self.nodes} "
+                f"P={self.pods}: {self.bound}/{self.pods} bound, "
+                f"{len(self.replica_faults)} faults, "
+                f"{self.failovers} failovers p99 "
+                f"{self.failover_p99_ms:.1f}ms, resumes/relists "
+                f"{self.resumes}/{self.relists}, watch "
+                f"{self.watch_events} events {self.watch_gaps} gaps "
+                f"{self.watch_dupes} dupes")
+
+
+def run_rolling_restart(n_nodes: int = 16, n_pods: int = 96,
+                        seed: int = 2027, replicas: int = 3,
+                        race_detect: bool = True) -> RollingRestartResult:
+    """Blocking entry point for the rolling-restart HA drill.
+
+    Topology: a ReplicaSet of `replicas` APIServers (watch cache on) over
+    ONE seeded FaultPlane (plus RaceDetector + loop-stall watchdog when
+    `race_detect`) on a background serving loop; the scheduler, a pod
+    creator, and a dedicated resourceVersion-recording watcher all drive
+    it over TCP through replica-aware RemoteStores. Replica injuries fire
+    through the FaultPlane's seeded action schedule — op-indexed, so each
+    one lands at the same point of the workload on replay — at the 1/4,
+    1/2 and 3/4 pod-creation milestones: hard kill, graceful drain, hard
+    kill. Each victim is restarted on its original port before the next
+    injury, the rolling shape."""
+    import threading
+
+    from kubernetes_tpu.api.objects import Node
+    from kubernetes_tpu.apiserver.auth import TokenAuthenticator, UserInfo
+    from kubernetes_tpu.apiserver.store import AlreadyExists, TooManyRequests
+    from kubernetes_tpu.client.informer import _metrics
+    from kubernetes_tpu.testing.faults import FaultPlane
+    from kubernetes_tpu.testing.races import LoopStallWatchdog, RaceDetector
+    from kubernetes_tpu.testing.replicas import ReplicaSet
+
+    cap = {"cpu": "16", "memory": "32Gi", "pods": "110"}
+    inner = ObjectStore(watch_window=max(1 << 16, 8 * (n_pods + n_nodes)))
+    for i in range(n_nodes):
+        inner.create(Node.from_dict({
+            "metadata": {"name": f"ha-{i}",
+                         "labels": {"kubernetes.io/hostname": f"ha-{i}"}},
+            "status": {"allocatable": dict(cap), "capacity": dict(cap)}}))
+    plane = FaultPlane(inner, seed=seed)
+    server_store = RaceDetector(plane) if race_detect else plane
+    auth = TokenAuthenticator({
+        "sched-token": UserInfo("system:kube-scheduler",
+                                ("system:authenticated",))})
+
+    # same reasoning as run_overload: freeze the pre-drill heap so gen2 GC
+    # passes only walk what the drill itself allocates
+    import gc
+    gc.collect()
+    gc.freeze()
+
+    rs = ReplicaSet(server_store, n=replicas, watch_cache=True,
+                    authenticator=auth).start()
+    for i, control in enumerate(rs.controls()):
+        plane.attach_replica(i, control)
+    watchdog_box: dict = {}
+    if race_detect:
+        rs._call(lambda: watchdog_box.update(
+            dog=LoopStallWatchdog().start()))
+
+    async def drive() -> RollingRestartResult:
+        caps = Capacities(num_nodes=1 << max(6, (n_nodes - 1).bit_length()),
+                          batch_pods=min(64, max(16, n_pods)))
+        sched_client = rs.client(token="sched-token")
+        creator = rs.client(token="sched-token")
+        watcher_client = rs.client(token="sched-token")
+        mx = _metrics("Pod")
+        relists0, resumes0 = mx[3].value, mx[4].value
+        sched = Scheduler(sched_client, caps=caps)
+        loop = asyncio.get_running_loop()
+        driver = loop.create_task(sched.run())
+
+        # the coherence witness: one logical watch across the whole
+        # replica set, recording every (type, resourceVersion) it delivers
+        observed: list[tuple[str, int]] = []
+        watcher = watcher_client.watch_resilient("Pod", since=0)
+        watch_stop = asyncio.Event()
+
+        async def observe() -> None:
+            while not watch_stop.is_set():
+                try:
+                    ev = await watcher.next(timeout=0.5)
+                except ConnectionError:
+                    return  # every endpoint stayed dead past the deadline
+                if ev is not None:
+                    observed.append((ev.type, ev.resource_version))
+
+        observer = loop.create_task(observe())
+
+        def create_with_retry(pod) -> None:
+            while True:
+                try:
+                    creator.create(pod)
+                    return
+                except AlreadyExists:
+                    # a failover replay: the first send landed before its
+                    # replica died — the shared store already has the pod,
+                    # which is exactly the exactly-once contract
+                    return
+                except TooManyRequests as e:
+                    # runs under asyncio.to_thread — never on the event loop
+                    time.sleep(max(0.05, getattr(e, "retry_after", 0.0)))  # ktpu: allow[blocking-in-async]
+
+        async def wait_bound(expect: int, timeout_s: float) -> bool:
+            deadline = time.monotonic() + timeout_s
+            while time.monotonic() < deadline:
+                pods = await asyncio.to_thread(creator.list, "Pod")
+                if sum(1 for p in pods if p.spec.node_name) >= expect:
+                    return True
+                await asyncio.sleep(0.1)
+            return False
+
+        async def wait_fault(count: int) -> None:
+            # the scheduled injury fires inside a store tick on the
+            # serving loop; wait until it has actually landed before
+            # restarting the victim
+            deadline = time.monotonic() + 30
+            while len(plane.stats.replica_faults) < count \
+                    and time.monotonic() < deadline:
+                await asyncio.sleep(0.01)
+
+        async def restart_replica(idx: int) -> None:
+            # a draining victim closes its listener early but stops late:
+            # wait for the port to free before rebinding it
+            deadline = time.monotonic() + 15
+            while rs.servers[idx]._server is not None \
+                    and time.monotonic() < deadline:
+                await asyncio.sleep(0.01)
+            await asyncio.to_thread(rs.restart, idx)
+
+        # injuries at pod-creation milestones, fired via the seeded action
+        # schedule (op-indexed: the next store op pulls the trigger)
+        milestones = {
+            n_pods // 4: ("kill", 0),
+            n_pods // 2: ("drain", 1),
+            (3 * n_pods) // 4: ("kill", 2),
+        }
+        faults_seen = 0
+        for i, pod in enumerate(make_pods(n_pods, cpu="100m",
+                                          memory="64Mi",
+                                          name_prefix="ha")):
+            injury = milestones.get(i)
+            if injury is not None:
+                kind, victim = injury
+                if kind == "kill":
+                    plane.schedule(
+                        plane.stats.ops + 1,
+                        lambda p, v=victim: p.kill_replica(v),
+                        f"kill-replica-{victim}")
+                else:
+                    plane.schedule(
+                        plane.stats.ops + 1,
+                        lambda p, v=victim: p.drain_replica(v),
+                        f"drain-replica-{victim}")
+                faults_seen += 1
+                await asyncio.to_thread(create_with_retry, pod)
+                await wait_fault(faults_seen)
+                await restart_replica(victim)
+            else:
+                await asyncio.to_thread(create_with_retry, pod)
+        conv = await wait_bound(n_pods, 240)
+
+        # fence the coherence check at a fixed revision, then let the
+        # watcher catch up to it before comparing against the store's
+        # authoritative history
+        fence_rv = inner.resource_version
+        deadline = time.monotonic() + 30
+        while (watcher.last_rv or 0) < fence_rv \
+                and time.monotonic() < deadline \
+                and not observer.done():
+            await asyncio.sleep(0.05)
+        watch_stop.set()
+        watcher.stop()
+        observer.cancel()
+        driver.cancel()
+        sched.stop()
+
+        expected = [e.resource_version for e in inner._history
+                    if e.kind == "Pod" and e.resource_version <= fence_rv]
+        got = [rv for _, rv in observed if rv <= fence_rv]
+        gaps = len(set(expected) - set(got))
+        dupes = len(got) - len(set(got))
+        double = sum(1 for v in plane.bind_counts.values() if v > 1)
+        samples = (list(sched_client.failover_samples)
+                   + list(creator.failover_samples)
+                   + list(watcher_client.failover_samples))
+        return RollingRestartResult(
+            nodes=n_nodes, pods=n_pods, seed=seed, replicas=replicas,
+            bound=len(plane.bind_counts), double_binds=double,
+            failovers=(sched_client.failover_total
+                       + creator.failover_total
+                       + watcher_client.failover_total),
+            failover_p99_ms=_p99_ms([s / 1e3 for s in samples]),
+            resumes=int(mx[4].value - resumes0),
+            relists=int(mx[3].value - relists0),
+            watch_resumes=watcher.resumes,
+            watch_events=len(got), watch_gaps=gaps, watch_dupes=dupes,
+            converged=(conv and double == 0
+                       and len(plane.bind_counts) >= n_pods),
+            racy_writes=len(server_store.racy_writes) if race_detect else 0,
+            replica_faults=list(plane.stats.replica_faults))
+
+    try:
+        result = asyncio.run(drive())
+    finally:
+        stalls = rs._call(watchdog_box["dog"].stop) \
+            if watchdog_box else []
+        rs.stop()
+        gc.unfreeze()
+    result.loop_stalls = len(stalls)
+    result.max_stall_ms = 1e3 * max(stalls, default=0.0)
+    return result
+
+
+@dataclass
 class FanoutResult:
     """Watch-cache fan-out drill: N subscribers, M store events, and the
     proof that the store did O(M) work — `store_fanout_puts` counts one

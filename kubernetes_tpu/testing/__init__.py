@@ -1,3 +1,4 @@
 from kubernetes_tpu.testing.framework import ClusterFixture  # noqa: F401
 from kubernetes_tpu.testing.chaos import ChaosMonkey  # noqa: F401
 from kubernetes_tpu.testing.faults import FaultPlane, SolveFault  # noqa: F401
+from kubernetes_tpu.testing.replicas import ReplicaSet  # noqa: F401

@@ -69,6 +69,7 @@ class FaultStats:
     solve_faults: int = 0
     actions_fired: list = field(default_factory=list)
     floods: list = field(default_factory=list)     # noisy-tenant bursts
+    replica_faults: list = field(default_factory=list)  # HA drill injuries
 
     @property
     def injected_total(self) -> int:
@@ -112,6 +113,11 @@ class FaultPlane:
         # — the overload harness installs the traffic generator here
         self.flood_hook: Callable[[str, float, random.Random], Any] | None \
             = None
+        # HA: per-replica controls registered by attach_replica() — any
+        # object exposing kill()/drain()/refuse(on)/black_hole(on)
+        # (testing.replicas.ReplicaSet hands out compatible handles), so
+        # the seeded action schedule can injure a SPECIFIC replica
+        self.replicas: dict[int, Any] = {}
 
     # ---- schedule-driven disruptions ----
 
@@ -133,6 +139,40 @@ class FaultPlane:
         informers must notice and relist)."""
         for watcher in list(self.inner._watchers):
             self.inner._evict_watcher(watcher)
+
+    # ---- per-replica targeting (HA drills) ----
+
+    def attach_replica(self, index: int, control: Any) -> None:
+        """Register one replica's control handle (kill/drain/refuse/
+        black_hole) under an index the action schedule can name."""
+        self.replicas[index] = control
+
+    def kill_replica(self, index: int) -> None:
+        """SIGKILL-style: abort the replica's listener and every open
+        connection NOW (clients see resets mid-stream)."""
+        self.stats.replica_faults.append({"replica": index, "kind": "kill"})
+        self.replicas[index].kill()
+
+    def drain_replica(self, index: int) -> None:
+        """Graceful shutdown: readyz 503 first, in-flight requests finish,
+        watchers get the terminal DRAIN frame."""
+        self.stats.replica_faults.append({"replica": index, "kind": "drain"})
+        self.replicas[index].drain()
+
+    def refuse_replica(self, index: int, on: bool = True) -> None:
+        """Close (or reopen) the replica's listener: new connections are
+        refused, established ones keep serving — the half-dead shape a
+        crashed accept loop produces."""
+        self.stats.replica_faults.append(
+            {"replica": index, "kind": "refuse", "on": on})
+        self.replicas[index].refuse(on)
+
+    def black_hole_replica(self, index: int, on: bool = True) -> None:
+        """Accept connections but never answer a byte — the worst failure
+        mode: only client-side I/O timeouts detect it."""
+        self.stats.replica_faults.append(
+            {"replica": index, "kind": "black_hole", "on": on})
+        self.replicas[index].black_hole(on)
 
     def flood(self, flow: str, rate_multiplier: float) -> None:
         """Noisy-tenant burst: drive `flow`'s request rate to

@@ -1,0 +1,253 @@
+"""N stateless apiserver replicas over ONE shared ObjectStore.
+
+The HA control-plane topology packaged for drills and tests: the
+reference's N-apiservers-over-shared-etcd shape, where every replica has
+its own watch cache, APF queues and obs mux, and coherence comes from the
+store's single resourceVersion sequence. The ReplicaSet owns the serving
+side; clients talk HTTP through a replica-aware RemoteStore built from
+`endpoints`/`client()`.
+
+Single-loop discipline: the shared ObjectStore's watch fan-out
+(asyncio.Queue) is loop-affine, so ALL replicas serve on ONE background
+event loop — isolation between replicas is the HTTP boundary, exactly as
+N processes over one etcd are isolated by the network. Every control
+method (`kill`, `drain`, `refuse`, `black_hole`, `restart`) marshals onto
+that loop and is safe to call from the client thread.
+
+`control(i)` hands out FaultPlane-compatible handles for
+`FaultPlane.attach_replica`, so the seeded action schedule can injure a
+specific replica mid-workload:
+
+    with ReplicaSet(store, n=3, watch_cache=True) as rs:
+        plane.attach_replica(0, rs.control(0))
+        plane.schedule(200, lambda p: p.kill_replica(0), "kill-r0")
+        remote = rs.client()          # fails over across all 3
+"""
+
+from __future__ import annotations
+
+import asyncio
+import concurrent.futures
+import threading
+from typing import Any
+
+from kubernetes_tpu.apiserver.http import APIServer, RemoteStore
+from kubernetes_tpu.apiserver.store import ObjectStore
+
+
+class ReplicaControl:
+    """One replica's injury handle (the FaultPlane.attach_replica shape:
+    kill/drain/refuse/black_hole, all thread-safe)."""
+
+    def __init__(self, replica_set: "ReplicaSet", index: int):
+        self._rs = replica_set
+        self.index = index
+
+    def kill(self) -> None:
+        self._rs.kill(self.index)
+
+    def drain(self, timeout: float | None = None) -> None:
+        self._rs.drain(self.index, timeout)
+
+    def refuse(self, on: bool = True) -> None:
+        self._rs.refuse(self.index, on)
+
+    def black_hole(self, on: bool = True) -> None:
+        self._rs.black_hole(self.index, on)
+
+
+class ReplicaSet:
+    """N APIServer replicas over one shared store, one serving loop."""
+
+    def __init__(self, store: Any = None, n: int = 3,
+                 host: str = "127.0.0.1", watch_cache: bool = True,
+                 drain_timeout: float = 5.0, advertise: bool = True,
+                 **server_kwargs):
+        # `store` may be the raw ObjectStore or any proxy over it
+        # (FaultPlane, RaceDetector) — exactly like APIServer itself
+        self.store = store if store is not None else ObjectStore()
+        self.n = n
+        self.host = host
+        self.watch_cache = watch_cache
+        self.drain_timeout = drain_timeout
+        self.advertise = advertise
+        self.server_kwargs = server_kwargs
+        self.servers: list[APIServer] = []
+        self.loop: asyncio.AbstractEventLoop | None = None
+        self._ports: list[int] = []
+        self._thread: threading.Thread | None = None
+        self._started = threading.Event()
+        self._startup_error: BaseException | None = None
+
+    # ---- lifecycle ----
+
+    def start(self) -> "ReplicaSet":
+        def serve():
+            async def main():
+                self.loop = asyncio.get_running_loop()
+                shutdown = asyncio.Event()
+                self._shutdown = shutdown
+                try:
+                    for i in range(self.n):
+                        server = self._make_server(i, port=0)
+                        await server.start()
+                        if self.advertise:
+                            server.advertise()
+                        self.servers.append(server)
+                        self._ports.append(server.port)
+                except BaseException as e:  # surface to the caller thread
+                    self._startup_error = e
+                    self._started.set()
+                    raise
+                self._started.set()
+                await shutdown.wait()
+                for server in self.servers:
+                    try:
+                        await server.stop()
+                    except Exception:
+                        pass
+
+            asyncio.run(main())
+
+        self._thread = threading.Thread(
+            target=serve, name="ktpu-replicaset", daemon=True)
+        self._thread.start()
+        if not self._started.wait(timeout=10.0):
+            raise RuntimeError("replica set failed to start within 10s")
+        if self._startup_error is not None:
+            raise RuntimeError("replica startup failed") \
+                from self._startup_error
+        return self
+
+    def stop(self) -> None:
+        if self.loop is not None and not self.loop.is_closed():
+            try:
+                self.loop.call_soon_threadsafe(self._shutdown.set)
+            except RuntimeError:
+                pass  # loop already closing
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+
+    def __enter__(self) -> "ReplicaSet":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    def _make_server(self, index: int, port: int) -> APIServer:
+        return APIServer(self.store, host=self.host, port=port,
+                         watch_cache=self.watch_cache,
+                         replica_id=f"replica-{index}",
+                         **self.server_kwargs)
+
+    # ---- addressing ----
+
+    @property
+    def endpoints(self) -> list[tuple[str, int]]:
+        """The full (host, port) set — ports are stable across restart()."""
+        return [(self.host, p) for p in self._ports]
+
+    def client(self, **kw) -> RemoteStore:
+        """A replica-aware RemoteStore over every endpoint."""
+        return RemoteStore(self.host, self._ports[0],
+                           endpoints=self.endpoints, **kw)
+
+    def control(self, index: int) -> ReplicaControl:
+        return ReplicaControl(self, index)
+
+    def controls(self) -> list[ReplicaControl]:
+        return [ReplicaControl(self, i) for i in range(self.n)]
+
+    # ---- loop marshalling ----
+
+    def _on_loop(self) -> bool:
+        """True when the caller is already the serving loop — a FaultPlane
+        action firing inside a store tick. Blocking on a future there
+        would deadlock the loop against itself."""
+        try:
+            return asyncio.get_running_loop() is self.loop
+        except RuntimeError:
+            return False
+
+    def _call(self, fn, timeout: float = 10.0) -> Any:
+        """Run sync `fn()` on the serving loop, wait for the result."""
+        assert self.loop is not None, "replica set not started"
+        if self._on_loop():
+            return fn()
+        fut: concurrent.futures.Future = concurrent.futures.Future()
+
+        def run():
+            try:
+                fut.set_result(fn())
+            except BaseException as e:  # noqa: BLE001 — relay to caller
+                fut.set_exception(e)
+
+        self.loop.call_soon_threadsafe(run)
+        return fut.result(timeout=timeout)
+
+    # ---- per-replica injuries / lifecycle ----
+
+    def kill(self, index: int) -> None:
+        """SIGKILL-style: abort the replica's listener and every open
+        connection (clients see mid-stream resets)."""
+        server = self.servers[index]
+        self._call(server.kill)
+
+    def drain(self, index: int, timeout: float | None = None) -> None:
+        """Graceful shutdown: readyz 503s, in-flight finishes, watchers
+        get the terminal DRAIN frame. Blocks until the drain completes."""
+        server = self.servers[index]
+        t = self.drain_timeout if timeout is None else timeout
+        assert self.loop is not None, "replica set not started"
+        if self._on_loop():
+            # fired from a store tick on the serving loop (a scheduled
+            # FaultPlane action): run the drain as a task — blocking here
+            # would deadlock the loop the drain needs
+            self.loop.create_task(server.drain(t))
+            return
+        asyncio.run_coroutine_threadsafe(
+            server.drain(t), self.loop).result(timeout=t + 5.0)
+
+    def refuse(self, index: int, on: bool = True) -> None:
+        """Close (reopen) the listener only: new connections are refused,
+        established ones keep serving — the half-dead accept-loop shape."""
+        server = self.servers[index]
+        if on:
+            def close():
+                if server._server is not None:
+                    server._server.close()
+                    server._server = None
+
+            self._call(close)
+        else:
+            assert self.loop is not None, "replica set not started"
+            asyncio.run_coroutine_threadsafe(
+                server.start(), self.loop).result(timeout=10.0)
+
+    def black_hole(self, index: int, on: bool = True) -> None:
+        """Accept but never answer — only client I/O timeouts detect it."""
+        server = self.servers[index]
+
+        def flip():
+            server._black_holed = on
+
+        self._call(flip)
+
+    def restart(self, index: int) -> APIServer:
+        """Bring a fresh stateless replica up on the SAME port (so static
+        endpoint lists stay valid): new process state, same shared store —
+        the rolling-restart recovery step."""
+        port = self._ports[index]
+
+        async def bring_up():
+            server = self._make_server(index, port=port)
+            await server.start()
+            if self.advertise:
+                server.advertise()
+            return server
+
+        assert self.loop is not None, "replica set not started"
+        new = asyncio.run_coroutine_threadsafe(
+            bring_up(), self.loop).result(timeout=10.0)
+        self.servers[index] = new
+        return new

@@ -1,0 +1,428 @@
+"""HA control plane: N stateless apiserver replicas over one shared store.
+
+Covers the tentpole's coherence contract (a watcher resuming on a
+DIFFERENT replica from rv R gets exactly the post-R events, or an honest
+410 — never a silent gap, never a duplicate), the replica-aware
+RemoteStore's failover matrix (connect refused / mid-stream cut / black
+hole / 410 on resume), graceful drain's watcher handoff (the terminal
+DRAIN frame), endpoint discovery through the well-known Endpoints object,
+APF policy propagation across replicas, leader-election renew surviving a
+dead replica, informer resume-before-relist accounting, the FaultPlane's
+per-replica targeting under the seeded action schedule, and the
+rolling-restart chaos drill (plus its bench[ha] --smoke twin from outside
+the process).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import pytest
+
+from kubernetes_tpu.api.objects import (
+    FlowSchema,
+    ObjectMeta,
+    Pod,
+    PriorityLevelConfiguration,
+)
+from kubernetes_tpu.apiserver.auth import UserInfo
+from kubernetes_tpu.apiserver.http import RemoteStore
+from kubernetes_tpu.apiserver.store import AlreadyExists, Expired, ObjectStore
+from kubernetes_tpu.client.informer import Informer, _metrics
+from kubernetes_tpu.client.leaderelection import LeaderElector
+from kubernetes_tpu.testing.faults import FaultPlane
+from kubernetes_tpu.testing.replicas import ReplicaSet
+
+
+def _pod(name: str) -> Pod:
+    return Pod.from_dict({
+        "metadata": {"name": name},
+        "spec": {"containers": [{"name": "c", "resources": {
+            "requests": {"cpu": "100m", "memory": "64Mi"}}}]}})
+
+
+# ---- cross-replica watch coherence (the tentpole's core claim) ----
+
+
+def test_cross_replica_resume_parity():
+    """Consume a watch up to rv X on one replica, resume from X on a
+    DIFFERENT replica: exactly the post-X events arrive, in order —
+    coherence comes from the shared store's resourceVersions, not from
+    any replica-local state."""
+    store = ObjectStore()
+    with ReplicaSet(store, n=2, watch_cache=True) as rs:
+        (h0, p0), (h1, p1) = rs.endpoints
+        a = RemoteStore(h0, p0)
+        b = RemoteStore(h1, p1)
+        rv0 = store.resource_version
+        for i in range(6):
+            a.create(_pod(f"par-{i}"))
+
+        async def run():
+            wa = a.watch("Pod", since=rv0)
+            seen = []
+            for _ in range(3):  # stop mid-history on replica 0
+                ev = await wa.next(timeout=5.0)
+                seen.append((ev.type, ev.obj.metadata.name,
+                             ev.resource_version))
+            cut = seen[-1][2]
+            wa.stop()
+            wb = b.watch("Pod", since=cut)  # resume on replica 1
+            for _ in range(3):
+                ev = await wb.next(timeout=5.0)
+                seen.append((ev.type, ev.obj.metadata.name,
+                             ev.resource_version))
+            wb.stop()
+            return seen
+
+        seen = asyncio.run(run())
+    names = [n for _, n, _ in seen]
+    rvs = [rv for _, _, rv in seen]
+    assert names == [f"par-{i}" for i in range(6)]  # no gap, no duplicate
+    assert rvs == sorted(set(rvs))
+
+
+def test_resume_too_old_is_honest_410():
+    """A resume point that predates every replica's window raises Expired
+    (HTTP 410) on whichever replica gets asked — the relist contract,
+    never a silent gap."""
+    store = ObjectStore(watch_window=8)
+    with ReplicaSet(store, n=2, watch_cache=True) as rs:
+        remote = rs.client()
+        rv0 = store.resource_version
+        for i in range(20):  # roll rv0 out of the 8-event window
+            remote.create(_pod(f"old-{i}"))
+
+        async def run():
+            for host, port in rs.endpoints:
+                one = RemoteStore(host, port)
+                with pytest.raises(Expired):
+                    stream = one.watch("Pod", since=rv0)
+                    await stream.next(timeout=5.0)
+            # the failover watch surfaces the same honest 410 instead of
+            # silently relisting over the gap
+            w = remote.watch_resilient("Pod", since=rv0)
+            with pytest.raises(Expired):
+                await w.next(timeout=5.0)
+            w.stop()
+
+        asyncio.run(run())
+
+
+# ---- graceful drain ----
+
+
+def test_graceful_drain_hands_off_watchers():
+    """drain() ends every live watch with the terminal DRAIN frame; the
+    failover watch resumes from its last delivered rv on the surviving
+    replica with no gap and no duplicate."""
+    store = ObjectStore()
+    with ReplicaSet(store, n=2, watch_cache=True) as rs:
+        remote = rs.client()
+
+        async def run():
+            w = remote.watch_resilient("Pod", since=store.resource_version)
+            remote.create(_pod("pre-drain"))
+            ev = await w.next(timeout=5.0)
+            assert ev.obj.metadata.name == "pre-drain"
+            # the first watch a fresh client opens lands on endpoint 0
+            # (round-robin from _watch_seq=0): drain exactly that replica
+            await asyncio.to_thread(rs.drain, 0)
+            remote.create(_pod("post-drain"))
+            ev = await w.next(timeout=10.0)
+            while ev is None:
+                ev = await w.next(timeout=10.0)
+            assert ev.obj.metadata.name == "post-drain"
+            assert w.resumes >= 1
+            w.stop()
+
+        asyncio.run(run())
+
+
+def test_draining_replica_fails_readyz_and_503s_requests():
+    """A draining replica reports not-ready and bounces new API requests
+    with 503 so clients (and load balancers) steer away before the
+    listener closes."""
+    store = ObjectStore()
+    with ReplicaSet(store, n=2, watch_cache=True) as rs:
+        h0, p0 = rs.endpoints[0]
+        single = RemoteStore(h0, p0)
+        assert single._ready(h0, p0)
+        # flip the drain flag without closing the listener, so the HTTP
+        # surface of a draining-but-still-listening replica is observable
+        rs._call(lambda: setattr(rs.servers[0], "_draining", True))
+        assert not single._ready(h0, p0)
+        with pytest.raises(ValueError, match="503|shutting down"):
+            single.list("Pod")  # single endpoint: honest 503, no retry
+        multi = rs.client()
+        assert [p.metadata.name for p in multi.list("Pod")] == []
+        assert multi.failover_total >= 1  # 503 -> failover to replica 1
+        rs._call(lambda: setattr(rs.servers[0], "_draining", False))
+
+
+# ---- RemoteStore failover matrix ----
+
+
+def test_failover_on_connect_refused():
+    store = ObjectStore()
+    with ReplicaSet(store, n=2, watch_cache=True) as rs:
+        remote = rs.client()
+        remote.create(_pod("refused-0"))
+        rs.refuse(0, on=True)  # listener closed, replica 1 keeps serving
+        remote.create(_pod("refused-1"))
+        assert {p.metadata.name for p in remote.list("Pod")} == \
+            {"refused-0", "refused-1"}
+        rs.refuse(0, on=False)
+        assert remote.probe_endpoints() == [True, True]
+
+
+def test_failover_on_mid_stream_kill():
+    """SIGKILL-style death mid-watch: the transport aborts, the failover
+    watch resumes from the last delivered rv on the survivor, and the
+    event sequence stays gapless and duplicate-free."""
+    store = ObjectStore()
+    with ReplicaSet(store, n=2, watch_cache=True) as rs:
+        remote = rs.client()
+
+        async def run():
+            rv0 = store.resource_version
+            w = remote.watch_resilient("Pod", since=rv0)
+            remote.create(_pod("cut-0"))
+            first = await w.next(timeout=5.0)
+            assert first.obj.metadata.name == "cut-0"
+            rs.kill(0)
+            for i in range(1, 4):
+                remote.create(_pod(f"cut-{i}"))
+            got = []
+            while len(got) < 3:
+                ev = await w.next(timeout=10.0)
+                if ev is not None:
+                    got.append((ev.obj.metadata.name, ev.resource_version))
+            assert [n for n, _ in got] == ["cut-1", "cut-2", "cut-3"]
+            assert w.resumes >= 1
+            w.stop()
+
+        asyncio.run(run())
+
+
+def test_failover_on_black_hole():
+    """A replica that accepts but never answers is only detectable by I/O
+    timeout: a replica-aware client with a request timeout fails over
+    instead of hanging forever."""
+    store = ObjectStore()
+    with ReplicaSet(store, n=2, watch_cache=True) as rs:
+        remote = rs.client(request_timeout_s=0.5)
+        remote.create(_pod("bh-0"))
+        rs.black_hole(0, on=True)
+        t0 = time.monotonic()
+        remote.create(_pod("bh-1"))  # times out on r0, lands on r1
+        assert time.monotonic() - t0 < 5.0
+        assert remote.failover_total >= 1
+        rs.black_hole(0, on=False)
+        assert {p.metadata.name for p in remote.list("Pod")} == \
+            {"bh-0", "bh-1"}
+
+
+def test_endpoint_discovery_from_well_known_object():
+    """Replicas advertise into default/kubernetes Endpoints; a client
+    bootstrapped with ONE endpoint discovers the whole set."""
+    store = ObjectStore()
+    with ReplicaSet(store, n=3, watch_cache=True) as rs:
+        h0, p0 = rs.endpoints[0]
+        remote = RemoteStore(h0, p0)
+        assert remote.endpoints == [(h0, p0)]
+        remote.discover_endpoints()
+        assert sorted(remote.endpoints) == sorted(rs.endpoints)
+        # discovery failure keeps the last-known-good set (bound the
+        # all-endpoints-down connect walk so the test stays fast)
+        remote.connect_deadline_s = 2.0
+        rs.kill(0)
+        rs.kill(1)
+        rs.kill(2)
+        before = remote.endpoints
+        remote.discover_endpoints()
+        assert remote.endpoints == before
+
+
+# ---- APF config propagation ----
+
+
+def test_apf_policy_propagates_to_every_replica():
+    """FlowSchema / PriorityLevelConfiguration written through ONE replica
+    reroute flows on ALL replicas within one refresh TTL — each replica's
+    FlowController reloads from the same shared store."""
+    store = ObjectStore()
+    with ReplicaSet(store, n=3, watch_cache=True) as rs:
+        for server in rs.servers:
+            server.flow.refresh_s = 0.05
+        remote = rs.client()
+        remote.create(PriorityLevelConfiguration(
+            metadata=ObjectMeta(name="batch"),
+            spec={"shares": 2, "queues": 2, "queueLengthLimit": 4,
+                  "handSize": 1}))
+        remote.create(FlowSchema(
+            metadata=ObjectMeta(name="batch-users"),
+            spec={"priorityLevel": "batch", "matchingPrecedence": 50,
+                  "rules": [{"users": ["batch-*"]}]}))
+        time.sleep(0.1)  # one TTL
+        user = UserInfo("batch-runner", ())
+        for i in range(rs.n):
+            schema, flow = rs._call(
+                lambda i=i: rs.servers[i].flow.classify(
+                    user, "list", "pods"))
+            assert schema.name == "batch-users", f"replica {i}"
+            assert flow == "batch-users/batch-runner"
+
+
+# ---- leader election across replica death ----
+
+
+def test_leader_renew_survives_replica_death():
+    """The holder's renew hits a dead replica, fails over inside the
+    renew deadline, and leadership is retained — the deadline anchors to
+    the last SUCCESSFUL renew, not the first failed attempt."""
+    store = ObjectStore()
+    with ReplicaSet(store, n=2, watch_cache=True) as rs:
+        remote = rs.client(request_timeout_s=1.0)
+        elector = LeaderElector(
+            remote, "scheduler-a",
+            lease_duration=2.0, renew_deadline=1.5, retry_period=0.1)
+
+        async def run():
+            task = asyncio.get_running_loop().create_task(elector.run())
+            deadline = time.monotonic() + 5
+            while not elector.is_leader and time.monotonic() < deadline:
+                await asyncio.sleep(0.02)
+            assert elector.is_leader
+            rs.kill(0)  # whichever endpoint the client started on
+            await asyncio.sleep(0.5)  # several renew periods
+            assert elector.is_leader, \
+                "leadership surrendered during failover"
+            rec = elector._get_record()
+            assert rec is not None \
+                and rec.holder_identity == "scheduler-a"
+            elector.stop()
+            await asyncio.wait_for(task, timeout=5.0)
+
+        asyncio.run(run())
+
+
+# ---- informer failover accounting ----
+
+
+def test_informer_resumes_from_rv_on_replica_death():
+    """After its replica dies mid-watch, the informer resumes from the
+    last delivered rv on a survivor (counted) instead of paying for a
+    full relist, and its cache stays complete."""
+    store = ObjectStore()
+    with ReplicaSet(store, n=2, watch_cache=True) as rs:
+        remote = rs.client()
+        mx = _metrics("Pod")
+        relists0, resumes0 = mx[3].value, mx[4].value
+
+        async def run():
+            inf = Informer(remote, "Pod")
+            inf.start()
+            await asyncio.wait_for(inf.wait_for_sync(), timeout=5.0)
+            remote.create(_pod("inf-0"))
+            deadline = time.monotonic() + 5
+            while inf.get("inf-0") is None and time.monotonic() < deadline:
+                await asyncio.sleep(0.02)
+            rs.kill(0)
+            for i in range(1, 4):
+                remote.create(_pod(f"inf-{i}"))
+            deadline = time.monotonic() + 10
+            while len(inf.items()) < 4 and time.monotonic() < deadline:
+                await asyncio.sleep(0.05)
+            assert {p.metadata.name for p in inf.items()} == \
+                {f"inf-{i}" for i in range(4)}
+            inf.stop()
+
+        asyncio.run(run())
+        assert mx[4].value - resumes0 >= 1  # resumed from last rv
+        assert mx[3].value - relists0 == 0  # without a full relist
+
+
+# ---- FaultPlane per-replica targeting ----
+
+
+def test_fault_plane_targets_replicas_on_schedule():
+    """Replica injuries ride the same seeded, op-indexed action schedule
+    as every other disruption: the Nth store op pulls the trigger, and
+    the fired action is recorded for replay."""
+    inner = ObjectStore()
+    plane = FaultPlane(inner, seed=11)
+    with ReplicaSet(plane, n=2, watch_cache=True) as rs:
+        plane.attach_replica(0, rs.control(0))
+        plane.attach_replica(1, rs.control(1))
+        plane.schedule(plane.stats.ops + 3,
+                       lambda p: p.kill_replica(0), "kill-r0")
+        remote = rs.client()
+        for i in range(6):
+            try:
+                remote.create(_pod(f"sched-{i}"))
+            except AlreadyExists:
+                pass  # kill aborted the reply mid-create; the failover
+                # replay found the first attempt already committed
+        assert "kill-r0" in plane.stats.actions_fired
+        assert plane.stats.replica_faults == [
+            {"replica": 0, "kind": "kill"}]
+        assert rs.servers[0]._server is None  # listener really died
+        assert len(remote.list("Pod")) == 6  # workload survived on r1
+
+
+# ---- the rolling-restart chaos drill ----
+
+
+@pytest.mark.slow
+def test_rolling_restart_drill_smoke():
+    """The tentpole cap at CI scale: 3 replicas, live scheduler +
+    informer + watcher workload, every replica killed once (two hard, one
+    graceful drain) under RaceDetector + LoopStallWatchdog — every pod
+    bound exactly once, zero racy writes, zero stalls, and a gapless
+    duplicate-free watcher stream."""
+    from kubernetes_tpu.perf.harness import run_rolling_restart
+
+    r = run_rolling_restart(n_nodes=8, n_pods=24, seed=2027,
+                            race_detect=True)
+    assert r.converged and r.bound == 24
+    assert r.double_binds == 0
+    assert r.racy_writes == 0
+    assert r.loop_stalls == 0, f"max stall {r.max_stall_ms:.0f}ms"
+    assert r.watch_gaps == 0 and r.watch_dupes == 0
+    assert r.watch_resumes >= 1
+    assert [f["kind"] for f in r.replica_faults] == \
+        ["kill", "drain", "kill"]
+    assert r.gate
+
+
+def test_bench_ha_smoke_mode():
+    """bench.py --smoke with the ha config stays runnable end-to-end:
+    the rolling-restart drill's gates are armed from outside the
+    process, so config drift breaks tier-1 instead of a nightly."""
+    repo = Path(__file__).resolve().parents[1]
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["BENCH_CONFIGS"] = "ha"
+    env["BENCH_HA_NODES"] = "8"
+    env["BENCH_HA_PODS"] = "24"
+    proc = subprocess.run(
+        [sys.executable, "bench.py", "--smoke", "--with-race-detector"],
+        cwd=repo, env=env, capture_output=True, text=True, timeout=420)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    line = [ln for ln in proc.stdout.splitlines() if ln.strip()][-1]
+    result = json.loads(line)
+    assert "error" not in result, result
+    extras = result["extras"]
+    assert extras["ha_replica_faults"] == 3
+    assert extras["ha_failovers"] >= 1
+    assert extras["ha_watch_resumes"] >= 1
+    assert extras["ha_resumes"] >= extras["ha_relists"]
+    assert extras["ha_racy_writes"] == 0
+    assert extras["ha_loop_stalls"] == 0
