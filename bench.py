@@ -129,6 +129,13 @@ def main() -> None:
         os.environ.setdefault("BENCH_OVERLOAD_MULT", "10")
         os.environ.setdefault("BENCH_FANOUT_WATCHERS", "500")
         os.environ.setdefault("BENCH_FANOUT_EVENTS", "20")
+        os.environ.setdefault("BENCH_FANOUT_XL_WATCHERS", "2000")
+        os.environ.setdefault("BENCH_FANOUT_XL_EVENTS", "5")
+        os.environ.setdefault("BENCH_FANOUT_XL_NOMINAL", "3")
+        os.environ.setdefault("BENCH_FANOUT_XL_BASE_WATCHERS", "500")
+        os.environ.setdefault("BENCH_FANOUT_XL_SCHED_NODES", "8")
+        os.environ.setdefault("BENCH_FANOUT_XL_SCHED_PODS", "16")
+        os.environ.setdefault("BENCH_FANOUT_XL_GATE", "0")  # CI: no gate
         os.environ.setdefault("BENCH_MONITOR_TARGETS", "3")
         os.environ.setdefault("BENCH_MONITOR_SECONDS", "2")
         os.environ.setdefault("BENCH_MONITOR_INTERVAL", "0.2")
@@ -156,7 +163,7 @@ def main() -> None:
     configs = os.environ.get(
         "BENCH_CONFIGS",
         "headline,interpod,spread,gang,preemption,recovery,chaos,overload,"
-        "device,autoscaler,monitor,ha")
+        "device,autoscaler,monitor,ha,fanout-xl")
     configs = [c.strip() for c in configs.split(",") if c.strip()]
     metrics_snapshot = "--metrics-snapshot" in sys.argv[1:] or \
         os.environ.get("BENCH_METRICS_SNAPSHOT", "") in ("1", "true")
@@ -476,6 +483,90 @@ def main() -> None:
                 f"ha drill under race detector (seed {r.seed}): "
                 f"{r.racy_writes} racy writes, {r.loop_stalls} event-loop "
                 f"stalls (max {r.max_stall_ms:.0f}ms)")
+
+    if "fanout-xl" in configs:
+        from kubernetes_tpu.perf.harness import run_fanout_xl
+
+        # sharded off-loop watch fan-out drill: BENCH_FANOUT_XL_WATCHERS
+        # sink watchers on FanoutShard threads vs the single-loop
+        # (KTPU_FANOUT_SHARDS=0) fallback in the same process. Gates
+        # (BENCH_FANOUT_XL_GATE=0 disables the perf gates; the
+        # correctness gates — O(events) store puts, zero evictions,
+        # encode-once, witness coherence — are always armed):
+        # deliveries/s >= gate x the single-loop baseline, and scheduler
+        # batch-e2e p99 within 5x its unloaded self while the nominal
+        # flood runs
+        xl_watchers = int(
+            os.environ.get("BENCH_FANOUT_XL_WATCHERS", "100000"))
+        xl_events = int(os.environ.get("BENCH_FANOUT_XL_EVENTS", "12"))
+        xl_nominal = int(os.environ.get("BENCH_FANOUT_XL_NOMINAL", "8"))
+        xl_base = int(
+            os.environ.get("BENCH_FANOUT_XL_BASE_WATCHERS", "10000"))
+        xl_sched_nodes = int(
+            os.environ.get("BENCH_FANOUT_XL_SCHED_NODES", "32"))
+        xl_sched_pods = int(
+            os.environ.get("BENCH_FANOUT_XL_SCHED_PODS", "128"))
+        xl_gate = float(os.environ.get("BENCH_FANOUT_XL_GATE", "5"))
+        xl_p99_mult = float(os.environ.get("BENCH_FANOUT_XL_P99X", "5"))
+        r = run_fanout_xl(xl_watchers, xl_events,
+                          nominal_events=xl_nominal,
+                          baseline_watchers=xl_base,
+                          sched_nodes=xl_sched_nodes,
+                          sched_pods=xl_sched_pods)
+        print(f"bench[fanout-xl]: {r}", file=sys.stderr, flush=True)
+        extras["fanout_xl_watchers"] = r.watchers
+        extras["fanout_xl_shards"] = r.shards
+        extras["fanout_xl_deliveries"] = r.deliveries
+        extras["fanout_xl_events_per_sec"] = round(r.events_per_sec, 1)
+        extras["fanout_xl_baseline_events_per_sec"] = round(
+            r.baseline_events_per_sec, 1)
+        extras["fanout_xl_speedup"] = round(r.speedup, 2)
+        extras["fanout_xl_store_puts"] = r.store_fanout_puts
+        extras["fanout_xl_evicted"] = r.evicted
+        extras["fanout_xl_frames_encoded"] = r.frames_encoded
+        extras["fanout_xl_frames_delivered"] = r.frames_delivered
+        extras["fanout_xl_encode_ratio"] = round(r.encode_ratio, 1)
+        extras["fanout_xl_witness_events"] = r.witness_events
+        extras["fanout_xl_sched_p99_base_ms"] = round(
+            r.sched_p99_base_ms, 1)
+        extras["fanout_xl_sched_p99_flood_ms"] = round(
+            r.sched_p99_flood_ms, 1)
+        if r.store_fanout_puts != r.events:
+            RESULT["error"] = (
+                f"fanout-xl: store did {r.store_fanout_puts} puts for "
+                f"{r.events} events (the cache is not the only "
+                f"subscriber)")
+        elif r.evicted:
+            RESULT["error"] = (
+                f"fanout-xl: {r.evicted} slow-consumer evictions at "
+                f"nominal rate (expected 0)")
+        elif r.witness_gaps or r.witness_dupes:
+            RESULT["error"] = (
+                f"fanout-xl witness incoherence: {r.witness_gaps} gaps, "
+                f"{r.witness_dupes} duplicates across "
+                f"{r.witness_events} events at the fence rv")
+        elif r.frames_encoded != r.events:
+            RESULT["error"] = (
+                f"fanout-xl: {r.frames_encoded} frames encoded for "
+                f"{r.events} events — the encode-once contract is "
+                f"broken")
+        elif r.frames_delivered != r.deliveries + r.witness_events:
+            RESULT["error"] = (
+                f"fanout-xl: frames_delivered_total "
+                f"{r.frames_delivered} != {r.deliveries} sink + "
+                f"{r.witness_events} witness deliveries")
+        elif xl_gate and r.speedup < xl_gate:
+            RESULT["error"] = (
+                f"fanout-xl: sharded delivery {r.events_per_sec:.0f}/s "
+                f"is only {r.speedup:.1f}x the single-loop "
+                f"{r.baseline_events_per_sec:.0f}/s (gate {xl_gate}x)")
+        elif xl_gate and r.sched_p99_base_ms > 0 and \
+                r.sched_p99_flood_ms > xl_p99_mult * r.sched_p99_base_ms:
+            RESULT["error"] = (
+                f"fanout-xl: scheduler batch-e2e p99 "
+                f"{r.sched_p99_flood_ms:.1f}ms under flood breached "
+                f"{xl_p99_mult}x its unloaded {r.sched_p99_base_ms:.1f}"
+                f"ms")
 
     if "autoscaler" in configs:
         from kubernetes_tpu.perf.harness import run_autoscaler

@@ -36,7 +36,10 @@ from __future__ import annotations
 import asyncio
 import json
 import logging
+import select
 import socket
+import threading
+import time
 from typing import Any
 from urllib.parse import parse_qs, urlsplit
 
@@ -294,6 +297,137 @@ class _WatchServe:
             self._store._detach_watcher(entry)
 
 
+class _WatchSink:
+    """Off-loop delivery target for one sharded watch connection: the
+    owning FanoutShard thread writes encoded-once frames straight to the
+    connection's socket (non-blocking send + select retry under a
+    deadline), so the serving loop never touches watch-stream bytes after
+    the response headers. TLS connections — and any transport without a
+    raw socket — fall back to loop-marshalled writes through
+    `call_soon_threadsafe`, the one sanctioned thread→loop crossing. A
+    per-connection lock serializes shard-thread frame writes with the
+    serve coroutine's heartbeat and terminal DRAIN frames (which go
+    through the same lock via `asyncio.to_thread`)."""
+
+    SEND_TIMEOUT_S = 5.0
+
+    def __init__(self, writer: asyncio.StreamWriter,
+                 loop: asyncio.AbstractEventLoop, ns: str | None,
+                 binary: bool, last_rv: int):
+        self._writer = writer
+        self._loop = loop
+        self._ns = ns
+        self._binary = binary
+        self.last_rv = last_rv
+        self._lock = threading.Lock()
+        self._pending: list[tuple[bytes, int]] = []  # pre-arm buffer
+        self._armed = False
+        self.ended: str | None = None  # terminal reason, set once
+        self.end_event = asyncio.Event()  # loop-side park for the serve
+        self.last_write = time.monotonic()
+        sock = writer.get_extra_info("socket")
+        # asyncio hands out a TransportSocket facade whose send() is
+        # deprecated; shard threads need the real socket underneath
+        sock = getattr(sock, "_sock", sock)
+        if writer.get_extra_info("ssl_object") is not None:
+            sock = None
+        self._sock = sock
+
+    # ---- shard-thread side (the WatchCache.watch_sink contract) ----
+
+    def __call__(self, frame) -> None:
+        from kubernetes_tpu.apiserver.watchcache import SinkClosed
+
+        event = frame.event
+        if self._ns and event.obj.metadata.namespace != self._ns:
+            return  # namespace filter; last_rv tracks matching events only
+        data = frame.wire_bytes() if self._binary else frame.json_bytes()
+        with self._lock:
+            if self.ended is not None:
+                raise SinkClosed("watch connection already ended")
+            if not self._armed:
+                # headers still in flight on the loop: buffer, arm() flushes
+                self._pending.append((data, event.resource_version))
+                return
+            self._send(data)
+            self.last_rv = event.resource_version
+
+    def on_end(self, reason: str) -> None:
+        with self._lock:
+            if self.ended is None:
+                self.ended = reason
+        try:
+            self._loop.call_soon_threadsafe(self.end_event.set)
+        except RuntimeError:
+            pass  # loop already closed mid-teardown
+
+    # ---- serve-coroutine side (always via asyncio.to_thread) ----
+
+    def arm(self) -> None:
+        """Flush frames buffered while the headers were in flight, then
+        switch to direct writes. Runs in a worker thread, off the loop."""
+        with self._lock:
+            for data, rv in self._pending:
+                self._send(data)
+                self.last_rv = rv
+            self._pending.clear()
+            self._armed = True
+
+    def force_loop_writes(self) -> None:
+        """Permanently route writes through the loop (transport buffer
+        never emptied after the headers — direct socket writes would
+        interleave with it)."""
+        with self._lock:
+            self._sock = None
+
+    def heartbeat(self, interval: float) -> None:
+        from kubernetes_tpu.apiserver.watchcache import SinkClosed
+
+        with self._lock:
+            if self.ended is not None:
+                raise SinkClosed("watch connection already ended")
+            if time.monotonic() - self.last_write >= interval:
+                self._send(wire.HEARTBEAT if self._binary else b"\n")
+
+    def send_raw(self, data: bytes) -> None:
+        with self._lock:
+            self._send(data)
+
+    def close(self) -> None:
+        with self._lock:
+            if self.ended is None:
+                self.ended = "closed"
+
+    # ---- the actual write (lock held) ----
+
+    def _send(self, data: bytes) -> None:
+        from kubernetes_tpu.apiserver.watchcache import SinkClosed
+
+        if self._sock is None:
+            try:
+                self._loop.call_soon_threadsafe(self._writer.write, data)
+            except RuntimeError as e:
+                raise SinkClosed(str(e)) from e
+            self.last_write = time.monotonic()
+            return
+        deadline = time.monotonic() + self.SEND_TIMEOUT_S
+        view = memoryview(data)
+        while view.nbytes:
+            try:
+                sent = self._sock.send(view)
+                view = view[sent:]
+            except (BlockingIOError, InterruptedError):
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    # kernel send buffer stayed full for the whole
+                    # deadline: slow consumer — the caller evicts
+                    raise TimeoutError("watch client too slow")
+                select.select([], [self._sock], [], min(0.05, remaining))
+            except OSError as e:
+                raise SinkClosed(str(e)) from e
+        self.last_write = time.monotonic()
+
+
 class APIServer:
     """Asyncio HTTP/1.1 apiserver over one ObjectStore.
 
@@ -472,7 +606,9 @@ class APIServer:
 
     async def stop(self) -> None:
         if self.watch_cache is not None:
-            self.watch_cache.stop()
+            # awaitable teardown: reaps the cancelled pump/worker tasks
+            # and joins shard threads (stop() alone leaks pending tasks)
+            await self.watch_cache.aclose()
             self.watch_cache = None
         if self._server is not None:
             self._server.close()
@@ -1301,6 +1437,12 @@ class APIServer:
 
                 self.watch_cache = WatchCache(self.store).start()
             source = self.watch_cache
+        if getattr(source, "sharded", False):
+            # sharded cache: frames are written by the owning shard
+            # thread, not this coroutine — different serve shape
+            await self._serve_watch_sharded(writer, source, kind, ns,
+                                            since, binary)
+            return
         try:
             stream = source.watch(
                 kind, since=int(since) if since else None)
@@ -1354,6 +1496,88 @@ class APIServer:
         finally:
             self._watch_serves.discard(serve)
             stream.stop()
+            writer.close()
+
+    async def _serve_watch_sharded(self, writer: asyncio.StreamWriter,
+                                   cache, kind: str | None,
+                                   ns: str | None, since: str | None,
+                                   binary: bool) -> None:
+        """Sharded watch serving: subscribe a `_WatchSink`, so the owning
+        shard thread writes every frame straight to the socket. This
+        coroutine only writes the response headers, heartbeats idle
+        connections, and ends the stream — with the terminal DRAIN frame
+        on a graceful replica drain (same bytes as the single-loop path,
+        the PR 12 FailoverWatch contract)."""
+        from kubernetes_tpu.apiserver.watchcache import SinkClosed
+
+        loop = asyncio.get_running_loop()
+        last_rv = int(since) if since else self.store.resource_version
+        sink = _WatchSink(writer, loop, ns, binary, last_rv)
+        try:
+            handle = cache.watch_sink(
+                kind, since=int(since) if since else None,
+                sink=sink, on_end=sink.on_end)
+        except Expired as e:
+            await _respond(writer, 410, {"kind": "Status", "reason": "Gone",
+                                         "message": str(e)})
+            return
+        content_type = wire.CONTENT_TYPE if binary else "application/json"
+        serve = _WatchServe(self.store, handle)
+        self._watch_serves.add(serve)
+        try:
+            writer.write(f"HTTP/1.1 200 OK\r\n"
+                         f"Content-Type: {content_type}\r\n"
+                         f"Transfer-Encoding: identity\r\n"
+                         f"Connection: close\r\n\r\n".encode())
+            await writer.drain()
+            # direct socket writes may only start once the transport's own
+            # buffer is empty (drain() guarantees below-high-water, not
+            # empty); if it never empties, stay loop-marshalled
+            for _ in range(100):
+                if writer.transport.get_write_buffer_size() == 0:
+                    break
+                await asyncio.sleep(0.01)
+            else:
+                sink.force_loop_writes()
+            await asyncio.to_thread(sink.arm)
+            while True:
+                try:
+                    await asyncio.wait_for(sink.end_event.wait(),
+                                           timeout=self.watch_heartbeat_s)
+                except asyncio.TimeoutError:
+                    try:
+                        await asyncio.to_thread(sink.heartbeat,
+                                                self.watch_heartbeat_s)
+                    except (SinkClosed, TimeoutError, OSError):
+                        return  # client is gone
+                    continue
+                # stream over: evicted (consumer relists on its own),
+                # closed, or drained — only a drain gets the terminal
+                # "resume from last_rv on another replica, now" frame
+                if sink.ended == "drained" or serve.draining:
+                    status = {"kind": "Status", "reason": "Draining",
+                              "message": "replica shutting down; resume "
+                                         "from resourceVersion "
+                                         f"{sink.last_rv} elsewhere"}
+                    if binary:
+                        data = wire.encode_watch_frame(
+                            "DRAIN", sink.last_rv, status)
+                    else:
+                        data = json.dumps(
+                            {"type": "DRAIN",
+                             "resourceVersion": sink.last_rv,
+                             "object": status}).encode() + b"\n"
+                    try:
+                        await asyncio.to_thread(sink.send_raw, data)
+                    except (SinkClosed, TimeoutError, OSError):
+                        pass
+                return
+        except (ConnectionError, asyncio.CancelledError):
+            pass
+        finally:
+            self._watch_serves.discard(serve)
+            handle.stop()
+            sink.close()  # late shard writes raise SinkClosed, not OSError
             writer.close()
 
     async def _write_drain_frame(self, writer, last_rv: int,

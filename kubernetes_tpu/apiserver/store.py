@@ -821,10 +821,18 @@ class ObjectStore:
         except ValueError:
             return  # already evicted/stopped
         watcher.evicted = True
+        if watcher.queue.full():
+            # drop the oldest buffered event so the sentinel lands NOW: a
+            # consumer blocked in next() must learn of eviction promptly,
+            # not after draining the whole backlog (it relists anyway)
+            try:
+                watcher.queue.get_nowait()
+            except asyncio.QueueEmpty:
+                pass
         try:
             watcher.queue.put_nowait(_EVICTED)
         except asyncio.QueueFull:
-            pass  # a full queue can't block in get(): the flag suffices
+            pass
         _watch_evictions().inc()
 
     def _detach_watcher(self, watcher: _Watcher) -> None:
@@ -836,6 +844,11 @@ class ObjectStore:
         except ValueError:
             return
         watcher.evicted = True
+        if watcher.queue.full():
+            try:
+                watcher.queue.get_nowait()  # drop-oldest: sentinel lands now
+            except asyncio.QueueEmpty:
+                pass
         try:
             watcher.queue.put_nowait(_EVICTED)
         except asyncio.QueueFull:

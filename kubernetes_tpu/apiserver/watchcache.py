@@ -1,28 +1,55 @@
 """Watch cache: one store subscription fanned out to N watch subscribers.
 
-The cacher analog (reference apiserver/pkg/storage/cacher.go): without it,
-every HTTP watcher is its own store subscriber, so each published event
-costs one store-side queue put per watcher — O(watchers) work inside the
-write path. The WatchCache subscribes to the store exactly ONCE (so 10k
-watchers cost one store read per event — `ObjectStore.fanout_puts` is the
-counter that proves it), keeps its own ring of recent events plus a
-latest-object map per kind, and dedicated fan-out worker tasks deliver to
-subscriber queues OFF the write path. Slow consumers are absorbed by their
-bounded queue and evicted when it overflows — without ever touching the
-store. A resume point older than the ring raises `Expired` (HTTP 410), the
-same Reflector-relist contract as the store itself.
+The cacher analog (reference apiserver/pkg/storage/cacher/cacher.go):
+without it, every HTTP watcher is its own store subscriber, so each
+published event costs one store-side queue put per watcher — O(watchers)
+work inside the write path. The WatchCache subscribes to the store exactly
+ONCE (so 100k watchers cost one store read per event —
+`ObjectStore.fanout_puts` is the counter that proves it), keeps its own
+ring of recent events plus a latest-object map per kind, and a sharded
+delivery plane fans frames out to subscribers OFF the write path.
 
-Single-loop discipline: everything here runs on the serving loop; `start()`
-primes the ring from the store's own history synchronously, so no event can
-land between priming and subscribing.
+Delivery plane (PR 13), three pieces:
+
+- **Encode-once frames** (`_Frame`, the caching_object.go analog): each
+  ingested event is serialized to its wire frame at most once per format;
+  every subscriber shares the immutable bytes, so 1M deliveries pay ~20
+  `json.dumps`, not 1M.
+- **Shard threads** (`FanoutShard`): N OS worker threads each own a slice
+  of subscribers with a per-kind index. The serving loop only ingests from
+  the store pump and hands frames to interested shards; queue puts and
+  watch-socket writes happen on the shard threads. Thread→loop crossings
+  go through `call_soon_threadsafe` only (ktpu-lint R1 tier-3).
+- **Per-kind subscriber index**: an event touches only subscribers watching
+  its kind (plus all-kinds watchers), not every subscriber on the shard.
+
+`KTPU_FANOUT_SHARDS=0` pins the pre-shard single-loop behavior: fan-out
+workers are asyncio tasks on the serving loop (`_Worker`), the fallback
+the parity tests diff against.
+
+Slow consumers are absorbed by their bounded queue and evicted when it
+overflows — without ever touching the store. A resume point older than the
+ring raises `Expired` (HTTP 410), the same Reflector-relist contract as
+the store itself. `drain_subscribers` ends every stream with the DRAINED
+sentinel instead (resume elsewhere, not relist) — the PR 12 FailoverWatch
+contract.
+
+Single-loop discipline for control-plane state: `start()`, `watch()`,
+`stop()` and the ingest pump all run on the serving loop; `start()` primes
+the ring from the store's own history synchronously, so no event can land
+between priming and subscribing.
 """
 
 from __future__ import annotations
 
 import asyncio
+import json
 import logging
+import os
+import threading
+import time
 from collections import deque
-from typing import Any
+from typing import Any, Callable
 
 from kubernetes_tpu.apiserver.store import Expired, WatchEvent
 
@@ -35,29 +62,196 @@ _EVICTED = object()
 # another replica instead of relisting (CacheWatchStream.drained)
 _DRAINED = object()
 
-_mx_evicted = None
+
+class SinkClosed(Exception):
+    """Raised by a frame sink whose consumer is gone (connection closed):
+    the subscriber is detached WITHOUT counting a slow-consumer eviction —
+    the evictions counter must keep meaning "slow consumer"."""
 
 
-def _cache_evictions():
-    global _mx_evicted
-    if _mx_evicted is None:
+def default_shards() -> int:
+    """Fan-out shard thread count; `KTPU_FANOUT_SHARDS=0` pins the
+    single-loop fallback (asyncio-task workers on the serving loop)."""
+    try:
+        return max(0, int(os.environ.get("KTPU_FANOUT_SHARDS", "4")))
+    except ValueError:
+        return 4
+
+
+_mx = None
+
+
+def _metrics():
+    global _mx
+    if _mx is None:
         from kubernetes_tpu.obs import metrics as m
 
-        _mx_evicted = m.REGISTRY.counter(
-            "watchcache_subscribers_evicted_total",
-            "Watch-cache subscribers evicted for exceeding their queue "
-            "bound (slow consumers must relist).")
-    return _mx_evicted
+        _mx = (
+            m.REGISTRY.counter(
+                "watchcache_subscribers_evicted_total",
+                "Watch-cache subscribers evicted for exceeding their queue "
+                "bound (slow consumers must relist)."),
+            m.REGISTRY.counter(
+                "watchcache_frames_encoded_total",
+                "Watch frames serialized to wire bytes. Encode-once "
+                "contract: tracks ingested events, not deliveries."),
+            m.REGISTRY.counter(
+                "watchcache_frames_delivered_total",
+                "Frame deliveries to subscribers (queue puts + sink "
+                "calls). delivered/encoded is the fan-out ratio."),
+            m.REGISTRY.histogram(
+                "watchcache_delivery_seconds",
+                "Latency from event ingest to subscriber-queue put / sink "
+                "call completion, per frame per shard.",
+                buckets=m.exponential_buckets(1e-5, 4.0, 12)),
+            m.REGISTRY.gauge(
+                "watchcache_shard_queue_high_water",
+                "High-water mark of each fan-out shard's dispatch queue.",
+                labels=("shard",)),
+        )
+    return _mx
+
+
+_encode_object = None
+
+
+def _encoder():
+    # http.py owns the v1 JSON object shape; imported lazily (http.py
+    # imports this module lazily too — neither import runs at module load)
+    global _encode_object
+    if _encode_object is None:
+        from kubernetes_tpu.apiserver.http import encode_object
+
+        _encode_object = encode_object
+    return _encode_object
+
+
+class _Frame:
+    """One ingested event plus its wire encodings, serialized AT MOST ONCE
+    per format (the CachingObject analog): the first delivery in each
+    format pays the encode under the frame lock, every other delivery
+    shares the immutable bytes. Purely in-process consumers (informers,
+    drills) never touch the bytes, so they never pay an encode at all."""
+
+    __slots__ = ("event", "t_ingest", "_json", "_wire", "_lock")
+
+    def __init__(self, event: WatchEvent):
+        self.event = event
+        self.t_ingest = time.perf_counter()
+        self._json: bytes | None = None
+        self._wire: bytes | None = None
+        self._lock = threading.Lock()
+
+    def json_bytes(self) -> bytes:
+        data = self._json
+        if data is None:
+            with self._lock:
+                data = self._json
+                if data is None:
+                    ev = self.event
+                    # byte-for-byte the frame _serve_watch used to build
+                    # per delivery: same key order, same trailing newline
+                    data = json.dumps(
+                        {"type": ev.type,
+                         "resourceVersion": ev.resource_version,
+                         "object": _encoder()(ev.obj)}).encode() + b"\n"
+                    _metrics()[1].inc()
+                    self._json = data
+        return data
+
+    def wire_bytes(self) -> bytes:
+        data = self._wire
+        if data is None:
+            from kubernetes_tpu.api import wire
+
+            with self._lock:
+                data = self._wire
+                if data is None:
+                    ev = self.event
+                    data = wire.encode_watch_frame(
+                        ev.type, ev.resource_version, _encoder()(ev.obj))
+                    _metrics()[1].inc()
+                    self._wire = data
+        return data
+
+
+class _SubQueue:
+    """Thread-safe bounded subscriber queue bridging shard threads to a
+    loop-side consumer. The consumer parks on an asyncio.Event; a producer
+    on any thread wakes it via `call_soon_threadsafe` (the only sanctioned
+    thread→loop crossing). Single consumer per queue."""
+
+    __slots__ = ("_buf", "_max", "_lock", "_waiter")
+
+    def __init__(self, maxsize: int):
+        self._buf: deque = deque()
+        self._max = maxsize
+        self._lock = threading.Lock()
+        self._waiter: tuple | None = None  # (loop, asyncio.Event)
+
+    def _append(self, item) -> None:
+        self._buf.append(item)
+        waiter, self._waiter = self._waiter, None
+        if waiter is not None:
+            loop, event = waiter
+            try:
+                loop.call_soon_threadsafe(event.set)
+            except RuntimeError:
+                pass  # consumer's loop already closed (teardown)
+
+    def put_nowait(self, item) -> None:
+        with self._lock:
+            if self._max and len(self._buf) >= self._max:
+                raise asyncio.QueueFull
+            self._append(item)
+
+    def put_terminal(self, sentinel) -> None:
+        """Enqueue an end-of-stream sentinel, dropping the oldest buffered
+        event first when the queue is full — the sentinel must land NOW so
+        a consumer blocked in next() learns of eviction promptly instead
+        of after draining the whole backlog."""
+        with self._lock:
+            if self._max and len(self._buf) >= self._max:
+                self._buf.popleft()
+            self._append(sentinel)
+
+    def empty(self) -> bool:
+        return not self._buf
+
+    async def get(self, timeout: float | None = None):
+        while True:
+            with self._lock:
+                if self._buf:
+                    return self._buf.popleft()
+                event = asyncio.Event()
+                self._waiter = (asyncio.get_running_loop(), event)
+            try:
+                if timeout is None:
+                    await event.wait()
+                else:
+                    await asyncio.wait_for(event.wait(), timeout)
+            except asyncio.TimeoutError:
+                with self._lock:
+                    if self._waiter is not None \
+                            and self._waiter[1] is event:
+                        self._waiter = None
+                raise
 
 
 class _CacheSub:
-    __slots__ = ("kind", "queue", "evicted", "worker", "min_rv")
+    __slots__ = ("kind", "queue", "sink", "on_end", "evicted", "home",
+                 "min_rv")
 
-    def __init__(self, kind: str | None, maxsize: int, min_rv: int = 0):
+    def __init__(self, kind: str | None, queue: _SubQueue | None,
+                 min_rv: int = 0):
         self.kind = kind
-        self.queue: asyncio.Queue = asyncio.Queue(maxsize)
+        self.queue = queue
+        # sink mode: delivery is a direct call on the shard thread
+        # (per-watcher goroutine analog) instead of a queue put
+        self.sink: Callable[[_Frame], None] | None = None
+        self.on_end: Callable[[str], None] | None = None
         self.evicted = False
-        self.worker: _Worker | None = None
+        self.home: FanoutShard | _Worker | None = None
         # events at or below this rv were already served from the ring
         # backlog (or predate the subscriber's "now"): the fan-out skips
         # them — unlike the store's synchronous subscribe, an event can
@@ -66,7 +260,10 @@ class _CacheSub:
 
 
 class _Worker:
-    """One fan-out shard: its own dispatch queue + subscriber slice."""
+    """Single-loop fan-out shard (`KTPU_FANOUT_SHARDS=0`): its own
+    dispatch queue + subscriber slice, delivered by an asyncio task on the
+    serving loop — the pre-shard behavior, pinned as the fallback the
+    parity tests diff against."""
 
     __slots__ = ("queue", "subs", "task")
 
@@ -74,6 +271,178 @@ class _Worker:
         self.queue: asyncio.Queue = asyncio.Queue()
         self.subs: list[_CacheSub] = []
         self.task: asyncio.Task | None = None
+
+    def add(self, sub: _CacheSub) -> None:
+        self.subs.append(sub)
+
+    def discard(self, sub: _CacheSub) -> bool:
+        try:
+            self.subs.remove(sub)
+        except ValueError:
+            return False
+        return True
+
+    @property
+    def sub_count(self) -> int:
+        return len(self.subs)
+
+    def all_subs(self) -> list[_CacheSub]:
+        return list(self.subs)
+
+
+class FanoutShard:
+    """One fan-out shard: an OS worker thread owning a slice of
+    subscribers behind a per-kind index. The serving loop submits
+    encoded-once frames; delivery — subscriber-queue puts and watch-socket
+    writes — happens here, off the loop. The thread never touches the
+    event loop except through `call_soon_threadsafe` (R1 tier-3)."""
+
+    def __init__(self, cache: "WatchCache", index: int):
+        self._cache = cache
+        self.index = index
+        self._cond = threading.Condition()
+        self._items: deque = deque()
+        self._stopping = False
+        self._lock = threading.Lock()  # guards the subscriber index
+        self._by_kind: dict[str | None, list[_CacheSub]] = {}
+        self._nsubs = 0
+        self.high_water = 0
+        self.thread: threading.Thread | None = None
+
+    def start(self) -> None:
+        self.thread = threading.Thread(
+            target=self._run, name=f"ktpu-fanout-{self.index}", daemon=True)
+        self.thread.start()
+
+    # ---- loop side ----
+
+    def wants(self, kind: str) -> bool:
+        """Cheap lock-free per-kind check on the ingest path. Additions
+        happen on the serving loop (same thread as this call), so a
+        just-added subscriber can't be missed; a shard-thread eviction
+        racing us at worst submits one frame nobody wants."""
+        by = self._by_kind
+        return bool(by.get(kind)) or bool(by.get(None))
+
+    def add(self, sub: _CacheSub) -> None:
+        with self._lock:
+            self._by_kind.setdefault(sub.kind, []).append(sub)
+            self._nsubs += 1
+
+    def discard(self, sub: _CacheSub) -> bool:
+        with self._lock:
+            subs = self._by_kind.get(sub.kind)
+            if not subs:
+                return False
+            try:
+                subs.remove(sub)
+            except ValueError:
+                return False
+            self._nsubs -= 1
+            return True
+
+    @property
+    def sub_count(self) -> int:
+        return self._nsubs
+
+    def all_subs(self) -> list[_CacheSub]:
+        with self._lock:
+            return [s for subs in self._by_kind.values() for s in subs]
+
+    def submit(self, frame: _Frame) -> None:
+        with self._cond:
+            self._items.append((None, frame))
+            depth = len(self._items)
+            self._cond.notify()
+        if depth > self.high_water:
+            self.high_water = depth
+            _metrics()[4].labels(str(self.index)).set(depth)
+
+    def submit_backlog(self, sub: _CacheSub, frames: list[_Frame]) -> None:
+        """Targeted resume-backlog replay, ordered before any broadcast
+        frame submitted after it (FIFO queue, all submits on the loop)."""
+        with self._cond:
+            self._items.append((sub, frames))
+            self._cond.notify()
+
+    def stop(self) -> None:
+        with self._cond:
+            self._stopping = True
+            self._items.clear()  # stranded frames drained, not leaked
+            self._cond.notify()
+
+    def join(self, timeout: float | None = None) -> None:
+        thread = self.thread
+        if thread is not None:
+            thread.join(timeout)
+
+    # ---- shard thread ----
+
+    def _run(self) -> None:
+        while True:
+            with self._cond:
+                while not self._items and not self._stopping:
+                    self._cond.wait(timeout=1.0)
+                if self._stopping:
+                    self._items.clear()
+                    return
+                target, payload = self._items.popleft()
+            if target is None:
+                self._broadcast(payload)
+            else:
+                self._replay(target, payload)
+
+    def _broadcast(self, frame: _Frame) -> None:
+        ev = frame.event
+        with self._lock:
+            subs = list(self._by_kind.get(ev.kind, ()))
+            general = self._by_kind.get(None)
+            if general:
+                subs.extend(general)
+        if not subs:
+            return
+        delivered = 0
+        for sub in subs:
+            if ev.resource_version <= sub.min_rv:
+                continue
+            if self._cache._deliver(sub, frame):
+                delivered += 1
+        if delivered:
+            mx = _metrics()
+            mx[2].inc(delivered)
+            mx[3].observe(time.perf_counter() - frame.t_ingest)
+
+    def _replay(self, sub: _CacheSub, frames: list[_Frame]) -> None:
+        delivered = 0
+        for frame in frames:
+            if sub.evicted:
+                break
+            if self._cache._deliver(sub, frame):
+                delivered += 1
+        if delivered:
+            _metrics()[2].inc(delivered)
+
+
+class SinkHandle:
+    """Owner-side handle for one sink subscription."""
+
+    __slots__ = ("_cache", "_sub")
+
+    def __init__(self, cache: "WatchCache", sub: _CacheSub):
+        self._cache = cache
+        self._sub = sub
+
+    @property
+    def evicted(self) -> bool:
+        return self._sub.evicted
+
+    def stop(self) -> None:
+        """Unsubscribe without an end notification — the owner is going
+        away on its own terms."""
+        home = self._sub.home
+        if home is not None:
+            home.discard(self._sub)
+        self._sub.evicted = True
 
 
 class WatchCache:
@@ -84,22 +453,35 @@ class WatchCache:
     ring priming reads the underlying history."""
 
     def __init__(self, store: Any, window: int | None = None,
-                 workers: int = 4, queue_limit: int | None = None):
+                 workers: int = 4, queue_limit: int | None = None,
+                 shards: int | None = None):
         self.store = store
-        self._ring: deque[WatchEvent] = deque(
+        self._ring: deque[_Frame] = deque(
             maxlen=window or store._history.maxlen or 4096)
         self._latest: dict[str, dict] = {}
         self._queue_limit = store._watcher_queue_limit \
             if queue_limit is None else queue_limit
-        self._workers = [_Worker() for _ in range(max(1, workers))]
+        self.shards_n = default_shards() if shards is None \
+            else max(0, shards)
+        self._n_workers = max(1, workers)
+        self._shards: list[FanoutShard] = []
+        self._workers: list[_Worker] = []
         self._last_rv = 0
         self._stream = None
         self._pump_task: asyncio.Task | None = None
+        # cancelled-but-unawaited tasks, reaped by aclose() (cancel
+        # without await leaks "Task was destroyed but it is pending")
+        self._stashed: list[asyncio.Task] = []
+        self._count_lock = threading.Lock()
         self.started = False
         # drill/test counters
         self.events_total = 0
         self.evictions = 0
         self.rebuilds = 0
+
+    @property
+    def sharded(self) -> bool:
+        return bool(self._shards)
 
     # ---- lifecycle ----
 
@@ -108,30 +490,65 @@ class WatchCache:
         serving loop, so no event lands between priming and subscribing."""
         if self.started:
             return self
-        self._ring.extend(self.store._history)
+        self._ring.clear()  # restart after stop(): re-prime, don't append
+        self._ring.extend(_Frame(e) for e in self.store._history)
         self._last_rv = self.store.resource_version
         self._latest = {kind: dict(bucket)
                         for kind, bucket in self.store._objects.items()}
         self._stream = self.store.watch(None)
         loop = asyncio.get_running_loop()
         self._pump_task = loop.create_task(self._pump())
-        for w in self._workers:
-            w.task = loop.create_task(self._fan_out(w))
+        if self.shards_n:
+            # fresh shard objects every start: threads are not reusable
+            self._shards = [FanoutShard(self, i)
+                            for i in range(self.shards_n)]
+            for shard in self._shards:
+                shard.start()
+        else:
+            self._workers = [_Worker() for _ in range(self._n_workers)]
+            for w in self._workers:
+                w.task = loop.create_task(self._fan_out(w))
         self.started = True
         return self
 
     def stop(self) -> None:
+        """Synchronous, idempotent teardown: cancels the pump/worker tasks
+        (stashing them for `aclose()` to await), signals shard threads to
+        exit (each drains its stranded queue on the way out), and stops
+        the store subscription. Safe to call more than once."""
         if self._pump_task is not None:
             self._pump_task.cancel()
+            self._stashed.append(self._pump_task)
             self._pump_task = None
         for w in self._workers:
             if w.task is not None:
                 w.task.cancel()
+                self._stashed.append(w.task)
                 w.task = None
+            while not w.queue.empty():  # stranded frames
+                w.queue.get_nowait()
+        for shard in self._shards:
+            shard.stop()
         if self._stream is not None:
             self._stream.stop()
             self._stream = None
         self.started = False
+
+    async def aclose(self) -> None:
+        """`stop()` plus the awaits it can't do synchronously: reap the
+        cancelled pump/worker tasks and join the shard threads."""
+        self.stop()
+        tasks, self._stashed = self._stashed, []
+        for task in tasks:
+            try:
+                await task
+            except asyncio.CancelledError:
+                pass
+            except Exception:
+                log.exception("watch cache task died uncleanly")
+        for shard in self._shards:
+            if shard.thread is not None:
+                await asyncio.to_thread(shard.join, 2.0)
 
     # ---- the one store subscription ----
 
@@ -149,7 +566,8 @@ class WatchCache:
             self._ingest(event)
 
     def _ingest(self, event: WatchEvent) -> None:
-        self._ring.append(event)
+        frame = _Frame(event)
+        self._ring.append(frame)
         self._last_rv = max(self._last_rv, event.resource_version)
         obj = event.obj
         key = (obj.metadata.namespace or "default", obj.metadata.name)
@@ -159,8 +577,15 @@ class WatchCache:
         else:
             bucket[key] = obj
         self.events_total += 1
-        for w in self._workers:
-            w.queue.put_nowait(event)
+        if self._shards:
+            for shard in self._shards:
+                # per-kind index: the frame only reaches shards with at
+                # least one interested subscriber
+                if shard.wants(event.kind):
+                    shard.submit(frame)
+        else:
+            for w in self._workers:
+                w.queue.put_nowait(frame)
 
     async def _resubscribe(self) -> None:
         """The cache's own subscription died (forced expiry / eviction):
@@ -178,9 +603,8 @@ class WatchCache:
         self._last_rv = self.store.resource_version
         self._stream = self.store.watch(None)
         self.rebuilds += 1
-        for w in self._workers:
-            for sub in list(w.subs):
-                self._evict(sub)
+        for sub in self._all_subs():
+            self._end_sub(sub, _EVICTED, count=True, reason="evicted")
         log.warning("watch cache: resume point expired; rebuilt from "
                     "store snapshot and evicted all subscribers")
 
@@ -188,45 +612,77 @@ class WatchCache:
 
     async def _fan_out(self, worker: _Worker) -> None:
         while True:
-            event = await worker.queue.get()
+            frame = await worker.queue.get()
+            ev = frame.event
+            delivered = 0
             for sub in list(worker.subs):
-                if event.resource_version <= sub.min_rv:
+                if ev.resource_version <= sub.min_rv:
                     continue
-                if sub.kind is None or sub.kind == event.kind:
-                    try:
-                        sub.queue.put_nowait(event)
-                    except asyncio.QueueFull:
-                        self._evict(sub)
+                if sub.kind is None or sub.kind == ev.kind:
+                    if self._deliver(sub, frame):
+                        delivered += 1
+            if delivered:
+                mx = _metrics()
+                mx[2].inc(delivered)
+                mx[3].observe(time.perf_counter() - frame.t_ingest)
 
-    def _evict(self, sub: _CacheSub) -> None:
-        worker = sub.worker
-        if worker is None:
-            return
+    def _deliver(self, sub: _CacheSub, frame: _Frame) -> bool:
+        """One delivery attempt — shard thread or loop, either mode. A
+        failed attempt ends the subscription (evict or detach) inline."""
+        if sub.sink is not None:
+            try:
+                sub.sink(frame)
+                return True
+            except SinkClosed:
+                self._end_sub(sub, _EVICTED, count=False, reason="closed")
+                return False
+            except Exception:
+                self._end_sub(sub, _EVICTED, count=True, reason="evicted")
+                return False
         try:
-            worker.subs.remove(sub)
-        except ValueError:
-            return  # already evicted/stopped
-        sub.evicted = True
-        try:
-            sub.queue.put_nowait(_EVICTED)
+            sub.queue.put_nowait(frame)
+            return True
         except asyncio.QueueFull:
-            pass  # a full queue can't block in get(): the flag suffices
-        self.evictions += 1
-        _cache_evictions().inc()
+            self._end_sub(sub, _EVICTED, count=True, reason="evicted")
+            return False
+
+    def _end_sub(self, sub: _CacheSub, sentinel, count: bool,
+                 reason: str) -> None:
+        """Terminate one subscription (thread-safe): unsubscribe, mark
+        evicted, enqueue the end-of-stream sentinel — dropping the oldest
+        buffered event first if the queue is full, so a consumer blocked
+        in next() learns its fate promptly — and notify any sink."""
+        home = sub.home
+        if home is None or not home.discard(sub):
+            return  # already ended/stopped
+        sub.evicted = True
+        if sub.queue is not None:
+            sub.queue.put_terminal(sentinel)
+        if count:
+            with self._count_lock:
+                self.evictions += 1
+            _metrics()[0].inc()
+        if sub.on_end is not None:
+            try:
+                sub.on_end(reason)
+            except Exception:
+                log.exception("watch sink on_end callback failed")
 
     def drain_subscribers(self) -> None:
         """Graceful replica shutdown: end every subscription with the
         DRAINED sentinel (wakes consumers blocked in next() immediately).
         Not an eviction — subscribers resume from their last rv on another
         replica rather than relisting."""
+        for sub in self._all_subs():
+            self._end_sub(sub, _DRAINED, count=False, reason="drained")
+
+    def _all_subs(self) -> list[_CacheSub]:
+        out: list[_CacheSub] = []
         for w in self._workers:
-            for sub in list(w.subs):
-                w.subs.remove(sub)
-                sub.evicted = True
-                try:
-                    sub.queue.put_nowait(_DRAINED)
-                except asyncio.QueueFull:
-                    pass
+            out.extend(w.all_subs())
+        for shard in self._shards:
+            out.extend(shard.all_subs())
+        return out
 
     # ---- reads ----
 
@@ -236,37 +692,92 @@ class WatchCache:
         store by in-flight fan-out)."""
         return self._latest.get(kind, {}).get((namespace or "default", name))
 
+    def _resume_backlog(self, kind: str | None,
+                        since: int | None) -> list[_Frame]:
+        backlog: list[_Frame] = []
+        if since is not None and since < self._last_rv:
+            oldest = self._ring[0].event.resource_version if self._ring \
+                else self._last_rv + 1
+            if since < oldest - 1:
+                raise Expired(f"resourceVersion {since} is too old "
+                              f"(cache window starts at {oldest})")
+            backlog = [f for f in self._ring
+                       if f.event.resource_version > since
+                       and (kind is None or kind == f.event.kind)]
+        if self._queue_limit and len(backlog) >= self._queue_limit:
+            raise Expired(
+                f"resume backlog of {len(backlog)} events exceeds the "
+                f"{self._queue_limit}-event subscriber bound")
+        return backlog
+
+    def _min_rv(self, since: int | None) -> int:
+        # max(since, _last_rv), NOT bare `since`: the ring backlog covers
+        # (since, _last_rv], and an event in that range can also already
+        # be in flight through a shard/worker queue — bare `since` would
+        # deliver it twice
+        return self._last_rv if since is None else max(since, self._last_rv)
+
     def watch(self, kind: str | None = None,
               since: int | None = None) -> "CacheWatchStream":
         """Subscribe through the cache — the `ObjectStore.watch` contract
         (backlog from the ring, Expired when the resume point predates it),
         but the subscriber costs the store nothing."""
-        backlog: list[WatchEvent] = []
-        if since is not None and since < self._last_rv:
-            oldest = self._ring[0].resource_version if self._ring \
-                else self._last_rv + 1
-            if since < oldest - 1:
-                raise Expired(f"resourceVersion {since} is too old "
-                              f"(cache window starts at {oldest})")
-            backlog = [e for e in self._ring
-                       if e.resource_version > since
-                       and (kind is None or kind == e.kind)]
-        if self._queue_limit and len(backlog) >= self._queue_limit:
-            raise Expired(
-                f"resume backlog of {len(backlog)} events exceeds the "
-                f"{self._queue_limit}-event subscriber bound")
-        sub = _CacheSub(kind, self._queue_limit,
-                        min_rv=self._last_rv if since is None else since)
-        worker = min(self._workers, key=lambda w: len(w.subs))
-        sub.worker = worker
-        worker.subs.append(sub)
-        for e in backlog:
-            sub.queue.put_nowait(e)
+        backlog = self._resume_backlog(kind, since)
+        sub = _CacheSub(kind, _SubQueue(self._queue_limit),
+                        min_rv=self._min_rv(since))
+        home = self._least_loaded()
+        sub.home = home
+        home.add(sub)
+        # direct puts are safe in both modes: subscribe runs on the loop,
+        # so no broadcast with rv > min_rv can be enqueued before these
+        for frame in backlog:
+            sub.queue.put_nowait(frame)  # bound pre-checked via Expired
+        if backlog:
+            _metrics()[2].inc(len(backlog))
         return CacheWatchStream(sub)
+
+    def watch_sink(self, kind: str | None = None,
+                   since: int | None = None, *,
+                   sink: Callable[[_Frame], None],
+                   on_end: Callable[[str], None] | None = None
+                   ) -> SinkHandle:
+        """Subscribe a frame sink: delivery is a direct `sink(frame)` call
+        on the owning shard thread (the per-watcher goroutine analog) — no
+        subscriber queue, no loop hop. The sink must be thread-safe, must
+        not touch the event loop except via `call_soon_threadsafe`, and
+        signals a dead consumer by raising SinkClosed (detached, not
+        counted); any other exception evicts (slow consumer). The resume
+        backlog replays on the shard thread, ordered before live frames."""
+        backlog = self._resume_backlog(kind, since)
+        sub = _CacheSub(kind, None, min_rv=self._min_rv(since))
+        sub.sink = sink
+        sub.on_end = on_end
+        home = self._least_loaded()
+        sub.home = home
+        home.add(sub)
+        if backlog:
+            if isinstance(home, FanoutShard):
+                home.submit_backlog(sub, backlog)
+            else:
+                # single-loop fallback: replay inline (tests only — the
+                # HTTP path never uses sinks without shards)
+                delivered = 0
+                for frame in backlog:
+                    if sub.evicted or not self._deliver(sub, frame):
+                        break
+                    delivered += 1
+                if delivered:
+                    _metrics()[2].inc(delivered)
+        return SinkHandle(self, sub)
+
+    def _least_loaded(self):
+        return min(self._shards or self._workers,
+                   key=lambda home: home.sub_count)
 
     @property
     def subscriber_count(self) -> int:
-        return sum(len(w.subs) for w in self._workers)
+        return sum(w.sub_count for w in self._workers) \
+            + sum(s.sub_count for s in self._shards)
 
 
 class CacheWatchStream:
@@ -282,35 +793,30 @@ class CacheWatchStream:
     async def next(self, timeout: float | None = None) -> WatchEvent | None:
         if self._stopped:
             return None
-        if self._sub.evicted and self._sub.queue.empty():
+        sub = self._sub
+        if sub.evicted and sub.queue.empty():
             self._stopped = True
             return None
         try:
-            if timeout is None:
-                ev = await self._sub.queue.get()
-            else:
-                ev = await asyncio.wait_for(self._sub.queue.get(), timeout)
+            item = await sub.queue.get(timeout)
         except asyncio.TimeoutError:
             return None
-        if ev is _DRAINED:
+        if item is _DRAINED:
             self._stopped = True
             self.drained = True
             return None
-        if ev is _EVICTED:
+        if item is _EVICTED:
             self._stopped = True  # stream over: the consumer must relist
             return None
-        return ev
+        return item.event
 
     def stop(self) -> None:
         if self._stopped:
             return
         self._stopped = True
-        worker = self._sub.worker
-        if worker is not None:
-            try:
-                worker.subs.remove(self._sub)
-            except ValueError:
-                pass
+        home = self._sub.home
+        if home is not None:
+            home.discard(self._sub)
 
     def __aiter__(self):
         return self

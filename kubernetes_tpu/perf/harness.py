@@ -1313,6 +1313,244 @@ def run_watch_fanout(watchers: int = 10_000,
 
 
 @dataclass
+class FanoutXLResult:
+    """Sharded fan-out scale drill (bench[fanout-xl]): 100k sink watchers
+    on shard threads vs the single-loop fallback, in one process. The
+    contracts proven here: deliveries/s ≥ gate× the single-loop baseline,
+    store puts exactly O(events), zero slow-consumer evictions at nominal
+    rate, encode-once (frames_encoded == events while frames_delivered ==
+    deliveries), a witness stream gapless/dup-free against store history
+    at a fence rv, and scheduler e2e p99 unperturbed while the flood
+    runs."""
+
+    watchers: int
+    events: int               # burst + nominal store events
+    shards: int
+    store_fanout_puts: int
+    deliveries: int           # sharded sink deliveries (burst + nominal)
+    events_per_sec: float     # burst-phase sink deliveries/s
+    baseline_watchers: int
+    baseline_deliveries: int
+    baseline_events_per_sec: float  # single-loop (shards=0) queue mode
+    speedup: float
+    evicted: int
+    frames_encoded: int       # registry delta over the sharded phases
+    frames_delivered: int
+    encode_ratio: float       # delivered / encoded
+    witness_events: int
+    witness_gaps: int
+    witness_dupes: int
+    sched_p99_base_ms: float      # batch e2e p99, scheduler alone
+    sched_p99_flood_ms: float     # same workload under the nominal flood
+    sched_pods_per_sec_base: float
+    sched_pods_per_sec_flood: float
+
+    def __str__(self) -> str:
+        return (f"fanout-xl W={self.watchers} E={self.events} "
+                f"S={self.shards}: {self.deliveries} deliveries "
+                f"({self.events_per_sec:.0f}/s, {self.speedup:.1f}x the "
+                f"single-loop {self.baseline_events_per_sec:.0f}/s), "
+                f"store {self.store_fanout_puts} puts, "
+                f"{self.evicted} evicted, encode ratio "
+                f"{self.encode_ratio:.0f}:1, witness "
+                f"{self.witness_events} events {self.witness_gaps} gaps "
+                f"{self.witness_dupes} dupes, sched p99 "
+                f"{self.sched_p99_base_ms:.1f}->"
+                f"{self.sched_p99_flood_ms:.1f}ms")
+
+
+async def _sched_round(n_nodes: int, n_pods: int) -> tuple[float, float]:
+    """One scheduler workload round on its own store: returns
+    (pods_per_sec, batch-e2e p99 ms). The fanout-xl perturbation probe —
+    same process, loop and GIL as the flood, separate store."""
+    store = ObjectStore()
+    for node in make_nodes(n_nodes):
+        store.create(node)
+    caps = Capacities(num_nodes=1 << max(4, (n_nodes - 1).bit_length()),
+                      batch_pods=min(64, max(8, n_pods)))
+    sched = Scheduler(store, caps=caps)
+    await sched.start()
+    for pod in make_pods(n_pods, cpu="100m", memory="64Mi",
+                         name_prefix="xl"):
+        store.create(pod)
+    await asyncio.sleep(0)
+    samples: list[float] = []
+    done = 0
+    idle = 0
+    t0 = time.perf_counter()
+    while done < n_pods and idle < 5:
+        tb = time.perf_counter()
+        got = await sched.schedule_pending(wait=0.2)
+        if got:
+            samples.append(time.perf_counter() - tb)
+            done += got
+            idle = 0
+        else:
+            idle = 0 if sched.inflight_batches > 0 else idle + 1
+    dt = max(time.perf_counter() - t0, 1e-9)
+    sched.stop()
+    return done / dt, _p99_ms(samples)
+
+
+async def _run_fanout_xl(watchers: int, events: int, nominal_events: int,
+                         baseline_watchers: int, sched_nodes: int,
+                         sched_pods: int) -> FanoutXLResult:
+    from array import array
+
+    from kubernetes_tpu.api.objects import Node
+    from kubernetes_tpu.apiserver import watchcache as wc
+
+    mx = wc._metrics()
+
+    def tick(store, label: str, i: int) -> None:
+        store.guaranteed_update(
+            "Node", "fan", "default",
+            lambda n, i=i: n.metadata.labels.update({label: str(i)}))
+
+    # ---- phase 0: scheduler alone — the perturbation baseline ----
+    pps_base, p99_base = await _sched_round(sched_nodes, sched_pods)
+
+    # ---- phase 1: single-loop baseline (the KTPU_FANOUT_SHARDS=0
+    # fallback, queue mode — exactly the pre-shard bench[fanout] shape) ----
+    base_store = ObjectStore(watch_window=max(1 << 12, 4 * events))
+    base_cache = wc.WatchCache(base_store, shards=0).start()
+    base_subs = [base_cache.watch("Node")
+                 for _ in range(baseline_watchers)]
+
+    async def drain(sub) -> int:
+        got = 0
+        while got < events:
+            ev = await sub.next(timeout=10.0)
+            if ev is None:
+                break
+            got += 1
+        return got
+
+    tb0 = time.perf_counter()
+    base_store.create(Node.from_dict({"metadata": {"name": "fan"}}))
+    for i in range(events - 1):
+        tick(base_store, "tick", i)
+    base_counts = await asyncio.gather(*(drain(s) for s in base_subs))
+    base_dt = max(time.perf_counter() - tb0, 1e-9)
+    base_deliveries = sum(base_counts)
+    base_rate = base_deliveries / base_dt
+    await base_cache.aclose()
+
+    # ---- phase 2: sharded burst at full scale ----
+    total_events = events + nominal_events
+    store = ObjectStore(watch_window=max(1 << 12, 4 * total_events + 64))
+    cache = wc.WatchCache(store).start()
+    if not cache.sharded:
+        raise RuntimeError(
+            "bench[fanout-xl] needs KTPU_FANOUT_SHARDS >= 1")
+    counts = array("q", [0] * watchers)
+    handles = []
+    for i in range(watchers):
+        def sink(frame, _i=i, _counts=counts):
+            _counts[_i] += 1
+            frame.json_bytes()  # the wire bytes all sinks share
+        handles.append(cache.watch_sink("Node", sink=sink))
+
+    rv0 = store.resource_version
+    witness = cache.watch(None)  # coherence witness, queue mode
+    puts0 = store.fanout_puts
+    enc0 = mx[1].labels().value
+    dlv0 = mx[2].labels().value
+    observed: list[tuple[str, int]] = []
+
+    async def observe() -> None:
+        while True:
+            ev = await witness.next(timeout=2.0)
+            if ev is None:
+                if witness._stopped:
+                    return
+                continue
+            observed.append((ev.type, ev.resource_version))
+
+    observer = asyncio.get_running_loop().create_task(observe())
+
+    async def settle(expect: int, timeout_s: float) -> None:
+        deadline = time.monotonic() + timeout_s
+        while sum(counts) < expect and time.monotonic() < deadline:
+            await asyncio.sleep(0.005)
+
+    t0 = time.perf_counter()
+    store.create(Node.from_dict({"metadata": {"name": "fan"}}))
+    for i in range(events - 1):
+        tick(store, "tick", i)
+    await settle(watchers * events, 120.0)
+    dt = max(time.perf_counter() - t0, 1e-9)
+    burst_deliveries = sum(counts)
+    rate = burst_deliveries / dt
+
+    # ---- phase 3: nominal-rate flood + concurrent scheduler round ----
+    async def paced() -> None:
+        for i in range(nominal_events):
+            tick(store, "nom", i)
+            await asyncio.sleep(0.05)
+
+    (pps_flood, p99_flood), _ = await asyncio.gather(
+        _sched_round(sched_nodes, sched_pods), paced())
+    await settle(watchers * total_events, 60.0)
+
+    # ---- fence + witness coherence (the bench[ha] diff shape) ----
+    fence = store.resource_version
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        if observed and observed[-1][1] >= fence:
+            break
+        await asyncio.sleep(0.02)
+    witness.stop()
+    observer.cancel()
+    try:
+        await observer
+    except asyncio.CancelledError:
+        pass
+
+    expected = [e.resource_version for e in store._history
+                if rv0 < e.resource_version <= fence]
+    got = [rv for _, rv in observed if rv <= fence]
+    gaps = len(set(expected) - set(got))
+    dupes = len(got) - len(set(got))
+
+    deliveries = sum(counts)
+    encoded = int(mx[1].labels().value - enc0)
+    delivered = int(mx[2].labels().value - dlv0)
+    puts = store.fanout_puts - puts0
+    shards_n = cache.shards_n
+    evicted = cache.evictions
+    for h in handles:
+        h.stop()
+    await cache.aclose()
+    return FanoutXLResult(
+        watchers=watchers, events=total_events, shards=shards_n,
+        store_fanout_puts=puts, deliveries=deliveries,
+        events_per_sec=rate,
+        baseline_watchers=baseline_watchers,
+        baseline_deliveries=base_deliveries,
+        baseline_events_per_sec=base_rate,
+        speedup=rate / max(base_rate, 1e-9),
+        evicted=evicted,
+        frames_encoded=encoded, frames_delivered=delivered,
+        encode_ratio=delivered / max(encoded, 1),
+        witness_events=len(got), witness_gaps=gaps, witness_dupes=dupes,
+        sched_p99_base_ms=p99_base, sched_p99_flood_ms=p99_flood,
+        sched_pods_per_sec_base=pps_base,
+        sched_pods_per_sec_flood=pps_flood)
+
+
+def run_fanout_xl(watchers: int = 100_000, events: int = 12,
+                  nominal_events: int = 8,
+                  baseline_watchers: int = 10_000,
+                  sched_nodes: int = 32,
+                  sched_pods: int = 128) -> FanoutXLResult:
+    """Blocking entry point for the sharded fan-out scale drill."""
+    return asyncio.run(_run_fanout_xl(watchers, events, nominal_events,
+                                      baseline_watchers, sched_nodes,
+                                      sched_pods))
+
+
+@dataclass
 class MonitorBenchResult:
     """Monitoring-plane overhead drill: a Monitor scrapes a fleet of real
     ObsServers (each over its own churning registry) at a fixed interval

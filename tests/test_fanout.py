@@ -1,0 +1,363 @@
+"""Sharded off-loop watch fan-out (PR 13 test surface).
+
+Covers the delivery plane behind the WatchCache: encode-once frames
+(every subscriber shares one bytes object per format), FanoutShard worker
+threads delivering off the serving loop, the per-kind subscriber index,
+and the `KTPU_FANOUT_SHARDS=0` single-loop fallback — diffed stream-for-
+stream against the sharded plane. Slow-consumer eviction, SinkClosed
+detach-vs-evict accounting, DRAIN handoff, resume-from-rv/410, idempotent
+stop()/aclose() teardown, the sharded rolling-restart drill, and the
+bench[fanout-xl] --smoke config end to end.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from kubernetes_tpu.api.objects import Node
+from kubernetes_tpu.apiserver.store import Expired, ObjectStore
+from kubernetes_tpu.apiserver import watchcache as wc
+from kubernetes_tpu.apiserver.watchcache import SinkClosed, WatchCache
+
+
+def _mk_node(name: str) -> Node:
+    return Node.from_dict({"metadata": {"name": name}})
+
+
+def _tick(store: ObjectStore, name: str, n: int) -> None:
+    def mutate(node):
+        node.metadata.labels = dict(node.metadata.labels)
+        node.metadata.labels["tick"] = str(n)
+        return node
+
+    store.guaranteed_update("Node", name, "default", mutate)
+
+
+async def _collect(stream, n: int, timeout: float = 5.0) -> list:
+    out = []
+    while len(out) < n:
+        ev = await stream.next(timeout=timeout)
+        if ev is None:
+            break
+        out.append((ev.type, ev.kind, ev.resource_version))
+    return out
+
+
+# ---- sharded vs single-loop parity ----
+
+
+def test_sharded_vs_single_loop_stream_parity():
+    """The same workload through the sharded plane and the pinned
+    `shards=0` fallback yields identical streams — per-kind filtering
+    included — and identical store-side cost (one put per event)."""
+
+    async def run_mode(shards: int):
+        store = ObjectStore()
+        cache = WatchCache(store, shards=shards).start()
+        assert cache.sharded == bool(shards)
+        all_s = cache.watch(None)
+        node_s = cache.watch("Node")
+        base = store.fanout_puts
+        for i in range(3):
+            store.create(_mk_node(f"p{i}"))
+        for i in range(4):
+            _tick(store, "p0", i)
+        store.delete("Node", "p2")
+        got_all = await _collect(all_s, 8)
+        got_node = await _collect(node_s, 8)
+        puts = store.fanout_puts - base
+        all_s.stop()
+        node_s.stop()
+        await cache.aclose()
+        return got_all, got_node, puts
+
+    sharded = asyncio.run(run_mode(2))
+    single = asyncio.run(run_mode(0))
+    assert sharded == single
+    got_all, got_node, puts = sharded
+    assert len(got_all) == 8 and got_all[-1][0] == "DELETED"
+    assert got_node == got_all  # all events were Node events
+    assert puts == 8  # one store put per event in both modes
+
+
+def test_sharded_resume_from_rv_and_410():
+    """The ObjectStore.watch resume contract through shard threads:
+    since= inside the ring replays the backlog (ordered before live
+    frames), a resume point older than the ring raises Expired."""
+
+    async def run():
+        store = ObjectStore(watch_window=4)
+        store.create(_mk_node("r0"))
+        rv = store.resource_version
+        _tick(store, "r0", 1)
+        _tick(store, "r0", 2)
+        cache = WatchCache(store, window=4, shards=2).start()
+        sub = cache.watch("Node", since=rv)
+        first = await sub.next(timeout=5.0)
+        second = await sub.next(timeout=5.0)
+        assert [e.obj.metadata.labels["tick"] for e in (first, second)] \
+            == ["1", "2"]
+        # live events keep flowing after the replayed backlog
+        _tick(store, "r0", 3)
+        ev = await sub.next(timeout=5.0)
+        assert ev is not None and ev.obj.metadata.labels["tick"] == "3"
+        # age the ring past rv=1, then resume-from-1 must 410
+        for n in range(8):
+            _tick(store, "r0", 10 + n)
+        await asyncio.sleep(0.05)
+        with pytest.raises(Expired):
+            cache.watch("Node", since=1)
+        sub.stop()
+        await cache.aclose()
+
+    asyncio.run(run())
+
+
+def test_sharded_drain_vs_evict_stream_end():
+    """drain_subscribers ends a sharded stream with drained=True (resume
+    elsewhere — the PR 12 FailoverWatch contract); eviction ends it with
+    drained=False (relist)."""
+
+    async def run():
+        store = ObjectStore()
+        cache = WatchCache(store, shards=2, queue_limit=2).start()
+        drained_sub = cache.watch("Node")
+        slow = cache.watch("Node")
+        cache.drain_subscribers()
+        assert await drained_sub.next(timeout=2.0) is None
+        assert drained_sub.drained
+        assert not slow.drained  # drained too, but check eviction fresh
+        await cache.aclose()
+
+        cache = WatchCache(store, shards=2, queue_limit=2).start()
+        slow = cache.watch("Node")
+        store.create(_mk_node("d0"))
+        for n in range(6):
+            _tick(store, "d0", n)
+        deadline = asyncio.get_running_loop().time() + 5.0
+        while cache.evictions < 1:
+            assert asyncio.get_running_loop().time() < deadline
+            await asyncio.sleep(0.01)
+        while await slow.next(timeout=0.2) is not None:
+            pass
+        assert not slow.drained  # eviction is the relist signal
+        await cache.aclose()
+
+    asyncio.run(run())
+
+
+# ---- shard-thread eviction + sentinel promptness ----
+
+
+def test_slow_consumer_evicted_on_shard_thread():
+    """A subscriber that stops draining is evicted by the shard THREAD at
+    its queue bound; the sentinel drops the oldest buffered frame so a
+    blocked consumer learns promptly; the fast subscriber is untouched."""
+
+    async def run():
+        store = ObjectStore()
+        cache = WatchCache(store, shards=2, queue_limit=4).start()
+        slow = cache.watch("Node")
+        fast = cache.watch("Node")
+        store.create(_mk_node("s0"))
+        for n in range(10):
+            _tick(store, "s0", n)
+            assert await fast.next(timeout=5.0) is not None
+        deadline = asyncio.get_running_loop().time() + 5.0
+        while cache.evictions < 1:  # eviction happens off-loop
+            assert asyncio.get_running_loop().time() < deadline
+            await asyncio.sleep(0.01)
+        assert cache.evictions == 1
+        assert cache.subscriber_count == 1
+        # put_terminal dropped one buffered frame for the sentinel: the
+        # stream serves at most bound-1 events, then ends
+        seen = 0
+        while await slow.next(timeout=0.2) is not None:
+            seen += 1
+        assert seen <= 3
+        # the survivor still gets live events
+        assert await fast.next(timeout=5.0) is not None
+        _tick(store, "s0", 99)
+        ev = await fast.next(timeout=5.0)
+        assert ev is not None and ev.obj.metadata.labels["tick"] == "99"
+        await cache.aclose()
+
+    asyncio.run(run())
+
+
+def test_sink_closed_detaches_without_eviction():
+    """SinkClosed means the consumer hung up: detach, reason="closed",
+    NOT counted as an eviction. Any other sink exception is a slow
+    consumer: evicted, counted, reason="evicted"."""
+
+    async def run():
+        store = ObjectStore()
+        cache = WatchCache(store, shards=2).start()
+        ends: dict[str, str] = {}
+
+        def closed_sink(frame):
+            raise SinkClosed
+
+        def broken_sink(frame):
+            raise TimeoutError("watch client too slow")
+
+        ok_frames: list = []
+        cache.watch_sink("Node", sink=closed_sink,
+                         on_end=lambda r: ends.setdefault("closed", r))
+        cache.watch_sink("Node", sink=broken_sink,
+                         on_end=lambda r: ends.setdefault("broken", r))
+        ok = cache.watch_sink("Node", sink=ok_frames.append)
+        store.create(_mk_node("k0"))
+        deadline = asyncio.get_running_loop().time() + 5.0
+        while len(ends) < 2 or not ok_frames:
+            assert asyncio.get_running_loop().time() < deadline
+            await asyncio.sleep(0.01)
+        assert ends == {"closed": "closed", "broken": "evicted"}
+        assert cache.evictions == 1  # only the broken sink counts
+        assert not ok.evicted
+        assert ok_frames[0].event.obj.metadata.name == "k0"
+        ok.stop()
+        await cache.aclose()
+
+    asyncio.run(run())
+
+
+# ---- encode-once ----
+
+
+def test_encode_once_shared_bytes():
+    """Two sink subscribers serializing the same event share ONE bytes
+    object per format: the frames_encoded counter moves by exactly one
+    per format, not per delivery."""
+
+    async def run():
+        mx = wc._metrics()
+        store = ObjectStore()
+        cache = WatchCache(store, shards=2).start()
+        got_a: list = []
+        got_b: list = []
+        # force the two subs onto different shards via least-loaded
+        a = cache.watch_sink("Node", sink=got_a.append)
+        b = cache.watch_sink("Node", sink=got_b.append)
+        enc0 = mx[1].labels().value
+        store.create(_mk_node("e0"))
+        deadline = asyncio.get_running_loop().time() + 5.0
+        while not (got_a and got_b):
+            assert asyncio.get_running_loop().time() < deadline
+            await asyncio.sleep(0.01)
+        fa, fb = got_a[0], got_b[0]
+        assert fa is fb  # the frame object itself is shared
+        ja, jb = fa.json_bytes(), fb.json_bytes()
+        assert ja is jb  # one encode, one bytes object
+        assert mx[1].labels().value - enc0 == 1
+        from kubernetes_tpu.api import wire
+        if wire.available():  # protobuf wire format is optional
+            wa, wb = fa.wire_bytes(), fb.wire_bytes()
+            assert wa is wb
+            assert mx[1].labels().value - enc0 == 2  # +1 for wire format
+        # the JSON frame is the exact legacy per-delivery shape
+        line = json.loads(ja.decode())
+        assert list(line) == ["type", "resourceVersion", "object"]
+        assert line["type"] == "ADDED"
+        assert line["object"]["metadata"]["name"] == "e0"
+        a.stop()
+        b.stop()
+        await cache.aclose()
+
+    asyncio.run(run())
+
+
+# ---- lifecycle: idempotent stop, aclose reaps tasks + joins threads ----
+
+
+def test_stop_idempotent_and_aclose_joins_threads():
+    async def run():
+        store = ObjectStore()
+        cache = WatchCache(store, shards=2).start()
+        threads = [s.thread for s in cache._shards]
+        assert all(t is not None and t.is_alive() for t in threads)
+        sub = cache.watch("Node")
+        cache.stop()
+        cache.stop()  # idempotent
+        await cache.aclose()
+        await cache.aclose()  # and so is aclose
+        assert not cache._stashed  # cancelled tasks reaped, not leaked
+        assert all(not t.is_alive() for t in threads)
+        sub.stop()
+
+        # restartable: fresh shard threads, delivery works again
+        cache.start()
+        assert cache.started and cache.sharded
+        sub = cache.watch("Node")
+        store.create(_mk_node("l0"))
+        ev = await sub.next(timeout=5.0)
+        assert ev is not None and ev.obj.metadata.name == "l0"
+        sub.stop()
+        await cache.aclose()
+
+    asyncio.run(run())
+
+
+# ---- drills ----
+
+
+@pytest.mark.slow
+def test_rolling_restart_drill_with_pinned_shards(monkeypatch):
+    """The PR 12 HA drill with the fan-out shard count pinned explicitly
+    (not just whatever the default is): replica kills + graceful drain
+    under RaceDetector + LoopStallWatchdog stay exactly-once and gapless
+    when every watcher rides shard-thread delivery."""
+    from kubernetes_tpu.perf.harness import run_rolling_restart
+
+    monkeypatch.setenv("KTPU_FANOUT_SHARDS", "2")
+    r = run_rolling_restart(n_nodes=8, n_pods=24, seed=2027,
+                            race_detect=True)
+    assert r.converged and r.bound == 24
+    assert r.double_binds == 0
+    assert r.racy_writes == 0
+    assert r.loop_stalls == 0, f"max stall {r.max_stall_ms:.0f}ms"
+    assert r.watch_gaps == 0 and r.watch_dupes == 0
+    assert r.watch_resumes >= 1
+    assert [f["kind"] for f in r.replica_faults] == \
+        ["kill", "drain", "kill"]
+
+
+def test_bench_fanout_xl_smoke_mode():
+    """bench.py --smoke with the fanout-xl config stays runnable
+    end-to-end: the 100k-watcher drill's always-armed correctness gates
+    (O(events) store puts, zero evictions, encode-once, witness
+    coherence) run at CI scale, so config drift breaks tier-1 instead of
+    a nightly."""
+    repo = Path(__file__).resolve().parents[1]
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["BENCH_CONFIGS"] = "fanout-xl"
+    env["BENCH_FANOUT_XL_WATCHERS"] = "400"
+    env["BENCH_FANOUT_XL_EVENTS"] = "4"
+    env["BENCH_FANOUT_XL_NOMINAL"] = "2"
+    env["BENCH_FANOUT_XL_BASE_WATCHERS"] = "100"
+    env["BENCH_FANOUT_XL_SCHED_NODES"] = "4"
+    env["BENCH_FANOUT_XL_SCHED_PODS"] = "8"
+    proc = subprocess.run(
+        [sys.executable, "bench.py", "--smoke"],
+        cwd=repo, env=env, capture_output=True, text=True, timeout=420)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    line = [ln for ln in proc.stdout.splitlines() if ln.strip()][-1]
+    result = json.loads(line)
+    assert "error" not in result, result
+    extras = result["extras"]
+    assert extras["fanout_xl_watchers"] == 400
+    assert extras["fanout_xl_shards"] >= 1
+    assert extras["fanout_xl_deliveries"] == 400 * 6  # burst + nominal
+    assert extras["fanout_xl_store_puts"] == 6
+    assert extras["fanout_xl_evicted"] == 0
+    assert extras["fanout_xl_frames_encoded"] == 6  # encode-once
+    assert extras["fanout_xl_speedup"] > 0
+    assert extras["fanout_xl_sched_p99_base_ms"] > 0

@@ -147,6 +147,46 @@ def test_r1_tier3_clean_on_threadsafe_marshal_and_own_loop():
     assert lint_source(src, rules=R1) == []
 
 
+def test_r1_tier3_flags_fanout_shard_waking_consumer_unsafely():
+    # the watch fan-out shard bug class: a delivery thread waking the
+    # loop-side consumer with plain call_soon (instead of the threadsafe
+    # variant) races loop internals
+    src = (
+        "import asyncio, threading\n"
+        "class Shard:\n"
+        "    def start(self):\n"
+        "        threading.Thread(target=self._run).start()\n"
+        "    def _run(self):\n"
+        "        for sub in self.subs:\n"
+        "            sub.buf.append(self.frame)\n"
+        "            sub.loop.call_soon(sub.event.set)\n"
+    )
+    found = lint_source(src, rules=R1)
+    assert [f.line for f in found] == [8]
+    assert "call_soon_threadsafe" in found[0].message
+
+
+def test_r1_tier3_clean_on_shard_thread_socket_writes():
+    # the sanctioned fan-out shard shape: non-blocking socket sends with
+    # select-based backpressure are fine in sync thread code (select is
+    # only loop-hostile inside async def), and consumer wakeups cross to
+    # the loop through call_soon_threadsafe
+    src = (
+        "import select, threading\n"
+        "class Shard:\n"
+        "    def start(self):\n"
+        "        threading.Thread(target=self._run).start()\n"
+        "    def _run(self):\n"
+        "        while self.frames:\n"
+        "            data = self.frames.popleft()\n"
+        "            while data:\n"
+        "                select.select([], [self.sock], [], 0.05)\n"
+        "                data = data[self.sock.send(data):]\n"
+        "            self.loop.call_soon_threadsafe(self.wake)\n"
+    )
+    assert lint_source(src, rules=R1) == []
+
+
 def test_suppression_comment_on_line_and_line_above():
     inline = (
         "import time\n"

@@ -355,6 +355,30 @@ def test_watch_cache_resume_backlog_from_ring():
     asyncio.run(run())
 
 
+def test_store_eviction_sentinel_lands_promptly():
+    """Evicting a store watcher with a FULL queue drops the oldest
+    buffered event to make room for the end-of-stream sentinel: the
+    consumer sees at most bound-1 events and then the stream ends
+    immediately, instead of draining the whole backlog first."""
+
+    async def run():
+        store = ObjectStore(watcher_queue_limit=4)
+        slow = store.watch("Node")
+        for i in range(6):  # overflows at the 5th event -> eviction
+            store.create(Node.from_dict({"metadata": {"name": f"e{i}"}}))
+        assert slow._entry.evicted
+        seen = 0
+        t0 = time.monotonic()
+        while await slow.next(timeout=5.0) is not None:
+            seen += 1
+        assert seen <= 3  # one buffered event gave way to the sentinel
+        # the sentinel is IN the queue: the stream ended without burning
+        # the next() timeout on an evicted-flag poll
+        assert time.monotonic() - t0 < 1.0
+
+    asyncio.run(run())
+
+
 # ---- store longevity: compaction + snapshot-backed WAL ----
 
 
