@@ -150,6 +150,14 @@ def main() -> None:
         os.environ.setdefault("BENCH_SHARDED_DEVICE_PODS", "64")
         os.environ.setdefault("BENCH_SHARDED_GATE", "0")  # CPU CI: no gate
         os.environ.setdefault("BENCH_SHARDED_FORCE_HOST", "1")
+        os.environ.setdefault("BENCH_MULTIPROC_WORKERS", "2")
+        os.environ.setdefault("BENCH_MULTIPROC_WATCHERS", "50")
+        os.environ.setdefault("BENCH_MULTIPROC_EVENTS", "10")
+        os.environ.setdefault("BENCH_MULTIPROC_PODS", "12")
+        # 1-vCPU CI: worker processes contend for one core, so the
+        # cross-process rate cannot beat in-process — correctness gates
+        # stay armed, the perf gate does not
+        os.environ.setdefault("BENCH_MULTIPROC_GATE", "0")
         os.environ.setdefault(
             "BENCH_CONFIGS",
             "headline,gang,preemption,autoscaler,sharded,monitor")
@@ -163,7 +171,7 @@ def main() -> None:
     configs = os.environ.get(
         "BENCH_CONFIGS",
         "headline,interpod,spread,gang,preemption,recovery,chaos,overload,"
-        "device,autoscaler,monitor,ha,fanout-xl")
+        "device,autoscaler,monitor,ha,fanout-xl,multiproc")
     configs = [c.strip() for c in configs.split(",") if c.strip()]
     metrics_snapshot = "--metrics-snapshot" in sys.argv[1:] or \
         os.environ.get("BENCH_METRICS_SNAPSHOT", "") in ("1", "true")
@@ -567,6 +575,92 @@ def main() -> None:
                 f"{r.sched_p99_flood_ms:.1f}ms under flood breached "
                 f"{xl_p99_mult}x its unloaded {r.sched_p99_base_ms:.1f}"
                 f"ms")
+
+    if "multiproc" in configs:
+        from kubernetes_tpu.perf.harness import run_multiproc
+
+        # multi-process control plane drill: a store-owner process feeds
+        # BENCH_MULTIPROC_WORKERS real worker processes (pinned, own
+        # serving loop + fan-out shards) through the shared-memory event
+        # ring, A/B'd against the in-process sharded topology at the same
+        # total-sink shape. The correctness gates are always armed —
+        # encode-once across the process boundary (owner frames_encoded
+        # == ring appends == store events, zero worker re-encodes),
+        # exactly-once binds across a SIGKILL + respawn, a gapless
+        # cross-process witness, and a zero-failure fleet scrape over
+        # discovered per-worker /metrics. BENCH_MULTIPROC_GATE (default
+        # 1: aggregate must at least match in-process; 0 disables) gates
+        # the cross-process delivery rate
+        mpw = int(os.environ.get("BENCH_MULTIPROC_WORKERS", "2"))
+        mp_watchers = int(os.environ.get("BENCH_MULTIPROC_WATCHERS",
+                                         "1000"))
+        mp_events = int(os.environ.get("BENCH_MULTIPROC_EVENTS", "12"))
+        mp_pods = int(os.environ.get("BENCH_MULTIPROC_PODS", "24"))
+        mp_gate = float(os.environ.get("BENCH_MULTIPROC_GATE", "1"))
+        r = run_multiproc(workers=mpw,
+                          per_worker_watchers=mp_watchers,
+                          events=mp_events, n_pods=mp_pods)
+        print(f"bench[multiproc]: {r}", file=sys.stderr, flush=True)
+        extras["multiproc_workers"] = r.workers
+        extras["multiproc_watchers"] = r.watchers
+        extras["multiproc_deliveries"] = r.deliveries
+        extras["multiproc_events_per_sec"] = round(r.events_per_sec, 1)
+        extras["multiproc_inproc_events_per_sec"] = round(
+            r.inproc_events_per_sec, 1)
+        extras["multiproc_speedup"] = round(r.speedup, 2)
+        extras["multiproc_ring_appends"] = r.ring_appends
+        extras["multiproc_worker_frames_encoded"] = r.worker_frames_encoded
+        extras["multiproc_bound"] = r.bound
+        extras["multiproc_bind_conflicts"] = r.bind_conflicts
+        extras["multiproc_respawns"] = r.respawns
+        extras["multiproc_failovers"] = r.failovers
+        extras["multiproc_witness_events"] = r.witness_events
+        extras["multiproc_monitor_targets"] = r.monitor_targets
+        extras["multiproc_scrape_failures"] = r.scrape_failures
+        if r.ring_appends != r.store_events:
+            RESULT["error"] = (
+                f"multiproc: {r.ring_appends} ring appends for "
+                f"{r.store_events} store events — the owner is not "
+                f"appending exactly once per event")
+        elif r.owner_frames_encoded != r.ring_appends:
+            RESULT["error"] = (
+                f"multiproc: owner encoded {r.owner_frames_encoded} "
+                f"frames for {r.ring_appends} ring appends — the "
+                f"encode-once contract is broken at the writer")
+        elif r.worker_frames_encoded:
+            RESULT["error"] = (
+                f"multiproc: workers re-encoded "
+                f"{r.worker_frames_encoded} frames that crossed the ring "
+                f"as wire bytes (expected 0)")
+        elif r.deliveries < r.watchers * r.events:
+            RESULT["error"] = (
+                f"multiproc: {r.deliveries} sink deliveries for "
+                f"{r.watchers} watchers x {r.events} events")
+        elif r.bound != r.pods or r.double_binds:
+            RESULT["error"] = (
+                f"multiproc: {r.bound}/{r.pods} pods bound with "
+                f"{r.double_binds} double-binds across the worker kill "
+                f"(exactly-once broken)")
+        elif r.witness_gaps or r.witness_dupes:
+            RESULT["error"] = (
+                f"multiproc witness incoherence: {r.witness_gaps} gaps, "
+                f"{r.witness_dupes} duplicates across "
+                f"{r.witness_events} events at the fence rv")
+        elif not r.respawns or 0 not in r.reaped:
+            RESULT["error"] = (
+                f"multiproc: killed worker was not reaped+respawned "
+                f"(reaped={r.reaped}, respawns={r.respawns})")
+        elif r.monitor_targets < r.workers or r.scrape_failures:
+            RESULT["error"] = (
+                f"multiproc: monitor discovered {r.monitor_targets}/"
+                f"{r.workers} worker targets with {r.scrape_failures} "
+                f"scrape failures")
+        elif mp_gate and r.speedup < mp_gate:
+            RESULT["error"] = (
+                f"multiproc: cross-process delivery "
+                f"{r.events_per_sec:.0f}/s is only {r.speedup:.2f}x the "
+                f"in-process {r.inproc_events_per_sec:.0f}/s "
+                f"(gate {mp_gate}x)")
 
     if "autoscaler" in configs:
         from kubernetes_tpu.perf.harness import run_autoscaler

@@ -18,6 +18,11 @@ R6 span-discipline     — observability hygiene: scoped span acquisitions
                          path leaks an open span; counter/histogram
                          family names carry the Prometheus suffix
                          conventions (_total, _seconds/...).
+R7 multiproc-handles   — process-boundary hygiene: no live handle
+                         (socket, loop, store, shm, jax array holder)
+                         captured by a multiprocessing spawn target or
+                         passed in its args, and no raw SharedMemory
+                         access outside the event-ring API.
 
 Each rule is a small class with a `name` and `check(Module) -> [Finding]`.
 Heuristics err toward precision: a rule that cries wolf gets suppressed
@@ -793,7 +798,140 @@ class SpanDiscipline:
                         "it)")
 
 
+# ---------------------------------------------------------------------------
+# R7: multiprocessing handle discipline
+
+
+class MultiprocDiscipline:
+    """A child process gets a COPY (fork) or a re-pickle (spawn) of
+    whatever the target captures — a socket fd pointing at the parent's
+    connection state, an event loop that was never running there, an
+    ObjectStore whose mutations silently diverge from the parent's, a
+    jax array whose device buffer does not follow. Every one of these is
+    a works-on-the-happy-path bug that only detonates under load or
+    respawn. The discipline (apiserver/multiproc.py's WorkerSpec shape):
+    a spawn target is a MODULE-LEVEL function taking only names and
+    numbers; the child constructs its own handles.
+
+    Three checks:
+      1. `*.Process(target=...)` with a lambda, a bound method
+         (Attribute), or a function defined nested in another function —
+         all three capture enclosing live state.
+      2. `Process(args=/kwargs=)` entries whose terminal identifier names
+         a live handle (store/loop/sock/ring/shm/...).
+      3. Raw `SharedMemory(...)` construction outside the event-ring
+         module: the ring API owns segment naming, tracker discipline
+         and lifetime; ad-hoc segments leak on crash."""
+
+    name = "multiproc-handles"
+
+    # terminal identifiers that name live handles (matched after
+    # stripping leading underscores)
+    LIVE_HANDLES = {
+        "store", "loop", "sock", "socket", "server", "conn", "writer",
+        "reader", "shm", "ring", "cache", "client", "session", "arr",
+        "array",
+    }
+    # the ring module owns the raw segment; everyone else rides its API
+    SHM_EXEMPT = ("kubernetes_tpu/apiserver/multiproc.py",)
+
+    def check(self, mod: Module):
+        nested = self._nested_function_names(mod)
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            resolved = mod.resolve(node.func) or ""
+            if resolved == "Process" or resolved.endswith(".Process"):
+                yield from self._check_process_call(mod, node, nested)
+            if resolved == "SharedMemory" \
+                    or resolved.endswith(".SharedMemory"):
+                if mod.relpath not in self.SHM_EXEMPT:
+                    yield Finding(
+                        self.name, mod.relpath, node.lineno,
+                        node.col_offset,
+                        "raw SharedMemory() outside the event-ring API "
+                        "(apiserver/multiproc.py): the ring owns segment "
+                        "naming, resource-tracker discipline and unlink "
+                        "lifetime — ad-hoc segments leak on crash")
+
+    @staticmethod
+    def _nested_function_names(mod: Module) -> set[str]:
+        """Names of functions defined INSIDE another function/method —
+        passing one as a spawn target captures the enclosing frame."""
+        top = {n.name for n in mod.tree.body
+               if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+        nested: set[str] = set()
+        for node in ast.walk(mod.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for child in ast.walk(node):
+                    if child is not node and isinstance(
+                            child,
+                            (ast.FunctionDef, ast.AsyncFunctionDef)):
+                        if child.name not in top:
+                            nested.add(child.name)
+        return nested
+
+    def _is_live_handle(self, expr: ast.expr) -> str | None:
+        """The offending terminal identifier when `expr` names a live
+        handle, else None."""
+        if isinstance(expr, ast.Attribute):
+            terminal = expr.attr
+        elif isinstance(expr, ast.Name):
+            terminal = expr.id
+        else:
+            return None
+        stripped = terminal.lstrip("_").lower()
+        return terminal if stripped in self.LIVE_HANDLES else None
+
+    def _check_process_call(self, mod: Module, node: ast.Call,
+                            nested: set[str]):
+        for kw in node.keywords:
+            if kw.arg == "target":
+                v = kw.value
+                if isinstance(v, ast.Lambda):
+                    yield Finding(
+                        self.name, mod.relpath, v.lineno, v.col_offset,
+                        "lambda as a Process target captures its "
+                        "enclosing frame (sockets, loops, stores ride "
+                        "along) — use a module-level function taking a "
+                        "picklable spec")
+                elif isinstance(v, ast.Attribute):
+                    yield Finding(
+                        self.name, mod.relpath, v.lineno, v.col_offset,
+                        f"bound method {ast.unparse(v)!r} as a Process "
+                        "target pickles/forks its whole instance — every "
+                        "live handle on it crosses the process boundary; "
+                        "use a module-level function taking a picklable "
+                        "spec")
+                elif isinstance(v, ast.Name) and v.id in nested:
+                    yield Finding(
+                        self.name, mod.relpath, v.lineno, v.col_offset,
+                        f"nested function {v.id!r} as a Process target "
+                        "captures its enclosing frame — hoist it to "
+                        "module level and pass state through args")
+            elif kw.arg in ("args", "kwargs"):
+                elements: list[ast.expr] = []
+                if isinstance(kw.value, (ast.Tuple, ast.List)):
+                    elements = list(kw.value.elts)
+                elif isinstance(kw.value, ast.Dict):
+                    elements = [v for v in kw.value.values
+                                if v is not None]
+                for el in elements:
+                    offender = self._is_live_handle(el)
+                    if offender:
+                        yield Finding(
+                            self.name, mod.relpath, el.lineno,
+                            el.col_offset,
+                            f"live handle {offender!r} passed to a child "
+                            "process: the child gets a copy/re-pickle "
+                            "whose state silently diverges (fds, loops, "
+                            "stores, device arrays don't cross) — pass "
+                            "names/numbers and reconstruct inside the "
+                            "child")
+
+
 RULES = [EventLoopPurity(), TracePurity(), BatchFlagsDiscipline(),
-         Determinism(), StoreWriteDiscipline(), SpanDiscipline()]
+         Determinism(), StoreWriteDiscipline(), SpanDiscipline(),
+         MultiprocDiscipline()]
 
 RULE_NAMES = {r.name for r in RULES}

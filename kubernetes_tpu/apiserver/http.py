@@ -1838,6 +1838,10 @@ class RemoteStore:
             [(h, int(p)) for h, p in endpoints] if endpoints \
             else [(host, int(port))]
         self._active = 0
+        # last endpoint that answered a request successfully: the first
+        # probe after a transport failure (it is the likeliest survivor,
+        # so failover skips the dead-endpoint walk in the common case)
+        self._last_good: int | None = None
         # per-connection I/O timeout: a black-holed replica (SYN accepted,
         # bytes never answered) must surface as an OSError and fail over
         # instead of hanging the caller forever. None = no bound (the
@@ -1898,7 +1902,16 @@ class RemoteStore:
         return list(self._endpoints)
 
     def _advance_endpoint(self) -> None:
-        """Round-robin onto the next replica after a transport failure."""
+        """Step off a failed replica: jump to the last-known-good
+        endpoint first (one jump per failure episode — it answered most
+        recently, so it shaves the dead-endpoint walk out of failover
+        p99), then round-robin the rest of the set."""
+        lg = self._last_good
+        self._last_good = None  # one preferred probe per episode
+        if lg is not None and lg != self._active \
+                and lg < len(self._endpoints):
+            self._active = lg
+            return
         self._active = (self._active + 1) % len(self._endpoints)
 
     def _ready(self, host: str, port: int,
@@ -1943,8 +1956,12 @@ class RemoteStore:
             return list(self._endpoints)
         if addrs:
             current = self._endpoints[self._active]
+            lg = self._endpoints[self._last_good] \
+                if self._last_good is not None \
+                and self._last_good < len(self._endpoints) else None
             self._endpoints = addrs
             self._active = addrs.index(current) if current in addrs else 0
+            self._last_good = addrs.index(lg) if lg in addrs else None
         return list(self._endpoints)
 
     def _auth_header(self) -> str:
@@ -2049,6 +2066,7 @@ class RemoteStore:
                 self.failover_total += 1
                 self.failover_samples.append(
                     1e3 * (_time.monotonic() - episode_start))
+            self._last_good = self._active
             break
         if status == 400 and self._pb and body is not None \
                 and content_type is None:
@@ -2342,13 +2360,20 @@ class RemoteStore:
             start = self._watch_seq % n
             self._watch_seq += 1
             order = [(start + i) % n for i in range(n)]
+            lg = self._last_good
+            if lg is not None and lg < n and lg != order[0]:
+                # keep the round-robin start first (load spreading), but
+                # probe the last-known-good endpoint right after it
+                # instead of walking the set in rotation order
+                order.remove(lg)
+                order.insert(1, lg)
         else:
             order = [self._active]
         last_exc: Exception | None = None
         for idx in order:
             host, port = self._endpoints[idx]
             try:
-                return await asyncio.wait_for(
+                stream = await asyncio.wait_for(
                     self._open_watch_at(host, port, plural, query),
                     timeout=5.0 if n > 1 else None)
             except (Expired, ValueError):
@@ -2358,6 +2383,8 @@ class RemoteStore:
                 if n > 1:
                     self.failover_total += 1
                 continue
+            self._last_good = idx
+            return stream
         raise ConnectionError(
             f"no replica would serve the watch "
             f"({len(order)} endpoint(s) tried)") from last_exc

@@ -155,6 +155,12 @@ class ObjectStore:
         # subscriber and this advances exactly once per published event no
         # matter how many HTTP watchers exist — the fan-out drill's counter
         self.fanout_puts = 0
+        # event taps: synchronous callbacks invoked once per published
+        # event, after WAL + history, in rv order — the multiproc ring
+        # writer hangs here (apiserver/multiproc.py). A tap must never
+        # raise and must not mutate the event. O(events) like fanout_puts:
+        # taps see each event exactly once regardless of subscriber count
+        self.event_taps: list[Callable[[WatchEvent], None]] = []
         # snapshot-backed WAL: after `snapshot_every` log appends, compact()
         # writes a snapshot and truncates the log (0 = manual compact only)
         self.snapshot_every = snapshot_every
@@ -496,6 +502,10 @@ class ObjectStore:
                 self._append_wal(ev, flush=False)
             self._wal.flush()
         self._history.extend(events)
+        if self.event_taps:
+            for ev in events:
+                for tap in self.event_taps:
+                    tap(ev)
         for watcher in list(self._watchers):
             kind = watcher.kind
             put = watcher.queue.put_nowait
@@ -754,6 +764,10 @@ class ObjectStore:
                 self._append_wal(ev, flush=False)
             self._wal.flush()
         self._history.extend(events)
+        if self.event_taps:
+            for ev in events:
+                for tap in self.event_taps:
+                    tap(ev)
         for watcher in pod_watchers:
             put = watcher.queue.put_nowait
             try:
@@ -797,12 +811,42 @@ class ObjectStore:
         self._publish(WatchEvent("MODIFIED", "Pod", stored, rv))
         return stored
 
+    # ---- multiproc mirror ----
+
+    def apply_external_event(self, event: WatchEvent) -> None:
+        """Mirror-apply one event from an external authority (the
+        multiproc shared-memory ring): update the bucket, advance the rv
+        clock, append history, fan out to local watchers. No WAL, no
+        validation/admission, no taps — the owner process already did all
+        of that; this store is a read replica and events arrive strictly
+        in rv order (single writer, single sequence)."""
+        obj = event.obj
+        key = _key(obj.metadata.namespace, obj.metadata.name)
+        bucket = self._bucket(event.kind)
+        if event.type == "DELETED":
+            bucket.pop(key, None)
+        else:
+            bucket[key] = obj
+            if event.kind == "Service":
+                self._reserve_cluster_ip(obj.spec.get("clusterIP", ""))
+        self._rv = max(self._rv, event.resource_version)
+        self._history.append(event)
+        for watcher in list(self._watchers):
+            if watcher.kind is None or watcher.kind == event.kind:
+                try:
+                    watcher.queue.put_nowait(event)
+                    self.fanout_puts += 1
+                except asyncio.QueueFull:
+                    self._evict_watcher(watcher)
+
     # ---- watch ----
 
     def _publish(self, event: WatchEvent) -> None:
         if self._wal is not None:
             self._append_wal(event)
         self._history.append(event)
+        for tap in self.event_taps:
+            tap(event)
         for watcher in list(self._watchers):
             if watcher.kind is None or watcher.kind == event.kind:
                 try:

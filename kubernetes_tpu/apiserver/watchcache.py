@@ -474,6 +474,9 @@ class WatchCache:
         self._stashed: list[asyncio.Task] = []
         self._count_lock = threading.Lock()
         self.started = False
+        # external-feed mode (multiproc workers): no store subscription —
+        # the ring pump pushes pre-encoded frames via ingest_external()
+        self._external = False
         # drill/test counters
         self.events_total = 0
         self.evictions = 0
@@ -510,6 +513,79 @@ class WatchCache:
                 w.task = loop.create_task(self._fan_out(w))
         self.started = True
         return self
+
+    def start_external(self) -> "WatchCache":
+        """Start in external-feed mode (multiproc worker processes): prime
+        ring + latest map from the mirror store, start the delivery plane
+        (shards or loop workers), but subscribe to NOTHING — the worker's
+        ring pump is the only event source, pushing frames whose wire
+        bytes were encoded once in the owner process via
+        `ingest_external`. Must run on the serving loop."""
+        if self.started:
+            return self
+        self._ring.clear()
+        self._ring.extend(_Frame(e) for e in self.store._history)
+        self._last_rv = self.store.resource_version
+        self._latest = {kind: dict(bucket)
+                        for kind, bucket in self.store._objects.items()}
+        self._external = True
+        loop = asyncio.get_running_loop()
+        if self.shards_n:
+            self._shards = [FanoutShard(self, i)
+                            for i in range(self.shards_n)]
+            for shard in self._shards:
+                shard.start()
+        else:
+            self._workers = [_Worker() for _ in range(self._n_workers)]
+            for w in self._workers:
+                w.task = loop.create_task(self._fan_out(w))
+        self.started = True
+        return self
+
+    def ingest_external(self, event: WatchEvent,
+                        json_payload: bytes | None = None) -> None:
+        """Ingest one externally-published event (the multiproc ring pump
+        path, on the serving loop). `json_payload` is the owner-encoded
+        wire frame: the frame is pre-populated with it so every delivery
+        in this process shares the owner's bytes — zero per-process
+        re-encode, and `watchcache_frames_encoded_total` stays 0 here
+        (the owner's counter is the encode-once ledger)."""
+        frame = _Frame(event)
+        if json_payload is not None:
+            frame._json = json_payload
+        self._ring.append(frame)
+        self._last_rv = max(self._last_rv, event.resource_version)
+        obj = event.obj
+        key = (obj.metadata.namespace or "default", obj.metadata.name)
+        bucket = self._latest.setdefault(event.kind, {})
+        if event.type == "DELETED":
+            bucket.pop(key, None)
+        else:
+            bucket[key] = obj
+        self.events_total += 1
+        if self._shards:
+            for shard in self._shards:
+                if shard.wants(event.kind):
+                    shard.submit(frame)
+        else:
+            for w in self._workers:
+                w.queue.put_nowait(frame)
+
+    def rebuild_external(self) -> None:
+        """External-feed mode's honest-410 path: the ring overran this
+        worker. The pump has already resynced the mirror store from an
+        owner snapshot; rebuild the frame ring + latest map from it and
+        evict every subscriber — they relist, exactly as if the store
+        itself had expired their resume point. Never a silent gap."""
+        self._latest = {kind: dict(bucket)
+                        for kind, bucket in self.store._objects.items()}
+        self._ring.clear()
+        self._last_rv = self.store.resource_version
+        self.rebuilds += 1
+        for sub in self._all_subs():
+            self._end_sub(sub, _EVICTED, count=True, reason="evicted")
+        log.warning("watch cache (external feed): ring overrun; rebuilt "
+                    "from mirror snapshot and evicted all subscribers")
 
     def stop(self) -> None:
         """Synchronous, idempotent teardown: cancels the pump/worker tasks
@@ -566,26 +642,9 @@ class WatchCache:
             self._ingest(event)
 
     def _ingest(self, event: WatchEvent) -> None:
-        frame = _Frame(event)
-        self._ring.append(frame)
-        self._last_rv = max(self._last_rv, event.resource_version)
-        obj = event.obj
-        key = (obj.metadata.namespace or "default", obj.metadata.name)
-        bucket = self._latest.setdefault(event.kind, {})
-        if event.type == "DELETED":
-            bucket.pop(key, None)
-        else:
-            bucket[key] = obj
-        self.events_total += 1
-        if self._shards:
-            for shard in self._shards:
-                # per-kind index: the frame only reaches shards with at
-                # least one interested subscriber
-                if shard.wants(event.kind):
-                    shard.submit(frame)
-        else:
-            for w in self._workers:
-                w.queue.put_nowait(frame)
+        # per-kind index inside: the frame only reaches shards with at
+        # least one interested subscriber
+        self.ingest_external(event)
 
     async def _resubscribe(self) -> None:
         """The cache's own subscription died (forced expiry / eviction):

@@ -76,6 +76,13 @@ def parse_args(argv=None) -> argparse.Namespace:
                    help="graceful-shutdown budget: readyz 503s, in-flight "
                         "requests finish, watchers get the terminal DRAIN "
                         "frame before the process exits")
+    p.add_argument("--worker-procs", type=int, default=None,
+                   help="multi-process control plane: THIS process keeps "
+                        "the authoritative store (single writer, WAL, "
+                        "shared-memory event ring) and N worker processes "
+                        "serve HTTP on --port..--port+N-1, each pinned to "
+                        "a core. Defaults to $KTPU_WORKER_PROCS; 0 = "
+                        "today's single-process topology")
     return p.parse_args(argv)
 
 
@@ -180,12 +187,88 @@ async def run(args) -> None:
         await server.drain(getattr(args, "shutdown_drain_seconds", 5.0))
 
 
+async def run_multiproc(args, n: int) -> None:
+    """Owner + N worker processes (`--worker-procs N`). The owner does
+    not serve HTTP: workers own ports --port..--port+N-1 and forward
+    mutations back over the unix-socket RPC; watch frames reach them as
+    the owner's encode-once wire bytes through the shared-memory ring."""
+    import signal
+
+    from kubernetes_tpu.apiserver.admission import chain_for
+    from kubernetes_tpu.apiserver.multiproc import (
+        StoreOwner,
+        WorkerSpec,
+        spawn_worker,
+        wait_port,
+    )
+    from kubernetes_tpu.apiserver.store import ObjectStore
+
+    if args.tls_cert_file or args.token_auth_file or args.client_ca_file:
+        # serving-side security config lives in the worker processes;
+        # plumbing it through WorkerSpec is not wired yet — refuse
+        # loudly rather than serve an open surface the flags promised
+        # to close
+        raise SystemExit("--worker-procs does not support TLS/authn "
+                         "flags yet; run single-process for a secured "
+                         "surface")
+    store = ObjectStore(
+        watch_window=args.watch_cache_size,
+        persist_path=args.wal or None,
+        admission=chain_for(args.admission_control)
+        if args.admission_control else None)
+    owner = StoreOwner(store, n_slots=max(n, 2))
+    await owner.start()
+    procs = []
+    try:
+        for i in range(n):
+            spec = WorkerSpec(
+                worker_id=i, ring_name=owner.ring.name,
+                rpc_path=owner.rpc_path, host=args.host,
+                port=args.port + i,
+                advertise=getattr(args, "advertise", False))
+            procs.append(spawn_worker(spec))
+        for i in range(n):
+            if not await asyncio.to_thread(
+                    wait_port, args.host, args.port + i, 30.0):
+                raise SystemExit(
+                    f"worker {i} failed to serve on "
+                    f"{args.host}:{args.port + i}")
+            print(f"READY http://{args.host}:{args.port + i}",
+                  flush=True)
+        log.info("store owner up (wal=%s); %d worker process(es) on "
+                 "ports %d..%d", args.wal or "<memory>", n,
+                 args.port, args.port + n - 1)
+        await asyncio.Event().wait()  # serve until killed
+    finally:
+        for proc in procs:
+            if proc.is_alive():
+                try:
+                    os.kill(proc.pid, signal.SIGTERM)
+                except ProcessLookupError:
+                    pass
+        for proc in procs:
+            proc.join(timeout=getattr(
+                args, "shutdown_drain_seconds", 5.0) + 2.0)
+            if proc.is_alive():
+                proc.kill()
+        await owner.aclose()
+
+
 def main(argv=None) -> int:
     logging.basicConfig(
         level=os.environ.get("KUBE_LOG_LEVEL", "INFO"),
         format="%(asctime)s %(levelname).1s %(name)s: %(message)s")
+    args = parse_args(argv)
+    n = args.worker_procs
+    if n is None:
+        from kubernetes_tpu.apiserver.multiproc import default_worker_procs
+
+        n = default_worker_procs()
     try:
-        asyncio.run(run(parse_args(argv)))
+        if n > 0:
+            asyncio.run(run_multiproc(args, n))
+        else:
+            asyncio.run(run(args))
     except KeyboardInterrupt:
         pass
     return 0

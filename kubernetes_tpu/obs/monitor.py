@@ -874,11 +874,11 @@ class Monitor:
     def _discovered_targets(self) -> list[Target]:
         if self.store is None:
             return []
+        out = []
         try:
             nodes = self.store.list("Node")
         except Exception:  # noqa: BLE001 — discovery is best-effort
-            return []
-        out = []
+            nodes = []
         for node in nodes:
             eps = getattr(node.status, "daemon_endpoints", None) or {}
             port = (eps.get("kubeletEndpoint") or {}).get("Port")
@@ -886,6 +886,23 @@ class Monitor:
                 out.append(Target(
                     job="kubelet", instance=node.metadata.name,
                     url=f"http://{self._node_host}:{port}", summary=True))
+        # apiserver replicas/worker processes advertise into the
+        # well-known default/kubernetes Endpoints (the master-count
+        # reconciler shape) — each one scrapes as its own instance, so a
+        # multi-process control plane is N per-process /metrics targets
+        try:
+            ep = self.store.get("Endpoints", "kubernetes", "default")
+            for subset in ep.subsets:
+                for addr in subset.get("addresses", []):
+                    ip, port = addr.get("ip", ""), addr.get("port", 0)
+                    if not ip or not port:
+                        continue
+                    out.append(Target(
+                        job="apiserver",
+                        instance=addr.get("replica") or f"{ip}:{port}",
+                        url=f"http://{ip}:{port}"))
+        except Exception:  # noqa: BLE001 — discovery is best-effort
+            pass
         return out
 
     def targets(self) -> list[Target]:

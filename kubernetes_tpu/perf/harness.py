@@ -1682,3 +1682,348 @@ def run_monitor_bench(n_targets: int = 5, seconds: float = 10.0,
     """Blocking entry point for the monitoring-plane overhead drill."""
     return asyncio.run(_run_monitor_bench(n_targets, seconds, interval,
                                           retention_samples, seed=seed))
+
+
+@dataclass
+class MultiProcResult:
+    """Multi-process control-plane drill (bench[multiproc]): a store-owner
+    process feeding N worker processes over the shared-memory event ring,
+    A/B'd against the in-process sharded topology at the same shape. The
+    contracts: every worker's sinks see every event as the owner's
+    encode-once wire bytes (owner frames_encoded == ring appends == store
+    resourceVersion; worker re-encodes == 0), a SIGKILL'd worker is
+    reaped + respawned without replaying delivered frames or double-
+    binding a pod, the cross-process witness stream is gapless/dup-free
+    against the owner's authoritative history at a fence rv, and the
+    monitoring plane discovers every worker's per-process /metrics and
+    scrapes the fleet with zero failures."""
+
+    workers: int
+    shards: int
+    watchers: int             # total bench sinks across the fleet
+    events: int               # Node burst events
+    inproc_deliveries: int
+    inproc_events_per_sec: float
+    deliveries: int           # cross-process aggregate sink deliveries
+    events_per_sec: float
+    speedup: float            # cross-process rate / in-process rate
+    ring_appends: int
+    store_events: int         # owner store resourceVersion delta
+    owner_frames_encoded: int
+    worker_frames_encoded: int  # sum across workers — must stay 0
+    pods: int
+    bound: int
+    double_binds: int
+    bind_conflicts: int       # replayed binds answered Conflict
+    kills: int
+    respawns: int
+    reaped: list = field(default_factory=list)
+    failovers: int = 0
+    witness_events: int = 0
+    witness_gaps: int = 0
+    witness_dupes: int = 0
+    monitor_targets: int = 0
+    scrapes: int = 0
+    scrape_failures: int = 0
+
+    @property
+    def gate(self) -> bool:
+        """Correctness contract in one bool (speedup gates separately —
+        it is a perf target, not a correctness invariant)."""
+        return (self.ring_appends == self.store_events
+                and self.owner_frames_encoded == self.ring_appends
+                and self.worker_frames_encoded == 0
+                and self.deliveries >= self.watchers * self.events
+                and self.bound == self.pods and self.double_binds == 0
+                and self.witness_gaps == 0 and self.witness_dupes == 0
+                and self.respawns >= 1 and 0 in self.reaped
+                and self.monitor_targets >= self.workers
+                and self.scrape_failures == 0)
+
+    def __str__(self) -> str:
+        return (f"multiproc W={self.workers}x{self.watchers // max(self.workers, 1)} "
+                f"E={self.events} S={self.shards}: {self.deliveries} "
+                f"deliveries ({self.events_per_sec:.0f}/s, "
+                f"{self.speedup:.2f}x in-process "
+                f"{self.inproc_events_per_sec:.0f}/s), ring "
+                f"{self.ring_appends} appends / {self.store_events} events, "
+                f"worker re-encodes {self.worker_frames_encoded}, "
+                f"{self.bound}/{self.pods} bound "
+                f"({self.double_binds} double, {self.bind_conflicts} "
+                f"replay-conflicts), witness {self.witness_events} events "
+                f"{self.witness_gaps} gaps {self.witness_dupes} dupes, "
+                f"monitor {self.monitor_targets} targets "
+                f"{self.scrape_failures} failed scrapes")
+
+
+def _worker_metric(host: str, port: int, name: str) -> float:
+    """Blocking: read one unlabeled counter from a worker's /metrics."""
+    import urllib.request
+
+    with urllib.request.urlopen(
+            f"http://{host}:{port}/metrics", timeout=5.0) as resp:
+        text = resp.read().decode("utf-8", "replace")
+    total = 0.0
+    for line in text.splitlines():
+        if line.startswith(name) and not line.startswith("#"):
+            rest = line[len(name):]
+            if rest[:1] not in ("", " ", "{", "\t"):
+                continue  # a longer family sharing the prefix
+            total += float(rest.rsplit(None, 1)[-1])
+    return total
+
+
+async def _inproc_fanout_round(watchers: int, events: int,
+                               shards: int) -> tuple[int, float]:
+    """The A side: today's single-process topology (KTPU_WORKER_PROCS=0)
+    at the drill's shape — sharded fan-out, all sinks in one process."""
+    from array import array
+
+    from kubernetes_tpu.api.objects import Node
+    from kubernetes_tpu.apiserver import watchcache as wc
+
+    store = ObjectStore(watch_window=max(1 << 12, 4 * events))
+    cache = wc.WatchCache(store, shards=shards).start()
+    counts = array("q", [0] * watchers)
+    handles = []
+    for i in range(watchers):
+        def sink(frame, _i=i, _counts=counts):
+            _counts[_i] += 1
+            frame.json_bytes()
+        handles.append(cache.watch_sink("Node", sink=sink))
+    t0 = time.perf_counter()
+    store.create(Node.from_dict({"metadata": {"name": "fan"}}))
+    for i in range(events - 1):
+        store.guaranteed_update(
+            "Node", "fan", "default",
+            lambda n, i=i: n.metadata.labels.update({"tick": str(i)}))
+    deadline = time.monotonic() + 60
+    expect = watchers * events
+    while sum(counts) < expect and time.monotonic() < deadline:
+        await asyncio.sleep(0.005)
+    dt = max(time.perf_counter() - t0, 1e-9)
+    deliveries = sum(counts)
+    for h in handles:
+        h.stop()
+    await cache.aclose()
+    return deliveries, deliveries / dt
+
+
+def run_multiproc(workers: int = 2, per_worker_watchers: int = 100,
+                  events: int = 20, n_pods: int = 24,
+                  shards: int | None = None,
+                  ring_capacity: int = 1 << 20) -> MultiProcResult:
+    """Blocking entry point for the multi-process control-plane drill.
+
+    Five phases: (1) in-process sharded baseline at the same total-sink
+    shape; (2) cross-process burst — Node events appended once by the
+    owner, fanned out by every worker's shard threads, aggregate delivery
+    rate read from each worker's own /metrics; (3) rolling worker-kill
+    bind drill — SIGKILL mid-binds, owner reaps the ring slot, the
+    respawn resumes without replaying delivered frames, replayed binds
+    answer Conflict (exactly-once); (4) cross-process witness diff
+    against the owner's authoritative history at a fence rv; (5) the
+    monitoring plane discovers every worker's /metrics through the
+    advertised Endpoints and scrapes the fleet."""
+    from kubernetes_tpu.api.objects import Node
+    from kubernetes_tpu.apiserver.store import (
+        AlreadyExists,
+        Binding,
+        Conflict,
+        TooManyRequests,
+    )
+    from kubernetes_tpu.obs.monitor import Monitor
+    from kubernetes_tpu.testing.replicas import MultiProcCluster
+
+    total_watchers = workers * per_worker_watchers
+    cap = {"cpu": "16", "memory": "32Gi", "pods": "110"}
+    n_bind_nodes = 4
+
+    cluster = MultiProcCluster(
+        n=workers, shards=shards, ring_capacity=ring_capacity,
+        bench_watchers=per_worker_watchers, bench_kind="Node",
+        advertise=True).start()
+
+    async def drive() -> MultiProcResult:
+        # ---- phase 1: in-process baseline, same total shape ----
+        shards_n = shards if shards is not None else 2
+        inproc_deliveries, inproc_rate = await _inproc_fanout_round(
+            total_watchers, events, shards_n)
+
+        client = cluster.client()
+        witness_client = cluster.client()
+        ports = [p for _, p in cluster.endpoints]
+        host = cluster.host
+
+        def delivered_sum(alive_ports) -> float:
+            return sum(_worker_metric(
+                host, p, "watchcache_frames_delivered_total")
+                for p in alive_ports)
+
+        # the cross-process witness: a resilient Pod watch through the
+        # worker fleet, recording every (type, rv) across the kill
+        observed: list[tuple[str, int]] = []
+        watcher = witness_client.watch_resilient("Pod", since=0)
+        watch_stop = asyncio.Event()
+
+        async def observe() -> None:
+            while not watch_stop.is_set():
+                try:
+                    ev = await watcher.next(timeout=0.5)
+                except ConnectionError:
+                    return
+                if ev is not None:
+                    observed.append((ev.type, ev.resource_version))
+
+        observer = asyncio.get_running_loop().create_task(observe())
+
+        # bind targets, created before the measured burst so their fan-out
+        # doesn't pollute the delivery ledger
+        for i in range(n_bind_nodes):
+            await asyncio.to_thread(client.create, Node.from_dict({
+                "metadata": {"name": f"mp-{i}",
+                             "labels": {"kubernetes.io/hostname": f"mp-{i}"}},
+                "status": {"allocatable": dict(cap),
+                           "capacity": dict(cap)}}))
+        # quiesce: wait until the node-creation fan-out stops moving
+        prev = -1.0
+        while True:
+            cur = await asyncio.to_thread(delivered_sum, ports)
+            if cur == prev:
+                break
+            prev = cur
+            await asyncio.sleep(0.05)
+        base_delivered = prev
+
+        # ---- phase 2: cross-process burst ----
+        expect = total_watchers * events
+        t0 = time.perf_counter()
+        await asyncio.to_thread(
+            client.create, Node.from_dict({"metadata": {"name": "fan"}}))
+        for i in range(events - 1):
+            await asyncio.to_thread(
+                client.guaranteed_update, "Node", "fan", "default",
+                lambda n, i=i: n.metadata.labels.update({"tick": str(i)}))
+        deadline = time.monotonic() + 120
+        delivered = 0.0
+        while time.monotonic() < deadline:
+            delivered = await asyncio.to_thread(delivered_sum, ports)
+            if delivered - base_delivered >= expect:
+                break
+            await asyncio.sleep(0.005)
+        dt = max(time.perf_counter() - t0, 1e-9)
+        deliveries = int(delivered - base_delivered)
+        rate = deliveries / dt
+        worker_encoded = int(sum(await asyncio.gather(*(
+            asyncio.to_thread(_worker_metric, host, p,
+                              "watchcache_frames_encoded_total")
+            for p in ports))))
+
+        # ---- phase 3: rolling worker-kill bind drill ----
+        def create_with_retry(pod) -> None:
+            while True:
+                try:
+                    client.create(pod)
+                    return
+                except AlreadyExists:
+                    return  # failover replay: exactly-once held
+                except TooManyRequests as e:
+                    time.sleep(max(0.05, getattr(e, "retry_after", 0.0)))  # ktpu: allow[blocking-in-async]
+
+        acks: dict[str, int] = {}
+        conflicts = 0
+
+        def bind_with_retry(name: str, node: str) -> None:
+            nonlocal conflicts
+            for _ in range(64):
+                try:
+                    client.bind(Binding(pod_name=name, namespace="default",
+                                        target_node=node))
+                    acks[name] = acks.get(name, 0) + 1
+                    return
+                except Conflict:
+                    # the first send landed before its worker died: the
+                    # authoritative store already holds the bind, and the
+                    # replay is refused — the exactly-once evidence
+                    conflicts += 1
+                    return
+                except ConnectionError:
+                    time.sleep(0.02)  # ktpu: allow[blocking-in-async]
+            raise RuntimeError(f"bind of {name} never reached the owner")
+
+        pods = list(make_pods(n_pods, cpu="100m", memory="64Mi",
+                              name_prefix="mp"))
+        kills = 0
+        for i, pod in enumerate(pods):
+            await asyncio.to_thread(create_with_retry, pod)
+            if i == n_pods // 2:
+                # SIGKILL mid-binds: no drain frame, no shm detach — the
+                # owner's liveness sweep must reclaim the ring slot
+                await asyncio.to_thread(cluster.kill_worker, 0)
+                kills += 1
+            await asyncio.to_thread(bind_with_retry, pod.metadata.name,
+                                    f"mp-{i % n_bind_nodes}")
+        reaped = await asyncio.to_thread(cluster.reap_dead)
+        await asyncio.to_thread(cluster.respawn_worker, 0)
+        bound = sum(
+            1 for p in await asyncio.to_thread(client.list, "Pod")
+            if p.spec.node_name)
+        double = sum(1 for v in acks.values() if v > 1)
+
+        # ---- phase 4: witness coherence at a fence rv ----
+        fence = cluster.store.resource_version
+        deadline = time.monotonic() + 30
+        while (watcher.last_rv or 0) < fence \
+                and time.monotonic() < deadline \
+                and not observer.done():
+            await asyncio.sleep(0.05)
+        watch_stop.set()
+        watcher.stop()
+        observer.cancel()
+        try:
+            await observer
+        except asyncio.CancelledError:
+            pass
+        expected = [e.resource_version for e in cluster.store._history
+                    if e.kind == "Pod" and e.resource_version <= fence]
+        got = [rv for _, rv in observed if rv <= fence]
+        gaps = len(set(expected) - set(got))
+        dupes = len(got) - len(set(got))
+
+        # ---- phase 5: fleet scrape over discovered worker targets ----
+        monitor = Monitor(store=cluster.client(), interval=0.5,
+                          include_builtin_rules=False)
+        targets = [t for t in monitor.targets() if t.job == "apiserver"]
+        scrapes = 0
+        for _ in range(3):
+            await monitor.scrape_once()
+            scrapes += 1
+        failures = int(sum(
+            child.value for _v, child in monitor._mx_failures.children()))
+
+        owner = cluster.owner
+        return MultiProcResult(
+            workers=workers, shards=cluster.specs[0].shards or 0,
+            watchers=total_watchers, events=events,
+            inproc_deliveries=inproc_deliveries,
+            inproc_events_per_sec=inproc_rate,
+            deliveries=deliveries, events_per_sec=rate,
+            speedup=rate / max(inproc_rate, 1e-9),
+            ring_appends=owner.ring.appends,
+            store_events=cluster.store.resource_version,
+            owner_frames_encoded=owner.frames_encoded,
+            worker_frames_encoded=worker_encoded,
+            pods=n_pods, bound=bound, double_binds=double,
+            bind_conflicts=conflicts, kills=kills,
+            respawns=cluster.respawns, reaped=reaped,
+            failovers=(client.failover_total
+                       + witness_client.failover_total),
+            witness_events=len(got), witness_gaps=gaps,
+            witness_dupes=dupes,
+            monitor_targets=len(targets), scrapes=scrapes,
+            scrape_failures=failures)
+
+    try:
+        return asyncio.run(drive())
+    finally:
+        cluster.stop()

@@ -174,6 +174,23 @@ class FaultPlane:
             {"replica": index, "kind": "black_hole", "on": on})
         self.replicas[index].black_hole(on)
 
+    # ---- worker-process targeting (multiproc drills) ----
+
+    def attach_worker(self, index: int, control: Any) -> None:
+        """Register one worker *process*'s control handle
+        (MultiProcCluster.control: kill = real SIGKILL) under the same
+        index namespace as replicas — a worker IS a replica, just behind
+        a process boundary."""
+        self.replicas[index] = control
+
+    def kill_worker(self, index: int) -> None:
+        """Real SIGKILL of the worker process: no drain frames, no shm
+        detach, the ring reader slot just stops moving — the owner's
+        liveness sweep must notice and reclaim it."""
+        self.stats.replica_faults.append(
+            {"replica": index, "kind": "worker-kill"})
+        self.replicas[index].kill()
+
     def flood(self, flow: str, rate_multiplier: float) -> None:
         """Noisy-tenant burst: drive `flow`'s request rate to
         `rate_multiplier`x the baseline. The plane records the action and

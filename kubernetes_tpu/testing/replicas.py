@@ -28,10 +28,20 @@ from __future__ import annotations
 
 import asyncio
 import concurrent.futures
+import os
+import signal
 import threading
+import time
 from typing import Any
 
 from kubernetes_tpu.apiserver.http import APIServer, RemoteStore
+from kubernetes_tpu.apiserver.multiproc import (
+    StoreOwner,
+    WorkerSpec,
+    free_port,
+    spawn_worker,
+    wait_port,
+)
 from kubernetes_tpu.apiserver.store import ObjectStore
 
 
@@ -251,3 +261,245 @@ class ReplicaSet:
             bring_up(), self.loop).result(timeout=10.0)
         self.servers[index] = new
         return new
+
+
+class WorkerControl:
+    """One worker *process*'s injury handle (FaultPlane.attach_replica
+    shape, process edition: kill is a real SIGKILL)."""
+
+    def __init__(self, cluster: "MultiProcCluster", index: int):
+        self._cluster = cluster
+        self.index = index
+
+    def kill(self) -> None:
+        self._cluster.kill_worker(self.index)
+
+    def drain(self, timeout: float | None = None) -> None:
+        self._cluster.terminate_worker(self.index)
+
+    def refuse(self, on: bool = True) -> None:
+        raise NotImplementedError("worker processes support kill/drain")
+
+    def black_hole(self, on: bool = True) -> None:
+        raise NotImplementedError("worker processes support kill/drain")
+
+
+class MultiProcCluster:
+    """The multi-process control plane packaged for drills and tests:
+    THIS process is the store-owner (authoritative ObjectStore + ring
+    writer + mutation RPC on a background loop thread, exactly the
+    ReplicaSet serving pattern), and `n` real OS worker processes each
+    run their own serving loop + fan-out shards over a ring-fed mirror.
+
+    Same addressing surface as ReplicaSet (`endpoints` / `client()`), so
+    FailoverWatch, informers, and the rolling-kill drills work unchanged
+    across the process boundary.
+
+        with MultiProcCluster(n=2, shards=4) as mp:
+            remote = mp.client()
+            mp.kill_worker(0)        # SIGKILL, mid-anything
+            mp.respawn_worker(0)     # resumes from the ring
+    """
+
+    def __init__(self, store: Any = None, n: int = 2,
+                 host: str = "127.0.0.1", *,
+                 shards: int | None = None,
+                 ring_capacity: int = 1 << 22,
+                 bench_watchers: int = 0, bench_kind: str = "Pod",
+                 advertise: bool = True,
+                 heartbeat_s: float | None = None,
+                 spawn_timeout: float = 30.0):
+        self.store = store if store is not None else ObjectStore()
+        self.n = n
+        self.host = host
+        self.shards = shards
+        self.ring_capacity = ring_capacity
+        self.bench_watchers = bench_watchers
+        self.bench_kind = bench_kind
+        self.advertise = advertise
+        self.heartbeat_s = heartbeat_s
+        self.spawn_timeout = spawn_timeout
+        self.owner: StoreOwner | None = None
+        self.procs: list[Any] = [None] * n
+        self.specs: list[WorkerSpec] = []
+        self._ports: list[int] = []
+        self.loop: asyncio.AbstractEventLoop | None = None
+        self._thread: threading.Thread | None = None
+        self._started = threading.Event()
+        self._startup_error: BaseException | None = None
+        self.respawns = 0
+
+    # ---- lifecycle ----
+
+    def start(self) -> "MultiProcCluster":
+        self._ports = [free_port(self.host) for _ in range(self.n)]
+
+        def serve():
+            async def main():
+                self.loop = asyncio.get_running_loop()
+                shutdown = asyncio.Event()
+                self._shutdown = shutdown
+                try:
+                    self.owner = StoreOwner(
+                        self.store, ring_capacity=self.ring_capacity,
+                        n_slots=max(self.n, 2))
+                    await self.owner.start()
+                except BaseException as e:
+                    self._startup_error = e
+                    self._started.set()
+                    raise
+                self._started.set()
+                await shutdown.wait()
+                await self.owner.aclose()
+
+            asyncio.run(main())
+
+        self._thread = threading.Thread(
+            target=serve, name="ktpu-mp-owner", daemon=True)
+        self._thread.start()
+        if not self._started.wait(timeout=10.0):
+            raise RuntimeError("store owner failed to start within 10s")
+        if self._startup_error is not None:
+            raise RuntimeError("store owner startup failed") \
+                from self._startup_error
+        self.specs = [
+            WorkerSpec(worker_id=i, ring_name=self.owner.ring.name,
+                       rpc_path=self.owner.rpc_path, host=self.host,
+                       port=self._ports[i], shards=self.shards,
+                       advertise=self.advertise,
+                       heartbeat_s=self.heartbeat_s,
+                       bench_watchers=self.bench_watchers,
+                       bench_kind=self.bench_kind)
+            for i in range(self.n)
+        ]
+        try:
+            for i in range(self.n):
+                self._spawn(i)
+        except BaseException:
+            self.stop()
+            raise
+        return self
+
+    def _spawn(self, index: int) -> None:
+        proc = spawn_worker(self.specs[index])
+        self.procs[index] = proc
+        if not wait_port(self.host, self._ports[index],
+                         timeout_s=self.spawn_timeout):
+            raise RuntimeError(
+                f"worker {index} (pid {proc.pid}) did not come up on "
+                f"{self.host}:{self._ports[index]} within "
+                f"{self.spawn_timeout}s")
+
+    def stop(self) -> None:
+        # graceful first (DRAIN frames, shard joins, shm detach) ...
+        for i, proc in enumerate(self.procs):
+            if proc is not None and proc.is_alive():
+                try:
+                    os.kill(proc.pid, signal.SIGTERM)
+                except ProcessLookupError:
+                    pass
+        deadline = time.monotonic() + 5.0
+        for proc in self.procs:
+            if proc is not None:
+                proc.join(timeout=max(0.1, deadline - time.monotonic()))
+        # ... SIGKILL stragglers so teardown never hangs a test run
+        for proc in self.procs:
+            if proc is not None and proc.is_alive():
+                proc.kill()
+                proc.join(timeout=2.0)
+        self.procs = [None] * self.n
+        if self.loop is not None and not self.loop.is_closed():
+            try:
+                self.loop.call_soon_threadsafe(self._shutdown.set)
+            except RuntimeError:
+                pass
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+
+    # stop() already unlinks the segment (owner.aclose on the loop) and
+    # reaps every child; the alias is the ReplicaSet-compatible name
+    aclose = stop
+
+    def __enter__(self) -> "MultiProcCluster":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # ---- addressing ----
+
+    @property
+    def endpoints(self) -> list[tuple[str, int]]:
+        return [(self.host, p) for p in self._ports]
+
+    def client(self, **kw) -> RemoteStore:
+        return RemoteStore(self.host, self._ports[0],
+                           endpoints=self.endpoints, **kw)
+
+    def control(self, index: int) -> WorkerControl:
+        return WorkerControl(self, index)
+
+    # ---- owner-loop marshalling ----
+
+    def _call(self, fn, timeout: float = 10.0) -> Any:
+        assert self.loop is not None, "cluster not started"
+        fut: concurrent.futures.Future = concurrent.futures.Future()
+
+        def run():
+            try:
+                fut.set_result(fn())
+            except BaseException as e:  # noqa: BLE001 — relay to caller
+                fut.set_exception(e)
+
+        self.loop.call_soon_threadsafe(run)
+        return fut.result(timeout=timeout)
+
+    # ---- worker lifecycle (the crash-and-respawn satellite) ----
+
+    def kill_worker(self, index: int) -> None:
+        """Real SIGKILL mid-anything: no drain, no DRAIN frames, the
+        ring reader slot simply stops moving."""
+        proc = self.procs[index]
+        if proc is None:
+            return
+        try:
+            os.kill(proc.pid, signal.SIGKILL)
+        except ProcessLookupError:
+            pass
+        proc.join(timeout=5.0)
+
+    def terminate_worker(self, index: int) -> None:
+        """SIGTERM: the worker drains (terminal DRAIN frames), joins its
+        shard threads, detaches from the ring, exits 0."""
+        proc = self.procs[index]
+        if proc is None:
+            return
+        try:
+            os.kill(proc.pid, signal.SIGTERM)
+        except ProcessLookupError:
+            pass
+        proc.join(timeout=10.0)
+
+    def reap_dead(self) -> list[int]:
+        """Owner-side liveness sweep: find reader slots whose pid is
+        gone, reclaim them (pid cleared, read_pos/last_rv kept for the
+        respawn's no-replay resume)."""
+        assert self.owner is not None
+        dead = self.owner.dead_workers()
+        for wid in dead:
+            self.owner.reclaim_slot(wid)
+        return dead
+
+    def respawn_worker(self, index: int) -> None:
+        """Bring a fresh worker process up on the SAME port and reader
+        slot. It snapshots the owner (rv ≥ the dead worker's last_rv),
+        resumes the ring at the snapshot position, and inherits the
+        slot's last_rv floor — frames the dead process already delivered
+        are never replayed."""
+        assert self.owner is not None
+        proc = self.procs[index]
+        if proc is not None and proc.is_alive():
+            raise RuntimeError(f"worker {index} is still alive")
+        self.owner.reclaim_slot(index)
+        self.respawns += 1
+        self._spawn(index)

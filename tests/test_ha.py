@@ -211,6 +211,44 @@ def test_failover_on_mid_stream_kill():
         asyncio.run(run())
 
 
+def test_failover_probes_last_known_good_first(monkeypatch):
+    """Failover ordering: after a transport failure the client's first
+    probe is the last endpoint that answered successfully — the likeliest
+    survivor — not the next index in round-robin order, so a failover
+    with several dead replicas skips the dead-endpoint walk."""
+    import socket as socket_mod
+
+    store = ObjectStore()
+    with ReplicaSet(store, n=3, watch_cache=True) as rs:
+        remote = rs.client()
+        port_to_idx = {p: i for i, (_h, p) in enumerate(remote.endpoints)}
+        # establish replica 2 as the last-known-good answerer
+        remote._active = 2
+        remote.list("Pod")
+        assert remote._last_good == 2
+        # two dead replicas between the active one and the survivor
+        rs.kill(0)
+        rs.kill(1)
+        remote._active = 0
+        attempts: list[int] = []
+        real_connect = socket_mod.create_connection
+
+        def recording(addr, *a, **kw):
+            attempts.append(port_to_idx.get(addr[1], -1))
+            return real_connect(addr, *a, **kw)
+
+        monkeypatch.setattr(socket_mod, "create_connection", recording)
+        assert remote.list("Pod") == []
+        # probe order: the dead active endpoint, then STRAIGHT to the
+        # last-known-good survivor — replica 1 is never probed
+        assert attempts[0] == 0 and attempts[1] == 2, attempts
+        assert 1 not in attempts
+        assert remote._active == 2
+        # one preferred probe per episode: the jump consumed the hint,
+        # and the success re-armed it
+        assert remote._last_good == 2
+
+
 def test_failover_on_black_hole():
     """A replica that accepts but never answers is only detectable by I/O
     timeout: a replica-aware client with a request timeout fails over

@@ -25,6 +25,7 @@ from kubernetes_tpu.analysis.rules import (
     BatchFlagsDiscipline,
     Determinism,
     EventLoopPurity,
+    MultiprocDiscipline,
     SpanDiscipline,
     StoreWriteDiscipline,
     TracePurity,
@@ -35,6 +36,7 @@ from kubernetes_tpu.testing.races import LoopStallWatchdog, RaceDetector
 
 R1, R2, R3 = [EventLoopPurity()], [TracePurity()], [BatchFlagsDiscipline()]
 R4, R5, R6 = [Determinism()], [StoreWriteDiscipline()], [SpanDiscipline()]
+R7 = [MultiprocDiscipline()]
 
 KERNEL_PATH = "kubernetes_tpu/parallel/mesh.py"  # any KERNEL_MODULES entry
 
@@ -546,6 +548,78 @@ def test_r6_clean_monitoring_rule_names():
 
 def test_r6_whole_tree_clean():
     result = run_analysis(rules=R6, baseline={})
+    assert result.findings == [], [str(f) for f in result.findings]
+
+
+# ---------------------------------------------------------------------------
+# R7: multiprocessing handle discipline
+
+
+def test_r7_flags_lambda_and_bound_method_targets():
+    src = (
+        "import multiprocessing as mp\n"
+        "def boot(self, sock):\n"
+        "    mp.Process(target=lambda: sock.send(b'x')).start()\n"
+        "    mp.Process(target=self.serve).start()\n"
+    )
+    found = lint_source(src, rules=R7)
+    assert rules_of(found) == ["multiproc-handles"] * 2
+    assert [f.line for f in found] == [3, 4]
+    assert "lambda" in found[0].message
+    assert "bound method" in found[1].message
+
+
+def test_r7_flags_nested_function_target_and_live_handle_args():
+    src = (
+        "from multiprocessing import Process\n"
+        "def boot(store, loop):\n"
+        "    def child():\n"
+        "        pass\n"
+        "    Process(target=child).start()\n"
+        "    Process(target=main, args=(store, 3)).start()\n"
+        "    Process(target=main, kwargs={'loop': loop}).start()\n"
+        "def main(*a, **kw):\n"
+        "    pass\n"
+    )
+    found = lint_source(src, rules=R7)
+    assert rules_of(found) == ["multiproc-handles"] * 3
+    assert "nested function 'child'" in found[0].message
+    assert "live handle 'store'" in found[1].message
+    assert "live handle 'loop'" in found[2].message
+
+
+def test_r7_flags_raw_shared_memory_outside_ring_module():
+    src = (
+        "from multiprocessing import shared_memory\n"
+        "def attach(name):\n"
+        "    return shared_memory.SharedMemory(name=name)\n"
+    )
+    (f,) = lint_source(src, relpath="kubernetes_tpu/perf/x.py", rules=R7)
+    assert f.rule == "multiproc-handles" and f.line == 3
+    # ...but the ring module itself owns the raw segment
+    assert lint_source(
+        src, relpath="kubernetes_tpu/apiserver/multiproc.py",
+        rules=R7) == []
+
+
+def test_r7_clean_on_spec_shaped_spawn_and_threads():
+    src = (
+        "import multiprocessing as mp\n"
+        "import threading\n"
+        "def worker_main(spec):\n"
+        "    pass\n"
+        "def boot(spec, store):\n"
+        "    # module-level target + picklable spec: the sanctioned shape\n"
+        "    mp.get_context('spawn').Process(\n"
+        "        target=worker_main, args=(spec,)).start()\n"
+        "    # threads share an address space — live handles are fine\n"
+        "    threading.Thread(target=store.flush).start()\n"
+    )
+    assert lint_source(src, rules=R7) == []
+
+
+def test_r7_whole_tree_clean():
+    result = run_analysis(rules=R7, baseline={})
     assert result.findings == [], [str(f) for f in result.findings]
 
 

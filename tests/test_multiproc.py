@@ -1,0 +1,300 @@
+"""Multi-process control plane: shared-memory event ring + worker
+processes.
+
+Four layers of the PR's contract, bottom-up:
+
+- EventRing mechanics in one process: monotonic offsets with two-part
+  modular records across the wrap seam, head reclamation keeping
+  (min_rv, max_rv) honest, and a lapped reader getting Expired — the
+  410-relist signal — never a silent gap or torn bytes.
+- The mutation RPC is exactly-once by construction: the store is the
+  single writer, so a replayed create answers AlreadyExists and a
+  replayed bind answers Conflict (same vocabulary a failover replay
+  gets over HTTP).
+- Real OS processes: a SIGKILL'd worker is reaped (ring slot reclaimed)
+  and its respawn resumes from the ring without replaying delivered
+  frames; teardown leaks neither the shared-memory segment nor shard
+  threads; and the cross-process event stream is in lockstep parity
+  with the in-process KTPU_WORKER_PROCS=0 topology fed the same ops.
+- bench[multiproc] --smoke stays runnable end-to-end with its
+  correctness gates armed from outside the process.
+"""
+
+import asyncio
+import json
+import os
+import subprocess
+import sys
+import threading
+from multiprocessing import shared_memory
+from pathlib import Path
+
+import pytest
+
+from kubernetes_tpu.api.objects import Pod
+from kubernetes_tpu.apiserver.multiproc import EventRing, RpcClient, StoreOwner
+from kubernetes_tpu.apiserver.store import (
+    AlreadyExists,
+    Binding,
+    Conflict,
+    Expired,
+    ObjectStore,
+)
+from kubernetes_tpu.testing.replicas import MultiProcCluster
+
+
+def _pod(name: str) -> Pod:
+    return Pod.from_dict({
+        "metadata": {"name": name},
+        "spec": {"containers": [{"name": "c", "resources": {
+            "requests": {"cpu": "100m", "memory": "64Mi"}}}]}})
+
+
+def _node(name: str):
+    from kubernetes_tpu.api.objects import Node
+
+    cap = {"cpu": "16", "memory": "32Gi", "pods": "110"}
+    return Node.from_dict({
+        "metadata": {"name": name,
+                     "labels": {"kubernetes.io/hostname": name}},
+        "status": {"allocatable": dict(cap), "capacity": dict(cap)}})
+
+
+# ---------------------------------------------------------------------------
+# EventRing mechanics
+
+
+def test_ring_wraparound_two_part_records():
+    """Offsets are monotonic, the physical index wraps: a record split
+    across the seam reads back intact, and every append is recoverable
+    by a reader that keeps up."""
+    ring = EventRing.create(capacity=256, n_slots=2)
+    try:
+        got = []
+        pos = 0
+        # 40-byte payloads + 12-byte headers lap the 256-byte ring
+        # several times; the seam lands mid-record repeatedly
+        for rv in range(1, 25):
+            payload = bytes([rv]) * 40
+            ring.append(rv, payload)
+            pos, recs = ring.read(pos)
+            got.extend(recs)
+        assert [rv for rv, _ in got] == list(range(1, 25))
+        assert all(p == bytes([rv]) * 40 for rv, p in got)
+        assert ring.appends == 24              # O(events), exactly
+        assert ring.max_rv == 24
+        assert ring.min_rv > 1                 # head really advanced
+        assert ring.head > 0 and ring.tail > 256  # monotonic offsets
+    finally:
+        ring.close()
+        ring.unlink()
+
+
+def test_ring_slow_reader_overrun_gets_expired():
+    """A lapped reader must get the honest 410 — Expired — and resync
+    from the current head; it must never read a silently gapped or torn
+    record."""
+    ring = EventRing.create(capacity=256, n_slots=2)
+    try:
+        for rv in range(1, 20):
+            ring.append(rv, bytes([rv]) * 40)
+        with pytest.raises(Expired):
+            ring.read(0)                       # pos 0 was overwritten
+        # the relist path: resume from the advertised window instead
+        assert ring.min_rv > 1
+        _pos, recs = ring.read(ring.head)
+        assert [rv for rv, _ in recs] == list(
+            range(ring.min_rv, ring.max_rv + 1))
+    finally:
+        ring.close()
+        ring.unlink()
+
+
+# ---------------------------------------------------------------------------
+# mutation RPC: exactly-once vocabulary
+
+
+def test_rpc_replay_answers_already_exists_and_conflict():
+    """The store is the single writer, so a replayed mutation (client
+    retry after a worker death) is refused with the same vocabulary the
+    HTTP surface uses: create -> AlreadyExists, bind -> Conflict."""
+
+    async def main():
+        store = ObjectStore()
+        store.create(_node("n0"))
+        owner = StoreOwner(store, ring_capacity=1 << 16, n_slots=2)
+        await owner.start()
+        rpc = RpcClient(owner.rpc_path)
+        try:
+            from kubernetes_tpu.apiserver.http import encode_object
+
+            body = encode_object(_pod("p0"))
+            res = await asyncio.to_thread(
+                rpc.call, "create", kind="Pod", obj=body)
+            assert res["rv"] == store.resource_version
+            with pytest.raises(AlreadyExists):
+                await asyncio.to_thread(
+                    rpc.call, "create", kind="Pod", obj=body)
+            await asyncio.to_thread(
+                rpc.call, "bind", pod="p0", ns="default", node="n0")
+            with pytest.raises(Conflict):
+                await asyncio.to_thread(
+                    rpc.call, "bind", pod="p0", ns="default", node="n0")
+            # exactly-once held: one pod, bound once
+            assert store.get("Pod", "p0").spec.node_name == "n0"
+        finally:
+            rpc.close()
+            await owner.aclose()
+
+    asyncio.run(main())
+
+
+# ---------------------------------------------------------------------------
+# real OS processes
+
+
+def test_worker_crash_respawn_resumes_without_replay_or_leak():
+    """SIGKILL a worker mid-flight: the owner's liveness sweep reclaims
+    its ring slot, the respawn resumes from the surviving slot cursor
+    (frames delivered before the crash never replay), and teardown
+    leaves no shared-memory segment and no stray shard threads."""
+    cluster = MultiProcCluster(n=2, shards=2, ring_capacity=1 << 18,
+                               advertise=False)
+    cluster.start()
+    ring_name = cluster.owner.ring.name
+    try:
+        client = cluster.client()
+        for i in range(4):
+            client.create(_pod(f"pre-{i}"))
+        cluster.kill_worker(0)
+        assert cluster.reap_dead() == [0]
+        # the fleet keeps serving through the survivor
+        for i in range(4):
+            client.create(_pod(f"mid-{i}"))
+        cluster.respawn_worker(0)
+        assert cluster.respawns == 1
+        # the respawned worker serves the FULL state — snapshot + ring
+        # resume, no gap around the frames the dead incarnation consumed
+        import urllib.request
+
+        host, port = cluster.endpoints[0]
+        with urllib.request.urlopen(
+                f"http://{host}:{port}/api/v1/pods", timeout=5) as resp:
+            names = sorted(i["metadata"]["name"]
+                           for i in json.loads(resp.read())["items"])
+        assert names == sorted(
+            [f"pre-{i}" for i in range(4)] + [f"mid-{i}" for i in range(4)])
+    finally:
+        cluster.stop()
+    # no leaked segment: the owner unlinked it on close
+    with pytest.raises(FileNotFoundError):
+        shared_memory.SharedMemory(name=ring_name)
+    # no stray worker procs or shard threads in THIS process
+    assert not any(p.is_alive() for p in cluster.procs if p is not None)
+    assert not [t for t in threading.enumerate()
+                if "ktpu-mp-owner" in t.name and t.is_alive()]
+
+
+def test_cross_process_stream_parity_with_inprocess_topology():
+    """The KTPU_WORKER_PROCS=0 fallback is the reference semantics: the
+    same op sequence produces the identical (type, kind, rv) history in
+    both topologies, and a resilient watcher through the worker fleet
+    observes the cross-process history gaplessly — across a kill."""
+    ops = ([("create", _pod(f"p{i}")) for i in range(6)]
+           + [("create", _node("n0"))])
+
+    # reference: today's in-process store
+    ref = ObjectStore()
+    for _verb, obj in ops:
+        ref.create(obj)
+    ref.bind(Binding(pod_name="p0", namespace="default",
+                     target_node="n0"))
+    ref_history = [(e.type, e.kind, e.resource_version)
+                   for e in ref._history]
+
+    cluster = MultiProcCluster(n=2, shards=2, ring_capacity=1 << 18,
+                               advertise=False)
+    cluster.start()
+    try:
+        client = cluster.client()
+        observed: list[tuple[str, int]] = []
+        watcher = client.watch_resilient("Pod", since=0)
+
+        async def drive():
+            stop = asyncio.Event()
+
+            async def observe():
+                while not stop.is_set():
+                    try:
+                        ev = await watcher.next(timeout=0.5)
+                    except ConnectionError:
+                        return
+                    if ev is not None:
+                        observed.append((ev.type, ev.resource_version))
+
+            task = asyncio.get_running_loop().create_task(observe())
+            for i, (_verb, obj) in enumerate(ops):
+                await asyncio.to_thread(client.create, obj)
+                if i == 3:
+                    # mid-stream kill: the witness must resume on the
+                    # survivor without a gap
+                    await asyncio.to_thread(cluster.kill_worker, 0)
+            await asyncio.to_thread(
+                client.bind, Binding(pod_name="p0", namespace="default",
+                                     target_node="n0"))
+            fence = cluster.store.resource_version
+            deadline = asyncio.get_running_loop().time() + 15
+            while (watcher.last_rv or 0) < fence \
+                    and asyncio.get_running_loop().time() < deadline:
+                await asyncio.sleep(0.05)
+            stop.set()
+            watcher.stop()
+            task.cancel()
+            return fence
+
+        fence = asyncio.run(drive())
+        # topology parity: identical authoritative history
+        mp_history = [(e.type, e.kind, e.resource_version)
+                      for e in cluster.store._history]
+        assert mp_history == ref_history
+        # witness coherence: every Pod event <= fence, no gap, no dupe
+        expected = [rv for t, k, rv in mp_history
+                    if k == "Pod" and rv <= fence]
+        got = [rv for _t, rv in observed if rv <= fence]
+        assert sorted(set(got)) == expected
+        assert len(got) == len(set(got))
+    finally:
+        cluster.stop()
+
+
+# ---------------------------------------------------------------------------
+# the bench gate, from outside the process
+
+
+def test_bench_multiproc_smoke_mode():
+    """bench.py --smoke with the multiproc config stays runnable
+    end-to-end: real owner + worker processes, with the encode-once /
+    exactly-once / witness / fleet-scrape gates armed from outside."""
+    repo = Path(__file__).resolve().parents[1]
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["BENCH_CONFIGS"] = "multiproc"
+    env["BENCH_MULTIPROC_WORKERS"] = "2"
+    env["BENCH_MULTIPROC_WATCHERS"] = "50"
+    env["BENCH_MULTIPROC_EVENTS"] = "10"
+    env["BENCH_MULTIPROC_PODS"] = "12"
+    env["BENCH_MULTIPROC_GATE"] = "0"  # 1-vCPU CI: no perf gate
+    proc = subprocess.run(
+        [sys.executable, "bench.py", "--smoke"],
+        cwd=repo, env=env, capture_output=True, text=True, timeout=420)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    line = [ln for ln in proc.stdout.splitlines() if ln.strip()][-1]
+    result = json.loads(line)
+    assert "error" not in result, result
+    extras = result["extras"]
+    assert extras["multiproc_workers"] == 2
+    assert extras["multiproc_worker_frames_encoded"] == 0
+    assert extras["multiproc_deliveries"] >= 100 * 10
+    assert extras["multiproc_bound"] == 12
+    assert extras["multiproc_respawns"] == 1
+    assert extras["multiproc_scrape_failures"] == 0
