@@ -44,6 +44,14 @@ apiserver replicas over one store, each killed once mid-workload — hard
 and graceful — while scheduler + informers + a coherence watcher run;
 errors on any double-bind, watch gap/duplicate, failover p99 past the
 bound, or relists outnumbering resume-from-rv recoveries).
+BENCH_DEFRAG_NODES / BENCH_DEFRAG_GANG / BENCH_DEFRAG_MAX_MOVES /
+BENCH_DEFRAG_SEED shape the descheduler drill (full default 50k nodes,
+8-wide gang): a seeded fragmented cluster where a Pending gang is
+unschedulable despite ample aggregate capacity; the descheduler (run
+under the RaceDetector store) must first plan in dry-run with zero
+executed moves, then restore gang schedulability within the move budget;
+errors on non-convergence, dry-run moves, double-binds, or racy writes
+(reports defrag_convergence_ms and the probe-solve cost).
 
 The opt-in `sharded` config (BENCH_CONFIGS=...,sharded) runs
 headline/gang/preemption plus a device-solve gate with the node axis
@@ -141,6 +149,9 @@ def main() -> None:
         os.environ.setdefault("BENCH_MONITOR_INTERVAL", "0.2")
         os.environ.setdefault("BENCH_HA_NODES", "8")
         os.environ.setdefault("BENCH_HA_PODS", "24")
+        os.environ.setdefault("BENCH_DEFRAG_NODES", "24")
+        os.environ.setdefault("BENCH_DEFRAG_GANG", "4")
+        os.environ.setdefault("BENCH_DEFRAG_MAX_MOVES", "4")
         os.environ.setdefault("BENCH_DEVICE_GATE", "0")  # CPU CI: no gate
         os.environ.setdefault("BENCH_E2E_GATE", "0")     # seconds-scale run
         os.environ.setdefault("BENCH_SHARDED_NODES", "64")
@@ -160,7 +171,7 @@ def main() -> None:
         os.environ.setdefault("BENCH_MULTIPROC_GATE", "0")
         os.environ.setdefault(
             "BENCH_CONFIGS",
-            "headline,gang,preemption,autoscaler,sharded,monitor")
+            "headline,gang,preemption,autoscaler,sharded,monitor,defrag")
         os.environ.setdefault("BENCH_TIMEOUT_S", "600")
     timeout = int(os.environ.get("BENCH_TIMEOUT_S", "1800"))
     signal.signal(signal.SIGALRM, _die_with_timeout)
@@ -171,7 +182,7 @@ def main() -> None:
     configs = os.environ.get(
         "BENCH_CONFIGS",
         "headline,interpod,spread,gang,preemption,recovery,chaos,overload,"
-        "device,autoscaler,monitor,ha,fanout-xl,multiproc")
+        "device,autoscaler,monitor,ha,fanout-xl,multiproc,defrag")
     configs = [c.strip() for c in configs.split(",") if c.strip()]
     metrics_snapshot = "--metrics-snapshot" in sys.argv[1:] or \
         os.environ.get("BENCH_METRICS_SNAPSHOT", "") in ("1", "true")
@@ -680,6 +691,47 @@ def main() -> None:
         if r.nodes_added == 0:
             RESULT["error"] = ("autoscaler bench: burst bound without any "
                                "scale-up (cluster was not empty)")
+
+    if "defrag" in configs:
+        from kubernetes_tpu.perf.harness import run_defrag
+
+        # gang-defragmentation drill: a seeded cluster where every node
+        # carries a filler pod (plus a skew pod on a quarter of them), so
+        # a Pending gang is unschedulable despite ample aggregate free
+        # capacity. The descheduler — run against a RaceDetector store —
+        # must plan in dry-run WITHOUT executing, then evict a minimal
+        # move set and restore gang schedulability inside the move budget
+        # with exactly-once binds throughout
+        df_nodes = int(os.environ.get("BENCH_DEFRAG_NODES", "50000"))
+        df_gang = int(os.environ.get("BENCH_DEFRAG_GANG", "8"))
+        df_moves = int(os.environ.get("BENCH_DEFRAG_MAX_MOVES", "8"))
+        df_seed = int(os.environ.get("BENCH_DEFRAG_SEED", "1234"))
+        r = run_defrag(n_nodes=df_nodes, gang_size=df_gang,
+                       max_moves=df_moves, seed=df_seed)
+        print(f"bench[defrag]: {r}", file=sys.stderr, flush=True)
+        extras["defrag_convergence_ms"] = round(r.defrag_convergence_ms, 1)
+        extras["defrag_moves"] = r.moves
+        extras["defrag_dry_run_planned"] = r.dry_run_planned
+        extras["defrag_sim_solves"] = r.sim_solves
+        extras["defrag_sim_ms_per_solve"] = round(r.sim_ms_per_solve, 2)
+        extras["defrag_seed"] = r.seed
+        if not r.start_unschedulable:
+            RESULT["error"] = (
+                f"defrag bench (seed {r.seed}): gang was schedulable "
+                f"before any eviction (cluster was not fragmented)")
+        elif r.dry_run_moves:
+            RESULT["error"] = (
+                f"defrag bench (seed {r.seed}): dry-run executed "
+                f"{r.dry_run_moves} move(s) (expected 0)")
+        elif not r.converged:
+            RESULT["error"] = (
+                f"defrag bench (seed {r.seed}): gang did not land "
+                f"({r.gangs_defragged} defragged, {r.moves} moves, "
+                f"{r.rollbacks} rollbacks)")
+        elif r.double_binds or r.racy_writes:
+            RESULT["error"] = (
+                f"defrag bench (seed {r.seed}): {r.double_binds} "
+                f"double-binds, {r.racy_writes} racy writes")
 
     if "monitor" in configs:
         from kubernetes_tpu.perf.harness import run_monitor_bench

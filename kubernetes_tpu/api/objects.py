@@ -1143,6 +1143,50 @@ class NodeGroup(_SpecStatusObject):
 
 
 @dataclass
+class DeschedulePolicy(_SpecStatusObject):
+    """Descheduler policy: tuning knobs for the gang-defragmentation
+    control loop (the solver-driven analogue of upstream's
+    descheduler-policy ConfigMap, surfaced as a first-class object so
+    `kubectl get deschedulepolicies` shows what the planner may do).
+
+    spec: dryRun (bool — plan and count, never evict), maxMovesPerCycle
+    (int >= 1, cap on evictions per defrag plan), priorityCutoff (int —
+    only pods at or below this priority are move candidates),
+    cooldownSeconds (float — per-node stamp horizon that also blocks
+    autoscaler scale-down), rollbackSeconds (float — deadline for a
+    displaced gang to land before the plan is rolled back). status:
+    written by the descheduler's reconcile (cycles, moves, rollbacks,
+    gangsDefragged), never by users."""
+
+    kind = "DeschedulePolicy"
+    api_version = "descheduling.ktpu.io/v1alpha1"
+
+    @property
+    def dry_run(self) -> bool:
+        return bool(self.spec.get("dryRun", False))
+
+    @property
+    def max_moves_per_cycle(self) -> int:
+        m = self.spec.get("maxMovesPerCycle")
+        return 8 if m is None else int(m)
+
+    @property
+    def priority_cutoff(self) -> int:
+        c = self.spec.get("priorityCutoff")
+        return 0 if c is None else int(c)
+
+    @property
+    def cooldown_seconds(self) -> float:
+        t = self.spec.get("cooldownSeconds")
+        return 300.0 if t is None else float(t)
+
+    @property
+    def rollback_seconds(self) -> float:
+        t = self.spec.get("rollbackSeconds")
+        return 60.0 if t is None else float(t)
+
+
+@dataclass
 class PriorityClass:
     """scheduling.k8s.io PriorityClass (the v1.8-alpha shape,
     pkg/apis/scheduling/types.go): maps a name to an integer priority

@@ -96,6 +96,8 @@ RESOURCES: dict[str, str] = {
     "podgroups": "PodGroup",
     # autoscaling.ktpu.io (cluster autoscaler node pools)
     "nodegroups": "NodeGroup",
+    # descheduling.ktpu.io (gang defragmentation)
+    "deschedulepolicies": "DeschedulePolicy",
     # scheduling.k8s.io (pod priority & preemption)
     "priorityclasses": "PriorityClass",
     # flowcontrol.ktpu.io (API priority & fairness)
@@ -124,7 +126,8 @@ KIND_TO_CLS = {cls.kind: cls for cls in (
     objs.Namespace, objs.CustomResourceDefinition, objs.Cluster,
     objs.Secret, objs.ConfigMap, objs.ServiceAccount, objs.DaemonSet,
     objs.CronJob, objs.HorizontalPodAutoscaler, objs.PodDisruptionBudget,
-    objs.APIService, objs.PodGroup, objs.NodeGroup, objs.PriorityClass,
+    objs.APIService, objs.PodGroup, objs.NodeGroup, objs.DeschedulePolicy,
+    objs.PriorityClass,
     objs.FlowSchema, objs.PriorityLevelConfiguration, objs.AlertRule,
     objs.Role, objs.ClusterRole,
     objs.RoleBinding, objs.ClusterRoleBinding,

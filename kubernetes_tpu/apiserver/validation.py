@@ -54,6 +54,8 @@ def validate(obj: Any) -> None:
         _validate_prioritylevel(obj)
     elif kind == "AlertRule":
         _validate_alertrule(obj)
+    elif kind == "DeschedulePolicy":
+        _validate_deschedulepolicy(obj)
 
 
 def _validate_quantities(where: str, quantities: dict) -> dict:
@@ -145,6 +147,27 @@ def _validate_nodegroup(obj) -> None:
     if max_size < min_size:
         raise ValidationError(
             f"spec.maxSize: must be >= minSize ({max_size} < {min_size})")
+
+
+def _validate_deschedulepolicy(obj) -> None:
+    try:
+        max_moves = obj.max_moves_per_cycle
+        obj.priority_cutoff
+        cooldown = obj.cooldown_seconds
+        rollback = obj.rollback_seconds
+    except (TypeError, ValueError):
+        raise ValidationError(
+            f"spec: invalid DeschedulePolicy values "
+            f"{obj.spec.get('maxMovesPerCycle')!r}/"
+            f"{obj.spec.get('priorityCutoff')!r}/"
+            f"{obj.spec.get('cooldownSeconds')!r}/"
+            f"{obj.spec.get('rollbackSeconds')!r}")
+    if max_moves < 1:
+        raise ValidationError("spec.maxMovesPerCycle: must be >= 1")
+    if cooldown < 0:
+        raise ValidationError("spec.cooldownSeconds: must be >= 0")
+    if rollback <= 0:
+        raise ValidationError("spec.rollbackSeconds: must be > 0")
 
 
 def _validate_priorityclass(obj) -> None:

@@ -372,10 +372,14 @@ class ClusterAutoscaler:
         return ok
 
     def _scale_down(self, now: float) -> None:
+        # lazy: the descheduler imports DELETION_TAINT from this module
+        from kubernetes_tpu.descheduler.core import cooldown_active
+
         # phase 2 first: nodes cordoned last tick drain (or roll back) now
         for name in list(self._draining):
             self._finish_drain(name)
             return  # one scale-down action per tick
+        wall = self.clock.now()
         # phase 1: find a newly-unneeded node, verify, cordon + taint
         for node in self.nodes.items():
             name = node.metadata.name
@@ -388,6 +392,11 @@ class ClusterAutoscaler:
             if now < self._scaledown_after.get(group, 0.0):
                 continue
             if node.spec.unschedulable or not _node_ready(node):
+                self._unneeded_since.pop(name, None)
+                continue
+            if cooldown_active(node, wall):
+                # the descheduler just rearranged this node: shrinking it
+                # now would undo the move (evict/scale-down ping-pong)
                 self._unneeded_since.pop(name, None)
                 continue
             if self._utilization(node) >= self.utilization_threshold:

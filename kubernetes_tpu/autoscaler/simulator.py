@@ -228,25 +228,79 @@ class ScaleSimulator:
         re-fits on the remainder. `pods` is the node's current bound pod
         set (informer truth); their clones are encoded unbound (node_name
         stripped, or fits_host would pin them to the deleted row). State
-        is restored before returning."""
+        is restored before returning.
+
+        Nodes holding more pods than `caps.batch_pods` are probed in
+        chunks: each full chunk's placements are committed into the twin
+        (so later chunks see the earlier charges) before the next solve —
+        an honest multi-solve answer instead of the old blanket "not
+        drainable"."""
         name = node.metadata.name
         if not self.statedb.has_node(name):
             return False
-        if len(pods) > self.caps.batch_pods:
-            return False  # cannot verify the whole set: not drainable
         stripped = []
         for pod in pods:
             clone = pod.clone()
             clone.spec.node_name = ""
             stripped.append(clone)
         self.statedb.remove_node(name)
+        committed: list = []
         try:
             if not stripped:
                 return True
-            assignments, _placed = self._solve(stripped)
-            return bool((assignments >= 0).all())
+            step = self.caps.batch_pods
+            for start in range(0, len(stripped), step):
+                chunk = stripped[start:start + step]
+                assignments, _placed = self._solve(chunk)
+                if not bool((assignments >= 0).all()):
+                    return False
+                if start + step >= len(stripped):
+                    break
+                # commit this chunk's placements so the next solve sees
+                # the charges the re-fit just spent
+                for clone, row in zip(chunk, assignments.tolist()):
+                    clone.spec.node_name = self.statedb.table.name_of[row]
+                    self.statedb.add_pod(clone)
+                    committed.append(clone)
+            return True
         finally:
-            # revert: remove_node dropped the node's accounted pods too
+            # revert: the committed clones share keys with the originals,
+            # so drop them before re-adding; remove_node dropped the
+            # node's accounted pods too
+            for clone in committed:
+                self.statedb.remove_pod(clone.key)
             self.statedb.upsert_node(node)
             for pod in pods:
+                self.statedb.add_pod(pod)
+
+    def probe_defrag(self, victims, gang_pods) -> bool:
+        """What-if: evict `victims` (bound, non-gang pods) and check both
+        halves of a defrag move — the pending gang reaches quorum on the
+        freed space AND every victim re-fits elsewhere. One solve scores
+        the joint batch: gang members first in one contiguous run (so the
+        gang columns apply AND the gang claims the freed space before the
+        displaced pods re-pack — batch order is placement order, and the
+        whole point of the evictions is to seat the gang), victim clones
+        after them with node_name stripped. State is restored before
+        returning."""
+        if len(victims) + len(gang_pods) > self.caps.batch_pods:
+            return False
+        batch = list(gang_pods)
+        for pod in victims:
+            clone = pod.clone()
+            clone.spec.node_name = ""
+            batch.append(clone)
+        for pod in victims:
+            self.statedb.remove_pod(pod.key)
+        try:
+            assignments, _placed = self._solve(batch)
+            ng = len(gang_pods)
+            if victims and not bool((assignments[ng:] >= 0).all()):
+                return False
+            if not gang_pods:
+                return True
+            quorum = annotation_min(gang_pods[0]) or ng
+            return int((assignments[:ng] >= 0).sum()) >= quorum
+        finally:
+            for pod in victims:
                 self.statedb.add_pod(pod)

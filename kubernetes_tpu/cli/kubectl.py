@@ -65,6 +65,7 @@ ALIASES = {
     "poddisruptionbudget": "poddisruptionbudgets",
     "pg": "podgroups", "podgroup": "podgroups",
     "ng": "nodegroups", "nodegroup": "nodegroups",
+    "dsp": "deschedulepolicies", "deschedulepolicy": "deschedulepolicies",
     "pc": "priorityclasses", "priorityclass": "priorityclasses",
     "quota": "resourcequotas", "resourcequota": "resourcequotas",
     "limits": "limitranges", "limitrange": "limitranges",
@@ -161,6 +162,10 @@ def _row(kind: str, obj, wide: bool) -> list[str]:
     if kind == "NodeGroup":
         return [obj.metadata.name, str(obj.min_size), str(obj.max_size),
                 str(obj.target_size), str(obj.ready_nodes), _age(obj)]
+    if kind == "DeschedulePolicy":
+        return [obj.metadata.name, str(obj.dry_run).lower(),
+                str(obj.max_moves_per_cycle), str(obj.priority_cutoff),
+                _age(obj)]
     if kind == "AlertRule":
         expr = obj.expr if len(obj.expr) <= 44 else obj.expr[:41] + "..."
         return [obj.metadata.name,
@@ -184,6 +189,7 @@ HEADERS = {
     "PodGroup": ["NAME", "PHASE", "PLACED", "AGE"],
     "PriorityClass": ["NAME", "VALUE", "GLOBAL-DEFAULT", "AGE"],
     "NodeGroup": ["NAME", "MIN", "MAX", "TARGET", "READY", "AGE"],
+    "DeschedulePolicy": ["NAME", "DRY-RUN", "MAX-MOVES", "CUTOFF", "AGE"],
     "AlertRule": ["NAME", "TYPE", "EXPR", "FOR", "AGE"],
 }
 

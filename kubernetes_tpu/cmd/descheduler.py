@@ -1,0 +1,126 @@
+"""The standalone descheduler binary.
+
+Like the monitor and the autoscaler, the gang-defragmentation loop can
+run as its own leader-elected deployment instead of inside the
+controller-manager (the in-manager loop behind `enable_descheduler=True`
+is the default — use one or the other, never both, or two planners will
+stamp over each other's cooldowns).
+
+    python -m kubernetes_tpu.cmd.descheduler \
+        --apiserver http://127.0.0.1:8080 --leader-elect
+
+Policy knobs (--max-moves and friends) are ctor defaults; a stored
+DeschedulePolicy object overrides them live, `kubectl get dsp` shows it.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+import os
+import socket
+import sys
+from urllib.parse import urlsplit
+
+log = logging.getLogger(__name__)
+
+
+def parse_args(argv=None) -> argparse.Namespace:
+    p = argparse.ArgumentParser(
+        prog="kubernetes-tpu-descheduler",
+        description="gang-defragmentation descheduler (what-if planner)")
+    p.add_argument("--apiserver", required=True,
+                   help="HTTP apiserver URL (apiserver.http.APIServer)")
+    p.add_argument("--token", default=os.environ.get("KUBE_TOKEN", ""),
+                   help="bearer token for an authn-enabled apiserver "
+                        "(env KUBE_TOKEN)")
+    p.add_argument("--leader-elect", action="store_true")
+    p.add_argument("--port", type=int, default=10271,
+                   help="serve /metrics, /healthz and /readyz here "
+                        "(0 = ephemeral)")
+    p.add_argument("--lock-object-name", default="descheduler")
+    p.add_argument("--lock-object-namespace", default="kube-system")
+    p.add_argument("--scan-interval", type=float, default=2.0)
+    p.add_argument("--max-moves", type=int, default=8)
+    p.add_argument("--priority-cutoff", type=int, default=0)
+    p.add_argument("--cooldown", type=float, default=300.0)
+    p.add_argument("--rollback-after", type=float, default=60.0)
+    p.add_argument("--dry-run", action="store_true")
+    p.add_argument("--lease-duration", type=float, default=15.0)
+    p.add_argument("--renew-deadline", type=float, default=10.0)
+    p.add_argument("--retry-period", type=float, default=2.0)
+    return p.parse_args(argv)
+
+
+async def run(args: argparse.Namespace) -> None:
+    from kubernetes_tpu.apiserver.http import RemoteStore
+    from kubernetes_tpu.descheduler import Descheduler
+
+    url = urlsplit(args.apiserver)
+    store = RemoteStore(url.hostname, url.port or 80, token=args.token)
+    descheduler = Descheduler(
+        store,
+        scan_interval=args.scan_interval,
+        max_moves=args.max_moves,
+        priority_cutoff=args.priority_cutoff,
+        cooldown=args.cooldown,
+        rollback_after=args.rollback_after,
+        dry_run=args.dry_run)
+
+    from kubernetes_tpu.obs.http import ObsServer
+
+    obs = ObsServer(
+        ready_checks={"informers-synced":
+                      lambda: descheduler.nodes._synced.is_set()
+                      and descheduler.pods._synced.is_set()},
+        port=args.port)
+    try:
+        await obs.start()
+        log.info("observability endpoints on %s", obs.url)
+    except OSError as e:
+        log.warning("observability endpoints disabled "
+                    "(port %d unavailable: %s)", args.port, e)
+        obs = None
+
+    async def lead():
+        await descheduler.start()
+        log.info("descheduler running against %s%s", args.apiserver,
+                 " (dry-run)" if args.dry_run else "")
+        await asyncio.Event().wait()
+
+    try:
+        if args.leader_elect:
+            from kubernetes_tpu.client.leaderelection import LeaderElector
+
+            elector = LeaderElector(
+                store, f"{socket.gethostname()}_{os.getpid()}",
+                lock_name=args.lock_object_name,
+                lock_namespace=args.lock_object_namespace,
+                lease_duration=args.lease_duration,
+                renew_deadline=args.renew_deadline,
+                retry_period=args.retry_period,
+                on_started_leading=lead)
+            await elector.run()
+            log.warning("lost leader lease; exiting")
+        else:
+            await lead()
+    finally:
+        descheduler.stop()
+        if obs is not None:
+            await obs.stop()
+
+
+def main(argv=None) -> int:
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(levelname).1s %(name)s: %(message)s")
+    try:
+        asyncio.run(run(parse_args(argv)))
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

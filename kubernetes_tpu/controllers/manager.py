@@ -28,7 +28,9 @@ class ControllerManager:
                  enable_autoscaler: bool = True,
                  autoscaler_kwargs: dict | None = None,
                  enable_monitor: bool = False,
-                 monitor_kwargs: dict | None = None):
+                 monitor_kwargs: dict | None = None,
+                 enable_descheduler: bool = False,
+                 descheduler_kwargs: dict | None = None):
         self.store = store
         # embedded monitoring plane (obs/monitor.py): scrapes the store's
         # kubelet endpoints + the process registry, and becomes the HPA's
@@ -185,6 +187,15 @@ class ControllerManager:
                     store, cloud, node_informer=self.informers["Node"],
                     pod_informer=pods, **(autoscaler_kwargs or {}))
                 self.controllers.append(self.autoscaler)
+        # gang-defragmentation descheduler: opt-in (it costs a JAX import
+        # and a private simulator twin), sharing the factory's informers
+        if enable_descheduler:
+            from kubernetes_tpu.descheduler import Descheduler
+
+            self.descheduler = Descheduler(
+                store, node_informer=self.informers["Node"],
+                pod_informer=pods, **(descheduler_kwargs or {}))
+            self.controllers.append(self.descheduler)
 
     @property
     def synced(self) -> bool:

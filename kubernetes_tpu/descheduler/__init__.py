@@ -1,0 +1,14 @@
+"""Gang-defragmentation descheduler (solver-driven rebalancing)."""
+
+from kubernetes_tpu.descheduler.core import (
+    COOLDOWN_ANNOTATION,
+    PARKED_SCHEDULER,
+    PARKED_UNTIL_ANNOTATION,
+    DefragPlan,
+    Descheduler,
+    cooldown_active,
+)
+
+__all__ = ["COOLDOWN_ANNOTATION", "PARKED_SCHEDULER",
+           "PARKED_UNTIL_ANNOTATION", "DefragPlan", "Descheduler",
+           "cooldown_active"]

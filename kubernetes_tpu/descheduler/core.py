@@ -1,0 +1,639 @@
+"""Descheduler: continuous gang-defragmentation on the what-if simulator.
+
+ROADMAP open item 3's payoff: the batched solver stops being only a
+placer and becomes a cluster optimizer. A Pending gang can be blocked not
+by capacity but by *fragmentation* — aggregate free space covers the
+gang's demand, yet no quorum of nodes has room, because fillers are
+scattered one per node. Upstream's descheduler is a bolt-on heuristic
+evictor; this loop is a solver-driven planner: every candidate move set
+is scored by ONE device what-if (`ScaleSimulator.probe_defrag`) that
+answers the joint question "does the gang land at quorum after these
+evictions, and does every evicted pod re-fit elsewhere?".
+
+One pass (`run_once`):
+
+1. detect — a gang is *fragmented* when its members are Pending, a
+   baseline solve places fewer than quorum, and host-side aggregate free
+   capacity (eligible nodes only) covers the gang's aggregate request.
+2. plan — victim candidates are bound non-gang pods at or below the
+   priority cutoff that PDBs allow evicting, ordered lowest-priority /
+   smallest-key first (the preemption VictimTable ordering); candidate
+   sets are prefixes of that order, so at most `max_moves` probe solves
+   score a cycle and the smallest winning prefix is the plan.
+3. execute — under the autoscaler's safety ladder: cooldown-stamp the
+   source nodes (the shared annotation the autoscaler's scale-down
+   honors, preventing evict/shrink ping-pong), then per victim
+   `can_evict` (the spending PDB gate) -> delete -> recreate unbound but
+   PARKED under a sentinel schedulerName the real scheduler ignores.
+   Parking is what makes the freed space stick: recreated fillers would
+   otherwise race the gang's backoff retry and re-pack onto the emptied
+   nodes (spreading prefers them) before the gang's next solve. Nodes
+   carrying the autoscaler's ToBeDeletedByClusterAutoscaler taint are
+   never victim sources, and the solver's taint predicate keeps them out
+   of move targets.
+4. verify — later ticks watch the in-flight plan: once the gang is bound
+   at quorum the displaced pods are released (schedulerName restored, a
+   pod MODIFIED event re-enqueues them) and the plan succeeds when every
+   one rebound; past the deadline the plan rolls back (release whatever
+   is still parked, emit `DefragRolledBack`, back the gang off). Parked
+   pods are durable store objects carrying their own wall-clock release
+   deadline, so a descheduler killed mid-plan strands nothing: any
+   successor's sweep releases expired parked pods.
+
+The loop is leader-electable (cmd/descheduler.py) and lives in the
+controller-manager behind `enable_descheduler=True`, mirroring the
+monitor's wiring.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from dataclasses import dataclass, field
+
+from kubernetes_tpu.api.quantity import parse_quantity
+from kubernetes_tpu.apiserver.store import (
+    AlreadyExists,
+    Conflict,
+    NotFound,
+    ObjectStore,
+)
+from kubernetes_tpu.autoscaler.core import DELETION_TAINT, _node_ready, _pod_pending
+from kubernetes_tpu.autoscaler.simulator import ScaleSimulator
+from kubernetes_tpu.client.informer import Informer
+from kubernetes_tpu.controllers.disruption import can_evict
+from kubernetes_tpu.gang import annotation_min, pod_group_key
+from kubernetes_tpu.models.policy import DEFAULT_POLICY
+from kubernetes_tpu.preemption.victims import pdb_evictable
+from kubernetes_tpu.state.layout import Capacities
+from kubernetes_tpu.utils.clock import SYSTEM_CLOCK, Clock
+from kubernetes_tpu.utils.events import EventRecorder
+
+log = logging.getLogger(__name__)
+
+# shared evict/scale-down cooldown stamp: wall-clock unix-seconds expiry.
+# The descheduler stamps a plan's source nodes; the autoscaler's
+# scale-down skips unexpired nodes (and vice versa, the descheduler never
+# evicts from a stamped node) — neither loop undoes the other's move.
+COOLDOWN_ANNOTATION = "descheduling.ktpu.io/cooldown-until"
+
+# displaced pods are recreated under this sentinel schedulerName: the real
+# scheduler's _wants() skips them, holding the freed space for the gang
+# until the plan releases them (or any descheduler's sweep does, once the
+# parked-until stamp expires — the crash-recovery path)
+PARKED_SCHEDULER = "descheduling.ktpu.io/parked"
+PARKED_UNTIL_ANNOTATION = "descheduling.ktpu.io/parked-until"
+PARKED_ORIGIN_ANNOTATION = "descheduling.ktpu.io/origin-scheduler"
+
+SCAN_INTERVAL = 2.0       # between passes (reference descheduler: 5m)
+MAX_MOVES = 8             # evictions per plan, DeschedulePolicy override
+PRIORITY_CUTOFF = 0       # only pods at/below this priority may move
+COOLDOWN = 300.0          # node stamp horizon (seconds, wall clock)
+ROLLBACK_AFTER = 60.0     # plan deadline before DefragRolledBack
+
+_mx_cache: tuple | None = None
+
+
+def _metrics() -> tuple:
+    """(cycles, moves, rollbacks, gangs_defragged, sim_seconds) — the
+    descheduler_* families."""
+    global _mx_cache
+    if _mx_cache is None:
+        from kubernetes_tpu.obs import metrics as m
+
+        _mx_cache = (
+            m.REGISTRY.counter("descheduler_cycles_total",
+                               "Defragmentation passes run."),
+            m.REGISTRY.counter("descheduler_moves_total",
+                               "Pods evicted-to-move by executed plans."),
+            m.REGISTRY.counter("descheduler_rollbacks_total",
+                               "Plans abandoned at the deadline or "
+                               "refused mid-eviction."),
+            m.REGISTRY.counter("descheduler_gangs_defragged_total",
+                               "Pending gangs that landed after a plan."),
+            m.REGISTRY.histogram("descheduler_simulation_seconds",
+                                 "Wall time of one what-if probe solve."),
+        )
+    return _mx_cache
+
+
+def cooldown_active(node, wall_now: float) -> bool:
+    """True while `node` carries an unexpired cooldown stamp (malformed
+    stamps read as expired — a stuck annotation must not pin a node)."""
+    raw = node.metadata.annotations.get(COOLDOWN_ANNOTATION)
+    if not raw:
+        return False
+    try:
+        return float(raw) > wall_now
+    except ValueError:
+        return False
+
+
+@dataclass
+class DefragPlan:
+    """One in-flight move set: evictions done, waiting for the gang and
+    the displaced pods to land (or for the deadline)."""
+
+    gang_key: str
+    quorum: int
+    deadline: float                       # monotonic
+    displaced: list[str] = field(default_factory=list)   # pod keys moved
+    stamped: list[str] = field(default_factory=list)     # node names
+    released: bool = False                # parked pods handed back yet?
+
+
+class Descheduler:
+    """One periodic pass (`run_once`) over pending gangs — like the
+    autoscaler, the whole cluster is a single reconciliation unit."""
+
+    name = "descheduler"
+
+    def __init__(self, store: ObjectStore, *,
+                 caps: Capacities | None = None,
+                 policy=DEFAULT_POLICY,
+                 node_informer: Informer | None = None,
+                 pod_informer: Informer | None = None,
+                 scan_interval: float = SCAN_INTERVAL,
+                 max_moves: int = MAX_MOVES,
+                 priority_cutoff: int = PRIORITY_CUTOFF,
+                 cooldown: float = COOLDOWN,
+                 rollback_after: float = ROLLBACK_AFTER,
+                 dry_run: bool = False,
+                 now=time.monotonic,
+                 clock: Clock = SYSTEM_CLOCK):
+        self.store = store
+        self.scan_interval = scan_interval
+        self.max_moves = max_moves
+        self.priority_cutoff = priority_cutoff
+        self.cooldown = cooldown
+        self.rollback_after = rollback_after
+        self.dry_run = dry_run
+        self.now = now
+        # wall-clock cooldown stamps ride the injectable clock (they must
+        # be legible to the autoscaler's process); plan deadlines and
+        # backoffs stay on the monotonic `now` above
+        self.clock = clock
+        self._own_informers = node_informer is None or pod_informer is None
+        self.nodes = node_informer or Informer(store, "Node")
+        self.pods = pod_informer or Informer(store, "Pod")
+        self.simulator = ScaleSimulator(caps=caps, policy=policy)
+        self.nodes.add_handler(self._on_node_event)
+        self.pods.add_handler(self._on_pod_event)
+        self.events = EventRecorder(store, component="descheduler")
+        self._plan: DefragPlan | None = None
+        # gang key -> monotonic deadline before which it is not replanned
+        self._gang_backoff: dict[str, float] = {}
+        self._task = None
+        # counters mirrored as attributes for tests/bench
+        self.cycles = 0
+        self.moves = 0
+        self.rollbacks = 0
+        self.gangs_defragged = 0
+        self.planned_moves = 0      # dry-run: moves a plan WOULD make
+
+    # ---- informer mirror (the autoscaler's shape) ----
+
+    def _on_node_event(self, event) -> None:
+        node = event.obj
+        if event.type == "DELETED":
+            if self.simulator.has_node(node.metadata.name):
+                self.simulator.remove_node(node.metadata.name)
+            return
+        self.simulator.upsert_node(node)
+
+    def _on_pod_event(self, event) -> None:
+        pod = event.obj
+        if event.type == "DELETED":
+            self.simulator.remove_pod(pod.key)
+            return
+        if pod.spec.node_name:
+            self.simulator.add_pod(pod)
+
+    def _sweep_accounting(self) -> None:
+        for pod in self.pods.items():
+            if pod.spec.node_name \
+                    and not self.simulator.is_accounted(pod.key) \
+                    and self.simulator.has_node(pod.spec.node_name):
+                self.simulator.add_pod(pod)
+
+    # ---- lifecycle ----
+
+    async def start(self) -> None:
+        import asyncio
+
+        if self._own_informers:
+            self.nodes.start()
+            self.pods.start()
+            await self.nodes.wait_for_sync()
+            await self.pods.wait_for_sync()
+        self._task = asyncio.get_running_loop().create_task(self._loop())
+
+    def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            self._task = None
+        if self._own_informers:
+            self.nodes.stop()
+            self.pods.stop()
+
+    async def _loop(self) -> None:
+        import asyncio
+
+        while True:
+            await asyncio.sleep(self.scan_interval)
+            try:
+                self.run_once()
+            except Exception:  # noqa: BLE001 — the loop must not die
+                log.exception("descheduler pass failed")
+
+    # ---- one pass ----
+
+    def run_once(self) -> None:
+        from kubernetes_tpu.obs.tracing import TRACER
+
+        now = self.now()
+        self.cycles += 1
+        _metrics()[0].inc()
+        policy = self._load_policy()
+        with TRACER.start_span("descheduler.cycle",
+                               attrs={"cycle": self.cycles}):
+            self._sweep_accounting()
+            self._sweep_cooldowns()
+            self._sweep_parked()
+            if self._plan is not None:
+                self._check_plan(now)
+            else:
+                self._defrag_pass(now, policy)
+        self._write_status(policy)
+
+    # ---- DeschedulePolicy (store knobs override ctor defaults) ----
+
+    def _load_policy(self):
+        try:
+            policies = self.store.list("DeschedulePolicy")
+        except Exception:  # noqa: BLE001 — knobs are optional
+            return None
+        if not policies:
+            return None
+        policy = min(policies, key=lambda p: p.key)
+        self.max_moves = policy.max_moves_per_cycle
+        self.priority_cutoff = policy.priority_cutoff
+        self.cooldown = policy.cooldown_seconds
+        self.rollback_after = policy.rollback_seconds
+        self.dry_run = policy.dry_run
+        return policy
+
+    def _write_status(self, policy) -> None:
+        if policy is None:
+            return
+        status = {"cycles": self.cycles, "moves": self.moves,
+                  "rollbacks": self.rollbacks,
+                  "gangsDefragged": self.gangs_defragged}
+
+        def mutate(obj):
+            obj.status = status
+            return obj
+
+        try:
+            self.store.guaranteed_update("DeschedulePolicy",
+                                         policy.metadata.name,
+                                         policy.metadata.namespace, mutate)
+        except (NotFound, Conflict):
+            pass
+
+    # ---- cooldown stamps ----
+
+    def _sweep_cooldowns(self) -> None:
+        """Drop expired stamps so a finished (or abandoned) plan leaves
+        no annotation litter — also the recovery path when a descheduler
+        died mid-plan and a successor inherits its stamps."""
+        wall = self.clock.now()
+        for node in self.nodes.items():
+            raw = node.metadata.annotations.get(COOLDOWN_ANNOTATION)
+            if raw is None or cooldown_active(node, wall):
+                continue
+
+            def mutate(obj):
+                obj.metadata.annotations.pop(COOLDOWN_ANNOTATION, None)
+                return obj
+
+            try:
+                self.store.guaranteed_update("Node", node.metadata.name,
+                                             "default", mutate)
+            except (NotFound, Conflict):
+                pass
+
+    def _sweep_parked(self) -> None:
+        """Release parked pods whose hold expired — normally the owning
+        plan releases them first; this is the recovery path for a
+        descheduler that died between evicting and releasing (malformed
+        stamps read as expired for the same no-stranded-pods reason)."""
+        wall = self.clock.now()
+        for pod in self.pods.items():
+            if pod.spec.scheduler_name != PARKED_SCHEDULER:
+                continue
+            raw = pod.metadata.annotations.get(PARKED_UNTIL_ANNOTATION)
+            try:
+                if raw is not None and float(raw) > wall:
+                    continue
+            except ValueError:
+                pass
+            self._unpark(pod.key)
+
+    def _stamp_cooldown(self, name: str) -> None:
+        until = str(self.clock.now() + self.cooldown)
+
+        def mutate(node):
+            node.metadata.annotations[COOLDOWN_ANNOTATION] = until
+            return node
+
+        try:
+            self.store.guaranteed_update("Node", name, "default", mutate)
+        except (NotFound, Conflict):
+            pass
+
+    # ---- detection ----
+
+    def _eligible_node(self, node, wall: float) -> bool:
+        """May this node participate in a plan (free-space accounting and
+        victim source)? Autoscaler-cordoned and cooldown-stamped nodes
+        are out — composing, not fighting."""
+        if not _node_ready(node) or node.spec.unschedulable:
+            return False
+        if any(t.key == DELETION_TAINT for t in node.spec.taints):
+            return False
+        return not cooldown_active(node, wall)
+
+    @staticmethod
+    def _pod_demand(pod) -> tuple[float, float]:
+        cpu = mem = 0.0
+        for c in pod.spec.containers:
+            if "cpu" in c.requests:
+                cpu += float(parse_quantity(c.requests["cpu"]))
+            if "memory" in c.requests:
+                mem += float(parse_quantity(c.requests["memory"]))
+        return cpu, mem
+
+    def _aggregate_free(self, eligible: dict[str, object]) -> tuple[float,
+                                                                    float]:
+        """Summed (cpu, memory) headroom across eligible nodes — host
+        arithmetic, no solve. Enough headroom + a failed baseline solve
+        is the fragmentation signature."""
+        used: dict[str, tuple[float, float]] = {}
+        for pod in self.pods.items():
+            name = pod.spec.node_name
+            if not name or name not in eligible \
+                    or pod.status.phase in ("Succeeded", "Failed"):
+                continue
+            cpu, mem = self._pod_demand(pod)
+            have = used.get(name, (0.0, 0.0))
+            used[name] = (have[0] + cpu, have[1] + mem)
+        free_cpu = free_mem = 0.0
+        for name, node in eligible.items():
+            alloc = node.status.effective_allocatable()
+            cap_cpu = float(parse_quantity(alloc.get("cpu", "0") or "0"))
+            cap_mem = float(parse_quantity(alloc.get("memory", "0") or "0"))
+            cpu, mem = used.get(name, (0.0, 0.0))
+            free_cpu += max(0.0, cap_cpu - cpu)
+            free_mem += max(0.0, cap_mem - mem)
+        return free_cpu, free_mem
+
+    def _pending_gangs(self) -> list[tuple[str, int, list]]:
+        """[(gang key, quorum, members)] with full membership pending,
+        members sorted for a deterministic batch shape."""
+        groups: dict[str, list] = {}
+        for pod in self.pods.items():
+            if not _pod_pending(pod):
+                continue
+            key = pod_group_key(pod)
+            if key is not None:
+                groups.setdefault(key, []).append(pod)
+        out = []
+        for key in sorted(groups):
+            members = sorted(groups[key], key=lambda p: p.key)
+            quorum = annotation_min(members[0]) or len(members)
+            if len(members) >= quorum:
+                out.append((key, quorum, members))
+        return out
+
+    # ---- planning + execution ----
+
+    def _victim_candidates(self, eligible: dict[str, object]) -> list:
+        """Move candidates in VictimTable order: lowest priority first,
+        key-ascending within a class — bound, non-gang, at/below the
+        cutoff, PDB-evictable, on an eligible node."""
+        try:
+            # per-pod pdb_evictable re-lists PDBs; a PDB-less cluster
+            # (the common fleet shape) skips 50k redundant lists per pass
+            has_pdbs = bool(self.store.list("PodDisruptionBudget"))
+        except Exception:  # noqa: BLE001 — fail closed: check per pod
+            has_pdbs = True
+        out = []
+        for pod in self.pods.items():
+            if not pod.spec.node_name \
+                    or pod.spec.node_name not in eligible \
+                    or pod.metadata.deletion_timestamp \
+                    or pod.status.phase in ("Succeeded", "Failed"):
+                continue
+            if pod_group_key(pod) is not None:
+                continue  # never split a placed gang to seat another
+            if (pod.spec.priority or 0) > self.priority_cutoff:
+                continue
+            if has_pdbs and not pdb_evictable(self.store, pod):
+                continue
+            out.append(pod)
+        out.sort(key=lambda p: (p.spec.priority or 0, p.key))
+        return out
+
+    def _probe(self, victims: list, gang: list) -> bool:
+        t0 = time.perf_counter()
+        try:
+            return self.simulator.probe_defrag(victims, gang)
+        finally:
+            _metrics()[4].observe(time.perf_counter() - t0)
+
+    def _defrag_pass(self, now: float, policy) -> None:
+        wall = self.clock.now()
+        eligible = {n.metadata.name: n for n in self.nodes.items()
+                    if self._eligible_node(n, wall)}
+        if not eligible:
+            return
+        gangs = self._pending_gangs()
+        if not gangs:
+            return
+        free_cpu, free_mem = self._aggregate_free(eligible)
+        candidates = None  # built lazily, once, against current state
+        for gang_key, quorum, members in gangs:
+            if now < self._gang_backoff.get(gang_key, 0.0):
+                continue
+            need_cpu = need_mem = 0.0
+            for pod in members[:quorum]:
+                cpu, mem = self._pod_demand(pod)
+                need_cpu += cpu
+                need_mem += mem
+            if free_cpu < need_cpu or free_mem < need_mem:
+                continue  # true capacity shortfall: the autoscaler's job
+            t0 = time.perf_counter()
+            baseline = self.simulator.baseline_placed(members)
+            _metrics()[4].observe(time.perf_counter() - t0)
+            if baseline >= quorum:
+                continue  # fits as-is: the scheduler's job
+            if candidates is None:
+                candidates = self._victim_candidates(eligible)
+            victims = self._plan_moves(candidates, members)
+            if victims is None:
+                self._gang_backoff[gang_key] = now + self.scan_interval * 4
+                continue
+            self._execute(gang_key, quorum, members, victims, now)
+            return  # one plan in flight at a time
+
+    def _plan_moves(self, candidates: list, gang: list) -> list | None:
+        """Smallest winning prefix of the victim order, each prefix
+        scored by one joint what-if solve; None when no prefix within
+        max_moves unblocks the gang."""
+        for k in range(1, min(self.max_moves, len(candidates)) + 1):
+            prefix = candidates[:k]
+            if self._probe(prefix, gang):
+                return prefix
+        return None
+
+    def _execute(self, gang_key: str, quorum: int, members: list,
+                 victims: list, now: float) -> None:
+        if self.dry_run:
+            self.planned_moves += len(victims)
+            self.events.record(members[0], "Normal", "DefragPlanned",
+                               f"dry-run: {len(victims)} move(s) would "
+                               f"unblock gang {gang_key}")
+            log.info("defrag (dry-run): gang %s plan = %d move(s), not "
+                     "executed", gang_key, len(victims))
+            return
+        plan = DefragPlan(gang_key=gang_key, quorum=quorum,
+                          deadline=now + self.rollback_after)
+        for name in sorted({v.spec.node_name for v in victims}):
+            self._stamp_cooldown(name)
+            plan.stamped.append(name)
+        for pod in victims:
+            if not can_evict(self.store, pod):
+                # the PDB budget moved under us: stop evicting and roll
+                # back what's planned (already-displaced pods reschedule
+                # through the scheduler on their own)
+                self._rollback(plan, "eviction refused mid-plan")
+                return
+            if not self._move(pod):
+                self._rollback(plan, f"move of {pod.key} failed")
+                return
+            plan.displaced.append(pod.key)
+            self.moves += 1
+            _metrics()[1].inc()
+        self._plan = plan
+        log.info("defrag: gang %s, evicted %d pod(s), deadline in %.0fs",
+                 gang_key, len(plan.displaced), self.rollback_after)
+
+    def _move(self, pod) -> bool:
+        """Evict-to-move: delete the bound pod and recreate it unbound
+        AND parked (sentinel schedulerName + wall-clock release stamp) so
+        the freed space waits for the gang instead of being backfilled.
+        The plan releases it once the gang lands; each displaced pod then
+        reschedules through the real scheduler — one fresh bind per pod,
+        the exactly-once accounting the chaos drill checks."""
+        clone = pod.clone()
+        clone.spec.node_name = ""
+        # delete+create, not an update: the fresh object must not carry
+        # the dead incarnation's version
+        clone.metadata.resource_version = ""  # ktpu: allow[store-rmw]
+        clone.metadata.uid = ""
+        clone.metadata.deletion_timestamp = None
+        clone.status.phase = "Pending"
+        clone.status.nominated_node_name = ""
+        clone.metadata.annotations[PARKED_ORIGIN_ANNOTATION] = \
+            pod.spec.scheduler_name
+        clone.metadata.annotations[PARKED_UNTIL_ANNOTATION] = \
+            str(self.clock.now() + self.rollback_after)
+        clone.spec.scheduler_name = PARKED_SCHEDULER
+        try:
+            self.store.delete("Pod", pod.metadata.name,
+                              pod.metadata.namespace)
+        except NotFound:
+            pass
+        try:
+            self.store.create(clone)
+        except (AlreadyExists, Conflict):
+            return False
+        return True
+
+    def _unpark(self, key: str) -> None:
+        """Hand a parked pod back to its original scheduler: restore
+        schedulerName (the pod MODIFIED event re-enqueues it) and drop
+        the parking annotations."""
+        namespace, _, name = key.partition("/")
+
+        def mutate(pod):
+            origin = pod.metadata.annotations.pop(
+                PARKED_ORIGIN_ANNOTATION, "") or "default-scheduler"
+            pod.metadata.annotations.pop(PARKED_UNTIL_ANNOTATION, None)
+            if pod.spec.scheduler_name == PARKED_SCHEDULER:
+                pod.spec.scheduler_name = origin
+            return pod
+
+        try:
+            self.store.guaranteed_update("Pod", name, namespace, mutate)
+        except (NotFound, Conflict):
+            pass
+
+    # ---- plan verification / rollback ----
+
+    def _gang_bound(self, gang_key: str) -> int:
+        return sum(1 for p in self.pods.items()
+                   if pod_group_key(p) == gang_key and p.spec.node_name)
+
+    def _displaced_rebound(self, plan: DefragPlan) -> bool:
+        for key in plan.displaced:
+            namespace, _, name = key.partition("/")
+            pod = self.pods.get(name, namespace)
+            if pod is None or not pod.spec.node_name:
+                return False
+        return True
+
+    def _check_plan(self, now: float) -> None:
+        plan = self._plan
+        if self._gang_bound(plan.gang_key) >= plan.quorum:
+            if not plan.released:
+                # the gang has the space — hand the displaced pods back
+                # to the real scheduler for the "elsewhere" placements
+                # the probe already verified
+                for key in plan.displaced:
+                    self._unpark(key)
+                plan.released = True
+            if self._displaced_rebound(plan):
+                self._plan = None
+                self._gang_backoff.pop(plan.gang_key, None)
+                self.gangs_defragged += 1
+                _metrics()[3].inc()
+                log.info("defrag: gang %s landed (%d move(s))",
+                         plan.gang_key, len(plan.displaced))
+                return
+        if now >= plan.deadline:
+            self._rollback(plan, "gang did not land before the deadline")
+
+    def _rollback(self, plan: DefragPlan, why: str) -> None:
+        """Stop evicting and abandon the plan: release anything still
+        parked (the displaced pods reschedule through the scheduler on
+        their own — nothing is force-undone) and let the cooldown stamps
+        keep both loops off the touched nodes until the dust settles
+        (the sweep clears them at expiry)."""
+        if not plan.released:
+            for key in plan.displaced:
+                self._unpark(key)
+            plan.released = True
+        self._plan = None
+        self._gang_backoff[plan.gang_key] = self.now() + self.cooldown
+        self.rollbacks += 1
+        _metrics()[2].inc()
+        witness = next((p for p in self.pods.items()
+                        if pod_group_key(p) == plan.gang_key), None)
+        if witness is not None:
+            self.events.record(witness, "Warning", "DefragRolledBack",
+                               f"defrag plan for gang {plan.gang_key} "
+                               f"rolled back: {why}")
+        log.info("defrag: plan for gang %s rolled back: %s", plan.gang_key,
+                 why)

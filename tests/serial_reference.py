@@ -1118,3 +1118,40 @@ def fits_after_removing(nodes, assigned_pods, node_name):
             keep.append(pod)
     sched = SerialScheduler(remaining, assigned_pods=keep)
     return all(a is not None for a in sched.schedule(displaced))
+
+
+# ---- descheduler (gang defragmentation) oracle ----
+
+
+def fits_after_evicting(nodes, assigned_pods, gang, quorum, victims):
+    """True iff evicting `victims` (bound pods) both seats `gang` at
+    `quorum` and re-fits every victim elsewhere — the serial twin of
+    ScaleSimulator.probe_defrag. Order mirrors the device batch: the
+    gang schedules first (the evictions exist to seat it), the displaced
+    clones re-pack after it with bookings carried."""
+    evicted = {p.key for p in victims}
+    keep = [p for p in assigned_pods if p.key not in evicted]
+    displaced = []
+    for pod in victims:
+        clone = pod.clone()
+        clone.spec.node_name = ""
+        displaced.append(clone)
+    sched = SerialScheduler(list(nodes), assigned_pods=keep)
+    gang_res = sched.schedule_gang([p.clone() for p in gang],
+                                   [1] * len(gang), [quorum] * len(gang))
+    if sum(1 for a in gang_res if a is not None) < quorum:
+        return False
+    return all(a is not None for a in sched.schedule(displaced))
+
+
+def defrag(nodes, assigned_pods, gang, quorum, candidates, max_moves):
+    """Greedy evict-then-fit: the smallest prefix length k of
+    `candidates` (pre-sorted lowest-priority/smallest-key, the
+    VictimTable order the planner enumerates) whose eviction passes
+    fits_after_evicting, or None when no prefix within `max_moves`
+    unblocks the gang — the behavioral spec of Descheduler._plan_moves."""
+    for k in range(1, min(max_moves, len(candidates)) + 1):
+        if fits_after_evicting(nodes, assigned_pods, gang, quorum,
+                               candidates[:k]):
+            return k
+    return None

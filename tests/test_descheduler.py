@@ -1,0 +1,636 @@
+"""Descheduler: gang defragmentation on the batched what-if simulator.
+
+Covers the whole new subsystem: the DeschedulePolicy API object
+(validation + kubectl), the chunked probe_scale_down regression, the
+probe_defrag device what-if pinned against the serial defrag oracle
+(tests/serial_reference.py fits_after_evicting/defrag), fragmentation
+detection + dry-run discipline, the taint/cooldown composition with the
+autoscaler, park/release + rollback semantics, the small live-scheduler
+end-to-end drill, the kill-mid-plan chaos drill (slow), and the bench
+--smoke drift gate for the defrag config.
+"""
+
+import asyncio
+import io
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from kubernetes_tpu.api.objects import DeschedulePolicy, Node, Pod
+from kubernetes_tpu.apiserver import ObjectStore
+from kubernetes_tpu.apiserver.validation import ValidationError
+from kubernetes_tpu.autoscaler import ClusterAutoscaler, ScaleSimulator
+from kubernetes_tpu.autoscaler.core import DELETION_TAINT
+from kubernetes_tpu.client.informer import Informer
+from kubernetes_tpu.descheduler import (
+    COOLDOWN_ANNOTATION,
+    PARKED_SCHEDULER,
+    PARKED_UNTIL_ANNOTATION,
+    Descheduler,
+)
+from kubernetes_tpu.gang import GROUP_MIN_ANNOTATION, GROUP_NAME_ANNOTATION
+from kubernetes_tpu.state import Capacities
+from kubernetes_tpu.testing.races import LoopStallWatchdog, RaceDetector
+from kubernetes_tpu.utils.clock import ManualClock
+from tests.serial_reference import defrag, fits_after_evicting
+
+SMALL_CAPS = Capacities(num_nodes=16, batch_pods=16)
+
+
+def mk_node(name, cpu="4", mem="8Gi", pods="110", taints=None,
+            annotations=None):
+    return Node.from_dict({
+        "metadata": {"name": name, "annotations": annotations or {},
+                     "labels": {"kubernetes.io/hostname": name}},
+        "spec": {"taints": taints or []},
+        "status": {"allocatable": {"cpu": cpu, "memory": mem, "pods": pods},
+                   "conditions": [{"type": "Ready", "status": "True"}]},
+    })
+
+
+def mk_pod(name, cpu=None, mem=None, node=None, annotations=None,
+           priority=0):
+    c = {"name": "c"}
+    req = {}
+    if cpu:
+        req["cpu"] = cpu
+    if mem:
+        req["memory"] = mem
+    if req:
+        c["resources"] = {"requests": req}
+    spec = {"containers": [c], "priority": priority}
+    if node:
+        spec["nodeName"] = node
+    return Pod.from_dict({
+        "metadata": {"name": name, "annotations": annotations or {}},
+        "spec": spec})
+
+
+def mk_gang(n, quorum=None, cpu="3", mem="512Mi", group="ring",
+            name_prefix="gang"):
+    ann = {GROUP_NAME_ANNOTATION: group,
+           GROUP_MIN_ANNOTATION: str(quorum or n)}
+    return [mk_pod(f"{name_prefix}-{j}", cpu=cpu, mem=mem,
+                   annotations=dict(ann)) for j in range(n)]
+
+
+def fragment(store, n_nodes=4, filler_cpu="2"):
+    """The canonical fragmented shape: 4-cpu nodes, one bound filler
+    each — per-node headroom below one 3-cpu gang pod, aggregate ample."""
+    nodes, fillers = [], []
+    for i in range(n_nodes):
+        node = mk_node(f"n{i}")
+        store.create(node)
+        nodes.append(node)
+        filler = mk_pod(f"fill-{i}", cpu=filler_cpu, mem="256Mi",
+                        node=f"n{i}")
+        store.create(filler)
+        fillers.append(filler)
+    return nodes, fillers
+
+
+async def until(cond, timeout=10.0):
+    async with asyncio.timeout(timeout):
+        while not cond():
+            await asyncio.sleep(0.01)
+
+
+class _Env:
+    """Descheduler on manually-driven informers: tests step run_once()
+    against injectable monotonic + wall clocks instead of racing the
+    loop."""
+
+    def __init__(self, store, **kw):
+        self.store = store
+        self.mono = [0.0]
+        self.wall = ManualClock(1_000_000.0)
+        self.nodes = Informer(store, "Node")
+        self.pods = Informer(store, "Pod")
+        kw.setdefault("caps", SMALL_CAPS)
+        self.d = Descheduler(store, node_informer=self.nodes,
+                             pod_informer=self.pods,
+                             now=lambda: self.mono[0], clock=self.wall,
+                             **kw)
+
+    async def start(self):
+        self.nodes.start()
+        self.pods.start()
+        await self.nodes.wait_for_sync()
+        await self.pods.wait_for_sync()
+        return self
+
+    def stop(self):
+        self.nodes.stop()
+        self.pods.stop()
+
+
+# ---- DeschedulePolicy API object + kubectl ----
+
+
+def test_deschedulepolicy_defaults_and_validation():
+    store = ObjectStore()
+    store.create(DeschedulePolicy.from_dict({
+        "metadata": {"name": "default-policy"}, "spec": {}}))
+    got = store.get("DeschedulePolicy", "default-policy", "default")
+    assert got.dry_run is False
+    assert got.max_moves_per_cycle == 8
+    assert got.priority_cutoff == 0
+    assert got.cooldown_seconds == 300.0
+    assert got.rollback_seconds == 60.0
+
+    for bad in ({"maxMovesPerCycle": 0}, {"maxMovesPerCycle": "many"},
+                {"cooldownSeconds": -1}, {"rollbackSeconds": 0}):
+        with pytest.raises(ValidationError):
+            store.create(DeschedulePolicy.from_dict({
+                "metadata": {"name": "bad"}, "spec": bad}))
+
+
+def test_kubectl_get_deschedulepolicies():
+    from kubernetes_tpu.cli.kubectl import main
+
+    from tests.http_util import http_store
+
+    def run_cli(client, *argv):
+        out, old = io.StringIO(), sys.stdout
+        sys.stdout = out
+        try:
+            rc = main(["--server", f"http://{client.host}:{client.port}",
+                       *argv])
+        finally:
+            sys.stdout = old
+        return rc, out.getvalue()
+
+    with http_store() as (client, store):
+        store.create(DeschedulePolicy.from_dict({
+            "metadata": {"name": "frag", "namespace": "default"},
+            "spec": {"dryRun": True, "maxMovesPerCycle": 4,
+                     "priorityCutoff": 10}}))
+        rc, out = run_cli(client, "get", "deschedulepolicies")
+        assert rc == 0
+        lines = out.splitlines()
+        assert lines[0].split() == ["NAME", "DRY-RUN", "MAX-MOVES",
+                                    "CUTOFF", "AGE"]
+        row = next(ln for ln in lines[1:] if ln.startswith("frag"))
+        assert row.split()[:4] == ["frag", "true", "4", "10"]
+        rc, out = run_cli(client, "get", "dsp")  # the short name
+        assert rc == 0 and "frag" in out
+
+
+# ---- satellite: chunked probe_scale_down ----
+
+
+def test_probe_scale_down_chunks_nodes_beyond_batch_pods():
+    """A node holding more pods than caps.batch_pods used to be a blanket
+    'not drainable'; the chunked probe answers honestly in both
+    directions."""
+    caps = Capacities(num_nodes=8, batch_pods=4)
+    sim = ScaleSimulator(caps=caps)
+    big = mk_node("big", cpu="8")
+    spare = mk_node("spare", cpu="8")
+    sim.upsert_node(big)
+    sim.upsert_node(spare)
+    pods = []
+    for i in range(6):  # 6 pods > batch_pods 4: two chunks
+        pod = mk_pod(f"t{i}", cpu="500m", mem="128Mi", node="big")
+        assert sim.add_pod(pod)
+        pods.append(pod)
+
+    before = sim.solve_count
+    assert sim.probe_scale_down(big, pods) is True
+    assert sim.solve_count - before >= 2  # it really probed in chunks
+    # the what-if fully reverts: node intact, same answer again
+    assert sim.has_node("big")
+    assert sim.probe_scale_down(big, pods) is True
+
+    # now the remainder can't host the displaced set: blocker eats spare
+    blocker = mk_pod("blocker", cpu="7", node="spare")
+    assert sim.add_pod(blocker)
+    assert sim.probe_scale_down(big, pods) is False
+    assert sim.has_node("big")
+
+
+# ---- probe_defrag vs the serial oracle ----
+
+
+@pytest.mark.parametrize("seed", range(5))
+def test_probe_defrag_parity_random(seed):
+    rng = np.random.RandomState(seed)
+    nodes = [mk_node(f"n{i}", cpu="4", mem="8Gi", pods="10")
+             for i in range(4)]
+    sim = ScaleSimulator(caps=Capacities(num_nodes=8, batch_pods=16))
+    for node in nodes:
+        sim.upsert_node(node)
+    assigned = []
+    for i in range(4):
+        cpu = int(rng.choice([1500, 2000, 2500]))
+        pod = mk_pod(f"fill-{i}", cpu=f"{cpu}m", mem="256Mi", node=f"n{i}")
+        assert sim.add_pod(pod)
+        assigned.append(pod)
+    for i in rng.choice(4, size=2, replace=False):
+        pod = mk_pod(f"skew-{i}", cpu="300m", mem="64Mi", node=f"n{i}")
+        assert sim.add_pod(pod)
+        assigned.append(pod)
+    gang = mk_gang(2, cpu="3", mem="512Mi")
+    candidates = sorted((p for p in assigned
+                         if p.metadata.name.startswith("fill-")),
+                        key=lambda p: (p.spec.priority or 0, p.key))
+
+    probe_k = None
+    for k in range(1, len(candidates) + 1):
+        got = sim.probe_defrag(candidates[:k], gang)
+        want = fits_after_evicting(nodes, assigned, gang, 2,
+                                   candidates[:k])
+        assert got == want, f"k={k}: device {got} vs oracle {want}"
+        if got and probe_k is None:
+            probe_k = k
+    assert probe_k == defrag(nodes, assigned, gang, 2, candidates,
+                             max_moves=len(candidates))
+    # the what-if fully reverts: every victim still accounted
+    for pod in assigned:
+        assert sim.is_accounted(pod.key)
+
+
+# ---- detection + dry run ----
+
+
+def test_dry_run_plans_without_moving():
+    async def run():
+        store = ObjectStore()
+        _nodes, fillers = fragment(store)
+        for pod in mk_gang(2):
+            store.create(pod)
+        env = await _Env(store, dry_run=True).start()
+        try:
+            env.d.run_once()
+            assert env.d.planned_moves >= 1
+            assert env.d.moves == 0 and env.d._plan is None
+            # nothing in the store moved: fillers bound, gang pending
+            for filler in fillers:
+                got = store.get("Pod", filler.metadata.name, "default")
+                assert got.spec.node_name == filler.spec.node_name
+            assert all(not store.get("Pod", f"gang-{j}",
+                                     "default").spec.node_name
+                       for j in range(2))
+            events = store.list("Event")
+            assert any(e.reason == "DefragPlanned" for e in events)
+        finally:
+            env.stop()
+
+    asyncio.run(run())
+
+
+def test_policy_object_overrides_knobs_and_gets_status():
+    async def run():
+        store = ObjectStore()
+        fragment(store)
+        for pod in mk_gang(2):
+            store.create(pod)
+        store.create(DeschedulePolicy.from_dict({
+            "metadata": {"name": "frag", "namespace": "default"},
+            "spec": {"dryRun": True, "maxMovesPerCycle": 3,
+                     "priorityCutoff": 7, "cooldownSeconds": 120,
+                     "rollbackSeconds": 45}}))
+        env = await _Env(store, dry_run=False).start()
+        try:
+            env.d.run_once()
+            assert env.d.dry_run is True          # the object wins
+            assert env.d.max_moves == 3
+            assert env.d.priority_cutoff == 7
+            assert env.d.cooldown == 120.0
+            assert env.d.rollback_after == 45.0
+            assert env.d.moves == 0 and env.d.planned_moves >= 1
+            got = store.get("DeschedulePolicy", "frag", "default")
+            assert got.status["cycles"] == 1
+            assert got.status["moves"] == 0
+        finally:
+            env.stop()
+
+    asyncio.run(run())
+
+
+# ---- composing with the autoscaler ----
+
+
+def test_tainted_and_cooldown_nodes_are_not_victim_sources():
+    """The only winning eviction lives on a node the safety rules
+    exclude: autoscaler-tainted in one variant, cooldown-stamped in the
+    other — no plan may form."""
+
+    async def run():
+        for blocker in ("taint", "stamp"):
+            store = ObjectStore()
+            taints = [{"key": DELETION_TAINT, "effect": "NoSchedule"}] \
+                if blocker == "taint" else []
+            ann = {COOLDOWN_ANNOTATION: str(2_000_000.0)} \
+                if blocker == "stamp" else {}
+            store.create(mk_node("n0", taints=taints, annotations=ann))
+            store.create(mk_pod("fill-0", cpu="2", node="n0"))
+            for pod in mk_gang(1, cpu="3"):
+                store.create(pod)
+            env = await _Env(store).start()
+            try:
+                env.d.run_once()
+                assert env.d.moves == 0 and env.d._plan is None, blocker
+                got = store.get("Pod", "fill-0", "default")
+                assert got.spec.node_name == "n0", blocker
+            finally:
+                env.stop()
+
+    asyncio.run(run())
+
+
+def test_cooldown_stamp_blocks_autoscaler_scale_down():
+    from kubernetes_tpu.cloudprovider import FakeCloud
+
+    async def run():
+        store = ObjectStore()
+        cloud = FakeCloud()
+        cloud.add_node_group("pool", 0, 4, initial=2)
+        busy, idle = sorted(cloud.groups["pool"].members)
+        wall = ManualClock(5_000.0)
+        for name in (busy, idle):
+            node = cloud.template_node("pool").clone()
+            node.metadata.name = name
+            node.metadata.labels["kubernetes.io/hostname"] = name
+            if name == idle:
+                # a defrag plan just touched this node
+                node.metadata.annotations[COOLDOWN_ANNOTATION] = \
+                    str(wall.now() + 300.0)
+            store.create(node)
+        store.create(mk_pod("heavy", cpu="3", node=busy))
+        mono = [0.0]
+        nodes = Informer(store, "Node")
+        pods = Informer(store, "Pod")
+        autoscaler = ClusterAutoscaler(
+            store, cloud, node_informer=nodes, pod_informer=pods,
+            caps=SMALL_CAPS, now=lambda: mono[0], clock=wall,
+            unneeded_time=30.0, scaledown_cooldown=0.0)
+        nodes.start()
+        pods.start()
+        await nodes.wait_for_sync()
+        await pods.wait_for_sync()
+        try:
+            autoscaler.run_once()
+            mono[0] = 31.0
+            autoscaler.run_once()
+            mono[0] = 62.0
+            autoscaler.run_once()
+            # idle and past the dwell, but stamped: never cordoned
+            assert autoscaler._draining == {}
+            assert store.get("Node", idle, "default") \
+                .spec.unschedulable is False
+
+            wall.advance(400.0)  # the stamp expires
+            autoscaler.run_once()       # dwell restarts now
+            mono[0] = 100.0
+            autoscaler.run_once()
+            assert autoscaler._draining == {idle: "pool"}
+        finally:
+            nodes.stop()
+            pods.stop()
+
+    asyncio.run(run())
+
+
+# ---- park / release / rollback ----
+
+
+def test_rollback_on_deadline_releases_parked_and_emits_event():
+    async def run():
+        store = ObjectStore()
+        _nodes, fillers = fragment(store)
+        for pod in mk_gang(2):
+            store.create(pod)
+        env = await _Env(store, max_moves=4, rollback_after=60.0).start()
+        d = env.d
+        try:
+            d.run_once()  # plans and executes: no scheduler runs here
+            assert d.moves >= 1 and d._plan is not None
+            plan = d._plan
+            # displaced pods were recreated parked, sources stamped
+            for key in plan.displaced:
+                _ns, _, name = key.partition("/")
+                pod = store.get("Pod", name, "default")
+                assert pod.spec.node_name == ""
+                assert pod.spec.scheduler_name == PARKED_SCHEDULER
+                assert PARKED_UNTIL_ANNOTATION in pod.metadata.annotations
+            for node_name in plan.stamped:
+                node = store.get("Node", node_name, "default")
+                assert COOLDOWN_ANNOTATION in node.metadata.annotations
+
+            env.mono[0] = 61.0  # past the deadline; the gang never bound
+            d.run_once()
+            assert d.rollbacks == 1 and d._plan is None
+            # every parked pod was handed back to the real scheduler
+            for key in plan.displaced:
+                _ns, _, name = key.partition("/")
+                pod = store.get("Pod", name, "default")
+                assert pod.spec.scheduler_name == "default-scheduler"
+                assert PARKED_UNTIL_ANNOTATION not in \
+                    pod.metadata.annotations
+            events = store.list("Event")
+            assert any(e.reason == "DefragRolledBack" for e in events)
+            # the gang is backed off: the very next pass must not replan
+            moves_before = d.moves
+            d.run_once()
+            assert d.moves == moves_before
+
+            # cooldown stamps outlive the plan, then the sweep clears them
+            env.wall.advance(d.cooldown + 1.0)
+            # the sweep reads the informer mirror: wait for the stamp
+            # update events to land before running it
+            await until(lambda: all(
+                (env.nodes.get(nn) is not None
+                 and COOLDOWN_ANNOTATION
+                 in env.nodes.get(nn).metadata.annotations)
+                for nn in plan.stamped))
+            d.run_once()
+            for node_name in plan.stamped:
+                node = store.get("Node", node_name, "default")
+                assert COOLDOWN_ANNOTATION not in node.metadata.annotations
+        finally:
+            env.stop()
+        assert len(fillers) == 4  # fixture sanity
+
+    asyncio.run(run())
+
+
+def test_sweep_releases_only_expired_parked_pods():
+    async def run():
+        store = ObjectStore()
+        wall_now = 1_000_000.0
+        expired = mk_pod("orphan", cpu="1")
+        expired.spec.scheduler_name = PARKED_SCHEDULER
+        expired.metadata.annotations[PARKED_UNTIL_ANNOTATION] = \
+            str(wall_now - 5.0)
+        store.create(expired)
+        held = mk_pod("held", cpu="1")
+        held.spec.scheduler_name = PARKED_SCHEDULER
+        held.metadata.annotations[PARKED_UNTIL_ANNOTATION] = \
+            str(wall_now + 500.0)
+        store.create(held)
+        env = await _Env(store).start()
+        try:
+            env.d.run_once()
+            assert store.get("Pod", "orphan", "default") \
+                .spec.scheduler_name == "default-scheduler"
+            assert store.get("Pod", "held", "default") \
+                .spec.scheduler_name == PARKED_SCHEDULER
+        finally:
+            env.stop()
+
+    asyncio.run(run())
+
+
+# ---- end-to-end with the live scheduler ----
+
+
+def test_defrag_end_to_end_restores_gang_schedulability():
+    from kubernetes_tpu.scheduler import Scheduler
+
+    async def run():
+        inner = ObjectStore()
+        store = RaceDetector(inner)
+        fragment(inner, n_nodes=4)
+        sched = Scheduler(store, caps=SMALL_CAPS)
+        driver = asyncio.get_running_loop().create_task(sched.run())
+        for pod in mk_gang(2):
+            inner.create(pod)
+        await asyncio.sleep(0.75)  # the scheduler's shot: must fail
+        assert all(not inner.get("Pod", f"gang-{j}",
+                                 "default").spec.node_name
+                   for j in range(2))
+        d = Descheduler(store, caps=SMALL_CAPS, scan_interval=3600.0,
+                        max_moves=4, cooldown=3600.0, rollback_after=60.0)
+        await d.start()
+        try:
+            async with asyncio.timeout(120):
+                while d.gangs_defragged < 1:
+                    d.run_once()
+                    await asyncio.sleep(0.05)
+            assert 0 < d.moves <= 4 and d.rollbacks == 0
+            await until(lambda: all(
+                p.spec.node_name
+                for p in inner.list("Pod", copy_objects=False)), 30.0)
+            # exactly-once binds: each displaced filler rebound once, no
+            # pod bound twice
+            assert sum(1 for v in store.bind_counts.values() if v > 1) == 0
+            assert store.racy_writes == []
+            moved = [k for k in store.bind_counts if k.startswith(
+                "default/fill-")]
+            assert len(moved) == d.moves
+        finally:
+            d.stop()
+            driver.cancel()
+            sched.stop()
+
+    asyncio.run(run())
+
+
+# ---- chaos: kill the descheduler mid-plan ----
+
+
+@pytest.mark.slow
+def test_chaos_kill_descheduler_mid_plan():
+    """A descheduler dies between evicting and releasing. The parked
+    pods are durable store objects with their own release deadline, so a
+    successor's sweep releases them: every evicted pod rebinds exactly
+    once, the cooldown stamps are cleared at expiry, and the drill stays
+    free of racy writes and multi-second loop stalls."""
+    from kubernetes_tpu.scheduler import Scheduler
+
+    async def run():
+        inner = ObjectStore()
+        store = RaceDetector(inner)
+        fragment(inner, n_nodes=6)
+        sched = Scheduler(store, caps=SMALL_CAPS)
+        driver = asyncio.get_running_loop().create_task(sched.run())
+        for pod in mk_gang(2):
+            inner.create(pod)
+        await asyncio.sleep(0.75)
+
+        wall = ManualClock(1_000_000.0)
+        mono = [0.0]
+        d1 = Descheduler(store, caps=SMALL_CAPS, scan_interval=3600.0,
+                         max_moves=4, cooldown=90.0, rollback_after=30.0,
+                         now=lambda: mono[0], clock=wall)
+        await d1.start()
+        d1.run_once()
+        plan = d1._plan
+        assert plan is not None and d1.moves >= 1
+        d1.stop()  # SIGKILL stand-in: evicted, parked, never released
+
+        d2 = Descheduler(store, caps=SMALL_CAPS, scan_interval=3600.0,
+                         max_moves=4, cooldown=90.0, rollback_after=30.0,
+                         now=lambda: mono[0], clock=wall)
+        await d2.start()
+        # warm the successor's simulator off-camera so the watchdog
+        # window measures steady-state passes, not the one-time compile
+        d2.simulator.baseline_placed(
+            [p for p in inner.list("Pod", copy_objects=False)
+             if not p.spec.node_name][:2])
+        watchdog = LoopStallWatchdog(threshold_s=2.0).start()
+        try:
+            wall.advance(31.0)  # past the orphaned parked-until stamps
+            async with asyncio.timeout(120):
+                while True:
+                    d2.run_once()
+                    displaced = [inner.get("Pod", k.partition("/")[2],
+                                           "default")
+                                 for k in plan.displaced]
+                    if all(p is not None and p.spec.node_name
+                           for p in displaced):
+                        break
+                    await asyncio.sleep(0.05)
+            # exactly-once rebinds across the handover
+            for key in plan.displaced:
+                assert store.bind_counts.get(key) == 1
+            assert sum(1 for v in store.bind_counts.values() if v > 1) == 0
+            assert store.racy_writes == []
+            # the successor clears the dead plan's stamps once they expire
+            wall.advance(90.0)
+            d2.run_once()
+            for node_name in plan.stamped:
+                node = inner.get("Node", node_name, "default")
+                assert COOLDOWN_ANNOTATION not in node.metadata.annotations
+            assert watchdog.stop() == []
+        finally:
+            watchdog.stop()
+            d2.stop()
+            driver.cancel()
+            sched.stop()
+
+    asyncio.run(run())
+
+
+# ---- satellite: bench --smoke drift gate ----
+
+
+def test_bench_smoke_mode():
+    """bench.py --smoke with the defrag config must stay runnable
+    end-to-end: config drift breaks this test, not a nightly."""
+    repo = Path(__file__).resolve().parents[1]
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["BENCH_CONFIGS"] = "defrag"
+    env["BENCH_DEFRAG_NODES"] = "12"
+    env["BENCH_DEFRAG_GANG"] = "2"
+    env["BENCH_DEFRAG_MAX_MOVES"] = "2"
+    proc = subprocess.run(
+        [sys.executable, "bench.py", "--smoke"], cwd=repo, env=env,
+        capture_output=True, text=True, timeout=420)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    line = [ln for ln in proc.stdout.splitlines() if ln.strip()][-1]
+    result = json.loads(line)
+    assert "error" not in result, result
+    extras = result["extras"]
+    assert extras["defrag_convergence_ms"] > 0
+    assert 0 < extras["defrag_moves"] <= 2
+    assert extras["defrag_dry_run_planned"] >= 1
+    assert extras["defrag_sim_solves"] >= 1
+    assert extras["defrag_sim_ms_per_solve"] > 0

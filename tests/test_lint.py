@@ -437,6 +437,27 @@ def test_r4_scoped_to_solve_path_only():
                            rules=R4)) == 1
 
 
+def test_r4_covers_descheduler_scope():
+    # the descheduler feeds the what-if solver: its victim ordering and
+    # plan decisions must be as replayable as the scheduler's
+    src = (
+        "import time\n"
+        "def stamp():\n"
+        "    return time.time()\n"
+    )
+    found = lint_source(src, relpath="kubernetes_tpu/descheduler/core.py",
+                        rules=R4)
+    assert [f.line for f in found] == [3]
+    assert found[0].rule == "nondeterminism"
+    clean = (
+        "import time\n"
+        "def stamp(clock):\n"
+        "    return clock.now(), time.perf_counter()\n"
+    )
+    assert lint_source(clean, relpath="kubernetes_tpu/descheduler/core.py",
+                       rules=R4) == []
+
+
 # ---------------------------------------------------------------------------
 # R5: store write discipline
 
