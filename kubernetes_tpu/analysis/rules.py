@@ -660,6 +660,9 @@ RECORDING_SUFFIXES = COUNTER_SUFFIXES + HISTOGRAM_SUFFIXES + (
     "_ratio", "_frac", "_per_second", "_bytes", "_mib", "_cores")
 # Prometheus alertname convention: CamelCase, e.g. SchedulerDown
 ALERT_NAME_RE = re.compile(r"^[A-Z][a-zA-Z0-9]*$")
+# profiling endpoints live in the pprof-style debug namespace
+# (obs/profiling.py): /debug/pprof/<what> or /debug/profile/<what>
+PROFILE_PATH_RE = re.compile(r"^/debug/(pprof|profile)(/[a-z_]+)+$")
 
 
 class SpanDiscipline:
@@ -682,7 +685,14 @@ class SpanDiscipline:
     suffix (the counter/histogram set plus _ratio/_frac/_per_second/
     _bytes/_mib/_cores); an AlertingRule's name must be CamelCase (the
     Prometheus alertname convention — `kubectl get alerts` and the Event
-    reason both render it)."""
+    reason both render it).
+
+    Fourth check: profiling-plane naming. Profiling sample families
+    carry the `profiling_` prefix (one namespace for the sampler /
+    compile-introspection metrics), and any `*_PATH` endpoint constant
+    whose value mentions profiling lives under the pprof-style debug
+    namespace (`/debug/pprof/*` or `/debug/profile/*`) — ad-hoc
+    profile routes fragment the obs mux surface."""
 
     name = "span-discipline"
 
@@ -690,6 +700,7 @@ class SpanDiscipline:
         yield from self._check_span_lifecycle(mod)
         yield from self._check_metric_names(mod)
         yield from self._check_rule_names(mod)
+        yield from self._check_profiling_names(mod)
 
     def _check_span_lifecycle(self, mod: Module):
         sanctioned: set[int] = set()
@@ -796,6 +807,41 @@ class SpanDiscipline:
                         "(^[A-Z][a-zA-Z0-9]*$, the Prometheus alertname "
                         "convention — kubectl and Event reasons render "
                         "it)")
+
+    def _check_profiling_names(self, mod: Module):
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in ("counter", "gauge",
+                                           "histogram"):
+                arg = node.args[0] if node.args else None
+                if isinstance(arg, ast.Constant) \
+                        and isinstance(arg.value, str) \
+                        and "profil" in arg.value \
+                        and not arg.value.startswith("profiling_"):
+                    yield Finding(
+                        self.name, mod.relpath, node.lineno,
+                        node.col_offset,
+                        f"profiling-plane family {arg.value!r} must carry "
+                        "the profiling_ prefix — one namespace for the "
+                        "sampler/compile introspection families")
+            elif isinstance(node, ast.Assign):
+                for tgt in node.targets:
+                    if not (isinstance(tgt, ast.Name)
+                            and tgt.id.endswith("_PATH")):
+                        continue
+                    v = node.value
+                    if isinstance(v, ast.Constant) \
+                            and isinstance(v.value, str) \
+                            and "prof" in v.value \
+                            and not PROFILE_PATH_RE.match(v.value):
+                        yield Finding(
+                            self.name, mod.relpath, tgt.lineno,
+                            tgt.col_offset,
+                            f"profiling endpoint {v.value!r} must live "
+                            "under /debug/pprof/* or /debug/profile/* "
+                            "(the pprof-style debug namespace the obs "
+                            "mux routes)")
 
 
 # ---------------------------------------------------------------------------

@@ -70,6 +70,18 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--config", default="",
                    help="KubeSchedulerConfiguration JSON (componentconfig;"
                         " explicit flags take precedence)")
+    p.add_argument("--profile", action="store_true",
+                   default=os.environ.get("KTPU_PROFILE", "")
+                   in ("1", "true"),
+                   help="start the continuous profiling plane "
+                        "(obs/profiling.py): sampling host profiler + "
+                        "compile cost analysis; /debug/pprof/profile and "
+                        "/debug/profile/device serve on the obs port "
+                        "(KTPU_PROFILE=1)")
+    p.add_argument("--profile-interval", type=float,
+                   default=float(os.environ.get(
+                       "KTPU_PROFILE_INTERVAL_S", "0.01")),
+                   help="sampling profiler interval in seconds")
     args = p.parse_args(argv)
     if args.config:
         from kubernetes_tpu.models.componentconfig import (
@@ -146,6 +158,13 @@ async def run(args: argparse.Namespace) -> None:
     caps = Capacities(num_nodes=args.num_nodes, batch_pods=args.batch_pods)
     sched = Scheduler(store, caps=caps, policy=load_policy(
         args.policy_config_file), scheduler_name=args.scheduler_name)
+    if getattr(args, "profile", False):
+        from kubernetes_tpu.obs.profiling import PROFILER
+
+        PROFILER.sampler.interval_s = args.profile_interval
+        PROFILER.start(cost_analysis=True)
+        log.info("profiling plane on (interval %gs): /debug/pprof/profile"
+                 " + /debug/profile/device", args.profile_interval)
     server = SchedulerServer(sched, port=args.port)
     await server.start()
     log.info("healthz/metrics at %s", server.url)

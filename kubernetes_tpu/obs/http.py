@@ -29,8 +29,23 @@ Check = Callable[[], bool]
 TRACE_PATH = "/debug/traces"
 ALERTS_PATH = "/alerts"
 QUERY_PATH = "/query"
+# profiling plane (obs/profiling.py): pprof-style host profile + device
+# trace capture windows — only routed on components that pass a profiler
+PPROF_PROFILE_PATH = "/debug/pprof/profile"
+DEVICE_PROFILE_PATH = "/debug/profile/device"
 OBS_PATHS = ("/metrics", "/healthz", "/readyz", "/livez", TRACE_PATH,
-             ALERTS_PATH, QUERY_PATH)
+             ALERTS_PATH, QUERY_PATH, PPROF_PROFILE_PATH,
+             DEVICE_PROFILE_PATH)
+
+
+def _query_seconds(raw: str, default: float | None) -> float | None:
+    """?seconds=N from a raw request target (bad/absent -> default)."""
+    import urllib.parse
+    qs = raw.split("?", 1)[1] if "?" in raw else ""
+    try:
+        return float(urllib.parse.parse_qs(qs)["seconds"][0])
+    except (KeyError, IndexError, ValueError):
+        return default
 
 
 def _run_checks(checks: Mapping[str, Check] | None
@@ -55,6 +70,7 @@ def obs_response(method: str, path: str,
                  degraded_checks: Mapping[str, Check] | None = None,
                  extra_text: Callable[[], str] | None = None,
                  monitor=None,
+                 profiler=None,
                  ) -> tuple[int, bytes, str] | None:
     """-> (status, body, content-type) for the obs endpoints (/metrics,
     health checks, /debug/traces, and — on monitor-hosting components —
@@ -68,15 +84,32 @@ def obs_response(method: str, path: str,
     restarted by a liveness probe — the check names are annotated in the
     200 body instead. `monitor` is an obs.monitor.Monitor: /alerts serves
     its alert states, /query evaluates ?query= instant-vector expressions
-    (components without one fall through to their own 404)."""
+    (components without one fall through to their own 404). `profiler`
+    is an obs.profiling.ProfilingPlane: /debug/pprof/profile serves the
+    collapsed-stack ring (the trailing ?seconds=N window — served from
+    the always-on ring, never by blocking the handler),
+    /debug/profile/device opens a jax.profiler capture window in a
+    background thread and returns its artifact dir immediately."""
     raw = path
     path = path.split("?", 1)[0].rstrip("/") or "/"
     if path not in OBS_PATHS:
         return None
     if path in (ALERTS_PATH, QUERY_PATH) and monitor is None:
         return None
+    if path in (PPROF_PROFILE_PATH, DEVICE_PROFILE_PATH) \
+            and profiler is None:
+        return None
     if method != "GET":
         return 405, b"method not allowed", TEXT_CONTENT_TYPE
+    if path == PPROF_PROFILE_PATH:
+        seconds = _query_seconds(raw, None)
+        body = profiler.profile_text(seconds=seconds)
+        return 200, body.encode(), TEXT_CONTENT_TYPE
+    if path == DEVICE_PROFILE_PATH:
+        seconds = _query_seconds(raw, 5.0)
+        payload = profiler.capture_device(seconds)
+        status = 409 if payload.get("status") == "busy" else 200
+        return status, json.dumps(payload).encode(), JSON_CONTENT_TYPE
     if path == ALERTS_PATH:
         return (200, json.dumps(monitor.alerts_payload()).encode(),
                 JSON_CONTENT_TYPE)
@@ -119,7 +152,7 @@ def http_head(status: int, body: bytes, content_type: str,
               keep_alive: bool = False) -> bytes:
     """A full HTTP/1.1 response for hand-rolled asyncio servers."""
     reason = {200: "OK", 400: "Bad Request", 404: "Not Found",
-              405: "Method Not Allowed",
+              405: "Method Not Allowed", 409: "Conflict",
               503: "Service Unavailable"}.get(status, "Error")
     conn = "keep-alive" if keep_alive else "close"
     return (f"HTTP/1.1 {status} {reason}\r\n"
@@ -136,13 +169,14 @@ class ObsServer:
                  health_checks: Mapping[str, Check] | None = None,
                  ready_checks: Mapping[str, Check] | None = None,
                  host: str = "127.0.0.1", port: int = 0,
-                 monitor=None):
+                 monitor=None, profiler=None):
         self.registry = registry
         self.health_checks = health_checks
         self.ready_checks = ready_checks
         self.host = host
         self.port = port
         self.monitor = monitor
+        self.profiler = profiler
         self._server: asyncio.AbstractServer | None = None
 
     @property
@@ -177,7 +211,8 @@ class ObsServer:
             resp = obs_response(method, target, registry=self.registry,
                                 health_checks=self.health_checks,
                                 ready_checks=self.ready_checks,
-                                monitor=self.monitor)
+                                monitor=self.monitor,
+                                profiler=self.profiler)
             if resp is None:
                 resp = (404, b"not found", TEXT_CONTENT_TYPE)
             writer.write(http_head(*resp))

@@ -673,11 +673,13 @@ def builtin_rules(window_s: float = 60.0,
                   e2e_slo_seconds: float = 1.0,
                   apiserver_slo_seconds: float = 1.0,
                   reject_ratio_max: float = 0.5,
-                  busy_frac_max: float = 0.95) -> list:
+                  busy_frac_max: float = 0.95,
+                  device_memory_frac_max: float = 0.9) -> list:
     """The built-in SLO rule set: scheduler e2e p99, apiserver request p99
     and per-APF-flow rejection burn rate, pipeline stage busy-fraction,
-    event-loop stalls, and scrape-health (`up`) for the scheduler — the
-    alert the chaos drill holds to fires-then-resolves."""
+    event-loop stalls, device-memory high-water (profiling plane), and
+    scrape-health (`up`) for the scheduler — the alert the chaos drill
+    holds to fires-then-resolves."""
     w = f"[{window_s:g}s]"
     return [
         RecordingRule(
@@ -722,6 +724,20 @@ def builtin_rules(window_s: float = 60.0,
             "EventLoopStalled",
             f"increase(eventloop_stalls_total{w}) > 0", for_s=for_s,
             annotations={"summary": "event loop held >100ms"}),
+        # profiling plane (obs/profiling.py): device-memory high-water
+        # vs the backend-reported limit. The CPU fallback never exports
+        # device_memory_bytes_limit, so the division joins against an
+        # empty vector there and the alert cannot fire by construction.
+        RecordingRule(
+            "device_memory_highwater_frac",
+            "device_memory_peak_bytes_in_use"
+            " / device_memory_bytes_limit"),
+        AlertingRule(
+            "DeviceMemoryHigh",
+            f"device_memory_highwater_frac > {device_memory_frac_max:g}",
+            for_s=for_s,
+            annotations={"summary": "device HBM high-water near the "
+                                    "backend limit"}),
     ]
 
 
@@ -805,6 +821,7 @@ class Monitor:
                  slo_window_s: float | None = None,
                  alert_for_s: float = 0.0,
                  e2e_slo_seconds: float = 1.0,
+                 device_memory_frac_max: float = 0.9,
                  seed: int = 0, node_host: str = "127.0.0.1",
                  recorder=None, registry: _metrics.Registry | None = None):
         self.store = store
@@ -822,7 +839,8 @@ class Monitor:
                       else max(4 * self.interval, 1.0))
             self.rules.extend(builtin_rules(
                 window_s=window, for_s=alert_for_s,
-                e2e_slo_seconds=e2e_slo_seconds))
+                e2e_slo_seconds=e2e_slo_seconds,
+                device_memory_frac_max=device_memory_frac_max))
         self._node_host = node_host
         self._rnd = random.Random(seed)
         self._recorder = recorder

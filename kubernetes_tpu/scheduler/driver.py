@@ -45,6 +45,7 @@ from kubernetes_tpu.gang import (
 )
 from kubernetes_tpu.models.policy import DEFAULT_POLICY, Policy
 from kubernetes_tpu.obs import metrics as obs_metrics
+from kubernetes_tpu.obs.profiling import COMPILES, record_readback
 from kubernetes_tpu.obs.tracing import (
     TRACE_ANNOTATION,
     TRACER,
@@ -196,6 +197,20 @@ class SchedulerMetrics:
             "scheduler_trace_step_duration_seconds",
             "Scheduling-batch trace spans (StepTimer steps).",
             ("step",), buckets=PHASE_BUCKETS_S)
+        # pipeline saturation gauges, refreshed at scrape time from
+        # StagedPipeline.snapshot() so the monitor can watch the same
+        # busy fractions the bench extras report
+        self._g_stage_busy = r.gauge(
+            "scheduler_pipeline_stage_busy_frac",
+            "Fraction of the wall each pipeline stage was busy since "
+            "the last stats reset.", ("stage",))
+        self._g_queue_hw = r.gauge(
+            "scheduler_pipeline_queue_high_water",
+            "Queue-depth high-water mark per pipeline stage queue.",
+            ("stage",))
+        self._g_pipe_depth = r.gauge(
+            "scheduler_pipeline_depth",
+            "Batches currently in flight in the staged pipeline.")
         self._scheduled = 0
         self._failed = 0
         self._binding_errors = 0
@@ -321,6 +336,17 @@ class SchedulerMetrics:
     def add_phase(self, name: str, seconds: float) -> None:
         self.phase_s[name] = self.phase_s.get(name, 0.0) + seconds
         self._h_phase.labels(name).observe(seconds)
+
+    def export_pipeline(self, snap: dict | None) -> None:
+        """Mirror a StagedPipeline.snapshot() into the saturation
+        gauges — called at /metrics scrape time."""
+        if not snap:
+            return
+        for stage, frac in (snap.get("stage_busy_frac") or {}).items():
+            self._g_stage_busy.labels(stage).set(float(frac))
+        for stage, depth in (snap.get("queue_depth_max") or {}).items():
+            self._g_queue_hw.labels(stage).set(float(depth))
+        self._g_pipe_depth.set(float(snap.get("depth", 0)))
 
     def phase_histograms(self) -> dict:
         """Per-phase histogram snapshot {phase: {count, sum_ms, p50_ms,
@@ -578,6 +604,14 @@ class Scheduler:
         self.explain = explain if explain is not None \
             else os.environ.get("KTPU_EXPLAIN", "") in ("1", "true")
 
+    @staticmethod
+    def _variant_key(flags) -> str:
+        """Human-readable jit-variant label for the compile registry:
+        the active BatchFlags gates joined, 'baseline' when none."""
+        on = [f.name for f in dataclasses.fields(flags)
+              if getattr(flags, f.name)]
+        return "+".join(on) or "baseline"
+
     def _get_schedule_fn(self, flags):
         """Compiled solver variant for this batch's content gates — a
         handful of variants in practice (jit caches per BatchFlags)."""
@@ -601,6 +635,9 @@ class Scheduler:
                     lambda s, fb, ib, rr, v=None: schedule_batch(
                         s, unpack_batch(fb, ib, caps), rr, policy,
                         caps=caps, prows=prows, flags=flags, victims=v))
+            # compile registry (obs/profiling.py): first-call compile
+            # seconds + cost_analysis per variant ride the cache entry
+            fn = COMPILES.instrument(self._variant_key(flags), fn)
             self._schedule_fns[flags] = fn
         return fn
 
@@ -1699,6 +1736,7 @@ class Scheduler:
         t_wait = time.monotonic()
         if assignments is None:
             assignments = np.asarray(result.assignments)
+            record_readback(assignments)
             self.metrics.add_phase("settle_wait",
                                    time.monotonic() - t_wait)
         # synchronous batches observe the true dispatch-to-ready span; for a
@@ -1719,14 +1757,16 @@ class Scheduler:
         # this batch actually carried a victim table
         preempt_rows = victim_counts = None
         if vslots is not None:
-            preempt_rows = np.asarray(
-                result.preempt_node)[:len(pods)].tolist()
-            victim_counts = np.asarray(
-                result.victim_count)[:len(pods)].tolist()
+            preempt = np.asarray(result.preempt_node)
+            victims = np.asarray(result.victim_count)
+            record_readback(preempt, victims)
+            preempt_rows = preempt[:len(pods)].tolist()
+            victim_counts = victims[:len(pods)].tolist()
         explain_rows = None
         if flags.explain and result.explain_counts is not None:
-            explain_rows = np.asarray(
-                result.explain_counts)[:len(pods)].tolist()
+            explain = np.asarray(result.explain_counts)
+            record_readback(explain)
+            explain_rows = explain[:len(pods)].tolist()
         scheduled, committed, any_rejected = self._apply_batch(
             result, pods, live_keys, blobs, flags, rows, preempt_rows,
             victim_counts, gang_groups, vslots, timer,

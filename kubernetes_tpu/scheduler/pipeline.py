@@ -40,6 +40,7 @@ from collections import deque
 
 import numpy as np
 
+from kubernetes_tpu.obs.profiling import record_readback
 from kubernetes_tpu.obs.tracing import TRACER, wall_now
 
 log = logging.getLogger(__name__)
@@ -617,15 +618,20 @@ class StagedPipeline:
                         work.assignments = np.asarray(
                             work.result.assignments)
                         work.rows = work.assignments[:n].tolist()
+                        read = [work.assignments]
                         if work.vslots is not None:
-                            work.preempt_rows = np.asarray(
-                                work.result.preempt_node)[:n].tolist()
-                            work.victim_counts = np.asarray(
-                                work.result.victim_count)[:n].tolist()
+                            preempt = np.asarray(work.result.preempt_node)
+                            victims = np.asarray(work.result.victim_count)
+                            work.preempt_rows = preempt[:n].tolist()
+                            work.victim_counts = victims[:n].tolist()
+                            read += [preempt, victims]
                         if (work.flags.explain
                                 and work.result.explain_counts is not None):
-                            work.explain_rows = np.asarray(
-                                work.result.explain_counts)[:n].tolist()
+                            explain = np.asarray(
+                                work.result.explain_counts)
+                            work.explain_rows = explain[:n].tolist()
+                            read.append(explain)
+                        record_readback(*read)
                     except Exception as e:  # noqa: BLE001 — transport
                         work.error = e  # routed into solve-failure recovery
                     dt = time.perf_counter() - t0

@@ -19,6 +19,7 @@ from kubernetes_tpu.obs.http import (
     http_head,
     obs_response,
 )
+from kubernetes_tpu.obs.profiling import PROFILER
 from kubernetes_tpu.scheduler.driver import Scheduler
 
 # ExponentialBuckets(1000, 2, 15) in microseconds (metrics.go:36);
@@ -29,7 +30,13 @@ BUCKETS_US = [1000.0 * (2 ** i) for i in range(15)]
 def render_metrics(sched: Scheduler) -> str:
     """The driver's (usually private) registry plus the process-global
     one. Family names don't overlap: scheduler families live on the
-    driver's registry, workqueue/informer families on the global one."""
+    driver's registry, workqueue/informer families on the global one.
+    Scrape-time refresh: pipeline saturation gauges mirror the live
+    StagedPipeline.snapshot(), device-memory gauges re-read
+    memory_stats() (CPU fallback: StateDB blob accounting)."""
+    if sched._staged is not None:
+        sched.metrics.export_pipeline(sched._staged.snapshot())
+    PROFILER.memory.collect([sched.statedb])
     text = sched.metrics.registry.render()
     if sched.metrics.registry is not obs_metrics.REGISTRY:
         text += obs_metrics.REGISTRY.render()
@@ -75,6 +82,7 @@ class SchedulerServer:
                 line = await reader.readline()
                 if line in (b"\r\n", b"\n", b""):
                     break
+            query = path.split("?", 1)[1] if "?" in path else ""
             path = path.split("?", 1)[0].rstrip("/") or "/"
             if path == "/":  # healthz alias, kube-scheduler's root ping
                 path = "/healthz"
@@ -84,12 +92,13 @@ class SchedulerServer:
                     METRICS_CONTENT_TYPE)
             else:
                 resp = obs_response(
-                    method, path,
+                    method, path + ("?" + query if query else ""),
                     ready_checks={
                         "informers-synced": lambda: self.sched.synced},
                     degraded_checks={
                         "device-solver":
-                            lambda: not self.sched.solver_degraded})
+                            lambda: not self.sched.solver_degraded},
+                    profiler=PROFILER)
                 if resp is None:
                     status, body, ctype = 404, b"not found", "text/plain"
                 else:

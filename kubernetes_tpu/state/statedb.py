@@ -91,6 +91,7 @@ class StateDB:
         self.flush_rows_total = 0
         self.flush_transfers_total = 0
         self.flush_full_total = 0
+        self.flush_bytes_total = 0
         from kubernetes_tpu.obs import REGISTRY
         self._m_rows = REGISTRY.counter(
             "statedb_flush_rows_total",
@@ -98,6 +99,11 @@ class StateDB:
         self._m_transfers = REGISTRY.counter(
             "statedb_flush_transfers_total",
             "host->device transfers issued by StateDB.flush")
+        self._m_bytes = REGISTRY.counter(
+            "statedb_flush_bytes_total",
+            "host->device bytes uploaded by StateDB.flush (the upload "
+            "side of the transfer ledger; readback is "
+            "device_readback_bytes_total)")
 
     # ---- node lifecycle ----
 
@@ -317,6 +323,7 @@ class StateDB:
         self.flush_transfers_total += 1
         self._m_rows.inc(k)
         self._m_transfers.inc()
+        self._count_flush_bytes(int(packed.nbytes) + int(idx.nbytes))
         return dev.replace(**dict(zip(fields, new)))
 
     def flush(self) -> ClusterState:
@@ -527,17 +534,25 @@ class StateDB:
             self._dirty_ledger = True
             self._dirty_rows.update(rows.tolist())
 
+    def _count_flush_bytes(self, nbytes: int) -> None:
+        self.flush_bytes_total += nbytes
+        self._m_bytes.inc(nbytes)
+
     def _put(self, state: ClusterState) -> ClusterState:
+        host = jax.tree.map(np.asarray, state)
+        self._count_flush_bytes(sum(
+            int(leaf.nbytes) for leaf in jax.tree_util.tree_leaves(host)))
         if self.mesh is not None:
             from kubernetes_tpu.parallel.mesh import shard_state
             return shard_state(state, self.mesh)
         # ONE batched transfer for the whole pytree — per-leaf puts pay a
         # per-call round trip each on remote-device transports
-        return jax.device_put(jax.tree.map(np.asarray, state))
+        return jax.device_put(host)
 
     def _put_arr(self, arr: np.ndarray):
         self.flush_transfers_total += 1
         self._m_transfers.inc()
+        self._count_flush_bytes(int(np.asarray(arr).nbytes))
         if self.mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec
             from kubernetes_tpu.parallel.mesh import NODE_AXIS

@@ -567,9 +567,45 @@ def test_r6_clean_monitoring_rule_names():
     assert lint_source(src, relpath="kubernetes_tpu/x.py", rules=R6) == []
 
 
+def test_r6_flags_unprefixed_profiling_family():
+    # a sampler/compile-introspection family without the profiling_
+    # prefix fragments the profiling namespace
+    src = (
+        "def metrics(r):\n"
+        "    bad = r.counter('sample_profile_walks_total', 'd')\n"
+        "    bad_g = r.gauge('host_profiler_threads', 'd')\n"
+        "    ok = r.counter('profiling_samples_total', 'd')\n"
+        "    ok_h = r.histogram('profiling_sample_walk_seconds', 'd')\n"
+    )
+    found = lint_source(src, relpath="kubernetes_tpu/x.py", rules=R6)
+    assert sorted(f.line for f in found) == [2, 3]
+    assert all("profiling_ prefix" in f.message for f in found)
+
+
+def test_r6_flags_profiling_path_outside_debug_namespace():
+    src = (
+        "PROFILE_PATH = '/profilez'\n"
+        "CPU_PROFILE_PATH = '/debug/cpuprofile'\n"
+        "PPROF_PROFILE_PATH = '/debug/pprof/profile'\n"
+        "DEVICE_PROFILE_PATH = '/debug/profile/device'\n"
+        "METRICS_PATH = '/metrics'\n"  # no 'prof' in value: not ours
+    )
+    found = lint_source(src, relpath="kubernetes_tpu/x.py", rules=R6)
+    assert sorted(f.line for f in found) == [1, 2]
+    assert all("/debug/pprof" in f.message for f in found)
+
+
 def test_r6_whole_tree_clean():
     result = run_analysis(rules=R6, baseline={})
     assert result.findings == [], [str(f) for f in result.findings]
+
+
+def test_r1_profiling_sampler_thread_is_loop_pure():
+    # the sampler/capture threads must never touch the event loop or
+    # park on time.sleep (Event.wait only): audit the real module
+    r = run_analysis(["kubernetes_tpu/obs/profiling.py"], rules=R1,
+                     use_baseline=False)
+    assert r.findings == [], [str(f) for f in r.findings]
 
 
 # ---------------------------------------------------------------------------

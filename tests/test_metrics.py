@@ -459,6 +459,12 @@ def test_fleet_obs_endpoints():
             # in the TSDB with up=1 and its series are queryable
             from kubernetes_tpu.obs.monitor import Monitor
 
+            # one profiling sample so the plane's families carry a
+            # child (families render no series until first touched)
+            from kubernetes_tpu.obs.profiling import PROFILER
+
+            PROFILER.sampler.sample_once()
+
             mon = Monitor(store=None, interval=1.0)
             for job, base in fleet.items():
                 mon.add_static_target(job, base)
@@ -474,6 +480,29 @@ def test_fleet_obs_endpoints():
             assert mon.query(
                 'sum by (phase) '
                 '(scheduler_phase_duration_seconds_count)')
+
+            # profiling plane families land in the TSDB off the same
+            # scrape: the sampler ring counter, the CPU-fallback StateDB
+            # blob accounting (refreshed by the scheduler's /metrics
+            # render), and the staged pipeline's busy-fraction export
+            assert mon.query('profiling_samples_total'
+                             '{job="scheduler"}') != []
+            vec = mon.query('device_memory_statedb_bytes'
+                            '{job="scheduler"}')
+            assert vec and sum(v for _, v in vec) > 0
+            if sched._staged is not None:
+                assert mon.query('scheduler_pipeline_stage_busy_frac'
+                                 '{job="scheduler",stage="settle"}') != []
+            # DeviceMemoryHigh can never fire on the CPU fallback: no
+            # device_memory_bytes_limit series means the highwater_frac
+            # recording rule joins an empty vector
+            assert mon.query('device_memory_bytes_limit') == []
+            mon.evaluate_rules()
+            assert mon.query('device_memory_highwater_frac') == []
+            assert not any(
+                s["state"] == "firing"
+                for s in mon._alert_state.get(
+                    "DeviceMemoryHigh", {}).values())
         finally:
             await cm_obs.stop()
             await ext_srv.stop()
