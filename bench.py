@@ -29,6 +29,12 @@ chaos_recovery_ms), BENCH_OVERLOAD_NODES / BENCH_OVERLOAD_PODS /
 BENCH_OVERLOAD_MULT / BENCH_OVERLOAD_SEED + BENCH_FANOUT_WATCHERS /
 BENCH_FANOUT_EVENTS (noisy-tenant APF drill + watch-cache fan-out;
 reports overload_p99_ms and watch_fanout_events_per_sec),
+BENCH_SOLVERSVC_TENANTS / BENCH_SOLVERSVC_NODES / BENCH_SOLVERSVC_PODS /
+BENCH_SOLVERSVC_BATCH_PODS / BENCH_SOLVERSVC_FLOOD (solver-as-a-service
+drill: M tenant control planes — one on the stock extender wire — share
+one continuous-batching device program; reports per-tenant victim p99
+under a noisy flood, aggregate vs solo pods/s, and errors on any
+cross-tenant assignment or double bind),
 BENCH_E2E_GATE (headline pods/s hard floor at >=1000 nodes, default
 15000 — pins the staged host pipeline the way BENCH_DEVICE_GATE pins the
 compiled program; 0 disables, and --smoke defaults it off). The headline
@@ -159,6 +165,11 @@ def main() -> None:
         os.environ.setdefault("BENCH_FANOUT_XL_SCHED_NODES", "8")
         os.environ.setdefault("BENCH_FANOUT_XL_SCHED_PODS", "16")
         os.environ.setdefault("BENCH_FANOUT_XL_GATE", "0")  # CI: no gate
+        os.environ.setdefault("BENCH_SOLVERSVC_TENANTS", "4")
+        os.environ.setdefault("BENCH_SOLVERSVC_NODES", "8")
+        os.environ.setdefault("BENCH_SOLVERSVC_PODS", "16")
+        os.environ.setdefault("BENCH_SOLVERSVC_BATCH_PODS", "32")
+        os.environ.setdefault("BENCH_SOLVERSVC_FLOOD", "8")
         os.environ.setdefault("BENCH_MONITOR_TARGETS", "3")
         os.environ.setdefault("BENCH_MONITOR_SECONDS", "2")
         os.environ.setdefault("BENCH_MONITOR_INTERVAL", "0.2")
@@ -186,7 +197,8 @@ def main() -> None:
         os.environ.setdefault("BENCH_MULTIPROC_GATE", "0")
         os.environ.setdefault(
             "BENCH_CONFIGS",
-            "headline,gang,preemption,autoscaler,sharded,monitor,defrag")
+            "headline,gang,preemption,autoscaler,sharded,monitor,defrag,"
+            "solver-svc")
         os.environ.setdefault("BENCH_TIMEOUT_S", "600")
     timeout = int(os.environ.get("BENCH_TIMEOUT_S", "1800"))
     signal.signal(signal.SIGALRM, _die_with_timeout)
@@ -197,7 +209,8 @@ def main() -> None:
     configs = os.environ.get(
         "BENCH_CONFIGS",
         "headline,interpod,spread,gang,preemption,recovery,chaos,overload,"
-        "device,autoscaler,monitor,ha,fanout-xl,multiproc,defrag")
+        "device,autoscaler,monitor,ha,fanout-xl,multiproc,defrag,"
+        "solver-svc")
     configs = [c.strip() for c in configs.split(",") if c.strip()]
     metrics_snapshot = "--metrics-snapshot" in sys.argv[1:] or \
         os.environ.get("BENCH_METRICS_SNAPSHOT", "") in ("1", "true")
@@ -483,6 +496,69 @@ def main() -> None:
                 f"watch fanout: store did {fr.store_fanout_puts} puts for "
                 f"{fan_events} events (the cache is not the only "
                 f"subscriber)")
+
+    if "solver-svc" in configs:
+        from kubernetes_tpu.perf.harness import run_solver_svc
+
+        # solver-as-a-service drill: M tenant control planes (tenant-0 an
+        # unmodified extender consumer over the wire, the rest native
+        # /solve clients) share ONE continuous-batching device program.
+        # Gates stay armed even in --smoke: exactly-once binds per tenant
+        # under the RaceDetector, zero cross-tenant assignments, a noisy
+        # tenant's flood moves the victim's p99 by at most 5x, and the
+        # multi-tenant aggregate throughput at least matches one tenant
+        # pushing the same total shape through the same warmed service
+        svc_tenants = int(os.environ.get("BENCH_SOLVERSVC_TENANTS", "4"))
+        svc_nodes = int(os.environ.get("BENCH_SOLVERSVC_NODES", "32"))
+        svc_pods = int(os.environ.get("BENCH_SOLVERSVC_PODS", "96"))
+        svc_batch = int(os.environ.get("BENCH_SOLVERSVC_BATCH_PODS", "64"))
+        svc_flood = int(os.environ.get("BENCH_SOLVERSVC_FLOOD", "12"))
+        svc_seed = int(os.environ.get("BENCH_SOLVERSVC_SEED", "2026"))
+        race_detect = "--with-race-detector" in sys.argv[1:] or \
+            os.environ.get("BENCH_RACE_DETECTOR", "") in ("1", "true")
+        rs = run_solver_svc(
+            n_tenants=svc_tenants, nodes_per_tenant=svc_nodes,
+            pods_per_tenant=svc_pods, seed=svc_seed, batch_pods=svc_batch,
+            flood_threads=svc_flood, race_detect=race_detect)
+        print(f"bench[solver-svc]: {rs}", file=sys.stderr, flush=True)
+        extras["solversvc_agg_pods_per_sec"] = round(rs.agg_pods_per_sec, 1)
+        extras["solversvc_solo_pods_per_sec"] = \
+            round(rs.solo_pods_per_sec, 1)
+        extras["solversvc_victim_p99_ms"] = round(rs.p99_loaded_ms, 2)
+        extras["solversvc_victim_p99_unloaded_ms"] = \
+            round(rs.p99_unloaded_ms, 2)
+        extras["solversvc_flood_requests"] = rs.flood_requests
+        extras["solversvc_flood_rejected"] = rs.flood_rejected
+        extras["solversvc_steps"] = rs.steps
+        extras["solversvc_isolation_violations"] = rs.isolation_violations
+        extras["solversvc_seed"] = rs.seed
+        if race_detect:
+            extras["solversvc_racy_writes"] = rs.racy_writes
+        if not rs.converged:
+            RESULT["error"] = (
+                f"solver-svc drill did not converge (seed {rs.seed}): "
+                f"{rs.bound}/{rs.expected_bound} bound, "
+                f"{rs.double_binds} double-binds, "
+                f"{rs.cross_tenant_assignments} cross-tenant assignments")
+        elif rs.isolation_violations:
+            RESULT["error"] = (
+                f"solver-svc drill: {rs.isolation_violations} isolation "
+                f"violations decoded from the shared batch")
+        elif not rs.p99_bounded:
+            RESULT["error"] = (
+                f"solver-svc drill: victim p99 {rs.p99_loaded_ms:.1f}ms "
+                f"under flood breached 5x unloaded baseline "
+                f"({rs.p99_unloaded_ms:.1f}ms)")
+        elif not rs.batching_wins:
+            RESULT["error"] = (
+                f"solver-svc drill: aggregate {rs.agg_pods_per_sec:.0f} "
+                f"pods/s under {rs.tenants} tenants fell below the "
+                f"single-tenant headline {rs.solo_pods_per_sec:.0f} at "
+                f"the same total shape")
+        elif race_detect and rs.racy_writes:
+            RESULT["error"] = (
+                f"solver-svc drill under race detector (seed {rs.seed}): "
+                f"{rs.racy_writes} racy writes")
 
     if "ha" in configs:
         from kubernetes_tpu.perf.harness import run_rolling_restart
@@ -945,7 +1021,8 @@ def main() -> None:
         gang_keys = [k for k in extras
                      if k.startswith("gang_") and k.endswith("_pods_per_sec")]
         for key in ("interpod_5k_pods_per_sec", "spread_15k_pods_per_sec",
-                    "sharded_pods_per_sec", *gang_keys):
+                    "sharded_pods_per_sec", "solversvc_agg_pods_per_sec",
+                    *gang_keys):
             if key in extras:
                 RESULT["metric"] = key
                 RESULT["value"] = extras[key]

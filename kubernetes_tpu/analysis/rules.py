@@ -557,7 +557,8 @@ class BatchFlagsDiscipline:
 
 
 R4_SCOPES = ("kubernetes_tpu/ops/", "kubernetes_tpu/state/",
-             "kubernetes_tpu/scheduler/", "kubernetes_tpu/descheduler/")
+             "kubernetes_tpu/scheduler/", "kubernetes_tpu/descheduler/",
+             "kubernetes_tpu/solversvc/")
 R4_FILES = ("kubernetes_tpu/autoscaler/simulator.py",)
 
 AMBIENT_ENTROPY = {"uuid.uuid4", "uuid.uuid1", "os.urandom",
@@ -692,7 +693,13 @@ class SpanDiscipline:
     compile-introspection metrics), and any `*_PATH` endpoint constant
     whose value mentions profiling lives under the pprof-style debug
     namespace (`/debug/pprof/*` or `/debug/profile/*`) — ad-hoc
-    profile routes fragment the obs mux surface."""
+    profile routes fragment the obs mux surface.
+
+    Fifth check: solver-service naming. Every metric family DEFINED in
+    `kubernetes_tpu/solversvc/` carries the `solversvc_` prefix — the
+    multi-tenant serving plane is one dashboard namespace, and a bare
+    `requests_total` from the service would collide with (or hide
+    behind) the apiserver's families on every federated scrape."""
 
     name = "span-discipline"
 
@@ -701,6 +708,7 @@ class SpanDiscipline:
         yield from self._check_metric_names(mod)
         yield from self._check_rule_names(mod)
         yield from self._check_profiling_names(mod)
+        yield from self._check_solversvc_names(mod)
 
     def _check_span_lifecycle(self, mod: Module):
         sanctioned: set[int] = set()
@@ -842,6 +850,26 @@ class SpanDiscipline:
                             "under /debug/pprof/* or /debug/profile/* "
                             "(the pprof-style debug namespace the obs "
                             "mux routes)")
+
+    def _check_solversvc_names(self, mod: Module):
+        if not mod.relpath.startswith("kubernetes_tpu/solversvc/"):
+            return
+        for node in ast.walk(mod.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in ("counter", "gauge",
+                                           "histogram")):
+                continue
+            arg = node.args[0] if node.args else None
+            if isinstance(arg, ast.Constant) \
+                    and isinstance(arg.value, str) \
+                    and not arg.value.startswith("solversvc_"):
+                yield Finding(
+                    self.name, mod.relpath, node.lineno, node.col_offset,
+                    f"solve-service family {arg.value!r} must carry the "
+                    "solversvc_ prefix — the multi-tenant serving plane "
+                    "is one dashboard namespace and bare names collide "
+                    "with the apiserver's families on federated scrapes")
 
 
 # ---------------------------------------------------------------------------

@@ -235,6 +235,22 @@ class FlowController:
         parsed.sort(key=lambda s: (s.precedence, s.name))
         self.schemas = parsed
 
+    def configure(self, levels: dict[str, dict] | None = None,
+                  schemas: list[dict] | None = None) -> None:
+        """Public configuration hook for embedders: layer extra priority
+        levels / flow schemas over the built-ins (wins by name, same merge
+        the store-driven refresh applies). The solversvc front end uses
+        this to install a dedicated `solversvc` level so tenant solve
+        traffic gets its own seat budget and shuffle-sharded queues
+        instead of competing inside `workload`."""
+        base_levels = {name: dict(spec)
+                       for name, spec in DEFAULT_PRIORITY_LEVELS.items()}
+        base_levels.update(levels or {})
+        merged = {s["name"]: dict(s) for s in DEFAULT_FLOW_SCHEMAS}
+        for s in schemas or []:
+            merged[s["name"]] = dict(s)
+        self._apply_config(base_levels, list(merged.values()))
+
     def _maybe_refresh(self) -> None:
         """Layer store-defined FlowSchema / PriorityLevelConfiguration
         objects over the built-ins (objects win by name; unknown levels on
@@ -420,3 +436,12 @@ class FlowController:
             return 0.0
         return 1e3 * samples[min(len(samples) - 1,
                                  int(0.99 * (len(samples) - 1)))]
+
+
+def solve_seats(n_pods: int) -> int:
+    """APF work estimate for one solve request: device time is roughly
+    linear in the pod count, so charge one seat per started 16 pods (the
+    reference's LIST work estimator shape applied to solver work). A
+    single-pod extender verb is 1 seat; a 64-pod native batch is 4 —
+    a tenant shipping huge batches drains its seat budget proportionally."""
+    return 1 + max(0, int(n_pods) - 1) // 16

@@ -1,0 +1,99 @@
+"""The standalone solver-as-a-service binary.
+
+One device program, many control planes: start a SolverService (the
+continuous batcher over shape-bucketed jit caches) and its HTTP front
+end, pre-register the named tenants, warm the shape buckets, and serve
+until interrupted:
+
+    python -m kubernetes_tpu.cmd.solversvc \
+        --port 10260 --tenant prod --tenant staging \
+        --window-ms 5 --seats 32 --warmup-bucket 16
+
+A stock Go kube-scheduler joins as a tenant with nothing but an
+extender policy pointing at ``urlPrefix:
+http://host:10260/tenants/<name>``; native clients use
+``/tenants/<name>/solve`` (gangs, preemption, batch binds) and
+``/tenants/<name>/state`` for cache-capable node sync.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+import sys
+
+log = logging.getLogger(__name__)
+
+
+def parse_args(argv=None) -> argparse.Namespace:
+    p = argparse.ArgumentParser(
+        prog="kubernetes-tpu-solversvc",
+        description="multi-tenant solve service (continuous batching)")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=10260,
+                   help="HTTP front end port (0 = ephemeral)")
+    p.add_argument("--tenant", action="append", default=[],
+                   metavar="NAME", help="pre-register a tenant (repeatable)")
+    p.add_argument("--auto-register", action="store_true",
+                   help="register unknown tenants on first request instead "
+                        "of returning 404")
+    p.add_argument("--window-ms", type=float, default=5.0,
+                   help="micro-batch coalescing window")
+    p.add_argument("--seats", type=int, default=32,
+                   help="concurrent solve seats shared APF-style across "
+                        "tenants")
+    p.add_argument("--queue-wait", type=float, default=2.0,
+                   help="max seconds a request may queue for a seat before "
+                        "a 429")
+    p.add_argument("--deadline", type=float, default=5.0,
+                   help="per-request HTTP deadline (504 past this)")
+    p.add_argument("--batch-pods", type=int, default=64,
+                   help="device batch capacity in pod rows")
+    p.add_argument("--nodes", type=int, default=256,
+                   help="initial node capacity (grows by pow-2 rebuild)")
+    p.add_argument("--warmup-bucket", action="append", type=int, default=[],
+                   metavar="PODS",
+                   help="pre-compile this pod bucket at startup (repeatable)")
+    return p.parse_args(argv)
+
+
+async def run(args: argparse.Namespace) -> None:
+    from kubernetes_tpu.solversvc.core import SolverService
+    from kubernetes_tpu.solversvc.server import SolverFrontend
+    from kubernetes_tpu.state.layout import Capacities
+
+    svc = SolverService(
+        caps=Capacities(num_nodes=args.nodes, batch_pods=args.batch_pods),
+        window_s=args.window_ms / 1000.0,
+        total_seats=args.seats,
+        queue_wait_s=args.queue_wait)
+    for name in args.tenant:
+        svc.register_tenant(name)
+    frontend = SolverFrontend(
+        svc, host=args.host, port=args.port, deadline_s=args.deadline,
+        warmup_buckets=tuple(args.warmup_bucket),
+        auto_register=args.auto_register)
+    await frontend.start()
+    log.info("solversvc serving %d tenant(s) on %s (window %.1fms, "
+             "%d seats)", len(args.tenant), frontend.url, args.window_ms,
+             args.seats)
+    try:
+        await asyncio.Event().wait()
+    finally:
+        await frontend.stop()
+
+
+def main(argv=None) -> int:
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(levelname).1s %(name)s: %(message)s")
+    try:
+        asyncio.run(run(parse_args(argv)))
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

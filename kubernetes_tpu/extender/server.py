@@ -33,6 +33,7 @@ import jax
 import numpy as np
 
 from kubernetes_tpu.api.objects import Node, Pod
+from kubernetes_tpu.apiserver.flowcontrol import FlowRejected
 from kubernetes_tpu.models.policy import DEFAULT_POLICY, Policy, build_policy_rows
 from kubernetes_tpu.ops.solver import evaluate_pod
 from kubernetes_tpu.state import Capacities, encode_cluster
@@ -60,11 +61,16 @@ class ExtenderService:
 
     def __init__(self, caps: Capacities | None = None,
                  policy: Policy = DEFAULT_POLICY, statedb: StateDB | None = None,
-                 store=None):
+                 store=None, solversvc=None, solversvc_buckets: tuple = ()):
         self.caps = caps or Capacities()
         self.policy = policy.with_env_overrides()
         self.statedb = statedb
         self.store = store
+        # co-located multi-tenant service (solversvc.SolverService): one
+        # warmup() call compiles BOTH the per-cluster path and the
+        # service's shape buckets before traffic arrives
+        self.solversvc = solversvc
+        self.solversvc_buckets = tuple(solversvc_buckets)
         # prows arrays are passed as traced args so per-request tables
         # (full-objects mode) don't recompile; policy/caps stay static
         self._eval = jax.jit(
@@ -76,7 +82,11 @@ class ExtenderService:
 
     def warmup(self) -> None:
         """Compile the evaluation program before serving (first compile can
-        exceed the reference client's 5s default timeout, extender.go:36)."""
+        exceed the reference client's 5s default timeout, extender.go:36).
+        When a solversvc is attached, its pow-2 shape buckets pre-compile
+        here too — the compile registry names each bucket variant
+        (``solversvc[evaluate,pN]`` / ``solversvc[solve,pN]+flags``) so
+        `bench --profile` attributes any recompile to the exact bucket."""
         try:
             dummy = Node.from_dict({
                 "metadata": {"name": "warmup-node"},
@@ -88,6 +98,8 @@ class ExtenderService:
                            [dummy], None)
         except Exception:  # never block serving on a warmup failure
             log.exception("extender warmup failed")
+        if self.solversvc is not None:
+            self.solversvc.warmup(self.solversvc_buckets)
 
     # ---- state resolution ----
 
@@ -135,23 +147,14 @@ class ExtenderService:
             pod = Pod.from_dict(args.get("pod") or {})
             nodes, node_names = _parse_candidates(args)
             names, feasible, _, row_of = self._evaluate(pod, nodes, node_names)
-            passed, failed = [], {}
-            for name in names:
+
+            def ok(name: str) -> bool:
                 row = row_of.get(name)
-                if row is not None and feasible[row]:
-                    passed.append(name)
-                else:
-                    failed[name] = "node(s) didn't satisfy TPU predicates"
-            if nodes is not None:
-                by_name = {n.metadata.name: n for n in nodes}
-                result: dict[str, Any] = {"nodes": {
-                    "apiVersion": "v1", "kind": "NodeList",
-                    "items": [by_name[n].to_dict() for n in passed]}}
-            else:
-                result = {"nodenames": passed}
-            if failed:
-                result["failedNodes"] = failed
-            return result
+                return row is not None and bool(feasible[row])
+
+            items = {n.metadata.name: n.to_dict() for n in nodes} \
+                if nodes is not None else None
+            return filter_payload(names, ok, items)
         except (ValueError, CapacityError, KeyError) as e:  # malformed args
             return {"error": f"{type(e).__name__}: {e}"}
 
@@ -162,12 +165,12 @@ class ExtenderService:
         pod = Pod.from_dict(args.get("pod") or {})
         nodes, node_names = _parse_candidates(args)
         names, _, score, row_of = self._evaluate(pod, nodes, node_names)
-        out = []
-        for name in names:
+
+        def score_of(name: str) -> int:
             row = row_of.get(name)
-            out.append({"host": name,
-                        "score": int(score[row]) if row is not None else 0})
-        return out
+            return int(score[row]) if row is not None else 0
+
+        return priority_payload(names, score_of)
 
     def bind(self, args: dict[str, Any]) -> dict[str, Any]:
         """ExtenderBindingResult for ExtenderBindingArgs — standalone mode
@@ -192,20 +195,66 @@ def _parse_candidates(args: dict[str, Any]):
     return None, list(names or [])
 
 
+# ---- wire payload shaping, shared by the per-cluster service above and
+# the multi-tenant solversvc front end (one evaluation path, one protocol
+# rendering — both end at ops.solver.evaluate_pod, single or vmapped) ----
+
+FAILED_REASON = "node(s) didn't satisfy TPU predicates"
+
+
+def filter_payload(names: list[str], feasible_of,
+                   node_items: dict[str, dict] | None) -> dict[str, Any]:
+    """ExtenderFilterResult from a per-name feasibility callable.
+    `node_items` (name -> node dict) echoes full objects back in
+    non-cache-capable mode; None renders the nodenames shape."""
+    passed, failed = [], {}
+    for name in names:
+        if feasible_of(name):
+            passed.append(name)
+        else:
+            failed[name] = FAILED_REASON
+    if node_items is not None:
+        result: dict[str, Any] = {"nodes": {
+            "apiVersion": "v1", "kind": "NodeList",
+            "items": [node_items[n] for n in passed]}}
+    else:
+        result = {"nodenames": passed}
+    if failed:
+        result["failedNodes"] = failed
+    return result
+
+
+def priority_payload(names: list[str], score_of) -> list[dict[str, Any]]:
+    """HostPriorityList from a per-name score callable."""
+    return [{"host": name, "score": int(score_of(name))} for name in names]
+
+
 class ExtenderServer:
-    """Minimal asyncio HTTP/1.1 wrapper around ExtenderService."""
+    """Minimal asyncio HTTP/1.1 wrapper around ExtenderService.
+
+    Hardened like the reference treats its extenders: a configurable
+    per-request deadline (default 5s — DefaultExtenderTimeout,
+    extender.go:36) answered with 504 when evaluation overruns, and an
+    honest 429 + Retry-After when a fair-queue front end (solversvc)
+    sheds the request — `HTTPExtender` raises ExtenderError on either,
+    so the stock scheduler's per-pod retry/backoff semantics compose."""
 
     def __init__(self, service: ExtenderService, host: str = "127.0.0.1",
-                 port: int = 0):
+                 port: int = 0, deadline_s: float = 5.0):
         self.service = service
         self.host = host
         self.port = port
+        self.deadline_s = deadline_s
         self._server: asyncio.AbstractServer | None = None
         self._ready = False  # /readyz: true once warmup compiled
 
+    def _warm(self) -> None:
+        """Blocking pre-compile, run in an executor before serving
+        (subclasses override to warm their own programs)."""
+        self.service.warmup()
+
     async def start(self) -> None:
-        await asyncio.get_running_loop().run_in_executor(
-            None, self.service.warmup)
+        await asyncio.get_running_loop().run_in_executor(None, self._warm)
         self._ready = True
         self._server = await asyncio.start_server(self._handle, self.host, self.port)
         self.port = self._server.sockets[0].getsockname()[1]
@@ -258,9 +307,25 @@ class ExtenderServer:
                     writer.write(http_head(status, rbody, ctype))
                     await writer.drain()
                     return
-                status, payload = self._route(method, path, body)
+                extra: dict[str, str] = {}
+                try:
+                    routed = await asyncio.wait_for(
+                        self._route(method, path, body), self.deadline_s)
+                    status, payload = routed[0], routed[1]
+                    extra = routed[2] if len(routed) > 2 else {}
+                except asyncio.TimeoutError:
+                    status, payload = 504, {
+                        "error": f"request exceeded the "
+                                 f"{self.deadline_s:.0f}s deadline"}
+                except FlowRejected as e:
+                    # the fair queues shed this request: honest 429 with a
+                    # drain-time hint — HTTPExtender surfaces it and the
+                    # stock scheduler requeues the pod with backoff
+                    status, payload = 429, {"error": str(e)}
+                    extra = {"Retry-After": str(max(1, round(e.retry_after)))}
                 keep = headers.get("connection", "keep-alive").lower() != "close"
-                await self._respond(writer, status, payload, keep_alive=keep)
+                await self._respond(writer, status, payload, keep_alive=keep,
+                                    extra_headers=extra)
                 if not keep:
                     return
         except (asyncio.IncompleteReadError, ConnectionError):
@@ -268,7 +333,10 @@ class ExtenderServer:
         finally:
             writer.close()
 
-    def _route(self, method: str, path: str, body: bytes):
+    async def _route(self, method: str, path: str, body: bytes):
+        """-> (status, payload) or (status, payload, extra_headers). Verb
+        evaluation runs in an executor so the deadline can actually fire
+        and device compute never stalls the serving loop."""
         path = path.rstrip("/")
         if method == "GET" and path in ("", "/healthz"):
             return 200, {"ok": True}
@@ -281,23 +349,32 @@ class ExtenderServer:
         if not isinstance(args, dict):
             return 400, {"error": "request body must be a JSON object"}
         verb = path.rsplit("/", 1)[-1]
+        loop = asyncio.get_running_loop()
         if verb == "filter":
-            return 200, self.service.filter(args)
+            return 200, await loop.run_in_executor(
+                None, self.service.filter, args)
         if verb == "prioritize":
-            return 200, self.service.prioritize(args)
+            return 200, await loop.run_in_executor(
+                None, self.service.prioritize, args)
         if verb == "bind":
-            return 200, self.service.bind(args)
+            return 200, await loop.run_in_executor(
+                None, self.service.bind, args)
         return 404, {"error": f"unknown verb {verb!r}"}
 
     async def _respond(self, writer: asyncio.StreamWriter, status: int,
-                       payload, keep_alive: bool = False) -> None:
+                       payload, keep_alive: bool = False,
+                       extra_headers: dict[str, str] | None = None) -> None:
         body = json.dumps(payload).encode()
         reason = {200: "OK", 400: "Bad Request", 404: "Not Found",
-                  405: "Method Not Allowed"}.get(status, "Error")
+                  405: "Method Not Allowed", 429: "Too Many Requests",
+                  503: "Service Unavailable",
+                  504: "Gateway Timeout"}.get(status, "Error")
         conn = "keep-alive" if keep_alive else "close"
-        writer.write(
-            f"HTTP/1.1 {status} {reason}\r\n"
-            f"Content-Type: application/json\r\n"
-            f"Content-Length: {len(body)}\r\n"
-            f"Connection: {conn}\r\n\r\n".encode() + body)
+        head = [f"HTTP/1.1 {status} {reason}",
+                "Content-Type: application/json",
+                f"Content-Length: {len(body)}"]
+        for name, value in (extra_headers or {}).items():
+            head.append(f"{name}: {value}")
+        head.append(f"Connection: {conn}")
+        writer.write(("\r\n".join(head) + "\r\n\r\n").encode() + body)
         await writer.drain()

@@ -1,0 +1,40 @@
+"""Solver-as-a-service: one device program serving many control planes.
+
+The extender surface promoted from a per-cluster callout to a standing
+multi-tenant service (ROADMAP open item 2): N tenant control planes —
+stock Go kube-schedulers speaking the extender wire protocol, or native
+clients speaking the batch-solve endpoint — submit solve requests that a
+continuous batcher coalesces into ONE padded device batch per step,
+inference-serving style. Tenancy is enforced at ingestion
+(`tenancy.py`: tenant-prefixed names/label keys/universe ids + an
+injected tenant marker selector), fairness by APF seats
+(`apiserver/flowcontrol.py` reused with a dedicated solversvc priority
+level), and shapes by pow-2 pod buckets over persistent jit caches so a
+shifting tenant mix never recompiles.
+"""
+
+from kubernetes_tpu.solversvc.core import (
+    EvalVerdict,
+    SolverService,
+    SolveVerdict,
+    Tenant,
+)
+from kubernetes_tpu.solversvc.tenancy import (
+    TENANT_MARKER_LABEL,
+    namespace_node,
+    namespace_pod,
+    split_tenant,
+    tenant_prefix,
+)
+
+__all__ = [
+    "EvalVerdict",
+    "SolverService",
+    "SolveVerdict",
+    "Tenant",
+    "TENANT_MARKER_LABEL",
+    "namespace_node",
+    "namespace_pod",
+    "split_tenant",
+    "tenant_prefix",
+]

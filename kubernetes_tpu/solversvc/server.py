@@ -1,0 +1,137 @@
+"""SolverFrontend: the multi-tenant wire surface over one SolverService.
+
+Two protocols on one port:
+
+- **Stock extender wire protocol, per tenant** — an unmodified Go
+  kube-scheduler configured with ``urlPrefix:
+  http://svc:port/tenants/<name>`` POSTs the usual ExtenderArgs to
+  ``/tenants/<name>/{filter,prioritize,bind}`` and gets the usual
+  ExtenderFilterResult / HostPriorityList back. Payload shaping is the
+  SAME helpers the per-cluster `ExtenderServer` uses
+  (extender.server.filter_payload / priority_payload): one evaluation
+  path, one protocol rendering.
+- **Native batch-solve endpoint** — ``POST /tenants/<name>/solve``
+  with ``{"pods": [...], "bind": bool}``: the gang/preemption-capable
+  superset, plus ``/tenants/<name>/state`` for node/pod sync
+  (cache-capable tenants) and ``/tenants/<name>/register``.
+
+HTTP mechanics (deadline, 429 + Retry-After on FlowRejected, obs
+endpoints) are inherited from ExtenderServer — overload shed by the
+service's fair queues surfaces to stock HTTPExtender retry semantics
+unchanged.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+from typing import Any
+
+from kubernetes_tpu.extender.server import (
+    ExtenderServer,
+    filter_payload,
+    priority_payload,
+)
+from kubernetes_tpu.solversvc.core import SolverService
+
+log = logging.getLogger(__name__)
+
+
+class SolverFrontend(ExtenderServer):
+    """Asyncio HTTP front end for a SolverService (see module docstring)."""
+
+    def __init__(self, svc: SolverService, host: str = "127.0.0.1",
+                 port: int = 0, deadline_s: float = 5.0,
+                 warmup_buckets: tuple = (), auto_register: bool = False):
+        super().__init__(service=None, host=host, port=port,
+                         deadline_s=deadline_s)
+        self.svc = svc
+        self.warmup_buckets = tuple(warmup_buckets)
+        self.auto_register = auto_register
+
+    def _warm(self) -> None:
+        self.svc.warmup(self.warmup_buckets)
+
+    async def start(self) -> None:
+        await self.svc.start()
+        await super().start()
+
+    async def stop(self) -> None:
+        await super().stop()
+        await self.svc.stop()
+
+    async def _route(self, method: str, path: str, body: bytes):
+        path = path.rstrip("/")
+        if method == "GET" and path in ("", "/healthz"):
+            return 200, {"ok": True, "tenants": len(self.svc.tenants)}
+        parts = [p for p in path.split("/") if p]
+        if len(parts) != 3 or parts[0] != "tenants":
+            return 404, {"error": f"unknown path {path!r}"}
+        _, tenant, verb = parts
+        if method != "POST":
+            return 405, {"error": f"method {method} not allowed"}
+        try:
+            args = json.loads(body or b"{}")
+        except json.JSONDecodeError as e:
+            return 400, {"error": f"bad JSON: {e}"}
+        if not isinstance(args, dict):
+            return 400, {"error": "request body must be a JSON object"}
+        try:
+            if verb == "register":
+                self.svc.register_tenant(tenant)
+                return 200, {"ok": True}
+            if tenant not in self.svc.tenants:
+                if not self.auto_register:
+                    return 404, {"error": f"unknown tenant {tenant!r}"}
+                self.svc.register_tenant(tenant)
+            return await self._tenant_verb(tenant, verb, args)
+        except (ValueError, KeyError) as e:  # malformed args / bad tenant
+            return 400, {"error": f"{type(e).__name__}: {e}"}
+
+    async def _tenant_verb(self, tenant: str, verb: str,
+                           args: dict[str, Any]):
+        if verb in ("filter", "prioritize"):
+            pod = args.get("pod") or {}
+            node_items = None
+            if args.get("nodes") is not None:
+                items = args["nodes"].get("items") or []
+                node_items = {
+                    ((d.get("metadata") or {}).get("name", "")): d
+                    for d in items}
+                verdict = await self.svc.evaluate(tenant, pod, nodes=items)
+            else:
+                verdict = await self.svc.evaluate(
+                    tenant, pod, node_names=args.get("nodenames") or [])
+            if verb == "filter":
+                return 200, filter_payload(
+                    verdict.names,
+                    lambda n: verdict.feasible.get(n, False), node_items)
+            return 200, priority_payload(
+                verdict.names, lambda n: verdict.score.get(n, 0))
+        if verb == "bind":
+            err = self.svc.bind(tenant, args.get("PodName", ""),
+                                args.get("PodNamespace", "default"),
+                                args.get("Node", ""))
+            return 200, {"Error": err}
+        if verb == "solve":
+            verdict = await self.svc.solve(tenant, args.get("pods") or [],
+                                           bind=bool(args.get("bind")))
+            return 200, {"assignments": verdict.assignments,
+                         "bound": verdict.bound, "errors": verdict.errors}
+        if verb == "state":
+            synced = {"nodes": 0, "pods": 0, "removed": 0}
+            for nd in args.get("nodes") or []:
+                self.svc.upsert_node(tenant, nd)
+                synced["nodes"] += 1
+            for pd in args.get("pods") or []:
+                if self.svc.account_pod(tenant, pd):
+                    synced["pods"] += 1
+            for name in args.get("removeNodes") or []:
+                self.svc.remove_node(tenant, name)
+                synced["removed"] += 1
+            for ref in args.get("removePods") or []:
+                self.svc.forget_pod(tenant, ref.get("namespace", "default"),
+                                    ref.get("name", ""))
+                synced["removed"] += 1
+            return 200, synced
+        return 404, {"error": f"unknown verb {verb!r}"}

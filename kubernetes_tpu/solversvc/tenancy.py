@@ -1,0 +1,202 @@
+"""Tenant namespacing: isolation by construction, not by filtering.
+
+All tenants share ONE StateDB so the batcher can put every tenant's
+requests in the same padded device batch — so the isolation invariant
+("tenant A's nodes can never satisfy tenant B's pods") must hold at the
+*encoding* layer, where nodes and pods meet. Every identifier a
+predicate can match on is rewritten at ingestion to live in a
+per-tenant namespace:
+
+- object names and pod namespaces get a ``<tenant>/`` prefix ("/" is
+  illegal in DNS-1123 names, the same trick as the autoscaler's
+  ``~sim~`` rows — a prefixed name can never collide with any real
+  object, and tenant names reject "/" so the split is unambiguous);
+- label KEYS on nodes and pods, selector/affinity-expression keys,
+  taint and toleration keys are prefixed, so the interned universe ids
+  (selector_universe, req_universe, ...) are disjoint per tenant — two
+  tenants both saying ``disk=ssd`` intern different entries;
+- the WELL-KNOWN topology keys (hostname/zone/region) keep their key —
+  the zone slot and default spreading semantics must survive — and get
+  the prefix on the VALUE instead, so spread domains stay per-tenant;
+- pod namespaces (interpod-affinity scoping) and PVC claim names are
+  prefixed.
+
+Defense in depth: every node additionally carries the marker label
+``solversvc.ktpu.io/tenant: <tenant>`` and every pod an injected
+nodeSelector requiring it, so even if some future predicate matched on
+an un-namespaced identifier, MatchNodeSelector — the oldest predicate
+in the set — still pins assignments inside the tenant.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any
+
+from kubernetes_tpu.api.objects import Node, Pod
+
+TENANT_MARKER_LABEL = "solversvc.ktpu.io/tenant"
+
+# topology keys whose KEY must survive namespacing (zone-slot encoding and
+# default spreading key off them); their VALUES are prefixed instead
+TOPOLOGY_VALUE_KEYS = frozenset({
+    "kubernetes.io/hostname",
+    "failure-domain.beta.kubernetes.io/zone",
+    "failure-domain.beta.kubernetes.io/region",
+})
+
+_TENANT_RE = re.compile(r"^[a-z0-9]([a-z0-9.-]{0,61}[a-z0-9])?$")
+
+
+def check_tenant_name(tenant: str) -> str:
+    """Tenant names are DNS-1123-shaped and never contain "/" — the
+    prefix separator — so `split_tenant` is unambiguous."""
+    if not _TENANT_RE.match(tenant):
+        raise ValueError(f"invalid tenant name {tenant!r} "
+                         "(want DNS-1123 label/subdomain, no '/')")
+    return tenant
+
+
+def tenant_prefix(tenant: str, name: str) -> str:
+    return f"{tenant}/{name}"
+
+
+def split_tenant(name: str) -> tuple[str | None, str]:
+    """Inverse of `tenant_prefix`: (tenant, original) or (None, name)."""
+    tenant, sep, rest = name.partition("/")
+    return (tenant, rest) if sep else (None, name)
+
+
+def _ns_labels(tenant: str, labels: dict[str, str]) -> dict[str, str]:
+    out: dict[str, str] = {}
+    for k, v in (labels or {}).items():
+        if k in TOPOLOGY_VALUE_KEYS:
+            out[k] = tenant_prefix(tenant, v)
+        else:
+            out[tenant_prefix(tenant, k)] = v
+    return out
+
+
+def _ns_match_expressions(tenant: str, exprs: list[dict]) -> list[dict]:
+    out = []
+    for e in exprs or []:
+        e = dict(e)
+        key = e.get("key", "")
+        if key in TOPOLOGY_VALUE_KEYS:
+            e["values"] = [tenant_prefix(tenant, v)
+                           for v in e.get("values") or []]
+        else:
+            e["key"] = tenant_prefix(tenant, key)
+        out.append(e)
+    return out
+
+
+def _ns_label_selector(tenant: str, sel: dict) -> dict:
+    sel = dict(sel or {})
+    if sel.get("matchLabels"):
+        sel["matchLabels"] = {tenant_prefix(tenant, k): v
+                              for k, v in sel["matchLabels"].items()}
+    if sel.get("matchExpressions"):
+        sel["matchExpressions"] = _ns_match_expressions(
+            tenant, sel["matchExpressions"])
+    return sel
+
+
+def _ns_affinity(tenant: str, affinity: dict) -> dict:
+    """Rewrite a raw v1 Affinity dict in place-safe copy form."""
+    import copy
+
+    aff = copy.deepcopy(affinity or {})
+    na = aff.get("nodeAffinity") or {}
+    req = na.get("requiredDuringSchedulingIgnoredDuringExecution")
+    if req:
+        for term in req.get("nodeSelectorTerms") or []:
+            term["matchExpressions"] = _ns_match_expressions(
+                tenant, term.get("matchExpressions"))
+    for pref in na.get("preferredDuringSchedulingIgnoredDuringExecution") or []:
+        pterm = pref.get("preference") or {}
+        pterm["matchExpressions"] = _ns_match_expressions(
+            tenant, pterm.get("matchExpressions"))
+    for kind in ("podAffinity", "podAntiAffinity"):
+        pa = aff.get(kind) or {}
+        for term in pa.get("requiredDuringSchedulingIgnoredDuringExecution") or []:
+            _ns_pod_affinity_term(tenant, term)
+        for wt in pa.get("preferredDuringSchedulingIgnoredDuringExecution") or []:
+            _ns_pod_affinity_term(tenant, wt.get("podAffinityTerm") or {})
+    return aff
+
+
+def _ns_pod_affinity_term(tenant: str, term: dict) -> None:
+    if term.get("labelSelector") is not None:
+        term["labelSelector"] = _ns_label_selector(tenant,
+                                                   term["labelSelector"])
+    tk = term.get("topologyKey", "")
+    if tk and tk not in TOPOLOGY_VALUE_KEYS:
+        term["topologyKey"] = tenant_prefix(tenant, tk)
+    if term.get("namespaces"):
+        term["namespaces"] = [tenant_prefix(tenant, n)
+                              for n in term["namespaces"]]
+
+
+def namespace_node(tenant: str, node: dict | Node) -> Node:
+    """Rewrite one tenant node into the shared-StateDB namespace."""
+    check_tenant_name(tenant)
+    d = node.to_dict() if isinstance(node, Node) else dict(node)
+    import copy
+
+    d = copy.deepcopy(d)
+    meta = d.setdefault("metadata", {})
+    name = meta.get("name", "")
+    meta["name"] = tenant_prefix(tenant, name)
+    labels = _ns_labels(tenant, meta.get("labels") or {})
+    # hostname label tracks the (namespaced) node name; inject if absent
+    labels.setdefault("kubernetes.io/hostname", meta["name"])
+    labels[TENANT_MARKER_LABEL] = tenant
+    meta["labels"] = labels
+    spec = d.setdefault("spec", {})
+    if spec.get("taints"):
+        spec["taints"] = [
+            {**t, "key": tenant_prefix(tenant, t.get("key", ""))}
+            for t in spec["taints"]]
+    return Node.from_dict(d)
+
+
+def namespace_pod(tenant: str, pod: dict | Pod) -> Pod:
+    """Rewrite one tenant pod into the shared-StateDB namespace, including
+    the injected tenant-marker nodeSelector (assignment isolation via
+    MatchNodeSelector even if everything else failed)."""
+    check_tenant_name(tenant)
+    d = pod.to_dict() if isinstance(pod, Pod) else dict(pod)
+    import copy
+
+    d = copy.deepcopy(d)
+    meta = d.setdefault("metadata", {})
+    meta["name"] = tenant_prefix(tenant, meta.get("name", ""))
+    meta["namespace"] = tenant_prefix(tenant,
+                                      meta.get("namespace") or "default")
+    if meta.get("labels"):
+        meta["labels"] = {tenant_prefix(tenant, k): v
+                          for k, v in meta["labels"].items()}
+    spec = d.setdefault("spec", {})
+    selector = {}
+    for k, v in (spec.get("nodeSelector") or {}).items():
+        if k in TOPOLOGY_VALUE_KEYS:
+            selector[k] = tenant_prefix(tenant, v)
+        else:
+            selector[tenant_prefix(tenant, k)] = v
+    selector[TENANT_MARKER_LABEL] = tenant
+    spec["nodeSelector"] = selector
+    if spec.get("nodeName"):
+        spec["nodeName"] = tenant_prefix(tenant, spec["nodeName"])
+    if spec.get("tolerations"):
+        spec["tolerations"] = [
+            {**t, "key": tenant_prefix(tenant, t["key"])} if t.get("key")
+            else dict(t)
+            for t in spec["tolerations"]]
+    if spec.get("affinity"):
+        spec["affinity"] = _ns_affinity(tenant, spec["affinity"])
+    for vol in spec.get("volumes") or []:
+        pvc = vol.get("persistentVolumeClaim")
+        if pvc and pvc.get("claimName"):
+            pvc["claimName"] = tenant_prefix(tenant, pvc["claimName"])
+    return Pod.from_dict(d)

@@ -1155,3 +1155,49 @@ def defrag(nodes, assigned_pods, gang, quorum, candidates, max_moves):
                                candidates[:k]):
             return k
     return None
+
+
+def solversvc_tenant_mix(seed: int, tenants: int = 3,
+                         nodes_per_tenant: int = 6,
+                         pods_per_tenant: int = 10):
+    """Seeded per-tenant fixture for solver-service parity: each tenant
+    gets its own node list (deliberately REUSING node names across
+    tenants — the adversarial case the service must namespace apart) and
+    a pod list, shaped so priority scores are tie-free within a tenant.
+
+    The service shares ONE round-robin tie-break counter across a
+    mixed-tenant device batch (selectHost parity: rr advances once per
+    successful placement, whoever owns the pod). The exact per-tenant
+    oracle is therefore the serial scheduler started with `rr` offset by
+    the number of placements that preceded the tenant's pods in the
+    batch — set `SerialScheduler.rr` before calling `.schedule()`.
+
+    Returns {tenant_name: (nodes, pods)}, seeded and replayable."""
+    import random
+
+    rng = random.Random(seed)
+    mix = {}
+    for t in range(tenants):
+        nodes = []
+        # strictly distinct cpu capacities -> strictly ordered scores
+        cpus = rng.sample(range(4, 4 + 4 * nodes_per_tenant, 4),
+                          nodes_per_tenant)
+        for i, cpu in enumerate(cpus):
+            nodes.append(Node.from_dict({
+                "metadata": {"name": f"node-{i}"},
+                "status": {
+                    "capacity": {"cpu": str(cpu), "memory": f"{4 * cpu}Gi",
+                                 "pods": "110"},
+                    "allocatable": {"cpu": str(cpu),
+                                    "memory": f"{4 * cpu}Gi",
+                                    "pods": "110"}}}))
+        pods = []
+        for i in range(pods_per_tenant):
+            cpu_m = rng.choice([300, 500, 700, 900, 1100])
+            pods.append(Pod.from_dict({
+                "metadata": {"name": f"pod-{i}", "namespace": "default"},
+                "spec": {"containers": [{"name": "c", "resources": {
+                    "requests": {"cpu": f"{cpu_m}m",
+                                 "memory": f"{cpu_m}Mi"}}}]}}))
+        mix[f"tenant-{t}"] = (nodes, pods)
+    return mix

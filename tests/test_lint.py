@@ -595,6 +595,59 @@ def test_r6_flags_profiling_path_outside_debug_namespace():
     assert all("/debug/pprof" in f.message for f in found)
 
 
+def test_r6_flags_unprefixed_solversvc_family():
+    # the multi-tenant serving plane is one dashboard namespace: any
+    # family DEFINED under kubernetes_tpu/solversvc/ carries the
+    # solversvc_ prefix (a bare requests_total would collide with the
+    # apiserver's on federated scrapes)
+    src = (
+        "def metrics(r):\n"
+        "    bad = r.counter('requests_total', 'd', ('tenant',))\n"
+        "    bad_g = r.gauge('batch_occupancy', 'd')\n"
+        "    bad_h = r.histogram('solve_seconds', 'd')\n"
+        "    ok = r.counter('solversvc_requests_total', 'd')\n"
+        "    ok_g = r.gauge('solversvc_tenants', 'd')\n"
+    )
+    found = lint_source(src, relpath="kubernetes_tpu/solversvc/core.py",
+                        rules=R6)
+    svc = [f for f in found if "solversvc_ prefix" in f.message]
+    assert sorted(f.line for f in svc) == [2, 3, 4]
+
+
+def test_r6_solversvc_prefix_scoped_to_package():
+    # the same bare family elsewhere is legal (the apiserver owns its
+    # own namespaces); only definitions inside solversvc/ are gated
+    src = "def metrics(r):\n    r.gauge('batch_occupancy', 'd')\n"
+    assert lint_source(src, relpath="kubernetes_tpu/apiserver/x.py",
+                       rules=R6) == []
+    assert len(lint_source(src,
+                           relpath="kubernetes_tpu/solversvc/server.py",
+                           rules=R6)) == 1
+
+
+def test_r4_covers_solversvc_scope():
+    # the continuous batcher's window must be ManualClock-warpable and
+    # its coalescing order replayable: wall-clock and ambient rng are
+    # banned in the package, perf_counter (latency metrics) is not
+    src = (
+        "import random, time\n"
+        "def window_deadline():\n"
+        "    return time.time() + 0.005\n"
+        "def jitter():\n"
+        "    return random.random()\n"
+    )
+    found = lint_source(src, relpath="kubernetes_tpu/solversvc/core.py",
+                        rules=R4)
+    assert sorted(f.line for f in found) == [3, 5]
+    clean = (
+        "import time\n"
+        "def window_deadline(clock, window_s):\n"
+        "    return clock.now() + window_s, time.perf_counter()\n"
+    )
+    assert lint_source(clean, relpath="kubernetes_tpu/solversvc/core.py",
+                       rules=R4) == []
+
+
 def test_r6_whole_tree_clean():
     result = run_analysis(rules=R6, baseline={})
     assert result.findings == [], [str(f) for f in result.findings]

@@ -1,0 +1,424 @@
+"""Solver-as-a-service: tenancy, batching, fairness, and isolation.
+
+The adversarial cases here are the subsystem's reason to exist: two
+tenants registering IDENTICALLY-NAMED nodes and pods must share one
+padded device batch (one step) while never cross-matching, and a bind
+routed to the wrong tenant must be refused before it can touch a store.
+ManualClock drives the micro-batch window (R4: no wall-clock in the
+decision), so the window tests are exact, not sleep-and-hope.
+"""
+
+import asyncio
+import json
+import os
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from kubernetes_tpu.api.objects import Node, Pod
+from kubernetes_tpu.apiserver import ObjectStore
+from kubernetes_tpu.perf.fixtures import make_nodes, make_pods
+from kubernetes_tpu.solversvc import (
+    TENANT_MARKER_LABEL,
+    SolverService,
+    namespace_node,
+    namespace_pod,
+    split_tenant,
+    tenant_prefix,
+)
+from kubernetes_tpu.solversvc.core import _svc_metrics, _TenantUser
+from kubernetes_tpu.solversvc.server import SolverFrontend
+from kubernetes_tpu.solversvc.tenancy import check_tenant_name
+from kubernetes_tpu.state.layout import Capacities
+from kubernetes_tpu.testing.races import RaceDetector
+from kubernetes_tpu.utils.clock import ManualClock
+
+from tests.serial_reference import SerialScheduler, solversvc_tenant_mix
+
+CAPS = Capacities(num_nodes=32, batch_pods=16)
+
+
+def _steps() -> float:
+    return _svc_metrics()["steps"].labels().value
+
+
+def _post(url, payload, timeout=15.0):
+    """Blocking JSON POST -> (status, parsed body). Run via executor."""
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read()), dict(resp.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"{}"), dict(e.headers)
+
+
+# ---- tenancy: the namespacing layer itself ----
+
+
+def test_tenant_name_rejects_separator():
+    check_tenant_name("team-a")          # DNS-1123 ok
+    for bad in ("a/b", "", "UPPER", "-edge", "edge-"):
+        with pytest.raises(ValueError):
+            check_tenant_name(bad)
+
+
+def test_split_tenant_roundtrip():
+    assert split_tenant(tenant_prefix("blue", "node-3")) == ("blue", "node-3")
+    assert split_tenant("bare-name") == (None, "bare-name")
+
+
+def test_namespace_node_prefixes_and_marker():
+    node = Node.from_dict({
+        "metadata": {"name": "node-0",
+                     "labels": {"disk": "ssd",
+                                "failure-domain.beta.kubernetes.io/zone":
+                                    "zone-1"}},
+        "spec": {"taints": [{"key": "dedicated", "value": "x",
+                             "effect": "NoSchedule"}]},
+        "status": {"allocatable": {"cpu": "4", "memory": "8Gi",
+                                   "pods": "110"}}})
+    nsd = namespace_node("blue", node)
+    assert nsd.metadata.name == "blue/node-0"
+    labels = nsd.metadata.labels
+    # plain label: KEY prefixed; well-known topology key: VALUE prefixed
+    assert labels["blue/disk"] == "ssd"
+    assert labels["failure-domain.beta.kubernetes.io/zone"] == "blue/zone-1"
+    assert labels[TENANT_MARKER_LABEL] == "blue"
+    assert nsd.spec.taints[0].key == "blue/dedicated"
+
+
+def test_namespace_pod_selector_and_marker():
+    pod = Pod.from_dict({
+        "metadata": {"name": "web-1", "labels": {"app": "web"}},
+        "spec": {"nodeSelector": {"disk": "ssd",
+                                  "kubernetes.io/hostname": "node-0"}}})
+    nsp = namespace_pod("blue", pod)
+    assert nsp.metadata.name == "blue/web-1"
+    assert nsp.metadata.namespace == "blue/default"
+    assert nsp.metadata.labels == {"blue/app": "web"}
+    sel = nsp.spec.node_selector
+    assert sel["blue/disk"] == "ssd"
+    assert sel["kubernetes.io/hostname"] == "blue/node-0"
+    # the injected marker pins assignments in-tenant even if every other
+    # namespaced identifier somehow failed
+    assert sel[TENANT_MARKER_LABEL] == "blue"
+
+
+def test_two_tenants_same_labels_intern_disjoint_ids():
+    # both tenants say disk=ssd; the interned keys must differ
+    a = namespace_node("blue", {"metadata": {"name": "n",
+                                             "labels": {"disk": "ssd"}}})
+    b = namespace_node("red", {"metadata": {"name": "n",
+                                            "labels": {"disk": "ssd"}}})
+    assert "blue/disk" in a.metadata.labels
+    assert "red/disk" in b.metadata.labels
+    assert a.metadata.labels[TENANT_MARKER_LABEL] != \
+        b.metadata.labels[TENANT_MARKER_LABEL]
+
+
+# ---- adversarial isolation through one shared device batch ----
+
+
+def test_same_named_tenants_never_cross_match():
+    """blue and red register the SAME node names and solve the SAME pod
+    names in one coalesced step. red's nodes are too small for its pods:
+    red must come back unplaced — never on blue's identically-named
+    big nodes — and blue must bind exactly once per pod."""
+    async def run():
+        svc = SolverService(caps=CAPS, window_s=0.05)
+        blue_store = RaceDetector(ObjectStore())
+        red_store = RaceDetector(ObjectStore())
+        svc.register_tenant("blue", store=blue_store)
+        svc.register_tenant("red", store=red_store)
+        for nd in make_nodes(4, cpu="16", memory="64Gi"):
+            svc.upsert_node("blue", nd)
+        for nd in make_nodes(4, cpu="100m", memory="64Mi"):  # same names!
+            svc.upsert_node("red", nd)
+        pods = make_pods(4, cpu="2", memory="1Gi", name_prefix="job")
+        blue_store.create_many(list(pods))
+        red_store.create_many(list(pods))
+        await svc.start()
+        mx = _svc_metrics()
+        steps0, iso0 = _steps(), mx["isolation"].labels().value
+        try:
+            blue_v, red_v = await asyncio.gather(
+                svc.solve("blue", pods, bind=True),
+                svc.solve("red", pods, bind=True))
+        finally:
+            await svc.stop()
+        # one coalesced device step served both tenants
+        assert _steps() - steps0 == 1
+        assert mx["isolation"].labels().value == iso0
+        assert all(a is not None and a.startswith("node-")
+                   for a in blue_v.assignments), blue_v
+        assert all(blue_v.bound), blue_v
+        # red's pods fit nowhere IN RED — blue's big nodes with the same
+        # names must be invisible to them
+        assert red_v.assignments == [None] * 4, red_v
+        assert not any(red_v.bound)
+        assert blue_store.double_binds == 0
+        assert {k: v for k, v in blue_store.bind_counts.items()} == {
+            f"default/job-{i}": 1 for i in range(4)}
+        assert red_store.bind_counts == {}
+
+    asyncio.run(run())
+
+
+def test_wrong_tenant_bind_rejected_before_store():
+    svc = SolverService(caps=CAPS)
+    blue_store = RaceDetector(ObjectStore())
+    red_store = RaceDetector(ObjectStore())
+    svc.register_tenant("blue", store=blue_store)
+    svc.register_tenant("red", store=red_store)
+    for nd in make_nodes(2):
+        svc.upsert_node("blue", nd)
+    red_store.create(Pod.from_dict(
+        {"metadata": {"name": "p", "namespace": "default"},
+         "spec": {"containers": [{"name": "c"}]}}))
+    # red never registered node-0; a bind naming it must be refused
+    # WITHOUT touching red's store (no phantom Binding reaches a tenant)
+    err = svc.bind("red", "p", "default", "node-0")
+    assert "not registered" in err
+    assert red_store.bind_counts == {}
+    assert blue_store.bind_counts == {}
+
+
+# ---- the micro-batch window on the injected clock ----
+
+
+def test_window_waits_on_manual_clock():
+    """With a ManualClock the window NEVER elapses on its own: requests
+    park until the test advances time, then one step serves them all."""
+    async def run():
+        clock = ManualClock()
+        svc = SolverService(caps=CAPS, clock=clock, window_s=0.08)
+        svc.register_tenant("blue")
+        for nd in make_nodes(4):
+            svc.upsert_node("blue", nd)
+        await svc.start()
+        steps0 = _steps()
+        try:
+            f1 = asyncio.ensure_future(
+                svc.solve("blue", make_pods(2, name_prefix="a")))
+            f2 = asyncio.ensure_future(
+                svc.solve("blue", make_pods(2, name_prefix="b")))
+            await asyncio.sleep(0.05)  # many real poll intervals
+            assert not f1.done() and not f2.done()
+            assert _steps() == steps0
+            clock.advance(0.1)  # past the window — now it fires
+            v1, v2 = await asyncio.gather(f1, f2)
+        finally:
+            await svc.stop()
+        assert _steps() - steps0 == 1  # both coalesced into ONE step
+        assert all(v1.assignments) and all(v2.assignments)
+
+    asyncio.run(run())
+
+
+def test_full_pod_budget_fires_without_clock():
+    """The pod budget bypasses the window: once pending pods reach
+    batch_pods the step fires even though the clock never moves."""
+    async def run():
+        clock = ManualClock()
+        svc = SolverService(caps=Capacities(num_nodes=16, batch_pods=8),
+                            clock=clock, window_s=60.0)
+        svc.register_tenant("blue")
+        for nd in make_nodes(4):
+            svc.upsert_node("blue", nd)
+        await svc.start()
+        steps0 = _steps()
+        try:
+            v1, v2 = await asyncio.wait_for(asyncio.gather(
+                svc.solve("blue", make_pods(4, name_prefix="a")),
+                svc.solve("blue", make_pods(4, name_prefix="b"))), 30)
+        finally:
+            await svc.stop()
+        assert clock.now() == 0.0
+        assert _steps() - steps0 == 1
+        assert all(v1.assignments) and all(v2.assignments)
+
+    asyncio.run(run())
+
+
+# ---- wire hardening: honest 429 + Retry-After, 504 deadline ----
+
+
+def test_http_429_carries_retry_after():
+    """Seat starvation (another flow holds the only seat) must surface as
+    an honest 429 with a Retry-After hint — not a hang, not a 500."""
+    async def run():
+        svc = SolverService(caps=CAPS, total_seats=1, queue_wait_s=0.05)
+        svc.register_tenant("blue")
+        for nd in make_nodes(2):
+            svc.upsert_node("blue", nd)
+        front = SolverFrontend(svc)
+        await front.start()
+        loop = asyncio.get_running_loop()
+        hog = await svc.flow.acquire(_TenantUser("hog"), "solve", "solves",
+                                     width=1)
+        try:
+            status, body, headers = await loop.run_in_executor(
+                None, lambda: _post(
+                    front.url + "/tenants/blue/solve",
+                    {"pods": [p.to_dict()
+                              for p in make_pods(1, name_prefix="x")]}))
+        finally:
+            svc.flow.release(hog)
+            await front.stop()
+        assert status == 429, (status, body)
+        retry = {k.lower(): v for k, v in headers.items()}.get("retry-after")
+        assert retry is not None and int(retry) >= 1
+
+    asyncio.run(run())
+
+
+def test_http_504_when_window_outlives_deadline():
+    """A ManualClock that never advances stalls the batch window forever;
+    the front end's request deadline must answer 504, not hang."""
+    async def run():
+        svc = SolverService(caps=CAPS, clock=ManualClock(), window_s=30.0)
+        svc.register_tenant("blue")
+        nodes = make_nodes(2)
+        for nd in nodes:
+            svc.upsert_node("blue", nd)
+        front = SolverFrontend(svc, deadline_s=0.3)
+        await front.start()
+        loop = asyncio.get_running_loop()
+        try:
+            status, body, _ = await loop.run_in_executor(
+                None, lambda: _post(
+                    front.url + "/tenants/blue/filter",
+                    {"pod": make_pods(1)[0].to_dict(),
+                     "nodenames": [n.metadata.name for n in nodes]}))
+        finally:
+            await front.stop()
+        assert status == 504, (status, body)
+        assert "deadline" in body.get("error", "")
+
+    asyncio.run(run())
+
+
+# ---- shape buckets: warmup pre-compiles, traffic reuses ----
+
+
+def test_warmup_compiles_named_buckets_and_traffic_reuses_them():
+    svc = SolverService(caps=CAPS)
+    assert svc._eval_fns == {} and svc._solve_fns == {}
+    svc.warmup((4, 8))
+    assert set(svc._eval_fns) == {4, 8}
+    assert {b for b, _ in svc._solve_fns} == {4, 8}
+    # the compile registry names each bucket variant for attribution
+    from kubernetes_tpu.obs.profiling import COMPILES
+    assert "solversvc[evaluate,p4]" in COMPILES._variants
+    assert any(v.startswith("solversvc[solve,p8]+")
+               for v in COMPILES._variants)
+
+    async def run():
+        svc.register_tenant("blue")
+        for nd in make_nodes(4):
+            svc.upsert_node("blue", nd)
+        await svc.start()
+        keys_before = set(svc._solve_fns)
+        try:
+            # sizes 3 and 4 both land in the warmed p4 bucket: no new keys
+            v3 = await svc.solve("blue", make_pods(3, name_prefix="a"))
+            v4 = await svc.solve("blue", make_pods(4, name_prefix="b"))
+        finally:
+            await svc.stop()
+        assert set(svc._solve_fns) == keys_before
+        assert all(v3.assignments) and all(v4.assignments)
+
+    asyncio.run(run())
+
+
+def test_extender_service_warmup_warms_attached_solversvc():
+    from kubernetes_tpu.extender.server import ExtenderService
+
+    svc = SolverService(caps=CAPS)
+    ext = ExtenderService(caps=CAPS, solversvc=svc, solversvc_buckets=(4,))
+    assert svc._eval_fns == {}
+    ext.warmup()  # one call warms the per-cluster path AND the buckets
+    assert 4 in svc._eval_fns
+    assert {b for b, _ in svc._solve_fns} == {4}
+
+
+# ---- serial-oracle parity per tenant through a mixed batch ----
+
+
+def test_mixed_tenant_batch_matches_per_tenant_serial_oracle():
+    """Three tenants (deliberately reused node names) solved in ONE
+    coalesced device batch: each tenant's assignments must equal a
+    SerialScheduler run over that tenant's nodes alone. The oracle gets
+    the shared round-robin counter's offset (placements preceding the
+    tenant in the batch), so parity is exact even on score ties."""
+    mix = solversvc_tenant_mix(seed=2026, tenants=3, nodes_per_tenant=6,
+                               pods_per_tenant=10)
+    expected = {}
+    rr_offset = 0
+    for t, (nodes, pods) in mix.items():  # == batch submission order
+        oracle = SerialScheduler(nodes)
+        oracle.rr = rr_offset
+        expected[t] = oracle.schedule(pods)
+        rr_offset += sum(a is not None for a in expected[t])
+
+    async def run():
+        svc = SolverService(caps=Capacities(num_nodes=32, batch_pods=32),
+                            window_s=0.1)
+        for t, (nodes, _) in mix.items():
+            svc.register_tenant(t)
+            for nd in nodes:
+                svc.upsert_node(t, nd)
+        await svc.start()
+        steps0 = _steps()
+        try:
+            verdicts = await asyncio.gather(
+                *[svc.solve(t, pods) for t, (_, pods) in mix.items()])
+        finally:
+            await svc.stop()
+        assert _steps() - steps0 == 1  # 30 pods <= 32: one shared step
+        return dict(zip(mix, verdicts))
+
+    got = asyncio.run(run())
+    for t in mix:
+        assert got[t].assignments == expected[t], \
+            f"{t}: {got[t].assignments} != serial {expected[t]}"
+
+
+# ---- the bench gate itself runs in tier-1 ----
+
+
+def test_bench_solversvc_smoke_subprocess():
+    """bench[solver-svc] --smoke end to end in a subprocess: M=4 tenants
+    (one on the stock extender wire), RaceDetector armed, flood phase
+    live — the full acceptance drill at CI shape."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "BENCH_CONFIGS": "solver-svc",
+        "BENCH_SOLVERSVC_TENANTS": "4",
+        "BENCH_SOLVERSVC_NODES": "8",
+        "BENCH_SOLVERSVC_PODS": "16",
+        "BENCH_SOLVERSVC_BATCH_PODS": "32",
+        "BENCH_SOLVERSVC_FLOOD": "8",
+    })
+    proc = subprocess.run(
+        [sys.executable, "bench.py", "--smoke", "--with-race-detector"],
+        cwd=repo, env=env, capture_output=True, text=True, timeout=420)
+    assert proc.returncode == 0, proc.stderr[-4000:]
+    last = [ln for ln in proc.stdout.strip().splitlines() if ln][-1]
+    result = json.loads(last)
+    assert "error" not in result, result
+    extras = result["extras"]
+    assert extras["solversvc_isolation_violations"] == 0
+    assert extras["solversvc_racy_writes"] == 0
+    assert extras["solversvc_flood_requests"] > 0
+    assert extras["solversvc_agg_pods_per_sec"] > 0
+    assert extras["solversvc_agg_pods_per_sec"] >= \
+        extras["solversvc_solo_pods_per_sec"]
