@@ -195,10 +195,19 @@ def main() -> None:
         # cross-process rate cannot beat in-process — correctness gates
         # stay armed, the perf gate does not
         os.environ.setdefault("BENCH_MULTIPROC_GATE", "0")
+        os.environ.setdefault("BENCH_SOAK_NODES", "8")
+        os.environ.setdefault("BENCH_SOAK_TICKS", "36")
+        os.environ.setdefault("BENCH_SOAK_RATE", "1.5")
+        os.environ.setdefault("BENCH_SOAK_TICK_S", "0.02")
+        os.environ.setdefault("BENCH_SOAK_P99_MS", "0")  # CI: latency
+        # gate off (seconds-scale ticks make p99 meaningless on CPU);
+        # the exactly-once/race/stall/memory-ceiling gates stay armed
+        os.environ.setdefault("BENCH_SOAK_SNAPSHOT_EVERY", "150")
+        os.environ.setdefault("BENCH_SOAK_RSS_SLACK", "0.6")
         os.environ.setdefault(
             "BENCH_CONFIGS",
             "headline,gang,preemption,autoscaler,sharded,monitor,defrag,"
-            "solver-svc")
+            "solver-svc,soak")
         os.environ.setdefault("BENCH_TIMEOUT_S", "600")
     timeout = int(os.environ.get("BENCH_TIMEOUT_S", "1800"))
     signal.signal(signal.SIGALRM, _die_with_timeout)
@@ -872,6 +881,68 @@ def main() -> None:
                                 for k in defrag_tb0},
                 compile_totals=profiling.COMPILES.totals(),
                 wall_s=wall)
+
+    if "soak" in configs:
+        from kubernetes_tpu.scenario.soak import run_soak
+        from kubernetes_tpu.scenario.traces import TraceConfig
+
+        # day-in-the-life soak: a seeded trace tape (diurnal arrivals,
+        # Borg-shaped gangs/priorities/lifetimes, deletes, node
+        # flaps/drains/adds, watch faults) plays against the FULL control
+        # plane — scheduler + autoscaler + descheduler + monitor — under
+        # the RaceDetector + stall watchdog. Gates: every pod bound
+        # exactly once, zero racy writes, zero >100ms stalls, flat memory
+        # ceilings (RSS, WAL live records post-compaction, TSDB series,
+        # jit variants) and, when armed, scheduler e2e p99. Any breach is
+        # one-command reproducible from the printed replay seed;
+        # scenario/search.py shrinks it to a minimal tape
+        soak_nodes = int(os.environ.get("BENCH_SOAK_NODES", "15000"))
+        soak_ticks = int(os.environ.get("BENCH_SOAK_TICKS", "288"))
+        soak_seed = int(os.environ.get(
+            "KTPU_SCENARIO_SEED",
+            os.environ.get("BENCH_SOAK_SEED", "2026")))
+        soak_rate = float(os.environ.get(
+            "BENCH_SOAK_RATE", str(max(2.0, soak_nodes / 400))))
+        soak_tick_s = float(os.environ.get("BENCH_SOAK_TICK_S", "0.25"))
+        soak_p99 = float(os.environ.get("BENCH_SOAK_P99_MS", "2000"))
+        soak_snapshot = int(os.environ.get(
+            "BENCH_SOAK_SNAPSHOT_EVERY", "20000"))
+        soak_slack = float(os.environ.get("BENCH_SOAK_RSS_SLACK", "0.35"))
+        cfg = TraceConfig(
+            seed=soak_seed, ticks=soak_ticks, nodes=soak_nodes,
+            base_rate=soak_rate, flap_rate=0.05,
+            autoscale_max=max(2, soak_nodes // 8),
+            drain_every=max(2, soak_ticks // 6),
+            add_every=max(2, soak_ticks // 5),
+            watch_expire_ticks=(soak_ticks // 3,),
+            watcher_drop_ticks=(2 * soak_ticks // 3,))
+        r = run_soak(cfg, tick_seconds=soak_tick_s,
+                     snapshot_every=soak_snapshot, p99_bound_ms=soak_p99,
+                     rss_slack_frac=soak_slack)
+        print(f"bench[soak]: {r}", file=sys.stderr, flush=True)
+        extras["soak_seed"] = r.seed
+        extras["soak_pods"] = r.pods_submitted
+        extras["soak_bound"] = r.bound
+        extras["soak_events_applied"] = r.events_applied
+        extras["soak_p99_ms"] = round(r.p99_ms, 1)
+        extras["soak_rss_growth_pct"] = round(100 * r.rss_growth_frac, 1)
+        extras["soak_wal_compactions"] = r.compactions
+        extras["soak_wal_records"] = r.wal_records
+        extras["soak_tsdb_series"] = r.tsdb_series
+        extras["soak_jit_variants"] = r.jit_variants
+        extras["soak_scaleups"] = r.scaleups
+        extras["soak_desched_moves"] = r.desched_moves
+        extras["soak_node_flaps"] = r.node_flaps
+        extras["soak_faults_injected"] = r.faults_injected
+        extras["soak_violations"] = list(r.violations)
+        if r.violations:
+            RESULT["error"] = (f"soak gates breached (seed {r.seed}): "
+                               + "; ".join(r.violations))
+            # one-command repro: replay exactly this day
+            print(f"bench[soak]: replay with KTPU_SCENARIO_SEED={r.seed} "
+                  f"BENCH_CONFIGS=soak python bench.py"
+                  + (" --smoke" if smoke else ""),
+                  file=sys.stderr, flush=True)
 
     if "monitor" in configs:
         from kubernetes_tpu.perf.harness import run_monitor_bench

@@ -558,7 +558,7 @@ class BatchFlagsDiscipline:
 
 R4_SCOPES = ("kubernetes_tpu/ops/", "kubernetes_tpu/state/",
              "kubernetes_tpu/scheduler/", "kubernetes_tpu/descheduler/",
-             "kubernetes_tpu/solversvc/")
+             "kubernetes_tpu/solversvc/", "kubernetes_tpu/scenario/")
 R4_FILES = ("kubernetes_tpu/autoscaler/simulator.py",)
 
 AMBIENT_ENTROPY = {"uuid.uuid4", "uuid.uuid1", "os.urandom",

@@ -26,6 +26,14 @@ Handler = Callable[[WatchEvent], None]
 RELIST_BACKOFF_INITIAL = 0.05
 RELIST_BACKOFF_MAX = 5.0
 
+# During a relist's delta replay, yield the event loop every this many
+# dispatched synthetic events. A relist under churn (watch history expired
+# or the watcher dropped as a slow consumer) replays hundreds of
+# ADDED/MODIFIED events through every registered handler; doing that in
+# one callback holds the loop for 100ms+ — co-resident heartbeats,
+# schedulers, and stall watchdogs all read it as a control-plane stall.
+RELIST_YIELD_EVERY = 32
+
 _reflector_mx: dict[str, tuple] = {}
 
 
@@ -206,15 +214,31 @@ class Informer:
         t_list = time.monotonic()
         items, rv = self.store.list_with_version(self.kind)
         fresh = {(o.metadata.namespace, o.metadata.name): o for o in items}
-        # replay the delta between cache and fresh list as synthetic events
+        # replay the delta between cache and fresh list as synthetic
+        # events, yielding every RELIST_YIELD_EVERY dispatches so a big
+        # replay stays cooperative; the cache is updated incrementally so
+        # readers interleaved at a yield point see exactly the objects
+        # whose events have been dispatched so far
+        dispatched = 0
         for key, obj in fresh.items():
             old = self.cache.get(key)
             if old is None:
+                self.cache[key] = obj
                 self._dispatch(WatchEvent("ADDED", self.kind, obj, rv))
             elif old.metadata.resource_version != obj.metadata.resource_version:
+                self.cache[key] = obj
                 self._dispatch(WatchEvent("MODIFIED", self.kind, obj, rv))
+            else:
+                continue
+            dispatched += 1
+            if dispatched % RELIST_YIELD_EVERY == 0:
+                await asyncio.sleep(0)
         for key in list(self.cache.keys() - fresh.keys()):
-            self._dispatch(WatchEvent("DELETED", self.kind, self.cache[key], rv))
+            self._dispatch(WatchEvent("DELETED", self.kind,
+                                      self.cache.pop(key), rv))
+            dispatched += 1
+            if dispatched % RELIST_YIELD_EVERY == 0:
+                await asyncio.sleep(0)
         self.cache = dict(fresh)
         self._synced.set()
         self._relist_delay = self._backoff_initial  # healthy again

@@ -61,6 +61,27 @@ def _transfer_counters() -> dict:
     return out
 
 
+def freeze_drill_heap() -> None:
+    """Pre-drill GC hygiene shared by every stall-gated drill (chaos,
+    overload, rolling-restart, scenario soak): collect whatever earlier
+    configs left behind, then freeze the surviving heap out of the
+    collector's reach. A gen2 pass walking co-resident heaps (a previous
+    config's object graphs, jax caches) holds the GIL 50-220ms from
+    whichever thread trips the allocation threshold — long enough to
+    flake the 100ms loop-stall gate with a pause the drill's own loop
+    never caused. After the freeze, gen2 passes only walk what the drill
+    itself allocates (which IS control-plane behavior)."""
+    import gc
+    gc.collect()
+    gc.freeze()
+
+
+def thaw_drill_heap() -> None:
+    """Undo freeze_drill_heap once the stall-sensitive window is over."""
+    import gc
+    gc.unfreeze()
+
+
 async def _run(n_nodes: int, n_pods: int, caps: Capacities, policy: Policy,
                warmup_pods: int, node_kwargs: dict, pod_kwargs: dict,
                mesh=None, n_services: int = 0) -> ThroughputResult:
@@ -508,11 +529,7 @@ async def _run_chaos(n_nodes: int, n_pods: int, seed: int,
     from kubernetes_tpu.testing.faults import FaultPlane
     from kubernetes_tpu.testing.races import LoopStallWatchdog, RaceDetector
 
-    # same GC hygiene as run_overload: the stall contract measures this
-    # drill's loop holds, not a gen2 pass over earlier configs' heaps
-    import gc
-    gc.collect()
-    gc.freeze()
+    freeze_drill_heap()
 
     cap = {"cpu": "16", "memory": "32Gi", "pods": "110"}
     inner = ObjectStore(watch_window=max(1 << 16, 8 * (n_pods + n_nodes)))
@@ -603,7 +620,7 @@ async def _run_chaos(n_nodes: int, n_pods: int, seed: int,
     driver.cancel()
     sched.stop()
     cluster.stop()
-    gc.unfreeze()
+    thaw_drill_heap()
     stalls = watchdog.stop() if watchdog is not None else []
     double = sum(1 for v in plane.bind_counts.values() if v > 1)
     return ChaosResult(
@@ -988,15 +1005,7 @@ def run_overload(n_nodes: int = 64, n_pods: int = 256, seed: int = 2026,
     started = threading.Event()
     holder: dict = {}
 
-    # the zero->100ms-stall contract measures the control plane's OWN
-    # loop holds. A gen2 GC pass walking co-resident heaps (a previous
-    # bench config's object graphs, jax caches) holds the GIL 50-220ms
-    # from whichever thread trips the allocation threshold — freeze the
-    # pre-drill heap out of the collector so gen2 passes only walk what
-    # the drill itself allocates (which IS control-plane behavior)
-    import gc
-    gc.collect()
-    gc.freeze()
+    freeze_drill_heap()
 
     def serve() -> None:
         async def main():
@@ -1168,7 +1177,7 @@ def run_overload(n_nodes: int = 64, n_pods: int = 256, seed: int = 2026,
         flood_stop.set()
         holder["loop"].call_soon_threadsafe(holder["shutdown"].set)
         thread.join(timeout=15)
-        gc.unfreeze()
+        thaw_drill_heap()
     stalls = holder.get("stalls", [])
     result.loop_stalls = len(stalls)
     result.max_stall_ms = 1e3 * max(stalls, default=0.0)
@@ -1264,11 +1273,7 @@ def run_rolling_restart(n_nodes: int = 16, n_pods: int = 96,
         "sched-token": UserInfo("system:kube-scheduler",
                                 ("system:authenticated",))})
 
-    # same reasoning as run_overload: freeze the pre-drill heap so gen2 GC
-    # passes only walk what the drill itself allocates
-    import gc
-    gc.collect()
-    gc.freeze()
+    freeze_drill_heap()
 
     rs = ReplicaSet(server_store, n=replicas, watch_cache=True,
                     authenticator=auth).start()
@@ -1427,7 +1432,7 @@ def run_rolling_restart(n_nodes: int = 16, n_pods: int = 96,
         stalls = rs._call(watchdog_box["dog"].stop) \
             if watchdog_box else []
         rs.stop()
-        gc.unfreeze()
+        thaw_drill_heap()
     result.loop_stalls = len(stalls)
     result.max_stall_ms = 1e3 * max(stalls, default=0.0)
     return result

@@ -1,0 +1,24 @@
+"""Scenario plane: seeded day-in-the-life traces, the full-stack soak
+driver, and adversarial scenario search (ROADMAP item 5)."""
+
+from kubernetes_tpu.scenario.traces import (  # noqa: F401
+    EVENT_KINDS,
+    Event,
+    FaultShift,
+    FlapBurst,
+    GangWidthShift,
+    MUTATION_KINDS,
+    RateSpike,
+    Tape,
+    TraceConfig,
+    TraceEngine,
+    make_tape,
+    mutation_from_dict,
+    mutation_to_dict,
+)
+
+__all__ = [
+    "EVENT_KINDS", "Event", "FaultShift", "FlapBurst", "GangWidthShift",
+    "MUTATION_KINDS", "RateSpike", "Tape", "TraceConfig", "TraceEngine",
+    "make_tape", "mutation_from_dict", "mutation_to_dict",
+]

@@ -1,0 +1,299 @@
+"""Adversarial scenario search: mutate a trace toward gate violations,
+then delta-debug the failing tape to a minimal replayable artifact.
+
+The search half is property-based testing turned offensive: the property
+is "the control plane holds its gates" (exactly-once binds, zero racy
+writes, zero stalls, flat memory ceilings, p99 bound — soak.py's
+``violations``), and the generator walks TraceConfig mutation space
+(rate spikes, gang-width shifts, fault-timing shifts, flap bursts)
+uphill on soak.py's graded ``pressure`` signal until a gate breaks.
+
+The shrink half is classic ddmin (Zeller & Hildebrandt, TSE'02) over
+the event tape: first the minimal violating *prefix* (binary search),
+then chunk-removal minimization of the surviving events, then the
+minimal node count — every probe a full replay through the evaluator,
+every step counted, so tests can assert bounded convergence.
+
+Everything is driven by one ``evaluate(tape) -> (violations, pressure)``
+callable. The real one wraps :func:`~kubernetes_tpu.scenario.soak.
+run_soak` (:func:`soak_evaluator`); tests plug in cheap pure-tape
+predicates to pin the search/shrink mechanics deterministically.
+
+A found-and-shrunk scenario prints as a replay artifact:
+``KTPU_SCENARIO_SEED`` + the mutation stack as JSON + the minimal tape —
+one command reproduces the failure.
+"""
+
+from __future__ import annotations
+
+import json
+import random
+from dataclasses import dataclass, field
+
+from kubernetes_tpu.scenario.traces import (
+    FaultShift,
+    FlapBurst,
+    GangWidthShift,
+    RateSpike,
+    Tape,
+    TraceConfig,
+    make_tape,
+    mutation_to_dict,
+)
+
+
+def default_mutations(rng: random.Random, cfg: TraceConfig) -> list:
+    """The seeded mutation menu: one candidate of each family, drawn
+    from ``rng`` so a search replays from its seed."""
+    span = max(2, cfg.ticks // 8)
+    start = rng.randrange(max(1, cfg.ticks - span))
+    return [
+        RateSpike(start=start, end=start + span,
+                  mult=2.0 + 6.0 * rng.random()),
+        GangWidthShift(factor=1.5 + rng.random() * 2.0),
+        FaultShift(delta=rng.randrange(-span, span + 1)),
+        FlapBurst(tick=rng.randrange(cfg.ticks),
+                  count=1 + rng.randrange(4)),
+    ]
+
+
+@dataclass
+class ShrunkScenario:
+    """A minimal violating tape plus the bookkeeping tests assert on."""
+
+    tape: Tape
+    violations: list
+    steps: int                  # evaluator calls the shrink consumed
+    from_events: int            # tape size before shrinking
+    mutations: list = field(default_factory=list)
+
+    def artifact(self) -> str:
+        muts = json.dumps([mutation_to_dict(m) for m in self.mutations],
+                          separators=(",", ":"))
+        lines = [
+            "# ktpu scenario artifact — minimal failing tape",
+            f"# violations: {'; '.join(self.violations) or '(none)'}",
+            f"# replay: KTPU_SCENARIO_SEED={self.tape.config.seed} "
+            "python -m kubernetes_tpu.scenario.search --replay <this file>",
+            f"# KTPU_SCENARIO_SEED={self.tape.config.seed}",
+            f"# KTPU_SCENARIO_MUTATIONS={muts}",
+        ]
+        return "\n".join(lines) + "\n" + self.tape.to_text()
+
+
+@dataclass
+class SearchResult:
+    found: bool
+    evaluations: int
+    mutations: list
+    violations: list
+    pressure: float
+    shrunk: ShrunkScenario | None = None
+
+    def __str__(self) -> str:
+        if not self.found:
+            return (f"no violation in {self.evaluations} evaluations "
+                    f"(best pressure {self.pressure:.2f})")
+        sh = self.shrunk
+        return (f"violation after {self.evaluations} evaluations: "
+                f"{'; '.join(self.violations)} — shrunk "
+                f"{sh.from_events} -> {len(sh.tape.events)} events / "
+                f"{sh.tape.config.nodes} nodes in {sh.steps} steps")
+
+
+def _violates(evaluate, tape: Tape, counter: list) -> list:
+    counter[0] += 1
+    violations, _ = evaluate(tape)
+    return violations
+
+
+def shrink(tape: Tape, evaluate, *, keep_mutations=()) -> ShrunkScenario:
+    """Delta-debug a violating tape to a minimal one.
+
+    Three passes, all counted in ``steps``: (1) binary-search the
+    shortest violating event *prefix* (churn failures are usually
+    triggered by everything up to some straw — later events are noise);
+    (2) ddmin chunk removal over the surviving events; (3) binary-search
+    the minimal initial node count. If a probe stops violating, the
+    candidate is simply rejected — non-monotone evaluators cost extra
+    probes, never correctness."""
+    counter = [0]
+    events = list(tape.events)
+    from_events = len(events)
+
+    # pass 1: minimal violating prefix
+    lo, hi = 1, len(events)
+    while lo < hi:
+        mid = (lo + hi) // 2
+        if _violates(evaluate, tape.with_events(events[:mid]), counter):
+            hi = mid
+        else:
+            lo = mid + 1
+    if _violates(evaluate, tape.with_events(events[:lo]), counter):
+        events = events[:lo]
+    # else: non-monotone around the boundary — keep the full tape
+
+    # pass 2: ddmin chunk removal
+    n = 2
+    while len(events) >= 2:
+        chunk = (len(events) + n - 1) // n
+        reduced = False
+        for i in range(n):
+            cand = events[:i * chunk] + events[(i + 1) * chunk:]
+            if not cand:
+                continue
+            if _violates(evaluate, tape.with_events(cand), counter):
+                events = cand
+                n = max(2, n - 1)
+                reduced = True
+                break
+        if not reduced:
+            if n >= len(events):
+                break
+            n = min(len(events), 2 * n)
+
+    # pass 3: minimal node count
+    cur = tape.with_events(events)
+    lo, hi = 1, cur.config.nodes
+    while lo < hi:
+        mid = (lo + hi) // 2
+        if _violates(evaluate, cur.with_nodes(mid), counter):
+            hi = mid
+        else:
+            lo = mid + 1
+    cand = cur.with_nodes(lo)
+    if _violates(evaluate, cand, counter):
+        cur = cand
+
+    violations, _ = evaluate(cur)
+    counter[0] += 1
+    return ShrunkScenario(tape=cur, violations=violations,
+                          steps=counter[0], from_events=from_events,
+                          mutations=list(keep_mutations))
+
+
+class ScenarioSearch:
+    """Seeded greedy hill-climb over mutation stacks.
+
+    Each round draws the mutation menu from the search's own
+    ``random.Random(seed)`` stream, tries each candidate on top of the
+    current stack, and keeps the one that raises ``pressure`` the most.
+    The first tape whose ``violations`` is non-empty goes straight to
+    :func:`shrink`. Fully deterministic from ``seed``."""
+
+    def __init__(self, config: TraceConfig, evaluate, *, seed: int = 0,
+                 rounds: int = 8, do_shrink: bool = True):
+        self.config = config
+        self.evaluate = evaluate
+        self.seed = seed
+        self.rounds = rounds
+        self.do_shrink = do_shrink
+
+    def run(self) -> SearchResult:
+        rng = random.Random(self.seed)
+        evaluations = 0
+        stack: list = []
+
+        def ev(muts):
+            nonlocal evaluations
+            evaluations += 1
+            tape = make_tape(self.config, muts)
+            violations, pressure = self.evaluate(tape)
+            return tape, violations, pressure
+
+        tape, violations, best = ev(stack)
+        if not violations:
+            for _ in range(self.rounds):
+                gain = None
+                for m in default_mutations(rng, self.config):
+                    tape, violations, pressure = ev(stack + [m])
+                    if violations:
+                        stack = stack + [m]
+                        break
+                    if pressure > best and (gain is None
+                                            or pressure > gain[1]):
+                        gain = (m, pressure)
+                if violations:
+                    break
+                if gain is not None:
+                    stack = stack + [gain[0]]
+                    best = gain[1]
+        if not violations:
+            return SearchResult(found=False, evaluations=evaluations,
+                                mutations=stack, violations=[],
+                                pressure=best)
+        shrunk = None
+        if self.do_shrink:
+            shrunk = shrink(tape, self.evaluate, keep_mutations=stack)
+            evaluations += shrunk.steps
+        return SearchResult(found=True, evaluations=evaluations,
+                            mutations=stack, violations=violations,
+                            pressure=max(best, 1.0), shrunk=shrunk)
+
+
+def soak_evaluator(**soak_kwargs):
+    """The production evaluator: play the tape through the full control
+    plane (:func:`~kubernetes_tpu.scenario.soak.run_soak`) and return
+    its gate verdict. Every kwarg is forwarded (tick_seconds,
+    p99_bound_ms, ...), so the search probes exactly the bench's
+    configuration."""
+    from kubernetes_tpu.scenario.soak import run_soak
+
+    def evaluate(tape: Tape):
+        result = run_soak(tape=tape, **soak_kwargs)
+        return result.violations, result.pressure
+
+    return evaluate
+
+
+def main(argv=None) -> int:
+    import argparse
+    import sys
+
+    ap = argparse.ArgumentParser(
+        description="search trace-scenario space for gate violations, "
+        "or replay a shrunk artifact")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--rounds", type=int, default=4)
+    ap.add_argument("--nodes", type=int, default=8)
+    ap.add_argument("--ticks", type=int, default=32)
+    ap.add_argument("--rate", type=float, default=2.0)
+    ap.add_argument("--tick-seconds", type=float, default=0.02)
+    ap.add_argument("--p99-ms", type=float, default=0.0)
+    ap.add_argument("--replay", metavar="FILE",
+                    help="evaluate a saved tape artifact instead of "
+                    "searching")
+    ap.add_argument("--out", metavar="FILE",
+                    help="write the shrunk artifact here")
+    args = ap.parse_args(argv)
+
+    evaluate = soak_evaluator(tick_seconds=args.tick_seconds,
+                              p99_bound_ms=args.p99_ms)
+    if args.replay:
+        with open(args.replay) as f:
+            text = "".join(ln for ln in f if not ln.startswith("#"))
+        violations, pressure = evaluate(Tape.from_text(text))
+        print(f"pressure {pressure:.2f}; violations: "
+              f"{'; '.join(violations) or '(none)'}")
+        return 1 if violations else 0
+
+    cfg = TraceConfig(seed=args.seed, ticks=args.ticks, nodes=args.nodes,
+                      base_rate=args.rate, flap_rate=0.05,
+                      watch_expire_ticks=(args.ticks // 3,),
+                      watcher_drop_ticks=(2 * args.ticks // 3,))
+    result = ScenarioSearch(cfg, evaluate, seed=args.seed,
+                            rounds=args.rounds).run()
+    print(result)
+    if result.shrunk is not None:
+        artifact = result.shrunk.artifact()
+        if args.out:
+            with open(args.out, "w") as f:
+                f.write(artifact)
+            print(f"artifact -> {args.out}")
+        else:
+            sys.stdout.write(artifact)
+    return 1 if result.found else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,369 @@
+"""Seeded workload-trace engine: a compressed "day" as a typed event tape.
+
+The unit of scenario is a :class:`Tape` — a :class:`TraceConfig` header
+plus an ordered list of :class:`Event` rows — generated bit-identically
+from ``TraceConfig.seed``.  Two properties carry the whole design:
+
+* **Replayability** — every random draw comes from a ``random.Random``
+  child seeded from ``(seed, tick)``.  No ambient ``random`` module
+  state, no wall clock (ktpu-lint R4 scopes this package).
+* **Mutation locality** — because each tick owns its RNG stream, a
+  mutation that perturbs tick window ``[a, b)`` (a rate spike, a fault
+  shift) leaves every event whose *origin* tick falls outside the
+  window byte-identical.  The scenario search (search.py) leans on this:
+  it can stack mutations and still diff tapes event-by-event.
+
+Shapes follow the public cluster traces: diurnal sinusoid arrival
+intensity (Borg/Alibaba both show a ~2x day/night swing), heavy-tailed
+request sizes, exponential lifetimes with a long-running mass, and a
+three-tier priority mix (prod / batch / best-effort).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import math
+import random
+from dataclasses import dataclass, field, fields, replace
+
+# Event kinds -----------------------------------------------------------
+
+SUBMIT = "submit"            # one pod
+SUBMIT_GANG = "submit-gang"  # `width` pods under one gang annotation
+DELETE = "delete"            # job (or gang) reaches end of lifetime
+NODE_ADD = "node-add"        # operator adds a node (kubelet joins)
+NODE_DRAIN = "node-drain"    # node drained: pods evicted, node removed
+NODE_FLAP = "node-flap"      # heartbeat stops -> NotReady -> recovers
+WATCH_EXPIRE = "watch-expire"    # FaultPlane: compact watch history
+WATCHER_DROP = "watcher-drop"    # FaultPlane: sever live watchers
+
+EVENT_KINDS = (SUBMIT, SUBMIT_GANG, DELETE, NODE_ADD, NODE_DRAIN,
+               NODE_FLAP, WATCH_EXPIRE, WATCHER_DROP)
+
+_TICK_MIX = 2654435761  # Knuth multiplicative hash, keeps tick streams apart
+
+
+@dataclass(frozen=True)
+class Event:
+    """One row of the tape.  Serialises to a single stable text line."""
+
+    tick: int
+    kind: str
+    name: str
+    origin: int = 0       # tick whose RNG stream produced this event
+    cpu_m: int = 0        # millicores per pod
+    mem_mi: int = 0       # Mi per pod
+    width: int = 1        # gang width (1 for plain submits)
+    priority: int = 0     # numeric pod priority
+    lifetime: int = 0     # ticks until delete (0 = long-running)
+    down: int = 0         # node-flap: ticks until recovery
+
+    def to_line(self) -> str:
+        return (f"{self.tick} {self.kind} {self.name or '-'} "
+                f"origin={self.origin} cpu={self.cpu_m} mem={self.mem_mi} "
+                f"w={self.width} prio={self.priority} "
+                f"life={self.lifetime} down={self.down}")
+
+    @classmethod
+    def from_line(cls, line: str) -> "Event":
+        head, *kv = line.split()
+        tick, kind, name = int(head), kv[0], kv[1]
+        vals = dict(p.split("=", 1) for p in kv[2:])
+        return cls(tick=tick, kind=kind,
+                   name="" if name == "-" else name,
+                   origin=int(vals["origin"]), cpu_m=int(vals["cpu"]),
+                   mem_mi=int(vals["mem"]), width=int(vals["w"]),
+                   priority=int(vals["prio"]), lifetime=int(vals["life"]),
+                   down=int(vals["down"]))
+
+
+@dataclass(frozen=True)
+class TraceConfig:
+    """Everything the generator needs; the whole header is the seed."""
+
+    seed: int = 0
+    ticks: int = 96            # compressed day: 96 x 15-min slots
+    nodes: int = 16            # initial hollow-node fleet
+    node_cpu: str = "16"
+    node_memory: str = "32Gi"
+    autoscale_max: int = 4     # extra nodes the autoscaler may add
+    # arrival process
+    base_rate: float = 3.0     # mean submits per tick at the diurnal mean
+    diurnal_amplitude: float = 0.6
+    # job shapes (Borg-ish)
+    gang_fraction: float = 0.2
+    gang_widths: tuple = (2, 4, 8)
+    gang_width_weights: tuple = (4, 2, 1)
+    priority_mix: tuple = ((1000, 2), (100, 5), (0, 3))  # (prio, weight)
+    cpu_choices_m: tuple = (100, 250, 500, 1000, 2000)
+    cpu_weights: tuple = (40, 30, 15, 10, 5)
+    mean_lifetime_ticks: float = 12.0
+    long_running_frac: float = 0.05
+    # cluster churn
+    flap_rate: float = 0.0     # P(node flap) per tick
+    flap_down_ticks: int = 3
+    drain_every: int = 0       # 0 = never
+    add_every: int = 0
+    # FaultPlane timings
+    watch_expire_ticks: tuple = ()
+    watcher_drop_ticks: tuple = ()
+    # mutation surfaces (normally installed by Mutation.apply)
+    rate_spikes: tuple = ()    # ((start, end, mult), ...)
+    flap_bursts: tuple = ()    # ((tick, count), ...)
+
+    def to_dict(self) -> dict:
+        d = {}
+        for f in fields(self):
+            v = getattr(self, f.name)
+            d[f.name] = list(v) if isinstance(v, tuple) else v
+        return d
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "TraceConfig":
+        kw = {}
+        for f in fields(cls):
+            if f.name not in d:
+                continue
+            v = d[f.name]
+            if isinstance(v, list):
+                v = tuple(tuple(x) if isinstance(x, list) else x for x in v)
+            kw[f.name] = v
+        return cls(**kw)
+
+
+# Mutations -------------------------------------------------------------
+#
+# A mutation is a small frozen dataclass with ``apply(cfg) -> cfg`` and a
+# stable dict form, so a found scenario serialises as (seed, [mutations])
+# and replays from the artifact alone.
+
+
+@dataclass(frozen=True)
+class RateSpike:
+    """Multiply arrival intensity inside ``[start, end)``."""
+
+    start: int
+    end: int
+    mult: float = 4.0
+    kind: str = field(default="rate-spike", init=False)
+
+    def apply(self, cfg: TraceConfig) -> TraceConfig:
+        return replace(cfg, rate_spikes=cfg.rate_spikes
+                       + ((self.start, self.end, self.mult),))
+
+
+@dataclass(frozen=True)
+class GangWidthShift:
+    """Scale every gang width (and the gang fraction) by ``factor``."""
+
+    factor: float = 2.0
+    kind: str = field(default="gang-width-shift", init=False)
+
+    def apply(self, cfg: TraceConfig) -> TraceConfig:
+        widths = tuple(max(1, int(w * self.factor))
+                       for w in cfg.gang_widths)
+        frac = min(0.9, cfg.gang_fraction * max(1.0, self.factor / 2.0))
+        return replace(cfg, gang_widths=widths, gang_fraction=frac)
+
+
+@dataclass(frozen=True)
+class FaultShift:
+    """Slide the FaultPlane timings (watch expiry / watcher drops) by
+    ``delta`` ticks — fault-vs-load phase is a classic failure surface."""
+
+    delta: int
+    kind: str = field(default="fault-shift", init=False)
+
+    def apply(self, cfg: TraceConfig) -> TraceConfig:
+        hi = max(1, cfg.ticks - 1)
+
+        def sh(ts):
+            return tuple(min(hi, max(0, t + self.delta)) for t in ts)
+
+        return replace(cfg, watch_expire_ticks=sh(cfg.watch_expire_ticks),
+                       watcher_drop_ticks=sh(cfg.watcher_drop_ticks))
+
+
+@dataclass(frozen=True)
+class FlapBurst:
+    """Flap ``count`` extra nodes at ``tick`` (correlated failure)."""
+
+    tick: int
+    count: int = 2
+    kind: str = field(default="flap-burst", init=False)
+
+    def apply(self, cfg: TraceConfig) -> TraceConfig:
+        return replace(cfg, flap_bursts=cfg.flap_bursts
+                       + ((self.tick, self.count),))
+
+
+MUTATION_KINDS = {"rate-spike": RateSpike, "gang-width-shift": GangWidthShift,
+                  "fault-shift": FaultShift, "flap-burst": FlapBurst}
+
+
+def mutation_to_dict(m) -> dict:
+    d = {"kind": m.kind}
+    for f in fields(m):
+        if f.name != "kind":
+            d[f.name] = getattr(m, f.name)
+    return d
+
+
+def mutation_from_dict(d: dict):
+    cls = MUTATION_KINDS[d["kind"]]
+    return cls(**{k: v for k, v in d.items() if k != "kind"})
+
+
+# Tape ------------------------------------------------------------------
+
+
+@dataclass
+class Tape:
+    config: TraceConfig
+    events: list
+
+    def to_text(self) -> str:
+        header = json.dumps(self.config.to_dict(), sort_keys=True,
+                            separators=(",", ":"))
+        return "\n".join([header] + [e.to_line() for e in self.events]) + "\n"
+
+    @classmethod
+    def from_text(cls, text: str) -> "Tape":
+        lines = [ln for ln in text.splitlines() if ln.strip()]
+        cfg = TraceConfig.from_dict(json.loads(lines[0]))
+        return cls(cfg, [Event.from_line(ln) for ln in lines[1:]])
+
+    def checksum(self) -> str:
+        return hashlib.sha256(self.to_text().encode()).hexdigest()[:16]
+
+    def with_events(self, events) -> "Tape":
+        return Tape(self.config, list(events))
+
+    def with_nodes(self, nodes: int) -> "Tape":
+        return Tape(replace(self.config, nodes=nodes), list(self.events))
+
+    def counts(self) -> dict:
+        out: dict = {}
+        for e in self.events:
+            out[e.kind] = out.get(e.kind, 0) + 1
+        return out
+
+    def pods_submitted(self) -> int:
+        return sum(e.width if e.kind == SUBMIT_GANG else 1
+                   for e in self.events
+                   if e.kind in (SUBMIT, SUBMIT_GANG))
+
+
+# Generator -------------------------------------------------------------
+
+
+def _wchoice(rng: random.Random, items, weights):
+    total = sum(weights)
+    x = rng.random() * total
+    for item, w in zip(items, weights):
+        x -= w
+        if x < 0:
+            return item
+    return items[-1]
+
+
+def _poisson(rng: random.Random, lam: float) -> int:
+    if lam <= 0:
+        return 0
+    if lam > 60:  # Knuth underflows; normal approximation is fine here
+        return max(0, int(rng.gauss(lam, math.sqrt(lam)) + 0.5))
+    limit = math.exp(-lam)
+    k, p = 0, 1.0
+    while True:
+        p *= rng.random()
+        if p <= limit:
+            return k
+        k += 1
+
+
+class TraceEngine:
+    """Generates a :class:`Tape` from a config plus optional mutations."""
+
+    def __init__(self, config: TraceConfig | None = None, mutations=()):
+        cfg = config or TraceConfig()
+        for m in mutations:
+            cfg = m.apply(cfg)
+        self.config = cfg
+
+    def _tick_rng(self, tick: int) -> random.Random:
+        cfg = self.config
+        return random.Random((cfg.seed << 24) ^ ((tick * _TICK_MIX)
+                                                 & 0xFFFFFFFF))
+
+    def _rate_at(self, tick: int) -> float:
+        cfg = self.config
+        phase = 2.0 * math.pi * tick / max(1, cfg.ticks)
+        lam = cfg.base_rate * max(
+            0.0, 1.0 + cfg.diurnal_amplitude * math.sin(phase - math.pi / 2))
+        for start, end, mult in cfg.rate_spikes:
+            if start <= tick < end:
+                lam *= mult
+        return lam
+
+    def generate(self) -> Tape:
+        cfg = self.config
+        prios = [p for p, _ in cfg.priority_mix]
+        prio_w = [w for _, w in cfg.priority_mix]
+        events: list[Event] = []
+        pending_deletes: dict[int, list[Event]] = {}
+
+        for t in range(cfg.ticks):
+            # deletes scheduled by earlier ticks land first, in the order
+            # their submits drew them (deterministic)
+            events.extend(pending_deletes.pop(t, ()))
+            rng = self._tick_rng(t)
+            for i in range(_poisson(rng, self._rate_at(t))):
+                is_gang = rng.random() < cfg.gang_fraction
+                cpu = _wchoice(rng, cfg.cpu_choices_m, cfg.cpu_weights)
+                prio = _wchoice(rng, prios, prio_w)
+                if rng.random() < cfg.long_running_frac:
+                    life = 0
+                else:
+                    life = 1 + int(rng.expovariate(
+                        1.0 / max(0.5, cfg.mean_lifetime_ticks)))
+                if is_gang:
+                    width = _wchoice(rng, cfg.gang_widths,
+                                     cfg.gang_width_weights)
+                    ev = Event(t, SUBMIT_GANG, f"g{t}-{i}", origin=t,
+                               cpu_m=cpu, mem_mi=cpu, width=width,
+                               priority=prio, lifetime=life)
+                else:
+                    ev = Event(t, SUBMIT, f"j{t}-{i}", origin=t,
+                               cpu_m=cpu, mem_mi=cpu, priority=prio,
+                               lifetime=life)
+                events.append(ev)
+                if life and t + life < cfg.ticks:
+                    pending_deletes.setdefault(t + life, []).append(
+                        Event(t + life, DELETE, ev.name, origin=t,
+                              width=ev.width))
+            # node churn
+            flaps = 1 if rng.random() < cfg.flap_rate else 0
+            for btick, count in cfg.flap_bursts:
+                if btick == t:
+                    flaps += count
+            for _ in range(flaps):
+                events.append(Event(t, NODE_FLAP,
+                                    f"soak-{rng.randrange(cfg.nodes):05d}",
+                                    origin=t, down=cfg.flap_down_ticks))
+            if cfg.add_every and t and t % cfg.add_every == 0:
+                events.append(Event(t, NODE_ADD, f"soak-add-{t}", origin=t))
+            if cfg.drain_every and t and t % cfg.drain_every == 0:
+                events.append(Event(t, NODE_DRAIN,
+                                    f"soak-{rng.randrange(cfg.nodes):05d}",
+                                    origin=t))
+            # FaultPlane timings
+            if t in cfg.watch_expire_ticks:
+                events.append(Event(t, WATCH_EXPIRE, "", origin=t))
+            if t in cfg.watcher_drop_ticks:
+                events.append(Event(t, WATCHER_DROP, "", origin=t))
+        return Tape(cfg, events)
+
+
+def make_tape(config: TraceConfig | None = None, mutations=()) -> Tape:
+    return TraceEngine(config, mutations).generate()

@@ -490,6 +490,15 @@ class Scheduler:
         # informer handlers and encode, the staged dispatch thread reads
         # them in flush(), the commit thread scatters in commit_batch()
         self._state_lock = threading.RLock()
+        # informer events waiting for _state_lock: handlers run on the
+        # event loop, but the staged commit thread can hold the lock for
+        # the length of a ledger commit — a blocking acquire there would
+        # stall the whole loop (heartbeats, other informers, watchdogs)
+        # for that window. Contended events park here and replay in FIFO
+        # order once the lock frees (per-object ordering preserved: once
+        # anything is queued, everything queues behind it).
+        self._deferred_events: deque = deque()
+        self._drain_handle: asyncio.TimerHandle | None = None
         # deferred event buffer, (obj, type, reason, message): recording
         # is off the batch-critical path, coalesced per solved batch and
         # flushed when the loop next idles (the EventBroadcaster's
@@ -646,7 +655,59 @@ class Scheduler:
 
     # ---- informer handlers ----
 
+    # retry cadence for the deferred-event drain; short enough that a
+    # parked event lands within a tick of the commit thread releasing
+    # the lock, long enough not to busy-spin the loop against it
+    _DRAIN_RETRY_S = 0.002
+
     def _on_node_event(self, event: WatchEvent) -> None:
+        self._handle_locked(self._apply_node_event, event)
+
+    def _on_pod_event(self, event: WatchEvent) -> None:
+        self._handle_locked(self._apply_pod_event, event)
+
+    def _handle_locked(self, apply, event: WatchEvent) -> None:
+        """Run an informer-event application under _state_lock without
+        ever blocking the event loop on it: if the lock is contended
+        (commit thread mid-ledger-commit) or earlier events are already
+        parked, defer and drain in order once it frees."""
+        if self._deferred_events \
+                or not self._state_lock.acquire(blocking=False):
+            self._deferred_events.append((apply, event))
+            self._schedule_drain()
+            return
+        try:
+            apply(event)
+        finally:
+            self._state_lock.release()
+
+    def _schedule_drain(self) -> None:
+        if self._drain_handle is None:
+            self._drain_handle = asyncio.get_running_loop().call_later(
+                self._DRAIN_RETRY_S, self._drain_deferred)
+
+    def _drain_deferred(self) -> None:
+        self._drain_handle = None
+        # re-acquire per event so the commit/dispatch threads can
+        # interleave, and bound the work per loop callback — a long
+        # backlog drains across several callbacks instead of recreating
+        # the stall this path exists to avoid
+        budget = 256
+        while self._deferred_events and budget > 0:
+            if not self._state_lock.acquire(blocking=False):
+                break
+            try:
+                apply, event = self._deferred_events.popleft()
+                apply(event)
+            except Exception:  # noqa: BLE001 — parity with Informer._dispatch
+                log.exception("deferred informer event failed")
+            finally:
+                self._state_lock.release()
+            budget -= 1
+        if self._deferred_events:
+            self._schedule_drain()
+
+    def _apply_node_event(self, event: WatchEvent) -> None:
         node = event.obj
         with self._state_lock:
             if event.type == "DELETED":
@@ -684,7 +745,7 @@ class Scheduler:
                 if not pods:
                     del self._pods_by_node[prev]
 
-    def _on_pod_event(self, event: WatchEvent) -> None:
+    def _apply_pod_event(self, event: WatchEvent) -> None:
         pod: Pod = event.obj
         key = pod.key
         if event.type == "DELETED":
@@ -727,6 +788,13 @@ class Scheduler:
                 # over-capacity pods still enqueue: batch assembly re-raises
                 # and its per-pod failure path records the FailedScheduling
                 # event instead of wedging the informer handler
+                pass
+            except (Conflict, TooManyRequests):
+                # transient apiserver fault inside encode-on-watch (the
+                # encode context lists Services/workloads through the
+                # store): premake is only a cache warmer — the pod MUST
+                # still enqueue, or a throttled list silently drops it
+                # from scheduling forever; batch assembly re-encodes
                 pass
             # gang members wait in staging until their group reaches
             # quorum — the extender path is per-pod and cannot place a
@@ -961,6 +1029,18 @@ class Scheduler:
 
     def stop(self) -> None:
         self._stopped = True
+        if self._drain_handle is not None:
+            self._drain_handle.cancel()
+            self._drain_handle = None
+        # flush parked informer events with a blocking acquire — stop()
+        # may block, and state must reflect every delivered event
+        while self._deferred_events:
+            apply, event = self._deferred_events.popleft()
+            with self._state_lock:
+                try:
+                    apply(event)
+                except Exception:  # noqa: BLE001
+                    log.exception("deferred informer event failed in stop")
         if self._staged is not None:
             self._staged.drain_sync()
         self._settle_inflight()
@@ -990,6 +1070,10 @@ class Scheduler:
             self._event_shard.kill()
         self._loop_calls.clear()
         self._pending_events = []
+        if self._drain_handle is not None:
+            self._drain_handle.cancel()
+            self._drain_handle = None
+        self._deferred_events.clear()
         for entry in self._inflight_q:
             timer = entry[6]
             if getattr(timer, "trace_span", None) is not None:

@@ -70,6 +70,7 @@ class FaultStats:
     actions_fired: list = field(default_factory=list)
     floods: list = field(default_factory=list)     # noisy-tenant bursts
     replica_faults: list = field(default_factory=list)  # HA drill injuries
+    node_flaps: list = field(default_factory=list)  # node NotReady dips
 
     @property
     def injected_total(self) -> int:
@@ -118,6 +119,9 @@ class FaultPlane:
         # (testing.replicas.ReplicaSet hands out compatible handles), so
         # the seeded action schedule can injure a SPECIFIC replica
         self.replicas: dict[int, Any] = {}
+        # node-flap targets: hollow kubelets registered by attach_kubelet()
+        # so traces schedule node failures like they schedule watch drops
+        self.kubelets: dict[str, Any] = {}
 
     # ---- schedule-driven disruptions ----
 
@@ -139,6 +143,33 @@ class FaultPlane:
         informers must notice and relist)."""
         for watcher in list(self.inner._watchers):
             self.inner._evict_watcher(watcher)
+
+    # ---- node flaps (kubelet heartbeat dips) ----
+
+    def attach_kubelet(self, name: str, kubelet: Any) -> None:
+        """Register one node's agent (anything with a ``report_ready``
+        flag and a ``_heartbeat()`` — HollowKubelet's shape) under its
+        node name so scheduled actions and trace tapes can flap it."""
+        self.kubelets[name] = kubelet
+
+    def flap_node(self, name: str) -> None:
+        """Soft node failure: the kubelet keeps running but its next
+        heartbeats report NotReady (the node_controller flapping shape —
+        distinct from ``stop()``, which is silent death). The NotReady
+        condition is written synchronously so the flap lands at a
+        deterministic point of the replay, not a heartbeat-timer later."""
+        kubelet = self.kubelets[name]
+        kubelet.report_ready = False
+        kubelet._heartbeat()
+        self.stats.node_flaps.append({"node": name, "kind": "down"})
+
+    def recover_node(self, name: str) -> None:
+        """End a flap: heartbeats report Ready again, written
+        synchronously for the same replay-determinism reason."""
+        kubelet = self.kubelets[name]
+        kubelet.report_ready = True
+        kubelet._heartbeat()
+        self.stats.node_flaps.append({"node": name, "kind": "up"})
 
     # ---- per-replica targeting (HA drills) ----
 

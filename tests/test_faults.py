@@ -110,6 +110,35 @@ def test_scheduled_action_fires_once_at_op_count():
     assert plane.stats.actions_fired == ["boom"]
 
 
+def test_node_flap_lands_notready_then_recovers():
+    # the scenario plane's node-flap action: soft failure via the
+    # kubelet's own heartbeat (report_ready=False + synchronous beat), so
+    # the NotReady condition lands at a deterministic replay point —
+    # recover_node is the symmetric half, and both are in the stats tape
+    from kubernetes_tpu.agent.hollow import HollowKubelet
+
+    store = ObjectStore()
+    plane = FaultPlane(store, seed=0)
+    kubelet = HollowKubelet(plane, "flappy")
+    kubelet.register()
+    plane.attach_kubelet("flappy", kubelet)
+
+    def ready_status() -> str:
+        node = store.get("Node", "flappy", "default")
+        return next(c.status for c in node.status.conditions
+                    if c.type == "Ready")
+
+    assert ready_status() == "True"
+    plane.flap_node("flappy")
+    assert ready_status() == "False"
+    plane.recover_node("flappy")
+    assert ready_status() == "True"
+    assert plane.stats.node_flaps == [
+        {"node": "flappy", "kind": "down"},
+        {"node": "flappy", "kind": "up"},
+    ]
+
+
 def test_guaranteed_update_draws_injection_through_the_plane():
     _announce()
     store = ObjectStore()

@@ -437,6 +437,31 @@ def test_r4_scoped_to_solve_path_only():
                            rules=R4)) == 1
 
 
+def test_r4_covers_scenario_scope():
+    # the scenario plane's whole contract is replay-from-seed: a trace
+    # engine or soak driver reaching for ambient entropy or the wall
+    # clock breaks bit-identical tape replay
+    src = (
+        "import random, time\n"
+        "def arrivals():\n"
+        "    return random.random(), time.time()\n"
+    )
+    found = lint_source(src, relpath="kubernetes_tpu/scenario/traces.py",
+                        rules=R4)
+    assert sorted(f.line for f in found) == [3, 3]
+    assert all(f.rule == "nondeterminism" for f in found)
+    clean = (
+        "import random, time\n"
+        "class Engine:\n"
+        "    def __init__(self, seed):\n"
+        "        self._rng = random.Random(seed)\n"
+        "    def arrivals(self):\n"
+        "        return self._rng.random(), time.perf_counter()\n"
+    )
+    assert lint_source(clean, relpath="kubernetes_tpu/scenario/soak.py",
+                       rules=R4) == []
+
+
 def test_r4_covers_descheduler_scope():
     # the descheduler feeds the what-if solver: its victim ordering and
     # plan decisions must be as replayable as the scheduler's

@@ -1,0 +1,241 @@
+"""Scenario plane: trace determinism, mutation locality, search/shrink
+convergence, and the soak drill's gates (in-process and at bench shape).
+
+Replay contract under test: same seed -> byte-identical tape; a mutation
+perturbs only the events whose origin tick falls in its window; a found
+violation shrinks to a minimal tape in a bounded number of evaluator
+calls — deterministically, so a CI failure is a one-command replay.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from kubernetes_tpu.scenario.search import (
+    ScenarioSearch,
+    ShrunkScenario,
+    shrink,
+)
+from kubernetes_tpu.scenario.traces import (
+    Event,
+    FlapBurst,
+    GangWidthShift,
+    RateSpike,
+    Tape,
+    TraceConfig,
+    make_tape,
+    mutation_from_dict,
+    mutation_to_dict,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# trace engine: determinism + serialization
+
+
+def test_same_seed_is_byte_identical_different_seed_is_not():
+    cfg = TraceConfig(seed=7, ticks=48, nodes=8, flap_rate=0.05)
+    a, b = make_tape(cfg), make_tape(cfg)
+    assert a.to_text() == b.to_text()
+    assert a.checksum() == b.checksum()
+    c = make_tape(TraceConfig(seed=8, ticks=48, nodes=8, flap_rate=0.05))
+    assert a.to_text() != c.to_text()
+
+
+def test_tape_round_trips_through_text():
+    tape = make_tape(TraceConfig(seed=3, ticks=32, nodes=6, flap_rate=0.1,
+                                 drain_every=8, add_every=10,
+                                 watch_expire_ticks=(9,),
+                                 watcher_drop_ticks=(21,)))
+    back = Tape.from_text(tape.to_text())
+    assert back.to_text() == tape.to_text()
+    assert back.config == tape.config
+    assert back.events == tape.events
+
+
+def test_event_line_round_trip():
+    ev = Event(5, "submit-gang", "g1", origin=5, cpu_m=500, mem_mi=1024,
+               width=4, priority=100, lifetime=7)
+    assert Event.from_line(ev.to_line()) == ev
+
+
+def test_mutation_dict_round_trip():
+    for m in (RateSpike(start=4, end=9, mult=3.5),
+              GangWidthShift(factor=2.0), FlapBurst(tick=11, count=3)):
+        assert mutation_from_dict(mutation_to_dict(m)) == m
+
+
+def test_rate_spike_mutation_is_local_to_its_window():
+    cfg = TraceConfig(seed=11, ticks=64, nodes=8, flap_rate=0.05)
+    base = make_tape(cfg)
+    spiked = make_tape(cfg, [RateSpike(start=20, end=30, mult=6.0)])
+
+    def split(tape):
+        inside = [e for e in tape.events if 20 <= e.origin < 30]
+        outside = [e.to_line() for e in tape.events
+                   if not 20 <= e.origin < 30]
+        return inside, outside
+
+    base_in, base_out = split(base)
+    spiked_in, spiked_out = split(spiked)
+    # the spike multiplies arrivals inside its window...
+    assert len(spiked_in) > len(base_in)
+    # ...and leaves every event originating outside it byte-identical
+    # (per-tick child RNG streams: no cross-tick draw coupling)
+    assert spiked_out == base_out
+
+
+# ---------------------------------------------------------------------------
+# search + shrink (cheap pure-tape evaluator pins the mechanics)
+
+
+def _wide_gang_evaluator(tape):
+    """Violates when any gang is >= 12 wide — false on the base tape
+    (widths 2/4/8), true once GangWidthShift lands."""
+    widest = max((e.width for e in tape.events), default=0)
+    if widest >= 12:
+        return [f"gang width {widest} >= 12"], 2.0
+    return [], widest / 12.0
+
+
+def test_search_finds_seeded_violation_deterministically():
+    cfg = TraceConfig(seed=5, ticks=48, nodes=8, gang_fraction=0.4)
+
+    def run():
+        return ScenarioSearch(cfg, _wide_gang_evaluator, seed=5,
+                              rounds=6).run()
+
+    a, b = run(), run()
+    assert a.found and b.found
+    assert [m.kind for m in a.mutations] == [m.kind for m in b.mutations]
+    assert any(m.kind == "gang-width-shift" for m in a.mutations)
+    assert a.evaluations == b.evaluations
+    assert a.violations == b.violations
+    assert a.shrunk.tape.to_text() == b.shrunk.tape.to_text()
+
+
+def test_shrinker_reaches_minimal_tape_in_bounded_steps():
+    cfg = TraceConfig(seed=5, ticks=48, nodes=8, gang_fraction=0.4)
+    tape = make_tape(cfg, [GangWidthShift(factor=2.0)])
+    assert _wide_gang_evaluator(tape)[0]  # mutated tape does violate
+
+    sh = shrink(tape, _wide_gang_evaluator)
+    assert sh.violations
+    # minimal: one offending gang submit, and dropping it stops violating
+    assert len(sh.tape.events) == 1
+    assert sh.tape.events[0].width >= 12
+    assert sh.tape.config.nodes == 1
+    assert not _wide_gang_evaluator(sh.tape.with_events([]))[0]
+    # ddmin is O(log n) prefix + linear-ish chunk passes: a 48-tick tape
+    # must converge in well under 60 probes, and deterministically
+    assert sh.steps <= 60
+    assert sh.from_events == len(tape.events)
+    sh2 = shrink(tape, _wide_gang_evaluator)
+    assert sh2.steps == sh.steps
+    assert sh2.tape.to_text() == sh.tape.to_text()
+
+
+def test_artifact_round_trips_and_names_the_seed():
+    cfg = TraceConfig(seed=9, ticks=32, nodes=4, gang_fraction=0.5)
+    tape = make_tape(cfg, [GangWidthShift(factor=2.0)])
+    sh = shrink(tape, _wide_gang_evaluator,
+                keep_mutations=[GangWidthShift(factor=2.0)])
+    art = sh.artifact()
+    assert "KTPU_SCENARIO_SEED=9" in art
+    muts_line = next(ln for ln in art.splitlines()
+                     if ln.startswith("# KTPU_SCENARIO_MUTATIONS="))
+    muts = json.loads(muts_line.split("=", 1)[1])
+    assert [mutation_from_dict(m) for m in muts] == sh.mutations
+    body = "".join(ln + "\n" for ln in art.splitlines()
+                   if not ln.startswith("#"))
+    assert Tape.from_text(body).to_text() == sh.tape.to_text()
+
+
+def _is_shrunk(x):
+    return isinstance(x, ShrunkScenario)
+
+
+# ---------------------------------------------------------------------------
+# the soak drill itself
+
+
+def test_tiny_soak_day_holds_all_gates():
+    from kubernetes_tpu.scenario.soak import run_soak
+
+    cfg = TraceConfig(seed=42, ticks=8, nodes=4, base_rate=1.0,
+                      flap_rate=0.05)
+    r = run_soak(cfg, tick_seconds=0.02, snapshot_every=0,
+                 p99_bound_ms=0.0, rss_slack_frac=2.0)
+    assert r.violations == []
+    assert r.converged and r.pending_at_end == 0
+    assert r.double_binds == 0 and r.racy_writes == 0
+    assert r.loop_stalls == 0
+    assert r.bound > 0 and r.pods_submitted > 0
+    assert r.jit_variants <= 4  # the warmup's variant space, nothing more
+
+
+def test_bench_soak_smoke_subprocess():
+    """bench[soak] --smoke end to end: the compressed day at CI shape
+    with the RaceDetector armed — exactly-once binds, zero stalls, flat
+    ceilings, WAL compaction exercised."""
+    env = dict(os.environ)
+    env.update({"JAX_PLATFORMS": "cpu", "BENCH_CONFIGS": "soak"})
+    proc = subprocess.run(
+        [sys.executable, "bench.py", "--smoke", "--with-race-detector"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=420)
+    assert proc.returncode == 0, proc.stderr[-4000:]
+    last = [ln for ln in proc.stdout.strip().splitlines() if ln][-1]
+    result = json.loads(last)
+    assert "error" not in result, result
+    extras = result["extras"]
+    assert extras["soak_violations"] == []
+    assert extras["soak_bound"] > 0
+    assert extras["soak_wal_compactions"] >= 1  # compaction held under churn
+    assert extras["soak_jit_variants"] <= 4
+    assert extras["soak_events_applied"] > 0
+
+
+def test_bench_soak_breach_prints_replay_seed():
+    """Any gate breach must print the one-command replay recipe: an
+    impossible p99 bound forces the latency gate, and stderr must carry
+    KTPU_SCENARIO_SEED plus the seed that reproduces the day."""
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "BENCH_CONFIGS": "soak",
+        "BENCH_SOAK_TICKS": "6",
+        "BENCH_SOAK_NODES": "4",
+        "BENCH_SOAK_RATE": "1.0",
+        "BENCH_SOAK_P99_MS": "0.001",  # unmeetable: any real day breaches
+        "BENCH_SOAK_SEED": "777",
+    })
+    proc = subprocess.run(
+        [sys.executable, "bench.py", "--smoke", "--with-race-detector"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=420)
+    last = [ln for ln in proc.stdout.strip().splitlines() if ln][-1]
+    result = json.loads(last)
+    assert "error" in result
+    assert "seed 777" in result["error"]
+    assert "KTPU_SCENARIO_SEED=777" in proc.stderr
+    assert result["extras"]["soak_violations"]
+
+
+@pytest.mark.slow
+def test_full_soak_day_with_search_round():
+    """The uncompressed drill: a bigger day, then one search round over
+    it — slow tier only."""
+    from kubernetes_tpu.scenario.search import soak_evaluator
+
+    cfg = TraceConfig(seed=2026, ticks=96, nodes=16, base_rate=2.0,
+                      flap_rate=0.05, drain_every=16, add_every=20,
+                      watch_expire_ticks=(32,), watcher_drop_ticks=(64,))
+    evaluate = soak_evaluator(tick_seconds=0.05, p99_bound_ms=0.0,
+                              rss_slack_frac=0.6)
+    violations, pressure = evaluate(make_tape(cfg))
+    assert violations == []
+    assert pressure >= 0.0
