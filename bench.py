@@ -204,10 +204,12 @@ def main() -> None:
         # the exactly-once/race/stall/memory-ceiling gates stay armed
         os.environ.setdefault("BENCH_SOAK_SNAPSHOT_EVERY", "150")
         os.environ.setdefault("BENCH_SOAK_RSS_SLACK", "0.6")
+        os.environ.setdefault("BENCH_STOREHA_NODES", "8")
+        os.environ.setdefault("BENCH_STOREHA_PODS", "36")
         os.environ.setdefault(
             "BENCH_CONFIGS",
             "headline,gang,preemption,autoscaler,sharded,monitor,defrag,"
-            "solver-svc,soak")
+            "solver-svc,soak,store-ha")
         os.environ.setdefault("BENCH_TIMEOUT_S", "600")
     timeout = int(os.environ.get("BENCH_TIMEOUT_S", "1800"))
     signal.signal(signal.SIGALRM, _die_with_timeout)
@@ -219,7 +221,7 @@ def main() -> None:
         "BENCH_CONFIGS",
         "headline,interpod,spread,gang,preemption,recovery,chaos,overload,"
         "device,autoscaler,monitor,ha,fanout-xl,multiproc,defrag,"
-        "solver-svc")
+        "solver-svc,store-ha")
     configs = [c.strip() for c in configs.split(",") if c.strip()]
     metrics_snapshot = "--metrics-snapshot" in sys.argv[1:] or \
         os.environ.get("BENCH_METRICS_SNAPSHOT", "") in ("1", "true")
@@ -626,6 +628,80 @@ def main() -> None:
         elif race_detect and (r.racy_writes or r.loop_stalls):
             RESULT["error"] = (
                 f"ha drill under race detector (seed {r.seed}): "
+                f"{r.racy_writes} racy writes, {r.loop_stalls} event-loop "
+                f"stalls (max {r.max_stall_ms:.0f}ms)")
+
+    if "store-ha" in configs:
+        from kubernetes_tpu.perf.harness import run_store_ha
+
+        # store-HA (fenced failover) drill: BENCH_STOREHA_REPLICAS
+        # *replicated stores* (WAL-streamed hot standbys,
+        # apiserver/replication.py) serve a live scheduler + coherence
+        # witness while the PRIMARY store is killed mid-workload — the
+        # last SPOF the stateless `ha` drill can't touch — and later
+        # resurrected still believing it rules. Contract: a standby
+        # promotes under the lease and mints the next fencing epoch
+        # (p99 under BENCH_STOREHA_PROMOTION_P99_MS), every pod binds
+        # exactly once, ZERO writes are accepted under the stale epoch
+        # (the resurrected primary's first write comes back FencedWrite),
+        # and the witness rv stream stays gapless and duplicate-free
+        # across the failover
+        sha_nodes = int(os.environ.get("BENCH_STOREHA_NODES", "8"))
+        sha_pods = int(os.environ.get("BENCH_STOREHA_PODS", "48"))
+        sha_seed = int(os.environ.get("BENCH_STOREHA_SEED", "2031"))
+        sha_replicas = int(os.environ.get("BENCH_STOREHA_REPLICAS", "3"))
+        sha_p99_bound = float(
+            os.environ.get("BENCH_STOREHA_PROMOTION_P99_MS", "5000"))
+        race_detect = "--with-race-detector" in sys.argv[1:] or \
+            os.environ.get("BENCH_RACE_DETECTOR", "") in ("1", "true")
+        r = run_store_ha(sha_nodes, sha_pods, seed=sha_seed,
+                         replicas=sha_replicas, race_detect=race_detect)
+        print(f"bench[store-ha]: {r}", file=sys.stderr, flush=True)
+        extras["store_ha_replicas"] = r.replicas
+        extras["store_ha_promotions"] = r.promotions
+        extras["store_ha_promotion_p99_ms"] = round(r.promotion_p99_ms, 2)
+        extras["store_ha_epoch"] = r.epoch
+        extras["store_ha_fenced_rejections"] = r.fenced_rejections
+        extras["store_ha_fenced_leaks"] = r.fenced_leaks
+        extras["store_ha_records_streamed"] = r.records_streamed
+        extras["store_ha_snapshots_sent"] = r.snapshots_sent
+        extras["store_ha_snapshots_discarded"] = r.snapshots_discarded
+        extras["store_ha_watch_events"] = r.watch_events
+        extras["store_ha_watch_resumes"] = r.watch_resumes
+        extras["store_ha_seed"] = r.seed
+        if race_detect:
+            extras["store_ha_racy_writes"] = r.racy_writes
+            extras["store_ha_loop_stalls"] = r.loop_stalls
+            extras["store_ha_max_stall_ms"] = round(r.max_stall_ms, 1)
+        if not r.converged:
+            RESULT["error"] = (
+                f"store-ha drill did not converge (seed {r.seed}): "
+                f"{r.bound}/{r.pods} bound")
+        elif r.double_binds:
+            RESULT["error"] = (
+                f"store-ha drill (seed {r.seed}): {r.double_binds} pods "
+                f"bound more than once across the failover")
+        elif r.fenced_leaks or not r.stale_resurrect_fenced:
+            RESULT["error"] = (
+                f"store-ha drill (seed {r.seed}): fencing breached — "
+                f"{r.fenced_leaks} stale-epoch writes accepted "
+                f"(stale primary fenced: {r.stale_resurrect_fenced})")
+        elif r.promotions < 1:
+            RESULT["error"] = (
+                f"store-ha drill (seed {r.seed}): primary killed but no "
+                f"standby promoted")
+        elif r.watch_gaps or r.watch_dupes:
+            RESULT["error"] = (
+                f"store-ha drill watch incoherence (seed {r.seed}): "
+                f"{r.watch_gaps} gaps, {r.watch_dupes} duplicates across "
+                f"{r.watch_events} events")
+        elif r.promotion_p99_ms > sha_p99_bound:
+            RESULT["error"] = (
+                f"store-ha drill: promotion p99 {r.promotion_p99_ms:.1f}ms "
+                f"past the {sha_p99_bound:.0f}ms bound")
+        elif race_detect and (r.racy_writes or r.loop_stalls):
+            RESULT["error"] = (
+                f"store-ha drill under race detector (seed {r.seed}): "
                 f"{r.racy_writes} racy writes, {r.loop_stalls} event-loop "
                 f"stalls (max {r.max_stall_ms:.0f}ms)")
 

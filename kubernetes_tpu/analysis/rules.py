@@ -699,7 +699,14 @@ class SpanDiscipline:
     `kubernetes_tpu/solversvc/` carries the `solversvc_` prefix — the
     multi-tenant serving plane is one dashboard namespace, and a bare
     `requests_total` from the service would collide with (or hide
-    behind) the apiserver's families on every federated scrape."""
+    behind) the apiserver's families on every federated scrape.
+
+    Sixth check: replication-plane naming. Metric families DEFINED in
+    `kubernetes_tpu/apiserver/replication.py` carry the registered
+    `store_replication_` family prefix — failover dashboards and the
+    bench[store-ha] gate select on that namespace, and a bare
+    `promotions_total` would alias leader-election families from the
+    client package on the same scrape."""
 
     name = "span-discipline"
 
@@ -709,6 +716,7 @@ class SpanDiscipline:
         yield from self._check_rule_names(mod)
         yield from self._check_profiling_names(mod)
         yield from self._check_solversvc_names(mod)
+        yield from self._check_replication_names(mod)
 
     def _check_span_lifecycle(self, mod: Module):
         sanctioned: set[int] = set()
@@ -870,6 +878,27 @@ class SpanDiscipline:
                     "solversvc_ prefix — the multi-tenant serving plane "
                     "is one dashboard namespace and bare names collide "
                     "with the apiserver's families on federated scrapes")
+
+    def _check_replication_names(self, mod: Module):
+        if mod.relpath != "kubernetes_tpu/apiserver/replication.py":
+            return
+        for node in ast.walk(mod.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in ("counter", "gauge",
+                                           "histogram")):
+                continue
+            arg = node.args[0] if node.args else None
+            if isinstance(arg, ast.Constant) \
+                    and isinstance(arg.value, str) \
+                    and not arg.value.startswith("store_replication_"):
+                yield Finding(
+                    self.name, mod.relpath, node.lineno, node.col_offset,
+                    f"replication family {arg.value!r} must carry the "
+                    "registered store_replication_ prefix — failover "
+                    "dashboards and the bench[store-ha] gate select on "
+                    "that namespace, and bare names alias the client "
+                    "package's leader-election families")
 
 
 # ---------------------------------------------------------------------------

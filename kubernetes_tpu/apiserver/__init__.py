@@ -1,5 +1,6 @@
 from kubernetes_tpu.apiserver.store import (  # noqa: F401
     Conflict,
+    FencedWrite,
     NotFound,
     AlreadyExists,
     Expired,

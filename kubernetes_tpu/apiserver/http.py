@@ -56,6 +56,7 @@ from kubernetes_tpu.apiserver.store import (
     AlreadyExists,
     Conflict,
     Expired,
+    FencedWrite,
     NotFound,
     ObjectStore,
     TooManyRequests,
@@ -1397,6 +1398,15 @@ class APIServer:
                              "details": {"deleted": count,
                                          "terminating": terminating}}
             return 405, {"message": f"method {method} not allowed"}
+        except FencedWrite as e:
+            # replication fencing: this replica is a standby or a deposed
+            # primary. 409 with a distinct reason (not Conflict — nothing
+            # here is retryable against THIS endpoint) carrying the newer
+            # epoch and the current primary so the client can chase it
+            return 409, {"kind": "Status", "reason": "Fenced",
+                         "message": str(e),
+                         "details": {"epoch": e.epoch,
+                                     "endpoint": e.endpoint}}
         except NotFound as e:
             return 404, {"kind": "Status", "reason": "NotFound",
                          "message": str(e)}
@@ -1845,6 +1855,9 @@ class RemoteStore:
         # probe after a transport failure (it is the likeliest survivor,
         # so failover skips the dead-endpoint walk in the common case)
         self._last_good: int | None = None
+        # highest fencing epoch observed in any reply: replies from older
+        # epochs never resurrect a deposed primary as last-good
+        self._fenced_epoch = 0
         # per-connection I/O timeout: a black-holed replica (SYN accepted,
         # bytes never answered) must surface as an OSError and fail over
         # instead of hanging the caller forever. None = no bound (the
@@ -1908,7 +1921,9 @@ class RemoteStore:
         """Step off a failed replica: jump to the last-known-good
         endpoint first (one jump per failure episode — it answered most
         recently, so it shaves the dead-endpoint walk out of failover
-        p99), then round-robin the rest of the set."""
+        p99), then round-robin the rest of the set. A fenced reply with a
+        newer epoch clears `_last_good` before this runs (`_request`), so
+        a deposed primary never gets the preferred probe."""
         lg = self._last_good
         self._last_good = None  # one preferred probe per episode
         if lg is not None and lg != self._active \
@@ -1916,6 +1931,28 @@ class RemoteStore:
             self._active = lg
             return
         self._active = (self._active + 1) % len(self._endpoints)
+
+    # how long a write keeps chasing fenced replies before surfacing the
+    # verdict — covers a promotion in flight (lease expiry + epoch mint);
+    # drills shrink it along with the election timings
+    fenced_grace_s = 5.0
+
+    def _steer_to(self, endpoint: str) -> bool:
+        """Point the active endpoint at an advertised "host:port" (the
+        primary a fenced reply named), learning it if it isn't in the
+        configured set. False when there is nothing to steer to — empty
+        advertisement, unparseable, or the very endpoint that just
+        answered (a stale advertisement must not pin us in place)."""
+        host, _, port = endpoint.rpartition(":")
+        if not host or not port.isdigit():
+            return False
+        target = (host, int(port))
+        if target == self._endpoints[self._active]:
+            return False
+        if target not in self._endpoints:
+            self._endpoints.append(target)
+        self._active = self._endpoints.index(target)
+        return True
 
     def _ready(self, host: str, port: int,
                timeout: float = 0.5) -> bool:
@@ -2048,7 +2085,10 @@ class RemoteStore:
         attempts = 2 * len(self._endpoints) if len(self._endpoints) > 1 \
             else 1
         episode_start = None
-        for attempt in range(attempts):
+        attempt = 0
+        fenced_deadline = None
+        while True:
+            fenced = False
             try:
                 status, decoded, resp_headers = self._request_once(
                     method, path, body, content_type)
@@ -2056,20 +2096,54 @@ class RemoteStore:
                         and len(self._endpoints) > 1:
                     raise ConnectionError(
                         decoded.get("message", "HTTP 503"))
+                fenced = (status == 409
+                          and decoded.get("reason") == "Fenced")
+                if fenced:
+                    # replication fencing (apiserver/replication.py): this
+                    # endpoint is a standby or a deposed primary. A reply
+                    # carrying a newer epoch also deposes the cached
+                    # last-good endpoint — preferring it would hammer the
+                    # deposed primary for a full backoff cycle — and names
+                    # the current primary, so chase it directly.
+                    details = decoded.get("details") or {}
+                    epoch = int(details.get("epoch", 0) or 0)
+                    if epoch >= self._fenced_epoch:
+                        self._fenced_epoch = epoch
+                        self._last_good = None
+                    if len(self._endpoints) > 1:
+                        if episode_start is None:
+                            episode_start = _time.monotonic()
+                        if fenced_deadline is None:
+                            fenced_deadline = (_time.monotonic()
+                                               + self.fenced_grace_s)
+                        if _time.monotonic() < fenced_deadline:
+                            if not self._steer_to(
+                                    details.get("endpoint", "")):
+                                # no primary advertised yet (promotion in
+                                # flight): walk the set while the
+                                # election settles
+                                self._advance_endpoint()
+                                _time.sleep(  # ktpu: allow[blocking-in-async]
+                                    0.05)
+                            continue
+                    # single endpoint, or chase grace exhausted: surface
+                    # the fenced verdict to the caller below
             except (ConnectionError, TimeoutError, OSError):
-                if len(self._endpoints) <= 1 or attempt == attempts - 1:
+                attempt += 1
+                if len(self._endpoints) <= 1 or attempt >= attempts:
                     raise
                 if episode_start is None:
                     episode_start = _time.monotonic()
                 self._advance_endpoint()
                 continue
-            if episode_start is not None:
+            if episode_start is not None and not fenced:
                 # one failover episode = first failure -> next success,
                 # however many endpoints it walked (the drill's p99)
                 self.failover_total += 1
                 self.failover_samples.append(
                     1e3 * (_time.monotonic() - episode_start))
-            self._last_good = self._active
+            if not fenced:
+                self._last_good = self._active
             break
         if status == 400 and self._pb and body is not None \
                 and content_type is None:
@@ -2164,6 +2238,11 @@ class RemoteStore:
         if status == 409:
             if decoded.get("reason") == "AlreadyExists":
                 raise AlreadyExists(decoded.get("message", ""))
+            if decoded.get("reason") == "Fenced":
+                details = decoded.get("details") or {}
+                raise FencedWrite(decoded.get("message", "write fenced"),
+                                  epoch=int(details.get("epoch", 0) or 0),
+                                  endpoint=details.get("endpoint", ""))
             raise Conflict(decoded.get("message", ""))
         if status == 410:
             raise Expired(decoded.get("message", ""))

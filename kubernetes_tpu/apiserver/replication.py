@@ -1,0 +1,957 @@
+"""Replicated store: WAL-streamed hot standbys with fenced failover.
+
+The HA plane so far (PRs 12-14) made the *apiservers* stateless replicas
+— every one of them sat over ONE ObjectStore with one WAL: the last
+single point of failure. This module replicates the store itself, the
+in-process analog of etcd's raft log shipping
+(apiserver/pkg/storage/etcd3/store.go over mvcc/wal):
+
+- the **primary** streams every published WatchEvent as a WAL-shaped
+  record (the exact `{"op","rv","kind","ns","name","obj"}` line the
+  store's own log uses, plus the fencing epoch) to N **standbys** over
+  the existing TCP transport, via an `event_taps` hook — O(events), in
+  rv order, encoded once per event;
+- a new or lagging follower catches up from a **snapshot** first, in the
+  compaction framing (PR 7): one `SNAP{rv}` header, `OBJ` lines, an
+  `END{count}` trailer. A snapshot whose trailer never arrives (primary
+  died mid-catch-up) is DISCARDED wholesale and re-requested — a standby
+  never serves from a torn snapshot;
+- failover is **fenced**: a monotonically increasing epoch token is
+  minted at promotion (a CAS on the same Endpoints lock object the
+  `LeaderElector` lease rides), stamped on every replicated record and
+  checked on every write — a deposed primary returning from a GC pause
+  or partition gets `FencedWrite` instead of split-braining the fleet,
+  and the rejection carries the new primary's endpoint so clients chase;
+- promotion rides the existing `client/leaderelection.py` machinery: the
+  standby that wins the lease replays/verifies its own durable WAL
+  prefix (every applied record was re-logged locally), bumps the epoch,
+  installs the streaming tap, and advertises.
+
+All replicas share one resourceVersion sequence, so `watch(since=rv)` —
+and therefore `FailoverWatch`'s gapless `since=last_rv` resume — works
+unchanged against any replica.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import random
+import time
+from typing import Any, Callable
+
+from kubernetes_tpu.api.objects import Endpoints, ObjectMeta
+from kubernetes_tpu.apiserver.store import (
+    FencedWrite,
+    NotFound,
+    ObjectStore,
+    WatchEvent,
+)
+from kubernetes_tpu.client.leaderelection import LeaderElector
+
+log = logging.getLogger(__name__)
+
+# the promotion lock: LeaderElector lease record AND fencing-epoch ledger
+# live in the annotations of this one Endpoints object, so lease and
+# epoch move under the same CAS discipline
+REPLICATION_LOCK = "ktpu-store-primary"
+REPLICATION_LOCK_NS = "kube-system"
+EPOCH_ANNOTATION = "ktpu.io/fencing-epoch"
+ENDPOINT_ANNOTATION = "ktpu.io/primary-endpoint"
+REP_ENDPOINT_ANNOTATION = "ktpu.io/replication-endpoint"
+
+_mx = None
+
+
+def _metrics():
+    global _mx
+    if _mx is None:
+        from kubernetes_tpu.obs import REGISTRY
+
+        _mx = {
+            "records": REGISTRY.counter(
+                "store_replication_records_total",
+                "Replicated WAL records by outcome (streamed at the "
+                "primary, applied/rejected at a standby).",
+                labels=("result",)),
+            "snapshots": REGISTRY.counter(
+                "store_replication_snapshots_total",
+                "Catch-up snapshots by outcome (sent, applied, or "
+                "discarded because the END trailer never arrived).",
+                labels=("result",)),
+            "fenced": REGISTRY.counter(
+                "store_replication_fenced_writes_total",
+                "Writes rejected by the fencing guard (standby or "
+                "deposed-primary write attempts)."),
+            "promotions": REGISTRY.counter(
+                "store_replication_promotions_total",
+                "Standby-to-primary promotions (epoch mints)."),
+            "promotion_seconds": REGISTRY.histogram(
+                "store_replication_promotion_seconds",
+                "Primary-outage to promoted-and-serving latency.",
+                buckets=(0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0)),
+            "epoch": REGISTRY.gauge(
+                "store_replication_epoch",
+                "This process's highest observed fencing epoch."),
+            "followers": REGISTRY.gauge(
+                "store_replication_followers",
+                "Standby connections currently streamed by the primary."),
+        }
+    return _mx
+
+
+# ---------------------------------------------------------------------------
+# fencing ledger
+
+
+class FencingLedger:
+    """The fencing-token authority, backed by the coordination store (the
+    quorum the lease also lives in — in-process here, etcd's role in the
+    reference). `mint` is a CAS (`guaranteed_update`) on the lock
+    object's annotations, so epochs are strictly monotonic even under
+    racing promotions; `current` is the read every fencing check and
+    every follower re-resolve performs."""
+
+    def __init__(self, store, lock_name: str = REPLICATION_LOCK,
+                 lock_namespace: str = REPLICATION_LOCK_NS):
+        self.store = store
+        self.lock_name = lock_name
+        self.lock_namespace = lock_namespace
+
+    def current(self) -> tuple[int, str, str]:
+        """-> (epoch, primary apiserver endpoint, replication endpoint).
+        (0, "", "") before the first promotion. Raises ConnectionError
+        (et al.) when the quorum is unreachable — callers decide whether
+        that is fail-safe-reject (the write guard) or retry (a follower)."""
+        try:
+            obj = self.store.get("Endpoints", self.lock_name,
+                                 self.lock_namespace)
+        except NotFound:
+            return 0, "", ""
+        ann = obj.metadata.annotations or {}
+        return (int(ann.get(EPOCH_ANNOTATION, 0) or 0),
+                ann.get(ENDPOINT_ANNOTATION, ""),
+                ann.get(REP_ENDPOINT_ANNOTATION, ""))
+
+    def mint(self, endpoint: str, rep_endpoint: str) -> int:
+        """Bump the epoch and advertise `endpoint` as the new primary.
+        Returns the minted epoch."""
+        minted = 0
+
+        def bump(obj):
+            nonlocal minted
+            if obj.metadata.annotations is None:
+                obj.metadata.annotations = {}
+            ann = obj.metadata.annotations
+            minted = int(ann.get(EPOCH_ANNOTATION, 0) or 0) + 1
+            ann[EPOCH_ANNOTATION] = str(minted)
+            ann[ENDPOINT_ANNOTATION] = endpoint
+            ann[REP_ENDPOINT_ANNOTATION] = rep_endpoint
+            return obj
+
+        try:
+            self.store.guaranteed_update("Endpoints", self.lock_name,
+                                         self.lock_namespace, bump)
+        except NotFound:
+            # promotion before any election wrote the lock object (the
+            # bootstrap primary): create it carrying epoch 1
+            minted = 1
+            self.store.create(Endpoints(metadata=ObjectMeta(
+                name=self.lock_name, namespace=self.lock_namespace,
+                annotations={EPOCH_ANNOTATION: "1",
+                             ENDPOINT_ANNOTATION: endpoint,
+                             REP_ENDPOINT_ANNOTATION: rep_endpoint})))
+        return minted
+
+    def check(self, epoch: int) -> tuple[bool, int, str]:
+        """Fencing check for one write: does `epoch` still rule?
+        -> (ok, current epoch, current primary endpoint)."""
+        cur, endpoint, _rep = self.current()
+        return cur == epoch, cur, endpoint
+
+
+class CoordinationGate:
+    """A replica's view of the coordination store. Severing the gate
+    simulates a partition from the quorum: every verb raises
+    ConnectionError, which the elector counts as a failed attempt
+    (`_Unavailable`) and the fencing guard counts as cannot-verify —
+    fail-safe reject, never fail-open."""
+
+    def __init__(self, inner):
+        self._inner = inner
+        self.severed = False
+
+    def __getattr__(self, name: str):
+        attr = getattr(self._inner, name)
+        if not callable(attr):
+            if self.severed:
+                raise ConnectionError("partitioned from coordination quorum")
+            return attr
+
+        def call(*args, **kwargs):
+            if self.severed:
+                raise ConnectionError("partitioned from coordination quorum")
+            return attr(*args, **kwargs)
+
+        return call
+
+
+# ---------------------------------------------------------------------------
+# record framing (the WAL line, plus epoch + event type)
+
+
+def encode_record(event: WatchEvent, epoch: int) -> dict:
+    obj = event.obj
+    rec = {
+        "op": "DELETE" if event.type == "DELETED" else "PUT",
+        "type": event.type,
+        "rv": event.resource_version,
+        "kind": event.kind,
+        "ns": obj.metadata.namespace or "default",
+        "name": obj.metadata.name,
+        "epoch": epoch,
+        # included for DELETE too: the standby re-logs the record to its
+        # own WAL and fans the full object out to its local watchers
+        "obj": obj.to_dict(),
+    }
+    return rec
+
+
+def decode_record(rec: dict) -> WatchEvent:
+    from kubernetes_tpu.apiserver.http import decode_object
+
+    obj = decode_object(rec["kind"], rec["obj"])
+    rv = int(rec["rv"])
+    obj.metadata.resource_version = str(rv)
+    ev_type = rec.get("type") or (
+        "DELETED" if rec["op"] == "DELETE" else "MODIFIED")
+    return WatchEvent(ev_type, rec["kind"], obj, rv)
+
+
+# ---------------------------------------------------------------------------
+# the replicated store
+
+
+class ReplicatedStore(ObjectStore):
+    """An ObjectStore that participates in primary/standby replication.
+
+    Every mutating verb runs the fencing check first: a standby — or a
+    primary whose epoch token has been superseded, or one that cannot
+    reach the coordination quorum to verify it — raises `FencedWrite`
+    BEFORE any state is touched, so no resourceVersion is ever allocated
+    under a stale epoch. Reads and watches serve from any role (one
+    shared rv sequence; standbys may trail by in-flight records)."""
+
+    def __init__(self, *args, replica: str = "", **kwargs):
+        super().__init__(*args, **kwargs)
+        self.replica = replica
+        self.role = "standby"
+        self.epoch = 0                      # highest epoch this replica saw
+        self.known_primary: tuple[int, str] = (0, "")
+        # wired by StoreReplica: () -> (ok, cur_epoch, cur_endpoint);
+        # must not raise (quorum-unreachable returns ok=False)
+        self.verify_lease: Callable[[], tuple[bool, int, str]] | None = None
+        # wired by StoreReplica: (newer_epoch, endpoint) -> None, called
+        # synchronously inside a rejected write when the guard OBSERVES
+        # the newer epoch — schedules demote+rejoin, must not raise
+        self.on_deposed: Callable[[int, str], None] | None = None
+        self.fenced_writes = 0
+        self.replicated_applied = 0
+        # the epoch the LAST applied/published record was stamped with —
+        # advertised in HELLO so a primary can detect a dead-timeline
+        # suffix (records applied under an older epoch, beyond the rv the
+        # new timeline diverged at) and force a snapshot reset instead of
+        # tail-feeding an aliased rv range
+        self.applied_epoch = 0
+
+    # ---- fencing guard ----
+
+    def _fence_check(self) -> None:
+        if self.role == "primary":
+            if self.verify_lease is None:
+                return  # unmanaged store (unit tests drive roles directly)
+            ok, cur, endpoint = self.verify_lease()
+            if ok:
+                return
+            self.fenced_writes += 1
+            _metrics()["fenced"].inc()
+            if cur > self.epoch and self.on_deposed is not None:
+                self.on_deposed(cur, endpoint)
+            raise FencedWrite(
+                f"write fenced: replica {self.replica} holds epoch "
+                f"{self.epoch} but the ledger says {cur or 'unreachable'}",
+                epoch=cur, endpoint=endpoint)
+        epoch, endpoint = self.known_primary
+        self.fenced_writes += 1
+        _metrics()["fenced"].inc()
+        raise FencedWrite(
+            f"replica {self.replica} is a standby (primary epoch {epoch} "
+            f"at {endpoint or 'unknown'})", epoch=epoch, endpoint=endpoint)
+
+    def create(self, obj: Any, *, copy: bool = True) -> Any:
+        self._fence_check()
+        return super().create(obj, copy=copy)
+
+    def create_many(self, objs: list[Any]) -> list[Any]:
+        self._fence_check()
+        return super().create_many(objs)
+
+    def update(self, obj: Any, *, check_version: bool = True) -> Any:
+        self._fence_check()
+        return super().update(obj, check_version=check_version)
+
+    def delete(self, kind: str, name: str, namespace: str = "default") -> Any:
+        self._fence_check()
+        return super().delete(kind, name, namespace)
+
+    def bind(self, binding) -> Any:
+        self._fence_check()
+        return super().bind(binding)
+
+    def bind_many(self, bindings) -> tuple[list, list]:
+        self._fence_check()
+        return super().bind_many(bindings)
+
+    # ---- standby apply ----
+
+    def apply_replicated(self, event: WatchEvent, epoch: int = 0) -> None:
+        """Apply one replicated record on a standby: everything
+        `apply_external_event` does (bucket, rv clock, history, local
+        watcher fan-out) PLUS re-logging the record to this replica's OWN
+        WAL — the durable prefix a promoted standby vouches for."""
+        if self._wal is not None:
+            self._append_wal(event)
+        self.apply_external_event(event)
+        self.replicated_applied += 1
+        if epoch > self.applied_epoch:
+            self.applied_epoch = epoch
+
+    def reset_from_snapshot(self, objs: list[tuple[str, str, str, int, Any]],
+                            snap_rv: int, snap_epoch: int = 0) -> None:
+        """Install a validated catch-up snapshot wholesale: local state
+        (possibly a diverged or empty prefix) is discarded and replaced —
+        the pg_rewind analog. Local watchers are evicted (they relist);
+        the durable snapshot+WAL are rewritten to match via compact()."""
+        for watcher in list(self._watchers):
+            self._evict_watcher(watcher)
+        self._objects.clear()
+        self._history.clear()
+        self._cluster_ip_counter = 0
+        self._rv = snap_rv
+        for kind, ns, name, rv, obj in objs:
+            self._bucket(kind)[(ns, name)] = obj
+            if kind == "Service":
+                self._reserve_cluster_ip(obj.spec.get("clusterIP", ""))
+            self._rv = max(self._rv, rv)
+        if snap_epoch > self.applied_epoch:
+            self.applied_epoch = snap_epoch
+        if self._persist_path:
+            self.compact()
+
+    def replay_prefix(self) -> int:
+        """Promotion-time WAL replay: re-read this replica's own log and
+        verify the durable prefix against the in-memory clock (a crash-
+        restarted replica replays for real in __init__; the live path
+        re-reads to confirm nothing the primary streamed was lost before
+        the fsync barrier). Returns the verified record count."""
+        import os
+
+        if not self._persist_path:
+            return 0
+        if self._wal is not None:
+            self._wal.flush()
+            os.fsync(self._wal.fileno())
+        count = 0
+        max_rv = 0
+        if os.path.exists(self._persist_path):
+            with open(self._persist_path, encoding="utf-8",
+                      errors="replace") as f:
+                for line in f:
+                    try:
+                        rec = json.loads(line)
+                        max_rv = max(max_rv, int(rec.get("rv", 0)))
+                    except (ValueError, TypeError):
+                        continue
+                    count += 1
+        if max_rv > self._rv:
+            log.warning("%s: WAL prefix runs ahead of memory "
+                        "(rv %d > %d) — replay was incomplete",
+                        self.replica, max_rv, self._rv)
+        return count
+
+
+# ---------------------------------------------------------------------------
+# one replica's runtime: apiserver + replication stream + election
+
+
+class StoreReplica:
+    """One store replica: a `ReplicatedStore`, the APIServer over it, a
+    replication listener (streams the WAL to followers while primary), a
+    follower loop (chases the ledger's primary while standby), and a
+    `LeaderElector` candidacy whose win is the promotion path.
+
+    All async pieces run on the loop `start()` is awaited on (the
+    testing harness puts a whole replica set on one background loop)."""
+
+    def __init__(self, index: int, coord_store, *,
+                 host: str = "127.0.0.1",
+                 persist_path: str | None = None,
+                 watch_window: int = 4096,
+                 lock_name: str = REPLICATION_LOCK,
+                 lock_namespace: str = REPLICATION_LOCK_NS,
+                 lease_duration: float = 1.0,
+                 renew_deadline: float = 0.7,
+                 retry_period: float = 0.05,
+                 follower_queue: int = 8192,
+                 server_kwargs: dict | None = None):
+        self.index = index
+        self.identity = f"store-{index}"
+        self.host = host
+        self.coord = CoordinationGate(coord_store)
+        self.ledger = FencingLedger(self.coord, lock_name, lock_namespace)
+        self.lock_name = lock_name
+        self.lock_namespace = lock_namespace
+        self.lease_duration = lease_duration
+        self.renew_deadline = renew_deadline
+        self.retry_period = retry_period
+        self.follower_queue = follower_queue
+        self.server_kwargs = dict(server_kwargs or {})
+        self.store = ReplicatedStore(watch_window=watch_window,
+                                     persist_path=persist_path,
+                                     replica=self.identity)
+        self.store.verify_lease = self._verify_lease
+        self.store.on_deposed = self._deposed_from_guard
+        self.api = None                      # APIServer, built in start()
+        self.api_port = 0
+        self.rep_port = 0
+        self._rep_server = None
+        self._followers: dict[int, asyncio.Queue] = {}
+        self._follower_writers: dict[int, asyncio.StreamWriter] = {}
+        self._next_follower_id = 0
+        self._follow_task: asyncio.Task | None = None
+        self._follow_writer: asyncio.StreamWriter | None = None
+        self._elector_task: asyncio.Task | None = None
+        self._elector: LeaderElector | None = None
+        self._stopped = False
+        self.killed = False
+        self.partitioned = False
+        self.promoted_at = 0.0
+        # the rv this replica's timeline began ruling at: everything at or
+        # below it is the shared prefix every in-sync follower also holds
+        # (the old primary streamed in rv order from one source); anything
+        # ABOVE it applied under an older epoch is a dead-timeline suffix
+        self.promo_rv = 0
+        self.on_promoted: Callable[["StoreReplica"], None] | None = None
+        # drill knob: while primary, abort the follower connection after
+        # streaming this many snapshot OBJ lines (one-shot) — drives the
+        # torn-mid-catch-up coverage without killing the whole process
+        self.snapshot_fault_after = 0
+        # observability (per-replica mirrors of the registry families)
+        self.records_sent = 0
+        self.snapshots_sent = 0
+        self.snapshots_discarded = 0
+        self.catchups = 0
+
+    @property
+    def endpoint(self) -> str:
+        return f"{self.host}:{self.api_port}"
+
+    @property
+    def rep_endpoint(self) -> str:
+        return f"{self.host}:{self.rep_port}"
+
+    # ---- lifecycle ----
+
+    async def start(self, *, start_election: bool = True) -> None:
+        from kubernetes_tpu.apiserver.http import APIServer
+
+        self.api = APIServer(self.store, host=self.host, port=self.api_port,
+                             replica_id=self.identity, **self.server_kwargs)
+        await self.api.start()
+        self.api_port = self.api.port
+        self._rep_server = await asyncio.start_server(
+            self._serve_follower, self.host, self.rep_port)
+        self.rep_port = self._rep_server.sockets[0].getsockname()[1]
+        self.killed = False
+        if start_election:
+            self.start_election()
+
+    def start_election(self) -> None:
+        loop = asyncio.get_running_loop()
+        if self._follow_task is None or self._follow_task.done():
+            self._follow_task = loop.create_task(self._follow())
+        if self._elector_task is None or self._elector_task.done():
+            self._elector_task = loop.create_task(self._run_elector())
+
+    def kill(self) -> None:
+        """SIGKILL equivalent: apiserver, replication stream, and
+        candidacy all vanish — but local state AND beliefs (role, epoch)
+        freeze exactly as they were, so a later `resurrect()` models the
+        GC-pause return of a primary that never learned it was deposed."""
+        self.killed = True
+        for task in (self._elector_task, self._follow_task):
+            if task is not None:
+                task.cancel()
+        self._elector_task = self._follow_task = None
+        if self._elector is not None:
+            self._elector.stop()
+            self._elector = None
+        self._drop_followers()
+        if self._rep_server is not None:
+            self._rep_server.close()
+            self._rep_server = None
+        if self._follow_writer is not None:
+            try:
+                self._follow_writer.close()
+            except Exception:  # noqa: BLE001 — already torn down
+                pass
+            self._follow_writer = None
+        if self.api is not None:
+            self.api.kill()
+
+    async def resurrect(self) -> None:
+        """Bring a killed replica back believing whatever it believed:
+        the apiserver rebinds its old port over the SAME store, but the
+        candidacy does NOT restart — a resurrected stale primary must
+        learn of its deposition the hard way (first fenced write or
+        follower NACK), at which point `_deposed_from_guard` demotes it
+        and it rejoins as a standby. A replica that was a standby when
+        killed rejoins the quorum immediately."""
+        from kubernetes_tpu.apiserver.http import APIServer
+
+        self.api = APIServer(self.store, host=self.host, port=self.api_port,
+                             replica_id=self.identity, **self.server_kwargs)
+        await self.api.start()
+        self._rep_server = await asyncio.start_server(
+            self._serve_follower, self.host, self.rep_port)
+        self.killed = False
+        if self.store.role != "primary":
+            self.start_election()
+
+    def partition(self) -> None:
+        """Sever this replica from coordination quorum AND peers: lease
+        reads/renews fail (the elector loses leadership after
+        renew_deadline; the write guard fail-safe rejects immediately),
+        follower links drop both ways."""
+        self.partitioned = True
+        self.coord.severed = True
+        self._drop_followers()
+        if self._follow_writer is not None:
+            try:
+                self._follow_writer.close()
+            except Exception:  # noqa: BLE001 — already torn down
+                pass
+            self._follow_writer = None
+
+    def heal(self) -> None:
+        self.partitioned = False
+        self.coord.severed = False
+
+    async def stop(self) -> None:
+        self._stopped = True
+        self.kill()
+        if self.api is not None:
+            self.api.kill()
+
+    # ---- fencing plumbing ----
+
+    def _verify_lease(self) -> tuple[bool, int, str]:
+        try:
+            ok, cur, endpoint = self.ledger.check(self.store.epoch)
+        except Exception:  # noqa: BLE001 — quorum unreachable: fail safe
+            return False, 0, ""
+        return ok, cur, endpoint
+
+    def _deposed_from_guard(self, epoch: int, endpoint: str) -> None:
+        """A write (or a follower HELLO) just proved a newer epoch rules.
+        Demote synchronously — the very next write must see standby role —
+        and schedule the rejoin (follow + candidacy) onto the loop."""
+        log.warning("%s: deposed — epoch %d at %s supersedes %d",
+                    self.identity, epoch, endpoint, self.store.epoch)
+        self.store.role = "standby"
+        self.store.epoch = epoch
+        self.store.known_primary = (epoch, endpoint)
+        _metrics()["epoch"].set(epoch)
+        self._drop_followers()
+        if not self.killed and not self._stopped:
+            try:
+                asyncio.get_running_loop().call_soon(self.start_election)
+            except RuntimeError:  # no loop: direct-driven unit test
+                pass
+
+    # ---- election / promotion ----
+
+    async def _run_elector(self) -> None:
+        rng = random.Random(f"ktpu-store-elector-{self.index}")
+        while not self._stopped and not self.killed:
+            elector = LeaderElector(
+                self.coord, self.identity,
+                lock_name=self.lock_name, lock_namespace=self.lock_namespace,
+                lease_duration=self.lease_duration,
+                renew_deadline=self.renew_deadline,
+                retry_period=self.retry_period,
+                on_started_leading=self._lead, rng=rng)
+            self._elector = elector
+            try:
+                await elector.run()
+            except asyncio.CancelledError:
+                raise
+            except Exception:  # noqa: BLE001 — candidacy survives hiccups
+                log.exception("%s: elector crashed; recontending",
+                              self.identity)
+            if self.store.role == "primary":
+                # lease lost (partition, renew deadline): step down before
+                # anyone else can mint — CP behavior, never two writers
+                self._demote("lease lost")
+            await asyncio.sleep(self.retry_period)
+
+    async def _lead(self) -> None:
+        await self._promote()
+        # hold leadership while primary: returning stops the elector's
+        # renew loop (it treats finished work as done leading)
+        while not self._stopped and self.store.role == "primary":
+            await asyncio.sleep(self.retry_period)
+
+    async def _promote(self) -> None:
+        """The standby-to-primary transition: stop following, replay the
+        durable WAL prefix, mint the next epoch (the CAS also advertises
+        our endpoints), flip the role, start streaming."""
+        if self._follow_writer is not None:
+            try:
+                self._follow_writer.close()
+            except Exception:  # noqa: BLE001 — already torn down
+                pass
+            self._follow_writer = None
+        replayed = self.store.replay_prefix()
+        try:
+            epoch = self.ledger.mint(self.endpoint, self.rep_endpoint)
+        except Exception:  # noqa: BLE001 — quorum gone mid-promotion:
+            # surrender leadership, the elector loop recontends
+            log.warning("%s: epoch mint failed; abandoning promotion",
+                        self.identity)
+            self.store.role = "standby"
+            return
+        self.store.epoch = epoch
+        self.store.known_primary = (epoch, self.endpoint)
+        self.store.role = "primary"
+        self.promo_rv = self.store._rv
+        self.store.applied_epoch = epoch
+        self._install_tap()
+        m = _metrics()
+        m["promotions"].inc()
+        m["epoch"].set(epoch)
+        self.promoted_at = time.monotonic()
+        log.info("%s: promoted to primary, epoch %d (%d WAL records "
+                 "verified)", self.identity, epoch, replayed)
+        try:
+            self.api.advertise()
+        except Exception:  # noqa: BLE001 — discovery is best-effort; the
+            # fenced-response chase finds the primary without it
+            pass
+        if self.on_promoted is not None:
+            self.on_promoted(self)
+
+    def _demote(self, why: str) -> None:
+        log.warning("%s: demoted (%s)", self.identity, why)
+        self.store.role = "standby"
+        self._drop_followers()
+
+    # ---- primary side: the streaming tap + follower serving ----
+
+    def _install_tap(self) -> None:
+        if self._tap not in self.store.event_taps:
+            self.store.event_taps.append(self._tap)
+
+    def _tap(self, event: WatchEvent) -> None:
+        """Synchronous event tap on the primary store: encode once, fan
+        out to every follower queue. Never raises; a follower that cannot
+        keep up is dropped (it reconnects and snapshot-catches-up)."""
+        if self.store.role != "primary" or not self._followers:
+            return
+        try:
+            line = json.dumps(encode_record(event, self.store.epoch)) + "\n"
+        except Exception:  # noqa: BLE001 — taps must never raise
+            return
+        item = (event.resource_version, line)
+        for fid in list(self._followers):
+            try:
+                self._followers[fid].put_nowait(item)
+            except asyncio.QueueFull:
+                self._drop_follower(fid)
+            except KeyError:
+                pass
+        self.records_sent += 1
+        _metrics()["records"].labels("streamed").inc()
+
+    def _drop_follower(self, fid: int) -> None:
+        self._followers.pop(fid, None)
+        writer = self._follower_writers.pop(fid, None)
+        if writer is not None:
+            try:
+                writer.close()
+            except Exception:  # noqa: BLE001 — already torn down
+                pass
+        _metrics()["followers"].set(len(self._followers))
+
+    def _drop_followers(self) -> None:
+        for fid in list(self._followers):
+            self._drop_follower(fid)
+
+    async def _serve_follower(self, reader: asyncio.StreamReader,
+                              writer: asyncio.StreamWriter) -> None:
+        fid = None
+        try:
+            raw = await reader.readline()
+            if not raw:
+                return
+            hello = json.loads(raw)
+            hello_epoch = int(hello.get("epoch", 0) or 0)
+            if self.partitioned or self.store.role != "primary":
+                epoch, endpoint = self.store.known_primary
+                writer.write(json.dumps({
+                    "op": "NACK", "epoch": epoch,
+                    "endpoint": endpoint}).encode() + b"\n")
+                await writer.drain()
+                return
+            if hello_epoch > self.store.epoch:
+                # the follower has seen a future epoch: WE are the stale
+                # primary returning from a pause — fence ourselves now
+                writer.write(json.dumps({
+                    "op": "NACK", "epoch": hello_epoch,
+                    "endpoint": ""}).encode() + b"\n")
+                await writer.drain()
+                self._deposed_from_guard(hello_epoch, "")
+                return
+            # register the live queue BEFORE the catch-up so nothing
+            # published during it can slip between tail and stream
+            queue: asyncio.Queue = asyncio.Queue(self.follower_queue)
+            fid = self._next_follower_id
+            self._next_follower_id += 1
+            self._followers[fid] = queue
+            self._follower_writers[fid] = writer
+            _metrics()["followers"].set(len(self._followers))
+            writer.write(json.dumps({
+                "op": "EPOCH", "epoch": self.store.epoch,
+                "endpoint": self.endpoint}).encode() + b"\n")
+            sent_rv = await self._send_catchup(
+                writer, int(hello.get("have_rv", 0) or 0),
+                int(hello.get("applied_epoch", 0) or 0))
+            await writer.drain()
+            while not self._stopped:
+                rv, line = await queue.get()
+                if rv <= sent_rv:
+                    continue  # the catch-up already carried this record
+                writer.write(line.encode())
+                await writer.drain()
+        except (asyncio.CancelledError, GeneratorExit):
+            raise
+        except Exception:  # noqa: BLE001 — a follower connection dying is
+            # routine; it reconnects and re-requests
+            pass
+        finally:
+            if fid is not None:
+                self._drop_follower(fid)
+            try:
+                writer.close()
+            except Exception:  # noqa: BLE001 — already torn down
+                pass
+
+    async def _send_catchup(self, writer: asyncio.StreamWriter,
+                            have_rv: int, applied_epoch: int = 0) -> int:
+        """History tail when the follower's rv is still inside the ring
+        buffer AND its prefix is provably shared; full SNAP/OBJ/END
+        snapshot otherwise (fresh follower, lagging follower, one whose
+        prefix ran AHEAD of ours, or — the subtle case — a DIVERGED one:
+        its last records were applied under an older epoch at rvs beyond
+        our promotion point. The dead primary may have published records
+        that never reached the promoted standby; the new timeline reuses
+        those rv numbers for different content, so an rv-range check
+        alone would silently merge the two timelines. Such a follower is
+        reset wholesale, the pg_rewind move). Returns the rv the catch-up
+        covers through."""
+        st = self.store
+        oldest = (st._history[0].resource_version
+                  if st._history else st._rv + 1)
+        diverged = (applied_epoch and applied_epoch < st.epoch
+                    and have_rv > self.promo_rv)
+        if not diverged and oldest - 1 <= have_rv <= st._rv:
+            tail = [e for e in st._history if e.resource_version > have_rv]
+            for ev in tail:
+                writer.write((json.dumps(
+                    encode_record(ev, st.epoch)) + "\n").encode())
+            if tail:
+                self.records_sent += len(tail)
+                _metrics()["records"].labels("streamed").inc(len(tail))
+            return st._rv
+        snap_rv = st._rv
+        writer.write(json.dumps(
+            {"op": "SNAP", "rv": snap_rv,
+             "epoch": st.epoch}).encode() + b"\n")
+        count = 0
+        for kind, bucket in st._objects.items():
+            for (ns, name), obj in list(bucket.items()):
+                writer.write((json.dumps({
+                    "op": "OBJ", "kind": kind, "ns": ns, "name": name,
+                    "rv": int(obj.metadata.resource_version or 0),
+                    "obj": obj.to_dict()}) + "\n").encode())
+                count += 1
+                if count % 256 == 0:
+                    await writer.drain()
+                if self.snapshot_fault_after \
+                        and count >= self.snapshot_fault_after:
+                    # drill knob: die mid-catch-up, END never sent — the
+                    # follower must discard everything it buffered
+                    self.snapshot_fault_after = 0
+                    await writer.drain()
+                    raise ConnectionError("injected mid-snapshot fault")
+        writer.write(json.dumps(
+            {"op": "END", "count": count}).encode() + b"\n")
+        self.snapshots_sent += 1
+        _metrics()["snapshots"].labels("sent").inc()
+        return snap_rv
+
+    # ---- standby side: follow the ledger's primary ----
+
+    async def _follow(self) -> None:
+        st = self.store
+        while not self._stopped and not self.killed:
+            if st.role == "primary":
+                return
+            if self.partitioned:
+                await asyncio.sleep(self.retry_period)
+                continue
+            try:
+                epoch, endpoint, rep_endpoint = self.ledger.current()
+            except asyncio.CancelledError:
+                raise
+            except Exception:  # noqa: BLE001 — quorum hiccup: retry
+                await asyncio.sleep(self.retry_period)
+                continue
+            if epoch > st.epoch:
+                st.epoch = epoch
+                _metrics()["epoch"].set(epoch)
+            if epoch:
+                st.known_primary = (epoch, endpoint)
+            if not rep_endpoint or rep_endpoint == self.rep_endpoint:
+                await asyncio.sleep(self.retry_period)
+                continue
+            host, _, port = rep_endpoint.rpartition(":")
+            try:
+                reader, writer = await asyncio.open_connection(
+                    host, int(port))
+                self._follow_writer = writer
+                writer.write(json.dumps({
+                    "op": "HELLO", "have_rv": st._rv, "epoch": st.epoch,
+                    "applied_epoch": st.applied_epoch,
+                    "replica": self.identity}).encode() + b"\n")
+                await writer.drain()
+                await self._consume(reader)
+            except asyncio.CancelledError:
+                raise
+            except Exception:  # noqa: BLE001 — primary gone mid-stream:
+                # re-resolve from the ledger and reconnect
+                pass
+            finally:
+                if self._follow_writer is not None:
+                    try:
+                        self._follow_writer.close()
+                    except Exception:  # noqa: BLE001 — already torn down
+                        pass
+                    self._follow_writer = None
+            await asyncio.sleep(self.retry_period / 2)
+
+    async def _consume(self, reader: asyncio.StreamReader) -> None:
+        """Apply one replication stream. Snapshot frames are buffered and
+        applied ONLY when the END trailer validates the count — a stream
+        that dies mid-snapshot leaves local state untouched (discard and
+        re-request; never serve from a torn snapshot)."""
+        from kubernetes_tpu.apiserver.http import decode_object
+
+        st = self.store
+        m = _metrics()
+        snap_rv: int | None = None
+        snap_epoch = 0
+        snap_items: list[dict] = []
+        try:
+            while not self._stopped:
+                raw = await reader.readline()
+                if not raw:
+                    return  # connection ended (torn snapshot handled below)
+                rec = json.loads(raw)
+                op = rec.get("op")
+                if op == "NACK":
+                    epoch = int(rec.get("epoch", 0) or 0)
+                    if epoch > st.epoch:
+                        st.epoch = epoch
+                        st.known_primary = (epoch, rec.get("endpoint", ""))
+                    return
+                if op == "EPOCH":
+                    epoch = int(rec.get("epoch", 0) or 0)
+                    if epoch < st.epoch:
+                        return  # stale primary: drop it, re-resolve
+                    st.epoch = epoch
+                    st.known_primary = (epoch, rec.get("endpoint", ""))
+                    m["epoch"].set(epoch)
+                elif op == "SNAP":
+                    snap_rv = int(rec["rv"])
+                    snap_epoch = int(rec.get("epoch", 0) or 0)
+                    snap_items = []
+                elif op == "OBJ":
+                    if snap_rv is None:
+                        return  # OBJ outside a snapshot: broken frame
+                    snap_items.append(rec)
+                elif op == "END":
+                    if snap_rv is None:
+                        return
+                    if int(rec.get("count", -1)) != len(snap_items):
+                        self.snapshots_discarded += 1
+                        m["snapshots"].labels("discarded").inc()
+                        snap_rv, snap_items = None, []
+                        return  # short-counted frame: discard, re-request
+                    objs = []
+                    for item in snap_items:
+                        obj = decode_object(item["kind"], item["obj"])
+                        obj.metadata.resource_version = str(int(item["rv"]))
+                        objs.append((item["kind"], item["ns"], item["name"],
+                                     int(item["rv"]), obj))
+                    st.reset_from_snapshot(objs, snap_rv,
+                                           snap_epoch=snap_epoch)
+                    self.catchups += 1
+                    m["snapshots"].labels("applied").inc()
+                    snap_rv, snap_items = None, []
+                else:  # PUT / DELETE record
+                    if snap_rv is not None:
+                        # a data record inside an unterminated snapshot:
+                        # the frame broke — never apply any of it
+                        self.snapshots_discarded += 1
+                        m["snapshots"].labels("discarded").inc()
+                        snap_rv, snap_items = None, []
+                        return
+                    rec_epoch = int(rec.get("epoch", 0) or 0)
+                    if rec_epoch < st.epoch:
+                        m["records"].labels("rejected").inc()
+                        return  # stale-epoch record: drop the stream
+                    ev = decode_record(rec)
+                    if ev.resource_version <= st._rv:
+                        continue  # overlap with the catch-up: dedup by rv
+                    st.apply_replicated(ev, epoch=rec_epoch)
+                    m["records"].labels("applied").inc()
+        finally:
+            if snap_rv is not None:
+                # the primary died before the END trailer arrived: the
+                # buffered partial snapshot is DISCARDED — local state was
+                # never touched, and the reconnect re-requests in full
+                self.snapshots_discarded += 1
+                m["snapshots"].labels("discarded").inc()
+
+    # ---- helpers ----
+
+    async def wait_rv(self, rv: int, timeout: float = 10.0) -> bool:
+        """Poll until this replica's clock reaches `rv` (tests/drills)."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if self.store._rv >= rv:
+                return True
+            await asyncio.sleep(0.01)
+        return self.store._rv >= rv

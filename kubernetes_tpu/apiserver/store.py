@@ -74,6 +74,23 @@ class TooManyRequests(ValueError):
     """HTTP 429 — an eviction refused by a disruption budget."""
 
 
+class FencedWrite(ConnectionError):
+    """Write rejected by the replication fencing guard: this replica is a
+    standby, or a deposed primary whose epoch token has been superseded
+    (apiserver/replication.py). Subclasses ConnectionError deliberately —
+    "this endpoint cannot serve the write, go elsewhere" is a transport-
+    level failover signal, and every retry loop in the tree already knows
+    how to route around one. Carries the newer epoch and the current
+    primary's apiserver endpoint ("host:port", possibly empty when the
+    rejecting replica cannot reach the coordination quorum either) so
+    clients chase the primary instead of backing off blindly."""
+
+    def __init__(self, message: str, epoch: int = 0, endpoint: str = ""):
+        super().__init__(message)
+        self.epoch = int(epoch)
+        self.endpoint = endpoint
+
+
 @dataclass
 class WatchEvent:
     type: str          # ADDED | MODIFIED | DELETED

@@ -1439,6 +1439,371 @@ def run_rolling_restart(n_nodes: int = 16, n_pods: int = 96,
 
 
 @dataclass
+class StoreHAResult:
+    """Store-HA chaos drill: N *replicated stores* (each a ReplicatedStore
+    + apiserver + WAL stream + lease candidacy, apiserver/replication.py)
+    serve a live scheduler + coherence-watcher workload while the PRIMARY
+    store is killed mid-flight — the last-SPOF failure the stateless
+    rolling-restart drill could never inject. A standby must win the
+    lease, replay its WAL prefix, mint the next fencing epoch and take
+    the write load; the old primary is then resurrected believing it
+    still rules, and its first write must come back FencedWrite with the
+    new primary's endpoint — zero writes accepted under the stale epoch,
+    zero split-brain. The witness watch stream must stay gapless and
+    duplicate-free across the failover (shared rv sequence + FailoverWatch
+    since=last_rv resume), and every pod binds exactly once."""
+
+    nodes: int
+    pods: int
+    seed: int
+    replicas: int
+    bound: int
+    double_binds: int
+    promotions: int              # epoch mints past the bootstrap election
+    promotion_p99_ms: float      # primary-kill to standby-serving
+    epoch: int                   # ruling epoch at drill end
+    fenced_rejections: int       # writes the fencing guard turned away
+    fenced_leaks: int            # writes ACCEPTED under a stale epoch (0!)
+    stale_resurrect_fenced: bool  # the resurrected primary was fenced
+    records_streamed: int
+    snapshots_sent: int
+    snapshots_discarded: int
+    watch_events: int
+    watch_gaps: int
+    watch_dupes: int
+    watch_resumes: int
+    converged: bool
+    racy_writes: int = 0
+    loop_stalls: int = 0
+    max_stall_ms: float = 0.0
+    replica_faults: list = field(default_factory=list)
+
+    @property
+    def gate(self) -> bool:
+        """The drill's whole contract in one bool (the bench's gate)."""
+        return (self.converged and self.double_binds == 0
+                and self.fenced_leaks == 0 and self.stale_resurrect_fenced
+                and self.promotions >= 1
+                and self.watch_gaps == 0 and self.watch_dupes == 0
+                and self.racy_writes == 0 and self.loop_stalls == 0)
+
+    def __str__(self) -> str:
+        return (f"store-ha R={self.replicas} N={self.nodes} P={self.pods}: "
+                f"{self.bound}/{self.pods} bound, "
+                f"{self.promotions} promotions p99 "
+                f"{self.promotion_p99_ms:.1f}ms epoch {self.epoch}, "
+                f"{self.fenced_rejections} fenced "
+                f"{self.fenced_leaks} leaks, "
+                f"streamed {self.records_streamed} records "
+                f"{self.snapshots_sent} snaps, watch "
+                f"{self.watch_events} events {self.watch_gaps} gaps "
+                f"{self.watch_dupes} dupes")
+
+
+def run_store_ha(n_nodes: int = 8, n_pods: int = 48, seed: int = 2031,
+                 replicas: int = 3,
+                 race_detect: bool = True) -> StoreHAResult:
+    """Blocking entry point for the store-HA (fenced failover) drill.
+
+    Topology: a StoreReplicaSet of `replicas` replicated stores over one
+    coordination quorum wrapped in a seeded FaultPlane (plus RaceDetector
+    + loop-stall watchdog when `race_detect` — elector renew/CAS traffic
+    ticks the plane continuously, so the op-indexed action schedule fires
+    at deterministic points of the lease protocol). The scheduler, a pod
+    creator and a resourceVersion-recording witness drive the data plane
+    over TCP through primary-chasing RemoteStores. At the 1/3 milestone
+    the ruling primary store is KILLED (state and beliefs frozen); at 2/3
+    it is resurrected still believing it rules, and a client pinned to it
+    proves the fence: FencedWrite carrying the new epoch + endpoint, no
+    state mutated, and the deposed primary demotes and rejoins as a
+    standby."""
+    from kubernetes_tpu.api.objects import Node
+    from kubernetes_tpu.apiserver.auth import TokenAuthenticator, UserInfo
+    from kubernetes_tpu.apiserver.http import RemoteStore
+    from kubernetes_tpu.apiserver.store import (
+        AlreadyExists,
+        FencedWrite,
+        NotFound,
+        TooManyRequests,
+    )
+    from kubernetes_tpu.testing.faults import FaultPlane
+    from kubernetes_tpu.testing.races import LoopStallWatchdog, RaceDetector
+    from kubernetes_tpu.testing.replicas import StoreReplicaSet
+
+    coord_inner = ObjectStore()
+    plane = FaultPlane(coord_inner, seed=seed)
+    coord = RaceDetector(plane) if race_detect else plane
+    auth = TokenAuthenticator({
+        "sched-token": UserInfo("system:kube-scheduler",
+                                ("system:authenticated",))})
+
+    freeze_drill_heap()
+
+    sg = StoreReplicaSet(
+        coord, n=replicas,
+        watch_window=max(1 << 16, 8 * (n_pods + n_nodes)),
+        lease_duration=0.6, renew_deadline=0.45, retry_period=0.05,
+        server_kwargs={"authenticator": auth}).start()
+    for i, control in enumerate(sg.controls()):
+        plane.attach_store_replica(i, control)
+    watchdog_box: dict = {}
+
+    async def drive() -> StoreHAResult:
+        caps = Capacities(num_nodes=1 << max(6, (n_nodes - 1).bit_length()),
+                          batch_pods=min(64, max(16, n_pods)))
+        sched_client = sg.client(token="sched-token")
+        creator = sg.client(token="sched-token")
+        watcher_client = sg.client(token="sched-token")
+        cap = {"cpu": "16", "memory": "32Gi", "pods": "110"}
+
+        def create_with_retry(obj, deadline_s: float = 30.0) -> None:
+            deadline = time.monotonic() + deadline_s
+            while True:
+                try:
+                    creator.create(obj)
+                    return
+                except AlreadyExists:
+                    return  # failover replay: exactly-once held
+                except TooManyRequests as e:
+                    # thread context (asyncio.to_thread), never the loop
+                    time.sleep(max(0.05, getattr(e, "retry_after", 0.0)))  # ktpu: allow[blocking-in-async]
+                except ConnectionError:
+                    # promotion blackout: NO primary rules for a lease
+                    # interval — unlike the stateless drill there is no
+                    # other replica that can take the write, so ride it
+                    # out (FencedWrite chases internally; what surfaces
+                    # here is the every-endpoint-refused window)
+                    if time.monotonic() > deadline:
+                        raise
+                    time.sleep(0.1)  # ktpu: allow[blocking-in-async]
+
+        for i in range(n_nodes):
+            await asyncio.to_thread(create_with_retry, Node.from_dict({
+                "metadata": {"name": f"sha-{i}",
+                             "labels": {"kubernetes.io/hostname":
+                                        f"sha-{i}"}},
+                "status": {"allocatable": dict(cap),
+                           "capacity": dict(cap)}}))
+
+        sched = Scheduler(sched_client, caps=caps)
+        loop = asyncio.get_running_loop()
+        driver = loop.create_task(sched.run())
+
+        # the coherence witness: one logical Pod watch across the whole
+        # group, recording (type, rv, key, bound?) for the gapless gate
+        # AND the exactly-once-bind gate (a split-brained double bind
+        # would surface as two bound-MODIFIEDs for one key)
+        observed: list[tuple[str, int, str, bool]] = []
+        watcher = watcher_client.watch_resilient("Pod", since=0)
+        watch_stop = asyncio.Event()
+
+        async def observe() -> None:
+            while not watch_stop.is_set():
+                try:
+                    ev = await watcher.next(timeout=0.5)
+                except ConnectionError:
+                    return  # every endpoint stayed dead past the deadline
+                if ev is not None:
+                    key = (f"{ev.obj.metadata.namespace or 'default'}/"
+                           f"{ev.obj.metadata.name}")
+                    observed.append(
+                        (ev.type, ev.resource_version, key,
+                         bool(ev.obj.spec.node_name)))
+
+        observer = loop.create_task(observe())
+
+        async def wait_bound(expect: int, timeout_s: float) -> bool:
+            deadline = time.monotonic() + timeout_s
+            while time.monotonic() < deadline:
+                try:
+                    pods = await asyncio.to_thread(creator.list, "Pod")
+                except ConnectionError:
+                    await asyncio.sleep(0.2)
+                    continue
+                if sum(1 for p in pods if p.spec.node_name) >= expect:
+                    return True
+                await asyncio.sleep(0.1)
+            return False
+
+        async def wait_fault(count: int) -> None:
+            deadline = time.monotonic() + 30
+            while len(plane.stats.replica_faults) < count \
+                    and time.monotonic() < deadline:
+                await asyncio.sleep(0.01)
+
+        # warm the solver's jit variants BEFORE arming the stall watchdog:
+        # first-call XLA compile can hold the GIL past the 100ms stall
+        # threshold, which would charge a one-time compile cost against
+        # the failover drill's loop-health contract
+        n_warm = 2
+        for pod in make_pods(n_warm, cpu="100m", memory="64Mi",
+                             name_prefix="warm"):
+            await asyncio.to_thread(create_with_retry, pod)
+        await wait_bound(n_warm, 120)
+        if race_detect:
+            # the store-group loop legitimately fsyncs WAL compactions and
+            # shares the GIL with solver jit on the driver loop, so give it
+            # headroom over the 100ms default; real blocking bugs in the
+            # replication path (sync reads, time.sleep) stall far longer
+            sg._call(lambda: watchdog_box.update(
+                dog=LoopStallWatchdog(threshold_s=0.25).start()))
+
+        victim = sg.primary_index()
+        kill_at = max(1, n_pods // 3)
+        resurrect_at = max(kill_at + 1, (2 * n_pods) // 3)
+        stale_fenced = False
+        stale_fence_epoch = 0
+        proof = make_pods(1, cpu="100m", memory="64Mi",
+                          name_prefix="stale-proof")[0]
+        faults_seen = 0
+        for i, pod in enumerate(make_pods(n_pods, cpu="100m",
+                                          memory="64Mi",
+                                          name_prefix="sha")):
+            if i == kill_at:
+                # op-indexed on the COORDINATION plane: the elector's next
+                # renew/CAS pulls the trigger, same point every replay
+                plane.schedule(
+                    plane.stats.ops + 1,
+                    lambda p, v=victim: p.kill_store_replica(v),
+                    f"kill-store-primary-{victim}")
+                faults_seen += 1
+                await asyncio.to_thread(create_with_retry, pod)
+                await wait_fault(faults_seen)
+                # a standby must promote before writes flow again;
+                # create_with_retry above already rode the blackout
+            elif i == resurrect_at:
+                plane.schedule(
+                    plane.stats.ops + 1,
+                    lambda p, v=victim: p.resurrect_store_replica(v),
+                    f"resurrect-store-{victim}")
+                faults_seen += 1
+                await asyncio.to_thread(create_with_retry, pod)
+                await wait_fault(faults_seen)
+                # the resurrectee still believes it is primary at the old
+                # epoch: a client pinned to it must get FencedWrite, and
+                # its state must stay untouched (verified below via the
+                # everywhere-absent proof pod)
+                stale = sg.replicas[victim]
+                deadline = time.monotonic() + 10
+                while stale.killed and time.monotonic() < deadline:
+                    await asyncio.sleep(0.01)
+                pinned = RemoteStore(stale.host, stale.api_port,
+                                     token="sched-token")
+
+                def poke():
+                    try:
+                        pinned.create(proof)
+                        return "accepted"
+                    except FencedWrite as e:
+                        return ("fenced", e.epoch)
+                    except ConnectionError:
+                        return "conn"
+
+                outcome = await asyncio.to_thread(poke)
+                if isinstance(outcome, tuple):
+                    stale_fenced = True
+                    stale_fence_epoch = outcome[1]
+            else:
+                await asyncio.to_thread(create_with_retry, pod)
+        conv = await wait_bound(n_warm + n_pods, 240)
+
+        # fence the coherence check at the ruling primary's revision,
+        # then let the witness catch up before comparing histories
+        p_idx = sg.wait_for_primary(10)
+        primary = sg.replicas[p_idx].store
+        fence_rv = primary.resource_version
+        deadline = time.monotonic() + 30
+        while (watcher.last_rv or 0) < fence_rv \
+                and time.monotonic() < deadline \
+                and not observer.done():
+            await asyncio.sleep(0.05)
+        watch_stop.set()
+        watcher.stop()
+        observer.cancel()
+        driver.cancel()
+        sched.stop()
+
+        # the fenced-leak proof: the stale write must exist NOWHERE — not
+        # on the ruling primary, not on the resurrectee's own copy
+        leaks = 0
+        for replica in sg.replicas:
+            try:
+                replica.store.get("Pod", proof.metadata.name)
+                leaks += 1
+            except NotFound:
+                pass
+        if not stale_fenced:
+            leaks += 1  # the poke was swallowed or accepted: count it
+
+        expected = [e.resource_version for e in primary._history
+                    if e.kind == "Pod" and e.resource_version <= fence_rv]
+        got = [rv for _, rv, _, _ in observed if rv <= fence_rv]
+        gaps = len(set(expected) - set(got))
+        dupes = len(got) - len(set(got))
+        # exactly-once binds, judged from the AUTHORITATIVE timeline (the
+        # ruling primary's history): a split-brained double bind would
+        # show as a second unbound->bound transition for one key, or a
+        # bound pod silently moving nodes. Post-bind MODIFIEDs that keep
+        # the assignment (trace-annotation stamps on sampled batches,
+        # condition writes) are not binds. The witness is the wrong judge
+        # for this — it may legitimately have observed a bind the dead
+        # primary acked but never replicated (the async-replication ack
+        # window); that bind is not in the surviving timeline and the
+        # scheduler's retry is the recovery, not a bug.
+        bind_counts: dict[str, int] = {}
+        last_node: dict[str, str] = {}
+        for e in primary._history:
+            if e.kind != "Pod" or e.resource_version > fence_rv:
+                continue
+            key = (f"{e.obj.metadata.namespace or 'default'}/"
+                   f"{e.obj.metadata.name}")
+            if e.type == "DELETED":
+                last_node.pop(key, None)
+                continue
+            node = e.obj.spec.node_name or ""
+            prev = last_node.get(key, "")
+            if node and (not prev or node != prev):
+                bind_counts[key] = bind_counts.get(key, 0) + 1
+            last_node[key] = node
+        double = sum(1 for v in bind_counts.values() if v > 1)
+        bound_final = sum(
+            1 for p in primary.list("Pod")
+            if p.spec.node_name and p.metadata.name.startswith("sha-"))
+        return StoreHAResult(
+            nodes=n_nodes, pods=n_pods, seed=seed, replicas=replicas,
+            bound=bound_final, double_binds=double,
+            promotions=sum(1 for _, ep in sg.promotions if ep >= 2),
+            promotion_p99_ms=_p99_ms(
+                [s / 1e3 for s in sg.promotion_samples_ms]),
+            epoch=max((r.store.epoch for r in sg.replicas), default=0),
+            fenced_rejections=sum(
+                r.store.fenced_writes for r in sg.replicas),
+            fenced_leaks=leaks,
+            stale_resurrect_fenced=(stale_fenced
+                                    and stale_fence_epoch >= 2),
+            records_streamed=sum(r.records_sent for r in sg.replicas),
+            snapshots_sent=sum(r.snapshots_sent for r in sg.replicas),
+            snapshots_discarded=sum(
+                r.snapshots_discarded for r in sg.replicas),
+            watch_events=len(got), watch_gaps=gaps, watch_dupes=dupes,
+            watch_resumes=watcher.resumes,
+            converged=(conv and bound_final >= n_pods),
+            racy_writes=len(coord.racy_writes) if race_detect else 0,
+            replica_faults=list(plane.stats.replica_faults))
+
+    try:
+        result = asyncio.run(drive())
+    finally:
+        stalls = sg._call(watchdog_box["dog"].stop) \
+            if watchdog_box else []
+        sg.stop()
+        thaw_drill_heap()
+    result.loop_stalls = len(stalls)
+    result.max_stall_ms = 1e3 * max(stalls, default=0.0)
+    return result
+
+
+@dataclass
 class FanoutResult:
     """Watch-cache fan-out drill: N subscribers, M store events, and the
     proof that the store did O(M) work — `store_fanout_puts` counts one

@@ -31,6 +31,8 @@ import random
 from dataclasses import dataclass, field
 
 from kubernetes_tpu.scenario.traces import (
+    ApiserverBrownout,
+    CorrelatedZoneFailure,
     FaultShift,
     FlapBurst,
     GangWidthShift,
@@ -54,6 +56,11 @@ def default_mutations(rng: random.Random, cfg: TraceConfig) -> list:
         FaultShift(delta=rng.randrange(-span, span + 1)),
         FlapBurst(tick=rng.randrange(cfg.ticks),
                   count=1 + rng.randrange(4)),
+        ApiserverBrownout(start=start, end=start + span,
+                          peak=0.2 + 0.6 * rng.random()),
+        CorrelatedZoneFailure(tick=rng.randrange(cfg.ticks),
+                              zone=rng.randrange(max(1, cfg.zones)),
+                              down=2 + rng.randrange(4)),
     ]
 
 
@@ -231,6 +238,44 @@ class ScenarioSearch:
                             pressure=max(best, 1.0), shrunk=shrunk)
 
 
+@dataclass
+class NightlyResult:
+    """Outcome of one nightly sweep: which seeds ran, what (if anything)
+    was found, and where the replay artifact landed."""
+
+    seeds: list
+    found_seed: int | None = None
+    result: SearchResult | None = None
+    artifact_path: str | None = None
+
+
+def nightly_search(make_config, evaluate, *, base_seed: int = 0,
+                   nights: int = 4, rounds: int = 4,
+                   out_path: str = "ktpu-scenario-artifact.txt",
+                   log=lambda msg: None) -> NightlyResult:
+    """The nightly scenario-search job: ``nights`` independent seeded
+    searches against HEAD (seed ``base_seed + i`` for night ``i``, so a
+    sweep is as replayable as a single search).  The first violation is
+    shrunk and its artifact — ``KTPU_SCENARIO_SEED`` line, mutation
+    stack, minimal tape — is written to ``out_path``: the morning
+    engineer replays with one command instead of re-searching.  A clean
+    sweep writes nothing."""
+    seeds: list = []
+    for i in range(nights):
+        seed = base_seed + i
+        seeds.append(seed)
+        result = ScenarioSearch(make_config(seed), evaluate, seed=seed,
+                                rounds=rounds).run()
+        log(f"night {i + 1}/{nights} seed={seed}: {result}")
+        if result.found:
+            with open(out_path, "w") as f:
+                f.write(result.shrunk.artifact())
+            log(f"artifact -> {out_path}")
+            return NightlyResult(seeds, found_seed=seed, result=result,
+                                 artifact_path=out_path)
+    return NightlyResult(seeds)
+
+
 def soak_evaluator(**soak_kwargs):
     """The production evaluator: play the tape through the full control
     plane (:func:`~kubernetes_tpu.scenario.soak.run_soak`) and return
@@ -248,12 +293,14 @@ def soak_evaluator(**soak_kwargs):
 
 def main(argv=None) -> int:
     import argparse
+    import os
     import sys
 
     ap = argparse.ArgumentParser(
         description="search trace-scenario space for gate violations, "
-        "or replay a shrunk artifact")
-    ap.add_argument("--seed", type=int, default=0)
+        "replay a shrunk artifact, or run the nightly sweep")
+    ap.add_argument("--seed", type=int,
+                    default=int(os.environ.get("KTPU_SCENARIO_SEED", 0)))
     ap.add_argument("--rounds", type=int, default=4)
     ap.add_argument("--nodes", type=int, default=8)
     ap.add_argument("--ticks", type=int, default=32)
@@ -263,8 +310,13 @@ def main(argv=None) -> int:
     ap.add_argument("--replay", metavar="FILE",
                     help="evaluate a saved tape artifact instead of "
                     "searching")
+    ap.add_argument("--nightly", type=int, default=0, metavar="N",
+                    help="nightly job: N independent seeded searches "
+                    "(seed, seed+1, ...), auto-writing the shrunk "
+                    "artifact of the first find to --out")
     ap.add_argument("--out", metavar="FILE",
-                    help="write the shrunk artifact here")
+                    help="write the shrunk artifact here (nightly "
+                    "default: ktpu-scenario-artifact.txt)")
     args = ap.parse_args(argv)
 
     evaluate = soak_evaluator(tick_seconds=args.tick_seconds,
@@ -277,12 +329,21 @@ def main(argv=None) -> int:
               f"{'; '.join(violations) or '(none)'}")
         return 1 if violations else 0
 
-    cfg = TraceConfig(seed=args.seed, ticks=args.ticks, nodes=args.nodes,
-                      base_rate=args.rate, flap_rate=0.05,
-                      watch_expire_ticks=(args.ticks // 3,),
-                      watcher_drop_ticks=(2 * args.ticks // 3,))
-    result = ScenarioSearch(cfg, evaluate, seed=args.seed,
-                            rounds=args.rounds).run()
+    def make_config(seed: int) -> TraceConfig:
+        return TraceConfig(seed=seed, ticks=args.ticks, nodes=args.nodes,
+                           base_rate=args.rate, flap_rate=0.05,
+                           watch_expire_ticks=(args.ticks // 3,),
+                           watcher_drop_ticks=(2 * args.ticks // 3,))
+
+    if args.nightly:
+        nightly = nightly_search(
+            make_config, evaluate, base_seed=args.seed,
+            nights=args.nightly, rounds=args.rounds,
+            out_path=args.out or "ktpu-scenario-artifact.txt", log=print)
+        return 1 if nightly.found_seed is not None else 0
+
+    result = ScenarioSearch(make_config(args.seed), evaluate,
+                            seed=args.seed, rounds=args.rounds).run()
     print(result)
     if result.shrunk is not None:
         artifact = result.shrunk.artifact()

@@ -31,6 +31,7 @@ from dataclasses import dataclass, field
 
 from kubernetes_tpu.obs import REGISTRY
 from kubernetes_tpu.scenario.traces import (
+    BROWNOUT,
     DELETE,
     NODE_ADD,
     NODE_DRAIN,
@@ -319,6 +320,11 @@ async def _run_soak(tape: Tape, *, tick_seconds: float,
             plane.expire_watch_history()
         elif ev.kind == WATCHER_DROP:
             plane.drop_watchers()
+        elif ev.kind == BROWNOUT:
+            # the tape carries the whole ramp as explicit rows, so a
+            # brownout needs no timer state here: set-and-forget, the
+            # final row of the window restores the baseline
+            plane.error_rate = ev.rate if ev.rate > 0 else error_rate
 
     by_tick: dict[int, list] = {}
     for ev in tape.events:

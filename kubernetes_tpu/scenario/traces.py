@@ -37,9 +37,10 @@ NODE_DRAIN = "node-drain"    # node drained: pods evicted, node removed
 NODE_FLAP = "node-flap"      # heartbeat stops -> NotReady -> recovers
 WATCH_EXPIRE = "watch-expire"    # FaultPlane: compact watch history
 WATCHER_DROP = "watcher-drop"    # FaultPlane: sever live watchers
+BROWNOUT = "brownout"            # FaultPlane: set injected error rate
 
 EVENT_KINDS = (SUBMIT, SUBMIT_GANG, DELETE, NODE_ADD, NODE_DRAIN,
-               NODE_FLAP, WATCH_EXPIRE, WATCHER_DROP)
+               NODE_FLAP, WATCH_EXPIRE, WATCHER_DROP, BROWNOUT)
 
 _TICK_MIX = 2654435761  # Knuth multiplicative hash, keeps tick streams apart
 
@@ -58,12 +59,17 @@ class Event:
     priority: int = 0     # numeric pod priority
     lifetime: int = 0     # ticks until delete (0 = long-running)
     down: int = 0         # node-flap: ticks until recovery
+    rate: float = 0.0     # brownout: injected error rate at this tick
 
     def to_line(self) -> str:
+        # `rate` serialises only when set, so every pre-brownout tape
+        # line stays byte-identical (locality diffs and stored artifacts
+        # both lean on that)
+        tail = f" rate={self.rate}" if self.kind == BROWNOUT else ""
         return (f"{self.tick} {self.kind} {self.name or '-'} "
                 f"origin={self.origin} cpu={self.cpu_m} mem={self.mem_mi} "
                 f"w={self.width} prio={self.priority} "
-                f"life={self.lifetime} down={self.down}")
+                f"life={self.lifetime} down={self.down}" + tail)
 
     @classmethod
     def from_line(cls, line: str) -> "Event":
@@ -75,7 +81,8 @@ class Event:
                    origin=int(vals["origin"]), cpu_m=int(vals["cpu"]),
                    mem_mi=int(vals["mem"]), width=int(vals["w"]),
                    priority=int(vals["prio"]), lifetime=int(vals["life"]),
-                   down=int(vals["down"]))
+                   down=int(vals["down"]),
+                   rate=float(vals.get("rate", 0.0)))
 
 
 @dataclass(frozen=True)
@@ -111,6 +118,10 @@ class TraceConfig:
     # mutation surfaces (normally installed by Mutation.apply)
     rate_spikes: tuple = ()    # ((start, end, mult), ...)
     flap_bursts: tuple = ()    # ((tick, count), ...)
+    zones: int = 1             # failure domains: node i sits in zone
+    #                            i // ceil(nodes / zones)
+    brownouts: tuple = ()      # ((start, end, peak_error_rate), ...)
+    zone_failures: tuple = ()  # ((tick, zone, down_ticks), ...)
 
     def to_dict(self) -> dict:
         d = {}
@@ -198,8 +209,46 @@ class FlapBurst:
                        + ((self.tick, self.count),))
 
 
+@dataclass(frozen=True)
+class ApiserverBrownout:
+    """Ramp the FaultPlane's injected error rate over ``[start, end)``:
+    a brownout, not an outage — verbs fail with rising-then-falling
+    probability (triangular, peaking at ``peak`` mid-window), and the
+    rate drops back to zero at ``end``.  Retry storms under partial
+    availability are a different failure surface than a clean kill."""
+
+    start: int
+    end: int
+    peak: float = 0.5
+    kind: str = field(default="apiserver-brownout", init=False)
+
+    def apply(self, cfg: TraceConfig) -> TraceConfig:
+        return replace(cfg, brownouts=cfg.brownouts
+                       + ((self.start, self.end, self.peak),))
+
+
+@dataclass(frozen=True)
+class CorrelatedZoneFailure:
+    """Flap EVERY node in one failure domain at ``tick`` for ``down``
+    ticks — a rack/zone power event, the correlated cousin of the
+    independent per-node flap.  Installs enough zones for the target to
+    exist; node ``i`` lives in zone ``i // ceil(nodes / zones)``."""
+
+    tick: int
+    zone: int = 0
+    down: int = 4
+    kind: str = field(default="zone-failure", init=False)
+
+    def apply(self, cfg: TraceConfig) -> TraceConfig:
+        return replace(cfg, zones=max(cfg.zones, self.zone + 1),
+                       zone_failures=cfg.zone_failures
+                       + ((self.tick, self.zone, self.down),))
+
+
 MUTATION_KINDS = {"rate-spike": RateSpike, "gang-width-shift": GangWidthShift,
-                  "fault-shift": FaultShift, "flap-burst": FlapBurst}
+                  "fault-shift": FaultShift, "flap-burst": FlapBurst,
+                  "apiserver-brownout": ApiserverBrownout,
+                  "zone-failure": CorrelatedZoneFailure}
 
 
 def mutation_to_dict(m) -> dict:
@@ -317,6 +366,19 @@ class TraceEngine:
             # deletes scheduled by earlier ticks land first, in the order
             # their submits drew them (deterministic)
             events.extend(pending_deletes.pop(t, ()))
+            # brownout rows are RNG-free (deterministic triangular ramp)
+            # and precede the tick's submits so the rate governs them;
+            # not drawing from `rng` keeps even the window's own submit
+            # stream byte-identical when the mutation is stacked
+            for start, end, peak in cfg.brownouts:
+                if start <= t < end:
+                    x = (t - start + 0.5) / max(1, end - start)
+                    events.append(Event(
+                        t, BROWNOUT, "", origin=t,
+                        rate=round(peak * (1.0 - abs(2.0 * x - 1.0)), 4)))
+                elif t == end and end < cfg.ticks:
+                    events.append(Event(t, BROWNOUT, "", origin=t,
+                                        rate=0.0))
             rng = self._tick_rng(t)
             for i in range(_poisson(rng, self._rate_at(t))):
                 is_gang = rng.random() < cfg.gang_fraction
@@ -351,6 +413,16 @@ class TraceEngine:
                 events.append(Event(t, NODE_FLAP,
                                     f"soak-{rng.randrange(cfg.nodes):05d}",
                                     origin=t, down=cfg.flap_down_ticks))
+            # correlated zone failure: every node of the domain flaps at
+            # once — RNG-free so the rows land without perturbing the
+            # tick's stream (zone membership is positional)
+            for ftick, zone, down in cfg.zone_failures:
+                if ftick == t:
+                    per = -(-cfg.nodes // max(1, cfg.zones))  # ceil
+                    for i in range(zone * per,
+                                   min(cfg.nodes, (zone + 1) * per)):
+                        events.append(Event(t, NODE_FLAP, f"soak-{i:05d}",
+                                            origin=t, down=down))
             if cfg.add_every and t and t % cfg.add_every == 0:
                 events.append(Event(t, NODE_ADD, f"soak-add-{t}", origin=t))
             if cfg.drain_every and t and t % cfg.drain_every == 0:

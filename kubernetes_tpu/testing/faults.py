@@ -122,6 +122,11 @@ class FaultPlane:
         # node-flap targets: hollow kubelets registered by attach_kubelet()
         # so traces schedule node failures like they schedule watch drops
         self.kubelets: dict[str, Any] = {}
+        # store-HA: *stateful* store replicas registered by
+        # attach_store_replica() — a separate namespace from the stateless
+        # apiserver handles because the injury vocabulary differs
+        # (kill/partition/heal/resurrect, see StoreReplicaControl)
+        self.store_replicas: dict[int, Any] = {}
 
     # ---- schedule-driven disruptions ----
 
@@ -221,6 +226,45 @@ class FaultPlane:
         self.stats.replica_faults.append(
             {"replica": index, "kind": "worker-kill"})
         self.replicas[index].kill()
+
+    # ---- store-replica targeting (store-HA drills) ----
+
+    def attach_store_replica(self, index: int, control: Any) -> None:
+        """Register one replicated-store replica's control handle
+        (kill/partition/heal/resurrect — the shape
+        testing.replicas.StoreReplicaSet.control hands out) so the seeded
+        action schedule can injure the *stateful* layer: kill the
+        primary mid-workload, resurrect it stale, partition a standby."""
+        self.store_replicas[index] = control
+
+    def kill_store_replica(self, index: int) -> None:
+        """SIGKILL the store replica: apiserver, replication stream and
+        lease candidacy vanish; state and beliefs freeze for a later
+        resurrect (the stale-primary-return shape fencing must catch)."""
+        self.stats.replica_faults.append(
+            {"replica": index, "kind": "store-kill"})
+        self.store_replicas[index].kill()
+
+    def partition_store_replica(self, index: int) -> None:
+        """Sever the store replica from coordination quorum and peers: a
+        partitioned primary fail-safe rejects writes and loses its lease
+        within renew_deadline."""
+        self.stats.replica_faults.append(
+            {"replica": index, "kind": "store-partition"})
+        self.store_replicas[index].partition()
+
+    def heal_store_replica(self, index: int) -> None:
+        self.stats.replica_faults.append(
+            {"replica": index, "kind": "store-heal"})
+        self.store_replicas[index].heal()
+
+    def resurrect_store_replica(self, index: int) -> None:
+        """Bring a killed store replica back on its old ports believing
+        whatever it believed — if it was the primary, its first write
+        attempt must come back FencedWrite, never split-brain."""
+        self.stats.replica_faults.append(
+            {"replica": index, "kind": "store-resurrect"})
+        self.store_replicas[index].resurrect()
 
     def flood(self, flow: str, rate_multiplier: float) -> None:
         """Noisy-tenant burst: drive `flow`'s request rate to

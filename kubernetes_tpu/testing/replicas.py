@@ -29,7 +29,9 @@ from __future__ import annotations
 import asyncio
 import concurrent.futures
 import os
+import shutil
 import signal
+import tempfile
 import threading
 import time
 from typing import Any
@@ -42,6 +44,7 @@ from kubernetes_tpu.apiserver.multiproc import (
     spawn_worker,
     wait_port,
 )
+from kubernetes_tpu.apiserver.replication import StoreReplica
 from kubernetes_tpu.apiserver.store import ObjectStore
 
 
@@ -503,3 +506,301 @@ class MultiProcCluster:
         self.owner.reclaim_slot(index)
         self.respawns += 1
         self._spawn(index)
+
+
+class StoreReplicaControl:
+    """One *store* replica's injury handle (the shape
+    FaultPlane.attach_store_replica expects: kill/partition/heal/
+    resurrect, all thread-safe). Store replicas are stateful, so the
+    injury vocabulary differs from the stateless apiserver handles —
+    `resurrect` brings the SAME state and beliefs back, the stale-primary
+    return the fencing epoch exists to contain."""
+
+    def __init__(self, group: "StoreReplicaSet", index: int):
+        self._group = group
+        self.index = index
+
+    def kill(self) -> None:
+        self._group.kill(self.index)
+
+    def partition(self) -> None:
+        self._group.partition(self.index)
+
+    def heal(self) -> None:
+        self._group.heal(self.index)
+
+    def resurrect(self) -> None:
+        self._group.resurrect(self.index)
+
+
+class StoreReplicaSet:
+    """N replicated *stores* (each with its own apiserver, WAL and
+    replication link) over one coordination store — the topology
+    `apiserver/replication.py` builds, packaged for drills and tests the
+    way ReplicaSet packages stateless apiservers.
+
+    Single-loop discipline, same as ReplicaSet: every replica's asyncio
+    pieces (apiserver, replication stream, elector) run on ONE background
+    loop; isolation between replicas is the HTTP/TCP boundary. All
+    control methods marshal onto that loop and are safe from the client
+    thread AND from FaultPlane actions firing on the loop itself.
+
+    `coord_store` may be the raw ObjectStore or any proxy over it
+    (FaultPlane, RaceDetector) — the store-HA drill wraps the
+    coordination quorum in the plane so elector renew traffic ticks the
+    seeded op schedule.
+
+        with StoreReplicaSet(n=3, lease_duration=0.6) as sg:
+            plane.attach_store_replica(0, sg.control(0))
+            remote = sg.client()       # chases the current primary
+            sg.kill(sg.primary_index())
+            sg.wait_for_primary()      # a standby promotes, epoch+1
+    """
+
+    def __init__(self, coord_store: Any = None, n: int = 3,
+                 host: str = "127.0.0.1", *,
+                 watch_window: int = 4096,
+                 persist_dir: str | None = None,
+                 lease_duration: float = 0.6,
+                 renew_deadline: float = 0.45,
+                 retry_period: float = 0.05,
+                 follower_queue: int = 8192,
+                 server_kwargs: dict | None = None):
+        self.coord_store = coord_store if coord_store is not None \
+            else ObjectStore()
+        self.n = n
+        self.host = host
+        self.watch_window = watch_window
+        self._own_persist_dir = persist_dir is None
+        self.persist_dir = persist_dir
+        self.lease_duration = lease_duration
+        self.renew_deadline = renew_deadline
+        self.retry_period = retry_period
+        self.follower_queue = follower_queue
+        self.server_kwargs = dict(server_kwargs or {})
+        self.replicas: list[StoreReplica] = []
+        self.loop: asyncio.AbstractEventLoop | None = None
+        self._thread: threading.Thread | None = None
+        self._started = threading.Event()
+        self._startup_error: BaseException | None = None
+        # promotion-latency ledger: outage_mark() (or killing/partitioning
+        # the current primary) stamps t0; the next on_promoted callback
+        # closes the sample — the drill's promotion-p99 source
+        self._outage_at = 0.0
+        self.promotion_samples_ms: list[float] = []
+        self.promotions: list[tuple[str, int]] = []   # (identity, epoch)
+
+    # ---- lifecycle ----
+
+    def start(self) -> "StoreReplicaSet":
+        if self.persist_dir is None:
+            self.persist_dir = tempfile.mkdtemp(prefix="ktpu-storeha-")
+
+        def serve():
+            async def main():
+                self.loop = asyncio.get_running_loop()
+                shutdown = asyncio.Event()
+                self._shutdown = shutdown
+                try:
+                    for i in range(self.n):
+                        replica = StoreReplica(
+                            i, self.coord_store, host=self.host,
+                            persist_path=os.path.join(
+                                self.persist_dir, f"store-{i}.wal"),
+                            watch_window=self.watch_window,
+                            lease_duration=self.lease_duration,
+                            renew_deadline=self.renew_deadline,
+                            retry_period=self.retry_period,
+                            follower_queue=self.follower_queue,
+                            server_kwargs=self.server_kwargs)
+                        replica.on_promoted = self._on_promoted
+                        await replica.start()
+                        self.replicas.append(replica)
+                    # open for business only once a primary rules —
+                    # otherwise the first client write races the election
+                    deadline = time.monotonic() + 10.0
+                    while not any(r.store.role == "primary"
+                                  for r in self.replicas):
+                        if time.monotonic() > deadline:
+                            raise RuntimeError(
+                                "no store primary elected within 10s")
+                        await asyncio.sleep(0.01)
+                except BaseException as e:  # surface to the caller thread
+                    self._startup_error = e
+                    self._started.set()
+                    raise
+                self._started.set()
+                await shutdown.wait()
+                for replica in self.replicas:
+                    try:
+                        await replica.stop()
+                    except Exception:
+                        pass
+
+            asyncio.run(main())
+
+        self._thread = threading.Thread(
+            target=serve, name="ktpu-storegroup", daemon=True)
+        self._thread.start()
+        if not self._started.wait(timeout=20.0):
+            raise RuntimeError("store replica set failed to start in 20s")
+        if self._startup_error is not None:
+            raise RuntimeError("store replica startup failed") \
+                from self._startup_error
+        return self
+
+    def stop(self) -> None:
+        if self.loop is not None and not self.loop.is_closed():
+            try:
+                self.loop.call_soon_threadsafe(self._shutdown.set)
+            except RuntimeError:
+                pass  # loop already closing
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+        if self._own_persist_dir and self.persist_dir:
+            shutil.rmtree(self.persist_dir, ignore_errors=True)
+
+    def __enter__(self) -> "StoreReplicaSet":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # ---- addressing ----
+
+    @property
+    def endpoints(self) -> list[tuple[str, int]]:
+        """Every replica's apiserver endpoint — ports are stable across
+        kill()/resurrect(), so static client lists survive failover."""
+        return [(r.host, r.api_port) for r in self.replicas]
+
+    def client(self, **kw) -> RemoteStore:
+        """A RemoteStore over every replica. Writes that land on a
+        standby (or a deposed primary) come back 409/Fenced with the
+        ruling primary's endpoint, and the client steers there."""
+        eps = self.endpoints
+        return RemoteStore(eps[0][0], eps[0][1], endpoints=eps, **kw)
+
+    def control(self, index: int) -> StoreReplicaControl:
+        return StoreReplicaControl(self, index)
+
+    def controls(self) -> list[StoreReplicaControl]:
+        return [StoreReplicaControl(self, i) for i in range(self.n)]
+
+    def primary_index(self) -> int:
+        """The index of the replica that BELIEVES it is primary with the
+        highest epoch (a resurrected stale primary may also believe, at
+        a lower epoch), or -1."""
+        best, best_epoch = -1, -1
+        for i, replica in enumerate(self.replicas):
+            if replica.store.role == "primary" \
+                    and replica.store.epoch > best_epoch:
+                best, best_epoch = i, replica.store.epoch
+        return best
+
+    # ---- loop marshalling (the ReplicaSet pattern) ----
+
+    def _on_loop(self) -> bool:
+        try:
+            return asyncio.get_running_loop() is self.loop
+        except RuntimeError:
+            return False
+
+    def _call(self, fn, timeout: float = 10.0) -> Any:
+        assert self.loop is not None, "store replica set not started"
+        if self._on_loop():
+            return fn()
+        fut: concurrent.futures.Future = concurrent.futures.Future()
+
+        def run():
+            try:
+                fut.set_result(fn())
+            except BaseException as e:  # noqa: BLE001 — relay to caller
+                fut.set_exception(e)
+
+        self.loop.call_soon_threadsafe(run)
+        return fut.result(timeout=timeout)
+
+    # ---- injuries / lifecycle ----
+
+    def outage_mark(self) -> None:
+        """Stamp t0 for the next promotion sample (called implicitly when
+        kill()/partition() hits the ruling primary)."""
+        self._outage_at = time.monotonic()
+
+    def _on_promoted(self, replica: StoreReplica) -> None:
+        # runs on the serving loop, synchronously inside _promote()
+        self.promotions.append((replica.identity, replica.store.epoch))
+        if self._outage_at:
+            self.promotion_samples_ms.append(
+                (time.monotonic() - self._outage_at) * 1000.0)
+            self._outage_at = 0.0
+
+    def kill(self, index: int) -> None:
+        """SIGKILL equivalent: the replica's apiserver, replication link
+        and candidacy vanish; its state and beliefs freeze (see
+        StoreReplica.kill). Killing the ruling primary starts the
+        promotion clock."""
+        replica = self.replicas[index]
+
+        def injure():
+            if index == self.primary_index():
+                self.outage_mark()
+            replica.kill()
+
+        self._call(injure)
+
+    def partition(self, index: int) -> None:
+        """Sever the replica from the coordination quorum and its peers.
+        A partitioned primary fail-safe rejects writes immediately and
+        loses the lease within renew_deadline — the promotion clock
+        starts now, when clients first feel it."""
+        replica = self.replicas[index]
+
+        def injure():
+            if index == self.primary_index():
+                self.outage_mark()
+            replica.partition()
+
+        self._call(injure)
+
+    def heal(self, index: int) -> None:
+        replica = self.replicas[index]
+        self._call(replica.heal)
+
+    def resurrect(self, index: int) -> None:
+        """Bring a killed replica back on the SAME ports, believing
+        whatever it believed — the GC-pause return. Safe from the client
+        thread and from on-loop FaultPlane actions (runs as a task
+        there, exactly like ReplicaSet.drain)."""
+        replica = self.replicas[index]
+        assert self.loop is not None, "store replica set not started"
+        if self._on_loop():
+            self.loop.create_task(replica.resurrect())
+            return
+        asyncio.run_coroutine_threadsafe(
+            replica.resurrect(), self.loop).result(timeout=10.0)
+
+    # ---- convergence helpers ----
+
+    def wait_for_primary(self, timeout: float = 10.0) -> int:
+        """Block until some live replica rules as primary; -> its index."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            idx = self.primary_index()
+            if idx >= 0 and not self.replicas[idx].killed:
+                return idx
+            time.sleep(0.01)  # ktpu: allow[blocking-in-async]
+        raise TimeoutError("no store primary within %.1fs" % timeout)
+
+    def wait_converged(self, rv: int, timeout: float = 10.0) -> bool:
+        """Block until every live, unpartitioned replica's clock reaches
+        `rv` (replication caught up everywhere)."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            live = [r for r in self.replicas
+                    if not r.killed and not r.partitioned]
+            if live and all(r.store._rv >= rv for r in live):
+                return True
+            time.sleep(0.01)  # ktpu: allow[blocking-in-async]
+        return False

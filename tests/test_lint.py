@@ -650,6 +650,38 @@ def test_r6_solversvc_prefix_scoped_to_package():
                            rules=R6)) == 1
 
 
+def test_r6_flags_unprefixed_replication_family():
+    # failover dashboards and the bench[store-ha] gate select on the
+    # registered store_replication_ namespace: any family DEFINED in
+    # apiserver/replication.py must carry it (a bare promotions_total
+    # would alias the client package's leader-election families)
+    src = (
+        "def metrics(r):\n"
+        "    bad = r.counter('promotions_total', 'd')\n"
+        "    bad_g = r.gauge('epoch', 'd')\n"
+        "    bad_h = r.histogram('promotion_seconds', 'd')\n"
+        "    ok = r.counter('store_replication_records_total', 'd',\n"
+        "                   ('result',))\n"
+        "    ok_g = r.gauge('store_replication_epoch', 'd')\n"
+    )
+    found = lint_source(
+        src, relpath="kubernetes_tpu/apiserver/replication.py", rules=R6)
+    rep = [f for f in found if "store_replication_ prefix" in f.message]
+    assert sorted(f.line for f in rep) == [2, 3, 4]
+
+
+def test_r6_replication_prefix_scoped_to_module():
+    # the same bare family elsewhere in the apiserver package is legal
+    # (the store/http planes own their namespaces); only definitions in
+    # replication.py itself are gated
+    src = "def metrics(r):\n    r.gauge('epoch', 'd')\n"
+    assert lint_source(src, relpath="kubernetes_tpu/apiserver/store.py",
+                       rules=R6) == []
+    assert len(lint_source(
+        src, relpath="kubernetes_tpu/apiserver/replication.py",
+        rules=R6)) == 1
+
+
 def test_r4_covers_solversvc_scope():
     # the continuous batcher's window must be ManualClock-warpable and
     # its coalescing order replayable: wall-clock and ambient rng are

@@ -1,0 +1,326 @@
+"""Replicated store: WAL-streamed hot standby with fenced failover.
+
+Covers apiserver/replication.py end to end on real sockets with drill
+timings (0.6s lease): bootstrap election, WAL tail catch-up, snapshot
+late-join, torn-mid-snapshot recovery, fenced failover with a stale
+resurrected primary, dead-timeline divergence reset, and the
+RemoteStore fenced-chase client contract — plus the bench[store-ha]
+smoke drill as a subprocess.
+"""
+
+import asyncio
+import json
+import os
+import subprocess
+import sys
+import time
+
+from kubernetes_tpu.apiserver.http import RemoteStore
+from kubernetes_tpu.apiserver.replication import StoreReplica
+from kubernetes_tpu.apiserver.store import FencedWrite, ObjectStore
+from kubernetes_tpu.perf.fixtures import make_pods
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# drill timings: promotions settle in ~lease_duration, keeping every
+# failover scenario sub-second without changing the protocol under test
+FAST = {"lease_duration": 0.6, "renew_deadline": 0.45,
+        "retry_period": 0.05}
+
+
+def _pods(n, prefix):
+    return make_pods(n, cpu="100m", memory="64Mi", name_prefix=prefix)
+
+
+def _replica(i, coord, tmp, **kw):
+    kw.setdefault("watch_window", 8)  # tiny window forces snapshot path
+    return StoreReplica(i, coord, persist_path=str(tmp / f"r{i}.wal"),
+                        **FAST, **kw)
+
+
+async def _wait(pred, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        await asyncio.sleep(0.02)
+    return pred()
+
+
+async def _bootstrap(coord, tmp, n):
+    """Start n replicas; r0 campaigns first so the primary is known."""
+    reps = [_replica(i, coord, tmp) for i in range(n)]
+    await reps[0].start()
+    assert await _wait(lambda: reps[0].store.role == "primary")
+    for r in reps[1:]:
+        await r.start()
+    return reps
+
+
+async def _stop_all(reps):
+    for r in reps:
+        await r.stop()
+
+
+def test_wal_tail_catchup_and_snapshot_late_joiner(tmp_path):
+    """Standbys follow the live WAL stream; a joiner too far behind the
+    retained window is seeded with a consistent snapshot instead."""
+
+    async def run():
+        coord = ObjectStore()
+        reps = await _bootstrap(coord, tmp_path, 3)
+        try:
+            client = RemoteStore(
+                "", 0, endpoints=[(r.host, r.api_port) for r in reps])
+            for pod in _pods(20, "tail"):
+                await asyncio.to_thread(client.create, pod)
+            rv = reps[0].store.resource_version
+            assert await reps[1].wait_rv(rv, 5)
+            assert await reps[2].wait_rv(rv, 5)
+            assert len(reps[1].store.list("Pod")) == 20
+            assert len(reps[2].store.list("Pod")) == 20
+
+            # 20 writes >> watch_window=8: an empty late joiner cannot be
+            # served a tail and must get the SNAP/OBJ/END reset
+            late = _replica(3, coord, tmp_path)
+            await late.start()
+            assert await late.wait_rv(rv, 5)
+            assert late.catchups >= 1
+            assert reps[0].snapshots_sent >= 1
+            assert len(late.store.list("Pod")) == 20
+            await late.stop()
+        finally:
+            await _stop_all(reps)
+
+    asyncio.run(run())
+
+
+def test_fenced_failover_and_stale_primary_resurrect(tmp_path):
+    """Kill the primary: a standby promotes under a fresh epoch and the
+    deposed primary, resurrected mid-GC-pause beliefs intact, gets every
+    write fenced without mutating state — then demotes and rejoins."""
+
+    async def run():
+        coord = ObjectStore()
+        reps = await _bootstrap(coord, tmp_path, 3)
+        try:
+            client = RemoteStore(
+                "", 0, endpoints=[(r.host, r.api_port) for r in reps])
+            for pod in _pods(5, "pre"):
+                await asyncio.to_thread(client.create, pod)
+            rv = reps[0].store.resource_version
+            assert await reps[1].wait_rv(rv, 5)
+
+            reps[0].kill()
+            assert await _wait(lambda: any(
+                r.store.role == "primary" for r in reps[1:]))
+            new_primary = next(r for r in reps[1:]
+                               if r.store.role == "primary")
+            assert new_primary.store.epoch == 2  # minted, not reused
+
+            # the replica-aware client chases the fenced 409 straight to
+            # the advertised primary: the write lands, no caller retry
+            await asyncio.to_thread(client.create, _pods(1, "post")[0])
+            assert new_primary.store.get(
+                "Pod", "post-0") is not None
+
+            # resurrect the deposed primary: it still believes epoch 1
+            await reps[0].resurrect()
+            assert reps[0].store.role == "primary"
+            assert reps[0].store.epoch == 1
+            rv_before = reps[0].store._rv
+            pinned = RemoteStore(reps[0].host, reps[0].api_port)
+            try:
+                await asyncio.to_thread(
+                    pinned.create, _pods(1, "split")[0])
+                raise AssertionError("stale primary accepted a write")
+            except FencedWrite as e:
+                assert e.epoch == 2
+                assert e.endpoint  # names the current primary
+            assert reps[0].store._rv == rv_before  # nothing leaked
+
+            # first fenced write is the deposition signal: demote, rejoin
+            assert await _wait(
+                lambda: reps[0].store.role == "standby"
+                and reps[0].store._rv >= new_primary.store._rv)
+            assert reps[0].store.get("Pod", "post-0") is not None
+            assert reps[0].store.epoch == 2
+        finally:
+            await _stop_all(reps)
+
+    asyncio.run(run())
+
+
+def test_torn_snapshot_discarded_and_rerequested(tmp_path):
+    """A snapshot torn mid-stream must never be served from: the standby
+    discards the partial state and re-requests until a complete
+    SNAP..END frame lands."""
+
+    async def run():
+        coord = ObjectStore()
+        reps = await _bootstrap(coord, tmp_path, 2)
+        try:
+            client = RemoteStore(
+                "", 0, endpoints=[(r.host, r.api_port) for r in reps])
+            for pod in _pods(16, "snap"):
+                await asyncio.to_thread(client.create, pod)
+            rv = reps[0].store.resource_version
+
+            reps[0].snapshot_fault_after = 3  # abort after 3 OBJ records
+            torn = _replica(2, coord, tmp_path)
+            await torn.start()
+            assert await torn.wait_rv(rv, 8)
+            assert torn.snapshots_discarded >= 1
+            # recovery came from a COMPLETE retry, not the partial state
+            assert len(torn.store.list("Pod")) == 16
+            assert torn.catchups >= 1  # counts COMPLETED catch-ups only
+            await torn.stop()
+        finally:
+            await _stop_all(reps)
+
+    asyncio.run(run())
+
+
+def test_dead_timeline_divergence_forces_snapshot_reset(tmp_path):
+    """Async-replication ack window: the old primary committed writes no
+    standby ever saw, and the new timeline reuses those rv numbers for
+    different objects. A returning replica whose history extends past
+    the shared prefix under an older epoch must be snapshot-reset, never
+    tail-merged — rv ranges alone cannot distinguish the timelines."""
+
+    async def run():
+        coord = ObjectStore()
+        reps = await _bootstrap(coord, tmp_path, 2)
+        old, standby = reps
+        try:
+            eps = [(r.host, r.api_port) for r in reps]
+            client = RemoteStore("", 0, endpoints=eps)
+            for pod in _pods(4, "shared"):
+                await asyncio.to_thread(client.create, pod)
+            assert await standby.wait_rv(old.store.resource_version, 5)
+
+            # sever the standby, then commit writes only the primary has:
+            # acked to the client, never replicated — the ack window
+            standby.partition()
+            pinned_old = RemoteStore(old.host, old.api_port)
+            for pod in _pods(3, "dead"):
+                await asyncio.to_thread(pinned_old.create, pod)
+            dead_rv = old.store._rv
+            assert standby.store._rv < dead_rv
+
+            # primary dies; the healed standby promotes from the shared
+            # prefix and mints epoch 2 — the dead suffix is now aliased
+            old.kill()
+            standby.heal()
+            assert await _wait(
+                lambda: standby.store.role == "primary", 15)
+            pinned_new = RemoteStore(standby.host, standby.api_port)
+            for pod in _pods(3, "alive"):
+                await asyncio.to_thread(pinned_new.create, pod)
+            assert standby.store._rv >= dead_rv  # rv aliasing is live
+
+            # the deposed primary returns, fences, demotes, rejoins: its
+            # have_rv sits past promo_rv under epoch 1 -> forced snapshot
+            await old.resurrect()
+            try:
+                await asyncio.to_thread(
+                    pinned_old.create, _pods(1, "poke")[0])
+            except (FencedWrite, ConnectionError):
+                pass
+            assert await _wait(
+                lambda: old.store.role == "standby"
+                and old.store._rv >= standby.store._rv, 15)
+            assert standby.snapshots_sent >= 1
+            names = {p.metadata.name for p in old.store.list("Pod")}
+            assert names == {p.metadata.name
+                             for p in standby.store.list("Pod")}
+            assert not any(n.startswith("dead-") for n in names)
+            assert {n for n in names if n.startswith("alive-")} == \
+                {"alive-0", "alive-1", "alive-2"}
+        finally:
+            await _stop_all(reps)
+
+    asyncio.run(run())
+
+
+def test_fenced_reply_drops_cached_last_good_endpoint(tmp_path):
+    """Failover-probe ordering vs fencing: `_last_good` points at the
+    deposed primary after it resurrects, and a fenced reply carrying a
+    newer epoch must drop that cache — otherwise every failure episode
+    would probe the deposed primary first for a full grace cycle."""
+
+    async def run():
+        coord = ObjectStore()
+        reps = await _bootstrap(coord, tmp_path, 2)
+        try:
+            client = RemoteStore(
+                "", 0, endpoints=[(r.host, r.api_port) for r in reps])
+            client._active = 0
+            await asyncio.to_thread(client.list, "Pod")
+            assert client._last_good == 0  # old primary answered last
+
+            reps[0].kill()
+            assert await _wait(
+                lambda: reps[1].store.role == "primary", 15)
+            await reps[0].resurrect()  # alive again, believes epoch 1
+
+            client._active = 0  # next write hits the deposed primary
+            await asyncio.to_thread(client.create, _pods(1, "w")[0])
+            # the fenced 409 named epoch 2: the cache was dropped before
+            # the chase, and the write landed on the real primary
+            assert client._fenced_epoch == 2
+            assert client._last_good != 0
+            assert reps[1].store.get("Pod", "w-0") is not None
+            assert reps[0].store._rv <= reps[1].store._rv
+        finally:
+            await _stop_all(reps)
+
+    asyncio.run(run())
+
+
+def test_epoch_monotonic_across_repeated_failovers(tmp_path):
+    """Each promotion mints a strictly greater epoch from the ledger —
+    epochs are never reused even when the same replica wins twice."""
+
+    async def run():
+        coord = ObjectStore()
+        reps = await _bootstrap(coord, tmp_path, 3)
+        try:
+            epochs = [reps[0].store.epoch]
+            assert epochs == [1]
+            victims = [0, 1]
+            for victim in victims:
+                reps[victim].kill()
+                assert await _wait(lambda: any(
+                    not r.killed and r.store.role == "primary"
+                    and r.store.epoch == epochs[-1] + 1
+                    for r in reps), 15)
+                epochs.append(epochs[-1] + 1)
+            assert epochs == [1, 2, 3]
+        finally:
+            await _stop_all(reps)
+
+    asyncio.run(run())
+
+
+def test_bench_store_ha_smoke_subprocess():
+    """bench[store-ha] --smoke end to end: kill the primary mid-workload
+    under the RaceDetector — exactly-once binds, zero fenced-write
+    leaks, gapless witness stream, bounded promotion p99."""
+    env = dict(os.environ)
+    env.update({"JAX_PLATFORMS": "cpu", "BENCH_CONFIGS": "store-ha",
+                "BENCH_STOREHA_NODES": "6", "BENCH_STOREHA_PODS": "18"})
+    proc = subprocess.run(
+        [sys.executable, "bench.py", "--smoke", "--with-race-detector"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=420)
+    assert proc.returncode == 0, proc.stderr[-4000:]
+    last = [ln for ln in proc.stdout.strip().splitlines() if ln][-1]
+    result = json.loads(last)
+    assert "error" not in result, result
+    extras = result["extras"]
+    assert extras["store_ha_promotions"] >= 1
+    assert extras["store_ha_fenced_leaks"] == 0
+    assert extras["store_ha_fenced_rejections"] >= 1
+    assert extras["store_ha_racy_writes"] == 0
+    assert extras["store_ha_epoch"] >= 2
+    assert extras["store_ha_promotion_p99_ms"] < 5000

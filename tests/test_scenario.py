@@ -17,9 +17,14 @@ import pytest
 from kubernetes_tpu.scenario.search import (
     ScenarioSearch,
     ShrunkScenario,
+    nightly_search,
     shrink,
 )
 from kubernetes_tpu.scenario.traces import (
+    BROWNOUT,
+    NODE_FLAP,
+    ApiserverBrownout,
+    CorrelatedZoneFailure,
     Event,
     FlapBurst,
     GangWidthShift,
@@ -66,8 +71,17 @@ def test_event_line_round_trip():
 
 def test_mutation_dict_round_trip():
     for m in (RateSpike(start=4, end=9, mult=3.5),
-              GangWidthShift(factor=2.0), FlapBurst(tick=11, count=3)):
+              GangWidthShift(factor=2.0), FlapBurst(tick=11, count=3),
+              ApiserverBrownout(start=6, end=14, peak=0.4),
+              CorrelatedZoneFailure(tick=9, zone=1, down=3)):
         assert mutation_from_dict(mutation_to_dict(m)) == m
+
+
+def test_brownout_event_line_round_trips_rate():
+    ev = Event(7, BROWNOUT, "", origin=7, rate=0.375)
+    assert Event.from_line(ev.to_line()) == ev
+    # pre-brownout kinds serialise without the field: old tapes parse
+    assert "rate=" not in Event(3, "submit", "j").to_line()
 
 
 def test_rate_spike_mutation_is_local_to_its_window():
@@ -88,6 +102,72 @@ def test_rate_spike_mutation_is_local_to_its_window():
     # ...and leaves every event originating outside it byte-identical
     # (per-tick child RNG streams: no cross-tick draw coupling)
     assert spiked_out == base_out
+
+
+def test_brownout_mutation_adds_ramp_rows_and_nothing_else():
+    """An ApiserverBrownout is RNG-free: it ADDS brownout rows inside
+    its window (triangular ramp, restore row at end) and leaves every
+    other event of the tape — including the window's own submits —
+    byte-identical."""
+    cfg = TraceConfig(seed=11, ticks=64, nodes=8, flap_rate=0.05)
+    base = make_tape(cfg)
+    browned = make_tape(cfg, [ApiserverBrownout(start=20, end=30,
+                                                peak=0.6)])
+
+    rows = [e for e in browned.events if e.kind == BROWNOUT]
+    assert [e.tick for e in rows] == list(range(20, 31))
+    rates = [e.rate for e in rows]
+    assert rates[-1] == 0.0           # restore row at `end`
+    ramp = rates[:-1]
+    peak_at = ramp.index(max(ramp))
+    assert 0 < max(ramp) <= 0.6
+    assert all(a <= b for a, b in zip(ramp[:peak_at], ramp[1:peak_at + 1]))
+    assert all(a >= b for a, b in zip(ramp[peak_at:], ramp[peak_at + 1:]))
+    # same seed, same everything-else: the mutation is purely additive
+    others = [e.to_line() for e in browned.events if e.kind != BROWNOUT]
+    assert others == [e.to_line() for e in base.events]
+    # and the mutated tape still round-trips through text
+    assert Tape.from_text(browned.to_text()).to_text() == browned.to_text()
+
+
+def test_zone_failure_mutation_flaps_exactly_one_zone():
+    """A CorrelatedZoneFailure takes down every node of one positional
+    failure domain at its tick — and, being RNG-free, perturbs nothing
+    else on the tape."""
+    cfg = TraceConfig(seed=11, ticks=64, nodes=8, zones=4, flap_rate=0.05)
+    base = make_tape(cfg)
+    failed = make_tape(cfg, [CorrelatedZoneFailure(tick=33, zone=2,
+                                                   down=5)])
+
+    base_flaps = {(e.tick, e.name, e.down)
+                  for e in base.events if e.kind == NODE_FLAP}
+    new_flaps = [e for e in failed.events if e.kind == NODE_FLAP
+                 and (e.tick, e.name, e.down) not in base_flaps]
+    # zone 2 of 4 over 8 nodes = nodes 4 and 5, all at tick 33
+    assert {(e.tick, e.name, e.down) for e in new_flaps} == \
+        {(33, "soak-00004", 5), (33, "soak-00005", 5)}
+    others = [e.to_line() for e in failed.events
+              if (e.tick, e.name, e.down)
+              not in {(33, "soak-00004", 5), (33, "soak-00005", 5)}]
+    assert others == [e.to_line() for e in base.events]
+    # applying the mutation installs enough zones for the target
+    grown = CorrelatedZoneFailure(tick=1, zone=6).apply(cfg)
+    assert grown.zones == 7
+
+
+def test_tiny_soak_survives_brownout_and_zone_failure():
+    """The soak engine honours brownout rows (FaultPlane error-rate ramp
+    and restore) and correlated zone flaps while holding its gates."""
+    from kubernetes_tpu.scenario.soak import run_soak
+
+    cfg = TraceConfig(seed=42, ticks=10, nodes=4, zones=2, base_rate=1.0)
+    for m in (ApiserverBrownout(start=2, end=6, peak=0.3),
+              CorrelatedZoneFailure(tick=3, zone=1, down=2)):
+        cfg = m.apply(cfg)
+    r = run_soak(cfg, tick_seconds=0.02, snapshot_every=0,
+                 p99_bound_ms=0.0, rss_slack_frac=2.0)
+    assert r.violations == []
+    assert r.converged and r.double_binds == 0
 
 
 # ---------------------------------------------------------------------------
@@ -154,6 +234,56 @@ def test_artifact_round_trips_and_names_the_seed():
     body = "".join(ln + "\n" for ln in art.splitlines()
                    if not ln.startswith("#"))
     assert Tape.from_text(body).to_text() == sh.tape.to_text()
+
+
+def test_nightly_sweep_writes_replay_artifact_on_first_find(tmp_path):
+    """The nightly job runs N independent seeded searches and, at the
+    first violation, auto-writes the shrunk KTPU_SCENARIO_SEED artifact
+    — then stops (the morning replay wants ONE minimal scenario, not a
+    pile)."""
+    out = tmp_path / "artifact.txt"
+    lines = []
+
+    def make_config(seed):
+        return TraceConfig(seed=seed, ticks=48, nodes=8,
+                           gang_fraction=0.4)
+
+    r = nightly_search(make_config, _wide_gang_evaluator, base_seed=5,
+                       nights=3, rounds=6, out_path=str(out),
+                       log=lines.append)
+    assert r.found_seed is not None
+    assert r.seeds[0] == 5 and r.seeds[-1] == r.found_seed
+    assert r.artifact_path == str(out) and out.exists()
+    art = out.read_text()
+    assert f"KTPU_SCENARIO_SEED={r.found_seed}" in art
+    assert any(str(out) in ln for ln in lines)
+    # the artifact replays standalone: strip comments, parse, re-violate
+    body = "".join(ln + "\n" for ln in art.splitlines()
+                   if not ln.startswith("#"))
+    assert _wide_gang_evaluator(Tape.from_text(body))[0]
+    # determinism: the same sweep finds the same night and same tape
+    out2 = tmp_path / "artifact2.txt"
+    r2 = nightly_search(make_config, _wide_gang_evaluator, base_seed=5,
+                        nights=3, rounds=6, out_path=str(out2))
+    assert r2.found_seed == r.found_seed
+    assert out2.read_text() == art
+
+
+def test_nightly_sweep_clean_run_writes_nothing(tmp_path):
+    out = tmp_path / "artifact.txt"
+
+    def make_config(seed):
+        return TraceConfig(seed=seed, ticks=16, nodes=8,
+                           gang_fraction=0.0)  # no gangs: never violates
+
+    def never(tape):
+        return [], 0.0
+
+    r = nightly_search(make_config, never, base_seed=1, nights=2,
+                       rounds=2, out_path=str(out))
+    assert r.found_seed is None and r.result is None
+    assert r.seeds == [1, 2]
+    assert not out.exists()
 
 
 def _is_shrunk(x):
