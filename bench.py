@@ -206,10 +206,12 @@ def main() -> None:
         os.environ.setdefault("BENCH_SOAK_RSS_SLACK", "0.6")
         os.environ.setdefault("BENCH_STOREHA_NODES", "8")
         os.environ.setdefault("BENCH_STOREHA_PODS", "36")
+        os.environ.setdefault("BENCH_FED_CLUSTERS", "3")
+        os.environ.setdefault("BENCH_FED_PODS", "16")
         os.environ.setdefault(
             "BENCH_CONFIGS",
             "headline,gang,preemption,autoscaler,sharded,monitor,defrag,"
-            "solver-svc,soak,store-ha")
+            "solver-svc,soak,store-ha,fed")
         os.environ.setdefault("BENCH_TIMEOUT_S", "600")
     timeout = int(os.environ.get("BENCH_TIMEOUT_S", "1800"))
     signal.signal(signal.SIGALRM, _die_with_timeout)
@@ -221,7 +223,7 @@ def main() -> None:
         "BENCH_CONFIGS",
         "headline,interpod,spread,gang,preemption,recovery,chaos,overload,"
         "device,autoscaler,monitor,ha,fanout-xl,multiproc,defrag,"
-        "solver-svc,store-ha")
+        "solver-svc,store-ha,fed")
     configs = [c.strip() for c in configs.split(",") if c.strip()]
     metrics_snapshot = "--metrics-snapshot" in sys.argv[1:] or \
         os.environ.get("BENCH_METRICS_SNAPSHOT", "") in ("1", "true")
@@ -704,6 +706,57 @@ def main() -> None:
                 f"store-ha drill under race detector (seed {r.seed}): "
                 f"{r.racy_writes} racy writes, {r.loop_stalls} event-loop "
                 f"stalls (max {r.max_stall_ms:.0f}ms)")
+
+    if "fed" in configs:
+        from kubernetes_tpu.perf.harness import run_federation
+
+        # federation global-planning drill: a hub control plane (health +
+        # sync + GlobalPlanner) over BENCH_FED_CLUSTERS in-process member
+        # control planes places a mixed `placement: global` workload set
+        # (incl. one gang) via the stock device solver, then member 0 is
+        # saturated mid-run (nodes gone, NodeGroup pinned at max — zero
+        # autoscaler headroom). Contract: every workload's replicas land
+        # across clusters exactly once (member copies sum to the hub
+        # total and match the plan), the planner records >= 1 spillover
+        # and drains the victim to zero, convergence within the bench
+        # timeout, and zero racy hub writes under the RaceDetector
+        fed_clusters = int(os.environ.get("BENCH_FED_CLUSTERS", "4"))
+        fed_pods = int(os.environ.get("BENCH_FED_PODS", "24"))
+        fed_seed = int(os.environ.get("BENCH_FED_SEED", "2032"))
+        race_detect = "--with-race-detector" in sys.argv[1:] or \
+            os.environ.get("BENCH_RACE_DETECTOR", "") in ("1", "true")
+        r = run_federation(fed_clusters, fed_pods, seed=fed_seed,
+                           race_detect=race_detect)
+        print(f"bench[fed]: {r}", file=sys.stderr, flush=True)
+        extras["fed_clusters"] = r.clusters
+        extras["fed_workloads"] = r.workloads
+        extras["fed_planned"] = r.planned
+        extras["fed_placed"] = r.placed
+        extras["fed_spillovers"] = r.spillovers
+        extras["fed_cycles"] = r.cycles
+        extras["fed_solves"] = r.solves
+        extras["fed_solve_ms"] = round(r.solve_p50_ms, 2)
+        extras["fed_seed"] = r.seed
+        if race_detect:
+            extras["fed_racy_writes"] = r.racy_writes
+        if not r.converged:
+            RESULT["error"] = (
+                f"fed drill did not converge (seed {r.seed}): "
+                f"{r.planned}/{r.workloads} planned, "
+                f"{r.placed} replicas placed")
+        elif not r.exactly_once or r.duplicate_placements:
+            RESULT["error"] = (
+                f"fed drill (seed {r.seed}): placement not exactly-once "
+                f"({r.duplicate_placements} duplicated workloads)")
+        elif r.spillovers < 1 or not r.victim_drained:
+            RESULT["error"] = (
+                f"fed drill (seed {r.seed}): saturated member did not "
+                f"spill ({r.spillovers} spillovers, victim drained: "
+                f"{r.victim_drained})")
+        elif race_detect and r.racy_writes:
+            RESULT["error"] = (
+                f"fed drill under race detector (seed {r.seed}): "
+                f"{r.racy_writes} racy hub writes")
 
     if "fanout-xl" in configs:
         from kubernetes_tpu.perf.harness import run_fanout_xl

@@ -706,7 +706,15 @@ class SpanDiscipline:
     `store_replication_` family prefix — failover dashboards and the
     bench[store-ha] gate select on that namespace, and a bare
     `promotions_total` would alias leader-election families from the
-    client package on the same scrape."""
+    client package on the same scrape.
+
+    Seventh check: federation-plane naming. Metric families DEFINED in
+    `kubernetes_tpu/federation/` carry the `federation_` prefix (the
+    GlobalPlanner's `federation_planner_{cycles,placements,spillovers}
+    _total` / `federation_planner_solve_seconds` set the pattern) — the
+    hub scrapes its own apiserver AND every member's, so a bare
+    `placements_total` from the planner would shadow member scheduler
+    families on the federated dashboard."""
 
     name = "span-discipline"
 
@@ -717,6 +725,7 @@ class SpanDiscipline:
         yield from self._check_profiling_names(mod)
         yield from self._check_solversvc_names(mod)
         yield from self._check_replication_names(mod)
+        yield from self._check_federation_names(mod)
 
     def _check_span_lifecycle(self, mod: Module):
         sanctioned: set[int] = set()
@@ -899,6 +908,27 @@ class SpanDiscipline:
                     "dashboards and the bench[store-ha] gate select on "
                     "that namespace, and bare names alias the client "
                     "package's leader-election families")
+
+    def _check_federation_names(self, mod: Module):
+        if not mod.relpath.startswith("kubernetes_tpu/federation/"):
+            return
+        for node in ast.walk(mod.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in ("counter", "gauge",
+                                           "histogram")):
+                continue
+            arg = node.args[0] if node.args else None
+            if isinstance(arg, ast.Constant) \
+                    and isinstance(arg.value, str) \
+                    and not arg.value.startswith("federation_"):
+                yield Finding(
+                    self.name, mod.relpath, node.lineno, node.col_offset,
+                    f"federation family {arg.value!r} must carry the "
+                    "federation_ prefix — the hub scrapes its own and "
+                    "every member's apiserver, and a bare planner family "
+                    "would shadow member scheduler families on the "
+                    "federated dashboard")
 
 
 # ---------------------------------------------------------------------------

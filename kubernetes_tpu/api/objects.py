@@ -1013,19 +1013,60 @@ class GenericObject:
 class Cluster(_SpecStatusObject):
     """federation/v1beta1 Cluster: a member cluster registered with the
     federation control plane (reference federation/apis/federation/types.go;
-    spec.serverAddress points at the member apiserver)."""
+    spec.serverAddress points at the member apiserver).
+
+    status.capacity is written by the ClusterHealthController's probe:
+    {allocatable, free (both v1 resource maps summed over the member's
+    schedulable Ready nodes; free = allocatable minus bound pod requests),
+    zones (sorted zone labels seen on those nodes), nodes (count),
+    headroom (sum over the member's NodeGroups of maxSize minus the
+    larger of targetSize/readyNodes — how many more nodes its autoscaler
+    may still add; 0 with no NodeGroups: no growth possible)}.
+    status.planner is written by the federation GlobalPlanner."""
 
     kind = "Cluster"
     api_version = "federation/v1beta1"
 
     @property
     def server_address(self) -> str:
-        return self.spec.get("serverAddress", "")
+        addr = self.spec.get("serverAddress", "")
+        if addr:
+            return addr
+        # kubefed join writes the CIDR-keyed form (join.go): first
+        # populated entry wins
+        for entry in self.spec.get("serverAddressByClientCIDRs") or []:
+            if entry.get("serverAddress"):
+                return entry["serverAddress"]
+        return ""
 
     @property
     def ready(self) -> bool:
         return any(c.get("type") == "Ready" and c.get("status") == "True"
                    for c in self.status.get("conditions", []))
+
+    @property
+    def capacity(self) -> dict[str, Any]:
+        return self.status.get("capacity") or {}
+
+    @property
+    def allocatable_capacity(self) -> dict[str, str]:
+        return dict(self.capacity.get("allocatable") or {})
+
+    @property
+    def free_capacity(self) -> dict[str, str]:
+        return dict(self.capacity.get("free") or {})
+
+    @property
+    def zones(self) -> tuple[str, ...]:
+        return tuple(self.capacity.get("zones") or ())
+
+    @property
+    def headroom(self) -> int:
+        return int(self.capacity.get("headroom", 0) or 0)
+
+    @property
+    def planner_status(self) -> dict[str, Any]:
+        return self.status.get("planner") or {}
 
 
 @dataclass

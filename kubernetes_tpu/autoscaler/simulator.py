@@ -181,6 +181,21 @@ class ScaleSimulator:
         self.solve_count += 1
         return assignments, placed
 
+    def solve_assignments(self, pods) -> list[str | None]:
+        """One solve of the batch against the current (real + hypothetical)
+        state: per-pod node NAME, None = unplaced. The federation
+        GlobalPlanner's entry point — its rows are whole member clusters,
+        so names (not row indices) are the meaningful unit. Pods beyond
+        batch_pods are reported unplaced (callers re-batch the tail)."""
+        if not pods:
+            return []
+        assignments, _placed = self._solve(pods)
+        names: list[str | None] = [
+            self.statedb.table.name_of[a] if a >= 0 else None
+            for a in assignments.tolist()]
+        names.extend([None] * (len(pods) - len(names)))
+        return names
+
     def baseline_placed(self, pods) -> int:
         """k=0 probe: how many of the pending pods fit the cluster as-is."""
         if not pods:

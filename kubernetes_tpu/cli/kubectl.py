@@ -79,6 +79,7 @@ ALIASES = {
     "rolebinding": "rolebindings",
     "clusterrolebinding": "clusterrolebindings",
     "alertrule": "alertrules",
+    "cluster": "clusters",
 }
 
 
@@ -171,7 +172,32 @@ def _row(kind: str, obj, wide: bool) -> list[str]:
         return [obj.metadata.name,
                 "alert" if obj.alert else "record", expr,
                 f"{obj.for_s:g}s" if obj.alert else "-", _age(obj)]
+    if kind == "Cluster":
+        alloc = obj.allocatable_capacity
+        capacity = ",".join(alloc[r] for r in ("cpu", "memory")
+                            if r in alloc) or "<unknown>"
+        return [obj.metadata.name, str(obj.ready), capacity,
+                _cluster_allocated(alloc, obj.free_capacity),
+                ",".join(obj.zones) or "<none>", _age(obj)]
     return [obj.metadata.name, _age(obj)]
+
+
+def _cluster_allocated(alloc: dict, free: dict) -> str:
+    """allocatable minus free = what the member's bound pods hold."""
+    from kubernetes_tpu.api.quantity import parse_quantity
+
+    out = []
+    for res, fmt in (("cpu", lambda f: f"{int(f * 1000)}m"),
+                     ("memory", lambda f: f"{int(f / (1 << 20))}Mi")):
+        if res not in alloc:
+            continue
+        try:
+            used = parse_quantity(alloc[res]) - parse_quantity(
+                free.get(res, "0"))
+        except ValueError:
+            continue
+        out.append(fmt(max(0, used)))
+    return ",".join(out) or "<unknown>"
 
 
 HEADERS = {
@@ -191,6 +217,7 @@ HEADERS = {
     "NodeGroup": ["NAME", "MIN", "MAX", "TARGET", "READY", "AGE"],
     "DeschedulePolicy": ["NAME", "DRY-RUN", "MAX-MOVES", "CUTOFF", "AGE"],
     "AlertRule": ["NAME", "TYPE", "EXPR", "FOR", "AGE"],
+    "Cluster": ["NAME", "READY", "CAPACITY", "ALLOCATED", "ZONES", "AGE"],
 }
 
 
@@ -333,6 +360,15 @@ def cmd_describe(client, args) -> int:
     kind = RESOURCES[resolve_resource(args.resource)]
     obj = client.get(kind, args.name, args.namespace)
     print(json.dumps(obj.to_dict(), indent=2))
+    if kind == "Cluster" and obj.planner_status:
+        planner = obj.planner_status
+        print("\nPlanner:")
+        print(f"  Placements:\t{planner.get('placements', 0)}")
+        print(f"  Spillovers:\t{planner.get('spillovers', 0)}")
+        print(f"  Masked:\t{planner.get('masked', False)}")
+        for workload, count in sorted(
+                (planner.get("lastDecision") or {}).items()):
+            print(f"  Decision:\t{workload} -> {count} replicas")
     # related events, the describe signature feature
     events = [e for e in client.list("Event", namespace=args.namespace)
               if e.involved_object.get("name") == args.name]

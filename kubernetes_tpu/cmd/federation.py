@@ -1,0 +1,150 @@
+"""The standalone federation control-plane binary.
+
+Runs the federation hub's controller set — cluster health (with capacity
+reporting), multi-type workload sync, service DNS, and optionally the
+GlobalPlanner — against a federation apiserver, resolving each member
+Cluster's `spec.serverAddress` to a RemoteStore:
+
+    python -m kubernetes_tpu.cmd.federation \
+        --apiserver http://127.0.0.1:8080 --planner --leader-elect
+
+Leader election guards the whole control plane: the GlobalPlanner and the
+sync controllers must run as ONE instance or two planners would stamp
+over each other's plan annotations (same discipline as the descheduler).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+import os
+import socket
+import sys
+from urllib.parse import urlsplit
+
+log = logging.getLogger(__name__)
+
+
+def parse_args(argv=None) -> argparse.Namespace:
+    p = argparse.ArgumentParser(
+        prog="kubernetes-tpu-federation",
+        description="federation control plane (health + sync + planner)")
+    p.add_argument("--apiserver", required=True,
+                   help="federation apiserver URL (the hub store)")
+    p.add_argument("--token", default=os.environ.get("KUBE_TOKEN", ""),
+                   help="bearer token for an authn-enabled apiserver "
+                        "(env KUBE_TOKEN)")
+    p.add_argument("--leader-elect", action="store_true")
+    p.add_argument("--port", type=int, default=10272,
+                   help="serve /metrics, /healthz and /readyz here "
+                        "(0 = ephemeral)")
+    p.add_argument("--lock-object-name", default="federation")
+    p.add_argument("--lock-object-namespace", default="kube-system")
+    p.add_argument("--federation-name", default="fed")
+    p.add_argument("--dns-zone", default="example.com")
+    p.add_argument("--health-period", type=float, default=10.0,
+                   help="member probe cadence (Ready + capacity report)")
+    p.add_argument("--planner", action="store_true",
+                   help="run the GlobalPlanner (device-solved cross-"
+                        "cluster placement for placement=global workloads)")
+    p.add_argument("--plan-interval", type=float, default=2.0)
+    p.add_argument("--lease-duration", type=float, default=15.0)
+    p.add_argument("--renew-deadline", type=float, default=10.0)
+    p.add_argument("--retry-period", type=float, default=2.0)
+    return p.parse_args(argv)
+
+
+def member_client_factory(token: str = ""):
+    """Resolve a member Cluster to a RemoteStore for its serverAddress
+    (one cached client per address — probes run every few seconds)."""
+    from kubernetes_tpu.apiserver.http import RemoteStore
+
+    clients: dict[str, RemoteStore] = {}
+
+    def factory(cluster):
+        address = cluster.server_address
+        if not address:
+            raise ConnectionError(
+                f"cluster {cluster.metadata.name} has no serverAddress")
+        client = clients.get(address)
+        if client is None:
+            url = urlsplit(address)
+            client = RemoteStore(url.hostname, url.port or 80, token=token)
+            clients[address] = client
+        return client
+
+    return factory
+
+
+async def run(args: argparse.Namespace) -> None:
+    from kubernetes_tpu.apiserver.http import RemoteStore
+    from kubernetes_tpu.federation.kubefed import FederationControlPlane
+
+    url = urlsplit(args.apiserver)
+    store = RemoteStore(url.hostname, url.port or 80, token=args.token)
+    plane = FederationControlPlane(
+        store, member_client_factory(args.token),
+        federation_name=args.federation_name,
+        dns_zone=args.dns_zone,
+        health_period=args.health_period,
+        planner=args.planner,
+        plan_interval=args.plan_interval)
+
+    from kubernetes_tpu.obs.http import ObsServer
+
+    obs = ObsServer(
+        ready_checks={"informers-synced":
+                      lambda: plane.clusters._synced.is_set()
+                      and plane.workloads._synced.is_set()},
+        port=args.port)
+    try:
+        await obs.start()
+        log.info("observability endpoints on %s", obs.url)
+    except OSError as e:
+        log.warning("observability endpoints disabled "
+                    "(port %d unavailable: %s)", args.port, e)
+        obs = None
+
+    async def lead():
+        await plane.start()
+        log.info("federation control plane running against %s%s",
+                 args.apiserver,
+                 " (planner on)" if args.planner else "")
+        await asyncio.Event().wait()
+
+    try:
+        if args.leader_elect:
+            from kubernetes_tpu.client.leaderelection import LeaderElector
+
+            elector = LeaderElector(
+                store, f"{socket.gethostname()}_{os.getpid()}",
+                lock_name=args.lock_object_name,
+                lock_namespace=args.lock_object_namespace,
+                lease_duration=args.lease_duration,
+                renew_deadline=args.renew_deadline,
+                retry_period=args.retry_period,
+                on_started_leading=lead)
+            await elector.run()
+            log.warning("lost leader lease; exiting")
+        else:
+            await lead()
+    finally:
+        plane.stop()
+        if obs is not None:
+            await obs.stop()
+
+
+def main(argv=None) -> int:
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(levelname).1s %(name)s: %(message)s")
+    try:
+        asyncio.run(run(parse_args(argv)))
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

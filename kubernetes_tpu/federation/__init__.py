@@ -1,3 +1,4 @@
+from kubernetes_tpu.federation.planner import GlobalPlanner  # noqa: F401
 from kubernetes_tpu.federation.sync import (  # noqa: F401
     ClusterHealthController,
     FederatedSyncController,

@@ -60,12 +60,15 @@ class FederationControlPlane:
     def __init__(self, fed_store: ObjectStore, client_factory,
                  dns=None, federation_name: str = "fed",
                  dns_zone: str = "example.com",
-                 health_period: float = 1.0):
+                 health_period: float = 1.0,
+                 planner: bool = False, plan_interval: float = 1.0,
+                 planner_caps=None, solver_service=None):
         from kubernetes_tpu.federation.dns import (
             FakeDNSProvider,
             FederatedServiceController,
         )
         from kubernetes_tpu.federation.sync import (
+            SYNCED_KINDS,
             ClusterHealthController,
             FederatedSyncController,
         )
@@ -73,31 +76,56 @@ class FederationControlPlane:
         self.store = fed_store
         self.dns = dns if dns is not None else FakeDNSProvider()
         self.clusters = Informer(fed_store, "Cluster")
-        self.workloads = Informer(fed_store, "ReplicaSet")
+        self.workload_informers = {
+            kind: Informer(fed_store, kind) for kind in SYNCED_KINDS}
+        self.workloads = self.workload_informers["ReplicaSet"]
         self.services = Informer(fed_store, "Service")
         self.health = ClusterHealthController(
             fed_store, self.clusters, client_factory,
             monitor_period=health_period)
         self.sync = FederatedSyncController(
-            fed_store, self.workloads, self.clusters, client_factory)
+            fed_store, self.workloads, self.clusters, client_factory,
+            informers={k: v for k, v in self.workload_informers.items()
+                       if k != "ReplicaSet"})
         self.service_dns = FederatedServiceController(
             fed_store, self.services, self.clusters, client_factory,
             self.dns, federation_name=federation_name, dns_zone=dns_zone)
+        self.planner = None
+        if planner:
+            from kubernetes_tpu.federation.planner import (
+                PLANNED_KINDS,
+                GlobalPlanner,
+            )
+
+            self.planner = GlobalPlanner(
+                fed_store, self.clusters,
+                {k: self.workload_informers[k] for k in PLANNED_KINDS},
+                caps=planner_caps, plan_interval=plan_interval,
+                solver_service=solver_service,
+                sync_controller=self.sync)
+
+    def _informers(self):
+        return (self.clusters, self.services,
+                *self.workload_informers.values())
 
     async def start(self) -> None:
-        for informer in (self.clusters, self.workloads, self.services):
+        for informer in self._informers():
             informer.start()
-        for informer in (self.clusters, self.workloads, self.services):
+        for informer in self._informers():
             await informer.wait_for_sync()
         await self.health.start()
         await self.sync.start()
         await self.service_dns.start()
+        if self.planner is not None:
+            await self.planner.start()
 
     def stop(self) -> None:
+        if self.planner is not None:
+            self.planner.stop()
         self.service_dns.stop()
         self.sync.stop()
         self.health.stop()
-        for informer in (self.clusters, self.workloads, self.services):
+        for informer in self._informers():
             informer.stop()
 
 

@@ -1,0 +1,550 @@
+"""GlobalPlanner: device-solved cross-cluster placement with spillover.
+
+ROADMAP item 4's observation made concrete: the batched filter/score
+program in `ops/solver.py` answers *cross-cluster* placement unchanged if
+each Ready member cluster becomes one "node" row. The planner owns a
+private ScaleSimulator twin (the autoscaler's what-if engine — same
+StateDB/EncodeCache/jit-cache shape, zero new BatchFlags) whose rows are
+synthetic Nodes built from each member's reported aggregate free capacity
+(`Cluster.status.capacity`, written by the ClusterHealthController probe);
+globally-placed workloads — ReplicaSets/Deployments/PodGroups annotated
+`federation.ktpu.io/placement: global` — become synthetic pod rows, one
+per replica, with gang semantics preserved through the existing
+gang_id/gang_min columns (a PodGroup or gang-annotated workload places at
+quorum across clusters or not at all). One solve assigns every replica a
+cluster; the decision lands as the `federation.ktpu.io/planned-placement`
+annotation on the hub object, which the FederatedSyncController consumes
+in place of its weighted split — the ensure machinery (create / rescale /
+delete per member) is unchanged.
+
+Spillover: a placement that a member *rejects* (the sync controller's
+rejection ledger) or that *overcommits* a member — the planner's own
+charged demand exceeds the member's refreshed free capacity while its
+reported autoscaler headroom is exhausted (every NodeGroup at max-size) —
+masks that cluster's row for `mask_cycles` planning cycles and re-enters
+the affected workloads into the next batch, so demand drains to siblings
+instead of wedging.
+
+Composes both ways: pass `solver_service=` to mount the planner as a
+`solversvc/` tenant (the hub becomes one more client of
+solver-as-a-service instead of owning a device program), and every plan
+write stamps a traceparent (`trace.ktpu.io/context`) that rides the
+synced objects so one trace stitches hub decision -> member bind.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import copy
+import hashlib
+import json
+import logging
+import time
+
+import numpy as np
+
+from kubernetes_tpu.api.objects import Cluster, Node, Pod
+from kubernetes_tpu.apiserver.store import Conflict, NotFound, ObjectStore
+from kubernetes_tpu.client.informer import Informer
+from kubernetes_tpu.gang import GROUP_MIN_ANNOTATION, GROUP_NAME_ANNOTATION
+from kubernetes_tpu.models.policy import DEFAULT_POLICY, Policy
+from kubernetes_tpu.obs.tracing import TRACE_ANNOTATION, TRACER
+from kubernetes_tpu.state.cluster_state import pod_requests, resource_rows
+from kubernetes_tpu.state.layout import Capacities, Resource
+from kubernetes_tpu.utils.clock import SYSTEM_CLOCK, Clock
+
+log = logging.getLogger(__name__)
+
+# opt-in: only annotated workloads are planned globally (everything else
+# keeps the weighted-split path)
+PLACEMENT_ANNOTATION = "federation.ktpu.io/placement"
+PLACEMENT_GLOBAL = "global"
+# the planner's decision, consumed by FederatedSyncController in place of
+# split_replicas: {"clusters": {name: replicas}, "replicas": total,
+# "template": fingerprint, "unplaced": n}
+PLAN_ANNOTATION = "federation.ktpu.io/planned-placement"
+ZONE_LABEL = "failure-domain.beta.kubernetes.io/zone"
+# synthetic-row name prefix for plan pods ("~" is illegal in DNS-1123, so
+# a plan row can never collide with a real object; SIM_NODE_PREFIX idiom)
+PLAN_POD_PREFIX = "~plan~"
+
+# the workload kinds the planner reads (PodGroups place whole gangs)
+PLANNED_KINDS = ("ReplicaSet", "Deployment", "PodGroup")
+
+
+def _metrics() -> tuple:
+    global _METRICS
+    if _METRICS is None:
+        from kubernetes_tpu.obs import metrics as m
+
+        _METRICS = (
+            m.REGISTRY.counter(
+                "federation_planner_cycles_total",
+                "Planning cycles the GlobalPlanner has run."),
+            m.REGISTRY.counter(
+                "federation_planner_placements_total",
+                "Workload plans written (one per workload per decision)."),
+            m.REGISTRY.counter(
+                "federation_planner_spillovers_total",
+                "Workloads re-entered after a member rejection or "
+                "headroom-exhausted overcommit masked their cluster."),
+            m.REGISTRY.histogram(
+                "federation_planner_solve_seconds",
+                "One batched cross-cluster device solve."),
+        )
+    return _METRICS
+
+
+_METRICS = None
+
+
+def is_global(obj) -> bool:
+    """Does this workload opt into planner-driven placement?"""
+    return obj.metadata.annotations.get(PLACEMENT_ANNOTATION) \
+        == PLACEMENT_GLOBAL
+
+
+def parse_plan(obj) -> dict | None:
+    """The planner's decision annotation, or None when absent/corrupt."""
+    raw = obj.metadata.annotations.get(PLAN_ANNOTATION)
+    if not raw:
+        return None
+    try:
+        doc = json.loads(raw)
+    except ValueError:
+        return None
+    if not isinstance(doc, dict) or \
+            not isinstance(doc.get("clusters"), dict):
+        return None
+    return doc
+
+
+def template_fingerprint(obj) -> str:
+    """Stable digest of the pod template: a template edit re-plans (the
+    requests the rows are charged with may have changed)."""
+    blob = json.dumps(obj.spec.get("template") or {}, sort_keys=True)
+    return hashlib.sha1(blob.encode()).hexdigest()[:12]
+
+
+def format_capacity(rows: np.ndarray) -> dict[str, str]:
+    """Device-unit resource vector -> v1 quantity map (negative values
+    clamp to 0: a member may report less free than the planner charged)."""
+    out: dict[str, str] = {}
+    for name, (row, kind) in Resource.NAMES.items():
+        v = max(0, int(rows[row]))
+        if v == 0 and name not in ("cpu", "memory", "pods"):
+            continue
+        if kind == "milli":
+            out[name] = f"{v}m"
+        elif kind == "mem":
+            out[name] = f"{v}Mi"
+        else:
+            out[name] = str(v)
+    return out
+
+
+def cluster_node(cluster: Cluster,
+                 free: dict[str, str] | None = None) -> Node:
+    """Encode one Ready member as a schedulable node row. Allocatable is
+    the member's reported aggregate free capacity (optionally pre-charged
+    by the caller); a single-zone member carries its zone label so
+    zone-aware templates keep meaning at cluster granularity."""
+    name = cluster.metadata.name
+    labels = {"kubernetes.io/hostname": name}
+    zones = cluster.zones
+    if len(zones) == 1:
+        labels[ZONE_LABEL] = zones[0]
+    cap = dict(free if free is not None else cluster.free_capacity)
+    return Node.from_dict({
+        "metadata": {"name": name, "labels": labels},
+        "status": {"allocatable": cap, "capacity": dict(cap),
+                   "conditions": [{"type": "Ready", "status": "True"}]}})
+
+
+def workload_gang(obj) -> tuple[int, int] | None:
+    """(members, quorum) for a gang workload, None for a plain one. A
+    PodGroup is always a gang (spec.minMember); a ReplicaSet/Deployment
+    opts in with the scheduling.ktpu.io/group-name annotation."""
+    if obj.kind == "PodGroup":
+        members = int(obj.spec.get("members") or obj.min_member)
+        return max(members, obj.min_member), obj.min_member
+    if GROUP_NAME_ANNOTATION not in obj.metadata.annotations:
+        return None
+    members = obj.replicas
+    raw = obj.metadata.annotations.get(GROUP_MIN_ANNOTATION)
+    try:
+        quorum = int(raw) if raw else members
+    except ValueError:
+        quorum = members
+    return members, max(1, min(quorum, members))
+
+
+def workload_replicas(obj) -> int:
+    if obj.kind == "PodGroup":
+        return workload_gang(obj)[0]
+    return obj.replicas
+
+
+def workload_pods(obj) -> list[Pod]:
+    """Synthetic pod rows for one globally-placed workload: one per
+    replica, carrying the template's requests; gang workloads carry the
+    group annotations so the simulator's gang columns (contiguous runs,
+    all-or-nothing at quorum) apply unchanged."""
+    template = obj.spec.get("template") or {}
+    spec = copy.deepcopy(template.get("spec") or {})
+    spec.pop("nodeName", None)  # plan rows are never pre-bound
+    labels = dict((template.get("metadata") or {}).get("labels") or {})
+    gang = workload_gang(obj)
+    count = gang[0] if gang else workload_replicas(obj)
+    annotations: dict[str, str] = {}
+    if gang:
+        annotations[GROUP_NAME_ANNOTATION] = \
+            f"{PLAN_POD_PREFIX}{obj.kind}~{obj.metadata.name}"
+        annotations[GROUP_MIN_ANNOTATION] = str(gang[1])
+    ns = obj.metadata.namespace
+    pods = []
+    for i in range(count):
+        pods.append(Pod.from_dict({
+            "metadata": {
+                "name": f"{PLAN_POD_PREFIX}{obj.kind}~"
+                        f"{obj.metadata.name}~{i}",
+                "namespace": ns,
+                "labels": labels,
+                "annotations": dict(annotations)},
+            "spec": spec}))
+    return pods
+
+
+class GlobalPlanner:
+    """The federation hub's planning loop (leader-electable like the
+    descheduler: run one instance, or put it behind a LeaderElector).
+
+    Per cycle: refresh cluster rows from Ready members' reported capacity
+    (charged with the planner's own outstanding plans so batches
+    compose), detect spillover (rejections + headroom-exhausted
+    overcommit -> mask rows, re-enter workloads), encode every workload
+    needing a plan as synthetic pod rows, run ONE batched device solve,
+    and write each decision back as the plan annotation the sync
+    controller consumes."""
+
+    def __init__(self, fed_store: ObjectStore, cluster_informer: Informer,
+                 workload_informers: dict[str, Informer],
+                 caps: Capacities | None = None,
+                 policy: Policy = DEFAULT_POLICY,
+                 plan_interval: float = 1.0,
+                 mask_cycles: int = 3,
+                 solver_service=None, solver_tenant: str = "federation",
+                 sync_controller=None,
+                 clock: Clock = SYSTEM_CLOCK):
+        self.store = fed_store
+        self.clusters = cluster_informer
+        self.workloads = dict(workload_informers)
+        self.caps = caps or Capacities(num_nodes=32, batch_pods=64)
+        self.plan_interval = plan_interval
+        self.mask_cycles = mask_cycles
+        self.clock = clock
+        self.svc = solver_service
+        self.tenant = solver_tenant
+        self.sync = sync_controller
+        self.sim = None
+        if solver_service is None:
+            from kubernetes_tpu.autoscaler.simulator import ScaleSimulator
+
+            self.sim = ScaleSimulator(caps=self.caps, policy=policy)
+        else:
+            solver_service.register_tenant(solver_tenant)
+        self._rows: set[str] = set()          # cluster names encoded
+        self._masked: dict[str, int] = {}     # cluster -> cycles left
+        self._replan: set[tuple[str, str]] = set()   # (kind, key)
+        self._task: asyncio.Task | None = None
+        # counters mirrored as attributes for tests/bench
+        self.cycles = 0
+        self.placements = 0
+        self.spillovers = 0
+        self.spill_by_cluster: dict[str, int] = {}
+        self.solve_count = 0
+        self.solve_seconds = 0.0
+        self.last_decision: dict[str, dict[str, int]] = {}
+
+    # ---- lifecycle ----
+
+    async def start(self) -> None:
+        loop = asyncio.get_running_loop()
+        self._task = loop.create_task(self._loop())
+
+    def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            self._task = None
+
+    async def _loop(self) -> None:
+        while True:
+            try:
+                await self.run_once()
+            except asyncio.CancelledError:
+                return
+            except Exception:  # noqa: BLE001 — planner must survive a bad cycle
+                log.exception("global planner cycle failed")
+            await asyncio.sleep(self.plan_interval)
+
+    # ---- planning cycle ----
+
+    async def run_once(self) -> int:
+        """One planning cycle; returns the number of plans written."""
+        _metrics()[0].inc()
+        self.cycles += 1
+        members = {c.metadata.name: c
+                   for c in self.clusters.items()
+                   if c.ready and c.capacity}
+        self._age_masks(members)
+
+        pending = self._pending_workloads()
+        planned = [(obj, plan) for obj, plan in self._planned_workloads()
+                   if (obj.kind, obj.key) not in
+                   {(o.kind, o.key) for o in pending}]
+        self._detect_spillover(members, planned)
+        if self._replan:
+            keys = {(o.kind, o.key) for o in pending}
+            for obj, _plan in planned:
+                if (obj.kind, obj.key) in self._replan and \
+                        (obj.kind, obj.key) not in keys:
+                    pending.append(obj)
+            planned = [(o, p) for o, p in planned
+                       if (o.kind, o.key) not in self._replan]
+
+        charged = self._charged(planned)
+        await self._sync_rows(members, charged)
+        if not pending or not (set(members) - set(self._masked)):
+            return 0
+
+        batch: list[Pod] = []
+        spans: list[tuple[object, int, int]] = []
+        for obj in pending:
+            pods = workload_pods(obj)
+            if not pods:
+                continue
+            if len(batch) + len(pods) > self.caps.batch_pods:
+                continue  # tail waits for the next cycle's batch
+            spans.append((obj, len(batch), len(pods)))
+            batch.extend(pods)
+        if not batch:
+            return 0
+
+        t0 = time.perf_counter()
+        names = await self._solve(batch)
+        dt = time.perf_counter() - t0
+        self.solve_count += 1
+        self.solve_seconds += dt
+        _metrics()[3].observe(dt)
+
+        written = 0
+        with TRACER.start_span(
+                "federation.plan",
+                attrs={"workloads": len(spans),
+                       "clusters": len(members)}) as cycle_span:
+            for obj, start, count in spans:
+                assigned = names[start:start + count]
+                counts: dict[str, int] = {}
+                for n in assigned:
+                    if n is not None:
+                        counts[n] = counts.get(n, 0) + 1
+                unplaced = count - sum(counts.values())
+                with TRACER.start_span(
+                        f"plan {obj.kind}/{obj.key}",
+                        parent=cycle_span.context,
+                        attrs={"clusters": len(counts),
+                               "unplaced": unplaced}) as span:
+                    if self._write_plan(obj, counts, unplaced,
+                                        span.context.to_traceparent()):
+                        written += 1
+                        self.placements += 1
+                        _metrics()[1].inc()
+                self._replan.discard((obj.kind, obj.key))
+                self.last_decision[f"{obj.kind}/{obj.key}"] = counts
+        self._write_cluster_status(members)
+        return written
+
+    # ---- workload selection ----
+
+    def _iter_global(self):
+        for kind in PLANNED_KINDS:
+            informer = self.workloads.get(kind)
+            if informer is None:
+                continue
+            for obj in sorted(informer.items(), key=lambda o: o.key):
+                if is_global(obj):
+                    yield obj
+
+    def _pending_workloads(self) -> list:
+        out = []
+        for obj in self._iter_global():
+            plan = parse_plan(obj)
+            if plan is None \
+                    or (obj.kind, obj.key) in self._replan \
+                    or plan.get("replicas") != workload_replicas(obj) \
+                    or plan.get("template") != template_fingerprint(obj) \
+                    or int(plan.get("unplaced", 0)) > 0:
+                out.append(obj)
+        return out
+
+    def _planned_workloads(self) -> list:
+        out = []
+        for obj in self._iter_global():
+            plan = parse_plan(obj)
+            if plan is not None:
+                out.append((obj, plan))
+        return out
+
+    # ---- capacity accounting & spillover ----
+
+    def _charged(self, planned) -> dict[str, np.ndarray]:
+        """Per-cluster resource demand of every outstanding plan, in
+        device units — the planner deducts its own decisions from the
+        rows so consecutive batches never overcommit a member between
+        capacity refreshes."""
+        charged: dict[str, np.ndarray] = {}
+        for obj, plan in planned:
+            pods = workload_pods(obj)
+            if not pods:
+                continue
+            per_replica = pod_requests(pods[0])
+            for cname, count in plan["clusters"].items():
+                if count <= 0:
+                    continue
+                row = charged.setdefault(
+                    cname, np.zeros((Resource.COUNT,), np.float32))
+                row += per_replica * int(count)
+        return charged
+
+    def _detect_spillover(self, members, planned) -> None:
+        """Mask clusters that rejected a placement or whose refreshed
+        report no longer covers the planner's charge with zero autoscaler
+        headroom left, and re-enter the workloads planned there."""
+        charged = self._charged(planned)
+        saturated: set[str] = set()
+        for name, cluster in members.items():
+            charge = charged.get(name)
+            if charge is None:
+                continue
+            free = resource_rows(cluster.free_capacity)
+            if cluster.headroom <= 0 and bool((charge > free + 0.5).any()):
+                saturated.add(name)
+        rejected: dict[tuple[str, str], set[str]] = {}
+        if self.sync is not None:
+            for kind, key, cname in self.sync.take_rejections():
+                rejected.setdefault((kind, key), set()).add(cname)
+        if not saturated and not rejected:
+            return
+        for obj, plan in planned:
+            hits = {c for c, n in plan["clusters"].items() if n > 0
+                    and (c in saturated
+                         or c in rejected.get((obj.kind, obj.key), ()))}
+            if not hits:
+                continue
+            for cname in hits:
+                self._masked[cname] = self.mask_cycles
+                self.spill_by_cluster[cname] = \
+                    self.spill_by_cluster.get(cname, 0) + 1
+            self._replan.add((obj.kind, obj.key))
+            self.spillovers += 1
+            _metrics()[2].inc()
+            log.info("spillover: %s/%s re-enters planning (masked %s)",
+                     obj.kind, obj.key, ",".join(sorted(hits)))
+
+    def _age_masks(self, members) -> None:
+        for name in list(self._masked):
+            self._masked[name] -= 1
+            if self._masked[name] <= 0 or name not in members:
+                del self._masked[name]
+
+    # ---- solver backends ----
+
+    async def _sync_rows(self, members, charged) -> None:
+        want: dict[str, Node] = {}
+        for name in sorted(members):
+            if name in self._masked:
+                continue
+            free = resource_rows(members[name].free_capacity)
+            charge = charged.get(name)
+            if charge is not None:
+                free = free - charge
+            want[name] = cluster_node(members[name], format_capacity(free))
+        for name in sorted(self._rows - set(want)):
+            if self.sim is not None:
+                self.sim.remove_node(name)
+            else:
+                self.svc.remove_node(self.tenant, name)
+        for name, node in want.items():
+            if self.sim is not None:
+                self.sim.upsert_node(node)
+            else:
+                self.svc.upsert_node(self.tenant, node)
+        self._rows = set(want)
+
+    async def _solve(self, batch: list[Pod]) -> list[str | None]:
+        if self.svc is not None:
+            verdict = await self.svc.solve(self.tenant, batch, bind=False)
+            return list(verdict.assignments)
+        # the device solve holds the GIL through XLA dispatch: keep it off
+        # the hub's event loop like every member probe
+        return await asyncio.to_thread(self.sim.solve_assignments, batch)
+
+    # ---- decision write-back ----
+
+    def _write_plan(self, obj, counts: dict[str, int], unplaced: int,
+                    traceparent: str) -> bool:
+        plan = {"clusters": dict(sorted(counts.items())),
+                "replicas": workload_replicas(obj),
+                "template": template_fingerprint(obj),
+                "unplaced": unplaced}
+        encoded = json.dumps(plan, sort_keys=True)
+        ns, name = obj.key.split("/", 1)
+        try:
+            current = self.store.get(obj.kind, name, ns)
+        except NotFound:
+            return False
+        if current.metadata.annotations.get(PLAN_ANNOTATION) == encoded:
+            # decision unchanged (the informer may lag a cycle): re-writing
+            # would only churn the trace annotation and the members
+            return False
+
+        def mutate(fresh):
+            fresh.metadata.annotations[PLAN_ANNOTATION] = encoded
+            fresh.metadata.annotations[TRACE_ANNOTATION] = traceparent
+            return fresh
+
+        try:
+            self.store.guaranteed_update(obj.kind, name, ns, mutate)
+        except (NotFound, Conflict):
+            return False
+        return True
+
+    def _write_cluster_status(self, members) -> None:
+        """Surface the planner's view on each Cluster object (`kubectl
+        describe cluster` shows the last decision + spillover count)."""
+        for name, cluster in members.items():
+            placements = {w: c.get(name, 0)
+                          for w, c in sorted(self.last_decision.items())
+                          if c.get(name, 0) > 0}
+            entry = {
+                "lastDecision": placements,
+                "lastDecisionAt": round(self.clock.now(), 3),
+                "placements": int(sum(placements.values())),
+                "spillovers": self.spill_by_cluster.get(name, 0),
+                "masked": name in self._masked,
+            }
+            current = cluster.planner_status
+            if {k: v for k, v in current.items() if k != "lastDecisionAt"} \
+                    == {k: v for k, v in entry.items()
+                        if k != "lastDecisionAt"}:
+                continue
+
+            def mutate(fresh, entry=entry):
+                fresh.status["planner"] = entry
+                return fresh
+
+            try:
+                self.store.guaranteed_update("Cluster", name, "default",
+                                             mutate)
+            except (NotFound, Conflict):
+                pass

@@ -5,15 +5,25 @@ The federation/ tree's core loops re-designed over this framework's stores
 
 - **ClusterHealthController** (cluster/clustercontroller.go): probes each
   registered member cluster and maintains its Ready condition — an
-  unreachable member drops out of placement.
-- **FederatedSyncController** (federatedtypes/replicaset.go + the
-  replica-set scheduler sync/schedulingtypes): watches federated
-  ReplicaSets in the federation control plane, splits `spec.replicas`
-  across Ready members by the `federation.kubernetes.io/replica-set-
-  preferences` weights (equal weights by default, largest-remainder
-  rounding), and ensures a per-cluster ReplicaSet in every member —
-  creating, rescaling, and deleting (incl. members removed from the split
-  and federated objects deleted upstream).
+  unreachable member drops out of placement. The probe also aggregates the
+  member's capacity (summed schedulable-node allocatable minus bound pod
+  requests, zone labels, autoscaler headroom from NodeGroup bounds) into
+  `Cluster.status.capacity` — the rows the GlobalPlanner encodes.
+- **FederatedSyncController** (federatedtypes/ + sync/schedulingtypes):
+  watches federated workloads in the federation control plane and ensures
+  per-cluster copies in every Ready member — creating, rescaling, and
+  deleting (incl. members removed from the split and federated objects
+  deleted upstream). Replica-carrying kinds (ReplicaSet, Deployment,
+  PodGroup) split `spec.replicas`/`spec.minMember` across members by the
+  `federation.kubernetes.io/replica-set-preferences` weights (equal
+  weights by default, largest-remainder rounding) — unless the workload is
+  annotated `federation.ktpu.io/placement: global`, in which case the
+  GlobalPlanner's `planned-placement` decision replaces the weighted split
+  and the planner's trace/plan annotations ride the member copies.
+  Whole-copy kinds (Secret, ConfigMap) land verbatim on every Ready
+  member. Member rejections (a member store refusing a write for any
+  reason other than the usual CAS races) feed a ledger the planner drains
+  to trigger spillover.
 
 Member access goes through a client factory resolving a Cluster object to
 its ObjectStore-compatible client (RemoteStore for spec.serverAddress; the
@@ -26,15 +36,33 @@ from __future__ import annotations
 import asyncio
 import logging
 
+import numpy as np
+
 from kubernetes_tpu.api.objects import NodeCondition  # noqa: F401 (doc link)
 from kubernetes_tpu.apiserver.store import AlreadyExists, Conflict, NotFound, ObjectStore
 from kubernetes_tpu.client.informer import Informer
 from kubernetes_tpu.controllers.base import ReconcileController
+from kubernetes_tpu.federation.planner import (
+    ZONE_LABEL,
+    format_capacity,
+    is_global,
+    parse_plan,
+)
+from kubernetes_tpu.gang import GROUP_MIN_ANNOTATION, GROUP_NAME_ANNOTATION
+from kubernetes_tpu.state.cluster_state import pod_requests, resource_rows
+from kubernetes_tpu.state.layout import Resource
 
 log = logging.getLogger(__name__)
 
 PREFERENCES_ANNOTATION = "federation.kubernetes.io/replica-set-preferences"
 CLUSTER_LABEL = "federation.kubernetes.io/cluster"
+
+# kind -> the spec field the per-member split lands in
+REPLICA_FIELD = {"ReplicaSet": "replicas", "Deployment": "replicas",
+                 "PodGroup": "minMember"}
+# kinds propagated verbatim to every Ready member
+COPY_KINDS = ("Secret", "ConfigMap")
+SYNCED_KINDS = tuple(REPLICA_FIELD) + COPY_KINDS
 
 
 def split_replicas(total: int, clusters: list[str],
@@ -58,11 +86,48 @@ def split_replicas(total: int, clusters: list[str],
     return dict(zip(clusters, floors))
 
 
+def member_capacity(nodes, pods, groups) -> dict:
+    """Aggregate one member's capacity report from its listed objects
+    (runs inside the probe thread): allocatable is summed over
+    schedulable Ready nodes; free subtracts every bound, non-terminal
+    pod's requests; headroom is the autoscaler's remaining expansion
+    (NodeGroup max-size minus attained size, summed)."""
+    alloc = np.zeros((Resource.COUNT,), np.float32)
+    zones: set[str] = set()
+    schedulable: set[str] = set()
+    for node in nodes:
+        ready = any(c.type == "Ready" and c.status == "True"
+                    for c in node.status.conditions)
+        if not ready or node.spec.unschedulable:
+            continue
+        schedulable.add(node.metadata.name)
+        alloc += resource_rows(node.status.effective_allocatable())
+        zone = node.metadata.labels.get(ZONE_LABEL)
+        if zone:
+            zones.add(zone)
+    used = np.zeros((Resource.COUNT,), np.float32)
+    for pod in pods:
+        if not pod.spec.node_name \
+                or pod.spec.node_name not in schedulable \
+                or pod.status.phase in ("Succeeded", "Failed"):
+            continue
+        used += pod_requests(pod)
+    headroom = sum(
+        max(0, g.max_size - max(g.target_size, g.ready_nodes))
+        for g in groups)
+    return {"allocatable": format_capacity(alloc),
+            "free": format_capacity(alloc - used),
+            "zones": sorted(zones),
+            "nodes": len(schedulable),
+            "headroom": int(headroom)}
+
+
 class ClusterHealthController(ReconcileController):
-    """Maintain each member Cluster's Ready condition by probing it, on a
-    periodic monitor cadence (clusterMonitorPeriod,
+    """Maintain each member Cluster's Ready condition AND capacity report
+    by probing it, on a periodic monitor cadence (clusterMonitorPeriod,
     cluster/clustercontroller.go) — health must track outages and
-    recoveries, not just watch events."""
+    recoveries, not just watch events, and the planner's rows must track
+    real member load."""
 
     workers = 1
 
@@ -80,14 +145,24 @@ class ClusterHealthController(ReconcileController):
         if event.type == "ADDED":
             self.enqueue(event.obj.metadata.name)
 
+    def _probe(self, cluster) -> dict:
+        """One member probe (blocking HTTP — runs in a thread): list the
+        member's nodes/pods/node-groups and fold them into the capacity
+        report. Any failure marks the member unhealthy."""
+        client = self.client_factory(cluster)
+        nodes = client.list("Node")
+        pods = client.list("Pod")
+        groups = client.list("NodeGroup")
+        return member_capacity(nodes, pods, groups)
+
     async def sync(self, key: str) -> None:
         cluster = self.clusters.get(key)
         if cluster is None:
             return
+        capacity = None
         try:
             # member probes are blocking HTTP: keep them off the event loop
-            await asyncio.to_thread(
-                lambda: self.client_factory(cluster).list("Node"))
+            capacity = await asyncio.to_thread(self._probe, cluster)
             ready = "True"
         except Exception:  # noqa: BLE001 — any failure = unhealthy
             ready = "False"
@@ -95,7 +170,8 @@ class ClusterHealthController(ReconcileController):
         self.enqueue_after(key, self.monitor_period)
         current = next((c for c in cluster.status.get("conditions", [])
                         if c.get("type") == "Ready"), None)
-        if current is not None and current.get("status") == ready:
+        if current is not None and current.get("status") == ready \
+                and (capacity is None or cluster.capacity == capacity):
             return
 
         def mutate(obj):
@@ -108,6 +184,8 @@ class ClusterHealthController(ReconcileController):
                 conditions.append({"type": "Ready", "status": ready})
             else:
                 entry["status"] = ready
+            if capacity is not None:
+                obj.status["capacity"] = capacity
             return obj
 
         try:
@@ -120,36 +198,52 @@ class FederatedSyncController(ReconcileController):
     workers = 2
 
     def __init__(self, fed_store: ObjectStore, rs_informer: Informer,
-                 cluster_informer: Informer, client_factory):
+                 cluster_informer: Informer, client_factory,
+                 informers: dict[str, Informer] | None = None):
         super().__init__()
-        self.name = "federated-replicaset-controller"
+        self.name = "federated-sync-controller"
         self.store = fed_store
-        self.workloads = rs_informer
         self.clusters = cluster_informer
         self.client_factory = client_factory
-        rs_informer.add_handler(self._on_workload)
+        # kind -> informer; the historical single-informer signature keeps
+        # working (ReplicaSet-only federation)
+        self.informers: dict[str, Informer] = {"ReplicaSet": rs_informer}
+        if informers:
+            self.informers.update(informers)
+        for informer in self.informers.values():
+            informer.add_handler(self._on_workload)
         cluster_informer.add_handler(self._on_cluster)
         # keys of federated objects we have propagated (so a DELETED event
         # can clean the members without the source object)
-        self._managed: set[str] = set()
+        self._managed: set[tuple[str, str]] = set()
+        # member write rejections since the last drain: (kind, key,
+        # cluster) — the GlobalPlanner turns these into spillover
+        self._rejections: set[tuple[str, str, str]] = set()
 
     def _on_workload(self, event) -> None:
-        if event.obj.kind == "ReplicaSet":
-            self.enqueue(event.obj.key)
+        if event.obj.kind in self.informers:
+            self.enqueue(f"{event.obj.kind}/{event.obj.key}")
 
     def _on_cluster(self, event) -> None:
         # membership/health changes re-plan every federated workload
-        for rs in self.workloads.items():
-            self.enqueue(rs.key)
+        for kind, informer in self.informers.items():
+            for obj in informer.items():
+                self.enqueue(f"{kind}/{obj.key}")
+
+    def take_rejections(self) -> list[tuple[str, str, str]]:
+        """Drain the member-rejection ledger (planner spillover input)."""
+        out = sorted(self._rejections)
+        self._rejections.clear()
+        return out
 
     def _ready_members(self):
         return sorted((c for c in self.clusters.items() if c.ready),
                       key=lambda c: c.metadata.name)
 
-    def _preferences(self, rs) -> dict[str, float]:
+    def _preferences(self, obj) -> dict[str, float]:
         import json
 
-        raw = rs.metadata.annotations.get(PREFERENCES_ANNOTATION)
+        raw = obj.metadata.annotations.get(PREFERENCES_ANNOTATION)
         if not raw:
             return {}
         try:
@@ -158,37 +252,59 @@ class FederatedSyncController(ReconcileController):
                     for name, spec in (prefs.get("clusters") or {}).items()}
         except (ValueError, TypeError, AttributeError):
             log.warning("bad %s annotation on %s", PREFERENCES_ANNOTATION,
-                        rs.key)
+                        obj.key)
             return {}
 
     async def sync(self, key: str) -> None:
-        ns, name = key.split("/", 1)
-        rs = self.workloads.get(name, ns)
-        if rs is None:
+        kind, rest = key.split("/", 1)
+        ns, name = rest.split("/", 1)
+        informer = self.informers.get(kind)
+        obj = informer.get(name, ns) if informer is not None else None
+        if obj is None:
             # federated object deleted: remove from EVERY member (reachable
             # or not — unreachable ones retry until clean, so a recovering
             # member cannot resurrect an orphan)
-            failed = await self._cleanup(ns, name)
+            failed = await self._cleanup(kind, ns, name)
             if failed:
                 self.enqueue_after(key, 1.0)
             else:
-                self._managed.discard(key)
+                self._managed.discard((kind, rest))
             return
-        self._managed.add(key)
+        self._managed.add((kind, rest))
         members = self._ready_members()
-        plan = split_replicas(rs.replicas,
-                              [c.metadata.name for c in members],
-                              self._preferences(rs))
+        planned = False
+        if kind in REPLICA_FIELD:
+            if is_global(obj):
+                # the GlobalPlanner owns this workload's distribution: no
+                # decision yet means nothing to ensure (the plan
+                # annotation's arrival re-enqueues the key)
+                plan = parse_plan(obj)
+                if plan is None:
+                    return
+                planned = True
+                counts = {c: int(n) for c, n in plan["clusters"].items()}
+            else:
+                counts = split_replicas(
+                    self._total_replicas(obj),
+                    [c.metadata.name for c in members],
+                    self._preferences(obj))
+        else:
+            counts = {}
         for cluster in members:
             # member CRUD is blocking HTTP: run each member's reconcile in
             # a worker thread so a slow member never stalls the event loop
             retry = await asyncio.to_thread(
-                self._reconcile_member, cluster, rs, ns, name,
-                plan.get(cluster.metadata.name, 0))
+                self._reconcile_member, cluster, obj, kind, ns, name,
+                counts.get(cluster.metadata.name, 0), planned)
             if retry:
                 self.enqueue_after(key, 0.05)
 
-    async def _cleanup(self, ns: str, name: str) -> bool:
+    def _total_replicas(self, obj) -> int:
+        if obj.kind == "PodGroup":
+            return obj.min_member
+        return obj.replicas
+
+    async def _cleanup(self, kind: str, ns: str, name: str) -> bool:
         """Delete the propagated object from all members; True if any
         member could not be cleaned yet."""
         failed = False
@@ -196,8 +312,7 @@ class FederatedSyncController(ReconcileController):
                               key=lambda c: c.metadata.name):
             def delete_one(cluster=cluster):
                 try:
-                    self.client_factory(cluster).delete(
-                        "ReplicaSet", name, ns)
+                    self.client_factory(cluster).delete(kind, name, ns)
                 except NotFound:
                     pass
 
@@ -207,36 +322,110 @@ class FederatedSyncController(ReconcileController):
                 failed = True
         return failed
 
-    def _reconcile_member(self, cluster, rs, ns: str, name: str,
-                          want: int) -> bool:
+    def _member_annotations(self, obj, want: int,
+                            planned: bool) -> dict[str, str]:
+        """Hub annotations ride the member copy (incl. the planner's plan
+        + traceparent, stitching hub decision -> member bind into one
+        trace); a planned gang's member slice rewrites group-min to its
+        own size so each cluster's slice binds all-or-nothing."""
+        ann = dict(obj.metadata.annotations)
+        if planned and GROUP_NAME_ANNOTATION in ann:
+            ann[GROUP_MIN_ANNOTATION] = str(max(1, want))
+        return ann
+
+    def _record_rejection(self, kind: str, ns: str, name: str,
+                          cluster) -> None:
+        cname = cluster.metadata.name
+        self._rejections.add((kind, f"{ns}/{name}", cname))
+        log.warning("member %s rejected %s %s/%s", cname, kind, ns, name)
+
+    def _reconcile_member(self, cluster, obj, kind: str, ns: str, name: str,
+                          want: int, planned: bool) -> bool:
         """Ensure one member's copy (runs in a worker thread). Returns True
         when the key should be retried."""
+        if kind in COPY_KINDS:
+            return self._reconcile_copy(cluster, obj, kind, ns, name)
         client = self.client_factory(cluster)
+        field = REPLICA_FIELD[kind]
+        ann = self._member_annotations(obj, want, planned)
         try:
-            current = client.get("ReplicaSet", name, ns)
+            current = client.get(kind, name, ns)
         except NotFound:
             current = None
         if current is None:
-            copy = rs.clone()
+            copy = obj.clone()
             # hub rv is meaningless in the member store: strip before CREATE
             copy.metadata.resource_version = ""  # ktpu: allow[store-rmw]
             copy.metadata.labels = dict(copy.metadata.labels)
             copy.metadata.labels[CLUSTER_LABEL] = cluster.metadata.name
-            copy.spec["replicas"] = want
+            copy.metadata.annotations = ann
+            copy.spec[field] = want
             try:
                 client.create(copy)
             except AlreadyExists:
                 return True
+            except (Conflict, NotFound):
+                return True
+            except Exception:  # noqa: BLE001 — member refused the object
+                self._record_rejection(kind, ns, name, cluster)
+                return False
             return False
-        if current.replicas != want \
-                or current.spec.get("template") != rs.spec.get("template"):
+        drift = int(current.spec.get(field) or 0) != int(want) \
+            or current.spec.get("template") != obj.spec.get("template") \
+            or any(current.metadata.annotations.get(k) != v
+                   for k, v in ann.items())
+        if drift:
             fresh = current.clone()
-            fresh.spec = dict(rs.spec)
-            fresh.spec["replicas"] = want
+            fresh.spec = dict(obj.spec)
+            fresh.spec[field] = want
+            fresh.metadata.annotations = dict(current.metadata.annotations)
+            fresh.metadata.annotations.update(ann)
             try:
                 # CAS against the member's version just read: a racing
                 # member-side writer wins and the key is retried
                 client.update(fresh)
             except (Conflict, NotFound):
                 return True
+            except Exception:  # noqa: BLE001 — member refused the write
+                self._record_rejection(kind, ns, name, cluster)
+                return False
+        return False
+
+    def _reconcile_copy(self, cluster, obj, kind: str, ns: str,
+                        name: str) -> bool:
+        """Ensure one member's verbatim copy of a config kind."""
+        client = self.client_factory(cluster)
+        try:
+            current = client.get(kind, name, ns)
+        except NotFound:
+            current = None
+        if current is None:
+            copy = obj.clone()
+            copy.metadata.resource_version = ""  # ktpu: allow[store-rmw]
+            copy.metadata.labels = dict(copy.metadata.labels)
+            copy.metadata.labels[CLUSTER_LABEL] = cluster.metadata.name
+            try:
+                client.create(copy)
+            except AlreadyExists:
+                return True
+            except Exception:  # noqa: BLE001 — member refused the object
+                self._record_rejection(kind, ns, name, cluster)
+                return False
+            return False
+        drift = current.data != obj.data
+        if kind == "Secret":
+            drift = drift or getattr(current, "type", None) != \
+                getattr(obj, "type", None)
+        if drift:
+            fresh = current.clone()
+            fresh.data = dict(obj.data)
+            if kind == "Secret":
+                fresh.type = obj.type
+            try:
+                client.update(fresh)
+            except (Conflict, NotFound):
+                return True
+            except Exception:  # noqa: BLE001 — member refused the write
+                self._record_rejection(kind, ns, name, cluster)
+                return False
         return False

@@ -2894,3 +2894,251 @@ def run_solver_svc(n_tenants: int = 4, nodes_per_tenant: int = 32,
         return asyncio.run(drive())
     finally:
         flood_stop.set()
+
+
+@dataclass
+class FederationResult:
+    """Federation drill: one hub control plane (health + sync +
+    GlobalPlanner) over N in-process member control planes, a mixed
+    globally-placed workload set (incl. one gang), and a mid-run member
+    saturation (its nodes vanish; its NodeGroup has zero headroom).
+    Gates: every workload's replicas land across clusters exactly once
+    (member copies sum to the hub total and match the plan, no
+    duplicates), the planner records >= 1 spillover for the saturated
+    member and drains its demand to siblings, the whole thing converges
+    within budget, and the RaceDetector sees zero racy hub writes."""
+
+    clusters: int
+    pods: int
+    seed: int
+    workloads: int
+    planned: int                 # workloads holding a complete plan
+    placed: int                  # replicas ensured on members, post-drain
+    exactly_once: bool           # sums match the hub totals + the plans
+    duplicate_placements: int
+    spillovers: int              # planner spillover events recorded
+    victim_drained: bool         # saturated member ended at 0 replicas
+    cycles: int
+    solves: int
+    solve_p50_ms: float
+    converged: bool
+    racy_writes: int = 0
+
+    @property
+    def gate(self) -> bool:
+        return (self.converged and self.exactly_once
+                and self.duplicate_placements == 0
+                and self.spillovers >= 1 and self.victim_drained
+                and self.racy_writes == 0)
+
+    def __str__(self) -> str:
+        return (f"fed C={self.clusters} P={self.pods}: "
+                f"{self.planned}/{self.workloads} planned, "
+                f"{self.placed} replicas placed "
+                f"({'exactly-once' if self.exactly_once else 'DUPED'}), "
+                f"{self.spillovers} spillovers "
+                f"(victim {'drained' if self.victim_drained else 'WEDGED'}),"
+                f" {self.cycles} cycles {self.solves} solves "
+                f"~{self.solve_p50_ms:.1f}ms")
+
+
+def run_federation(n_clusters: int = 4, n_pods: int = 24, seed: int = 2032,
+                   race_detect: bool = True) -> FederationResult:
+    """Blocking entry point for the federation global-planning drill.
+
+    Topology: hub ObjectStore (RaceDetector-wrapped) running the full
+    FederationControlPlane with the GlobalPlanner; N member ObjectStores,
+    each a few nodes plus a NodeGroup pinned at max size (headroom 0 —
+    saturation cannot be autoscaled away). Workloads: ~n_pods replicas
+    split over several `placement: global` ReplicaSets, one of them a
+    gang. Mid-run, member 0's nodes are deleted: its next capacity report
+    shows zero free, the planner's charge trips spillover, the member's
+    row is masked, demand re-plans onto siblings, and the sync controller
+    rescales the victim's copies to zero."""
+    import random
+
+    from kubernetes_tpu.api.objects import Node, NodeGroup, ReplicaSet
+    from kubernetes_tpu.apiserver.store import NotFound
+    from kubernetes_tpu.federation.kubefed import (
+        FederationControlPlane,
+        join,
+    )
+    from kubernetes_tpu.federation.planner import (
+        PLACEMENT_ANNOTATION,
+        PLACEMENT_GLOBAL,
+        parse_plan,
+    )
+    from kubernetes_tpu.gang import GROUP_MIN_ANNOTATION, GROUP_NAME_ANNOTATION
+    from kubernetes_tpu.testing.races import RaceDetector
+
+    n_clusters = max(3, n_clusters)
+    rng = random.Random(seed)
+    hub_inner = ObjectStore()
+    hub = RaceDetector(hub_inner) if race_detect else hub_inner
+    members = {f"member-{i}": ObjectStore() for i in range(n_clusters)}
+    victim = "member-0"
+
+    # every member can hold the WHOLE workload set on its own (spillover
+    # must be able to drain anywhere), via a few fat nodes
+    nodes_per = 2
+    cpu_per_node = max(4, n_pods)  # cores; replicas request 500m each
+    for name, store in members.items():
+        for j in range(nodes_per):
+            store.create(Node.from_dict({
+                "metadata": {"name": f"{name}-n{j}",
+                             "labels": {"kubernetes.io/hostname":
+                                        f"{name}-n{j}"}},
+                "status": {
+                    "allocatable": {"cpu": str(cpu_per_node),
+                                    "memory": f"{4 * cpu_per_node}Gi",
+                                    "pods": "110"},
+                    "capacity": {"cpu": str(cpu_per_node),
+                                 "memory": f"{4 * cpu_per_node}Gi",
+                                 "pods": "110"},
+                    "conditions": [{"type": "Ready", "status": "True"}]}}))
+        # pool pinned at max: zero autoscaler headroom, so a saturated
+        # member spills instead of pretending it can grow
+        store.create(NodeGroup.from_dict({
+            "metadata": {"name": f"{name}-pool"},
+            "spec": {"minSize": nodes_per, "maxSize": nodes_per},
+            "status": {"targetSize": nodes_per,
+                       "readyNodes": nodes_per}}))
+
+    def client_factory(cluster):
+        store = members.get(cluster.metadata.name)
+        if store is None:
+            raise ConnectionError(cluster.metadata.name)
+        return store
+
+    # mixed workload set: one gang + several plain ReplicaSets summing to
+    # ~n_pods replicas, all placement=global
+    gang_size = max(3, min(8, n_pods // 4))
+    remaining = max(1, n_pods - gang_size)
+    sizes = []
+    while remaining > 0:
+        s = min(remaining, rng.randint(2, 6))
+        sizes.append(s)
+        remaining -= s
+    workloads = []
+    for i, size in enumerate(sizes):
+        workloads.append(ReplicaSet.from_dict({
+            "metadata": {"name": f"fedw-{i}", "annotations": {
+                PLACEMENT_ANNOTATION: PLACEMENT_GLOBAL}},
+            "spec": {"replicas": size, "template": {
+                "metadata": {"labels": {"app": f"fedw-{i}"}},
+                "spec": {"containers": [{"name": "c", "resources": {
+                    "requests": {"cpu": "500m", "memory": "256Mi"}}}]}}}}))
+    workloads.append(ReplicaSet.from_dict({
+        "metadata": {"name": "fedw-gang", "annotations": {
+            PLACEMENT_ANNOTATION: PLACEMENT_GLOBAL,
+            GROUP_NAME_ANNOTATION: "fedw-gang",
+            GROUP_MIN_ANNOTATION: str(gang_size)}},
+        "spec": {"replicas": gang_size, "template": {
+            "metadata": {"labels": {"app": "fedw-gang"}},
+            "spec": {"containers": [{"name": "c", "resources": {
+                "requests": {"cpu": "500m", "memory": "256Mi"}}}]}}}}))
+    total = sum(w.replicas for w in workloads)
+
+    batch = 1
+    while batch < max(16, total):
+        batch *= 2
+    plane = FederationControlPlane(
+        hub, client_factory, health_period=0.1,
+        planner=True, plan_interval=0.1,
+        planner_caps=Capacities(num_nodes=max(8, n_clusters),
+                                batch_pods=min(64, batch)))
+    planner = plane.planner
+
+    freeze_drill_heap()
+
+    async def drive() -> FederationResult:
+        for name in members:
+            join(hub, name)
+        for w in workloads:
+            hub.create(w)
+        await plane.start()
+        for cluster in plane.clusters.items():
+            plane.health.enqueue(cluster.metadata.name)
+
+        def member_counts(wname: str) -> dict[str, int]:
+            out = {}
+            for cname, store in members.items():
+                try:
+                    out[cname] = store.get("ReplicaSet", wname).replicas
+                except NotFound:
+                    pass
+            return out
+
+        def settled(require_victim_zero: bool) -> bool:
+            for w in workloads:
+                try:
+                    fresh = hub.get("ReplicaSet", w.metadata.name)
+                except NotFound:
+                    return False
+                plan = parse_plan(fresh)
+                if plan is None or int(plan.get("unplaced", 0)) > 0:
+                    return False
+                if require_victim_zero and \
+                        plan["clusters"].get(victim, 0) > 0:
+                    return False
+                got = member_counts(w.metadata.name)
+                for cname in members:
+                    if got.get(cname, 0) != plan["clusters"].get(cname, 0):
+                        return False
+                if sum(got.values()) != w.replicas:
+                    return False
+            return True
+
+        async def wait_settled(require_victim_zero: bool,
+                               timeout_s: float) -> bool:
+            deadline = time.monotonic() + timeout_s
+            while time.monotonic() < deadline:
+                if settled(require_victim_zero):
+                    return True
+                await asyncio.sleep(0.05)
+            return False
+
+        phase1 = await wait_settled(False, 120.0)
+
+        # saturate the victim: its nodes vanish (kernel panic, preemption,
+        # a zone outage) while its NodeGroup stays pinned at max size —
+        # the next capacity report shows zero free and zero headroom
+        for j in range(nodes_per):
+            members[victim].delete("Node", f"{victim}-n{j}")
+        phase2 = await wait_settled(True, 120.0)
+
+        dupes = 0
+        placed = 0
+        exactly_once = True
+        for w in workloads:
+            got = member_counts(w.metadata.name)
+            placed += sum(got.values())
+            if sum(got.values()) != w.replicas:
+                exactly_once = False
+            if sum(got.values()) > w.replicas:
+                dupes += 1
+        planned = sum(
+            1 for w in workloads
+            if parse_plan(hub.get("ReplicaSet", w.metadata.name)))
+        victim_total = sum(
+            member_counts(w.metadata.name).get(victim, 0)
+            for w in workloads)
+        solve_ms = (1e3 * planner.solve_seconds / planner.solve_count
+                    if planner.solve_count else 0.0)
+        plane.stop()
+        return FederationResult(
+            clusters=n_clusters, pods=total, seed=seed,
+            workloads=len(workloads), planned=planned, placed=placed,
+            exactly_once=exactly_once, duplicate_placements=dupes,
+            spillovers=planner.spillovers,
+            victim_drained=(victim_total == 0),
+            cycles=planner.cycles, solves=planner.solve_count,
+            solve_p50_ms=solve_ms,
+            converged=(phase1 and phase2),
+            racy_writes=len(hub.racy_writes) if race_detect else 0)
+
+    try:
+        result = asyncio.run(drive())
+    finally:
+        thaw_drill_heap()
+    return result

@@ -1201,3 +1201,49 @@ def solversvc_tenant_mix(seed: int, tenants: int = 3,
                                  "memory": f"{cpu_m}Mi"}}}]}}))
         mix[f"tenant-{t}"] = (nodes, pods)
     return mix
+
+
+# ---------------------------------------------------------------------------
+# Federation GlobalPlanner oracle (federation/planner.py)
+
+
+def federation_placement(clusters, workloads):
+    """Host-side twin of one GlobalPlanner solve: each Ready member
+    cluster with a capacity report becomes ONE node (name = cluster name,
+    allocatable = the reported free capacity, single zone -> zone label),
+    each globally-placed workload becomes per-replica synthetic pods, and
+    the plain SerialScheduler places them — gang workloads through
+    schedule_gang (all-or-nothing at quorum, the same contiguous-run
+    semantics the device gang columns encode).
+
+    Returns the per-pod cluster-name list, concatenated over `workloads`
+    in order — exactly the shape ScaleSimulator.solve_assignments returns
+    for the planner's batch, so parity tests compare lists verbatim.
+    Clusters must be passed in sorted-name order (the planner's row
+    order) for tie-breaks to line up."""
+    from kubernetes_tpu.federation.planner import cluster_node, workload_pods
+    from kubernetes_tpu.gang import annotation_min, pod_group_key
+
+    nodes = [cluster_node(c) for c in clusters if c.ready and c.capacity]
+    pods = []
+    for obj in workloads:
+        pods.extend(workload_pods(obj))
+    gang_ids = [0] * len(pods)
+    gang_mins = [0] * len(pods)
+    i = 0
+    gid = 0
+    while i < len(pods):
+        gkey = pod_group_key(pods[i])
+        if gkey is None:
+            i += 1
+            continue
+        j = i
+        while j < len(pods) and pod_group_key(pods[j]) == gkey:
+            j += 1
+        gid += 1
+        quorum = annotation_min(pods[i]) or (j - i)
+        for k in range(i, j):
+            gang_ids[k] = gid
+            gang_mins[k] = quorum
+        i = j
+    return SerialScheduler(nodes).schedule_gang(pods, gang_ids, gang_mins)

@@ -1,21 +1,45 @@
-"""Federation: replica split planning, cluster health, and federated
-ReplicaSet propagation across member clusters (federation/pkg/
-federation-controller analogs)."""
+"""Federation: replica split planning, cluster health + capacity
+reporting, federated workload propagation (ReplicaSets, Deployments,
+Secrets, ConfigMaps), and the GlobalPlanner's device-solved cross-cluster
+placement with spillover (federation/pkg/federation-controller analogs)."""
 
 import asyncio
 import json
+import random
 
-from kubernetes_tpu.api.objects import Cluster, Node
+from kubernetes_tpu.api.objects import (
+    Cluster,
+    ConfigMap,
+    Node,
+    NodeGroup,
+    Pod,
+    PodGroup,
+    Secret,
+)
 from kubernetes_tpu.apiserver import ObjectStore
 from kubernetes_tpu.client.informer import Informer
 from kubernetes_tpu.federation import (
     ClusterHealthController,
     FederatedSyncController,
+    GlobalPlanner,
     split_replicas,
 )
-from kubernetes_tpu.federation.sync import PREFERENCES_ANNOTATION
+from kubernetes_tpu.federation.planner import (
+    PLACEMENT_ANNOTATION,
+    PLACEMENT_GLOBAL,
+    ZONE_LABEL,
+    cluster_node,
+    parse_plan,
+    workload_pods,
+)
+from kubernetes_tpu.federation.sync import (
+    PREFERENCES_ANNOTATION,
+    member_capacity,
+)
+from kubernetes_tpu.gang import GROUP_MIN_ANNOTATION, GROUP_NAME_ANNOTATION
+from kubernetes_tpu.obs.tracing import TRACE_ANNOTATION
 
-from tests.test_controllers import rs_obj, until
+from tests.test_controllers import deploy_obj, rs_obj, until
 
 
 def test_split_replicas_planner():
@@ -43,10 +67,14 @@ class _Fed:
                 "spec": {"serverAddress": f"fake://{name}"}}))
         self.cluster_informer = Informer(self.fed, "Cluster")
         self.rs_informer = Informer(self.fed, "ReplicaSet")
+        self.extra_informers = {
+            kind: Informer(self.fed, kind)
+            for kind in ("Deployment", "PodGroup", "Secret", "ConfigMap")}
         self.health = ClusterHealthController(
             self.fed, self.cluster_informer, self.client)
         self.sync = FederatedSyncController(
-            self.fed, self.rs_informer, self.cluster_informer, self.client)
+            self.fed, self.rs_informer, self.cluster_informer, self.client,
+            informers=self.extra_informers)
 
     def client(self, cluster):
         store = self.members.get(cluster.metadata.name)
@@ -54,11 +82,15 @@ class _Fed:
             raise ConnectionError(cluster.metadata.name)
         return store
 
+    def _informers(self):
+        return (self.cluster_informer, self.rs_informer,
+                *self.extra_informers.values())
+
     async def start(self):
-        self.cluster_informer.start()
-        self.rs_informer.start()
-        await self.cluster_informer.wait_for_sync()
-        await self.rs_informer.wait_for_sync()
+        for informer in self._informers():
+            informer.start()
+        for informer in self._informers():
+            await informer.wait_for_sync()
         await self.health.start()
         await self.sync.start()
         for c in self.cluster_informer.items():
@@ -70,8 +102,8 @@ class _Fed:
     def stop(self):
         self.health.stop()
         self.sync.stop()
-        self.cluster_informer.stop()
-        self.rs_informer.stop()
+        for informer in self._informers():
+            informer.stop()
 
 
 def member_replicas(fed, name="web"):
@@ -244,3 +276,399 @@ def test_federated_service_dns_failover_and_kubefed():
         plane.stop()
 
     asyncio.run(run())
+
+
+# ---- cluster capacity reporting (GlobalPlanner rows) ----
+
+
+def ready_node(name, cpu="4", memory="8Gi", pods="10", zone=None,
+               unschedulable=False):
+    labels = {ZONE_LABEL: zone} if zone else {}
+    return Node.from_dict({
+        "metadata": {"name": name, "labels": labels},
+        "spec": {"unschedulable": unschedulable},
+        "status": {
+            "allocatable": {"cpu": cpu, "memory": memory, "pods": pods},
+            "conditions": [{"type": "Ready", "status": "True"}]}})
+
+
+def test_member_capacity_aggregation():
+    nodes = [
+        ready_node("n0", cpu="4", memory="8Gi", pods="10", zone="z-a"),
+        ready_node("n1", cpu="2", memory="4Gi", pods="10", zone="z-b"),
+        # never-Ready and cordoned nodes are not placement capacity
+        Node.from_dict({"metadata": {"name": "n2"},
+                        "status": {"allocatable": {"cpu": "64"}}}),
+        ready_node("n3", cpu="64", memory="64Gi", unschedulable=True),
+    ]
+    pods = [
+        Pod.from_dict({"metadata": {"name": "p0"},
+                       "spec": {"nodeName": "n0", "containers": [
+                           {"name": "c", "resources": {
+                               "requests": {"cpu": "500m"}}}]}}),
+        # terminal and unbound pods hold nothing
+        Pod.from_dict({"metadata": {"name": "p1"},
+                       "spec": {"nodeName": "n0"},
+                       "status": {"phase": "Succeeded"}}),
+        Pod.from_dict({"metadata": {"name": "p2"}}),
+        # bound to a non-schedulable node: that node contributed nothing
+        Pod.from_dict({"metadata": {"name": "p3"},
+                       "spec": {"nodeName": "n3"}}),
+    ]
+    groups = [
+        NodeGroup.from_dict({"metadata": {"name": "g0"},
+                             "spec": {"minSize": 1, "maxSize": 5},
+                             "status": {"targetSize": 2, "readyNodes": 2}}),
+        NodeGroup.from_dict({"metadata": {"name": "g1"},
+                             "spec": {"maxSize": 2},
+                             "status": {"targetSize": 2, "readyNodes": 1}}),
+    ]
+    cap = member_capacity(nodes, pods, groups)
+    assert cap["allocatable"] == {"pods": "20", "cpu": "6000m",
+                                  "memory": "12288Mi"}
+    assert cap["free"] == {"pods": "19", "cpu": "5500m",
+                           "memory": "12288Mi"}
+    assert cap["zones"] == ["z-a", "z-b"]
+    assert cap["nodes"] == 2
+    assert cap["headroom"] == 3  # g0 may add 3 more; g1 is at max
+
+
+def test_health_probe_reports_capacity_in_cluster_status():
+    async def run():
+        fed = _Fed(1)
+        store = fed.members["m0"]
+        store.create(ready_node("m0-big", cpu="8", memory="16Gi",
+                                pods="20", zone="z-east"))
+        store.create(NodeGroup.from_dict({
+            "metadata": {"name": "pool"},
+            "spec": {"minSize": 1, "maxSize": 4},
+            "status": {"targetSize": 1, "readyNodes": 1}}))
+        await fed.start()
+        await until(lambda: fed.fed.get("Cluster", "m0").capacity)
+        cluster = fed.fed.get("Cluster", "m0")
+        # _Fed's bare m0-n0 node has no Ready condition: only m0-big counts
+        assert cluster.allocatable_capacity["cpu"] == "8000m"
+        assert cluster.free_capacity["memory"] == "16384Mi"
+        assert cluster.zones == ("z-east",)
+        assert cluster.headroom == 3
+        assert cluster.capacity["nodes"] == 1
+        fed.stop()
+
+    asyncio.run(run())
+
+
+# ---- per-type federated sync: Deployment / Secret / ConfigMap ----
+
+
+def member_field(fed, kind, name, field):
+    out = {}
+    for cname, store in fed.members.items():
+        objs = [o for o in store.list(kind, copy_objects=False)
+                if o.metadata.name == name]
+        out[cname] = field(objs[0]) if objs else None
+    return out
+
+
+def test_federated_deployment_propagates_rescales_deletes():
+    async def run():
+        fed = _Fed(2)
+        await fed.start()
+        fed.fed.create(deploy_obj("site", replicas=5))
+        replicas = lambda o: int(o.spec.get("replicas") or 0)  # noqa: E731
+        await until(lambda: member_field(fed, "Deployment", "site", replicas)
+                    == {"m0": 3, "m1": 2})
+        dep = fed.fed.get("Deployment", "site")
+        dep.spec["replicas"] = 8
+        fed.fed.update(dep, check_version=False)
+        await until(lambda: member_field(fed, "Deployment", "site", replicas)
+                    == {"m0": 4, "m1": 4})
+        fed.fed.delete("Deployment", "site")
+        await until(lambda: member_field(fed, "Deployment", "site", replicas)
+                    == {"m0": None, "m1": None})
+        fed.stop()
+
+    asyncio.run(run())
+
+
+def test_federated_secret_and_configmap_copy_update_delete():
+    async def run():
+        fed = _Fed(2)
+        await fed.start()
+        fed.fed.create(Secret.from_dict({
+            "metadata": {"name": "creds", "namespace": "default"},
+            "data": {"user": "u1"}}))
+        fed.fed.create(ConfigMap.from_dict({
+            "metadata": {"name": "conf", "namespace": "default"},
+            "data": {"mode": "fast"}}))
+        data = lambda o: dict(o.data)  # noqa: E731
+        await until(lambda: member_field(fed, "Secret", "creds", data)
+                    == {"m0": {"user": "u1"}, "m1": {"user": "u1"}})
+        await until(lambda: member_field(fed, "ConfigMap", "conf", data)
+                    == {"m0": {"mode": "fast"}, "m1": {"mode": "fast"}})
+        # the member copy carries the cluster label, verbatim payload
+        copy = fed.members["m0"].get("Secret", "creds")
+        assert copy.metadata.labels[
+            "federation.kubernetes.io/cluster"] == "m0"
+        assert copy.type == "Opaque"
+        # hub edit converges on every member
+        cm = fed.fed.get("ConfigMap", "conf")
+        cm.data["mode"] = "safe"
+        fed.fed.update(cm, check_version=False)
+        await until(lambda: member_field(fed, "ConfigMap", "conf", data)
+                    == {"m0": {"mode": "safe"}, "m1": {"mode": "safe"}})
+        # hub delete cleans every member
+        fed.fed.delete("Secret", "creds")
+        fed.fed.delete("ConfigMap", "conf")
+        await until(lambda: member_field(fed, "Secret", "creds", data)
+                    == {"m0": None, "m1": None})
+        await until(lambda: member_field(fed, "ConfigMap", "conf", data)
+                    == {"m0": None, "m1": None})
+        fed.stop()
+
+    asyncio.run(run())
+
+
+# ---- GlobalPlanner: device-solved cross-cluster placement ----
+
+
+def gobj(name, replicas, cpu="200m", gang_min=None):
+    """A globally-placed ReplicaSet (optionally a gang at `gang_min`)."""
+    rs = rs_obj(name, replicas=replicas)
+    rs.spec["template"]["spec"]["containers"][0]["resources"][
+        "requests"]["cpu"] = cpu
+    rs.metadata.annotations[PLACEMENT_ANNOTATION] = PLACEMENT_GLOBAL
+    if gang_min is not None:
+        rs.metadata.annotations[GROUP_NAME_ANNOTATION] = name
+        rs.metadata.annotations[GROUP_MIN_ANNOTATION] = str(gang_min)
+    return rs
+
+
+def test_global_planner_places_mixed_workload_across_clusters():
+    """The acceptance drill: a mixed federated workload (plain ReplicaSet,
+    gang ReplicaSet, PodGroup) lands across >= 3 member clusters via one
+    batched device solve, and the sync controller materialises exactly the
+    planned counts on each member."""
+    from kubernetes_tpu.federation.kubefed import (
+        FederationControlPlane,
+        join,
+    )
+
+    async def run():
+        members = {f"m{i}": ObjectStore() for i in range(3)}
+        for i, store in enumerate(members.values()):
+            # 1 cpu free per member: 13 x 200m replicas cannot fit on two
+            store.create(ready_node(f"n{i}", cpu="1", memory="4Gi",
+                                    pods="64", zone=f"z{i}"))
+
+        def client(cluster):
+            store = members.get(cluster.metadata.name)
+            if store is None:
+                raise ConnectionError(cluster.metadata.name)
+            return store
+
+        fed = ObjectStore()
+        plane = FederationControlPlane(fed, client, health_period=0.05,
+                                       planner=True, plan_interval=0.05)
+        await plane.start()
+        for name in members:
+            join(fed, name, f"http://{name}:8080")
+        await until(lambda: all(
+            c.ready and c.capacity
+            for c in fed.list("Cluster", copy_objects=False)))
+
+        fed.create(gobj("web", 6))
+        fed.create(gobj("ring", 4, gang_min=4))
+        pg = PodGroup.from_dict({
+            "metadata": {"name": "train", "namespace": "default",
+                         "annotations": {
+                             PLACEMENT_ANNOTATION: PLACEMENT_GLOBAL}},
+            "spec": {"minMember": 3,
+                     "template": {"spec": {"containers": [
+                         {"name": "c", "resources": {
+                             "requests": {"cpu": "200m"}}}]}}}})
+        fed.create(pg)
+        targets = (("ReplicaSet", "web"), ("ReplicaSet", "ring"),
+                   ("PodGroup", "train"))
+
+        def plans():
+            return {(k, n): parse_plan(fed.get(k, n)) for k, n in targets}
+
+        await until(lambda: all(
+            p is not None and p["unplaced"] == 0
+            for p in plans().values()), timeout=120)
+        decided = plans()
+        used = {c for p in decided.values()
+                for c, n in p["clusters"].items() if n > 0}
+        assert len(used) >= 3, decided
+        # every plan is total and the gang stayed whole
+        assert sum(decided[("ReplicaSet", "web")]["clusters"].values()) == 6
+        assert sum(decided[("ReplicaSet", "ring")]["clusters"].values()) == 4
+        assert sum(decided[("PodGroup", "train")]["clusters"].values()) == 3
+        # sync materialises exactly the planned counts on each member
+        field = {"ReplicaSet": "replicas", "PodGroup": "minMember"}
+
+        def member_counts(kind, name):
+            out = {}
+            for cname, store in members.items():
+                objs = [o for o in store.list(kind, copy_objects=False)
+                        if o.metadata.name == name]
+                if objs:
+                    out[cname] = int(objs[0].spec.get(field[kind]) or 0)
+            return out
+
+        for kind, name in targets:
+            want = {c: n for c, n in
+                    decided[(kind, name)]["clusters"].items() if n > 0}
+            await until(lambda k=kind, n=name, w=want:
+                        member_counts(k, n) == w, timeout=30)
+        # a planned gang slice binds all-or-nothing per member
+        for cname, n in member_counts("ReplicaSet", "ring").items():
+            copy = members[cname].get("ReplicaSet", "ring")
+            assert copy.metadata.annotations[GROUP_MIN_ANNOTATION] == str(n)
+        # the traceparent stitched onto the plan rides the member copy
+        hub_trace = fed.get("ReplicaSet", "web").metadata.annotations[
+            TRACE_ANNOTATION]
+        some = next(iter(member_counts("ReplicaSet", "web")))
+        assert members[some].get("ReplicaSet", "web").metadata.annotations[
+            TRACE_ANNOTATION] == hub_trace
+        # the planner surfaced its decision on the Cluster objects
+        await until(lambda: any(
+            c.planner_status.get("placements", 0) > 0
+            for c in fed.list("Cluster", copy_objects=False)), timeout=30)
+        assert plane.planner.cycles >= 1
+        assert plane.planner.placements >= 3
+        plane.stop()
+
+    asyncio.run(run())
+
+
+def mk_capacity_cluster(name, cpu_m=8000, pods=50, headroom=0):
+    free = {"cpu": f"{cpu_m}m", "memory": f"{2 * cpu_m}Mi",
+            "pods": str(pods)}
+    return Cluster.from_dict({
+        "metadata": {"name": name},
+        "spec": {"serverAddress": f"fake://{name}"},
+        "status": {"conditions": [{"type": "Ready", "status": "True"}],
+                   "capacity": {"allocatable": dict(free),
+                                "free": free, "zones": [],
+                                "nodes": 1, "headroom": headroom}}})
+
+
+class _LedgerStub:
+    """A sync-controller stand-in: hands the planner canned rejections."""
+
+    def __init__(self):
+        self.pending = []
+
+    def take_rejections(self):
+        out, self.pending = self.pending, []
+        return out
+
+
+def test_planner_spillover_masks_rejecting_cluster_and_replans():
+    async def run():
+        fed = ObjectStore()
+        fed.create(mk_capacity_cluster("m0"))
+        fed.create(mk_capacity_cluster("m1"))
+        clusters = Informer(fed, "Cluster")
+        workloads = Informer(fed, "ReplicaSet")
+        clusters.start()
+        workloads.start()
+        await clusters.wait_for_sync()
+        await workloads.wait_for_sync()
+        ledger = _LedgerStub()
+        planner = GlobalPlanner(fed, clusters, {"ReplicaSet": workloads},
+                                sync_controller=ledger, mask_cycles=2)
+        fed.create(gobj("web", 4))
+        await until(lambda: workloads.get("web") is not None)
+        assert await planner.run_once() == 1
+        await until(lambda: parse_plan(workloads.get("web")) is not None)
+        first = parse_plan(fed.get("ReplicaSet", "web"))
+        assert sum(first["clusters"].values()) == 4
+        victim = next(c for c, n in first["clusters"].items() if n > 0)
+
+        # the member refused the write: the sync ledger reports it, the
+        # planner masks the cluster and re-enters the workload
+        ledger.pending = [("ReplicaSet", "default/web", victim)]
+        await planner.run_once()
+        assert planner.spillovers == 1
+        assert planner.spill_by_cluster == {victim: 1}
+        await until(lambda: parse_plan(workloads.get("web")) != first)
+        second = parse_plan(fed.get("ReplicaSet", "web"))
+        survivor = ({"m0", "m1"} - {victim}).pop()
+        assert second["clusters"] == {survivor: 4}
+        assert second["unplaced"] == 0
+        clusters.stop()
+        workloads.stop()
+
+    asyncio.run(run())
+
+
+def test_planner_parity_with_serial_oracle():
+    """Randomized seeds: the planner's device solve over cluster rows
+    (incl. an all-or-nothing gang) matches the host-side SerialScheduler
+    oracle verbatim, per replica."""
+    from kubernetes_tpu.autoscaler.simulator import ScaleSimulator
+    from kubernetes_tpu.state.layout import Capacities
+
+    from tests.serial_reference import federation_placement
+
+    for seed in range(6):
+        rng = random.Random(900 + seed)
+        n = rng.randint(3, 5)
+        # strictly distinct cpu capacities -> strictly ordered scores: a
+        # host/device float tie cannot flip the argmax
+        clusters = [
+            mk_capacity_cluster(
+                f"c{i}", cpu_m=2000 + 400 * i + 100 * rng.randint(0, 3),
+                pods=rng.randint(8, 12))
+            for i in range(n)]
+        workloads = [
+            gobj(f"w{j}", rng.randint(2, 5),
+                 cpu=f"{rng.choice((300, 500, 700))}m")
+            for j in range(rng.randint(2, 4))]
+        size = rng.randint(2, 4)
+        workloads.append(gobj("gang", size,
+                              cpu=f"{rng.choice((300, 500))}m",
+                              gang_min=size))
+        expected = federation_placement(clusters, workloads)
+        sim = ScaleSimulator(caps=Capacities(num_nodes=32, batch_pods=64))
+        for c in clusters:
+            sim.upsert_node(cluster_node(c))
+        pods = [p for obj in workloads for p in workload_pods(obj)]
+        got = sim.solve_assignments(pods)
+        assert got == expected, f"seed {seed}: {got} != {expected}"
+
+
+# ---- satellite: bench[fed] --smoke drift gate ----
+
+
+def test_bench_fed_smoke_mode():
+    """bench.py --smoke with the federation config must stay runnable
+    end-to-end: the hub plans, the saturated member spills over, and the
+    gates (exactly-once, convergence, zero racy writes) hold."""
+    import os
+    import subprocess
+    import sys
+    from pathlib import Path
+
+    repo = Path(__file__).resolve().parents[1]
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["BENCH_CONFIGS"] = "fed"
+    env["BENCH_FED_CLUSTERS"] = "3"
+    env["BENCH_FED_PODS"] = "12"
+    proc = subprocess.run(
+        [sys.executable, "bench.py", "--smoke"], cwd=repo, env=env,
+        capture_output=True, text=True, timeout=420)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    line = [ln for ln in proc.stdout.splitlines() if ln.strip()][-1]
+    result = json.loads(line)
+    assert "error" not in result, result
+    extras = result["extras"]
+    assert extras["fed_planned"] == extras["fed_workloads"]
+    assert extras["fed_placed"] == 12
+    assert extras["fed_spillovers"] >= 1
+    # only reported when bench ran under the race detector
+    assert extras.get("fed_racy_writes", 0) == 0
+    assert extras["fed_solves"] >= 1

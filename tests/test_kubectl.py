@@ -335,3 +335,43 @@ def test_top_nodes_and_pods():
         assert rc == 0 and "p1" in out
         line = next(ln for ln in out.splitlines() if ln.startswith("p1"))
         assert "0.50" in line and "1024" in line
+
+
+def test_get_clusters_columns_and_describe_planner():
+    """`kubectl get clusters` surfaces the health probe's capacity report
+    (READY/CAPACITY/ALLOCATED/ZONES) and `describe cluster` renders the
+    GlobalPlanner's last decision + spillover count."""
+    with http_store() as (client, _store):
+        from kubernetes_tpu.api.objects import Cluster
+
+        client.create(Cluster.from_dict({
+            "metadata": {"name": "east", "namespace": "default"},
+            "spec": {"serverAddress": "http://east:8080"},
+            "status": {
+                "conditions": [{"type": "Ready", "status": "True"}],
+                "capacity": {
+                    "allocatable": {"cpu": "8000m", "memory": "16384Mi",
+                                    "pods": "20"},
+                    "free": {"cpu": "6000m", "memory": "12288Mi",
+                             "pods": "15"},
+                    "zones": ["z-a", "z-b"], "nodes": 2, "headroom": 3},
+                "planner": {"placements": 5, "spillovers": 1,
+                            "masked": False,
+                            "lastDecision": {
+                                "ReplicaSet/default/web": 3,
+                                "PodGroup/default/train": 2}}}}))
+        rc, out = run_cli(client, "get", "clusters")
+        assert rc == 0
+        header, row = [ln.split() for ln in out.splitlines()[:2]]
+        assert header == ["NAME", "READY", "CAPACITY", "ALLOCATED",
+                         "ZONES", "AGE"]
+        assert row[:5] == ["east", "True", "8000m,16384Mi",
+                           "2000m,4096Mi", "z-a,z-b"]
+
+        rc, out = run_cli(client, "describe", "cluster", "east")
+        assert rc == 0
+        assert "Planner:" in out
+        assert "Placements:\t5" in out
+        assert "Spillovers:\t1" in out
+        assert "Decision:\tReplicaSet/default/web -> 3 replicas" in out
+        assert "Decision:\tPodGroup/default/train -> 2 replicas" in out

@@ -682,6 +682,35 @@ def test_r6_replication_prefix_scoped_to_module():
         rules=R6)) == 1
 
 
+def test_r6_flags_unprefixed_federation_family():
+    # the federation hub scrapes its own and every member's apiserver
+    # into one dashboard: any family DEFINED under kubernetes_tpu/
+    # federation/ carries the federation_ prefix (a bare planner
+    # cycles_total would shadow member scheduler families)
+    src = (
+        "def metrics(r):\n"
+        "    bad = r.counter('planner_cycles_total', 'd')\n"
+        "    bad_g = r.gauge('clusters_ready', 'd')\n"
+        "    bad_h = r.histogram('plan_solve_seconds', 'd')\n"
+        "    ok = r.counter('federation_planner_cycles_total', 'd')\n"
+        "    ok_h = r.histogram('federation_planner_solve_seconds', 'd')\n"
+    )
+    found = lint_source(
+        src, relpath="kubernetes_tpu/federation/planner.py", rules=R6)
+    fed = [f for f in found if "federation_ prefix" in f.message]
+    assert sorted(f.line for f in fed) == [2, 3, 4]
+
+
+def test_r6_federation_prefix_scoped_to_package():
+    # the same bare family elsewhere is legal (members own their local
+    # namespaces); only definitions inside federation/ are gated
+    src = "def metrics(r):\n    r.gauge('clusters_ready', 'd')\n"
+    assert lint_source(src, relpath="kubernetes_tpu/scheduler/x.py",
+                       rules=R6) == []
+    assert len(lint_source(
+        src, relpath="kubernetes_tpu/federation/sync.py", rules=R6)) == 1
+
+
 def test_r4_covers_solversvc_scope():
     # the continuous batcher's window must be ManualClock-warpable and
     # its coalescing order replayable: wall-clock and ambient rng are
